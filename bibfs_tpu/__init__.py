@@ -1,0 +1,24 @@
+"""bibfs_tpu — a TPU-native bidirectional-BFS framework.
+
+A ground-up JAX/XLA/Pallas re-design of the capabilities of the reference
+Bidirectional-BFS project (four solver backends: serial CPU, MPI bitset,
+CUDA single-GPU, hybrid MPI+CUDA). Instead of four copy-pasted mains, this
+framework exposes ONE solver API with multiple backends:
+
+- ``serial``  — host NumPy oracle (reference v1, v1/main-v1.cpp:50-81)
+- ``native``  — C++ serial solver via ctypes (native-runtime v1 parity)
+- ``dense``   — single-chip JAX solver, device-resident ``lax.while_loop``
+                (reference v3, v3/bibfs_cuda_only.cu:173-203, without the
+                per-level host round-trips of v4/comp.cu:84-107)
+- ``sharded`` — multi-chip ``shard_map`` solver over a 1D vertex-partitioned
+                mesh with psum/all_gather collectives (reference v2+v4,
+                v2/second_try.cpp:68-129 + v4/mpi_bas.cpp:79-132, with real
+                owner-computes partitioning instead of full replication)
+
+Graph data layer is bit-compatible with the reference binary format
+(uint32 N, uint32 M, M uint32 pairs; graphs/generate_graph.py:35-39).
+"""
+
+__version__ = "0.1.0"
+
+from bibfs_tpu.solvers.api import BFSResult, solve, SOLVERS  # noqa: F401

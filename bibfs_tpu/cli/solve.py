@@ -1,0 +1,83 @@
+"""CLI solver — the reference's common binary contract, one binary for all
+backends.
+
+Reference contract: ``<exe> <graph.bin> <src> <dst>`` (v1/main-v1.cpp:15,
+v2/second_try.cpp:23, v3/bibfs_cuda_only.cu:66, v4/mpi_bas.cpp:19), printing
+a scrapeable time line, a "Shortest path length = N" line and a "Path: ..."
+line (v1/main-v1.cpp:93-101). We keep those exact output shapes so the
+reference's awk harness patterns (benchmark_test.sh:61-69) scrape this
+solver unmodified, and add ``--backend`` to select the engine.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Bidirectional BFS (TPU-native framework)"
+    )
+    ap.add_argument("graph", help="binary graph file (uint32 N,M + edge pairs)")
+    ap.add_argument("src", type=int)
+    ap.add_argument("dst", type=int)
+    ap.add_argument(
+        "--backend",
+        default="serial",
+        help="serial | native | dense | sharded (default: serial)",
+    )
+    ap.add_argument(
+        "--devices",
+        type=int,
+        default=None,
+        help="mesh size for --backend sharded (default: all visible devices)",
+    )
+    ap.add_argument("--no-path", action="store_true", help="skip path printing")
+    args = ap.parse_args(argv)
+
+    from bibfs_tpu.graph.io import read_graph_bin
+    from bibfs_tpu.solvers.api import solve
+
+    try:
+        n, edges = read_graph_bin(args.graph)
+    except (OSError, ValueError) as e:
+        print(f"Error reading graph: {e}", file=sys.stderr)
+        return 2
+
+    kwargs = {}
+    if args.devices is not None:
+        kwargs["num_devices"] = args.devices
+    try:
+        res = solve(args.backend, n, edges, args.src, args.dst, **kwargs)
+    except KeyError as e:
+        print(f"Error: {e.args[0]}", file=sys.stderr)
+        return 2
+    except ValueError as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 2
+
+    if res.found:
+        print(f"Shortest path length = {res.hops}")
+        if res.path and not args.no_path:
+            print("Path: " + " -> ".join(str(v) for v in res.path))
+    else:
+        print("No path found.")
+    # scrapeable time line (same shape as v1/main-v1.cpp:101)
+    print(f"[Time] {args.backend} bidirectional BFS took {res.time_s:.9f} seconds")
+    print(f"[TEPS] {res.teps:.3e} traversed edges/second ({res.edges_scanned} edges)")
+    return 0
+
+
+def _main():
+    try:
+        return main()
+    except BrokenPipeError:  # e.g. piped into `head`
+        import os
+
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(_main())

@@ -1,0 +1,8 @@
+from bibfs_tpu.graph.io import (  # noqa: F401
+    read_graph_bin,
+    write_graph_bin,
+    read_ground_truth,
+    write_ground_truth,
+)
+from bibfs_tpu.graph.csr import build_csr, build_ell, EllGraph  # noqa: F401
+from bibfs_tpu.graph.generate import gnp_random_graph, rmat_graph  # noqa: F401

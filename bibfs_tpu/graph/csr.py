@@ -1,0 +1,130 @@
+"""Host-side graph builders: CSR and ELL (padded) adjacency.
+
+The reference builds CSR on the host with a degree-count + prefix-sum +
+scatter pass (v3/bibfs_cuda_only.cu:89-99, v4/mpi_bas.cpp:45-58). We do the
+same vectorized in NumPy, then additionally *regularize* the CSR into ELL
+form — a dense ``[n_pad, width]`` neighbor table — because TPU frontier
+expansion is a dense gather over that table (variable-length CSR rows are
+the canonical bad fit for a dense-vector machine; see SURVEY.md §7).
+
+For G(n, p) random graphs with small average degree the max degree is
+O(log n / log log n), so ELL padding waste is modest. Power-law graphs
+(RMAT) need the hybrid ELL + COO-overflow layout; ``build_ell`` supports a
+``width_cap`` that spills high-degree rows into an overflow COO list.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+
+def _mirror_and_dedup(n: int, edges: np.ndarray) -> np.ndarray:
+    """Mirror undirected edges into a directed pair list, drop self-loops
+    and duplicates. Returns an ``(E, 2)`` int64 array sorted by source."""
+    edges = np.asarray(edges, dtype=np.int64).reshape(-1, 2)
+    both = np.concatenate([edges, edges[:, ::-1]], axis=0)
+    both = both[both[:, 0] != both[:, 1]]
+    # unique via linear keys
+    keys = both[:, 0] * n + both[:, 1]
+    keys = np.unique(keys)
+    out = np.empty((keys.size, 2), dtype=np.int64)
+    out[:, 0] = keys // n
+    out[:, 1] = keys % n
+    return out
+
+
+def build_csr(n: int, edges: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Build a symmetric CSR adjacency (row_ptr[n+1], col_ind[2E]).
+
+    Mirrors edges for undirectedness like the reference loader
+    (graphs/read_graph.py:13-16) and dedups — the reference generator never
+    emits duplicates so dedup is a no-op on its files.
+    """
+    pairs = _mirror_and_dedup(n, edges)
+    deg = np.bincount(pairs[:, 0], minlength=n)
+    row_ptr = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum(deg, out=row_ptr[1:])
+    col_ind = pairs[:, 1].copy()  # already grouped+sorted by source
+    return row_ptr, col_ind
+
+
+@dataclasses.dataclass
+class EllGraph:
+    """Device-ready regularized adjacency.
+
+    - ``nbr``: int32 ``[n_pad, width]`` neighbor table, rows padded with 0
+      beyond ``deg[v]`` entries (padding is masked by ``deg`` at use sites).
+    - ``deg``: int32 ``[n_pad]`` true degree per vertex (0 for pad vertices).
+    - ``overflow``: int32 ``[n_over, 2]`` COO (u, v) pairs for edges that did
+      not fit under ``width`` when a cap was applied (empty otherwise).
+    """
+
+    n: int
+    n_pad: int
+    width: int
+    num_edges: int  # undirected unique edge count
+    nbr: np.ndarray
+    deg: np.ndarray
+    overflow: np.ndarray
+
+    @property
+    def num_directed_edges(self) -> int:
+        return int(self.deg.sum()) + self.overflow.shape[0]
+
+
+def build_ell(
+    n: int,
+    edges: np.ndarray,
+    *,
+    width_cap: int | None = None,
+    pad_multiple: int = 8,
+) -> EllGraph:
+    """Regularize an undirected edge list into ELL form.
+
+    ``pad_multiple`` rounds ``n_pad`` up so vertex arrays tile evenly across
+    a device mesh (the sharded solver requires ``n_pad % num_devices == 0``).
+    """
+    pairs = _mirror_and_dedup(n, edges)
+    num_edges = pairs.shape[0] // 2
+    deg = np.bincount(pairs[:, 0], minlength=n).astype(np.int64)
+    max_deg = int(deg.max()) if deg.size and pairs.size else 0
+    width = max(1, max_deg)
+    overflow = np.zeros((0, 2), dtype=np.int32)
+    if width_cap is not None and width > width_cap:
+        width = max(1, width_cap)
+        # rank of each directed edge within its row
+        row_ptr = np.zeros(n + 1, dtype=np.int64)
+        np.cumsum(deg, out=row_ptr[1:])
+        rank = np.arange(pairs.shape[0]) - row_ptr[pairs[:, 0]]
+        spill = rank >= width
+        overflow = pairs[spill].astype(np.int32)
+        pairs = pairs[~spill]
+        deg = np.minimum(deg, width)
+
+    n_pad = -(-n // pad_multiple) * pad_multiple
+    nbr = np.zeros((n_pad, width), dtype=np.int32)
+    if pairs.size:
+        row_ptr = np.zeros(n + 1, dtype=np.int64)
+        np.cumsum(deg, out=row_ptr[1:])
+        rank = np.arange(pairs.shape[0]) - row_ptr[pairs[:, 0]]
+        nbr[pairs[:, 0], rank] = pairs[:, 1]
+    deg_pad = np.zeros(n_pad, dtype=np.int32)
+    deg_pad[:n] = deg
+    return EllGraph(
+        n=n,
+        n_pad=n_pad,
+        width=width,
+        num_edges=num_edges,
+        nbr=nbr,
+        deg=deg_pad,
+        overflow=overflow,
+    )
+
+
+def ell_from_file(path, **kwargs) -> EllGraph:
+    from bibfs_tpu.graph.io import read_graph_bin
+
+    n, edges = read_graph_bin(path)
+    return build_ell(n, edges, **kwargs)

@@ -1,0 +1,159 @@
+"""Graph generators: G(n, p) and RMAT, NumPy-vectorized (no NetworkX).
+
+Reference contract: ``generate_graph.py --n --p --src --dst --out`` builds
+``nx.fast_gnp_random_graph(N, P)`` (graphs/generate_graph.py:31), writes the
+binary edge list (35-39) and a ground-truth JSON with the true shortest path
+(42-62). The reference README's own limitation note (README.md:19) says
+NetworkX cannot reach 10M-node graphs; these generators are O(M) vectorized
+NumPy and do reach them (RMAT scale-23 per BASELINE.json configs).
+
+Ground truth here is computed by this framework's serial oracle solver and
+cross-validated against NetworkX in the test suite.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+
+def _linear_to_upper_pair(k: np.ndarray, n: int) -> tuple[np.ndarray, np.ndarray]:
+    """Map linear indices over the upper triangle {(i, j): i < j}, ordered by
+    row then column, back to (i, j). Float solve + integer correction."""
+    k = k.astype(np.int64)
+    twon1 = 2 * n - 1
+    i = np.floor((twon1 - np.sqrt(np.maximum(twon1 * twon1 - 8.0 * k, 0.0))) / 2.0)
+    i = i.astype(np.int64)
+    i = np.clip(i, 0, n - 2)
+
+    def start(i):
+        return i * n - (i * (i + 1)) // 2
+
+    for _ in range(4):  # fix float rounding, ±2 at most
+        i = np.where(start(i + 1) <= k, i + 1, i)
+        i = np.where(start(i) > k, i - 1, i)
+        i = np.clip(i, 0, n - 2)
+    j = i + 1 + (k - start(i))
+    return i, j
+
+
+def gnp_random_graph(
+    n: int, p: float, *, seed: int | None = None
+) -> np.ndarray:
+    """Sample G(n, p) as an ``(M, 2)`` unique undirected edge array.
+
+    Exact in distribution: M ~ Binomial(C(n,2), p), then M distinct pairs
+    uniformly without replacement (equivalent to per-pair Bernoulli(p)).
+    O(M) memory/time — unlike a dense matrix, works for n in the millions.
+    """
+    rng = np.random.default_rng(seed)
+    total = n * (n - 1) // 2
+    if total == 0 or p <= 0:
+        return np.zeros((0, 2), dtype=np.int64)
+    m = int(rng.binomial(total, min(p, 1.0))) if p < 1.0 else total
+    picks = np.zeros(0, dtype=np.int64)
+    while picks.size < m:
+        need = m - picks.size
+        cand = rng.integers(0, total, size=int(need * 1.1) + 16, dtype=np.int64)
+        picks = np.unique(np.concatenate([picks, cand]))
+    if picks.size > m:
+        picks = rng.permutation(picks)[:m]
+    i, j = _linear_to_upper_pair(picks, n)
+    return np.stack([i, j], axis=1)
+
+
+def rmat_graph(
+    scale: int,
+    edge_factor: int = 16,
+    *,
+    a: float = 0.57,
+    b: float = 0.19,
+    c: float = 0.19,
+    seed: int | None = None,
+    dedup: bool = True,
+) -> tuple[int, np.ndarray]:
+    """Graph500-style RMAT generator. Returns ``(n, edges)`` with n = 2**scale.
+
+    Kronecker recursive quadrant sampling, vectorized over all edges per bit
+    level (scale iterations over length-M arrays).
+    """
+    rng = np.random.default_rng(seed)
+    n = 1 << scale
+    m = n * edge_factor
+    row = np.zeros(m, dtype=np.int64)
+    col = np.zeros(m, dtype=np.int64)
+    ab, abc = a + b, a + b + c
+    for _ in range(scale):
+        u = rng.random(m)
+        row_bit = u >= ab
+        col_bit = ((u >= a) & (u < ab)) | (u >= abc)
+        row = (row << 1) | row_bit
+        col = (col << 1) | col_bit
+    edges = np.stack([row, col], axis=1)
+    edges = edges[edges[:, 0] != edges[:, 1]]
+    if dedup:
+        lo = np.minimum(edges[:, 0], edges[:, 1])
+        hi = np.maximum(edges[:, 0], edges[:, 1])
+        keys = np.unique(lo * n + hi)
+        edges = np.stack([keys // n, keys % n], axis=1)
+    return n, edges
+
+
+def generate_with_ground_truth(
+    out_path: str,
+    n: int,
+    p: float,
+    src: int,
+    dst: int | None = None,
+    *,
+    seed: int | None = None,
+) -> dict:
+    """Reference ``generate_graph.py`` parity: write .bin + ground-truth .json."""
+    from bibfs_tpu.graph.io import (
+        ground_truth_path,
+        write_graph_bin,
+        write_ground_truth,
+    )
+    from bibfs_tpu.solvers.serial import solve_serial
+
+    if dst is None:
+        dst = n - 1
+    edges = gnp_random_graph(n, p, seed=seed)
+    write_graph_bin(out_path, n, edges)
+    res = solve_serial(n, edges, src, dst)
+    write_ground_truth(
+        ground_truth_path(out_path),
+        src,
+        dst,
+        res.hops if res.found else None,
+        res.path if res.found else None,
+    )
+    return {
+        "n": n,
+        "m": int(edges.shape[0]),
+        "hop_count": res.hops if res.found else None,
+    }
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description="Generate a random graph + ground truth")
+    ap.add_argument("--n", type=int, required=True)
+    ap.add_argument("--p", type=float, default=None, help="edge probability (gnp)")
+    ap.add_argument("--src", type=int, default=0)
+    ap.add_argument("--dst", type=int, default=None, help="default n-1")
+    ap.add_argument("--out", type=str, required=True)
+    ap.add_argument("--seed", type=int, default=None)
+    ap.add_argument("--avg-deg", type=float, default=None, help="sets p = avg_deg / n")
+    args = ap.parse_args(argv)
+    p = args.p if args.p is not None else (args.avg_deg or 2.2000000001) / args.n
+    info = generate_with_ground_truth(
+        args.out, args.n, p, args.src, args.dst, seed=args.seed
+    )
+    print(
+        f"wrote {args.out}: n={info['n']} m={info['m']} hop_count={info['hop_count']}"
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,83 @@
+"""Binary graph format IO — bit-compatible with the reference on-disk contract.
+
+Format (little-endian): ``uint32 N``, ``uint32 M``, then ``M`` pairs of
+``uint32 (u, v)`` undirected edges. Writer in the reference:
+graphs/generate_graph.py:35-39; readers: v1/main-v1.cpp:26-30,
+v3/bibfs_cuda_only.cu:74-87, graphs/read_graph.py:6-11.
+
+Alongside each ``<name>.bin`` the reference ships a ground-truth JSON
+``{source, target, hop_count, nodes}`` (graphs/generate_graph.py:53-62);
+we read and write the same schema so reference graph suites are drop-in.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+import numpy as np
+
+_HEADER_DTYPE = np.dtype("<u4")
+
+
+def write_graph_bin(path: str | os.PathLike, n: int, edges: np.ndarray) -> None:
+    """Write an undirected edge list in the reference binary format.
+
+    ``edges`` is an ``(M, 2)`` integer array of endpoint pairs. Each
+    undirected edge is stored once, exactly as the reference writer does.
+    """
+    edges = np.ascontiguousarray(edges, dtype=_HEADER_DTYPE).reshape(-1, 2)
+    m = edges.shape[0]
+    with open(path, "wb") as f:
+        np.array([n, m], dtype=_HEADER_DTYPE).tofile(f)
+        edges.tofile(f)
+
+
+def read_graph_bin(path: str | os.PathLike) -> tuple[int, np.ndarray]:
+    """Read the reference binary format. Returns ``(n, edges[M, 2])``.
+
+    Validates the file size against the header the way the reference's
+    legacy reader did (v2/read_in.cpp:16-22) — truncated files raise.
+    """
+    with open(path, "rb") as f:
+        header = np.fromfile(f, dtype=_HEADER_DTYPE, count=2)
+        if header.size != 2:
+            raise ValueError(f"{path}: truncated header")
+        n, m = int(header[0]), int(header[1])
+        data = np.fromfile(f, dtype=_HEADER_DTYPE)
+    if data.size != 2 * m:
+        raise ValueError(
+            f"{path}: header claims {m} edges ({2 * m} words) but file has "
+            f"{data.size} payload words"
+        )
+    return n, data.reshape(m, 2).astype(np.int64)
+
+
+def write_ground_truth(
+    path: str | os.PathLike,
+    source: int,
+    target: int,
+    hop_count: Optional[int],
+    nodes: Optional[list[int]],
+) -> None:
+    """Write the reference ground-truth JSON schema (generate_graph.py:53-62)."""
+    payload = {
+        "source": int(source),
+        "target": int(target),
+        "hop_count": None if hop_count is None else int(hop_count),
+        "nodes": None if nodes is None else [int(v) for v in nodes],
+    }
+    with open(path, "w") as f:
+        json.dump(payload, f)
+
+
+def read_ground_truth(path: str | os.PathLike) -> dict:
+    with open(path) as f:
+        return json.load(f)
+
+
+def ground_truth_path(bin_path: str | os.PathLike) -> str:
+    """The JSON sidecar path convention: ``foo.bin`` → ``foo.json``."""
+    root, _ = os.path.splitext(os.fspath(bin_path))
+    return root + ".json"

@@ -1,0 +1,1 @@
+from bibfs_tpu.solvers.api import BFSResult, solve, SOLVERS  # noqa: F401

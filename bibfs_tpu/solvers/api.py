@@ -1,0 +1,87 @@
+"""The single solver API all backends implement.
+
+The reference implements "the API" four separate times as standalone mains
+with a shared CLI contract ``<exe> <graph.bin> <src> <dst>`` and scraped
+stdout (SURVEY.md §1-L2). Here every backend is a function returning a
+:class:`BFSResult`, so correctness (hop/path parity) is asserted in code
+instead of eyeballed from logs — and hop counts are TRUE hop counts
+(the reference's v2 reports round counts, second_try.cpp:107,134 — quirk Q1
+— which this framework fixes rather than reproduces).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class BFSResult:
+    found: bool
+    hops: Optional[int]  # true shortest-path edge count (None if no path)
+    path: Optional[list[int]]  # [src, ..., dst] (None if no path)
+    meet: Optional[int]  # meeting vertex of the two searches
+    time_s: float  # search loop only, matching reference timed regions
+    levels: int  # number of frontier expansions performed
+    edges_scanned: int  # directed edges examined (for TEPS)
+
+    @property
+    def teps(self) -> float:
+        return self.edges_scanned / self.time_s if self.time_s > 0 else float("inf")
+
+    def validate_path(self, n: int, edges: np.ndarray, src: int, dst: int) -> None:
+        """Assert the reported path is a real path of the reported length."""
+        if not self.found:
+            return
+        assert self.path is not None and self.hops == len(self.path) - 1
+        assert self.path[0] == src and self.path[-1] == dst
+        es = set()
+        for u, v in np.asarray(edges).reshape(-1, 2):
+            es.add((int(u), int(v)))
+            es.add((int(v), int(u)))
+        for a, b in zip(self.path, self.path[1:]):
+            assert (a, b) in es, f"path edge ({a},{b}) not in graph"
+
+
+SOLVERS: dict[str, Callable] = {}
+
+
+def register(name: str):
+    def deco(fn):
+        SOLVERS[name] = fn
+        return fn
+
+    return deco
+
+
+def solve(
+    backend: str, n: int, edges: np.ndarray, src: int, dst: int, **kwargs
+) -> BFSResult:
+    """Uniform entry: build whatever representation the backend needs and run.
+
+    Backends are registered lazily; importing this module does not pull in
+    JAX. Use the backend modules directly to control graph-build vs search
+    timing separately (the reference times only the search loop).
+    """
+    _ensure_registered()
+    if backend not in SOLVERS:
+        raise KeyError(f"unknown backend {backend!r}; have {sorted(SOLVERS)}")
+    return SOLVERS[backend](n, edges, src, dst, **kwargs)
+
+
+def _ensure_registered():
+    import bibfs_tpu.solvers.serial  # noqa: F401
+
+    if "dense" not in SOLVERS:
+        try:
+            import bibfs_tpu.solvers.dense  # noqa: F401
+            import bibfs_tpu.solvers.sharded  # noqa: F401
+        except ImportError:  # JAX unavailable — host backends still work
+            pass
+    if "native" not in SOLVERS:
+        try:
+            import bibfs_tpu.solvers.native  # noqa: F401
+        except (ImportError, OSError):
+            pass

@@ -1,0 +1,40 @@
+"""Test configuration: force an 8-device virtual CPU mesh BEFORE jax imports.
+
+This is the moral equivalent of the reference's single-machine fake cluster
+(`mpirun -n 4` on one box, single_machine_bench.sh:9,52) — multi-chip code
+paths run on N virtual CPU devices without TPU hardware (SURVEY.md §4).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)
+
+
+def random_graph_cases(num=20, seed=123, nmin=2, nmax=120):
+    """Small random (n, edges, src, dst) cases for oracle property tests."""
+    rng = np.random.default_rng(seed)
+    cases = []
+    for i in range(num):
+        n = int(rng.integers(nmin, nmax))
+        # span sparse to dense-ish so some cases are disconnected
+        p = float(rng.uniform(0.5, 4.0)) / n
+        from bibfs_tpu.graph.generate import gnp_random_graph
+
+        edges = gnp_random_graph(n, p, seed=int(rng.integers(1 << 30)))
+        src = int(rng.integers(n))
+        dst = int(rng.integers(n))
+        cases.append((n, edges, src, dst))
+    return cases

@@ -1,0 +1,111 @@
+"""Graph layer tests: binary format round-trip + reference-file compatibility,
+CSR/ELL builders, generators."""
+
+import numpy as np
+import pytest
+
+from bibfs_tpu.graph.csr import build_csr, build_ell
+from bibfs_tpu.graph.generate import gnp_random_graph, rmat_graph
+from bibfs_tpu.graph.io import read_graph_bin, write_graph_bin
+
+
+def test_bin_roundtrip(tmp_path):
+    edges = np.array([[0, 1], [1, 2], [2, 3], [0, 3]])
+    p = tmp_path / "g.bin"
+    write_graph_bin(p, 4, edges)
+    n, back = read_graph_bin(p)
+    assert n == 4
+    np.testing.assert_array_equal(back, edges)
+
+
+def test_bin_format_bytes(tmp_path):
+    """Byte-level contract: uint32 N, uint32 M, M little-endian uint32 pairs
+    (reference writer graphs/generate_graph.py:35-39)."""
+    p = tmp_path / "g.bin"
+    write_graph_bin(p, 3, np.array([[0, 2]]))
+    raw = p.read_bytes()
+    assert raw == (3).to_bytes(4, "little") + (1).to_bytes(4, "little") + (
+        0
+    ).to_bytes(4, "little") + (2).to_bytes(4, "little")
+
+
+def test_bin_truncated(tmp_path):
+    p = tmp_path / "bad.bin"
+    write_graph_bin(p, 4, np.array([[0, 1], [1, 2]]))
+    p.write_bytes(p.read_bytes()[:-4])
+    with pytest.raises(ValueError):
+        read_graph_bin(p)
+
+
+def test_csr_symmetric():
+    row_ptr, col_ind = build_csr(4, np.array([[0, 1], [1, 2], [0, 3]]))
+    assert row_ptr.tolist() == [0, 2, 4, 5, 6]
+    # row 0 -> {1, 3}; row 1 -> {0, 2}; row 2 -> {1}; row 3 -> {0}
+    assert sorted(col_ind[0:2].tolist()) == [1, 3]
+    assert sorted(col_ind[2:4].tolist()) == [0, 2]
+
+
+def test_csr_dedup_selfloop():
+    row_ptr, col_ind = build_csr(3, np.array([[0, 1], [1, 0], [2, 2]]))
+    assert row_ptr.tolist() == [0, 1, 2, 2]
+
+
+def test_ell_matches_csr():
+    edges = gnp_random_graph(200, 3.0 / 200, seed=7)
+    row_ptr, col_ind = build_csr(200, edges)
+    g = build_ell(200, edges)
+    assert g.n == 200 and g.n_pad % 8 == 0
+    for v in range(200):
+        csr_nbrs = sorted(col_ind[row_ptr[v] : row_ptr[v + 1]].tolist())
+        ell_nbrs = sorted(g.nbr[v, : g.deg[v]].tolist())
+        assert csr_nbrs == ell_nbrs
+    assert g.deg[200:].sum() == 0
+
+
+def test_ell_width_cap_overflow():
+    # star graph: vertex 0 has degree 5
+    edges = np.array([[0, i] for i in range(1, 6)])
+    g = build_ell(6, edges, width_cap=2)
+    assert g.width == 2
+    assert g.deg[0] == 2
+    # spilled directed edges: 3 out of row 0 (+0 from leaf rows, deg 1 each)
+    assert g.overflow.shape[0] == 3
+    assert g.num_directed_edges == 2 * 5
+
+
+def test_gnp_stats():
+    n, avg = 5000, 2.2
+    edges = gnp_random_graph(n, avg / n, seed=1)
+    assert edges.shape[1] == 2
+    assert (edges[:, 0] < edges[:, 1]).all()
+    m = edges.shape[0]
+    expected = avg * n / 2
+    assert abs(m - expected) < 5 * np.sqrt(expected)
+    # no duplicates
+    keys = edges[:, 0] * n + edges[:, 1]
+    assert np.unique(keys).size == m
+
+
+def test_gnp_indices_in_range():
+    edges = gnp_random_graph(50, 0.2, seed=3)
+    assert edges.min() >= 0 and edges.max() < 50
+
+
+def test_rmat():
+    n, edges = rmat_graph(8, edge_factor=4, seed=5)
+    assert n == 256
+    assert edges.min() >= 0 and edges.max() < n
+    assert (edges[:, 0] != edges[:, 1]).all()
+
+
+def test_generate_with_ground_truth(tmp_path):
+    from bibfs_tpu.graph.generate import generate_with_ground_truth
+    from bibfs_tpu.graph.io import read_ground_truth
+
+    out = tmp_path / "t.bin"
+    info = generate_with_ground_truth(str(out), 100, 3.0 / 100, 0, 99, seed=2)
+    gt = read_ground_truth(tmp_path / "t.json")
+    assert gt["source"] == 0 and gt["target"] == 99
+    if gt["hop_count"] is not None:
+        assert len(gt["nodes"]) == gt["hop_count"] + 1
+        assert info["hop_count"] == gt["hop_count"]

@@ -1,0 +1,58 @@
+"""Oracle property tests: serial solver vs NetworkX shortest_path_length.
+
+Automates the reference's manual golden-oracle checking (SURVEY.md §4):
+the reference eyeballed solver output against NetworkX JSON; here NetworkX
+is the in-test oracle on hundreds of random graphs.
+"""
+
+import networkx as nx
+import numpy as np
+import pytest
+
+from bibfs_tpu.solvers.serial import solve_serial
+from tests.conftest import random_graph_cases
+
+CASES = random_graph_cases(num=40)
+
+
+def nx_hops(n, edges, src, dst):
+    g = nx.Graph()
+    g.add_nodes_from(range(n))
+    g.add_edges_from([tuple(e) for e in np.asarray(edges).reshape(-1, 2)])
+    try:
+        return nx.shortest_path_length(g, src, dst)
+    except nx.NetworkXNoPath:
+        return None
+
+
+@pytest.mark.parametrize("case", range(len(CASES)))
+def test_serial_matches_networkx(case):
+    n, edges, src, dst = CASES[case]
+    res = solve_serial(n, edges, src, dst)
+    expected = nx_hops(n, edges, src, dst)
+    if expected is None:
+        assert not res.found
+    else:
+        assert res.found
+        assert res.hops == expected
+        res.validate_path(n, edges, src, dst)
+
+
+def test_src_equals_dst():
+    res = solve_serial(5, np.array([[0, 1]]), 3, 3)
+    assert res.found and res.hops == 0 and res.path == [3]
+
+
+def test_no_edges():
+    res = solve_serial(4, np.zeros((0, 2), dtype=np.int64), 0, 3)
+    assert not res.found and res.hops is None
+
+
+def test_single_edge():
+    res = solve_serial(2, np.array([[0, 1]]), 0, 1)
+    assert res.found and res.hops == 1 and res.path == [0, 1]
+
+
+def test_out_of_range():
+    with pytest.raises(ValueError):
+        solve_serial(3, np.array([[0, 1]]), 0, 7)
