@@ -6,3 +6,4 @@ from bibfs_tpu.graph.io import (  # noqa: F401
 )
 from bibfs_tpu.graph.csr import build_csr, build_ell, EllGraph  # noqa: F401
 from bibfs_tpu.graph.generate import gnp_random_graph, rmat_graph  # noqa: F401
+from bibfs_tpu.graph.suite import make_suite  # noqa: F401

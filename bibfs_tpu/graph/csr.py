@@ -24,6 +24,11 @@ def _mirror_and_dedup(n: int, edges: np.ndarray) -> np.ndarray:
     """Mirror undirected edges into a directed pair list, drop self-loops
     and duplicates. Returns an ``(E, 2)`` int64 array sorted by source."""
     edges = np.asarray(edges, dtype=np.int64).reshape(-1, 2)
+    if edges.size and (int(edges.min()) < 0 or int(edges.max()) >= n):
+        raise ValueError(
+            f"edge endpoints must be in [0, {n}); got "
+            f"[{int(edges.min())}, {int(edges.max())}]"
+        )
     both = np.concatenate([edges, edges[:, ::-1]], axis=0)
     both = both[both[:, 0] != both[:, 1]]
     # unique via linear keys

@@ -17,6 +17,10 @@ import argparse
 
 import numpy as np
 
+# The reference suite's average degree (graphs/make_graphs:8) — the odd
+# epsilon is reproduced verbatim so regenerated suites match its p exactly.
+DEFAULT_AVG_DEG = 2.2000000001
+
 
 def _linear_to_upper_pair(k: np.ndarray, n: int) -> tuple[np.ndarray, np.ndarray]:
     """Map linear indices over the upper triangle {(i, j): i < j}, ordered by
@@ -55,7 +59,12 @@ def gnp_random_graph(
     picks = np.zeros(0, dtype=np.int64)
     while picks.size < m:
         need = m - picks.size
-        cand = rng.integers(0, total, size=int(need * 1.1) + 16, dtype=np.int64)
+        # scale the batch by the expected collision rate against both the
+        # already-picked set and intra-batch duplicates, so dense p doesn't
+        # degrade into many tiny rounds of full re-unique
+        remaining_frac = max(1.0 - picks.size / total, 1e-9)
+        batch = int(need / remaining_frac * 1.1) + 16
+        cand = rng.integers(0, total, size=batch, dtype=np.int64)
         picks = np.unique(np.concatenate([picks, cand]))
     if picks.size > m:
         picks = rng.permutation(picks)[:m]
@@ -136,6 +145,43 @@ def generate_with_ground_truth(
     }
 
 
+def rmat_with_ground_truth(
+    out_path: str,
+    scale: int,
+    edge_factor: int = 16,
+    src: int = 0,
+    dst: int | None = None,
+    *,
+    seed: int | None = None,
+) -> dict:
+    """RMAT suite row (BASELINE.json 'RMAT scale-23 / Graph500' config):
+    write .bin + ground-truth .json like the G(n,p) generator."""
+    from bibfs_tpu.graph.io import (
+        ground_truth_path,
+        write_graph_bin,
+        write_ground_truth,
+    )
+    from bibfs_tpu.solvers.serial import solve_serial
+
+    n, edges = rmat_graph(scale, edge_factor, seed=seed)
+    if dst is None:
+        dst = n - 1
+    write_graph_bin(out_path, n, edges)
+    res = solve_serial(n, edges, src, dst)
+    write_ground_truth(
+        ground_truth_path(out_path),
+        src,
+        dst,
+        res.hops if res.found else None,
+        res.path if res.found else None,
+    )
+    return {
+        "n": n,
+        "m": int(edges.shape[0]),
+        "hop_count": res.hops if res.found else None,
+    }
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description="Generate a random graph + ground truth")
     ap.add_argument("--n", type=int, required=True)
@@ -146,7 +192,8 @@ def main(argv=None):
     ap.add_argument("--seed", type=int, default=None)
     ap.add_argument("--avg-deg", type=float, default=None, help="sets p = avg_deg / n")
     args = ap.parse_args(argv)
-    p = args.p if args.p is not None else (args.avg_deg or 2.2000000001) / args.n
+    avg = args.avg_deg if args.avg_deg is not None else DEFAULT_AVG_DEG
+    p = args.p if args.p is not None else avg / args.n
     info = generate_with_ground_truth(
         args.out, args.n, p, args.src, args.dst, seed=args.seed
     )

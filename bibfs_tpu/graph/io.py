@@ -51,7 +51,12 @@ def read_graph_bin(path: str | os.PathLike) -> tuple[int, np.ndarray]:
             f"{path}: header claims {m} edges ({2 * m} words) but file has "
             f"{data.size} payload words"
         )
-    return n, data.reshape(m, 2).astype(np.int64)
+    edges = data.reshape(m, 2).astype(np.int64)
+    if m and int(edges.max()) >= n:
+        raise ValueError(
+            f"{path}: edge endpoint {int(edges.max())} out of range for n={n}"
+        )
+    return n, edges
 
 
 def write_ground_truth(

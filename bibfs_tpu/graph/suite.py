@@ -1,0 +1,76 @@
+"""Benchmark-suite generator — reference ``graphs/make_graphs`` parity.
+
+The reference driver (graphs/make_graphs:13-32) generates four G(n, p)
+graphs with N ∈ {1000, 10000, 50000, 100000}, p = 2.2000000001/N, src=0,
+dst=N−1, writing ``<label>.bin`` + ground-truth ``<label>.json``. Same
+contract here, plus optional RMAT rows (``--rmat SCALE...``) for the
+Graph500-style configs the reference could never generate
+(README.md:19; BASELINE.json configs).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+from bibfs_tpu.graph.generate import (
+    DEFAULT_AVG_DEG,
+    generate_with_ground_truth,
+    rmat_with_ground_truth,
+)
+
+SUITE = [(1000, "1k"), (10_000, "10k"), (50_000, "50k"), (100_000, "100k")]
+
+
+def make_suite(
+    out_dir: str,
+    *,
+    avg_deg: float = DEFAULT_AVG_DEG,
+    seed: int | None = 0,
+    sizes=SUITE,
+) -> list[str]:
+    os.makedirs(out_dir, exist_ok=True)
+    written = []
+    for i, (n, label) in enumerate(sizes):
+        path = os.path.join(out_dir, f"{label}.bin")
+        info = generate_with_ground_truth(
+            path, n, avg_deg / n, 0, n - 1,
+            seed=None if seed is None else seed + i,
+        )
+        print(
+            f"{label}: n={info['n']} m={info['m']} hop_count={info['hop_count']}"
+        )
+        written.append(path)
+    return written
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description="Generate the benchmark graph suite")
+    ap.add_argument("--out-dir", default="graphs")
+    ap.add_argument("--avg-deg", type=float, default=DEFAULT_AVG_DEG)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument(
+        "--rmat",
+        type=int,
+        nargs="*",
+        default=[],
+        metavar="SCALE",
+        help="also generate RMAT graphs at these scales "
+        "(e.g. --rmat 20 23 for 1M/8M-node Graph500 rows)",
+    )
+    ap.add_argument("--edge-factor", type=int, default=16)
+    args = ap.parse_args(argv)
+    make_suite(args.out_dir, avg_deg=args.avg_deg, seed=args.seed)
+    for scale in args.rmat:
+        path = os.path.join(args.out_dir, f"rmat{scale}.bin")
+        info = rmat_with_ground_truth(
+            path, scale, args.edge_factor, seed=args.seed
+        )
+        print(
+            f"rmat{scale}: n={info['n']} m={info['m']} "
+            f"hop_count={info['hop_count']}"
+        )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,1 @@
+from bibfs_tpu.ops.expand import expand_pull, frontier_count  # noqa: F401

@@ -1,0 +1,6 @@
+from bibfs_tpu.parallel.mesh import make_1d_mesh, shard_spec  # noqa: F401
+from bibfs_tpu.parallel.collectives import (  # noqa: F401
+    or_allreduce,
+    sum_allreduce,
+    global_min_and_argmin,
+)

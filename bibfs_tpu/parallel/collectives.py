@@ -1,0 +1,48 @@
+"""Collective helpers — the TPU-native census of the reference's MPI usage.
+
+Complete mapping (reference collective census in SURVEY.md §2):
+
+| MPI (reference)              | here                                    |
+|------------------------------|-----------------------------------------|
+| ``Allreduce BOR`` of bitsets | ``or_allreduce`` (psum of masks > 0)    |
+| ``Allreduce LOR`` votes      | ``or_allreduce`` on a scalar bool       |
+| ``Allreduce SUM`` popcounts  | ``sum_allreduce``                       |
+| ``Allreduce MIN`` best dist  | ``global_min_and_argmin`` (pmin)        |
+| ``Allgather(v)`` frontiers   | ``jax.lax.all_gather(..., tiled=True)`` |
+| ``Bcast`` graph replication  | none — the graph is 1D-sharded at load  |
+
+All helpers are usable inside ``shard_map`` bodies (including under
+``lax.while_loop``/``lax.cond``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_IMAX = jnp.int32(2**31 - 1)
+
+
+def or_allreduce(x: jnp.ndarray, axis: str) -> jnp.ndarray:
+    """Boolean OR across the mesh axis (MPI_Allreduce BOR/LOR,
+    v2/second_try.cpp:82-85,115; v4/mpi_bas.cpp:107,124)."""
+    return jax.lax.psum(x.astype(jnp.int32), axis) > 0
+
+
+def sum_allreduce(x: jnp.ndarray, axis: str) -> jnp.ndarray:
+    """Sum across the mesh axis (MPI_Allreduce SUM, second_try.cpp:123-124)."""
+    return jax.lax.psum(x, axis)
+
+
+def global_min_and_argmin(
+    local_min: jnp.ndarray, local_arg: jnp.ndarray, axis: str
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Global (min value, arg at min) across shards.
+
+    ``local_arg`` must be a GLOBAL id. Tie-break: smallest arg among shards
+    achieving the min — deterministic, unlike MPI rank-order races.
+    """
+    gmin = jax.lax.pmin(local_min, axis)
+    cand = jnp.where(local_min == gmin, local_arg, _IMAX)
+    garg = jax.lax.pmin(cand, axis)
+    return gmin, garg

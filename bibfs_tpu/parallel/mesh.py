@@ -1,0 +1,39 @@
+"""Device-mesh utilities — the framework's replacement for MPI rank/size
+bookkeeping (v2/second_try.cpp:16-19, v4/mpi_bas.cpp:12-15).
+
+The reference's process model is `mpirun -n p` CPU ranks over 1 Gb Ethernet;
+here a single SPMD program spans a `jax.sharding.Mesh` whose collectives
+ride ICI (intra-pod) / DCN (multi-slice), and "rank"/"size" become
+`jax.lax.axis_index` / mesh axis size inside `shard_map` blocks.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+VERTEX_AXIS = "x"
+
+
+def make_1d_mesh(num_devices: int | None = None, axis: str = VERTEX_AXIS) -> Mesh:
+    """A 1D mesh over the first ``num_devices`` visible devices (all by
+    default). Vertex arrays are 1D-sharded over this axis (the real
+    owner-computes partition the reference's v4 compiled in but disabled,
+    v4/comp.cu:27,99 — quirk Q4)."""
+    devs = jax.devices()
+    if num_devices is not None:
+        if num_devices > len(devs):
+            raise ValueError(
+                f"requested {num_devices} devices, have {len(devs)}"
+            )
+        devs = devs[:num_devices]
+    return jax.make_mesh((len(devs),), (axis,), devices=devs)
+
+
+def shard_spec(mesh: Mesh, axis: str = VERTEX_AXIS) -> NamedSharding:
+    """NamedSharding that splits the leading (vertex) dimension."""
+    return NamedSharding(mesh, PartitionSpec(axis))
+
+
+def replicated_spec(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())

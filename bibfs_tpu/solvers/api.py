@@ -46,6 +46,7 @@ class BFSResult:
 
 
 SOLVERS: dict[str, Callable] = {}
+_IMPORT_ERRORS: dict[str, Exception] = {}
 
 
 def register(name: str):
@@ -67,6 +68,10 @@ def solve(
     """
     _ensure_registered()
     if backend not in SOLVERS:
+        if backend in _IMPORT_ERRORS:
+            raise KeyError(
+                f"backend {backend!r} unavailable: {_IMPORT_ERRORS[backend]}"
+            )
         raise KeyError(f"unknown backend {backend!r}; have {sorted(SOLVERS)}")
     return SOLVERS[backend](n, edges, src, dst, **kwargs)
 
@@ -74,14 +79,22 @@ def solve(
 def _ensure_registered():
     import bibfs_tpu.solvers.serial  # noqa: F401
 
-    if "dense" not in SOLVERS:
+    if "dense" not in SOLVERS and "dense" not in _IMPORT_ERRORS:
         try:
             import bibfs_tpu.solvers.dense  # noqa: F401
             import bibfs_tpu.solvers.sharded  # noqa: F401
-        except ImportError:  # JAX unavailable — host backends still work
-            pass
+        except ImportError as e:
+            # a missing or broken JAX stack must not break the host
+            # backends; the stashed error resurfaces if a JAX backend is
+            # actually requested. Non-import bugs in our modules still raise.
+            _IMPORT_ERRORS["dense"] = e
+            _IMPORT_ERRORS["sharded"] = e
     if "native" not in SOLVERS:
         try:
             import bibfs_tpu.solvers.native  # noqa: F401
-        except (ImportError, OSError):
-            pass
+        except ModuleNotFoundError:
+            pass  # native .so not built — optional backend
+        except OSError as e:
+            import warnings
+
+            warnings.warn(f"native backend unavailable: {e}", stacklevel=2)

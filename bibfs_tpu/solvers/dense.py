@@ -1,0 +1,207 @@
+"""Single-chip device-resident bidirectional BFS — the v3 replacement.
+
+The reference v3 (v3/bibfs_cuda_only.cu:173-203) launches one CUDA kernel
+per side per level, synchronizing and copying flag bytes back to the host
+every iteration; v4 additionally round-trips the whole frontier+visited
+arrays through host memory per level (v4/comp.cu:84-107, quirk Q5). Here the
+ENTIRE search — both frontiers, visited sets, parents, distances, direction
+choice, meet detection, and termination vote — is one ``jax.lax.while_loop``
+inside one jitted XLA program: state never leaves HBM, and the host syncs
+exactly once, at the end.
+
+Algorithmic upgrades over the reference:
+- smaller-frontier-first direction choice (v1/main-v1.cpp:51, v4
+  mpi_bas.cpp:90-92 — absent in v3, which expands both sides every round)
+- provably-correct termination: keep the best meet candidate and stop when
+  ``level_s + level_t >= best`` (fixes quirks Q1/Q2)
+- true hop counts and device-computed parent arrays for path reconstruction
+  (v3 reports only found/not-found, v3/bibfs_cuda_only.cu:224; v2/v4
+  re-run a serial BFS on the host, second_try.cpp:137-162)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bibfs_tpu.graph.csr import EllGraph, build_ell
+from bibfs_tpu.ops.expand import expand_pull, frontier_count, frontier_degree_sum
+from bibfs_tpu.solvers.api import BFSResult, register
+from bibfs_tpu.solvers.serial import _reconstruct
+
+INF32 = jnp.int32(1 << 30)
+
+
+@dataclasses.dataclass
+class DeviceGraph:
+    """ELL adjacency resident in device HBM — the analog of v4's
+    ``cudaInitGraph`` upload (v4/comp.cu:49-73), done once per graph."""
+
+    n: int
+    n_pad: int
+    width: int
+    num_edges: int
+    nbr: jax.Array  # int32[n_pad, width]
+    deg: jax.Array  # int32[n_pad]
+
+    @classmethod
+    def from_ell(cls, g: EllGraph, device=None) -> "DeviceGraph":
+        if g.overflow.shape[0]:
+            raise NotImplementedError(
+                "EllGraph has width_cap overflow edges; the device solvers "
+                "do not handle the hybrid ELL+COO layout yet — build the "
+                "ELL without width_cap"
+            )
+        put = partial(jax.device_put, device=device) if device else jax.device_put
+        return cls(
+            n=g.n,
+            n_pad=g.n_pad,
+            width=g.width,
+            num_edges=g.num_edges,
+            nbr=put(g.nbr),
+            deg=put(g.deg),
+        )
+
+
+@partial(jax.jit, static_argnames=())
+def bibfs_dense(nbr, deg, src, dst):
+    """Jittable full bidirectional-BFS search.
+
+    Returns ``(best, meet, dist_s, dist_t, parent_s, parent_t, levels,
+    edges_scanned)`` — ``best >= INF32`` means no path.
+    """
+    n_pad = nbr.shape[0]
+    zeros_b = jnp.zeros(n_pad, dtype=jnp.bool_)
+
+    def seed(v):
+        return zeros_b.at[v].set(True)
+
+    fs = seed(src)
+    ft = seed(dst)
+    init = dict(
+        vis_s=fs,
+        fr_s=fs,
+        par_s=jnp.full(n_pad, -1, jnp.int32),
+        dist_s=jnp.where(fs, 0, INF32).astype(jnp.int32),
+        vis_t=ft,
+        fr_t=ft,
+        par_t=jnp.full(n_pad, -1, jnp.int32),
+        dist_t=jnp.where(ft, 0, INF32).astype(jnp.int32),
+        lvl_s=jnp.int32(0),
+        lvl_t=jnp.int32(0),
+        best=jnp.where(src == dst, 0, INF32).astype(jnp.int32),
+        meet=jnp.where(src == dst, src, -1).astype(jnp.int32),
+        levels=jnp.int32(0),
+        edges=jnp.int32(0),
+    )
+
+    def cond(st):
+        return (
+            (st["lvl_s"] + st["lvl_t"] < st["best"])
+            & jnp.any(st["fr_s"])
+            & jnp.any(st["fr_t"])
+        )
+
+    def body(st):
+        cs = frontier_count(st["fr_s"])
+        ct = frontier_count(st["fr_t"])
+        expand_s = cs <= ct
+
+        def one_side(fr, vis, par, dist, lvl):
+            nf, pcand = expand_pull(fr, vis, nbr, deg)
+            par = jnp.where(nf, pcand, par)
+            dist = jnp.where(nf, lvl + 1, dist)
+            return nf, vis | nf, par, dist, lvl + 1
+
+        def s_branch(st):
+            scanned = frontier_degree_sum(st["fr_s"], deg)
+            nf, vis, par, dist, lvl = one_side(
+                st["fr_s"], st["vis_s"], st["par_s"], st["dist_s"], st["lvl_s"]
+            )
+            return {
+                **st,
+                "fr_s": nf,
+                "vis_s": vis,
+                "par_s": par,
+                "dist_s": dist,
+                "lvl_s": lvl,
+                "edges": st["edges"] + scanned,
+            }
+
+        def t_branch(st):
+            scanned = frontier_degree_sum(st["fr_t"], deg)
+            nf, vis, par, dist, lvl = one_side(
+                st["fr_t"], st["vis_t"], st["par_t"], st["dist_t"], st["lvl_t"]
+            )
+            return {
+                **st,
+                "fr_t": nf,
+                "vis_t": vis,
+                "par_t": par,
+                "dist_t": dist,
+                "lvl_t": lvl,
+                "edges": st["edges"] + scanned,
+            }
+
+        st = jax.lax.cond(expand_s, s_branch, t_branch, st)
+        # meet vote — the check_intersect kernel (v3:45-62) fused in-loop
+        sums = jnp.where(
+            st["vis_s"] & st["vis_t"], st["dist_s"] + st["dist_t"], INF32
+        )
+        cur = jnp.min(sums)
+        arg = jnp.argmin(sums).astype(jnp.int32)
+        st["meet"] = jnp.where(cur < st["best"], arg, st["meet"])
+        st["best"] = jnp.minimum(st["best"], cur)
+        st["levels"] = st["levels"] + 1
+        return st
+
+    out = jax.lax.while_loop(cond, body, init)
+    return (
+        out["best"],
+        out["meet"],
+        out["dist_s"],
+        out["dist_t"],
+        out["par_s"],
+        out["par_t"],
+        out["levels"],
+        out["edges"],
+    )
+
+
+def solve_dense_graph(g: DeviceGraph, src: int, dst: int) -> BFSResult:
+    """Run the jitted search on an already-device-resident graph; timing
+    covers the search only (reference parity: each version times only the
+    hot loop, SURVEY.md §5 tracing)."""
+    if not (0 <= src < g.n and 0 <= dst < g.n):
+        raise ValueError(f"src/dst out of range for n={g.n}")
+    src_a = jnp.int32(src)
+    dst_a = jnp.int32(dst)
+    t0 = time.perf_counter()
+    best, meet, dist_s, dist_t, par_s, par_t, levels, edges = jax.block_until_ready(
+        bibfs_dense(g.nbr, g.deg, src_a, dst_a)
+    )
+    elapsed = time.perf_counter() - t0
+    best = int(best)
+    if best >= int(INF32):
+        return BFSResult(False, None, None, None, elapsed, int(levels), int(edges))
+    par_s_np = np.asarray(par_s, dtype=np.int64)
+    par_t_np = np.asarray(par_t, dtype=np.int64)
+    path = _reconstruct(par_s_np, par_t_np, int(meet))
+    return BFSResult(
+        True, best, path, int(meet), elapsed, int(levels), int(edges)
+    )
+
+
+def solve_dense(n: int, edges: np.ndarray, src: int, dst: int) -> BFSResult:
+    g = DeviceGraph.from_ell(build_ell(n, edges))
+    return solve_dense_graph(g, src, dst)
+
+
+@register("dense")
+def _dense_backend(n, edges, src, dst, **_):
+    return solve_dense(n, edges, src, dst)

@@ -1,0 +1,62 @@
+"""Parity tests: single-chip dense JAX solver vs the serial oracle.
+
+This automates the reference's cross-implementation agreement checking
+(SURVEY.md §4.3) — every backend must report IDENTICAL hop counts (the
+reference's v2 notoriously didn't, quirk Q1)."""
+
+import numpy as np
+import pytest
+
+from bibfs_tpu.solvers.dense import solve_dense
+from bibfs_tpu.solvers.serial import solve_serial
+from tests.conftest import random_graph_cases
+
+CASES = random_graph_cases(num=25, seed=77)
+
+
+@pytest.mark.parametrize("case", range(len(CASES)))
+def test_dense_matches_serial(case):
+    n, edges, src, dst = CASES[case]
+    ref = solve_serial(n, edges, src, dst)
+    got = solve_dense(n, edges, src, dst)
+    assert got.found == ref.found
+    if ref.found:
+        assert got.hops == ref.hops
+        got.validate_path(n, edges, src, dst)
+
+
+def test_dense_src_eq_dst():
+    r = solve_dense(10, np.array([[0, 1], [1, 2]]), 4, 4)
+    assert r.found and r.hops == 0 and r.path == [4]
+
+
+def test_dense_disconnected():
+    r = solve_dense(4, np.array([[0, 1], [2, 3]]), 0, 3)
+    assert not r.found
+
+
+def test_dense_line_graph():
+    n = 50
+    edges = np.array([[i, i + 1] for i in range(n - 1)])
+    r = solve_dense(n, edges, 0, n - 1)
+    assert r.found and r.hops == n - 1
+    assert r.path == list(range(n))
+
+
+def test_dense_counterexample_first_meet():
+    """The Q2 counterexample where v1's first-meet early exit overshoots:
+    true distance 0→9 is 3 via 0-2-3-9; naive first-meet reports 4."""
+    edges = np.array(
+        [[0, 1], [0, 2], [0, 8], [9, 3], [3, 4], [3, 6], [3, 7], [1, 4], [2, 3]]
+    )
+    r = solve_dense(10, edges, 0, 9)
+    assert r.found and r.hops == 3
+    ref = solve_serial(10, edges, 0, 9)
+    assert ref.hops == 3
+
+
+def test_dense_teps_accounting():
+    n, edges = 30, np.array([[i, i + 1] for i in range(29)])
+    r = solve_dense(n, edges, 0, 29)
+    assert r.edges_scanned > 0
+    assert r.levels >= 15  # bidirectional: ~n/2 levels each side

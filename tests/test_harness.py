@@ -1,0 +1,91 @@
+"""Harness-layer tests: suite generator, benchmark sweep, visualizer."""
+
+import csv
+import os
+
+import numpy as np
+import pytest
+
+from bibfs_tpu.cli.bench import run_bench
+from bibfs_tpu.graph.generate import generate_with_ground_truth
+from bibfs_tpu.graph.suite import make_suite
+
+
+@pytest.fixture(scope="module")
+def tiny_suite(tmp_path_factory):
+    d = tmp_path_factory.mktemp("suite")
+    # small sizes so the sweep is fast; same contract as the real suite
+    paths = make_suite(str(d), sizes=[(200, "s"), (400, "m")], seed=5)
+    return paths
+
+
+def test_make_suite_contract(tiny_suite):
+    for p in tiny_suite:
+        assert os.path.exists(p)
+        assert os.path.exists(p.replace(".bin", ".json"))
+
+
+def test_run_bench_csv_and_table(tiny_suite, tmp_path):
+    csv_path = str(tmp_path / "results.csv")
+    table_path = str(tmp_path / "table.txt")
+    rows = run_bench(
+        tiny_suite,
+        ["serial", "dense"],
+        repeats=2,
+        csv_path=csv_path,
+        table_path=table_path,
+    )
+    assert len(rows) == 4  # 2 graphs x 2 backends
+    assert all(r["ok"] for r in rows)
+    with open(csv_path) as f:
+        got = list(csv.DictReader(f))
+    assert [r["version"] for r in got] == ["serial", "dense"] * 2
+    # units are seconds: nothing should take minutes on a 400-node graph
+    for r in got:
+        assert float(r["time_sec"]) < 60.0
+    assert os.path.exists(table_path)
+    text = open(table_path).read()
+    assert "TEPS" in text and "+" in text
+
+
+def test_bench_hop_mismatch_flagged(tmp_path):
+    """A wrong ground-truth file must flip ok to False (the automated
+    version of catching quirk Q1)."""
+    import json
+
+    p = str(tmp_path / "g.bin")
+    generate_with_ground_truth(p, 300, 3.0 / 300, 0, 299, seed=11)
+    j = json.load(open(p.replace(".bin", ".json")))
+    if j["hop_count"] is None:
+        pytest.skip("disconnected sample")
+    j["hop_count"] += 1  # corrupt
+    json.dump(j, open(p.replace(".bin", ".json"), "w"))
+    rows = run_bench(
+        [p], ["serial"], repeats=1,
+        csv_path=str(tmp_path / "r.csv"), table_path=str(tmp_path / "t.txt"),
+    )
+    assert rows[0]["ok"] is False
+
+
+def test_viz_draw(tiny_suite, tmp_path):
+    from bibfs_tpu.viz.draw import draw
+    from bibfs_tpu.graph.io import read_ground_truth
+
+    out = str(tmp_path / "g.png")
+    gt = read_ground_truth(tiny_suite[0].replace(".bin", ".json"))
+    draw(tiny_suite[0], out, path_nodes=gt.get("nodes"))
+    assert os.path.getsize(out) > 1000
+
+
+def test_viz_cli_solve_mode(tiny_suite, tmp_path):
+    from bibfs_tpu.viz.draw import main
+    from bibfs_tpu.graph.io import read_graph_bin
+    from bibfs_tpu.solvers.serial import solve_serial
+
+    n, edges = read_graph_bin(tiny_suite[0])
+    r = solve_serial(n, edges, 0, n - 1)
+    if not r.found:
+        pytest.skip("disconnected sample")
+    out = str(tmp_path / "cli.png")
+    rc = main([tiny_suite[0], "--solve", "0", str(n - 1), "--out", out])
+    assert rc == 0 and os.path.getsize(out) > 1000

@@ -1,0 +1,69 @@
+"""Multi-device solver tests on the 8-device virtual CPU mesh — the moral
+equivalent of the reference's single_machine_bench.sh fake cluster
+(SURVEY.md §4.5), but asserting hop parity instead of eyeballing logs."""
+
+import jax
+import numpy as np
+import pytest
+
+from bibfs_tpu.solvers.serial import solve_serial
+from bibfs_tpu.solvers.sharded import solve_sharded
+from tests.conftest import random_graph_cases
+
+CASES = random_graph_cases(num=15, seed=99)
+
+
+def test_mesh_has_8_devices():
+    assert len(jax.devices()) == 8
+
+
+@pytest.mark.parametrize("case", range(len(CASES)))
+def test_sharded_matches_serial_8dev(case):
+    n, edges, src, dst = CASES[case]
+    ref = solve_serial(n, edges, src, dst)
+    got = solve_sharded(n, edges, src, dst, num_devices=8)
+    assert got.found == ref.found
+    if ref.found:
+        assert got.hops == ref.hops
+        got.validate_path(n, edges, src, dst)
+
+
+@pytest.mark.parametrize("ndev", [1, 2, 4, 8])
+def test_sharded_mesh_sizes(ndev):
+    n, edges, src, dst = CASES[0]
+    ref = solve_serial(n, edges, src, dst)
+    got = solve_sharded(n, edges, src, dst, num_devices=ndev)
+    assert got.found == ref.found
+    if ref.found:
+        assert got.hops == ref.hops
+
+
+def test_sharded_counterexample_first_meet():
+    edges = np.array(
+        [[0, 1], [0, 2], [0, 8], [9, 3], [3, 4], [3, 6], [3, 7], [1, 4], [2, 3]]
+    )
+    r = solve_sharded(10, edges, 0, 9, num_devices=8)
+    assert r.found and r.hops == 3
+
+
+def test_sharded_disconnected():
+    r = solve_sharded(16, np.array([[0, 1], [14, 15]]), 0, 15, num_devices=4)
+    assert not r.found
+
+
+def test_sharded_src_eq_dst():
+    r = solve_sharded(16, np.array([[0, 1]]), 7, 7, num_devices=8)
+    assert r.found and r.hops == 0 and r.path == [7]
+
+
+def test_sharded_endpoint_in_last_shard():
+    """src/dst landing in the highest shard exercises the global-id offset."""
+    n = 64
+    edges = np.array([[i, i + 1] for i in range(n - 1)])
+    r = solve_sharded(n, edges, 60, 63, num_devices=8)
+    assert r.found and r.hops == 3
+
+
+def test_too_many_devices():
+    with pytest.raises(ValueError):
+        solve_sharded(10, np.array([[0, 1]]), 0, 1, num_devices=64)
