@@ -53,7 +53,9 @@ def main(argv=None):
     except KeyError as e:
         print(f"Error: {e.args[0]}", file=sys.stderr)
         return 2
-    except ValueError as e:
+    except (ValueError, RuntimeError) as e:
+        # RuntimeError covers device-backend init failures (e.g. a
+        # configured-but-unreachable TPU platform) and native-lib errors
         print(f"Error: {e}", file=sys.stderr)
         return 2
 

@@ -1,0 +1,196 @@
+// Native runtime for bibfs_tpu — C++17 shared library bound via ctypes.
+//
+// Role: the framework's host-side native layer, replacing what the
+// reference implemented in C++ around its solvers — binary graph loading
+// (v1/main-v1.cpp:21-34), CSR construction by degree-count + prefix-sum +
+// scatter (v3/bibfs_cuda_only.cu:89-99, v4/mpi_bas.cpp:45-58), and the v1
+// serial bidirectional-BFS baseline itself (v1/main-v1.cpp:50-97). The TPU
+// compute path stays in JAX/Pallas; this .so exists so graph preprocessing
+// at 10M-node scale and the wall-clock baseline don't pay Python overheads.
+//
+// API style: stateless extern "C" functions over caller-allocated buffers
+// (NumPy arrays on the Python side). Return 0 on success, negative errno-
+// style codes on failure. No globals, no exceptions across the boundary.
+
+#include <algorithm>
+#include <chrono>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <vector>
+
+extern "C" {
+
+// ---------------------------------------------------------------- errors
+enum {
+  BIBFS_OK = 0,
+  BIBFS_EOPEN = -1,     // cannot open file
+  BIBFS_EFORMAT = -2,   // truncated / malformed file
+  BIBFS_ERANGE = -3,    // endpoint out of range
+  BIBFS_EARG = -4,      // bad argument (src/dst out of range, etc.)
+  BIBFS_EBUF = -5,      // caller buffer too small
+};
+
+// ------------------------------------------------------------- graph I/O
+// Binary format: little-endian uint32 N, uint32 M, then M uint32 pairs
+// (the reference on-disk contract, graphs/generate_graph.py:35-39).
+
+int bibfs_read_header(const char* path, uint32_t* n, uint32_t* m) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return BIBFS_EOPEN;
+  uint32_t hdr[2];
+  size_t got = std::fread(hdr, sizeof(uint32_t), 2, f);
+  std::fclose(f);
+  if (got != 2) return BIBFS_EFORMAT;
+  *n = hdr[0];
+  *m = hdr[1];
+  return BIBFS_OK;
+}
+
+// edges: caller-allocated uint32[2*m]; validates size and endpoint range.
+int bibfs_read_edges(const char* path, uint32_t n, uint32_t m,
+                     uint32_t* edges) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return BIBFS_EOPEN;
+  if (std::fseek(f, 2 * sizeof(uint32_t), SEEK_SET) != 0) {
+    std::fclose(f);
+    return BIBFS_EFORMAT;
+  }
+  size_t want = size_t(2) * m;
+  size_t got = std::fread(edges, sizeof(uint32_t), want, f);
+  std::fclose(f);
+  if (got != want) return BIBFS_EFORMAT;
+  for (size_t i = 0; i < want; ++i)
+    if (edges[i] >= n) return BIBFS_ERANGE;
+  return BIBFS_OK;
+}
+
+// --------------------------------------------------------------- CSR build
+// Mirror undirected edges, drop self-loops and duplicates, produce a
+// sorted symmetric CSR. row_ptr: int64[n+1]; col_ind: int32[<=2m]
+// (caller allocates the 2m upper bound; *out_nnz reports the used size).
+int bibfs_build_csr(uint32_t n, uint64_t m, const uint32_t* edges,
+                    int64_t* row_ptr, int32_t* col_ind, int64_t* out_nnz) {
+  std::vector<uint64_t> keys;
+  keys.reserve(2 * m);
+  for (uint64_t e = 0; e < m; ++e) {
+    uint32_t u = edges[2 * e], v = edges[2 * e + 1];
+    if (u >= n || v >= n) return BIBFS_ERANGE;
+    if (u == v) continue;
+    keys.push_back((uint64_t(u) << 32) | v);
+    keys.push_back((uint64_t(v) << 32) | u);
+  }
+  std::sort(keys.begin(), keys.end());
+  keys.erase(std::unique(keys.begin(), keys.end()), keys.end());
+
+  std::memset(row_ptr, 0, (n + 1) * sizeof(int64_t));
+  for (uint64_t k : keys) row_ptr[(k >> 32) + 1]++;
+  for (uint32_t v = 0; v < n; ++v) row_ptr[v + 1] += row_ptr[v];
+  for (size_t i = 0; i < keys.size(); ++i)
+    col_ind[i] = int32_t(keys[i] & 0xffffffffu);
+  *out_nnz = int64_t(keys.size());
+  return BIBFS_OK;
+}
+
+// ---------------------------------------------------- serial bidirectional BFS
+// The v1-parity native baseline (v1/main-v1.cpp:50-97): level-synchronous,
+// smaller-frontier-first, per-side parent arrays — but with the correct
+// termination rule (track best meet, stop when level_s + level_t >= best)
+// instead of v1's first-meet early exit (quirk Q2).
+//
+// Outputs: *out_hops = -1 if unreachable, else hop count; path written to
+// path_buf (path_cap entries; *out_path_len = 0 if it doesn't fit);
+// *out_time_s = search-loop seconds (reference timing parity);
+// *out_edges = directed edges scanned; *out_levels = expansions done.
+int bibfs_solve(uint32_t n, const int64_t* row_ptr, const int32_t* col_ind,
+                uint32_t src, uint32_t dst, int32_t* out_hops,
+                int32_t* path_buf, int32_t path_cap, int32_t* out_path_len,
+                double* out_time_s, int64_t* out_edges, int32_t* out_levels) {
+  if (src >= n || dst >= n) return BIBFS_EARG;
+  *out_hops = -1;
+  *out_path_len = 0;
+  *out_edges = 0;
+  *out_levels = 0;
+
+  auto t0 = std::chrono::steady_clock::now();
+  auto finish = [&]() {
+    *out_time_s =
+        std::chrono::duration<double>(std::chrono::steady_clock::now() - t0)
+            .count();
+  };
+
+  if (src == dst) {
+    *out_hops = 0;
+    if (path_cap >= 1) {
+      path_buf[0] = int32_t(src);
+      *out_path_len = 1;
+    }
+    finish();
+    return BIBFS_OK;
+  }
+
+  constexpr int32_t INF = INT32_MAX / 4;
+  std::vector<int32_t> dist_s(n, INF), dist_t(n, INF);
+  std::vector<int32_t> par_s(n, -1), par_t(n, -1);
+  std::vector<uint32_t> fr_s{src}, fr_t{dst}, next;
+  dist_s[src] = 0;
+  dist_t[dst] = 0;
+
+  int32_t level_s = 0, level_t = 0, best = INF;
+  int64_t scanned = 0;
+  int32_t levels = 0;
+  uint32_t meet = UINT32_MAX;
+
+  while (!fr_s.empty() && !fr_t.empty() && level_s + level_t < best) {
+    bool s_side = fr_s.size() <= fr_t.size();
+    auto& fr = s_side ? fr_s : fr_t;
+    auto& dist = s_side ? dist_s : dist_t;
+    auto& par = s_side ? par_s : par_t;
+    auto& dist_other = s_side ? dist_t : dist_s;
+    int32_t lvl = (s_side ? ++level_s : ++level_t);
+
+    next.clear();
+    for (uint32_t u : fr) {
+      for (int64_t i = row_ptr[u]; i < row_ptr[u + 1]; ++i) {
+        ++scanned;
+        uint32_t v = uint32_t(col_ind[i]);
+        if (dist[v] != INF) continue;
+        dist[v] = lvl;
+        par[v] = int32_t(u);
+        next.push_back(v);
+        if (dist_other[v] != INF) {
+          int32_t cand = dist[v] + dist_other[v];
+          if (cand < best) {
+            best = cand;
+            meet = v;
+          }
+        }
+      }
+    }
+    fr.swap(next);
+    ++levels;
+  }
+  finish();
+  *out_edges = scanned;
+  *out_levels = levels;
+
+  if (best >= INF) return BIBFS_OK;  // unreachable: out_hops stays -1
+  *out_hops = best;
+
+  // path reconstruction: walk parents both ways from the meet vertex
+  // (v1/main-v1.cpp:86-97)
+  std::vector<int32_t> left;  // meet .. src
+  for (int32_t v = int32_t(meet); v != -1; v = par_s[v]) left.push_back(v);
+  std::vector<int32_t> right;  // after meet .. dst
+  for (int32_t v = par_t[meet]; v != -1; v = par_t[v]) right.push_back(v);
+
+  int64_t total = int64_t(left.size()) + int64_t(right.size());
+  if (total > path_cap) return BIBFS_OK;  // hops valid, path omitted
+  int32_t k = 0;
+  for (auto it = left.rbegin(); it != left.rend(); ++it) path_buf[k++] = *it;
+  for (int32_t v : right) path_buf[k++] = v;
+  *out_path_len = k;
+  return BIBFS_OK;
+}
+
+}  // extern "C"

@@ -1,0 +1,41 @@
+"""Lazy builder for the native shared library.
+
+The .so is compiled on first use (and rebuilt when the source is newer),
+so `pip install` needs no compile step and environments without a C++
+toolchain simply don't get the `native` backend.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+SRC = os.path.join(_DIR, "bibfs_native.cpp")
+SO = os.path.join(_DIR, "libbibfs_native.so")
+
+
+def ensure_built(force: bool = False) -> str:
+    """Compile the native library if missing/stale; returns the .so path."""
+    if (
+        not force
+        and os.path.exists(SO)
+        and os.path.getmtime(SO) >= os.path.getmtime(SRC)
+    ):
+        return SO
+    cxx = os.environ.get("CXX", "g++")
+    cmd = [
+        cxx, "-std=c++17", "-O3", "-fPIC", "-Wall", "-Wextra",
+        "-shared", "-o", SO, SRC,
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+    except FileNotFoundError as e:
+        raise OSError(f"no C++ compiler ({cxx}): {e}") from e
+    except subprocess.CalledProcessError as e:
+        raise OSError(f"native build failed:\n{e.stderr}") from e
+    return SO
+
+
+if __name__ == "__main__":
+    print(ensure_built(force=True))

@@ -46,7 +46,15 @@ class BFSResult:
 
 
 SOLVERS: dict[str, Callable] = {}
-_IMPORT_ERRORS: dict[str, Exception] = {}
+
+# backend name -> implementing module, imported lazily so that requesting
+# one backend never pays (or crashes on) another backend's dependencies
+BACKEND_MODULES = {
+    "serial": "bibfs_tpu.solvers.serial",
+    "native": "bibfs_tpu.solvers.native",
+    "dense": "bibfs_tpu.solvers.dense",
+    "sharded": "bibfs_tpu.solvers.sharded",
+}
 
 
 def register(name: str):
@@ -66,35 +74,17 @@ def solve(
     JAX. Use the backend modules directly to control graph-build vs search
     timing separately (the reference times only the search loop).
     """
-    _ensure_registered()
     if backend not in SOLVERS:
-        if backend in _IMPORT_ERRORS:
+        if backend not in BACKEND_MODULES:
             raise KeyError(
-                f"backend {backend!r} unavailable: {_IMPORT_ERRORS[backend]}"
+                f"unknown backend {backend!r}; have {sorted(BACKEND_MODULES)}"
             )
-        raise KeyError(f"unknown backend {backend!r}; have {sorted(SOLVERS)}")
+        import importlib
+
+        try:
+            importlib.import_module(BACKEND_MODULES[backend])
+        except (ImportError, OSError) as e:
+            # missing JAX stack / missing C++ toolchain — report it against
+            # the requested backend; the others remain usable
+            raise KeyError(f"backend {backend!r} unavailable: {e}") from e
     return SOLVERS[backend](n, edges, src, dst, **kwargs)
-
-
-def _ensure_registered():
-    import bibfs_tpu.solvers.serial  # noqa: F401
-
-    if "dense" not in SOLVERS and "dense" not in _IMPORT_ERRORS:
-        try:
-            import bibfs_tpu.solvers.dense  # noqa: F401
-            import bibfs_tpu.solvers.sharded  # noqa: F401
-        except ImportError as e:
-            # a missing or broken JAX stack must not break the host
-            # backends; the stashed error resurfaces if a JAX backend is
-            # actually requested. Non-import bugs in our modules still raise.
-            _IMPORT_ERRORS["dense"] = e
-            _IMPORT_ERRORS["sharded"] = e
-    if "native" not in SOLVERS:
-        try:
-            import bibfs_tpu.solvers.native  # noqa: F401
-        except ModuleNotFoundError:
-            pass  # native .so not built — optional backend
-        except OSError as e:
-            import warnings
-
-            warnings.warn(f"native backend unavailable: {e}", stacklevel=2)

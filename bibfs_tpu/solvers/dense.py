@@ -34,7 +34,9 @@ from bibfs_tpu.ops.expand import expand_pull, frontier_count, frontier_degree_su
 from bibfs_tpu.solvers.api import BFSResult, register
 from bibfs_tpu.solvers.serial import _reconstruct
 
-INF32 = jnp.int32(1 << 30)
+# "infinite" distance sentinel; a plain int so importing this module never
+# touches a JAX backend (device constants would initialize one eagerly)
+INF32 = 1 << 30
 
 
 @dataclasses.dataclass
