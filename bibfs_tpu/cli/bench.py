@@ -190,6 +190,9 @@ def main(argv=None):
     ap.add_argument("--csv", default="benchmark_results.csv")
     ap.add_argument("--table", default="benchmark_table.txt")
     args = ap.parse_args(argv)
+    from bibfs_tpu.utils.platform import apply_platform_env
+
+    apply_platform_env()
     backends = (
         args.backends.split(",") if args.backends else available_backends()
     )

@@ -34,10 +34,20 @@ def main(argv=None):
         help="mesh size for --backend sharded (default: all visible devices)",
     )
     ap.add_argument("--no-path", action="store_true", help="skip path printing")
+    ap.add_argument(
+        "--repeat",
+        type=int,
+        default=1,
+        help="time the median of K repeats after a warm-up run (K>1 excludes "
+        "JIT compile from the reported time, like the benchmark harness)",
+    )
     args = ap.parse_args(argv)
 
     from bibfs_tpu.graph.io import read_graph_bin
     from bibfs_tpu.solvers.api import solve
+    from bibfs_tpu.utils.platform import apply_platform_env
+
+    apply_platform_env()
 
     try:
         n, edges = read_graph_bin(args.graph)
@@ -50,6 +60,16 @@ def main(argv=None):
         kwargs["num_devices"] = args.devices
     try:
         res = solve(args.backend, n, edges, args.src, args.dst, **kwargs)
+        if args.repeat > 1:
+            import dataclasses
+
+            import statistics
+
+            times = [
+                solve(args.backend, n, edges, args.src, args.dst, **kwargs).time_s
+                for _ in range(args.repeat)
+            ]
+            res = dataclasses.replace(res, time_s=statistics.median(times))
     except KeyError as e:
         print(f"Error: {e.args[0]}", file=sys.stderr)
         return 2

@@ -24,16 +24,23 @@ def ensure_built(force: bool = False) -> str:
     ):
         return SO
     cxx = os.environ.get("CXX", "g++")
-    cmd = [
-        cxx, "-std=c++17", "-O3", "-fPIC", "-Wall", "-Wextra",
-        "-shared", "-o", SO, SRC,
-    ]
+    cxxflags = os.environ.get(
+        "CXXFLAGS", "-std=c++17 -O3 -fPIC -Wall -Wextra"
+    ).split()
+    # compile to a temp path and os.replace() so concurrent builders never
+    # leave a torn .so for another process's dlopen
+    tmp = f"{SO}.tmp.{os.getpid()}"
+    cmd = [cxx, *cxxflags, "-shared", "-o", tmp, SRC]
     try:
         subprocess.run(cmd, check=True, capture_output=True, text=True)
+        os.replace(tmp, SO)
     except FileNotFoundError as e:
         raise OSError(f"no C++ compiler ({cxx}): {e}") from e
     except subprocess.CalledProcessError as e:
         raise OSError(f"native build failed:\n{e.stderr}") from e
+    finally:
+        if os.path.exists(tmp):
+            os.remove(tmp)
     return SO
 
 

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import ctypes
 import dataclasses
+import os
 
 import numpy as np
 
@@ -71,6 +72,12 @@ def read_graph_native(path: str) -> tuple[int, np.ndarray]:
     m = ctypes.c_uint32()
     _check(lib.bibfs_read_header(path.encode(), ctypes.byref(n), ctypes.byref(m)),
            path)
+    # validate the untrusted header against the actual file size before
+    # allocating m*8 bytes (a corrupt m=0xFFFFFFFF would try ~32 GB)
+    need = 8 + 8 * int(m.value)
+    have = os.path.getsize(path)
+    if have < need:
+        raise RuntimeError(f"{path}: {_ERR[-2]} (m={m.value} needs {need} B, file is {have} B)")
     edges = np.empty((m.value, 2), dtype=np.uint32)
     _check(
         lib.bibfs_read_edges(path.encode(), n.value, m.value,

@@ -1,0 +1,32 @@
+"""Make the documented ``JAX_PLATFORMS`` env contract actually hold.
+
+Some environments boot JAX from ``sitecustomize`` and pin the platform list
+via ``jax.config.update("jax_platforms", ...)`` — which silently overrides
+the ``JAX_PLATFORMS`` environment variable the docs (and the reference-style
+single-machine workflow, SURVEY.md §4.5) tell users to set. Calling
+:func:`apply_platform_env` before the first backend access re-asserts the
+env var so e.g. ``JAX_PLATFORMS=cpu
+XLA_FLAGS=--xla_force_host_platform_device_count=8 bibfs-solve --backend
+sharded --devices 8`` works everywhere.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def apply_platform_env() -> None:
+    plat = os.environ.get("JAX_PLATFORMS")
+    if not plat:
+        return
+    import sys
+
+    # Only act when something (the sitecustomize boot) already imported jax
+    # and may have pinned the config; otherwise the env var will be honored
+    # at import time naturally, and serial/native-only runs stay jax-free.
+    if "jax" not in sys.modules:
+        return
+    import jax
+
+    if jax.config.jax_platforms != plat:
+        jax.config.update("jax_platforms", plat)

@@ -3,8 +3,6 @@
 import numpy as np
 import pytest
 
-pytest.importorskip("ctypes")
-
 try:
     from bibfs_tpu.native.build import ensure_built
 
