@@ -30,41 +30,19 @@ import numpy as np
 from bibfs_tpu.graph.io import ground_truth_path, read_graph_bin, read_ground_truth
 
 
-def _run_backend(backend: str, n, edges, src, dst, repeats: int, num_devices=None):
-    """Returns (best_time_s, result) with jit warm-up excluded for device
-    backends (graph build excluded for all, like the reference)."""
-    if backend == "serial":
-        from bibfs_tpu.solvers.serial import solve_serial_csr
-        from bibfs_tpu.graph.csr import build_csr
+def _run_backend(
+    backend: str, n, edges, src, dst, repeats: int, num_devices=None, mode="sync"
+):
+    """Returns (median_time_s, result) via the shared timing protocol
+    (graph build + warm-up excluded, zero-D2H repeat loop; see
+    bibfs_tpu.solvers.timing). ``result.time_s`` equals the returned time."""
+    from bibfs_tpu.solvers.timing import time_backend
 
-        row_ptr, col_ind = build_csr(n, edges)
-        runs = [solve_serial_csr(n, row_ptr, col_ind, src, dst) for _ in range(repeats)]
-    elif backend == "native":
-        from bibfs_tpu.solvers.native import NativeGraph, solve_native_graph
-
-        g = NativeGraph.build(n, edges)
-        runs = [solve_native_graph(g, src, dst) for _ in range(repeats)]
-    elif backend == "dense":
-        from bibfs_tpu.graph.csr import build_ell
-        from bibfs_tpu.solvers.dense import DeviceGraph, solve_dense_graph
-
-        g = DeviceGraph.from_ell(build_ell(n, edges))
-        solve_dense_graph(g, src, dst)  # compile warm-up
-        runs = [solve_dense_graph(g, src, dst) for _ in range(repeats)]
-    elif backend == "sharded":
-        from bibfs_tpu.graph.csr import build_ell
-        from bibfs_tpu.parallel.mesh import make_1d_mesh
-        from bibfs_tpu.solvers.sharded import ShardedGraph, solve_sharded_graph
-
-        mesh = make_1d_mesh(num_devices)
-        ell = build_ell(n, edges, pad_multiple=8 * int(mesh.devices.size))
-        g = ShardedGraph(ell, mesh)
-        solve_sharded_graph(g, src, dst)  # compile warm-up
-        runs = [solve_sharded_graph(g, src, dst) for _ in range(repeats)]
-    else:
-        raise KeyError(f"unknown backend {backend!r}")
-    best = min(runs, key=lambda r: r.time_s)
-    return best.time_s, best
+    _times, res = time_backend(
+        backend, n, edges, src, dst,
+        repeats=repeats, num_devices=num_devices, mode=mode,
+    )
+    return res.time_s, res
 
 
 def available_backends() -> list[str]:
@@ -92,6 +70,7 @@ def run_bench(
     csv_path: str = "benchmark_results.csv",
     table_path: str = "benchmark_table.txt",
     num_devices=None,
+    mode: str = "sync",
 ) -> list[dict]:
     rows = []
     for gpath in graphs:
@@ -108,7 +87,7 @@ def run_bench(
             t0 = time.time()
             try:
                 secs, res = _run_backend(
-                    backend, n, edges, src, dst, repeats, num_devices
+                    backend, n, edges, src, dst, repeats, num_devices, mode
                 )
             except Exception as e:  # keep the sweep alive, record the failure
                 print(f"  {backend} on {label}: FAILED ({e})", file=sys.stderr)
@@ -187,6 +166,14 @@ def main(argv=None):
     )
     ap.add_argument("--repeats", type=int, default=5)
     ap.add_argument("--devices", type=int, default=None)
+    ap.add_argument(
+        "--mode",
+        default="sync",
+        choices=["sync", "alt"],
+        help="device-kernel schedule: sync = both sides per round (fewest "
+        "rounds), alt = smaller-frontier-first alternation (fewest edge "
+        "scans)",
+    )
     ap.add_argument("--csv", default="benchmark_results.csv")
     ap.add_argument("--table", default="benchmark_table.txt")
     args = ap.parse_args(argv)
@@ -203,6 +190,7 @@ def main(argv=None):
         csv_path=args.csv,
         table_path=args.table,
         num_devices=args.devices,
+        mode=args.mode,
     )
     return 0 if all(r["ok"] for r in rows) else 1
 

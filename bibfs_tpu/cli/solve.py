@@ -41,6 +41,13 @@ def main(argv=None):
         help="time the median of K repeats after a warm-up run (K>1 excludes "
         "JIT compile from the reported time, like the benchmark harness)",
     )
+    ap.add_argument(
+        "--mode",
+        default="sync",
+        choices=["sync", "alt"],
+        help="device-kernel schedule for dense/sharded backends: sync = "
+        "both sides per round, alt = smaller-frontier-first alternation",
+    )
     args = ap.parse_args(argv)
 
     from bibfs_tpu.graph.io import read_graph_bin
@@ -58,24 +65,29 @@ def main(argv=None):
     kwargs = {}
     if args.devices is not None:
         kwargs["num_devices"] = args.devices
+    if args.backend in ("dense", "sharded"):
+        kwargs["mode"] = args.mode
     try:
-        res = solve(args.backend, n, edges, args.src, args.dst, **kwargs)
         if args.repeat > 1:
-            import dataclasses
+            # shared protocol: graph/JIT warm-up excluded, zero-D2H repeat
+            # loop, median reported (bibfs_tpu.solvers.timing)
+            from bibfs_tpu.solvers.timing import time_backend
 
-            import statistics
-
-            times = [
-                solve(args.backend, n, edges, args.src, args.dst, **kwargs).time_s
-                for _ in range(args.repeat)
-            ]
-            res = dataclasses.replace(res, time_s=statistics.median(times))
+            _times, res = time_backend(
+                args.backend, n, edges, args.src, args.dst,
+                repeats=args.repeat,
+                num_devices=args.devices,
+                mode=args.mode,
+            )
+        else:
+            res = solve(args.backend, n, edges, args.src, args.dst, **kwargs)
     except KeyError as e:
         print(f"Error: {e.args[0]}", file=sys.stderr)
         return 2
-    except (ValueError, RuntimeError) as e:
+    except (ValueError, RuntimeError, ImportError, OSError) as e:
         # RuntimeError covers device-backend init failures (e.g. a
-        # configured-but-unreachable TPU platform) and native-lib errors
+        # configured-but-unreachable TPU platform); ImportError/OSError a
+        # missing JAX stack or native toolchain on the --repeat path
         print(f"Error: {e}", file=sys.stderr)
         return 2
 

@@ -8,6 +8,7 @@ toolchain simply don't get the `native` backend.
 from __future__ import annotations
 
 import os
+import shlex
 import subprocess
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
@@ -24,9 +25,9 @@ def ensure_built(force: bool = False) -> str:
     ):
         return SO
     cxx = os.environ.get("CXX", "g++")
-    cxxflags = os.environ.get(
-        "CXXFLAGS", "-std=c++17 -O3 -fPIC -Wall -Wextra"
-    ).split()
+    cxxflags = shlex.split(
+        os.environ.get("CXXFLAGS", "-std=c++17 -O3 -fPIC -Wall -Wextra")
+    )
     # compile to a temp path and os.replace() so concurrent builders never
     # leave a torn .so for another process's dlopen
     tmp = f"{SO}.tmp.{os.getpid()}"

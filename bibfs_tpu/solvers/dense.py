@@ -23,7 +23,7 @@ from __future__ import annotations
 
 import dataclasses
 import time
-from functools import partial
+from functools import lru_cache, partial
 
 import jax
 import jax.numpy as jnp
@@ -37,6 +37,19 @@ from bibfs_tpu.solvers.serial import _reconstruct
 # "infinite" distance sentinel; a plain int so importing this module never
 # touches a JAX backend (device constants would initialize one eagerly)
 INF32 = 1 << 30
+
+
+@lru_cache(maxsize=4096)
+def _device_scalar(v: int) -> jax.Array:
+    """Device-resident int32 scalar, cached by value.
+
+    Passing a *freshly* eager-created device scalar as a jit argument stalls
+    the dispatch path on tunneled-TPU runtimes (measured ~100ms per fresh
+    arg vs ~20us when the scalar buffer is reused), so solver entry points
+    must route src/dst through this cache rather than calling
+    ``jnp.int32(...)`` per solve.
+    """
+    return jnp.int32(v)
 
 
 @dataclasses.dataclass
@@ -70,22 +83,11 @@ class DeviceGraph:
         )
 
 
-@partial(jax.jit, static_argnames=())
-def bibfs_dense(nbr, deg, src, dst):
-    """Jittable full bidirectional-BFS search.
-
-    Returns ``(best, meet, dist_s, dist_t, parent_s, parent_t, levels,
-    edges_scanned)`` — ``best >= INF32`` means no path.
-    """
-    n_pad = nbr.shape[0]
+def _init_state(n_pad, src, dst):
     zeros_b = jnp.zeros(n_pad, dtype=jnp.bool_)
-
-    def seed(v):
-        return zeros_b.at[v].set(True)
-
-    fs = seed(src)
-    ft = seed(dst)
-    init = dict(
+    fs = zeros_b.at[src].set(True)
+    ft = zeros_b.at[dst].set(True)
+    return dict(
         vis_s=fs,
         fr_s=fs,
         par_s=jnp.full(n_pad, -1, jnp.int32),
@@ -102,17 +104,98 @@ def bibfs_dense(nbr, deg, src, dst):
         edges=jnp.int32(0),
     )
 
-    def cond(st):
-        return (
-            (st["lvl_s"] + st["lvl_t"] < st["best"])
-            & jnp.any(st["fr_s"])
-            & jnp.any(st["fr_t"])
+
+def _meet_vote(st):
+    """Fused check_intersect (v3/bibfs_cuda_only.cu:45-62): best candidate
+    distance + its meet vertex over the visited intersection. dist values of
+    visited vertices are final in a level-synchronous BFS, so the min is
+    exact."""
+    sums = jnp.where(st["vis_s"] & st["vis_t"], st["dist_s"] + st["dist_t"], INF32)
+    cur = jnp.min(sums)
+    arg = jnp.argmin(sums).astype(jnp.int32)
+    st["meet"] = jnp.where(cur < st["best"], arg, st["meet"])
+    st["best"] = jnp.minimum(st["best"], cur)
+    return st
+
+
+def _outputs(out):
+    return (
+        out["best"],
+        out["meet"],
+        out["par_s"],
+        out["par_t"],
+        out["levels"],
+        out["edges"],
+    )
+
+
+def _cond(st):
+    # provably-correct stop: once lvl_s+lvl_t >= best no undiscovered vertex
+    # can improve the meet (the midpoint of any shorter path would already
+    # be visited by both sides) — fixes quirks Q1/Q2
+    return (
+        (st["lvl_s"] + st["lvl_t"] < st["best"])
+        & jnp.any(st["fr_s"])
+        & jnp.any(st["fr_t"])
+    )
+
+
+@jax.jit
+def bibfs_dense(nbr, deg, src, dst):
+    """Jittable bidirectional-BFS search, lock-step variant: BOTH sides
+    expand every round (the v2/v3 schedule, second_try.cpp:68-105 /
+    bibfs_cuda_only.cu:173-193 — but with the correct termination rule).
+
+    Half the sequential rounds of the alternating variant for the same
+    total work — on TPU the search is latency-bound (a round is one
+    while_loop iteration), so this is the headline path.
+
+    Returns ``(best, meet, parent_s, parent_t, levels, edges_scanned)`` —
+    ``best >= INF32`` means no path.
+    """
+    n_pad = nbr.shape[0]
+    init = _init_state(n_pad, src, dst)
+
+    def body(st):
+        scanned = frontier_degree_sum(st["fr_s"], deg) + frontier_degree_sum(
+            st["fr_t"], deg
         )
+        nf_s, pcand_s = expand_pull(st["fr_s"], st["vis_s"], nbr, deg)
+        nf_t, pcand_t = expand_pull(st["fr_t"], st["vis_t"], nbr, deg)
+        st = {
+            **st,
+            "fr_s": nf_s,
+            "vis_s": st["vis_s"] | nf_s,
+            "par_s": jnp.where(nf_s, pcand_s, st["par_s"]),
+            "dist_s": jnp.where(nf_s, st["lvl_s"] + 1, st["dist_s"]),
+            "fr_t": nf_t,
+            "vis_t": st["vis_t"] | nf_t,
+            "par_t": jnp.where(nf_t, pcand_t, st["par_t"]),
+            "dist_t": jnp.where(nf_t, st["lvl_t"] + 1, st["dist_t"]),
+            "lvl_s": st["lvl_s"] + 1,
+            "lvl_t": st["lvl_t"] + 1,
+            "edges": st["edges"] + scanned,
+            "levels": st["levels"] + 2,
+        }
+        return _meet_vote(st)
+
+    return _outputs(jax.lax.while_loop(_cond, body, init))
+
+
+@jax.jit
+def bibfs_dense_alt(nbr, deg, src, dst):
+    """Alternating smaller-frontier-first variant (v1/main-v1.cpp:51, v4
+    mpi_bas.cpp:90-92): one side per round, always the cheaper one — fewer
+    total edge scans than lock-step at twice the sequential rounds. Prefer
+    for work-bound (large-graph) searches; same return contract as
+    :func:`bibfs_dense`.
+    """
+    n_pad = nbr.shape[0]
+    init = _init_state(n_pad, src, dst)
 
     def body(st):
         cs = frontier_count(st["fr_s"])
         ct = frontier_count(st["fr_t"])
-        expand_s = cs <= ct
 
         def one_side(fr, vis, par, dist, lvl):
             nf, pcand = expand_pull(fr, vis, nbr, deg)
@@ -150,60 +233,69 @@ def bibfs_dense(nbr, deg, src, dst):
                 "edges": st["edges"] + scanned,
             }
 
-        st = jax.lax.cond(expand_s, s_branch, t_branch, st)
-        # meet vote — the check_intersect kernel (v3:45-62) fused in-loop
-        sums = jnp.where(
-            st["vis_s"] & st["vis_t"], st["dist_s"] + st["dist_t"], INF32
-        )
-        cur = jnp.min(sums)
-        arg = jnp.argmin(sums).astype(jnp.int32)
-        st["meet"] = jnp.where(cur < st["best"], arg, st["meet"])
-        st["best"] = jnp.minimum(st["best"], cur)
+        st = jax.lax.cond(cs <= ct, s_branch, t_branch, st)
         st["levels"] = st["levels"] + 1
-        return st
+        return _meet_vote(st)
 
-    out = jax.lax.while_loop(cond, body, init)
-    return (
-        out["best"],
-        out["meet"],
-        out["dist_s"],
-        out["dist_t"],
-        out["par_s"],
-        out["par_t"],
-        out["levels"],
-        out["edges"],
-    )
+    return _outputs(jax.lax.while_loop(_cond, body, init))
 
 
-def solve_dense_graph(g: DeviceGraph, src: int, dst: int) -> BFSResult:
+_DENSE_KERNELS = {"sync": bibfs_dense, "alt": bibfs_dense_alt}
+
+
+def solve_dense_graph(
+    g: DeviceGraph, src: int, dst: int, *, mode: str = "sync"
+) -> BFSResult:
     """Run the jitted search on an already-device-resident graph; timing
     covers the search only (reference parity: each version times only the
     hot loop, SURVEY.md §5 tracing)."""
     if not (0 <= src < g.n and 0 <= dst < g.n):
         raise ValueError(f"src/dst out of range for n={g.n}")
-    src_a = jnp.int32(src)
-    dst_a = jnp.int32(dst)
+    kern = _DENSE_KERNELS[mode]
+    src_a = _device_scalar(src)
+    dst_a = _device_scalar(dst)
     t0 = time.perf_counter()
-    best, meet, dist_s, dist_t, par_s, par_t, levels, edges = jax.block_until_ready(
-        bibfs_dense(g.nbr, g.deg, src_a, dst_a)
-    )
+    out = jax.block_until_ready(kern(g.nbr, g.deg, src_a, dst_a))
     elapsed = time.perf_counter() - t0
+    return _materialize(out, elapsed)
+
+
+def _materialize(out, elapsed: float) -> BFSResult:
+    best, meet, par_s, par_t, levels, edges = out
     best = int(best)
     if best >= int(INF32):
         return BFSResult(False, None, None, None, elapsed, int(levels), int(edges))
-    par_s_np = np.asarray(par_s, dtype=np.int64)
-    par_t_np = np.asarray(par_t, dtype=np.int64)
-    path = _reconstruct(par_s_np, par_t_np, int(meet))
-    return BFSResult(
-        True, best, path, int(meet), elapsed, int(levels), int(edges)
+    path = _reconstruct(
+        np.asarray(par_s, dtype=np.int64), np.asarray(par_t, dtype=np.int64), int(meet)
+    )
+    return BFSResult(True, best, path, int(meet), elapsed, int(levels), int(edges))
+
+
+def time_search(
+    g: DeviceGraph, src: int, dst: int, *, repeats: int = 30, mode: str = "sync"
+) -> tuple[list[float], BFSResult]:
+    """Zero-D2H timing loop + one materializing solve (protocol and
+    rationale in :mod:`bibfs_tpu.solvers.timing`). Returns ``(times_s,
+    result)`` with ``result.time_s`` = median."""
+    from bibfs_tpu.solvers.timing import timed_repeats
+
+    kern = _DENSE_KERNELS[mode]
+    src_a = _device_scalar(src)
+    dst_a = _device_scalar(dst)
+    return timed_repeats(
+        lambda: jax.block_until_ready(kern(g.nbr, g.deg, src_a, dst_a)),
+        lambda: solve_dense_graph(g, src, dst, mode=mode),
+        repeats,
     )
 
 
-def solve_dense(n: int, edges: np.ndarray, src: int, dst: int) -> BFSResult:
+def solve_dense(
+    n: int, edges: np.ndarray, src: int, dst: int, *, mode: str = "sync"
+) -> BFSResult:
     g = DeviceGraph.from_ell(build_ell(n, edges))
-    return solve_dense_graph(g, src, dst)
+    return solve_dense_graph(g, src, dst, mode=mode)
 
 
 @register("dense")
-def _dense_backend(n, edges, src, dst, **_):
-    return solve_dense(n, edges, src, dst)
+def _dense_backend(n, edges, src, dst, mode="sync", **_):
+    return solve_dense(n, edges, src, dst, mode=mode)

@@ -39,13 +39,15 @@ from bibfs_tpu.ops.expand import expand_pull, frontier_count, frontier_degree_su
 from bibfs_tpu.parallel.collectives import global_min_and_argmin, sum_allreduce
 from bibfs_tpu.parallel.mesh import VERTEX_AXIS, make_1d_mesh, shard_spec
 from bibfs_tpu.solvers.api import BFSResult, register
-from bibfs_tpu.solvers.dense import INF32
-from bibfs_tpu.solvers.serial import _reconstruct
+from bibfs_tpu.solvers.dense import INF32, _device_scalar, _materialize
 
 
-def _bibfs_shard_body(nbr, deg, src, dst, *, axis: str):
+def _bibfs_shard_body(nbr, deg, src, dst, *, axis: str, mode: str = "sync"):
     """The per-device program. ``nbr``/``deg`` are the LOCAL vertex shard;
-    ``src``/``dst`` are replicated scalars."""
+    ``src``/``dst`` are replicated scalars. ``mode="sync"`` expands both
+    sides every round (half the sequential rounds — the latency-bound
+    default); ``mode="alt"`` expands the globally-smaller frontier only
+    (fewer total edge scans, v1/v4's direction optimization)."""
     n_loc = nbr.shape[0]
     me = jax.lax.axis_index(axis)
     offset = (me * n_loc).astype(jnp.int32)
@@ -88,52 +90,48 @@ def _bibfs_shard_body(nbr, deg, src, dst, *, axis: str):
             & (st["cnt_t"] > 0)
         )
 
-    def body(st):
-        expand_s = st["cnt_s"] <= st["cnt_t"]  # smaller-frontier-first
+    def one_side(fr, vis, par, dist, lvl):
+        # THE per-level exchange: one boolean frontier all_gather (ICI)
+        f_glob = jax.lax.all_gather(fr, axis, tiled=True)
+        nf, pcand = expand_pull(f_glob, vis, nbr, deg)
+        par = jnp.where(nf, pcand, par)
+        dist = jnp.where(nf, lvl + 1, dist)
+        cnt = sum_allreduce(frontier_count(nf), axis)
+        return nf, vis | nf, par, dist, lvl + 1, cnt
 
-        def one_side(fr, vis, par, dist, lvl):
-            # THE per-level exchange: one boolean frontier all_gather (ICI)
-            f_glob = jax.lax.all_gather(fr, axis, tiled=True)
-            nf, pcand = expand_pull(f_glob, vis, nbr, deg)
-            par = jnp.where(nf, pcand, par)
-            dist = jnp.where(nf, lvl + 1, dist)
-            cnt = sum_allreduce(frontier_count(nf), axis)
-            return nf, vis | nf, par, dist, lvl + 1, cnt
+    def s_step(st):
+        scanned = sum_allreduce(frontier_degree_sum(st["fr_s"], deg), axis)
+        nf, vis, par, dist, lvl, cnt = one_side(
+            st["fr_s"], st["vis_s"], st["par_s"], st["dist_s"], st["lvl_s"]
+        )
+        return {
+            **st,
+            "fr_s": nf,
+            "vis_s": vis,
+            "par_s": par,
+            "dist_s": dist,
+            "lvl_s": lvl,
+            "cnt_s": cnt,
+            "edges": st["edges"] + scanned,
+        }
 
-        def s_branch(st):
-            scanned = sum_allreduce(frontier_degree_sum(st["fr_s"], deg), axis)
-            nf, vis, par, dist, lvl, cnt = one_side(
-                st["fr_s"], st["vis_s"], st["par_s"], st["dist_s"], st["lvl_s"]
-            )
-            return {
-                **st,
-                "fr_s": nf,
-                "vis_s": vis,
-                "par_s": par,
-                "dist_s": dist,
-                "lvl_s": lvl,
-                "cnt_s": cnt,
-                "edges": st["edges"] + scanned,
-            }
+    def t_step(st):
+        scanned = sum_allreduce(frontier_degree_sum(st["fr_t"], deg), axis)
+        nf, vis, par, dist, lvl, cnt = one_side(
+            st["fr_t"], st["vis_t"], st["par_t"], st["dist_t"], st["lvl_t"]
+        )
+        return {
+            **st,
+            "fr_t": nf,
+            "vis_t": vis,
+            "par_t": par,
+            "dist_t": dist,
+            "lvl_t": lvl,
+            "cnt_t": cnt,
+            "edges": st["edges"] + scanned,
+        }
 
-        def t_branch(st):
-            scanned = sum_allreduce(frontier_degree_sum(st["fr_t"], deg), axis)
-            nf, vis, par, dist, lvl, cnt = one_side(
-                st["fr_t"], st["vis_t"], st["par_t"], st["dist_t"], st["lvl_t"]
-            )
-            return {
-                **st,
-                "fr_t": nf,
-                "vis_t": vis,
-                "par_t": par,
-                "dist_t": dist,
-                "lvl_t": lvl,
-                "cnt_t": cnt,
-                "edges": st["edges"] + scanned,
-            }
-
-        st = jax.lax.cond(expand_s, s_branch, t_branch, st)
-
+    def meet_vote(st, delta):
         # meet vote: local min(dist_s+dist_t) over my shard, then a global
         # pmin pair (replaces v2's word-wise AND scan + Allreduce LOR,
         # second_try.cpp:110-116, and reports the true hop count — fix Q1)
@@ -145,15 +143,24 @@ def _bibfs_shard_body(nbr, deg, src, dst, *, axis: str):
         gmin, garg = global_min_and_argmin(lmin, larg, axis)
         st["meet"] = jnp.where(gmin < st["best"], garg, st["meet"])
         st["best"] = jnp.minimum(st["best"], gmin)
-        st["levels"] = st["levels"] + 1
+        st["levels"] = st["levels"] + delta
         return st
+
+    if mode == "sync":
+
+        def body(st):
+            return meet_vote(t_step(s_step(st)), 2)
+
+    else:
+
+        def body(st):
+            st = jax.lax.cond(st["cnt_s"] <= st["cnt_t"], s_step, t_step, st)
+            return meet_vote(st, 1)
 
     out = jax.lax.while_loop(cond, body, init)
     return (
         out["best"],
         out["meet"],
-        out["dist_s"],
-        out["dist_t"],
         out["par_s"],
         out["par_t"],
         out["levels"],
@@ -162,14 +169,16 @@ def _bibfs_shard_body(nbr, deg, src, dst, *, axis: str):
 
 
 @lru_cache(maxsize=None)
-def _compiled_sharded(mesh, axis: str):
+def _compiled_sharded(mesh, axis: str, mode: str = "sync"):
     sh = P(axis)
     rep = P()
     fn = jax.shard_map(
-        lambda nbr, deg, src, dst: _bibfs_shard_body(nbr, deg, src, dst, axis=axis),
+        lambda nbr, deg, src, dst: _bibfs_shard_body(
+            nbr, deg, src, dst, axis=axis, mode=mode
+        ),
         mesh=mesh,
         in_specs=(sh, sh, rep, rep),
-        out_specs=(rep, rep, sh, sh, sh, sh, rep, rep),
+        out_specs=(rep, rep, sh, sh, rep, rep),
     )
     return jax.jit(fn)
 
@@ -202,22 +211,35 @@ class ShardedGraph:
         self.deg = jax.device_put(g.deg, spec)
 
 
-def solve_sharded_graph(g: ShardedGraph, src: int, dst: int) -> BFSResult:
+def solve_sharded_graph(
+    g: ShardedGraph, src: int, dst: int, *, mode: str = "sync"
+) -> BFSResult:
     if not (0 <= src < g.n and 0 <= dst < g.n):
         raise ValueError(f"src/dst out of range for n={g.n}")
-    fn = _compiled_sharded(g.mesh, VERTEX_AXIS)
+    fn = _compiled_sharded(g.mesh, VERTEX_AXIS, mode)
+    src_a = _device_scalar(src)
+    dst_a = _device_scalar(dst)
     t0 = time.perf_counter()
-    best, meet, dist_s, dist_t, par_s, par_t, levels, edges = jax.block_until_ready(
-        fn(g.nbr, g.deg, jnp.int32(src), jnp.int32(dst))
-    )
+    out = jax.block_until_ready(fn(g.nbr, g.deg, src_a, dst_a))
     elapsed = time.perf_counter() - t0
-    best = int(best)
-    if best >= int(INF32):
-        return BFSResult(False, None, None, None, elapsed, int(levels), int(edges))
-    path = _reconstruct(
-        np.asarray(par_s, dtype=np.int64), np.asarray(par_t, dtype=np.int64), int(meet)
+    return _materialize(out, elapsed)
+
+
+def time_search(
+    g: ShardedGraph, src: int, dst: int, *, repeats: int = 30, mode: str = "sync"
+) -> tuple[list[float], BFSResult]:
+    """Zero-D2H timing loop + one materializing solve (protocol and
+    rationale in :mod:`bibfs_tpu.solvers.timing`)."""
+    from bibfs_tpu.solvers.timing import timed_repeats
+
+    fn = _compiled_sharded(g.mesh, VERTEX_AXIS, mode)
+    src_a = _device_scalar(src)
+    dst_a = _device_scalar(dst)
+    return timed_repeats(
+        lambda: jax.block_until_ready(fn(g.nbr, g.deg, src_a, dst_a)),
+        lambda: solve_sharded_graph(g, src, dst, mode=mode),
+        repeats,
     )
-    return BFSResult(True, best, path, int(meet), elapsed, int(levels), int(edges))
 
 
 def solve_sharded(
@@ -227,13 +249,14 @@ def solve_sharded(
     dst: int,
     *,
     num_devices: int | None = None,
+    mode: str = "sync",
 ) -> BFSResult:
     mesh = make_1d_mesh(num_devices)
     ndev = int(mesh.devices.size)
     ell = build_ell(n, edges, pad_multiple=8 * ndev)
-    return solve_sharded_graph(ShardedGraph(ell, mesh), src, dst)
+    return solve_sharded_graph(ShardedGraph(ell, mesh), src, dst, mode=mode)
 
 
 @register("sharded")
-def _sharded_backend(n, edges, src, dst, num_devices=None, **_):
-    return solve_sharded(n, edges, src, dst, num_devices=num_devices)
+def _sharded_backend(n, edges, src, dst, num_devices=None, mode="sync", **_):
+    return solve_sharded(n, edges, src, dst, num_devices=num_devices, mode=mode)

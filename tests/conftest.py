@@ -20,15 +20,12 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 # The ambient axon boot (sitecustomize) pins jax_platforms="axon,cpu" via
-# jax.config, which overrides the env var — re-pin to cpu explicitly. Only
-# needed when jax is ALREADY imported (the sitecustomize case); otherwise
-# the env vars above are honored at import time and serial/native-only test
-# runs stay jax-free. Backends init lazily, so the XLA_FLAGS
-# host-device-count flag still applies at re-pin time.
-import sys  # noqa: E402
+# jax.config, which overrides the env var — re-assert the env contract
+# (no-op when jax isn't imported yet; backends init lazily, so the
+# XLA_FLAGS host-device-count flag still applies at re-pin time).
+from bibfs_tpu.utils.platform import apply_platform_env  # noqa: E402
 
-if "jax" in sys.modules:
-    sys.modules["jax"].config.update("jax_platforms", "cpu")
+apply_platform_env()
 
 
 @pytest.fixture

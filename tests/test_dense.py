@@ -60,3 +60,41 @@ def test_dense_teps_accounting():
     r = solve_dense(n, edges, 0, 29)
     assert r.edges_scanned > 0
     assert r.levels >= 15  # bidirectional: ~n/2 levels each side
+
+
+@pytest.mark.parametrize("case", range(0, len(CASES), 3))
+def test_dense_alt_mode_matches_serial(case):
+    """The alternating smaller-frontier-first schedule (mode="alt",
+    v1/main-v1.cpp:51) must agree with the oracle like the sync default."""
+    n, edges, src, dst = CASES[case]
+    ref = solve_serial(n, edges, src, dst)
+    got = solve_dense(n, edges, src, dst, mode="alt")
+    assert got.found == ref.found
+    if ref.found:
+        assert got.hops == ref.hops
+        got.validate_path(n, edges, src, dst)
+
+
+def test_dense_alt_counterexample_first_meet():
+    edges = np.array(
+        [[0, 1], [0, 2], [0, 8], [9, 3], [3, 4], [3, 6], [3, 7], [1, 4], [2, 3]]
+    )
+    r = solve_dense(10, edges, 0, 9, mode="alt")
+    assert r.found and r.hops == 3
+
+
+def test_dense_time_search_protocol():
+    """time_search: times list of the right length, result matches a plain
+    solve, and time_s is the median of the returned times."""
+    from bibfs_tpu.graph.csr import build_ell
+    from bibfs_tpu.solvers.dense import DeviceGraph, time_search
+
+    n, edges, src, dst = CASES[1]
+    g = DeviceGraph.from_ell(build_ell(n, edges))
+    times, res = time_search(g, src, dst, repeats=4)
+    assert len(times) == 4
+    assert res.time_s == float(np.median(times))
+    ref = solve_serial(n, edges, src, dst)
+    assert res.found == ref.found
+    if ref.found:
+        assert res.hops == ref.hops

@@ -67,3 +67,30 @@ def test_sharded_endpoint_in_last_shard():
 def test_too_many_devices():
     with pytest.raises(ValueError):
         solve_sharded(10, np.array([[0, 1]]), 0, 1, num_devices=64)
+
+
+@pytest.mark.parametrize("case", range(0, len(CASES), 4))
+def test_sharded_alt_mode_matches_serial(case):
+    n, edges, src, dst = CASES[case]
+    ref = solve_serial(n, edges, src, dst)
+    got = solve_sharded(n, edges, src, dst, num_devices=8, mode="alt")
+    assert got.found == ref.found
+    if ref.found:
+        assert got.hops == ref.hops
+        got.validate_path(n, edges, src, dst)
+
+
+def test_sharded_time_search_protocol():
+    from bibfs_tpu.graph.csr import build_ell
+    from bibfs_tpu.parallel.mesh import make_1d_mesh
+    from bibfs_tpu.solvers.sharded import ShardedGraph, time_search
+
+    n, edges, src, dst = CASES[2]
+    mesh = make_1d_mesh(8)
+    g = ShardedGraph(build_ell(n, edges, pad_multiple=64), mesh)
+    times, res = time_search(g, src, dst, repeats=3)
+    assert len(times) == 3
+    ref = solve_serial(n, edges, src, dst)
+    assert res.found == ref.found
+    if ref.found:
+        assert res.hops == ref.hops
