@@ -44,9 +44,12 @@ def main(argv=None):
     ap.add_argument(
         "--mode",
         default="sync",
-        choices=["sync", "alt"],
+        choices=["sync", "alt", "beamer", "beamer_alt"],
         help="device-kernel schedule for dense/sharded backends: sync = "
-        "both sides per round, alt = smaller-frontier-first alternation",
+        "both sides per round, alt = smaller-frontier-first alternation; "
+        "beamer/beamer_alt add push/pull direction optimization (sparse "
+        "frontiers go through a scatter push path instead of the full-table "
+        "pull gather)",
     )
     args = ap.parse_args(argv)
 

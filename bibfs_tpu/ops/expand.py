@@ -49,6 +49,72 @@ def expand_pull(
     return next_f, parent
 
 
+def expand_push(
+    fidx: jnp.ndarray,  # int32[K] compact frontier, -1 = dead slot
+    par: jnp.ndarray,  # int32[n_pad] parent array (-1 = none)
+    dist: jnp.ndarray,  # int32[n_pad] distance array (>= inf = unvisited)
+    nbr: jnp.ndarray,  # int32[n_pad, width] ELL neighbor table
+    deg: jnp.ndarray,  # int32[n_pad]
+    lvl_next: jnp.ndarray,  # int32 scalar: level being discovered
+    *,
+    inf: int,
+) -> tuple[jnp.ndarray, ...]:
+    """One BFS level, *push*-style over a compact frontier index list — the
+    top-down half of Beamer direction optimization (new-build scope per
+    SURVEY.md §2 strategy 6; the reference only ever chooses which SIDE to
+    expand, v1/main-v1.cpp:51, never how).
+
+    Cost scales with ``K * width`` (scatter/gather of the frontier's edges
+    only) instead of :func:`expand_pull`'s ``n_pad * width`` full-table read
+    — the win for the many early BFS levels whose frontiers are tiny, and
+    the only viable regime for multi-million-vertex graphs where the full
+    ELL table is hundreds of MB per level.
+
+    The CUDA version's ``atomicExch`` visited-claim (v3/bibfs_cuda_only.cu:36)
+    becomes a deterministic scatter-max parent claim: every discovering edge
+    scatters its source id, the max source wins, and the winning occurrence
+    is identified by a read-back compare (no atomics, no nondeterminism).
+
+    Returns ``(next_frontier bool[n_pad], next_fidx int32[K], cnt int32,
+    par int32[n_pad], dist int32[n_pad], scanned int32)``. ``next_fidx`` is
+    complete only when ``cnt <= K`` — callers must route the next level to
+    the pull path otherwise.
+    """
+    k = fidx.shape[0]
+    width = nbr.shape[1]
+    n_pad = nbr.shape[0]
+    live = fidx >= 0
+    fc = jnp.where(live, fidx, 0)
+    rows = nbr[fc]  # [K, width] row gather
+    vd = jnp.where(live, deg[fc], 0)
+    valid = jnp.arange(width, dtype=jnp.int32)[None, :] < vd[:, None]
+    cand_new = valid & (dist[rows] >= inf)  # unvisited targets only
+    tgt = jnp.where(cand_new, rows, n_pad)  # n_pad = out of bounds -> drop
+    dist = dist.at[tgt].min(
+        jnp.broadcast_to(lvl_next.astype(jnp.int32), tgt.shape), mode="drop"
+    )
+    srcb = jnp.broadcast_to(fc[:, None], tgt.shape)
+    par = par.at[tgt].max(srcb, mode="drop")
+    # winning occurrence per target: the one whose source survived the max
+    win = cand_new & (par[rows] == srcb)
+    next_f = (
+        jnp.zeros(n_pad, jnp.bool_)
+        .at[tgt]
+        .max(jnp.ones(tgt.shape, jnp.bool_), mode="drop")
+    )
+    # compact the winners into the next index list (cumsum over K*width —
+    # no O(n) work anywhere in the push path)
+    wflat = win.ravel()
+    pos = jnp.cumsum(wflat.astype(jnp.int32)) - 1
+    outpos = jnp.where(wflat, pos, k)  # k = out of bounds -> drop
+    next_fidx = (
+        jnp.full(k, -1, jnp.int32).at[outpos].set(rows.ravel(), mode="drop")
+    )
+    cnt = jnp.sum(wflat.astype(jnp.int32))
+    scanned = jnp.sum(vd)
+    return next_f, next_fidx, cnt, par, dist, scanned
+
+
 def frontier_count(frontier: jnp.ndarray) -> jnp.ndarray:
     """Popcount of a boolean frontier (v2's bitset popcount,
     second_try.cpp:117-124, without the bit twiddling)."""

@@ -30,7 +30,12 @@ import jax.numpy as jnp
 import numpy as np
 
 from bibfs_tpu.graph.csr import EllGraph, build_ell
-from bibfs_tpu.ops.expand import expand_pull, frontier_count, frontier_degree_sum
+from bibfs_tpu.ops.expand import (
+    expand_pull,
+    expand_push,
+    frontier_count,
+    frontier_degree_sum,
+)
 from bibfs_tpu.solvers.api import BFSResult, register
 from bibfs_tpu.solvers.serial import _reconstruct
 
@@ -83,38 +88,53 @@ class DeviceGraph:
         )
 
 
-def _init_state(n_pad, src, dst):
+def _auto_push_cap(n_pad: int) -> int:
+    """Frontier size below which push beats pull. Push costs ~K*width
+    scattered elements (element-at-a-time scatter/gather), pull costs
+    ~n_pad*width*4 bytes of sequential HBM reads — on v5e the crossover is
+    around K ≈ n_pad / 200; round to a power of two, clamp to a sane band."""
+    cap = 1 << max(7, (n_pad // 256).bit_length())
+    return int(min(2048, cap, max(128, n_pad)))
+
+
+def _init_state(n_pad, k, src, dst):
     zeros_b = jnp.zeros(n_pad, dtype=jnp.bool_)
-    fs = zeros_b.at[src].set(True)
-    ft = zeros_b.at[dst].set(True)
-    return dict(
-        vis_s=fs,
-        fr_s=fs,
-        par_s=jnp.full(n_pad, -1, jnp.int32),
-        dist_s=jnp.where(fs, 0, INF32).astype(jnp.int32),
-        vis_t=ft,
-        fr_t=ft,
-        par_t=jnp.full(n_pad, -1, jnp.int32),
-        dist_t=jnp.where(ft, 0, INF32).astype(jnp.int32),
-        lvl_s=jnp.int32(0),
-        lvl_t=jnp.int32(0),
+
+    def side(v):
+        fr = zeros_b.at[v].set(True)
+        return dict(
+            fr=fr,
+            fi=jnp.full(k, -1, jnp.int32).at[0].set(v.astype(jnp.int32)),
+            ok=jnp.bool_(True),
+            cnt=jnp.int32(1),
+            par=jnp.full(n_pad, -1, jnp.int32),
+            dist=jnp.where(fr, 0, INF32).astype(jnp.int32),
+            lvl=jnp.int32(0),
+        )
+
+    st = {f"{key}_s": val for key, val in side(src).items()}
+    st.update({f"{key}_t": val for key, val in side(dst).items()})
+    st.update(
         best=jnp.where(src == dst, 0, INF32).astype(jnp.int32),
         meet=jnp.where(src == dst, src, -1).astype(jnp.int32),
         levels=jnp.int32(0),
         edges=jnp.int32(0),
     )
+    return st
 
 
-def _meet_vote(st):
+def _meet_vote(st, delta):
     """Fused check_intersect (v3/bibfs_cuda_only.cu:45-62): best candidate
     distance + its meet vertex over the visited intersection. dist values of
     visited vertices are final in a level-synchronous BFS, so the min is
-    exact."""
-    sums = jnp.where(st["vis_s"] & st["vis_t"], st["dist_s"] + st["dist_t"], INF32)
+    exact. Visited sets are implicit: ``dist < INF32``."""
+    both = (st["dist_s"] < INF32) & (st["dist_t"] < INF32)
+    sums = jnp.where(both, st["dist_s"] + st["dist_t"], INF32)
     cur = jnp.min(sums)
     arg = jnp.argmin(sums).astype(jnp.int32)
     st["meet"] = jnp.where(cur < st["best"], arg, st["meet"])
     st["best"] = jnp.minimum(st["best"], cur)
+    st["levels"] = st["levels"] + delta
     return st
 
 
@@ -132,115 +152,135 @@ def _outputs(out):
 def _cond(st):
     # provably-correct stop: once lvl_s+lvl_t >= best no undiscovered vertex
     # can improve the meet (the midpoint of any shorter path would already
-    # be visited by both sides) — fixes quirks Q1/Q2
+    # be visited by both sides) — fixes quirks Q1/Q2. Frontier-emptiness is
+    # a scalar carry (v2 recomputed it with two Allreduce SUMs per level,
+    # second_try.cpp:117-128).
     return (
         (st["lvl_s"] + st["lvl_t"] < st["best"])
-        & jnp.any(st["fr_s"])
-        & jnp.any(st["fr_t"])
+        & (st["cnt_s"] > 0)
+        & (st["cnt_t"] > 0)
     )
 
 
-@jax.jit
-def bibfs_dense(nbr, deg, src, dst):
-    """Jittable bidirectional-BFS search, lock-step variant: BOTH sides
-    expand every round (the v2/v3 schedule, second_try.cpp:68-105 /
-    bibfs_cuda_only.cu:173-193 — but with the correct termination rule).
+def _side_step(st, side: str, nbr, deg, *, push_cap: int):
+    """Advance one side one level. ``push_cap > 0`` enables Beamer direction
+    optimization: frontiers at most ``push_cap`` wide go through the sparse
+    push path, larger ones through the dense pull path. ``push_cap == 0``
+    is pull-only (the v3-style dense schedule)."""
+    k = st[f"fi_{side}"].shape[0]
+    carry = (
+        st[f"fr_{side}"],
+        st[f"fi_{side}"],
+        st[f"ok_{side}"],
+        st[f"par_{side}"],
+        st[f"dist_{side}"],
+        st[f"lvl_{side}"],
+    )
 
-    Half the sequential rounds of the alternating variant for the same
-    total work — on TPU the search is latency-bound (a round is one
-    while_loop iteration), so this is the headline path.
+    def pull(c):
+        fr, fi, _ok, par, dist, lvl = c
+        scanned = frontier_degree_sum(fr, deg)
+        nf, pcand = expand_pull(fr, dist < INF32, nbr, deg)
+        par = jnp.where(nf, pcand, par)
+        dist = jnp.where(nf, lvl + 1, dist)
+        # the compact index list is now stale; push recomputes it on entry
+        return nf, fi, jnp.bool_(False), par, dist, lvl + 1, frontier_count(nf), scanned
 
-    Returns ``(best, meet, parent_s, parent_t, levels, edges_scanned)`` —
-    ``best >= INF32`` means no path.
-    """
-    n_pad = nbr.shape[0]
-    init = _init_state(n_pad, src, dst)
-
-    def body(st):
-        scanned = frontier_degree_sum(st["fr_s"], deg) + frontier_degree_sum(
-            st["fr_t"], deg
+    def push(c):
+        fr, fi, ok, par, dist, lvl = c
+        fi = jax.lax.cond(
+            ok,
+            lambda: fi,
+            lambda: jnp.flatnonzero(fr, size=k, fill_value=-1).astype(jnp.int32),
         )
-        nf_s, pcand_s = expand_pull(st["fr_s"], st["vis_s"], nbr, deg)
-        nf_t, pcand_t = expand_pull(st["fr_t"], st["vis_t"], nbr, deg)
-        st = {
-            **st,
-            "fr_s": nf_s,
-            "vis_s": st["vis_s"] | nf_s,
-            "par_s": jnp.where(nf_s, pcand_s, st["par_s"]),
-            "dist_s": jnp.where(nf_s, st["lvl_s"] + 1, st["dist_s"]),
-            "fr_t": nf_t,
-            "vis_t": st["vis_t"] | nf_t,
-            "par_t": jnp.where(nf_t, pcand_t, st["par_t"]),
-            "dist_t": jnp.where(nf_t, st["lvl_t"] + 1, st["dist_t"]),
-            "lvl_s": st["lvl_s"] + 1,
-            "lvl_t": st["lvl_t"] + 1,
-            "edges": st["edges"] + scanned,
-            "levels": st["levels"] + 2,
-        }
-        return _meet_vote(st)
+        nf, nfi, cnt, par, dist, scanned = expand_push(
+            fi, par, dist, nbr, deg, lvl + 1, inf=INF32
+        )
+        return nf, nfi, cnt <= k, par, dist, lvl + 1, cnt, scanned
 
-    return _outputs(jax.lax.while_loop(_cond, body, init))
+    if push_cap > 0:
+        out = jax.lax.cond(st[f"cnt_{side}"] <= push_cap, push, pull, carry)
+    else:
+        out = pull(carry)
+    nf, fi, ok, par, dist, lvl, cnt, scanned = out
+    return {
+        **st,
+        f"fr_{side}": nf,
+        f"fi_{side}": fi,
+        f"ok_{side}": ok,
+        f"par_{side}": par,
+        f"dist_{side}": dist,
+        f"lvl_{side}": lvl,
+        f"cnt_{side}": cnt,
+        "edges": st["edges"] + scanned,
+    }
 
 
-@jax.jit
+# mode -> (schedule, hybrid expansion?). Schedules: "sync" expands BOTH
+# sides every round (the v2/v3 schedule, second_try.cpp:68-105 /
+# bibfs_cuda_only.cu:173-193 — half the sequential rounds, best when
+# latency-bound); "alt" expands the smaller frontier only
+# (v1/main-v1.cpp:51, v4 mpi_bas.cpp:90-92 — fewest edge scans). "beamer"
+# variants add push/pull direction optimization per expansion (Beamer-style
+# top-down/bottom-up switching — BASELINE.json config scope, never in the
+# reference).
+DENSE_MODES = {
+    "sync": ("sync", False),
+    "alt": ("alt", False),
+    "beamer": ("sync", True),
+    "beamer_alt": ("alt", True),
+}
+
+
+@lru_cache(maxsize=None)
+def _get_kernel(mode: str, push_cap: int):
+    """Build + jit the search kernel for (mode, push_cap). Returns
+    ``fn(nbr, deg, src, dst) -> (best, meet, parent_s, parent_t, levels,
+    edges_scanned)``; ``best >= INF32`` means no path. The whole search is
+    one ``lax.while_loop`` in one XLA program — state never leaves HBM and
+    the host syncs exactly once at the end (versus per-level host
+    round-trips, quirk Q5)."""
+    schedule, hybrid = DENSE_MODES[mode]
+    cap = push_cap if hybrid else 0
+    k = max(cap, 1)
+
+    def kernel(nbr, deg, src, dst):
+        n_pad = nbr.shape[0]
+        init = _init_state(n_pad, k, src, dst)
+
+        if schedule == "sync":
+
+            def body(st):
+                st = _side_step(st, "s", nbr, deg, push_cap=cap)
+                st = _side_step(st, "t", nbr, deg, push_cap=cap)
+                return _meet_vote(st, 2)
+
+        else:
+
+            def body(st):
+                st = jax.lax.cond(
+                    st["cnt_s"] <= st["cnt_t"],
+                    lambda st: _side_step(st, "s", nbr, deg, push_cap=cap),
+                    lambda st: _side_step(st, "t", nbr, deg, push_cap=cap),
+                    st,
+                )
+                return _meet_vote(st, 1)
+
+        return _outputs(jax.lax.while_loop(_cond, body, init))
+
+    return jax.jit(kernel)
+
+
+def bibfs_dense(nbr, deg, src, dst):
+    """Pull-only lock-step search (both sides per round). Kept as the plain
+    jittable entry (`__graft_entry__.entry`); see :data:`DENSE_MODES` for
+    the full schedule × expansion matrix."""
+    return _get_kernel("sync", 0)(nbr, deg, src, dst)
+
+
 def bibfs_dense_alt(nbr, deg, src, dst):
-    """Alternating smaller-frontier-first variant (v1/main-v1.cpp:51, v4
-    mpi_bas.cpp:90-92): one side per round, always the cheaper one — fewer
-    total edge scans than lock-step at twice the sequential rounds. Prefer
-    for work-bound (large-graph) searches; same return contract as
-    :func:`bibfs_dense`.
-    """
-    n_pad = nbr.shape[0]
-    init = _init_state(n_pad, src, dst)
-
-    def body(st):
-        cs = frontier_count(st["fr_s"])
-        ct = frontier_count(st["fr_t"])
-
-        def one_side(fr, vis, par, dist, lvl):
-            nf, pcand = expand_pull(fr, vis, nbr, deg)
-            par = jnp.where(nf, pcand, par)
-            dist = jnp.where(nf, lvl + 1, dist)
-            return nf, vis | nf, par, dist, lvl + 1
-
-        def s_branch(st):
-            scanned = frontier_degree_sum(st["fr_s"], deg)
-            nf, vis, par, dist, lvl = one_side(
-                st["fr_s"], st["vis_s"], st["par_s"], st["dist_s"], st["lvl_s"]
-            )
-            return {
-                **st,
-                "fr_s": nf,
-                "vis_s": vis,
-                "par_s": par,
-                "dist_s": dist,
-                "lvl_s": lvl,
-                "edges": st["edges"] + scanned,
-            }
-
-        def t_branch(st):
-            scanned = frontier_degree_sum(st["fr_t"], deg)
-            nf, vis, par, dist, lvl = one_side(
-                st["fr_t"], st["vis_t"], st["par_t"], st["dist_t"], st["lvl_t"]
-            )
-            return {
-                **st,
-                "fr_t": nf,
-                "vis_t": vis,
-                "par_t": par,
-                "dist_t": dist,
-                "lvl_t": lvl,
-                "edges": st["edges"] + scanned,
-            }
-
-        st = jax.lax.cond(cs <= ct, s_branch, t_branch, st)
-        st["levels"] = st["levels"] + 1
-        return _meet_vote(st)
-
-    return _outputs(jax.lax.while_loop(_cond, body, init))
-
-
-_DENSE_KERNELS = {"sync": bibfs_dense, "alt": bibfs_dense_alt}
+    """Pull-only alternating smaller-frontier-first search."""
+    return _get_kernel("alt", 0)(nbr, deg, src, dst)
 
 
 def solve_dense_graph(
@@ -251,7 +291,7 @@ def solve_dense_graph(
     hot loop, SURVEY.md §5 tracing)."""
     if not (0 <= src < g.n and 0 <= dst < g.n):
         raise ValueError(f"src/dst out of range for n={g.n}")
-    kern = _DENSE_KERNELS[mode]
+    kern = _get_kernel(mode, _auto_push_cap(g.n_pad))
     src_a = _device_scalar(src)
     dst_a = _device_scalar(dst)
     t0 = time.perf_counter()
@@ -279,7 +319,7 @@ def time_search(
     result)`` with ``result.time_s`` = median."""
     from bibfs_tpu.solvers.timing import timed_repeats
 
-    kern = _DENSE_KERNELS[mode]
+    kern = _get_kernel(mode, _auto_push_cap(g.n_pad))
     src_a = _device_scalar(src)
     dst_a = _device_scalar(dst)
     return timed_repeats(

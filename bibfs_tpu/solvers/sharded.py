@@ -39,41 +39,61 @@ from bibfs_tpu.ops.expand import expand_pull, frontier_count, frontier_degree_su
 from bibfs_tpu.parallel.collectives import global_min_and_argmin, sum_allreduce
 from bibfs_tpu.parallel.mesh import VERTEX_AXIS, make_1d_mesh, shard_spec
 from bibfs_tpu.solvers.api import BFSResult, register
-from bibfs_tpu.solvers.dense import INF32, _device_scalar, _materialize
+from bibfs_tpu.solvers.dense import (
+    INF32,
+    _auto_push_cap,
+    _device_scalar,
+    _materialize,
+)
+
+from bibfs_tpu.solvers.dense import DENSE_MODES as SHARDED_MODES  # same matrix
 
 
-def _bibfs_shard_body(nbr, deg, src, dst, *, axis: str, mode: str = "sync"):
+def _bibfs_shard_body(
+    nbr, deg, src, dst, *, axis: str, mode: str = "sync", push_cap: int = 0
+):
     """The per-device program. ``nbr``/``deg`` are the LOCAL vertex shard;
     ``src``/``dst`` are replicated scalars. ``mode="sync"`` expands both
     sides every round (half the sequential rounds — the latency-bound
     default); ``mode="alt"`` expands the globally-smaller frontier only
-    (fewer total edge scans, v1/v4's direction optimization)."""
+    (fewer total edge scans, v1/v4's direction optimization). ``push_cap >
+    0`` enables Beamer push/pull direction optimization: frontiers at most
+    that wide skip the n-bool frontier all_gather entirely and instead
+    exchange only their candidate edges — ``K*width`` (tgt, src) pairs —
+    over ICI, so per-level traffic scales with the frontier, not the graph.
+    """
     n_loc = nbr.shape[0]
+    width = nbr.shape[1]
+    k = max(push_cap, 1)
     me = jax.lax.axis_index(axis)
     offset = (me * n_loc).astype(jnp.int32)
     ids = offset + jnp.arange(n_loc, dtype=jnp.int32)  # my global vertex ids
 
     def seed(v):
-        return ids == v
+        fr = ids == v
+        return dict(
+            fr=fr,
+            # fi holds the replicated global frontier-index list, but its
+            # provenance alternates between constants (seed), all_gather
+            # products (push), and carries (pull) — pin the vma to varying
+            # so every cond branch agrees (same reason as par below)
+            fi=jax.lax.pcast(
+                jnp.full(k, -1, jnp.int32).at[0].set(v.astype(jnp.int32)),
+                axis,
+                to="varying",
+            ),
+            ok=jnp.bool_(True),
+            cnt=jnp.int32(1),
+            # parents start as constants; mark them device-varying so both
+            # lax.cond branches (only one of which writes each side) agree
+            par=jax.lax.pcast(jnp.full(n_loc, -1, jnp.int32), axis, to="varying"),
+            dist=jnp.where(fr, 0, INF32).astype(jnp.int32),
+            lvl=jnp.int32(0),
+        )
 
-    fs = seed(src)
-    ft = seed(dst)
-    # parent arrays start as constants; mark them device-varying so both
-    # lax.cond branches (only one of which writes each side) agree on vma
-    par0 = jax.lax.pcast(jnp.full(n_loc, -1, jnp.int32), axis, to="varying")
-    init = dict(
-        vis_s=fs,
-        fr_s=fs,
-        par_s=par0,
-        dist_s=jnp.where(fs, 0, INF32).astype(jnp.int32),
-        vis_t=ft,
-        fr_t=ft,
-        par_t=par0,
-        dist_t=jnp.where(ft, 0, INF32).astype(jnp.int32),
-        cnt_s=jnp.int32(1),
-        cnt_t=jnp.int32(1),
-        lvl_s=jnp.int32(0),
-        lvl_t=jnp.int32(0),
+    init = {f"{key}_s": val for key, val in seed(src).items()}
+    init.update({f"{key}_t": val for key, val in seed(dst).items()})
+    init.update(
         best=jnp.where(src == dst, 0, INF32).astype(jnp.int32),
         meet=jnp.where(src == dst, src, -1).astype(jnp.int32),
         levels=jnp.int32(0),
@@ -90,44 +110,95 @@ def _bibfs_shard_body(nbr, deg, src, dst, *, axis: str, mode: str = "sync"):
             & (st["cnt_t"] > 0)
         )
 
-    def one_side(fr, vis, par, dist, lvl):
+    def pull(c):
+        fr, fi, _ok, par, dist, lvl = c
+        scanned = sum_allreduce(frontier_degree_sum(fr, deg), axis)
         # THE per-level exchange: one boolean frontier all_gather (ICI)
         f_glob = jax.lax.all_gather(fr, axis, tiled=True)
-        nf, pcand = expand_pull(f_glob, vis, nbr, deg)
+        nf, pcand = expand_pull(f_glob, dist < INF32, nbr, deg)
         par = jnp.where(nf, pcand, par)
         dist = jnp.where(nf, lvl + 1, dist)
         cnt = sum_allreduce(frontier_count(nf), axis)
-        return nf, vis | nf, par, dist, lvl + 1, cnt
+        return nf, fi, jnp.bool_(False), par, dist, lvl + 1, cnt, scanned
 
-    def s_step(st):
-        scanned = sum_allreduce(frontier_degree_sum(st["fr_s"], deg), axis)
-        nf, vis, par, dist, lvl, cnt = one_side(
-            st["fr_s"], st["vis_s"], st["par_s"], st["dist_s"], st["lvl_s"]
+    def push(c):
+        fr, fi, ok, par, dist, lvl = c
+
+        def recompact():
+            # pull -> push transition: rebuild the replicated global index
+            # list from the sharded boolean frontier (one small all_gather)
+            loc = jnp.flatnonzero(fr, size=k, fill_value=-1).astype(jnp.int32)
+            loc = jnp.where(loc >= 0, loc + offset, -1)
+            allv = jax.lax.all_gather(loc, axis).ravel()  # [ndev*k]
+            live = allv >= 0
+            pos = jnp.cumsum(live.astype(jnp.int32)) - 1
+            outpos = jnp.where(live, pos, k)
+            return jnp.full(k, -1, jnp.int32).at[outpos].set(allv, mode="drop")
+
+        fi = jax.lax.cond(ok, lambda: fi, recompact)
+        # owner-computes: expand only the fidx entries whose rows I hold
+        mine = (fi >= offset) & (fi < offset + n_loc)
+        floc = jnp.where(mine, fi - offset, 0)
+        rows = nbr[floc]  # [k, width] local row gather (global target ids)
+        vd = jnp.where(mine, deg[floc], 0)
+        valid = jnp.arange(width, dtype=jnp.int32)[None, :] < vd[:, None]
+        srcb = jnp.broadcast_to(fi[:, None], rows.shape)
+        # exchange candidate targets, NOT the frontier: [ndev*k*width] ids.
+        # The matching sources need no collective at all — fi is replicated,
+        # so every device reconstructs src_all locally by tiling.
+        tgt_all = jax.lax.all_gather(jnp.where(valid, rows, -1).ravel(), axis).ravel()
+        ndev = tgt_all.shape[0] // (k * width)
+        src_all = jnp.tile(srcb.ravel(), ndev)
+        # scatter the candidates I own into my dist/par shard
+        tloc = tgt_all - offset
+        own = (tloc >= 0) & (tloc < n_loc)
+        tclip = jnp.where(own, tloc, 0)
+        new = own & (dist[tclip] >= INF32)
+        t2 = jnp.where(new, tloc, n_loc)  # n_loc = out of bounds -> drop
+        dist = dist.at[t2].min(
+            jnp.broadcast_to((lvl + 1).astype(jnp.int32), t2.shape), mode="drop"
         )
+        par = par.at[t2].max(src_all, mode="drop")
+        # winner occurrences (disjoint across devices: each target has one
+        # owner) -> global winner flags by psum -> identical compaction on
+        # every device -> replicated next fidx
+        win_loc = new & (par[tclip] == src_all)
+        win = sum_allreduce(win_loc.astype(jnp.int32), axis) > 0
+        nf = (
+            jnp.zeros(n_loc, jnp.bool_)
+            .at[t2]
+            .max(jnp.ones(t2.shape, jnp.bool_), mode="drop")
+        )
+        pos = jnp.cumsum(win.astype(jnp.int32)) - 1
+        outpos = jnp.where(win, pos, k)
+        nfi = jnp.full(k, -1, jnp.int32).at[outpos].set(tgt_all, mode="drop")
+        cnt = jnp.sum(win.astype(jnp.int32))
+        scanned = sum_allreduce(jnp.sum(vd), axis)
+        return nf, nfi, cnt <= k, par, dist, lvl + 1, cnt, scanned
+
+    def side_step(st, side):
+        carry = (
+            st[f"fr_{side}"],
+            st[f"fi_{side}"],
+            st[f"ok_{side}"],
+            st[f"par_{side}"],
+            st[f"dist_{side}"],
+            st[f"lvl_{side}"],
+        )
+        if push_cap > 0:
+            out = jax.lax.cond(st[f"cnt_{side}"] <= push_cap, push, pull, carry)
+        else:
+            out = pull(carry)
+        nf, fi, ok, par, dist, lvl, cnt, scanned = out
         return {
             **st,
-            "fr_s": nf,
-            "vis_s": vis,
-            "par_s": par,
-            "dist_s": dist,
-            "lvl_s": lvl,
-            "cnt_s": cnt,
-            "edges": st["edges"] + scanned,
-        }
-
-    def t_step(st):
-        scanned = sum_allreduce(frontier_degree_sum(st["fr_t"], deg), axis)
-        nf, vis, par, dist, lvl, cnt = one_side(
-            st["fr_t"], st["vis_t"], st["par_t"], st["dist_t"], st["lvl_t"]
-        )
-        return {
-            **st,
-            "fr_t": nf,
-            "vis_t": vis,
-            "par_t": par,
-            "dist_t": dist,
-            "lvl_t": lvl,
-            "cnt_t": cnt,
+            f"fr_{side}": nf,
+            f"fi_{side}": fi,
+            f"ok_{side}": ok,
+            f"par_{side}": par,
+            f"dist_{side}": dist,
+            f"lvl_{side}": lvl,
+            f"cnt_{side}": cnt,
             "edges": st["edges"] + scanned,
         }
 
@@ -135,9 +206,8 @@ def _bibfs_shard_body(nbr, deg, src, dst, *, axis: str, mode: str = "sync"):
         # meet vote: local min(dist_s+dist_t) over my shard, then a global
         # pmin pair (replaces v2's word-wise AND scan + Allreduce LOR,
         # second_try.cpp:110-116, and reports the true hop count — fix Q1)
-        sums = jnp.where(
-            st["vis_s"] & st["vis_t"], st["dist_s"] + st["dist_t"], INF32
-        )
+        both = (st["dist_s"] < INF32) & (st["dist_t"] < INF32)
+        sums = jnp.where(both, st["dist_s"] + st["dist_t"], INF32)
         lmin = jnp.min(sums)
         larg = ids[jnp.argmin(sums)]
         gmin, garg = global_min_and_argmin(lmin, larg, axis)
@@ -146,15 +216,21 @@ def _bibfs_shard_body(nbr, deg, src, dst, *, axis: str, mode: str = "sync"):
         st["levels"] = st["levels"] + delta
         return st
 
-    if mode == "sync":
+    schedule = SHARDED_MODES[mode][0]
+    if schedule == "sync":
 
         def body(st):
-            return meet_vote(t_step(s_step(st)), 2)
+            return meet_vote(side_step(side_step(st, "s"), "t"), 2)
 
     else:
 
         def body(st):
-            st = jax.lax.cond(st["cnt_s"] <= st["cnt_t"], s_step, t_step, st)
+            st = jax.lax.cond(
+                st["cnt_s"] <= st["cnt_t"],
+                lambda st: side_step(st, "s"),
+                lambda st: side_step(st, "t"),
+                st,
+            )
             return meet_vote(st, 1)
 
     out = jax.lax.while_loop(cond, body, init)
@@ -169,12 +245,14 @@ def _bibfs_shard_body(nbr, deg, src, dst, *, axis: str, mode: str = "sync"):
 
 
 @lru_cache(maxsize=None)
-def _compiled_sharded(mesh, axis: str, mode: str = "sync"):
+def _compiled_sharded(mesh, axis: str, mode: str = "sync", push_cap: int = 0):
+    hybrid = SHARDED_MODES[mode][1]
+    cap = push_cap if hybrid else 0
     sh = P(axis)
     rep = P()
     fn = jax.shard_map(
         lambda nbr, deg, src, dst: _bibfs_shard_body(
-            nbr, deg, src, dst, axis=axis, mode=mode
+            nbr, deg, src, dst, axis=axis, mode=mode, push_cap=cap
         ),
         mesh=mesh,
         in_specs=(sh, sh, rep, rep),
@@ -216,7 +294,7 @@ def solve_sharded_graph(
 ) -> BFSResult:
     if not (0 <= src < g.n and 0 <= dst < g.n):
         raise ValueError(f"src/dst out of range for n={g.n}")
-    fn = _compiled_sharded(g.mesh, VERTEX_AXIS, mode)
+    fn = _compiled_sharded(g.mesh, VERTEX_AXIS, mode, _auto_push_cap(g.n_pad))
     src_a = _device_scalar(src)
     dst_a = _device_scalar(dst)
     t0 = time.perf_counter()
@@ -232,7 +310,7 @@ def time_search(
     rationale in :mod:`bibfs_tpu.solvers.timing`)."""
     from bibfs_tpu.solvers.timing import timed_repeats
 
-    fn = _compiled_sharded(g.mesh, VERTEX_AXIS, mode)
+    fn = _compiled_sharded(g.mesh, VERTEX_AXIS, mode, _auto_push_cap(g.n_pad))
     src_a = _device_scalar(src)
     dst_a = _device_scalar(dst)
     return timed_repeats(

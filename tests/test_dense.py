@@ -83,6 +83,51 @@ def test_dense_alt_counterexample_first_meet():
     assert r.found and r.hops == 3
 
 
+@pytest.mark.parametrize("mode", ["beamer", "beamer_alt"])
+@pytest.mark.parametrize("case", range(0, len(CASES), 2))
+def test_dense_beamer_matches_serial(case, mode):
+    """Beamer push/pull direction optimization must agree with the oracle
+    in both schedules. At these sizes the auto push_cap >= n, so these
+    cases exercise the pure-push path end to end."""
+    n, edges, src, dst = CASES[case]
+    ref = solve_serial(n, edges, src, dst)
+    got = solve_dense(n, edges, src, dst, mode=mode)
+    assert got.found == ref.found
+    if ref.found:
+        assert got.hops == ref.hops
+        got.validate_path(n, edges, src, dst)
+
+
+@pytest.mark.parametrize("case", range(0, len(CASES), 2))
+def test_dense_beamer_push_pull_switching(case):
+    """Force a tiny push_cap so the search crosses push->pull (and the
+    stale-fidx pull->push recompaction path) mid-search."""
+    import jax.numpy as jnp
+
+    from bibfs_tpu.graph.csr import build_ell
+    from bibfs_tpu.solvers.dense import _get_kernel, _materialize
+
+    n, edges, src, dst = CASES[case]
+    ref = solve_serial(n, edges, src, dst)
+    g = build_ell(n, edges)
+    out = _get_kernel("beamer", 2)(
+        jnp.asarray(g.nbr), jnp.asarray(g.deg), jnp.int32(src), jnp.int32(dst)
+    )
+    got = _materialize(out, 0.0)
+    assert got.found == ref.found
+    if ref.found:
+        assert got.hops == ref.hops
+        got.validate_path(n, edges, src, dst)
+
+
+def test_dense_beamer_counterexample_first_meet():
+    edges = np.array(
+        [[0, 1], [0, 2], [0, 8], [9, 3], [3, 4], [3, 6], [3, 7], [1, 4], [2, 3]]
+    )
+    r = solve_dense(10, edges, 0, 9, mode="beamer")
+    assert r.found and r.hops == 3
+
+
 def test_dense_time_search_protocol():
     """time_search: times list of the right length, result matches a plain
     solve, and time_s is the median of the returned times."""

@@ -80,6 +80,54 @@ def test_sharded_alt_mode_matches_serial(case):
         got.validate_path(n, edges, src, dst)
 
 
+@pytest.mark.parametrize("mode", ["beamer", "beamer_alt"])
+@pytest.mark.parametrize("case", range(0, len(CASES), 3))
+def test_sharded_beamer_matches_serial(case, mode):
+    """Beamer candidate-edge exchange (push) under shard_map must agree
+    with the oracle. At these sizes the auto push_cap >= n, so the push
+    path (all_gather of (tgt, src) pairs + owner scatter) runs every
+    level."""
+    n, edges, src, dst = CASES[case]
+    ref = solve_serial(n, edges, src, dst)
+    got = solve_sharded(n, edges, src, dst, num_devices=8, mode=mode)
+    assert got.found == ref.found
+    if ref.found:
+        assert got.hops == ref.hops
+        got.validate_path(n, edges, src, dst)
+
+
+@pytest.mark.parametrize("case", range(0, len(CASES), 3))
+def test_sharded_beamer_push_pull_switching(case):
+    """Force a tiny push_cap so the sharded search crosses push->pull and
+    the pull->push recompaction (all_gather flatnonzero) mid-search."""
+    import jax.numpy as jnp
+
+    from bibfs_tpu.graph.csr import build_ell
+    from bibfs_tpu.parallel.mesh import VERTEX_AXIS, make_1d_mesh
+    from bibfs_tpu.solvers.dense import _materialize
+    from bibfs_tpu.solvers.sharded import ShardedGraph, _compiled_sharded
+
+    n, edges, src, dst = CASES[case]
+    ref = solve_serial(n, edges, src, dst)
+    mesh = make_1d_mesh(8)
+    g = ShardedGraph(build_ell(n, edges, pad_multiple=64), mesh)
+    fn = _compiled_sharded(mesh, VERTEX_AXIS, "beamer", 2)
+    out = fn(g.nbr, g.deg, jnp.int32(src), jnp.int32(dst))
+    got = _materialize(out, 0.0)
+    assert got.found == ref.found
+    if ref.found:
+        assert got.hops == ref.hops
+        got.validate_path(n, edges, src, dst)
+
+
+def test_sharded_beamer_counterexample_first_meet():
+    edges = np.array(
+        [[0, 1], [0, 2], [0, 8], [9, 3], [3, 4], [3, 6], [3, 7], [1, 4], [2, 3]]
+    )
+    r = solve_sharded(10, edges, 0, 9, num_devices=8, mode="beamer")
+    assert r.found and r.hops == 3
+
+
 def test_sharded_time_search_protocol():
     from bibfs_tpu.graph.csr import build_ell
     from bibfs_tpu.parallel.mesh import make_1d_mesh
