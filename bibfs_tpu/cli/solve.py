@@ -51,6 +51,14 @@ def main(argv=None):
         "frontiers go through a scatter push path instead of the full-table "
         "pull gather)",
     )
+    ap.add_argument(
+        "--layout",
+        default="ell",
+        choices=["ell", "tiered"],
+        help="adjacency layout for the dense backend: ell = single-width "
+        "table (uniform-degree graphs), tiered = base table + geometric "
+        "hub tiers (power-law/RMAT degree distributions)",
+    )
     args = ap.parse_args(argv)
 
     from bibfs_tpu.graph.io import read_graph_bin
@@ -65,11 +73,15 @@ def main(argv=None):
         print(f"Error reading graph: {e}", file=sys.stderr)
         return 2
 
+    if args.layout == "tiered" and args.backend != "dense":
+        ap.error("--layout tiered is only supported by --backend dense")
     kwargs = {}
     if args.devices is not None:
         kwargs["num_devices"] = args.devices
     if args.backend in ("dense", "sharded"):
         kwargs["mode"] = args.mode
+    if args.backend == "dense":
+        kwargs["layout"] = args.layout
     try:
         if args.repeat > 1:
             # shared protocol: graph/JIT warm-up excluded, zero-D2H repeat

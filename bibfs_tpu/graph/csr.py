@@ -40,6 +40,14 @@ def _mirror_and_dedup(n: int, edges: np.ndarray) -> np.ndarray:
     return out
 
 
+def _rank_within_row(pairs: np.ndarray, deg: np.ndarray, n: int) -> np.ndarray:
+    """Per-directed-edge rank within its source row (pairs sorted by source,
+    which :func:`_mirror_and_dedup` guarantees)."""
+    row_ptr = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum(deg, out=row_ptr[1:])
+    return np.arange(pairs.shape[0]) - row_ptr[pairs[:, 0]]
+
+
 def build_csr(n: int, edges: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
     """Build a symmetric CSR adjacency (row_ptr[n+1], col_ind[2E]).
 
@@ -99,11 +107,7 @@ def build_ell(
     overflow = np.zeros((0, 2), dtype=np.int32)
     if width_cap is not None and width > width_cap:
         width = max(1, width_cap)
-        # rank of each directed edge within its row
-        row_ptr = np.zeros(n + 1, dtype=np.int64)
-        np.cumsum(deg, out=row_ptr[1:])
-        rank = np.arange(pairs.shape[0]) - row_ptr[pairs[:, 0]]
-        spill = rank >= width
+        spill = _rank_within_row(pairs, deg, n) >= width
         overflow = pairs[spill].astype(np.int32)
         pairs = pairs[~spill]
         deg = np.minimum(deg, width)
@@ -111,9 +115,7 @@ def build_ell(
     n_pad = -(-n // pad_multiple) * pad_multiple
     nbr = np.zeros((n_pad, width), dtype=np.int32)
     if pairs.size:
-        row_ptr = np.zeros(n + 1, dtype=np.int64)
-        np.cumsum(deg, out=row_ptr[1:])
-        rank = np.arange(pairs.shape[0]) - row_ptr[pairs[:, 0]]
+        rank = _rank_within_row(pairs, deg, n)
         nbr[pairs[:, 0], rank] = pairs[:, 1]
     deg_pad = np.zeros(n_pad, dtype=np.int32)
     deg_pad[:n] = deg
@@ -133,3 +135,154 @@ def ell_from_file(path, **kwargs) -> EllGraph:
 
     n, edges = read_graph_bin(path)
     return build_ell(n, edges, **kwargs)
+
+
+@dataclasses.dataclass
+class HubTier:
+    """One geometric slice of the high-degree tail: neighbor slots
+    ``[start, start + nbr.shape[1])`` for every vertex whose degree exceeds
+    ``start``. Hub membership is nested (tier rows are indexed by the shared
+    degree-descending ``hub_rank``), so tier t's members are exactly the
+    first ``count`` entries of the hub ordering."""
+
+    start: int  # first neighbor-slot rank this tier stores
+    count: int  # true member count (rows beyond it are padding)
+    nbr: np.ndarray  # int32 [count_pad, width]
+
+
+@dataclasses.dataclass
+class TieredEllGraph:
+    """ELL adjacency with geometric hub tiers — the power-law answer.
+
+    A single fixed-width ELL table wastes ``n_pad * max_deg`` slots on
+    skewed (RMAT/Graph500) degree distributions where ``max_deg`` can be
+    10^4 x the average. Here the base table stores every vertex's first
+    ``width`` neighbors, and each :class:`HubTier` t stores slot ranks
+    ``[start_t, start_t + width_t)`` for the ``count_t`` vertices whose
+    degree exceeds ``start_t``, with widths growing geometrically — so the
+    padded footprint stays O(directed edges * small constant) and every
+    array is static-shaped for XLA. ``deg`` holds TRUE degrees (unlike
+    ``EllGraph`` built with ``width_cap``); use sites clip per tier.
+
+    ``hub_rank[v]`` is v's position in the degree-descending hub ordering
+    (-1 for non-hubs): one map serves every tier because membership is
+    nested.
+    """
+
+    n: int
+    n_pad: int
+    width: int  # base-tier width
+    num_edges: int  # undirected unique edge count
+    max_deg: int
+    nbr: np.ndarray  # int32 [n_pad, width] first `width` neighbors
+    deg: np.ndarray  # int32 [n_pad] TRUE degree (0 for pad vertices)
+    hub_rank: np.ndarray  # int32 [n_pad], -1 for non-hub vertices
+    hub_ids: np.ndarray  # int32 [num_hubs_pad] rank -> vertex id (-1 pad)
+    tiers: tuple  # tuple[HubTier, ...]
+
+    @property
+    def num_directed_edges(self) -> int:
+        return int(self.deg.sum())
+
+    @property
+    def padded_slots(self) -> int:
+        return int(
+            self.nbr.size + sum(t.nbr.size for t in self.tiers)
+        )
+
+
+# Candidate base widths; the builder picks the one minimizing total padded
+# slots (base table + hub tiers), which is also what each pull level reads.
+_BASE_WIDTHS = (4, 8, 16, 32, 64, 128)
+_TIER_GROWTH = 8
+# Hub arrays are replicated (never mesh-sharded), so they pad to the int32
+# sublane multiple rather than the caller's pad_multiple.
+_HUB_PAD = 8
+
+
+def _pad_hub_count(count: int) -> int:
+    return -(-count // _HUB_PAD) * _HUB_PAD
+
+
+def _tier_plan(w0: int, max_deg: int):
+    """Geometric tier boundaries for a given base width: [(start, width)]."""
+    plan = []
+    start = w0
+    while start < max_deg:
+        width = min(start * (_TIER_GROWTH - 1), max_deg - start)
+        plan.append((start, width))
+        start += width
+    return plan
+
+
+def _padded_slots(w0: int, n_pad: int, deg: np.ndarray, max_deg: int) -> int:
+    total = n_pad * w0
+    for start, width in _tier_plan(w0, max_deg):
+        total += _pad_hub_count(int((deg > start).sum())) * width
+    return total
+
+
+def build_tiered(
+    n: int,
+    edges: np.ndarray,
+    *,
+    base_width: int | None = None,
+    pad_multiple: int = 8,
+) -> TieredEllGraph:
+    """Regularize an undirected edge list into tiered ELL form.
+
+    For low-skew graphs (max degree <= the smallest viable base width) this
+    degenerates to a plain single-table ELL with no tiers — identical
+    layout and cost to :func:`build_ell`.
+    """
+    pairs = _mirror_and_dedup(n, edges)
+    num_edges = pairs.shape[0] // 2
+    deg = np.bincount(pairs[:, 0], minlength=n).astype(np.int64)
+    max_deg = int(deg.max()) if deg.size and pairs.size else 0
+
+    n_pad = -(-n // pad_multiple) * pad_multiple
+    if base_width is None:
+        cands = [w for w in _BASE_WIDTHS if w < max_deg] + [max_deg]
+        base_width = min(
+            cands, key=lambda w: _padded_slots(w, n_pad, deg, max_deg)
+        )
+    w0 = max(1, min(base_width, max_deg) if max_deg else base_width)
+    rank = _rank_within_row(pairs, deg, n)
+
+    nbr = np.zeros((n_pad, w0), dtype=np.int32)
+    base_sel = rank < w0
+    nbr[pairs[base_sel, 0], rank[base_sel]] = pairs[base_sel, 1]
+
+    hub_rank = np.full(n_pad, -1, dtype=np.int32)
+    hub_ids = np.zeros(0, dtype=np.int32)
+    tiers = []
+    if max_deg > w0:
+        # degree-descending hub ordering shared by all tiers
+        hub_order = np.argsort(-deg, kind="stable")
+        num_hubs = int((deg > w0).sum())
+        hub_order = hub_order[:num_hubs]
+        hub_rank[hub_order] = np.arange(num_hubs, dtype=np.int32)
+        hub_ids = np.full(_pad_hub_count(num_hubs), -1, dtype=np.int32)
+        hub_ids[:num_hubs] = hub_order
+        for start, width in _tier_plan(w0, max_deg):
+            count = int((deg > start).sum())
+            count_pad = _pad_hub_count(count)
+            arr = np.zeros((count_pad, width), dtype=np.int32)
+            sel = (rank >= start) & (rank < start + width)
+            arr[hub_rank[pairs[sel, 0]], rank[sel] - start] = pairs[sel, 1]
+            tiers.append(HubTier(start=start, count=count, nbr=arr))
+
+    deg_pad = np.zeros(n_pad, dtype=np.int32)
+    deg_pad[:n] = deg
+    return TieredEllGraph(
+        n=n,
+        n_pad=n_pad,
+        width=w0,
+        num_edges=num_edges,
+        max_deg=max_deg,
+        nbr=nbr,
+        deg=deg_pad,
+        hub_rank=hub_rank,
+        hub_ids=hub_ids,
+        tiers=tuple(tiers),
+    )

@@ -184,19 +184,47 @@ def rmat_with_ground_truth(
 
 def main(argv=None):
     ap = argparse.ArgumentParser(description="Generate a random graph + ground truth")
-    ap.add_argument("--n", type=int, required=True)
+    ap.add_argument("--n", type=int, default=None, help="vertex count (gnp)")
     ap.add_argument("--p", type=float, default=None, help="edge probability (gnp)")
+    ap.add_argument(
+        "--rmat-scale",
+        type=int,
+        default=None,
+        help="generate a Graph500-style RMAT graph with 2**scale vertices "
+        "instead of G(n, p)",
+    )
+    ap.add_argument(
+        "--edge-factor", type=int, default=16, help="RMAT edges per vertex"
+    )
     ap.add_argument("--src", type=int, default=0)
     ap.add_argument("--dst", type=int, default=None, help="default n-1")
     ap.add_argument("--out", type=str, required=True)
     ap.add_argument("--seed", type=int, default=None)
     ap.add_argument("--avg-deg", type=float, default=None, help="sets p = avg_deg / n")
     args = ap.parse_args(argv)
-    avg = args.avg_deg if args.avg_deg is not None else DEFAULT_AVG_DEG
-    p = args.p if args.p is not None else avg / args.n
-    info = generate_with_ground_truth(
-        args.out, args.n, p, args.src, args.dst, seed=args.seed
-    )
+    if (args.rmat_scale is None) == (args.n is None):
+        ap.error("exactly one of --n (gnp) or --rmat-scale (RMAT) is required")
+    if args.rmat_scale is not None and (
+        args.p is not None or args.avg_deg is not None
+    ):
+        ap.error("--p/--avg-deg apply to gnp only; use --edge-factor with RMAT")
+    if args.n is not None and args.edge_factor != 16:
+        ap.error("--edge-factor applies to RMAT only; use --p/--avg-deg with gnp")
+    if args.rmat_scale is not None:
+        info = rmat_with_ground_truth(
+            args.out,
+            args.rmat_scale,
+            args.edge_factor,
+            args.src,
+            args.dst,
+            seed=args.seed,
+        )
+    else:
+        avg = args.avg_deg if args.avg_deg is not None else DEFAULT_AVG_DEG
+        p = args.p if args.p is not None else avg / args.n
+        info = generate_with_ground_truth(
+            args.out, args.n, p, args.src, args.dst, seed=args.seed
+        )
     print(
         f"wrote {args.out}: n={info['n']} m={info['m']} hop_count={info['hop_count']}"
     )

@@ -76,18 +76,31 @@ def expand_push(
     is identified by a read-back compare (no atomics, no nondeterminism).
 
     Returns ``(next_frontier bool[n_pad], next_fidx int32[K], cnt int32,
-    par int32[n_pad], dist int32[n_pad], scanned int32)``. ``next_fidx`` is
-    complete only when ``cnt <= K`` — callers must route the next level to
-    the pull path otherwise.
+    par int32[n_pad], dist int32[n_pad], scanned int32, max_deg int32)``
+    where ``max_deg`` is the maximum degree in the new frontier (Beamer
+    span routing). ``next_fidx`` is complete only when ``cnt <= K`` —
+    callers must route the next level to the pull path otherwise.
     """
-    k = fidx.shape[0]
-    width = nbr.shape[1]
-    n_pad = nbr.shape[0]
     live = fidx >= 0
     fc = jnp.where(live, fidx, 0)
     rows = nbr[fc]  # [K, width] row gather
     vd = jnp.where(live, deg[fc], 0)
+    width = nbr.shape[1]
     valid = jnp.arange(width, dtype=jnp.int32)[None, :] < vd[:, None]
+    return _push_claim(fc, rows, valid, jnp.sum(vd), par, dist, deg, lvl_next, inf=inf)
+
+
+def _push_claim(fc, rows, valid, scanned, par, dist, deg, lvl_next, *, inf):
+    """Shared push claim/dedup/compact phase over candidate edges.
+
+    ``fc``: int32[K] source vertex per row (dead slots arbitrary as long as
+    ``valid`` is False there); ``rows``: int32[K, W] candidate target ids;
+    ``valid``: bool[K, W] true where the slot is a real edge. Returns the
+    same tuple as :func:`expand_push` plus a trailing ``max_deg`` of the
+    newly discovered frontier (used by tiered Beamer routing).
+    """
+    k = fc.shape[0]
+    n_pad = par.shape[0]
     cand_new = valid & (dist[rows] >= inf)  # unvisited targets only
     tgt = jnp.where(cand_new, rows, n_pad)  # n_pad = out of bounds -> drop
     dist = dist.at[tgt].min(
@@ -111,8 +124,76 @@ def expand_push(
         jnp.full(k, -1, jnp.int32).at[outpos].set(rows.ravel(), mode="drop")
     )
     cnt = jnp.sum(wflat.astype(jnp.int32))
-    scanned = jnp.sum(vd)
-    return next_f, next_fidx, cnt, par, dist, scanned
+    max_deg = jnp.max(jnp.where(win, deg[rows], 0))
+    return next_f, next_fidx, cnt, par, dist, scanned, max_deg
+
+
+def _tier_valid(slot_count, width, rank, tier_count):
+    """Valid-slot mask for one hub tier: bool[K_or_H, width]."""
+    member = (rank >= 0) & (rank < tier_count)
+    cols = jnp.arange(width, dtype=jnp.int32)[None, :]
+    return member[:, None] & (cols < slot_count[:, None])
+
+
+def expand_pull_tiered(frontier, par, dist, nbr, deg, tiers, lvl_next, *, inf: int):
+    """Pull expansion over a tiered ELL (power-law graphs): the base-table
+    pull plus, per hub tier, a [count_pad, width] gather and a sparse
+    scatter of the hub hits back into the dense per-vertex state.
+
+    ``tiers`` is a tuple of ``(start, count, tier_nbr, hub_ids)`` with
+    static start/count; ``hub_ids[r]`` = vertex id at hub rank r. Returns
+    ``(next_frontier, par, dist, max_deg_of_new_frontier)``.
+    """
+    n_pad = nbr.shape[0]
+    visited = dist < inf
+    nf, pcand = expand_pull(frontier, visited, nbr, deg)
+    par = jnp.where(nf, pcand, par)
+    for start, count, tier_nbr, hub_ids in tiers:
+        width = tier_nbr.shape[1]
+        rank = jnp.arange(tier_nbr.shape[0], dtype=jnp.int32)
+        ids_c = jnp.clip(hub_ids, 0, n_pad - 1)
+        slot_count = jnp.clip(deg[ids_c] - start, 0, width)
+        valid = _tier_valid(slot_count, width, rank, count) & (hub_ids >= 0)[:, None]
+        hits = frontier[tier_nbr] & valid
+        hub_any = jnp.any(hits, axis=1)
+        hub_new = hub_any & ~visited[ids_c]
+        j_star = jnp.argmax(hits, axis=1)
+        hub_par = jnp.take_along_axis(tier_nbr, j_star[:, None], axis=1)[:, 0]
+        tgt = jnp.where(hub_new, hub_ids, n_pad)
+        nf = nf.at[tgt].max(jnp.ones(tgt.shape, jnp.bool_), mode="drop")
+        par = par.at[tgt].max(hub_par, mode="drop")
+    dist = jnp.where(nf & (dist >= inf), lvl_next, dist)
+    max_deg = jnp.max(jnp.where(nf, deg, 0))
+    return nf, par, dist, max_deg
+
+
+def expand_push_tiered(
+    fidx, par, dist, nbr, deg, hub_rank, push_tiers, lvl_next, *, inf: int
+):
+    """Push expansion over a tiered ELL. Only callable when every frontier
+    vertex's degree fits inside the base width plus the supplied
+    ``push_tiers`` (the Beamer router guarantees this via the carried
+    max-degree); candidate width is static: base + allowed tier widths.
+    """
+    live = fidx >= 0
+    fc = jnp.where(live, fidx, 0)
+    vd = jnp.where(live, deg[fc], 0)
+    base_w = nbr.shape[1]
+    parts_rows = [nbr[fc]]
+    parts_valid = [
+        jnp.arange(base_w, dtype=jnp.int32)[None, :] < jnp.minimum(vd, base_w)[:, None]
+    ]
+    if push_tiers:
+        frank = hub_rank[fc]
+        for start, count, tier_nbr, _hub_ids in push_tiers:
+            width = tier_nbr.shape[1]
+            rk = jnp.where((frank >= 0) & (frank < count), frank, 0)
+            slot_count = jnp.clip(vd - start, 0, width)
+            parts_rows.append(tier_nbr[rk])
+            parts_valid.append(_tier_valid(slot_count, width, frank, count))
+    rows = jnp.concatenate(parts_rows, axis=1)
+    valid = jnp.concatenate(parts_valid, axis=1)
+    return _push_claim(fc, rows, valid, jnp.sum(vd), par, dist, deg, lvl_next, inf=inf)
 
 
 def frontier_count(frontier: jnp.ndarray) -> jnp.ndarray:

@@ -29,10 +29,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from bibfs_tpu.graph.csr import EllGraph, build_ell
+from bibfs_tpu.graph.csr import EllGraph, build_ell, build_tiered
 from bibfs_tpu.ops.expand import (
-    expand_pull,
-    expand_push,
+    expand_pull_tiered,
+    expand_push_tiered,
     frontier_count,
     frontier_degree_sum,
 )
@@ -59,23 +59,29 @@ def _device_scalar(v: int) -> jax.Array:
 
 @dataclasses.dataclass
 class DeviceGraph:
-    """ELL adjacency resident in device HBM — the analog of v4's
-    ``cudaInitGraph`` upload (v4/comp.cu:49-73), done once per graph."""
+    """ELL (optionally tiered) adjacency resident in device HBM — the
+    analog of v4's ``cudaInitGraph`` upload (v4/comp.cu:49-73), done once
+    per graph. ``tiers`` (power-law graphs) holds one
+    ``(nbr [count_pad, width], hub_ids [count_pad])`` array pair per hub
+    tier; ``tier_meta`` carries the matching static ``(start, count,
+    width)`` triples used as a jit-cache key."""
 
     n: int
     n_pad: int
     width: int
     num_edges: int
     nbr: jax.Array  # int32[n_pad, width]
-    deg: jax.Array  # int32[n_pad]
+    deg: jax.Array  # int32[n_pad] (TRUE degree when tiered)
+    hub_rank: jax.Array | None = None  # int32[n_pad] when tiered
+    tiers: tuple = ()  # ((nbr, hub_ids), ...)
+    tier_meta: tuple = ()  # ((start, count, width), ...)
 
     @classmethod
     def from_ell(cls, g: EllGraph, device=None) -> "DeviceGraph":
         if g.overflow.shape[0]:
             raise NotImplementedError(
-                "EllGraph has width_cap overflow edges; the device solvers "
-                "do not handle the hybrid ELL+COO layout yet — build the "
-                "ELL without width_cap"
+                "EllGraph has width_cap overflow edges; use build_tiered "
+                "(tiered ELL) for skewed-degree graphs instead of width_cap"
             )
         put = partial(jax.device_put, device=device) if device else jax.device_put
         return cls(
@@ -87,6 +93,33 @@ class DeviceGraph:
             deg=put(g.deg),
         )
 
+    @classmethod
+    def from_tiered(cls, g, device=None) -> "DeviceGraph":
+        """Upload a :class:`bibfs_tpu.graph.csr.TieredEllGraph`."""
+        put = partial(jax.device_put, device=device) if device else jax.device_put
+        tiers = []
+        meta = []
+        for t in g.tiers:
+            count_pad = t.nbr.shape[0]
+            tiers.append((put(t.nbr), put(g.hub_ids[:count_pad])))
+            meta.append((t.start, t.count, t.nbr.shape[1]))
+        return cls(
+            n=g.n,
+            n_pad=g.n_pad,
+            width=g.width,
+            num_edges=g.num_edges,
+            nbr=put(g.nbr),
+            deg=put(g.deg),
+            hub_rank=put(g.hub_rank) if g.tiers else None,
+            tiers=tuple(tiers),
+            tier_meta=tuple(meta),
+        )
+
+    @property
+    def aux(self):
+        """The tier pytree passed through jit: () for plain ELL."""
+        return (self.hub_rank, self.tiers) if self.tiers else ()
+
 
 def _auto_push_cap(n_pad: int) -> int:
     """Frontier size below which push beats pull. Push costs ~K*width
@@ -97,7 +130,7 @@ def _auto_push_cap(n_pad: int) -> int:
     return int(min(2048, cap, max(128, n_pad)))
 
 
-def _init_state(n_pad, k, src, dst):
+def _init_state(n_pad, k, src, dst, deg):
     zeros_b = jnp.zeros(n_pad, dtype=jnp.bool_)
 
     def side(v):
@@ -107,6 +140,7 @@ def _init_state(n_pad, k, src, dst):
             fi=jnp.full(k, -1, jnp.int32).at[0].set(v.astype(jnp.int32)),
             ok=jnp.bool_(True),
             cnt=jnp.int32(1),
+            md=deg[v],  # max degree in the frontier (Beamer span routing)
             par=jnp.full(n_pad, -1, jnp.int32),
             dist=jnp.where(fr, 0, INF32).astype(jnp.int32),
             lvl=jnp.int32(0),
@@ -162,12 +196,39 @@ def _cond(st):
     )
 
 
-def _side_step(st, side: str, nbr, deg, *, push_cap: int):
+# a frontier whose max degree exceeds this stays on the pull path even
+# when small: the push candidate width is static (base + allowed tiers),
+# so hub tiers past this span never enter the push gather
+PUSH_SPAN_TARGET = 256
+
+
+def _push_tiers(width: int, tier_meta, tiers):
+    """Static split of hub tiers into push-covered and pull-only; returns
+    ``(span, push_tiers)`` with push_tiers in the ops format ``(start,
+    count, nbr, hub_ids)``."""
+    span = width
+    covered = []
+    for (start, count, twidth), (tnbr, tids) in zip(tier_meta, tiers):
+        if start >= PUSH_SPAN_TARGET:
+            break
+        covered.append((start, count, tnbr, tids))
+        span = start + twidth
+    return span, covered
+
+
+def _side_step(st, side: str, nbr, deg, aux, tier_meta, *, push_cap: int):
     """Advance one side one level. ``push_cap > 0`` enables Beamer direction
-    optimization: frontiers at most ``push_cap`` wide go through the sparse
-    push path, larger ones through the dense pull path. ``push_cap == 0``
-    is pull-only (the v3-style dense schedule)."""
+    optimization: frontiers at most ``push_cap`` wide (and whose max degree
+    fits the static push span) go through the sparse push path, larger ones
+    through the dense pull path. ``push_cap == 0`` is pull-only (the
+    v3-style dense schedule)."""
     k = st[f"fi_{side}"].shape[0]
+    hub_rank, tiers = aux if aux else (None, ())
+    full_tiers = tuple(
+        (start, count, tnbr, tids)
+        for (start, count, _w), (tnbr, tids) in zip(tier_meta, tiers)
+    )
+    span, push_tiers = _push_tiers(nbr.shape[1], tier_meta, tiers)
     carry = (
         st[f"fr_{side}"],
         st[f"fi_{side}"],
@@ -180,11 +241,14 @@ def _side_step(st, side: str, nbr, deg, *, push_cap: int):
     def pull(c):
         fr, fi, _ok, par, dist, lvl = c
         scanned = frontier_degree_sum(fr, deg)
-        nf, pcand = expand_pull(fr, dist < INF32, nbr, deg)
-        par = jnp.where(nf, pcand, par)
-        dist = jnp.where(nf, lvl + 1, dist)
+        nf, par, dist, md = expand_pull_tiered(
+            fr, par, dist, nbr, deg, full_tiers, lvl + 1, inf=INF32
+        )
         # the compact index list is now stale; push recomputes it on entry
-        return nf, fi, jnp.bool_(False), par, dist, lvl + 1, frontier_count(nf), scanned
+        return (
+            nf, fi, jnp.bool_(False), par, dist, lvl + 1,
+            frontier_count(nf), md, scanned,
+        )
 
     def push(c):
         fr, fi, ok, par, dist, lvl = c
@@ -193,16 +257,17 @@ def _side_step(st, side: str, nbr, deg, *, push_cap: int):
             lambda: fi,
             lambda: jnp.flatnonzero(fr, size=k, fill_value=-1).astype(jnp.int32),
         )
-        nf, nfi, cnt, par, dist, scanned = expand_push(
-            fi, par, dist, nbr, deg, lvl + 1, inf=INF32
+        nf, nfi, cnt, par, dist, scanned, md = expand_push_tiered(
+            fi, par, dist, nbr, deg, hub_rank, push_tiers, lvl + 1, inf=INF32
         )
-        return nf, nfi, cnt <= k, par, dist, lvl + 1, cnt, scanned
+        return nf, nfi, cnt <= k, par, dist, lvl + 1, cnt, md, scanned
 
     if push_cap > 0:
-        out = jax.lax.cond(st[f"cnt_{side}"] <= push_cap, push, pull, carry)
+        use_push = (st[f"cnt_{side}"] <= push_cap) & (st[f"md_{side}"] <= span)
+        out = jax.lax.cond(use_push, push, pull, carry)
     else:
         out = pull(carry)
-    nf, fi, ok, par, dist, lvl, cnt, scanned = out
+    nf, fi, ok, par, dist, lvl, cnt, md, scanned = out
     return {
         **st,
         f"fr_{side}": nf,
@@ -212,6 +277,7 @@ def _side_step(st, side: str, nbr, deg, *, push_cap: int):
         f"dist_{side}": dist,
         f"lvl_{side}": lvl,
         f"cnt_{side}": cnt,
+        f"md_{side}": md,
         "edges": st["edges"] + scanned,
     }
 
@@ -233,35 +299,37 @@ DENSE_MODES = {
 
 
 @lru_cache(maxsize=None)
-def _get_kernel(mode: str, push_cap: int):
-    """Build + jit the search kernel for (mode, push_cap). Returns
-    ``fn(nbr, deg, src, dst) -> (best, meet, parent_s, parent_t, levels,
-    edges_scanned)``; ``best >= INF32`` means no path. The whole search is
-    one ``lax.while_loop`` in one XLA program — state never leaves HBM and
-    the host syncs exactly once at the end (versus per-level host
-    round-trips, quirk Q5)."""
+def _get_kernel(mode: str, push_cap: int, tier_meta: tuple = ()):
+    """Build + jit the search kernel for (mode, push_cap, tier layout).
+    Returns ``fn(nbr, deg, aux, src, dst) -> (best, meet, parent_s,
+    parent_t, levels, edges_scanned)``; ``best >= INF32`` means no path.
+    ``aux`` is ``(hub_rank, tiers)`` for tiered graphs, ``()`` otherwise.
+    The whole search is one ``lax.while_loop`` in one XLA program — state
+    never leaves HBM and the host syncs exactly once at the end (versus
+    per-level host round-trips, quirk Q5)."""
     schedule, hybrid = DENSE_MODES[mode]
     cap = push_cap if hybrid else 0
     k = max(cap, 1)
 
-    def kernel(nbr, deg, src, dst):
+    def kernel(nbr, deg, aux, src, dst):
         n_pad = nbr.shape[0]
-        init = _init_state(n_pad, k, src, dst)
+        init = _init_state(n_pad, k, src, dst, deg)
+
+        def step(st, side):
+            return _side_step(st, side, nbr, deg, aux, tier_meta, push_cap=cap)
 
         if schedule == "sync":
 
             def body(st):
-                st = _side_step(st, "s", nbr, deg, push_cap=cap)
-                st = _side_step(st, "t", nbr, deg, push_cap=cap)
-                return _meet_vote(st, 2)
+                return _meet_vote(step(step(st, "s"), "t"), 2)
 
         else:
 
             def body(st):
                 st = jax.lax.cond(
                     st["cnt_s"] <= st["cnt_t"],
-                    lambda st: _side_step(st, "s", nbr, deg, push_cap=cap),
-                    lambda st: _side_step(st, "t", nbr, deg, push_cap=cap),
+                    lambda st: step(st, "s"),
+                    lambda st: step(st, "t"),
                     st,
                 )
                 return _meet_vote(st, 1)
@@ -275,12 +343,12 @@ def bibfs_dense(nbr, deg, src, dst):
     """Pull-only lock-step search (both sides per round). Kept as the plain
     jittable entry (`__graft_entry__.entry`); see :data:`DENSE_MODES` for
     the full schedule × expansion matrix."""
-    return _get_kernel("sync", 0)(nbr, deg, src, dst)
+    return _get_kernel("sync", 0)(nbr, deg, (), src, dst)
 
 
 def bibfs_dense_alt(nbr, deg, src, dst):
     """Pull-only alternating smaller-frontier-first search."""
-    return _get_kernel("alt", 0)(nbr, deg, src, dst)
+    return _get_kernel("alt", 0)(nbr, deg, (), src, dst)
 
 
 def solve_dense_graph(
@@ -291,11 +359,11 @@ def solve_dense_graph(
     hot loop, SURVEY.md §5 tracing)."""
     if not (0 <= src < g.n and 0 <= dst < g.n):
         raise ValueError(f"src/dst out of range for n={g.n}")
-    kern = _get_kernel(mode, _auto_push_cap(g.n_pad))
+    kern = _get_kernel(mode, _auto_push_cap(g.n_pad), g.tier_meta)
     src_a = _device_scalar(src)
     dst_a = _device_scalar(dst)
     t0 = time.perf_counter()
-    out = jax.block_until_ready(kern(g.nbr, g.deg, src_a, dst_a))
+    out = jax.block_until_ready(kern(g.nbr, g.deg, g.aux, src_a, dst_a))
     elapsed = time.perf_counter() - t0
     return _materialize(out, elapsed)
 
@@ -319,23 +387,35 @@ def time_search(
     result)`` with ``result.time_s`` = median."""
     from bibfs_tpu.solvers.timing import timed_repeats
 
-    kern = _get_kernel(mode, _auto_push_cap(g.n_pad))
+    kern = _get_kernel(mode, _auto_push_cap(g.n_pad), g.tier_meta)
     src_a = _device_scalar(src)
     dst_a = _device_scalar(dst)
     return timed_repeats(
-        lambda: jax.block_until_ready(kern(g.nbr, g.deg, src_a, dst_a)),
+        lambda: jax.block_until_ready(kern(g.nbr, g.deg, g.aux, src_a, dst_a)),
         lambda: solve_dense_graph(g, src, dst, mode=mode),
         repeats,
     )
 
 
 def solve_dense(
-    n: int, edges: np.ndarray, src: int, dst: int, *, mode: str = "sync"
+    n: int,
+    edges: np.ndarray,
+    src: int,
+    dst: int,
+    *,
+    mode: str = "sync",
+    layout: str = "ell",
 ) -> BFSResult:
-    g = DeviceGraph.from_ell(build_ell(n, edges))
+    """``layout="ell"`` builds the single-table ELL (uniform-degree graphs);
+    ``layout="tiered"`` builds the tiered ELL for skewed/power-law degree
+    distributions (RMAT/Graph500)."""
+    if layout == "tiered":
+        g = DeviceGraph.from_tiered(build_tiered(n, edges))
+    else:
+        g = DeviceGraph.from_ell(build_ell(n, edges))
     return solve_dense_graph(g, src, dst, mode=mode)
 
 
 @register("dense")
-def _dense_backend(n, edges, src, dst, mode="sync", **_):
-    return solve_dense(n, edges, src, dst, mode=mode)
+def _dense_backend(n, edges, src, dst, mode="sync", layout="ell", **_):
+    return solve_dense(n, edges, src, dst, mode=mode, layout=layout)

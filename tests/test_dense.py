@@ -111,7 +111,7 @@ def test_dense_beamer_push_pull_switching(case):
     ref = solve_serial(n, edges, src, dst)
     g = build_ell(n, edges)
     out = _get_kernel("beamer", 2)(
-        jnp.asarray(g.nbr), jnp.asarray(g.deg), jnp.int32(src), jnp.int32(dst)
+        jnp.asarray(g.nbr), jnp.asarray(g.deg), (), jnp.int32(src), jnp.int32(dst)
     )
     got = _materialize(out, 0.0)
     assert got.found == ref.found
@@ -126,6 +126,48 @@ def test_dense_beamer_counterexample_first_meet():
     )
     r = solve_dense(10, edges, 0, 9, mode="beamer")
     assert r.found and r.hops == 3
+
+
+@pytest.mark.parametrize("mode", ["sync", "beamer", "beamer_alt"])
+@pytest.mark.parametrize("case", range(0, len(CASES), 4))
+def test_dense_tiered_matches_serial(case, mode):
+    """The tiered-ELL layout (power-law path) must agree with the oracle in
+    every mode; at these sizes base_width=8 usually yields real hub tiers."""
+    n, edges, src, dst = CASES[case]
+    ref = solve_serial(n, edges, src, dst)
+    got = solve_dense(n, edges, src, dst, mode=mode, layout="tiered")
+    assert got.found == ref.found
+    if ref.found:
+        assert got.hops == ref.hops
+        got.validate_path(n, edges, src, dst)
+
+
+@pytest.mark.parametrize("mode", ["sync", "beamer"])
+def test_dense_tiered_star_hub(mode):
+    """A star hub (degree n-1) forces multiple hub tiers and, under beamer,
+    the max-degree span routing (the hub level must take the pull path)."""
+    n = 600
+    hub_edges = [[0, i] for i in range(1, n)]
+    chain = [[n - 1, n - 2]]  # give dst a second neighbor
+    edges = np.array(hub_edges + chain)
+    ref = solve_serial(n, edges, 1, n - 2)
+    got = solve_dense(n, edges, 1, n - 2, mode=mode, layout="tiered")
+    assert got.found and got.hops == ref.hops == 2
+    got.validate_path(n, edges, 1, n - 2)
+
+
+@pytest.mark.parametrize("mode", ["sync", "beamer"])
+def test_dense_tiered_rmat(mode):
+    """Small RMAT graph (skewed degrees): tiered layout vs oracle."""
+    from bibfs_tpu.graph.generate import rmat_graph
+
+    n, edges = rmat_graph(9, edge_factor=8, seed=5)
+    ref = solve_serial(n, edges, 0, n - 1)
+    got = solve_dense(n, edges, 0, n - 1, mode=mode, layout="tiered")
+    assert got.found == ref.found
+    if ref.found:
+        assert got.hops == ref.hops
+        got.validate_path(n, edges, 0, n - 1)
 
 
 def test_dense_time_search_protocol():

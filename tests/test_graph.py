@@ -109,3 +109,72 @@ def test_generate_with_ground_truth(tmp_path):
     if gt["hop_count"] is not None:
         assert len(gt["nodes"]) == gt["hop_count"] + 1
         assert info["hop_count"] == gt["hop_count"]
+
+
+def _tiered_edge_list(g):
+    """Reassemble the directed edges stored across base + hub tiers, with
+    multiplicity (a duplicate across tiers would show up as a repeat)."""
+    pairs = []
+    for v in range(g.n):
+        d = int(g.deg[v])
+        for j in range(min(d, g.width)):
+            pairs.append((v, int(g.nbr[v, j])))
+    for t in g.tiers:
+        for r in range(t.count):
+            v = int(g.hub_ids[r])
+            cnt = min(int(g.deg[v]) - t.start, t.nbr.shape[1])
+            for j in range(cnt):
+                pairs.append((v, int(t.nbr[r, j])))
+    return pairs
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_tiered_ell_stores_every_edge(seed):
+    """Tiered ELL must hold exactly the mirrored+deduped directed edge set,
+    split across base and hub tiers without loss or duplication."""
+    from bibfs_tpu.graph.csr import _mirror_and_dedup, build_tiered
+
+    n, edges = rmat_graph(7, edge_factor=6, seed=seed)
+    g = build_tiered(n, edges)
+    want = {(int(u), int(v)) for u, v in _mirror_and_dedup(n, edges)}
+    got = _tiered_edge_list(g)
+    assert len(got) == len(want)  # no edge stored twice across tiers
+    assert set(got) == want
+    assert g.num_directed_edges == len(want)
+
+
+def test_tiered_degenerates_to_plain_ell():
+    """Uniform-degree graphs (max_deg <= smallest base width) get no tiers
+    and the same layout as build_ell."""
+    from bibfs_tpu.graph.csr import build_tiered
+
+    edges = np.array([[i, i + 1] for i in range(50)])
+    g = build_tiered(51, edges)
+    assert g.tiers == ()
+    ell = build_ell(51, edges)
+    np.testing.assert_array_equal(g.deg, ell.deg)
+    assert g.width == ell.width
+    np.testing.assert_array_equal(g.nbr, ell.nbr)
+
+
+def test_tiered_memory_stays_bounded():
+    """The point of tiering: padded slots stay O(edges), not n * max_deg."""
+    from bibfs_tpu.graph.csr import build_tiered
+
+    n, edges = rmat_graph(10, edge_factor=8, seed=3)
+    g = build_tiered(n, edges)
+    dense_slots = g.n_pad * g.max_deg
+    assert g.padded_slots < dense_slots / 4
+    assert g.padded_slots < 6 * g.num_directed_edges + 8 * g.width * len(g.tiers)
+
+
+def test_tiered_hub_rank_is_degree_descending_prefix():
+    from bibfs_tpu.graph.csr import build_tiered
+
+    n, edges = rmat_graph(8, edge_factor=8, seed=2)
+    g = build_tiered(n, edges)
+    for t in g.tiers:
+        members = g.hub_ids[: t.count]
+        assert (g.deg[members] > t.start).all()
+        # nested membership: ranks below t.count are exactly the members
+        assert (g.hub_rank[members] == np.arange(t.count)).all()
