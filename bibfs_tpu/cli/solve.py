@@ -20,8 +20,22 @@ def main(argv=None):
         description="Bidirectional BFS (TPU-native framework)"
     )
     ap.add_argument("graph", help="binary graph file (uint32 N,M + edge pairs)")
-    ap.add_argument("src", type=int)
-    ap.add_argument("dst", type=int)
+    ap.add_argument("src", type=int, nargs="?", default=None)
+    ap.add_argument("dst", type=int, nargs="?", default=None)
+    ap.add_argument(
+        "--pairs",
+        default=None,
+        metavar="FILE",
+        help='batch mode (dense backend): file of "src dst" lines solved as '
+        "ONE vmapped device program; replaces the positional src/dst",
+    )
+    ap.add_argument(
+        "--profile",
+        default=None,
+        metavar="DIR",
+        help="write a jax.profiler trace of the solve to DIR (inspect with "
+        "TensorBoard / xprof)",
+    )
     ap.add_argument(
         "--backend",
         default="serial",
@@ -75,6 +89,13 @@ def main(argv=None):
 
     if args.layout == "tiered" and args.backend != "dense":
         ap.error("--layout tiered is only supported by --backend dense")
+    if args.pairs is not None:
+        if args.backend != "dense":
+            ap.error("--pairs batch mode is only supported by --backend dense")
+        if args.src is not None or args.dst is not None:
+            ap.error("--pairs replaces the positional src/dst arguments")
+    elif args.src is None or args.dst is None:
+        ap.error("src and dst are required (or use --pairs FILE)")
     kwargs = {}
     if args.devices is not None:
         kwargs["num_devices"] = args.devices
@@ -82,20 +103,33 @@ def main(argv=None):
         kwargs["mode"] = args.mode
     if args.backend == "dense":
         kwargs["layout"] = args.layout
-    try:
-        if args.repeat > 1:
-            # shared protocol: graph/JIT warm-up excluded, zero-D2H repeat
-            # loop, median reported (bibfs_tpu.solvers.timing)
-            from bibfs_tpu.solvers.timing import time_backend
+    import contextlib
 
-            _times, res = time_backend(
-                args.backend, n, edges, args.src, args.dst,
-                repeats=args.repeat,
-                num_devices=args.devices,
-                mode=args.mode,
-            )
-        else:
-            res = solve(args.backend, n, edges, args.src, args.dst, **kwargs)
+    def tracer():
+        if not args.profile:
+            return contextlib.nullcontext()
+        import jax
+
+        return jax.profiler.trace(args.profile)
+
+    try:
+        if args.pairs is not None:
+            return _batch_main(args, n, edges, tracer)
+        with tracer():
+            if args.repeat > 1:
+                # shared protocol: graph/JIT warm-up excluded, zero-D2H
+                # repeat loop, median reported (bibfs_tpu.solvers.timing)
+                from bibfs_tpu.solvers.timing import time_backend
+
+                _times, res = time_backend(
+                    args.backend, n, edges, args.src, args.dst,
+                    repeats=args.repeat,
+                    num_devices=args.devices,
+                    mode=args.mode,
+                    layout=args.layout,
+                )
+            else:
+                res = solve(args.backend, n, edges, args.src, args.dst, **kwargs)
     except KeyError as e:
         print(f"Error: {e.args[0]}", file=sys.stderr)
         return 2
@@ -115,6 +149,43 @@ def main(argv=None):
     # scrapeable time line (same shape as v1/main-v1.cpp:101)
     print(f"[Time] {args.backend} bidirectional BFS took {res.time_s:.9f} seconds")
     print(f"[TEPS] {res.teps:.3e} traversed edges/second ({res.edges_scanned} edges)")
+    return 0
+
+
+def _batch_main(args, n, edges, tracer):
+    import numpy as np
+
+    from bibfs_tpu.solvers.dense import (
+        DeviceGraph,
+        solve_batch_graph,
+        time_batch_graph,
+    )
+
+    pairs = np.loadtxt(args.pairs, dtype=np.int64, ndmin=2)
+    if pairs.shape[1] != 2:
+        print(f"Error: {args.pairs} must have two columns (src dst)", file=sys.stderr)
+        return 2
+    g = DeviceGraph.build(n, edges, layout=args.layout)
+    with tracer():
+        if args.repeat > 1:
+            _times, results = time_batch_graph(
+                g, pairs, repeats=args.repeat, mode=args.mode
+            )
+        else:
+            results = solve_batch_graph(g, pairs, mode=args.mode)
+    for (src, dst), res in zip(pairs, results):
+        if res.found:
+            line = f"{src} -> {dst}: length = {res.hops}"
+            if res.path and not args.no_path:
+                line += "  path: " + " -> ".join(str(v) for v in res.path)
+        else:
+            line = f"{src} -> {dst}: no path"
+        print(line)
+    batch_s = results[0].time_s if results else 0.0
+    print(
+        f"[Time] dense batch of {len(results)} searches took "
+        f"{batch_s:.9f} seconds ({batch_s / max(len(results), 1):.9f} s/query)"
+    )
     return 0
 
 

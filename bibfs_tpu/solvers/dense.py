@@ -120,6 +120,19 @@ class DeviceGraph:
         """The tier pytree passed through jit: () for plain ELL."""
         return (self.hub_rank, self.tiers) if self.tiers else ()
 
+    @classmethod
+    def build(
+        cls, n: int, edges: np.ndarray, *, layout: str = "ell", device=None
+    ) -> "DeviceGraph":
+        """Build + upload in one step. ``layout="ell"`` = single-width table
+        (uniform-degree graphs); ``layout="tiered"`` = base table +
+        geometric hub tiers (power-law/RMAT degree distributions)."""
+        if layout == "tiered":
+            return cls.from_tiered(build_tiered(n, edges), device=device)
+        if layout == "ell":
+            return cls.from_ell(build_ell(n, edges), device=device)
+        raise ValueError(f"unknown layout {layout!r} (expected 'ell' or 'tiered')")
+
 
 def _auto_push_cap(n_pad: int) -> int:
     """Frontier size below which push beats pull. Push costs ~K*width
@@ -298,15 +311,14 @@ DENSE_MODES = {
 }
 
 
-@lru_cache(maxsize=None)
-def _get_kernel(mode: str, push_cap: int, tier_meta: tuple = ()):
-    """Build + jit the search kernel for (mode, push_cap, tier layout).
-    Returns ``fn(nbr, deg, aux, src, dst) -> (best, meet, parent_s,
-    parent_t, levels, edges_scanned)``; ``best >= INF32`` means no path.
-    ``aux`` is ``(hub_rank, tiers)`` for tiered graphs, ``()`` otherwise.
-    The whole search is one ``lax.while_loop`` in one XLA program — state
-    never leaves HBM and the host syncs exactly once at the end (versus
-    per-level host round-trips, quirk Q5)."""
+def _build_kernel(mode: str, push_cap: int, tier_meta: tuple = ()):
+    """Build the (unjitted) search kernel for (mode, push_cap, tier layout):
+    ``fn(nbr, deg, aux, src, dst) -> (best, meet, parent_s, parent_t,
+    levels, edges_scanned)``; ``best >= INF32`` means no path. ``aux`` is
+    ``(hub_rank, tiers)`` for tiered graphs, ``()`` otherwise. The whole
+    search is one ``lax.while_loop`` in one XLA program — state never
+    leaves HBM and the host syncs exactly once at the end (versus per-level
+    host round-trips, quirk Q5)."""
     schedule, hybrid = DENSE_MODES[mode]
     cap = push_cap if hybrid else 0
     k = max(cap, 1)
@@ -336,7 +348,27 @@ def _get_kernel(mode: str, push_cap: int, tier_meta: tuple = ()):
 
         return _outputs(jax.lax.while_loop(_cond, body, init))
 
-    return jax.jit(kernel)
+    return kernel
+
+
+@lru_cache(maxsize=None)
+def _get_kernel(mode: str, push_cap: int, tier_meta: tuple = ()):
+    return jax.jit(_build_kernel(mode, push_cap, tier_meta))
+
+
+@lru_cache(maxsize=None)
+def _get_batch_kernel(mode: str, push_cap: int, tier_meta: tuple = ()):
+    """vmap of the full search over (src, dst) pairs: B independent
+    bidirectional searches advance lock-step inside ONE compiled while_loop
+    (finished searches freeze via select until the last one stops) — the
+    amortized-throughput mode the reference cannot express (one process
+    launch per query, benchmark_test.sh:44-59)."""
+    return jax.jit(
+        jax.vmap(
+            _build_kernel(mode, push_cap, tier_meta),
+            in_axes=(None, None, None, 0, 0),
+        )
+    )
 
 
 def bibfs_dense(nbr, deg, src, dst):
@@ -397,6 +429,60 @@ def time_search(
     )
 
 
+def _batch_dispatch(g: DeviceGraph, pairs, mode: str):
+    pairs = np.asarray(pairs, dtype=np.int64).reshape(-1, 2)
+    if pairs.size and not ((0 <= pairs).all() and (pairs < g.n).all()):
+        raise ValueError(f"src/dst out of range for n={g.n}")
+    kern = _get_batch_kernel(mode, _auto_push_cap(g.n_pad), g.tier_meta)
+    srcs = jnp.asarray(pairs[:, 0], dtype=jnp.int32)
+    dsts = jnp.asarray(pairs[:, 1], dtype=jnp.int32)
+    return pairs, lambda: jax.block_until_ready(
+        kern(g.nbr, g.deg, g.aux, srcs, dsts)
+    )
+
+
+def _materialize_batch(out, num: int, elapsed: float) -> list[BFSResult]:
+    return [
+        _materialize(tuple(np.asarray(o)[i] for o in out), elapsed)
+        for i in range(num)
+    ]
+
+
+def solve_batch_graph(
+    g: DeviceGraph, pairs, *, mode: str = "sync"
+) -> list[BFSResult]:
+    """Solve many (src, dst) queries in ONE device program (vmapped search).
+
+    Wall-clock is amortized: the batch runs as long as its hardest query,
+    with every level's gathers/scatters batched across queries. Returns one
+    :class:`BFSResult` per pair; each result's ``time_s`` is the WHOLE
+    batch wall-clock (divide by ``len(pairs)`` for per-query throughput).
+    """
+    pairs, dispatch = _batch_dispatch(g, pairs, mode)
+    t0 = time.perf_counter()
+    out = dispatch()
+    elapsed = time.perf_counter() - t0
+    return _materialize_batch(out, pairs.shape[0], elapsed)
+
+
+def time_batch_graph(
+    g: DeviceGraph, pairs, *, repeats: int = 5, mode: str = "sync"
+) -> tuple[list[float], list[BFSResult]]:
+    """Batch solve under the shared timing protocol (warm-up excluded,
+    zero-D2H repeat loop, median stamped into every result's ``time_s``;
+    see :mod:`bibfs_tpu.solvers.timing`)."""
+    if repeats < 1:
+        raise ValueError(f"repeats must be >= 1, got {repeats}")
+    pairs, dispatch = _batch_dispatch(g, pairs, mode)
+    out = dispatch()  # warm-up: JIT compile excluded
+    times = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        out = dispatch()
+        times.append(time.perf_counter() - t0)
+    return times, _materialize_batch(out, pairs.shape[0], float(np.median(times)))
+
+
 def solve_dense(
     n: int,
     edges: np.ndarray,
@@ -406,14 +492,7 @@ def solve_dense(
     mode: str = "sync",
     layout: str = "ell",
 ) -> BFSResult:
-    """``layout="ell"`` builds the single-table ELL (uniform-degree graphs);
-    ``layout="tiered"`` builds the tiered ELL for skewed/power-law degree
-    distributions (RMAT/Graph500)."""
-    if layout == "tiered":
-        g = DeviceGraph.from_tiered(build_tiered(n, edges))
-    else:
-        g = DeviceGraph.from_ell(build_ell(n, edges))
-    return solve_dense_graph(g, src, dst, mode=mode)
+    return solve_dense_graph(DeviceGraph.build(n, edges, layout=layout), src, dst, mode=mode)
 
 
 @register("dense")

@@ -56,6 +56,7 @@ def time_backend(
     repeats: int = 5,
     num_devices: int | None = None,
     mode: str = "sync",
+    layout: str = "ell",
 ) -> tuple[list[float], BFSResult]:
     """Build the graph once for ``backend`` and run the timing protocol.
 
@@ -82,10 +83,9 @@ def time_backend(
             repeats,
         )
     if backend == "dense":
-        from bibfs_tpu.graph.csr import build_ell
         from bibfs_tpu.solvers.dense import DeviceGraph, time_search
 
-        g = DeviceGraph.from_ell(build_ell(n, edges))
+        g = DeviceGraph.build(n, edges, layout=layout)
         return time_search(g, src, dst, repeats=repeats, mode=mode)
     if backend == "sharded":
         from bibfs_tpu.graph.csr import build_ell

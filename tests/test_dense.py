@@ -170,6 +170,54 @@ def test_dense_tiered_rmat(mode):
         got.validate_path(n, edges, 0, n - 1)
 
 
+@pytest.mark.parametrize("mode", ["sync", "beamer"])
+def test_dense_batch_matches_serial(mode):
+    """Batched (vmapped) multi-query search: every pair must agree with the
+    oracle, including unreachable and src==dst pairs mixed into one batch."""
+    from bibfs_tpu.graph.csr import build_ell
+    from bibfs_tpu.solvers.dense import DeviceGraph, solve_batch_graph
+
+    n, edges, _, _ = CASES[0]
+    rng = np.random.default_rng(5)
+    pairs = rng.integers(0, n, size=(9, 2))
+    pairs[3] = (2, 2)  # src == dst
+    g = DeviceGraph.from_ell(build_ell(n, edges))
+    got = solve_batch_graph(g, pairs, mode=mode)
+    assert len(got) == len(pairs)
+    for (src, dst), r in zip(pairs, got):
+        ref = solve_serial(n, edges, int(src), int(dst))
+        assert r.found == ref.found
+        if ref.found:
+            assert r.hops == ref.hops
+            r.validate_path(n, edges, int(src), int(dst))
+
+
+def test_dense_batch_tiered():
+    from bibfs_tpu.graph.csr import build_tiered
+    from bibfs_tpu.graph.generate import rmat_graph
+    from bibfs_tpu.solvers.dense import DeviceGraph, solve_batch_graph
+
+    n, edges = rmat_graph(8, edge_factor=6, seed=1)
+    g = DeviceGraph.from_tiered(build_tiered(n, edges))
+    pairs = [(0, n - 1), (1, 5), (7, 7), (3, 200)]
+    got = solve_batch_graph(g, pairs, mode="beamer")
+    for (src, dst), r in zip(pairs, got):
+        ref = solve_serial(n, edges, src, dst)
+        assert r.found == ref.found
+        if ref.found:
+            assert r.hops == ref.hops
+            r.validate_path(n, edges, src, dst)
+
+
+def test_dense_batch_range_check():
+    from bibfs_tpu.graph.csr import build_ell
+    from bibfs_tpu.solvers.dense import DeviceGraph, solve_batch_graph
+
+    g = DeviceGraph.from_ell(build_ell(4, np.array([[0, 1]])))
+    with pytest.raises(ValueError):
+        solve_batch_graph(g, [(0, 9)])
+
+
 def test_dense_time_search_protocol():
     """time_search: times list of the right length, result matches a plain
     solve, and time_s is the median of the returned times."""

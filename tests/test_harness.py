@@ -89,3 +89,53 @@ def test_viz_cli_solve_mode(tiny_suite, tmp_path):
     out = str(tmp_path / "cli.png")
     rc = main([tiny_suite[0], "--solve", "0", str(n - 1), "--out", out])
     assert rc == 0 and os.path.getsize(out) > 1000
+
+
+def test_solve_cli_pairs_batch(tiny_suite, tmp_path, capsys):
+    from bibfs_tpu.cli.solve import main
+    from bibfs_tpu.graph.io import read_graph_bin
+    from bibfs_tpu.solvers.serial import solve_serial
+
+    gpath = tiny_suite[0]
+    n, edges = read_graph_bin(gpath)
+    pfile = str(tmp_path / "pairs.txt")
+    pairs = [(0, n - 1), (3, 3), (1, n // 2)]
+    with open(pfile, "w") as f:
+        for s, d in pairs:
+            f.write(f"{s} {d}\n")
+    rc = main([gpath, "--backend", "dense", "--pairs", pfile, "--no-path"])
+    out = capsys.readouterr().out.strip().splitlines()
+    assert rc == 0
+    assert len(out) == len(pairs) + 1  # one line per pair + time line
+    for (s, d), line in zip(pairs, out):
+        ref = solve_serial(n, edges, s, d)
+        if ref.found:
+            assert f"length = {ref.hops}" in line
+        else:
+            assert "no path" in line
+    assert "batch of 3 searches" in out[-1]
+
+
+def test_solve_cli_pairs_requires_dense(tiny_suite, tmp_path):
+    from bibfs_tpu.cli.solve import main
+
+    pfile = str(tmp_path / "p.txt")
+    open(pfile, "w").write("0 1\n")
+    with pytest.raises(SystemExit):
+        main([tiny_suite[0], "--backend", "serial", "--pairs", pfile])
+    with pytest.raises(SystemExit):  # positional src/dst conflict
+        main([tiny_suite[0], "0", "1", "--backend", "dense", "--pairs", pfile])
+    with pytest.raises(SystemExit):  # missing src/dst without --pairs
+        main([tiny_suite[0], "--backend", "dense"])
+
+
+def test_solve_cli_profile_trace(tiny_suite, tmp_path, capsys):
+    from bibfs_tpu.cli.solve import main
+
+    trace_dir = str(tmp_path / "trace")
+    rc = main(
+        [tiny_suite[0], "0", "5", "--backend", "dense", "--no-path",
+         "--profile", trace_dir]
+    )
+    assert rc == 0
+    assert os.path.isdir(os.path.join(trace_dir, "plugins", "profile"))
