@@ -69,9 +69,9 @@ def main(argv=None):
         "--layout",
         default="ell",
         choices=["ell", "tiered"],
-        help="adjacency layout for the dense backend: ell = single-width "
-        "table (uniform-degree graphs), tiered = base table + geometric "
-        "hub tiers (power-law/RMAT degree distributions)",
+        help="adjacency layout for the dense/sharded backends: ell = "
+        "single-width table (uniform-degree graphs), tiered = base table + "
+        "geometric hub tiers (power-law/RMAT degree distributions)",
     )
     args = ap.parse_args(argv)
 
@@ -87,8 +87,8 @@ def main(argv=None):
         print(f"Error reading graph: {e}", file=sys.stderr)
         return 2
 
-    if args.layout == "tiered" and args.backend != "dense":
-        ap.error("--layout tiered is only supported by --backend dense")
+    if args.layout == "tiered" and args.backend not in ("dense", "sharded"):
+        ap.error("--layout tiered is only supported by the dense/sharded backends")
     if args.pairs is not None:
         if args.backend != "dense":
             ap.error("--pairs batch mode is only supported by --backend dense")
@@ -101,7 +101,6 @@ def main(argv=None):
         kwargs["num_devices"] = args.devices
     if args.backend in ("dense", "sharded"):
         kwargs["mode"] = args.mode
-    if args.backend == "dense":
         kwargs["layout"] = args.layout
     import contextlib
 

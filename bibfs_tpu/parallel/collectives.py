@@ -34,6 +34,11 @@ def sum_allreduce(x: jnp.ndarray, axis: str) -> jnp.ndarray:
     return jax.lax.psum(x, axis)
 
 
+def max_allreduce(x: jnp.ndarray, axis: str) -> jnp.ndarray:
+    """Max across the mesh axis (MPI_Allreduce MAX, main-v2.cpp:70-71)."""
+    return jax.lax.pmax(x, axis)
+
+
 def global_min_and_argmin(
     local_min: jnp.ndarray, local_arg: jnp.ndarray, axis: str
 ) -> tuple[jnp.ndarray, jnp.ndarray]:

@@ -215,18 +215,21 @@ def _cond(st):
 PUSH_SPAN_TARGET = 256
 
 
-def _push_tiers(width: int, tier_meta, tiers):
-    """Static split of hub tiers into push-covered and pull-only; returns
-    ``(span, push_tiers)`` with push_tiers in the ops format ``(start,
-    count, nbr, hub_ids)``."""
+def push_span(width: int, tier_meta) -> tuple[int, int]:
+    """Static split of hub tiers into push-covered and pull-only. Returns
+    ``(span, ncovered)``: the first ``ncovered`` tiers are inside the push
+    span (cumulative width up to the first tier starting at or past
+    :data:`PUSH_SPAN_TARGET`); a frontier whose max degree exceeds ``span``
+    must take the pull path. Shared by the dense and sharded solvers so
+    their Beamer gates cannot diverge."""
     span = width
-    covered = []
-    for (start, count, twidth), (tnbr, tids) in zip(tier_meta, tiers):
+    ncovered = 0
+    for start, _count, twidth, *_rest in tier_meta:
         if start >= PUSH_SPAN_TARGET:
             break
-        covered.append((start, count, tnbr, tids))
+        ncovered += 1
         span = start + twidth
-    return span, covered
+    return span, ncovered
 
 
 def _side_step(st, side: str, nbr, deg, aux, tier_meta, *, push_cap: int):
@@ -241,7 +244,8 @@ def _side_step(st, side: str, nbr, deg, aux, tier_meta, *, push_cap: int):
         (start, count, tnbr, tids)
         for (start, count, _w), (tnbr, tids) in zip(tier_meta, tiers)
     )
-    span, push_tiers = _push_tiers(nbr.shape[1], tier_meta, tiers)
+    span, ncov = push_span(nbr.shape[1], tier_meta)
+    push_tiers = full_tiers[:ncov]
     carry = (
         st[f"fr_{side}"],
         st[f"fi_{side}"],

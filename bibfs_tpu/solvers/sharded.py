@@ -9,11 +9,14 @@ and per level the hosts exchange N-int arrays over 1 Gb Ethernet
 
 Here instead:
 - the ELL adjacency and all per-vertex state are 1D vertex-sharded across
-  the mesh (owner-computes — each device expands only its own rows);
+  the mesh (owner-computes — each device expands only its own rows); hub
+  tiers of the tiered layout (power-law graphs) are sharded by hub RANK, so
+  high-degree rows parallelize across the mesh too;
 - the only per-level exchange is one ``all_gather`` of the expanding side's
-  boolean frontier over ICI, plus scalar ``psum``/``pmin`` votes for
-  popcounts, meet, and termination (replacing five MPI_Allreduce per level,
-  SURVEY.md §3.2);
+  boolean frontier over ICI (pull) or just the candidate edge ids (push —
+  ``K*width`` ints, independent of graph size), plus scalar ``psum``/
+  ``pmin`` votes for popcounts, meet, and termination (replacing five
+  MPI_Allreduce per level, SURVEY.md §3.2);
 - the whole search is ONE ``lax.while_loop`` inside ONE ``shard_map``-jitted
   program: no host in the loop at all (v2/v4 return to the host every
   level).
@@ -32,11 +35,16 @@ from functools import lru_cache
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import NamedSharding
 from jax.sharding import PartitionSpec as P
 
-from bibfs_tpu.graph.csr import EllGraph, build_ell
+from bibfs_tpu.graph.csr import EllGraph, TieredEllGraph, build_ell, build_tiered
 from bibfs_tpu.ops.expand import expand_pull, frontier_count, frontier_degree_sum
-from bibfs_tpu.parallel.collectives import global_min_and_argmin, sum_allreduce
+from bibfs_tpu.parallel.collectives import (
+    global_min_and_argmin,
+    max_allreduce,
+    sum_allreduce,
+)
 from bibfs_tpu.parallel.mesh import VERTEX_AXIS, make_1d_mesh, shard_spec
 from bibfs_tpu.solvers.api import BFSResult, register
 from bibfs_tpu.solvers.dense import (
@@ -44,23 +52,36 @@ from bibfs_tpu.solvers.dense import (
     _auto_push_cap,
     _device_scalar,
     _materialize,
+    push_span,
 )
 
 from bibfs_tpu.solvers.dense import DENSE_MODES as SHARDED_MODES  # same matrix
 
 
 def _bibfs_shard_body(
-    nbr, deg, src, dst, *, axis: str, mode: str = "sync", push_cap: int = 0
+    nbr,
+    deg,
+    aux,
+    src,
+    dst,
+    *,
+    axis: str,
+    mode: str = "sync",
+    push_cap: int = 0,
+    tier_meta: tuple = (),
 ):
     """The per-device program. ``nbr``/``deg`` are the LOCAL vertex shard;
-    ``src``/``dst`` are replicated scalars. ``mode="sync"`` expands both
-    sides every round (half the sequential rounds — the latency-bound
-    default); ``mode="alt"`` expands the globally-smaller frontier only
-    (fewer total edge scans, v1/v4's direction optimization). ``push_cap >
-    0`` enables Beamer push/pull direction optimization: frontiers at most
-    that wide skip the n-bool frontier all_gather entirely and instead
-    exchange only their candidate edges — ``K*width`` (tgt, src) pairs —
-    over ICI, so per-level traffic scales with the frontier, not the graph.
+    ``src``/``dst`` are replicated scalars; ``aux`` is ``()`` for plain ELL
+    or ``(hub_rank_shard, ((tier_nbr_shard, tier_slots_shard,
+    hub_ids_replicated), ...))`` for the tiered layout (tier tables sharded
+    by hub rank). ``mode="sync"`` expands both sides every round (half the
+    sequential rounds — the latency-bound default); ``mode="alt"`` expands
+    the globally-smaller frontier only (fewer total edge scans, v1/v4's
+    direction optimization). ``push_cap > 0`` enables Beamer push/pull
+    direction optimization: frontiers at most that wide (whose max degree
+    fits the static push span) skip the n-bool frontier all_gather entirely
+    and instead exchange only their candidate edges over ICI, so per-level
+    traffic scales with the frontier, not the graph.
     """
     n_loc = nbr.shape[0]
     width = nbr.shape[1]
@@ -68,6 +89,10 @@ def _bibfs_shard_body(
     me = jax.lax.axis_index(axis)
     offset = (me * n_loc).astype(jnp.int32)
     ids = offset + jnp.arange(n_loc, dtype=jnp.int32)  # my global vertex ids
+    hub_rank, tiers = aux if aux else (None, ())
+    full_tiers = tuple(zip(tier_meta, tiers))
+    span, ncov = push_span(width, tier_meta)  # shared Beamer gate rule
+    push_tiers = full_tiers[:ncov]
 
     def seed(v):
         fr = ids == v
@@ -84,6 +109,7 @@ def _bibfs_shard_body(
             ),
             ok=jnp.bool_(True),
             cnt=jnp.int32(1),
+            md=sum_allreduce(jnp.sum(jnp.where(fr, deg, 0)), axis),
             # parents start as constants; mark them device-varying so both
             # lax.cond branches (only one of which writes each side) agree
             par=jax.lax.pcast(jnp.full(n_loc, -1, jnp.int32), axis, to="varying"),
@@ -115,11 +141,37 @@ def _bibfs_shard_body(
         scanned = sum_allreduce(frontier_degree_sum(fr, deg), axis)
         # THE per-level exchange: one boolean frontier all_gather (ICI)
         f_glob = jax.lax.all_gather(fr, axis, tiled=True)
-        nf, pcand = expand_pull(f_glob, dist < INF32, nbr, deg)
-        par = jnp.where(nf, pcand, par)
-        dist = jnp.where(nf, lvl + 1, dist)
+        visited = dist < INF32
+        nf0, pcand = expand_pull(f_glob, visited, nbr, deg)
+        par = jnp.where(nf0, pcand, par)
+        nf = nf0
+        for (tstart, tcount, twidth, _cpad), (tnbr, tslots, tids) in full_tiers:
+            # hub rows I own (rank-sharded): gather hits from the global
+            # frontier, then exchange the per-hub verdicts ([count_pad]
+            # bools + ints — tiny next to the n-bool frontier) so vertex
+            # owners can scatter them into their shards
+            cols = jnp.arange(twidth, dtype=jnp.int32)[None, :]
+            valid = cols < tslots[:, None]
+            hits = f_glob[tnbr] & valid
+            any_loc = jnp.any(hits, axis=1)
+            j_star = jnp.argmax(hits, axis=1)
+            par_loc = jnp.take_along_axis(tnbr, j_star[:, None], axis=1)[:, 0]
+            # one collective per tier: parent id where hit, -1 otherwise
+            par_all = jax.lax.all_gather(
+                jnp.where(any_loc, par_loc, -1), axis, tiled=True
+            )
+            tloc = tids - offset
+            own = (tloc >= 0) & (tloc < n_loc) & (par_all >= 0) & (tids >= 0)
+            tclip = jnp.where(own, tloc, 0)
+            new = own & (dist[tclip] >= INF32)
+            t2 = jnp.where(new, tloc, n_loc)  # n_loc = out of bounds -> drop
+            nf = nf.at[t2].max(jnp.ones(t2.shape, jnp.bool_), mode="drop")
+            par = par.at[t2].max(par_all, mode="drop")
+        dist = jnp.where(nf & (dist >= INF32), lvl + 1, dist)
         cnt = sum_allreduce(frontier_count(nf), axis)
-        return nf, fi, jnp.bool_(False), par, dist, lvl + 1, cnt, scanned
+        md = max_allreduce(jnp.max(jnp.where(nf, deg, 0)), axis)
+        # the compact index list is now stale; push recomputes it on entry
+        return nf, fi, jnp.bool_(False), par, dist, lvl + 1, cnt, md, scanned
 
     def push(c):
         fr, fi, ok, par, dist, lvl = c
@@ -139,15 +191,43 @@ def _bibfs_shard_body(
         # owner-computes: expand only the fidx entries whose rows I hold
         mine = (fi >= offset) & (fi < offset + n_loc)
         floc = jnp.where(mine, fi - offset, 0)
+        # replicate per-entry degree (and hub rank) via ONE fused psum —
+        # exactly one vertex owner contributes each entry
+        if push_tiers:
+            packed = sum_allreduce(
+                jnp.where(
+                    mine,
+                    jnp.stack([deg[floc], hub_rank[floc] + 1]),
+                    0,
+                ),
+                axis,
+            )
+            vd, franks = packed[0], packed[1] - 1
+        else:
+            vd = sum_allreduce(jnp.where(mine, deg[floc], 0), axis)  # [k]
         rows = nbr[floc]  # [k, width] local row gather (global target ids)
-        vd = jnp.where(mine, deg[floc], 0)
-        valid = jnp.arange(width, dtype=jnp.int32)[None, :] < vd[:, None]
+        cols = jnp.arange(width, dtype=jnp.int32)[None, :]
+        valid = mine[:, None] & (cols < jnp.minimum(vd, width)[:, None])
+        parts_rows = [rows]
+        parts_valid = [valid]
+        if push_tiers:
+            for (tstart, tcount, twidth, cpad), (tnbr, tslots, _tids) in push_tiers:
+                h_loc = tnbr.shape[0]
+                r_off = (me * h_loc).astype(jnp.int32)
+                mine_r = (franks >= r_off) & (franks < r_off + h_loc)
+                rloc = jnp.where(mine_r, franks - r_off, 0)
+                tcols = jnp.arange(twidth, dtype=jnp.int32)[None, :]
+                parts_rows.append(tnbr[rloc])
+                parts_valid.append(mine_r[:, None] & (tcols < tslots[rloc][:, None]))
+        rows = jnp.concatenate(parts_rows, axis=1)
+        valid = jnp.concatenate(parts_valid, axis=1)
+        wtot = rows.shape[1]
         srcb = jnp.broadcast_to(fi[:, None], rows.shape)
-        # exchange candidate targets, NOT the frontier: [ndev*k*width] ids.
+        # exchange candidate targets, NOT the frontier: [ndev*k*wtot] ids.
         # The matching sources need no collective at all — fi is replicated,
         # so every device reconstructs src_all locally by tiling.
         tgt_all = jax.lax.all_gather(jnp.where(valid, rows, -1).ravel(), axis).ravel()
-        ndev = tgt_all.shape[0] // (k * width)
+        ndev = tgt_all.shape[0] // (k * wtot)
         src_all = jnp.tile(srcb.ravel(), ndev)
         # scatter the candidates I own into my dist/par shard
         tloc = tgt_all - offset
@@ -173,8 +253,11 @@ def _bibfs_shard_body(
         outpos = jnp.where(win, pos, k)
         nfi = jnp.full(k, -1, jnp.int32).at[outpos].set(tgt_all, mode="drop")
         cnt = jnp.sum(win.astype(jnp.int32))
-        scanned = sum_allreduce(jnp.sum(vd), axis)
-        return nf, nfi, cnt <= k, par, dist, lvl + 1, cnt, scanned
+        md = max_allreduce(jnp.max(jnp.where(win_loc, deg[tclip], 0)), axis)
+        # vd is already the psum-replicated global degree list (dead
+        # entries contribute 0), so its sum needs no further collective
+        scanned = jnp.sum(vd)
+        return nf, nfi, cnt <= k, par, dist, lvl + 1, cnt, md, scanned
 
     def side_step(st, side):
         carry = (
@@ -186,10 +269,13 @@ def _bibfs_shard_body(
             st[f"lvl_{side}"],
         )
         if push_cap > 0:
-            out = jax.lax.cond(st[f"cnt_{side}"] <= push_cap, push, pull, carry)
+            use_push = (st[f"cnt_{side}"] <= push_cap) & (
+                st[f"md_{side}"] <= span
+            )
+            out = jax.lax.cond(use_push, push, pull, carry)
         else:
             out = pull(carry)
-        nf, fi, ok, par, dist, lvl, cnt, scanned = out
+        nf, fi, ok, par, dist, lvl, cnt, md, scanned = out
         return {
             **st,
             f"fr_{side}": nf,
@@ -199,6 +285,7 @@ def _bibfs_shard_body(
             f"dist_{side}": dist,
             f"lvl_{side}": lvl,
             f"cnt_{side}": cnt,
+            f"md_{side}": md,
             "edges": st["edges"] + scanned,
         }
 
@@ -245,48 +332,104 @@ def _bibfs_shard_body(
 
 
 @lru_cache(maxsize=None)
-def _compiled_sharded(mesh, axis: str, mode: str = "sync", push_cap: int = 0):
+def _compiled_sharded(
+    mesh, axis: str, mode: str = "sync", push_cap: int = 0, tier_meta: tuple = ()
+):
     hybrid = SHARDED_MODES[mode][1]
     cap = push_cap if hybrid else 0
     sh = P(axis)
     rep = P()
+    aux_spec = (sh, tuple((sh, sh, rep) for _ in tier_meta)) if tier_meta else ()
     fn = jax.shard_map(
-        lambda nbr, deg, src, dst: _bibfs_shard_body(
-            nbr, deg, src, dst, axis=axis, mode=mode, push_cap=cap
+        lambda nbr, deg, aux, src, dst: _bibfs_shard_body(
+            nbr,
+            deg,
+            aux,
+            src,
+            dst,
+            axis=axis,
+            mode=mode,
+            push_cap=cap,
+            tier_meta=tier_meta,
         ),
         mesh=mesh,
-        in_specs=(sh, sh, rep, rep),
+        in_specs=(sh, sh, aux_spec, rep, rep),
         out_specs=(rep, rep, sh, sh, rep, rep),
     )
     return jax.jit(fn)
 
 
 class ShardedGraph:
-    """ELL adjacency 1D-sharded across a device mesh — the framework's
-    answer to ``MPI_Bcast`` full-graph replication (quirk Q6): each device
-    holds only ``n_pad / ndev`` rows."""
+    """Adjacency 1D-sharded across a device mesh — the framework's answer
+    to ``MPI_Bcast`` full-graph replication (quirk Q6): each device holds
+    only ``n_pad / ndev`` base rows. Accepts a plain :class:`EllGraph`
+    (uniform degrees) or a :class:`TieredEllGraph` (power-law): hub tier
+    tables are sharded by hub rank, their (tiny) rank->vertex maps
+    replicated."""
 
-    def __init__(self, g: EllGraph, mesh=None):
-        if g.overflow.shape[0]:
-            raise NotImplementedError(
-                "EllGraph has width_cap overflow edges; the device solvers "
-                "do not handle the hybrid ELL+COO layout yet — build the "
-                "ELL without width_cap"
-            )
+    def __init__(self, g: EllGraph | TieredEllGraph, mesh=None):
         self.mesh = mesh if mesh is not None else make_1d_mesh()
-        ndev = self.mesh.devices.size
+        ndev = int(self.mesh.devices.size)
         if g.n_pad % ndev:
             raise ValueError(
-                f"n_pad={g.n_pad} not divisible by {ndev} devices; build the "
-                f"ELL with pad_multiple a multiple of the mesh size"
+                f"n_pad={g.n_pad} not divisible by {ndev} devices; build "
+                f"with pad_multiple a multiple of the mesh size"
             )
         spec = shard_spec(self.mesh)
+        rep = NamedSharding(self.mesh, P())
         self.n = g.n
         self.n_pad = g.n_pad
         self.width = g.width
         self.num_edges = g.num_edges
         self.nbr = jax.device_put(g.nbr, spec)
         self.deg = jax.device_put(g.deg, spec)
+        self.tier_meta = ()
+        self._aux = ()
+        if isinstance(g, TieredEllGraph) and g.tiers:
+            tiers = []
+            meta = []
+            for t in g.tiers:
+                # re-pad the rank dimension so it tiles across the mesh
+                cpad = -(-t.nbr.shape[0] // (8 * ndev)) * (8 * ndev)
+                tnbr = np.zeros((cpad, t.nbr.shape[1]), dtype=np.int32)
+                tnbr[: t.nbr.shape[0]] = t.nbr
+                tids = np.full(cpad, -1, dtype=np.int32)
+                tids[: min(t.count, cpad)] = g.hub_ids[: t.count]
+                tslots = np.zeros(cpad, dtype=np.int32)
+                tslots[: t.count] = np.clip(
+                    g.deg[g.hub_ids[: t.count]] - t.start, 0, t.nbr.shape[1]
+                )
+                tiers.append(
+                    (
+                        jax.device_put(tnbr, spec),
+                        jax.device_put(tslots, spec),
+                        jax.device_put(tids, rep),
+                    )
+                )
+                meta.append((t.start, t.count, t.nbr.shape[1], cpad))
+            self._aux = (jax.device_put(g.hub_rank, spec), tuple(tiers))
+            self.tier_meta = tuple(meta)
+        elif isinstance(g, EllGraph) and g.overflow.shape[0]:
+            raise NotImplementedError(
+                "EllGraph has width_cap overflow edges; use build_tiered "
+                "(tiered ELL) for skewed-degree graphs instead of width_cap"
+            )
+
+    @property
+    def aux(self):
+        return self._aux
+
+    @classmethod
+    def build(
+        cls, n: int, edges: np.ndarray, mesh=None, *, layout: str = "ell"
+    ) -> "ShardedGraph":
+        mesh = mesh if mesh is not None else make_1d_mesh()
+        ndev = int(mesh.devices.size)
+        if layout == "tiered":
+            return cls(build_tiered(n, edges, pad_multiple=8 * ndev), mesh)
+        if layout == "ell":
+            return cls(build_ell(n, edges, pad_multiple=8 * ndev), mesh)
+        raise ValueError(f"unknown layout {layout!r} (expected 'ell' or 'tiered')")
 
 
 def solve_sharded_graph(
@@ -294,11 +437,13 @@ def solve_sharded_graph(
 ) -> BFSResult:
     if not (0 <= src < g.n and 0 <= dst < g.n):
         raise ValueError(f"src/dst out of range for n={g.n}")
-    fn = _compiled_sharded(g.mesh, VERTEX_AXIS, mode, _auto_push_cap(g.n_pad))
+    fn = _compiled_sharded(
+        g.mesh, VERTEX_AXIS, mode, _auto_push_cap(g.n_pad), g.tier_meta
+    )
     src_a = _device_scalar(src)
     dst_a = _device_scalar(dst)
     t0 = time.perf_counter()
-    out = jax.block_until_ready(fn(g.nbr, g.deg, src_a, dst_a))
+    out = jax.block_until_ready(fn(g.nbr, g.deg, g.aux, src_a, dst_a))
     elapsed = time.perf_counter() - t0
     return _materialize(out, elapsed)
 
@@ -310,11 +455,13 @@ def time_search(
     rationale in :mod:`bibfs_tpu.solvers.timing`)."""
     from bibfs_tpu.solvers.timing import timed_repeats
 
-    fn = _compiled_sharded(g.mesh, VERTEX_AXIS, mode, _auto_push_cap(g.n_pad))
+    fn = _compiled_sharded(
+        g.mesh, VERTEX_AXIS, mode, _auto_push_cap(g.n_pad), g.tier_meta
+    )
     src_a = _device_scalar(src)
     dst_a = _device_scalar(dst)
     return timed_repeats(
-        lambda: jax.block_until_ready(fn(g.nbr, g.deg, src_a, dst_a)),
+        lambda: jax.block_until_ready(fn(g.nbr, g.deg, g.aux, src_a, dst_a)),
         lambda: solve_sharded_graph(g, src, dst, mode=mode),
         repeats,
     )
@@ -328,13 +475,18 @@ def solve_sharded(
     *,
     num_devices: int | None = None,
     mode: str = "sync",
+    layout: str = "ell",
 ) -> BFSResult:
     mesh = make_1d_mesh(num_devices)
-    ndev = int(mesh.devices.size)
-    ell = build_ell(n, edges, pad_multiple=8 * ndev)
-    return solve_sharded_graph(ShardedGraph(ell, mesh), src, dst, mode=mode)
+    return solve_sharded_graph(
+        ShardedGraph.build(n, edges, mesh, layout=layout), src, dst, mode=mode
+    )
 
 
 @register("sharded")
-def _sharded_backend(n, edges, src, dst, num_devices=None, mode="sync", **_):
-    return solve_sharded(n, edges, src, dst, num_devices=num_devices, mode=mode)
+def _sharded_backend(
+    n, edges, src, dst, num_devices=None, mode="sync", layout="ell", **_
+):
+    return solve_sharded(
+        n, edges, src, dst, num_devices=num_devices, mode=mode, layout=layout
+    )

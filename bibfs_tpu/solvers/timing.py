@@ -88,12 +88,10 @@ def time_backend(
         g = DeviceGraph.build(n, edges, layout=layout)
         return time_search(g, src, dst, repeats=repeats, mode=mode)
     if backend == "sharded":
-        from bibfs_tpu.graph.csr import build_ell
         from bibfs_tpu.parallel.mesh import make_1d_mesh
         from bibfs_tpu.solvers.sharded import ShardedGraph, time_search
 
         mesh = make_1d_mesh(num_devices)
-        ell = build_ell(n, edges, pad_multiple=8 * int(mesh.devices.size))
-        g = ShardedGraph(ell, mesh)
+        g = ShardedGraph.build(n, edges, mesh, layout=layout)
         return time_search(g, src, dst, repeats=repeats, mode=mode)
     raise KeyError(f"unknown backend {backend!r}")

@@ -111,8 +111,8 @@ def test_sharded_beamer_push_pull_switching(case):
     ref = solve_serial(n, edges, src, dst)
     mesh = make_1d_mesh(8)
     g = ShardedGraph(build_ell(n, edges, pad_multiple=64), mesh)
-    fn = _compiled_sharded(mesh, VERTEX_AXIS, "beamer", 2)
-    out = fn(g.nbr, g.deg, jnp.int32(src), jnp.int32(dst))
+    fn = _compiled_sharded(mesh, VERTEX_AXIS, "beamer", 2, g.tier_meta)
+    out = fn(g.nbr, g.deg, g.aux, jnp.int32(src), jnp.int32(dst))
     got = _materialize(out, 0.0)
     assert got.found == ref.found
     if ref.found:
@@ -126,6 +126,51 @@ def test_sharded_beamer_counterexample_first_meet():
     )
     r = solve_sharded(10, edges, 0, 9, num_devices=8, mode="beamer")
     assert r.found and r.hops == 3
+
+
+@pytest.mark.parametrize("mode", ["sync", "beamer", "beamer_alt"])
+@pytest.mark.parametrize("case", range(0, len(CASES), 4))
+def test_sharded_tiered_matches_serial(case, mode):
+    """Tiered layout under shard_map (rank-sharded hub tiers) must agree
+    with the oracle in every mode."""
+    n, edges, src, dst = CASES[case]
+    ref = solve_serial(n, edges, src, dst)
+    got = solve_sharded(
+        n, edges, src, dst, num_devices=8, mode=mode, layout="tiered"
+    )
+    assert got.found == ref.found
+    if ref.found:
+        assert got.hops == ref.hops
+        got.validate_path(n, edges, src, dst)
+
+
+@pytest.mark.parametrize("mode", ["sync", "beamer"])
+def test_sharded_tiered_rmat(mode):
+    """Skewed RMAT graph on the 8-device mesh: hub tiers really form, and
+    under beamer the hub levels must route to pull via the md carry."""
+    from bibfs_tpu.graph.generate import rmat_graph
+
+    n, edges = rmat_graph(9, edge_factor=8, seed=5)
+    ref = solve_serial(n, edges, 0, n - 1)
+    got = solve_sharded(
+        n, edges, 0, n - 1, num_devices=8, mode=mode, layout="tiered"
+    )
+    assert got.found == ref.found
+    if ref.found:
+        assert got.hops == ref.hops
+        got.validate_path(n, edges, 0, n - 1)
+
+
+def test_sharded_tiered_star_hub():
+    """Star hub (degree n-1): multi-tier hubs + span routing on the mesh."""
+    n = 600
+    edges = np.array([[0, i] for i in range(1, n)] + [[n - 1, n - 2]])
+    ref = solve_serial(n, edges, 1, n - 2)
+    got = solve_sharded(
+        n, edges, 1, n - 2, num_devices=8, mode="beamer", layout="tiered"
+    )
+    assert got.found and got.hops == ref.hops == 2
+    got.validate_path(n, edges, 1, n - 2)
 
 
 def test_sharded_time_search_protocol():
