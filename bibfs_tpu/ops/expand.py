@@ -128,6 +128,41 @@ def _push_claim(fc, rows, valid, scanned, par, dist, deg, lvl_next, *, inf):
     return next_f, next_fidx, cnt, par, dist, scanned, max_deg
 
 
+def pack_dual(frontier_s: jnp.ndarray, frontier_t: jnp.ndarray) -> jnp.ndarray:
+    """Pack both sides' boolean frontiers into one uint8 bitfield (bit 0 =
+    source side, bit 1 = target side) so a lock-step round reads the
+    neighbor table ONCE for both expansions — the dominant HBM (and, under
+    sharding, ICI) traffic of a pull round, halved."""
+    return frontier_s.astype(jnp.uint8) | (frontier_t.astype(jnp.uint8) << 1)
+
+
+def _dual_hits(vals, valid, bit):
+    return ((vals & bit) > 0) & valid
+
+
+def expand_pull_dual(
+    packed: jnp.ndarray,  # uint8[n] from pack_dual (global under sharding)
+    visited_s: jnp.ndarray,
+    visited_t: jnp.ndarray,
+    nbr: jnp.ndarray,
+    deg: jnp.ndarray,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Both sides of one lock-step level with a single ``packed[nbr]``
+    gather. Returns ``(next_s, parent_s, next_t, parent_t)`` with the same
+    per-side semantics as :func:`expand_pull`."""
+    width = nbr.shape[1]
+    valid = jnp.arange(width, dtype=deg.dtype)[None, :] < deg[:, None]
+    vals = packed[nbr]  # ONE [n_local, width] gather for both sides
+    outs = []
+    for bit, visited in ((1, visited_s), (2, visited_t)):
+        hits = _dual_hits(vals, valid, bit)
+        next_f = jnp.any(hits, axis=1) & ~visited
+        j_star = jnp.argmax(hits, axis=1)
+        parent = jnp.take_along_axis(nbr, j_star[:, None], axis=1)[:, 0]
+        outs += [next_f, parent]
+    return tuple(outs)
+
+
 def _tier_valid(slot_count, width, rank, tier_count):
     """Valid-slot mask for one hub tier: bool[K_or_H, width]."""
     member = (rank >= 0) & (rank < tier_count)
@@ -165,6 +200,46 @@ def expand_pull_tiered(frontier, par, dist, nbr, deg, tiers, lvl_next, *, inf: i
     dist = jnp.where(nf & (dist >= inf), lvl_next, dist)
     max_deg = jnp.max(jnp.where(nf, deg, 0))
     return nf, par, dist, max_deg
+
+
+def expand_pull_dual_tiered(
+    fr_s, fr_t, par_s, dist_s, par_t, dist_t, nbr, deg, tiers, lvl_s, lvl_t, *, inf
+):
+    """Lock-step variant of :func:`expand_pull_tiered`: one packed gather
+    per table (base and each hub tier) serves BOTH sides' expansions.
+    Returns ``(nf_s, par_s, dist_s, md_s, nf_t, par_t, dist_t, md_t)``."""
+    n_pad = nbr.shape[0]
+    packed = pack_dual(fr_s, fr_t)
+    vis_s = dist_s < inf
+    vis_t = dist_t < inf
+    nf_s, pc_s, nf_t, pc_t = expand_pull_dual(packed, vis_s, vis_t, nbr, deg)
+    par_s = jnp.where(nf_s, pc_s, par_s)
+    par_t = jnp.where(nf_t, pc_t, par_t)
+    for start, count, tier_nbr, hub_ids in tiers:
+        width = tier_nbr.shape[1]
+        rank = jnp.arange(tier_nbr.shape[0], dtype=jnp.int32)
+        ids_c = jnp.clip(hub_ids, 0, n_pad - 1)
+        slot_count = jnp.clip(deg[ids_c] - start, 0, width)
+        valid = _tier_valid(slot_count, width, rank, count) & (hub_ids >= 0)[:, None]
+        vals = packed[tier_nbr]  # ONE gather for both sides
+        for bit, vis in ((1, vis_s), (2, vis_t)):
+            hits = _dual_hits(vals, valid, bit)
+            hub_any = jnp.any(hits, axis=1)
+            hub_new = hub_any & ~vis[ids_c]
+            j_star = jnp.argmax(hits, axis=1)
+            hub_par = jnp.take_along_axis(tier_nbr, j_star[:, None], axis=1)[:, 0]
+            tgt = jnp.where(hub_new, hub_ids, n_pad)
+            if bit == 1:
+                nf_s = nf_s.at[tgt].max(jnp.ones(tgt.shape, jnp.bool_), mode="drop")
+                par_s = par_s.at[tgt].max(hub_par, mode="drop")
+            else:
+                nf_t = nf_t.at[tgt].max(jnp.ones(tgt.shape, jnp.bool_), mode="drop")
+                par_t = par_t.at[tgt].max(hub_par, mode="drop")
+    dist_s = jnp.where(nf_s & ~vis_s, lvl_s, dist_s)
+    dist_t = jnp.where(nf_t & ~vis_t, lvl_t, dist_t)
+    md_s = jnp.max(jnp.where(nf_s, deg, 0))
+    md_t = jnp.max(jnp.where(nf_t, deg, 0))
+    return nf_s, par_s, dist_s, md_s, nf_t, par_t, dist_t, md_t
 
 
 def expand_push_tiered(
