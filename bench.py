@@ -16,19 +16,29 @@ adjacency layout) ON THE BENCH HARDWARE and reports the best median — the
 right config is hardware-dependent (pull is HBM-bound, push is
 scatter-latency-bound), so it is selected where it runs, not guessed.
 
-Correctness gate: a config is discarded (and the run aborts if none
-survive) if the device solver's hop count disagrees with the serial oracle.
+Robustness contract (round-1 failure was an unstructured rc=1 traceback):
+- the accelerator backend is probed in a SUBPROCESS with a bounded timeout
+  (a hung tunneled-TPU init cannot stall the bench), retried once;
+- if the accelerator is unusable, the bench falls back to the host CPU
+  platform and says so in the emitted JSON (``platform`` + ``tpu_error``)
+  instead of dying mid-``device_put``;
+- EVERY exit path prints exactly one JSON line on stdout (``value: null``
+  + ``error`` when no number could be produced).
+
+Correctness gate: a config is discarded (and recorded in
+``detail.failed_configs``) if the device solver's hop count disagrees with
+the serial oracle or its reconstructed path fails CSR edge validation.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
 import sys
 import time
 
 import numpy as np
-
-import os
 
 BASELINE_V1_100K_S = 0.000115546  # benchmark_results.csv:5
 # BENCH_N/BENCH_REPEATS are debug overrides (CPU smoke tests); the driver
@@ -36,19 +46,44 @@ BASELINE_V1_100K_S = 0.000115546  # benchmark_results.csv:5
 N = int(os.environ.get("BENCH_N", 100_000))
 AVG_DEG = 2.2000000001  # graphs/make_graphs:8
 REPEATS = int(os.environ.get("BENCH_REPEATS", 30))
+PROBE_TIMEOUT_S = float(os.environ.get("BENCH_PROBE_TIMEOUT_S", 150))
 SWEEP = [  # (mode, layout)
     ("sync", "ell"),
     ("beamer", "ell"),
     ("sync", "tiered"),
     ("beamer", "tiered"),
 ]
+# Precomputed connected seeds (src=0, dst=n-1 reachable) for the generator's
+# G(n, 2.2/n) at the sizes the bench runs — kills the serial search-on-boot
+# (round-1 weak #8). Verified: seed 1 @ 100k gives hops=15.
+KNOWN_SEEDS = {100_000: 1}
+# v5e HBM peak per chip (public spec: 819 GB/s) — used for the roofline
+# accounting that backs (or refutes) the no-Pallas decision.
+HBM_PEAK_GBPS = {"tpu": 819.0, "cpu": float(os.environ.get("BENCH_CPU_GBPS", 50.0))}
+
+
+def emit(value, detail, error=None):
+    line = {
+        "metric": "bibfs_100k_search_wall_clock",
+        "value": value,
+        "unit": "s",
+        "vs_baseline": (BASELINE_V1_100K_S / value) if value else None,
+        "detail": detail,
+    }
+    if error:
+        line["error"] = error
+    print(json.dumps(line))
 
 
 def find_connected_seed(max_tries=50):
     from bibfs_tpu.graph.generate import gnp_random_graph
     from bibfs_tpu.solvers.serial import solve_serial
 
-    for seed in range(max_tries):
+    start = KNOWN_SEEDS.get(N)
+    order = ([start] if start is not None else []) + [
+        s for s in range(max_tries) if s != start
+    ]
+    for seed in order:
         edges = gnp_random_graph(N, AVG_DEG / N, seed=seed)
         res = solve_serial(N, edges, 0, N - 1)
         if res.found:
@@ -56,71 +91,150 @@ def find_connected_seed(max_tries=50):
     raise RuntimeError("no connected seed found")
 
 
+def probe_accelerator() -> tuple[str, str | None]:
+    """Bounded-time check that the ambient accelerator backend can actually
+    initialize and run a dispatch. Runs in a SUBPROCESS so a hung PJRT init
+    (round 1: bare ``jax.devices()`` >280 s) cannot take the bench down.
+    Returns ``(platform, tpu_error)`` where platform is "tpu" or "cpu"."""
+    code = (
+        "import jax, jax.numpy as jnp;"
+        "d = jax.devices();"
+        "assert d and d[0].platform != 'cpu', f'cpu-only: {d}';"
+        "x = jnp.zeros(8); jax.block_until_ready(x + 1);"
+        "print('PROBE_OK', d[0].platform, len(d))"
+    )
+    err = None
+    for attempt in range(2):
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", code],
+                capture_output=True,
+                text=True,
+                timeout=PROBE_TIMEOUT_S,
+            )
+            for line in r.stdout.splitlines():
+                if line.startswith("PROBE_OK"):
+                    return line.split()[1], None  # the real platform name
+            err = (r.stdout + r.stderr).strip()[-600:]
+        except subprocess.TimeoutExpired:
+            err = f"probe timeout after {PROBE_TIMEOUT_S}s (attempt {attempt + 1})"
+    return "cpu", err
+
+
 def main():
     t_setup = time.time()
-    seed, edges, oracle = find_connected_seed()
+    detail: dict = {}
+    try:
+        seed, edges, oracle = find_connected_seed()
 
-    from bibfs_tpu.solvers.dense import DeviceGraph, time_search
-    from bibfs_tpu.utils.platform import apply_platform_env
+        from bibfs_tpu.utils.platform import apply_platform_env, force_cpu
 
-    apply_platform_env()  # honor JAX_PLATFORMS even under sitecustomize boots
+        if os.environ.get("JAX_PLATFORMS"):
+            # debug override (e.g. CPU smoke test): honor it, skip the probe
+            platform, tpu_error = os.environ["JAX_PLATFORMS"], None
+            apply_platform_env()
+        else:
+            platform, tpu_error = probe_accelerator()
+            if platform == "cpu":
+                force_cpu(1)
+        detail["platform"] = platform
+        if tpu_error:
+            detail["tpu_error"] = tpu_error
 
-    graphs = {
-        layout: DeviceGraph.build(N, edges, layout=layout)
-        for layout in ("ell", "tiered")
-    }
+        from bibfs_tpu.graph.csr import build_csr, canonical_pairs
+        from bibfs_tpu.solvers.api import validate_path
+        from bibfs_tpu.solvers.dense import DeviceGraph, time_search
 
-    # warm-up/compile excluded inside time_search; the repeat loop performs
-    # ZERO device->host reads between dispatches (a single scalar readback
-    # stalls tunneled-TPU runtimes ~200ms), matching the reference's
-    # readout-free timed regions (v1/main-v1.cpp:49-82)
-    results = {}
-    for mode, layout in SWEEP:
-        label = f"{mode}/{layout}"
-        try:
-            times, res = time_search(graphs[layout], 0, N - 1, repeats=REPEATS, mode=mode)
-        except Exception as e:  # keep the sweep alive
-            print(f"config {label} failed: {e}", file=sys.stderr)
-            continue
-        if res.hops != oracle.hops:
-            print(
-                f"CORRECTNESS FAILURE ({label}): device hops {res.hops} != "
-                f"oracle {oracle.hops}",
-                file=sys.stderr,
+        pairs = canonical_pairs(N, edges)  # one O(M log M) pass for all layouts
+        csr = build_csr(N, pairs=pairs)
+        graphs = {
+            layout: DeviceGraph.build(N, layout=layout, pairs=pairs)
+            for layout in ("ell", "tiered")
+        }
+
+        # warm-up/compile excluded inside time_search; the repeat loop performs
+        # ZERO device->host reads between dispatches (a single scalar readback
+        # stalls tunneled-TPU runtimes ~200ms), matching the reference's
+        # readout-free timed regions (v1/main-v1.cpp:49-82)
+        results = {}
+        failed = {}
+        for mode, layout in SWEEP:
+            label = f"{mode}/{layout}"
+            try:
+                times, res = time_search(
+                    graphs[layout], 0, N - 1, repeats=REPEATS, mode=mode
+                )
+            except Exception as e:  # keep the sweep alive, but record it
+                failed[label] = f"{type(e).__name__}: {e}"[:300]
+                print(f"config {label} failed: {e}", file=sys.stderr)
+                continue
+            if res.hops != oracle.hops:
+                failed[label] = (
+                    f"hops {res.hops} != oracle {oracle.hops} (CORRECTNESS)"
+                )
+                print(f"CORRECTNESS FAILURE ({label}): {failed[label]}", file=sys.stderr)
+                continue
+            if not validate_path(csr, res.path, 0, N - 1, hops=res.hops):
+                failed[label] = "path failed CSR edge validation (CORRECTNESS)"
+                print(f"CORRECTNESS FAILURE ({label}): {failed[label]}", file=sys.stderr)
+                continue
+            results[label] = (float(np.median(times)), float(np.min(times)), res)
+
+        if not results:
+            emit(
+                None,
+                {**detail, "failed_configs": failed},
+                error="no config produced a correct result",
             )
-            continue
-        results[label] = (float(np.median(times)), float(np.min(times)), res)
+            return 1
+        best_label = min(results, key=lambda k: results[k][0])
+        wall, best_s, res = results[best_label]
 
-    if not results:
-        print("no config produced a correct result", file=sys.stderr)
-        return 1
-    best_label = min(results, key=lambda k: results[k][0])
-    wall, best_s, res = results[best_label]
-
-    print(
-        json.dumps(
-            {
-                "metric": "bibfs_100k_search_wall_clock",
-                "value": wall,
-                "unit": "s",
-                "vs_baseline": BASELINE_V1_100K_S / wall,
-                "detail": {
-                    "graph": f"G({N}, {AVG_DEG:.1f}/n) seed={seed}",
-                    "config": best_label,
-                    "hops": res.hops,
-                    "levels": res.levels,
-                    "teps": res.edges_scanned / wall if wall > 0 else None,
-                    "baseline": "v1 serial 100k = 0.000115546 s (benchmark_results.csv:5)",
-                    "best_s": best_s,
-                    "sweep_medians_us": {
-                        k: round(v[0] * 1e6, 1) for k, v in results.items()
-                    },
-                    "setup_s": round(time.time() - t_setup, 1),
-                },
-            }
+        # HBM roofline accounting for the winning config: the pull path
+        # streams the whole ELL neighbor table (n_pad*width int32) plus
+        # ~13 B/vertex of state (dist/par r+w, frontier bits) per side-
+        # expansion. % of chip peak is the MFU-style number that justifies
+        # (or refutes) replacing XLA gathers with a Pallas kernel.
+        mode, layout = best_label.split("/")
+        g = graphs[layout]
+        tier_bytes = sum(
+            tnbr.size * 4 for (tnbr, _ids) in g.tiers
         )
-    )
-    return 0
+        bytes_per_level = g.n_pad * g.width * 4 + tier_bytes + g.n_pad * 13
+        total_bytes = res.levels * bytes_per_level
+        gbps = total_bytes / wall / 1e9 if wall > 0 else None
+        # any non-pure-CPU platform string (tpu, axon, "axon,cpu", ...) is
+        # scored against the TPU HBM peak
+        peak = HBM_PEAK_GBPS["cpu" if platform == "cpu" else "tpu"]
+
+        emit(
+            wall,
+            {
+                **detail,
+                "graph": f"G({N}, {AVG_DEG:.1f}/n) seed={seed}",
+                "config": best_label,
+                "hops": res.hops,
+                "levels": res.levels,
+                "teps": res.edges_scanned / wall if wall > 0 else None,
+                "baseline": "v1 serial 100k = 0.000115546 s (benchmark_results.csv:5)",
+                "best_s": best_s,
+                "sweep_medians_us": {
+                    k: round(v[0] * 1e6, 1) for k, v in results.items()
+                },
+                "failed_configs": failed,
+                "hbm_gbps": round(gbps, 2) if gbps else None,
+                "hbm_pct_peak": round(100 * gbps / peak, 1) if gbps else None,
+                "hbm_bytes_per_level": bytes_per_level,
+                "setup_s": round(time.time() - t_setup, 1),
+            },
+        )
+        return 0
+    except Exception as e:  # structured last-resort: the driver gets JSON, not a traceback tail
+        import traceback
+
+        traceback.print_exc()
+        emit(None, detail, error=f"{type(e).__name__}: {e}"[:500])
+        return 1
 
 
 if __name__ == "__main__":

@@ -20,9 +20,13 @@ import dataclasses
 import numpy as np
 
 
-def _mirror_and_dedup(n: int, edges: np.ndarray) -> np.ndarray:
+def canonical_pairs(n: int, edges: np.ndarray) -> np.ndarray:
     """Mirror undirected edges into a directed pair list, drop self-loops
-    and duplicates. Returns an ``(E, 2)`` int64 array sorted by source."""
+    and duplicates. Returns an ``(E, 2)`` int64 array sorted by source.
+
+    The O(M log M) canonicalization pass. Every builder accepts the result
+    via its ``pairs=`` kwarg so callers building several layouts of the same
+    graph (CSR + ELL + tiered, as the bench does) pay it once."""
     edges = np.asarray(edges, dtype=np.int64).reshape(-1, 2)
     if edges.size and (int(edges.min()) < 0 or int(edges.max()) >= n):
         raise ValueError(
@@ -42,20 +46,24 @@ def _mirror_and_dedup(n: int, edges: np.ndarray) -> np.ndarray:
 
 def _rank_within_row(pairs: np.ndarray, deg: np.ndarray, n: int) -> np.ndarray:
     """Per-directed-edge rank within its source row (pairs sorted by source,
-    which :func:`_mirror_and_dedup` guarantees)."""
+    which :func:`canonical_pairs` guarantees)."""
     row_ptr = np.zeros(n + 1, dtype=np.int64)
     np.cumsum(deg, out=row_ptr[1:])
     return np.arange(pairs.shape[0]) - row_ptr[pairs[:, 0]]
 
 
-def build_csr(n: int, edges: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+def build_csr(
+    n: int, edges: np.ndarray | None = None, *, pairs: np.ndarray | None = None
+) -> tuple[np.ndarray, np.ndarray]:
     """Build a symmetric CSR adjacency (row_ptr[n+1], col_ind[2E]).
 
     Mirrors edges for undirectedness like the reference loader
     (graphs/read_graph.py:13-16) and dedups — the reference generator never
-    emits duplicates so dedup is a no-op on its files.
+    emits duplicates so dedup is a no-op on its files. Rows are ascending
+    (``canonical_pairs`` sorts globally), which path validation relies on.
     """
-    pairs = _mirror_and_dedup(n, edges)
+    if pairs is None:
+        pairs = canonical_pairs(n, edges)
     deg = np.bincount(pairs[:, 0], minlength=n)
     row_ptr = np.zeros(n + 1, dtype=np.int64)
     np.cumsum(deg, out=row_ptr[1:])
@@ -89,17 +97,19 @@ class EllGraph:
 
 def build_ell(
     n: int,
-    edges: np.ndarray,
+    edges: np.ndarray | None = None,
     *,
     width_cap: int | None = None,
     pad_multiple: int = 8,
+    pairs: np.ndarray | None = None,
 ) -> EllGraph:
     """Regularize an undirected edge list into ELL form.
 
     ``pad_multiple`` rounds ``n_pad`` up so vertex arrays tile evenly across
     a device mesh (the sharded solver requires ``n_pad % num_devices == 0``).
     """
-    pairs = _mirror_and_dedup(n, edges)
+    if pairs is None:
+        pairs = canonical_pairs(n, edges)
     num_edges = pairs.shape[0] // 2
     deg = np.bincount(pairs[:, 0], minlength=n).astype(np.int64)
     max_deg = int(deg.max()) if deg.size and pairs.size else 0
@@ -224,10 +234,11 @@ def _padded_slots(w0: int, n_pad: int, deg: np.ndarray, max_deg: int) -> int:
 
 def build_tiered(
     n: int,
-    edges: np.ndarray,
+    edges: np.ndarray | None = None,
     *,
     base_width: int | None = None,
     pad_multiple: int = 8,
+    pairs: np.ndarray | None = None,
 ) -> TieredEllGraph:
     """Regularize an undirected edge list into tiered ELL form.
 
@@ -235,7 +246,8 @@ def build_tiered(
     degenerates to a plain single-table ELL with no tiers — identical
     layout and cost to :func:`build_ell`.
     """
-    pairs = _mirror_and_dedup(n, edges)
+    if pairs is None:
+        pairs = canonical_pairs(n, edges)
     num_edges = pairs.shape[0] // 2
     deg = np.bincount(pairs[:, 0], minlength=n).astype(np.int64)
     max_deg = int(deg.max()) if deg.size and pairs.size else 0

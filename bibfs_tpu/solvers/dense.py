@@ -122,15 +122,23 @@ class DeviceGraph:
 
     @classmethod
     def build(
-        cls, n: int, edges: np.ndarray, *, layout: str = "ell", device=None
+        cls,
+        n: int,
+        edges: np.ndarray | None = None,
+        *,
+        layout: str = "ell",
+        device=None,
+        pairs: np.ndarray | None = None,
     ) -> "DeviceGraph":
         """Build + upload in one step. ``layout="ell"`` = single-width table
         (uniform-degree graphs); ``layout="tiered"`` = base table +
-        geometric hub tiers (power-law/RMAT degree distributions)."""
+        geometric hub tiers (power-law/RMAT degree distributions). ``pairs``
+        is the precomputed :func:`~bibfs_tpu.graph.csr.canonical_pairs`
+        result, letting callers canonicalize once across layouts."""
         if layout == "tiered":
-            return cls.from_tiered(build_tiered(n, edges), device=device)
+            return cls.from_tiered(build_tiered(n, edges, pairs=pairs), device=device)
         if layout == "ell":
-            return cls.from_ell(build_ell(n, edges), device=device)
+            return cls.from_ell(build_ell(n, edges, pairs=pairs), device=device)
         raise ValueError(f"unknown layout {layout!r} (expected 'ell' or 'tiered')")
 
 

@@ -1,30 +1,80 @@
-"""Make the documented ``JAX_PLATFORMS`` env contract actually hold.
+"""Platform selection that survives sitecustomize boots.
 
 Some environments boot JAX from ``sitecustomize`` and pin the platform list
 via ``jax.config.update("jax_platforms", ...)`` — which silently overrides
 the ``JAX_PLATFORMS`` environment variable the docs (and the reference-style
-single-machine workflow, SURVEY.md §4.5) tell users to set. Calling
-:func:`apply_platform_env` before the first backend access re-asserts the
-env var so e.g. ``JAX_PLATFORMS=cpu
-XLA_FLAGS=--xla_force_host_platform_device_count=8 bibfs-solve --backend
-sharded --devices 8`` works everywhere.
+single-machine workflow, SURVEY.md §4.5) tell users to set. Worse, the pinned
+backend may be a tunneled accelerator whose init hangs for minutes; a test or
+dry-run that was supposed to use the virtual CPU mesh then stalls on the very
+first ``jax.devices()``.
+
+Two entry points:
+
+- :func:`apply_platform_env` — re-assert the ``JAX_PLATFORMS`` env var over
+  any config pin, whether or not jax is imported yet.
+- :func:`force_cpu` — unconditionally route this process to the host CPU
+  platform with ``n_devices`` virtual devices (the moral equivalent of the
+  reference's ``mpirun -n 4`` single-machine fake cluster,
+  single_machine_bench.sh:9,52). Safe to call before OR after jax import;
+  must be called before the first backend access to take effect.
 """
 
 from __future__ import annotations
 
 import os
+import sys
+
+
+def _set_host_device_count_flag(n_devices: int) -> None:
+    import re
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    token = "--xla_force_host_platform_device_count"
+    if token in flags:
+        # replace any stale count (e.g. =1 left by an earlier smoke run)
+        flags = re.sub(rf"{token}=\d+", f"{token}={n_devices}", flags)
+        os.environ["XLA_FLAGS"] = flags
+    else:
+        os.environ["XLA_FLAGS"] = f"{flags} {token}={n_devices}".strip()
+
+
+def force_cpu(n_devices: int = 1) -> None:
+    """Route this process to ``n_devices`` virtual CPU devices, robustly.
+
+    Works in every boot configuration:
+    - jax not imported yet: env vars alone are honored at import time;
+    - jax imported by a sitecustomize boot that pinned ``jax_platforms``:
+      ``jax.config.update`` re-pins before the first backend init;
+    - jax 0.5+ exposes ``jax_num_cpu_devices``, which (unlike ``XLA_FLAGS``)
+      also applies when the flag env var was already consumed.
+    """
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    _set_host_device_count_flag(n_devices)
+    if "jax" not in sys.modules:
+        return
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        jax.config.update("jax_num_cpu_devices", n_devices)
+    except AttributeError:  # older jax: XLA_FLAGS path covers it
+        pass
+    except RuntimeError:
+        # backends already initialized (jax raises "...should be updated
+        # before backends are initialized") — too late to change the device
+        # count in-process; leave whatever is live rather than crash the
+        # caller. Callers needing a guaranteed fresh mesh must call
+        # force_cpu before any backend access (or use a subprocess).
+        pass
 
 
 def apply_platform_env() -> None:
+    """Make the documented ``JAX_PLATFORMS`` env contract actually hold."""
     plat = os.environ.get("JAX_PLATFORMS")
     if not plat:
         return
-    import sys
-
-    # Only act when something (the sitecustomize boot) already imported jax
-    # and may have pinned the config; otherwise the env var will be honored
-    # at import time naturally, and serial/native-only runs stay jax-free.
     if "jax" not in sys.modules:
+        # Honored naturally at import time; nothing pinned yet.
         return
     import jax
 

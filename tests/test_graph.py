@@ -132,11 +132,11 @@ def _tiered_edge_list(g):
 def test_tiered_ell_stores_every_edge(seed):
     """Tiered ELL must hold exactly the mirrored+deduped directed edge set,
     split across base and hub tiers without loss or duplication."""
-    from bibfs_tpu.graph.csr import _mirror_and_dedup, build_tiered
+    from bibfs_tpu.graph.csr import canonical_pairs, build_tiered
 
     n, edges = rmat_graph(7, edge_factor=6, seed=seed)
     g = build_tiered(n, edges)
-    want = {(int(u), int(v)) for u, v in _mirror_and_dedup(n, edges)}
+    want = {(int(u), int(v)) for u, v in canonical_pairs(n, edges)}
     got = _tiered_edge_list(g)
     assert len(got) == len(want)  # no edge stored twice across tiers
     assert set(got) == want
