@@ -233,7 +233,10 @@ def main():
         import traceback
 
         traceback.print_exc()
-        emit(None, detail, error=f"{type(e).__name__}: {e}"[:500])
+        try:
+            emit(None, detail, error=f"{type(e).__name__}: {e}"[:500])
+        except Exception:  # e.g. stdout already closed (BrokenPipeError)
+            pass
         return 1
 
 

@@ -31,7 +31,8 @@ from bibfs_tpu.graph.io import ground_truth_path, read_graph_bin, read_ground_tr
 
 
 def _run_backend(
-    backend: str, n, edges, src, dst, repeats: int, num_devices=None, mode="sync"
+    backend: str, n, edges, src, dst, repeats: int, num_devices=None,
+    mode="sync", layout="ell",
 ):
     """Returns (median_time_s, result) via the shared timing protocol
     (graph build + warm-up excluded, zero-D2H repeat loop; see
@@ -40,7 +41,7 @@ def _run_backend(
 
     _times, res = time_backend(
         backend, n, edges, src, dst,
-        repeats=repeats, num_devices=num_devices, mode=mode,
+        repeats=repeats, num_devices=num_devices, mode=mode, layout=layout,
     )
     return res.time_s, res
 
@@ -62,6 +63,38 @@ def available_backends() -> list[str]:
     return out
 
 
+def _batch_row(gpath, label, n, edges, pairs_file, repeats, mode, layout):
+    """One amortized-throughput row: all (src, dst) pairs solved as ONE
+    vmapped device program (dense backend), validated per pair against the
+    serial oracle. time_sec is the PER-QUERY amortized wall-clock."""
+    from bibfs_tpu.solvers.dense import DeviceGraph, time_batch_graph
+    from bibfs_tpu.solvers.serial import solve_serial
+
+    pairs = np.loadtxt(pairs_file, dtype=np.int64, ndmin=2)
+    if pairs.shape[1] != 2:
+        raise ValueError(f"{pairs_file} must have two columns (src dst)")
+    g = DeviceGraph.build(n, edges, layout=layout)
+    times, results = time_batch_graph(g, pairs, repeats=repeats, mode=mode)
+    batch_s = float(np.median(times))
+    ok = True
+    hops_total = 0
+    edges_scanned = 0
+    for (src, dst), res in zip(pairs, results):
+        want = solve_serial(n, edges, int(src), int(dst))
+        ok = ok and (res.found == want.found) and (res.hops == want.hops)
+        hops_total += res.hops or 0
+        edges_scanned += res.edges_scanned
+    per_query = batch_s / max(len(results), 1)
+    return dict(
+        version=f"dense-batch{len(results)}",
+        graph=label,
+        time_sec=per_query,
+        teps=edges_scanned / batch_s if batch_s > 0 else 0.0,
+        hops=hops_total,
+        ok=ok,
+    )
+
+
 def run_bench(
     graphs: list[str],
     backends: list[str],
@@ -71,6 +104,8 @@ def run_bench(
     table_path: str = "benchmark_table.txt",
     num_devices=None,
     mode: str = "sync",
+    layout: str = "ell",
+    pairs_file: str | None = None,
 ) -> list[dict]:
     rows = []
     for gpath in graphs:
@@ -87,7 +122,8 @@ def run_bench(
             t0 = time.time()
             try:
                 secs, res = _run_backend(
-                    backend, n, edges, src, dst, repeats, num_devices, mode
+                    backend, n, edges, src, dst, repeats, num_devices,
+                    mode, layout,
                 )
             except Exception as e:  # keep the sweep alive, record the failure
                 print(f"  {backend} on {label}: FAILED ({e})", file=sys.stderr)
@@ -113,6 +149,23 @@ def run_bench(
                 f"{'OK' if ok else 'MISMATCH vs gt=' + str(expected)} "
                 f"(total {time.time() - t0:.1f}s)"
             )
+        if pairs_file is not None and "dense" in backends:
+            try:
+                row = _batch_row(
+                    gpath, label, n, edges, pairs_file, repeats, mode, layout
+                )
+                rows.append(row)
+                print(
+                    f"  {row['version']:8s} {label:6s} {row['time_sec']:.6e}"
+                    f"s/query  teps={row['teps']:.3e} "
+                    f"{'OK' if row['ok'] else 'MISMATCH vs oracle'}"
+                )
+            except Exception as e:
+                print(f"  batch on {label}: FAILED ({e})", file=sys.stderr)
+                rows.append(
+                    dict(version="dense-batch", graph=label, time_sec=None,
+                         teps=None, hops=None, ok=False)
+                )
     _write_csv(rows, csv_path)
     _write_table(rows, table_path)
     return rows
@@ -169,10 +222,26 @@ def main(argv=None):
     ap.add_argument(
         "--mode",
         default="sync",
-        choices=["sync", "alt"],
+        choices=["sync", "alt", "beamer", "beamer_alt", "pallas", "pallas_alt"],
         help="device-kernel schedule: sync = both sides per round (fewest "
         "rounds), alt = smaller-frontier-first alternation (fewest edge "
-        "scans)",
+        "scans); beamer variants add push/pull direction optimization; "
+        "pallas variants use the fused Pallas pull kernel (dense backend, "
+        "ell layout only)",
+    )
+    ap.add_argument(
+        "--layout",
+        default="ell",
+        choices=["ell", "tiered"],
+        help="adjacency layout for the device backends (see bibfs-solve)",
+    )
+    ap.add_argument(
+        "--pairs",
+        default=None,
+        metavar="FILE",
+        help='also bench batched multi-query throughput: file of "src dst" '
+        "lines solved as one vmapped device program (dense backend), "
+        "reported as a per-query amortized row",
     )
     ap.add_argument("--csv", default="benchmark_results.csv")
     ap.add_argument("--table", default="benchmark_table.txt")
@@ -183,6 +252,15 @@ def main(argv=None):
     backends = (
         args.backends.split(",") if args.backends else available_backends()
     )
+    if args.mode.startswith("pallas") and any(
+        b not in ("dense", "serial", "native") for b in backends
+    ):
+        ap.error("--mode pallas/pallas_alt requires --backends dense (the "
+                 "sharded backend has no pallas path)")
+    if args.layout == "tiered" and args.mode.startswith("pallas"):
+        ap.error("pallas modes support --layout ell only")
+    if args.pairs is not None and "dense" not in backends:
+        ap.error("--pairs requires the dense backend in --backends")
     rows = run_bench(
         args.graphs,
         backends,
@@ -191,6 +269,8 @@ def main(argv=None):
         table_path=args.table,
         num_devices=args.devices,
         mode=args.mode,
+        layout=args.layout,
+        pairs_file=args.pairs,
     )
     return 0 if all(r["ok"] for r in rows) else 1
 

@@ -58,12 +58,14 @@ def main(argv=None):
     ap.add_argument(
         "--mode",
         default="sync",
-        choices=["sync", "alt", "beamer", "beamer_alt"],
+        choices=["sync", "alt", "beamer", "beamer_alt", "pallas", "pallas_alt"],
         help="device-kernel schedule for dense/sharded backends: sync = "
         "both sides per round, alt = smaller-frontier-first alternation; "
         "beamer/beamer_alt add push/pull direction optimization (sparse "
         "frontiers go through a scatter push path instead of the full-table "
-        "pull gather)",
+        "pull gather); pallas/pallas_alt run the pull level as the fused "
+        "Pallas TPU kernel (dense backend, ell layout only; interpreted "
+        "off-TPU)",
     )
     ap.add_argument(
         "--layout",
@@ -89,9 +91,14 @@ def main(argv=None):
 
     if args.layout == "tiered" and args.backend not in ("dense", "sharded"):
         ap.error("--layout tiered is only supported by the dense/sharded backends")
+    if args.mode.startswith("pallas") and args.backend != "dense":
+        ap.error("--mode pallas/pallas_alt is only supported by --backend dense")
     if args.pairs is not None:
         if args.backend != "dense":
             ap.error("--pairs batch mode is only supported by --backend dense")
+        if args.devices is not None:
+            ap.error("--devices has no effect in --pairs batch mode (dense "
+                     "backend is single-device)")
         if args.src is not None or args.dst is not None:
             ap.error("--pairs replaces the positional src/dst arguments")
     elif args.src is None or args.dst is None:

@@ -49,55 +49,28 @@ def expand_pull(
     return next_f, parent
 
 
-def expand_push(
-    fidx: jnp.ndarray,  # int32[K] compact frontier, -1 = dead slot
-    par: jnp.ndarray,  # int32[n_pad] parent array (-1 = none)
-    dist: jnp.ndarray,  # int32[n_pad] distance array (>= inf = unvisited)
-    nbr: jnp.ndarray,  # int32[n_pad, width] ELL neighbor table
-    deg: jnp.ndarray,  # int32[n_pad]
-    lvl_next: jnp.ndarray,  # int32 scalar: level being discovered
-    *,
-    inf: int,
-) -> tuple[jnp.ndarray, ...]:
-    """One BFS level, *push*-style over a compact frontier index list — the
+def _push_claim(fc, rows, valid, scanned, par, dist, deg, lvl_next, *, inf):
+    """Shared push claim/dedup/compact phase over candidate edges — the
     top-down half of Beamer direction optimization (new-build scope per
     SURVEY.md §2 strategy 6; the reference only ever chooses which SIDE to
-    expand, v1/main-v1.cpp:51, never how).
-
-    Cost scales with ``K * width`` (scatter/gather of the frontier's edges
-    only) instead of :func:`expand_pull`'s ``n_pad * width`` full-table read
-    — the win for the many early BFS levels whose frontiers are tiny, and
-    the only viable regime for multi-million-vertex graphs where the full
-    ELL table is hundreds of MB per level.
+    expand, v1/main-v1.cpp:51, never how). Cost scales with ``K * width``
+    (the frontier's candidate edges only) instead of
+    :func:`expand_pull`'s ``n_pad * width`` full-table read.
 
     The CUDA version's ``atomicExch`` visited-claim (v3/bibfs_cuda_only.cu:36)
     becomes a deterministic scatter-max parent claim: every discovering edge
     scatters its source id, the max source wins, and the winning occurrence
     is identified by a read-back compare (no atomics, no nondeterminism).
 
+    ``fc``: int32[K] source vertex per row (dead slots arbitrary as long as
+    ``valid`` is False there); ``rows``: int32[K, W] candidate target ids;
+    ``valid``: bool[K, W] true where the slot is a real edge.
+
     Returns ``(next_frontier bool[n_pad], next_fidx int32[K], cnt int32,
     par int32[n_pad], dist int32[n_pad], scanned int32, max_deg int32)``
     where ``max_deg`` is the maximum degree in the new frontier (Beamer
     span routing). ``next_fidx`` is complete only when ``cnt <= K`` —
     callers must route the next level to the pull path otherwise.
-    """
-    live = fidx >= 0
-    fc = jnp.where(live, fidx, 0)
-    rows = nbr[fc]  # [K, width] row gather
-    vd = jnp.where(live, deg[fc], 0)
-    width = nbr.shape[1]
-    valid = jnp.arange(width, dtype=jnp.int32)[None, :] < vd[:, None]
-    return _push_claim(fc, rows, valid, jnp.sum(vd), par, dist, deg, lvl_next, inf=inf)
-
-
-def _push_claim(fc, rows, valid, scanned, par, dist, deg, lvl_next, *, inf):
-    """Shared push claim/dedup/compact phase over candidate edges.
-
-    ``fc``: int32[K] source vertex per row (dead slots arbitrary as long as
-    ``valid`` is False there); ``rows``: int32[K, W] candidate target ids;
-    ``valid``: bool[K, W] true where the slot is a real edge. Returns the
-    same tuple as :func:`expand_push` plus a trailing ``max_deg`` of the
-    newly discovered frontier (used by tiered Beamer routing).
     """
     k = fc.shape[0]
     n_pad = par.shape[0]

@@ -31,6 +31,7 @@ import numpy as np
 
 from bibfs_tpu.graph.csr import EllGraph, build_ell, build_tiered
 from bibfs_tpu.ops.expand import (
+    expand_pull_dual_tiered,
     expand_pull_tiered,
     expand_push_tiered,
     frontier_count,
@@ -217,6 +218,17 @@ def _cond(st):
     )
 
 
+def _full_tiers(aux, tier_meta) -> tuple:
+    """Zip the static tier metadata with the device tier arrays into the
+    ``(start, count, tier_nbr, hub_ids)`` tuples the expansion ops take —
+    the ONE place the (meta, aux) pairing is interpreted."""
+    tiers = aux[1] if aux else ()
+    return tuple(
+        (start, count, tnbr, tids)
+        for (start, count, _w), (tnbr, tids) in zip(tier_meta, tiers)
+    )
+
+
 # a frontier whose max degree exceeds this stays on the pull path even
 # when small: the push candidate width is static (base + allowed tiers),
 # so hub tiers past this span never enter the push gather
@@ -240,18 +252,19 @@ def push_span(width: int, tier_meta) -> tuple[int, int]:
     return span, ncovered
 
 
-def _side_step(st, side: str, nbr, deg, aux, tier_meta, *, push_cap: int):
+def _side_step(
+    st, side: str, nbr, deg, aux, tier_meta, *, push_cap: int,
+    use_pallas: bool = False,
+):
     """Advance one side one level. ``push_cap > 0`` enables Beamer direction
     optimization: frontiers at most ``push_cap`` wide (and whose max degree
     fits the static push span) go through the sparse push path, larger ones
     through the dense pull path. ``push_cap == 0`` is pull-only (the
-    v3-style dense schedule)."""
+    v3-style dense schedule). ``use_pallas`` routes the pull level through
+    the fused Pallas kernel (plain ELL only)."""
     k = st[f"fi_{side}"].shape[0]
-    hub_rank, tiers = aux if aux else (None, ())
-    full_tiers = tuple(
-        (start, count, tnbr, tids)
-        for (start, count, _w), (tnbr, tids) in zip(tier_meta, tiers)
-    )
+    hub_rank = aux[0] if aux else None
+    full_tiers = _full_tiers(aux, tier_meta)
     span, ncov = push_span(nbr.shape[1], tier_meta)
     push_tiers = full_tiers[:ncov]
     carry = (
@@ -266,9 +279,16 @@ def _side_step(st, side: str, nbr, deg, aux, tier_meta, *, push_cap: int):
     def pull(c):
         fr, fi, _ok, par, dist, lvl = c
         scanned = frontier_degree_sum(fr, deg)
-        nf, par, dist, md = expand_pull_tiered(
-            fr, par, dist, nbr, deg, full_tiers, lvl + 1, inf=INF32
-        )
+        if use_pallas:
+            from bibfs_tpu.ops.pallas_expand import pallas_pull_level
+
+            nf, par, dist, md = pallas_pull_level(
+                fr, par, dist, nbr, deg, lvl + 1, inf=INF32
+            )
+        else:
+            nf, par, dist, md = expand_pull_tiered(
+                fr, par, dist, nbr, deg, full_tiers, lvl + 1, inf=INF32
+            )
         # the compact index list is now stale; push recomputes it on entry
         return (
             nf, fi, jnp.bool_(False), par, dist, lvl + 1,
@@ -307,20 +327,31 @@ def _side_step(st, side: str, nbr, deg, aux, tier_meta, *, push_cap: int):
     }
 
 
-# mode -> (schedule, hybrid expansion?). Schedules: "sync" expands BOTH
-# sides every round (the v2/v3 schedule, second_try.cpp:68-105 /
-# bibfs_cuda_only.cu:173-193 — half the sequential rounds, best when
+# mode -> (schedule, hybrid expansion?, pallas pull?). Schedules: "sync"
+# expands BOTH sides every round (the v2/v3 schedule, second_try.cpp:68-105
+# / bibfs_cuda_only.cu:173-193 — half the sequential rounds, best when
 # latency-bound); "alt" expands the smaller frontier only
 # (v1/main-v1.cpp:51, v4 mpi_bas.cpp:90-92 — fewest edge scans). "beamer"
 # variants add push/pull direction optimization per expansion (Beamer-style
 # top-down/bottom-up switching — BASELINE.json config scope, never in the
-# reference).
+# reference). "pallas" variants run the pull level as the fused Pallas
+# kernel (ops/pallas_expand.py — the v3 expand_frontier analog the north
+# star names); plain-ELL layout only, interpret-mode off-TPU.
 DENSE_MODES = {
-    "sync": ("sync", False),
-    "alt": ("alt", False),
-    "beamer": ("sync", True),
-    "beamer_alt": ("alt", True),
+    "sync": ("sync", False, False),
+    "alt": ("alt", False, False),
+    "beamer": ("sync", True, False),
+    "beamer_alt": ("alt", True, False),
+    "pallas": ("sync", False, True),
+    "pallas_alt": ("alt", False, True),
 }
+
+
+def kernel_cap(mode: str, n_pad: int) -> int:
+    """The push-cap cache key for (mode, graph): the auto cap for hybrid
+    (Beamer) modes, 0 for pull-only modes — so sync/alt/pallas share one
+    compiled kernel per shape instead of one per distinct auto cap."""
+    return _auto_push_cap(n_pad) if DENSE_MODES[mode][1] else 0
 
 
 def _build_kernel(mode: str, push_cap: int, tier_meta: tuple = ()):
@@ -331,7 +362,12 @@ def _build_kernel(mode: str, push_cap: int, tier_meta: tuple = ()):
     search is one ``lax.while_loop`` in one XLA program — state never
     leaves HBM and the host syncs exactly once at the end (versus per-level
     host round-trips, quirk Q5)."""
-    schedule, hybrid = DENSE_MODES[mode]
+    schedule, hybrid, use_pallas = DENSE_MODES[mode]
+    if use_pallas and tier_meta:
+        raise ValueError(
+            "pallas modes support the plain ELL layout only (the fused "
+            "kernel has no hub-tier path yet); use layout='ell'"
+        )
     cap = push_cap if hybrid else 0
     k = max(cap, 1)
 
@@ -340,9 +376,43 @@ def _build_kernel(mode: str, push_cap: int, tier_meta: tuple = ()):
         init = _init_state(n_pad, k, src, dst, deg)
 
         def step(st, side):
-            return _side_step(st, side, nbr, deg, aux, tier_meta, push_cap=cap)
+            return _side_step(
+                st, side, nbr, deg, aux, tier_meta,
+                push_cap=cap, use_pallas=use_pallas,
+            )
 
-        if schedule == "sync":
+        if schedule == "sync" and not hybrid and not use_pallas:
+            # pull-only lock-step: fuse both sides' expansions so every
+            # neighbor table (base + hub tiers) is gathered ONCE per round
+            # for both searches — half the HBM traffic of two sequential
+            # pulls, the dominant cost of a pull round
+            full_tiers = _full_tiers(aux, tier_meta)
+
+            def body(st):
+                scanned = frontier_degree_sum(
+                    st["fr_s"], deg
+                ) + frontier_degree_sum(st["fr_t"], deg)
+                nf_s, par_s, dist_s, md_s, nf_t, par_t, dist_t, md_t = (
+                    expand_pull_dual_tiered(
+                        st["fr_s"], st["fr_t"],
+                        st["par_s"], st["dist_s"], st["par_t"], st["dist_t"],
+                        nbr, deg, full_tiers,
+                        st["lvl_s"] + 1, st["lvl_t"] + 1, inf=INF32,
+                    )
+                )
+                st = {
+                    **st,
+                    "fr_s": nf_s, "par_s": par_s, "dist_s": dist_s,
+                    "md_s": md_s, "cnt_s": frontier_count(nf_s),
+                    "lvl_s": st["lvl_s"] + 1, "ok_s": jnp.bool_(False),
+                    "fr_t": nf_t, "par_t": par_t, "dist_t": dist_t,
+                    "md_t": md_t, "cnt_t": frontier_count(nf_t),
+                    "lvl_t": st["lvl_t"] + 1, "ok_t": jnp.bool_(False),
+                    "edges": st["edges"] + scanned,
+                }
+                return _meet_vote(st, 2)
+
+        elif schedule == "sync":
 
             def body(st):
                 return _meet_vote(step(step(st, "s"), "t"), 2)
@@ -363,9 +433,29 @@ def _build_kernel(mode: str, push_cap: int, tier_meta: tuple = ()):
     return kernel
 
 
+def _resolve_pallas_mode(mode: str) -> str:
+    """Fall back to the XLA pull path when the compiled Pallas kernel is
+    unavailable on this backend (Mosaic vector-gather support varies by
+    jaxlib). Off-TPU the kernel runs interpreted and is always available."""
+    if not DENSE_MODES[mode][2] or jax.default_backend() != "tpu":
+        return mode
+    from bibfs_tpu.ops.pallas_expand import pallas_available
+
+    if pallas_available():
+        return mode
+    import sys
+
+    print(
+        f"warning: Pallas pull kernel does not compile on this backend; "
+        f"mode {mode!r} falling back to the XLA pull path",
+        file=sys.stderr,
+    )
+    return {"pallas": "sync", "pallas_alt": "alt"}[mode]
+
+
 @lru_cache(maxsize=None)
 def _get_kernel(mode: str, push_cap: int, tier_meta: tuple = ()):
-    return jax.jit(_build_kernel(mode, push_cap, tier_meta))
+    return jax.jit(_build_kernel(_resolve_pallas_mode(mode), push_cap, tier_meta))
 
 
 @lru_cache(maxsize=None)
@@ -377,7 +467,7 @@ def _get_batch_kernel(mode: str, push_cap: int, tier_meta: tuple = ()):
     launch per query, benchmark_test.sh:44-59)."""
     return jax.jit(
         jax.vmap(
-            _build_kernel(mode, push_cap, tier_meta),
+            _build_kernel(_resolve_pallas_mode(mode), push_cap, tier_meta),
             in_axes=(None, None, None, 0, 0),
         )
     )
@@ -403,7 +493,7 @@ def solve_dense_graph(
     hot loop, SURVEY.md §5 tracing)."""
     if not (0 <= src < g.n and 0 <= dst < g.n):
         raise ValueError(f"src/dst out of range for n={g.n}")
-    kern = _get_kernel(mode, _auto_push_cap(g.n_pad), g.tier_meta)
+    kern = _get_kernel(mode, kernel_cap(mode, g.n_pad), g.tier_meta)
     src_a = _device_scalar(src)
     dst_a = _device_scalar(dst)
     t0 = time.perf_counter()
@@ -431,7 +521,7 @@ def time_search(
     result)`` with ``result.time_s`` = median."""
     from bibfs_tpu.solvers.timing import timed_repeats
 
-    kern = _get_kernel(mode, _auto_push_cap(g.n_pad), g.tier_meta)
+    kern = _get_kernel(mode, kernel_cap(mode, g.n_pad), g.tier_meta)
     src_a = _device_scalar(src)
     dst_a = _device_scalar(dst)
     return timed_repeats(
@@ -445,7 +535,7 @@ def _batch_dispatch(g: DeviceGraph, pairs, mode: str):
     pairs = np.asarray(pairs, dtype=np.int64).reshape(-1, 2)
     if pairs.size and not ((0 <= pairs).all() and (pairs < g.n).all()):
         raise ValueError(f"src/dst out of range for n={g.n}")
-    kern = _get_batch_kernel(mode, _auto_push_cap(g.n_pad), g.tier_meta)
+    kern = _get_batch_kernel(mode, kernel_cap(mode, g.n_pad), g.tier_meta)
     srcs = jnp.asarray(pairs[:, 0], dtype=jnp.int32)
     dsts = jnp.asarray(pairs[:, 1], dtype=jnp.int32)
     return pairs, lambda: jax.block_until_ready(

@@ -49,9 +49,9 @@ from bibfs_tpu.parallel.mesh import VERTEX_AXIS, make_1d_mesh, shard_spec
 from bibfs_tpu.solvers.api import BFSResult, register
 from bibfs_tpu.solvers.dense import (
     INF32,
-    _auto_push_cap,
     _device_scalar,
     _materialize,
+    kernel_cap,
     push_span,
 )
 
@@ -335,6 +335,11 @@ def _bibfs_shard_body(
 def _compiled_sharded(
     mesh, axis: str, mode: str = "sync", push_cap: int = 0, tier_meta: tuple = ()
 ):
+    if SHARDED_MODES[mode][2]:
+        raise ValueError(
+            "pallas modes are single-chip (dense backend) only; the sharded "
+            "pull path is plain XLA under shard_map"
+        )
     hybrid = SHARDED_MODES[mode][1]
     cap = push_cap if hybrid else 0
     sh = P(axis)
@@ -438,7 +443,7 @@ def solve_sharded_graph(
     if not (0 <= src < g.n and 0 <= dst < g.n):
         raise ValueError(f"src/dst out of range for n={g.n}")
     fn = _compiled_sharded(
-        g.mesh, VERTEX_AXIS, mode, _auto_push_cap(g.n_pad), g.tier_meta
+        g.mesh, VERTEX_AXIS, mode, kernel_cap(mode, g.n_pad), g.tier_meta
     )
     src_a = _device_scalar(src)
     dst_a = _device_scalar(dst)
@@ -456,7 +461,7 @@ def time_search(
     from bibfs_tpu.solvers.timing import timed_repeats
 
     fn = _compiled_sharded(
-        g.mesh, VERTEX_AXIS, mode, _auto_push_cap(g.n_pad), g.tier_meta
+        g.mesh, VERTEX_AXIS, mode, kernel_cap(mode, g.n_pad), g.tier_meta
     )
     src_a = _device_scalar(src)
     dst_a = _device_scalar(dst)
