@@ -8,8 +8,15 @@ Complete mapping (reference collective census in SURVEY.md §2):
 | ``Allreduce LOR`` votes      | ``or_allreduce`` on a scalar bool       |
 | ``Allreduce SUM`` popcounts  | ``sum_allreduce``                       |
 | ``Allreduce MIN`` best dist  | ``global_min_and_argmin`` (pmin)        |
-| ``Allgather(v)`` frontiers   | ``jax.lax.all_gather(..., tiled=True)`` |
+| ``Allgather(v)`` frontiers   | ``all_gather_bits`` (packed uint32)     |
 | ``Bcast`` graph replication  | none — the graph is 1D-sharded at load  |
+
+``all_gather_bits`` is the direct analog of v2's bitset exchange
+(second_try.cpp:53-62: frontiers as ``uint64_t`` words, 64 vertices/word,
+merged with ``Allreduce BOR``): the per-level frontier crossing the ICI is
+packed 32 vertices to a ``uint32`` word, so the wire payload is n/8 bytes
+instead of the n bool bytes a plain ``all_gather`` would ship — 8× less
+traffic on the one exchange whose size scales with the graph.
 
 All helpers are usable inside ``shard_map`` bodies (including under
 ``lax.while_loop``/``lax.cond``).
@@ -37,6 +44,65 @@ def sum_allreduce(x: jnp.ndarray, axis: str) -> jnp.ndarray:
 def max_allreduce(x: jnp.ndarray, axis: str) -> jnp.ndarray:
     """Max across the mesh axis (MPI_Allreduce MAX, main-v2.cpp:70-71)."""
     return jax.lax.pmax(x, axis)
+
+
+PACK_W = 32  # vertices per packed word (v2 used 64/word, second_try.cpp:53)
+
+
+def pack_bits(fr: jnp.ndarray) -> jnp.ndarray:
+    """Pack ``bool[m]`` into little-endian ``uint32[ceil(m/32)]`` words.
+
+    Pure elementwise/reshape ops — fuses into the surrounding level kernel;
+    the only thing it changes is the payload that crosses the ICI.
+    """
+    m = fr.shape[0]
+    nw = -(-m // PACK_W)
+    b = jnp.pad(fr, (0, nw * PACK_W - m)).reshape(nw, PACK_W)
+    weights = jnp.left_shift(
+        jnp.uint32(1), jnp.arange(PACK_W, dtype=jnp.uint32)
+    )[None, :]
+    return jnp.sum(
+        jnp.where(b, weights, jnp.uint32(0)), axis=1, dtype=jnp.uint32
+    )
+
+
+def unpack_bits(words: jnp.ndarray, m: int) -> jnp.ndarray:
+    """Inverse of :func:`pack_bits`: ``uint32[nw] -> bool[m]``."""
+    bits = jnp.bitwise_and(
+        jnp.right_shift(
+            words[:, None], jnp.arange(PACK_W, dtype=jnp.uint32)[None, :]
+        ),
+        jnp.uint32(1),
+    )
+    return bits.reshape(-1)[:m].astype(jnp.bool_)
+
+
+def all_gather_bits(fr: jnp.ndarray, axis: str) -> jnp.ndarray:
+    """Bitpacked boolean all_gather: each shard packs its ``bool[n_loc]``
+    into uint32 words, ONE tiled ``all_gather`` ships the words (n/8 bytes
+    on the wire vs n for bools), and every device unpacks the global
+    frontier locally. Per-shard pad-to-word gaps are preserved and stripped
+    shard-by-shard, so ``n_loc`` need not divide the word size.
+    """
+    n_loc = fr.shape[0]
+    nw = -(-n_loc // PACK_W)
+    words = jax.lax.all_gather(pack_bits(fr), axis, tiled=True)  # [ndev*nw]
+    ndev = words.shape[0] // nw
+    bits = jnp.bitwise_and(
+        jnp.right_shift(
+            words.reshape(ndev, nw, 1),
+            jnp.arange(PACK_W, dtype=jnp.uint32)[None, None, :],
+        ),
+        jnp.uint32(1),
+    )
+    return bits.reshape(ndev, nw * PACK_W)[:, :n_loc].reshape(-1).astype(jnp.bool_)
+
+
+def frontier_exchange_bytes(n_loc: int, packed: bool = True) -> int:
+    """Wire bytes per device for one frontier exchange — the measured
+    traffic number the bench detail reports (packed uint32 words vs the
+    round-1 bool payload)."""
+    return (-(-n_loc // PACK_W)) * 4 if packed else n_loc
 
 
 def global_min_and_argmin(

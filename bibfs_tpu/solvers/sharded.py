@@ -13,10 +13,11 @@ Here instead:
   tiers of the tiered layout (power-law graphs) are sharded by hub RANK, so
   high-degree rows parallelize across the mesh too;
 - the only per-level exchange is one ``all_gather`` of the expanding side's
-  boolean frontier over ICI (pull) or just the candidate edge ids (push —
-  ``K*width`` ints, independent of graph size), plus scalar ``psum``/
-  ``pmin`` votes for popcounts, meet, and termination (replacing five
-  MPI_Allreduce per level, SURVEY.md §3.2);
+  BITPACKED frontier over ICI (pull: uint32 words, 32 vertices/word — n/8
+  wire bytes, the v2 bitset exchange reborn, second_try.cpp:53-62) or just
+  the candidate edge ids (push — ``K*width`` ints, independent of graph
+  size), plus scalar ``psum``/``pmin`` votes for popcounts, meet, and
+  termination (replacing five MPI_Allreduce per level, SURVEY.md §3.2);
 - the whole search is ONE ``lax.while_loop`` inside ONE ``shard_map``-jitted
   program: no host in the loop at all (v2/v4 return to the host every
   level).
@@ -41,6 +42,7 @@ from jax.sharding import PartitionSpec as P
 from bibfs_tpu.graph.csr import EllGraph, TieredEllGraph, build_ell, build_tiered
 from bibfs_tpu.ops.expand import expand_pull, frontier_count, frontier_degree_sum
 from bibfs_tpu.parallel.collectives import (
+    all_gather_bits,
     global_min_and_argmin,
     max_allreduce,
     sum_allreduce,
@@ -139,8 +141,10 @@ def _bibfs_shard_body(
     def pull(c):
         fr, fi, _ok, par, dist, lvl = c
         scanned = sum_allreduce(frontier_degree_sum(fr, deg), axis)
-        # THE per-level exchange: one boolean frontier all_gather (ICI)
-        f_glob = jax.lax.all_gather(fr, axis, tiled=True)
+        # THE per-level exchange: one BITPACKED frontier all_gather (ICI) —
+        # uint32 words, 32 vertices each, n/8 wire bytes instead of n bool
+        # bytes (the v2 bitset exchange, second_try.cpp:53-62,82-85)
+        f_glob = all_gather_bits(fr, axis)
         visited = dist < INF32
         nf0, pcand = expand_pull(f_glob, visited, nbr, deg)
         par = jnp.where(nf0, pcand, par)
