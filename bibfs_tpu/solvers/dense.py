@@ -146,8 +146,29 @@ class DeviceGraph:
 def _auto_push_cap(n_pad: int) -> int:
     """Frontier size below which push beats pull. Push costs ~K*width
     scattered elements (element-at-a-time scatter/gather), pull costs
-    ~n_pad*width*4 bytes of sequential HBM reads — on v5e the crossover is
-    around K ≈ n_pad / 200; round to a power of two, clamp to a sane band."""
+    ~n_pad*width*4 bytes of sequential HBM reads.
+
+    When ``calibration.json`` has an entry for this platform (produced by
+    ``python bench.py --calibrate``, bibfs_tpu/utils/calibrate.py), the
+    crossover is the MEASURED one: K = n_pad / push_cap_divisor rounded
+    DOWN to a power of two (never exceeding what was measured faster), and
+    a measured verdict of "push never beats pull" (push_cap 0) is honored
+    as pull-only. Otherwise fall back to the uncalibrated default divisor
+    256 (≈ the v5e-class crossover), rounded to a power of two and
+    clamped."""
+    from bibfs_tpu.utils.calibrate import load_calibration
+
+    cal = load_calibration() or {}
+    if "push_cap" in cal:
+        if not cal["push_cap"]:
+            return 0  # measured: pull wins at every tested K
+        divisor = cal.get("push_cap_divisor")
+        if isinstance(divisor, int) and divisor > 0:
+            scaled = n_pad // divisor
+            cap = 1 << max(7, scaled.bit_length() - 1)
+            return int(min(4096, cap, max(128, n_pad)))
+        # malformed entry (hand-edited/truncated): fall through to the
+        # uncalibrated heuristic rather than crashing every solve
     cap = 1 << max(7, (n_pad // 256).bit_length())
     return int(min(2048, cap, max(128, n_pad)))
 
@@ -433,6 +454,7 @@ def _build_kernel(mode: str, push_cap: int, tier_meta: tuple = ()):
     return kernel
 
 
+@lru_cache(maxsize=None)
 def _resolve_pallas_mode(mode: str) -> str:
     """Fall back to the XLA pull path when the compiled Pallas kernel is
     unavailable on this backend (Mosaic vector-gather support varies by
@@ -453,13 +475,27 @@ def _resolve_pallas_mode(mode: str) -> str:
     return {"pallas": "sync", "pallas_alt": "alt"}[mode]
 
 
-@lru_cache(maxsize=None)
 def _get_kernel(mode: str, push_cap: int, tier_meta: tuple = ()):
-    return jax.jit(_build_kernel(_resolve_pallas_mode(mode), push_cap, tier_meta))
+    # resolve the pallas fallback BEFORE the cache key so a fallen-back
+    # 'pallas' shares the already-compiled 'sync' kernel instead of paying
+    # a redundant XLA compile of an identical program
+    return _get_kernel_resolved(_resolve_pallas_mode(mode), push_cap, tier_meta)
 
 
 @lru_cache(maxsize=None)
+def _get_kernel_resolved(mode: str, push_cap: int, tier_meta: tuple = ()):
+    return jax.jit(_build_kernel(mode, push_cap, tier_meta))
+
+
 def _get_batch_kernel(mode: str, push_cap: int, tier_meta: tuple = ()):
+    # same pre-cache pallas resolution as _get_kernel
+    return _get_batch_kernel_resolved(
+        _resolve_pallas_mode(mode), push_cap, tier_meta
+    )
+
+
+@lru_cache(maxsize=None)
+def _get_batch_kernel_resolved(mode: str, push_cap: int, tier_meta: tuple = ()):
     """vmap of the full search over (src, dst) pairs: B independent
     bidirectional searches advance lock-step inside ONE compiled while_loop
     (finished searches freeze via select until the last one stops) — the
@@ -467,7 +503,7 @@ def _get_batch_kernel(mode: str, push_cap: int, tier_meta: tuple = ()):
     launch per query, benchmark_test.sh:44-59)."""
     return jax.jit(
         jax.vmap(
-            _build_kernel(_resolve_pallas_mode(mode), push_cap, tier_meta),
+            _build_kernel(mode, push_cap, tier_meta),
             in_axes=(None, None, None, 0, 0),
         )
     )
@@ -531,6 +567,34 @@ def time_search(
     )
 
 
+def time_search_only(
+    g: DeviceGraph, src: int, dst: int, *, repeats: int = 30, mode: str = "sync"
+) -> list[float]:
+    """Dispatch-only timing: warm up, then time ``repeats`` blocked solves
+    WITHOUT ever reading a result value back.
+
+    Exists because of a measured tunneled-runtime failure mode, worse than
+    the per-call stall :mod:`bibfs_tpu.solvers.timing` documents: the FIRST
+    device->host value read (even one scalar) permanently switches the
+    process into a slow dispatch mode — the same compiled kernel measured
+    at ~50us/solve before any read times at ~170ms/solve forever after,
+    with no recovery (30s idle tested). Multi-config harnesses must
+    therefore run ALL timing loops first (this function) and materialize/
+    validate afterwards (:func:`solve_dense_graph`) — see bench.py.
+    """
+    from bibfs_tpu.solvers.timing import timed_repeats
+
+    kern = _get_kernel(mode, kernel_cap(mode, g.n_pad), g.tier_meta)
+    src_a = _device_scalar(src)
+    dst_a = _device_scalar(dst)
+    times, _ = timed_repeats(
+        lambda: jax.block_until_ready(kern(g.nbr, g.deg, g.aux, src_a, dst_a)),
+        None,
+        repeats,
+    )
+    return times
+
+
 def _batch_dispatch(g: DeviceGraph, pairs, mode: str):
     pairs = np.asarray(pairs, dtype=np.int64).reshape(-1, 2)
     if pairs.size and not ((0 <= pairs).all() and (pairs < g.n).all()):
@@ -583,6 +647,19 @@ def time_batch_graph(
         out = dispatch()
         times.append(time.perf_counter() - t0)
     return times, _materialize_batch(out, pairs.shape[0], float(np.median(times)))
+
+
+def time_batch_only(
+    g: DeviceGraph, pairs, *, repeats: int = 10, mode: str = "sync"
+) -> list[float]:
+    """Dispatch-only batch timing (no value readbacks — see
+    :func:`time_search_only` for why multi-config harnesses need this).
+    Returns per-repeat wall times for solving ALL pairs in one vmapped
+    device program."""
+    from bibfs_tpu.solvers.timing import timed_repeats
+
+    _pairs, dispatch = _batch_dispatch(g, pairs, mode)
+    return timed_repeats(dispatch, None, repeats)[0]
 
 
 def solve_dense(

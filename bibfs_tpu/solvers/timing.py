@@ -26,11 +26,14 @@ from bibfs_tpu.solvers.api import BFSResult
 
 def timed_repeats(
     dispatch: Callable[[], object],
-    materialize: Callable[[], BFSResult],
+    materialize: Callable[[], BFSResult] | None,
     repeats: int,
-) -> tuple[list[float], BFSResult]:
+) -> tuple[list[float], BFSResult | None]:
     """Warm up, time ``repeats`` calls of ``dispatch`` (which must not read
-    device results back), then call ``materialize`` once.
+    device results back), then call ``materialize`` once (skipped when
+    None — callers that sweep several configs must defer ALL value
+    readbacks past ALL timing loops; see ``time_search_only``'s account of
+    the permanent post-readback dispatch degradation on tunneled runtimes).
 
     Returns ``(times_s, result)`` with ``result.time_s`` = median of times.
     """
@@ -42,6 +45,8 @@ def timed_repeats(
         t0 = time.perf_counter()
         dispatch()
         times.append(time.perf_counter() - t0)
+    if materialize is None:
+        return times, None
     result = materialize()
     return times, dataclasses.replace(result, time_s=float(np.median(times)))
 

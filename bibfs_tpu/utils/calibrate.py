@@ -1,0 +1,198 @@
+"""Hardware calibration — turns the solver's tuning constants into
+committed, reproducible measurements.
+
+Round 1 shipped three "measured" claims as comments (push/pull crossover
+K ~ n_pad/200, ~100ms fresh-arg dispatch stall, gather throughput); this
+module measures them on the machine it runs on and persists the result to
+``calibration.json``, keyed by device platform. The dense solver's
+``_auto_push_cap`` (bibfs_tpu/solvers/dense.py) reads the calibrated
+crossover when present, so the Beamer push/pull routing threshold is a
+per-hardware fact, not a guess.
+
+Run via ``python bench.py --calibrate`` (writes ``calibration.json`` at the
+repo root) or programmatically with :func:`run_calibration`.
+
+What is measured (all medians over repeats, jit-compiled, blocked):
+
+- ``dispatch_cached_us`` / ``dispatch_fresh_us``: one jitted no-op level
+  with a cache-reused vs freshly created device scalar argument — the
+  tunneled-TPU dispatch stall behind ``_device_scalar``'s cache.
+- ``pull_level_us``: one full pull level over the n=100k ELL table
+  (``expand_pull``), plus the implied gather throughput in elements/us.
+- ``push_level_us``: one push claim phase at each candidate cap K —
+  cost scales with K*width, independent of n.
+- ``push_cap``: the largest measured K whose push level is still cheaper
+  than the pull level — the Beamer crossover. ``push_cap_divisor`` =
+  n_pad // push_cap generalizes it to other graph sizes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from functools import lru_cache
+
+import numpy as np
+
+CAL_ENV = "BIBFS_CALIBRATION"
+CAL_FILENAME = "calibration.json"
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def _median_us(fn, repeats: int) -> float:
+    import jax
+
+    jax.block_until_ready(fn())  # compile / warm
+    times = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn())
+        times.append(time.perf_counter() - t0)
+    return float(np.median(times) * 1e6)
+
+
+def run_calibration(
+    n: int = 100_000, avg_deg: float = 2.2, seed: int = 1, repeats: int = 30
+) -> dict:
+    """Measure the tuning constants on the current default backend and
+    return the calibration entry (see module docstring for fields)."""
+    import jax
+    import jax.numpy as jnp
+
+    from bibfs_tpu.graph.csr import build_ell
+    from bibfs_tpu.graph.generate import gnp_random_graph
+    from bibfs_tpu.ops.expand import _push_claim, expand_pull
+    from bibfs_tpu.solvers.dense import INF32
+
+    platform = jax.devices()[0].platform
+    edges = gnp_random_graph(n, avg_deg / n, seed=seed)
+    g = build_ell(n, edges, pad_multiple=8)
+    nbr = jax.device_put(g.nbr)
+    deg = jax.device_put(g.deg)
+    width = g.width
+
+    # --- dispatch stall: cached vs fresh device-scalar argument ---------
+    noop = jax.jit(lambda d, s: d[s] + 1)
+    cached = jnp.int32(3)
+    jax.block_until_ready(noop(deg, cached))
+    dispatch_cached_us = _median_us(lambda: noop(deg, cached), repeats)
+    # a FRESH eager scalar per call is exactly what _device_scalar avoids
+    dispatch_fresh_us = _median_us(
+        lambda: noop(deg, jnp.int32(int(np.random.default_rng(0).integers(4)))),
+        repeats,
+    )
+
+    # --- one pull level over the full ELL table -------------------------
+    rng = np.random.default_rng(seed)
+    frontier = jax.device_put(rng.random(g.n_pad) < 0.02)
+    visited = jax.device_put(rng.random(g.n_pad) < 0.1)
+    pull = jax.jit(expand_pull)
+    pull_level_us = _median_us(lambda: pull(frontier, visited, nbr, deg), repeats)
+    gather_elems_per_us = g.n_pad * width / pull_level_us
+
+    # --- push claim phase at each candidate cap K -----------------------
+    dist0 = jax.device_put(
+        np.where(rng.random(g.n_pad) < 0.1, 1, INF32).astype(np.int32)
+    )
+    par0 = jax.device_put(np.full(g.n_pad, -1, dtype=np.int32))
+    lvl = jnp.int32(2)
+
+    def push_at(k):
+        fidx = jax.device_put(
+            rng.choice(g.n_pad, size=k, replace=False).astype(np.int32)
+        )
+
+        @jax.jit
+        def one(fidx, par, dist):
+            rows = nbr[fidx]
+            valid = (
+                jnp.arange(width, dtype=jnp.int32)[None, :]
+                < deg[fidx][:, None]
+            )
+            return _push_claim(
+                fidx, rows, valid, jnp.int32(0), par, dist, deg, lvl, inf=INF32
+            )
+
+        return _median_us(lambda: one(fidx, par0, dist0), repeats)
+
+    push_level_us = {}
+    push_cap = 0
+    for k in (128, 256, 512, 1024, 2048, 4096):
+        if k > g.n_pad:
+            break
+        push_level_us[str(k)] = round(push_at(k), 1)
+        if push_level_us[str(k)] < pull_level_us:
+            push_cap = k
+
+    entry = {
+        "n_pad": g.n_pad,
+        "width": width,
+        "repeats": repeats,
+        "dispatch_cached_us": round(dispatch_cached_us, 1),
+        "dispatch_fresh_us": round(dispatch_fresh_us, 1),
+        "pull_level_us": round(pull_level_us, 1),
+        "gather_elems_per_us": round(gather_elems_per_us, 1),
+        "push_level_us": push_level_us,
+        "push_cap": push_cap,
+        "push_cap_divisor": (g.n_pad // push_cap) if push_cap else None,
+    }
+    return {"platform": platform, "entry": entry}
+
+
+def write_calibration(path: str | None = None, **kwargs) -> dict:
+    """Run and merge into ``calibration.json`` (platform-keyed)."""
+    path = path or os.path.join(_REPO_ROOT, CAL_FILENAME)
+    result = run_calibration(**kwargs)
+    data = {}
+    if os.path.exists(path):
+        with open(path) as f:
+            data = json.load(f)
+    data[result["platform"]] = result["entry"]
+    with open(path, "w") as f:
+        json.dump(data, f, indent=2, sort_keys=True)
+        f.write("\n")
+    _read_calibration_file.cache_clear()
+    return data
+
+
+@lru_cache(maxsize=None)
+def _read_calibration_file() -> dict:
+    path = os.environ.get(CAL_ENV)
+    candidates = [path] if path else [
+        os.path.join(os.getcwd(), CAL_FILENAME),
+        os.path.join(_REPO_ROOT, CAL_FILENAME),
+    ]
+    for cand in candidates:
+        if cand and os.path.exists(cand):
+            try:
+                with open(cand) as f:
+                    return json.load(f)
+            except (OSError, json.JSONDecodeError):
+                continue
+    return {}
+
+
+def load_calibration() -> dict | None:
+    """The calibration entry for the CURRENT default backend's platform, or
+    None when absent — callers fall back to their uncalibrated heuristics.
+    Never initializes a backend on its own: returns None if jax has not
+    been imported yet (calibration only matters once a solver is running,
+    by which point the backend exists). Uncached on purpose: the file read
+    behind it is cached, and the platform lookup must track whichever
+    backend the caller ended up on."""
+    import sys
+
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return None
+    data = _read_calibration_file()
+    if not data:
+        return None
+    try:
+        platform = jax.devices()[0].platform
+    except RuntimeError:
+        return None
+    return data.get(platform)

@@ -1,0 +1,346 @@
+"""Scale demonstration: RMAT graphs at >=1M nodes, end to end.
+
+The reference never ran beyond 100k nodes — its own limitation note names
+the 10M-node graph as the thing that would vindicate parallelism
+(/root/reference/README.md:19, full-graph replication on every rank,
+SURVEY.md quirk Q6). This script produces the committed evidence that this
+framework operates in that regime:
+
+  python scripts/run_scale.py --scales 20          # 1M vertices
+  python scripts/run_scale.py --scales 20 23       # + 8.4M vertices
+
+Per scale it generates a Graph500-style RMAT graph (fixed seed), finds a
+deep reachable (src, dst) pair with a host BFS, solves with the serial
+oracle, then times:
+
+- ``dense``/tiered on the ambient platform (the real TPU chip when run
+  under the tunneled backend, else host CPU) — single-device HBM residency;
+- ``sharded``/tiered on an 8-device virtual CPU mesh in a subprocess
+  (the fake-cluster methodology of the reference's single_machine_bench.sh)
+  — proves the 1D vertex-partitioned multi-chip program compiles and agrees
+  at this size; its wall-clock is an emulation artifact, not a TPU number.
+
+Rows append to SCALE_RESULTS.csv: wall-clock (median of repeats, search
+only), TEPS, hop parity vs the oracle, and peak host RSS.
+"""
+
+from __future__ import annotations
+
+import argparse
+import csv
+import json
+import os
+import resource
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+CSV_PATH = os.path.join(REPO, "SCALE_RESULTS.csv")
+FIELDS = [
+    "config",
+    "scale",
+    "n",
+    "m",
+    "platform",
+    "time_sec",
+    "teps",
+    "hops",
+    "levels",
+    "ok",
+    "peak_rss_mb",
+]
+
+
+def peak_rss_mb() -> float:
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
+
+
+def farthest_reachable(n: int, row_ptr, col_ind, src: int) -> tuple[int, int]:
+    """Host BFS from src; returns (vertex at max distance, that distance).
+    RMAT graphs leave many vertices isolated, so dst must be picked from
+    the giant component rather than the reference's n-1 convention."""
+    dist = np.full(n, -1, dtype=np.int64)
+    dist[src] = 0
+    frontier = np.array([src], dtype=np.int64)
+    d = 0
+    while frontier.size:
+        starts = row_ptr[frontier]
+        ends = row_ptr[frontier + 1]
+        counts = ends - starts
+        idx = np.repeat(starts, counts) + (
+            np.arange(counts.sum()) - np.repeat(np.cumsum(counts) - counts, counts)
+        )
+        nxt = np.unique(col_ind[idx])
+        nxt = nxt[dist[nxt] == -1]
+        d += 1
+        dist[nxt] = d
+        frontier = nxt
+    far = int(np.argmax(dist))
+    return far, int(dist[far])
+
+
+DENSE_SUB = """
+import json, resource, sys
+import numpy as np
+sys.path.insert(0, {repo!r})
+from bibfs_tpu.utils.platform import apply_platform_env
+apply_platform_env()
+import jax
+from bibfs_tpu.graph.io import read_graph_bin
+from bibfs_tpu.solvers.dense import DeviceGraph, solve_dense_graph, time_search_only
+n, edges = read_graph_bin({bin_path!r})
+g = DeviceGraph.build(n, edges, layout="tiered")
+# timing FIRST, materialize after: the first value readback permanently
+# degrades tunneled-runtime dispatch (see dense.time_search_only) — and a
+# fresh subprocess per scale keeps one scale's readbacks off the next's clock
+times = time_search_only(g, {src}, {dst}, repeats={repeats}, mode="sync")
+res = solve_dense_graph(g, {src}, {dst}, mode="sync")
+print(json.dumps(dict(
+    time_sec=float(np.median(times)), hops=res.hops, levels=res.levels,
+    edges_scanned=res.edges_scanned, platform=jax.devices()[0].platform,
+    peak_rss_mb=resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0,
+)))
+"""
+
+
+def bench_dense(bin_path, src, dst, repeats, timeout):
+    code = DENSE_SUB.format(
+        repo=REPO, bin_path=bin_path, src=src, dst=dst, repeats=repeats
+    )
+    r = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+    )
+    if r.returncode != 0:
+        raise RuntimeError(f"dense subprocess failed: {r.stderr[-500:]}")
+    return json.loads(r.stdout.splitlines()[-1])
+
+
+SHARDED_SUB = """
+import json, resource, sys
+import numpy as np
+sys.path.insert(0, {repo!r})
+from bibfs_tpu.utils.platform import force_cpu
+force_cpu(8)
+from bibfs_tpu.graph.io import read_graph_bin
+from bibfs_tpu.parallel.mesh import make_1d_mesh
+from bibfs_tpu.solvers.sharded import ShardedGraph, time_search
+n, edges = read_graph_bin({bin_path!r})
+g = ShardedGraph.build(n, edges, make_1d_mesh(8), layout="tiered")
+times, res = time_search(g, {src}, {dst}, repeats={repeats}, mode="sync")
+print(json.dumps(dict(
+    time_sec=float(np.median(times)), hops=res.hops, levels=res.levels,
+    edges_scanned=res.edges_scanned,
+    peak_rss_mb=resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0,
+)))
+"""
+
+
+def run_scale(
+    scale: int,
+    repeats: int,
+    out_rows: list,
+    *,
+    dense_timeout: int,
+    sharded_timeout: int,
+):
+    from bibfs_tpu.graph.csr import build_csr
+    from bibfs_tpu.graph.generate import rmat_graph
+    from bibfs_tpu.graph.io import write_graph_bin
+    from bibfs_tpu.solvers.serial import solve_serial_csr
+
+    t0 = time.time()
+    n, edges = rmat_graph(scale, seed=7)
+    row_ptr, col_ind = build_csr(n, edges)
+    src = int(np.argmax(np.diff(row_ptr)))  # top hub: always in the giant comp.
+    dst, depth = farthest_reachable(n, row_ptr, col_ind, src)
+    oracle = solve_serial_csr(n, row_ptr, col_ind, src, dst)
+    assert oracle.found and oracle.hops == depth
+    print(
+        f"scale {scale}: n={n} m={len(edges)} src={src} dst={dst} "
+        f"hops={oracle.hops} (gen+oracle {time.time() - t0:.0f}s)",
+        flush=True,
+    )
+    out_rows.append(
+        dict(
+            config="serial-oracle",
+            scale=scale,
+            n=n,
+            m=len(edges),
+            platform="host",
+            time_sec=oracle.time_s,
+            teps=oracle.edges_scanned / oracle.time_s if oracle.time_s else None,
+            hops=oracle.hops,
+            levels=oracle.levels,
+            ok=True,
+            peak_rss_mb=round(peak_rss_mb(), 1),
+        )
+    )
+
+    bin_path = f"/tmp/rmat{scale}.bin"
+    write_graph_bin(bin_path, n, edges)
+
+    try:
+        info = bench_dense(bin_path, src, dst, repeats, dense_timeout)
+        t_dense = info["time_sec"]
+        ok = info["hops"] == oracle.hops
+        out_rows.append(
+            dict(
+                config="dense/tiered",
+                scale=scale,
+                n=n,
+                m=len(edges),
+                platform=info["platform"],
+                time_sec=t_dense,
+                teps=info["edges_scanned"] / t_dense if t_dense else None,
+                hops=info["hops"],
+                levels=info["levels"],
+                ok=ok,
+                peak_rss_mb=round(info["peak_rss_mb"], 1),
+            )
+        )
+        print(
+            f"  dense/tiered [{info['platform']}]: {t_dense:.4f}s "
+            f"teps={out_rows[-1]['teps']:.3e} {'OK' if ok else 'MISMATCH'}",
+            flush=True,
+        )
+    except (
+        subprocess.TimeoutExpired,
+        RuntimeError,
+        json.JSONDecodeError,
+        IndexError,
+    ) as e:
+        print(f"  dense/tiered FAILED: {e}", file=sys.stderr, flush=True)
+        out_rows.append(
+            dict(
+                config="dense/tiered",
+                scale=scale,
+                n=n,
+                m=len(edges),
+                platform="?",
+                time_sec=None,
+                teps=None,
+                hops=None,
+                levels=None,
+                ok=False,
+                peak_rss_mb=None,
+            )
+        )
+
+    code = SHARDED_SUB.format(
+        repo=REPO, bin_path=bin_path, src=src, dst=dst, repeats=max(2, repeats // 2)
+    )
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True,
+            text=True,
+            timeout=sharded_timeout,
+        )
+        if r.returncode != 0:
+            raise RuntimeError(f"sharded subprocess failed: {r.stderr[-500:]}")
+        info = json.loads(r.stdout.splitlines()[-1])
+        ok = info["hops"] == oracle.hops
+        out_rows.append(
+            dict(
+                config="sharded8/tiered",
+                scale=scale,
+                n=n,
+                m=len(edges),
+                platform="cpu-mesh-emulated",
+                time_sec=info["time_sec"],
+                teps=info["edges_scanned"] / info["time_sec"],
+                hops=info["hops"],
+                levels=info["levels"],
+                ok=ok,
+                peak_rss_mb=round(info["peak_rss_mb"], 1),
+            )
+        )
+        print(
+            f"  sharded8/tiered [cpu-emulated]: {info['time_sec']:.4f}s "
+            f"{'OK' if ok else 'MISMATCH'}",
+            flush=True,
+        )
+    except (
+        subprocess.TimeoutExpired,
+        RuntimeError,
+        json.JSONDecodeError,
+        IndexError,
+    ) as e:
+        print(f"  sharded8/tiered FAILED: {e}", file=sys.stderr, flush=True)
+        out_rows.append(
+            dict(
+                config="sharded8/tiered",
+                scale=scale,
+                n=n,
+                m=len(edges),
+                platform="cpu-mesh-emulated",
+                time_sec=None,
+                teps=None,
+                hops=None,
+                levels=None,
+                ok=False,
+                peak_rss_mb=None,
+            )
+        )
+    finally:
+        os.unlink(bin_path)
+
+
+def _append_rows(rows: list[dict]) -> None:
+    exists = os.path.exists(CSV_PATH)
+    with open(CSV_PATH, "a", newline="") as f:
+        w = csv.DictWriter(f, fieldnames=FIELDS)
+        if not exists:
+            w.writeheader()
+        w.writerows(rows)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--scales", type=int, nargs="+", default=[20])
+    ap.add_argument("--repeats", type=int, default=5)
+    ap.add_argument(
+        "--dense-timeout", type=int, default=1800,
+        help="seconds allowed for the single-device (TPU) run per scale",
+    )
+    ap.add_argument(
+        "--sharded-timeout", type=int, default=1800,
+        help="seconds allowed for the 8-device CPU-mesh emulation per scale",
+    )
+    args = ap.parse_args(argv)
+
+    from bibfs_tpu.utils.platform import apply_platform_env
+
+    apply_platform_env()
+    all_ok = True
+    total = 0
+    for scale in args.scales:
+        # rows flush to the CSV after EVERY scale: a later scale's OOM or
+        # crash must not discard completed hours of measurement
+        rows: list[dict] = []
+        try:
+            run_scale(
+                scale,
+                args.repeats,
+                rows,
+                dense_timeout=args.dense_timeout,
+                sharded_timeout=args.sharded_timeout,
+            )
+        finally:
+            _append_rows(rows)
+            total += len(rows)
+        all_ok = all_ok and all(r["ok"] for r in rows)
+    print(f"appended {total} rows to {CSV_PATH}")
+    return 0 if all_ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,106 @@
+"""Direct unit tests for the expansion kernels in bibfs_tpu.ops.expand —
+in particular the lock-step dual path (one packed gather serving both
+sides), asserted slot-for-slot against two independent single-side pulls.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bibfs_tpu.graph.csr import build_ell
+from bibfs_tpu.graph.generate import gnp_random_graph
+from bibfs_tpu.ops.expand import expand_pull, expand_pull_dual, pack_dual
+
+
+def _random_state(n, seed, p_frontier=0.15, p_visited=0.3):
+    rng = np.random.default_rng(seed)
+    fr = rng.random(n) < p_frontier
+    # a frontier vertex is by definition visited
+    vis = fr | (rng.random(n) < p_visited)
+    return jnp.asarray(fr), jnp.asarray(vis)
+
+
+def test_pack_dual_bit_layout():
+    fs = jnp.asarray([True, False, True, False])
+    ft = jnp.asarray([True, True, False, False])
+    packed = pack_dual(fs, ft)
+    assert packed.dtype == jnp.uint8
+    np.testing.assert_array_equal(np.asarray(packed), [3, 2, 1, 0])
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3, 4])
+def test_dual_pull_matches_two_single_pulls(seed):
+    n = 300
+    edges = gnp_random_graph(n, 4.0 / n, seed=seed)
+    g = build_ell(n, edges, pad_multiple=8)
+    nbr = jnp.asarray(g.nbr)
+    deg = jnp.asarray(g.deg)
+    fr_s, vis_s = _random_state(g.n_pad, seed * 2 + 1)
+    fr_t, vis_t = _random_state(g.n_pad, seed * 2 + 2)
+
+    nf_s1, par_s1 = expand_pull(fr_s, vis_s, nbr, deg)
+    nf_t1, par_t1 = expand_pull(fr_t, vis_t, nbr, deg)
+    nf_s2, par_s2, nf_t2, par_t2 = expand_pull_dual(
+        pack_dual(fr_s, fr_t), vis_s, vis_t, nbr, deg
+    )
+
+    np.testing.assert_array_equal(np.asarray(nf_s1), np.asarray(nf_s2))
+    np.testing.assert_array_equal(np.asarray(nf_t1), np.asarray(nf_t2))
+    # parent choice must be IDENTICAL (first frontier neighbor in slot
+    # order), not merely a valid parent — determinism is part of the
+    # contract (SURVEY.md: replaces CUDA first-atomic-wins nondeterminism)
+    s_new = np.asarray(nf_s1)
+    t_new = np.asarray(nf_t1)
+    np.testing.assert_array_equal(
+        np.asarray(par_s1)[s_new], np.asarray(par_s2)[s_new]
+    )
+    np.testing.assert_array_equal(
+        np.asarray(par_t1)[t_new], np.asarray(par_t2)[t_new]
+    )
+
+
+def test_dual_pull_empty_frontiers():
+    n = 64
+    edges = gnp_random_graph(n, 3.0 / n, seed=9)
+    g = build_ell(n, edges, pad_multiple=8)
+    z = jnp.zeros(g.n_pad, jnp.bool_)
+    nf_s, _, nf_t, _ = expand_pull_dual(
+        pack_dual(z, z), z, z, jnp.asarray(g.nbr), jnp.asarray(g.deg)
+    )
+    assert not bool(jnp.any(nf_s)) and not bool(jnp.any(nf_t))
+
+
+def test_auto_push_cap_calibration(tmp_path, monkeypatch):
+    """The calibrated Beamer crossover must be honored: rounded DOWN (never
+    past the measured faster K) and a measured push-never-wins verdict (cap
+    0) must yield pull-only, not the uncalibrated heuristic."""
+    import json
+
+    import jax
+
+    from bibfs_tpu.solvers.dense import _auto_push_cap
+    from bibfs_tpu.utils import calibrate
+
+    plat = jax.devices()[0].platform
+    path = tmp_path / "calibration.json"
+    try:
+        path.write_text(
+            json.dumps({plat: {"push_cap": 1024, "push_cap_divisor": 97}})
+        )
+        monkeypatch.setenv(calibrate.CAL_ENV, str(path))
+        calibrate._read_calibration_file.cache_clear()
+        # 100000 // 97 = 1030; round DOWN to 1024 (round-up would route
+        # frontiers of 1025..2048 through a push path measured slower)
+        assert _auto_push_cap(100_000) == 1024
+
+        path.write_text(
+            json.dumps({plat: {"push_cap": 0, "push_cap_divisor": None}})
+        )
+        calibrate._read_calibration_file.cache_clear()
+        assert _auto_push_cap(100_000) == 0
+
+        monkeypatch.setenv(calibrate.CAL_ENV, str(tmp_path / "absent.json"))
+        calibrate._read_calibration_file.cache_clear()
+        assert _auto_push_cap(100_000) == 512  # uncalibrated heuristic
+    finally:
+        calibrate._read_calibration_file.cache_clear()
