@@ -141,12 +141,16 @@ def pallas_available() -> bool:
     current default backend (Mosaic gather support varies by version).
     Interpret mode always works, so this only gates the compiled path."""
     try:
+        import numpy as np
+
         n, w = 16, 2
         nbr = jnp.zeros((n, w), jnp.int32)
         deg = jnp.zeros(n, jnp.int32)
         fr = jnp.zeros(n, jnp.bool_)
         nf, _ = expand_pull_pallas(fr, fr, nbr, deg)
-        jax.block_until_ready(nf)
+        # read a VALUE, not just block: lazy runtimes defer execution (and
+        # its errors) until a readback — see solvers/timing.py
+        np.asarray(nf).ravel()[0]
         return True
     except Exception:
         return False

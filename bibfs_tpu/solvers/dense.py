@@ -529,11 +529,14 @@ def solve_dense_graph(
     hot loop, SURVEY.md §5 tracing)."""
     if not (0 <= src < g.n and 0 <= dst < g.n):
         raise ValueError(f"src/dst out of range for n={g.n}")
+    from bibfs_tpu.solvers.timing import force_scalar
+
     kern = _get_kernel(mode, kernel_cap(mode, g.n_pad), g.tier_meta)
     src_a = _device_scalar(src)
     dst_a = _device_scalar(dst)
     t0 = time.perf_counter()
-    out = jax.block_until_ready(kern(g.nbr, g.deg, g.aux, src_a, dst_a))
+    out = kern(g.nbr, g.deg, g.aux, src_a, dst_a)
+    force_scalar(out)  # execution is lazy until a value read; see timing.py
     elapsed = time.perf_counter() - t0
     return _materialize(out, elapsed)
 
@@ -552,47 +555,38 @@ def _materialize(out, elapsed: float) -> BFSResult:
 def time_search(
     g: DeviceGraph, src: int, dst: int, *, repeats: int = 30, mode: str = "sync"
 ) -> tuple[list[float], BFSResult]:
-    """Zero-D2H timing loop + one materializing solve (protocol and
-    rationale in :mod:`bibfs_tpu.solvers.timing`). Returns ``(times_s,
-    result)`` with ``result.time_s`` = median."""
-    from bibfs_tpu.solvers.timing import timed_repeats
-
-    kern = _get_kernel(mode, kernel_cap(mode, g.n_pad), g.tier_meta)
-    src_a = _device_scalar(src)
-    dst_a = _device_scalar(dst)
-    return timed_repeats(
-        lambda: jax.block_until_ready(kern(g.nbr, g.deg, g.aux, src_a, dst_a)),
-        lambda: solve_dense_graph(g, src, dst, mode=mode),
-        repeats,
-    )
+    """Forced-execution timing loop + one materializing solve (protocol and
+    the tunneled-runtime laziness rationale in
+    :mod:`bibfs_tpu.solvers.timing`). Returns ``(times_s, result)`` with
+    ``result.time_s`` = median."""
+    return _timed(g, src, dst, repeats, mode,
+                  lambda: solve_dense_graph(g, src, dst, mode=mode))
 
 
 def time_search_only(
     g: DeviceGraph, src: int, dst: int, *, repeats: int = 30, mode: str = "sync"
 ) -> list[float]:
-    """Dispatch-only timing: warm up, then time ``repeats`` blocked solves
-    WITHOUT ever reading a result value back.
+    """:func:`time_search` without the final result materialization —
+    per-repeat execution is still FORCED via a one-scalar read (see
+    :mod:`bibfs_tpu.solvers.timing`: on the tunneled backend,
+    ``block_until_ready`` does not actually wait, so un-forced loops
+    measure enqueue rate, not solves)."""
+    times, _ = _timed(g, src, dst, repeats, mode, None)
+    return times
 
-    Exists because of a measured tunneled-runtime failure mode, worse than
-    the per-call stall :mod:`bibfs_tpu.solvers.timing` documents: the FIRST
-    device->host value read (even one scalar) permanently switches the
-    process into a slow dispatch mode — the same compiled kernel measured
-    at ~50us/solve before any read times at ~170ms/solve forever after,
-    with no recovery (30s idle tested). Multi-config harnesses must
-    therefore run ALL timing loops first (this function) and materialize/
-    validate afterwards (:func:`solve_dense_graph`) — see bench.py.
-    """
-    from bibfs_tpu.solvers.timing import timed_repeats
+
+def _timed(g, src, dst, repeats, mode, materialize):
+    from bibfs_tpu.solvers.timing import force_scalar, timed_repeats
 
     kern = _get_kernel(mode, kernel_cap(mode, g.n_pad), g.tier_meta)
     src_a = _device_scalar(src)
     dst_a = _device_scalar(dst)
-    times, _ = timed_repeats(
-        lambda: jax.block_until_ready(kern(g.nbr, g.deg, g.aux, src_a, dst_a)),
-        None,
+    return timed_repeats(
+        lambda: kern(g.nbr, g.deg, g.aux, src_a, dst_a),
+        materialize,
         repeats,
+        force=force_scalar,
     )
-    return times
 
 
 def _batch_dispatch(g: DeviceGraph, pairs, mode: str):
@@ -624,9 +618,12 @@ def solve_batch_graph(
     :class:`BFSResult` per pair; each result's ``time_s`` is the WHOLE
     batch wall-clock (divide by ``len(pairs)`` for per-query throughput).
     """
+    from bibfs_tpu.solvers.timing import force_scalar
+
     pairs, dispatch = _batch_dispatch(g, pairs, mode)
     t0 = time.perf_counter()
     out = dispatch()
+    force_scalar(out)  # execution is lazy until a value read; see timing.py
     elapsed = time.perf_counter() - t0
     return _materialize_batch(out, pairs.shape[0], elapsed)
 
@@ -635,16 +632,23 @@ def time_batch_graph(
     g: DeviceGraph, pairs, *, repeats: int = 5, mode: str = "sync"
 ) -> tuple[list[float], list[BFSResult]]:
     """Batch solve under the shared timing protocol (warm-up excluded,
-    zero-D2H repeat loop, median stamped into every result's ``time_s``;
-    see :mod:`bibfs_tpu.solvers.timing`)."""
+    forced execution per repeat, median stamped into every result's
+    ``time_s``; see :mod:`bibfs_tpu.solvers.timing`). The loop is inlined
+    (not :func:`timed_repeats`) so the LAST timed output is materialized
+    directly — an extra whole-batch solve just to fetch a result would
+    cost real seconds through the tunnel."""
+    from bibfs_tpu.solvers.timing import force_scalar
+
     if repeats < 1:
         raise ValueError(f"repeats must be >= 1, got {repeats}")
     pairs, dispatch = _batch_dispatch(g, pairs, mode)
-    out = dispatch()  # warm-up: JIT compile excluded
+    out = dispatch()  # warm-up: compile excluded, lazy runtime flipped
+    force_scalar(out)
     times = []
     for _ in range(repeats):
         t0 = time.perf_counter()
         out = dispatch()
+        force_scalar(out)
         times.append(time.perf_counter() - t0)
     return times, _materialize_batch(out, pairs.shape[0], float(np.median(times)))
 
@@ -652,14 +656,13 @@ def time_batch_graph(
 def time_batch_only(
     g: DeviceGraph, pairs, *, repeats: int = 10, mode: str = "sync"
 ) -> list[float]:
-    """Dispatch-only batch timing (no value readbacks — see
-    :func:`time_search_only` for why multi-config harnesses need this).
+    """Forced-execution batch timing without result materialization.
     Returns per-repeat wall times for solving ALL pairs in one vmapped
     device program."""
-    from bibfs_tpu.solvers.timing import timed_repeats
+    from bibfs_tpu.solvers.timing import force_scalar, timed_repeats
 
     _pairs, dispatch = _batch_dispatch(g, pairs, mode)
-    return timed_repeats(dispatch, None, repeats)[0]
+    return timed_repeats(dispatch, None, repeats, force=force_scalar)[0]
 
 
 def solve_dense(

@@ -449,10 +449,13 @@ def solve_sharded_graph(
     fn = _compiled_sharded(
         g.mesh, VERTEX_AXIS, mode, kernel_cap(mode, g.n_pad), g.tier_meta
     )
+    from bibfs_tpu.solvers.timing import force_scalar
+
     src_a = _device_scalar(src)
     dst_a = _device_scalar(dst)
     t0 = time.perf_counter()
-    out = jax.block_until_ready(fn(g.nbr, g.deg, g.aux, src_a, dst_a))
+    out = fn(g.nbr, g.deg, g.aux, src_a, dst_a)
+    force_scalar(out)  # execution is lazy until a value read; see timing.py
     elapsed = time.perf_counter() - t0
     return _materialize(out, elapsed)
 
@@ -460,9 +463,9 @@ def solve_sharded_graph(
 def time_search(
     g: ShardedGraph, src: int, dst: int, *, repeats: int = 30, mode: str = "sync"
 ) -> tuple[list[float], BFSResult]:
-    """Zero-D2H timing loop + one materializing solve (protocol and
-    rationale in :mod:`bibfs_tpu.solvers.timing`)."""
-    from bibfs_tpu.solvers.timing import timed_repeats
+    """Forced-execution timing loop + one materializing solve (protocol
+    and rationale in :mod:`bibfs_tpu.solvers.timing`)."""
+    from bibfs_tpu.solvers.timing import force_scalar, timed_repeats
 
     fn = _compiled_sharded(
         g.mesh, VERTEX_AXIS, mode, kernel_cap(mode, g.n_pad), g.tier_meta
@@ -470,9 +473,10 @@ def time_search(
     src_a = _device_scalar(src)
     dst_a = _device_scalar(dst)
     return timed_repeats(
-        lambda: jax.block_until_ready(fn(g.nbr, g.deg, g.aux, src_a, dst_a)),
+        lambda: fn(g.nbr, g.deg, g.aux, src_a, dst_a),
         lambda: solve_sharded_graph(g, src, dst, mode=mode),
         repeats,
+        force=force_scalar,
     )
 
 

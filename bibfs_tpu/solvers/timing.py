@@ -2,15 +2,30 @@
 
 One rule, applied uniformly: build the graph representation ONCE, warm up
 once (JIT compile / first-touch excluded), then time ``repeats`` searches
-with ZERO device→host traffic between dispatches, and materialize the
-result payload once at the end. A single scalar readback between two
-dispatches stalls tunneled-TPU runtimes by ~200ms (measured), and the
-reference likewise keeps its timed regions free of result readout
-(v1/main-v1.cpp:49-82, v2/second_try.cpp:66-131, v4/mpi_bas.cpp:76-134).
+with execution FORCED inside every timed interval, and materialize the
+full result payload once at the end. The reported statistic is the MEDIAN
+of the repeat times, stamped into the returned result's ``time_s`` so
+every consumer (CLI, sweep harness, root bench.py) agrees on what the
+number means.
 
-The reported statistic is the MEDIAN of the repeat times, stamped into the
-returned result's ``time_s`` so every consumer (CLI, sweep harness, root
-bench.py) agrees on what the number means.
+Why forcing matters — the tunneled-runtime laziness finding (measured
+2026-07-29 on the axon-tunneled v5e): on that backend
+``jax.block_until_ready`` returns WITHOUT waiting for device execution.
+Work queues lazily and only a device->host VALUE read forces it: five
+"blocked" 100k solves enqueued in 0.45 ms, then — after a 10 s sleep that
+real execution would have long finished within — the first scalar read
+took 1.75 s, i.e. the solves only ran when read. Every timing loop that
+trusts ``block_until_ready`` therefore measures the ENQUEUE rate (tens of
+us) instead of the execution time (~170 ms/solve at 100k through the
+tunnel), a ~2500x fiction. The first value read also flips the process
+into a synchronous dispatch mode, so a warm-up read pins all subsequent
+repeats to honest per-solve latency.
+
+The ``force`` callback here reads ONE scalar from the dispatch result (4
+bytes — negligible next to any real search, and the reference's timed
+regions also end by using their result: v1/main-v1.cpp:82-93). Host
+backends (serial/native) pass ``force=None``; their return values are
+already real.
 """
 
 from __future__ import annotations
@@ -24,26 +39,39 @@ import numpy as np
 from bibfs_tpu.solvers.api import BFSResult
 
 
+def force_scalar(out) -> None:
+    """Default ``force`` for device backends: read one element of the first
+    output (the ``best`` distance; element 0 of the batch in vmapped
+    solves), compelling the runtime to actually execute everything queued
+    for it."""
+    np.asarray(out[0]).ravel()[0]
+
+
 def timed_repeats(
     dispatch: Callable[[], object],
     materialize: Callable[[], BFSResult] | None,
     repeats: int,
+    force: Callable[[object], None] | None = None,
 ) -> tuple[list[float], BFSResult | None]:
-    """Warm up, time ``repeats`` calls of ``dispatch`` (which must not read
-    device results back), then call ``materialize`` once (skipped when
-    None — callers that sweep several configs must defer ALL value
-    readbacks past ALL timing loops; see ``time_search_only``'s account of
-    the permanent post-readback dispatch degradation on tunneled runtimes).
+    """Warm up (compile + flip any lazy runtime into its synchronous mode),
+    then time ``repeats`` calls of ``dispatch``, applying ``force`` to each
+    result INSIDE the timed interval so lazily-deferred execution cannot
+    masquerade as speed (module docstring), then call ``materialize`` once
+    (skipped when None).
 
     Returns ``(times_s, result)`` with ``result.time_s`` = median of times.
     """
     if repeats < 1:
         raise ValueError(f"repeats must be >= 1, got {repeats}")
-    dispatch()  # warm-up: JIT compile / first-touch excluded from timing
+    out = dispatch()  # warm-up: JIT compile / first-touch excluded
+    if force is not None:
+        force(out)
     times = []
     for _ in range(repeats):
         t0 = time.perf_counter()
-        dispatch()
+        out = dispatch()
+        if force is not None:
+            force(out)
         times.append(time.perf_counter() - t0)
     if materialize is None:
         return times, None

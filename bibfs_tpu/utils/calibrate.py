@@ -17,13 +17,25 @@ What is measured (all medians over repeats, jit-compiled, blocked):
 - ``dispatch_cached_us`` / ``dispatch_fresh_us``: one jitted no-op level
   with a cache-reused vs freshly created device scalar argument — the
   tunneled-TPU dispatch stall behind ``_device_scalar``'s cache.
-- ``pull_level_us``: one full pull level over the n=100k ELL table
-  (``expand_pull``), plus the implied gather throughput in elements/us.
-- ``push_level_us``: one push claim phase at each candidate cap K —
-  cost scales with K*width, independent of n.
+- ``pull_level_us``: amortized cost of one pull level over the n=100k ELL
+  table, measured INSIDE a ``lax.while_loop`` of 32 levels (divided by
+  32), plus the implied gather throughput in elements/us.
+- ``push_level_us``: amortized in-loop cost of one push claim phase at
+  each candidate cap K — cost scales with K*width, independent of n.
 - ``push_cap``: the largest measured K whose push level is still cheaper
   than the pull level — the Beamer crossover. ``push_cap_divisor`` =
   n_pad // push_cap generalizes it to other graph sizes.
+
+Two methodology rules, both consequences of measured runtime behavior
+(full account in bibfs_tpu/solvers/timing.py):
+
+- every measured call FORCES execution with a value read — on the tunneled
+  TPU runtime ``block_until_ready`` returns without waiting, so un-forced
+  loops time the enqueue, not the work;
+- levels are measured INSIDE a ``lax.while_loop`` (amortized over 32
+  iterations) rather than as standalone jitted calls, because that is
+  where the solver runs them and per-dispatch overhead would otherwise
+  swamp the per-level cost being compared.
 """
 
 from __future__ import annotations
@@ -42,14 +54,21 @@ _REPO_ROOT = os.path.dirname(
 )
 
 
-def _median_us(fn, repeats: int) -> float:
+def _force(out) -> None:
+    """Read one element so lazily-deferred execution actually runs —
+    ``block_until_ready`` alone does NOT wait on the tunneled runtime
+    (measured; full account in bibfs_tpu/solvers/timing.py)."""
     import jax
 
-    jax.block_until_ready(fn())  # compile / warm
+    np.asarray(jax.tree.leaves(out)[0]).ravel()[0]
+
+
+def _median_us(fn, repeats: int) -> float:
+    _force(fn())  # compile / warm / flip any lazy runtime to sync mode
     times = []
     for _ in range(repeats):
         t0 = time.perf_counter()
-        jax.block_until_ready(fn())
+        _force(fn())
         times.append(time.perf_counter() - t0)
     return float(np.median(times) * 1e6)
 
@@ -85,45 +104,71 @@ def run_calibration(
         repeats,
     )
 
-    # --- one pull level over the full ELL table -------------------------
+    # --- amortized IN-LOOP level costs (module docstring: standalone
+    # dispatch of the same computations is wildly unrepresentative on
+    # tunneled backends) ------------------------------------------------
+    levels = 32
     rng = np.random.default_rng(seed)
     frontier = jax.device_put(rng.random(g.n_pad) < 0.02)
     visited = jax.device_put(rng.random(g.n_pad) < 0.1)
-    pull = jax.jit(expand_pull)
-    pull_level_us = _median_us(lambda: pull(frontier, visited, nbr, deg), repeats)
+
+    @jax.jit
+    def pull_loop(fr, vis):
+        def body(c):
+            i, fr = c
+            # perturb one element so the level cannot be hoisted out of
+            # the loop as loop-invariant; cost: one 1-element scatter
+            fr = fr.at[i % g.n_pad].set(i % 2 == 0)
+            nf, _par = expand_pull(fr, vis, nbr, deg)
+            return i + 1, nf
+
+        return jax.lax.while_loop(lambda c: c[0] < levels, body, (0, fr))
+
+    pull_level_us = (
+        _median_us(lambda: pull_loop(frontier, visited), repeats) / levels
+    )
     gather_elems_per_us = g.n_pad * width / pull_level_us
 
-    # --- push claim phase at each candidate cap K -----------------------
     dist0 = jax.device_put(
         np.where(rng.random(g.n_pad) < 0.1, 1, INF32).astype(np.int32)
     )
     par0 = jax.device_put(np.full(g.n_pad, -1, dtype=np.int32))
-    lvl = jnp.int32(2)
 
     def push_at(k):
-        fidx = jax.device_put(
+        fidx0 = jax.device_put(
             rng.choice(g.n_pad, size=k, replace=False).astype(np.int32)
         )
 
         @jax.jit
-        def one(fidx, par, dist):
-            rows = nbr[fidx]
-            valid = (
-                jnp.arange(width, dtype=jnp.int32)[None, :]
-                < deg[fidx][:, None]
-            )
-            return _push_claim(
-                fidx, rows, valid, jnp.int32(0), par, dist, deg, lvl, inf=INF32
+        def push_loop(fidx, par, dist):
+            def body(c):
+                i, fidx, par, dist = c
+                fidx = (fidx + 1) % g.n_pad  # iteration-dependent targets
+                rows = nbr[fidx]
+                valid = (
+                    jnp.arange(width, dtype=jnp.int32)[None, :]
+                    < deg[fidx][:, None]
+                )
+                _nf, _nfi, _cnt, par, dist, _sc, _md = _push_claim(
+                    fidx, rows, valid, jnp.int32(0), par, dist, deg,
+                    i.astype(jnp.int32), inf=INF32,
+                )
+                return i + 1, fidx, par, dist
+
+            return jax.lax.while_loop(
+                lambda c: c[0] < levels, body, (0, fidx, par, dist)
             )
 
-        return _median_us(lambda: one(fidx, par0, dist0), repeats)
+        return (
+            _median_us(lambda: push_loop(fidx0, par0, dist0), repeats) / levels
+        )
 
     push_level_us = {}
     push_cap = 0
     for k in (128, 256, 512, 1024, 2048, 4096):
         if k > g.n_pad:
             break
-        push_level_us[str(k)] = round(push_at(k), 1)
+        push_level_us[str(k)] = round(push_at(k), 2)
         if push_level_us[str(k)] < pull_level_us:
             push_cap = k
 
@@ -131,9 +176,10 @@ def run_calibration(
         "n_pad": g.n_pad,
         "width": width,
         "repeats": repeats,
+        "levels_per_measure": levels,
         "dispatch_cached_us": round(dispatch_cached_us, 1),
         "dispatch_fresh_us": round(dispatch_fresh_us, 1),
-        "pull_level_us": round(pull_level_us, 1),
+        "pull_level_us": round(pull_level_us, 2),
         "gather_elems_per_us": round(gather_elems_per_us, 1),
         "push_level_us": push_level_us,
         "push_cap": push_cap,
