@@ -62,8 +62,8 @@ SWEEP = [  # device configs: (mode, layout)
     ("beamer", "tiered"),
 ]
 # each real device solve through the tunnel costs ~0.2s; cap device repeats
-# so seven configs fit the driver's budget while host backends keep the
-# full repeat count
+# so the five device configs fit the driver's budget while host backends
+# keep the full repeat count
 DEVICE_REPEATS = int(os.environ.get("BENCH_DEVICE_REPEATS", 10))
 # Precomputed connected seeds (src=0, dst=n-1 reachable) for the generator's
 # G(n, 2.2/n) at the sizes the bench runs — kills the serial search-on-boot
@@ -305,10 +305,11 @@ def main():
                 "hbm_gbps": round(gbps, 2) if gbps else None,
                 "hbm_pct_peak": round(100 * gbps / peak, 1) if gbps else None,
                 # well under 1% of peak means the device search is NOT
-                # bandwidth-bound: the wall-clock is per-dispatch overhead
-                # (tunnel round trips at ~2-3ms/op-fusion, measured in
-                # calibration.json) — no expansion kernel, Pallas included,
-                # changes that term
+                # bandwidth-bound: the wall-clock is dispatch overhead —
+                # calibration.json measures ~67ms for one whole-program
+                # dispatch round trip and ~2ms of fixed cost per in-loop
+                # level (PERF_NOTES.md §2) — and no expansion kernel,
+                # Pallas included, changes that term
                 "hbm_note": (
                     "achieved bandwidth <1% of peak: device search is "
                     "dispatch/latency-bound (tunnel per-op tax), not "

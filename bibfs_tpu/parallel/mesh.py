@@ -37,3 +37,48 @@ def shard_spec(mesh: Mesh, axis: str = VERTEX_AXIS) -> NamedSharding:
 
 def replicated_spec(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, PartitionSpec())
+
+
+def init_multihost(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+    *,
+    auto: bool = False,
+) -> int:
+    """Join a multi-host SPMD job — the framework's ``mpirun -hostfile``
+    analog (the reference's two-laptop cluster launch, README.md:16).
+
+    Wraps :func:`jax.distributed.initialize`: every host runs the same
+    program, this call makes ``jax.devices()`` span ALL hosts' chips
+    (collectives then ride ICI within a slice and DCN across slices), and
+    :func:`make_1d_mesh` over the global device list gives each process its
+    addressable shard of the vertex partition. Returns this process's
+    index. Must run before anything touches a backend (jax requirement).
+
+    Two ways to call it:
+
+    - explicit: pass ``coordinator_address`` (+ ``num_processes``,
+      ``process_id``) — the hostfile analog;
+    - ``auto=True``: delegate entirely to jax's cluster auto-detection
+      (Cloud TPU pods, GKE, SLURM, Open MPI). May block retrying the
+      coordinator connection if detection misfires, which is why it is
+      opt-in rather than the no-argument default.
+
+    With neither, raises :class:`ValueError` immediately — a bare call on
+    an unconfigured single host would otherwise hang in connection retry;
+    single-host meshes (including the 8-device virtual CPU test mesh) do
+    not need this function at all.
+    """
+    if coordinator_address is None and not auto:
+        raise ValueError(
+            "init_multihost needs a coordinator_address, or auto=True to "
+            "use jax's cluster auto-detection (TPU pod / GKE / SLURM / "
+            "MPI); on a single host just build a mesh with make_1d_mesh()"
+        )
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    return jax.process_index()

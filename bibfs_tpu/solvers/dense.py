@@ -49,11 +49,11 @@ INF32 = 1 << 30
 def _device_scalar(v: int) -> jax.Array:
     """Device-resident int32 scalar, cached by value.
 
-    Passing a *freshly* eager-created device scalar as a jit argument stalls
-    the dispatch path on tunneled-TPU runtimes (measured ~100ms per fresh
-    arg vs ~20us when the scalar buffer is reused), so solver entry points
-    must route src/dst through this cache rather than calling
-    ``jnp.int32(...)`` per solve.
+    Reusing the scalar buffer avoids a per-solve host->device transfer of
+    the src/dst arguments. (calibration.json records the measured
+    cached-vs-fresh dispatch cost per platform; on the tunneled backend
+    the synchronous per-dispatch tax dwarfs both, but the cache stays —
+    it is free and matters on backends with normal dispatch.)
     """
     return jnp.int32(v)
 

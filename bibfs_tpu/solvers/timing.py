@@ -40,11 +40,13 @@ from bibfs_tpu.solvers.api import BFSResult
 
 
 def force_scalar(out) -> None:
-    """Default ``force`` for device backends: read one element of the first
-    output (the ``best`` distance; element 0 of the batch in vmapped
-    solves), compelling the runtime to actually execute everything queued
-    for it."""
-    np.asarray(out[0]).ravel()[0]
+    """Default ``force``: read one element of the first array leaf of
+    ``out`` (works for a bare array, a solver output tuple — leaf 0 is the
+    ``best`` distance — or any pytree), compelling the runtime to actually
+    execute everything queued for it."""
+    import jax
+
+    np.asarray(jax.tree.leaves(out)[0]).ravel()[0]
 
 
 def timed_repeats(

@@ -42,7 +42,6 @@ from __future__ import annotations
 
 import json
 import os
-import time
 from functools import lru_cache
 
 import numpy as np
@@ -54,22 +53,12 @@ _REPO_ROOT = os.path.dirname(
 )
 
 
-def _force(out) -> None:
-    """Read one element so lazily-deferred execution actually runs —
-    ``block_until_ready`` alone does NOT wait on the tunneled runtime
-    (measured; full account in bibfs_tpu/solvers/timing.py)."""
-    import jax
-
-    np.asarray(jax.tree.leaves(out)[0]).ravel()[0]
-
-
 def _median_us(fn, repeats: int) -> float:
-    _force(fn())  # compile / warm / flip any lazy runtime to sync mode
-    times = []
-    for _ in range(repeats):
-        t0 = time.perf_counter()
-        _force(fn())
-        times.append(time.perf_counter() - t0)
+    """Median wall-clock of ``fn`` in us under the shared forced-execution
+    protocol (one place owns that workaround: solvers/timing.py)."""
+    from bibfs_tpu.solvers.timing import force_scalar, timed_repeats
+
+    times, _ = timed_repeats(fn, None, repeats, force=force_scalar)
     return float(np.median(times) * 1e6)
 
 

@@ -95,9 +95,8 @@ from bibfs_tpu.graph.io import read_graph_bin
 from bibfs_tpu.solvers.dense import DeviceGraph, solve_dense_graph, time_search_only
 n, edges = read_graph_bin({bin_path!r})
 g = DeviceGraph.build(n, edges, layout="tiered")
-# timing FIRST, materialize after: the first value readback permanently
-# degrades tunneled-runtime dispatch (see dense.time_search_only) — and a
-# fresh subprocess per scale keeps one scale's readbacks off the next's clock
+# forced-execution timing (solvers/timing.py); a fresh subprocess per scale
+# keeps compile caches and runtime mode isolated between scales
 times = time_search_only(g, {src}, {dst}, repeats={repeats}, mode="sync")
 res = solve_dense_graph(g, {src}, {dst}, mode="sync")
 print(json.dumps(dict(
