@@ -52,7 +52,10 @@ BASELINE_V1_100K_S = 0.000115546  # benchmark_results.csv:5
 N = int(os.environ.get("BENCH_N", 100_000))
 AVG_DEG = 2.2000000001  # graphs/make_graphs:8
 REPEATS = int(os.environ.get("BENCH_REPEATS", 30))
-PROBE_TIMEOUT_S = float(os.environ.get("BENCH_PROBE_TIMEOUT_S", 150))
+# two probe attempts run before any CPU fallback; 110s each keeps the
+# worst case (dead tunnel: 2 probes + full CPU-platform sweep) inside the
+# driver's budget while still riding out a slow-but-alive backend init
+PROBE_TIMEOUT_S = float(os.environ.get("BENCH_PROBE_TIMEOUT_S", 110))
 HOST_BACKENDS = ["native", "serial"]  # the framework's latency runtimes
 SWEEP = [  # device configs: (mode, layout)
     ("sync", "ell"),
@@ -134,20 +137,25 @@ def probe_accelerator() -> tuple[str, str | None]:
             err = (r.stdout + r.stderr).strip()[-600:]
         except subprocess.TimeoutExpired:
             err = f"probe timeout after {PROBE_TIMEOUT_S}s (attempt {attempt + 1})"
-    return "cpu", err
+    # err can be "" when the probe died without output (e.g. OOM-kill);
+    # the emitted JSON must still state why the accelerator was rejected
+    return "cpu", err or "probe failed with no diagnostic output"
 
 
 def select_platform() -> tuple[str, str | None]:
-    """Shared platform policy for every bench entry point: honor an
-    explicit JAX_PLATFORMS debug override, else probe the accelerator in a
-    bounded subprocess and fall back to the host CPU. Returns
+    """Shared platform policy for every bench entry point: an explicit
+    ``JAX_PLATFORMS=cpu`` debug override skips the probe; ANY other value
+    (including the ambient ``axon`` this environment exports) still goes
+    through the bounded-subprocess probe — a wedged tunnel must fall back
+    to CPU, not hang the bench at its first backend touch (measured:
+    trusting the ambient env here reintroduced round 1's rc=124). Returns
     ``(platform, tpu_error)``."""
     from bibfs_tpu.utils.platform import apply_platform_env, force_cpu
 
-    if os.environ.get("JAX_PLATFORMS"):
-        # debug override (e.g. CPU smoke test): honor it, skip the probe
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        # CPU smoke test: honor it, skip the probe
         apply_platform_env()
-        return os.environ["JAX_PLATFORMS"], None
+        return "cpu", None
     platform, tpu_error = probe_accelerator()
     if platform == "cpu":
         force_cpu(1)
@@ -164,6 +172,21 @@ def main():
         detail["platform"] = platform
         if tpu_error:
             detail["tpu_error"] = tpu_error
+        # degraded mode: ANY large run on the CPU platform — probe-failure
+        # fallback or an explicit JAX_PLATFORMS=cpu with the default N.
+        # The host rows carry the headline either way; run ONE token
+        # device config (compiling five 100k programs + a 32-wide vmap on
+        # a single core blows the driver's budget — measured rc=124) and
+        # skip the batch row. Small-N CPU smoke tests keep the full sweep.
+        degraded = platform == "cpu" and N >= 50_000
+        sweep = [("sync", "ell")] if degraded else SWEEP
+        device_repeats = 3 if degraded else DEVICE_REPEATS
+        if degraded:
+            detail["degraded"] = (
+                "large run on the CPU platform"
+                + (" (accelerator probe failed)" if tpu_error else "")
+                + ": reduced device sweep, batch row skipped"
+            )
 
         from bibfs_tpu.graph.csr import build_csr, canonical_pairs
         from bibfs_tpu.parallel.collectives import frontier_exchange_bytes as fx
@@ -172,9 +195,11 @@ def main():
 
         pairs = canonical_pairs(N, edges)  # one O(M log M) pass for all layouts
         csr = build_csr(N, pairs=pairs)
+        # build only the layouts the active sweep uses (degraded mode pays
+        # for no tiered hub tables it will never read)
         graphs = {
             layout: DeviceGraph.build(N, layout=layout, pairs=pairs)
-            for layout in ("ell", "tiered")
+            for layout in sorted({lay for _m, lay in sweep})
         }
 
         # every timed interval forces execution (value read inside the
@@ -215,11 +240,11 @@ def main():
                 continue
             gate(backend, times, res)
 
-        for mode, layout in SWEEP:
+        for mode, layout in sweep:
             label = f"{mode}/{layout}"
             try:
                 times, res = time_search(
-                    graphs[layout], 0, N - 1, repeats=DEVICE_REPEATS, mode=mode
+                    graphs[layout], 0, N - 1, repeats=device_repeats, mode=mode
                 )
             except Exception as e:
                 failed[label] = f"{type(e).__name__}: {e}"[:300]
@@ -230,22 +255,28 @@ def main():
         # amortized multi-query throughput — 32 searches vmapped into ONE
         # device program (a capability the reference's process-per-query
         # harness cannot express)
+        # schema note: batch32 is a dict or null in EVERY run (degraded
+        # runs record why in detail.degraded) — consumers index into it
         batch_stats = None
-        try:
-            from bibfs_tpu.solvers.dense import time_batch_only
+        if not degraded:
+            try:
+                from bibfs_tpu.solvers.dense import time_batch_only
 
-            rng = np.random.default_rng(0)
-            bpairs = np.stack(
-                [rng.integers(0, N, size=32), rng.integers(0, N, size=32)], axis=1
-            )
-            bt = time_batch_only(graphs["ell"], bpairs, repeats=5, mode="sync")
-            batch_stats = {
-                "batch_size": 32,
-                "per_query_us": round(float(np.median(bt)) / 32 * 1e6, 2),
-                "batch_median_ms": round(float(np.median(bt)) * 1e3, 3),
-            }
-        except Exception as e:
-            print(f"batch timing failed: {e}", file=sys.stderr)
+                rng = np.random.default_rng(0)
+                bpairs = np.stack(
+                    [rng.integers(0, N, size=32), rng.integers(0, N, size=32)],
+                    axis=1,
+                )
+                bt = time_batch_only(
+                    graphs["ell"], bpairs, repeats=5, mode="sync"
+                )
+                batch_stats = {
+                    "batch_size": 32,
+                    "per_query_us": round(float(np.median(bt)) / 32 * 1e6, 2),
+                    "batch_median_ms": round(float(np.median(bt)) * 1e3, 3),
+                }
+            except Exception as e:
+                print(f"batch timing failed: {e}", file=sys.stderr)
 
         if not results:
             emit(

@@ -27,7 +27,10 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-_IMAX = jnp.int32(2**31 - 1)
+# plain int, NOT jnp.int32: a device constant here would initialize a JAX
+# backend at import time (same rule as dense.INF32 — and on a hung tunneled
+# backend that import-time init blocks the whole process)
+_IMAX = 2**31 - 1
 
 
 def or_allreduce(x: jnp.ndarray, axis: str) -> jnp.ndarray:

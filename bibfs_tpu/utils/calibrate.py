@@ -85,7 +85,6 @@ def run_calibration(
     # --- dispatch stall: cached vs fresh device-scalar argument ---------
     noop = jax.jit(lambda d, s: d[s] + 1)
     cached = jnp.int32(3)
-    jax.block_until_ready(noop(deg, cached))
     dispatch_cached_us = _median_us(lambda: noop(deg, cached), repeats)
     # a FRESH eager scalar per call is exactly what _device_scalar avoids
     dispatch_fresh_us = _median_us(

@@ -139,3 +139,77 @@ def test_solve_cli_profile_trace(tiny_suite, tmp_path, capsys):
     )
     assert rc == 0
     assert os.path.isdir(os.path.join(trace_dir, "plugins", "profile"))
+
+
+def test_init_multihost_fails_fast_unconfigured(monkeypatch):
+    """A bare init_multihost() on an unconfigured single host must raise
+    immediately (not hang in coordinator connection retry)."""
+    import pytest
+
+    from bibfs_tpu.parallel.mesh import init_multihost
+
+    for var in (
+        "JAX_COORDINATOR_ADDRESS",
+        "COORDINATOR_ADDRESS",
+        "SLURM_JOB_ID",
+        "OMPI_COMM_WORLD_SIZE",
+    ):
+        monkeypatch.delenv(var, raising=False)
+    with pytest.raises(ValueError, match="coordinator_address"):
+        init_multihost()
+
+
+def test_farthest_reachable_matches_oracle():
+    """The scale runner's host BFS picks a genuinely farthest vertex whose
+    distance the bidirectional oracle reproduces."""
+    import importlib.util
+    import os
+
+    import numpy as np
+
+    from bibfs_tpu.graph.csr import build_csr
+    from bibfs_tpu.graph.generate import gnp_random_graph
+    from bibfs_tpu.solvers.serial import solve_serial_csr
+
+    spec = importlib.util.spec_from_file_location(
+        "run_scale",
+        os.path.join(os.path.dirname(__file__), "..", "scripts", "run_scale.py"),
+    )
+    run_scale = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(run_scale)
+
+    n = 500
+    edges = gnp_random_graph(n, 4.0 / n, seed=11)
+    row_ptr, col_ind = build_csr(n, edges)
+    src = int(np.argmax(np.diff(row_ptr)))
+    dst, depth = run_scale.farthest_reachable(n, row_ptr, col_ind, src)
+    res = solve_serial_csr(n, row_ptr, col_ind, src, dst)
+    assert res.found and res.hops == depth
+    # no vertex is farther: every reachable vertex is within depth hops
+    for probe in range(0, n, 97):
+        r = solve_serial_csr(n, row_ptr, col_ind, src, probe)
+        if r.found:
+            assert r.hops <= depth
+
+
+def test_timed_repeats_forces_every_interval():
+    """timed_repeats must invoke force inside warm-up AND every timed
+    repeat — the lazy-runtime countermeasure (solvers/timing.py): skipping
+    any interval would let deferred execution masquerade as speed."""
+    from bibfs_tpu.solvers.timing import timed_repeats
+
+    calls = {"dispatch": 0, "force": 0}
+
+    def dispatch():
+        calls["dispatch"] += 1
+        return ("out", calls["dispatch"])
+
+    def force(out):
+        assert out[0] == "out"
+        calls["force"] += 1
+
+    times, res = timed_repeats(dispatch, None, 4, force=force)
+    assert res is None
+    assert len(times) == 4
+    assert calls["dispatch"] == 5  # warm-up + 4 repeats
+    assert calls["force"] == 5  # forced in warm-up and in each interval
