@@ -11,12 +11,18 @@
 // API style: stateless extern "C" functions over caller-allocated buffers
 // (NumPy arrays on the Python side). Return 0 on success, negative errno-
 // style codes on failure. No globals, no exceptions across the boundary.
+// The one stateful object is the OPAQUE solve scratch (bibfs_scratch_*):
+// repeated solves over one graph reuse epoch-stamped distance/parent
+// arrays, so per-solve setup is O(vertices touched), not O(n) — the O(n)
+// re-initialization of four n-sized arrays otherwise dominates wall-clock
+// for short searches on large graphs (measured: most of ~100us at n=100k).
 
 #include <algorithm>
 #include <chrono>
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
+#include <new>
 #include <vector>
 
 extern "C" {
@@ -29,6 +35,7 @@ enum {
   BIBFS_ERANGE = -3,    // endpoint out of range
   BIBFS_EARG = -4,      // bad argument (src/dst out of range, etc.)
   BIBFS_EBUF = -5,      // caller buffer too small
+  BIBFS_ENOMEM = -6,    // allocation failure
 };
 
 // ------------------------------------------------------------- graph I/O
@@ -97,16 +104,71 @@ int bibfs_build_csr(uint32_t n, uint64_t m, const uint32_t* edges,
 // smaller-frontier-first, per-side parent arrays — but with the correct
 // termination rule (track best meet, stop when level_s + level_t >= best)
 // instead of v1's first-meet early exit (quirk Q2).
-//
+
+namespace {
+
+constexpr int32_t INF = INT32_MAX / 4;
+
+// Epoch-stamped per-side search state: dist/par entries are valid only
+// where stamp[v] == epoch, so starting a new solve is one ++epoch instead
+// of refilling four n-sized arrays.
+struct Side {
+  std::vector<int32_t> dist, par;
+  std::vector<uint32_t> stamp;
+  std::vector<uint32_t> fr, next;
+
+  void init(uint32_t n) {
+    dist.assign(n, INF);
+    par.assign(n, -1);
+    stamp.assign(n, 0);
+  }
+  int32_t d(uint32_t v, uint32_t ep) const {
+    return stamp[v] == ep ? dist[v] : INF;
+  }
+  void claim(uint32_t v, uint32_t ep, int32_t lvl, int32_t parent) {
+    stamp[v] = ep;
+    dist[v] = lvl;
+    par[v] = parent;
+  }
+};
+
+struct Scratch {
+  uint32_t n = 0;
+  uint32_t epoch = 0;
+  Side s, t;
+};
+
+}  // namespace
+
+void* bibfs_scratch_create(uint32_t n) {
+  // no exception may cross the extern "C"/ctypes boundary: vector growth
+  // can throw bad_alloc, so the whole construction is fenced
+  try {
+    auto* sc = new Scratch;
+    sc->n = n;
+    sc->s.init(n);
+    sc->t.init(n);
+    return sc;
+  } catch (...) {
+    return nullptr;
+  }
+}
+
+void bibfs_scratch_free(void* scratch) { delete static_cast<Scratch*>(scratch); }
+
+// Scratch-reusing solve: per-solve setup cost is O(touched), not O(n).
 // Outputs: *out_hops = -1 if unreachable, else hop count; path written to
 // path_buf (path_cap entries; *out_path_len = 0 if it doesn't fit);
 // *out_time_s = search-loop seconds (reference timing parity);
 // *out_edges = directed edges scanned; *out_levels = expansions done.
-int bibfs_solve(uint32_t n, const int64_t* row_ptr, const int32_t* col_ind,
-                uint32_t src, uint32_t dst, int32_t* out_hops,
-                int32_t* path_buf, int32_t path_cap, int32_t* out_path_len,
-                double* out_time_s, int64_t* out_edges, int32_t* out_levels) {
-  if (src >= n || dst >= n) return BIBFS_EARG;
+int bibfs_solve_s(uint32_t n, const int64_t* row_ptr, const int32_t* col_ind,
+                  void* scratch, uint32_t src, uint32_t dst,
+                  int32_t* out_hops, int32_t* path_buf, int32_t path_cap,
+                  int32_t* out_path_len, double* out_time_s,
+                  int64_t* out_edges, int32_t* out_levels) {
+  if (src >= n || dst >= n || !scratch) return BIBFS_EARG;
+  auto* sc = static_cast<Scratch*>(scratch);
+  if (sc->n != n) return BIBFS_EARG;
   *out_hops = -1;
   *out_path_len = 0;
   *out_edges = 0;
@@ -129,37 +191,41 @@ int bibfs_solve(uint32_t n, const int64_t* row_ptr, const int32_t* col_ind,
     return BIBFS_OK;
   }
 
-  constexpr int32_t INF = INT32_MAX / 4;
-  std::vector<int32_t> dist_s(n, INF), dist_t(n, INF);
-  std::vector<int32_t> par_s(n, -1), par_t(n, -1);
-  std::vector<uint32_t> fr_s{src}, fr_t{dst}, next;
-  dist_s[src] = 0;
-  dist_t[dst] = 0;
+  if (++sc->epoch == 0) {  // stamp wrap (once per 2^32 solves): hard reset
+    sc->s.init(n);
+    sc->t.init(n);
+    sc->epoch = 1;
+  }
+  const uint32_t ep = sc->epoch;
+  Side& S = sc->s;
+  Side& T = sc->t;
+  S.fr.assign(1, src);
+  T.fr.assign(1, dst);
+  S.claim(src, ep, 0, -1);
+  T.claim(dst, ep, 0, -1);
 
   int32_t level_s = 0, level_t = 0, best = INF;
   int64_t scanned = 0;
   int32_t levels = 0;
   uint32_t meet = UINT32_MAX;
 
-  while (!fr_s.empty() && !fr_t.empty() && level_s + level_t < best) {
-    bool s_side = fr_s.size() <= fr_t.size();
-    auto& fr = s_side ? fr_s : fr_t;
-    auto& dist = s_side ? dist_s : dist_t;
-    auto& par = s_side ? par_s : par_t;
-    auto& dist_other = s_side ? dist_t : dist_s;
+  while (!S.fr.empty() && !T.fr.empty() && level_s + level_t < best) {
+    bool s_side = S.fr.size() <= T.fr.size();
+    Side& A = s_side ? S : T;
+    Side& B = s_side ? T : S;
     int32_t lvl = (s_side ? ++level_s : ++level_t);
 
-    next.clear();
-    for (uint32_t u : fr) {
+    A.next.clear();
+    for (uint32_t u : A.fr) {
       for (int64_t i = row_ptr[u]; i < row_ptr[u + 1]; ++i) {
         ++scanned;
         uint32_t v = uint32_t(col_ind[i]);
-        if (dist[v] != INF) continue;
-        dist[v] = lvl;
-        par[v] = int32_t(u);
-        next.push_back(v);
-        if (dist_other[v] != INF) {
-          int32_t cand = dist[v] + dist_other[v];
+        if (A.stamp[v] == ep) continue;  // already visited this side
+        A.claim(v, ep, lvl, int32_t(u));
+        A.next.push_back(v);
+        int32_t dv_other = B.d(v, ep);
+        if (dv_other != INF) {
+          int32_t cand = lvl + dv_other;
           if (cand < best) {
             best = cand;
             meet = v;
@@ -167,7 +233,7 @@ int bibfs_solve(uint32_t n, const int64_t* row_ptr, const int32_t* col_ind,
         }
       }
     }
-    fr.swap(next);
+    A.fr.swap(A.next);
     ++levels;
   }
   finish();
@@ -178,11 +244,16 @@ int bibfs_solve(uint32_t n, const int64_t* row_ptr, const int32_t* col_ind,
   *out_hops = best;
 
   // path reconstruction: walk parents both ways from the meet vertex
-  // (v1/main-v1.cpp:86-97)
+  // (v1/main-v1.cpp:86-97). Every vertex on a parent chain was claim()ed
+  // this epoch (claim stamps before writing par, and best < INF means the
+  // meet is stamped on both sides), so the plain -1-terminated walk needs
+  // no stamp guards.
   std::vector<int32_t> left;  // meet .. src
-  for (int32_t v = int32_t(meet); v != -1; v = par_s[v]) left.push_back(v);
+  for (int32_t v = int32_t(meet); v != -1; v = S.par[uint32_t(v)])
+    left.push_back(v);
   std::vector<int32_t> right;  // after meet .. dst
-  for (int32_t v = par_t[meet]; v != -1; v = par_t[v]) right.push_back(v);
+  for (int32_t v = T.par[meet]; v != -1; v = T.par[uint32_t(v)])
+    right.push_back(v);
 
   int64_t total = int64_t(left.size()) + int64_t(right.size());
   if (total > path_cap) return BIBFS_OK;  // hops valid, path omitted
@@ -191,6 +262,21 @@ int bibfs_solve(uint32_t n, const int64_t* row_ptr, const int32_t* col_ind,
   for (int32_t v : right) path_buf[k++] = v;
   *out_path_len = k;
   return BIBFS_OK;
+}
+
+// Stateless one-shot wrapper (original ABI, kept for compatibility):
+// allocates a scratch for the single call.
+int bibfs_solve(uint32_t n, const int64_t* row_ptr, const int32_t* col_ind,
+                uint32_t src, uint32_t dst, int32_t* out_hops,
+                int32_t* path_buf, int32_t path_cap, int32_t* out_path_len,
+                double* out_time_s, int64_t* out_edges, int32_t* out_levels) {
+  void* sc = bibfs_scratch_create(n);
+  if (!sc) return BIBFS_ENOMEM;
+  int rc = bibfs_solve_s(n, row_ptr, col_ind, sc, src, dst, out_hops,
+                         path_buf, path_cap, out_path_len, out_time_s,
+                         out_edges, out_levels);
+  bibfs_scratch_free(sc);
+  return rc;
 }
 
 }  // extern "C"

@@ -24,6 +24,7 @@ _ERR = {
     -3: "edge endpoint out of range",
     -4: "bad argument",
     -5: "buffer too small",
+    -6: "allocation failure",
 }
 
 
@@ -49,8 +50,16 @@ def _lib() -> ctypes.CDLL:
         u32, p(i64), p(i32), u32, u32,
         p(i32), p(i32), i32, p(i32), p(f64), p(i64), p(i32),
     ]
+    lib.bibfs_solve_s.argtypes = [
+        u32, p(i64), p(i32), ctypes.c_void_p, u32, u32,
+        p(i32), p(i32), i32, p(i32), p(f64), p(i64), p(i32),
+    ]
+    lib.bibfs_scratch_create.argtypes = [u32]
+    lib.bibfs_scratch_create.restype = ctypes.c_void_p
+    lib.bibfs_scratch_free.argtypes = [ctypes.c_void_p]
+    lib.bibfs_scratch_free.restype = None
     for fn in (lib.bibfs_read_header, lib.bibfs_read_edges,
-               lib.bibfs_build_csr, lib.bibfs_solve):
+               lib.bibfs_build_csr, lib.bibfs_solve, lib.bibfs_solve_s):
         fn.restype = i32
     _CACHED = lib
     return lib
@@ -93,6 +102,21 @@ class NativeGraph:
     row_ptr: np.ndarray  # int64[n+1]
     col_ind: np.ndarray  # int32[nnz]
 
+    def __post_init__(self):
+        # epoch-stamped solve scratch: repeated solves over this graph pay
+        # O(vertices touched) setup instead of refilling four n-sized
+        # arrays (the dominant cost of short searches on big graphs).
+        # Owned by this object; freed by the GC finalizer. NOT thread-safe:
+        # one in-flight solve per NativeGraph.
+        import weakref
+
+        lib = _lib()
+        self._scratch = lib.bibfs_scratch_create(self.n)
+        if not self._scratch:
+            raise MemoryError(f"scratch allocation failed for n={self.n}")
+        self._path_buf = np.empty(self.n + 1, dtype=np.int32)
+        weakref.finalize(self, lib.bibfs_scratch_free, self._scratch)
+
     @classmethod
     def build(cls, n: int, edges: np.ndarray) -> "NativeGraph":
         lib = _lib()
@@ -115,18 +139,26 @@ class NativeGraph:
 
 
 def solve_native_graph(g: NativeGraph, src: int, dst: int) -> BFSResult:
+    """Solve on a prebuilt :class:`NativeGraph`, reusing its epoch-stamped
+    scratch (per-solve setup is O(vertices touched), not O(n)).
+
+    NOT thread-safe: the scratch and path buffer belong to ``g``, so run
+    at most one solve per NativeGraph at a time (concurrent threads must
+    use separate NativeGraph instances or the stateless
+    :func:`solve_native`)."""
     if not (0 <= src < g.n and 0 <= dst < g.n):
         raise ValueError(f"src/dst out of range for n={g.n}")
     lib = _lib()
     hops = ctypes.c_int32()
-    path_buf = np.empty(g.n + 1, dtype=np.int32)
+    path_buf = g._path_buf
     path_len = ctypes.c_int32()
     secs = ctypes.c_double()
     scanned = ctypes.c_int64()
     levels = ctypes.c_int32()
     _check(
-        lib.bibfs_solve(
+        lib.bibfs_solve_s(
             g.n, _ptr(g.row_ptr, ctypes.c_int64), _ptr(g.col_ind, ctypes.c_int32),
+            g._scratch,
             src, dst, ctypes.byref(hops), _ptr(path_buf, ctypes.c_int32),
             path_buf.size, ctypes.byref(path_len), ctypes.byref(secs),
             ctypes.byref(scanned), ctypes.byref(levels),

@@ -97,3 +97,29 @@ def test_native_counterexample_first_meet():
     )
     r = solve_native(10, edges, 0, 9)
     assert r.found and r.hops == 3
+
+
+def test_scratch_reuse_many_queries_match_oracle():
+    """The epoch-stamped scratch must stay correct across MANY solves on
+    one NativeGraph — stale dist/par entries from earlier epochs must
+    never leak into later searches."""
+    import numpy as np
+
+    from bibfs_tpu.graph.csr import build_csr
+    from bibfs_tpu.graph.generate import gnp_random_graph
+    from bibfs_tpu.solvers.native import NativeGraph, solve_native_graph
+    from bibfs_tpu.solvers.serial import solve_serial_csr
+
+    rng = np.random.default_rng(0)
+    n = 2000
+    edges = gnp_random_graph(n, 3.0 / n, seed=5)
+    g = NativeGraph.build(n, edges)
+    row_ptr, col_ind = build_csr(n, edges)
+    for _ in range(60):
+        s, d = map(int, rng.integers(0, n, 2))
+        got = solve_native_graph(g, s, d)
+        want = solve_serial_csr(n, row_ptr, col_ind, s, d)
+        assert got.found == want.found
+        if want.found:
+            assert got.hops == want.hops
+            got.validate_path(n, edges, s, d)
