@@ -63,30 +63,47 @@ def available_backends() -> list[str]:
     return out
 
 
-def _batch_row(gpath, label, n, edges, pairs_file, repeats, mode, layout):
-    """One amortized-throughput row: all (src, dst) pairs solved as ONE
-    vmapped device program (dense backend), validated per pair against the
-    serial oracle. time_sec is the PER-QUERY amortized wall-clock."""
-    from bibfs_tpu.solvers.dense import DeviceGraph, time_batch_graph
+def _batch_oracle(n, edges, pairs_file):
+    """Load the pairs file and solve every pair with the serial oracle
+    ONCE — shared across however many backends get a batch row (the
+    oracle pass dominates on big graphs and must not repeat per backend)."""
     from bibfs_tpu.solvers.serial import solve_serial
 
     pairs = np.loadtxt(pairs_file, dtype=np.int64, ndmin=2)
     if pairs.shape[1] != 2:
         raise ValueError(f"{pairs_file} must have two columns (src dst)")
-    g = DeviceGraph.build(n, edges, layout=layout)
-    times, results = time_batch_graph(g, pairs, repeats=repeats, mode=mode)
+    wants = [solve_serial(n, edges, int(s), int(d)) for s, d in pairs]
+    return pairs, wants
+
+
+def _batch_row(
+    label, n, edges, pairs, wants, repeats, mode, layout, backend="dense"
+):
+    """One amortized-throughput row: all (src, dst) pairs solved as ONE
+    vmapped device program (dense backend) or a scratch-reusing host loop
+    (native backend), validated per pair against the precomputed oracle
+    results. time_sec is the PER-QUERY amortized wall-clock."""
+    if backend == "native":
+        from bibfs_tpu.solvers.native import NativeGraph, time_batch_native
+
+        ng = NativeGraph.build(n, edges)
+        times, results = time_batch_native(ng, pairs, repeats=repeats)
+    else:
+        from bibfs_tpu.solvers.dense import DeviceGraph, time_batch_graph
+
+        g = DeviceGraph.build(n, edges, layout=layout)
+        times, results = time_batch_graph(g, pairs, repeats=repeats, mode=mode)
     batch_s = float(np.median(times))
     ok = True
     hops_total = 0
     edges_scanned = 0
-    for (src, dst), res in zip(pairs, results):
-        want = solve_serial(n, edges, int(src), int(dst))
+    for want, res in zip(wants, results):
         ok = ok and (res.found == want.found) and (res.hops == want.hops)
         hops_total += res.hops or 0
         edges_scanned += res.edges_scanned
     per_query = batch_s / max(len(results), 1)
     return dict(
-        version=f"dense-batch{len(results)}",
+        version=f"{backend}-batch{len(results)}",
         graph=label,
         time_sec=per_query,
         teps=edges_scanned / batch_s if batch_s > 0 else 0.0,
@@ -149,10 +166,16 @@ def run_bench(
                 f"{'OK' if ok else 'MISMATCH vs gt=' + str(expected)} "
                 f"(total {time.time() - t0:.1f}s)"
             )
-        if pairs_file is not None and "dense" in backends:
+        batch_oracle = None
+        for batch_backend in ("dense", "native"):
+            if pairs_file is None or batch_backend not in backends:
+                continue
             try:
+                if batch_oracle is None:
+                    batch_oracle = _batch_oracle(n, edges, pairs_file)
                 row = _batch_row(
-                    gpath, label, n, edges, pairs_file, repeats, mode, layout
+                    label, n, edges, *batch_oracle, repeats, mode,
+                    layout, backend=batch_backend,
                 )
                 rows.append(row)
                 print(
@@ -161,10 +184,13 @@ def run_bench(
                     f"{'OK' if row['ok'] else 'MISMATCH vs oracle'}"
                 )
             except Exception as e:
-                print(f"  batch on {label}: FAILED ({e})", file=sys.stderr)
+                print(
+                    f"  {batch_backend} batch on {label}: FAILED ({e})",
+                    file=sys.stderr,
+                )
                 rows.append(
-                    dict(version="dense-batch", graph=label, time_sec=None,
-                         teps=None, hops=None, ok=False)
+                    dict(version=f"{batch_backend}-batch", graph=label,
+                         time_sec=None, teps=None, hops=None, ok=False)
                 )
     _write_csv(rows, csv_path)
     _write_table(rows, table_path)
@@ -240,8 +266,9 @@ def main(argv=None):
         default=None,
         metavar="FILE",
         help='also bench batched multi-query throughput: file of "src dst" '
-        "lines solved as one vmapped device program (dense backend), "
-        "reported as a per-query amortized row",
+        "lines solved as one vmapped device program (dense) and/or a "
+        "scratch-reusing host loop (native), one per-query amortized row "
+        "per benched backend",
     )
     ap.add_argument("--csv", default="benchmark_results.csv")
     ap.add_argument("--table", default="benchmark_table.txt")
@@ -259,8 +286,9 @@ def main(argv=None):
                  "sharded backend has no pallas path)")
     if args.layout == "tiered" and args.mode.startswith("pallas"):
         ap.error("pallas modes support --layout ell only")
-    if args.pairs is not None and "dense" not in backends:
-        ap.error("--pairs requires the dense backend in --backends")
+    if args.pairs is not None and not {"dense", "native"} & set(backends):
+        ap.error("--pairs requires the dense and/or native backend in "
+                 "--backends")
     rows = run_bench(
         args.graphs,
         backends,

@@ -26,8 +26,9 @@ def main(argv=None):
         "--pairs",
         default=None,
         metavar="FILE",
-        help='batch mode (dense backend): file of "src dst" lines solved as '
-        "ONE vmapped device program; replaces the positional src/dst",
+        help='batch mode (dense or native backend): file of "src dst" lines '
+        "solved as ONE vmapped device program (dense) or a scratch-reusing "
+        "host loop (native); replaces the positional src/dst",
     )
     ap.add_argument(
         "--profile",
@@ -94,11 +95,13 @@ def main(argv=None):
     if args.mode.startswith("pallas") and args.backend != "dense":
         ap.error("--mode pallas/pallas_alt is only supported by --backend dense")
     if args.pairs is not None:
-        if args.backend != "dense":
-            ap.error("--pairs batch mode is only supported by --backend dense")
+        if args.backend not in ("dense", "native"):
+            ap.error("--pairs batch mode is supported by --backend dense "
+                     "(one vmapped device program) and native (scratch-"
+                     "reusing host loop)")
         if args.devices is not None:
-            ap.error("--devices has no effect in --pairs batch mode (dense "
-                     "backend is single-device)")
+            ap.error("--devices has no effect in --pairs batch mode (dense/"
+                     "native backends are single-device)")
         if args.src is not None or args.dst is not None:
             ap.error("--pairs replaces the positional src/dst arguments")
     elif args.src is None or args.dst is None:
@@ -161,24 +164,40 @@ def main(argv=None):
 def _batch_main(args, n, edges, tracer):
     import numpy as np
 
-    from bibfs_tpu.solvers.dense import (
-        DeviceGraph,
-        solve_batch_graph,
-        time_batch_graph,
-    )
-
     pairs = np.loadtxt(args.pairs, dtype=np.int64, ndmin=2)
     if pairs.shape[1] != 2:
         print(f"Error: {args.pairs} must have two columns (src dst)", file=sys.stderr)
         return 2
-    g = DeviceGraph.build(n, edges, layout=args.layout)
-    with tracer():
-        if args.repeat > 1:
-            _times, results = time_batch_graph(
-                g, pairs, repeats=args.repeat, mode=args.mode
-            )
-        else:
-            results = solve_batch_graph(g, pairs, mode=args.mode)
+    if args.backend == "native":
+        from bibfs_tpu.solvers.native import (
+            NativeGraph,
+            solve_batch_native_graph,
+            time_batch_native,
+        )
+
+        g = NativeGraph.build(n, edges)
+        with tracer():
+            if args.repeat > 1:
+                _times, results = time_batch_native(
+                    g, pairs, repeats=args.repeat
+                )
+            else:
+                results = solve_batch_native_graph(g, pairs)
+    else:
+        from bibfs_tpu.solvers.dense import (
+            DeviceGraph,
+            solve_batch_graph,
+            time_batch_graph,
+        )
+
+        g = DeviceGraph.build(n, edges, layout=args.layout)
+        with tracer():
+            if args.repeat > 1:
+                _times, results = time_batch_graph(
+                    g, pairs, repeats=args.repeat, mode=args.mode
+                )
+            else:
+                results = solve_batch_graph(g, pairs, mode=args.mode)
     for (src, dst), res in zip(pairs, results):
         if res.found:
             line = f"{src} -> {dst}: length = {res.hops}"
@@ -189,7 +208,7 @@ def _batch_main(args, n, edges, tracer):
         print(line)
     batch_s = results[0].time_s if results else 0.0
     print(
-        f"[Time] dense batch of {len(results)} searches took "
+        f"[Time] {args.backend} batch of {len(results)} searches took "
         f"{batch_s:.9f} seconds ({batch_s / max(len(results), 1):.9f} s/query)"
     )
     return 0

@@ -156,16 +156,15 @@ void* bibfs_scratch_create(uint32_t n) {
 
 void bibfs_scratch_free(void* scratch) { delete static_cast<Scratch*>(scratch); }
 
-// Scratch-reusing solve: per-solve setup cost is O(touched), not O(n).
-// Outputs: *out_hops = -1 if unreachable, else hop count; path written to
-// path_buf (path_cap entries; *out_path_len = 0 if it doesn't fit);
-// *out_time_s = search-loop seconds (reference timing parity);
-// *out_edges = directed edges scanned; *out_levels = expansions done.
-int bibfs_solve_s(uint32_t n, const int64_t* row_ptr, const int32_t* col_ind,
-                  void* scratch, uint32_t src, uint32_t dst,
-                  int32_t* out_hops, int32_t* path_buf, int32_t path_cap,
-                  int32_t* out_path_len, double* out_time_s,
-                  int64_t* out_edges, int32_t* out_levels) {
+namespace {
+
+// May throw (frontier push_back / path vectors on OOM); the extern "C"
+// wrapper below fences it so no exception crosses the ABI.
+int solve_impl(uint32_t n, const int64_t* row_ptr, const int32_t* col_ind,
+               void* scratch, uint32_t src, uint32_t dst,
+               int32_t* out_hops, int32_t* path_buf, int32_t path_cap,
+               int32_t* out_path_len, double* out_time_s,
+               int64_t* out_edges, int32_t* out_levels) {
   if (src >= n || dst >= n || !scratch) return BIBFS_EARG;
   auto* sc = static_cast<Scratch*>(scratch);
   if (sc->n != n) return BIBFS_EARG;
@@ -262,6 +261,27 @@ int bibfs_solve_s(uint32_t n, const int64_t* row_ptr, const int32_t* col_ind,
   for (int32_t v : right) path_buf[k++] = v;
   *out_path_len = k;
   return BIBFS_OK;
+}
+
+}  // namespace
+
+// Scratch-reusing solve: per-solve setup cost is O(touched), not O(n).
+// Outputs: *out_hops = -1 if unreachable, else hop count; path written to
+// path_buf (path_cap entries; *out_path_len = 0 if it doesn't fit);
+// *out_time_s = search-loop seconds (reference timing parity);
+// *out_edges = directed edges scanned; *out_levels = expansions done.
+int bibfs_solve_s(uint32_t n, const int64_t* row_ptr, const int32_t* col_ind,
+                  void* scratch, uint32_t src, uint32_t dst,
+                  int32_t* out_hops, int32_t* path_buf, int32_t path_cap,
+                  int32_t* out_path_len, double* out_time_s,
+                  int64_t* out_edges, int32_t* out_levels) {
+  try {
+    return solve_impl(n, row_ptr, col_ind, scratch, src, dst, out_hops,
+                      path_buf, path_cap, out_path_len, out_time_s,
+                      out_edges, out_levels);
+  } catch (...) {  // bad_alloc etc. must not cross the C ABI
+    return BIBFS_ENOMEM;
+  }
 }
 
 // Stateless one-shot wrapper (original ABI, kept for compatibility):

@@ -180,6 +180,34 @@ def solve_native(n: int, edges: np.ndarray, src: int, dst: int) -> BFSResult:
     return solve_native_graph(NativeGraph.build(n, edges), src, dst)
 
 
+def solve_batch_native_graph(g: NativeGraph, pairs) -> list[BFSResult]:
+    """Solve many (src, dst) queries back-to-back on one scratch-reusing
+    graph (the host analog of the dense backend's vmapped batch). Each
+    returned result's ``time_s`` is the WHOLE batch wall-clock, matching
+    :func:`bibfs_tpu.solvers.dense.solve_batch_graph`'s contract."""
+    return time_batch_native(g, pairs, repeats=1)[1]
+
+
+def time_batch_native(
+    g: NativeGraph, pairs, *, repeats: int = 5
+) -> tuple[list[float], list[BFSResult]]:
+    """Batch timing protocol for the native backend: ``repeats`` whole-
+    batch passes, median stamped into every result's ``time_s``."""
+    import time
+
+    if repeats < 1:
+        raise ValueError(f"repeats must be >= 1, got {repeats}")
+    pairs = np.asarray(pairs, dtype=np.int64).reshape(-1, 2)
+    times = []
+    results: list[BFSResult] = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        results = [solve_native_graph(g, int(s), int(d)) for s, d in pairs]
+        times.append(time.perf_counter() - t0)
+    med = float(np.median(times))
+    return times, [dataclasses.replace(r, time_s=med) for r in results]
+
+
 # Load (building if needed) at import time so a missing C++ toolchain
 # surfaces as an OSError HERE — where solve()'s lazy-import catch turns it
 # into "backend 'native' unavailable" — instead of escaping from the first

@@ -123,3 +123,34 @@ def test_scratch_reuse_many_queries_match_oracle():
         if want.found:
             assert got.hops == want.hops
             got.validate_path(n, edges, s, d)
+
+
+def test_native_batch_matches_oracle():
+    import numpy as np
+
+    from bibfs_tpu.graph.csr import build_csr
+    from bibfs_tpu.graph.generate import gnp_random_graph
+    from bibfs_tpu.solvers.native import (
+        NativeGraph,
+        solve_batch_native_graph,
+        time_batch_native,
+    )
+    from bibfs_tpu.solvers.serial import solve_serial_csr
+
+    n = 1500
+    edges = gnp_random_graph(n, 3.0 / n, seed=8)
+    g = NativeGraph.build(n, edges)
+    row_ptr, col_ind = build_csr(n, edges)
+    rng = np.random.default_rng(1)
+    pairs = rng.integers(0, n, size=(12, 2))
+    results = solve_batch_native_graph(g, pairs)
+    assert len(results) == 12
+    batch_time = results[0].time_s
+    for (s, d), got in zip(pairs, results):
+        want = solve_serial_csr(n, row_ptr, col_ind, int(s), int(d))
+        assert got.found == want.found
+        if want.found:
+            assert got.hops == want.hops
+        assert got.time_s == batch_time  # whole-batch wall on every result
+    times, timed = time_batch_native(g, pairs, repeats=3)
+    assert len(times) == 3 and len(timed) == 12
