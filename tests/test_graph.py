@@ -178,3 +178,35 @@ def test_tiered_hub_rank_is_degree_descending_prefix():
         assert (g.deg[members] > t.start).all()
         # nested membership: ranks below t.count are exactly the members
         assert (g.hub_rank[members] == np.arange(t.count)).all()
+
+
+def test_messy_edge_lists_all_backends_agree():
+    """Self-loops and duplicate/reversed duplicate edges in the input edge
+    list must not change any backend's answer (the CSR/ELL builders
+    canonicalize; the reference never guarded this)."""
+    from bibfs_tpu.solvers.api import solve
+    from bibfs_tpu.solvers.serial import solve_serial
+
+    rng = np.random.default_rng(3)
+    n = 120
+    base = rng.integers(0, n, size=(260, 2))
+    messy = np.vstack(
+        [
+            base,
+            base[:40],          # exact duplicates
+            base[:40, ::-1],    # reversed duplicates
+            np.stack([np.arange(10), np.arange(10)], axis=1),  # self-loops
+        ]
+    )
+    clean = base[base[:, 0] != base[:, 1]]
+    from bibfs_tpu.cli.bench import available_backends
+
+    backends = available_backends()  # skip-friendly on minimal installs
+    for src, dst in [(0, n - 1), (3, 77)]:
+        want = solve_serial(n, clean, src, dst)
+        for backend in backends:
+            got = solve(backend, n, messy, src, dst)
+            assert got.found == want.found, (backend, src, dst)
+            if want.found:
+                assert got.hops == want.hops, (backend, src, dst)
+                got.validate_path(n, clean, src, dst)
