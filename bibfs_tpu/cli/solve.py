@@ -69,6 +69,29 @@ def main(argv=None):
         "off-TPU)",
     )
     ap.add_argument(
+        "--checkpoint",
+        default=None,
+        metavar="FILE",
+        help="dense/sharded backends: run the search in chunks and snapshot "
+        "the device state to FILE after every chunk (atomic .npz); with "
+        "--resume, continue a previous search from FILE instead of "
+        "restarting (the snapshot is backend/mesh-portable)",
+    )
+    ap.add_argument(
+        "--chunk",
+        type=int,
+        default=None,
+        metavar="K",
+        help="levels per dispatch for the checkpointed path (default 8); "
+        "implies chunked execution even without --checkpoint",
+    )
+    ap.add_argument(
+        "--resume",
+        action="store_true",
+        help="resume the search from --checkpoint FILE (src/dst must match "
+        "the snapshot's fingerprint)",
+    )
+    ap.add_argument(
         "--layout",
         default="ell",
         choices=["ell", "tiered"],
@@ -106,6 +129,22 @@ def main(argv=None):
             ap.error("--pairs replaces the positional src/dst arguments")
     elif args.src is None or args.dst is None:
         ap.error("src and dst are required (or use --pairs FILE)")
+    checkpointed = (
+        args.checkpoint is not None or args.chunk is not None or args.resume
+    )
+    if checkpointed:
+        if args.backend not in ("dense", "sharded"):
+            ap.error("--checkpoint/--chunk/--resume need --backend dense "
+                     "or sharded (host backends finish in one shot)")
+        if args.pairs is not None or args.repeat > 1:
+            ap.error("--checkpoint/--chunk are single-query (no --pairs / "
+                     "--repeat)")
+        if args.resume and args.checkpoint is None:
+            ap.error("--resume needs --checkpoint FILE to resume from")
+        if args.chunk is not None and args.chunk < 1:
+            ap.error("--chunk must be >= 1")
+        if args.mode.startswith("pallas") and args.backend == "sharded":
+            ap.error("pallas modes are single-chip (dense backend) only")
     kwargs = {}
     if args.devices is not None:
         kwargs["num_devices"] = args.devices
@@ -124,6 +163,8 @@ def main(argv=None):
     try:
         if args.pairs is not None:
             return _batch_main(args, n, edges, tracer)
+        if checkpointed:
+            return _checkpoint_main(args, n, edges, tracer)
         with tracer():
             if args.repeat > 1:
                 # shared protocol: graph/JIT warm-up excluded, zero-D2H
@@ -158,6 +199,50 @@ def main(argv=None):
     # scrapeable time line (same shape as v1/main-v1.cpp:101)
     print(f"[Time] {args.backend} bidirectional BFS took {res.time_s:.9f} seconds")
     print(f"[TEPS] {res.teps:.3e} traversed edges/second ({res.edges_scanned} edges)")
+    return 0
+
+
+def _checkpoint_main(args, n, edges, tracer):
+    from bibfs_tpu.solvers.checkpoint import resume, solve_checkpointed
+
+    if args.backend == "sharded":
+        from bibfs_tpu.parallel.mesh import make_1d_mesh
+        from bibfs_tpu.solvers.sharded import ShardedGraph
+
+        g = ShardedGraph.build(
+            n, edges, make_1d_mesh(args.devices), layout=args.layout
+        )
+    else:
+        from bibfs_tpu.solvers.dense import DeviceGraph
+
+        g = DeviceGraph.build(n, edges, layout=args.layout)
+    chunk = args.chunk if args.chunk is not None else 8
+    with tracer():
+        if args.resume:
+            res = resume(
+                args.checkpoint, g, src=args.src, dst=args.dst,
+                mode=args.mode, chunk=chunk,
+            )
+        else:
+            res = solve_checkpointed(
+                g, args.src, args.dst, mode=args.mode, chunk=chunk,
+                path=args.checkpoint,
+            )
+    if res.found:
+        print(f"Shortest path length = {res.hops}")
+        if res.path and not args.no_path:
+            print("Path: " + " -> ".join(str(v) for v in res.path))
+    else:
+        print("No path found.")
+    print(
+        f"[Time] {args.backend} bidirectional BFS took {res.time_s:.9f} seconds"
+    )
+    print(
+        f"[TEPS] {res.teps:.3e} traversed edges/second "
+        f"({res.edges_scanned} edges)"
+    )
+    if args.checkpoint:
+        print(f"[Checkpoint] {args.checkpoint} (chunk={chunk} levels)")
     return 0
 
 

@@ -1,0 +1,434 @@
+"""Checkpoint/resume for long searches — chunked execution with periodic
+host sync and crash-durable state snapshots.
+
+The reference has no checkpointing of any kind (SURVEY.md §5: runs are
+seconds-long, state is never persisted) and its device solvers return to
+the host every level anyway (quirk Q5). This framework's solvers are the
+opposite extreme: the WHOLE search is one ``lax.while_loop`` and the host
+syncs once at the end. At 10M-node scale (the regime the reference's own
+README names as the goal it never reached) a search is long enough that a
+preemption, an OOM on a later level, or a dropped TPU tunnel loses
+everything. This module adds the middle strategy:
+
+- run the SAME loop body (``solvers.dense._make_body`` /
+  ``solvers.sharded._make_shard_body`` — shared code, so the chunked
+  search cannot diverge algorithmically from the one-shot search) in
+  bounded chunks of ``chunk`` levels per dispatch via
+  ``lax.while_loop((cond & steps < chunk))``;
+- between chunks, read the three termination scalars on the host (one
+  tiny D2H — this is also the "periodic host sync" pattern from
+  SURVEY.md §2's TPU mapping) and atomically snapshot the carry to an
+  ``.npz`` (write-temp + ``os.replace``, so a crash mid-write never
+  corrupts the previous checkpoint);
+- on restart, :func:`resume` reloads the snapshot and continues from the
+  exact level where the last completed chunk ended.
+
+The snapshot holds only the PORTABLE carry — per-vertex
+frontier/parent/distance arrays plus replicated scalars; the transient
+push-path compaction (``fi``/``ok``) is rebuilt on chunk entry. That makes
+checkpoints **backend- and mesh-elastic**: a search checkpointed from the
+single-chip dense solver resumes on a sharded mesh of any divisor size
+(or vice versa), because state is re-padded and re-sharded to fit the
+resuming graph. The reference's closest analog is "rerun the binary"
+(MPI_Abort on failure, second_try.cpp:35).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from bibfs_tpu.solvers.api import BFSResult
+from bibfs_tpu.solvers.dense import (
+    DENSE_MODES,
+    INF32,
+    DeviceGraph,
+    _check_mode_layout,
+    _cond,
+    _make_body,
+    _materialize,
+    kernel_cap,
+)
+
+CKPT_VERSION = 1
+# the portable carry: everything the search needs across a chunk boundary.
+# fi/ok (push-path compaction) are deliberately absent — they are rebuilt
+# on chunk entry, which keeps snapshots mesh-size independent.
+_VERTEX_KEYS = ("fr_s", "fr_t", "par_s", "par_t", "dist_s", "dist_t")
+_SCALAR_KEYS = (
+    "cnt_s", "cnt_t", "md_s", "md_t", "lvl_s", "lvl_t",
+    "best", "meet", "levels", "edges",
+)
+_STATE_KEYS = _VERTEX_KEYS + _SCALAR_KEYS
+
+
+# ---------------------------------------------------------------- kernels
+
+
+def _strip(st: dict) -> dict:
+    return {k: v for k, v in st.items() if k in _STATE_KEYS}
+
+
+def _with_transients(st: dict, k: int, *, axis: str | None = None) -> dict:
+    """Re-add the transient push-compaction state dropped at the chunk
+    boundary: ``ok=False`` makes the push path rebuild its index list from
+    the boolean frontier on first use."""
+    st = dict(st)
+    for side in ("s", "t"):
+        fi = jnp.full(k, -1, jnp.int32)
+        if axis is not None:
+            # same vma pinning as the sharded seed: fi's provenance
+            # alternates between constants and all_gather products across
+            # cond branches, so pin it to device-varying
+            fi = jax.lax.pcast(fi, axis, to="varying")
+        st[f"fi_{side}"] = fi
+        st[f"ok_{side}"] = jnp.bool_(False)
+    return st
+
+
+@lru_cache(maxsize=None)
+def _dense_chunk_kernel(mode: str, push_cap: int, tier_meta: tuple, chunk: int):
+    """jitted ``(nbr, deg, aux, state) -> state`` advancing at most
+    ``chunk`` rounds of the dense search."""
+    _check_mode_layout(mode, tier_meta)
+    cap = push_cap if DENSE_MODES[mode][1] else 0
+    k = max(cap, 1)
+
+    def kernel(nbr, deg, aux, st):
+        body = _make_body(mode, cap, tier_meta, nbr, deg, aux)
+
+        def cond2(c):
+            return _cond(c[0]) & (c[1] < chunk)
+
+        def body2(c):
+            return body(c[0]), c[1] + 1
+
+        st, _steps = jax.lax.while_loop(
+            cond2, body2, (_with_transients(st, k), jnp.int32(0))
+        )
+        return _strip(st)
+
+    return jax.jit(kernel)
+
+
+@lru_cache(maxsize=None)
+def _sharded_chunk_kernel(
+    mesh, axis: str, mode: str, push_cap: int, tier_meta: tuple, chunk: int
+):
+    """shard_map'd ``(nbr, deg, aux, state) -> state`` advancing at most
+    ``chunk`` rounds of the multi-chip search. Vertex state shards with the
+    graph; scalars stay replicated."""
+    from bibfs_tpu.solvers.sharded import (
+        SHARDED_MODES,
+        _make_shard_body,
+        _shard_cond,
+    )
+
+    if SHARDED_MODES[mode][2]:
+        raise ValueError("pallas modes are single-chip (dense backend) only")
+    hybrid = SHARDED_MODES[mode][1]
+    cap = push_cap if hybrid else 0
+    k = max(cap, 1)
+    sh = P(axis)
+    rep = P()
+    aux_spec = (sh, tuple((sh, sh, rep) for _ in tier_meta)) if tier_meta else ()
+    st_spec = {key: sh for key in _VERTEX_KEYS}
+    st_spec.update({key: rep for key in _SCALAR_KEYS})
+
+    def fn(nbr, deg, aux, st):
+        body = _make_shard_body(
+            nbr, deg, aux, axis=axis, mode=mode, push_cap=cap,
+            tier_meta=tier_meta,
+        )
+
+        def cond2(c):
+            return _shard_cond(c[0]) & (c[1] < chunk)
+
+        def body2(c):
+            return body(c[0]), c[1] + 1
+
+        st, _steps = jax.lax.while_loop(
+            cond2, body2, (_with_transients(st, k, axis=axis), jnp.int32(0))
+        )
+        return _strip(st)
+
+    return jax.jit(
+        jax.shard_map(
+            fn,
+            mesh=mesh,
+            in_specs=(sh, sh, aux_spec, st_spec),
+            out_specs=dict(st_spec),
+        )
+    )
+
+
+# ------------------------------------------------------- state lifecycle
+
+
+def _init_state_np(n_pad: int, src: int, dst: int, deg_src: int, deg_dst: int):
+    """Fresh portable carry as host arrays (level 0, both seeds placed)."""
+    st = {}
+    for side, v, d in (("s", src, deg_src), ("t", dst, deg_dst)):
+        fr = np.zeros(n_pad, dtype=bool)
+        fr[v] = True
+        dist = np.full(n_pad, INF32, dtype=np.int32)
+        dist[v] = 0
+        st[f"fr_{side}"] = fr
+        st[f"par_{side}"] = np.full(n_pad, -1, dtype=np.int32)
+        st[f"dist_{side}"] = dist
+        st[f"cnt_{side}"] = np.int32(1)
+        st[f"md_{side}"] = np.int32(d)
+        st[f"lvl_{side}"] = np.int32(0)
+    st["best"] = np.int32(0 if src == dst else INF32)
+    st["meet"] = np.int32(src if src == dst else -1)
+    st["levels"] = np.int32(0)
+    st["edges"] = np.int32(0)
+    return st
+
+
+def _refit(state: dict, n_pad: int) -> dict:
+    """Re-pad the per-vertex arrays to a new padded size (mesh elasticity:
+    dense pads to 8, an 8-device mesh to 64). Padded rows are inert by
+    construction (degree 0, unreachable), so growing adds inert rows and
+    shrinking requires the dropped tail to be inert."""
+    old = state["fr_s"].shape[0]
+    if old == n_pad:
+        return state
+    out = dict(state)
+    fills = {"fr": False, "par": -1, "dist": INF32}
+    for key in _VERTEX_KEYS:
+        arr = state[key]
+        fill = fills[key.split("_")[0]]
+        if n_pad > old:
+            out[key] = np.concatenate(
+                [arr, np.full(n_pad - old, fill, dtype=arr.dtype)]
+            )
+        else:
+            tail = arr[n_pad:]
+            inert = (
+                not tail.any()
+                if key.startswith("fr")
+                else (tail >= INF32).all() if key.startswith("dist")
+                else True
+            )
+            if not inert:
+                raise ValueError(
+                    f"cannot shrink checkpoint state from n_pad={old} to "
+                    f"{n_pad}: {key} has live entries in the dropped tail"
+                )
+            out[key] = np.ascontiguousarray(arr[:n_pad])
+    return out
+
+
+def _put_state(state: dict, g) -> dict:
+    """Host carry -> device carry with the graph's shardings (sharded
+    vertex arrays on a ShardedGraph, plain device arrays otherwise)."""
+    from bibfs_tpu.parallel.mesh import replicated_spec, shard_spec
+
+    state = _refit(state, g.n_pad)
+    dev = {}
+    if hasattr(g, "mesh"):
+        vspec = shard_spec(g.mesh)
+        sspec = replicated_spec(g.mesh)
+        for key in _VERTEX_KEYS:
+            dev[key] = jax.device_put(state[key], vspec)
+        for key in _SCALAR_KEYS:
+            dev[key] = jax.device_put(np.int32(state[key]), sspec)
+    else:
+        for key in _VERTEX_KEYS:
+            dev[key] = jax.device_put(state[key])
+        for key in _SCALAR_KEYS:
+            dev[key] = jax.device_put(np.int32(state[key]))
+    return dev
+
+
+def _fetch_state(st: dict) -> dict:
+    return {key: np.asarray(st[key]) for key in _STATE_KEYS}
+
+
+# ----------------------------------------------------------- persistence
+
+
+@dataclasses.dataclass
+class CheckpointMeta:
+    """Identity + progress of a snapshot. ``n``/``num_edges``/``src``/
+    ``dst`` fingerprint the search (resuming against a different graph or
+    query is refused); ``mode`` is the schedule it ran under (resume may
+    override it — the level-synchronous carry is schedule-portable)."""
+
+    n: int
+    num_edges: int
+    src: int
+    dst: int
+    mode: str
+    levels: int
+    elapsed_s: float = 0.0  # search seconds accumulated across resumes
+    version: int = CKPT_VERSION
+
+    def check(self, g, src: int, dst: int) -> None:
+        if self.version != CKPT_VERSION:
+            raise ValueError(
+                f"checkpoint version {self.version} != {CKPT_VERSION}"
+            )
+        mine = (g.n, g.num_edges, src, dst)
+        theirs = (self.n, self.num_edges, self.src, self.dst)
+        if mine != theirs:
+            raise ValueError(
+                f"checkpoint fingerprint mismatch: file has (n, edges, src, "
+                f"dst)={theirs}, caller has {mine}"
+            )
+
+
+def save_checkpoint(path: str, state: dict, meta: CheckpointMeta) -> None:
+    """Atomic snapshot: write ``<path>.tmp`` then ``os.replace`` — a crash
+    mid-write leaves the previous checkpoint intact."""
+    tmp = f"{path}.tmp"
+    with open(tmp, "wb") as f:
+        np.savez(
+            f,
+            _meta=np.bytes_(json.dumps(dataclasses.asdict(meta))),
+            **state,
+        )
+    os.replace(tmp, path)
+
+
+def load_checkpoint(path: str) -> tuple[CheckpointMeta, dict]:
+    with np.load(path) as z:
+        meta = CheckpointMeta(**json.loads(bytes(z["_meta"].item()).decode()))
+        state = {key: z[key] for key in _STATE_KEYS}
+    return meta, state
+
+
+def _deg_at(g, v: int) -> int:
+    """Seed degree for the initial carry. A sharded array can't be indexed
+    eagerly (gather output sharding is ambiguous) — ask for a replicated
+    result explicitly."""
+    if hasattr(g, "mesh"):
+        from bibfs_tpu.parallel.mesh import replicated_spec
+
+        return int(g.deg.at[jnp.int32(v)].get(out_sharding=replicated_spec(g.mesh)))
+    return int(jax.device_get(g.deg[v]))
+
+
+# ---------------------------------------------------------------- driver
+
+
+def _get_chunk_kernel(g, mode: str, chunk: int):
+    from bibfs_tpu.parallel.mesh import VERTEX_AXIS
+
+    cap = kernel_cap(mode, g.n_pad)
+    if hasattr(g, "mesh"):
+        kern = _sharded_chunk_kernel(
+            g.mesh, VERTEX_AXIS, mode, cap, g.tier_meta, chunk
+        )
+    else:
+        kern = _dense_chunk_kernel(mode, cap, g.tier_meta, chunk)
+    return kern
+
+
+def _drive(g, state_np, meta, *, mode, chunk, path, max_chunks):
+    """The chunk loop: dispatch -> host-read the termination scalars ->
+    snapshot -> repeat. Returns a BFSResult, or None when ``max_chunks``
+    ran out first (state is durable in ``path`` if one was given)."""
+    if chunk < 1:
+        raise ValueError(f"chunk must be >= 1, got {chunk}")
+    kern = _get_chunk_kernel(g, mode, chunk)
+    st = _put_state(state_np, g)
+    base_s = meta.elapsed_s  # search time accumulated by prior runs
+    t0 = time.perf_counter()
+    chunks = 0
+    while True:
+        st = kern(g.nbr, g.deg, g.aux, st)
+        # periodic host sync: three scalars decide termination (the same
+        # predicate as the in-loop cond). Reading them also FORCES
+        # execution of the queued chunk (solvers/timing.py laziness note).
+        best = int(st["best"])
+        running = (
+            int(st["lvl_s"]) + int(st["lvl_t"]) < best
+            and int(st["cnt_s"]) > 0
+            and int(st["cnt_t"]) > 0
+        )
+        chunks += 1
+        if path is not None:
+            meta = dataclasses.replace(
+                meta,
+                levels=int(st["levels"]),
+                elapsed_s=base_s + (time.perf_counter() - t0),
+            )
+            save_checkpoint(path, _fetch_state(st), meta)
+        if not running:
+            break
+        if max_chunks is not None and chunks >= max_chunks:
+            return None
+    # cumulative across resumes, so levels/edges/time stay consistent and
+    # the reported TEPS describes the WHOLE search
+    elapsed = base_s + (time.perf_counter() - t0)
+    out = (
+        st["best"], st["meet"], st["par_s"], st["par_t"],
+        st["levels"], st["edges"],
+    )
+    return _materialize(out, elapsed)
+
+
+def solve_checkpointed(
+    g,
+    src: int,
+    dst: int,
+    *,
+    mode: str = "sync",
+    chunk: int = 8,
+    path: str | None = None,
+    max_chunks: int | None = None,
+) -> BFSResult | None:
+    """Chunked search on a :class:`~bibfs_tpu.solvers.dense.DeviceGraph` or
+    :class:`~bibfs_tpu.solvers.sharded.ShardedGraph`: at most ``chunk``
+    rounds per dispatch, snapshotting to ``path`` after every chunk.
+    Returns the result, or ``None`` if ``max_chunks`` chunks ran out first
+    (resume later with :func:`resume`). ``path=None`` gives pure chunked
+    execution (periodic host sync, no disk)."""
+    if not (0 <= src < g.n and 0 <= dst < g.n):
+        raise ValueError(f"src/dst out of range for n={g.n}")
+    deg_src = _deg_at(g, src)
+    deg_dst = _deg_at(g, dst)
+    state = _init_state_np(g.n_pad, src, dst, deg_src, deg_dst)
+    meta = CheckpointMeta(
+        n=g.n, num_edges=g.num_edges, src=src, dst=dst, mode=mode, levels=0
+    )
+    return _drive(
+        g, state, meta, mode=mode, chunk=chunk, path=path,
+        max_chunks=max_chunks,
+    )
+
+
+def resume(
+    path: str,
+    g,
+    *,
+    src: int,
+    dst: int,
+    mode: str | None = None,
+    chunk: int = 8,
+    max_chunks: int | None = None,
+) -> BFSResult | None:
+    """Continue a checkpointed search from its last completed chunk. ``g``
+    may be a different backend or mesh size than the one that wrote the
+    snapshot (state is re-padded/re-sharded); ``src``/``dst`` must match
+    the file's fingerprint. ``mode=None`` keeps the snapshot's schedule.
+
+    The resumed result's ``time_s`` and per-run counters (``levels``,
+    ``edges_scanned``) are cumulative across the original run and the
+    resume — the search continues, it does not restart."""
+    meta, state = load_checkpoint(path)
+    meta.check(g, src, dst)
+    return _drive(
+        g, state, meta, mode=mode or meta.mode, chunk=chunk, path=path,
+        max_chunks=max_chunks,
+    )

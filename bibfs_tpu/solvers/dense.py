@@ -375,6 +375,77 @@ def kernel_cap(mode: str, n_pad: int) -> int:
     return _auto_push_cap(n_pad) if DENSE_MODES[mode][1] else 0
 
 
+def _make_body(mode: str, cap: int, tier_meta, nbr, deg, aux):
+    """The while_loop body ``st -> st`` for (mode, cap, tier layout) over
+    the given device graph arrays — shared by the one-shot kernel below and
+    the chunked/checkpointed kernel (:mod:`bibfs_tpu.solvers.checkpoint`),
+    so the two execution strategies cannot diverge algorithmically."""
+    schedule, hybrid, use_pallas = DENSE_MODES[mode]
+
+    def step(st, side):
+        return _side_step(
+            st, side, nbr, deg, aux, tier_meta,
+            push_cap=cap, use_pallas=use_pallas,
+        )
+
+    if schedule == "sync" and not hybrid and not use_pallas:
+        # pull-only lock-step: fuse both sides' expansions so every
+        # neighbor table (base + hub tiers) is gathered ONCE per round
+        # for both searches — half the HBM traffic of two sequential
+        # pulls, the dominant cost of a pull round
+        full_tiers = _full_tiers(aux, tier_meta)
+
+        def body(st):
+            scanned = frontier_degree_sum(
+                st["fr_s"], deg
+            ) + frontier_degree_sum(st["fr_t"], deg)
+            nf_s, par_s, dist_s, md_s, nf_t, par_t, dist_t, md_t = (
+                expand_pull_dual_tiered(
+                    st["fr_s"], st["fr_t"],
+                    st["par_s"], st["dist_s"], st["par_t"], st["dist_t"],
+                    nbr, deg, full_tiers,
+                    st["lvl_s"] + 1, st["lvl_t"] + 1, inf=INF32,
+                )
+            )
+            st = {
+                **st,
+                "fr_s": nf_s, "par_s": par_s, "dist_s": dist_s,
+                "md_s": md_s, "cnt_s": frontier_count(nf_s),
+                "lvl_s": st["lvl_s"] + 1, "ok_s": jnp.bool_(False),
+                "fr_t": nf_t, "par_t": par_t, "dist_t": dist_t,
+                "md_t": md_t, "cnt_t": frontier_count(nf_t),
+                "lvl_t": st["lvl_t"] + 1, "ok_t": jnp.bool_(False),
+                "edges": st["edges"] + scanned,
+            }
+            return _meet_vote(st, 2)
+
+    elif schedule == "sync":
+
+        def body(st):
+            return _meet_vote(step(step(st, "s"), "t"), 2)
+
+    else:
+
+        def body(st):
+            st = jax.lax.cond(
+                st["cnt_s"] <= st["cnt_t"],
+                lambda st: step(st, "s"),
+                lambda st: step(st, "t"),
+                st,
+            )
+            return _meet_vote(st, 1)
+
+    return body
+
+
+def _check_mode_layout(mode: str, tier_meta: tuple) -> None:
+    if DENSE_MODES[mode][2] and tier_meta:
+        raise ValueError(
+            "pallas modes support the plain ELL layout only (the fused "
+            "kernel has no hub-tier path yet); use layout='ell'"
+        )
+
+
 def _build_kernel(mode: str, push_cap: int, tier_meta: tuple = ()):
     """Build the (unjitted) search kernel for (mode, push_cap, tier layout):
     ``fn(nbr, deg, aux, src, dst) -> (best, meet, parent_s, parent_t,
@@ -383,72 +454,14 @@ def _build_kernel(mode: str, push_cap: int, tier_meta: tuple = ()):
     search is one ``lax.while_loop`` in one XLA program — state never
     leaves HBM and the host syncs exactly once at the end (versus per-level
     host round-trips, quirk Q5)."""
-    schedule, hybrid, use_pallas = DENSE_MODES[mode]
-    if use_pallas and tier_meta:
-        raise ValueError(
-            "pallas modes support the plain ELL layout only (the fused "
-            "kernel has no hub-tier path yet); use layout='ell'"
-        )
-    cap = push_cap if hybrid else 0
+    _check_mode_layout(mode, tier_meta)
+    cap = push_cap if DENSE_MODES[mode][1] else 0
     k = max(cap, 1)
 
     def kernel(nbr, deg, aux, src, dst):
         n_pad = nbr.shape[0]
         init = _init_state(n_pad, k, src, dst, deg)
-
-        def step(st, side):
-            return _side_step(
-                st, side, nbr, deg, aux, tier_meta,
-                push_cap=cap, use_pallas=use_pallas,
-            )
-
-        if schedule == "sync" and not hybrid and not use_pallas:
-            # pull-only lock-step: fuse both sides' expansions so every
-            # neighbor table (base + hub tiers) is gathered ONCE per round
-            # for both searches — half the HBM traffic of two sequential
-            # pulls, the dominant cost of a pull round
-            full_tiers = _full_tiers(aux, tier_meta)
-
-            def body(st):
-                scanned = frontier_degree_sum(
-                    st["fr_s"], deg
-                ) + frontier_degree_sum(st["fr_t"], deg)
-                nf_s, par_s, dist_s, md_s, nf_t, par_t, dist_t, md_t = (
-                    expand_pull_dual_tiered(
-                        st["fr_s"], st["fr_t"],
-                        st["par_s"], st["dist_s"], st["par_t"], st["dist_t"],
-                        nbr, deg, full_tiers,
-                        st["lvl_s"] + 1, st["lvl_t"] + 1, inf=INF32,
-                    )
-                )
-                st = {
-                    **st,
-                    "fr_s": nf_s, "par_s": par_s, "dist_s": dist_s,
-                    "md_s": md_s, "cnt_s": frontier_count(nf_s),
-                    "lvl_s": st["lvl_s"] + 1, "ok_s": jnp.bool_(False),
-                    "fr_t": nf_t, "par_t": par_t, "dist_t": dist_t,
-                    "md_t": md_t, "cnt_t": frontier_count(nf_t),
-                    "lvl_t": st["lvl_t"] + 1, "ok_t": jnp.bool_(False),
-                    "edges": st["edges"] + scanned,
-                }
-                return _meet_vote(st, 2)
-
-        elif schedule == "sync":
-
-            def body(st):
-                return _meet_vote(step(step(st, "s"), "t"), 2)
-
-        else:
-
-            def body(st):
-                st = jax.lax.cond(
-                    st["cnt_s"] <= st["cnt_t"],
-                    lambda st: step(st, "s"),
-                    lambda st: step(st, "t"),
-                    st,
-                )
-                return _meet_vote(st, 1)
-
+        body = _make_body(mode, cap, tier_meta, nbr, deg, aux)
         return _outputs(jax.lax.while_loop(_cond, body, init))
 
     return kernel

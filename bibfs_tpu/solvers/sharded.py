@@ -60,31 +60,25 @@ from bibfs_tpu.solvers.dense import (
 from bibfs_tpu.solvers.dense import DENSE_MODES as SHARDED_MODES  # same matrix
 
 
-def _bibfs_shard_body(
+def _make_shard_body(
     nbr,
     deg,
     aux,
-    src,
-    dst,
     *,
     axis: str,
     mode: str = "sync",
     push_cap: int = 0,
     tier_meta: tuple = (),
 ):
-    """The per-device program. ``nbr``/``deg`` are the LOCAL vertex shard;
-    ``src``/``dst`` are replicated scalars; ``aux`` is ``()`` for plain ELL
-    or ``(hub_rank_shard, ((tier_nbr_shard, tier_slots_shard,
-    hub_ids_replicated), ...))`` for the tiered layout (tier tables sharded
-    by hub rank). ``mode="sync"`` expands both sides every round (half the
-    sequential rounds — the latency-bound default); ``mode="alt"`` expands
-    the globally-smaller frontier only (fewer total edge scans, v1/v4's
-    direction optimization). ``push_cap > 0`` enables Beamer push/pull
-    direction optimization: frontiers at most that wide (whose max degree
-    fits the static push span) skip the n-bool frontier all_gather entirely
-    and instead exchange only their candidate edges over ICI, so per-level
-    traffic scales with the frontier, not the graph.
-    """
+    """Build the per-device while_loop body ``st -> st`` over the LOCAL
+    vertex shard — shared by the one-shot program below and the
+    chunked/checkpointed program (:mod:`bibfs_tpu.solvers.checkpoint`), so
+    the two execution strategies cannot diverge. ``push_cap > 0`` enables
+    Beamer push/pull direction optimization: frontiers at most that wide
+    (whose max degree fits the static push span) skip the n-bool frontier
+    all_gather entirely and instead exchange only their candidate edges
+    over ICI, so per-level traffic scales with the frontier, not the
+    graph."""
     n_loc = nbr.shape[0]
     width = nbr.shape[1]
     k = max(push_cap, 1)
@@ -95,48 +89,6 @@ def _bibfs_shard_body(
     full_tiers = tuple(zip(tier_meta, tiers))
     span, ncov = push_span(width, tier_meta)  # shared Beamer gate rule
     push_tiers = full_tiers[:ncov]
-
-    def seed(v):
-        fr = ids == v
-        return dict(
-            fr=fr,
-            # fi holds the replicated global frontier-index list, but its
-            # provenance alternates between constants (seed), all_gather
-            # products (push), and carries (pull) — pin the vma to varying
-            # so every cond branch agrees (same reason as par below)
-            fi=jax.lax.pcast(
-                jnp.full(k, -1, jnp.int32).at[0].set(v.astype(jnp.int32)),
-                axis,
-                to="varying",
-            ),
-            ok=jnp.bool_(True),
-            cnt=jnp.int32(1),
-            md=sum_allreduce(jnp.sum(jnp.where(fr, deg, 0)), axis),
-            # parents start as constants; mark them device-varying so both
-            # lax.cond branches (only one of which writes each side) agree
-            par=jax.lax.pcast(jnp.full(n_loc, -1, jnp.int32), axis, to="varying"),
-            dist=jnp.where(fr, 0, INF32).astype(jnp.int32),
-            lvl=jnp.int32(0),
-        )
-
-    init = {f"{key}_s": val for key, val in seed(src).items()}
-    init.update({f"{key}_t": val for key, val in seed(dst).items()})
-    init.update(
-        best=jnp.where(src == dst, 0, INF32).astype(jnp.int32),
-        meet=jnp.where(src == dst, src, -1).astype(jnp.int32),
-        levels=jnp.int32(0),
-        edges=jnp.int32(0),
-    )
-
-    def cond(st):
-        # all scalars replicated — every device votes identically
-        # (the v2 termination votes, second_try.cpp:117-128, without the
-        # per-level Allreduce SUM pair: counts ride the carry)
-        return (
-            (st["lvl_s"] + st["lvl_t"] < st["best"])
-            & (st["cnt_s"] > 0)
-            & (st["cnt_t"] > 0)
-        )
 
     def pull(c):
         fr, fi, _ok, par, dist, lvl = c
@@ -324,7 +276,84 @@ def _bibfs_shard_body(
             )
             return meet_vote(st, 1)
 
-    out = jax.lax.while_loop(cond, body, init)
+    return body
+
+
+def _shard_cond(st):
+    # all scalars replicated — every device votes identically
+    # (the v2 termination votes, second_try.cpp:117-128, without the
+    # per-level Allreduce SUM pair: counts ride the carry)
+    return (
+        (st["lvl_s"] + st["lvl_t"] < st["best"])
+        & (st["cnt_s"] > 0)
+        & (st["cnt_t"] > 0)
+    )
+
+
+def _bibfs_shard_body(
+    nbr,
+    deg,
+    aux,
+    src,
+    dst,
+    *,
+    axis: str,
+    mode: str = "sync",
+    push_cap: int = 0,
+    tier_meta: tuple = (),
+):
+    """The per-device program. ``nbr``/``deg`` are the LOCAL vertex shard;
+    ``src``/``dst`` are replicated scalars; ``aux`` is ``()`` for plain ELL
+    or ``(hub_rank_shard, ((tier_nbr_shard, tier_slots_shard,
+    hub_ids_replicated), ...))`` for the tiered layout (tier tables sharded
+    by hub rank). ``mode="sync"`` expands both sides every round (half the
+    sequential rounds — the latency-bound default); ``mode="alt"`` expands
+    the globally-smaller frontier only (fewer total edge scans, v1/v4's
+    direction optimization).
+    """
+    n_loc = nbr.shape[0]
+    k = max(push_cap, 1)
+    me = jax.lax.axis_index(axis)
+    offset = (me * n_loc).astype(jnp.int32)
+    ids = offset + jnp.arange(n_loc, dtype=jnp.int32)  # my global vertex ids
+
+    def seed(v):
+        fr = ids == v
+        return dict(
+            fr=fr,
+            # fi holds the replicated global frontier-index list, but its
+            # provenance alternates between constants (seed), all_gather
+            # products (push), and carries (pull) — pin the vma to varying
+            # so every cond branch agrees (same reason as par below)
+            fi=jax.lax.pcast(
+                jnp.full(k, -1, jnp.int32).at[0].set(v.astype(jnp.int32)),
+                axis,
+                to="varying",
+            ),
+            ok=jnp.bool_(True),
+            cnt=jnp.int32(1),
+            md=sum_allreduce(jnp.sum(jnp.where(fr, deg, 0)), axis),
+            # parents start as constants; mark them device-varying so both
+            # lax.cond branches (only one of which writes each side) agree
+            par=jax.lax.pcast(jnp.full(n_loc, -1, jnp.int32), axis, to="varying"),
+            dist=jnp.where(fr, 0, INF32).astype(jnp.int32),
+            lvl=jnp.int32(0),
+        )
+
+    init = {f"{key}_s": val for key, val in seed(src).items()}
+    init.update({f"{key}_t": val for key, val in seed(dst).items()})
+    init.update(
+        best=jnp.where(src == dst, 0, INF32).astype(jnp.int32),
+        meet=jnp.where(src == dst, src, -1).astype(jnp.int32),
+        levels=jnp.int32(0),
+        edges=jnp.int32(0),
+    )
+
+    body = _make_shard_body(
+        nbr, deg, aux, axis=axis, mode=mode, push_cap=push_cap,
+        tier_meta=tier_meta,
+    )
+    out = jax.lax.while_loop(_shard_cond, body, init)
     return (
         out["best"],
         out["meet"],

@@ -22,6 +22,10 @@ oracle, then times:
 
 Rows append to SCALE_RESULTS.csv: wall-clock (median of repeats, search
 only), TEPS, hop parity vs the oracle, and peak host RSS.
+
+``--configs`` reruns a subset (e.g. ``--configs dense``) without paying
+for the others — the serial oracle still runs (it is the parity gate for
+every row) but only emits its own row when selected.
 """
 
 from __future__ import annotations
@@ -54,10 +58,21 @@ FIELDS = [
     "ok",
     "peak_rss_mb",
 ]
+ALL_CONFIGS = ("serial", "native", "dense", "sharded")
 
 
 def peak_rss_mb() -> float:
     return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
+
+
+def _row(config, scale, n, m, platform, **kw):
+    base = dict(
+        config=config, scale=scale, n=n, m=m, platform=platform,
+        time_sec=None, teps=None, hops=None, levels=None, ok=False,
+        peak_rss_mb=None,
+    )
+    base.update(kw)
+    return base
 
 
 def farthest_reachable(n: int, row_ptr, col_ind, src: int) -> tuple[int, int]:
@@ -106,22 +121,6 @@ print(json.dumps(dict(
 )))
 """
 
-
-def bench_dense(bin_path, src, dst, repeats, timeout):
-    code = DENSE_SUB.format(
-        repo=REPO, bin_path=bin_path, src=src, dst=dst, repeats=repeats
-    )
-    r = subprocess.run(
-        [sys.executable, "-c", code],
-        capture_output=True,
-        text=True,
-        timeout=timeout,
-    )
-    if r.returncode != 0:
-        raise RuntimeError(f"dense subprocess failed: {r.stderr[-500:]}")
-    return json.loads(r.stdout.splitlines()[-1])
-
-
 SHARDED_SUB = """
 import json, resource, sys
 import numpy as np
@@ -142,6 +141,119 @@ print(json.dumps(dict(
 """
 
 
+def _run_sub(code: str, timeout: int) -> dict:
+    r = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+    )
+    if r.returncode != 0:
+        raise RuntimeError(f"subprocess failed: {r.stderr[-500:]}")
+    return json.loads(r.stdout.splitlines()[-1])
+
+
+def _bench_native(scale, n, edges, src, dst, oracle, repeats, out_rows):
+    ng = None
+    try:
+        from bibfs_tpu.solvers.native import NativeGraph, solve_native_graph
+
+        ng = NativeGraph.build(n, edges)
+        solve_native_graph(ng, src, dst)  # warm (first touch of scratch)
+        nat_times = []
+        nat = None
+        for _ in range(max(repeats, 3)):
+            t0n = time.perf_counter()
+            nat = solve_native_graph(ng, src, dst)
+            nat_times.append(time.perf_counter() - t0n)
+        t_nat = float(np.median(nat_times))
+        ok = nat.hops == oracle.hops
+        out_rows.append(
+            _row(
+                "native", scale, n, len(edges), "host-c++",
+                time_sec=t_nat,
+                teps=nat.edges_scanned / t_nat if t_nat else None,
+                hops=nat.hops, levels=nat.levels, ok=ok,
+                peak_rss_mb=round(peak_rss_mb(), 1),
+            )
+        )
+        print(
+            f"  native [host-c++]: {t_nat:.4f}s {'OK' if ok else 'MISMATCH'}",
+            flush=True,
+        )
+    except Exception as e:  # gated like the device rows: record, continue
+        print(f"  native FAILED: {e}", file=sys.stderr, flush=True)
+        out_rows.append(_row("native", scale, n, len(edges), "host-c++"))
+    finally:
+        # ~1.1 GB of CSR + scratch at scale 23 must not stay resident
+        # while the dense/sharded subprocess benches run
+        del ng
+
+
+def _bench_dense(scale, n, edges, src, dst, oracle, repeats, timeout,
+                 bin_path, out_rows):
+    try:
+        info = _run_sub(
+            DENSE_SUB.format(
+                repo=REPO, bin_path=bin_path, src=src, dst=dst, repeats=repeats
+            ),
+            timeout,
+        )
+        t_dense = info["time_sec"]
+        ok = info["hops"] == oracle.hops
+        out_rows.append(
+            _row(
+                "dense/tiered", scale, n, len(edges), info["platform"],
+                time_sec=t_dense,
+                teps=info["edges_scanned"] / t_dense if t_dense else None,
+                hops=info["hops"], levels=info["levels"], ok=ok,
+                peak_rss_mb=round(info["peak_rss_mb"], 1),
+            )
+        )
+        print(
+            f"  dense/tiered [{info['platform']}]: {t_dense:.4f}s "
+            f"teps={out_rows[-1]['teps']:.3e} {'OK' if ok else 'MISMATCH'}",
+            flush=True,
+        )
+    except (subprocess.TimeoutExpired, RuntimeError, json.JSONDecodeError,
+            IndexError) as e:
+        print(f"  dense/tiered FAILED: {e}", file=sys.stderr, flush=True)
+        out_rows.append(_row("dense/tiered", scale, n, len(edges), "?"))
+
+
+def _bench_sharded(scale, n, edges, src, dst, oracle, repeats, timeout,
+                   bin_path, out_rows):
+    try:
+        info = _run_sub(
+            SHARDED_SUB.format(
+                repo=REPO, bin_path=bin_path, src=src, dst=dst,
+                repeats=max(2, repeats // 2),
+            ),
+            timeout,
+        )
+        ok = info["hops"] == oracle.hops
+        out_rows.append(
+            _row(
+                "sharded8/tiered", scale, n, len(edges), "cpu-mesh-emulated",
+                time_sec=info["time_sec"],
+                teps=info["edges_scanned"] / info["time_sec"],
+                hops=info["hops"], levels=info["levels"], ok=ok,
+                peak_rss_mb=round(info["peak_rss_mb"], 1),
+            )
+        )
+        print(
+            f"  sharded8/tiered [cpu-emulated]: {info['time_sec']:.4f}s "
+            f"{'OK' if ok else 'MISMATCH'}",
+            flush=True,
+        )
+    except (subprocess.TimeoutExpired, RuntimeError, json.JSONDecodeError,
+            IndexError) as e:
+        print(f"  sharded8/tiered FAILED: {e}", file=sys.stderr, flush=True)
+        out_rows.append(
+            _row("sharded8/tiered", scale, n, len(edges), "cpu-mesh-emulated")
+        )
+
+
 def run_scale(
     scale: int,
     repeats: int,
@@ -149,6 +261,7 @@ def run_scale(
     *,
     dense_timeout: int,
     sharded_timeout: int,
+    configs: tuple = ALL_CONFIGS,
 ):
     from bibfs_tpu.graph.csr import build_csr
     from bibfs_tpu.graph.generate import rmat_graph
@@ -167,179 +280,35 @@ def run_scale(
         f"hops={oracle.hops} (gen+oracle {time.time() - t0:.0f}s)",
         flush=True,
     )
-    out_rows.append(
-        dict(
-            config="serial-oracle",
-            scale=scale,
-            n=n,
-            m=len(edges),
-            platform="host",
-            time_sec=oracle.time_s,
-            teps=oracle.edges_scanned / oracle.time_s if oracle.time_s else None,
-            hops=oracle.hops,
-            levels=oracle.levels,
-            ok=True,
-            peak_rss_mb=round(peak_rss_mb(), 1),
+    if "serial" in configs:
+        out_rows.append(
+            _row(
+                "serial-oracle", scale, n, len(edges), "host",
+                time_sec=oracle.time_s,
+                teps=(oracle.edges_scanned / oracle.time_s
+                      if oracle.time_s else None),
+                hops=oracle.hops, levels=oracle.levels, ok=True,
+                peak_rss_mb=round(peak_rss_mb(), 1),
+            )
         )
-    )
 
     # native C++ runtime at scale: the framework's host latency backend is
     # not capped at toy sizes — it handles the 10M-node regime the
     # reference's README names as out of reach
-    ng = None
-    try:
-        from bibfs_tpu.solvers.native import NativeGraph, solve_native_graph
+    if "native" in configs:
+        _bench_native(scale, n, edges, src, dst, oracle, repeats, out_rows)
 
-        ng = NativeGraph.build(n, edges)
-        solve_native_graph(ng, src, dst)  # warm (first touch of scratch)
-        nat_times = []
-        nat = None
-        for _ in range(max(repeats, 3)):
-            t0n = time.perf_counter()
-            nat = solve_native_graph(ng, src, dst)
-            nat_times.append(time.perf_counter() - t0n)
-        t_nat = float(np.median(nat_times))
-        ok = nat.hops == oracle.hops
-        out_rows.append(
-            dict(
-                config="native",
-                scale=scale,
-                n=n,
-                m=len(edges),
-                platform="host-c++",
-                time_sec=t_nat,
-                teps=nat.edges_scanned / t_nat if t_nat else None,
-                hops=nat.hops,
-                levels=nat.levels,
-                ok=ok,
-                peak_rss_mb=round(peak_rss_mb(), 1),
-            )
-        )
-        print(
-            f"  native [host-c++]: {t_nat:.4f}s "
-            f"{'OK' if ok else 'MISMATCH'}",
-            flush=True,
-        )
-    except Exception as e:  # gated like the device rows: record, continue
-        print(f"  native FAILED: {e}", file=sys.stderr, flush=True)
-        out_rows.append(
-            dict(
-                config="native", scale=scale, n=n, m=len(edges),
-                platform="host-c++", time_sec=None, teps=None, hops=None,
-                levels=None, ok=False, peak_rss_mb=None,
-            )
-        )
-    finally:
-        # ~1.1 GB of CSR + scratch at scale 23 must not stay resident
-        # while the dense/sharded subprocess benches run
-        del ng
-
+    if not ({"dense", "sharded"} & set(configs)):
+        return
     bin_path = f"/tmp/rmat{scale}.bin"
     write_graph_bin(bin_path, n, edges)
-
     try:
-        info = bench_dense(bin_path, src, dst, repeats, dense_timeout)
-        t_dense = info["time_sec"]
-        ok = info["hops"] == oracle.hops
-        out_rows.append(
-            dict(
-                config="dense/tiered",
-                scale=scale,
-                n=n,
-                m=len(edges),
-                platform=info["platform"],
-                time_sec=t_dense,
-                teps=info["edges_scanned"] / t_dense if t_dense else None,
-                hops=info["hops"],
-                levels=info["levels"],
-                ok=ok,
-                peak_rss_mb=round(info["peak_rss_mb"], 1),
-            )
-        )
-        print(
-            f"  dense/tiered [{info['platform']}]: {t_dense:.4f}s "
-            f"teps={out_rows[-1]['teps']:.3e} {'OK' if ok else 'MISMATCH'}",
-            flush=True,
-        )
-    except (
-        subprocess.TimeoutExpired,
-        RuntimeError,
-        json.JSONDecodeError,
-        IndexError,
-    ) as e:
-        print(f"  dense/tiered FAILED: {e}", file=sys.stderr, flush=True)
-        out_rows.append(
-            dict(
-                config="dense/tiered",
-                scale=scale,
-                n=n,
-                m=len(edges),
-                platform="?",
-                time_sec=None,
-                teps=None,
-                hops=None,
-                levels=None,
-                ok=False,
-                peak_rss_mb=None,
-            )
-        )
-
-    code = SHARDED_SUB.format(
-        repo=REPO, bin_path=bin_path, src=src, dst=dst, repeats=max(2, repeats // 2)
-    )
-    try:
-        r = subprocess.run(
-            [sys.executable, "-c", code],
-            capture_output=True,
-            text=True,
-            timeout=sharded_timeout,
-        )
-        if r.returncode != 0:
-            raise RuntimeError(f"sharded subprocess failed: {r.stderr[-500:]}")
-        info = json.loads(r.stdout.splitlines()[-1])
-        ok = info["hops"] == oracle.hops
-        out_rows.append(
-            dict(
-                config="sharded8/tiered",
-                scale=scale,
-                n=n,
-                m=len(edges),
-                platform="cpu-mesh-emulated",
-                time_sec=info["time_sec"],
-                teps=info["edges_scanned"] / info["time_sec"],
-                hops=info["hops"],
-                levels=info["levels"],
-                ok=ok,
-                peak_rss_mb=round(info["peak_rss_mb"], 1),
-            )
-        )
-        print(
-            f"  sharded8/tiered [cpu-emulated]: {info['time_sec']:.4f}s "
-            f"{'OK' if ok else 'MISMATCH'}",
-            flush=True,
-        )
-    except (
-        subprocess.TimeoutExpired,
-        RuntimeError,
-        json.JSONDecodeError,
-        IndexError,
-    ) as e:
-        print(f"  sharded8/tiered FAILED: {e}", file=sys.stderr, flush=True)
-        out_rows.append(
-            dict(
-                config="sharded8/tiered",
-                scale=scale,
-                n=n,
-                m=len(edges),
-                platform="cpu-mesh-emulated",
-                time_sec=None,
-                teps=None,
-                hops=None,
-                levels=None,
-                ok=False,
-                peak_rss_mb=None,
-            )
-        )
+        if "dense" in configs:
+            _bench_dense(scale, n, edges, src, dst, oracle, repeats,
+                         dense_timeout, bin_path, out_rows)
+        if "sharded" in configs:
+            _bench_sharded(scale, n, edges, src, dst, oracle, repeats,
+                           sharded_timeout, bin_path, out_rows)
     finally:
         os.unlink(bin_path)
 
@@ -357,6 +326,11 @@ def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--scales", type=int, nargs="+", default=[20])
     ap.add_argument("--repeats", type=int, default=5)
+    ap.add_argument(
+        "--configs", nargs="+", default=list(ALL_CONFIGS),
+        choices=list(ALL_CONFIGS),
+        help="which rows to (re)measure; the oracle always runs as the gate",
+    )
     ap.add_argument(
         "--dense-timeout", type=int, default=1800,
         help="seconds allowed for the single-device (TPU) run per scale",
@@ -383,6 +357,7 @@ def main(argv=None):
                 rows,
                 dense_timeout=args.dense_timeout,
                 sharded_timeout=args.sharded_timeout,
+                configs=tuple(args.configs),
             )
         finally:
             _append_rows(rows)

@@ -1,0 +1,182 @@
+"""Checkpoint/resume (solvers/checkpoint.py): chunked execution parity,
+crash-resume durability, and backend/mesh elasticity.
+
+The reference has nothing to compare against here (SURVEY.md §5:
+checkpoint/resume "None") — the contract under test is internal: a
+chunked search must agree with the one-shot kernel and the serial oracle,
+a resumed search must agree with an uninterrupted one, and snapshots must
+move between backends and mesh sizes.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from bibfs_tpu.graph.generate import gnp_random_graph
+from bibfs_tpu.solvers import checkpoint as ck
+from bibfs_tpu.solvers.api import BFSResult
+from bibfs_tpu.solvers.dense import DeviceGraph, solve_dense_graph
+from bibfs_tpu.solvers.serial import solve_serial
+
+
+def _graph(n=96, avg_deg=3.0, seed=11):
+    edges = gnp_random_graph(n, avg_deg / n, seed=seed)
+    return n, edges
+
+
+def _oracle(n, edges, src, dst):
+    return solve_serial(n, edges, src, dst)
+
+
+def _check(res: BFSResult, ora: BFSResult, n, edges, src, dst):
+    assert res.found == ora.found
+    if ora.found:
+        assert res.hops == ora.hops
+        res.validate_path(n, edges, src, dst)
+
+
+@pytest.mark.parametrize("mode", ["sync", "alt", "beamer"])
+@pytest.mark.parametrize("chunk", [1, 3])
+def test_chunked_matches_oracle_dense(mode, chunk):
+    n, edges = _graph(seed=5)
+    g = DeviceGraph.build(n, edges)
+    for src, dst in [(0, n - 1), (3, 3), (7, 60)]:
+        ora = _oracle(n, edges, src, dst)
+        res = ck.solve_checkpointed(g, src, dst, mode=mode, chunk=chunk)
+        _check(res, ora, n, edges, src, dst)
+
+
+def test_chunked_matches_oracle_tiered():
+    n, edges = _graph(seed=9, avg_deg=4.0)
+    g = DeviceGraph.build(n, edges, layout="tiered")
+    ora = _oracle(n, edges, 0, n - 1)
+    res = ck.solve_checkpointed(g, 0, n - 1, mode="beamer", chunk=2)
+    _check(res, ora, n, edges, 0, n - 1)
+
+
+def test_chunked_unreachable():
+    n = 64
+    # two components: a path 0-1-2 and an isolated clique far away
+    edges = np.array([[0, 1], [1, 2], [10, 11], [11, 12]], dtype=np.uint32)
+    g = DeviceGraph.build(n, edges)
+    res = ck.solve_checkpointed(g, 0, 12, chunk=2)
+    assert res is not None and not res.found
+
+
+def test_crash_and_resume(tmp_path):
+    n, edges = _graph(n=128, seed=3)
+    g = DeviceGraph.build(n, edges)
+    src, dst = 0, n - 1
+    ora = _oracle(n, edges, src, dst)
+    path = str(tmp_path / "search.ckpt")
+
+    # "crash" after one 1-level chunk: driver returns None, file persists
+    partial = ck.solve_checkpointed(
+        g, src, dst, chunk=1, path=path, max_chunks=1
+    )
+    assert partial is None
+    meta, state = ck.load_checkpoint(path)
+    assert meta.levels >= 1
+    assert int(state["lvl_s"]) + int(state["lvl_t"]) >= 1
+
+    res = ck.resume(path, g, src=src, dst=dst, chunk=4)
+    assert res is not None
+    _check(res, ora, n, edges, src, dst)
+    # cumulative counters: the resumed result reports the WHOLE search —
+    # levels match the uninterrupted kernel and time_s includes the
+    # pre-crash portion persisted in the snapshot (finite TEPS)
+    if ora.found:
+        full = solve_dense_graph(g, src, dst)
+        assert res.levels == full.levels
+    meta2, _ = ck.load_checkpoint(path)
+    assert res.time_s >= meta.elapsed_s > 0
+    assert meta2.elapsed_s >= meta.elapsed_s
+    assert np.isfinite(res.teps)
+
+
+def test_chunk_must_be_positive():
+    n, edges = _graph(seed=5)
+    g = DeviceGraph.build(n, edges)
+    with pytest.raises(ValueError, match="chunk"):
+        ck.solve_checkpointed(g, 0, n - 1, chunk=0)
+
+
+def test_resume_fingerprint_mismatch(tmp_path):
+    n, edges = _graph(seed=3)
+    g = DeviceGraph.build(n, edges)
+    path = str(tmp_path / "search.ckpt")
+    ck.solve_checkpointed(g, 0, n - 1, chunk=1, path=path, max_chunks=1)
+    with pytest.raises(ValueError, match="fingerprint"):
+        ck.resume(path, g, src=1, dst=n - 1)
+    n2, edges2 = _graph(n=64, seed=4)
+    g2 = DeviceGraph.build(n2, edges2)
+    with pytest.raises(ValueError, match="fingerprint"):
+        ck.resume(path, g2, src=0, dst=n - 1)
+
+
+def test_elastic_dense_to_sharded(tmp_path):
+    """A snapshot written by the single-chip solver resumes on an 8-device
+    mesh (state re-padded 8 -> 64 and re-sharded) — and the other way."""
+    from bibfs_tpu.parallel.mesh import make_1d_mesh
+    from bibfs_tpu.solvers.sharded import ShardedGraph
+
+    cpu_mesh8 = make_1d_mesh(8)
+    n, edges = _graph(n=160, seed=13)
+    src, dst = 0, n - 1
+    ora = _oracle(n, edges, src, dst)
+    assert ora.found and ora.hops >= 3  # deep enough to interrupt mid-way
+
+    gd = DeviceGraph.build(n, edges)
+    gs = ShardedGraph.build(n, edges, cpu_mesh8)
+
+    path = str(tmp_path / "d2s.ckpt")
+    assert ck.solve_checkpointed(
+        gd, src, dst, chunk=1, path=path, max_chunks=1
+    ) is None
+    res = ck.resume(path, gs, src=src, dst=dst, chunk=4)
+    _check(res, ora, n, edges, src, dst)
+
+    path2 = str(tmp_path / "s2d.ckpt")
+    assert ck.solve_checkpointed(
+        gs, src, dst, chunk=1, path=path2, max_chunks=1
+    ) is None
+    res2 = ck.resume(path2, gd, src=src, dst=dst, chunk=4)
+    _check(res2, ora, n, edges, src, dst)
+
+
+def test_sharded_chunked_modes():
+    from bibfs_tpu.parallel.mesh import make_1d_mesh
+    from bibfs_tpu.solvers.sharded import ShardedGraph
+
+    cpu_mesh8 = make_1d_mesh(8)
+    n, edges = _graph(n=160, seed=21)
+    gs = ShardedGraph.build(n, edges, cpu_mesh8)
+    for mode in ["sync", "alt", "beamer"]:
+        ora = _oracle(n, edges, 2, 150)
+        res = ck.solve_checkpointed(gs, 2, 150, mode=mode, chunk=2)
+        _check(res, ora, n, edges, 2, 150)
+
+
+def test_refit_rejects_live_tail():
+    state = ck._init_state_np(64, 0, 40, 3, 2)
+    with pytest.raises(ValueError, match="live entries"):
+        ck._refit(state, 32)  # dst=40 lives in the dropped tail
+    grown = ck._refit(state, 128)
+    assert grown["fr_t"].shape == (128,)
+    assert grown["fr_t"][40] and not grown["fr_t"][64:].any()
+    back = ck._refit(grown, 64)
+    assert back["dist_s"].shape == (64,)
+
+
+def test_mode_override_on_resume(tmp_path):
+    n, edges = _graph(n=128, seed=30)
+    g = DeviceGraph.build(n, edges)
+    ora = _oracle(n, edges, 0, n - 1)
+    path = str(tmp_path / "m.ckpt")
+    assert ck.solve_checkpointed(
+        g, 0, n - 1, mode="sync", chunk=1, path=path, max_chunks=1
+    ) is None
+    # the level-synchronous carry is schedule-portable: finish under alt
+    res = ck.resume(path, g, src=0, dst=n - 1, mode="alt", chunk=4)
+    _check(res, ora, n, edges, 0, n - 1)

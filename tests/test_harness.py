@@ -213,3 +213,48 @@ def test_timed_repeats_forces_every_interval():
     assert len(times) == 4
     assert calls["dispatch"] == 5  # warm-up + 4 repeats
     assert calls["force"] == 5  # forced in warm-up and in each interval
+
+
+def test_solve_cli_checkpoint_roundtrip(tiny_suite, tmp_path, capsys):
+    """bibfs-solve --checkpoint writes a resumable snapshot and the
+    checkpointed run agrees with the serial oracle."""
+    from bibfs_tpu.cli.solve import main
+    from bibfs_tpu.graph.io import read_graph_bin
+    from bibfs_tpu.solvers.serial import solve_serial
+
+    gpath = tiny_suite[0]
+    n, edges = read_graph_bin(gpath)
+    ref = solve_serial(n, edges, 0, n - 1)
+    ck = str(tmp_path / "run.ckpt")
+    rc = main(
+        [gpath, "0", str(n - 1), "--backend", "dense", "--checkpoint", ck,
+         "--chunk", "2", "--no-path"]
+    )
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert os.path.exists(ck)
+    if ref.found:
+        assert f"Shortest path length = {ref.hops}" in out
+    # resuming a FINISHED search just re-reads the final state and agrees
+    rc = main(
+        [gpath, "0", str(n - 1), "--backend", "dense", "--checkpoint", ck,
+         "--resume", "--no-path"]
+    )
+    out = capsys.readouterr().out
+    assert rc == 0
+    if ref.found:
+        assert f"Shortest path length = {ref.hops}" in out
+
+
+def test_solve_cli_checkpoint_flag_validation(tiny_suite, tmp_path):
+    from bibfs_tpu.cli.solve import main
+
+    with pytest.raises(SystemExit):  # host backends can't chunk
+        main([tiny_suite[0], "0", "1", "--backend", "serial", "--chunk", "2"])
+    with pytest.raises(SystemExit):  # --resume needs --checkpoint
+        main([tiny_suite[0], "0", "1", "--backend", "dense", "--resume"])
+    with pytest.raises(SystemExit):  # no --repeat with checkpointing
+        main(
+            [tiny_suite[0], "0", "1", "--backend", "dense", "--chunk", "2",
+             "--repeat", "3"]
+        )
