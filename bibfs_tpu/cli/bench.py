@@ -77,17 +77,28 @@ def _batch_oracle(n, edges, pairs_file):
 
 
 def _batch_row(
-    label, n, edges, pairs, wants, repeats, mode, layout, backend="dense"
+    label, n, edges, pairs, wants, repeats, mode, layout, backend="dense",
+    num_devices=None,
 ):
     """One amortized-throughput row: all (src, dst) pairs solved as ONE
-    vmapped device program (dense backend) or a scratch-reusing host loop
-    (native backend), validated per pair against the precomputed oracle
-    results. time_sec is the PER-QUERY amortized wall-clock."""
+    vmapped device program (dense/sharded backends) or a scratch-reusing
+    host loop (native backend), validated per pair against the precomputed
+    oracle results. time_sec is the PER-QUERY amortized wall-clock."""
     if backend == "native":
         from bibfs_tpu.solvers.native import NativeGraph, time_batch_native
 
         ng = NativeGraph.build(n, edges)
         times, results = time_batch_native(ng, pairs, repeats=repeats)
+    elif backend == "sharded":
+        from bibfs_tpu.parallel.mesh import make_1d_mesh
+        from bibfs_tpu.solvers.sharded import ShardedGraph, time_batch_sharded
+
+        sg = ShardedGraph.build(
+            n, edges, make_1d_mesh(num_devices), layout=layout
+        )
+        times, results = time_batch_sharded(
+            sg, pairs, repeats=repeats, mode=mode
+        )
     else:
         from bibfs_tpu.solvers.dense import DeviceGraph, time_batch_graph
 
@@ -167,15 +178,17 @@ def run_bench(
                 f"(total {time.time() - t0:.1f}s)"
             )
         batch_oracle = None
-        for batch_backend in ("dense", "native"):
+        for batch_backend in ("dense", "native", "sharded"):
             if pairs_file is None or batch_backend not in backends:
                 continue
+            if batch_backend == "sharded" and mode.startswith("pallas"):
+                continue  # no pallas path under shard_map
             try:
                 if batch_oracle is None:
                     batch_oracle = _batch_oracle(n, edges, pairs_file)
                 row = _batch_row(
                     label, n, edges, *batch_oracle, repeats, mode,
-                    layout, backend=batch_backend,
+                    layout, backend=batch_backend, num_devices=num_devices,
                 )
                 rows.append(row)
                 print(
@@ -266,9 +279,9 @@ def main(argv=None):
         default=None,
         metavar="FILE",
         help='also bench batched multi-query throughput: file of "src dst" '
-        "lines solved as one vmapped device program (dense) and/or a "
-        "scratch-reusing host loop (native), one per-query amortized row "
-        "per benched backend",
+        "lines solved as one vmapped device program (dense single-chip, "
+        "sharded multi-chip) and/or a scratch-reusing host loop (native), "
+        "one per-query amortized row per benched backend",
     )
     ap.add_argument("--csv", default="benchmark_results.csv")
     ap.add_argument("--table", default="benchmark_table.txt")
@@ -286,9 +299,11 @@ def main(argv=None):
                  "sharded backend has no pallas path)")
     if args.layout == "tiered" and args.mode.startswith("pallas"):
         ap.error("pallas modes support --layout ell only")
-    if args.pairs is not None and not {"dense", "native"} & set(backends):
-        ap.error("--pairs requires the dense and/or native backend in "
-                 "--backends")
+    if args.pairs is not None and not {"dense", "native", "sharded"} & set(
+        backends
+    ):
+        ap.error("--pairs requires the dense, native and/or sharded backend "
+                 "in --backends")
     rows = run_bench(
         args.graphs,
         backends,

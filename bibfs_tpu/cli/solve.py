@@ -26,9 +26,10 @@ def main(argv=None):
         "--pairs",
         default=None,
         metavar="FILE",
-        help='batch mode (dense or native backend): file of "src dst" lines '
-        "solved as ONE vmapped device program (dense) or a scratch-reusing "
-        "host loop (native); replaces the positional src/dst",
+        help='batch mode (dense/sharded/native backends): file of "src dst" '
+        "lines solved as ONE vmapped device program (dense single-chip, "
+        "sharded multi-chip) or a scratch-reusing host loop (native); "
+        "replaces the positional src/dst",
     )
     ap.add_argument(
         "--profile",
@@ -58,15 +59,16 @@ def main(argv=None):
     )
     ap.add_argument(
         "--mode",
-        default="sync",
+        default=None,
         choices=["sync", "alt", "beamer", "beamer_alt", "pallas", "pallas_alt"],
-        help="device-kernel schedule for dense/sharded backends: sync = "
-        "both sides per round, alt = smaller-frontier-first alternation; "
-        "beamer/beamer_alt add push/pull direction optimization (sparse "
-        "frontiers go through a scatter push path instead of the full-table "
-        "pull gather); pallas/pallas_alt run the pull level as the fused "
-        "Pallas TPU kernel (dense backend, ell layout only; interpreted "
-        "off-TPU)",
+        help="device-kernel schedule for dense/sharded backends (default "
+        "sync): sync = both sides per round, alt = smaller-frontier-first "
+        "alternation; beamer/beamer_alt add push/pull direction "
+        "optimization (sparse frontiers go through a scatter push path "
+        "instead of the full-table pull gather); pallas/pallas_alt run the "
+        "pull level as the fused Pallas TPU kernel (dense backend, ell "
+        "layout only; interpreted off-TPU). With --resume, omitting --mode "
+        "keeps the snapshot's recorded schedule",
     )
     ap.add_argument(
         "--checkpoint",
@@ -100,6 +102,9 @@ def main(argv=None):
         "geometric hub tiers (power-law/RMAT degree distributions)",
     )
     args = ap.parse_args(argv)
+    # None = unspecified: acts as "sync" everywhere except --resume, where
+    # it means "keep the schedule recorded in the snapshot"
+    mode = args.mode or "sync"
 
     from bibfs_tpu.graph.io import read_graph_bin
     from bibfs_tpu.solvers.api import solve
@@ -115,16 +120,16 @@ def main(argv=None):
 
     if args.layout == "tiered" and args.backend not in ("dense", "sharded"):
         ap.error("--layout tiered is only supported by the dense/sharded backends")
-    if args.mode.startswith("pallas") and args.backend != "dense":
+    if mode.startswith("pallas") and args.backend != "dense":
         ap.error("--mode pallas/pallas_alt is only supported by --backend dense")
     if args.pairs is not None:
-        if args.backend not in ("dense", "native"):
-            ap.error("--pairs batch mode is supported by --backend dense "
-                     "(one vmapped device program) and native (scratch-"
-                     "reusing host loop)")
-        if args.devices is not None:
-            ap.error("--devices has no effect in --pairs batch mode (dense/"
-                     "native backends are single-device)")
+        if args.backend not in ("dense", "native", "sharded"):
+            ap.error("--pairs batch mode is supported by --backend dense/"
+                     "sharded (one vmapped device program) and native "
+                     "(scratch-reusing host loop)")
+        if args.devices is not None and args.backend != "sharded":
+            ap.error("--devices only applies to --backend sharded in "
+                     "--pairs batch mode (dense/native are single-device)")
         if args.src is not None or args.dst is not None:
             ap.error("--pairs replaces the positional src/dst arguments")
     elif args.src is None or args.dst is None:
@@ -143,13 +148,13 @@ def main(argv=None):
             ap.error("--resume needs --checkpoint FILE to resume from")
         if args.chunk is not None and args.chunk < 1:
             ap.error("--chunk must be >= 1")
-        if args.mode.startswith("pallas") and args.backend == "sharded":
+        if mode.startswith("pallas") and args.backend == "sharded":
             ap.error("pallas modes are single-chip (dense backend) only")
     kwargs = {}
     if args.devices is not None:
         kwargs["num_devices"] = args.devices
     if args.backend in ("dense", "sharded"):
-        kwargs["mode"] = args.mode
+        kwargs["mode"] = mode
         kwargs["layout"] = args.layout
     import contextlib
 
@@ -162,9 +167,9 @@ def main(argv=None):
 
     try:
         if args.pairs is not None:
-            return _batch_main(args, n, edges, tracer)
+            return _batch_main(args, n, edges, tracer, mode)
         if checkpointed:
-            return _checkpoint_main(args, n, edges, tracer)
+            return _checkpoint_main(args, n, edges, tracer, mode)
         with tracer():
             if args.repeat > 1:
                 # shared protocol: graph/JIT warm-up excluded, zero-D2H
@@ -175,7 +180,7 @@ def main(argv=None):
                     args.backend, n, edges, args.src, args.dst,
                     repeats=args.repeat,
                     num_devices=args.devices,
-                    mode=args.mode,
+                    mode=mode,
                     layout=args.layout,
                 )
             else:
@@ -202,7 +207,7 @@ def main(argv=None):
     return 0
 
 
-def _checkpoint_main(args, n, edges, tracer):
+def _checkpoint_main(args, n, edges, tracer, mode):
     from bibfs_tpu.solvers.checkpoint import resume, solve_checkpointed
 
     if args.backend == "sharded":
@@ -225,7 +230,7 @@ def _checkpoint_main(args, n, edges, tracer):
             )
         else:
             res = solve_checkpointed(
-                g, args.src, args.dst, mode=args.mode, chunk=chunk,
+                g, args.src, args.dst, mode=mode, chunk=chunk,
                 path=args.checkpoint,
             )
     if res.found:
@@ -246,7 +251,7 @@ def _checkpoint_main(args, n, edges, tracer):
     return 0
 
 
-def _batch_main(args, n, edges, tracer):
+def _batch_main(args, n, edges, tracer, mode):
     import numpy as np
 
     pairs = np.loadtxt(args.pairs, dtype=np.int64, ndmin=2)
@@ -268,6 +273,24 @@ def _batch_main(args, n, edges, tracer):
                 )
             else:
                 results = solve_batch_native_graph(g, pairs)
+    elif args.backend == "sharded":
+        from bibfs_tpu.parallel.mesh import make_1d_mesh
+        from bibfs_tpu.solvers.sharded import (
+            ShardedGraph,
+            solve_batch_sharded_graph,
+            time_batch_sharded,
+        )
+
+        g = ShardedGraph.build(
+            n, edges, make_1d_mesh(args.devices), layout=args.layout
+        )
+        with tracer():
+            if args.repeat > 1:
+                _times, results = time_batch_sharded(
+                    g, pairs, repeats=args.repeat, mode=mode
+                )
+            else:
+                results = solve_batch_sharded_graph(g, pairs, mode=mode)
     else:
         from bibfs_tpu.solvers.dense import (
             DeviceGraph,
@@ -279,10 +302,10 @@ def _batch_main(args, n, edges, tracer):
         with tracer():
             if args.repeat > 1:
                 _times, results = time_batch_graph(
-                    g, pairs, repeats=args.repeat, mode=args.mode
+                    g, pairs, repeats=args.repeat, mode=mode
                 )
             else:
-                results = solve_batch_graph(g, pairs, mode=args.mode)
+                results = solve_batch_graph(g, pairs, mode=mode)
     for (src, dst), res in zip(pairs, results):
         if res.found:
             line = f"{src} -> {dst}: length = {res.hops}"

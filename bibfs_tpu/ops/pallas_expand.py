@@ -31,7 +31,13 @@ Pallas interpret mode, so parity tests exercise the same kernel body
 everywhere. On TPU it compiles via Mosaic; if the running jaxlib's Mosaic
 rejects the in-kernel gather (support for vector gathers varies by
 version), callers fall back to the XLA path — see
-:func:`bibfs_tpu.solvers.dense` mode ``"pallas"`` wiring.
+:func:`bibfs_tpu.solvers.dense` mode ``"pallas"`` wiring. Measured on the
+bench chip (v5e, jax/jaxlib 0.9.0, 2026-07-30): Mosaic raises
+``NotImplementedError: Only 2D gather is supported`` for the 1D
+frontier-at-neighbor-indices gather, so the compiled path is unavailable
+there and ``pallas``/``pallas_alt`` resolve to the XLA pull kernel; the
+bench's HBM accounting shows that search is dispatch-bound on that
+backend regardless (PERF_NOTES.md §2), so the fallback costs nothing.
 """
 
 from __future__ import annotations

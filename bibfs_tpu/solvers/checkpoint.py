@@ -288,8 +288,10 @@ class CheckpointMeta:
 
 
 def save_checkpoint(path: str, state: dict, meta: CheckpointMeta) -> None:
-    """Atomic snapshot: write ``<path>.tmp`` then ``os.replace`` — a crash
-    mid-write leaves the previous checkpoint intact."""
+    """Atomic snapshot: write ``<path>.tmp``, fsync it, then ``os.replace``
+    — a process crash mid-write leaves the previous checkpoint intact, and
+    the fsync keeps a SYSTEM crash right after the rename from leaving a
+    truncated npz behind the new name (rename-before-data reordering)."""
     tmp = f"{path}.tmp"
     with open(tmp, "wb") as f:
         np.savez(
@@ -297,6 +299,8 @@ def save_checkpoint(path: str, state: dict, meta: CheckpointMeta) -> None:
             _meta=np.bytes_(json.dumps(dataclasses.asdict(meta))),
             **state,
         )
+        f.flush()
+        os.fsync(f.fileno())
     os.replace(tmp, path)
 
 

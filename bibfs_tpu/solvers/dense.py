@@ -615,10 +615,11 @@ def _batch_dispatch(g: DeviceGraph, pairs, mode: str):
 
 
 def _materialize_batch(out, num: int, elapsed: float) -> list[BFSResult]:
-    return [
-        _materialize(tuple(np.asarray(o)[i] for o in out), elapsed)
-        for i in range(num)
-    ]
+    # one device->host transfer per OUTPUT, not per (output, query) pair —
+    # np.asarray inside the query loop would re-copy the whole [B, n_pad]
+    # parent arrays B times
+    outs = [np.asarray(o) for o in out]
+    return [_materialize(tuple(o[i] for o in outs), elapsed) for i in range(num)]
 
 
 def solve_batch_graph(
@@ -646,23 +647,13 @@ def time_batch_graph(
 ) -> tuple[list[float], list[BFSResult]]:
     """Batch solve under the shared timing protocol (warm-up excluded,
     forced execution per repeat, median stamped into every result's
-    ``time_s``; see :mod:`bibfs_tpu.solvers.timing`). The loop is inlined
-    (not :func:`timed_repeats`) so the LAST timed output is materialized
-    directly — an extra whole-batch solve just to fetch a result would
-    cost real seconds through the tunnel."""
-    from bibfs_tpu.solvers.timing import force_scalar
+    ``time_s``; see :mod:`bibfs_tpu.solvers.timing`). The LAST timed
+    output is materialized directly — an extra whole-batch solve just to
+    fetch a result would cost real seconds through the tunnel."""
+    from bibfs_tpu.solvers.timing import timed_batch_repeats
 
-    if repeats < 1:
-        raise ValueError(f"repeats must be >= 1, got {repeats}")
     pairs, dispatch = _batch_dispatch(g, pairs, mode)
-    out = dispatch()  # warm-up: compile excluded, lazy runtime flipped
-    force_scalar(out)
-    times = []
-    for _ in range(repeats):
-        t0 = time.perf_counter()
-        out = dispatch()
-        force_scalar(out)
-        times.append(time.perf_counter() - t0)
+    times, out = timed_batch_repeats(dispatch, repeats)
     return times, _materialize_batch(out, pairs.shape[0], float(np.median(times)))
 
 

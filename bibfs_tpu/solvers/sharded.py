@@ -364,10 +364,10 @@ def _bibfs_shard_body(
     )
 
 
-@lru_cache(maxsize=None)
-def _compiled_sharded(
+def _sharded_fn(
     mesh, axis: str, mode: str = "sync", push_cap: int = 0, tier_meta: tuple = ()
 ):
+    """The (unjitted) shard_map'd whole-search program."""
     if SHARDED_MODES[mode][2]:
         raise ValueError(
             "pallas modes are single-chip (dense backend) only; the sharded "
@@ -378,7 +378,7 @@ def _compiled_sharded(
     sh = P(axis)
     rep = P()
     aux_spec = (sh, tuple((sh, sh, rep) for _ in tier_meta)) if tier_meta else ()
-    fn = jax.shard_map(
+    return jax.shard_map(
         lambda nbr, deg, aux, src, dst: _bibfs_shard_body(
             nbr,
             deg,
@@ -394,7 +394,31 @@ def _compiled_sharded(
         in_specs=(sh, sh, aux_spec, rep, rep),
         out_specs=(rep, rep, sh, sh, rep, rep),
     )
-    return jax.jit(fn)
+
+
+@lru_cache(maxsize=None)
+def _compiled_sharded(
+    mesh, axis: str, mode: str = "sync", push_cap: int = 0, tier_meta: tuple = ()
+):
+    return jax.jit(_sharded_fn(mesh, axis, mode, push_cap, tier_meta))
+
+
+@lru_cache(maxsize=None)
+def _compiled_sharded_batch(
+    mesh, axis: str, mode: str = "sync", push_cap: int = 0, tier_meta: tuple = ()
+):
+    """vmap of the sharded search over (src, dst) pairs: B multi-chip
+    searches advance lock-step in ONE collective program — every level's
+    frontier all_gathers and vote psums are batched across queries, so the
+    per-level ICI/dispatch overhead is paid once per level, not once per
+    query per level. The multi-chip twin of the dense batch kernel
+    (:func:`bibfs_tpu.solvers.dense._get_batch_kernel_resolved`)."""
+    return jax.jit(
+        jax.vmap(
+            _sharded_fn(mesh, axis, mode, push_cap, tier_meta),
+            in_axes=(None, None, None, 0, 0),
+        )
+    )
 
 
 class ShardedGraph:
@@ -506,6 +530,54 @@ def time_search(
         lambda: solve_sharded_graph(g, src, dst, mode=mode),
         repeats,
         force=force_scalar,
+    )
+
+
+def _batch_dispatch(g: ShardedGraph, pairs, mode: str):
+    pairs = np.asarray(pairs, dtype=np.int64).reshape(-1, 2)
+    if pairs.size and not ((0 <= pairs).all() and (pairs < g.n).all()):
+        raise ValueError(f"src/dst out of range for n={g.n}")
+    kern = _compiled_sharded_batch(
+        g.mesh, VERTEX_AXIS, mode, kernel_cap(mode, g.n_pad), g.tier_meta
+    )
+    srcs = jnp.asarray(pairs[:, 0], dtype=jnp.int32)
+    dsts = jnp.asarray(pairs[:, 1], dtype=jnp.int32)
+    return pairs, lambda: jax.block_until_ready(
+        kern(g.nbr, g.deg, g.aux, srcs, dsts)
+    )
+
+
+def solve_batch_sharded_graph(
+    g: ShardedGraph, pairs, *, mode: str = "sync"
+) -> list[BFSResult]:
+    """Solve many (src, dst) queries in ONE multi-chip program (vmapped
+    shard_map search). Same contract as
+    :func:`bibfs_tpu.solvers.dense.solve_batch_graph`: each result's
+    ``time_s`` is the whole-batch wall-clock."""
+    from bibfs_tpu.solvers.dense import _materialize_batch
+    from bibfs_tpu.solvers.timing import force_scalar
+
+    pairs, dispatch = _batch_dispatch(g, pairs, mode)
+    t0 = time.perf_counter()
+    out = dispatch()
+    force_scalar(out)  # execution is lazy until a value read; see timing.py
+    elapsed = time.perf_counter() - t0
+    return _materialize_batch(out, pairs.shape[0], elapsed)
+
+
+def time_batch_sharded(
+    g: ShardedGraph, pairs, *, repeats: int = 5, mode: str = "sync"
+) -> tuple[list[float], list[BFSResult]]:
+    """Batch solve under the shared timing protocol — the same
+    :func:`bibfs_tpu.solvers.timing.timed_batch_repeats` loop the dense
+    backend uses, so the two cannot diverge."""
+    from bibfs_tpu.solvers.dense import _materialize_batch
+    from bibfs_tpu.solvers.timing import timed_batch_repeats
+
+    pairs, dispatch = _batch_dispatch(g, pairs, mode)
+    times, out = timed_batch_repeats(dispatch, repeats)
+    return times, _materialize_batch(
+        out, pairs.shape[0], float(np.median(times))
     )
 
 

@@ -81,6 +81,29 @@ def timed_repeats(
     return times, dataclasses.replace(result, time_s=float(np.median(times)))
 
 
+def timed_batch_repeats(
+    dispatch: Callable[[], object],
+    repeats: int,
+    force: Callable[[object], None] = force_scalar,
+) -> tuple[list[float], object]:
+    """The batch variant of :func:`timed_repeats`: warm up once, then time
+    ``repeats`` whole-batch dispatches with execution forced inside every
+    interval, and return ``(times_s, last_out)`` so the caller can
+    materialize the final outputs once. Shared by the dense and sharded
+    batch solvers so the protocol cannot diverge between them."""
+    if repeats < 1:
+        raise ValueError(f"repeats must be >= 1, got {repeats}")
+    out = dispatch()  # warm-up: compile excluded, lazy runtime flipped
+    force(out)
+    times = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        out = dispatch()
+        force(out)
+        times.append(time.perf_counter() - t0)
+    return times, out
+
+
 def time_backend(
     backend: str,
     n: int,

@@ -258,3 +258,54 @@ def test_solve_cli_checkpoint_flag_validation(tiny_suite, tmp_path):
             [tiny_suite[0], "0", "1", "--backend", "dense", "--chunk", "2",
              "--repeat", "3"]
         )
+
+
+def test_solve_cli_pairs_sharded(tiny_suite, tmp_path, capsys):
+    """--pairs with the multi-chip backend: one vmapped shard_map program
+    over the 8-device mesh, hop parity per pair."""
+    from bibfs_tpu.cli.solve import main
+    from bibfs_tpu.graph.io import read_graph_bin
+    from bibfs_tpu.solvers.serial import solve_serial
+
+    gpath = tiny_suite[0]
+    n, edges = read_graph_bin(gpath)
+    pfile = str(tmp_path / "pairs.txt")
+    pairs = [(0, n - 1), (2, 2)]
+    with open(pfile, "w") as f:
+        for s, d in pairs:
+            f.write(f"{s} {d}\n")
+    rc = main(
+        [gpath, "--backend", "sharded", "--pairs", pfile, "--devices", "8",
+         "--no-path"]
+    )
+    out = capsys.readouterr().out.strip().splitlines()
+    assert rc == 0
+    for (s, d), line in zip(pairs, out):
+        ref = solve_serial(n, edges, s, d)
+        if ref.found:
+            assert f"length = {ref.hops}" in line
+        else:
+            assert "no path" in line
+
+
+def test_run_bench_sharded_batch_row(tiny_suite, tmp_path):
+    """--pairs produces an amortized sharded-batchN row (vmapped shard_map
+    program on the 8-device mesh), validated per pair vs the oracle."""
+    pfile = str(tmp_path / "pairs.txt")
+    from bibfs_tpu.graph.io import read_graph_bin
+
+    n, _edges = read_graph_bin(tiny_suite[0])
+    with open(pfile, "w") as f:
+        f.write(f"0 {n - 1}\n1 1\n")
+    rows = run_bench(
+        [tiny_suite[0]],
+        ["sharded"],
+        repeats=2,
+        csv_path=str(tmp_path / "r.csv"),
+        table_path=str(tmp_path / "t.txt"),
+        num_devices=8,
+        pairs_file=pfile,
+    )
+    versions = [r["version"] for r in rows]
+    assert "sharded" in versions and "sharded-batch2" in versions
+    assert all(r["ok"] for r in rows)
