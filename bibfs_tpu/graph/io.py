@@ -59,6 +59,59 @@ def read_graph_bin(path: str | os.PathLike) -> tuple[int, np.ndarray]:
     return n, edges
 
 
+def read_dense_matrix(path: str | os.PathLike) -> tuple[int, np.ndarray]:
+    """Read the reference's LEGACY dense-matrix format: ``uint32 N`` then
+    ``N*N`` uint8 adjacency bytes (v2/read_in.cpp:13-25 — the format its
+    edge-list ``.bin`` replaced; the stale docstring in
+    graphs/generate_graph.py:13-14 still describes it). Returns
+    ``(n, edges[M, 2])`` in the canonical undirected form the rest of the
+    framework consumes: one row per edge, ``u < v``.
+
+    Validates file size against the header exactly as read_in.cpp:16-22
+    does, and additionally requires the matrix to be symmetric with a zero
+    diagonal (an asymmetric matrix cannot be an undirected graph).
+    """
+    size = os.path.getsize(path)
+    with open(path, "rb") as f:
+        header = np.fromfile(f, dtype=_HEADER_DTYPE, count=1)
+        if header.size != 1:
+            raise ValueError(f"{path}: truncated header")
+        n = int(header[0])
+        expected = 4 + n * n
+        if size != expected:
+            raise ValueError(
+                f"{path}: size mismatch: header says N = {n} => expected "
+                f"{expected} bytes, but file is {size} bytes"
+            )
+        mat = np.fromfile(f, dtype=np.uint8, count=n * n).reshape(n, n)
+    if np.any(np.diagonal(mat)):
+        raise ValueError(f"{path}: dense matrix has self-loops on the diagonal")
+    if not np.array_equal(mat, mat.T):
+        raise ValueError(f"{path}: dense matrix is not symmetric")
+    u, v = np.nonzero(np.triu(mat, k=1))
+    return n, np.stack([u, v], axis=1).astype(np.int64)
+
+
+def write_dense_matrix(
+    path: str | os.PathLike, n: int, edges: np.ndarray
+) -> None:
+    """Write the legacy dense-matrix format (testing/migration aid: lets
+    the framework round-trip files for tools that still speak it)."""
+    edges = np.asarray(edges, dtype=np.int64).reshape(-1, 2)
+    if edges.size and (edges.min() < 0 or edges.max() >= n):
+        raise ValueError(f"edge endpoint out of range for n={n}")
+    if edges.size and np.any(edges[:, 0] == edges[:, 1]):
+        # the format cannot represent self-loops (the reader rejects a
+        # non-zero diagonal); refuse loudly instead of dropping data
+        raise ValueError("dense-matrix format cannot represent self-loops")
+    mat = np.zeros((n, n), dtype=np.uint8)
+    mat[edges[:, 0], edges[:, 1]] = 1
+    mat[edges[:, 1], edges[:, 0]] = 1
+    with open(path, "wb") as f:
+        np.array([n], dtype=_HEADER_DTYPE).tofile(f)
+        mat.tofile(f)
+
+
 def write_ground_truth(
     path: str | os.PathLike,
     source: int,

@@ -180,3 +180,26 @@ def test_mode_override_on_resume(tmp_path):
     # the level-synchronous carry is schedule-portable: finish under alt
     res = ck.resume(path, g, src=0, dst=n - 1, mode="alt", chunk=4)
     _check(res, ora, n, edges, 0, n - 1)
+
+
+def test_elastic_mesh_resize(tmp_path):
+    """Snapshot from an 8-device mesh, resume on a 4-device mesh: n_pad
+    shrinks 192 -> 160 (inert-tail shrink) and state re-shards."""
+    from bibfs_tpu.parallel.mesh import make_1d_mesh
+    from bibfs_tpu.solvers.sharded import ShardedGraph
+
+    n, edges = _graph(n=160, seed=13)
+    src, dst = 0, n - 1
+    ora = _oracle(n, edges, src, dst)
+    assert ora.found
+
+    g8 = ShardedGraph.build(n, edges, make_1d_mesh(8))
+    g4 = ShardedGraph.build(n, edges, make_1d_mesh(4))
+    assert g8.n_pad != g4.n_pad  # the resize actually exercises _refit
+
+    path = str(tmp_path / "resize.ckpt")
+    assert ck.solve_checkpointed(
+        g8, src, dst, chunk=1, path=path, max_chunks=1
+    ) is None
+    res = ck.resume(path, g4, src=src, dst=dst, chunk=4)
+    _check(res, ora, n, edges, src, dst)

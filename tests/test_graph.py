@@ -1,6 +1,8 @@
 """Graph layer tests: binary format round-trip + reference-file compatibility,
 CSR/ELL builders, generators."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -210,3 +212,50 @@ def test_messy_edge_lists_all_backends_agree():
             if want.found:
                 assert got.hops == want.hops, (backend, src, dst)
                 got.validate_path(n, clean, src, dst)
+
+
+def test_legacy_dense_matrix_roundtrip(tmp_path):
+    """The v2-era dense-matrix format (v2/read_in.cpp): round-trip, size
+    validation, and solver agreement with the edge-list form."""
+    from bibfs_tpu.graph.generate import gnp_random_graph
+    from bibfs_tpu.graph.io import read_dense_matrix, write_dense_matrix
+    from bibfs_tpu.solvers.serial import solve_serial
+
+    n = 40
+    edges = gnp_random_graph(n, 4.0 / n, seed=6)
+    path = str(tmp_path / "legacy.bin")
+    write_dense_matrix(path, n, edges)
+    assert os.path.getsize(path) == 4 + n * n  # read_in.cpp:16-22 contract
+    n2, edges2 = read_dense_matrix(path)
+    assert n2 == n
+    a = solve_serial(n, edges, 0, n - 1)
+    b = solve_serial(n2, edges2, 0, n - 1)
+    assert a.found == b.found and a.hops == b.hops
+
+
+def test_legacy_dense_matrix_validation(tmp_path):
+    from bibfs_tpu.graph.io import read_dense_matrix
+
+    path = str(tmp_path / "bad.bin")
+    # size mismatch: header says n=5 but only 3 matrix bytes follow
+    with open(path, "wb") as f:
+        np.array([5], dtype="<u4").tofile(f)
+        np.zeros(3, dtype=np.uint8).tofile(f)
+    with pytest.raises(ValueError, match="size mismatch"):
+        read_dense_matrix(path)
+    # asymmetric matrix is not an undirected graph
+    n = 3
+    mat = np.zeros((n, n), dtype=np.uint8)
+    mat[0, 1] = 1  # no mirror edge
+    with open(path, "wb") as f:
+        np.array([n], dtype="<u4").tofile(f)
+        mat.tofile(f)
+    with pytest.raises(ValueError, match="not symmetric"):
+        read_dense_matrix(path)
+
+
+def test_legacy_dense_matrix_rejects_self_loops(tmp_path):
+    from bibfs_tpu.graph.io import write_dense_matrix
+
+    with pytest.raises(ValueError, match="self-loops"):
+        write_dense_matrix(str(tmp_path / "l.bin"), 4, np.array([[1, 1]]))
