@@ -57,7 +57,7 @@ def available_backends() -> list[str]:
     try:
         import jax  # noqa: F401
 
-        out += ["dense", "sharded"]
+        out += ["dense", "sharded", "sharded2d"]
     except ModuleNotFoundError:
         pass
     return out
@@ -296,7 +296,12 @@ def main(argv=None):
         b not in ("dense", "serial", "native") for b in backends
     ):
         ap.error("--mode pallas/pallas_alt requires --backends dense (the "
-                 "sharded backend has no pallas path)")
+                 "sharded backends have no pallas path)")
+    if args.mode not in ("sync", "alt") and "sharded2d" in backends:
+        ap.error("--backends sharded2d supports --mode sync/alt only")
+    if args.layout != "ell" and "sharded2d" in backends:
+        ap.error("--backends sharded2d has its own block layout; drop "
+                 "--layout or bench it separately")
     if args.layout == "tiered" and args.mode.startswith("pallas"):
         ap.error("pallas modes support --layout ell only")
     if args.pairs is not None and not {"dense", "native", "sharded"} & set(

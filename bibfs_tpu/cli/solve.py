@@ -41,7 +41,17 @@ def main(argv=None):
     ap.add_argument(
         "--backend",
         default="serial",
-        help="serial | native | dense | sharded (default: serial)",
+        help="serial | native | dense | sharded | sharded2d "
+        "(default: serial)",
+    )
+    ap.add_argument(
+        "--grid",
+        default=None,
+        metavar="RxC",
+        help="mesh shape for --backend sharded2d (e.g. 2x4): adjacency is "
+        "blocked over an R x C grid so per-level frontier traffic scales "
+        "as O(n/C + n/R) instead of the 1D solver's O(n) (default: the "
+        "squarest factorization of the visible device count)",
     )
     ap.add_argument(
         "--devices",
@@ -120,6 +130,31 @@ def main(argv=None):
 
     if args.layout == "tiered" and args.backend not in ("dense", "sharded"):
         ap.error("--layout tiered is only supported by the dense/sharded backends")
+    rows = cols = None
+    if args.grid is not None:
+        if args.backend != "sharded2d":
+            ap.error("--grid only applies to --backend sharded2d")
+        try:
+            rows, cols = (int(x) for x in args.grid.lower().split("x"))
+            if rows < 1 or cols < 1:
+                raise ValueError
+        except ValueError:
+            ap.error(f"--grid must look like 2x4, got {args.grid!r}")
+    if args.backend == "sharded2d":
+        if mode not in ("sync", "alt"):
+            ap.error("--backend sharded2d supports --mode sync/alt only "
+                     "(pull-only 2D partition)")
+        if args.layout != "ell":
+            ap.error("--backend sharded2d has its own block layout; "
+                     "--layout does not apply")
+        if (
+            args.pairs is not None
+            or args.checkpoint is not None
+            or args.chunk is not None
+            or args.resume
+        ):
+            ap.error("--backend sharded2d supports single queries only "
+                     "(no --pairs / --checkpoint yet)")
     if mode.startswith("pallas") and args.backend != "dense":
         ap.error("--mode pallas/pallas_alt is only supported by --backend dense")
     if args.pairs is not None:
@@ -156,6 +191,10 @@ def main(argv=None):
     if args.backend in ("dense", "sharded"):
         kwargs["mode"] = mode
         kwargs["layout"] = args.layout
+    elif args.backend == "sharded2d":
+        kwargs["mode"] = mode
+        kwargs["rows"] = rows
+        kwargs["cols"] = cols
     import contextlib
 
     def tracer():
@@ -182,6 +221,8 @@ def main(argv=None):
                     num_devices=args.devices,
                     mode=mode,
                     layout=args.layout,
+                    rows=rows,
+                    cols=cols,
                 )
             else:
                 res = solve(args.backend, n, edges, args.src, args.dst, **kwargs)

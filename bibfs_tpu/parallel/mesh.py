@@ -30,6 +30,25 @@ def make_1d_mesh(num_devices: int | None = None, axis: str = VERTEX_AXIS) -> Mes
     return jax.make_mesh((len(devs),), (axis,), devices=devs)
 
 
+ROW_AXIS = "r"
+COL_AXIS = "c"
+
+
+def make_2d_mesh(rows: int, cols: int) -> Mesh:
+    """An ``rows x cols`` mesh for the 2D-partitioned solver
+    (:mod:`bibfs_tpu.solvers.sharded2d`): adjacency blocks shard over both
+    axes, per-level frontier exchange rides the ``r`` axis and the fold
+    rides the ``c`` axis — O(n/C + n/R) wire traffic per device per level
+    instead of the 1D solver's O(n)."""
+    devs = jax.devices()
+    if rows * cols > len(devs):
+        raise ValueError(
+            f"requested {rows}x{cols} mesh, have {len(devs)} devices"
+        )
+    return jax.make_mesh((rows, cols), (ROW_AXIS, COL_AXIS),
+                         devices=devs[: rows * cols])
+
+
 def shard_spec(mesh: Mesh, axis: str = VERTEX_AXIS) -> NamedSharding:
     """NamedSharding that splits the leading (vertex) dimension."""
     return NamedSharding(mesh, PartitionSpec(axis))

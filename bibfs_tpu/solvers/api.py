@@ -84,6 +84,7 @@ BACKEND_MODULES = {
     "native": "bibfs_tpu.solvers.native",
     "dense": "bibfs_tpu.solvers.dense",
     "sharded": "bibfs_tpu.solvers.sharded",
+    "sharded2d": "bibfs_tpu.solvers.sharded2d",
 }
 
 

@@ -115,6 +115,8 @@ def time_backend(
     num_devices: int | None = None,
     mode: str = "sync",
     layout: str = "ell",
+    rows: int | None = None,
+    cols: int | None = None,
 ) -> tuple[list[float], BFSResult]:
     """Build the graph once for ``backend`` and run the timing protocol.
 
@@ -152,4 +154,14 @@ def time_backend(
         mesh = make_1d_mesh(num_devices)
         g = ShardedGraph.build(n, edges, mesh, layout=layout)
         return time_search(g, src, dst, repeats=repeats, mode=mode)
+    if backend == "sharded2d":
+        from bibfs_tpu.solvers.sharded2d import (
+            Sharded2DGraph,
+            time_search_2d,
+        )
+
+        g = Sharded2DGraph.build(
+            n, edges, rows=rows, cols=cols, num_devices=num_devices
+        )
+        return time_search_2d(g, src, dst, repeats=repeats, mode=mode)
     raise KeyError(f"unknown backend {backend!r}")
