@@ -190,6 +190,9 @@ def main():
 
         from bibfs_tpu.graph.csr import build_csr, canonical_pairs
         from bibfs_tpu.parallel.collectives import frontier_exchange_bytes as fx
+        from bibfs_tpu.solvers.sharded2d import (
+            frontier_exchange_bytes_2d as fx2d,
+        )
         from bibfs_tpu.solvers.api import validate_path
         from bibfs_tpu.solvers.dense import DeviceGraph, time_search
 
@@ -357,6 +360,11 @@ def main():
                     "packed": fx(g.n_pad // 8, True),
                     "bool": fx(g.n_pad // 8, False),
                 },
+                # 2D block partition (solvers/sharded2d): per-device wire
+                # bytes/level by mesh axis on a 2x4 grid vs the 1D gather
+                "sharded2d_frontier_exchange_bytes_per_level_2x4": fx2d(
+                    g.n_pad, 2, 4
+                ),
                 "batch32": batch_stats,
                 "setup_s": round(time.time() - t_setup, 1),
             },

@@ -14,6 +14,9 @@ framework exposes ONE solver API with multiple backends:
                 mesh with psum/all_gather collectives (reference v2+v4,
                 v2/second_try.cpp:68-129 + v4/mpi_bas.cpp:79-132, with real
                 owner-computes partitioning instead of full replication)
+- ``sharded2d`` — Graph500-style 2D block partition over an R x C mesh:
+                per-level frontier traffic O(n/C + n/R) instead of O(n)
+                (beyond-reference; solvers/sharded2d.py)
 
 Graph data layer is bit-compatible with the reference binary format
 (uint32 N, uint32 M, M uint32 pairs; graphs/generate_graph.py:35-39).
