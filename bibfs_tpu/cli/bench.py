@@ -99,6 +99,16 @@ def _batch_row(
         times, results = time_batch_sharded(
             sg, pairs, repeats=repeats, mode=mode
         )
+    elif backend == "sharded2d":
+        from bibfs_tpu.solvers.sharded2d import (
+            Sharded2DGraph,
+            time_batch_sharded2d,
+        )
+
+        g2 = Sharded2DGraph.build(n, edges, num_devices=num_devices)
+        times, results = time_batch_sharded2d(
+            g2, pairs, repeats=repeats, mode=mode
+        )
     else:
         from bibfs_tpu.solvers.dense import DeviceGraph, time_batch_graph
 
@@ -178,11 +188,13 @@ def run_bench(
                 f"(total {time.time() - t0:.1f}s)"
             )
         batch_oracle = None
-        for batch_backend in ("dense", "native", "sharded"):
+        for batch_backend in ("dense", "native", "sharded", "sharded2d"):
             if pairs_file is None or batch_backend not in backends:
                 continue
             if batch_backend == "sharded" and mode.startswith("pallas"):
                 continue  # no pallas path under shard_map
+            if batch_backend == "sharded2d" and mode not in ("sync", "alt"):
+                continue  # the 2D partition is pull-only sync/alt
             try:
                 if batch_oracle is None:
                     batch_oracle = _batch_oracle(n, edges, pairs_file)

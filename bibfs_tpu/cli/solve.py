@@ -26,10 +26,10 @@ def main(argv=None):
         "--pairs",
         default=None,
         metavar="FILE",
-        help='batch mode (dense/sharded/native backends): file of "src dst" '
-        "lines solved as ONE vmapped device program (dense single-chip, "
-        "sharded multi-chip) or a scratch-reusing host loop (native); "
-        "replaces the positional src/dst",
+        help='batch mode (dense/sharded/sharded2d/native backends): file '
+        'of "src dst" lines solved as ONE vmapped device program (dense '
+        "single-chip, sharded/sharded2d multi-chip) or a scratch-reusing "
+        "host loop (native); replaces the positional src/dst",
     )
     ap.add_argument(
         "--profile",
@@ -57,7 +57,9 @@ def main(argv=None):
         "--devices",
         type=int,
         default=None,
-        help="mesh size for --backend sharded (default: all visible devices)",
+        help="mesh size for the sharded/sharded2d backends (default: all "
+        "visible devices; sharded2d factorizes it into the squarest grid "
+        "unless --grid is given)",
     )
     ap.add_argument("--no-path", action="store_true", help="skip path printing")
     ap.add_argument(
@@ -148,22 +150,22 @@ def main(argv=None):
             ap.error("--backend sharded2d has its own block layout; "
                      "--layout does not apply")
         if (
-            args.pairs is not None
-            or args.checkpoint is not None
+            args.checkpoint is not None
             or args.chunk is not None
             or args.resume
         ):
-            ap.error("--backend sharded2d supports single queries only "
-                     "(no --pairs / --checkpoint yet)")
+            ap.error("--backend sharded2d has no checkpoint path yet")
     if mode.startswith("pallas") and args.backend != "dense":
         ap.error("--mode pallas/pallas_alt is only supported by --backend dense")
     if args.pairs is not None:
-        if args.backend not in ("dense", "native", "sharded"):
+        if args.backend not in ("dense", "native", "sharded", "sharded2d"):
             ap.error("--pairs batch mode is supported by --backend dense/"
-                     "sharded (one vmapped device program) and native "
-                     "(scratch-reusing host loop)")
-        if args.devices is not None and args.backend != "sharded":
-            ap.error("--devices only applies to --backend sharded in "
+                     "sharded/sharded2d (one vmapped device program) and "
+                     "native (scratch-reusing host loop)")
+        if args.devices is not None and args.backend not in (
+            "sharded", "sharded2d"
+        ):
+            ap.error("--devices only applies to the sharded backends in "
                      "--pairs batch mode (dense/native are single-device)")
         if args.src is not None or args.dst is not None:
             ap.error("--pairs replaces the positional src/dst arguments")
@@ -206,7 +208,7 @@ def main(argv=None):
 
     try:
         if args.pairs is not None:
-            return _batch_main(args, n, edges, tracer, mode)
+            return _batch_main(args, n, edges, tracer, mode, rows, cols)
         if checkpointed:
             return _checkpoint_main(args, n, edges, tracer, mode)
         with tracer():
@@ -292,7 +294,7 @@ def _checkpoint_main(args, n, edges, tracer, mode):
     return 0
 
 
-def _batch_main(args, n, edges, tracer, mode):
+def _batch_main(args, n, edges, tracer, mode, rows=None, cols=None):
     import numpy as np
 
     pairs = np.loadtxt(args.pairs, dtype=np.int64, ndmin=2)
@@ -332,6 +334,23 @@ def _batch_main(args, n, edges, tracer, mode):
                 )
             else:
                 results = solve_batch_sharded_graph(g, pairs, mode=mode)
+    elif args.backend == "sharded2d":
+        from bibfs_tpu.solvers.sharded2d import (
+            Sharded2DGraph,
+            solve_batch_sharded2d_graph,
+            time_batch_sharded2d,
+        )
+
+        g = Sharded2DGraph.build(
+            n, edges, rows=rows, cols=cols, num_devices=args.devices
+        )
+        with tracer():
+            if args.repeat > 1:
+                _times, results = time_batch_sharded2d(
+                    g, pairs, repeats=args.repeat, mode=mode
+                )
+            else:
+                results = solve_batch_sharded2d_graph(g, pairs, mode=mode)
     else:
         from bibfs_tpu.solvers.dense import (
             DeviceGraph,

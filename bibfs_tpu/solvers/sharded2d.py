@@ -198,13 +198,12 @@ def _bibfs_2d_body(bnbr, bcnt, deg, src, dst, *, R: int, C: int, mode: str):
     )
 
 
-@lru_cache(maxsize=None)
-def _compiled_2d(mesh, R: int, C: int, mode: str):
+def _2d_fn(mesh, R: int, C: int, mode: str):
     blk4 = P(ROW_AXIS, COL_AXIS, None, None)
     blk3 = P(ROW_AXIS, COL_AXIS, None)
     own = P((ROW_AXIS, COL_AXIS))
     rep = P()
-    fn = jax.shard_map(
+    return jax.shard_map(
         lambda bnbr, bcnt, deg, src, dst: _bibfs_2d_body(
             bnbr[0, 0], bcnt[0, 0], deg, src, dst, R=R, C=C, mode=mode
         ),
@@ -212,7 +211,19 @@ def _compiled_2d(mesh, R: int, C: int, mode: str):
         in_specs=(blk4, blk3, own, rep, rep),
         out_specs=(rep, rep, own, own, rep, rep),
     )
-    return jax.jit(fn)
+
+
+@lru_cache(maxsize=None)
+def _compiled_2d(mesh, R: int, C: int, mode: str):
+    return jax.jit(_2d_fn(mesh, R, C, mode))
+
+
+@lru_cache(maxsize=None)
+def _compiled_2d_batch(mesh, R: int, C: int, mode: str):
+    """vmap of the 2D search over (src, dst) pairs — B block-partitioned
+    searches per collective program, same contract as the 1D
+    :func:`bibfs_tpu.solvers.sharded._compiled_sharded_batch`."""
+    return jax.jit(jax.vmap(_2d_fn(mesh, R, C, mode), in_axes=(None, None, None, 0, 0)))
 
 
 class Sharded2DGraph:
@@ -319,6 +330,46 @@ def time_search_2d(
         lambda: solve_sharded2d_graph(g, src, dst, mode=mode),
         repeats,
         force=force_scalar,
+    )
+
+
+def _batch_dispatch_2d(g: "Sharded2DGraph", pairs, mode: str):
+    pairs = np.asarray(pairs, dtype=np.int64).reshape(-1, 2)
+    if pairs.size and not ((0 <= pairs).all() and (pairs < g.n).all()):
+        raise ValueError(f"src/dst out of range for n={g.n}")
+    kern = _compiled_2d_batch(g.mesh, g.R, g.C, mode)
+    srcs = jnp.asarray(pairs[:, 0], dtype=jnp.int32)
+    dsts = jnp.asarray(pairs[:, 1], dtype=jnp.int32)
+    return pairs, lambda: jax.block_until_ready(
+        kern(g.bnbr, g.bcnt, g.deg, srcs, dsts)
+    )
+
+
+def solve_batch_sharded2d_graph(
+    g: "Sharded2DGraph", pairs, *, mode: str = "sync"
+) -> list[BFSResult]:
+    """Solve many (src, dst) queries in ONE 2D-partitioned program; same
+    contract as the dense/1D batch solvers (``time_s`` = whole batch)."""
+    from bibfs_tpu.solvers.dense import _materialize_batch
+    from bibfs_tpu.solvers.timing import force_scalar
+
+    pairs, dispatch = _batch_dispatch_2d(g, pairs, mode)
+    t0 = time.perf_counter()
+    out = dispatch()
+    force_scalar(out)  # execution is lazy until a value read; see timing.py
+    return _materialize_batch(out, pairs.shape[0], time.perf_counter() - t0)
+
+
+def time_batch_sharded2d(
+    g: "Sharded2DGraph", pairs, *, repeats: int = 5, mode: str = "sync"
+) -> tuple[list[float], list[BFSResult]]:
+    from bibfs_tpu.solvers.dense import _materialize_batch
+    from bibfs_tpu.solvers.timing import timed_batch_repeats
+
+    pairs, dispatch = _batch_dispatch_2d(g, pairs, mode)
+    times, out = timed_batch_repeats(dispatch, repeats)
+    return times, _materialize_batch(
+        out, pairs.shape[0], float(np.median(times))
     )
 
 

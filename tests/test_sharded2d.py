@@ -166,3 +166,39 @@ def test_devices_flag_honored():
     assert g.R * g.C == 4
     with pytest.raises(ValueError, match="disagrees"):
         Sharded2DGraph.build(n, edges, rows=2, cols=4, num_devices=4)
+
+
+def test_batch_matches_oracle():
+    """vmapped 2D batch: B block-partitioned searches in one program."""
+    from bibfs_tpu.solvers.sharded2d import solve_batch_sharded2d_graph
+
+    n = 300
+    edges = gnp_random_graph(n, 3.0 / n, seed=21)
+    g = Sharded2DGraph(n, edges, make_2d_mesh(2, 4))
+    pairs = [(0, n - 1), (5, 5), (3, 250), (7, 100)]
+    results = solve_batch_sharded2d_graph(g, pairs)
+    assert len(results) == len(pairs)
+    for (s, d), res in zip(pairs, results):
+        ref = solve_serial(n, edges, s, d)
+        _check(res, ref, n, edges, s, d)
+
+
+def test_cli_pairs_sharded2d(tmp_path, capsys):
+    from bibfs_tpu.cli.solve import main
+    from bibfs_tpu.graph.io import write_graph_bin
+
+    n = 256
+    edges = gnp_random_graph(n, 3.0 / n, seed=3)
+    gpath = str(tmp_path / "g.bin")
+    write_graph_bin(gpath, n, edges)
+    pfile = str(tmp_path / "p.txt")
+    with open(pfile, "w") as f:
+        f.write(f"0 {n - 1}\n4 4\n")
+    rc = main([gpath, "--backend", "sharded2d", "--pairs", pfile,
+               "--grid", "2x4", "--no-path"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    ref = solve_serial(n, edges, 0, n - 1)
+    if ref.found:
+        assert f"length = {ref.hops}" in out
+    assert "length = 0" in out  # the self-pair
