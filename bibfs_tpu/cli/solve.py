@@ -73,7 +73,7 @@ def main(argv=None):
         "--mode",
         default=None,
         choices=["sync", "alt", "beamer", "beamer_alt", "pallas", "pallas_alt"],
-        help="device-kernel schedule for dense/sharded backends (default "
+        help="device-kernel schedule for the device backends (default "
         "sync): sync = both sides per round, alt = smaller-frontier-first "
         "alternation; beamer/beamer_alt add push/pull direction "
         "optimization (sparse frontiers go through a scatter push path "
@@ -86,7 +86,8 @@ def main(argv=None):
         "--checkpoint",
         default=None,
         metavar="FILE",
-        help="dense/sharded backends: run the search in chunks and snapshot "
+        help="device backends (dense/sharded/sharded2d): run the search "
+        "in chunks and snapshot "
         "the device state to FILE after every chunk (atomic .npz); with "
         "--resume, continue a previous search from FILE instead of "
         "restarting (the snapshot is backend/mesh-portable)",
@@ -149,12 +150,7 @@ def main(argv=None):
         if args.layout != "ell":
             ap.error("--backend sharded2d has its own block layout; "
                      "--layout does not apply")
-        if (
-            args.checkpoint is not None
-            or args.chunk is not None
-            or args.resume
-        ):
-            ap.error("--backend sharded2d has no checkpoint path yet")
+
     if mode.startswith("pallas") and args.backend != "dense":
         ap.error("--mode pallas/pallas_alt is only supported by --backend dense")
     if args.pairs is not None:
@@ -175,9 +171,10 @@ def main(argv=None):
         args.checkpoint is not None or args.chunk is not None or args.resume
     )
     if checkpointed:
-        if args.backend not in ("dense", "sharded"):
-            ap.error("--checkpoint/--chunk/--resume need --backend dense "
-                     "or sharded (host backends finish in one shot)")
+        if args.backend not in ("dense", "sharded", "sharded2d"):
+            ap.error("--checkpoint/--chunk/--resume need a device backend "
+                     "(dense/sharded/sharded2d); host backends finish in "
+                     "one shot")
         if args.pairs is not None or args.repeat > 1:
             ap.error("--checkpoint/--chunk are single-query (no --pairs / "
                      "--repeat)")
@@ -210,7 +207,7 @@ def main(argv=None):
         if args.pairs is not None:
             return _batch_main(args, n, edges, tracer, mode, rows, cols)
         if checkpointed:
-            return _checkpoint_main(args, n, edges, tracer, mode)
+            return _checkpoint_main(args, n, edges, tracer, mode, rows, cols)
         with tracer():
             if args.repeat > 1:
                 # shared protocol: graph/JIT warm-up excluded, zero-D2H
@@ -250,10 +247,16 @@ def main(argv=None):
     return 0
 
 
-def _checkpoint_main(args, n, edges, tracer, mode):
+def _checkpoint_main(args, n, edges, tracer, mode, rows=None, cols=None):
     from bibfs_tpu.solvers.checkpoint import resume, solve_checkpointed
 
-    if args.backend == "sharded":
+    if args.backend == "sharded2d":
+        from bibfs_tpu.solvers.sharded2d import Sharded2DGraph
+
+        g = Sharded2DGraph.build(
+            n, edges, rows=rows, cols=cols, num_devices=args.devices
+        )
+    elif args.backend == "sharded":
         from bibfs_tpu.parallel.mesh import make_1d_mesh
         from bibfs_tpu.solvers.sharded import ShardedGraph
 

@@ -26,10 +26,13 @@ everything. This module adds the middle strategy:
 The snapshot holds only the PORTABLE carry — per-vertex
 frontier/parent/distance arrays plus replicated scalars; the transient
 push-path compaction (``fi``/``ok``) is rebuilt on chunk entry. That makes
-checkpoints **backend- and mesh-elastic**: a search checkpointed from the
-single-chip dense solver resumes on a sharded mesh of any divisor size
-(or vice versa), because state is re-padded and re-sharded to fit the
-resuming graph. The reference's closest analog is "rerun the binary"
+checkpoints **backend- and mesh-elastic** across all three device
+substrates: a search checkpointed from the single-chip dense solver
+resumes on a 1D vertex-sharded mesh of any divisor size OR on a 2D
+block-partitioned mesh (and any direction between the three), because
+state is re-padded and re-sharded to fit the resuming graph; hybrid
+(Beamer) schedules degrade to their underlying pull schedule on the
+pull-only 2D leg. The reference's closest analog is "rerun the binary"
 (MPI_Abort on failure, second_try.cpp:35).
 """
 
@@ -170,6 +173,57 @@ def _sharded_chunk_kernel(
     )
 
 
+@lru_cache(maxsize=None)
+def _sharded2d_chunk_kernel(mesh, R: int, C: int, mode: str, chunk: int):
+    """shard_map'd ``(bnbr, bcnt, deg, state) -> state`` advancing at most
+    ``chunk`` rounds of the 2D-partitioned search. The portable carry's
+    ``md_*`` (Beamer gate input, unused by the pull-only 2D body) is
+    dropped on entry and recomputed from the live frontier on exit, so a
+    snapshot leaving a 2D mesh resumes correctly on a Beamer-routed
+    backend."""
+    from bibfs_tpu.parallel.mesh import COL_AXIS, ROW_AXIS
+    from bibfs_tpu.solvers.sharded2d import _2d_cond, _make_2d_body
+
+    # the 2D path is pull-only: hybrid/pallas schedules degrade to their
+    # base schedule (DENSE_MODES' first column) when a snapshot written
+    # under them resumes on a 2D mesh — the level-synchronous carry is
+    # schedule-portable
+    mode2d = DENSE_MODES[mode][0]
+    axes = (ROW_AXIS, COL_AXIS)
+    blk4 = P(ROW_AXIS, COL_AXIS, None, None)
+    blk3 = P(ROW_AXIS, COL_AXIS, None)
+    own = P((ROW_AXIS, COL_AXIS))
+    rep = P()
+    st_spec = {key: own for key in _VERTEX_KEYS}
+    st_spec.update({key: rep for key in _SCALAR_KEYS})
+
+    def fn(bnbr, bcnt, deg, st):
+        body = _make_2d_body(bnbr[0, 0], bcnt[0, 0], deg, R=R, C=C, mode=mode2d)
+        loop_st = {k: v for k, v in st.items() if not k.startswith("md_")}
+
+        def cond2(c2):
+            return _2d_cond(c2[0]) & (c2[1] < chunk)
+
+        def body2(c2):
+            return body(c2[0]), c2[1] + 1
+
+        out, _steps = jax.lax.while_loop(cond2, body2, (loop_st, jnp.int32(0)))
+        for side in ("s", "t"):
+            out[f"md_{side}"] = jax.lax.pmax(
+                jnp.max(jnp.where(out[f"fr_{side}"], deg, 0)), axes
+            )
+        return out
+
+    return jax.jit(
+        jax.shard_map(
+            fn,
+            mesh=mesh,
+            in_specs=(blk4, blk3, own, dict(st_spec)),
+            out_specs=dict(st_spec),
+        )
+    )
+
+
 # ------------------------------------------------------- state lifecycle
 
 
@@ -228,15 +282,28 @@ def _refit(state: dict, n_pad: int) -> dict:
     return out
 
 
+def _vertex_sharding(g):
+    """The NamedSharding of per-vertex state on ``g``'s mesh: 1D over the
+    vertex axis, or row-major over both axes of a 2D mesh (the fold
+    layout of :mod:`bibfs_tpu.solvers.sharded2d`)."""
+    from jax.sharding import NamedSharding
+
+    from bibfs_tpu.parallel.mesh import COL_AXIS, ROW_AXIS, shard_spec
+
+    if g.mesh.devices.ndim == 2:
+        return NamedSharding(g.mesh, P((ROW_AXIS, COL_AXIS)))
+    return shard_spec(g.mesh)
+
+
 def _put_state(state: dict, g) -> dict:
     """Host carry -> device carry with the graph's shardings (sharded
-    vertex arrays on a ShardedGraph, plain device arrays otherwise)."""
-    from bibfs_tpu.parallel.mesh import replicated_spec, shard_spec
+    vertex arrays on a Sharded(2D)Graph, plain device arrays otherwise)."""
+    from bibfs_tpu.parallel.mesh import replicated_spec
 
     state = _refit(state, g.n_pad)
     dev = {}
     if hasattr(g, "mesh"):
-        vspec = shard_spec(g.mesh)
+        vspec = _vertex_sharding(g)
         sspec = replicated_spec(g.mesh)
         for key in _VERTEX_KEYS:
             dev[key] = jax.device_put(state[key], vspec)
@@ -325,17 +392,22 @@ def _deg_at(g, v: int) -> int:
 # ---------------------------------------------------------------- driver
 
 
-def _get_chunk_kernel(g, mode: str, chunk: int):
+def _get_chunk_step(g, mode: str, chunk: int):
+    """One-chunk advance function ``step(state) -> state`` for whichever
+    execution substrate ``g`` is (dense chip / 1D mesh / 2D mesh)."""
     from bibfs_tpu.parallel.mesh import VERTEX_AXIS
 
+    if hasattr(g, "bnbr"):  # Sharded2DGraph
+        kern = _sharded2d_chunk_kernel(g.mesh, g.R, g.C, mode, chunk)
+        return lambda st: kern(g.bnbr, g.bcnt, g.deg, st)
     cap = kernel_cap(mode, g.n_pad)
-    if hasattr(g, "mesh"):
+    if hasattr(g, "mesh"):  # ShardedGraph
         kern = _sharded_chunk_kernel(
             g.mesh, VERTEX_AXIS, mode, cap, g.tier_meta, chunk
         )
-    else:
+    else:  # DeviceGraph
         kern = _dense_chunk_kernel(mode, cap, g.tier_meta, chunk)
-    return kern
+    return lambda st: kern(g.nbr, g.deg, g.aux, st)
 
 
 def _drive(g, state_np, meta, *, mode, chunk, path, max_chunks):
@@ -344,13 +416,13 @@ def _drive(g, state_np, meta, *, mode, chunk, path, max_chunks):
     ran out first (state is durable in ``path`` if one was given)."""
     if chunk < 1:
         raise ValueError(f"chunk must be >= 1, got {chunk}")
-    kern = _get_chunk_kernel(g, mode, chunk)
+    step = _get_chunk_step(g, mode, chunk)
     st = _put_state(state_np, g)
     base_s = meta.elapsed_s  # search time accumulated by prior runs
     t0 = time.perf_counter()
     chunks = 0
     while True:
-        st = kern(g.nbr, g.deg, g.aux, st)
+        st = step(st)
         # periodic host sync: three scalars decide termination (the same
         # predicate as the in-loop cond). Reading them also FORCES
         # execution of the queued chunk (solvers/timing.py laziness note).
@@ -392,8 +464,9 @@ def solve_checkpointed(
     path: str | None = None,
     max_chunks: int | None = None,
 ) -> BFSResult | None:
-    """Chunked search on a :class:`~bibfs_tpu.solvers.dense.DeviceGraph` or
-    :class:`~bibfs_tpu.solvers.sharded.ShardedGraph`: at most ``chunk``
+    """Chunked search on a :class:`~bibfs_tpu.solvers.dense.DeviceGraph`,
+    :class:`~bibfs_tpu.solvers.sharded.ShardedGraph`, or
+    :class:`~bibfs_tpu.solvers.sharded2d.Sharded2DGraph`: at most ``chunk``
     rounds per dispatch, snapshotting to ``path`` after every chunk.
     Returns the result, or ``None`` if ``max_chunks`` chunks ran out first
     (resume later with :func:`resume`). ``path=None`` gives pure chunked

@@ -71,10 +71,21 @@ def _transpose_perm(R: int, C: int) -> tuple:
     return tuple((s, (s % R) * C + s // R) for s in range(R * C))
 
 
-def _bibfs_2d_body(bnbr, bcnt, deg, src, dst, *, R: int, C: int, mode: str):
-    """Per-device program. ``bnbr``/``bcnt``: this device's adjacency block
-    ([nr, W] localized neighbor ids + per-row slot counts); ``deg``: owned
-    slice of true degrees [n_loc]; ``src``/``dst`` replicated scalars."""
+def _2d_cond(st):
+    return (
+        (st["lvl_s"] + st["lvl_t"] < st["best"])
+        & (st["cnt_s"] > 0)
+        & (st["cnt_t"] > 0)
+    )
+
+
+def _make_2d_body(bnbr, bcnt, deg, *, R: int, C: int, mode: str):
+    """The while_loop body ``st -> st`` over this device's adjacency block
+    — shared by the one-shot program below and the chunked/checkpointed
+    program (:mod:`bibfs_tpu.solvers.checkpoint`), so the two execution
+    strategies cannot diverge. ``bnbr``/``bcnt``: [nr, W] localized
+    neighbor ids + per-row slot counts; ``deg``: owned slice of true
+    degrees [n_loc]."""
     nr, W = bnbr.shape
     n_loc = deg.shape[0]
     nc = n_loc * R  # column-range width (= n_pad / C)
@@ -86,34 +97,6 @@ def _bibfs_2d_body(bnbr, bcnt, deg, src, dst, *, R: int, C: int, mode: str):
     perm = _transpose_perm(R, C)
     axes = (ROW_AXIS, COL_AXIS)
     cols_iota = jnp.arange(W, dtype=jnp.int32)[None, :]
-
-    def seed(v):
-        fr = ids == v
-        return dict(
-            fr=fr,
-            cnt=jnp.int32(1),
-            par=jax.lax.pcast(
-                jnp.full(n_loc, -1, jnp.int32), axes, to="varying"
-            ),
-            dist=jnp.where(fr, 0, INF32).astype(jnp.int32),
-            lvl=jnp.int32(0),
-        )
-
-    init = {f"{key}_s": val for key, val in seed(src).items()}
-    init.update({f"{key}_t": val for key, val in seed(dst).items()})
-    init.update(
-        best=jnp.where(src == dst, 0, INF32).astype(jnp.int32),
-        meet=jnp.where(src == dst, src, -1).astype(jnp.int32),
-        levels=jnp.int32(0),
-        edges=jnp.int32(0),
-    )
-
-    def cond(st):
-        return (
-            (st["lvl_s"] + st["lvl_t"] < st["best"])
-            & (st["cnt_s"] > 0)
-            & (st["cnt_t"] > 0)
-        )
 
     def side_step(st, side):
         fr = st[f"fr_{side}"]
@@ -187,7 +170,41 @@ def _bibfs_2d_body(bnbr, bcnt, deg, src, dst, *, R: int, C: int, mode: str):
             f"sharded2d supports modes 'sync' and 'alt', got {mode!r}"
         )
 
-    out = jax.lax.while_loop(cond, body, init)
+    return body
+
+
+def _bibfs_2d_body(bnbr, bcnt, deg, src, dst, *, R: int, C: int, mode: str):
+    """The whole-search per-device program: seed, while_loop over
+    :func:`_make_2d_body`, output tuple."""
+    n_loc = deg.shape[0]
+    r = jax.lax.axis_index(ROW_AXIS)
+    c = jax.lax.axis_index(COL_AXIS)
+    offset = ((r * C + c) * n_loc).astype(jnp.int32)
+    ids = offset + jnp.arange(n_loc, dtype=jnp.int32)
+    axes = (ROW_AXIS, COL_AXIS)
+
+    def seed(v):
+        fr = ids == v
+        return dict(
+            fr=fr,
+            cnt=jnp.int32(1),
+            par=jax.lax.pcast(
+                jnp.full(n_loc, -1, jnp.int32), axes, to="varying"
+            ),
+            dist=jnp.where(fr, 0, INF32).astype(jnp.int32),
+            lvl=jnp.int32(0),
+        )
+
+    init = {f"{key}_s": val for key, val in seed(src).items()}
+    init.update({f"{key}_t": val for key, val in seed(dst).items()})
+    init.update(
+        best=jnp.where(src == dst, 0, INF32).astype(jnp.int32),
+        meet=jnp.where(src == dst, src, -1).astype(jnp.int32),
+        levels=jnp.int32(0),
+        edges=jnp.int32(0),
+    )
+    body = _make_2d_body(bnbr, bcnt, deg, R=R, C=C, mode=mode)
+    out = jax.lax.while_loop(_2d_cond, body, init)
     return (
         out["best"],
         out["meet"],

@@ -203,3 +203,45 @@ def test_elastic_mesh_resize(tmp_path):
     ) is None
     res = ck.resume(path, g4, src=src, dst=dst, chunk=4)
     _check(res, ora, n, edges, src, dst)
+
+
+def test_chunked_2d_matches_oracle():
+    from bibfs_tpu.parallel.mesh import make_2d_mesh
+    from bibfs_tpu.solvers.sharded2d import Sharded2DGraph
+
+    n, edges = _graph(n=300, seed=13)
+    g = Sharded2DGraph(n, edges, make_2d_mesh(2, 4))
+    for src, dst in [(0, n - 1), (4, 4), (3, 250)]:
+        ora = _oracle(n, edges, src, dst)
+        res = ck.solve_checkpointed(g, src, dst, chunk=2)
+        _check(res, ora, n, edges, src, dst)
+
+
+def test_elastic_dense_to_2d_and_back(tmp_path):
+    """One snapshot, three substrates: interrupt on the single chip,
+    resume on the 2D mesh, interrupt there, finish on the 1D mesh.
+    A beamer-mode snapshot degrades to the pull schedule on the 2D leg."""
+    from bibfs_tpu.parallel.mesh import make_1d_mesh, make_2d_mesh
+    from bibfs_tpu.solvers.sharded import ShardedGraph
+    from bibfs_tpu.solvers.sharded2d import Sharded2DGraph
+
+    n, edges = _graph(n=300, seed=13)
+    src, ora = 3, None
+    for dst in range(4, n):  # first deep reachable target from src
+        cand = _oracle(n, edges, src, dst)
+        if cand.found and cand.hops >= 4:
+            ora = cand
+            break
+    assert ora is not None
+
+    gd = DeviceGraph.build(n, edges)
+    g2 = Sharded2DGraph(n, edges, make_2d_mesh(2, 4))
+    g1 = ShardedGraph.build(n, edges, make_1d_mesh(8))
+
+    path = str(tmp_path / "tri.ckpt")
+    assert ck.solve_checkpointed(
+        gd, src, dst, mode="beamer", chunk=1, path=path, max_chunks=1
+    ) is None
+    assert ck.resume(path, g2, src=src, dst=dst, chunk=1, max_chunks=1) is None
+    res = ck.resume(path, g1, src=src, dst=dst, chunk=8)
+    _check(res, ora, n, edges, src, dst)
