@@ -52,6 +52,16 @@ out = fn(g.nbr, g.deg, g.aux, jnp.int32({src}), jnp.int32({dst}))
 # best/meet are replicated scalars: addressable on every host (the sharded
 # parent arrays are NOT fully addressable here, so only scalars are read)
 print("MH_RESULT", idx, int(np.asarray(out[0])), flush=True)
+
+# the 2D block partition across the SAME two processes: its transpose
+# ppermute and row-axis all_gather now cross the process boundary too
+from bibfs_tpu.parallel.mesh import make_2d_mesh
+from bibfs_tpu.solvers.sharded2d import Sharded2DGraph, _compiled_2d
+
+g2 = Sharded2DGraph(n, edges, make_2d_mesh(2, 4))
+fn2 = _compiled_2d(g2.mesh, 2, 4, "sync")
+out2 = fn2(g2.bnbr, g2.bcnt, g2.deg, jnp.int32({src}), jnp.int32({dst}))
+print("MH2D_RESULT", idx, int(np.asarray(out2[0])), flush=True)
 jax.distributed.shutdown()
 """
 
@@ -88,9 +98,12 @@ def test_two_process_mesh_agrees_with_oracle(tmp_path):
             p.kill()
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"proc {i} failed:\n{out[-1500:]}"
-        results = [
-            line for line in out.splitlines() if line.startswith("MH_RESULT")
-        ]
-        assert results, f"proc {i} printed no result:\n{out[-1500:]}"
-        _tag, _idx, best = results[-1].split()
-        assert int(best) == want.hops, f"proc {i}: best={best} != {want.hops}"
+        for tag in ("MH_RESULT", "MH2D_RESULT"):
+            results = [
+                line for line in out.splitlines() if line.startswith(tag)
+            ]
+            assert results, f"proc {i} printed no {tag}:\n{out[-1500:]}"
+            _tag, _idx, best = results[-1].split()
+            assert int(best) == want.hops, (
+                f"proc {i} {tag}: best={best} != {want.hops}"
+            )
