@@ -58,7 +58,7 @@ FIELDS = [
     "ok",
     "peak_rss_mb",
 ]
-ALL_CONFIGS = ("serial", "native", "dense", "sharded")
+ALL_CONFIGS = ("serial", "native", "dense", "sharded", "sharded2d")
 
 
 def peak_rss_mb() -> float:
@@ -117,6 +117,24 @@ res = solve_dense_graph(g, {src}, {dst}, mode="sync")
 print(json.dumps(dict(
     time_sec=float(np.median(times)), hops=res.hops, levels=res.levels,
     edges_scanned=res.edges_scanned, platform=jax.devices()[0].platform,
+    peak_rss_mb=resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0,
+)))
+"""
+
+SHARDED2D_SUB = """
+import json, resource, sys
+import numpy as np
+sys.path.insert(0, {repo!r})
+from bibfs_tpu.utils.platform import force_cpu
+force_cpu(8)
+from bibfs_tpu.graph.io import read_graph_bin
+from bibfs_tpu.solvers.sharded2d import Sharded2DGraph, time_search_2d
+n, edges = read_graph_bin({bin_path!r})
+g = Sharded2DGraph.build(n, edges, num_devices=8)
+times, res = time_search_2d(g, {src}, {dst}, repeats={repeats}, mode="sync")
+print(json.dumps(dict(
+    time_sec=float(np.median(times)), hops=res.hops, levels=res.levels,
+    edges_scanned=res.edges_scanned,
     peak_rss_mb=resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0,
 )))
 """
@@ -221,6 +239,39 @@ def _bench_dense(scale, n, edges, src, dst, oracle, repeats, timeout,
         out_rows.append(_row("dense/tiered", scale, n, len(edges), "?"))
 
 
+def _bench_sharded2d(scale, n, edges, src, dst, oracle, repeats, timeout,
+                     bin_path, out_rows):
+    try:
+        info = _run_sub(
+            SHARDED2D_SUB.format(
+                repo=REPO, bin_path=bin_path, src=src, dst=dst,
+                repeats=max(2, repeats // 2),
+            ),
+            timeout,
+        )
+        ok = info["hops"] == oracle.hops
+        out_rows.append(
+            _row(
+                "sharded2d-2x4", scale, n, len(edges), "cpu-mesh-emulated",
+                time_sec=info["time_sec"],
+                teps=info["edges_scanned"] / info["time_sec"],
+                hops=info["hops"], levels=info["levels"], ok=ok,
+                peak_rss_mb=round(info["peak_rss_mb"], 1),
+            )
+        )
+        print(
+            f"  sharded2d-2x4 [cpu-emulated]: {info['time_sec']:.4f}s "
+            f"{'OK' if ok else 'MISMATCH'}",
+            flush=True,
+        )
+    except (subprocess.TimeoutExpired, RuntimeError, json.JSONDecodeError,
+            IndexError) as e:
+        print(f"  sharded2d-2x4 FAILED: {e}", file=sys.stderr, flush=True)
+        out_rows.append(
+            _row("sharded2d-2x4", scale, n, len(edges), "cpu-mesh-emulated")
+        )
+
+
 def _bench_sharded(scale, n, edges, src, dst, oracle, repeats, timeout,
                    bin_path, out_rows):
     try:
@@ -262,14 +313,20 @@ def run_scale(
     dense_timeout: int,
     sharded_timeout: int,
     configs: tuple = ALL_CONFIGS,
+    dist: str = "rmat",
+    avg_deg: float = 8.0,
 ):
     from bibfs_tpu.graph.csr import build_csr
-    from bibfs_tpu.graph.generate import rmat_graph
+    from bibfs_tpu.graph.generate import gnp_random_graph, rmat_graph
     from bibfs_tpu.graph.io import write_graph_bin
     from bibfs_tpu.solvers.serial import solve_serial_csr
 
     t0 = time.time()
-    n, edges = rmat_graph(scale, seed=7)
+    if dist == "gnp":
+        n = 1 << scale
+        edges = gnp_random_graph(n, avg_deg / n, seed=7)
+    else:
+        n, edges = rmat_graph(scale, seed=7)
     row_ptr, col_ind = build_csr(n, edges)
     src = int(np.argmax(np.diff(row_ptr)))  # top hub: always in the giant comp.
     dst, depth = farthest_reachable(n, row_ptr, col_ind, src)
@@ -298,7 +355,7 @@ def run_scale(
     if "native" in configs:
         _bench_native(scale, n, edges, src, dst, oracle, repeats, out_rows)
 
-    if not ({"dense", "sharded"} & set(configs)):
+    if not ({"dense", "sharded", "sharded2d"} & set(configs)):
         return
     bin_path = f"/tmp/rmat{scale}.bin"
     write_graph_bin(bin_path, n, edges)
@@ -309,6 +366,9 @@ def run_scale(
         if "sharded" in configs:
             _bench_sharded(scale, n, edges, src, dst, oracle, repeats,
                            sharded_timeout, bin_path, out_rows)
+        if "sharded2d" in configs:
+            _bench_sharded2d(scale, n, edges, src, dst, oracle, repeats,
+                             sharded_timeout, bin_path, out_rows)
     finally:
         os.unlink(bin_path)
 
@@ -331,6 +391,14 @@ def main(argv=None):
         choices=list(ALL_CONFIGS),
         help="which rows to (re)measure; the oracle always runs as the gate",
     )
+    ap.add_argument(
+        "--dist", default="rmat", choices=["rmat", "gnp"],
+        help="graph distribution: rmat (Graph500 skew; default) or gnp "
+        "(uniform G(2^scale, avg-deg/n) — the distribution the 2D block "
+        "layout is sized for)",
+    )
+    ap.add_argument("--avg-deg", type=float, default=8.0,
+                    help="average degree for --dist gnp")
     ap.add_argument(
         "--dense-timeout", type=int, default=1800,
         help="seconds allowed for the single-device (TPU) run per scale",
@@ -358,8 +426,13 @@ def main(argv=None):
                 dense_timeout=args.dense_timeout,
                 sharded_timeout=args.sharded_timeout,
                 configs=tuple(args.configs),
+                dist=args.dist,
+                avg_deg=args.avg_deg,
             )
         finally:
+            if args.dist == "gnp":  # distribution is part of the row identity
+                for r in rows:
+                    r["config"] += f"@gnp-deg{args.avg_deg:g}"
             _append_rows(rows)
             total += len(rows)
         all_ok = all_ok and all(r["ok"] for r in rows)
