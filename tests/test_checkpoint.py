@@ -245,3 +245,18 @@ def test_elastic_dense_to_2d_and_back(tmp_path):
     assert ck.resume(path, g2, src=src, dst=dst, chunk=1, max_chunks=1) is None
     res = ck.resume(path, g1, src=src, dst=dst, chunk=8)
     _check(res, ora, n, edges, src, dst)
+
+
+def test_chunked_random_property_sweep():
+    """Randomized parity: chunked execution on random graphs equals the
+    serial oracle for every substrate it can reach cheaply (dense here;
+    the sharded substrates have their own dedicated tests above)."""
+    from tests.conftest import random_graph_cases
+
+    for i, (n, edges, src, dst) in enumerate(random_graph_cases(num=6, seed=99)):
+        ora = _oracle(n, edges, src, dst)
+        g = DeviceGraph.build(n, edges)
+        res = ck.solve_checkpointed(
+            g, src, dst, mode="beamer" if i % 2 else "sync", chunk=1 + i % 3
+        )
+        _check(res, ora, n, edges, src, dst)
