@@ -154,3 +154,51 @@ def test_native_batch_matches_oracle():
         assert got.time_s == batch_time  # whole-batch wall on every result
     times, timed = time_batch_native(g, pairs, repeats=3)
     assert len(times) == 3 and len(timed) == 12
+
+
+def test_loader_fuzz_no_crashes(tmp_path):
+    """Randomly mutated/truncated graph files must either load cleanly or
+    raise a clean Python error — never crash the process. Exercises both
+    the Python loader and the C loader's validation paths (header-vs-size,
+    endpoint range) with the same corpus."""
+    import os
+
+    from bibfs_tpu.graph.generate import gnp_random_graph
+    from bibfs_tpu.graph.io import read_graph_bin, write_graph_bin
+    from bibfs_tpu.solvers.native import read_graph_native
+
+    n = 60
+    edges = gnp_random_graph(n, 4.0 / n, seed=8)
+    base = str(tmp_path / "base.bin")
+    write_graph_bin(base, n, edges)
+    blob = open(base, "rb").read()
+    rng = np.random.default_rng(0)
+
+    loaded = errored = 0
+    for trial in range(60):
+        b = bytearray(blob)
+        kind = trial % 3
+        if kind == 0:  # flip random bytes (header or payload)
+            for _ in range(int(rng.integers(1, 4))):
+                b[int(rng.integers(len(b)))] = int(rng.integers(256))
+        elif kind == 1:  # truncate
+            b = b[: int(rng.integers(len(b)))]
+        else:  # append garbage
+            b += bytes(rng.integers(0, 256, size=int(rng.integers(1, 16)), dtype=np.uint8))
+        p = str(tmp_path / f"fuzz{trial}.bin")
+        open(p, "wb").write(bytes(b))
+        for loader, err in (
+            (read_graph_bin, (ValueError, OSError)),
+            (read_graph_native, (RuntimeError, OSError)),
+        ):
+            try:
+                n2, e2 = loader(p)
+                # whatever loaded must be internally consistent
+                assert e2.shape[1] == 2
+                assert e2.size == 0 or (0 <= e2.min() and e2.max() < n2)
+                loaded += 1
+            except err:
+                errored += 1
+        os.unlink(p)
+    # the corpus must exercise both outcomes
+    assert loaded > 0 and errored > 0
