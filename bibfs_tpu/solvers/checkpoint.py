@@ -372,9 +372,21 @@ def save_checkpoint(path: str, state: dict, meta: CheckpointMeta) -> None:
 
 
 def load_checkpoint(path: str) -> tuple[CheckpointMeta, dict]:
-    with np.load(path) as z:
-        meta = CheckpointMeta(**json.loads(bytes(z["_meta"].item()).decode()))
-        state = {key: z[key] for key in _STATE_KEYS}
+    """Load a snapshot. A file that is not a valid checkpoint (corrupt
+    archive, missing arrays, malformed metadata) raises ValueError with
+    the reason — never a raw zipfile/pickle/KeyError traceback."""
+    try:
+        with np.load(path) as z:
+            meta = CheckpointMeta(
+                **json.loads(bytes(z["_meta"].item()).decode())
+            )
+            state = {key: z[key] for key in _STATE_KEYS}
+    except OSError:
+        raise  # missing/unreadable file: the errno message is already clear
+    except Exception as e:
+        raise ValueError(
+            f"{path} is not a valid checkpoint: {type(e).__name__}: {e}"
+        ) from e
     return meta, state
 
 

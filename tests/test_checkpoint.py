@@ -260,3 +260,27 @@ def test_chunked_random_property_sweep():
             g, src, dst, mode="beamer" if i % 2 else "sync", chunk=1 + i % 3
         )
         _check(res, ora, n, edges, src, dst)
+
+
+def test_corrupt_checkpoint_raises_cleanly(tmp_path):
+    """A damaged snapshot file must raise ValueError with the reason, not
+    a raw zipfile/KeyError traceback (the CLI maps ValueError to a clean
+    error exit)."""
+    n, edges = _graph(seed=5)
+    g = DeviceGraph.build(n, edges)
+    path = str(tmp_path / "c.ckpt")
+    ck.solve_checkpointed(g, 0, n - 1, chunk=1, path=path, max_chunks=1)
+
+    # truncate the archive
+    blob = open(path, "rb").read()
+    open(path, "wb").write(blob[: len(blob) // 2])
+    with pytest.raises((ValueError, OSError)):
+        ck.load_checkpoint(path)
+    # not a zip at all
+    open(path, "wb").write(b"not a checkpoint")
+    with pytest.raises(ValueError, match="not a valid checkpoint"):
+        ck.load_checkpoint(path)
+    # valid npz, wrong contents
+    np.savez(open(path, "wb"), foo=np.zeros(3))
+    with pytest.raises(ValueError, match="not a valid checkpoint"):
+        ck.load_checkpoint(path)
