@@ -325,3 +325,25 @@ def test_bench_survives_corrupt_ground_truth(tiny_suite, tmp_path, capsys):
         csv_path=str(tmp_path / "r.csv"), table_path=str(tmp_path / "t.txt"),
     )
     assert len(rows) == 1 and rows[0]["ok"]  # ungated: no expected hops
+
+
+def test_calibration_roundtrip(tmp_path, monkeypatch):
+    """run_calibration measures real numbers at a tiny n and the written
+    file is readable by the loader the solver's router uses."""
+    from bibfs_tpu.utils import calibrate
+
+    path = str(tmp_path / "cal.json")
+    monkeypatch.setenv(calibrate.CAL_ENV, path)
+    calibrate._read_calibration_file.cache_clear()
+    data = calibrate.write_calibration(path, n=1024, repeats=2)
+    assert os.path.exists(path)
+    platform = next(iter(data))
+    entry = data[platform]
+    for key in ("pull_level_us", "push_level_us", "push_cap",
+                "dispatch_cached_us"):
+        assert key in entry
+    assert entry["pull_level_us"] > 0
+    calibrate._read_calibration_file.cache_clear()
+    loaded = calibrate.load_calibration()
+    assert loaded is not None and "push_cap" in loaded
+    calibrate._read_calibration_file.cache_clear()
