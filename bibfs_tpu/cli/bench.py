@@ -326,11 +326,11 @@ def main(argv=None):
                  "--layout or bench it separately")
     if args.layout == "tiered" and args.mode.startswith("pallas"):
         ap.error("pallas modes support --layout ell only")
-    if args.pairs is not None and not {"dense", "native", "sharded"} & set(
-        backends
-    ):
-        ap.error("--pairs requires the dense, native and/or sharded backend "
-                 "in --backends")
+    if args.pairs is not None and not {
+        "dense", "native", "sharded", "sharded2d"
+    } & set(backends):
+        ap.error("--pairs requires the dense, native, sharded and/or "
+                 "sharded2d backend in --backends")
     rows = run_bench(
         args.graphs,
         backends,

@@ -5,105 +5,229 @@ kernel (v3/bibfs_cuda_only.cu:13-43, v4/comp.cu:20-38) — the component
 BASELINE.md's north star names as "becomes a Pallas kernel". The CUDA
 kernel is push-style (thread per frontier vertex, atomicExch claims); on
 TPU the same level is computed pull-style over the regularized ELL table
-(see :mod:`bibfs_tpu.ops.expand` for why), and this kernel fuses the whole
-per-tile pipeline that the XLA path expresses as separate HLOs:
+(see :mod:`bibfs_tpu.ops.expand` for why), fusing
 
-    gather frontier[nbr]  ->  mask by degree  ->  any-reduce  ->
+    gather frontier[nbr]  ->  mask  ->  any-reduce  ->
     visited test  ->  first-hit parent select
 
-into one VMEM-resident pass per vertex tile:
+into one VMEM-resident pass per vertex tile.
 
-- grid: 1D over tiles of ``tile_rows`` ELL rows; each step streams its
-  ``[tile_rows, width]`` neighbor block HBM -> VMEM exactly once (the
-  dominant traffic, n_pad*width*4 bytes per level — what the bench's
-  roofline accounting measures);
-- the frontier (int8, n_pad bytes) stays whole in VMEM across tiles —
-  1 MB at 1M vertices, comfortably inside the ~16 MB budget at every
-  size this framework benches — so the per-row neighbor lookup is an
-  on-chip gather, never an HBM round-trip;
-- visited/degree tiles ride in with the block; next-frontier and parent
-  tiles are written once per tile. No atomics anywhere: the parent choice
-  is the deterministic first frontier neighbor in slot order, identical
-  to :func:`bibfs_tpu.ops.expand.expand_pull`.
+Why this shape of kernel — the Mosaic gather contract
+-----------------------------------------------------
+The obvious formulation (round 2 of this file) gathered the frontier at
+the neighbor ids with a flat ``frontier[nbr]``. Mosaic on the bench chip
+(v5e, jax/jaxlib 0.9.0) rejects that: its only vector gather is
+``tpu.dynamic_gather`` over a 2D operand where operand, indices, and
+output all share one shape — i.e. ``take_along_axis`` along lanes
+(``out[i,j] = x[i, idx[i,j]]``) or sublanes (``out[i,j] = x[idx[i,j], j]``)
+with equal shapes (jax/_src/pallas/mosaic/lowering.py, gather rule). An
+arbitrary-index lookup therefore has to be built from those two moves:
+
+- the ELL table is stored TRANSPOSED and sentinel-padded:
+  ``nbr_t int32[Wp, n_pad_p]`` — slot-major, one vertex per lane. Dead
+  slots hold the sentinel id ``n_pad_p`` whose frontier bit is always 0,
+  which deletes the degree/valid mask from the kernel entirely;
+- the frontier is BIT-PACKED into ``uint32`` words arranged
+  ``[chunks, Tc]``. For each chunk ``k`` (a ``Tc``-word = ``32*Tc``-vertex
+  window), the word row is lane-broadcast to ``[Wp, Tc]`` and the word of
+  every neighbor slot is fetched with a lane-wise ``take_along_axis`` —
+  the supported dynamic_gather — then the slot's bit is selected by a
+  logical shift. Chunks outside a slot's window contribute 0, so OR-ing
+  the per-chunk results reconstructs the full arbitrary gather;
+- per-vertex reductions (any-hit, first-hit slot) run along the SUBLANE
+  axis (slots), and the winning parent id is fetched from ``nbr_t`` with
+  the sublane-wise ``take_along_axis`` (the other supported gather form).
+
+Per level the kernel streams the ``[Wp, Tc]`` neighbor blocks HBM->VMEM
+exactly once (the dominant traffic, ``n_pad_p*Wp*4`` bytes); the packed
+frontier (``n_pad_p/8`` bytes) stays whole in VMEM across tiles. The
+chunk loop costs ``chunks`` lane-gathers per tile — one chunk covers
+``32*Tc`` (131072 at ``Tc=4096``) vertices, so every graph this framework
+benches at 1M vertices or below runs 1-8 chunks. No atomics anywhere: the
+parent choice is the deterministic first frontier neighbor in slot order,
+identical to :func:`bibfs_tpu.ops.expand.expand_pull`.
 
 Portability: on non-TPU backends (the CPU test mesh) the kernel runs in
 Pallas interpret mode, so parity tests exercise the same kernel body
-everywhere. On TPU it compiles via Mosaic; if the running jaxlib's Mosaic
-rejects the in-kernel gather (support for vector gathers varies by
-version), callers fall back to the XLA path — see
-:func:`bibfs_tpu.solvers.dense` mode ``"pallas"`` wiring. Measured on the
-bench chip (v5e, jax/jaxlib 0.9.0, 2026-07-30): Mosaic raises
-``NotImplementedError: Only 2D gather is supported`` for the 1D
-frontier-at-neighbor-indices gather, so the compiled path is unavailable
-there and ``pallas``/``pallas_alt`` resolve to the XLA pull kernel; the
-bench's HBM accounting shows that search is dispatch-bound on that
-backend regardless (PERF_NOTES.md §2), so the fallback costs nothing.
+everywhere. On TPU it compiles via Mosaic; :func:`pallas_available`
+probes an end-to-end compile+run once per process and the dense solver
+falls back to the XLA pull path if the probe fails
+(:func:`bibfs_tpu.solvers.dense._resolve_pallas_mode`).
 """
 
 from __future__ import annotations
 
-from functools import lru_cache, partial
+from functools import lru_cache
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-# Preferred rows-per-tile. The actual tile is the largest divisor of n_pad
-# that is <= this and a multiple of 8 (n_pad is always a multiple of 8),
-# so the grid always tiles n_pad exactly — no out-of-bounds blocks, no
-# host-side padding copies inside the search loop.
-PREFERRED_TILE_ROWS = 1024
+LANE = 128
+# lane-block (vertices per grid step, frontier words per chunk) candidates:
+# biggest divisor wins; n_pad_p is always a multiple of the smallest
+LANE_BLOCKS = (4096, 2048, 1024, 512)
+# static chunk loops longer than this would unroll into absurd Mosaic
+# programs; callers route such graphs to the XLA path via pallas_fits()
+# (with _pad_n forcing Tc=4096 past 64k vertices, the limit trips just
+# past 8.3M vertices: 64 chunks * 4096 words * 32 bits)
+MAX_CHUNKS = 64
 
 
-def _tile_rows(n_pad: int) -> int:
-    best = 8
-    for t in range(8, min(PREFERRED_TILE_ROWS, n_pad) + 1, 8):
-        if n_pad % t == 0:
-            best = t
-    return best
+def _pad_n(n_pad: int) -> int:
+    """Vertex-dimension padding for the pallas layout. Small graphs pad to
+    the 512 quantum; past 64k vertices pad all the way to the largest lane
+    block so ``_lane_block`` always picks Tc=4096 — the sentinel-only pad
+    rows cost at most ``Wp*4095*4`` bytes (~256 KB) while a pessimal
+    Tc=512 would cost 8x the chunk-loop work on every level."""
+    q = LANE_BLOCKS[0] if n_pad > (1 << 16) else LANE_BLOCKS[-1]
+    return -(-n_pad // q) * q
 
 
-def _pull_kernel(f_ref, vis_ref, nbr_ref, deg_ref, nf_ref, par_ref):
-    """One vertex tile of pull expansion. Refs:
-    f_ref int8[n_pad] (whole frontier, VMEM-resident), vis_ref int8[tile],
-    nbr_ref int32[tile, width], deg_ref int32[tile];
-    outputs nf_ref int8[tile], par_ref int32[tile]."""
+def _lane_block(n_pad_p: int) -> int:
+    for t in LANE_BLOCKS:
+        if n_pad_p % t == 0:
+            return t
+    raise ValueError(f"n_pad_p={n_pad_p} not a multiple of {LANE_BLOCKS[-1]}")
+
+
+def _word_geometry(n_pad_p: int, tc: int) -> tuple[int, int]:
+    """(n_words_p, chunks): packed words padded to whole chunks. The
+    sentinel id ``n_pad_p`` needs no physical word: its word index falls
+    outside every chunk window, so the in-bounds mask already zeroes its
+    contribution."""
+    chunks = -(-(n_pad_p // 32) // tc)
+    return chunks * tc, chunks
+
+
+def pallas_fits(n_pad: int) -> bool:
+    """Whether the compiled kernel's static chunk loop stays within
+    MAX_CHUNKS for this graph size. Callers (the dense solver and the
+    checkpoint driver) route oversized graphs to the XLA pull path."""
+    n_pad_p = _pad_n(n_pad)
+    tc = _lane_block(n_pad_p)
+    return _word_geometry(n_pad_p, tc)[1] <= MAX_CHUNKS
+
+
+def _slot_pad(width: int) -> int:
+    """ELL width padded up to the int32 sublane quantum."""
+    return max(8, -(-width // 8) * 8)
+
+
+def prepare_pallas_tables(nbr: jnp.ndarray, deg: jnp.ndarray) -> tuple:
+    """Build the kernel's transposed sentinel-padded table from the XLA
+    path's ``[n_pad, width]`` ELL table. Pure jittable ops on loop-constant
+    arrays — the dense solver calls this OUTSIDE its ``while_loop`` so the
+    transpose happens once per solve, not once per level. Returns a
+    one-element pytree ``(nbr_t int32[Wp, n_pad_p],)`` (tuple so it rides
+    the solver's ``aux`` slot)."""
+    n_pad, width = nbr.shape
+    n_pad_p = _pad_n(n_pad)
+    wp = _slot_pad(width)
+    sent = jnp.int32(n_pad_p)  # frontier bit of the sentinel is always 0
+    mask = jnp.arange(width, dtype=jnp.int32)[None, :] < deg[:, None]
+    nbrm = jnp.where(mask, nbr.astype(jnp.int32), sent)
+    nbrm = jnp.pad(
+        nbrm,
+        ((0, n_pad_p - n_pad), (0, wp - width)),
+        constant_values=n_pad_p,
+    )
+    return (nbrm.T,)
+
+
+def _pack_frontier(frontier: jnp.ndarray, n_words_p: int, tc: int) -> jnp.ndarray:
+    """bool[n_pad] -> packed int32[chunks, Tc] (bit v&31 of word v>>5).
+    Cheap XLA prologue fused into the level: O(n_pad) work vs the kernel's
+    table stream."""
+    bits = jnp.pad(
+        frontier.astype(jnp.uint32), (0, n_words_p * 32 - frontier.shape[0])
+    )
+    words = jnp.sum(
+        bits.reshape(n_words_p, 32) << jnp.arange(32, dtype=jnp.uint32)[None, :],
+        axis=1,
+        dtype=jnp.uint32,
+    )
+    return jax.lax.bitcast_convert_type(words, jnp.int32).reshape(-1, tc)
+
+
+def _pull_kernel(chunks: int, tc: int, fw_ref, nbr_ref, vis_ref, nf_ref, par_ref):
+    """One vertex tile (Tc lanes) of pull expansion. Refs:
+    fw_ref int32[chunks, Tc] (whole packed frontier, VMEM-resident),
+    nbr_ref int32[Wp, Tc] (transposed ELL block), vis_ref int32[1, Tc];
+    outputs nf_ref int32[1, Tc], par_ref int32[1, Tc]."""
     nbr = nbr_ref[...]
-    deg = deg_ref[...]
-    valid = jax.lax.broadcasted_iota(jnp.int32, nbr.shape, 1) < deg[:, None]
-    # on-chip gather: every neighbor slot looks up its frontier byte
-    f = f_ref[...]
-    hits = (jnp.take(f, nbr.reshape(-1), axis=0).reshape(nbr.shape) > 0) & valid
-    nf = jnp.any(hits, axis=1) & (vis_ref[...] == 0)
-    j_star = jnp.argmax(hits, axis=1)
-    parent = jnp.take_along_axis(nbr, j_star[:, None], axis=1)[:, 0]
-    nf_ref[...] = nf.astype(jnp.int8)
-    par_ref[...] = parent
+    wp = nbr.shape[0]
+    word = jax.lax.shift_right_logical(nbr, 5)
+    bit_ix = nbr & 31
+    hit = jnp.zeros(nbr.shape, jnp.int32)
+    for k in range(chunks):  # static unroll; bounded by MAX_CHUNKS
+        local = word - k * tc
+        inb = (local >= 0) & (local < tc)
+        lidx = jnp.clip(local, 0, tc - 1)
+        tbl = jnp.broadcast_to(fw_ref[k : k + 1, :], nbr.shape)
+        g = jnp.take_along_axis(tbl, lidx, axis=1, mode="promise_in_bounds")
+        b = jax.lax.shift_right_logical(g, bit_ix) & 1
+        hit = hit | jnp.where(inb, b, 0)
+    # first-hit slot via a sublane max of (Wp - slot); 0 = no hit anywhere
+    slot = jax.lax.broadcasted_iota(jnp.int32, nbr.shape, 0)
+    m = jnp.max(jnp.where(hit > 0, wp - slot, 0), axis=0, keepdims=True)
+    j_star = jnp.clip(wp - m, 0, wp - 1)
+    psel = jnp.take_along_axis(
+        nbr, jnp.broadcast_to(j_star, nbr.shape), axis=0, mode="promise_in_bounds"
+    )
+    nf = (m > 0) & (vis_ref[...] == 0)
+    nf_ref[...] = nf.astype(jnp.int32)
+    # psel rows are identical (every sublane gathered slot j_star); the max
+    # is just a supported way to extract that one row
+    par_ref[...] = jnp.max(psel, axis=0, keepdims=True)
 
 
 @lru_cache(maxsize=None)
-def _get_pull_call(n_pad: int, width: int, interpret: bool):
-    tile = _tile_rows(n_pad)
-    grid = n_pad // tile
+def _get_pull_call(wp: int, n_pad_p: int, interpret: bool):
+    tc = _lane_block(n_pad_p)
+    n_words_p, chunks = _word_geometry(n_pad_p, tc)
+    if chunks > MAX_CHUNKS:
+        raise ValueError(
+            f"pallas pull kernel: {chunks} frontier chunks at n_pad_p="
+            f"{n_pad_p} exceeds MAX_CHUNKS={MAX_CHUNKS}; use the XLA path"
+        )
+    grid = n_pad_p // tc
+    kernel = lambda *refs: _pull_kernel(chunks, tc, *refs)  # noqa: E731
     return pl.pallas_call(
-        _pull_kernel,
+        kernel,
         grid=(grid,),
         in_specs=[
-            pl.BlockSpec((n_pad,), lambda i: (0,)),  # whole frontier
-            pl.BlockSpec((tile,), lambda i: (i,)),
-            pl.BlockSpec((tile, width), lambda i: (i, 0)),
-            pl.BlockSpec((tile,), lambda i: (i,)),
+            pl.BlockSpec((chunks, tc), lambda i: (0, 0)),  # whole packed frontier
+            pl.BlockSpec((wp, tc), lambda i: (0, i)),
+            pl.BlockSpec((1, tc), lambda i: (0, i)),
         ],
         out_specs=[
-            pl.BlockSpec((tile,), lambda i: (i,)),
-            pl.BlockSpec((tile,), lambda i: (i,)),
+            pl.BlockSpec((1, tc), lambda i: (0, i)),
+            pl.BlockSpec((1, tc), lambda i: (0, i)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((n_pad,), jnp.int8),
-            jax.ShapeDtypeStruct((n_pad,), jnp.int32),
+            jax.ShapeDtypeStruct((1, n_pad_p), jnp.int32),
+            jax.ShapeDtypeStruct((1, n_pad_p), jnp.int32),
         ],
         interpret=interpret,
     )
+
+
+def _run_pull(tables: tuple, frontier, visited, interpret: bool | None):
+    (nbr_t,) = tables
+    wp, n_pad_p = nbr_t.shape
+    n_pad = frontier.shape[0]
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    tc = _lane_block(n_pad_p)
+    n_words_p, _chunks = _word_geometry(n_pad_p, tc)
+    fw = _pack_frontier(frontier, n_words_p, tc)
+    visp = jnp.pad(
+        visited.astype(jnp.int32), (0, n_pad_p - n_pad), constant_values=1
+    ).reshape(1, n_pad_p)
+    call = _get_pull_call(wp, n_pad_p, interpret)
+    nf2, par2 = call(fw, nbr_t, visp)
+    return nf2[0, :n_pad] > 0, par2[0, :n_pad]
 
 
 def expand_pull_pallas(
@@ -118,24 +242,26 @@ def expand_pull_pallas(
     (single-table ELL only). Returns ``(next_frontier bool[n_pad],
     parent int32[n_pad])`` with identical semantics.
 
+    Prepares the transposed table on every call — fine for tests and
+    one-shot use; the solver prepares once via
+    :func:`prepare_pallas_tables` and calls :func:`pallas_pull_level`.
+
     ``interpret`` defaults to True off-TPU (CPU test mesh) and False on
     TPU. jit/while_loop-safe: the flag is resolved at trace time.
     """
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
-    call = _get_pull_call(nbr.shape[0], nbr.shape[1], interpret)
-    nf8, parent = call(
-        frontier.astype(jnp.int8), visited.astype(jnp.int8), nbr, deg
+    return _run_pull(
+        prepare_pallas_tables(nbr, deg), frontier, visited, interpret
     )
-    return nf8 > 0, parent
 
 
-def pallas_pull_level(frontier, par, dist, nbr, deg, lvl_next, *, inf: int):
+def pallas_pull_level(frontier, par, dist, tables, deg, lvl_next, *, inf: int):
     """Full pull level via the Pallas kernel, matching the return contract
     of :func:`bibfs_tpu.ops.expand.expand_pull_tiered` with no tiers:
-    ``(next_frontier, par, dist, max_deg_of_new_frontier)``."""
+    ``(next_frontier, par, dist, max_deg_of_new_frontier)``. ``tables`` is
+    the :func:`prepare_pallas_tables` result (built once per solve by the
+    dense kernel, outside its while_loop)."""
     visited = dist < inf
-    nf, pcand = expand_pull_pallas(frontier, visited, nbr, deg)
+    nf, pcand = _run_pull(tables, frontier, visited, None)
     par = jnp.where(nf, pcand, par)
     dist = jnp.where(nf & ~visited, lvl_next, dist)
     max_deg = jnp.max(jnp.where(nf, deg, 0))

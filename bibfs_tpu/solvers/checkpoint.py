@@ -136,7 +136,10 @@ def _sharded_chunk_kernel(
     )
 
     if SHARDED_MODES[mode][2]:
-        raise ValueError("pallas modes are single-chip (dense backend) only")
+        # pallas modes are single-chip (dense backend) only: a snapshot
+        # written under them degrades to its base schedule on the 1D mesh,
+        # same as the 2D leg below — the carry is schedule-portable
+        mode = SHARDED_MODES[mode][0]
     hybrid = SHARDED_MODES[mode][1]
     cap = push_cap if hybrid else 0
     k = max(cap, 1)
@@ -410,16 +413,38 @@ def _get_chunk_step(g, mode: str, chunk: int):
     from bibfs_tpu.parallel.mesh import VERTEX_AXIS
 
     if hasattr(g, "bnbr"):  # Sharded2DGraph
-        kern = _sharded2d_chunk_kernel(g.mesh, g.R, g.C, mode, chunk)
+        # remap BEFORE the lru_cache key so 'pallas'/'beamer' share the
+        # base-schedule kernel instead of compiling identical duplicates
+        kern = _sharded2d_chunk_kernel(
+            g.mesh, g.R, g.C, DENSE_MODES[mode][0], chunk
+        )
         return lambda st: kern(g.bnbr, g.bcnt, g.deg, st)
-    cap = kernel_cap(mode, g.n_pad)
     if hasattr(g, "mesh"):  # ShardedGraph
+        if DENSE_MODES[mode][2]:  # pallas is single-chip: degrade (pre-key)
+            mode = DENSE_MODES[mode][0]
+        cap = kernel_cap(mode, g.n_pad)
         kern = _sharded_chunk_kernel(
             g.mesh, VERTEX_AXIS, mode, cap, g.tier_meta, chunk
         )
-    else:  # DeviceGraph
-        kern = _dense_chunk_kernel(mode, cap, g.tier_meta, chunk)
-    return lambda st: kern(g.nbr, g.deg, g.aux, st)
+        return lambda st: kern(g.nbr, g.deg, g.aux, st)
+    # DeviceGraph
+    from bibfs_tpu.solvers.dense import _resolve_pallas_mode
+
+    mode = _resolve_pallas_mode(mode)  # Mosaic-unsupported -> base schedule
+    aux = g.aux
+    if DENSE_MODES[mode][2]:
+        from bibfs_tpu.ops.pallas_expand import pallas_fits, prepare_pallas_tables
+
+        if pallas_fits(g.n_pad):
+            # build the kernel table ONCE per drive, device-resident, and
+            # ride it through the (plain-ELL-empty) aux slot — each chunk
+            # dispatch reuses it instead of re-transposing per chunk
+            aux = jax.jit(prepare_pallas_tables)(g.nbr, g.deg)
+        else:
+            mode = DENSE_MODES[mode][0]
+    cap = kernel_cap(mode, g.n_pad)
+    kern = _dense_chunk_kernel(mode, cap, g.tier_meta, chunk)
+    return lambda st: kern(g.nbr, g.deg, aux, st)
 
 
 def _drive(g, state_np, meta, *, mode, chunk, path, max_chunks):

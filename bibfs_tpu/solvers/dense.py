@@ -284,8 +284,10 @@ def _side_step(
     v3-style dense schedule). ``use_pallas`` routes the pull level through
     the fused Pallas kernel (plain ELL only)."""
     k = st[f"fi_{side}"].shape[0]
-    hub_rank = aux[0] if aux else None
-    full_tiers = _full_tiers(aux, tier_meta)
+    # under pallas modes aux carries the prepared kernel table, not tier
+    # arrays (plain-ELL only, enforced by _check_mode_layout)
+    hub_rank = aux[0] if aux and not use_pallas else None
+    full_tiers = () if use_pallas else _full_tiers(aux, tier_meta)
     span, ncov = push_span(nbr.shape[1], tier_meta)
     push_tiers = full_tiers[:ncov]
     carry = (
@@ -303,8 +305,10 @@ def _side_step(
         if use_pallas:
             from bibfs_tpu.ops.pallas_expand import pallas_pull_level
 
+            # aux carries the prepared transposed table (built once per
+            # solve, outside the while_loop — see _build_kernel)
             nf, par, dist, md = pallas_pull_level(
-                fr, par, dist, nbr, deg, lvl + 1, inf=INF32
+                fr, par, dist, aux, deg, lvl + 1, inf=INF32
             )
         else:
             nf, par, dist, md = expand_pull_tiered(
@@ -460,8 +464,26 @@ def _build_kernel(mode: str, push_cap: int, tier_meta: tuple = ()):
 
     def kernel(nbr, deg, aux, src, dst):
         n_pad = nbr.shape[0]
+        kmode = mode
+        if DENSE_MODES[mode][2]:
+            from bibfs_tpu.ops.pallas_expand import (
+                pallas_fits,
+                prepare_pallas_tables,
+            )
+
+            if pallas_fits(n_pad):
+                # pallas pull: repurpose the (empty for plain ELL) aux slot
+                # to carry the kernel's transposed sentinel-padded table,
+                # built HERE — outside the while_loop — so the transpose
+                # runs once per solve, not once per level
+                aux = prepare_pallas_tables(nbr, deg)
+            else:
+                # graph too large for the static chunk loop: degrade to the
+                # XLA pull path (same documented fallback as an unsupported
+                # Mosaic), resolved at trace time from the static shape
+                kmode = DENSE_MODES[mode][0]
         init = _init_state(n_pad, k, src, dst, deg)
-        body = _make_body(mode, cap, tier_meta, nbr, deg, aux)
+        body = _make_body(kmode, cap, tier_meta, nbr, deg, aux)
         return _outputs(jax.lax.while_loop(_cond, body, init))
 
     return kernel

@@ -145,6 +145,29 @@ def test_elastic_dense_to_sharded(tmp_path):
     _check(res2, ora, n, edges, src, dst)
 
 
+def test_pallas_snapshot_resumes_on_1d_mesh(tmp_path):
+    """A snapshot written under a pallas mode degrades to its base schedule
+    on the 1D sharded substrate (same rule as the 2D leg) instead of
+    raising — all three substrates accept any recorded mode."""
+    from bibfs_tpu.parallel.mesh import make_1d_mesh
+    from bibfs_tpu.solvers.sharded import ShardedGraph
+
+    cpu_mesh8 = make_1d_mesh(8)
+    n, edges = _graph(n=160, seed=13)
+    src, dst = 0, n - 1
+    ora = _oracle(n, edges, src, dst)
+    assert ora.found and ora.hops >= 3
+
+    gd = DeviceGraph.build(n, edges)
+    gs = ShardedGraph.build(n, edges, cpu_mesh8)
+    path = str(tmp_path / "pallas2s.ckpt")
+    assert ck.solve_checkpointed(
+        gd, src, dst, chunk=1, path=path, max_chunks=1, mode="pallas"
+    ) is None
+    res = ck.resume(path, gs, src=src, dst=dst, chunk=4)
+    _check(res, ora, n, edges, src, dst)
+
+
 def test_sharded_chunked_modes():
     from bibfs_tpu.parallel.mesh import make_1d_mesh
     from bibfs_tpu.solvers.sharded import ShardedGraph

@@ -39,13 +39,27 @@ def test_expand_pull_pallas_matches_xla(seed):
     assert (np.asarray(p0)[sel] == np.asarray(p1)[sel]).all()
 
 
-def test_tile_rows_divides_exactly():
-    from bibfs_tpu.ops.pallas_expand import PREFERRED_TILE_ROWS, _tile_rows
+def test_pallas_geometry_invariants():
+    from bibfs_tpu.ops.pallas_expand import (
+        _lane_block,
+        _pad_n,
+        _slot_pad,
+        _word_geometry,
+    )
 
-    for n_pad in (8, 16, 1000, 1024, 100000, 123456 // 8 * 8):
-        t = _tile_rows(n_pad)
-        assert n_pad % t == 0 and t % 8 == 0
-        assert t <= max(PREFERRED_TILE_ROWS, 8)
+    for n_pad in (8, 16, 1000, 1024, 100000, 123456 // 8 * 8, 1 << 20):
+        n_pad_p = _pad_n(n_pad)
+        assert n_pad_p >= n_pad and n_pad_p % 512 == 0
+        tc = _lane_block(n_pad_p)
+        assert n_pad_p % tc == 0 and tc % 128 == 0
+        n_words_p, chunks = _word_geometry(n_pad_p, tc)
+        assert n_words_p == chunks * tc
+        # every real vertex id has a word to read; the sentinel n_pad_p
+        # needs none (its word index is masked or reads a zero pad word)
+        assert n_words_p * 32 >= n_pad_p
+    for width in (1, 2, 7, 8, 9, 16, 100):
+        wp = _slot_pad(width)
+        assert wp >= width and wp % 8 == 0
 
 
 @pytest.mark.parametrize("mode", ["pallas", "pallas_alt"])
