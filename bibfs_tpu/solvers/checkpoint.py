@@ -98,6 +98,15 @@ def _with_transients(st: dict, k: int, *, axis: str | None = None) -> dict:
 
 
 @lru_cache(maxsize=None)
+def _prepare_tables_jit():
+    """One process-wide jitted table-prep program (a fresh ``jax.jit``
+    per drive would re-trace + re-compile on every resume)."""
+    from bibfs_tpu.ops.pallas_expand import prepare_pallas_tables
+
+    return jax.jit(prepare_pallas_tables)
+
+
+@lru_cache(maxsize=None)
 def _dense_chunk_kernel(mode: str, push_cap: int, tier_meta: tuple, chunk: int):
     """jitted ``(nbr, deg, aux, state) -> state`` advancing at most
     ``chunk`` rounds of the dense search."""
@@ -433,13 +442,13 @@ def _get_chunk_step(g, mode: str, chunk: int):
     mode = _resolve_pallas_mode(mode)  # Mosaic-unsupported -> base schedule
     aux = g.aux
     if DENSE_MODES[mode][2]:
-        from bibfs_tpu.ops.pallas_expand import pallas_fits, prepare_pallas_tables
+        from bibfs_tpu.ops.pallas_expand import pallas_fits
 
         if pallas_fits(g.n_pad):
             # build the kernel table ONCE per drive, device-resident, and
             # ride it through the (plain-ELL-empty) aux slot — each chunk
             # dispatch reuses it instead of re-transposing per chunk
-            aux = jax.jit(prepare_pallas_tables)(g.nbr, g.deg)
+            aux = _prepare_tables_jit()(g.nbr, g.deg)
         else:
             mode = DENSE_MODES[mode][0]
     cap = kernel_cap(mode, g.n_pad)
