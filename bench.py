@@ -155,12 +155,16 @@ PROBE_CODE = (
 def _start_probe() -> subprocess.Popen:
     """Launch the accelerator probe WITHOUT waiting — main() starts it
     first thing and overlaps the whole host-side setup and host-backend
-    measurement with the (potentially ~100 s) tunneled backend init."""
+    measurement with the (potentially ~100 s) tunneled backend init.
+    The child is niced to the bottom so its jax-import CPU burst cannot
+    contend with the concurrently-running host-row timing loops (the
+    probe's own wait is network-bound, not CPU-bound)."""
     return subprocess.Popen(
         [sys.executable, "-c", PROBE_CODE],
         stdout=subprocess.PIPE,
         stderr=subprocess.STDOUT,
         text=True,
+        preexec_fn=lambda: os.nice(19),
     )
 
 

@@ -93,9 +93,9 @@ def _lane_block(n_pad_p: int) -> int:
 
 def _word_geometry(n_pad_p: int, tc: int) -> tuple[int, int]:
     """(n_words_p, chunks): packed words padded to whole chunks. The
-    sentinel id ``n_pad_p`` needs no physical word: its word index falls
-    outside every chunk window, so the in-bounds mask already zeroes its
-    contribution."""
+    sentinel id ``n_pad_p`` needs no dedicated word: its word index either
+    falls outside every chunk window (the in-bounds mask zeroes it) or
+    lands in the zero-padded tail of the packed array — both read as 0."""
     chunks = -(-(n_pad_p // 32) // tc)
     return chunks * tc, chunks
 

@@ -186,12 +186,14 @@ def _sharded_chunk_kernel(
 
 
 @lru_cache(maxsize=None)
-def _sharded2d_chunk_kernel(mesh, R: int, C: int, mode: str, chunk: int):
-    """shard_map'd ``(bnbr, bcnt, deg, state) -> state`` advancing at most
-    ``chunk`` rounds of the 2D-partitioned search. The portable carry's
-    ``md_*`` (Beamer gate input, unused by the pull-only 2D body) is
-    dropped on entry and recomputed from the live frontier on exit, so a
-    snapshot leaving a 2D mesh resumes correctly on a Beamer-routed
+def _sharded2d_chunk_kernel(
+    mesh, R: int, C: int, mode: str, tier_meta: tuple, chunk: int
+):
+    """shard_map'd ``(bnbr, bcnt, deg, aux, state) -> state`` advancing at
+    most ``chunk`` rounds of the 2D-partitioned search. The portable
+    carry's ``md_*`` (Beamer gate input, unused by the pull-only 2D body)
+    is dropped on entry and recomputed from the live frontier on exit, so
+    a snapshot leaving a 2D mesh resumes correctly on a Beamer-routed
     backend."""
     from bibfs_tpu.parallel.mesh import COL_AXIS, ROW_AXIS
     from bibfs_tpu.solvers.sharded2d import _2d_cond, _make_2d_body
@@ -199,18 +201,26 @@ def _sharded2d_chunk_kernel(mesh, R: int, C: int, mode: str, chunk: int):
     # the 2D path is pull-only: hybrid/pallas schedules degrade to their
     # base schedule (DENSE_MODES' first column) when a snapshot written
     # under them resumes on a 2D mesh — the level-synchronous carry is
-    # schedule-portable
+    # schedule-portable (the caller also remaps pre-cache-key; this is
+    # belt-and-braces for direct callers)
     mode2d = DENSE_MODES[mode][0]
     axes = (ROW_AXIS, COL_AXIS)
     blk4 = P(ROW_AXIS, COL_AXIS, None, None)
     blk3 = P(ROW_AXIS, COL_AXIS, None)
     own = P((ROW_AXIS, COL_AXIS))
     rep = P()
+    aux_spec = tuple((blk4, blk3) for _ in tier_meta)
     st_spec = {key: own for key in _VERTEX_KEYS}
     st_spec.update({key: rep for key in _SCALAR_KEYS})
 
-    def fn(bnbr, bcnt, deg, st):
-        body = _make_2d_body(bnbr[0, 0], bcnt[0, 0], deg, R=R, C=C, mode=mode2d)
+    def fn(bnbr, bcnt, deg, aux, st):
+        tiers = tuple(
+            (start, tn[0, 0], ti[0, 0])
+            for (start, _kp, _wt), (tn, ti) in zip(tier_meta, aux)
+        )
+        body = _make_2d_body(
+            bnbr[0, 0], bcnt[0, 0], deg, tiers, R=R, C=C, mode=mode2d
+        )
         loop_st = {k: v for k, v in st.items() if not k.startswith("md_")}
 
         def cond2(c2):
@@ -230,7 +240,7 @@ def _sharded2d_chunk_kernel(mesh, R: int, C: int, mode: str, chunk: int):
         jax.shard_map(
             fn,
             mesh=mesh,
-            in_specs=(blk4, blk3, own, dict(st_spec)),
+            in_specs=(blk4, blk3, own, aux_spec, dict(st_spec)),
             out_specs=dict(st_spec),
         )
     )
@@ -425,9 +435,9 @@ def _get_chunk_step(g, mode: str, chunk: int):
         # remap BEFORE the lru_cache key so 'pallas'/'beamer' share the
         # base-schedule kernel instead of compiling identical duplicates
         kern = _sharded2d_chunk_kernel(
-            g.mesh, g.R, g.C, DENSE_MODES[mode][0], chunk
+            g.mesh, g.R, g.C, DENSE_MODES[mode][0], g.tier_meta, chunk
         )
-        return lambda st: kern(g.bnbr, g.bcnt, g.deg, st)
+        return lambda st: kern(g.bnbr, g.bcnt, g.deg, g.aux, st)
     if hasattr(g, "mesh"):  # ShardedGraph
         if DENSE_MODES[mode][2]:  # pallas is single-chip: degrade (pre-key)
             mode = DENSE_MODES[mode][0]
@@ -444,13 +454,16 @@ def _get_chunk_step(g, mode: str, chunk: int):
     if DENSE_MODES[mode][2]:
         from bibfs_tpu.ops.pallas_expand import pallas_fits
 
-        if pallas_fits(g.n_pad):
+        if g.tier_meta or not pallas_fits(g.n_pad):
+            # a pallas-mode snapshot resumed on a tiered-layout graph (or
+            # one too large for the chunk loop) degrades to its base
+            # schedule — same rule as the 1D/2D substrates
+            mode = DENSE_MODES[mode][0]
+        else:
             # build the kernel table ONCE per drive, device-resident, and
             # ride it through the (plain-ELL-empty) aux slot — each chunk
             # dispatch reuses it instead of re-transposing per chunk
             aux = _prepare_tables_jit()(g.nbr, g.deg)
-        else:
-            mode = DENSE_MODES[mode][0]
     cap = kernel_cap(mode, g.n_pad)
     kern = _dense_chunk_kernel(mode, cap, g.tier_meta, chunk)
     return lambda st: kern(g.nbr, g.deg, aux, st)

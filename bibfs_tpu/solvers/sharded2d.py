@@ -30,14 +30,28 @@ per-device traffic by the MESH shape:
 Semantics match the 1D/dense solvers exactly: level-synchronous pull,
 deterministic parents (first ELL slot within a block, max across blocks),
 the provably-correct ``lvl_s + lvl_t >= best`` termination, true hop
-counts. Pull-only and plain blocks (no hub tiers, no Beamer push) — on a
-2D mesh the frontier exchange is already frontier-size-independent per
-level, which is what push bought the 1D solver.
+counts.
 
-Trade-off, stated honestly: block ELL padding is worse than 1D ELL (each
-row range pads to the max per-block row length ACROSS blocks), so padded
-slots grow by up to ~C x on low-degree graphs. 2D is the layout for when
-ICI traffic, not HBM capacity, is the binding constraint.
+**Tiered blocks** (capability parity with the 1D/dense tiered-ELL layout,
+:func:`bibfs_tpu.graph.csr.build_tiered`): a single block width pads every
+(vertex, column-block) group to the max group size across the whole grid,
+which on skewed (RMAT) graphs blows the table up by the hub degree. The
+builder instead picks the base width minimizing total padded slots and
+spills hub groups into geometric per-block overflow tiers
+``(tnbr [R, C, K_pad, Wt], tids [R, C, K_pad])`` indexed by block-local
+row ids; the expansion adds one small gather + scatter-max per tier. On
+low-skew graphs the plan degenerates to zero tiers — identical layout and
+cost to the plain blocks. ``Sharded2DGraph.padded_slots`` reports the
+footprint either way.
+
+**Pull-only, by design** (the measured case, PERF_NOTES.md): Beamer push
+buys the 1D solver a frontier-size-PROPORTIONAL level cost because its
+exchange is O(n) regardless; the 2D exchange is already bounded by the
+mesh — O(n/C + n/R) wire bytes per level, frontier-size-independent — so
+a push leg would save only block-table HBM reads at small frontiers while
+adding a second (CSC-ordered) copy of every block. HBM capacity is the 2D
+layout's scarce resource (it exists to fit big graphs); spending ~2x block
+storage to accelerate the cheap levels inverts the trade-off.
 """
 
 from __future__ import annotations
@@ -79,13 +93,15 @@ def _2d_cond(st):
     )
 
 
-def _make_2d_body(bnbr, bcnt, deg, *, R: int, C: int, mode: str):
+def _make_2d_body(bnbr, bcnt, deg, tiers=(), *, R: int, C: int, mode: str):
     """The while_loop body ``st -> st`` over this device's adjacency block
     — shared by the one-shot program below and the chunked/checkpointed
     program (:mod:`bibfs_tpu.solvers.checkpoint`), so the two execution
     strategies cannot diverge. ``bnbr``/``bcnt``: [nr, W] localized
-    neighbor ids + per-row slot counts; ``deg``: owned slice of true
-    degrees [n_loc]."""
+    neighbor ids + per-row TRUE group sizes; ``deg``: owned slice of true
+    degrees [n_loc]; ``tiers``: per-device hub-tier blocks, a tuple of
+    ``(start, tnbr [K_pad, Wt], tids [K_pad])`` with static start/shapes
+    (tids are block-local row ids, -1 padding)."""
     nr, W = bnbr.shape
     n_loc = deg.shape[0]
     nc = n_loc * R  # column-range width (= n_pad / C)
@@ -119,6 +135,20 @@ def _make_2d_body(bnbr, bcnt, deg, *, R: int, C: int, mode: str):
         cand = jnp.where(
             jnp.any(hits, axis=1), p_loc + c * nc, -1
         ).astype(jnp.int32)
+        for start, tnbr, tids in tiers:  # hub overflow: gather + scatter-max
+            wt = tnbr.shape[1]
+            ids_c = jnp.clip(tids, 0, nr - 1)
+            scnt = jnp.clip(bcnt[ids_c] - start, 0, wt)
+            tvalid = (
+                jnp.arange(wt, dtype=jnp.int32)[None, :] < scnt[:, None]
+            ) & (tids >= 0)[:, None]
+            thits = f_col[tnbr] & tvalid
+            tany = jnp.any(thits, axis=1)
+            tj = jnp.argmax(thits, axis=1)
+            tp = jnp.take_along_axis(tnbr, tj[:, None], axis=1)[:, 0]
+            tcand = jnp.where(tany, tp + c * nc, -1).astype(jnp.int32)
+            tgt = jnp.where(tany, ids_c, nr)  # nr = out of bounds -> drop
+            cand = cand.at[tgt].max(tcand, mode="drop")
         # 3. fold: max parent across the row; my owned slice is exactly
         #    chunk c of the row range (row-major layout), so one slice
         #    finishes the level — no second permute
@@ -173,7 +203,9 @@ def _make_2d_body(bnbr, bcnt, deg, *, R: int, C: int, mode: str):
     return body
 
 
-def _bibfs_2d_body(bnbr, bcnt, deg, src, dst, *, R: int, C: int, mode: str):
+def _bibfs_2d_body(
+    bnbr, bcnt, deg, src, dst, tiers=(), *, R: int, C: int, mode: str
+):
     """The whole-search per-device program: seed, while_loop over
     :func:`_make_2d_body`, output tuple."""
     n_loc = deg.shape[0]
@@ -203,7 +235,7 @@ def _bibfs_2d_body(bnbr, bcnt, deg, src, dst, *, R: int, C: int, mode: str):
         levels=jnp.int32(0),
         edges=jnp.int32(0),
     )
-    body = _make_2d_body(bnbr, bcnt, deg, R=R, C=C, mode=mode)
+    body = _make_2d_body(bnbr, bcnt, deg, tiers, R=R, C=C, mode=mode)
     out = jax.lax.while_loop(_2d_cond, body, init)
     return (
         out["best"],
@@ -215,32 +247,49 @@ def _bibfs_2d_body(bnbr, bcnt, deg, src, dst, *, R: int, C: int, mode: str):
     )
 
 
-def _2d_fn(mesh, R: int, C: int, mode: str):
+def _2d_fn(mesh, R: int, C: int, mode: str, tier_meta: tuple = ()):
+    """``tier_meta`` is the static ``(start, K_pad, Wt)`` triple per hub
+    tier (the jit-cache key half); the matching device arrays ride the
+    ``aux`` argument as ``((tnbr, tids), ...)``."""
     blk4 = P(ROW_AXIS, COL_AXIS, None, None)
     blk3 = P(ROW_AXIS, COL_AXIS, None)
     own = P((ROW_AXIS, COL_AXIS))
     rep = P()
+    aux_spec = tuple((blk4, blk3) for _ in tier_meta)
+
+    def fn(bnbr, bcnt, deg, aux, src, dst):
+        tiers = tuple(
+            (start, tn[0, 0], ti[0, 0])
+            for (start, _kp, _wt), (tn, ti) in zip(tier_meta, aux)
+        )
+        return _bibfs_2d_body(
+            bnbr[0, 0], bcnt[0, 0], deg, src, dst, tiers, R=R, C=C, mode=mode
+        )
+
     return jax.shard_map(
-        lambda bnbr, bcnt, deg, src, dst: _bibfs_2d_body(
-            bnbr[0, 0], bcnt[0, 0], deg, src, dst, R=R, C=C, mode=mode
-        ),
+        fn,
         mesh=mesh,
-        in_specs=(blk4, blk3, own, rep, rep),
+        in_specs=(blk4, blk3, own, aux_spec, rep, rep),
         out_specs=(rep, rep, own, own, rep, rep),
     )
 
 
 @lru_cache(maxsize=None)
-def _compiled_2d(mesh, R: int, C: int, mode: str):
-    return jax.jit(_2d_fn(mesh, R, C, mode))
+def _compiled_2d(mesh, R: int, C: int, mode: str, tier_meta: tuple = ()):
+    return jax.jit(_2d_fn(mesh, R, C, mode, tier_meta))
 
 
 @lru_cache(maxsize=None)
-def _compiled_2d_batch(mesh, R: int, C: int, mode: str):
+def _compiled_2d_batch(mesh, R: int, C: int, mode: str, tier_meta: tuple = ()):
     """vmap of the 2D search over (src, dst) pairs — B block-partitioned
     searches per collective program, same contract as the 1D
     :func:`bibfs_tpu.solvers.sharded._compiled_sharded_batch`."""
-    return jax.jit(jax.vmap(_2d_fn(mesh, R, C, mode), in_axes=(None, None, None, 0, 0)))
+    return jax.jit(
+        jax.vmap(
+            _2d_fn(mesh, R, C, mode, tier_meta),
+            in_axes=(None, None, None, None, 0, 0),
+        )
+    )
 
 
 class Sharded2DGraph:
@@ -271,26 +320,84 @@ class Sharded2DGraph:
         cb = v // nc  # column block of each directed edge's target
         gkey = u * C + cb  # consecutive groups: pairs sorted by (u, v)
         counts = np.bincount(gkey, minlength=n_pad * C)
+        cmat = counts.reshape(n_pad, C)  # [vertex, col block] TRUE sizes
         if pairs.size:
             firsts = np.zeros(gkey.size, dtype=np.int64)
             starts = np.flatnonzero(np.diff(gkey)) + 1
             firsts[starts] = starts
             np.maximum.accumulate(firsts, out=firsts)
             rank_blk = np.arange(gkey.size) - firsts
-            W = int(rank_blk.max()) + 1
+            w_max = int(rank_blk.max()) + 1
         else:
             rank_blk = np.zeros(0, dtype=np.int64)
-            W = 1
-        self.width = W
-        bnbr = np.zeros((R, C, nr, W), dtype=np.int32)
+            w_max = 1
+
+        # base width: same slot-minimizing selection as the 1D tiered
+        # builder (graph/csr.build_tiered), over (vertex, col-block) group
+        # sizes; the footprint model is exact (base + padded tier rows)
+        from bibfs_tpu.graph.csr import (
+            _BASE_WIDTHS,
+            _pad_hub_count,
+            _tier_plan,
+        )
+
+        def _tier_rows_pad(start: int) -> int:
+            per_dev = (cmat > start).reshape(R, nr, C).sum(axis=1)  # [R, C]
+            k = int(per_dev.max())
+            return _pad_hub_count(k) if k else 0
+
+        def _slots(w0: int) -> int:
+            total = n_pad * C * w0  # R*C devices x nr rows x w0
+            for start, width in _tier_plan(w0, w_max):
+                total += R * C * _tier_rows_pad(start) * width
+            return total
+
+        cands = [w for w in _BASE_WIDTHS if w < w_max] + [w_max]
+        w0 = min(cands, key=_slots)
+        self.width = w0
+        self.max_group = w_max
+
+        bnbr = np.zeros((R, C, nr, w0), dtype=np.int32)
         if pairs.size:
-            bnbr[u // nr, cb, u % nr, rank_blk] = v - cb * nc  # localized
-        bcnt = counts.reshape(n_pad, C)  # [vertex, col block]
+            sel = rank_blk < w0
+            bnbr[u[sel] // nr, cb[sel], u[sel] % nr, rank_blk[sel]] = (
+                v[sel] - cb[sel] * nc
+            )  # localized
         bcnt = (
-            bcnt.reshape(R, nr, C).transpose(0, 2, 1).astype(np.int32)
+            cmat.reshape(R, nr, C).transpose(0, 2, 1).astype(np.int32)
         )  # -> [R, C, nr]
         deg = np.zeros(n_pad, dtype=np.int32)
         deg[:n] = np.bincount(u, minlength=n)[:n]
+
+        # geometric hub tiers: groups whose size exceeds the base width
+        # spill rank range [start, start+Wt) into per-device overflow rows
+        tiers_np = []
+        meta = []
+        for start, wt in _tier_plan(w0, w_max):
+            mu, mcb = np.nonzero(cmat > start)  # members, row-major order
+            mdev = (mu // nr) * C + mcb
+            order = np.argsort(mdev, kind="stable")
+            mu, mcb, mdev = mu[order], mcb[order], mdev[order]
+            tfirst = np.zeros(mdev.size, dtype=np.int64)
+            tstarts = np.flatnonzero(np.diff(mdev)) + 1
+            tfirst[tstarts] = tstarts
+            np.maximum.accumulate(tfirst, out=tfirst)
+            k_local = np.arange(mdev.size) - tfirst  # rank within device
+            k_pad = _tier_rows_pad(start)
+            tnbr = np.zeros((R, C, k_pad, wt), dtype=np.int32)
+            tids = np.full((R, C, k_pad), -1, dtype=np.int32)
+            tids[mu // nr, mcb, k_local] = (mu % nr).astype(np.int32)
+            gk = np.full((n_pad, C), -1, dtype=np.int64)
+            gk[mu, mcb] = k_local
+            esel = (rank_blk >= start) & (rank_blk < start + wt)
+            if esel.any():
+                us, cbs = u[esel], cb[esel]
+                tnbr[us // nr, cbs, gk[us, cbs], rank_blk[esel] - start] = (
+                    v[esel] - cbs * nc
+                ).astype(np.int32)
+            tiers_np.append((tnbr, tids))
+            meta.append((start, k_pad, wt))
+        self.tier_meta = tuple(meta)
 
         blk = NamedSharding(mesh, P(ROW_AXIS, COL_AXIS, None, None))
         blk3 = NamedSharding(mesh, P(ROW_AXIS, COL_AXIS, None))
@@ -298,6 +405,19 @@ class Sharded2DGraph:
         self.bnbr = jax.device_put(bnbr, blk)
         self.bcnt = jax.device_put(bcnt, blk3)
         self.deg = jax.device_put(deg, own)
+        self.aux = tuple(
+            (jax.device_put(tn, blk), jax.device_put(ti, blk3))
+            for tn, ti in tiers_np
+        )
+
+    @property
+    def padded_slots(self) -> int:
+        """Total stored neighbor slots (base blocks + tier rows) — the HBM
+        footprint the tiered layout exists to bound."""
+        base = self.R * self.C * (self.n_pad // self.R) * self.width
+        return base + sum(
+            self.R * self.C * kp * wt for (_s, kp, wt) in self.tier_meta
+        )
 
     @classmethod
     def build(cls, n, edges, mesh=None, *, rows=None, cols=None,
@@ -326,9 +446,11 @@ def solve_sharded2d_graph(
         raise ValueError(f"src/dst out of range for n={g.n}")
     from bibfs_tpu.solvers.timing import force_scalar
 
-    fn = _compiled_2d(g.mesh, g.R, g.C, mode)
+    fn = _compiled_2d(g.mesh, g.R, g.C, mode, g.tier_meta)
     t0 = time.perf_counter()
-    out = fn(g.bnbr, g.bcnt, g.deg, _device_scalar(src), _device_scalar(dst))
+    out = fn(
+        g.bnbr, g.bcnt, g.deg, g.aux, _device_scalar(src), _device_scalar(dst)
+    )
     force_scalar(out)  # execution is lazy until a value read; see timing.py
     return _materialize(out, time.perf_counter() - t0)
 
@@ -339,11 +461,11 @@ def time_search_2d(
 ) -> tuple[list[float], BFSResult]:
     from bibfs_tpu.solvers.timing import force_scalar, timed_repeats
 
-    fn = _compiled_2d(g.mesh, g.R, g.C, mode)
+    fn = _compiled_2d(g.mesh, g.R, g.C, mode, g.tier_meta)
     src_a = _device_scalar(src)
     dst_a = _device_scalar(dst)
     return timed_repeats(
-        lambda: fn(g.bnbr, g.bcnt, g.deg, src_a, dst_a),
+        lambda: fn(g.bnbr, g.bcnt, g.deg, g.aux, src_a, dst_a),
         lambda: solve_sharded2d_graph(g, src, dst, mode=mode),
         repeats,
         force=force_scalar,
@@ -354,11 +476,11 @@ def _batch_dispatch_2d(g: "Sharded2DGraph", pairs, mode: str):
     pairs = np.asarray(pairs, dtype=np.int64).reshape(-1, 2)
     if pairs.size and not ((0 <= pairs).all() and (pairs < g.n).all()):
         raise ValueError(f"src/dst out of range for n={g.n}")
-    kern = _compiled_2d_batch(g.mesh, g.R, g.C, mode)
+    kern = _compiled_2d_batch(g.mesh, g.R, g.C, mode, g.tier_meta)
     srcs = jnp.asarray(pairs[:, 0], dtype=jnp.int32)
     dsts = jnp.asarray(pairs[:, 1], dtype=jnp.int32)
     return pairs, lambda: jax.block_until_ready(
-        kern(g.bnbr, g.bcnt, g.deg, srcs, dsts)
+        kern(g.bnbr, g.bcnt, g.deg, g.aux, srcs, dsts)
     )
 
 

@@ -59,8 +59,8 @@ from bibfs_tpu.parallel.mesh import make_2d_mesh
 from bibfs_tpu.solvers.sharded2d import Sharded2DGraph, _compiled_2d
 
 g2 = Sharded2DGraph(n, edges, make_2d_mesh(2, 4))
-fn2 = _compiled_2d(g2.mesh, 2, 4, "sync")
-out2 = fn2(g2.bnbr, g2.bcnt, g2.deg, jnp.int32({src}), jnp.int32({dst}))
+fn2 = _compiled_2d(g2.mesh, 2, 4, "sync", g2.tier_meta)
+out2 = fn2(g2.bnbr, g2.bcnt, g2.deg, g2.aux, jnp.int32({src}), jnp.int32({dst}))
 print("MH2D_RESULT", idx, int(np.asarray(out2[0])), flush=True)
 jax.distributed.shutdown()
 """
