@@ -128,7 +128,11 @@ def _dense_chunk_kernel(mode: str, push_cap: int, tier_meta: tuple, chunk: int):
         )
         return _strip(st)
 
-    return jax.jit(kernel)
+    # donate the state: the caller replaces its reference on every chunk
+    # (st = step(st)), so the previous buffers are dead — without donation
+    # each dispatch holds TWO full copies of the vertex state, which is
+    # what pushed the scale-24 dense run over single-chip HBM
+    return jax.jit(kernel, donate_argnums=3)
 
 
 @lru_cache(maxsize=None)
@@ -181,7 +185,8 @@ def _sharded_chunk_kernel(
             mesh=mesh,
             in_specs=(sh, sh, aux_spec, st_spec),
             out_specs=dict(st_spec),
-        )
+        ),
+        donate_argnums=3,  # same dead-previous-state rule as the dense leg
     )
 
 
@@ -242,7 +247,8 @@ def _sharded2d_chunk_kernel(
             mesh=mesh,
             in_specs=(blk4, blk3, own, aux_spec, dict(st_spec)),
             out_specs=dict(st_spec),
-        )
+        ),
+        donate_argnums=4,  # same dead-previous-state rule as the dense leg
     )
 
 

@@ -100,7 +100,7 @@ def farthest_reachable(n: int, row_ptr, col_ind, src: int) -> tuple[int, int]:
 
 
 DENSE_SUB = """
-import json, resource, sys
+import json, resource, sys, time
 import numpy as np
 sys.path.insert(0, {repo!r})
 from bibfs_tpu.utils.platform import apply_platform_env
@@ -110,10 +110,24 @@ from bibfs_tpu.graph.io import read_graph_bin
 from bibfs_tpu.solvers.dense import DeviceGraph, solve_dense_graph, time_search_only
 n, edges = read_graph_bin({bin_path!r})
 g = DeviceGraph.build(n, edges, layout="tiered")
-# forced-execution timing (solvers/timing.py); a fresh subprocess per scale
-# keeps compile caches and runtime mode isolated between scales
-times = time_search_only(g, {src}, {dst}, repeats={repeats}, mode="sync")
-res = solve_dense_graph(g, {src}, {dst}, mode="sync")
+if {chunked}:
+    # chunked execution (solvers/checkpoint.py, no snapshot path): bounds
+    # live HBM to ONE donated copy of the vertex state per dispatch — the
+    # whole-search while_loop program exceeded single-chip HBM at scale 24.
+    # Each chunk's termination-scalar read forces execution, so the wall
+    # timing protocol is the same forced-execution one as time_search_only.
+    from bibfs_tpu.solvers.checkpoint import solve_checkpointed
+    times = []
+    res = None
+    for _ in range({repeats}):
+        t0 = time.perf_counter()
+        res = solve_checkpointed(g, {src}, {dst}, chunk=4)
+        times.append(time.perf_counter() - t0)
+else:
+    # forced-execution timing (solvers/timing.py); a fresh subprocess per
+    # scale keeps compile caches and runtime mode isolated between scales
+    times = time_search_only(g, {src}, {dst}, repeats={repeats}, mode="sync")
+    res = solve_dense_graph(g, {src}, {dst}, mode="sync")
 print(json.dumps(dict(
     time_sec=float(np.median(times)), hops=res.hops, levels=res.levels,
     edges_scanned=res.edges_scanned, platform=jax.devices()[0].platform,
@@ -209,11 +223,13 @@ def _bench_native(scale, n, edges, src, dst, oracle, repeats, out_rows):
 
 
 def _bench_dense(scale, n, edges, src, dst, oracle, repeats, timeout,
-                 bin_path, out_rows):
+                 bin_path, out_rows, chunked=False):
+    label = "dense/tiered-chunked" if chunked else "dense/tiered"
     try:
         info = _run_sub(
             DENSE_SUB.format(
-                repo=REPO, bin_path=bin_path, src=src, dst=dst, repeats=repeats
+                repo=REPO, bin_path=bin_path, src=src, dst=dst,
+                repeats=repeats, chunked=chunked,
             ),
             timeout,
         )
@@ -221,7 +237,7 @@ def _bench_dense(scale, n, edges, src, dst, oracle, repeats, timeout,
         ok = info["hops"] == oracle.hops
         out_rows.append(
             _row(
-                "dense/tiered", scale, n, len(edges), info["platform"],
+                label, scale, n, len(edges), info["platform"],
                 time_sec=t_dense,
                 teps=info["edges_scanned"] / t_dense if t_dense else None,
                 hops=info["hops"], levels=info["levels"], ok=ok,
@@ -229,14 +245,14 @@ def _bench_dense(scale, n, edges, src, dst, oracle, repeats, timeout,
             )
         )
         print(
-            f"  dense/tiered [{info['platform']}]: {t_dense:.4f}s "
+            f"  {label} [{info['platform']}]: {t_dense:.4f}s "
             f"teps={out_rows[-1]['teps']:.3e} {'OK' if ok else 'MISMATCH'}",
             flush=True,
         )
     except (subprocess.TimeoutExpired, RuntimeError, json.JSONDecodeError,
             IndexError) as e:
-        print(f"  dense/tiered FAILED: {e}", file=sys.stderr, flush=True)
-        out_rows.append(_row("dense/tiered", scale, n, len(edges), "?"))
+        print(f"  {label} FAILED: {e}", file=sys.stderr, flush=True)
+        out_rows.append(_row(label, scale, n, len(edges), "?"))
 
 
 def _bench_sharded2d(scale, n, edges, src, dst, oracle, repeats, timeout,
@@ -315,6 +331,7 @@ def run_scale(
     configs: tuple = ALL_CONFIGS,
     dist: str = "rmat",
     avg_deg: float = 8.0,
+    dense_chunked: bool | None = None,
 ):
     from bibfs_tpu.graph.csr import build_csr
     from bibfs_tpu.graph.generate import gnp_random_graph, rmat_graph
@@ -361,8 +378,11 @@ def run_scale(
     write_graph_bin(bin_path, n, edges)
     try:
         if "dense" in configs:
+            # chunked execution by default at scale >= 24: the one-shot
+            # while_loop program exceeded single-chip HBM there (round 2)
+            chunked = dense_chunked if dense_chunked is not None else scale >= 24
             _bench_dense(scale, n, edges, src, dst, oracle, repeats,
-                         dense_timeout, bin_path, out_rows)
+                         dense_timeout, bin_path, out_rows, chunked=chunked)
         if "sharded" in configs:
             _bench_sharded(scale, n, edges, src, dst, oracle, repeats,
                            sharded_timeout, bin_path, out_rows)
@@ -404,6 +424,11 @@ def main(argv=None):
         help="seconds allowed for the single-device (TPU) run per scale",
     )
     ap.add_argument(
+        "--dense-chunked", type=int, default=None, choices=[0, 1],
+        help="force the dense row through chunked execution (1) or the "
+        "one-shot while_loop (0); default: chunked at scale >= 24",
+    )
+    ap.add_argument(
         "--sharded-timeout", type=int, default=1800,
         help="seconds allowed for the 8-device CPU-mesh emulation per scale",
     )
@@ -428,6 +453,10 @@ def main(argv=None):
                 configs=tuple(args.configs),
                 dist=args.dist,
                 avg_deg=args.avg_deg,
+                dense_chunked=(
+                    None if args.dense_chunked is None
+                    else bool(args.dense_chunked)
+                ),
             )
         finally:
             if args.dist == "gnp":  # distribution is part of the row identity
