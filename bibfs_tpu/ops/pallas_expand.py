@@ -150,36 +150,137 @@ def _pack_frontier(frontier: jnp.ndarray, n_words_p: int, tc: int) -> jnp.ndarra
     return jax.lax.bitcast_convert_type(words, jnp.int32).reshape(-1, tc)
 
 
-def _pull_kernel(chunks: int, tc: int, fw_ref, nbr_ref, vis_ref, nf_ref, par_ref):
-    """One vertex tile (Tc lanes) of pull expansion. Refs:
-    fw_ref int32[chunks, Tc] (whole packed frontier, VMEM-resident),
-    nbr_ref int32[Wp, Tc] (transposed ELL block), vis_ref int32[1, Tc];
-    outputs nf_ref int32[1, Tc], par_ref int32[1, Tc]."""
-    nbr = nbr_ref[...]
-    wp = nbr.shape[0]
-    word = jax.lax.shift_right_logical(nbr, 5)
-    bit_ix = nbr & 31
-    hit = jnp.zeros(nbr.shape, jnp.int32)
+def _hits_for(fw_ref, word, bit_ix, chunks: int, tc: int):
+    """Accumulate the per-slot frontier-bit lookups for one packed frontier
+    (the chunked arbitrary-gather; module docstring)."""
+    hit = jnp.zeros(word.shape, jnp.int32)
     for k in range(chunks):  # static unroll; bounded by MAX_CHUNKS
         local = word - k * tc
         inb = (local >= 0) & (local < tc)
         lidx = jnp.clip(local, 0, tc - 1)
-        tbl = jnp.broadcast_to(fw_ref[k : k + 1, :], nbr.shape)
+        tbl = jnp.broadcast_to(fw_ref[k : k + 1, :], word.shape)
         g = jnp.take_along_axis(tbl, lidx, axis=1, mode="promise_in_bounds")
         b = jax.lax.shift_right_logical(g, bit_ix) & 1
         hit = hit | jnp.where(inb, b, 0)
-    # first-hit slot via a sublane max of (Wp - slot); 0 = no hit anywhere
+    return hit
+
+
+def _reduce_side(nbr, hit, vis, nf_ref, par_ref):
+    """First-hit slot + parent + visited test for one side (sublane
+    reductions and the sublane-wise parent gather; module docstring)."""
+    wp = nbr.shape[0]
     slot = jax.lax.broadcasted_iota(jnp.int32, nbr.shape, 0)
     m = jnp.max(jnp.where(hit > 0, wp - slot, 0), axis=0, keepdims=True)
     j_star = jnp.clip(wp - m, 0, wp - 1)
     psel = jnp.take_along_axis(
         nbr, jnp.broadcast_to(j_star, nbr.shape), axis=0, mode="promise_in_bounds"
     )
-    nf = (m > 0) & (vis_ref[...] == 0)
+    nf = (m > 0) & (vis == 0)
     nf_ref[...] = nf.astype(jnp.int32)
     # psel rows are identical (every sublane gathered slot j_star); the max
     # is just a supported way to extract that one row
     par_ref[...] = jnp.max(psel, axis=0, keepdims=True)
+
+
+def _pull_kernel_dual(
+    chunks: int, tc: int,
+    fws_ref, fwt_ref, nbr_ref, viss_ref, vist_ref,
+    nfs_ref, pars_ref, nft_ref, part_ref,
+):
+    """Both sides of a lock-step level in ONE pass over the neighbor block
+    — the table stream (the dominant HBM traffic) is read once and feeds
+    two chunked gathers, mirroring the XLA path's
+    :func:`bibfs_tpu.ops.expand.expand_pull_dual`."""
+    nbr = nbr_ref[...]
+    word = jax.lax.shift_right_logical(nbr, 5)
+    bit_ix = nbr & 31
+    _reduce_side(
+        nbr, _hits_for(fws_ref, word, bit_ix, chunks, tc), viss_ref[...],
+        nfs_ref, pars_ref,
+    )
+    _reduce_side(
+        nbr, _hits_for(fwt_ref, word, bit_ix, chunks, tc), vist_ref[...],
+        nft_ref, part_ref,
+    )
+
+
+@lru_cache(maxsize=None)
+def _get_dual_call(wp: int, n_pad_p: int, interpret: bool):
+    tc = _lane_block(n_pad_p)
+    n_words_p, chunks = _word_geometry(n_pad_p, tc)
+    if chunks > MAX_CHUNKS:
+        raise ValueError(
+            f"pallas pull kernel: {chunks} frontier chunks at n_pad_p="
+            f"{n_pad_p} exceeds MAX_CHUNKS={MAX_CHUNKS}; use the XLA path"
+        )
+    grid = n_pad_p // tc
+    kernel = lambda *refs: _pull_kernel_dual(chunks, tc, *refs)  # noqa: E731
+    fw_spec = pl.BlockSpec((chunks, tc), lambda i: (0, 0))
+    col = pl.BlockSpec((1, tc), lambda i: (0, i))
+    return pl.pallas_call(
+        kernel,
+        grid=(grid,),
+        in_specs=[fw_spec, fw_spec, pl.BlockSpec((wp, tc), lambda i: (0, i)),
+                  col, col],
+        out_specs=[col, col, col, col],
+        out_shape=[jax.ShapeDtypeStruct((1, n_pad_p), jnp.int32)] * 4,
+        interpret=interpret,
+    )
+
+
+def pallas_pull_level_dual(
+    fr_s, fr_t, par_s, dist_s, par_t, dist_t, tables, deg, lvl_s, lvl_t,
+    *, inf: int,
+):
+    """Both sides of a lock-step round through the dual kernel, matching
+    the return contract of
+    :func:`bibfs_tpu.ops.expand.expand_pull_dual_tiered` with no tiers:
+    ``(nf_s, par_s, dist_s, md_s, nf_t, par_t, dist_t, md_t)``."""
+    (nbr_t,) = tables
+    wp, n_pad_p = nbr_t.shape
+    n_pad = fr_s.shape[0]
+    interpret = jax.default_backend() != "tpu"
+    tc = _lane_block(n_pad_p)
+    n_words_p, _chunks = _word_geometry(n_pad_p, tc)
+    vis_s = dist_s < inf
+    vis_t = dist_t < inf
+
+    def prep_vis(v):
+        return jnp.pad(
+            v.astype(jnp.int32), (0, n_pad_p - n_pad), constant_values=1
+        ).reshape(1, n_pad_p)
+
+    call = _get_dual_call(wp, n_pad_p, interpret)
+    nfs2, ps2, nft2, pt2 = call(
+        _pack_frontier(fr_s, n_words_p, tc),
+        _pack_frontier(fr_t, n_words_p, tc),
+        nbr_t,
+        prep_vis(vis_s),
+        prep_vis(vis_t),
+    )
+    nf_s = nfs2[0, :n_pad] > 0
+    nf_t = nft2[0, :n_pad] > 0
+    par_s = jnp.where(nf_s, ps2[0, :n_pad], par_s)
+    par_t = jnp.where(nf_t, pt2[0, :n_pad], par_t)
+    dist_s = jnp.where(nf_s & ~vis_s, lvl_s, dist_s)
+    dist_t = jnp.where(nf_t & ~vis_t, lvl_t, dist_t)
+    md_s = jnp.max(jnp.where(nf_s, deg, 0))
+    md_t = jnp.max(jnp.where(nf_t, deg, 0))
+    return nf_s, par_s, dist_s, md_s, nf_t, par_t, dist_t, md_t
+
+
+def _pull_kernel(chunks: int, tc: int, fw_ref, nbr_ref, vis_ref, nf_ref, par_ref):
+    """One vertex tile (Tc lanes) of pull expansion. Refs:
+    fw_ref int32[chunks, Tc] (whole packed frontier, VMEM-resident),
+    nbr_ref int32[Wp, Tc] (transposed ELL block), vis_ref int32[1, Tc];
+    outputs nf_ref int32[1, Tc], par_ref int32[1, Tc]."""
+    nbr = nbr_ref[...]
+    word = jax.lax.shift_right_logical(nbr, 5)
+    bit_ix = nbr & 31
+    _reduce_side(
+        nbr, _hits_for(fw_ref, word, bit_ix, chunks, tc), vis_ref[...],
+        nf_ref, par_ref,
+    )
 
 
 @lru_cache(maxsize=None)
@@ -280,9 +381,19 @@ def pallas_available() -> bool:
         deg = jnp.zeros(n, jnp.int32)
         fr = jnp.zeros(n, jnp.bool_)
         nf, _ = expand_pull_pallas(fr, fr, nbr, deg)
+        # the dual (lock-step) kernel must compile too — the sync schedule
+        # routes through it
+        zero = jnp.zeros(n, jnp.int32)
+        inf_d = jnp.full(n, 1 << 30, jnp.int32)
+        nf_s, *_rest = pallas_pull_level_dual(
+            fr, fr, zero, inf_d, zero, inf_d,
+            prepare_pallas_tables(nbr, deg), deg,
+            jnp.int32(1), jnp.int32(1), inf=1 << 30,
+        )
         # read a VALUE, not just block: lazy runtimes defer execution (and
         # its errors) until a readback — see solvers/timing.py
         np.asarray(nf).ravel()[0]
+        np.asarray(nf_s).ravel()[0]
         return True
     except Exception:
         return False

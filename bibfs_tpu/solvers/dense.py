@@ -392,7 +392,35 @@ def _make_body(mode: str, cap: int, tier_meta, nbr, deg, aux):
             push_cap=cap, use_pallas=use_pallas,
         )
 
-    if schedule == "sync" and not hybrid and not use_pallas:
+    if schedule == "sync" and use_pallas:
+        # lock-step pallas: the dual kernel streams the transposed table
+        # ONCE per round for both sides (mirrors the XLA dual branch below)
+        from bibfs_tpu.ops.pallas_expand import pallas_pull_level_dual
+
+        def body(st):
+            scanned = frontier_degree_sum(
+                st["fr_s"], deg
+            ) + frontier_degree_sum(st["fr_t"], deg)
+            nf_s, par_s, dist_s, md_s, nf_t, par_t, dist_t, md_t = (
+                pallas_pull_level_dual(
+                    st["fr_s"], st["fr_t"],
+                    st["par_s"], st["dist_s"], st["par_t"], st["dist_t"],
+                    aux, deg, st["lvl_s"] + 1, st["lvl_t"] + 1, inf=INF32,
+                )
+            )
+            st = {
+                **st,
+                "fr_s": nf_s, "par_s": par_s, "dist_s": dist_s,
+                "md_s": md_s, "cnt_s": frontier_count(nf_s),
+                "lvl_s": st["lvl_s"] + 1, "ok_s": jnp.bool_(False),
+                "fr_t": nf_t, "par_t": par_t, "dist_t": dist_t,
+                "md_t": md_t, "cnt_t": frontier_count(nf_t),
+                "lvl_t": st["lvl_t"] + 1, "ok_t": jnp.bool_(False),
+                "edges": st["edges"] + scanned,
+            }
+            return _meet_vote(st, 2)
+
+    elif schedule == "sync" and not hybrid and not use_pallas:
         # pull-only lock-step: fuse both sides' expansions so every
         # neighbor table (base + hub tiers) is gathered ONCE per round
         # for both searches — half the HBM traffic of two sequential

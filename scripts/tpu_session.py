@@ -1,0 +1,242 @@
+"""One bounded TPU session: every on-chip measurement round 3 needs.
+
+Runs each item in its own bounded subprocess (a wedged tunnel or an
+HBM-exceeding program must not take the whole session down) and appends
+one JSON line per item to ``TPU_SESSION.jsonl``:
+
+1. ``pallas``   — does the reformulated pull kernel COMPILE on Mosaic?
+                  Parity vs the XLA path on a 10k graph + full-solve and
+                  per-level timing vs sync/ell at 100k.
+2. ``mesh1``    — the 1D shard_map and 2D programs compiled + solved on a
+                  real-TPU 1-device mesh (proves the collective programs
+                  lower under the TPU toolchain, VERDICT r2 weak #6).
+3. ``batch``    — vmapped batch sweep: per-query us at batch 32/128/256/
+                  1024 on the 100k bench graph (the device's win-regime
+                  question, VERDICT r2 next-#4).
+4. ``levels``   — dispatch-vs-device decomposition without a profiler:
+                  fixed-trip fori_loop of the pull level at two trip
+                  counts; the slope is pure device+loop cost per level,
+                  the intercept is the tunnel dispatch tax.
+
+Usage:  python scripts/tpu_session.py [--items pallas mesh1 batch levels]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(REPO, "TPU_SESSION.jsonl")
+
+PALLAS_SUB = """
+import json, sys, time
+import numpy as np
+sys.path.insert(0, {repo!r})
+from bibfs_tpu.utils.platform import apply_platform_env
+apply_platform_env()
+import jax, jax.numpy as jnp
+out = dict(item="pallas", platform=jax.devices()[0].platform)
+
+from bibfs_tpu.ops.pallas_expand import expand_pull_pallas, pallas_available
+out["compiles"] = pallas_available()
+if out["compiles"]:
+    from bibfs_tpu.graph.csr import build_ell
+    from bibfs_tpu.graph.generate import gnp_random_graph
+    from bibfs_tpu.ops.expand import expand_pull
+
+    # parity ON THE CHIP (compiled kernel vs compiled XLA path)
+    rng = np.random.default_rng(0)
+    n = 10_000
+    edges = gnp_random_graph(n, 3.0 / n, seed=1)
+    g = build_ell(n, edges)
+    nbr = jnp.asarray(g.nbr); deg = jnp.asarray(g.deg)
+    fr = jnp.asarray(rng.random(g.n_pad) < 0.3)
+    vis = jnp.asarray(rng.random(g.n_pad) < 0.2)
+    nf0, p0 = expand_pull(fr, vis, nbr, deg)
+    nf1, p1 = expand_pull_pallas(fr, vis, nbr, deg)
+    nf0, nf1, p0, p1 = map(np.asarray, (nf0, nf1, p0, p1))
+    out["parity_nf"] = bool((nf0 == nf1).all())
+    out["parity_par"] = bool((p0[nf0] == p1[nf0]).all())
+
+    # full-solve timing: pallas vs sync on the 100k bench graph
+    from bibfs_tpu.solvers.dense import DeviceGraph, time_search_only
+    from bibfs_tpu.solvers.serial import solve_serial
+    n2 = 100_000
+    edges2 = gnp_random_graph(n2, 2.2 / n2, seed=1)
+    want = solve_serial(n2, edges2, 0, n2 - 1)
+    g2 = DeviceGraph.build(n2, edges2)
+    for mode in ("sync", "pallas"):
+        times = time_search_only(g2, 0, n2 - 1, repeats=8, mode=mode)
+        out["{{}}_median_s".format(mode)] = float(np.median(times))
+    from bibfs_tpu.solvers.dense import solve_dense_graph
+    res = solve_dense_graph(g2, 0, n2 - 1, mode="pallas")
+    out["pallas_hops_ok"] = bool(res.hops == want.hops)
+print("RESULT " + json.dumps(out))
+"""
+
+MESH1_SUB = """
+import json, sys, time
+import numpy as np
+sys.path.insert(0, {repo!r})
+from bibfs_tpu.utils.platform import apply_platform_env
+apply_platform_env()
+import jax, jax.numpy as jnp
+out = dict(item="mesh1", platform=jax.devices()[0].platform)
+from jax.sharding import Mesh
+from bibfs_tpu.graph.generate import gnp_random_graph
+from bibfs_tpu.solvers.serial import solve_serial
+from bibfs_tpu.parallel.mesh import VERTEX_AXIS, make_1d_mesh, make_2d_mesh
+from bibfs_tpu.solvers.sharded import ShardedGraph, time_search
+from bibfs_tpu.solvers.sharded2d import Sharded2DGraph, time_search_2d
+
+n = 100_000
+edges = gnp_random_graph(n, 2.2 / n, seed=1)
+want = solve_serial(n, edges, 0, n - 1)
+
+g1 = ShardedGraph.build(n, edges, make_1d_mesh(1), layout="tiered")
+t1, r1 = time_search(g1, 0, n - 1, repeats=5, mode="sync")
+out["sharded1_median_s"] = float(np.median(t1))
+out["sharded1_hops_ok"] = bool(r1.hops == want.hops)
+
+g2 = Sharded2DGraph.build(n, edges, make_2d_mesh(1, 1))
+t2, r2 = time_search_2d(g2, 0, n - 1, repeats=5, mode="sync")
+out["sharded2d_median_s"] = float(np.median(t2))
+out["sharded2d_hops_ok"] = bool(r2.hops == want.hops)
+print("RESULT " + json.dumps(out))
+"""
+
+BATCH_SUB = """
+import json, sys, time
+import numpy as np
+sys.path.insert(0, {repo!r})
+from bibfs_tpu.utils.platform import apply_platform_env
+apply_platform_env()
+import jax
+out = dict(item="batch", platform=jax.devices()[0].platform)
+from bibfs_tpu.graph.generate import gnp_random_graph
+from bibfs_tpu.solvers.dense import DeviceGraph, time_batch_only
+
+n = 100_000
+edges = gnp_random_graph(n, 2.2 / n, seed=1)
+g = DeviceGraph.build(n, edges)
+rng = np.random.default_rng(0)
+rows = {{}}
+for b in (32, 128, 256, 1024):
+    pairs = np.stack([rng.integers(0, n, b), rng.integers(0, n, b)], axis=1)
+    reps = 5 if b <= 256 else 3
+    try:
+        bt = time_batch_only(g, pairs, repeats=reps, mode="sync")
+        med = float(np.median(bt))
+        rows[str(b)] = dict(batch_s=med, per_query_us=med / b * 1e6)
+    except Exception as e:
+        rows[str(b)] = dict(error=str(e)[:200])
+    print("batch", b, rows[str(b)], file=sys.stderr, flush=True)
+out["batch_100k"] = rows
+print("RESULT " + json.dumps(out))
+"""
+
+LEVELS_SUB = """
+import json, sys, time
+import numpy as np
+sys.path.insert(0, {repo!r})
+from bibfs_tpu.utils.platform import apply_platform_env
+apply_platform_env()
+import jax, jax.numpy as jnp
+from functools import partial
+out = dict(item="levels", platform=jax.devices()[0].platform)
+from bibfs_tpu.graph.generate import gnp_random_graph
+from bibfs_tpu.ops.expand import expand_pull_dual_tiered
+from bibfs_tpu.solvers.dense import INF32, DeviceGraph
+
+# fixed-trip loop of the real dual-pull level body: wall(T) = dispatch +
+# T * level_cost. Two trip counts give both terms without a profiler.
+n = 100_000
+edges = gnp_random_graph(n, 2.2 / n, seed=1)
+g = DeviceGraph.build(n, edges)
+
+@partial(jax.jit, static_argnames="trips")
+def run(nbr, deg, trips):
+    n_pad = nbr.shape[0]
+    fr = jnp.zeros(n_pad, jnp.bool_).at[0].set(True)
+    st = (fr, fr, jnp.full(n_pad, -1, jnp.int32),
+          jnp.where(fr, 0, INF32).astype(jnp.int32),
+          jnp.full(n_pad, -1, jnp.int32),
+          jnp.where(fr, 0, INF32).astype(jnp.int32))
+    def body(i, st):
+        fs, ft, ps, ds, pt, dt = st
+        nf_s, ps, ds, _m1, nf_t, pt, dt, _m2 = expand_pull_dual_tiered(
+            fs, ft, ps, ds, pt, dt, nbr, deg, (), i + 1, i + 1, inf=INF32)
+        return (nf_s, nf_t, ps, ds, pt, dt)
+    st = jax.lax.fori_loop(0, trips, body, st)
+    return st[2].sum() + st[4].sum()
+
+for trips in (4, 64):
+    vals = []
+    for rep in range(6):
+        t0 = time.perf_counter()
+        v = int(run(g.nbr, g.deg, trips))  # value read = forced execution
+        vals.append(time.perf_counter() - t0)
+    out["wall_T{{}}_s".format(trips)] = float(np.median(vals[1:]))
+lo, hi = out["wall_T4_s"], out["wall_T64_s"]
+per_level = (hi - lo) / 60.0
+out["device_level_s"] = per_level
+out["dispatch_s"] = lo - 4 * per_level
+bytes_per_level = g.n_pad * g.width * 4 + g.n_pad * 13
+out["hbm_gbps_per_level"] = bytes_per_level / per_level / 1e9 if per_level > 0 else None
+print("RESULT " + json.dumps(out))
+"""
+
+ITEMS = {
+    "pallas": (PALLAS_SUB, 900),
+    "mesh1": (MESH1_SUB, 900),
+    "batch": (BATCH_SUB, 1500),
+    "levels": (LEVELS_SUB, 900),
+}
+
+
+def run_item(name: str) -> dict:
+    code, timeout = ITEMS[name]
+    t0 = time.time()
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", code.format(repo=REPO)],
+            capture_output=True, text=True, timeout=timeout,
+        )
+        for line in r.stdout.splitlines():
+            if line.startswith("RESULT "):
+                out = json.loads(line[len("RESULT "):])
+                out["elapsed_s"] = round(time.time() - t0, 1)
+                return out
+        return dict(
+            item=name, error=(r.stdout + r.stderr).strip()[-800:],
+            elapsed_s=round(time.time() - t0, 1),
+        )
+    except subprocess.TimeoutExpired:
+        return dict(item=name, error=f"timeout after {timeout}s",
+                    elapsed_s=round(time.time() - t0, 1))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--items", nargs="+", default=list(ITEMS),
+                    choices=list(ITEMS))
+    args = ap.parse_args(argv)
+    rc = 0
+    for name in args.items:
+        out = run_item(name)
+        out["recorded"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+        with open(OUT, "a") as f:
+            f.write(json.dumps(out) + "\n")
+        print(json.dumps(out), flush=True)
+        if "error" in out:
+            rc = 1
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

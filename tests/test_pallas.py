@@ -62,6 +62,48 @@ def test_pallas_geometry_invariants():
         assert wp >= width and wp % 8 == 0
 
 
+@pytest.mark.parametrize("seed", range(4))
+def test_pallas_dual_level_matches_xla(seed):
+    """The dual (lock-step) kernel agrees with expand_pull_dual_tiered on
+    both sides' frontiers, parents, distances, and max-degree carries."""
+    import jax.numpy as jnp
+
+    from bibfs_tpu.graph.generate import gnp_random_graph
+    from bibfs_tpu.ops.expand import expand_pull_dual_tiered
+    from bibfs_tpu.ops.pallas_expand import (
+        pallas_pull_level_dual,
+        prepare_pallas_tables,
+    )
+
+    INF = 1 << 30
+    rng = np.random.default_rng(seed + 100)
+    n = int(rng.integers(20, 400))
+    edges = gnp_random_graph(n, float(rng.uniform(1.5, 4.0)) / n, seed=seed)
+    _g, nbr, deg = _ell(n, edges)
+    n_pad = nbr.shape[0]
+    fr_s = jnp.asarray(rng.random(n_pad) < 0.3)
+    fr_t = jnp.asarray(rng.random(n_pad) < 0.3)
+    dist_s = jnp.where(jnp.asarray(rng.random(n_pad) < 0.2), 1, INF).astype(jnp.int32)
+    dist_t = jnp.where(jnp.asarray(rng.random(n_pad) < 0.2), 1, INF).astype(jnp.int32)
+    par0 = jnp.full(n_pad, -1, jnp.int32)
+    want = expand_pull_dual_tiered(
+        fr_s, fr_t, par0, dist_s, par0, dist_t, nbr, deg, (),
+        jnp.int32(2), jnp.int32(2), inf=INF,
+    )
+    got = pallas_pull_level_dual(
+        fr_s, fr_t, par0, dist_s, par0, dist_t,
+        prepare_pallas_tables(nbr, deg), deg,
+        jnp.int32(2), jnp.int32(2), inf=INF,
+    )
+    names = ["nf_s", "par_s", "dist_s", "md_s", "nf_t", "par_t", "dist_t", "md_t"]
+    for name, w, g in zip(names, want, got):
+        if name.startswith("par"):
+            sel = np.asarray(want[0] if name == "par_s" else want[4])
+            assert (np.asarray(w)[sel] == np.asarray(g)[sel]).all(), name
+        else:
+            assert (np.asarray(w) == np.asarray(g)).all(), name
+
+
 @pytest.mark.parametrize("mode", ["pallas", "pallas_alt"])
 def test_pallas_solver_matches_oracle(mode):
     from bibfs_tpu.solvers.dense import solve_dense
