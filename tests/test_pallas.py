@@ -118,6 +118,26 @@ def test_pallas_solver_matches_oracle(mode):
             got.validate_path(n, edges, src, dst)
 
 
+@pytest.mark.parametrize("mode", ["pallas", "pallas_alt"])
+def test_pallas_batch_matches_oracle(mode):
+    """vmapped batch solve under the pallas modes (pallas_call has its own
+    batching rule — exercise it through the public batch API)."""
+    from bibfs_tpu.graph.generate import gnp_random_graph
+    from bibfs_tpu.solvers.dense import DeviceGraph, solve_batch_graph
+    from bibfs_tpu.solvers.serial import solve_serial
+
+    n = 300
+    edges = gnp_random_graph(n, 3.0 / n, seed=2)
+    g = DeviceGraph.build(n, edges)
+    pairs = [(0, n - 1), (5, 250), (7, 7), (3, 299)]
+    results = solve_batch_graph(g, pairs, mode=mode)
+    for (s, d), res in zip(pairs, results):
+        ref = solve_serial(n, edges, s, d)
+        assert res.found == ref.found
+        if ref.found:
+            assert res.hops == ref.hops
+
+
 def test_pallas_rejects_tiered_layout():
     from bibfs_tpu.solvers.dense import solve_dense
 
