@@ -18,11 +18,13 @@
 // for short searches on large graphs (measured: most of ~100us at n=100k).
 
 #include <algorithm>
+#include <atomic>
 #include <chrono>
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
 #include <new>
+#include <thread>
 #include <vector>
 
 extern "C" {
@@ -282,6 +284,70 @@ int bibfs_solve_s(uint32_t n, const int64_t* row_ptr, const int32_t* col_ind,
   } catch (...) {  // bad_alloc etc. must not cross the C ABI
     return BIBFS_ENOMEM;
   }
+}
+
+// Threaded batch solve: `batch` independent queries striped over
+// `num_threads` worker threads, each with its own epoch-stamped scratch —
+// the host analog of the device backends' vmapped batch (and the
+// parallelism the reference's process-per-query harness could not
+// express, benchmark_test.sh:44-59). The graph arrays are shared
+// read-only; outputs are per-query slices, so no synchronization beyond
+// thread join is needed. Per-query paths land in path_buf[q*path_cap ..];
+// a path longer than path_cap leaves out_path_len[q] = 0 with hops still
+// valid (same rule as the single solve). *out_time_s is the WHOLE batch
+// wall-clock. Returns the first non-OK code any query hit (remaining
+// queries still run; per-query outputs of failed queries are untouched).
+int bibfs_solve_batch(uint32_t n, const int64_t* row_ptr,
+                      const int32_t* col_ind, int32_t batch,
+                      const uint32_t* srcs, const uint32_t* dsts,
+                      int32_t num_threads, int32_t* out_hops,
+                      int32_t* path_buf, int32_t path_cap,
+                      int32_t* out_path_len, double* out_time_s,
+                      int64_t* out_edges, int32_t* out_levels) {
+  if (batch < 0 || num_threads < 1) return BIBFS_EARG;
+  auto t0 = std::chrono::steady_clock::now();
+  int nthreads = std::min<int32_t>(num_threads, batch > 0 ? batch : 1);
+  std::atomic<int> err{BIBFS_OK};
+  auto work = [&](int tid) {
+    void* sc = bibfs_scratch_create(n);
+    if (!sc) {
+      int want = BIBFS_OK;
+      err.compare_exchange_strong(want, BIBFS_ENOMEM);
+      return;
+    }
+    for (int32_t q = tid; q < batch; q += nthreads) {
+      double tq = 0.0;
+      int rc = bibfs_solve_s(n, row_ptr, col_ind, sc, srcs[q], dsts[q],
+                             &out_hops[q], path_buf + size_t(q) * path_cap,
+                             path_cap, &out_path_len[q], &tq, &out_edges[q],
+                             &out_levels[q]);
+      if (rc != BIBFS_OK) {
+        int want = BIBFS_OK;
+        err.compare_exchange_strong(want, rc);
+      }
+    }
+    bibfs_scratch_free(sc);
+  };
+  if (nthreads == 1) {
+    work(0);
+  } else {
+    // thread construction can throw (resource exhaustion); nothing may
+    // cross the extern "C" boundary — fall back to inline execution of
+    // the un-started stripes
+    std::vector<std::thread> threads;
+    int started = 0;
+    try {
+      threads.reserve(nthreads);
+      for (; started < nthreads; ++started) threads.emplace_back(work, started);
+    } catch (...) {
+      for (int t = started; t < nthreads; ++t) work(t);
+    }
+    for (auto& th : threads) th.join();
+  }
+  *out_time_s =
+      std::chrono::duration<double>(std::chrono::steady_clock::now() - t0)
+          .count();
+  return err.load();
 }
 
 // Stateless one-shot wrapper (original ABI, kept for compatibility):

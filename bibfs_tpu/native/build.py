@@ -26,7 +26,7 @@ def ensure_built(force: bool = False) -> str:
         return SO
     cxx = os.environ.get("CXX", "g++")
     cxxflags = shlex.split(
-        os.environ.get("CXXFLAGS", "-std=c++17 -O3 -fPIC -Wall -Wextra")
+        os.environ.get("CXXFLAGS", "-std=c++17 -O3 -fPIC -Wall -Wextra -pthread")
     )
     # compile to a temp path and os.replace() so concurrent builders never
     # leave a torn .so for another process's dlopen

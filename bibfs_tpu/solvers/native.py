@@ -54,12 +54,17 @@ def _lib() -> ctypes.CDLL:
         u32, p(i64), p(i32), ctypes.c_void_p, u32, u32,
         p(i32), p(i32), i32, p(i32), p(f64), p(i64), p(i32),
     ]
+    lib.bibfs_solve_batch.argtypes = [
+        u32, p(i64), p(i32), i32, p(u32), p(u32), i32,
+        p(i32), p(i32), i32, p(i32), p(f64), p(i64), p(i32),
+    ]
     lib.bibfs_scratch_create.argtypes = [u32]
     lib.bibfs_scratch_create.restype = ctypes.c_void_p
     lib.bibfs_scratch_free.argtypes = [ctypes.c_void_p]
     lib.bibfs_scratch_free.restype = None
     for fn in (lib.bibfs_read_header, lib.bibfs_read_edges,
-               lib.bibfs_build_csr, lib.bibfs_solve, lib.bibfs_solve_s):
+               lib.bibfs_build_csr, lib.bibfs_solve, lib.bibfs_solve_s,
+               lib.bibfs_solve_batch):
         fn.restype = i32
     _CACHED = lib
     return lib
@@ -180,30 +185,84 @@ def solve_native(n: int, edges: np.ndarray, src: int, dst: int) -> BFSResult:
     return solve_native_graph(NativeGraph.build(n, edges), src, dst)
 
 
-def solve_batch_native_graph(g: NativeGraph, pairs) -> list[BFSResult]:
-    """Solve many (src, dst) queries back-to-back on one scratch-reusing
-    graph (the host analog of the dense backend's vmapped batch). Each
-    returned result's ``time_s`` is the WHOLE batch wall-clock, matching
+# per-query path capacity in the threaded batch: paths on the graphs this
+# framework targets are diameter-bounded (tens of hops); a longer path is
+# reported hops-only, same as the single-solve path_cap rule
+_BATCH_PATH_CAP = 512
+
+
+def solve_batch_native_graph(
+    g: NativeGraph, pairs, *, threads: int | None = None
+) -> list[BFSResult]:
+    """Solve many (src, dst) queries on one graph via the THREADED native
+    batch (`bibfs_solve_batch`): queries stripe over worker threads, each
+    with its own epoch-stamped scratch, sharing the read-only CSR — the
+    host analog of the dense backend's vmapped batch. Each returned
+    result's ``time_s`` is the WHOLE batch wall-clock, matching
     :func:`bibfs_tpu.solvers.dense.solve_batch_graph`'s contract."""
-    return time_batch_native(g, pairs, repeats=1)[1]
+    return time_batch_native(g, pairs, repeats=1, threads=threads)[1]
+
+
+def _run_batch_native(g: NativeGraph, pairs: np.ndarray, threads: int):
+    lib = _lib()
+    b = pairs.shape[0]
+    srcs = np.ascontiguousarray(pairs[:, 0], dtype=np.uint32)
+    dsts = np.ascontiguousarray(pairs[:, 1], dtype=np.uint32)
+    hops = np.full(b, -1, dtype=np.int32)
+    path_buf = np.empty((b, _BATCH_PATH_CAP), dtype=np.int32)
+    path_len = np.zeros(b, dtype=np.int32)
+    secs = ctypes.c_double()
+    edges = np.zeros(b, dtype=np.int64)
+    levels = np.zeros(b, dtype=np.int32)
+    _check(
+        lib.bibfs_solve_batch(
+            g.n, _ptr(g.row_ptr, ctypes.c_int64),
+            _ptr(g.col_ind, ctypes.c_int32), b,
+            _ptr(srcs, ctypes.c_uint32), _ptr(dsts, ctypes.c_uint32),
+            threads, _ptr(hops, ctypes.c_int32),
+            _ptr(path_buf, ctypes.c_int32), _BATCH_PATH_CAP,
+            _ptr(path_len, ctypes.c_int32), ctypes.byref(secs),
+            _ptr(edges, ctypes.c_int64), _ptr(levels, ctypes.c_int32),
+        ),
+        "solve_batch",
+    )
+    results = []
+    for i in range(b):
+        if hops[i] < 0:
+            results.append(BFSResult(
+                False, None, None, None, secs.value, int(levels[i]),
+                int(edges[i]),
+            ))
+        else:
+            path = path_buf[i, : path_len[i]].tolist() if path_len[i] else None
+            results.append(BFSResult(
+                True, int(hops[i]), path, None, secs.value, int(levels[i]),
+                int(edges[i]),
+            ))
+    return float(secs.value), results
 
 
 def time_batch_native(
-    g: NativeGraph, pairs, *, repeats: int = 5
+    g: NativeGraph, pairs, *, repeats: int = 5, threads: int | None = None
 ) -> tuple[list[float], list[BFSResult]]:
     """Batch timing protocol for the native backend: ``repeats`` whole-
-    batch passes, median stamped into every result's ``time_s``."""
-    import time
-
+    batch passes through the threaded C batch, median stamped into every
+    result's ``time_s``. ``threads`` defaults to the host's core count
+    (capped at 16)."""
     if repeats < 1:
         raise ValueError(f"repeats must be >= 1, got {repeats}")
+    if threads is None:
+        threads = min(os.cpu_count() or 1, 16)
+    if threads < 1:
+        raise ValueError(f"threads must be >= 1, got {threads}")
     pairs = np.asarray(pairs, dtype=np.int64).reshape(-1, 2)
+    if pairs.size and not ((0 <= pairs).all() and (pairs < g.n).all()):
+        raise ValueError(f"src/dst out of range for n={g.n}")
     times = []
     results: list[BFSResult] = []
     for _ in range(repeats):
-        t0 = time.perf_counter()
-        results = [solve_native_graph(g, int(s), int(d)) for s, d in pairs]
-        times.append(time.perf_counter() - t0)
+        wall, results = _run_batch_native(g, pairs, threads)
+        times.append(wall)
     med = float(np.median(times))
     return times, [dataclasses.replace(r, time_s=med) for r in results]
 

@@ -156,6 +156,35 @@ def test_native_batch_matches_oracle():
     assert len(times) == 3 and len(timed) == 12
 
 
+def test_native_batch_threaded_parity():
+    """The striped multi-thread batch (each worker its own scratch over
+    the shared CSR) agrees with single solves at every thread count,
+    including thread counts above the query count; paths stay valid."""
+    import numpy as np
+
+    from bibfs_tpu.graph.generate import gnp_random_graph
+    from bibfs_tpu.solvers.native import (
+        NativeGraph,
+        solve_batch_native_graph,
+        solve_native_graph,
+    )
+
+    n = 2000
+    edges = gnp_random_graph(n, 3.0 / n, seed=11)
+    g = NativeGraph.build(n, edges)
+    rng = np.random.default_rng(2)
+    pairs = rng.integers(0, n, size=(23, 2))
+    want = [solve_native_graph(g, int(s), int(d)) for s, d in pairs]
+    for threads in (1, 2, 7, 64):
+        got = solve_batch_native_graph(g, pairs, threads=threads)
+        for w, r, (s, d) in zip(want, got, pairs):
+            assert r.found == w.found, (threads, s, d)
+            if w.found:
+                assert r.hops == w.hops, (threads, s, d)
+                if r.path is not None:
+                    r.validate_path(n, edges, int(s), int(d))
+
+
 def test_loader_fuzz_no_crashes(tmp_path):
     """Randomly mutated/truncated graph files must either load cleanly or
     raise a clean Python error — never crash the process. Exercises both
