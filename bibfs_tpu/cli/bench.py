@@ -287,8 +287,8 @@ def main(argv=None):
         help="device-kernel schedule: sync = both sides per round (fewest "
         "rounds), alt = smaller-frontier-first alternation (fewest edge "
         "scans); beamer variants add push/pull direction optimization; "
-        "pallas variants use the fused Pallas pull kernel (dense backend, "
-        "ell layout only)",
+        "pallas variants use the fused Pallas pull kernel for the base "
+        "table, hub tiers as XLA ops (dense backend)",
     )
     ap.add_argument(
         "--layout",
@@ -324,8 +324,6 @@ def main(argv=None):
     if args.layout != "ell" and "sharded2d" in backends:
         ap.error("--backends sharded2d has its own block layout; drop "
                  "--layout or bench it separately")
-    if args.layout == "tiered" and args.mode.startswith("pallas"):
-        ap.error("pallas modes support --layout ell only")
     if args.pairs is not None and not {
         "dense", "native", "sharded", "sharded2d"
     } & set(backends):

@@ -78,9 +78,9 @@ def main(argv=None):
         "alternation; beamer/beamer_alt add push/pull direction "
         "optimization (sparse frontiers go through a scatter push path "
         "instead of the full-table pull gather); pallas/pallas_alt run the "
-        "pull level as the fused Pallas TPU kernel (dense backend, ell "
-        "layout only; interpreted off-TPU). With --resume, omitting --mode "
-        "keeps the snapshot's recorded schedule",
+        "base-table pull as the fused Pallas TPU kernel, hub tiers as XLA "
+        "ops (dense backend; interpreted off-TPU). With --resume, omitting "
+        "--mode keeps the snapshot's recorded schedule",
     )
     ap.add_argument(
         "--checkpoint",

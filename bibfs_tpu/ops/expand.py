@@ -143,19 +143,13 @@ def _tier_valid(slot_count, width, rank, tier_count):
     return member[:, None] & (cols < slot_count[:, None])
 
 
-def expand_pull_tiered(frontier, par, dist, nbr, deg, tiers, lvl_next, *, inf: int):
-    """Pull expansion over a tiered ELL (power-law graphs): the base-table
-    pull plus, per hub tier, a [count_pad, width] gather and a sparse
-    scatter of the hub hits back into the dense per-vertex state.
-
-    ``tiers`` is a tuple of ``(start, count, tier_nbr, hub_ids)`` with
-    static start/count; ``hub_ids[r]`` = vertex id at hub rank r. Returns
-    ``(next_frontier, par, dist, max_deg_of_new_frontier)``.
-    """
-    n_pad = nbr.shape[0]
-    visited = dist < inf
-    nf, pcand = expand_pull(frontier, visited, nbr, deg)
-    par = jnp.where(nf, pcand, par)
+def apply_tiers(nf, par, frontier, visited, deg, tiers, n_pad):
+    """Fold the hub-tier contributions of one side into ``(nf, par)``:
+    per tier, a ``[count_pad, width]`` gather of the frontier at the tier
+    table and a sparse scatter-max of the hits back into the dense
+    per-vertex state. THE single implementation of tier semantics — the
+    XLA pull path and the Pallas wrappers
+    (:mod:`bibfs_tpu.ops.pallas_expand`) both call it."""
     for start, count, tier_nbr, hub_ids in tiers:
         width = tier_nbr.shape[1]
         rank = jnp.arange(tier_nbr.shape[0], dtype=jnp.int32)
@@ -170,24 +164,14 @@ def expand_pull_tiered(frontier, par, dist, nbr, deg, tiers, lvl_next, *, inf: i
         tgt = jnp.where(hub_new, hub_ids, n_pad)
         nf = nf.at[tgt].max(jnp.ones(tgt.shape, jnp.bool_), mode="drop")
         par = par.at[tgt].max(hub_par, mode="drop")
-    dist = jnp.where(nf & (dist >= inf), lvl_next, dist)
-    max_deg = jnp.max(jnp.where(nf, deg, 0))
-    return nf, par, dist, max_deg
+    return nf, par
 
 
-def expand_pull_dual_tiered(
-    fr_s, fr_t, par_s, dist_s, par_t, dist_t, nbr, deg, tiers, lvl_s, lvl_t, *, inf
+def apply_tiers_dual(
+    nf_s, par_s, nf_t, par_t, packed, vis_s, vis_t, deg, tiers, n_pad
 ):
-    """Lock-step variant of :func:`expand_pull_tiered`: one packed gather
-    per table (base and each hub tier) serves BOTH sides' expansions.
-    Returns ``(nf_s, par_s, dist_s, md_s, nf_t, par_t, dist_t, md_t)``."""
-    n_pad = nbr.shape[0]
-    packed = pack_dual(fr_s, fr_t)
-    vis_s = dist_s < inf
-    vis_t = dist_t < inf
-    nf_s, pc_s, nf_t, pc_t = expand_pull_dual(packed, vis_s, vis_t, nbr, deg)
-    par_s = jnp.where(nf_s, pc_s, par_s)
-    par_t = jnp.where(nf_t, pc_t, par_t)
+    """Dual-side :func:`apply_tiers`: ONE packed gather per tier serves
+    both sides' hub contributions (see :func:`pack_dual`)."""
     for start, count, tier_nbr, hub_ids in tiers:
         width = tier_nbr.shape[1]
         rank = jnp.arange(tier_nbr.shape[0], dtype=jnp.int32)
@@ -208,6 +192,43 @@ def expand_pull_dual_tiered(
             else:
                 nf_t = nf_t.at[tgt].max(jnp.ones(tgt.shape, jnp.bool_), mode="drop")
                 par_t = par_t.at[tgt].max(hub_par, mode="drop")
+    return nf_s, par_s, nf_t, par_t
+
+
+def expand_pull_tiered(frontier, par, dist, nbr, deg, tiers, lvl_next, *, inf: int):
+    """Pull expansion over a tiered ELL (power-law graphs): the base-table
+    pull plus the :func:`apply_tiers` hub contributions.
+
+    ``tiers`` is a tuple of ``(start, count, tier_nbr, hub_ids)`` with
+    static start/count; ``hub_ids[r]`` = vertex id at hub rank r. Returns
+    ``(next_frontier, par, dist, max_deg_of_new_frontier)``.
+    """
+    n_pad = nbr.shape[0]
+    visited = dist < inf
+    nf, pcand = expand_pull(frontier, visited, nbr, deg)
+    par = jnp.where(nf, pcand, par)
+    nf, par = apply_tiers(nf, par, frontier, visited, deg, tiers, n_pad)
+    dist = jnp.where(nf & (dist >= inf), lvl_next, dist)
+    max_deg = jnp.max(jnp.where(nf, deg, 0))
+    return nf, par, dist, max_deg
+
+
+def expand_pull_dual_tiered(
+    fr_s, fr_t, par_s, dist_s, par_t, dist_t, nbr, deg, tiers, lvl_s, lvl_t, *, inf
+):
+    """Lock-step variant of :func:`expand_pull_tiered`: one packed gather
+    per table (base and each hub tier) serves BOTH sides' expansions.
+    Returns ``(nf_s, par_s, dist_s, md_s, nf_t, par_t, dist_t, md_t)``."""
+    n_pad = nbr.shape[0]
+    packed = pack_dual(fr_s, fr_t)
+    vis_s = dist_s < inf
+    vis_t = dist_t < inf
+    nf_s, pc_s, nf_t, pc_t = expand_pull_dual(packed, vis_s, vis_t, nbr, deg)
+    par_s = jnp.where(nf_s, pc_s, par_s)
+    par_t = jnp.where(nf_t, pc_t, par_t)
+    nf_s, par_s, nf_t, par_t = apply_tiers_dual(
+        nf_s, par_s, nf_t, par_t, packed, vis_s, vis_t, deg, tiers, n_pad
+    )
     dist_s = jnp.where(nf_s & ~vis_s, lvl_s, dist_s)
     dist_t = jnp.where(nf_t & ~vis_t, lvl_t, dist_t)
     md_s = jnp.max(jnp.where(nf_s, deg, 0))

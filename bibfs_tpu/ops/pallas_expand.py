@@ -229,13 +229,19 @@ def _get_dual_call(wp: int, n_pad_p: int, interpret: bool):
 
 
 def pallas_pull_level_dual(
-    fr_s, fr_t, par_s, dist_s, par_t, dist_t, tables, deg, lvl_s, lvl_t,
-    *, inf: int,
+    fr_s, fr_t, par_s, dist_s, par_t, dist_t, tables, deg, tiers, lvl_s,
+    lvl_t, *, inf: int,
 ):
     """Both sides of a lock-step round through the dual kernel, matching
     the return contract of
-    :func:`bibfs_tpu.ops.expand.expand_pull_dual_tiered` with no tiers:
-    ``(nf_s, par_s, dist_s, md_s, nf_t, par_t, dist_t, md_t)``."""
+    :func:`bibfs_tpu.ops.expand.expand_pull_dual_tiered`:
+    ``(nf_s, par_s, dist_s, md_s, nf_t, par_t, dist_t, md_t)``. Hub
+    ``tiers`` run as XLA ops around the kernel via the SAME
+    :func:`bibfs_tpu.ops.expand.apply_tiers_dual` the XLA path uses (one
+    packed gather per tier serves both sides); the kernel owns the
+    base-table bulk."""
+    from bibfs_tpu.ops.expand import apply_tiers_dual, pack_dual
+
     (nbr_t,) = tables
     wp, n_pad_p = nbr_t.shape
     n_pad = fr_s.shape[0]
@@ -262,6 +268,11 @@ def pallas_pull_level_dual(
     nf_t = nft2[0, :n_pad] > 0
     par_s = jnp.where(nf_s, ps2[0, :n_pad], par_s)
     par_t = jnp.where(nf_t, pt2[0, :n_pad], par_t)
+    if tiers:
+        nf_s, par_s, nf_t, par_t = apply_tiers_dual(
+            nf_s, par_s, nf_t, par_t, pack_dual(fr_s, fr_t),
+            vis_s, vis_t, deg, tiers, n_pad,
+        )
     dist_s = jnp.where(nf_s & ~vis_s, lvl_s, dist_s)
     dist_t = jnp.where(nf_t & ~vis_t, lvl_t, dist_t)
     md_s = jnp.max(jnp.where(nf_s, deg, 0))
@@ -355,15 +366,24 @@ def expand_pull_pallas(
     )
 
 
-def pallas_pull_level(frontier, par, dist, tables, deg, lvl_next, *, inf: int):
+def pallas_pull_level(
+    frontier, par, dist, tables, deg, tiers, lvl_next, *, inf: int
+):
     """Full pull level via the Pallas kernel, matching the return contract
-    of :func:`bibfs_tpu.ops.expand.expand_pull_tiered` with no tiers:
+    of :func:`bibfs_tpu.ops.expand.expand_pull_tiered`:
     ``(next_frontier, par, dist, max_deg_of_new_frontier)``. ``tables`` is
     the :func:`prepare_pallas_tables` result (built once per solve by the
-    dense kernel, outside its while_loop)."""
+    dense kernel, outside its while_loop). ``tiers`` are the hub overflow
+    tables of a tiered layout — the kernel computes the base-table bulk
+    and the (small) tier gathers run as XLA ops around it, via the SAME
+    :func:`bibfs_tpu.ops.expand.apply_tiers` the XLA path uses."""
+    from bibfs_tpu.ops.expand import apply_tiers
+
+    n_pad = par.shape[0]
     visited = dist < inf
     nf, pcand = _run_pull(tables, frontier, visited, None)
     par = jnp.where(nf, pcand, par)
+    nf, par = apply_tiers(nf, par, frontier, visited, deg, tiers, n_pad)
     dist = jnp.where(nf & ~visited, lvl_next, dist)
     max_deg = jnp.max(jnp.where(nf, deg, 0))
     return nf, par, dist, max_deg
@@ -387,7 +407,7 @@ def pallas_available() -> bool:
         inf_d = jnp.full(n, 1 << 30, jnp.int32)
         nf_s, *_rest = pallas_pull_level_dual(
             fr, fr, zero, inf_d, zero, inf_d,
-            prepare_pallas_tables(nbr, deg), deg,
+            prepare_pallas_tables(nbr, deg), deg, (),
             jnp.int32(1), jnp.int32(1), inf=1 << 30,
         )
         # read a VALUE, not just block: lazy runtimes defer execution (and

@@ -54,7 +54,6 @@ from bibfs_tpu.solvers.dense import (
     DENSE_MODES,
     INF32,
     DeviceGraph,
-    _check_mode_layout,
     _cond,
     _make_body,
     _materialize,
@@ -110,7 +109,6 @@ def _prepare_tables_jit():
 def _dense_chunk_kernel(mode: str, push_cap: int, tier_meta: tuple, chunk: int):
     """jitted ``(nbr, deg, aux, state) -> state`` advancing at most
     ``chunk`` rounds of the dense search."""
-    _check_mode_layout(mode, tier_meta)
     cap = push_cap if DENSE_MODES[mode][1] else 0
     k = max(cap, 1)
 
@@ -460,16 +458,15 @@ def _get_chunk_step(g, mode: str, chunk: int):
     if DENSE_MODES[mode][2]:
         from bibfs_tpu.ops.pallas_expand import pallas_fits
 
-        if g.tier_meta or not pallas_fits(g.n_pad):
-            # a pallas-mode snapshot resumed on a tiered-layout graph (or
-            # one too large for the chunk loop) degrades to its base
-            # schedule — same rule as the 1D/2D substrates
-            mode = DENSE_MODES[mode][0]
-        else:
+        if pallas_fits(g.n_pad):
             # build the kernel table ONCE per drive, device-resident, and
-            # ride it through the (plain-ELL-empty) aux slot — each chunk
-            # dispatch reuses it instead of re-transposing per chunk
-            aux = _prepare_tables_jit()(g.nbr, g.deg)
+            # pair it with the original tier aux — each chunk dispatch
+            # reuses it instead of re-transposing per chunk
+            aux = (_prepare_tables_jit()(g.nbr, g.deg), g.aux)
+        else:
+            # too large for the kernel's static chunk loop: degrade to the
+            # base schedule, same rule as the 1D/2D substrates
+            mode = DENSE_MODES[mode][0]
     cap = kernel_cap(mode, g.n_pad)
     kern = _dense_chunk_kernel(mode, cap, g.tier_meta, chunk)
     return lambda st: kern(g.nbr, g.deg, aux, st)

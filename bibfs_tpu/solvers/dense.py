@@ -281,13 +281,19 @@ def _side_step(
     optimization: frontiers at most ``push_cap`` wide (and whose max degree
     fits the static push span) go through the sparse push path, larger ones
     through the dense pull path. ``push_cap == 0`` is pull-only (the
-    v3-style dense schedule). ``use_pallas`` routes the pull level through
-    the fused Pallas kernel (plain ELL only)."""
+    v3-style dense schedule). ``use_pallas`` routes the base-table pull
+    through the fused Pallas kernel (hub tiers stay as XLA ops)."""
     k = st[f"fi_{side}"].shape[0]
-    # under pallas modes aux carries the prepared kernel table, not tier
-    # arrays (plain-ELL only, enforced by _check_mode_layout)
-    hub_rank = aux[0] if aux and not use_pallas else None
-    full_tiers = () if use_pallas else _full_tiers(aux, tier_meta)
+    # under pallas modes aux is (kernel tables, original tier aux): the
+    # kernel owns the base table, hub tiers run as XLA ops around it
+    if use_pallas:
+        ptables, tier_aux = aux
+        hub_rank = None  # pallas modes are pull-only; no push path
+        full_tiers = _full_tiers(tier_aux, tier_meta)
+    else:
+        ptables = None
+        hub_rank = aux[0] if aux else None
+        full_tiers = _full_tiers(aux, tier_meta)
     span, ncov = push_span(nbr.shape[1], tier_meta)
     push_tiers = full_tiers[:ncov]
     carry = (
@@ -305,10 +311,10 @@ def _side_step(
         if use_pallas:
             from bibfs_tpu.ops.pallas_expand import pallas_pull_level
 
-            # aux carries the prepared transposed table (built once per
+            # ptables is the prepared transposed table (built once per
             # solve, outside the while_loop — see _build_kernel)
             nf, par, dist, md = pallas_pull_level(
-                fr, par, dist, aux, deg, lvl + 1, inf=INF32
+                fr, par, dist, ptables, deg, full_tiers, lvl + 1, inf=INF32
             )
         else:
             nf, par, dist, md = expand_pull_tiered(
@@ -359,9 +365,9 @@ def _side_step(
 # (v1/main-v1.cpp:51, v4 mpi_bas.cpp:90-92 — fewest edge scans). "beamer"
 # variants add push/pull direction optimization per expansion (Beamer-style
 # top-down/bottom-up switching — BASELINE.json config scope, never in the
-# reference). "pallas" variants run the pull level as the fused Pallas
+# reference). "pallas" variants run the base-table pull as the fused Pallas
 # kernel (ops/pallas_expand.py — the v3 expand_frontier analog the north
-# star names); plain-ELL layout only, interpret-mode off-TPU.
+# star names) with hub tiers as XLA ops; interpret-mode off-TPU.
 DENSE_MODES = {
     "sync": ("sync", False, False),
     "alt": ("alt", False, False),
@@ -397,6 +403,9 @@ def _make_body(mode: str, cap: int, tier_meta, nbr, deg, aux):
         # ONCE per round for both sides (mirrors the XLA dual branch below)
         from bibfs_tpu.ops.pallas_expand import pallas_pull_level_dual
 
+        ptables, tier_aux = aux
+        pallas_tiers = _full_tiers(tier_aux, tier_meta)
+
         def body(st):
             scanned = frontier_degree_sum(
                 st["fr_s"], deg
@@ -405,7 +414,8 @@ def _make_body(mode: str, cap: int, tier_meta, nbr, deg, aux):
                 pallas_pull_level_dual(
                     st["fr_s"], st["fr_t"],
                     st["par_s"], st["dist_s"], st["par_t"], st["dist_t"],
-                    aux, deg, st["lvl_s"] + 1, st["lvl_t"] + 1, inf=INF32,
+                    ptables, deg, pallas_tiers,
+                    st["lvl_s"] + 1, st["lvl_t"] + 1, inf=INF32,
                 )
             )
             st = {
@@ -470,14 +480,6 @@ def _make_body(mode: str, cap: int, tier_meta, nbr, deg, aux):
     return body
 
 
-def _check_mode_layout(mode: str, tier_meta: tuple) -> None:
-    if DENSE_MODES[mode][2] and tier_meta:
-        raise ValueError(
-            "pallas modes support the plain ELL layout only (the fused "
-            "kernel has no hub-tier path yet); use layout='ell'"
-        )
-
-
 def _build_kernel(mode: str, push_cap: int, tier_meta: tuple = ()):
     """Build the (unjitted) search kernel for (mode, push_cap, tier layout):
     ``fn(nbr, deg, aux, src, dst) -> (best, meet, parent_s, parent_t,
@@ -486,7 +488,6 @@ def _build_kernel(mode: str, push_cap: int, tier_meta: tuple = ()):
     search is one ``lax.while_loop`` in one XLA program — state never
     leaves HBM and the host syncs exactly once at the end (versus per-level
     host round-trips, quirk Q5)."""
-    _check_mode_layout(mode, tier_meta)
     cap = push_cap if DENSE_MODES[mode][1] else 0
     k = max(cap, 1)
 
@@ -500,11 +501,11 @@ def _build_kernel(mode: str, push_cap: int, tier_meta: tuple = ()):
             )
 
             if pallas_fits(n_pad):
-                # pallas pull: repurpose the (empty for plain ELL) aux slot
-                # to carry the kernel's transposed sentinel-padded table,
-                # built HERE — outside the while_loop — so the transpose
-                # runs once per solve, not once per level
-                aux = prepare_pallas_tables(nbr, deg)
+                # pallas pull: aux becomes (kernel tables, original tier
+                # aux). The transposed sentinel-padded table is built HERE
+                # — outside the while_loop — so the transpose runs once
+                # per solve, not once per level; hub tiers stay as XLA ops
+                aux = (prepare_pallas_tables(nbr, deg), aux)
             else:
                 # graph too large for the static chunk loop: degrade to the
                 # XLA pull path (same documented fallback as an unsupported

@@ -168,6 +168,36 @@ def test_pallas_snapshot_resumes_on_1d_mesh(tmp_path):
     _check(res, ora, n, edges, src, dst)
 
 
+def test_pallas_tiered_chunked_and_resume(tmp_path):
+    """Chunked execution + interrupt/resume under mode=pallas on a TIERED
+    graph: the chunk driver pairs the kernel tables with the tier aux
+    (both must thread through every dispatch) and agrees with the oracle."""
+    import numpy as np
+
+    from bibfs_tpu.graph.generate import gnp_random_graph
+
+    n = 300
+    rng = np.random.default_rng(9)
+    base = np.asarray(gnp_random_graph(n, 3.0 / n, seed=9), np.int64)
+    star = np.stack(
+        [np.zeros(120, np.int64),
+         rng.choice(np.arange(1, n), 120, replace=False)], axis=1
+    )
+    edges = np.concatenate([base.reshape(-1, 2), star])
+    g = DeviceGraph.build(n, edges, layout="tiered")
+    assert g.tier_meta  # the hub really creates tiers
+    src, dst = 1, n - 1
+    ora = _oracle(n, edges, src, dst)
+    res = ck.solve_checkpointed(g, src, dst, mode="pallas", chunk=2)
+    _check(res, ora, n, edges, src, dst)
+    path = str(tmp_path / "pt.ckpt")
+    assert ck.solve_checkpointed(
+        g, src, dst, chunk=1, path=path, max_chunks=1, mode="pallas"
+    ) is None
+    res2 = ck.resume(path, g, src=src, dst=dst, chunk=4)
+    _check(res2, ora, n, edges, src, dst)
+
+
 def test_sharded_chunked_modes():
     from bibfs_tpu.parallel.mesh import make_1d_mesh
     from bibfs_tpu.solvers.sharded import ShardedGraph

@@ -92,7 +92,7 @@ def test_pallas_dual_level_matches_xla(seed):
     )
     got = pallas_pull_level_dual(
         fr_s, fr_t, par0, dist_s, par0, dist_t,
-        prepare_pallas_tables(nbr, deg), deg,
+        prepare_pallas_tables(nbr, deg), deg, (),
         jnp.int32(2), jnp.int32(2), inf=INF,
     )
     names = ["nf_s", "par_s", "dist_s", "md_s", "nf_t", "par_t", "dist_t", "md_t"]
@@ -138,14 +138,32 @@ def test_pallas_batch_matches_oracle(mode):
             assert res.hops == ref.hops
 
 
-def test_pallas_rejects_tiered_layout():
+@pytest.mark.parametrize("mode", ["pallas", "pallas_alt"])
+def test_pallas_tiered_layout_matches_oracle(mode):
+    """Tiered layout under the pallas modes: the kernel owns the base
+    table, hub tiers run as XLA ops around it — hop parity must hold on a
+    graph whose hub forces real tiers."""
+    from bibfs_tpu.graph.csr import build_tiered
+    from bibfs_tpu.graph.generate import gnp_random_graph
     from bibfs_tpu.solvers.dense import solve_dense
+    from bibfs_tpu.solvers.serial import solve_serial
 
-    # star graph: hub degree n-1 forces real hub tiers in the tiered layout
-    n = 200
-    star = np.stack([np.zeros(n - 1, np.int64), np.arange(1, n)], axis=1)
-    with pytest.raises(ValueError, match="plain ELL"):
-        solve_dense(n, star, 0, n - 1, mode="pallas", layout="tiered")
+    n = 300
+    rng = np.random.default_rng(9)
+    base = gnp_random_graph(n, 3.0 / n, seed=9)
+    star = np.stack(
+        [np.zeros(120, np.int64),
+         rng.choice(np.arange(1, n), 120, replace=False)], axis=1
+    )
+    edges = np.concatenate([np.asarray(base, np.int64).reshape(-1, 2), star])
+    assert build_tiered(n, edges).tiers  # the hub really creates tiers
+    for s, d in [(0, n - 1), (3, n // 2), (7, 7)]:
+        want = solve_serial(n, edges, s, d)
+        got = solve_dense(n, edges, s, d, mode=mode, layout="tiered")
+        assert got.found == want.found
+        if want.found:
+            assert got.hops == want.hops
+            got.validate_path(n, edges, s, d)
 
 
 def test_pallas_available_and_mode_resolution():
