@@ -80,25 +80,51 @@ def unpack_bits(words: jnp.ndarray, m: int) -> jnp.ndarray:
     return bits.reshape(-1)[:m].astype(jnp.bool_)
 
 
+def _unpack_shard_words(words: jnp.ndarray, n_loc: int) -> jnp.ndarray:
+    """Per-shard word unpack shared by the gather helpers below:
+    ``uint32[ndev, ..., nw] -> uint32 0/1 [ndev, ..., n_loc]`` with each
+    shard's pad-to-word gap stripped (so ``n_loc`` need not divide the
+    word size). THE one implementation of the unpack/strip rule."""
+    bits = jnp.bitwise_and(
+        jnp.right_shift(
+            words[..., None],
+            jnp.arange(PACK_W, dtype=jnp.uint32),
+        ),
+        jnp.uint32(1),
+    )
+    return bits.reshape(*words.shape[:-1], -1)[..., :n_loc]
+
+
 def all_gather_bits(fr: jnp.ndarray, axis: str) -> jnp.ndarray:
     """Bitpacked boolean all_gather: each shard packs its ``bool[n_loc]``
     into uint32 words, ONE tiled ``all_gather`` ships the words (n/8 bytes
     on the wire vs n for bools), and every device unpacks the global
-    frontier locally. Per-shard pad-to-word gaps are preserved and stripped
-    shard-by-shard, so ``n_loc`` need not divide the word size.
-    """
+    frontier locally."""
     n_loc = fr.shape[0]
     nw = -(-n_loc // PACK_W)
     words = jax.lax.all_gather(pack_bits(fr), axis, tiled=True)  # [ndev*nw]
     ndev = words.shape[0] // nw
-    bits = jnp.bitwise_and(
-        jnp.right_shift(
-            words.reshape(ndev, nw, 1),
-            jnp.arange(PACK_W, dtype=jnp.uint32)[None, None, :],
-        ),
-        jnp.uint32(1),
-    )
-    return bits.reshape(ndev, nw * PACK_W)[:, :n_loc].reshape(-1).astype(jnp.bool_)
+    bits = _unpack_shard_words(words.reshape(ndev, nw), n_loc)
+    return bits.reshape(-1).astype(jnp.bool_)
+
+
+def all_gather_bits_dual(
+    fr_s: jnp.ndarray, fr_t: jnp.ndarray, axis: str
+) -> jnp.ndarray:
+    """Both sides' bitpacked frontiers in ONE ``all_gather``: the two word
+    planes ride a single ``[2, nw]`` payload per shard, so a lock-step
+    round pays one collective's latency instead of two (the wire BYTES are
+    the same 2·n/8 either way — this halves the per-round latency/sync
+    term, which is what dominates small-message ICI collectives). Returns
+    the :func:`bibfs_tpu.ops.expand.pack_dual`-coded global frontier
+    ``uint8[n]`` (bit 0 = source side, bit 1 = target side), ready for
+    ``expand_pull_dual`` with no bool round-trip."""
+    n_loc = fr_s.shape[0]
+    planes = jnp.stack([pack_bits(fr_s), pack_bits(fr_t)])  # [2, nw]
+    allp = jax.lax.all_gather(planes, axis)  # [ndev, 2, nw]
+    bits = _unpack_shard_words(allp, n_loc)  # [ndev, 2, n_loc]
+    code = bits[:, 0, :] | (bits[:, 1, :] << 1)
+    return code.reshape(-1).astype(jnp.uint8)
 
 
 def frontier_exchange_bytes(n_loc: int, packed: bool = True) -> int:
