@@ -180,9 +180,85 @@ def _make_2d_body(bnbr, bcnt, deg, tiers=(), *, R: int, C: int, mode: str):
         return st
 
     if mode == "sync":
+        # lock-step fusion (mirrors the dense/1D dual branches): both
+        # sides' word planes ride ONE transpose ppermute and ONE row-axis
+        # all_gather, one block read serves both expansions, and the
+        # parent folds/counts ride stacked collectives — half the
+        # collective count per round, same wire bytes
+        from bibfs_tpu.ops.expand import _dual_hits, pack_dual
 
         def body(st):
-            return meet_vote(side_step(side_step(st, "s"), "t"), 2)
+            scanned2 = sum_allreduce(
+                jnp.stack([
+                    jnp.sum(jnp.where(st["fr_s"], deg, 0)),
+                    jnp.sum(jnp.where(st["fr_t"], deg, 0)),
+                ]),
+                axes,
+            )
+            planes = jnp.stack(
+                [pack_bits(st["fr_s"]), pack_bits(st["fr_t"])]
+            )  # [2, nw]
+            words = jax.lax.ppermute(planes, axes, perm)
+            allw = jax.lax.all_gather(words, ROW_AXIS)  # [R, 2, nw]
+            # n_loc is a multiple of 32 by construction: no pad gaps
+            f_col_s = unpack_bits(allw[:, 0, :].reshape(-1), nc)
+            f_col_t = unpack_bits(allw[:, 1, :].reshape(-1), nc)
+            packed_col = pack_dual(f_col_s, f_col_t)
+            valid = cols_iota < bcnt[:, None]
+            vals = packed_col[bnbr]  # ONE [nr, W] block gather, both sides
+            cands = []
+            for bit in (1, 2):
+                hits = _dual_hits(vals, valid, bit)
+                j_star = jnp.argmax(hits, axis=1)
+                p_loc = jnp.take_along_axis(bnbr, j_star[:, None], axis=1)[:, 0]
+                cands.append(
+                    jnp.where(jnp.any(hits, axis=1), p_loc + c * nc, -1)
+                    .astype(jnp.int32)
+                )
+            cand_s, cand_t = cands
+            for start, tnbr, tids in tiers:
+                wt = tnbr.shape[1]
+                ids_c = jnp.clip(tids, 0, nr - 1)
+                scnt = jnp.clip(bcnt[ids_c] - start, 0, wt)
+                tvalid = (
+                    jnp.arange(wt, dtype=jnp.int32)[None, :] < scnt[:, None]
+                ) & (tids >= 0)[:, None]
+                tvals = packed_col[tnbr]  # ONE tier gather, both sides
+                for bit in (1, 2):
+                    thits = _dual_hits(tvals, tvalid, bit)
+                    tany = jnp.any(thits, axis=1)
+                    tj = jnp.argmax(thits, axis=1)
+                    tp = jnp.take_along_axis(tnbr, tj[:, None], axis=1)[:, 0]
+                    tcand = jnp.where(tany, tp + c * nc, -1).astype(jnp.int32)
+                    tgt = jnp.where(tany, ids_c, nr)  # nr -> drop
+                    if bit == 1:
+                        cand_s = cand_s.at[tgt].max(tcand, mode="drop")
+                    else:
+                        cand_t = cand_t.at[tgt].max(tcand, mode="drop")
+            fold2 = jax.lax.pmax(jnp.stack([cand_s, cand_t]), COL_AXIS)
+            st = dict(st)
+            for i, side in enumerate(("s", "t")):
+                chunk = jax.lax.dynamic_slice_in_dim(
+                    fold2[i], c * n_loc, n_loc
+                )
+                nf = (chunk >= 0) & (st[f"dist_{side}"] >= INF32)
+                st[f"par_{side}"] = jnp.where(nf, chunk, st[f"par_{side}"])
+                st[f"dist_{side}"] = jnp.where(
+                    nf, st[f"lvl_{side}"] + 1, st[f"dist_{side}"]
+                )
+                st[f"fr_{side}"] = nf
+                st[f"lvl_{side}"] = st[f"lvl_{side}"] + 1
+            cnt2 = sum_allreduce(
+                jnp.stack([
+                    jnp.sum(st["fr_s"].astype(jnp.int32)),
+                    jnp.sum(st["fr_t"].astype(jnp.int32)),
+                ]),
+                axes,
+            )
+            st["cnt_s"] = cnt2[0]
+            st["cnt_t"] = cnt2[1]
+            st["edges"] = st["edges"] + scanned2[0] + scanned2[1]
+            return meet_vote(st, 2)
 
     elif mode == "alt":
 
