@@ -236,6 +236,41 @@ def test_all_gather_bits_matches_bool_gather(n_loc):
     np.testing.assert_array_equal(np.asarray(plain), fr)
 
 
+@pytest.mark.parametrize("n_loc", [16, 32, 40])  # incl. non-multiples of 32
+def test_all_gather_bits_dual_matches_pack_dual(n_loc):
+    """The one-collective dual exchange must equal pack_dual of two plain
+    gathers — both the bit coding and the shard ordering."""
+    from functools import partial
+
+    from bibfs_tpu.ops.expand import pack_dual
+    from bibfs_tpu.parallel.collectives import all_gather_bits_dual
+    from bibfs_tpu.parallel.mesh import VERTEX_AXIS, make_1d_mesh
+    from jax.sharding import PartitionSpec as P
+
+    mesh = make_1d_mesh(8)
+    rng = np.random.default_rng(n_loc + 7)
+    fr_s = rng.random(8 * n_loc) < 0.4
+    fr_t = rng.random(8 * n_loc) < 0.3
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(VERTEX_AXIS), P(VERTEX_AXIS)),
+        out_specs=(P(), P()),
+        check_vma=False,  # gather outputs are replicated by construction
+    )
+    def both(s_shard, t_shard):
+        dual = all_gather_bits_dual(s_shard, t_shard, VERTEX_AXIS)
+        want = pack_dual(
+            jax.lax.all_gather(s_shard, VERTEX_AXIS, tiled=True),
+            jax.lax.all_gather(t_shard, VERTEX_AXIS, tiled=True),
+        )
+        return dual, want
+
+    dual, want = both(jax.numpy.asarray(fr_s), jax.numpy.asarray(fr_t))
+    np.testing.assert_array_equal(np.asarray(dual), np.asarray(want))
+
+
 def test_frontier_exchange_bytes_reduction():
     from bibfs_tpu.parallel.collectives import frontier_exchange_bytes
 
