@@ -151,8 +151,9 @@ def main(argv=None):
             ap.error("--backend sharded2d has its own block layout; "
                      "--layout does not apply")
 
-    if mode.startswith("pallas") and args.backend != "dense":
-        ap.error("--mode pallas/pallas_alt is only supported by --backend dense")
+    if mode.startswith("pallas") and args.backend not in ("dense", "sharded"):
+        ap.error("--mode pallas/pallas_alt is only supported by the dense "
+                 "and sharded backends")
     if args.pairs is not None:
         if args.backend not in ("dense", "native", "sharded", "sharded2d"):
             ap.error("--pairs batch mode is supported by --backend dense/"
@@ -182,8 +183,6 @@ def main(argv=None):
             ap.error("--resume needs --checkpoint FILE to resume from")
         if args.chunk is not None and args.chunk < 1:
             ap.error("--chunk must be >= 1")
-        if mode.startswith("pallas") and args.backend == "sharded":
-            ap.error("pallas modes are single-chip (dense backend) only")
     kwargs = {}
     if args.devices is not None:
         kwargs["num_devices"] = args.devices

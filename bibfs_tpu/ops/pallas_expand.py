@@ -91,22 +91,27 @@ def _lane_block(n_pad_p: int) -> int:
     raise ValueError(f"n_pad_p={n_pad_p} not a multiple of {LANE_BLOCKS[-1]}")
 
 
-def _word_geometry(n_pad_p: int, tc: int) -> tuple[int, int]:
-    """(n_words_p, chunks): packed words padded to whole chunks. The
-    sentinel id ``n_pad_p`` needs no dedicated word: its word index either
-    falls outside every chunk window (the in-bounds mask zeroes it) or
-    lands in the zero-padded tail of the packed array — both read as 0."""
-    chunks = -(-(n_pad_p // 32) // tc)
+def _word_geometry(id_space_p: int, tc: int) -> tuple[int, int]:
+    """(n_words_p, chunks): packed frontier words padded to whole chunks.
+    The sentinel id ``id_space_p`` needs no dedicated word: its word index
+    either falls outside every chunk window (the in-bounds mask zeroes it)
+    or lands in the zero-padded tail of the packed array — both read
+    as 0."""
+    chunks = -(-(id_space_p // 32) // tc)
     return chunks * tc, chunks
 
 
-def pallas_fits(n_pad: int) -> bool:
+def pallas_fits(n_rows: int, id_space: int | None = None) -> bool:
     """Whether the compiled kernel's static chunk loop stays within
-    MAX_CHUNKS for this graph size. Callers (the dense solver and the
-    checkpoint driver) route oversized graphs to the XLA pull path."""
-    n_pad_p = _pad_n(n_pad)
-    tc = _lane_block(n_pad_p)
-    return _word_geometry(n_pad_p, tc)[1] <= MAX_CHUNKS
+    MAX_CHUNKS for this table geometry (``n_rows`` local vertex rows,
+    frontier ids in ``[0, id_space)`` — equal for the single-chip solver,
+    ``id_space = n_rows * ndev`` per shard under the 1D mesh). Callers
+    (the dense/sharded solvers and the checkpoint driver) route oversized
+    graphs to the XLA pull path."""
+    n_rows_p = _pad_n(n_rows)
+    id_space_p = _pad_n(id_space if id_space is not None else n_rows)
+    tc = _lane_block(n_rows_p)
+    return _word_geometry(id_space_p, tc)[1] <= MAX_CHUNKS
 
 
 def _slot_pad(width: int) -> int:
@@ -114,23 +119,29 @@ def _slot_pad(width: int) -> int:
     return max(8, -(-width // 8) * 8)
 
 
-def prepare_pallas_tables(nbr: jnp.ndarray, deg: jnp.ndarray) -> tuple:
+def prepare_pallas_tables(
+    nbr: jnp.ndarray, deg: jnp.ndarray, id_space: int | None = None
+) -> tuple:
     """Build the kernel's transposed sentinel-padded table from the XLA
-    path's ``[n_pad, width]`` ELL table. Pure jittable ops on loop-constant
-    arrays — the dense solver calls this OUTSIDE its ``while_loop`` so the
-    transpose happens once per solve, not once per level. Returns a
-    one-element pytree ``(nbr_t int32[Wp, n_pad_p],)`` (tuple so it rides
+    path's ``[n_rows, width]`` ELL table. Pure jittable ops on
+    loop-constant arrays — the solvers call this OUTSIDE their
+    ``while_loop`` so the transpose happens once per solve, not once per
+    level. ``id_space`` is the frontier id range the table's entries index
+    (defaults to ``n_rows``; under the 1D mesh the LOCAL shard's rows
+    index the GLOBAL frontier, so ``id_space = n_rows * ndev``). Returns a
+    one-element pytree ``(nbr_t int32[Wp, n_rows_p],)`` (tuple so it rides
     the solver's ``aux`` slot)."""
-    n_pad, width = nbr.shape
-    n_pad_p = _pad_n(n_pad)
+    n_rows, width = nbr.shape
+    n_rows_p = _pad_n(n_rows)
+    sent = _pad_n(id_space if id_space is not None else n_rows)
     wp = _slot_pad(width)
-    sent = jnp.int32(n_pad_p)  # frontier bit of the sentinel is always 0
+    # the sentinel id's frontier bit is always 0 (zero-padded word tail)
     mask = jnp.arange(width, dtype=jnp.int32)[None, :] < deg[:, None]
-    nbrm = jnp.where(mask, nbr.astype(jnp.int32), sent)
+    nbrm = jnp.where(mask, nbr.astype(jnp.int32), jnp.int32(sent))
     nbrm = jnp.pad(
         nbrm,
-        ((0, n_pad_p - n_pad), (0, wp - width)),
-        constant_values=n_pad_p,
+        ((0, n_rows_p - n_rows), (0, wp - width)),
+        constant_values=sent,
     )
     return (nbrm.T,)
 
@@ -205,15 +216,18 @@ def _pull_kernel_dual(
 
 
 @lru_cache(maxsize=None)
-def _get_dual_call(wp: int, n_pad_p: int, interpret: bool):
-    tc = _lane_block(n_pad_p)
-    n_words_p, chunks = _word_geometry(n_pad_p, tc)
+def _get_dual_call(
+    wp: int, n_rows_p: int, id_space_p: int, interpret: bool,
+    vma: frozenset = frozenset(),
+):
+    tc = _lane_block(n_rows_p)
+    n_words_p, chunks = _word_geometry(id_space_p, tc)
     if chunks > MAX_CHUNKS:
         raise ValueError(
-            f"pallas pull kernel: {chunks} frontier chunks at n_pad_p="
-            f"{n_pad_p} exceeds MAX_CHUNKS={MAX_CHUNKS}; use the XLA path"
+            f"pallas pull kernel: {chunks} frontier chunks at id_space_p="
+            f"{id_space_p} exceeds MAX_CHUNKS={MAX_CHUNKS}; use the XLA path"
         )
-    grid = n_pad_p // tc
+    grid = n_rows_p // tc
     kernel = lambda *refs: _pull_kernel_dual(chunks, tc, *refs)  # noqa: E731
     fw_spec = pl.BlockSpec((chunks, tc), lambda i: (0, 0))
     col = pl.BlockSpec((1, tc), lambda i: (0, i))
@@ -223,8 +237,50 @@ def _get_dual_call(wp: int, n_pad_p: int, interpret: bool):
         in_specs=[fw_spec, fw_spec, pl.BlockSpec((wp, tc), lambda i: (0, i)),
                   col, col],
         out_specs=[col, col, col, col],
-        out_shape=[jax.ShapeDtypeStruct((1, n_pad_p), jnp.int32)] * 4,
+        out_shape=[jax.ShapeDtypeStruct((1, n_rows_p), jnp.int32, vma=vma)] * 4,
         interpret=interpret,
+    )
+
+
+def run_pull_dual(
+    tables: tuple, fr_s, fr_t, vis_s, vis_t, *, interpret: bool | None = None
+):
+    """Both sides' raw kernel pass, mirroring the contract of
+    :func:`bibfs_tpu.ops.expand.expand_pull_dual`: returns
+    ``(nf_s, pc_s, nf_t, pc_t)`` over the table's LOCAL rows. The
+    frontiers are indexed by the ids stored in the table (GLOBAL under
+    sharding); the visited sets cover the local rows."""
+    (nbr_t,) = tables
+    wp, n_rows_p = nbr_t.shape
+    n_rows = vis_s.shape[0]
+    id_space_p = _pad_n(fr_s.shape[0])
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    tc = _lane_block(n_rows_p)
+    n_words_p, _chunks = _word_geometry(id_space_p, tc)
+
+    def prep_vis(v):
+        return jnp.pad(
+            v.astype(jnp.int32), (0, n_rows_p - n_rows), constant_values=1
+        ).reshape(1, n_rows_p)
+
+    fws = _pack_frontier(fr_s, n_words_p, tc)
+    fwt = _pack_frontier(fr_t, n_words_p, tc)
+    visp_s = prep_vis(vis_s)
+    visp_t = prep_vis(vis_t)
+    vma = _vma_of(fws, fwt, nbr_t, visp_s, visp_t)
+    if interpret and vma:  # see _reference_pull_vals
+        chks = _word_geometry(id_space_p, tc)[1]
+        nfs2, ps2 = _reference_pull_vals(fws, nbr_t, visp_s, chks, tc)
+        nft2, pt2 = _reference_pull_vals(fwt, nbr_t, visp_t, chks, tc)
+    else:
+        call = _get_dual_call(wp, n_rows_p, id_space_p, interpret, vma)
+        nfs2, ps2, nft2, pt2 = call(fws, fwt, nbr_t, visp_s, visp_t)
+    return (
+        nfs2[0, :n_rows] > 0,
+        ps2[0, :n_rows],
+        nft2[0, :n_rows] > 0,
+        pt2[0, :n_rows],
     )
 
 
@@ -242,32 +298,12 @@ def pallas_pull_level_dual(
     base-table bulk."""
     from bibfs_tpu.ops.expand import apply_tiers_dual, pack_dual
 
-    (nbr_t,) = tables
-    wp, n_pad_p = nbr_t.shape
-    n_pad = fr_s.shape[0]
-    interpret = jax.default_backend() != "tpu"
-    tc = _lane_block(n_pad_p)
-    n_words_p, _chunks = _word_geometry(n_pad_p, tc)
+    n_pad = par_s.shape[0]
     vis_s = dist_s < inf
     vis_t = dist_t < inf
-
-    def prep_vis(v):
-        return jnp.pad(
-            v.astype(jnp.int32), (0, n_pad_p - n_pad), constant_values=1
-        ).reshape(1, n_pad_p)
-
-    call = _get_dual_call(wp, n_pad_p, interpret)
-    nfs2, ps2, nft2, pt2 = call(
-        _pack_frontier(fr_s, n_words_p, tc),
-        _pack_frontier(fr_t, n_words_p, tc),
-        nbr_t,
-        prep_vis(vis_s),
-        prep_vis(vis_t),
-    )
-    nf_s = nfs2[0, :n_pad] > 0
-    nf_t = nft2[0, :n_pad] > 0
-    par_s = jnp.where(nf_s, ps2[0, :n_pad], par_s)
-    par_t = jnp.where(nf_t, pt2[0, :n_pad], par_t)
+    nf_s, pc_s, nf_t, pc_t = run_pull_dual(tables, fr_s, fr_t, vis_s, vis_t)
+    par_s = jnp.where(nf_s, pc_s, par_s)
+    par_t = jnp.where(nf_t, pc_t, par_t)
     if tiers:
         nf_s, par_s, nf_t, par_t = apply_tiers_dual(
             nf_s, par_s, nf_t, par_t, pack_dual(fr_s, fr_t),
@@ -294,16 +330,65 @@ def _pull_kernel(chunks: int, tc: int, fw_ref, nbr_ref, vis_ref, nf_ref, par_ref
     )
 
 
+def _reference_pull_vals(fw, nbr_t, visp, chunks: int, tc: int):
+    """Value-level evaluation of EXACTLY the kernel math (same window
+    geometry, same first-slot reduction) in plain XLA ops. Used when
+    interpret mode runs inside shard_map: the pallas HLO interpreter
+    evaluates the kernel body under the mesh's varying-axes checking,
+    which rejects the literal constants the body mixes with varying ref
+    loads (normal XLA tracing auto-lifts literals; the interpreter does
+    not). The compiled Mosaic path is opaque to that checking and runs
+    the real kernel. Returns ``(nf int32[1, n_rows_p], par int32[1,
+    n_rows_p])``."""
+    word = jax.lax.shift_right_logical(nbr_t, 5)
+    bit_ix = nbr_t & 31
+    hit = jnp.zeros(nbr_t.shape, jnp.int32)
+    for k in range(chunks):
+        local = word - k * tc
+        inb = (local >= 0) & (local < tc)
+        lidx = jnp.clip(local, 0, tc - 1)
+        g = jnp.take(fw[k], lidx)  # XLA-native arbitrary gather
+        b = jax.lax.shift_right_logical(g, bit_ix) & 1
+        hit = hit | jnp.where(inb, b, 0)
+    wp = nbr_t.shape[0]
+    slot = jax.lax.broadcasted_iota(jnp.int32, nbr_t.shape, 0)
+    m = jnp.max(jnp.where(hit > 0, wp - slot, 0), axis=0, keepdims=True)
+    j_star = jnp.clip(wp - m, 0, wp - 1)
+    psel = jnp.take_along_axis(
+        nbr_t, jnp.broadcast_to(j_star, nbr_t.shape), axis=0
+    )
+    nf = (m > 0) & (visp == 0)
+    return nf.astype(jnp.int32), jnp.max(psel, axis=0, keepdims=True)
+
+
+def _vma_of(*arrays) -> frozenset:
+    """Union of the inputs' varying-mesh-axes: under shard_map the
+    pallas_call's out_shape must declare how outputs vary across the mesh
+    (they vary exactly as the inputs do — per-shard rows)."""
+    out = frozenset()
+    for a in arrays:
+        try:
+            v = jax.typeof(a).vma
+        except AttributeError:
+            v = None
+        if v:
+            out |= frozenset(v)
+    return out
+
+
 @lru_cache(maxsize=None)
-def _get_pull_call(wp: int, n_pad_p: int, interpret: bool):
-    tc = _lane_block(n_pad_p)
-    n_words_p, chunks = _word_geometry(n_pad_p, tc)
+def _get_pull_call(
+    wp: int, n_rows_p: int, id_space_p: int, interpret: bool,
+    vma: frozenset = frozenset(),
+):
+    tc = _lane_block(n_rows_p)
+    n_words_p, chunks = _word_geometry(id_space_p, tc)
     if chunks > MAX_CHUNKS:
         raise ValueError(
-            f"pallas pull kernel: {chunks} frontier chunks at n_pad_p="
-            f"{n_pad_p} exceeds MAX_CHUNKS={MAX_CHUNKS}; use the XLA path"
+            f"pallas pull kernel: {chunks} frontier chunks at id_space_p="
+            f"{id_space_p} exceeds MAX_CHUNKS={MAX_CHUNKS}; use the XLA path"
         )
-    grid = n_pad_p // tc
+    grid = n_rows_p // tc
     kernel = lambda *refs: _pull_kernel(chunks, tc, *refs)  # noqa: E731
     return pl.pallas_call(
         kernel,
@@ -318,28 +403,44 @@ def _get_pull_call(wp: int, n_pad_p: int, interpret: bool):
             pl.BlockSpec((1, tc), lambda i: (0, i)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((1, n_pad_p), jnp.int32),
-            jax.ShapeDtypeStruct((1, n_pad_p), jnp.int32),
+            jax.ShapeDtypeStruct((1, n_rows_p), jnp.int32, vma=vma),
+            jax.ShapeDtypeStruct((1, n_rows_p), jnp.int32, vma=vma),
         ],
         interpret=interpret,
     )
 
 
 def _run_pull(tables: tuple, frontier, visited, interpret: bool | None):
+    """``frontier`` is indexed by the ids stored in the table (GLOBAL
+    under sharding); ``visited`` covers the table's local rows."""
     (nbr_t,) = tables
-    wp, n_pad_p = nbr_t.shape
-    n_pad = frontier.shape[0]
+    wp, n_rows_p = nbr_t.shape
+    n_rows = visited.shape[0]
+    id_space_p = _pad_n(frontier.shape[0])
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    tc = _lane_block(n_pad_p)
-    n_words_p, _chunks = _word_geometry(n_pad_p, tc)
+    tc = _lane_block(n_rows_p)
+    n_words_p, _chunks = _word_geometry(id_space_p, tc)
     fw = _pack_frontier(frontier, n_words_p, tc)
     visp = jnp.pad(
-        visited.astype(jnp.int32), (0, n_pad_p - n_pad), constant_values=1
-    ).reshape(1, n_pad_p)
-    call = _get_pull_call(wp, n_pad_p, interpret)
-    nf2, par2 = call(fw, nbr_t, visp)
-    return nf2[0, :n_pad] > 0, par2[0, :n_pad]
+        visited.astype(jnp.int32), (0, n_rows_p - n_rows), constant_values=1
+    ).reshape(1, n_rows_p)
+    vma = _vma_of(fw, nbr_t, visp)
+    if interpret and vma:
+        _chks = _word_geometry(id_space_p, tc)[1]
+        nf2, par2 = _reference_pull_vals(fw, nbr_t, visp, _chks, tc)
+    else:
+        call = _get_pull_call(wp, n_rows_p, id_space_p, interpret, vma)
+        nf2, par2 = call(fw, nbr_t, visp)
+    return nf2[0, :n_rows] > 0, par2[0, :n_rows]
+
+
+def run_pull(tables: tuple, frontier, visited, *, interpret: bool | None = None):
+    """Single-side raw kernel pass, mirroring the contract of
+    :func:`bibfs_tpu.ops.expand.expand_pull`: returns ``(next_frontier,
+    parent_candidate)`` over the table's LOCAL rows. ``frontier`` is
+    indexed by the ids stored in the table (GLOBAL under sharding)."""
+    return _run_pull(tables, frontier, visited, interpret)
 
 
 def expand_pull_pallas(

@@ -146,11 +146,6 @@ def _sharded_chunk_kernel(
         _shard_cond,
     )
 
-    if SHARDED_MODES[mode][2]:
-        # pallas modes are single-chip (dense backend) only: a snapshot
-        # written under them degrades to its base schedule on the 1D mesh,
-        # same as the 2D leg below — the carry is schedule-portable
-        mode = SHARDED_MODES[mode][0]
     hybrid = SHARDED_MODES[mode][1]
     cap = push_cap if hybrid else 0
     k = max(cap, 1)
@@ -443,8 +438,11 @@ def _get_chunk_step(g, mode: str, chunk: int):
         )
         return lambda st: kern(g.bnbr, g.bcnt, g.deg, g.aux, st)
     if hasattr(g, "mesh"):  # ShardedGraph
-        if DENSE_MODES[mode][2]:  # pallas is single-chip: degrade (pre-key)
-            mode = DENSE_MODES[mode][0]
+        # Mosaic-availability fallback resolved BEFORE the cache key; the
+        # shard body itself degrades oversized graphs via pallas_fits
+        from bibfs_tpu.solvers.dense import _resolve_pallas_mode
+
+        mode = _resolve_pallas_mode(mode)
         cap = kernel_cap(mode, g.n_pad)
         kern = _sharded_chunk_kernel(
             g.mesh, VERTEX_AXIS, mode, cap, g.tier_meta, chunk

@@ -96,6 +96,23 @@ def _make_shard_body(
     full_tiers = tuple(zip(tier_meta, tiers))
     span, ncov = push_span(width, tier_meta)  # shared Beamer gate rule
     push_tiers = full_tiers[:ncov]
+    # pallas modes: the fused kernel runs per shard over the LOCAL table
+    # with the GLOBAL gathered frontier (id_space = whole graph); tables
+    # are prepared HERE — trace time, outside the while_loop — and the
+    # hub-tier exchange stays the XLA collective path either way
+    use_pallas = SHARDED_MODES[mode][2]
+    ptables = None
+    if use_pallas:
+        from bibfs_tpu.ops.pallas_expand import (
+            pallas_fits,
+            prepare_pallas_tables,
+        )
+
+        n_glob = n_loc * jax.lax.axis_size(axis)
+        if pallas_fits(n_loc, n_glob):
+            ptables = prepare_pallas_tables(nbr, deg, id_space=n_glob)
+        else:  # chunk loop too long: degrade to the XLA pull path
+            use_pallas = False
 
     def pull(c):
         fr, fi, _ok, par, dist, lvl = c
@@ -105,7 +122,12 @@ def _make_shard_body(
         # bytes (the v2 bitset exchange, second_try.cpp:53-62,82-85)
         f_glob = all_gather_bits(fr, axis)
         visited = dist < INF32
-        nf0, pcand = expand_pull(f_glob, visited, nbr, deg)
+        if use_pallas:
+            from bibfs_tpu.ops.pallas_expand import run_pull
+
+            nf0, pcand = run_pull(ptables, f_glob, visited)
+        else:
+            nf0, pcand = expand_pull(f_glob, visited, nbr, deg)
         par = jnp.where(nf0, pcand, par)
         nf = nf0
         for (tstart, tcount, twidth, _cpad), (tnbr, tslots, tids) in full_tiers:
@@ -285,9 +307,17 @@ def _make_shard_body(
             packed = all_gather_bits_dual(fr_s, fr_t, axis)
             vis_s = st["dist_s"] < INF32
             vis_t = st["dist_t"] < INF32
-            nf_s, pc_s, nf_t, pc_t = expand_pull_dual(
-                packed, vis_s, vis_t, nbr, deg
-            )
+            if use_pallas:
+                from bibfs_tpu.ops.pallas_expand import run_pull_dual
+
+                nf_s, pc_s, nf_t, pc_t = run_pull_dual(
+                    ptables, (packed & 1) > 0, (packed & 2) > 0,
+                    vis_s, vis_t,
+                )
+            else:
+                nf_s, pc_s, nf_t, pc_t = expand_pull_dual(
+                    packed, vis_s, vis_t, nbr, deg
+                )
             par_s = jnp.where(nf_s, pc_s, st["par_s"])
             par_t = jnp.where(nf_t, pc_t, st["par_t"])
             for (_ts, _tc, twidth, _cp), (tnbr, tslots, tids) in full_tiers:
@@ -460,12 +490,10 @@ def _bibfs_shard_body(
 def _sharded_fn(
     mesh, axis: str, mode: str = "sync", push_cap: int = 0, tier_meta: tuple = ()
 ):
-    """The (unjitted) shard_map'd whole-search program."""
-    if SHARDED_MODES[mode][2]:
-        raise ValueError(
-            "pallas modes are single-chip (dense backend) only; the sharded "
-            "pull path is plain XLA under shard_map"
-        )
+    """The (unjitted) shard_map'd whole-search program. Pallas modes run
+    the fused kernel per shard inside the collective program (the v4
+    MPI-driving-CUDA-kernels architecture, mpi_bas.cpp:96-107, reborn as
+    one shard_map program)."""
     hybrid = SHARDED_MODES[mode][1]
     cap = push_cap if hybrid else 0
     sh = P(axis)
@@ -489,15 +517,38 @@ def _sharded_fn(
     )
 
 
-@lru_cache(maxsize=None)
 def _compiled_sharded(
+    mesh, axis: str, mode: str = "sync", push_cap: int = 0, tier_meta: tuple = ()
+):
+    # resolve the Mosaic-availability fallback BEFORE the cache key (same
+    # rule as dense._get_kernel): a fallen-back 'pallas' shares the
+    # already-compiled 'sync' program
+    from bibfs_tpu.solvers.dense import _resolve_pallas_mode
+
+    return _compiled_sharded_resolved(
+        mesh, axis, _resolve_pallas_mode(mode), push_cap, tier_meta
+    )
+
+
+@lru_cache(maxsize=None)
+def _compiled_sharded_resolved(
     mesh, axis: str, mode: str = "sync", push_cap: int = 0, tier_meta: tuple = ()
 ):
     return jax.jit(_sharded_fn(mesh, axis, mode, push_cap, tier_meta))
 
 
-@lru_cache(maxsize=None)
 def _compiled_sharded_batch(
+    mesh, axis: str, mode: str = "sync", push_cap: int = 0, tier_meta: tuple = ()
+):
+    from bibfs_tpu.solvers.dense import _resolve_pallas_mode
+
+    return _compiled_sharded_batch_resolved(
+        mesh, axis, _resolve_pallas_mode(mode), push_cap, tier_meta
+    )
+
+
+@lru_cache(maxsize=None)
+def _compiled_sharded_batch_resolved(
     mesh, axis: str, mode: str = "sync", push_cap: int = 0, tier_meta: tuple = ()
 ):
     """vmap of the sharded search over (src, dst) pairs: B multi-chip

@@ -177,8 +177,31 @@ def test_pallas_available_and_mode_resolution():
     assert _resolve_pallas_mode("sync") == "sync"
 
 
-def test_sharded_rejects_pallas_mode():
-    from bibfs_tpu.solvers.sharded import solve_sharded
+@pytest.mark.parametrize("mode", ["pallas", "pallas_alt"])
+@pytest.mark.parametrize("layout", ["ell", "tiered"])
+def test_sharded_pallas_matches_oracle(mode, layout):
+    """The fused kernel runs PER SHARD inside the collective program: the
+    local table indexes the global gathered frontier (rectangular
+    rows/id-space geometry) — hop parity must hold across the 8-device
+    mesh on both layouts."""
+    from bibfs_tpu.graph.generate import gnp_random_graph
+    from bibfs_tpu.parallel.mesh import make_1d_mesh
+    from bibfs_tpu.solvers.serial import solve_serial
+    from bibfs_tpu.solvers.sharded import ShardedGraph, solve_sharded_graph
 
-    with pytest.raises(ValueError, match="single-chip"):
-        solve_sharded(16, np.array([[0, 1]]), 0, 1, mode="pallas")
+    n = 400
+    rng = np.random.default_rng(5)
+    base = np.asarray(gnp_random_graph(n, 3.0 / n, seed=5), np.int64)
+    star = np.stack(
+        [np.zeros(100, np.int64),
+         rng.choice(np.arange(1, n), 100, replace=False)], axis=1
+    )
+    edges = np.concatenate([base.reshape(-1, 2), star])
+    g = ShardedGraph.build(n, edges, make_1d_mesh(8), layout=layout)
+    for s, d in [(0, n - 1), (3, n // 2), (7, 7)]:
+        want = solve_serial(n, edges, s, d)
+        got = solve_sharded_graph(g, s, d, mode=mode)
+        assert got.found == want.found, (s, d)
+        if want.found:
+            assert got.hops == want.hops, (s, d)
+            got.validate_path(n, edges, s, d)
