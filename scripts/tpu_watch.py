@@ -1,0 +1,359 @@
+"""Round-long tunnel watcher: fire the TPU measurements the moment the
+chip answers.
+
+The round-3 postmortem was unambiguous: every on-chip item was scripted
+and ready, but the tunnel was down whenever someone happened to try it by
+hand, so the round produced zero real-TPU evidence (VERDICT.md r3 missing
+#1). This watcher closes that loop structurally. It runs for the whole
+round as a detached background process:
+
+1. probe the tunneled accelerator in a bounded subprocess, reusing
+   ``bench.py``'s probe helpers (the read-a-value contract — on the lazy
+   tunneled runtime only a readback proves dispatch works);
+2. the moment a probe succeeds, run the measurement steps in priority
+   order, each in its own bounded subprocess:
+
+   - ``session``  — ``scripts/tpu_session.py --items pallas mesh1 batch
+     levels`` → ``TPU_SESSION.jsonl`` (compile truth for the Mosaic
+     kernel, 1-device-mesh collectives, batch win regime, per-level
+     dispatch/device decomposition);
+   - ``bench``    — root ``bench.py`` → refreshed ``bench_last_tpu.json``
+     and headline vs the reference baseline;
+   - ``scale24`` / ``scale25`` — ``scripts/run_scale.py`` dense rows at
+     16.8M/33.5M vertices, replacing round 2's ``ok=False``
+     ``tpu-single-chip-exceeded`` row;
+
+3. a step "done" is judged by its ARTIFACT, not its exit code: every
+   measurement script here degrades to the CPU platform rather than
+   crash when the tunnel drops mid-run (that is their own documented
+   contract), so rc==0 proves nothing about on-chip evidence. The
+   session items must have a clean non-cpu record in
+   ``TPU_SESSION.jsonl``, bench must have refreshed
+   ``bench_last_tpu.json``, and the scale steps must have an ok dense
+   row at their scale on a non-cpu platform in ``SCALE_RESULTS.csv``;
+4. a step that fails while the tunnel is still up counts toward its
+   deterministic-attempt cap; a step that fails and the immediate
+   re-probe finds the tunnel dead is refunded (it died of the drop, not
+   of its own bug) and retried on the next tunnel-up, bounded by a
+   separate transient cap so a crash that takes the tunnel down with it
+   cannot spin forever. The four session items are separate steps, so
+   one deterministically-failing item cannot force re-measuring the
+   other three.
+
+State lives in ``TPU_WATCH_STATUS.json`` at the repo root (committed at
+round end as evidence either way); the chatty log goes to
+``/tmp/tpu_watch.log``. The watcher never touches git — the builder
+commits artifacts when they appear.
+
+Usage: python scripts/tpu_watch.py [--max-hours 11] [--poll-s 120]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import bench as _bench  # probe contract lives in one place: bench.py
+
+STATUS = os.path.join(REPO, "TPU_WATCH_STATUS.json")
+LOG = "/tmp/tpu_watch.log"
+
+PY = sys.executable
+
+# refunded (tunnel-drop) failures per step before giving up anyway — a
+# step whose crash reliably wedges the tunnel must not retry forever
+TRANSIENT_CAP = 8
+
+# the watcher's own start: "bench refreshed" means refreshed during THIS
+# watcher's life, so a stale round-2 bench_last_tpu.json cannot satisfy it
+WATCH_START = time.time()
+
+
+def session_item_ok(item: str) -> str | None:
+    """A clean, non-cpu TPU_SESSION.jsonl record for ``item`` (any time —
+    an item measured on-chip earlier in the round stays measured)."""
+    path = os.path.join(REPO, "TPU_SESSION.jsonl")
+    try:
+        lines = open(path).read().splitlines()
+    except OSError:
+        return "no TPU_SESSION.jsonl yet"
+    for line in reversed(lines):
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if rec.get("item") != item or "error" in rec:
+            continue
+        if rec.get("platform") in (None, "", "cpu"):
+            continue
+        return None
+    return f"no clean non-cpu '{item}' record in TPU_SESSION.jsonl"
+
+
+def bench_ok() -> str | None:
+    p = os.path.join(REPO, "bench_last_tpu.json")
+    try:
+        if os.path.getmtime(p) < WATCH_START:
+            return "bench_last_tpu.json not refreshed (degraded/CPU run?)"
+        with open(p) as f:
+            line = json.load(f).get("line", {})
+    except (OSError, ValueError) as e:
+        return f"bench_last_tpu.json unreadable: {e}"
+    # bench persists the file whenever its PROBE saw the accelerator,
+    # even if the tunnel then dropped and every device config failed —
+    # require an actual device measurement in the artifact
+    if line.get("platform") in (None, "", "cpu"):
+        return "bench artifact has cpu platform"
+    if not isinstance(line.get("device_best_s"), (int, float)):
+        return "bench artifact has no device measurement (all configs failed?)"
+    return None
+
+
+def scale_ok(scale: int) -> str | None:
+    import csv
+
+    try:
+        with open(os.path.join(REPO, "SCALE_RESULTS.csv")) as f:
+            rows = list(csv.DictReader(f))
+    except OSError:
+        return "no SCALE_RESULTS.csv"
+    for r in rows:
+        if (r.get("scale") == str(scale)
+                and (r.get("config") or "").startswith("dense")
+                and (r.get("ok") or "").lower() in ("true", "1")
+                and r.get("platform") not in (None, "", "cpu")):
+            return None
+    return f"no ok dense non-cpu row at scale {scale} in SCALE_RESULTS.csv"
+
+
+def _session_argv(item: str) -> list[str]:
+    return [PY, os.path.join(REPO, "scripts", "tpu_session.py"),
+            "--items", item]
+
+
+def _scale_argv(scale: int) -> list[str]:
+    return [PY, os.path.join(REPO, "scripts", "run_scale.py"),
+            "--scales", str(scale), "--configs", "dense", "--repeats", "3",
+            "--dense-timeout", "2400"]
+
+
+# (name, argv, timeout_s, max_deterministic_attempts, artifact_check)
+# priority order: the Mosaic compile question first, then the perf
+# decomposition, the batch win regime, the mesh programs, the headline
+# bench, then the scale rows
+STEPS = [
+    ("session_pallas", _session_argv("pallas"), 1500, 3,
+     lambda: session_item_ok("pallas")),
+    ("session_levels", _session_argv("levels"), 1200, 3,
+     lambda: session_item_ok("levels")),
+    ("session_batch", _session_argv("batch"), 1800, 3,
+     lambda: session_item_ok("batch")),
+    ("session_mesh1", _session_argv("mesh1"), 1200, 3,
+     lambda: session_item_ok("mesh1")),
+    ("bench", [PY, os.path.join(REPO, "bench.py")], 2700, 3, bench_ok),
+    # watchdog must cover RMAT gen + CSR + serial oracle (~20-25 min at
+    # scale 25) ON TOP of the --dense-timeout 2400 the script is given
+    ("scale24", _scale_argv(24), 5400, 2, lambda: scale_ok(24)),
+    ("scale25", _scale_argv(25), 7200, 2, lambda: scale_ok(25)),
+]
+
+
+def log(msg: str) -> None:
+    line = f"{time.strftime('%Y-%m-%dT%H:%M:%S')} {msg}"
+    with open(LOG, "a") as f:
+        f.write(line + "\n")
+    print(line, flush=True)
+
+
+def load_status() -> dict:
+    try:
+        with open(STATUS) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {"steps": {}, "probes": {"ok": 0, "fail": 0}}
+
+
+def save_status(st: dict) -> None:
+    tmp = STATUS + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(st, f, indent=1, sort_keys=True)
+    os.replace(tmp, STATUS)
+
+
+def probe(st: dict) -> str | None:
+    """Bounded accelerator probe via bench.py's helpers. Returns the
+    platform name or None; records the outcome (incl. the failure
+    diagnostic) in the status file either way."""
+    plat, why = _bench._finish_probe(
+        _bench._start_probe(), _bench.PROBE_TIMEOUT_S
+    )
+    now = time.strftime("%Y-%m-%dT%H:%M:%S")
+    if plat is None:
+        st["probes"]["fail"] += 1
+        st["last_probe"] = {"ok": False, "at": now,
+                            "why": (why or "")[-300:]}
+    else:
+        st["probes"]["ok"] += 1
+        st["last_probe"] = {"ok": True, "platform": plat, "at": now}
+    save_status(st)
+    return plat
+
+
+def _step_rec(st: dict, name: str) -> dict:
+    return st["steps"].setdefault(
+        name, {"attempts": 0, "transient": 0, "done": False})
+
+
+def step_pending(st: dict, name: str, cap: int, check) -> bool:
+    rec = st["steps"].get(name, {})
+    if rec.get("done"):
+        return False
+    if check() is None:
+        # the artifact already exists (e.g. a previous watcher run or a
+        # manual session landed it) — record and skip
+        rec = _step_rec(st, name)
+        rec["done"] = True
+        rec["via"] = "artifact already present"
+        save_status(st)
+        return False
+    return (rec.get("attempts", 0) < cap
+            and rec.get("transient", 0) < TRANSIENT_CAP)
+
+
+def run_step(name: str, argv: list[str], timeout_s: int, st: dict,
+             check) -> bool:
+    rec = _step_rec(st, name)
+    rec["attempts"] += 1
+    rec["started"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+    save_status(st)
+    log(f"step {name}: attempt {rec['attempts']} starting: {' '.join(argv)}")
+    t0 = time.time()
+    try:
+        # own session: the measurement scripts spawn their own jax
+        # subprocesses, and a watchdog kill must take the WHOLE group or
+        # an orphaned grandchild keeps the chip busy into the next step
+        p = subprocess.Popen(
+            argv, cwd=REPO, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True, errors="replace",
+            start_new_session=True,
+        )
+        try:
+            out, _ = p.communicate(timeout=timeout_s)
+            rc = p.returncode
+        except subprocess.TimeoutExpired:
+            # SIGINT first: KeyboardInterrupt lets the scripts' finally
+            # blocks flush partial artifacts (run_scale appends completed
+            # rows to SCALE_RESULTS.csv on the way out); SIGKILL the
+            # group only if that grace period expires
+            try:
+                os.killpg(p.pid, signal.SIGINT)
+            except ProcessLookupError:
+                pass
+            try:
+                out, _ = p.communicate(timeout=90)
+            except subprocess.TimeoutExpired:
+                try:
+                    os.killpg(p.pid, signal.SIGKILL)
+                except ProcessLookupError:
+                    pass
+                out, _ = p.communicate()
+            rc = -9
+            out = (out or "") + f"\n[watchdog timeout after {timeout_s}s;" \
+                                " process group interrupted then killed]"
+    except OSError as e:
+        rc, out = -1, str(e)
+    rec["elapsed_s"] = round(time.time() - t0, 1)
+    rec["rc"] = rc
+    rec["tail"] = (out or "")[-2000:]
+    # the artifact is the truth: every step's script degrades to the CPU
+    # platform (rc==0, no on-chip evidence) when the tunnel drops
+    # mid-run, and conversely a nonzero rc with a clean artifact (e.g. a
+    # later session item failing) is still a success for THIS step
+    verify_err = check()
+    rec["done"] = verify_err is None
+    if verify_err is not None:
+        rec["verify_error"] = verify_err
+    else:
+        rec.pop("verify_error", None)
+    save_status(st)
+    log(f"step {name}: rc={rc} artifact={'ok' if rec['done'] else verify_err}"
+        f" in {rec['elapsed_s']}s")
+    return rec["done"]
+
+
+def refund_attempt(st: dict, name: str) -> None:
+    """The step died WITH the tunnel — charge it to the drop, not the
+    step's deterministic cap (bounded by TRANSIENT_CAP)."""
+    rec = _step_rec(st, name)
+    rec["attempts"] = max(0, rec["attempts"] - 1)
+    rec["transient"] += 1
+    save_status(st)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--max-hours", type=float, default=11.0)
+    ap.add_argument("--poll-s", type=float, default=120.0)
+    args = ap.parse_args(argv)
+
+    deadline = time.time() + args.max_hours * 3600
+    st = load_status()
+    log(f"watcher up: pid={os.getpid()} deadline in {args.max_hours}h")
+    while time.time() < deadline:
+        pending = [s for s in STEPS if step_pending(st, s[0], s[3], s[4])]
+        if not pending:
+            log("all steps done (or attempt-capped); watcher exiting")
+            break
+        plat = probe(st)
+        if plat is None:
+            log(f"probe: tunnel down ({st['probes']['fail']} fails so far)")
+            time.sleep(args.poll_s)
+            continue
+        log(f"probe: tunnel UP ({plat}); running {len(pending)} steps")
+        dropped = False
+        for idx, (name, step_argv, timeout_s, _cap, check) in enumerate(
+                pending):
+            # never let a step's watchdog carry the watcher much past the
+            # deadline: cap the timeout by the time remaining, and don't
+            # bother starting a step with <5 min left
+            remaining = deadline - time.time()
+            if remaining < 300:
+                break
+            ok = run_step(name, step_argv,
+                          min(timeout_s, int(remaining) + 60), st, check)
+            last = idx == len(pending) - 1
+            if ok:
+                # cheap-ish re-probe between steps only (never after the
+                # last): a dead tunnel must not burn hours of watchdogs
+                if not last and probe(st) is None:
+                    log("tunnel dropped mid-pass; back to polling")
+                    dropped = True
+                    break
+                continue
+            # failed step: one probe both classifies the failure
+            # (transient drop vs deterministic crash) and serves as the
+            # between-step check
+            if probe(st) is None:
+                refund_attempt(st, name)
+                log(f"step {name}: failure coincides with tunnel drop; "
+                    "attempt refunded, back to polling")
+                dropped = True
+                break
+            log(f"step {name}: failed with tunnel still up "
+                "(deterministic attempt recorded)")
+        if dropped:
+            time.sleep(args.poll_s)
+    log("watcher done")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
