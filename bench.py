@@ -60,6 +60,7 @@ HOST_BACKENDS = ["native", "serial"]  # the framework's latency runtimes
 SWEEP = [  # device configs: (mode, layout)
     ("sync", "ell"),
     ("pallas", "ell"),  # fused Pallas pull kernel (falls back if Mosaic rejects)
+    ("fused", "ell"),  # whole-level kernel: 1 op group/round (falls back too)
     ("beamer", "ell"),
     ("sync", "tiered"),
     ("beamer", "tiered"),

@@ -283,12 +283,14 @@ def main(argv=None):
     ap.add_argument(
         "--mode",
         default="sync",
-        choices=["sync", "alt", "beamer", "beamer_alt", "pallas", "pallas_alt"],
+        choices=["sync", "alt", "beamer", "beamer_alt", "pallas",
+                 "pallas_alt", "fused"],
         help="device-kernel schedule: sync = both sides per round (fewest "
         "rounds), alt = smaller-frontier-first alternation (fewest edge "
         "scans); beamer variants add push/pull direction optimization; "
         "pallas variants use the fused Pallas pull kernel for the base "
-        "table, hub tiers as XLA ops (dense backend)",
+        "table, hub tiers as XLA ops (dense backend); fused runs the whole "
+        "lock-step level as one kernel (dense backend, plain ELL)",
     )
     ap.add_argument(
         "--layout",
@@ -319,6 +321,11 @@ def main(argv=None):
     ):
         ap.error("--mode pallas/pallas_alt requires --backends dense (the "
                  "sharded backends have no pallas path)")
+    if args.mode == "fused" and any(
+        b not in ("dense", "serial", "native") for b in backends
+    ):
+        ap.error("--mode fused requires --backends dense (the whole-level "
+                 "kernel is single-chip only)")
     if args.mode not in ("sync", "alt") and "sharded2d" in backends:
         ap.error("--backends sharded2d supports --mode sync/alt only")
     if args.layout != "ell" and "sharded2d" in backends:

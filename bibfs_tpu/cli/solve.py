@@ -72,12 +72,15 @@ def main(argv=None):
     ap.add_argument(
         "--mode",
         default=None,
-        choices=["sync", "alt", "beamer", "beamer_alt", "pallas", "pallas_alt"],
+        choices=["sync", "alt", "beamer", "beamer_alt", "pallas",
+                 "pallas_alt", "fused"],
         help="device-kernel schedule for the device backends (default "
         "sync): sync = both sides per round, alt = smaller-frontier-first "
         "alternation; beamer/beamer_alt add push/pull direction "
         "optimization (sparse frontiers go through a scatter push path "
-        "instead of the full-table pull gather); pallas/pallas_alt run the "
+        "instead of the full-table pull gather); fused runs the whole "
+        "lock-step level as ONE kernel (dense backend, plain ELL); "
+        "pallas/pallas_alt run the "
         "base-table pull as the fused Pallas TPU kernel, hub tiers as XLA "
         "ops (dense backend; interpreted off-TPU). With --resume, omitting "
         "--mode keeps the snapshot's recorded schedule",
@@ -154,6 +157,9 @@ def main(argv=None):
     if mode.startswith("pallas") and args.backend not in ("dense", "sharded"):
         ap.error("--mode pallas/pallas_alt is only supported by the dense "
                  "and sharded backends")
+    if mode == "fused" and args.backend != "dense":
+        ap.error("--mode fused (whole-level kernel) is only supported by "
+                 "the dense backend")
     if args.pairs is not None:
         if args.backend not in ("dense", "native", "sharded", "sharded2d"):
             ap.error("--pairs batch mode is supported by --backend dense/"

@@ -1,0 +1,344 @@
+"""Whole-level Pallas kernel: one ``pallas_call`` per lock-step round.
+
+Round 3's dual kernel (:mod:`bibfs_tpu.ops.pallas_expand`) fused the
+expansion gather, but a level still ran ~10 XLA op groups around it:
+frontier bit-packing, visited padding, parent/dist selects, two counts,
+two max-degrees, two degree-sums, and the meet vote. PERF_NOTES §2's own
+measurement says the tunneled backend charges a fixed ~2 ms *per op
+group* inside the search loop — op-group count, not FLOPs, is the
+per-level cost on the bench path. This module is the VERDICT r3 item-2
+answer: the ENTIRE dual level — both sides' expansion, parent claim,
+distance stamp, re-pack of the next frontiers, and every per-level
+reduction (new-frontier counts, max degrees, degree sums for the TEPS
+carry, and the fused meet vote of ``check_intersect``,
+v3/bibfs_cuda_only.cu:45-62) — is one kernel; the while_loop body around
+it is the kernel call plus one tiny scalar fixup group.
+
+State representation (the reason this fuses)
+--------------------------------------------
+The frontier never exists as a bool vector between levels: it stays
+BIT-PACKED across iterations, in a layout chosen so the kernel can both
+*read* it (chunked lane-wise ``take_along_axis`` — the only vector
+gather Mosaic lowers, see pallas_expand's module docstring) and *write*
+it (static lane slices + shifts — no in-kernel reshape, which Mosaic
+would reject):
+
+    vertex v  ->  word (v >> 12) * 128 + (v & 127),  bit (v >> 7) & 31
+
+i.e. within each 4096-vertex tile, lane ``l`` of the 128-word row packs
+vertices ``l, l+128, ..., l+31*128``. Packing a tile's new frontier is
+then 32 static 128-lane slices shifted into one ``(1, 128)`` word row —
+the natural (sublane, lane) access pattern. ``dist``/``par`` ride the
+loop carry as ``[1, n_rows_p]`` rows; the level number enters as a
+``(1, 1)`` block broadcast by ``where``.
+
+Per-level reductions accumulate across the sequential TPU grid into
+``(1, 1)`` outputs (initialized at ``program_id == 0``): counts, max
+degree (Beamer telemetry parity), the NEXT round's edge-scan degree sum,
+and the meet vote's ``(min dist_s+dist_t, argmin)`` pair — so the
+``while_loop`` condition reads kernel outputs directly.
+
+Geometry: ``n_rows_p`` padded to the 4096-vertex tile; the packed
+frontier is ``[chunks, 4096]`` words (one chunk = 131072 vertices, same
+``MAX_CHUNKS = 64`` bound as pallas_expand — past ~8.4M vertices the
+dense solver degrades to the round-3 kernel). The table sentinel id is
+``chunks * 131072``, whose word index lands outside every chunk window,
+so sentinel slots read frontier bit 0 without touching the (possibly
+garbage) padded word tail.
+
+Plain ELL only: hub tiers would reintroduce per-level XLA op groups, so
+the dense solver routes tiered layouts to the round-3 kernel instead
+(`solvers/dense._build_kernel`).
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from bibfs_tpu.ops.pallas_expand import (  # shared table rules
+    _slot_pad,
+    sentinel_transposed_table,
+)
+
+TILE = 4096  # vertices per grid step; also packed words per gather row
+WPT = TILE // 32  # packed words per tile (128 = one lane row)
+CHUNK_VERTS = TILE * 32  # vertices covered by one packed chunk (131072)
+MAX_CHUNKS = 64  # same static-unroll bound as pallas_expand
+
+INF32 = 1 << 30
+
+
+def pad_rows(n: int) -> int:
+    """Vertex-dimension padding: whole 4096-vertex tiles."""
+    return -(-n // TILE) * TILE
+
+
+def fused_geometry(n_rows_p: int) -> tuple[int, int]:
+    """``(chunks, sentinel_id)`` for a padded row count."""
+    chunks = -(-(n_rows_p // 32) // TILE)
+    return chunks, chunks * CHUNK_VERTS
+
+
+def fused_fits(n_rows: int) -> bool:
+    """Whether the fused level's static chunk loop stays within
+    MAX_CHUNKS (~8.4M vertices). Callers also require a tier-free
+    (plain-ELL) layout — see module docstring."""
+    return fused_geometry(pad_rows(n_rows))[0] <= MAX_CHUNKS
+
+
+def prepare_fused_tables(nbr: jnp.ndarray, deg: jnp.ndarray) -> tuple:
+    """Transposed sentinel-padded table + padded degree row for the fused
+    kernel: ``(nbr_t int32[Wp, n_rows_p], deg2 int32[1, n_rows_p])``.
+    Jittable, loop-constant — the solver builds it once per solve,
+    outside the while_loop."""
+    n_rows, width = nbr.shape
+    n_rows_p = pad_rows(n_rows)
+    _chunks, sent = fused_geometry(n_rows_p)
+    nbr_t = sentinel_transposed_table(
+        nbr, deg, n_rows_p, sent, _slot_pad(width)
+    )
+    deg2 = jnp.pad(deg.astype(jnp.int32), (0, n_rows_p - n_rows)).reshape(
+        1, n_rows_p
+    )
+    return nbr_t, deg2
+
+
+def pack_frontier_fused(fr: jnp.ndarray, n_rows_p: int) -> jnp.ndarray:
+    """bool[n] -> packed int32[chunks, TILE] in the fused bit layout
+    (module docstring). XLA-side; runs once at solve init — the kernel
+    itself re-packs between levels."""
+    chunks, _sent = fused_geometry(n_rows_p)
+    tiles = n_rows_p // TILE
+    bits = jnp.pad(fr.astype(jnp.uint32), (0, n_rows_p - fr.shape[0]))
+    # vertex v = tile*4096 + b*128 + l  ->  fr3[tile, b, l]
+    fr3 = bits.reshape(tiles, 32, WPT)
+    words = jnp.sum(
+        fr3 << jnp.arange(32, dtype=jnp.uint32)[None, :, None],
+        axis=1,
+        dtype=jnp.uint32,
+    )  # [tiles, WPT]
+    flat = words.reshape(-1)  # [n_rows_p // 32]
+    flat = jnp.pad(flat, (0, chunks * TILE - flat.shape[0]))
+    return jax.lax.bitcast_convert_type(flat, jnp.int32).reshape(chunks, TILE)
+
+
+def _word_bit(nbr):
+    """Packed word/bit coordinates of neighbor ids (fused layout)."""
+    w = jax.lax.shift_left(
+        jax.lax.shift_right_logical(nbr, 12), 7
+    ) + (nbr & (WPT - 1))
+    b = jax.lax.shift_right_logical(nbr, 7) & 31
+    return w, b
+
+
+def _hits_from(fw_ref, word, bit_ix, chunks: int):
+    """Chunked arbitrary gather of packed frontier bits (same scheme as
+    pallas_expand._hits_for, in the fused word layout)."""
+    hit = jnp.zeros(word.shape, jnp.int32)
+    for k in range(chunks):  # static unroll, bounded by MAX_CHUNKS
+        local = word - k * TILE
+        inb = (local >= 0) & (local < TILE)
+        lidx = jnp.clip(local, 0, TILE - 1)
+        tbl = jnp.broadcast_to(fw_ref[k : k + 1, :], word.shape)
+        g = jnp.take_along_axis(tbl, lidx, axis=1, mode="promise_in_bounds")
+        b = jax.lax.shift_right_logical(g, bit_ix) & 1
+        hit = hit | jnp.where(inb, b, 0)
+    return hit
+
+
+def _pack_tile(nf_i32):
+    """int32[1, TILE] 0/1 -> packed int32[1, WPT]: 32 static lane slices
+    shifted into one word row (bit b of lane l = vertex b*128 + l)."""
+    acc = jnp.zeros((1, WPT), jnp.int32)
+    for b in range(32):
+        acc = acc | jax.lax.shift_left(
+            nf_i32[:, b * WPT : (b + 1) * WPT], b
+        )
+    return acc
+
+
+def _side(nbr, hit, dist, par, lvl_blk):
+    """One side's per-tile state update. Returns
+    ``(nf int32[1,Tc], dist_new, par_new)``."""
+    wp = nbr.shape[0]
+    vis = (dist < INF32).astype(jnp.int32)
+    slot = jax.lax.broadcasted_iota(jnp.int32, nbr.shape, 0)
+    m = jnp.max(jnp.where(hit > 0, wp - slot, 0), axis=0, keepdims=True)
+    j_star = jnp.clip(wp - m, 0, wp - 1)
+    psel = jnp.take_along_axis(
+        nbr, jnp.broadcast_to(j_star, nbr.shape), axis=0,
+        mode="promise_in_bounds",
+    )
+    pcand = jnp.max(psel, axis=0, keepdims=True)
+    nf = jnp.where(vis > 0, 0, (m > 0).astype(jnp.int32))
+    dist_new = jnp.where(nf > 0, lvl_blk, dist)
+    par_new = jnp.where(nf > 0, pcand, par)
+    return nf, dist_new, par_new
+
+
+def _fused_kernel(
+    chunks: int,
+    # inputs
+    fws_ref, fwt_ref, nbr_ref, deg_ref,
+    dists_ref, distt_ref, pars_ref, part_ref, lvls_ref, lvlt_ref,
+    # outputs
+    fwsn_ref, fwtn_ref, distsn_ref, disttn_ref, parsn_ref, partn_ref,
+    cnts_ref, cntt_ref, mds_ref, mdt_ref, dss_ref, dst_ref,
+    mval_ref, midx_ref,
+):
+    i = pl.program_id(0)
+    nbr = nbr_ref[...]
+    word, bit_ix = _word_bit(nbr)
+    deg = deg_ref[...]
+
+    nf_s, dist_s, par_s = _side(
+        nbr, _hits_from(fws_ref, word, bit_ix, chunks),
+        dists_ref[...], pars_ref[...], lvls_ref[...],
+    )
+    nf_t, dist_t, par_t = _side(
+        nbr, _hits_from(fwt_ref, word, bit_ix, chunks),
+        distt_ref[...], part_ref[...], lvlt_ref[...],
+    )
+    distsn_ref[...] = dist_s
+    disttn_ref[...] = dist_t
+    parsn_ref[...] = par_s
+    partn_ref[...] = par_t
+    fwsn_ref[...] = _pack_tile(nf_s)
+    fwtn_ref[...] = _pack_tile(nf_t)
+
+    # per-tile reductions -> (1,1) accumulators (TPU grid is sequential)
+    cnt_s = jnp.sum(nf_s, axis=1, keepdims=True)
+    cnt_t = jnp.sum(nf_t, axis=1, keepdims=True)
+    md_s = jnp.max(jnp.where(nf_s > 0, deg, 0), axis=1, keepdims=True)
+    md_t = jnp.max(jnp.where(nf_t > 0, deg, 0), axis=1, keepdims=True)
+    ds_s = jnp.sum(jnp.where(nf_s > 0, deg, 0), axis=1, keepdims=True)
+    ds_t = jnp.sum(jnp.where(nf_t > 0, deg, 0), axis=1, keepdims=True)
+    # fused meet vote on the POST-update dists (exact: dist values of
+    # visited vertices are final in a level-synchronous BFS)
+    both = (dist_s < INF32) & (dist_t < INF32)
+    sums = jnp.where(both, dist_s + dist_t, INF32)
+    mval = jnp.min(sums, axis=1, keepdims=True)
+    lane = jax.lax.broadcasted_iota(jnp.int32, sums.shape, 1)
+    gid = i * TILE + lane
+    midx = jnp.min(
+        jnp.where(sums == mval, gid, jnp.int32(2147483647)),
+        axis=1, keepdims=True,
+    )
+
+    @pl.when(i == 0)
+    def _init():
+        cnts_ref[...] = jnp.zeros((1, 1), jnp.int32)
+        cntt_ref[...] = jnp.zeros((1, 1), jnp.int32)
+        mds_ref[...] = jnp.zeros((1, 1), jnp.int32)
+        mdt_ref[...] = jnp.zeros((1, 1), jnp.int32)
+        dss_ref[...] = jnp.zeros((1, 1), jnp.int32)
+        dst_ref[...] = jnp.zeros((1, 1), jnp.int32)
+        mval_ref[...] = jnp.full((1, 1), INF32, jnp.int32)
+        midx_ref[...] = jnp.full((1, 1), -1, jnp.int32)
+
+    cnts_ref[...] = cnts_ref[...] + cnt_s
+    cntt_ref[...] = cntt_ref[...] + cnt_t
+    mds_ref[...] = jnp.maximum(mds_ref[...], md_s)
+    mdt_ref[...] = jnp.maximum(mdt_ref[...], md_t)
+    dss_ref[...] = dss_ref[...] + ds_s
+    dst_ref[...] = dst_ref[...] + ds_t
+    # strict < keeps the earliest (lowest-id) argmin across tiles; the
+    # within-tile min-id tie-break above completes jnp.argmin parity
+    take = mval < mval_ref[...]
+    midx_ref[...] = jnp.where(take, midx, midx_ref[...])
+    mval_ref[...] = jnp.where(take, mval, mval_ref[...])
+
+
+@lru_cache(maxsize=None)
+def _get_fused_call(wp: int, n_rows_p: int, interpret: bool):
+    chunks, _sent = fused_geometry(n_rows_p)
+    if chunks > MAX_CHUNKS:
+        raise ValueError(
+            f"fused level kernel: {chunks} chunks at n_rows_p={n_rows_p} "
+            f"exceeds MAX_CHUNKS={MAX_CHUNKS}; use the round-3 kernel path"
+        )
+    grid = n_rows_p // TILE
+    kernel = lambda *refs: _fused_kernel(chunks, *refs)  # noqa: E731
+    fw = pl.BlockSpec((chunks, TILE), lambda i: (0, 0))
+    row = pl.BlockSpec((1, TILE), lambda i: (0, i))
+    wrow = pl.BlockSpec((1, WPT), lambda i: (0, i))
+    one = pl.BlockSpec((1, 1), lambda i: (0, 0))
+    rs = jax.ShapeDtypeStruct((1, n_rows_p), jnp.int32)
+    ws = jax.ShapeDtypeStruct((chunks, TILE), jnp.int32)
+    ss = jax.ShapeDtypeStruct((1, 1), jnp.int32)
+    # the next packed frontiers write only words < n_rows_p/32; the padded
+    # word tail (if any) is never read back — sentinel word indices fall
+    # outside every chunk window by construction (module docstring)
+    wout = pl.BlockSpec(
+        (1, WPT), lambda i: (i // (TILE // WPT), i % (TILE // WPT))
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=(grid,),
+        in_specs=[fw, fw, pl.BlockSpec((wp, TILE), lambda i: (0, i)), row,
+                  row, row, row, row, one, one],
+        out_specs=[wout, wout, row, row, row, row,
+                   one, one, one, one, one, one, one, one],
+        out_shape=[ws, ws, rs, rs, rs, rs, ss, ss, ss, ss, ss, ss, ss, ss],
+        interpret=interpret,
+    )
+
+
+def fused_dual_level(
+    fws, fwt, nbr_t, deg2, dist_s, dist_t, par_s, par_t, lvl_s, lvl_t,
+    *, interpret: bool | None = None,
+):
+    """One whole lock-step level. All state arrays are in kernel layout
+    (packed ``[chunks, TILE]`` frontiers, ``[1, n_rows_p]`` rows); the
+    level numbers are traced int32 scalars. Returns
+    ``(fws', fwt', dist_s', dist_t', par_s', par_t',
+    cnt_s, cnt_t, md_s, md_t, degsum_s, degsum_t, meet_val, meet_idx)``
+    with the eight reductions as int32 scalars."""
+    wp, n_rows_p = nbr_t.shape
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    call = _get_fused_call(wp, n_rows_p, interpret)
+    outs = call(
+        fws, fwt, nbr_t, deg2, dist_s, dist_t, par_s, par_t,
+        jnp.asarray(lvl_s, jnp.int32).reshape(1, 1),
+        jnp.asarray(lvl_t, jnp.int32).reshape(1, 1),
+    )
+    arrays, scalars = outs[:6], outs[6:]
+    return tuple(arrays) + tuple(s[0, 0] for s in scalars)
+
+
+@lru_cache(maxsize=None)
+def _fused_available_padded(wp: int, n_rows_p: int) -> bool:
+    try:
+        import numpy as np
+
+        _chunks, sent = fused_geometry(n_rows_p)
+        nbr_t = jnp.full((wp, n_rows_p), sent, jnp.int32)
+        deg2 = jnp.zeros((1, n_rows_p), jnp.int32)
+        fw = pack_frontier_fused(jnp.zeros(n_rows_p, jnp.bool_), n_rows_p)
+        dist = jnp.full((1, n_rows_p), INF32, jnp.int32)
+        par = jnp.full((1, n_rows_p), -1, jnp.int32)
+        outs = fused_dual_level(
+            fw, fw, nbr_t, deg2, dist, dist, par, par,
+            jnp.int32(1), jnp.int32(1),
+        )
+        # read a VALUE: the lazy tunneled runtime defers execution (and
+        # its errors) until a readback — see solvers/timing.py
+        np.asarray(outs[6]).ravel()
+        return True
+    except Exception:
+        return False
+
+
+def fused_available(n_rows: int = 64, width: int = 2) -> bool:
+    """Compile+run probe of the fused kernel AT THE GIVEN GEOMETRY —
+    callers with a concrete graph pass its (n_rows, max width) so the
+    probe compiles the exact (grid, chunks, Wp) the solve will use
+    (Mosaic failures are frequently shape-dependent, VERDICT r3 weak #1).
+    Memoized on the padded geometry; the compiled kernel lands in jax's
+    executable cache for the solve to reuse."""
+    return _fused_available_padded(_slot_pad(width), pad_rows(n_rows))

@@ -441,8 +441,11 @@ def _get_chunk_step(g, mode: str, chunk: int):
         # Mosaic-availability fallback resolved BEFORE the cache key; the
         # shard body itself degrades oversized graphs via pallas_fits
         from bibfs_tpu.solvers.dense import _resolve_pallas_mode
+        from bibfs_tpu.solvers.sharded import _shard_geom
 
-        mode = _resolve_pallas_mode(mode)
+        if mode == "fused":  # no sharded form; same rule as _compiled_sharded
+            mode = "pallas"
+        mode = _resolve_pallas_mode(mode, _shard_geom(g))
         cap = kernel_cap(mode, g.n_pad)
         kern = _sharded_chunk_kernel(
             g.mesh, VERTEX_AXIS, mode, cap, g.tier_meta, chunk
@@ -451,12 +454,18 @@ def _get_chunk_step(g, mode: str, chunk: int):
     # DeviceGraph
     from bibfs_tpu.solvers.dense import _resolve_pallas_mode
 
-    mode = _resolve_pallas_mode(mode)  # Mosaic-unsupported -> base schedule
+    if mode == "fused":
+        # chunked execution snapshots the standard state dict; the fused
+        # program's packed-frontier carry has no snapshot form, so chunked/
+        # resumed fused solves run the round-3 kernel instead
+        mode = "pallas"
+    # Mosaic-unsupported -> base schedule (probe at the real geometry)
+    mode = _resolve_pallas_mode(mode, (g.n_pad, g.n_pad, g.width))
     aux = g.aux
     if DENSE_MODES[mode][2]:
         from bibfs_tpu.ops.pallas_expand import pallas_fits
 
-        if pallas_fits(g.n_pad):
+        if pallas_fits(g.n_pad, width=g.width):
             # build the kernel table ONCE per drive, device-resident, and
             # pair it with the original tier aux — each chunk dispatch
             # reuses it instead of re-transposing per chunk

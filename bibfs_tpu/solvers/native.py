@@ -185,31 +185,47 @@ def solve_native(n: int, edges: np.ndarray, src: int, dst: int) -> BFSResult:
     return solve_native_graph(NativeGraph.build(n, edges), src, dst)
 
 
-# per-query path capacity in the threaded batch: paths on the graphs this
-# framework targets are diameter-bounded (tens of hops); a longer path is
-# reported hops-only, same as the single-solve path_cap rule
+# default per-query path capacity in the threaded batch, bounded by the
+# graph size (a path can never exceed n+1 vertices, so small graphs get
+# FULL paths, matching the single solve). High-diameter graphs past the
+# default cap report hops-only unless the caller raises ``path_cap``.
 _BATCH_PATH_CAP = 512
 
 
+def _batch_path_cap(g: NativeGraph, path_cap: int | None) -> int:
+    if path_cap is None:
+        return min(g.n + 1, _BATCH_PATH_CAP)
+    if path_cap < 1:
+        raise ValueError(f"path_cap must be >= 1, got {path_cap}")
+    return min(g.n + 1, path_cap)
+
+
 def solve_batch_native_graph(
-    g: NativeGraph, pairs, *, threads: int | None = None
+    g: NativeGraph, pairs, *, threads: int | None = None,
+    path_cap: int | None = None,
 ) -> list[BFSResult]:
     """Solve many (src, dst) queries on one graph via the THREADED native
     batch (`bibfs_solve_batch`): queries stripe over worker threads, each
     with its own epoch-stamped scratch, sharing the read-only CSR — the
     host analog of the dense backend's vmapped batch. Each returned
     result's ``time_s`` is the WHOLE batch wall-clock, matching
-    :func:`bibfs_tpu.solvers.dense.solve_batch_graph`'s contract."""
-    return time_batch_native(g, pairs, repeats=1, threads=threads)[1]
+    :func:`bibfs_tpu.solvers.dense.solve_batch_graph`'s contract.
+    ``path_cap`` raises the per-query path buffer for high-diameter
+    graphs (default ``min(n+1, 512)``); deeper paths report hops-only."""
+    return time_batch_native(
+        g, pairs, repeats=1, threads=threads, path_cap=path_cap
+    )[1]
 
 
-def _run_batch_native(g: NativeGraph, pairs: np.ndarray, threads: int):
+def _run_batch_native(
+    g: NativeGraph, pairs: np.ndarray, threads: int, path_cap: int
+):
     lib = _lib()
     b = pairs.shape[0]
     srcs = np.ascontiguousarray(pairs[:, 0], dtype=np.uint32)
     dsts = np.ascontiguousarray(pairs[:, 1], dtype=np.uint32)
     hops = np.full(b, -1, dtype=np.int32)
-    path_buf = np.empty((b, _BATCH_PATH_CAP), dtype=np.int32)
+    path_buf = np.empty((b, path_cap), dtype=np.int32)
     path_len = np.zeros(b, dtype=np.int32)
     secs = ctypes.c_double()
     edges = np.zeros(b, dtype=np.int64)
@@ -220,7 +236,7 @@ def _run_batch_native(g: NativeGraph, pairs: np.ndarray, threads: int):
             _ptr(g.col_ind, ctypes.c_int32), b,
             _ptr(srcs, ctypes.c_uint32), _ptr(dsts, ctypes.c_uint32),
             threads, _ptr(hops, ctypes.c_int32),
-            _ptr(path_buf, ctypes.c_int32), _BATCH_PATH_CAP,
+            _ptr(path_buf, ctypes.c_int32), path_cap,
             _ptr(path_len, ctypes.c_int32), ctypes.byref(secs),
             _ptr(edges, ctypes.c_int64), _ptr(levels, ctypes.c_int32),
         ),
@@ -243,25 +259,27 @@ def _run_batch_native(g: NativeGraph, pairs: np.ndarray, threads: int):
 
 
 def time_batch_native(
-    g: NativeGraph, pairs, *, repeats: int = 5, threads: int | None = None
+    g: NativeGraph, pairs, *, repeats: int = 5, threads: int | None = None,
+    path_cap: int | None = None,
 ) -> tuple[list[float], list[BFSResult]]:
     """Batch timing protocol for the native backend: ``repeats`` whole-
     batch passes through the threaded C batch, median stamped into every
     result's ``time_s``. ``threads`` defaults to the host's core count
-    (capped at 16)."""
+    (capped at 16); ``path_cap`` as in :func:`solve_batch_native_graph`."""
     if repeats < 1:
         raise ValueError(f"repeats must be >= 1, got {repeats}")
     if threads is None:
         threads = min(os.cpu_count() or 1, 16)
     if threads < 1:
         raise ValueError(f"threads must be >= 1, got {threads}")
+    cap = _batch_path_cap(g, path_cap)
     pairs = np.asarray(pairs, dtype=np.int64).reshape(-1, 2)
     if pairs.size and not ((0 <= pairs).all() and (pairs < g.n).all()):
         raise ValueError(f"src/dst out of range for n={g.n}")
     times = []
     results: list[BFSResult] = []
     for _ in range(repeats):
-        wall, results = _run_batch_native(g, pairs, threads)
+        wall, results = _run_batch_native(g, pairs, threads, cap)
         times.append(wall)
     med = float(np.median(times))
     return times, [dataclasses.replace(r, time_s=med) for r in results]

@@ -109,7 +109,7 @@ def _make_shard_body(
         )
 
         n_glob = n_loc * jax.lax.axis_size(axis)
-        if pallas_fits(n_loc, n_glob):
+        if pallas_fits(n_loc, n_glob, width=width):
             ptables = prepare_pallas_tables(nbr, deg, id_space=n_glob)
         else:  # chunk loop too long: degrade to the XLA pull path
             use_pallas = False
@@ -518,15 +518,21 @@ def _sharded_fn(
 
 
 def _compiled_sharded(
-    mesh, axis: str, mode: str = "sync", push_cap: int = 0, tier_meta: tuple = ()
+    mesh, axis: str, mode: str = "sync", push_cap: int = 0,
+    tier_meta: tuple = (), geom: tuple | None = None,
 ):
     # resolve the Mosaic-availability fallback BEFORE the cache key (same
     # rule as dense._get_kernel): a fallen-back 'pallas' shares the
-    # already-compiled 'sync' program
+    # already-compiled 'sync' program. ``geom`` = the per-shard
+    # (n_loc, id_space, width) so the probe compiles the REAL geometry.
+    # The single-chip fused whole-level kernel has no sharded form: run
+    # the round-3 per-shard kernel (probed at the shard geometry) instead
     from bibfs_tpu.solvers.dense import _resolve_pallas_mode
 
+    if mode == "fused":
+        mode = "pallas"
     return _compiled_sharded_resolved(
-        mesh, axis, _resolve_pallas_mode(mode), push_cap, tier_meta
+        mesh, axis, _resolve_pallas_mode(mode, geom), push_cap, tier_meta
     )
 
 
@@ -538,12 +544,15 @@ def _compiled_sharded_resolved(
 
 
 def _compiled_sharded_batch(
-    mesh, axis: str, mode: str = "sync", push_cap: int = 0, tier_meta: tuple = ()
+    mesh, axis: str, mode: str = "sync", push_cap: int = 0,
+    tier_meta: tuple = (), geom: tuple | None = None,
 ):
     from bibfs_tpu.solvers.dense import _resolve_pallas_mode
 
+    if mode == "fused":  # same rule as _compiled_sharded
+        mode = "pallas"
     return _compiled_sharded_batch_resolved(
-        mesh, axis, _resolve_pallas_mode(mode), push_cap, tier_meta
+        mesh, axis, _resolve_pallas_mode(mode, geom), push_cap, tier_meta
     )
 
 
@@ -638,13 +647,21 @@ class ShardedGraph:
         raise ValueError(f"unknown layout {layout!r} (expected 'ell' or 'tiered')")
 
 
+def _shard_geom(g: "ShardedGraph") -> tuple:
+    """Per-shard (n_loc, id_space, width) — the geometry the pallas probe
+    must compile: LOCAL rows gathering from the GLOBAL frontier."""
+    ndev = int(g.mesh.devices.size)
+    return (g.n_pad // ndev, g.n_pad, g.width)
+
+
 def solve_sharded_graph(
     g: ShardedGraph, src: int, dst: int, *, mode: str = "sync"
 ) -> BFSResult:
     if not (0 <= src < g.n and 0 <= dst < g.n):
         raise ValueError(f"src/dst out of range for n={g.n}")
     fn = _compiled_sharded(
-        g.mesh, VERTEX_AXIS, mode, kernel_cap(mode, g.n_pad), g.tier_meta
+        g.mesh, VERTEX_AXIS, mode, kernel_cap(mode, g.n_pad), g.tier_meta,
+        _shard_geom(g),
     )
     from bibfs_tpu.solvers.timing import force_scalar
 
@@ -665,7 +682,8 @@ def time_search(
     from bibfs_tpu.solvers.timing import force_scalar, timed_repeats
 
     fn = _compiled_sharded(
-        g.mesh, VERTEX_AXIS, mode, kernel_cap(mode, g.n_pad), g.tier_meta
+        g.mesh, VERTEX_AXIS, mode, kernel_cap(mode, g.n_pad), g.tier_meta,
+        _shard_geom(g),
     )
     src_a = _device_scalar(src)
     dst_a = _device_scalar(dst)
@@ -682,7 +700,8 @@ def _batch_dispatch(g: ShardedGraph, pairs, mode: str):
     if pairs.size and not ((0 <= pairs).all() and (pairs < g.n).all()):
         raise ValueError(f"src/dst out of range for n={g.n}")
     kern = _compiled_sharded_batch(
-        g.mesh, VERTEX_AXIS, mode, kernel_cap(mode, g.n_pad), g.tier_meta
+        g.mesh, VERTEX_AXIS, mode, kernel_cap(mode, g.n_pad), g.tier_meta,
+        _shard_geom(g),
     )
     srcs = jnp.asarray(pairs[:, 0], dtype=jnp.int32)
     dsts = jnp.asarray(pairs[:, 1], dtype=jnp.int32)

@@ -117,6 +117,10 @@ if {chunked}:
     # Each chunk's termination-scalar read forces execution, so the wall
     # timing protocol is the same forced-execution one as time_search_only.
     from bibfs_tpu.solvers.checkpoint import solve_checkpointed
+    # untimed warm-up: jit compile of the chunk kernel must not leak into
+    # the timed repeats (the non-chunked branch excludes compile via
+    # time_search_only's warm-up; this keeps the rows comparable)
+    solve_checkpointed(g, {src}, {dst}, chunk=4)
     times = []
     res = None
     for _ in range({repeats}):

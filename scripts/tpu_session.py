@@ -42,7 +42,9 @@ apply_platform_env()
 import jax, jax.numpy as jnp
 out = dict(item="pallas", platform=jax.devices()[0].platform)
 
-from bibfs_tpu.ops.pallas_expand import expand_pull_pallas, pallas_available
+from bibfs_tpu.ops.pallas_expand import (
+    expand_pull_pallas, pallas_available, pallas_available_at,
+)
 out["compiles"] = pallas_available()
 if out["compiles"]:
     from bibfs_tpu.graph.csr import build_ell
@@ -70,12 +72,24 @@ if out["compiles"]:
     edges2 = gnp_random_graph(n2, 2.2 / n2, seed=1)
     want = solve_serial(n2, edges2, 0, n2 - 1)
     g2 = DeviceGraph.build(n2, edges2)
-    for mode in ("sync", "pallas"):
+    # geometry-true probes: the toy pass above does NOT prove the bench
+    # shape compiles (VERDICT r3 weak #1)
+    out["compiles_at_bench_geom"] = pallas_available_at(
+        g2.n_pad, g2.n_pad, g2.width)
+    out["compiles_at_multichunk_geom"] = pallas_available_at(
+        140_000, 140_000, g2.width)
+    from bibfs_tpu.ops.pallas_fused import fused_available
+    out["fused_compiles"] = fused_available(g2.n_pad, g2.width)
+    modes = ["sync", "pallas"] + (["fused"] if out["fused_compiles"] else [])
+    for mode in modes:
         times = time_search_only(g2, 0, n2 - 1, repeats=8, mode=mode)
         out["{{}}_median_s".format(mode)] = float(np.median(times))
     from bibfs_tpu.solvers.dense import solve_dense_graph
     res = solve_dense_graph(g2, 0, n2 - 1, mode="pallas")
     out["pallas_hops_ok"] = bool(res.hops == want.hops)
+    if out["fused_compiles"]:
+        resf = solve_dense_graph(g2, 0, n2 - 1, mode="fused")
+        out["fused_hops_ok"] = bool(resf.hops == want.hops)
 print("RESULT " + json.dumps(out))
 """
 
@@ -195,23 +209,65 @@ out["pallas_compiles"] = len(variants) == 2
 # variant's dispatch_s stays comparable to xla's
 tables = jax.jit(prepare_pallas_tables)(g.nbr, g.deg)
 bytes_per_level = g.n_pad * g.width * 4 + g.n_pad * 13
-for name, use_pallas in variants:
-    walls = {{}}
-    for trips in (4, 64):
-        vals = []
-        for rep in range(6):
-            t0 = time.perf_counter()
-            v = int(run(g.nbr, g.deg, tables, trips, use_pallas))  # forced
-            vals.append(time.perf_counter() - t0)
-        walls[trips] = float(np.median(vals[1:]))
+
+
+def decompose(walls):
     per_level = (walls[64] - walls[4]) / 60.0
-    out[name] = dict(
+    return dict(
         wall_T4_s=walls[4], wall_T64_s=walls[64],
         device_level_s=per_level,
         dispatch_s=walls[4] - 4 * per_level,
         hbm_gbps_per_level=(
             bytes_per_level / per_level / 1e9 if per_level > 0 else None),
     )
+
+
+def protocol(fn):
+    walls = {{}}
+    for trips in (4, 64):
+        vals = []
+        for rep in range(6):
+            t0 = time.perf_counter()
+            fn(trips)  # must force a value read
+            vals.append(time.perf_counter() - t0)
+        walls[trips] = float(np.median(vals[1:]))
+    return decompose(walls)
+
+
+for name, use_pallas in variants:
+    out[name] = protocol(
+        lambda trips: int(run(g.nbr, g.deg, tables, trips, use_pallas)))
+
+# the round-4 whole-level kernel: the same fixed-trip protocol over the
+# fused state (packed frontiers + dist/par rows + (1,1)-accumulated
+# reductions) — the per-level DELTA vs xla/pallas is the measured answer
+# to VERDICT r3 item 2 (op-group count per level)
+from bibfs_tpu.ops.pallas_fused import (
+    INF32, fused_available, fused_dual_level, pack_frontier_fused,
+    prepare_fused_tables,
+)
+out["fused_compiles"] = fused_available(g.n_pad, g.width)
+if out["fused_compiles"]:
+    nbr_t, deg2 = jax.jit(prepare_fused_tables)(g.nbr, g.deg)
+    n_rows_p = nbr_t.shape[1]
+
+    @partial(jax.jit, static_argnames=("trips",))
+    def run_fused(nbr_t, deg2, trips):
+        fr = jnp.zeros(g.n_pad, jnp.bool_).at[0].set(True)
+        fw = pack_frontier_fused(fr, n_rows_p)
+        dist = jnp.full((1, n_rows_p), INF32, jnp.int32).at[0, 0].set(0)
+        par = jnp.full((1, n_rows_p), -1, jnp.int32)
+        st = (fw, fw, dist, dist, par, par)
+        def body(i, st):
+            outs = fused_dual_level(
+                st[0], st[1], nbr_t, deg2, st[2], st[3], st[4], st[5],
+                i + 1, i + 1)
+            return outs[:6]
+        st = jax.lax.fori_loop(0, trips, body, st)
+        return st[2].sum() + st[3].sum()
+
+    out["fused"] = protocol(
+        lambda trips: int(run_fused(nbr_t, deg2, trips)))
 print("RESULT " + json.dumps(out))
 """
 

@@ -1,0 +1,338 @@
+"""Whole-level fused kernel (ops/pallas_fused): level parity with the XLA
+dual path, reduction/meet-vote parity, packed-layout round-trips, and
+full-solver oracle agreement (interpret mode on the CPU test mesh — the
+same kernel body Mosaic compiles on TPU)."""
+
+import numpy as np
+import pytest
+
+from tests.conftest import random_graph_cases
+
+INF32 = 1 << 30
+
+
+def _setup_level(n, avg, seed, fr_density=0.05):
+    """Random mid-search state over a G(n, avg/n) graph in both the XLA
+    and fused layouts. Returns everything both paths need."""
+    import jax.numpy as jnp
+
+    from bibfs_tpu.graph.csr import build_ell
+    from bibfs_tpu.graph.generate import gnp_random_graph
+    from bibfs_tpu.ops.pallas_fused import (
+        pack_frontier_fused,
+        prepare_fused_tables,
+    )
+
+    rng = np.random.default_rng(seed)
+    edges = gnp_random_graph(n, avg / n, seed=seed)
+    g = build_ell(n, edges)
+    n_pad = g.n_pad
+    nbr = jnp.asarray(g.nbr)
+    deg = jnp.asarray(g.deg)
+    fr_s = np.zeros(n_pad, bool)
+    fr_s[rng.integers(0, n, max(1, int(n * fr_density)))] = True
+    fr_t = np.zeros(n_pad, bool)
+    fr_t[rng.integers(0, n, max(1, int(n * fr_density)))] = True
+    dist_s = np.where(
+        rng.random(n_pad) < 0.1, rng.integers(0, 5, n_pad), INF32
+    ).astype(np.int32)
+    dist_t = np.where(
+        rng.random(n_pad) < 0.1, rng.integers(0, 5, n_pad), INF32
+    ).astype(np.int32)
+    dist_s[fr_s] = 3  # frontier vertices are visited by definition
+    dist_t[fr_t] = 2
+    dist_s[n:] = INF32
+    dist_t[n:] = INF32
+    par0 = np.full(n_pad, -1, np.int32)
+
+    nbr_t, deg2 = prepare_fused_tables(nbr, deg)
+    n_rows_p = nbr_t.shape[1]
+
+    def lift(a, fill):
+        return jnp.asarray(
+            np.pad(a, (0, n_rows_p - n_pad), constant_values=fill)
+        ).reshape(1, n_rows_p)
+
+    fused_in = dict(
+        fws=pack_frontier_fused(jnp.asarray(fr_s), n_rows_p),
+        fwt=pack_frontier_fused(jnp.asarray(fr_t), n_rows_p),
+        nbr_t=nbr_t,
+        deg2=deg2,
+        dist_s=lift(dist_s, INF32),
+        dist_t=lift(dist_t, INF32),
+        par_s=lift(par0, -1),
+        par_t=lift(par0, -1),
+    )
+    xla_in = dict(
+        fr_s=jnp.asarray(fr_s), fr_t=jnp.asarray(fr_t),
+        par=jnp.asarray(par0),
+        dist_s=jnp.asarray(dist_s), dist_t=jnp.asarray(dist_t),
+        nbr=nbr, deg=deg,
+    )
+    return g, n_pad, n_rows_p, fused_in, xla_in, dist_s, dist_t
+
+
+def _unpack(fwp, n_rows_p, n_pad):
+    """Invert the fused bit layout: word (v>>12)*128 + (v&127),
+    bit (v>>7)&31."""
+    w = np.asarray(fwp).view(np.uint32).reshape(-1)[: n_rows_p // 32]
+    w3 = w.reshape(n_rows_p // 4096, 128)
+    bits = (w3[:, None, :] >> np.arange(32, dtype=np.uint32)[None, :, None]) & 1
+    return bits.reshape(-1)[:n_pad].astype(bool)
+
+
+@pytest.mark.parametrize(
+    "n,avg,seed",
+    [(1_000, 2.2, 0), (4_000, 3.0, 1), (5_000, 1.5, 2), (9_000, 2.5, 3)],
+)
+def test_fused_level_matches_xla_dual(n, avg, seed):
+    """One fused level == the XLA dual level: dist/par/new-frontier,
+    every reduction, the packed next frontiers, and the meet vote."""
+    import jax.numpy as jnp
+
+    from bibfs_tpu.ops.expand import expand_pull_dual_tiered
+    from bibfs_tpu.ops.pallas_fused import fused_dual_level
+
+    g, n_pad, n_rows_p, fi, xi, dist_s_np, dist_t_np = _setup_level(
+        n, avg, seed
+    )
+    nf_s0, par_s0, dist_s0, md_s0, nf_t0, par_t0, dist_t0, md_t0 = [
+        np.asarray(x)
+        for x in expand_pull_dual_tiered(
+            xi["fr_s"], xi["fr_t"], xi["par"], xi["dist_s"], xi["par"],
+            xi["dist_t"], xi["nbr"], xi["deg"], (),
+            jnp.int32(4), jnp.int32(3), inf=INF32,
+        )
+    ]
+    outs = fused_dual_level(
+        fi["fws"], fi["fwt"], fi["nbr_t"], fi["deg2"], fi["dist_s"],
+        fi["dist_t"], fi["par_s"], fi["par_t"], jnp.int32(4), jnp.int32(3),
+    )
+    (fws1, fwt1, dist_s1, dist_t1, par_s1, par_t1,
+     cnt_s, cnt_t, md_s, md_t, ds_s, ds_t, mval, midx) = outs
+    dist_s1 = np.asarray(dist_s1)[0, :n_pad]
+    dist_t1 = np.asarray(dist_t1)[0, :n_pad]
+    par_s1 = np.asarray(par_s1)[0, :n_pad]
+    par_t1 = np.asarray(par_t1)[0, :n_pad]
+    assert (dist_s1 == dist_s0).all()
+    assert (dist_t1 == dist_t0).all()
+    assert (par_s1[nf_s0] == par_s0[nf_s0]).all()
+    assert (par_t1[nf_t0] == par_t0[nf_t0]).all()
+    assert (_unpack(fws1, n_rows_p, n_pad) == nf_s0).all()
+    assert (_unpack(fwt1, n_rows_p, n_pad) == nf_t0).all()
+    deg_np = np.asarray(xi["deg"])
+    assert int(cnt_s) == nf_s0.sum() and int(cnt_t) == nf_t0.sum()
+    assert int(md_s) == md_s0 and int(md_t) == md_t0
+    assert int(ds_s) == np.where(nf_s0, deg_np, 0).sum()
+    assert int(ds_t) == np.where(nf_t0, deg_np, 0).sum()
+    both = (dist_s0 < INF32) & (dist_t0 < INF32)
+    sums = np.where(both, dist_s0.astype(np.int64) + dist_t0, INF32)
+    assert int(mval) == sums.min()
+    if sums.min() < INF32:
+        assert int(midx) == int(sums.argmin())
+
+
+def test_fused_geometry_invariants():
+    from bibfs_tpu.ops.pallas_fused import (
+        CHUNK_VERTS,
+        MAX_CHUNKS,
+        TILE,
+        WPT,
+        fused_fits,
+        fused_geometry,
+        pad_rows,
+    )
+
+    assert TILE == WPT * 32 and CHUNK_VERTS == TILE * 32
+    for n in (1, 100, 4096, 5000, 100_000, 131_072, 1 << 20, 8_300_000):
+        n_rows_p = pad_rows(n)
+        assert n_rows_p >= n and n_rows_p % TILE == 0
+        chunks, sent = fused_geometry(n_rows_p)
+        # every real vertex has a packed word inside some chunk window;
+        # the sentinel's word index falls OUTSIDE every window
+        assert chunks * CHUNK_VERTS >= n_rows_p
+        assert sent == chunks * CHUNK_VERTS
+        sent_word = (sent >> 12) * 128 + (sent & 127)
+        assert sent_word >= chunks * TILE
+    assert fused_fits(8_300_000)
+    assert not fused_fits(MAX_CHUNKS * CHUNK_VERTS + 1)
+
+
+def test_pack_frontier_fused_layout(rng):
+    """pack_frontier_fused implements exactly the documented bit layout."""
+    import jax.numpy as jnp
+
+    from bibfs_tpu.ops.pallas_fused import pack_frontier_fused, pad_rows
+
+    n = 7_000
+    n_rows_p = pad_rows(n)
+    fr = rng.random(n) < 0.3
+    fw = np.asarray(
+        pack_frontier_fused(jnp.asarray(fr), n_rows_p)
+    ).view(np.uint32).reshape(-1)
+    for v in np.flatnonzero(fr)[:200]:
+        w = (v >> 12) * 128 + (v & 127)
+        b = (v >> 7) & 31
+        assert (fw[w] >> b) & 1 == 1
+    assert fw.sum() > 0
+    # total popcount round-trips
+    pop = int(np.unpackbits(fw.view(np.uint8)).sum())
+    assert pop == int(fr.sum())
+
+
+@pytest.mark.parametrize("case", random_graph_cases(10))
+def test_fused_solver_matches_oracle(case):
+    from bibfs_tpu.solvers.dense import DeviceGraph, solve_dense_graph
+    from bibfs_tpu.solvers.serial import solve_serial
+
+    n, edges, src, dst = case
+    want = solve_serial(n, edges, src, dst)
+    g = DeviceGraph.build(n, edges)
+    got = solve_dense_graph(g, src, dst, mode="fused")
+    assert got.found == want.found
+    if want.found:
+        assert got.hops == want.hops
+        assert got.path[0] == src and got.path[-1] == dst
+        es = {tuple(sorted(e)) for e in np.asarray(edges).tolist()}
+        for a, b in zip(got.path, got.path[1:]):
+            assert tuple(sorted((a, b))) in es
+
+
+def test_fused_stats_match_sync():
+    """levels/edges_scanned bookkeeping is identical to the sync schedule
+    (same lock-step algorithm, different fusion)."""
+    from bibfs_tpu.graph.generate import gnp_random_graph
+    from bibfs_tpu.solvers.dense import DeviceGraph, solve_dense_graph
+
+    n = 10_000
+    edges = gnp_random_graph(n, 3.0 / n, seed=1)
+    g = DeviceGraph.build(n, edges)
+    a = solve_dense_graph(g, 0, n - 1, mode="fused")
+    b = solve_dense_graph(g, 0, n - 1, mode="sync")
+    assert (a.found, a.hops, a.levels, a.edges_scanned) == (
+        b.found, b.hops, b.levels, b.edges_scanned
+    )
+
+
+def test_fused_src_eq_dst_and_disconnected():
+    from bibfs_tpu.solvers.dense import DeviceGraph, solve_dense_graph
+
+    e = np.array([[0, 1], [1, 2], [3, 4], [4, 5]], np.int64)
+    g = DeviceGraph.build(6, e)
+    r = solve_dense_graph(g, 2, 2, mode="fused")
+    assert r.found and r.hops == 0 and r.path == [2]
+    r2 = solve_dense_graph(g, 0, 5, mode="fused")
+    assert not r2.found
+    r3 = solve_dense_graph(g, 0, 2, mode="fused")
+    assert r3.found and r3.hops == 2 and r3.path == [0, 1, 2]
+
+
+def test_fused_degrades_on_tiered_layout():
+    """Tiered layouts route to the round-3 pallas program at trace time —
+    mode='fused' still solves correctly on a skewed graph."""
+    from bibfs_tpu.graph.generate import rmat_graph
+    from bibfs_tpu.solvers.dense import DeviceGraph, solve_dense_graph
+    from bibfs_tpu.solvers.serial import solve_serial
+
+    n, edges = rmat_graph(10, edge_factor=4, seed=7)
+    want = solve_serial(n, edges, 0, 5)
+    g = DeviceGraph.build(n, edges, layout="tiered")
+    assert g.tier_meta  # the degrade path is actually exercised
+    got = solve_dense_graph(g, 0, 5, mode="fused")
+    assert got.found == want.found
+    if want.found:
+        assert got.hops == want.hops
+
+
+def test_fused_batch_routes_to_pallas():
+    from bibfs_tpu.graph.generate import gnp_random_graph
+    from bibfs_tpu.solvers.dense import (
+        DeviceGraph,
+        solve_batch_graph,
+        solve_dense_graph,
+    )
+
+    n = 2_000
+    edges = gnp_random_graph(n, 2.5 / n, seed=3)
+    g = DeviceGraph.build(n, edges)
+    pairs = [(0, n - 1), (1, 17), (5, 5)]
+    batch = solve_batch_graph(g, pairs, mode="fused")
+    for (s, d), res in zip(pairs, batch):
+        single = solve_dense_graph(g, s, d, mode="sync")
+        assert res.found == single.found and res.hops == single.hops
+
+
+def test_fused_kernel_lowers_through_mosaic():
+    """Cross-platform TPU export runs the full jaxpr->Mosaic lowering —
+    the stage that rejected the round-2 gather formulation — without a
+    chip. The fused program at the REAL bench geometry (100k vertices)
+    must export with the kernel as a serialized tpu_custom_call, and its
+    while-body must carry only scalar fixup ops around that one call
+    (the measured VERDICT r3 item-2 structure: 29 stablehlo ops + 1
+    kernel call vs sync's 83 array-level ops per round)."""
+    import re
+    from unittest import mock
+
+    import jax
+    import jax.export as jexport
+
+    from bibfs_tpu.graph.generate import gnp_random_graph
+    from bibfs_tpu.solvers.dense import DeviceGraph, _build_kernel
+
+    n = 100_000
+    edges = gnp_random_graph(n, 2.2 / n, seed=1)
+    g = DeviceGraph.build(n, edges)
+    args = (
+        np.asarray(g.nbr), np.asarray(g.deg), (),
+        np.int32(0), np.int32(n - 1),
+    )
+    fn = _build_kernel("fused", 0, g.tier_meta)
+    # the interpret flag resolves from default_backend at trace time;
+    # force the compiled-kernel branch for the TPU export
+    with mock.patch.object(jax, "default_backend", lambda: "tpu"):
+        exp = jexport.export(jax.jit(fn), platforms=("tpu",))(*args)
+    txt = exp.mlir_module()
+    i = txt.find("stablehlo.while")
+    j = txt.find(" do {", i)
+    k = txt.find("\n    }", j)
+    body = txt[j:k]
+    kernel_calls = len(re.findall(r"custom_call @tpu_custom_call", body))
+    ops = len(re.findall(r"stablehlo\.", body))
+    assert kernel_calls == 1
+    # no array-shaped compute left in the level body: everything that is
+    # not the kernel call is (1,1)/scalar bookkeeping
+    assert ops < 40, f"level body grew back to {ops} ops"
+
+
+def test_fused_checkpoint_degrades():
+    """Chunked execution has no fused-state snapshot: mode='fused' solves
+    via the round-3 kernel under the chunk driver, same answer."""
+    from bibfs_tpu.graph.generate import gnp_random_graph
+    from bibfs_tpu.solvers.checkpoint import solve_checkpointed
+    from bibfs_tpu.solvers.dense import DeviceGraph, solve_dense_graph
+
+    n = 3_000
+    edges = gnp_random_graph(n, 2.5 / n, seed=5)
+    g = DeviceGraph.build(n, edges)
+    want = solve_dense_graph(g, 0, n - 1, mode="sync")
+    got = solve_checkpointed(g, 0, n - 1, mode="fused", chunk=4)
+    assert got.found == want.found and got.hops == want.hops
+
+
+def test_fused_sharded_routes_to_pallas():
+    """mode='fused' on the sharded solvers (public API) must run the
+    per-shard round-3 kernel, not leak the single-chip fused flag into
+    the shard body."""
+    from bibfs_tpu.graph.generate import gnp_random_graph
+    from bibfs_tpu.parallel.mesh import make_1d_mesh
+    from bibfs_tpu.solvers.serial import solve_serial
+    from bibfs_tpu.solvers.sharded import ShardedGraph, solve_sharded_graph
+
+    n = 600
+    edges = gnp_random_graph(n, 3.0 / n, seed=4)
+    g = ShardedGraph.build(n, edges, make_1d_mesh(8))
+    want = solve_serial(n, edges, 0, n - 1)
+    got = solve_sharded_graph(g, 0, n - 1, mode="fused")
+    assert got.found == want.found
+    if want.found:
+        assert got.hops == want.hops

@@ -231,3 +231,32 @@ def test_loader_fuzz_no_crashes(tmp_path):
         os.unlink(p)
     # the corpus must exercise both outcomes
     assert loaded > 0 and errored > 0
+
+
+def test_native_batch_deep_path_cap():
+    """ADVICE r3: on a high-diameter graph the default batch path cap
+    reports hops-only where the single solve returns the full path; a
+    caller-raised ``path_cap`` restores full paths — and found/hops never
+    disagree between the two."""
+    import numpy as np
+
+    from bibfs_tpu.solvers.native import (
+        NativeGraph,
+        solve_batch_native_graph,
+        solve_native_graph,
+    )
+
+    n = 700  # a path graph: diameter n-1 = 699 > the 512 default cap
+    edges = np.stack([np.arange(n - 1), np.arange(1, n)], axis=1)
+    g = NativeGraph.build(n, edges)
+    single = solve_native_graph(g, 0, n - 1)
+    assert single.found and single.hops == n - 1
+    assert single.path is not None and len(single.path) == n
+
+    capped = solve_batch_native_graph(g, [(0, n - 1), (0, 10)])
+    assert capped[0].found and capped[0].hops == n - 1
+    assert capped[0].path is None  # too deep for the default cap
+    assert capped[1].path == list(range(11))  # shallow query unaffected
+
+    full = solve_batch_native_graph(g, [(0, n - 1)], path_cap=n + 1)
+    assert full[0].path == single.path
