@@ -142,6 +142,23 @@ def _slot_pad(width: int) -> int:
     return max(8, -(-width // 8) * 8)
 
 
+def sentinel_transposed_table(
+    nbr: jnp.ndarray, deg: jnp.ndarray, n_rows_p: int, sent: int, wp: int
+) -> jnp.ndarray:
+    """THE shared table transform of both Pallas kernels: mask dead slots
+    to the sentinel id (whose frontier bit always reads 0), pad to
+    ``(n_rows_p, wp)``, transpose to slot-major ``[wp, n_rows_p]``."""
+    n_rows, width = nbr.shape
+    mask = jnp.arange(width, dtype=jnp.int32)[None, :] < deg[:, None]
+    nbrm = jnp.where(mask, nbr.astype(jnp.int32), jnp.int32(sent))
+    nbrm = jnp.pad(
+        nbrm,
+        ((0, n_rows_p - n_rows), (0, wp - width)),
+        constant_values=sent,
+    )
+    return nbrm.T
+
+
 def prepare_pallas_tables(
     nbr: jnp.ndarray, deg: jnp.ndarray, id_space: int | None = None
 ) -> tuple:
@@ -157,16 +174,9 @@ def prepare_pallas_tables(
     n_rows, width = nbr.shape
     n_rows_p = _pad_n(n_rows)
     sent = _pad_n(id_space if id_space is not None else n_rows)
-    wp = _slot_pad(width)
-    # the sentinel id's frontier bit is always 0 (zero-padded word tail)
-    mask = jnp.arange(width, dtype=jnp.int32)[None, :] < deg[:, None]
-    nbrm = jnp.where(mask, nbr.astype(jnp.int32), jnp.int32(sent))
-    nbrm = jnp.pad(
-        nbrm,
-        ((0, n_rows_p - n_rows), (0, wp - width)),
-        constant_values=sent,
+    return (
+        sentinel_transposed_table(nbr, deg, n_rows_p, sent, _slot_pad(width)),
     )
-    return (nbrm.T,)
 
 
 def _pack_frontier(frontier: jnp.ndarray, n_words_p: int, tc: int) -> jnp.ndarray:

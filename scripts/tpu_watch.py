@@ -40,8 +40,9 @@ round as a detached background process:
    one deterministically-failing item cannot force re-measuring the
    other three.
 
-State lives in ``TPU_WATCH_STATUS.json`` at the repo root (committed at
-round end as evidence either way); the chatty log goes to
+State lives in ``TPU_WATCH_STATUS.json`` at the repo root (gitignored —
+it churns every probe tick; the builder snapshots it with ``git add -f``
+once at round end as evidence either way); the chatty log goes to
 ``/tmp/tpu_watch.log``. The watcher never touches git — the builder
 commits artifacts when they appear.
 
