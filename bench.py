@@ -89,6 +89,10 @@ LAST_TPU_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 
 
 def emit(value, detail, error=None):
+    """One COMPACT JSON line on stdout (the driver keeps only a ~2000-char
+    tail, and round-3's full-detail line overflowed it into ``parsed:
+    null`` — VERDICT r3 weak #3); the full detail goes to
+    ``bench_last.json`` next to this script."""
     line = {
         "metric": "bibfs_100k_search_wall_clock",
         "value": value,
@@ -98,7 +102,37 @@ def emit(value, detail, error=None):
     }
     if error:
         line["error"] = error
-    print(json.dumps(line))
+    detail_file = "bench_last.json"
+    try:
+        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "bench_last.json"), "w") as f:
+            json.dump(line, f, indent=1)
+    except OSError as e:
+        print(f"could not write bench_last.json: {e}", file=sys.stderr)
+        detail_file = None  # never point consumers at a stale file
+    compact = {
+        "metric": line["metric"],
+        "value": value,
+        "unit": "s",
+        "vs_baseline": line["vs_baseline"],
+        "platform": detail.get("platform"),
+        "config": detail.get("config"),
+        "device_best_s": detail.get("device_best_s"),
+        "batch32_per_query_us": (detail.get("batch32") or {}).get(
+            "per_query_us"
+        ),
+        "degraded": bool(detail.get("degraded")),
+        "tpu_error": (detail.get("tpu_error") or "")[:120] or None,
+        "detail_file": detail_file,
+    }
+    if error:
+        compact["error"] = error[:200]
+    out = json.dumps(compact)
+    if len(out) > 900:  # belt and braces: never overflow the tail window
+        for k in ("tpu_error", "config", "batch32_per_query_us"):
+            compact.pop(k, None)
+        out = json.dumps(compact)
+    print(out)
     return line
 
 

@@ -284,7 +284,7 @@ def main(argv=None):
         "--mode",
         default="sync",
         choices=["sync", "alt", "beamer", "beamer_alt", "pallas",
-                 "pallas_alt", "fused"],
+                 "pallas_alt", "fused", "sync_unfused"],
         help="device-kernel schedule: sync = both sides per round (fewest "
         "rounds), alt = smaller-frontier-first alternation (fewest edge "
         "scans); beamer variants add push/pull direction optimization; "

@@ -73,7 +73,7 @@ def main(argv=None):
         "--mode",
         default=None,
         choices=["sync", "alt", "beamer", "beamer_alt", "pallas",
-                 "pallas_alt", "fused"],
+                 "pallas_alt", "fused", "sync_unfused"],
         help="device-kernel schedule for the device backends (default "
         "sync): sync = both sides per round, alt = smaller-frontier-first "
         "alternation; beamer/beamer_alt add push/pull direction "

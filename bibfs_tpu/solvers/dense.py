@@ -382,6 +382,12 @@ DENSE_MODES = {
     "pallas": ("sync", False, True),
     "pallas_alt": ("alt", False, True),
     "fused": ("sync", False, "fused"),
+    # A/B control for the round-3 dual fusion claims (VERDICT r3 item 4):
+    # the same lock-step schedule with the PRE-fusion structure — two
+    # single-side expansions per round (two table reads; under the 1D
+    # mesh, two single-side frontier collectives). Exists to measure the
+    # fusion, not to run in production.
+    "sync_unfused": ("sync", False, False),
 }
 
 
@@ -437,7 +443,8 @@ def _make_body(mode: str, cap: int, tier_meta, nbr, deg, aux):
             }
             return _meet_vote(st, 2)
 
-    elif schedule == "sync" and not hybrid and not use_pallas:
+    elif (schedule == "sync" and not hybrid and not use_pallas
+          and mode != "sync_unfused"):
         # pull-only lock-step: fuse both sides' expansions so every
         # neighbor table (base + hub tiers) is gathered ONCE per round
         # for both searches — half the HBM traffic of two sequential

@@ -289,7 +289,7 @@ def _make_shard_body(
         return st
 
     schedule = SHARDED_MODES[mode][0]
-    if schedule == "sync" and push_cap == 0:
+    if schedule == "sync" and push_cap == 0 and mode != "sync_unfused":
         # pull-only lock-step: ONE dual-packed frontier exchange and ONE
         # table read serve BOTH sides' expansions per round — the same
         # wire bytes as two single-side gathers but half the collective

@@ -1,0 +1,173 @@
+"""A/B the round-3 dual-fusion claims (VERDICT r3 item 4).
+
+Round 3 fused the lock-step round on every substrate — ONE dual-packed
+exchange + ONE table read per round instead of two single-side ones —
+justified by a latency model ("half the collectives => half the
+latency-bound level cost") that no artifact ever measured. This script
+measures it, via the ``sync_unfused`` A/B control mode (the same
+schedule with the pre-fusion structure):
+
+- ``dense`` leg: fixed-trip fori_loop of the real while-body at two trip
+  counts (the tpu_session ``levels`` protocol) on the ambient platform —
+  the slope is the pure per-level cost, fused vs unfused. On the
+  tunneled chip this also separates the dispatch intercept.
+- ``sharded`` leg: whole-solve forced-execution walls on the 8-device
+  virtual CPU mesh (the single_machine_bench.sh fake-cluster
+  methodology), fused vs unfused, divided by the level count. The ICI
+  regime the fusion targets needs a real multi-chip mesh; the CPU mesh
+  measures the op/collective-count effect only.
+
+Appends one JSON line per leg to stdout; paste the table into
+PERF_NOTES.md.
+
+Usage: python scripts/ab_fusion.py [--legs dense sharded] [--n 100000]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+DENSE_LEG = """
+import json, sys, time
+from functools import partial
+import numpy as np
+sys.path.insert(0, {repo!r})
+from bibfs_tpu.utils.platform import apply_platform_env
+apply_platform_env()
+import jax, jax.numpy as jnp
+from bibfs_tpu.graph.generate import gnp_random_graph
+from bibfs_tpu.solvers.dense import (
+    DeviceGraph, INF32, _init_state, _make_body, _outputs, solve_dense_graph,
+)
+
+n = {n}
+edges = gnp_random_graph(n, 2.2 / n, seed=1)
+g = DeviceGraph.build(n, edges)
+out = dict(item="fusion", leg="dense", n=n,
+           platform=jax.devices()[0].platform)
+
+# hop parity first: the control mode must be the same algorithm
+r_f = solve_dense_graph(g, 0, n - 1, mode="sync")
+r_u = solve_dense_graph(g, 0, n - 1, mode="sync_unfused")
+assert r_f.hops == r_u.hops and r_f.levels == r_u.levels, (r_f, r_u)
+out["hops"] = r_f.hops
+
+@partial(jax.jit, static_argnames=("mode", "trips"))
+def run(nbr, deg, mode, trips):
+    st = _init_state(nbr.shape[0], 1, jnp.int32(0), jnp.int32(n - 1), deg)
+    body = _make_body(mode, 0, (), nbr, deg, ())
+    st = jax.lax.fori_loop(0, trips, lambda i, s: body(s), st)
+    return st["dist_s"].sum() + st["dist_t"].sum()
+
+for mode in ("sync", "sync_unfused"):
+    walls = dict()
+    for trips in (4, 32):
+        vals = []
+        for rep in range(6):
+            t0 = time.perf_counter()
+            v = int(run(g.nbr, g.deg, mode, trips))  # forced readback
+            vals.append(time.perf_counter() - t0)
+        walls[trips] = float(np.median(vals[1:]))
+    per_round = (walls[32] - walls[4]) / 28.0
+    out[mode] = dict(
+        wall_T4_s=walls[4], wall_T32_s=walls[32],
+        device_round_s=per_round, dispatch_s=walls[4] - 4 * per_round,
+    )
+f, u = out["sync"]["device_round_s"], out["sync_unfused"]["device_round_s"]
+out["fused_speedup_per_round"] = (u / f) if f > 0 else None
+print("RESULT " + json.dumps(out))
+"""
+
+SHARDED_LEG = """
+import json, sys
+import numpy as np
+sys.path.insert(0, {repo!r})
+from bibfs_tpu.utils.platform import force_cpu
+force_cpu(8)
+import jax
+from bibfs_tpu.graph.generate import gnp_random_graph
+from bibfs_tpu.parallel.mesh import make_1d_mesh
+from bibfs_tpu.solvers.serial import solve_serial
+from bibfs_tpu.solvers.sharded import ShardedGraph, time_search
+
+n = {n}
+edges = gnp_random_graph(n, 2.2 / n, seed=1)
+want = solve_serial(n, edges, 0, n - 1)
+g = ShardedGraph.build(n, edges, make_1d_mesh(8))
+out = dict(leg="sharded", n=n, ndev=8, platform=jax.devices()[0].platform)
+for mode in ("sync", "sync_unfused"):
+    times, res = time_search(g, 0, n - 1, repeats={repeats}, mode=mode)
+    assert res.hops == want.hops, (mode, res.hops, want.hops)
+    med = float(np.median(times))
+    out[mode] = dict(wall_s=med, levels=res.levels,
+                     per_level_s=med / max(res.levels, 1))
+out["hops"] = want.hops
+f = out["sync"]["per_level_s"]
+u = out["sync_unfused"]["per_level_s"]
+out["fused_speedup_per_level"] = (u / f) if f > 0 else None
+print("RESULT " + json.dumps(out))
+"""
+
+
+# tpu_session.py embeds DENSE_LEG as its 'fusion' item via this template
+# (the ONLY placeholder left after substituting n must be {repo!r} —
+# run_item formats with repo alone)
+FUSION_ITEM_TEMPLATE = DENSE_LEG.replace("{n}", "100000")
+
+
+def run_result_subprocess(name: str, code: str, timeout: int) -> dict:
+    """THE bounded measurement-subprocess protocol, shared with
+    tpu_session.run_item: run ``python -c code``, scan stdout for the
+    one ``RESULT <json>`` line, stamp ``elapsed_s``, and turn timeouts /
+    missing results into an ``error`` record instead of an exception."""
+    t0 = time.time()
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True,
+            timeout=timeout,
+        )
+        for line in r.stdout.splitlines():
+            if line.startswith("RESULT "):
+                out = json.loads(line[len("RESULT "):])
+                out["elapsed_s"] = round(time.time() - t0, 1)
+                return out
+        err = (r.stdout + r.stderr).strip()[-800:] or "no RESULT line"
+    except subprocess.TimeoutExpired:
+        err = f"timeout after {timeout}s"
+    return dict(
+        item=name, error=err, elapsed_s=round(time.time() - t0, 1)
+    )
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--legs", nargs="+", default=["dense", "sharded"],
+                    choices=["dense", "sharded"])
+    ap.add_argument("--n", type=int, default=100_000)
+    ap.add_argument("--repeats", type=int, default=7)
+    args = ap.parse_args(argv)
+    rc = 0
+    for leg in args.legs:
+        if leg == "dense":
+            code = DENSE_LEG.format(repo=REPO, n=args.n)
+        else:
+            code = SHARDED_LEG.format(
+                repo=REPO, n=args.n, repeats=args.repeats
+            )
+        out = run_result_subprocess(leg, code, timeout=1800)
+        print(json.dumps(out), flush=True)
+        if "error" in out:
+            rc = 1
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -271,34 +271,27 @@ if out["fused_compiles"]:
 print("RESULT " + json.dumps(out))
 """
 
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from ab_fusion import (  # noqa: E402
+    FUSION_ITEM_TEMPLATE,
+    run_result_subprocess,
+)
+
 ITEMS = {
     "pallas": (PALLAS_SUB, 900),
     "mesh1": (MESH1_SUB, 900),
     "batch": (BATCH_SUB, 1500),
     "levels": (LEVELS_SUB, 900),
+    # the round-3 dual-fusion A/B (sync vs sync_unfused) on the chip,
+    # where the per-level fixed cost the fusion targets actually lives
+    "fusion": (FUSION_ITEM_TEMPLATE, 1200),
 }
 
 
 def run_item(name: str) -> dict:
     code, timeout = ITEMS[name]
-    t0 = time.time()
-    try:
-        r = subprocess.run(
-            [sys.executable, "-c", code.format(repo=REPO)],
-            capture_output=True, text=True, timeout=timeout,
-        )
-        for line in r.stdout.splitlines():
-            if line.startswith("RESULT "):
-                out = json.loads(line[len("RESULT "):])
-                out["elapsed_s"] = round(time.time() - t0, 1)
-                return out
-        return dict(
-            item=name, error=(r.stdout + r.stderr).strip()[-800:],
-            elapsed_s=round(time.time() - t0, 1),
-        )
-    except subprocess.TimeoutExpired:
-        return dict(item=name, error=f"timeout after {timeout}s",
-                    elapsed_s=round(time.time() - t0, 1))
+    # the shared bounded-subprocess/RESULT protocol lives in ab_fusion
+    return run_result_subprocess(name, code.format(repo=REPO), timeout)
 
 
 def main(argv=None):

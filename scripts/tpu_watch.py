@@ -110,10 +110,12 @@ def bench_ok() -> str | None:
         return f"bench_last_tpu.json unreadable: {e}"
     # bench persists the file whenever its PROBE saw the accelerator,
     # even if the tunnel then dropped and every device config failed —
-    # require an actual device measurement in the artifact
-    if line.get("platform") in (None, "", "cpu"):
+    # require an actual device measurement in the artifact. platform and
+    # device_best_s live under the line's ``detail`` dict (bench.emit)
+    det = line.get("detail") or {}
+    if det.get("platform") in (None, "", "cpu"):
         return "bench artifact has cpu platform"
-    if not isinstance(line.get("device_best_s"), (int, float)):
+    if not isinstance(det.get("device_best_s"), (int, float)):
         return "bench artifact has no device measurement (all configs failed?)"
     return None
 
@@ -159,6 +161,8 @@ STEPS = [
      lambda: session_item_ok("batch")),
     ("session_mesh1", _session_argv("mesh1"), 1200, 3,
      lambda: session_item_ok("mesh1")),
+    ("session_fusion", _session_argv("fusion"), 1500, 3,
+     lambda: session_item_ok("fusion")),
     ("bench", [PY, os.path.join(REPO, "bench.py")], 2700, 3, bench_ok),
     # watchdog must cover RMAT gen + CSR + serial oracle (~20-25 min at
     # scale 25) ON TOP of the --dense-timeout 2400 the script is given

@@ -233,3 +233,22 @@ def test_dense_time_search_protocol():
     assert res.found == ref.found
     if ref.found:
         assert res.hops == ref.hops
+
+
+def test_sync_unfused_control_matches_sync():
+    """The A/B control mode (scripts/ab_fusion.py) is the same algorithm:
+    identical hops, levels, and edge counts on ELL and tiered layouts."""
+    from bibfs_tpu.graph.generate import gnp_random_graph, rmat_graph
+    from bibfs_tpu.solvers.dense import DeviceGraph, solve_dense_graph
+
+    n = 5_000
+    edges = gnp_random_graph(n, 2.5 / n, seed=2)
+    for layout, (nn, ee) in (
+        ("ell", (n, edges)),
+        ("tiered", rmat_graph(10, edge_factor=4, seed=3)),
+    ):
+        g = DeviceGraph.build(nn, ee, layout=layout)
+        a = solve_dense_graph(g, 0, nn - 1, mode="sync")
+        b = solve_dense_graph(g, 0, nn - 1, mode="sync_unfused")
+        assert (a.found, a.hops, a.levels, a.edges_scanned) == (
+            b.found, b.hops, b.levels, b.edges_scanned), layout
