@@ -135,7 +135,8 @@ def _dense_chunk_kernel(mode: str, push_cap: int, tier_meta: tuple, chunk: int):
 
 @lru_cache(maxsize=None)
 def _sharded_chunk_kernel(
-    mesh, axis: str, mode: str, push_cap: int, tier_meta: tuple, chunk: int
+    mesh, axis: str, mode: str, push_cap: int, tier_meta: tuple, chunk: int,
+    geom: tuple | None = None,
 ):
     """shard_map'd ``(nbr, deg, aux, state) -> state`` advancing at most
     ``chunk`` rounds of the multi-chip search. Vertex state shards with the
@@ -172,12 +173,17 @@ def _sharded_chunk_kernel(
         )
         return _strip(st)
 
+    from bibfs_tpu.solvers.sharded import _check_vma_for
+
     return jax.jit(
         jax.shard_map(
             fn,
             mesh=mesh,
             in_specs=(sh, sh, aux_spec, st_spec),
             out_specs=dict(st_spec),
+            # off only for interpret-mode pallas programs (see
+            # sharded._check_vma_for): the real kernel body must run
+            check_vma=_check_vma_for(mode, geom),
         ),
         donate_argnums=3,  # same dead-previous-state rule as the dense leg
     )
@@ -448,7 +454,7 @@ def _get_chunk_step(g, mode: str, chunk: int):
         mode = _resolve_pallas_mode(mode, _shard_geom(g))
         cap = kernel_cap(mode, g.n_pad)
         kern = _sharded_chunk_kernel(
-            g.mesh, VERTEX_AXIS, mode, cap, g.tier_meta, chunk
+            g.mesh, VERTEX_AXIS, mode, cap, g.tier_meta, chunk, _shard_geom(g)
         )
         return lambda st: kern(g.nbr, g.deg, g.aux, st)
     # DeviceGraph

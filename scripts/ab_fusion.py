@@ -45,7 +45,7 @@ apply_platform_env()
 import jax, jax.numpy as jnp
 from bibfs_tpu.graph.generate import gnp_random_graph
 from bibfs_tpu.solvers.dense import (
-    DeviceGraph, INF32, _init_state, _make_body, _outputs, solve_dense_graph,
+    DeviceGraph, _init_state, _make_body, solve_dense_graph,
 )
 
 n = {n}
@@ -54,9 +54,12 @@ g = DeviceGraph.build(n, edges)
 out = dict(item="fusion", leg="dense", n=n,
            platform=jax.devices()[0].platform)
 
-# hop parity first: the control mode must be the same algorithm
+# hop parity first: the control mode must be the same algorithm — and the
+# pair must actually CONNECT, or the per-round slope below measures a
+# degenerate 2-level search (None == None would pass silently)
 r_f = solve_dense_graph(g, 0, n - 1, mode="sync")
 r_u = solve_dense_graph(g, 0, n - 1, mode="sync_unfused")
+assert r_f.found and r_u.found, "disconnected A/B pair; pick another seed/n"
 assert r_f.hops == r_u.hops and r_f.levels == r_u.levels, (r_f, r_u)
 out["hops"] = r_f.hops
 
@@ -101,6 +104,7 @@ from bibfs_tpu.solvers.sharded import ShardedGraph, time_search
 n = {n}
 edges = gnp_random_graph(n, 2.2 / n, seed=1)
 want = solve_serial(n, edges, 0, n - 1)
+assert want.found, "disconnected A/B pair; pick another seed/n"
 g = ShardedGraph.build(n, edges, make_1d_mesh(8))
 out = dict(leg="sharded", n=n, ndev=8, platform=jax.devices()[0].platform)
 for mode in ("sync", "sync_unfused"):

@@ -140,17 +140,41 @@ edges = gnp_random_graph(n, 2.2 / n, seed=1)
 g = DeviceGraph.build(n, edges)
 rng = np.random.default_rng(0)
 rows = {{}}
-for b in (32, 128, 256, 1024):
+# extend until HBM refuses (VERDICT r3 next-7: find where the per-query
+# curve flattens, or the asymptote that bounds the win regime)
+for b in (32, 128, 256, 1024, 2048, 4096):
     pairs = np.stack([rng.integers(0, n, b), rng.integers(0, n, b)], axis=1)
     reps = 5 if b <= 256 else 3
     try:
         bt = time_batch_only(g, pairs, repeats=reps, mode="sync")
         med = float(np.median(bt))
         rows[str(b)] = dict(batch_s=med, per_query_us=med / b * 1e6)
+        print("batch", b, rows[str(b)], file=sys.stderr, flush=True)
     except Exception as e:
         rows[str(b)] = dict(error=str(e)[:200])
-    print("batch", b, rows[str(b)], file=sys.stderr, flush=True)
+        print("batch", b, rows[str(b)], file=sys.stderr, flush=True)
+        msg = str(e).lower()
+        if "resource" in msg or "memory" in msg or "oom" in msg:
+            break  # larger sizes will only OOM harder; transients go on
 out["batch_100k"] = rows
+
+# the other axis of the win regime: a graph where per-level device work
+# dwarfs the per-level fixed cost (RMAT-18 skew, tiered layout)
+try:
+    from bibfs_tpu.graph.generate import rmat_graph
+    n2, edges2 = rmat_graph(18, edge_factor=8, seed=1)
+    g2 = DeviceGraph.build(n2, edges2, layout="tiered")
+    rows2 = {{}}
+    for b in (32, 256):
+        pairs = np.stack(
+            [rng.integers(0, n2, b), rng.integers(0, n2, b)], axis=1)
+        bt = time_batch_only(g2, pairs, repeats=3, mode="sync")
+        med = float(np.median(bt))
+        rows2[str(b)] = dict(batch_s=med, per_query_us=med / b * 1e6)
+        print("rmat18 batch", b, rows2[str(b)], file=sys.stderr, flush=True)
+    out["batch_rmat18"] = rows2
+except Exception as e:
+    out["batch_rmat18"] = dict(error=str(e)[:200])
 print("RESULT " + json.dumps(out))
 """
 
@@ -280,7 +304,7 @@ from ab_fusion import (  # noqa: E402
 ITEMS = {
     "pallas": (PALLAS_SUB, 900),
     "mesh1": (MESH1_SUB, 900),
-    "batch": (BATCH_SUB, 1500),
+    "batch": (BATCH_SUB, 2100),
     "levels": (LEVELS_SUB, 900),
     # the round-3 dual-fusion A/B (sync vs sync_unfused) on the chip,
     # where the per-level fixed cost the fusion targets actually lives

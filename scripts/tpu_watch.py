@@ -157,7 +157,7 @@ STEPS = [
      lambda: session_item_ok("pallas")),
     ("session_levels", _session_argv("levels"), 1200, 3,
      lambda: session_item_ok("levels")),
-    ("session_batch", _session_argv("batch"), 1800, 3,
+    ("session_batch", _session_argv("batch"), 2400, 3,
      lambda: session_item_ok("batch")),
     ("session_mesh1", _session_argv("mesh1"), 1200, 3,
      lambda: session_item_ok("mesh1")),
