@@ -322,10 +322,10 @@ def main(argv=None):
         ap.error("--mode pallas/pallas_alt requires --backends dense (the "
                  "sharded backends have no pallas path)")
     if args.mode == "fused" and any(
-        b not in ("dense", "serial", "native") for b in backends
+        b not in ("dense", "sharded", "serial", "native") for b in backends
     ):
-        ap.error("--mode fused requires --backends dense (the whole-level "
-                 "kernel is single-chip only)")
+        ap.error("--mode fused requires --backends dense/sharded (the "
+                 "whole-level kernel has no 2D form)")
     if args.mode not in ("sync", "alt") and "sharded2d" in backends:
         ap.error("--backends sharded2d supports --mode sync/alt only")
     if args.layout != "ell" and "sharded2d" in backends:

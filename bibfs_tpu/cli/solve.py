@@ -157,9 +157,9 @@ def main(argv=None):
     if mode.startswith("pallas") and args.backend not in ("dense", "sharded"):
         ap.error("--mode pallas/pallas_alt is only supported by the dense "
                  "and sharded backends")
-    if mode == "fused" and args.backend != "dense":
+    if mode == "fused" and args.backend not in ("dense", "sharded"):
         ap.error("--mode fused (whole-level kernel) is only supported by "
-                 "the dense backend")
+                 "the dense and sharded backends")
     if args.pairs is not None:
         if args.backend not in ("dense", "native", "sharded", "sharded2d"):
             ap.error("--pairs batch mode is supported by --backend dense/"

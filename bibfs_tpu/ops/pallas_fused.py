@@ -77,27 +77,38 @@ def pad_rows(n: int) -> int:
     return -(-n // TILE) * TILE
 
 
-def fused_geometry(n_rows_p: int) -> tuple[int, int]:
-    """``(chunks, sentinel_id)`` for a padded row count."""
-    chunks = -(-(n_rows_p // 32) // TILE)
+def fused_geometry(id_space_p: int) -> tuple[int, int]:
+    """``(chunks, sentinel_id)`` for a padded id space. For the dense
+    solver the id space IS the row count; under the 1D mesh the LOCAL
+    rows gather from the GLOBAL frontier, so ``id_space_p = n_loc_p *
+    ndev`` while the grid walks only the local rows."""
+    chunks = -(-(id_space_p // 32) // TILE)
     return chunks, chunks * CHUNK_VERTS
 
 
-def fused_fits(n_rows: int) -> bool:
+def fused_fits(n_rows: int, id_space: int | None = None) -> bool:
     """Whether the fused level's static chunk loop stays within
-    MAX_CHUNKS (~8.4M vertices). Callers also require a tier-free
+    MAX_CHUNKS (~8.4M vertices of id space; ``id_space`` defaults to
+    ``n_rows`` — the dense case). Callers also require a tier-free
     (plain-ELL) layout — see module docstring."""
-    return fused_geometry(pad_rows(n_rows))[0] <= MAX_CHUNKS
+    space = id_space if id_space is not None else n_rows
+    return fused_geometry(pad_rows(space))[0] <= MAX_CHUNKS
 
 
-def prepare_fused_tables(nbr: jnp.ndarray, deg: jnp.ndarray) -> tuple:
+def prepare_fused_tables(
+    nbr: jnp.ndarray, deg: jnp.ndarray, id_space: int | None = None
+) -> tuple:
     """Transposed sentinel-padded table + padded degree row for the fused
     kernel: ``(nbr_t int32[Wp, n_rows_p], deg2 int32[1, n_rows_p])``.
     Jittable, loop-constant — the solver builds it once per solve,
-    outside the while_loop."""
+    outside the while_loop. ``id_space`` is the frontier id range the
+    table's entries index (defaults to ``n_rows``; ``n_loc * ndev`` per
+    shard under the 1D mesh)."""
     n_rows, width = nbr.shape
     n_rows_p = pad_rows(n_rows)
-    _chunks, sent = fused_geometry(n_rows_p)
+    _chunks, sent = fused_geometry(
+        pad_rows(id_space if id_space is not None else n_rows)
+    )
     nbr_t = sentinel_transposed_table(
         nbr, deg, n_rows_p, sent, _slot_pad(width)
     )
@@ -107,11 +118,11 @@ def prepare_fused_tables(nbr: jnp.ndarray, deg: jnp.ndarray) -> tuple:
     return nbr_t, deg2
 
 
-def pack_frontier_fused(fr: jnp.ndarray, n_rows_p: int) -> jnp.ndarray:
-    """bool[n] -> packed int32[chunks, TILE] in the fused bit layout
-    (module docstring). XLA-side; runs once at solve init — the kernel
-    itself re-packs between levels."""
-    chunks, _sent = fused_geometry(n_rows_p)
+def pack_frontier_words(fr: jnp.ndarray, n_rows_p: int) -> jnp.ndarray:
+    """bool[n<=n_rows_p] -> FLAT packed int32[n_rows_p // 32] in the fused
+    bit layout (module docstring) — the per-shard building block of the
+    sharded exchange (each shard's flat words are a contiguous slice of
+    the global word array when ``n_loc % TILE == 0``)."""
     tiles = n_rows_p // TILE
     bits = jnp.pad(fr.astype(jnp.uint32), (0, n_rows_p - fr.shape[0]))
     # vertex v = tile*4096 + b*128 + l  ->  fr3[tile, b, l]
@@ -121,9 +132,21 @@ def pack_frontier_fused(fr: jnp.ndarray, n_rows_p: int) -> jnp.ndarray:
         axis=1,
         dtype=jnp.uint32,
     )  # [tiles, WPT]
-    flat = words.reshape(-1)  # [n_rows_p // 32]
+    return jax.lax.bitcast_convert_type(words.reshape(-1), jnp.int32)
+
+
+def words_to_chunks(flat: jnp.ndarray, id_space_p: int) -> jnp.ndarray:
+    """FLAT packed words -> the kernel's chunk-padded [chunks, TILE]."""
+    chunks, _sent = fused_geometry(id_space_p)
     flat = jnp.pad(flat, (0, chunks * TILE - flat.shape[0]))
-    return jax.lax.bitcast_convert_type(flat, jnp.int32).reshape(chunks, TILE)
+    return flat.reshape(chunks, TILE)
+
+
+def pack_frontier_fused(fr: jnp.ndarray, n_rows_p: int) -> jnp.ndarray:
+    """bool[n] -> packed int32[chunks, TILE] in the fused bit layout
+    (module docstring). XLA-side; runs once at solve init — the kernel
+    itself re-packs between levels."""
+    return words_to_chunks(pack_frontier_words(fr, n_rows_p), n_rows_p)
 
 
 def _word_bit(nbr):
@@ -254,22 +277,29 @@ def _fused_kernel(
 
 
 @lru_cache(maxsize=None)
-def _get_fused_call(wp: int, n_rows_p: int, interpret: bool):
-    chunks, _sent = fused_geometry(n_rows_p)
-    if chunks > MAX_CHUNKS:
+def _get_fused_call(wp: int, n_rows_p: int, in_chunks: int, interpret: bool,
+                    vma: frozenset = frozenset()):
+    """``in_chunks`` covers the frontier ID SPACE the table indexes
+    (equals the local-row chunk count for the dense solver; the GLOBAL
+    chunk count per shard under the 1D mesh); the grid and the outputs
+    cover the local rows."""
+    if in_chunks > MAX_CHUNKS:
         raise ValueError(
-            f"fused level kernel: {chunks} chunks at n_rows_p={n_rows_p} "
+            f"fused level kernel: {in_chunks} chunks of frontier id space "
             f"exceeds MAX_CHUNKS={MAX_CHUNKS}; use the round-3 kernel path"
         )
+    chunks, _sent = fused_geometry(n_rows_p)  # OUTPUT (local-row) chunks
     grid = n_rows_p // TILE
-    kernel = lambda *refs: _fused_kernel(chunks, *refs)  # noqa: E731
-    fw = pl.BlockSpec((chunks, TILE), lambda i: (0, 0))
+    kernel = lambda *refs: _fused_kernel(in_chunks, *refs)  # noqa: E731
+    fw = pl.BlockSpec((in_chunks, TILE), lambda i: (0, 0))
     row = pl.BlockSpec((1, TILE), lambda i: (0, i))
     wrow = pl.BlockSpec((1, WPT), lambda i: (0, i))
     one = pl.BlockSpec((1, 1), lambda i: (0, 0))
-    rs = jax.ShapeDtypeStruct((1, n_rows_p), jnp.int32)
-    ws = jax.ShapeDtypeStruct((chunks, TILE), jnp.int32)
-    ss = jax.ShapeDtypeStruct((1, 1), jnp.int32)
+    # vma: under a checking shard_map (TPU mesh) the outputs vary exactly
+    # as the per-shard inputs do — same declaration as pallas_expand
+    rs = jax.ShapeDtypeStruct((1, n_rows_p), jnp.int32, vma=vma)
+    ws = jax.ShapeDtypeStruct((chunks, TILE), jnp.int32, vma=vma)
+    ss = jax.ShapeDtypeStruct((1, 1), jnp.int32, vma=vma)
     # the next packed frontiers write only words < n_rows_p/32; the padded
     # word tail (if any) is never read back — sentinel word indices fall
     # outside every chunk window by construction (module docstring)
@@ -297,11 +327,18 @@ def fused_dual_level(
     level numbers are traced int32 scalars. Returns
     ``(fws', fwt', dist_s', dist_t', par_s', par_t',
     cnt_s, cnt_t, md_s, md_t, degsum_s, degsum_t, meet_val, meet_idx)``
-    with the eight reductions as int32 scalars."""
+    with the eight reductions as int32 scalars. The input frontiers'
+    chunk count may exceed the local-row geometry (global id space under
+    the 1D mesh); the packed outputs cover the LOCAL rows."""
+    from bibfs_tpu.ops.pallas_expand import _vma_of
+
     wp, n_rows_p = nbr_t.shape
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    call = _get_fused_call(wp, n_rows_p, interpret)
+    call = _get_fused_call(
+        wp, n_rows_p, int(fws.shape[0]), interpret,
+        _vma_of(fws, fwt, nbr_t, deg2, dist_s, dist_t, par_s, par_t),
+    )
     outs = call(
         fws, fwt, nbr_t, deg2, dist_s, dist_t, par_s, par_t,
         jnp.asarray(lvl_s, jnp.int32).reshape(1, 1),
@@ -312,14 +349,16 @@ def fused_dual_level(
 
 
 @lru_cache(maxsize=None)
-def _fused_available_padded(wp: int, n_rows_p: int) -> bool:
+def _fused_available_padded(wp: int, n_rows_p: int, id_space_p: int) -> bool:
     try:
         import numpy as np
 
-        _chunks, sent = fused_geometry(n_rows_p)
+        _chunks, sent = fused_geometry(id_space_p)
         nbr_t = jnp.full((wp, n_rows_p), sent, jnp.int32)
         deg2 = jnp.zeros((1, n_rows_p), jnp.int32)
-        fw = pack_frontier_fused(jnp.zeros(n_rows_p, jnp.bool_), n_rows_p)
+        fw = words_to_chunks(
+            jnp.zeros(id_space_p // 32, jnp.int32), id_space_p
+        )
         dist = jnp.full((1, n_rows_p), INF32, jnp.int32)
         par = jnp.full((1, n_rows_p), -1, jnp.int32)
         outs = fused_dual_level(
@@ -334,11 +373,16 @@ def _fused_available_padded(wp: int, n_rows_p: int) -> bool:
         return False
 
 
-def fused_available(n_rows: int = 64, width: int = 2) -> bool:
+def fused_available(
+    n_rows: int = 64, width: int = 2, id_space: int | None = None
+) -> bool:
     """Compile+run probe of the fused kernel AT THE GIVEN GEOMETRY —
-    callers with a concrete graph pass its (n_rows, max width) so the
-    probe compiles the exact (grid, chunks, Wp) the solve will use
-    (Mosaic failures are frequently shape-dependent, VERDICT r3 weak #1).
-    Memoized on the padded geometry; the compiled kernel lands in jax's
-    executable cache for the solve to reuse."""
-    return _fused_available_padded(_slot_pad(width), pad_rows(n_rows))
+    callers with a concrete graph pass its (n_rows, max width[, global id
+    space]) so the probe compiles the exact (grid, chunks, Wp) the solve
+    will use (Mosaic failures are frequently shape-dependent, VERDICT r3
+    weak #1). Memoized on the padded geometry; the compiled kernel lands
+    in jax's executable cache for the solve to reuse."""
+    return _fused_available_padded(
+        _slot_pad(width), pad_rows(n_rows),
+        pad_rows(id_space if id_space is not None else n_rows),
+    )

