@@ -86,13 +86,25 @@ def fused_geometry(id_space_p: int) -> tuple[int, int]:
     return chunks, chunks * CHUNK_VERTS
 
 
-def fused_fits(n_rows: int, id_space: int | None = None) -> bool:
-    """Whether the fused level's static chunk loop stays within
+def fused_fits(
+    n_rows: int, id_space: int | None = None, width: int | None = None
+) -> bool:
+    """Whether the fused level fits: the static chunk loop within
     MAX_CHUNKS (~8.4M vertices of id space; ``id_space`` defaults to
-    ``n_rows`` — the dense case). Callers also require a tier-free
-    (plain-ELL) layout — see module docstring."""
+    ``n_rows`` — the dense case) and, when ``width`` is given, the
+    per-grid-step working set within the shared VMEM budget (same rule
+    as pallas_expand.pallas_fits — wide plain-ELL rows must degrade, not
+    die at Mosaic compile). Callers also require a tier-free (plain-ELL)
+    layout — see module docstring."""
+    from bibfs_tpu.ops.pallas_expand import VMEM_BUDGET_BYTES, _vmem_bytes
+
     space = id_space if id_space is not None else n_rows
-    return fused_geometry(pad_rows(space))[0] <= MAX_CHUNKS
+    chunks = fused_geometry(pad_rows(space))[0]
+    if chunks > MAX_CHUNKS:
+        return False
+    if width is not None:
+        return _vmem_bytes(_slot_pad(width), TILE, chunks) <= VMEM_BUDGET_BYTES
+    return True
 
 
 def prepare_fused_tables(

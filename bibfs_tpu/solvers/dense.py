@@ -513,7 +513,7 @@ def _build_fused_kernel(tier_meta: tuple = ()):
 
     def kernel(nbr, deg, aux, src, dst):
         n_pad = nbr.shape[0]
-        if tier_meta or not fused_fits(n_pad):
+        if tier_meta or not fused_fits(n_pad, width=nbr.shape[1]):
             # degrade to the round-3 kernel path (which may itself degrade
             # further); resolved at trace time from static shape/layout
             return _build_kernel("pallas", 0, tier_meta)(nbr, deg, aux, src, dst)
@@ -696,7 +696,7 @@ def _get_kernel(mode: str, push_cap: int, tier_meta: tuple = (),
 def _fused_fits_geom(geom: tuple) -> bool:
     from bibfs_tpu.ops.pallas_fused import fused_fits
 
-    return fused_fits(geom[0])
+    return fused_fits(geom[0], id_space=geom[1], width=geom[2])
 
 
 @lru_cache(maxsize=None)

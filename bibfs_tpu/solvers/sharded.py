@@ -497,8 +497,10 @@ def _sharded_fused_ok(geom: tuple | None, tier_meta: tuple) -> bool:
 
     if geom is None or tier_meta:
         return False
-    n_loc, id_space, _width = geom
-    return n_loc % TILE == 0 and fused_fits(n_loc, id_space=id_space)
+    n_loc, id_space, width = geom
+    return n_loc % TILE == 0 and fused_fits(
+        n_loc, id_space=id_space, width=width
+    )
 
 
 def _sharded_fused_prog(axis: str):

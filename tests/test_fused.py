@@ -421,3 +421,14 @@ def test_sharded_fused_level_word_slice_contract():
         for d in range(ndev)
     ]
     assert (np.concatenate(parts) == glob).all()
+
+
+def test_fused_fits_vmem_budget():
+    """Same degrade rule as pallas_fits: wide plain-ELL rows must route
+    away from the fused kernel before Mosaic compile (shared VMEM
+    model)."""
+    from bibfs_tpu.ops.pallas_fused import fused_fits
+
+    assert fused_fits(100_000, width=13)
+    assert not fused_fits(100_000, width=5000)
+    assert fused_fits(100_000)  # width=None keeps the chunk-only contract
