@@ -132,6 +132,39 @@ def test_fused_level_matches_xla_dual(n, avg, seed):
         assert int(midx) == int(sums.argmin())
 
 
+def test_fused_level_multichunk():
+    """A >131072-vertex graph spans two packed chunks: the chunk-window
+    masking of the in-kernel gather must reconstruct the full frontier
+    lookup across the chunk boundary (ids in both windows)."""
+    import jax.numpy as jnp
+
+    from bibfs_tpu.ops.expand import expand_pull_dual_tiered
+    from bibfs_tpu.ops.pallas_fused import fused_dual_level, fused_geometry
+
+    g, n_pad, n_rows_p, fi, xi, dist_s_np, dist_t_np = _setup_level(
+        140_000, 1.2, 11, fr_density=0.01
+    )
+    assert fused_geometry(n_rows_p)[0] == 2  # really multi-chunk
+    nf_s0, par_s0, dist_s0, _md_s0, nf_t0, par_t0, dist_t0, _md_t0 = [
+        np.asarray(x)
+        for x in expand_pull_dual_tiered(
+            xi["fr_s"], xi["fr_t"], xi["par"], xi["dist_s"], xi["par"],
+            xi["dist_t"], xi["nbr"], xi["deg"], (),
+            jnp.int32(4), jnp.int32(3), inf=INF32,
+        )
+    ]
+    outs = fused_dual_level(
+        fi["fws"], fi["fwt"], fi["nbr_t"], fi["deg2"], fi["dist_s"],
+        fi["dist_t"], fi["par_s"], fi["par_t"], jnp.int32(4), jnp.int32(3),
+    )
+    dist_s1 = np.asarray(outs[2])[0, :n_pad]
+    dist_t1 = np.asarray(outs[3])[0, :n_pad]
+    assert (dist_s1 == dist_s0).all() and (dist_t1 == dist_t0).all()
+    assert (_unpack(outs[0], n_rows_p, n_pad) == nf_s0).all()
+    assert (_unpack(outs[1], n_rows_p, n_pad) == nf_t0).all()
+    assert int(outs[6]) == nf_s0.sum() and int(outs[7]) == nf_t0.sum()
+
+
 def test_fused_geometry_invariants():
     from bibfs_tpu.ops.pallas_fused import (
         CHUNK_VERTS,
