@@ -53,6 +53,16 @@ out = fn(g.nbr, g.deg, g.aux, jnp.int32({src}), jnp.int32({dst}))
 # parent arrays are NOT fully addressable here, so only scalars are read)
 print("MH_RESULT", idx, int(np.asarray(out[0])), flush=True)
 
+# the whole-level fused kernel per shard (round-4 mode "fused"): its
+# word-plane all_gather and scalar votes now cross the process boundary
+from bibfs_tpu.solvers.sharded import _shard_geom
+gf = ShardedGraph.build(n, edges, mesh, pad_multiple=4096 * 8)
+fnf = _compiled_sharded(
+    mesh, VERTEX_AXIS, "fused", 0, gf.tier_meta, _shard_geom(gf)
+)
+outf = fnf(gf.nbr, gf.deg, gf.aux, jnp.int32({src}), jnp.int32({dst}))
+print("MHFUSED_RESULT", idx, int(np.asarray(outf[0])), flush=True)
+
 # the 2D block partition across the SAME two processes: its transpose
 # ppermute and row-axis all_gather now cross the process boundary too
 from bibfs_tpu.parallel.mesh import make_2d_mesh
@@ -98,7 +108,7 @@ def test_two_process_mesh_agrees_with_oracle(tmp_path):
             p.kill()
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"proc {i} failed:\n{out[-1500:]}"
-        for tag in ("MH_RESULT", "MH2D_RESULT"):
+        for tag in ("MH_RESULT", "MHFUSED_RESULT", "MH2D_RESULT"):
             results = [
                 line for line in out.splitlines() if line.startswith(tag)
             ]
