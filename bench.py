@@ -415,6 +415,20 @@ def main():
         def over_budget() -> bool:
             return time.time() - t_setup > 0.8 * TIME_BUDGET_S
 
+        # record what the kernel modes actually RESOLVED to on this
+        # backend: if Mosaic rejects a kernel, its sweep row would
+        # otherwise silently time the fallback under the kernel's label
+        try:
+            from bibfs_tpu.solvers.dense import _geom_of, _resolve_pallas_mode
+
+            detail["resolved_modes"] = {
+                m: _resolve_pallas_mode(m, _geom_of(graphs["ell"]))
+                for m in ("pallas", "fused")
+                if any(mm == m for mm, _l in sweep)
+            }
+        except Exception as e:
+            detail["resolved_modes"] = {"error": str(e)[:200]}
+
         for mode, layout in sweep:
             label = f"{mode}/{layout}"
             if over_budget():

@@ -142,13 +142,26 @@ def run_result_subprocess(name: str, code: str, timeout: int) -> dict:
             if line.startswith("RESULT "):
                 out = json.loads(line[len("RESULT "):])
                 out["elapsed_s"] = round(time.time() - t0, 1)
+                out["git"] = _git_sha()
                 return out
         err = (r.stdout + r.stderr).strip()[-800:] or "no RESULT line"
     except subprocess.TimeoutExpired:
         err = f"timeout after {timeout}s"
     return dict(
-        item=name, error=err, elapsed_s=round(time.time() - t0, 1)
+        item=name, error=err, elapsed_s=round(time.time() - t0, 1),
+        git=_git_sha(),
     )
+
+
+def _git_sha() -> str | None:
+    """Provenance stamp: which code produced a measurement artifact."""
+    try:
+        return subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"], cwd=REPO,
+            capture_output=True, text=True, timeout=10,
+        ).stdout.strip() or None
+    except Exception:
+        return None
 
 
 def main(argv=None) -> int:
