@@ -495,17 +495,20 @@ def _make_body(mode: str, cap: int, tier_meta, nbr, deg, aux):
 
 
 def _build_fused_kernel(tier_meta: tuple = ()):
-    """The whole-level-kernel search program (mode "fused"): every round is
-    one :func:`bibfs_tpu.ops.pallas_fused.fused_dual_level` call plus a
-    scalar fixup — state (packed frontiers, dist/par rows) never leaves the
-    kernel layout between levels. Tiered layouts and graphs past the
-    kernel's chunk bound degrade to the round-3 "pallas" program at trace
-    time (same contract surface: ``fn(nbr, deg, aux, src, dst)``)."""
+    """The whole-level-kernel search program (mode "fused"): every round
+    is one XLA dual gather + one
+    :func:`bibfs_tpu.ops.pallas_fused.fused_dual_level` kernel + a scalar
+    fixup — state (the dual-coded frontier row, dist/par rows) never
+    leaves the kernel layout between levels. Tiered layouts and
+    geometries past the key/VMEM bounds degrade to the round-3 "pallas"
+    program at trace time (same contract surface:
+    ``fn(nbr, deg, aux, src, dst)``)."""
     from bibfs_tpu.ops.pallas_fused import (
         INF32 as FINF,
+        dual_seed,
         fused_dual_level,
         fused_fits,
-        pack_frontier_fused,
+        key_stride,
         prepare_fused_tables,
     )
 
@@ -519,13 +522,12 @@ def _build_fused_kernel(tier_meta: tuple = ()):
             return _build_kernel("pallas", 0, tier_meta)(nbr, deg, aux, src, dst)
         nbr_t, deg2 = prepare_fused_tables(nbr, deg)
         n_rows_p = nbr_t.shape[1]
+        ks = key_stride(n_pad)
         src32 = src.astype(jnp.int32)
         dst32 = dst.astype(jnp.int32)
 
         def side(v):
-            fr = jnp.zeros(n_pad, jnp.bool_).at[v].set(True)
             return dict(
-                fw=pack_frontier_fused(fr, n_rows_p),
                 dist=jnp.full((1, n_rows_p), INF32, jnp.int32)
                 .at[0, v].set(0),
                 par=jnp.full((1, n_rows_p), -1, jnp.int32),
@@ -538,6 +540,7 @@ def _build_fused_kernel(tier_meta: tuple = ()):
         st = {f"{k}_s": v for k, v in side(src).items()}
         st.update({f"{k}_t": v for k, v in side(dst).items()})
         st.update(
+            dual=dual_seed(src, dst, n_rows_p),
             best=jnp.where(src == dst, 0, INF32).astype(jnp.int32),
             meet=jnp.where(src == dst, src32, -1).astype(jnp.int32),
             levels=jnp.int32(0),
@@ -545,17 +548,17 @@ def _build_fused_kernel(tier_meta: tuple = ()):
         )
 
         def body(st):
-            (fws, fwt, dist_s, dist_t, par_s, par_t,
+            (dual, dist_s, dist_t, par_s, par_t,
              cnt_s, cnt_t, md_s, md_t, ds_s, ds_t, mval, midx) = (
                 fused_dual_level(
-                    st["fw_s"], st["fw_t"], nbr_t, deg2,
+                    st["dual"], nbr_t, deg2,
                     st["dist_s"], st["dist_t"], st["par_s"], st["par_t"],
-                    st["lvl_s"] + 1, st["lvl_t"] + 1,
+                    st["lvl_s"] + 1, st["lvl_t"] + 1, ks=ks,
                 )
             )
             take = mval < st["best"]
             return {
-                "fw_s": fws, "fw_t": fwt,
+                "dual": dual,
                 "dist_s": dist_s, "dist_t": dist_t,
                 "par_s": par_s, "par_t": par_t,
                 "cnt_s": cnt_s, "cnt_t": cnt_t,

@@ -489,34 +489,32 @@ def _bibfs_shard_body(
 
 def _sharded_fused_ok(geom: tuple | None, tier_meta: tuple) -> bool:
     """Whether the 1D mesh can run the whole-level fused kernel: plain
-    ELL, per-shard rows in whole 4096-vertex tiles (so each shard's flat
-    packed words are a contiguous slice of the GLOBAL word array — build
-    the graph with ``pad_multiple = 4096 * ndev``), and the global id
-    space within the kernel's chunk bound."""
-    from bibfs_tpu.ops.pallas_fused import TILE, fused_fits
+    ELL within the v2 key/VMEM bounds. (v1 additionally required
+    per-shard rows in whole 4096-vertex tiles for its packed-word
+    exchange; the v2 exchange gathers the dual row directly, so any
+    shard size qualifies.)"""
+    from bibfs_tpu.ops.pallas_fused import fused_fits
 
     if geom is None or tier_meta:
         return False
     n_loc, id_space, width = geom
-    return n_loc % TILE == 0 and fused_fits(
-        n_loc, id_space=id_space, width=width
-    )
+    return fused_fits(n_loc, id_space=id_space, width=width)
 
 
 def _sharded_fused_prog(axis: str):
     """Per-shard whole-level-kernel program (mode "fused" on the 1D
-    mesh): a lock-step round is ONE word-plane all_gather (both sides in
-    one collective, the round-3 dual exchange carried over), ONE fused
-    kernel call over the local rows against the GLOBAL packed frontier,
-    and three scalar collectives (stacked psum, stacked pmax, global
-    min/argmin meet vote) — versus the ~10 XLA op groups per round of
-    the sync path. State stays in kernel layout between rounds (flat
-    local packed words + [1, n_loc] dist/par rows)."""
+    mesh, v2): a lock-step round is ONE bitpacked dual-frontier
+    all_gather (``all_gather_bits_dual`` — both word planes in one
+    collective, n/4 wire bytes), the XLA dual gather + ONE fused kernel
+    over the local rows, and three scalar collectives (stacked psum,
+    stacked pmax, global min/argmin meet vote) — versus the ~10 XLA op
+    groups per round of the sync path. Local rows pad to the kernel's
+    4096-lane tile internally; no shard-size alignment is required."""
     from bibfs_tpu.ops.pallas_fused import (
         fused_dual_level,
-        pack_frontier_words,
+        key_stride,
+        pad_rows,
         prepare_fused_tables,
-        words_to_chunks,
     )
 
     def prog(nbr, deg, aux, src, dst):
@@ -526,19 +524,22 @@ def _sharded_fused_prog(axis: str):
         me = jax.lax.axis_index(axis)
         offset = (me * n_loc).astype(jnp.int32)
         n_glob = n_loc * ndev
-        wloc = n_loc // 32
+        glob_p = pad_rows(n_glob)
         nbr_t, deg2 = prepare_fused_tables(nbr, deg, id_space=n_glob)
+        n_rows_p = nbr_t.shape[1]
+        ks = key_stride(n_glob)
         ids = offset + jnp.arange(n_loc, dtype=jnp.int32)
 
         def seed(v):
             fr = ids == v
             dv = sum_allreduce(jnp.sum(jnp.where(fr, deg, 0)), axis)
             return dict(
-                fw=pack_frontier_words(fr, n_loc),
-                dist=jnp.where(fr, 0, INF32)
-                .astype(jnp.int32).reshape(1, n_loc),
+                dist=jnp.where(
+                    jnp.pad(fr, (0, n_rows_p - n_loc)), 0, INF32
+                ).astype(jnp.int32).reshape(1, n_rows_p),
                 par=jax.lax.pcast(
-                    jnp.full((1, n_loc), -1, jnp.int32), axis, to="varying"
+                    jnp.full((1, n_rows_p), -1, jnp.int32), axis,
+                    to="varying",
                 ),
                 cnt=jnp.int32(1),
                 md=dv,
@@ -548,6 +549,11 @@ def _sharded_fused_prog(axis: str):
 
         st = {f"{k}_s": v for k, v in seed(src).items()}
         st.update({f"{k}_t": v for k, v in seed(dst).items()})
+        dual0 = ((ids == src).astype(jnp.int32)
+                 | ((ids == dst).astype(jnp.int32) << 1))
+        st.update(
+            dual=jnp.pad(dual0, (0, n_rows_p - n_loc)).reshape(1, n_rows_p),
+        )
         st.update(
             best=jnp.where(src == dst, 0, INF32).astype(jnp.int32),
             meet=jnp.where(src == dst, src, -1).astype(jnp.int32),
@@ -556,19 +562,24 @@ def _sharded_fused_prog(axis: str):
         )
 
         def body(st):
-            # ONE collective carries both sides' word planes (each
-            # shard's flat words are a contiguous global slice)
-            both = jnp.stack([st["fw_s"], st["fw_t"]])  # (2, wloc)
-            allw = jax.lax.all_gather(both, axis)  # (ndev, 2, wloc)
-            glob = jnp.swapaxes(allw, 0, 1).reshape(2, ndev * wloc)
-            (fws_l, fwt_l, dist_s, dist_t, par_s, par_t,
+            # ONE bitpacked collective carries both sides (the round-3
+            # dual exchange): returns the pack_dual-coded GLOBAL frontier.
+            # The bit-extract feeding it is a single elementwise chain off
+            # the carried dual row (fuses into the pack)
+            loc = st["dual"][0, :n_loc]
+            dual_glob = all_gather_bits_dual(
+                (loc & 1) > 0, (loc & 2) > 0, axis
+            ).astype(jnp.int32)
+            dual_row = jnp.pad(
+                dual_glob, (0, glob_p - n_glob)
+            ).reshape(1, glob_p)
+            (dual_l, dist_s, dist_t, par_s, par_t,
              cnt_s, cnt_t, md_s, md_t, ds_s, ds_t, mval, midx) = (
                 fused_dual_level(
-                    words_to_chunks(glob[0], n_glob),
-                    words_to_chunks(glob[1], n_glob),
-                    nbr_t, deg2, st["dist_s"], st["dist_t"],
+                    dual_row, nbr_t, deg2,
+                    st["dist_s"], st["dist_t"],
                     st["par_s"], st["par_t"],
-                    st["lvl_s"] + 1, st["lvl_t"] + 1,
+                    st["lvl_s"] + 1, st["lvl_t"] + 1, ks=ks,
                 )
             )
             sums = sum_allreduce(
@@ -579,8 +590,7 @@ def _sharded_fused_prog(axis: str):
             gmin, garg = global_min_and_argmin(mval, gid, axis)
             take = gmin < st["best"]
             return {
-                "fw_s": fws_l.reshape(-1)[:wloc],
-                "fw_t": fwt_l.reshape(-1)[:wloc],
+                "dual": dual_l,
                 "dist_s": dist_s, "dist_t": dist_t,
                 "par_s": par_s, "par_t": par_t,
                 "cnt_s": sums[0], "cnt_t": sums[1],
@@ -599,8 +609,8 @@ def _sharded_fused_prog(axis: str):
         return (
             out["best"],
             out["meet"],
-            out["par_s"].reshape(-1),
-            out["par_t"].reshape(-1),
+            out["par_s"][0, :n_loc],
+            out["par_t"][0, :n_loc],
             out["levels"],
             out["edges"],
         )
@@ -708,8 +718,8 @@ def _warn_fused_degrade(geom, tier_meta, why: str | None = None) -> None:
     would let 'fused'-labeled timings describe the round-3 kernel."""
     if why is None:
         why = ("tiered layout" if tier_meta else
-               f"per-shard rows not whole 4096-vertex tiles (geom={geom}); "
-               "build with ShardedGraph.build(..., pad_multiple=4096*ndev)")
+               f"geometry outside the fused kernel's key/VMEM bounds "
+               f"(geom={geom}; see pallas_fused.fused_fits)")
     key = (geom, why)
     if key in _FUSED_DEGRADE_WARNED:
         return
@@ -954,16 +964,13 @@ def time_batch_sharded(
 
 
 def default_pad_multiple(mode: str, ndev: int) -> int:
-    """The vertex padding a freshly built graph needs for ``mode``: the
-    fused whole-level kernel wants whole 4096-vertex tiles per shard
-    (:func:`_sharded_fused_ok`); everything else tiles on the int32
-    sublane quantum. Callers building graphs FOR a known mode (the CLI
-    surfaces, ``timing.time_backend``) route through this so
-    ``--mode fused`` actually runs the fused program instead of silently
-    degrading on an unqualified layout."""
-    from bibfs_tpu.ops.pallas_fused import TILE
-
-    return (TILE if mode == "fused" else 8) * ndev
+    """The vertex padding a freshly built graph needs for ``mode``.
+    Every current mode tiles on the int32 sublane quantum (the v2 fused
+    program pads its local rows internally, so the v1-era 4096-tile
+    shard alignment is gone); the hook stays so the CLI surfaces keep
+    routing through one place if a mode ever needs special padding."""
+    del mode
+    return 8 * ndev
 
 
 def solve_sharded(

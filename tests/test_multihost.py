@@ -56,7 +56,7 @@ print("MH_RESULT", idx, int(np.asarray(out[0])), flush=True)
 # the whole-level fused kernel per shard (round-4 mode "fused"): its
 # word-plane all_gather and scalar votes now cross the process boundary
 from bibfs_tpu.solvers.sharded import _shard_geom
-gf = ShardedGraph.build(n, edges, mesh, pad_multiple=4096 * 8)
+gf = ShardedGraph.build(n, edges, mesh)  # v2: no shard alignment needed
 fnf = _compiled_sharded(
     mesh, VERTEX_AXIS, "fused", 0, gf.tier_meta, _shard_geom(gf)
 )
