@@ -1,0 +1,198 @@
+"""Deviceless TPU compile audit of every kernel-bearing program.
+
+Runs the full XLA:TPU + Mosaic pipeline (utils/tpu_aot.py; libtpu, no
+chip, no tunnel) over the solver program matrix and writes one record
+per program to ``AOT_AUDIT.json`` — the truthful, locally-reproducible
+answer to "which of this framework's programs compile for TPU", which
+rounds 2-4 could otherwise only ask through the tunnel lottery.
+
+Dense programs compile against a single abstract v5e device; the 1D
+sharded collective programs compile against an abstract 4-device v5e
+2x2 mesh (collectives and shard_map included). The 2D block programs
+and the tiered sharded aux pytree need constructed device graphs
+(device_put — impossible deviceless) and are covered by the virtual-CPU
+mesh tests plus the on-chip mesh1 session item instead; the audit
+records them as "not-auditable-deviceless" rather than silently
+omitting them.
+
+Usage: python scripts/aot_audit.py [--out AOT_AUDIT.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--out", default=os.path.join(REPO, "AOT_AUDIT.json"))
+    ap.add_argument("--n", type=int, default=100_000)
+    args = ap.parse_args(argv)
+
+    from bibfs_tpu.utils.platform import force_cpu
+
+    force_cpu()
+
+    import numpy as np
+    from unittest import mock
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    from bibfs_tpu.graph.csr import build_ell, build_tiered
+    from bibfs_tpu.graph.generate import gnp_random_graph, rmat_graph
+    from bibfs_tpu.parallel.mesh import VERTEX_AXIS
+    from bibfs_tpu.utils.tpu_aot import aot_available, aot_compile_tpu, tpu_topology
+
+    records: list[dict] = []
+    t_all = time.time()
+
+    def record(program: str, ok, err, t0):
+        rec = dict(
+            program=program, ok=bool(ok),
+            error=(err or "")[:300] or None,
+            elapsed_s=round(time.time() - t0, 1),
+        )
+        records.append(rec)
+        print(("OK  " if ok else "FAIL"), program,
+              "" if ok else f"-> {rec['error']}", flush=True)
+
+    if not aot_available():
+        record("topology", False, "TPU topology API unavailable", t_all)
+    else:
+        n = args.n
+        edges = gnp_random_graph(n, 2.2 / n, seed=1)
+
+        # ---- dense matrix (single abstract device) ----
+        from bibfs_tpu.solvers.dense import _build_kernel, kernel_cap
+
+        gell = build_ell(n, edges)
+        nt, et = rmat_graph(14, edge_factor=8, seed=1)
+        gt = build_tiered(nt, et)
+        t_aux = (np.asarray(gt.hub_rank),
+                 tuple((np.asarray(t.nbr),
+                        np.asarray(gt.hub_ids[: t.nbr.shape[0]]))
+                       for t in gt.tiers))
+        tier_meta = tuple((t.start, t.count, t.nbr.shape[1]) for t in gt.tiers)
+        dense_cases = [
+            ("dense/sync/ell", "sync", gell, (), ()),
+            ("dense/sync_unfused/ell", "sync_unfused", gell, (), ()),
+            ("dense/alt/ell", "alt", gell, (), ()),
+            ("dense/beamer/ell", "beamer", gell, (), ()),
+            ("dense/fused/ell", "fused", gell, (), ()),
+            ("dense/pallas/ell", "pallas", gell, (), ()),
+            ("dense/sync/tiered", "sync", gt, t_aux, tier_meta),
+            ("dense/beamer/tiered", "beamer", gt, t_aux, tier_meta),
+            ("dense/pallas/tiered", "pallas", gt, t_aux, tier_meta),
+        ]
+        for name, mode, g, aux, tm in dense_cases:
+            t0 = time.time()
+            fn = _build_kernel(mode, kernel_cap(mode, g.n_pad), tm)
+            ok, err = aot_compile_tpu(
+                fn, np.asarray(g.nbr), np.asarray(g.deg), aux,
+                np.int32(0), np.int32(g.n - 1),
+            )
+            record(name, ok, err, t0)
+
+        # dense batch kernel (vmapped search, B=4)
+        t0 = time.time()
+        batch_fn = jax.vmap(
+            _build_kernel("sync", 0, ()), in_axes=(None, None, None, 0, 0)
+        )
+        ok, err = aot_compile_tpu(
+            batch_fn, np.asarray(gell.nbr), np.asarray(gell.deg), (),
+            np.zeros(4, np.int32), np.full(4, n - 1, np.int32),
+        )
+        record("dense/batch4/sync/ell", ok, err, t0)
+
+        # checkpoint chunk kernel (chunked dense execution)
+        t0 = time.time()
+        try:
+            from bibfs_tpu.solvers.checkpoint import _dense_chunk_kernel
+
+            kern = _dense_chunk_kernel("sync", 0, (), 8)
+            from bibfs_tpu.solvers.dense import _init_state
+
+            def chunk_prog(nbr, deg, src, dst):
+                from bibfs_tpu.solvers.checkpoint import _strip
+
+                st = _init_state(nbr.shape[0], 1, src, dst, deg)
+                return kern(nbr, deg, (), _strip(st))
+
+            ok, err = aot_compile_tpu(
+                chunk_prog, np.asarray(gell.nbr), np.asarray(gell.deg),
+                np.int32(0), np.int32(n - 1),
+            )
+        except Exception as e:
+            ok, err = False, f"{type(e).__name__}: {e}"
+        record("dense/chunked/sync/ell", ok, err, t0)
+
+        # ---- 1D sharded collective programs (abstract 4-device mesh) ----
+        topo = tpu_topology()
+        mesh = Mesh(np.array(topo.devices).reshape(4), (VERTEX_AXIS,))
+        sh = NamedSharding(mesh, P(VERTEX_AXIS))
+        rep = NamedSharding(mesh, P())
+        g4 = build_ell(n, edges, pad_multiple=8 * 4)
+        geom = (g4.n_pad // 4, g4.n_pad, g4.width)
+
+        def sd(shape, sharding):
+            return jax.ShapeDtypeStruct(shape, jnp.int32, sharding=sharding)
+
+        from bibfs_tpu.solvers.sharded import _sharded_fn
+
+        for mode in ("sync", "sync_unfused", "alt", "beamer", "fused",
+                     "pallas"):
+            t0 = time.time()
+            cap = kernel_cap(mode, g4.n_pad)
+            try:
+                fn = _sharded_fn(mesh, VERTEX_AXIS, mode, cap, (), geom)
+                with mock.patch.object(jax, "default_backend", lambda: "tpu"):
+                    jax.jit(fn).lower(
+                        sd((g4.n_pad, g4.width), sh), sd((g4.n_pad,), sh),
+                        (), sd((), rep), sd((), rep),
+                    ).compile()
+                ok, err = True, None
+            except Exception as e:
+                ok, err = False, f"{type(e).__name__}: {e}"
+            record(f"sharded4/{mode}/ell", ok, err, t0)
+
+        for name in ("sharded/tiered (aux pytree needs device_put)",
+                     "sharded2d (block build needs device_put)"):
+            records.append(dict(program=name, ok=None,
+                                error="not-auditable-deviceless; covered "
+                                      "by the CPU-mesh tests + mesh1 "
+                                      "session item", elapsed_s=0))
+
+    sha = subprocess.run(
+        ["git", "rev-parse", "--short", "HEAD"], cwd=REPO,
+        capture_output=True, text=True,
+    ).stdout.strip()
+    out = dict(
+        recorded=time.strftime("%Y-%m-%dT%H:%M:%S"),
+        git=sha or None,
+        jax=jax.__version__,
+        topology="v5e:2x2 (abstract, deviceless)",
+        total_s=round(time.time() - t_all, 1),
+        programs=records,
+    )
+    with open(args.out, "w") as f:
+        json.dump(out, f, indent=1)
+    n_ok = sum(1 for r in records if r["ok"])
+    n_fail = sum(1 for r in records if r["ok"] is False)
+    print(f"\n{n_ok} compile, {n_fail} fail, "
+          f"{len(records) - n_ok - n_fail} not auditable -> {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
