@@ -81,6 +81,12 @@ if out["compiles"]:
     from bibfs_tpu.ops.pallas_fused import fused_available
     out["fused_compiles"] = fused_available(g2.n_pad, g2.width)
     modes = ["sync", "pallas"] + (["fused"] if out["fused_compiles"] else [])
+    # record what each kernel mode RESOLVED to — a Mosaic-rejected mode's
+    # timing row must not masquerade as a kernel number (the AOT audit
+    # says 'pallas' resolves to the XLA path on real TPUs)
+    from bibfs_tpu.solvers.dense import _geom_of, _resolve_pallas_mode
+    out["resolved_modes"] = dict(
+        (m, _resolve_pallas_mode(m, _geom_of(g2))) for m in modes)
     for mode in modes:
         times = time_search_only(g2, 0, n2 - 1, repeats=8, mode=mode)
         out["{{}}_median_s".format(mode)] = float(np.median(times))
