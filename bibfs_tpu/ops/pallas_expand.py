@@ -1,57 +1,47 @@
-"""Pallas TPU kernel for frontier-masked ELL pull expansion.
+"""Pallas TPU kernel for frontier-masked ELL pull expansion (v2).
 
 This is the TPU-native answer to the reference's CUDA ``expand_frontier``
 kernel (v3/bibfs_cuda_only.cu:13-43, v4/comp.cu:20-38) — the component
 BASELINE.md's north star names as "becomes a Pallas kernel". The CUDA
 kernel is push-style (thread per frontier vertex, atomicExch claims); on
 TPU the same level is computed pull-style over the regularized ELL table
-(see :mod:`bibfs_tpu.ops.expand` for why), fusing
+(see :mod:`bibfs_tpu.ops.expand` for why).
 
-    gather frontier[nbr]  ->  mask  ->  any-reduce  ->
-    visited test  ->  first-hit parent select
+History — what deviceless compilation taught (round 4)
+------------------------------------------------------
+Rounds 2-3 tried to do the ``frontier[nbr]`` lookup INSIDE the kernel.
+Round 2's flat gather was rejected outright ("Only 2D gather is
+supported"); round 3 rebuilt it from equal-shape ``take_along_axis``
+windows over bit-packed frontier words — which interpret mode happily
+ran, but deviceless Mosaic compilation (``utils/tpu_aot.py``; libtpu,
+no chip needed) later proved ``tpu.dynamic_gather`` lowers only
+SINGLE-VREG gathers: lane-wise with <=128 lanes, sublane-wise with <=8
+sublanes ("Not implemented: Multiple source vregs along gather
+dimension"). The 4096-lane window gathers and the Wp-sublane parent
+gather could never compile; every real geometry failed.
 
-into one VMEM-resident pass per vertex tile.
+The v2 split (same as :mod:`bibfs_tpu.ops.pallas_fused`): the ONE
+arbitrary lookup goes to XLA *outside* the kernel —
 
-Why this shape of kernel — the Mosaic gather contract
------------------------------------------------------
-The obvious formulation (round 2 of this file) gathered the frontier at
-the neighbor ids with a flat ``frontier[nbr]``. Mosaic on the bench chip
-(v5e, jax/jaxlib 0.9.0) rejects that: its only vector gather is
-``tpu.dynamic_gather`` over a 2D operand where operand, indices, and
-output all share one shape — i.e. ``take_along_axis`` along lanes
-(``out[i,j] = x[i, idx[i,j]]``) or sublanes (``out[i,j] = x[idx[i,j], j]``)
-with equal shapes (jax/_src/pallas/mosaic/lowering.py, gather rule). An
-arbitrary-index lookup therefore has to be built from those two moves:
+    vals[Wp, n_rows_p] = frontier_row[nbr_t]     (one fused XLA op;
+    dual-coded int32 row when serving both sides of a lock-step round)
 
-- the ELL table is stored TRANSPOSED and sentinel-padded:
-  ``nbr_t int32[Wp, n_pad_p]`` — slot-major, one vertex per lane. Dead
-  slots hold the sentinel id ``n_pad_p`` whose frontier bit is always 0,
-  which deletes the degree/valid mask from the kernel entirely;
-- the frontier is BIT-PACKED into ``uint32`` words arranged
-  ``[chunks, Tc]``. For each chunk ``k`` (a ``Tc``-word = ``32*Tc``-vertex
-  window), the word row is lane-broadcast to ``[Wp, Tc]`` and the word of
-  every neighbor slot is fetched with a lane-wise ``take_along_axis`` —
-  the supported dynamic_gather — then the slot's bit is selected by a
-  logical shift. Chunks outside a slot's window contribute 0, so OR-ing
-  the per-chunk results reconstructs the full arbitrary gather;
-- per-vertex reductions (any-hit, first-hit slot) run along the SUBLANE
-  axis (slots), and the winning parent id is fetched from ``nbr_t`` with
-  the sublane-wise ``take_along_axis`` (the other supported gather form).
-
-Per level the kernel streams the ``[Wp, Tc]`` neighbor blocks HBM->VMEM
-exactly once (the dominant traffic, ``n_pad_p*Wp*4`` bytes); the packed
-frontier (``n_pad_p/8`` bytes) stays whole in VMEM across tiles. The
-chunk loop costs ``chunks`` lane-gathers per tile — one chunk covers
-``32*Tc`` (131072 at ``Tc=4096``) vertices, so every graph this framework
-benches at 1M vertices or below runs 1-8 chunks. No atomics anywhere: the
-parent choice is the deterministic first frontier neighbor in slot order,
-identical to :func:`bibfs_tpu.ops.expand.expand_pull`.
+— and the kernel owns everything Mosaic supports natively: the any-hit
+sublane reduction, the visited test, and the deterministic first-slot
+parent claim as a key-min over ``slot * KS + nbr`` (KS = id_space_p + 1,
+the key derived in-kernel from a sublane iota; no gather, no second
+table). The ELL table stays TRANSPOSED and sentinel-padded
+(``nbr_t int32[Wp, n_rows_p]``, dead slots point at the sentinel id
+``id_space_p`` whose frontier value is always 0 via the gather's
+appended pad slot), so no degree mask exists in-kernel.
 
 Portability: on non-TPU backends (the CPU test mesh) the kernel runs in
 Pallas interpret mode, so parity tests exercise the same kernel body
-everywhere. On TPU it compiles via Mosaic; :func:`pallas_available`
-probes an end-to-end compile+run once per process and the dense solver
-falls back to the XLA pull path if the probe fails
+everywhere — including INSIDE shard_map (the solvers relax the
+varying-axes check there, ``solvers/sharded._check_vma_for``). On TPU
+it compiles via Mosaic — verified DEVICELESS by ``scripts/aot_audit.py``
+— and :func:`pallas_available_at` still probes the real geometry at
+runtime with the XLA pull path as the fallback
 (:func:`bibfs_tpu.solvers.dense._resolve_pallas_mode`).
 """
 
@@ -64,22 +54,17 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 LANE = 128
-# lane-block (vertices per grid step, frontier words per chunk) candidates:
-# biggest divisor wins; n_pad_p is always a multiple of the smallest
+# lane-block (vertices per grid step) candidates: biggest divisor wins;
+# n_pad_p is always a multiple of the smallest
 LANE_BLOCKS = (4096, 2048, 1024, 512)
-# static chunk loops longer than this would unroll into absurd Mosaic
-# programs; callers route such graphs to the XLA path via pallas_fits()
-# (with _pad_n forcing Tc=4096 past 64k vertices, the limit trips just
-# past 8.3M vertices: 64 chunks * 4096 words * 32 bits)
-MAX_CHUNKS = 64
+
+_BIG = 2147483647  # int32 max: never wins a min
 
 
 def _pad_n(n_pad: int) -> int:
     """Vertex-dimension padding for the pallas layout. Small graphs pad to
     the 512 quantum; past 64k vertices pad all the way to the largest lane
-    block so ``_lane_block`` always picks Tc=4096 — the sentinel-only pad
-    rows cost at most ``Wp*4095*4`` bytes (~256 KB) while a pessimal
-    Tc=512 would cost 8x the chunk-loop work on every level."""
+    block so ``_lane_block`` always picks Tc=4096."""
     q = LANE_BLOCKS[0] if n_pad > (1 << 16) else LANE_BLOCKS[-1]
     return -(-n_pad // q) * q
 
@@ -91,50 +76,48 @@ def _lane_block(n_pad_p: int) -> int:
     raise ValueError(f"n_pad_p={n_pad_p} not a multiple of {LANE_BLOCKS[-1]}")
 
 
-def _word_geometry(id_space_p: int, tc: int) -> tuple[int, int]:
-    """(n_words_p, chunks): packed frontier words padded to whole chunks.
-    The sentinel id ``id_space_p`` needs no dedicated word: its word index
-    either falls outside every chunk window (the in-bounds mask zeroes it)
-    or lands in the zero-padded tail of the packed array — both read
-    as 0."""
-    chunks = -(-(id_space_p // 32) // tc)
-    return chunks * tc, chunks
+def _choose_tc(wp: int, n_rows_p: int) -> int | None:
+    """Largest lane block whose per-step working set fits VMEM — wide
+    tables simply take more, narrower grid steps (v2 has no per-step
+    frontier state, so Tc is a free choice). None when even the smallest
+    block cannot fit (degrade to the XLA path)."""
+    for t in LANE_BLOCKS:
+        if n_rows_p % t == 0 and _vmem_bytes(wp, t) <= VMEM_BUDGET_BYTES:
+            return t
+    return None
 
 
 # VMEM working-set budget for one grid step of the dual kernel. The chip
 # has ~16 MB of VMEM; leave headroom for Mosaic's own scratch and double
-# buffering. Streams per step: the [Wp, Tc] neighbor block, BOTH packed
-# frontiers ([chunks, Tc] each, resident across steps), the two visited
-# rows and the four output rows.
+# buffering. Streams per step: the [Wp, Tc] gathered-vals block, the
+# [Wp, Tc] neighbor block (parent keys), the visited rows and outputs.
 VMEM_BUDGET_BYTES = 12 * (1 << 20)
 
 
-def _vmem_bytes(wp: int, tc: int, chunks: int) -> int:
-    return (wp * tc + 2 * chunks * tc + 2 * tc + 4 * tc) * 4
+def _vmem_bytes(wp: int, tc: int) -> int:
+    return (2 * wp * tc + 8 * tc) * 4
 
 
 def pallas_fits(
     n_rows: int, id_space: int | None = None, width: int | None = None
 ) -> bool:
-    """Whether the compiled kernel fits this table geometry: the static
-    chunk loop within MAX_CHUNKS *and* (when ``width`` is given) the
-    per-grid-step working set within the VMEM budget — a plain-ELL graph
-    with a huge max degree streams a [Wp, Tc] block per step and would
-    otherwise die at Mosaic compile time instead of degrading
-    (ADVICE r3). ``n_rows`` = local vertex rows, frontier ids in
-    ``[0, id_space)`` (equal for the single-chip solver; ``id_space =
-    n_rows * ndev`` per shard under the 1D mesh). Callers (the
-    dense/sharded solvers and the checkpoint driver) route unfit graphs
-    to the XLA pull path."""
+    """Whether the compiled kernel fits this table geometry: the parent
+    key encoding ``(Wp-1)*KS + sentinel < 2^31`` and (when ``width`` is
+    given) the per-grid-step working set within the VMEM budget — a
+    plain-ELL graph with a huge max degree must degrade to the XLA path
+    instead of dying at Mosaic compile time (ADVICE r3). ``n_rows`` =
+    local vertex rows, frontier ids in ``[0, id_space)`` (equal for the
+    single-chip solver; ``id_space = n_rows * ndev`` per shard under the
+    1D mesh)."""
     n_rows_p = _pad_n(n_rows)
     id_space_p = _pad_n(id_space if id_space is not None else n_rows)
-    tc = _lane_block(n_rows_p)
-    chunks = _word_geometry(id_space_p, tc)[1]
-    if chunks > MAX_CHUNKS:
-        return False
+    ks = id_space_p + 1
     if width is not None:
-        return _vmem_bytes(_slot_pad(width), tc, chunks) <= VMEM_BUDGET_BYTES
-    return True
+        wp = _slot_pad(width)
+        if wp * ks >= (1 << 31):
+            return False
+        return _choose_tc(wp, n_rows_p) is not None
+    return 8 * ks < (1 << 31)
 
 
 def _slot_pad(width: int) -> int:
@@ -146,7 +129,7 @@ def sentinel_transposed_table(
     nbr: jnp.ndarray, deg: jnp.ndarray, n_rows_p: int, sent: int, wp: int
 ) -> jnp.ndarray:
     """THE shared table transform of both Pallas kernels: mask dead slots
-    to the sentinel id (whose frontier bit always reads 0), pad to
+    to the sentinel id (whose frontier value always reads 0), pad to
     ``(n_rows_p, wp)``, transpose to slot-major ``[wp, n_rows_p]``."""
     n_rows, width = nbr.shape
     mask = jnp.arange(width, dtype=jnp.int32)[None, :] < deg[:, None]
@@ -179,100 +162,206 @@ def prepare_pallas_tables(
     )
 
 
-def _pack_frontier(frontier: jnp.ndarray, n_words_p: int, tc: int) -> jnp.ndarray:
-    """bool[n_pad] -> packed int32[chunks, Tc] (bit v&31 of word v>>5).
-    Cheap XLA prologue fused into the level: O(n_pad) work vs the kernel's
-    table stream."""
-    bits = jnp.pad(
-        frontier.astype(jnp.uint32), (0, n_words_p * 32 - frontier.shape[0])
+def _gather_vals(fr_row: jnp.ndarray, nbr_t: jnp.ndarray) -> jnp.ndarray:
+    """THE per-level XLA op: frontier values of every neighbor slot.
+    ``fr_row`` is int32 over the id space; the sentinel (== id_space_p)
+    is out of range and reads 0 via the fill mode — no copy of the row
+    is made."""
+    return jnp.take(fr_row.reshape(-1), nbr_t, mode="fill", fill_value=0)
+
+
+def _side_from_vals(vals_bit, nbr, vis, ks: int):
+    """One side's (nf, parent) from the 0/1 hit block — sublane
+    reductions + the key-min parent claim (first hit slot; identical
+    semantics to ops.expand.expand_pull's argmax)."""
+    anyh = jnp.max(vals_bit, axis=0, keepdims=True)
+    key = jax.lax.broadcasted_iota(jnp.int32, nbr.shape, 0) * ks + nbr
+    kmin = jnp.min(
+        jnp.where(vals_bit > 0, key, jnp.int32(_BIG)), axis=0, keepdims=True
     )
-    words = jnp.sum(
-        bits.reshape(n_words_p, 32) << jnp.arange(32, dtype=jnp.uint32)[None, :],
-        axis=1,
-        dtype=jnp.uint32,
+    psel = kmin % ks
+    nf = jnp.where(vis > 0, 0, anyh)
+    return nf, psel
+
+
+def _pull_kernel(ks: int, vals_ref, nbr_ref, vis_ref, nf_ref, par_ref):
+    """One vertex tile of single-side pull expansion."""
+    nf, psel = _side_from_vals(
+        vals_ref[...] & 1, nbr_ref[...], vis_ref[...], ks
     )
-    return jax.lax.bitcast_convert_type(words, jnp.int32).reshape(-1, tc)
-
-
-def _hits_for(fw_ref, word, bit_ix, chunks: int, tc: int):
-    """Accumulate the per-slot frontier-bit lookups for one packed frontier
-    (the chunked arbitrary-gather; module docstring)."""
-    hit = jnp.zeros(word.shape, jnp.int32)
-    for k in range(chunks):  # static unroll; bounded by MAX_CHUNKS
-        local = word - k * tc
-        inb = (local >= 0) & (local < tc)
-        lidx = jnp.clip(local, 0, tc - 1)
-        tbl = jnp.broadcast_to(fw_ref[k : k + 1, :], word.shape)
-        g = jnp.take_along_axis(tbl, lidx, axis=1, mode="promise_in_bounds")
-        b = jax.lax.shift_right_logical(g, bit_ix) & 1
-        hit = hit | jnp.where(inb, b, 0)
-    return hit
-
-
-def _reduce_side(nbr, hit, vis, nf_ref, par_ref):
-    """First-hit slot + parent + visited test for one side (sublane
-    reductions and the sublane-wise parent gather; module docstring)."""
-    wp = nbr.shape[0]
-    slot = jax.lax.broadcasted_iota(jnp.int32, nbr.shape, 0)
-    m = jnp.max(jnp.where(hit > 0, wp - slot, 0), axis=0, keepdims=True)
-    j_star = jnp.clip(wp - m, 0, wp - 1)
-    psel = jnp.take_along_axis(
-        nbr, jnp.broadcast_to(j_star, nbr.shape), axis=0, mode="promise_in_bounds"
-    )
-    nf = (m > 0) & (vis == 0)
-    nf_ref[...] = nf.astype(jnp.int32)
-    # psel rows are identical (every sublane gathered slot j_star); the max
-    # is just a supported way to extract that one row
-    par_ref[...] = jnp.max(psel, axis=0, keepdims=True)
+    nf_ref[...] = nf
+    par_ref[...] = psel
 
 
 def _pull_kernel_dual(
-    chunks: int, tc: int,
-    fws_ref, fwt_ref, nbr_ref, viss_ref, vist_ref,
+    ks: int,
+    vals_ref, nbr_ref, viss_ref, vist_ref,
     nfs_ref, pars_ref, nft_ref, part_ref,
 ):
-    """Both sides of a lock-step level in ONE pass over the neighbor block
-    — the table stream (the dominant HBM traffic) is read once and feeds
-    two chunked gathers, mirroring the XLA path's
-    :func:`bibfs_tpu.ops.expand.expand_pull_dual`."""
+    """Both sides of a lock-step level from ONE dual-coded vals block
+    (one XLA gather served both sides, mirroring
+    :func:`bibfs_tpu.ops.expand.expand_pull_dual`)."""
+    vals = vals_ref[...]
     nbr = nbr_ref[...]
-    word = jax.lax.shift_right_logical(nbr, 5)
-    bit_ix = nbr & 31
-    _reduce_side(
-        nbr, _hits_for(fws_ref, word, bit_ix, chunks, tc), viss_ref[...],
-        nfs_ref, pars_ref,
+    nf_s, ps = _side_from_vals(vals & 1, nbr, viss_ref[...], ks)
+    nf_t, pt = _side_from_vals(
+        jax.lax.shift_right_logical(vals, 1) & 1, nbr, vist_ref[...], ks
     )
-    _reduce_side(
-        nbr, _hits_for(fwt_ref, word, bit_ix, chunks, tc), vist_ref[...],
-        nft_ref, part_ref,
+    nfs_ref[...] = nf_s
+    pars_ref[...] = ps
+    nft_ref[...] = nf_t
+    part_ref[...] = pt
+
+
+def _vma_of(*arrays) -> frozenset:
+    """Union of the inputs' varying-mesh-axes: under shard_map the
+    pallas_call's out_shape must declare how outputs vary across the mesh
+    (they vary exactly as the inputs do — per-shard rows)."""
+    out = frozenset()
+    for a in arrays:
+        try:
+            v = jax.typeof(a).vma
+        except AttributeError:
+            v = None
+        if v:
+            out |= frozenset(v)
+    return out
+
+
+def _check_kernel_geometry(wp: int, n_rows_p: int, ks: int) -> int:
+    """Trace-time guard for DIRECT kernel callers (the solvers gate via
+    pallas_fits first): the parent key must not overflow int32, and some
+    lane block must fit the VMEM budget — fail loudly instead of
+    returning silently-wrong parents or an opaque Mosaic error."""
+    if wp * ks >= (1 << 31):
+        raise ValueError(
+            f"pallas pull kernel: parent key slot*{ks}+nbr overflows int32 "
+            f"at Wp={wp}; route this geometry to the XLA path (pallas_fits)"
+        )
+    tc = _choose_tc(wp, n_rows_p)
+    if tc is None:
+        raise ValueError(
+            f"pallas pull kernel: no lane block fits the VMEM budget at "
+            f"Wp={wp}; route this geometry to the XLA path (pallas_fits)"
+        )
+    return tc
+
+
+@lru_cache(maxsize=None)
+def _get_pull_call(
+    wp: int, n_rows_p: int, ks: int, interpret: bool,
+    vma: frozenset = frozenset(),
+):
+    tc = _check_kernel_geometry(wp, n_rows_p, ks)
+    grid = n_rows_p // tc
+    kernel = lambda *refs: _pull_kernel(ks, *refs)  # noqa: E731
+    blk = pl.BlockSpec((wp, tc), lambda i: (0, i))
+    row = pl.BlockSpec((1, tc), lambda i: (0, i))
+    rs = jax.ShapeDtypeStruct((1, n_rows_p), jnp.int32, vma=vma)
+    return pl.pallas_call(
+        kernel,
+        grid=(grid,),
+        in_specs=[blk, blk, row],
+        out_specs=[row, row],
+        out_shape=[rs, rs],
+        interpret=interpret,
     )
 
 
 @lru_cache(maxsize=None)
 def _get_dual_call(
-    wp: int, n_rows_p: int, id_space_p: int, interpret: bool,
+    wp: int, n_rows_p: int, ks: int, interpret: bool,
     vma: frozenset = frozenset(),
 ):
-    tc = _lane_block(n_rows_p)
-    n_words_p, chunks = _word_geometry(id_space_p, tc)
-    if chunks > MAX_CHUNKS:
-        raise ValueError(
-            f"pallas pull kernel: {chunks} frontier chunks at id_space_p="
-            f"{id_space_p} exceeds MAX_CHUNKS={MAX_CHUNKS}; use the XLA path"
-        )
+    tc = _check_kernel_geometry(wp, n_rows_p, ks)
     grid = n_rows_p // tc
-    kernel = lambda *refs: _pull_kernel_dual(chunks, tc, *refs)  # noqa: E731
-    fw_spec = pl.BlockSpec((chunks, tc), lambda i: (0, 0))
-    col = pl.BlockSpec((1, tc), lambda i: (0, i))
+    kernel = lambda *refs: _pull_kernel_dual(ks, *refs)  # noqa: E731
+    blk = pl.BlockSpec((wp, tc), lambda i: (0, i))
+    row = pl.BlockSpec((1, tc), lambda i: (0, i))
+    rs = jax.ShapeDtypeStruct((1, n_rows_p), jnp.int32, vma=vma)
     return pl.pallas_call(
         kernel,
         grid=(grid,),
-        in_specs=[fw_spec, fw_spec, pl.BlockSpec((wp, tc), lambda i: (0, i)),
-                  col, col],
-        out_specs=[col, col, col, col],
-        out_shape=[jax.ShapeDtypeStruct((1, n_rows_p), jnp.int32, vma=vma)] * 4,
+        in_specs=[blk, blk, row, row],
+        out_specs=[row, row, row, row],
+        out_shape=[rs, rs, rs, rs],
         interpret=interpret,
     )
+
+
+def _prep_vis(visited, n_rows_p: int):
+    n_rows = visited.shape[0]
+    return jnp.pad(
+        visited.astype(jnp.int32), (0, n_rows_p - n_rows), constant_values=1
+    ).reshape(1, n_rows_p)
+
+
+_WARNED_SUBSTITUTION = False
+
+
+def _reference_pull_vals(vals, nbr_t, visp, ks: int):
+    """Value-level evaluation of EXACTLY the kernel math in plain XLA
+    ops. FALLBACK ONLY: the pallas HLO interpreter neither lifts literal
+    constants nor propagates vma through ref loads, so under a shard_map
+    that enforces varying-axes checking every mixed op in the kernel
+    body trips the check. The framework's own sharded programs disable
+    that check for interpret-mode pallas (solvers/sharded.
+    _check_vma_for), so the REAL kernel body runs under the CPU test
+    mesh; this substitution remains only for direct callers inside a
+    check_vma=True mesh — and says so on stderr once, so a regression in
+    the check_vma routing cannot silently put it back on the
+    kernel-validation path."""
+    global _WARNED_SUBSTITUTION
+    if not _WARNED_SUBSTITUTION:
+        _WARNED_SUBSTITUTION = True
+        import sys
+
+        print(
+            "pallas_expand: interpret mode under a check_vma mesh — "
+            "evaluating the kernel MATH value-level instead of the kernel "
+            "body (see _reference_pull_vals docstring)",
+            file=sys.stderr,
+        )
+    anyh = jnp.max(vals, axis=0, keepdims=True)
+    key = jax.lax.broadcasted_iota(jnp.int32, nbr_t.shape, 0) * ks + nbr_t
+    kmin = jnp.min(
+        jnp.where(vals > 0, key, jnp.int32(_BIG)), axis=0, keepdims=True
+    )
+    psel = kmin % ks
+    nf = jnp.where(visp > 0, 0, anyh)
+    return nf, psel
+
+
+def _run_pull(tables: tuple, frontier, visited, interpret: bool | None):
+    """``frontier`` is indexed by the ids stored in the table (GLOBAL
+    under sharding); ``visited`` covers the table's local rows."""
+    (nbr_t,) = tables
+    wp, n_rows_p = nbr_t.shape
+    n_rows = visited.shape[0]
+    id_space_p = _pad_n(frontier.shape[0])
+    ks = id_space_p + 1
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    fr_row = jnp.pad(
+        frontier.astype(jnp.int32), (0, id_space_p - frontier.shape[0])
+    )
+    vals = _gather_vals(fr_row, nbr_t)
+    visp = _prep_vis(visited, n_rows_p)
+    vma = _vma_of(vals, nbr_t, visp)
+    if interpret and vma:
+        nf2, par2 = _reference_pull_vals(vals, nbr_t, visp, ks)
+    else:
+        call = _get_pull_call(wp, n_rows_p, ks, interpret, vma)
+        nf2, par2 = call(vals, nbr_t, visp)
+    return nf2[0, :n_rows] > 0, par2[0, :n_rows]
+
+
+def run_pull(tables: tuple, frontier, visited, *, interpret: bool | None = None):
+    """Single-side raw kernel pass, mirroring the contract of
+    :func:`bibfs_tpu.ops.expand.expand_pull`: returns ``(next_frontier,
+    parent_candidate)`` over the table's LOCAL rows. ``frontier`` is
+    indexed by the ids stored in the table (GLOBAL under sharding)."""
+    return _run_pull(tables, frontier, visited, interpret)
 
 
 def run_pull_dual(
@@ -280,35 +369,29 @@ def run_pull_dual(
 ):
     """Both sides' raw kernel pass, mirroring the contract of
     :func:`bibfs_tpu.ops.expand.expand_pull_dual`: returns
-    ``(nf_s, pc_s, nf_t, pc_t)`` over the table's LOCAL rows. The
-    frontiers are indexed by the ids stored in the table (GLOBAL under
-    sharding); the visited sets cover the local rows."""
+    ``(nf_s, pc_s, nf_t, pc_t)`` over the table's LOCAL rows — ONE XLA
+    gather of the dual-coded frontier serves both sides."""
     (nbr_t,) = tables
     wp, n_rows_p = nbr_t.shape
     n_rows = vis_s.shape[0]
     id_space_p = _pad_n(fr_s.shape[0])
+    ks = id_space_p + 1
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    tc = _lane_block(n_rows_p)
-    n_words_p, _chunks = _word_geometry(id_space_p, tc)
-
-    def prep_vis(v):
-        return jnp.pad(
-            v.astype(jnp.int32), (0, n_rows_p - n_rows), constant_values=1
-        ).reshape(1, n_rows_p)
-
-    fws = _pack_frontier(fr_s, n_words_p, tc)
-    fwt = _pack_frontier(fr_t, n_words_p, tc)
-    visp_s = prep_vis(vis_s)
-    visp_t = prep_vis(vis_t)
-    vma = _vma_of(fws, fwt, nbr_t, visp_s, visp_t)
-    if interpret and vma:  # see _reference_pull_vals
-        chks = _word_geometry(id_space_p, tc)[1]
-        nfs2, ps2 = _reference_pull_vals(fws, nbr_t, visp_s, chks, tc)
-        nft2, pt2 = _reference_pull_vals(fwt, nbr_t, visp_t, chks, tc)
+    dual = fr_s.astype(jnp.int32) | (fr_t.astype(jnp.int32) << 1)
+    dual_row = jnp.pad(dual, (0, id_space_p - dual.shape[0]))
+    vals = _gather_vals(dual_row, nbr_t)
+    visp_s = _prep_vis(vis_s, n_rows_p)
+    visp_t = _prep_vis(vis_t, n_rows_p)
+    vma = _vma_of(vals, nbr_t, visp_s, visp_t)
+    if interpret and vma:
+        nfs2, ps2 = _reference_pull_vals(vals & 1, nbr_t, visp_s, ks)
+        nft2, pt2 = _reference_pull_vals(
+            jax.lax.shift_right_logical(vals, 1) & 1, nbr_t, visp_t, ks
+        )
     else:
-        call = _get_dual_call(wp, n_rows_p, id_space_p, interpret, vma)
-        nfs2, ps2, nft2, pt2 = call(fws, fwt, nbr_t, visp_s, visp_t)
+        call = _get_dual_call(wp, n_rows_p, ks, interpret, vma)
+        nfs2, ps2, nft2, pt2 = call(vals, nbr_t, visp_s, visp_t)
     return (
         nfs2[0, :n_rows] > 0,
         ps2[0, :n_rows],
@@ -349,152 +432,6 @@ def pallas_pull_level_dual(
     return nf_s, par_s, dist_s, md_s, nf_t, par_t, dist_t, md_t
 
 
-def _pull_kernel(chunks: int, tc: int, fw_ref, nbr_ref, vis_ref, nf_ref, par_ref):
-    """One vertex tile (Tc lanes) of pull expansion. Refs:
-    fw_ref int32[chunks, Tc] (whole packed frontier, VMEM-resident),
-    nbr_ref int32[Wp, Tc] (transposed ELL block), vis_ref int32[1, Tc];
-    outputs nf_ref int32[1, Tc], par_ref int32[1, Tc]."""
-    nbr = nbr_ref[...]
-    word = jax.lax.shift_right_logical(nbr, 5)
-    bit_ix = nbr & 31
-    _reduce_side(
-        nbr, _hits_for(fw_ref, word, bit_ix, chunks, tc), vis_ref[...],
-        nf_ref, par_ref,
-    )
-
-
-_WARNED_SUBSTITUTION = False
-
-
-def _reference_pull_vals(fw, nbr_t, visp, chunks: int, tc: int):
-    """Value-level evaluation of EXACTLY the kernel math (same window
-    geometry, same first-slot reduction) in plain XLA ops. FALLBACK ONLY:
-    the pallas HLO interpreter neither lifts literal constants nor
-    propagates vma through ref loads, so under a shard_map that enforces
-    varying-axes checking every mixed op in the kernel body trips the
-    check. The framework's own sharded programs now disable that check
-    for interpret-mode pallas (solvers/sharded._check_vma_for), so the
-    REAL kernel body runs under the CPU test mesh (VERDICT r3 weak #2,
-    regression-tested by test_sharded_pallas_runs_real_kernel_body);
-    this substitution remains only for direct run_pull callers inside a
-    check_vma=True mesh — and says so on stderr once, so a regression in
-    the solvers' check_vma routing cannot silently put it back on the
-    kernel-validation path. Returns ``(nf int32[1, n_rows_p], par
-    int32[1, n_rows_p])``."""
-    global _WARNED_SUBSTITUTION
-    if not _WARNED_SUBSTITUTION:
-        _WARNED_SUBSTITUTION = True
-        import sys
-
-        print(
-            "pallas_expand: interpret mode under a check_vma mesh — "
-            "evaluating the kernel MATH value-level instead of the kernel "
-            "body (see _reference_pull_vals docstring)",
-            file=sys.stderr,
-        )
-    word = jax.lax.shift_right_logical(nbr_t, 5)
-    bit_ix = nbr_t & 31
-    hit = jnp.zeros(nbr_t.shape, jnp.int32)
-    for k in range(chunks):
-        local = word - k * tc
-        inb = (local >= 0) & (local < tc)
-        lidx = jnp.clip(local, 0, tc - 1)
-        g = jnp.take(fw[k], lidx)  # XLA-native arbitrary gather
-        b = jax.lax.shift_right_logical(g, bit_ix) & 1
-        hit = hit | jnp.where(inb, b, 0)
-    wp = nbr_t.shape[0]
-    slot = jax.lax.broadcasted_iota(jnp.int32, nbr_t.shape, 0)
-    m = jnp.max(jnp.where(hit > 0, wp - slot, 0), axis=0, keepdims=True)
-    j_star = jnp.clip(wp - m, 0, wp - 1)
-    psel = jnp.take_along_axis(
-        nbr_t, jnp.broadcast_to(j_star, nbr_t.shape), axis=0
-    )
-    nf = (m > 0) & (visp == 0)
-    return nf.astype(jnp.int32), jnp.max(psel, axis=0, keepdims=True)
-
-
-def _vma_of(*arrays) -> frozenset:
-    """Union of the inputs' varying-mesh-axes: under shard_map the
-    pallas_call's out_shape must declare how outputs vary across the mesh
-    (they vary exactly as the inputs do — per-shard rows)."""
-    out = frozenset()
-    for a in arrays:
-        try:
-            v = jax.typeof(a).vma
-        except AttributeError:
-            v = None
-        if v:
-            out |= frozenset(v)
-    return out
-
-
-@lru_cache(maxsize=None)
-def _get_pull_call(
-    wp: int, n_rows_p: int, id_space_p: int, interpret: bool,
-    vma: frozenset = frozenset(),
-):
-    tc = _lane_block(n_rows_p)
-    n_words_p, chunks = _word_geometry(id_space_p, tc)
-    if chunks > MAX_CHUNKS:
-        raise ValueError(
-            f"pallas pull kernel: {chunks} frontier chunks at id_space_p="
-            f"{id_space_p} exceeds MAX_CHUNKS={MAX_CHUNKS}; use the XLA path"
-        )
-    grid = n_rows_p // tc
-    kernel = lambda *refs: _pull_kernel(chunks, tc, *refs)  # noqa: E731
-    return pl.pallas_call(
-        kernel,
-        grid=(grid,),
-        in_specs=[
-            pl.BlockSpec((chunks, tc), lambda i: (0, 0)),  # whole packed frontier
-            pl.BlockSpec((wp, tc), lambda i: (0, i)),
-            pl.BlockSpec((1, tc), lambda i: (0, i)),
-        ],
-        out_specs=[
-            pl.BlockSpec((1, tc), lambda i: (0, i)),
-            pl.BlockSpec((1, tc), lambda i: (0, i)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((1, n_rows_p), jnp.int32, vma=vma),
-            jax.ShapeDtypeStruct((1, n_rows_p), jnp.int32, vma=vma),
-        ],
-        interpret=interpret,
-    )
-
-
-def _run_pull(tables: tuple, frontier, visited, interpret: bool | None):
-    """``frontier`` is indexed by the ids stored in the table (GLOBAL
-    under sharding); ``visited`` covers the table's local rows."""
-    (nbr_t,) = tables
-    wp, n_rows_p = nbr_t.shape
-    n_rows = visited.shape[0]
-    id_space_p = _pad_n(frontier.shape[0])
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
-    tc = _lane_block(n_rows_p)
-    n_words_p, _chunks = _word_geometry(id_space_p, tc)
-    fw = _pack_frontier(frontier, n_words_p, tc)
-    visp = jnp.pad(
-        visited.astype(jnp.int32), (0, n_rows_p - n_rows), constant_values=1
-    ).reshape(1, n_rows_p)
-    vma = _vma_of(fw, nbr_t, visp)
-    if interpret and vma:
-        _chks = _word_geometry(id_space_p, tc)[1]
-        nf2, par2 = _reference_pull_vals(fw, nbr_t, visp, _chks, tc)
-    else:
-        call = _get_pull_call(wp, n_rows_p, id_space_p, interpret, vma)
-        nf2, par2 = call(fw, nbr_t, visp)
-    return nf2[0, :n_rows] > 0, par2[0, :n_rows]
-
-
-def run_pull(tables: tuple, frontier, visited, *, interpret: bool | None = None):
-    """Single-side raw kernel pass, mirroring the contract of
-    :func:`bibfs_tpu.ops.expand.expand_pull`: returns ``(next_frontier,
-    parent_candidate)`` over the table's LOCAL rows. ``frontier`` is
-    indexed by the ids stored in the table (GLOBAL under sharding)."""
-    return _run_pull(tables, frontier, visited, interpret)
-
-
 def expand_pull_pallas(
     frontier: jnp.ndarray,  # bool[n_pad]
     visited: jnp.ndarray,  # bool[n_pad]
@@ -509,7 +446,7 @@ def expand_pull_pallas(
 
     Prepares the transposed table on every call — fine for tests and
     one-shot use; the solver prepares once via
-    :func:`prepare_pallas_tables` and calls :func:`pallas_pull_level`.
+    :func:`prepare_pallas_tables`.
 
     ``interpret`` defaults to True off-TPU (CPU test mesh) and False on
     TPU. jit/while_loop-safe: the flag is resolved at trace time.
@@ -537,7 +474,7 @@ def pallas_pull_level(
     nf, pcand = _run_pull(tables, frontier, visited, None)
     par = jnp.where(nf, pcand, par)
     nf, par = apply_tiers(nf, par, frontier, visited, deg, tiers, n_pad)
-    dist = jnp.where(nf & ~visited, lvl_next, dist)
+    dist = jnp.where(nf & (dist >= inf), lvl_next, dist)
     max_deg = jnp.max(jnp.where(nf, deg, 0))
     return nf, par, dist, max_deg
 
@@ -545,14 +482,10 @@ def pallas_pull_level(
 @lru_cache(maxsize=None)
 def pallas_available() -> bool:
     """Probe whether the Pallas pull kernel compiles+runs AT ALL on the
-    current default backend (Mosaic gather support varies by version) —
-    a cheap toy-shape smoke test, memoized per process (it used to
-    re-dispatch the probe kernels on every kernel lookup through the
-    high-latency tunneled backend, ADVICE r3). The real gate for a
-    concrete graph is :func:`pallas_available_at`, which compiles the
-    actual geometry: Mosaic failures are frequently shape-dependent
-    (VERDICT r3 weak #1), so a toy pass does not prove the bench shape
-    compiles."""
+    current default backend — a cheap toy-shape smoke test, memoized per
+    process (ADVICE r3). The real gate for a concrete graph is
+    :func:`pallas_available_at`, which compiles the actual geometry
+    (Mosaic failures can be shape-dependent, VERDICT r3 weak #1)."""
     try:
         import numpy as np
 
@@ -561,8 +494,6 @@ def pallas_available() -> bool:
         deg = jnp.zeros(n, jnp.int32)
         fr = jnp.zeros(n, jnp.bool_)
         nf, _ = expand_pull_pallas(fr, fr, nbr, deg)
-        # the dual (lock-step) kernel must compile too — the sync schedule
-        # routes through it
         zero = jnp.zeros(n, jnp.int32)
         inf_d = jnp.full(n, 1 << 30, jnp.int32)
         nf_s, *_rest = pallas_pull_level_dual(
@@ -586,7 +517,7 @@ def _pallas_available_at_padded(
     try:
         import numpy as np
 
-        nbr_t = jnp.full((wp, n_rows_p), _pad_n(id_space_p), jnp.int32)
+        nbr_t = jnp.full((wp, n_rows_p), id_space_p, jnp.int32)
         tables = (nbr_t,)
         fr = jnp.zeros(id_space_p, jnp.bool_)
         vis = jnp.zeros(n_rows_p, jnp.bool_)
@@ -605,11 +536,10 @@ def pallas_available_at(
     n_rows: int, id_space: int | None = None, width: int = 1
 ) -> bool:
     """Compile+run the single AND dual kernels at the REAL padded
-    geometry — (Tc, chunks, Wp) exactly as the target graph will use
-    them — and read a value back. Memoized on the padded geometry, so
-    graphs sharing a padded shape share one probe; the compiled kernels
-    land in jax's executable cache for the solve to reuse. Only
-    meaningful on the compiled (TPU) path; interpret mode always works."""
+    geometry and read a value back. Memoized on the padded geometry;
+    the compiled kernels land in jax's executable cache for the solve to
+    reuse. Only meaningful on the compiled (TPU) path; interpret mode
+    always works."""
     if jax.default_backend() != "tpu":
         return True
     n_rows_p = _pad_n(n_rows)

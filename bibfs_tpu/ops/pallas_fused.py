@@ -134,12 +134,11 @@ def dual_seed(src, dst, n_rows_p: int) -> jnp.ndarray:
 def gather_vals(dual_row: jnp.ndarray, nbr_t: jnp.ndarray) -> jnp.ndarray:
     """THE per-level XLA op: dual frontier bits of every neighbor slot.
     ``dual_row`` spans the ID SPACE (``[1, id_space_p]`` — the global
-    row under sharding); the sentinel index ``id_space_p`` reads the
-    appended zero."""
-    dual_pad = jnp.concatenate(
-        [dual_row.reshape(-1), jnp.zeros(1, jnp.int32)]
-    )
-    return jnp.take(dual_pad, nbr_t, mode="fill", fill_value=0)
+    row under sharding); the sentinel index ``id_space_p`` is out of
+    range and reads 0 via the fill mode."""
+    # the sentinel index (== id_space_p) is out of range and reads 0 via
+    # the fill mode — no copy of the row is made
+    return jnp.take(dual_row.reshape(-1), nbr_t, mode="fill", fill_value=0)
 
 
 def _fused_kernel(
@@ -233,6 +232,11 @@ def _fused_kernel(
 @lru_cache(maxsize=None)
 def _get_fused_call(wp: int, n_rows_p: int, ks: int, interpret: bool,
                     vma: frozenset = frozenset()):
+    if wp * ks >= (1 << 31):
+        raise ValueError(
+            f"fused level kernel: parent key slot*{ks}+nbr overflows int32 "
+            f"at Wp={wp}; route this geometry elsewhere (fused_fits)"
+        )
     grid = n_rows_p // TILE
     kernel = lambda *refs: _fused_kernel(ks, *refs)  # noqa: E731
     blk = pl.BlockSpec((wp, TILE), lambda i: (0, i))

@@ -367,15 +367,14 @@ def _side_step(
 # top-down/bottom-up switching — BASELINE.json config scope, never in the
 # reference). "pallas" variants run the base-table pull as the fused Pallas
 # kernel (ops/pallas_expand.py — the v3 expand_frontier analog the north
-# star names) with hub tiers as XLA ops; interpret-mode off-TPU (the
-# AOT audit shows this kernel does NOT compile on TPU — Mosaic's
-# single-vreg gather limit — so on-chip it degrades via its geometry
-# probes). "fused" runs the ENTIRE lock-step level as one XLA dual
-# gather + ONE whole-level kernel (ops/pallas_fused.py, v2 — the
-# formulation that DOES compile, AOT_AUDIT.json): the per-level
-# op-group count, which the tunneled backend charges ~2 ms each for
-# (PERF_NOTES §2), drops to gather + kernel + one scalar fixup. Plain
-# ELL only; tiered or key/VMEM-unfit graphs degrade at trace time.
+# star names) with hub tiers as XLA ops; interpret-mode off-TPU; the
+# v2 rebuild (XLA gather + reduction/key-min kernel) compiles on TPU at
+# every audited geometry (AOT_AUDIT.json). "fused" runs the ENTIRE
+# lock-step level as one XLA dual gather + ONE whole-level kernel
+# (ops/pallas_fused.py): the per-level op-group count, which the
+# tunneled backend charges ~2 ms each for (PERF_NOTES §2), drops to
+# gather + kernel + one scalar fixup. Plain ELL only; tiered or
+# key/VMEM-unfit graphs degrade at trace time.
 DENSE_MODES = {
     "sync": ("sync", False, False),
     "alt": ("alt", False, False),

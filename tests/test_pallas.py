@@ -40,23 +40,13 @@ def test_expand_pull_pallas_matches_xla(seed):
 
 
 def test_pallas_geometry_invariants():
-    from bibfs_tpu.ops.pallas_expand import (
-        _lane_block,
-        _pad_n,
-        _slot_pad,
-        _word_geometry,
-    )
+    from bibfs_tpu.ops.pallas_expand import _lane_block, _pad_n, _slot_pad
 
     for n_pad in (8, 16, 1000, 1024, 100000, 123456 // 8 * 8, 1 << 20):
         n_pad_p = _pad_n(n_pad)
         assert n_pad_p >= n_pad and n_pad_p % 512 == 0
         tc = _lane_block(n_pad_p)
         assert n_pad_p % tc == 0 and tc % 128 == 0
-        n_words_p, chunks = _word_geometry(n_pad_p, tc)
-        assert n_words_p == chunks * tc
-        # every real vertex id has a word to read; the sentinel n_pad_p
-        # needs none (its word index is masked or reads a zero pad word)
-        assert n_words_p * 32 >= n_pad_p
     for width in (1, 2, 7, 8, 9, 16, 100):
         wp = _slot_pad(width)
         assert wp >= width and wp % 8 == 0
@@ -191,19 +181,19 @@ def test_pallas_fits_vmem_budget():
     """A plain-ELL layout with a huge max degree streams a [Wp, Tc] block
     per grid step; past the VMEM budget the solvers must degrade to the
     XLA path instead of dying at Mosaic compile time (ADVICE r3)."""
-    from bibfs_tpu.ops.pallas_expand import (
-        MAX_CHUNKS,
-        VMEM_BUDGET_BYTES,
-        pallas_fits,
-    )
+    from bibfs_tpu.ops.pallas_expand import pallas_fits
 
     assert pallas_fits(100_000, width=13)
+    # wide rows fit by choosing a smaller lane block (the v2 grid is free)
     assert pallas_fits(100_000, width=500)
-    # width 5000 at Tc=4096: the neighbor block alone is 80 MB >> VMEM
+    # but past the smallest block's budget they must degrade
     assert not pallas_fits(100_000, width=5000)
-    # width=None keeps the chunk-only contract for geometry-less callers
+    # width=None keeps a weak key-encoding contract for geometry-less
+    # callers (v2 has no chunk bound — the gather is XLA's)
     assert pallas_fits(100_000)
-    assert not pallas_fits(64 * 131072 + (1 << 16))  # chunk bound intact
+    assert pallas_fits(33_554_432, width=13)  # scale 25 fits in v2
+    # key encoding: Wp * KS must stay in int32
+    assert not pallas_fits(100_000, id_space=33_554_432, width=600)
     # small graphs (Tc=512) tolerate much wider rows before the budget
     assert pallas_fits(1000, width=2000)
 
