@@ -296,14 +296,14 @@ if out["fused_compiles"]:
 
     @partial(jax.jit, static_argnames=("trips",))
     def run_fused(tabs, trips):
-        nbr_t, key_t, deg2 = tabs
+        nbr_t, deg2 = tabs
         dual = dual_seed(jnp.int32(0), jnp.int32(1), n_rows_p)
         dist = jnp.full((1, n_rows_p), INF32, jnp.int32).at[0, 0].set(0)
         par = jnp.full((1, n_rows_p), -1, jnp.int32)
         st = (dual, dist, dist, par, par)
         def body(i, st):
             outs = fused_dual_level(
-                st[0], nbr_t, key_t, deg2, st[1], st[2], st[3], st[4],
+                st[0], nbr_t, deg2, st[1], st[2], st[3], st[4],
                 i + 1, i + 1, ks=ks)
             return outs[:5]
         st = jax.lax.fori_loop(0, trips, body, st)
