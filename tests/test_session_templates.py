@@ -1,0 +1,97 @@
+"""The TPU session templates must not drift from the library APIs.
+
+Round 4 caught the ``levels`` item crashing on an API change that every
+unit test missed — the templates are format-strings executed only when
+the tunnel finally answers, which is exactly when a crash is most
+expensive. This module (a) parse-checks every item template and (b)
+EXECUTES the two most API-coupled items end-to-end at shrunken sizes in
+bounded subprocesses on the CPU platform, asserting a clean RESULT
+record."""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _session_module():
+    spec = importlib.util.spec_from_file_location(
+        "tpu_session", os.path.join(REPO, "scripts", "tpu_session.py")
+    )
+    m = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(m)
+    return m
+
+
+def _shrink(code: str) -> str:
+    code = code.replace("n = 100_000", "n = 3_000")
+    code = code.replace("n2 = 100_000", "n2 = 3_000")
+    code = code.replace("repeats=8", "repeats=2")
+    code = code.replace("repeats=5", "repeats=2")
+    code = code.replace("repeats=3", "repeats=2")
+    code = code.replace(
+        "for b in (32, 128, 256, 1024, 2048, 4096):", "for b in (4, 8):"
+    )
+    code = code.replace("for b in (32, 256):", "for b in (4,):")
+    code = code.replace(
+        "rmat_graph(18, edge_factor=8, seed=1)",
+        "rmat_graph(10, edge_factor=4, seed=1)",
+    )
+    code = code.replace("140_000, 140_000", "4_000, 4_000")
+    code = code.replace("for trips in (4, 64):", "for trips in (2, 6):")
+    code = code.replace("(walls[64] - walls[4]) / 60.0",
+                        "(walls[6] - walls[2]) / 4.0")
+    code = code.replace("wall_T4_s=walls[4], wall_T64_s=walls[64]",
+                        "wall_T4_s=walls[2], wall_T64_s=walls[6]")
+    code = code.replace("dispatch_s=walls[4] - 4 * per_level",
+                        "dispatch_s=walls[2] - 2 * per_level")
+    return code
+
+
+def test_all_templates_parse_and_format():
+    import ast
+
+    m = _session_module()
+    for name, (code, _timeout) in m.ITEMS.items():
+        ast.parse(code.format(repo=REPO))
+
+
+def _run_item(name: str, required_keys: tuple) -> dict:
+    m = _session_module()
+    code = _shrink(m.ITEMS[name][0].format(repo=REPO))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=500, env=env,
+    )
+    results = [
+        line for line in r.stdout.splitlines() if line.startswith("RESULT ")
+    ]
+    assert results, f"{name}: no RESULT line:\n{(r.stdout + r.stderr)[-1500:]}"
+    rec = json.loads(results[-1][len("RESULT "):])
+    for k in required_keys:
+        assert k in rec, (name, k, rec)
+    return rec
+
+
+@pytest.mark.slow
+def test_pallas_item_executes():
+    rec = _run_item(
+        "pallas",
+        ("compiles", "compiles_at_bench_geom", "fused_compiles",
+         "resolved_modes", "pallas_hops_ok"),
+    )
+    assert rec["pallas_hops_ok"] and rec.get("fused_hops_ok", True)
+
+
+@pytest.mark.slow
+def test_levels_item_executes():
+    rec = _run_item("levels", ("pallas_compiles", "xla", "fused_compiles"))
+    assert "device_level_s" in rec["xla"]
+    if rec["fused_compiles"]:
+        assert "device_level_s" in rec["fused"]
