@@ -53,7 +53,6 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-LANE = 128
 # lane-block (vertices per grid step) candidates: biggest divisor wins;
 # n_pad_p is always a multiple of the smallest
 LANE_BLOCKS = (4096, 2048, 1024, 512)
