@@ -284,7 +284,7 @@ def main(argv=None):
         "--mode",
         default="sync",
         choices=["sync", "alt", "beamer", "beamer_alt", "pallas",
-                 "pallas_alt", "fused", "sync_unfused"],
+                 "pallas_alt", "fused", "fused_alt", "sync_unfused"],
         help="device-kernel schedule: sync = both sides per round (fewest "
         "rounds), alt = smaller-frontier-first alternation (fewest edge "
         "scans); beamer variants add push/pull direction optimization; "
@@ -321,11 +321,11 @@ def main(argv=None):
     ):
         ap.error("--mode pallas/pallas_alt requires --backends dense (the "
                  "sharded backends have no pallas path)")
-    if args.mode == "fused" and any(
+    if args.mode in ("fused", "fused_alt") and any(
         b not in ("dense", "sharded", "serial", "native") for b in backends
     ):
-        ap.error("--mode fused requires --backends dense/sharded (the "
-                 "whole-level kernel has no 2D form)")
+        ap.error("--mode fused/fused_alt requires --backends dense/sharded "
+                 "(the whole-level kernel has no 2D form)")
     if args.mode not in ("sync", "alt") and "sharded2d" in backends:
         ap.error("--backends sharded2d supports --mode sync/alt only")
     if args.layout != "ell" and "sharded2d" in backends:

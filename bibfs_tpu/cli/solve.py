@@ -73,7 +73,7 @@ def main(argv=None):
         "--mode",
         default=None,
         choices=["sync", "alt", "beamer", "beamer_alt", "pallas",
-                 "pallas_alt", "fused", "sync_unfused"],
+                 "pallas_alt", "fused", "fused_alt", "sync_unfused"],
         help="device-kernel schedule for the device backends (default "
         "sync): sync = both sides per round, alt = smaller-frontier-first "
         "alternation; beamer/beamer_alt add push/pull direction "
@@ -157,9 +157,11 @@ def main(argv=None):
     if mode.startswith("pallas") and args.backend not in ("dense", "sharded"):
         ap.error("--mode pallas/pallas_alt is only supported by the dense "
                  "and sharded backends")
-    if mode == "fused" and args.backend not in ("dense", "sharded"):
-        ap.error("--mode fused (whole-level kernel) is only supported by "
-                 "the dense and sharded backends")
+    if mode in ("fused", "fused_alt") and args.backend not in (
+        "dense", "sharded"
+    ):
+        ap.error("--mode fused/fused_alt (whole-level kernel) is only "
+                 "supported by the dense and sharded backends")
     if args.pairs is not None:
         if args.backend not in ("dense", "native", "sharded", "sharded2d"):
             ap.error("--pairs batch mode is supported by --backend dense/"

@@ -154,26 +154,11 @@ def _fused_kernel(
     i = pl.program_id(0)
     vals = vals_ref[...]
     nbr = nbr_ref[...]
-    # the parent key, derived in-kernel (a second HBM table would double
-    # the dominant static memory for one cheap vector op)
-    key = jax.lax.broadcasted_iota(jnp.int32, nbr.shape, 0) * ks + nbr
     deg = deg_ref[...]
 
     def side(bit, d_ref, p_ref, l_ref):
         hit = jax.lax.shift_right_logical(vals, bit) & 1
-        d = d_ref[...]
-        vis = (d < INF32).astype(jnp.int32)
-        anyh = jnp.max(hit, axis=0, keepdims=True)
-        nf = jnp.where(vis > 0, 0, anyh)
-        # first-hit-slot parent via the static key (no gather): slot
-        # dominates the key, so the min is the lowest hit slot's entry
-        kmin = jnp.min(
-            jnp.where(hit > 0, key, jnp.int32(_BIG)), axis=0, keepdims=True
-        )
-        psel = kmin % ks
-        d2 = jnp.where(nf > 0, l_ref[...], d)
-        p2 = jnp.where(nf > 0, psel, p_ref[...])
-        return nf, d2, p2
+        return _claim(hit, nbr, ks, d_ref[...], p_ref[...], l_ref[...])
 
     nf_s, dist_s, par_s = side(0, dists_ref, pars_ref, lvls_ref)
     nf_t, dist_t, par_t = side(1, distt_ref, part_ref, lvlt_ref)
@@ -183,17 +168,8 @@ def _fused_kernel(
     parsn_ref[...] = par_s
     partn_ref[...] = par_t
 
-    # per-tile reductions -> (1,1) accumulators (TPU grid is sequential);
-    # fused meet vote on the POST-update dists (exact: dist values of
-    # visited vertices are final in a level-synchronous BFS)
-    both = (dist_s < INF32) & (dist_t < INF32)
-    sums = jnp.where(both, dist_s + dist_t, INF32)
-    mval = jnp.min(sums, axis=1, keepdims=True)
-    lane = jax.lax.broadcasted_iota(jnp.int32, sums.shape, 1)
-    midx = jnp.min(
-        jnp.where(sums == mval, i * TILE + lane, jnp.int32(_BIG)),
-        axis=1, keepdims=True,
-    )
+    # per-tile reductions -> (1,1) accumulators (TPU grid is sequential)
+    mval, midx = _meet_vote_tile(i, dist_s, dist_t)
 
     @pl.when(i == 0)
     def _init():
@@ -229,14 +205,146 @@ def _fused_kernel(
     mval_ref[...] = jnp.where(take, mval, mval_ref[...])
 
 
-@lru_cache(maxsize=None)
-def _get_fused_call(wp: int, n_rows_p: int, ks: int, interpret: bool,
-                    vma: frozenset = frozenset()):
+def _claim(vals_bit, nbr, ks: int, d, p, lvl_blk):
+    """THE per-side state update shared by the dual and single kernels:
+    any-hit, visited test, first-hit-slot parent via the static key-min
+    (slot dominates the key, so the min is the lowest hit slot's entry),
+    dist/par selects. Returns ``(nf, dist', par')``."""
+    vis = (d < INF32).astype(jnp.int32)
+    anyh = jnp.max(vals_bit, axis=0, keepdims=True)
+    nf = jnp.where(vis > 0, 0, anyh)
+    key = jax.lax.broadcasted_iota(jnp.int32, nbr.shape, 0) * ks + nbr
+    kmin = jnp.min(
+        jnp.where(vals_bit > 0, key, jnp.int32(_BIG)), axis=0, keepdims=True
+    )
+    psel = kmin % ks
+    d2 = jnp.where(nf > 0, lvl_blk, d)
+    p2 = jnp.where(nf > 0, psel, p)
+    return nf, d2, p2
+
+
+def _meet_vote_tile(i, d_a, d_b):
+    """Per-tile meet candidates on the post-update dists (exact in a
+    level-synchronous BFS): ``(min d_a+d_b, its lowest global id)``."""
+    both = (d_a < INF32) & (d_b < INF32)
+    sums = jnp.where(both, d_a + d_b, INF32)
+    mval = jnp.min(sums, axis=1, keepdims=True)
+    lane = jax.lax.broadcasted_iota(jnp.int32, sums.shape, 1)
+    midx = jnp.min(
+        jnp.where(sums == mval, i * TILE + lane, jnp.int32(_BIG)),
+        axis=1, keepdims=True,
+    )
+    return mval, midx
+
+
+def _check_fused_key(wp: int, ks: int) -> None:
     if wp * ks >= (1 << 31):
         raise ValueError(
             f"fused level kernel: parent key slot*{ks}+nbr overflows int32 "
             f"at Wp={wp}; route this geometry elsewhere (fused_fits)"
         )
+
+
+def _fused_kernel_single(
+    ks: int, bit: int,
+    # inputs
+    vals_ref, nbr_ref, deg_ref, dual_ref,
+    dista_ref, distp_ref, para_ref, lvla_ref,
+    # outputs
+    dualn_ref, distan_ref, paran_ref,
+    cnt_ref, md_ref, ds_ref, mval_ref, midx_ref,
+):
+    """One side of an ALT round (the smaller-frontier-first schedule):
+    only side ``bit`` advances; the passive side's frontier bits and
+    dist row pass through untouched. The meet vote still sees BOTH dist
+    rows (the passive one as a read-only input)."""
+    i = pl.program_id(0)
+    vals = vals_ref[...]
+    nbr = nbr_ref[...]
+    deg = deg_ref[...]
+    hit = jax.lax.shift_right_logical(vals, bit) & 1
+    nf, d2, p2 = _claim(
+        hit, nbr, ks, dista_ref[...], para_ref[...], lvla_ref[...]
+    )
+    distan_ref[...] = d2
+    paran_ref[...] = p2
+    passive_mask = 2 if bit == 0 else 1
+    dualn_ref[...] = (dual_ref[...] & passive_mask) | jax.lax.shift_left(
+        nf, bit
+    )
+    mval, midx = _meet_vote_tile(i, d2, distp_ref[...])
+
+    @pl.when(i == 0)
+    def _init():
+        cnt_ref[...] = jnp.zeros((1, 1), jnp.int32)
+        md_ref[...] = jnp.zeros((1, 1), jnp.int32)
+        ds_ref[...] = jnp.zeros((1, 1), jnp.int32)
+        mval_ref[...] = jnp.full((1, 1), INF32, jnp.int32)
+        midx_ref[...] = jnp.full((1, 1), -1, jnp.int32)
+
+    cnt_ref[...] = cnt_ref[...] + jnp.sum(nf, axis=1, keepdims=True)
+    md_ref[...] = jnp.maximum(
+        md_ref[...], jnp.max(jnp.where(nf > 0, deg, 0), axis=1,
+                             keepdims=True)
+    )
+    ds_ref[...] = ds_ref[...] + jnp.sum(
+        jnp.where(nf > 0, deg, 0), axis=1, keepdims=True
+    )
+    take = mval < mval_ref[...]
+    midx_ref[...] = jnp.where(take, midx, midx_ref[...])
+    mval_ref[...] = jnp.where(take, mval, mval_ref[...])
+
+
+@lru_cache(maxsize=None)
+def _get_fused_single_call(wp: int, n_rows_p: int, ks: int, bit: int,
+                           interpret: bool, vma: frozenset = frozenset()):
+    _check_fused_key(wp, ks)
+    grid = n_rows_p // TILE
+    kernel = lambda *refs: _fused_kernel_single(ks, bit, *refs)  # noqa: E731
+    blk = pl.BlockSpec((wp, TILE), lambda i: (0, i))
+    row = pl.BlockSpec((1, TILE), lambda i: (0, i))
+    one = pl.BlockSpec((1, 1), lambda i: (0, 0))
+    rs = jax.ShapeDtypeStruct((1, n_rows_p), jnp.int32, vma=vma)
+    ss = jax.ShapeDtypeStruct((1, 1), jnp.int32, vma=vma)
+    return pl.pallas_call(
+        kernel,
+        grid=(grid,),
+        in_specs=[blk, blk, row, row, row, row, row, one],
+        out_specs=[row, row, row, one, one, one, one, one],
+        out_shape=[rs, rs, rs, ss, ss, ss, ss, ss],
+        interpret=interpret,
+    )
+
+
+def fused_single_level(
+    dual_row, nbr_t, deg2, dist_a, dist_p, par_a, lvl_a,
+    *, bit: int, ks: int, interpret: bool | None = None,
+):
+    """One ALT round advancing side ``bit`` only. ``dual_row`` spans the
+    id space (the local-row slice is ALSO what the kernel updates — the
+    caller's dual carry must equal the local rows for the dense solver,
+    id_space == n_rows). Returns ``(dual_next, dist_a', par_a', cnt, md,
+    degsum, meet_val, meet_idx)`` with scalars as int32."""
+    wp, n_rows_p = nbr_t.shape
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    vals = gather_vals(dual_row, nbr_t)
+    call = _get_fused_single_call(
+        wp, n_rows_p, ks, bit, interpret,
+        _vma_of(vals, nbr_t, deg2, dual_row, dist_a, dist_p, par_a),
+    )
+    outs = call(
+        vals, nbr_t, deg2, dual_row, dist_a, dist_p, par_a,
+        jnp.asarray(lvl_a, jnp.int32).reshape(1, 1),
+    )
+    arrays, scalars = outs[:3], outs[3:]
+    return tuple(arrays) + tuple(s[0, 0] for s in scalars)
+
+
+@lru_cache(maxsize=None)
+def _get_fused_call(wp: int, n_rows_p: int, ks: int, interpret: bool,
+                    vma: frozenset = frozenset()):
+    _check_fused_key(wp, ks)
     grid = n_rows_p // TILE
     kernel = lambda *refs: _fused_kernel(ks, *refs)  # noqa: E731
     blk = pl.BlockSpec((wp, TILE), lambda i: (0, i))
@@ -285,7 +393,8 @@ def fused_dual_level(
 
 
 @lru_cache(maxsize=None)
-def _fused_available_padded(wp: int, n_rows_p: int, id_space_p: int) -> bool:
+def _fused_available_padded(wp: int, n_rows_p: int, id_space_p: int,
+                            single: bool = False) -> bool:
     try:
         import numpy as np
 
@@ -295,20 +404,29 @@ def _fused_available_padded(wp: int, n_rows_p: int, id_space_p: int) -> bool:
         dual = jnp.zeros((1, id_space_p), jnp.int32)
         dist = jnp.full((1, n_rows_p), INF32, jnp.int32)
         par = jnp.full((1, n_rows_p), -1, jnp.int32)
-        outs = fused_dual_level(
-            dual, nbr_t, deg2, dist, dist, par, par,
-            jnp.int32(1), jnp.int32(1), ks=ks,
-        )
+        if single:
+            outs = fused_single_level(
+                dual, nbr_t, deg2, dist, dist, par, jnp.int32(1),
+                bit=0, ks=ks,
+            )
+            probe_scalar = outs[3]
+        else:
+            outs = fused_dual_level(
+                dual, nbr_t, deg2, dist, dist, par, par,
+                jnp.int32(1), jnp.int32(1), ks=ks,
+            )
+            probe_scalar = outs[5]
         # read a VALUE: the lazy tunneled runtime defers execution (and
         # its errors) until a readback — see solvers/timing.py
-        np.asarray(outs[5]).ravel()
+        np.asarray(probe_scalar).ravel()
         return True
     except Exception:
         return False
 
 
 def fused_available(
-    n_rows: int = 64, width: int = 2, id_space: int | None = None
+    n_rows: int = 64, width: int = 2, id_space: int | None = None,
+    *, single: bool = False,
 ) -> bool:
     """Compile+run probe of the fused level AT THE GIVEN GEOMETRY on the
     current backend. Memoized on the padded geometry; the compiled
@@ -317,7 +435,7 @@ def fused_available(
     TPU compile via utils/tpu_aot.py, which needs no chip at all.)"""
     return _fused_available_padded(
         _slot_pad(width), pad_rows(n_rows),
-        pad_rows(id_space if id_space is not None else n_rows),
+        pad_rows(id_space if id_space is not None else n_rows), single,
     )
 
 

@@ -449,8 +449,8 @@ def _get_chunk_step(g, mode: str, chunk: int):
         from bibfs_tpu.solvers.dense import _resolve_pallas_mode
         from bibfs_tpu.solvers.sharded import _shard_geom
 
-        if mode == "fused":  # no sharded form; same rule as _compiled_sharded
-            mode = "pallas"
+        if mode in ("fused", "fused_alt"):  # same rule as _compiled_sharded
+            mode = {"fused": "pallas", "fused_alt": "pallas_alt"}[mode]
         mode = _resolve_pallas_mode(mode, _shard_geom(g))
         cap = kernel_cap(mode, g.n_pad)
         kern = _sharded_chunk_kernel(
@@ -460,11 +460,11 @@ def _get_chunk_step(g, mode: str, chunk: int):
     # DeviceGraph
     from bibfs_tpu.solvers.dense import _resolve_pallas_mode
 
-    if mode == "fused":
+    if mode in ("fused", "fused_alt"):
         # chunked execution snapshots the standard state dict; the fused
-        # program's packed-frontier carry has no snapshot form, so chunked/
-        # resumed fused solves run the round-3 kernel instead
-        mode = "pallas"
+        # programs' dual-row carry has no snapshot form, so chunked/
+        # resumed fused solves run the expansion-kernel modes instead
+        mode = {"fused": "pallas", "fused_alt": "pallas_alt"}[mode]
     # Mosaic-unsupported -> base schedule (probe at the real geometry)
     mode = _resolve_pallas_mode(mode, (g.n_pad, g.n_pad, g.width))
     aux = g.aux

@@ -383,6 +383,7 @@ DENSE_MODES = {
     "pallas": ("sync", False, True),
     "pallas_alt": ("alt", False, True),
     "fused": ("sync", False, "fused"),
+    "fused_alt": ("alt", False, "fused"),
     # A/B control for the round-3 dual fusion claims (VERDICT r3 item 4):
     # the same lock-step schedule with the PRE-fusion structure — two
     # single-side expansions per round (two table reads; under the 1D
@@ -587,6 +588,99 @@ def _build_fused_kernel(tier_meta: tuple = ()):
     return kernel
 
 
+def _build_fused_alt_kernel(tier_meta: tuple = ()):
+    """The alt-schedule whole-level-kernel program (mode "fused_alt"):
+    each round advances only the SMALLER frontier (v1's direction
+    choice) through ONE single-side kernel; the shared dual gather runs
+    inside the chosen branch. Degrades like mode "fused"."""
+    from bibfs_tpu.ops.pallas_fused import (
+        dual_seed,
+        fused_fits,
+        fused_single_level,
+        key_stride,
+        prepare_fused_tables,
+    )
+
+    def kernel(nbr, deg, aux, src, dst):
+        n_pad = nbr.shape[0]
+        if tier_meta or not fused_fits(n_pad, width=nbr.shape[1]):
+            return _build_kernel("pallas_alt", 0, tier_meta)(
+                nbr, deg, aux, src, dst
+            )
+        nbr_t, deg2 = prepare_fused_tables(nbr, deg)
+        n_rows_p = nbr_t.shape[1]
+        ks = key_stride(n_pad)
+        src32 = src.astype(jnp.int32)
+
+        def side(v):
+            return dict(
+                dist=jnp.full((1, n_rows_p), INF32, jnp.int32)
+                .at[0, v].set(0),
+                par=jnp.full((1, n_rows_p), -1, jnp.int32),
+                cnt=jnp.int32(1),
+                md=deg[v],
+                ds=deg[v],
+                lvl=jnp.int32(0),
+            )
+
+        st = {f"{k}_s": v for k, v in side(src).items()}
+        st.update({f"{k}_t": v for k, v in side(dst).items()})
+        st.update(
+            dual=dual_seed(src, dst, n_rows_p),
+            best=jnp.where(src == dst, 0, INF32).astype(jnp.int32),
+            meet=jnp.where(src == dst, src32, -1).astype(jnp.int32),
+            levels=jnp.int32(0),
+            edges=jnp.int32(0),
+        )
+
+        def round_side(st, side_key, bit):
+            other = "t" if side_key == "s" else "s"
+            (dual, dist_a, par_a, cnt, md, ds, mval, midx) = (
+                fused_single_level(
+                    st["dual"], nbr_t, deg2,
+                    st[f"dist_{side_key}"], st[f"dist_{other}"],
+                    st[f"par_{side_key}"], st[f"lvl_{side_key}"] + 1,
+                    bit=bit, ks=ks,
+                )
+            )
+            take = mval < st["best"]
+            return {
+                **st,
+                "dual": dual,
+                f"dist_{side_key}": dist_a,
+                f"par_{side_key}": par_a,
+                f"cnt_{side_key}": cnt,
+                f"md_{side_key}": md,
+                f"ds_{side_key}": ds,
+                f"lvl_{side_key}": st[f"lvl_{side_key}"] + 1,
+                "best": jnp.minimum(st["best"], mval),
+                "meet": jnp.where(take, midx, st["meet"]),
+                "levels": st["levels"] + 1,
+                # this round scanned the expanded side's CURRENT frontier
+                "edges": st["edges"] + st[f"ds_{side_key}"],
+            }
+
+        def body(st):
+            return jax.lax.cond(
+                st["cnt_s"] <= st["cnt_t"],
+                lambda st: round_side(st, "s", 0),
+                lambda st: round_side(st, "t", 1),
+                st,
+            )
+
+        out = jax.lax.while_loop(_cond, body, st)
+        return (
+            out["best"],
+            out["meet"],
+            out["par_s"][0, :n_pad],
+            out["par_t"][0, :n_pad],
+            out["levels"],
+            out["edges"],
+        )
+
+    return kernel
+
+
 def _build_kernel(mode: str, push_cap: int, tier_meta: tuple = ()):
     """Build the (unjitted) search kernel for (mode, push_cap, tier layout):
     ``fn(nbr, deg, aux, src, dst) -> (best, meet, parent_s, parent_t,
@@ -597,6 +691,8 @@ def _build_kernel(mode: str, push_cap: int, tier_meta: tuple = ()):
     host round-trips, quirk Q5)."""
     if mode == "fused":
         return _build_fused_kernel(tier_meta)
+    if mode == "fused_alt":
+        return _build_fused_alt_kernel(tier_meta)
     cap = push_cap if DENSE_MODES[mode][1] else 0
     k = max(cap, 1)
 
@@ -641,22 +737,25 @@ def _resolve_pallas_mode(mode: str, geom: tuple | None = None) -> str:
         return mode
     import sys
 
-    if mode == "fused":
+    if mode in ("fused", "fused_alt"):
         from bibfs_tpu.ops.pallas_fused import fused_available
 
+        single = mode == "fused_alt"  # probe only the kernel THIS mode runs
         ok = (
-            fused_available(geom[0], geom[2], id_space=geom[1])
-            if geom else fused_available()
+            fused_available(geom[0], geom[2], id_space=geom[1], single=single)
+            if geom else fused_available(single=single)
         )
         if ok:
             return mode
         print(
             "warning: fused level kernel does not compile on this backend "
-            f"(geometry {geom}); mode 'fused' falling back to the round-3 "
-            "pallas path",
+            f"(geometry {geom}); mode {mode!r} falling back to the "
+            "expansion-kernel path",
             file=sys.stderr,
         )
-        return _resolve_pallas_mode("pallas", geom)
+        return _resolve_pallas_mode(
+            {"fused": "pallas", "fused_alt": "pallas_alt"}[mode], geom
+        )
     from bibfs_tpu.ops.pallas_expand import (
         pallas_available,
         pallas_available_at,
@@ -684,14 +783,14 @@ def _get_kernel(mode: str, push_cap: int, tier_meta: tuple = (),
     # resolve the pallas fallback BEFORE the cache key so a fallen-back
     # 'pallas' shares the already-compiled 'sync' kernel instead of paying
     # a redundant XLA compile of an identical program
-    if mode == "fused" and (
+    if mode in ("fused", "fused_alt") and (
         tier_meta or (geom is not None and not _fused_fits_geom(geom))
     ):
         # a fused solve that will degrade at trace time must degrade HERE
         # first, so the probe chain gates the kernel it will actually run
         # (probing only the fused kernel and then tracing the pallas one
         # would bypass the Mosaic availability check)
-        mode = "pallas"
+        mode = {"fused": "pallas", "fused_alt": "pallas_alt"}[mode]
     return _get_kernel_resolved(
         _resolve_pallas_mode(mode, geom), push_cap, tier_meta
     )
@@ -713,9 +812,9 @@ def _get_batch_kernel(mode: str, push_cap: int, tier_meta: tuple = (),
     # same pre-cache pallas resolution as _get_kernel. The fused kernel's
     # cross-grid (1,1) accumulators assume grid axis 0 is the vertex tile
     # walk; vmap would prepend a batch grid dim and break that, so batch
-    # queries route to the round-3 kernel instead
-    if mode == "fused":
-        mode = "pallas"
+    # queries route to the expansion-kernel modes instead
+    if mode in ("fused", "fused_alt"):
+        mode = {"fused": "pallas", "fused_alt": "pallas_alt"}[mode]
     return _get_batch_kernel_resolved(
         _resolve_pallas_mode(mode, geom), push_cap, tier_meta
     )

@@ -701,6 +701,13 @@ def _compiled_sharded(
     # the round-3 per-shard kernel
     from bibfs_tpu.solvers.dense import _resolve_pallas_mode
 
+    if mode == "fused_alt":
+        # only the lock-step dual program has a sharded form
+        _warn_fused_degrade(
+            geom, tier_meta, "no sharded alt-schedule fused program",
+            mode_from="fused_alt", mode_to="pallas_alt",
+        )
+        mode = "pallas_alt"
     if mode == "fused" and not _sharded_fused_ok(geom, tier_meta):
         _warn_fused_degrade(geom, tier_meta)
         mode = "pallas"
@@ -713,22 +720,24 @@ def _compiled_sharded(
 _FUSED_DEGRADE_WARNED: set = set()
 
 
-def _warn_fused_degrade(geom, tier_meta, why: str | None = None) -> None:
+def _warn_fused_degrade(geom, tier_meta, why: str | None = None,
+                        mode_from: str = "fused",
+                        mode_to: str = "pallas") -> None:
     """One stderr notice per distinct geometry/reason: a silent reroute
     would let 'fused'-labeled timings describe the round-3 kernel."""
     if why is None:
         why = ("tiered layout" if tier_meta else
                f"geometry outside the fused kernel's key/VMEM bounds "
                f"(geom={geom}; see pallas_fused.fused_fits)")
-    key = (geom, why)
+    key = (geom, why, mode_from, mode_to)
     if key in _FUSED_DEGRADE_WARNED:
         return
     _FUSED_DEGRADE_WARNED.add(key)
     import sys
 
     print(
-        f"sharded mode 'fused': {why} — degrading to the round-3 "
-        "per-shard kernel ('pallas')",
+        f"sharded mode {mode_from!r}: {why} — degrading to the "
+        f"expansion-kernel mode {mode_to!r}",
         file=sys.stderr,
     )
 
@@ -747,6 +756,13 @@ def _compiled_sharded_batch(
 ):
     from bibfs_tpu.solvers.dense import _resolve_pallas_mode
 
+    if mode == "fused_alt":
+        _warn_fused_degrade(
+            geom, tier_meta,
+            "batch solves vmap the program (no fused batching rule)",
+            mode_from="fused_alt", mode_to="pallas_alt",
+        )
+        mode = "pallas_alt"
     if mode == "fused":
         # UNLIKE the single-query router, batch always degrades: the
         # fused kernel's cross-grid (1,1) accumulators assume grid axis 0

@@ -90,6 +90,7 @@ def main(argv=None) -> int:
             ("dense/alt/ell", "alt", gell, (), ()),
             ("dense/beamer/ell", "beamer", gell, (), ()),
             ("dense/fused/ell", "fused", gell, (), ()),
+            ("dense/fused_alt/ell", "fused_alt", gell, (), ()),
             ("dense/pallas/ell", "pallas", gell, (), ()),
             ("dense/sync/tiered", "sync", gt, t_aux, tier_meta),
             ("dense/beamer/tiered", "beamer", gt, t_aux, tier_meta),

@@ -390,3 +390,71 @@ def test_fused_checkpoint_degrades():
     want = solve_dense_graph(g, 0, n - 1, mode="sync")
     got = solve_checkpointed(g, 0, n - 1, mode="fused", chunk=4)
     assert got.found == want.found and got.hops == want.hops
+
+
+def test_fused_alt_matches_alt():
+    """mode='fused_alt': the alt schedule through the single-side
+    whole-level kernel — identical hops/levels/edges to the XLA alt
+    schedule, plus oracle path validity."""
+    from bibfs_tpu.graph.generate import gnp_random_graph
+    from bibfs_tpu.solvers.dense import DeviceGraph, solve_dense_graph
+    from bibfs_tpu.solvers.serial import solve_serial
+
+    n = 5_000
+    edges = gnp_random_graph(n, 2.5 / n, seed=4)
+    g = DeviceGraph.build(n, edges)
+    for s, d in [(0, n - 1), (3, n // 2), (9, 9)]:
+        want = solve_serial(n, edges, s, d)
+        got = solve_dense_graph(g, s, d, mode="fused_alt")
+        ref = solve_dense_graph(g, s, d, mode="alt")
+        assert got.found == want.found, (s, d)
+        if want.found:
+            assert got.hops == want.hops, (s, d)
+            got.validate_path(n, edges, s, d)
+        assert (got.levels, got.edges_scanned) == (
+            ref.levels, ref.edges_scanned
+        ), (s, d)
+
+
+def test_fused_alt_compiles_deviceless_for_tpu():
+    from bibfs_tpu.utils.tpu_aot import aot_available, aot_compile_tpu
+
+    if not aot_available():
+        pytest.skip("TPU topology API / libtpu unavailable")
+    from bibfs_tpu.graph.generate import gnp_random_graph
+    from bibfs_tpu.solvers.dense import DeviceGraph, _build_kernel
+
+    n = 100_000
+    edges = gnp_random_graph(n, 2.2 / n, seed=1)
+    g = DeviceGraph.build(n, edges)
+    ok, err = aot_compile_tpu(
+        _build_kernel("fused_alt", 0, g.tier_meta),
+        np.asarray(g.nbr), np.asarray(g.deg), (),
+        np.int32(0), np.int32(n - 1),
+    )
+    assert ok, f"fused_alt program no longer compiles for TPU: {err}"
+
+
+def test_fused_alt_degrades_on_tiered_and_sharded():
+    from bibfs_tpu.graph.generate import gnp_random_graph, rmat_graph
+    from bibfs_tpu.parallel.mesh import make_1d_mesh
+    from bibfs_tpu.solvers.dense import DeviceGraph, solve_dense_graph
+    from bibfs_tpu.solvers.serial import solve_serial
+    from bibfs_tpu.solvers.sharded import ShardedGraph, solve_sharded_graph
+
+    nt, et = rmat_graph(10, edge_factor=4, seed=7)
+    want = solve_serial(nt, et, 0, 5)
+    gt = DeviceGraph.build(nt, et, layout="tiered")
+    got = solve_dense_graph(gt, 0, 5, mode="fused_alt")
+    assert got.found == want.found and (
+        not want.found or got.hops == want.hops
+    )
+    # sharded: no alt-schedule fused program — degrades to pallas_alt
+    n = 800
+    edges = gnp_random_graph(n, 2.5 / n, seed=6)
+    ws = solve_serial(n, edges, 0, n - 1)
+    gs = ShardedGraph.build(n, edges, make_1d_mesh(8))
+    gots = solve_sharded_graph(gs, 0, n - 1, mode="fused_alt")
+    assert gots.found == ws.found and (
+        not ws.found or gots.hops == ws.hops
+    )
