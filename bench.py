@@ -61,6 +61,7 @@ SWEEP = [  # device configs: (mode, layout)
     ("sync", "ell"),
     ("pallas", "ell"),  # fused Pallas pull kernel (falls back if Mosaic rejects)
     ("fused", "ell"),  # whole-level kernel: 1 op group/round (falls back too)
+    ("fused_alt", "ell"),  # same kernel, smaller-frontier-first schedule
     ("beamer", "ell"),
     ("sync", "tiered"),
     ("beamer", "tiered"),
@@ -423,7 +424,7 @@ def main():
 
             detail["resolved_modes"] = {
                 m: _resolve_pallas_mode(m, _geom_of(graphs["ell"]))
-                for m in ("pallas", "fused")
+                for m in ("pallas", "fused", "fused_alt")
                 if any(mm == m for mm, _l in sweep)
             }
         except Exception as e:

@@ -80,7 +80,8 @@ if out["compiles"]:
         140_000, 140_000, g2.width)
     from bibfs_tpu.ops.pallas_fused import fused_available
     out["fused_compiles"] = fused_available(g2.n_pad, g2.width)
-    modes = ["sync", "pallas"] + (["fused"] if out["fused_compiles"] else [])
+    modes = ["sync", "pallas"] + (
+        ["fused", "fused_alt"] if out["fused_compiles"] else [])
     # record what each kernel mode RESOLVED to — a Mosaic-rejected mode's
     # timing row must not masquerade as a kernel number (the AOT audit
     # says 'pallas' resolves to the XLA path on real TPUs)
