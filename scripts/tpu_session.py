@@ -252,21 +252,25 @@ out["pallas_compiles"] = len(variants) == 2
 # built ONCE, outside the timed region (its own contract), so the pallas
 # variant's dispatch_s stays comparable to xla's
 tables = jax.jit(prepare_pallas_tables)(g.nbr, g.deg)
+# per-level HBM traffic models: the XLA/pallas pull reads the table once
+# plus ~13 B/vertex of state; the fused v2 level additionally writes and
+# re-reads the gathered vals block (one table-sized intermediate)
 bytes_per_level = g.n_pad * g.width * 4 + g.n_pad * 13
+bytes_per_level_fused = 3 * g.n_pad * g.width * 4 + g.n_pad * 13
 
 
-def decompose(walls):
+def decompose(walls, bpl):
     per_level = (walls[64] - walls[4]) / 60.0
     return dict(
         wall_T4_s=walls[4], wall_T64_s=walls[64],
         device_level_s=per_level,
         dispatch_s=walls[4] - 4 * per_level,
         hbm_gbps_per_level=(
-            bytes_per_level / per_level / 1e9 if per_level > 0 else None),
+            bpl / per_level / 1e9 if per_level > 0 else None),
     )
 
 
-def protocol(fn):
+def protocol(fn, bpl=bytes_per_level):
     walls = {{}}
     for trips in (4, 64):
         vals = []
@@ -275,7 +279,7 @@ def protocol(fn):
             fn(trips)  # must force a value read
             vals.append(time.perf_counter() - t0)
         walls[trips] = float(np.median(vals[1:]))
-    return decompose(walls)
+    return decompose(walls, bpl)
 
 
 for name, use_pallas in variants:
@@ -311,7 +315,8 @@ if out["fused_compiles"]:
         return st[1].sum() + st[2].sum()
 
     out["fused"] = protocol(
-        lambda trips: int(run_fused(ftables, trips)))
+        lambda trips: int(run_fused(ftables, trips)),
+        bpl=bytes_per_level_fused)
 print("RESULT " + json.dumps(out))
 """
 
