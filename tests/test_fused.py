@@ -458,3 +458,72 @@ def test_fused_alt_degrades_on_tiered_and_sharded():
     assert gots.found == ws.found and (
         not ws.found or gots.hops == ws.hops
     )
+
+
+def test_fused_level_edge_states():
+    """Degenerate level inputs: empty frontier (no hits anywhere), the
+    FULL vertex set as frontier, everything visited, and frontier mass
+    at the padding boundary — each against the XLA dual path."""
+    import jax.numpy as jnp
+
+    from bibfs_tpu.graph.csr import build_ell
+    from bibfs_tpu.graph.generate import gnp_random_graph
+    from bibfs_tpu.ops.expand import expand_pull_dual_tiered
+    from bibfs_tpu.ops.pallas_fused import (
+        fused_dual_level,
+        key_stride,
+        prepare_fused_tables,
+    )
+
+    n = 3_000
+    edges = gnp_random_graph(n, 3.0 / n, seed=8)
+    g = build_ell(n, edges)
+    n_pad = g.n_pad
+    nbr = jnp.asarray(g.nbr)
+    deg = jnp.asarray(g.deg)
+    nbr_t, deg2 = prepare_fused_tables(nbr, deg)
+    n_rows_p = nbr_t.shape[1]
+    ks = key_stride(n_pad)
+
+    def lift(a, fill):
+        return jnp.asarray(
+            np.pad(a, (0, n_rows_p - n_pad), constant_values=fill)
+        ).reshape(1, n_rows_p)
+
+    cases = {
+        "empty": (np.zeros(n_pad, bool), np.zeros(n_pad, bool)),
+        "full": (
+            np.arange(n_pad) < n, np.arange(n_pad) < n
+        ),
+        "boundary": (
+            np.isin(np.arange(n_pad), [n - 1, n - 2]),
+            np.isin(np.arange(n_pad), [0]),
+        ),
+    }
+    for name, (fr_s, fr_t) in cases.items():
+        dist_s = np.where(fr_s, 1, INF32).astype(np.int32)
+        dist_t = np.where(fr_t, 1, INF32).astype(np.int32)
+        if name == "full":  # everything visited: no new frontier anywhere
+            dist_s[:n] = 1
+            dist_t[:n] = 1
+        par0 = np.full(n_pad, -1, np.int32)
+        want = [
+            np.asarray(x)
+            for x in expand_pull_dual_tiered(
+                jnp.asarray(fr_s), jnp.asarray(fr_t), jnp.asarray(par0),
+                jnp.asarray(dist_s), jnp.asarray(par0), jnp.asarray(dist_t),
+                nbr, deg, (), jnp.int32(2), jnp.int32(2), inf=INF32,
+            )
+        ]
+        dual = fr_s.astype(np.int32) | (fr_t.astype(np.int32) << 1)
+        outs = fused_dual_level(
+            lift(dual, 0), nbr_t, deg2, lift(dist_s, INF32),
+            lift(dist_t, INF32), lift(par0, -1), lift(par0, -1),
+            jnp.int32(2), jnp.int32(2), ks=ks,
+        )
+        dual1 = np.asarray(outs[0])[0, :n_pad]
+        assert (((dual1 & 1) > 0) == want[0]).all(), name
+        assert (((dual1 & 2) > 0) == want[4]).all(), name
+        assert (np.asarray(outs[1])[0, :n_pad] == want[2]).all(), name
+        assert int(outs[5]) == want[0].sum(), name
+        assert int(outs[6]) == want[4].sum(), name
