@@ -57,14 +57,16 @@ REPEATS = int(os.environ.get("BENCH_REPEATS", 30))
 # driver's budget while still riding out a slow-but-alive backend init
 PROBE_TIMEOUT_S = float(os.environ.get("BENCH_PROBE_TIMEOUT_S", 110))
 HOST_BACKENDS = ["native", "serial"]  # the framework's latency runtimes
-SWEEP = [  # device configs: (mode, layout)
+SWEEP = [  # device configs: (mode, layout) — ordered so the historically
+    # best config and the round-4 kernel questions land before the time
+    # budget can skip anything
     ("sync", "ell"),
-    ("pallas", "ell"),  # fused Pallas pull kernel (falls back if Mosaic rejects)
-    ("fused", "ell"),  # whole-level kernel: 1 op group/round (falls back too)
+    ("beamer", "tiered"),  # the r2 real-chip winner (116 ms)
+    ("fused", "ell"),  # whole-level kernel: 1 gather + 1 kernel/round
     ("fused_alt", "ell"),  # same kernel, smaller-frontier-first schedule
+    ("pallas", "ell"),  # v2 expansion kernel
     ("beamer", "ell"),
     ("sync", "tiered"),
-    ("beamer", "tiered"),
 ]
 # each real device solve through the tunnel costs ~0.2s; cap device repeats
 # so the five device configs fit the driver's budget while host backends
