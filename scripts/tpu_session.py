@@ -11,14 +11,23 @@ one JSON line per item to ``TPU_SESSION.jsonl``:
                   real-TPU 1-device mesh (proves the collective programs
                   lower under the TPU toolchain, VERDICT r2 weak #6).
 3. ``batch``    — vmapped batch sweep: per-query us at batch 32/128/256/
-                  1024 on the 100k bench graph (the device's win-regime
-                  question, VERDICT r2 next-#4).
-4. ``levels``   — dispatch-vs-device decomposition without a profiler:
+                  1024/2048/4096 on the 100k bench graph (the device's
+                  win-regime question, VERDICT r2 next-#4).
+4. ``batch_rmat`` — the same question on an RMAT-18 tiered graph, where
+                  per-level device work dwarfs the fixed per-level cost.
+                  Its own item (not a leg of ``batch``): a device-level
+                  failure wedges a process's TPU context, so the two
+                  must not share one (2026-07-31 on-chip run).
+5. ``levels``   — dispatch-vs-device decomposition without a profiler:
                   fixed-trip fori_loop of the pull level at two trip
                   counts; the slope is pure device+loop cost per level,
                   the intercept is the tunnel dispatch tax.
+6. ``fusion``   — the round-3 dual-exchange A/B (sync vs sync_unfused)
+                  on the chip, where the per-collective fixed cost the
+                  fusion targets actually lives.
 
-Usage:  python scripts/tpu_session.py [--items pallas mesh1 batch levels]
+Usage:  python scripts/tpu_session.py [--items pallas mesh1 batch
+        batch_rmat levels fusion]
 """
 
 from __future__ import annotations
@@ -158,6 +167,8 @@ from bibfs_tpu.solvers.dense import DeviceGraph, time_batch_only
 n = 100_000
 edges = gnp_random_graph(n, 2.2 / n, seed=1)
 g = DeviceGraph.build(n, edges)
+# the sweep owns this rng: its draw order (and so its query pairs) must
+# not depend on any other leg, or runs stop being comparable
 rng = np.random.default_rng(0)
 rows = {{}}
 # extend until HBM refuses (VERDICT r3 next-7: find where the per-query
@@ -176,25 +187,59 @@ for b in (32, 128, 256, 1024, 2048, 4096):
         msg = str(e).lower()
         if "resource" in msg or "memory" in msg or "oom" in msg:
             break  # larger sizes will only OOM harder; transients go on
+        if "unavailable" in msg or "device error" in msg:
+            rows[str(b)]["note"] = (
+                "device-level failure wedges this process's TPU context;"
+                " stopping the escalation (later sizes would die of the"
+                " wedge, not their own workload)")
+            break
 out["batch_100k"] = rows
+if not any("per_query_us" in v for v in rows.values()):
+    # no measurement landed: surface it as a retryable item failure
+    # instead of a clean-looking record the watcher would accept
+    out["error"] = next(iter(rows.values()))["error"]
+print("RESULT " + json.dumps(out))
+"""
 
-# the other axis of the win regime: a graph where per-level device work
-# dwarfs the per-level fixed cost (RMAT-18 skew, tiered layout)
-try:
-    from bibfs_tpu.graph.generate import rmat_graph
-    n2, edges2 = rmat_graph(18, edge_factor=8, seed=1)
-    g2 = DeviceGraph.build(n2, edges2, layout="tiered")
-    rows2 = {{}}
-    for b in (32, 256):
-        pairs = np.stack(
-            [rng.integers(0, n2, b), rng.integers(0, n2, b)], axis=1)
+# The other axis of the win regime: a graph where per-level device work
+# dwarfs the per-level fixed cost (RMAT-18 skew, tiered layout). Its OWN
+# session item, not a leg of ``batch``: a device-level failure
+# (UNAVAILABLE "TPU device error") wedges a process's TPU context, so
+# the legs must not share a process — on the 2026-07-31 on-chip run the
+# b=2048 wedge killed the RMAT leg that followed in-process — and as a
+# separate item it gets its own watcher budget, retry state, and
+# artifact gate instead of being buried inside the batch record.
+BATCH_RMAT_SUB = """
+import json, sys, time
+import numpy as np
+sys.path.insert(0, {repo!r})
+from bibfs_tpu.utils.platform import apply_platform_env
+apply_platform_env()
+import jax
+out = dict(item="batch_rmat", platform=jax.devices()[0].platform)
+from bibfs_tpu.graph.generate import rmat_graph
+from bibfs_tpu.solvers.dense import DeviceGraph, time_batch_only
+
+rng = np.random.default_rng(1)
+n2, edges2 = rmat_graph(18, edge_factor=8, seed=1)
+g2 = DeviceGraph.build(n2, edges2, layout="tiered")
+rows2 = {{}}
+for b in (32, 256):
+    pairs = np.stack(
+        [rng.integers(0, n2, b), rng.integers(0, n2, b)], axis=1)
+    try:
         bt = time_batch_only(g2, pairs, repeats=3, mode="sync")
         med = float(np.median(bt))
         rows2[str(b)] = dict(batch_s=med, per_query_us=med / b * 1e6)
         print("rmat18 batch", b, rows2[str(b)], file=sys.stderr, flush=True)
-    out["batch_rmat18"] = rows2
-except Exception as e:
-    out["batch_rmat18"] = dict(error=str(e)[:200])
+    except Exception as e:
+        rows2[str(b)] = dict(error=str(e)[:200])
+        break  # the context is suspect after any device-level failure
+out["batch_rmat18"] = rows2
+if not any("per_query_us" in v for v in rows2.values()):
+    # no measurement landed: surface it as a retryable item failure
+    # instead of a clean-looking record the watcher would accept
+    out["error"] = next(iter(rows2.values()))["error"]
 print("RESULT " + json.dumps(out))
 """
 
@@ -330,6 +375,7 @@ ITEMS = {
     "pallas": (PALLAS_SUB, 900),
     "mesh1": (MESH1_SUB, 900),
     "batch": (BATCH_SUB, 2100),
+    "batch_rmat": (BATCH_RMAT_SUB, 900),
     "levels": (LEVELS_SUB, 900),
     # the round-3 dual-fusion A/B (sync vs sync_unfused) on the chip,
     # where the per-level fixed cost the fusion targets actually lives

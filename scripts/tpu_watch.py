@@ -159,6 +159,11 @@ STEPS = [
      lambda: session_item_ok("levels")),
     ("session_batch", _session_argv("batch"), 2400, 3,
      lambda: session_item_ok("batch")),
+    # its own step, not a leg of session_batch: a device-level failure
+    # in either wedges the process's TPU context (2026-07-31 run), and
+    # a separate step gives it independent budget + retry + artifact
+    ("session_batch_rmat", _session_argv("batch_rmat"), 1200, 3,
+     lambda: session_item_ok("batch_rmat")),
     ("session_mesh1", _session_argv("mesh1"), 1200, 3,
      lambda: session_item_ok("mesh1")),
     ("session_fusion", _session_argv("fusion"), 1500, 3,

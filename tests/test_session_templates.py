@@ -95,3 +95,17 @@ def test_levels_item_executes():
     assert "device_level_s" in rec["xla"]
     if rec["fused_compiles"]:
         assert "device_level_s" in rec["fused"]
+
+
+@pytest.mark.slow
+def test_batch_items_execute():
+    # batch and batch_rmat are separate items (a device-level failure
+    # wedges the process's TPU context, so they must not share one — the
+    # 2026-07-31 on-chip run lost the RMAT leg to the b=2048 wedge).
+    rec = _run_item("batch", ("batch_100k",))
+    for row in rec["batch_100k"].values():
+        assert "per_query_us" in row, rec
+    rmat = _run_item("batch_rmat", ("batch_rmat18",))
+    assert "error" not in rmat, rmat
+    for row in rmat["batch_rmat18"].values():
+        assert "per_query_us" in row, rmat
