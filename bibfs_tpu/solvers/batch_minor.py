@@ -1,0 +1,293 @@
+"""Batch-minor batched bidirectional BFS: per-query state as the MINOR
+(lane) axis, so the expansion gather moves contiguous lines.
+
+Why this exists (measured, `TPU_SESSION.jsonl` item ``batch``,
+2026-07-31): the vmapped batch kernel (`dense._get_batch_kernel_resolved`)
+lays state out batch-MAJOR — ``frontier[B, n]`` — so its per-level
+expansion is a batched arbitrary-index gather ``frontier[b, nbr[v, j]]``:
+every (query, vertex, slot) fetches ONE scattered int32, and TPU gathers
+issue roughly element-at-a-time. That is the 26.8 ms/query batch
+asymptote: 1.78 ms/level/query of almost pure gather time at B=1024.
+
+Here the SAME lock-step sync schedule runs over ``[n_pad, B]`` state.
+Every query shares one neighbor table, so the expansion becomes
+
+    vals[j, v, :] = dual[nbr_t[j, v], :]        # one row per index
+
+— a gather of CONTIGUOUS ``B``-wide lane lines (B a multiple of 128):
+each of the ``Wp * n_pad`` indices now serves ALL queries at once, and
+the gather's cost model flips from per-element to per-row bandwidth.
+Everything downstream (any-hit, the key-min parent claim, dist/par
+selects, counts, the meet vote) is elementwise/reduce work with B on the
+lane axis — exactly what the VPU tiles natively.
+
+The level is chunked over the vertex axis (``lax.scan`` +
+``dynamic_update_slice``) so the ``[Wp, Tc, B]`` gathered block stays
+inside a fixed working-set budget at any graph size; the whole multi-
+query search is still ONE ``lax.while_loop`` in ONE dispatch.
+
+Semantics match the vmapped batch path: all queries advance lock-step
+(both sides per round), finished queries freeze via masking, termination
+is the proven ``lvl_s + lvl_t >= best`` vote per query, and the outputs
+are per-query ``(best, meet, par_s, par_t, levels, edges)`` exactly as
+`dense._materialize_batch` expects.
+
+Plain ELL only: hub-tier tables would gather ``[count_pad, twidth, B]``
+blocks per tier, whose working set needs its own chunking plan — tiered
+graphs route to the vmapped path (`dense._get_batch_kernel`).
+
+Reference parity anchor: the reference has no batch mode at all — its
+harness launches one process per query (benchmark_test.sh:44-59); the
+batch solvers are the amortized-throughput regime the TPU design adds.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bibfs_tpu.ops.pallas_expand import _slot_pad, sentinel_transposed_table
+
+INF32 = 1 << 30
+_BIG = 2147483647  # int32 max: never wins a min
+
+# lane quantum: pad the batch axis so every row is whole vreg lanes
+LANES = 128
+
+# working-set budget for one chunk's gathered [Wp, Tc, B] block (plus its
+# same-shape hit/key intermediates); deliberately well under HBM so the
+# while-carry state (7 [n_pad, B] arrays) keeps the headroom
+CHUNK_BUDGET_BYTES = 192 * 2**20
+
+
+def pad_batch(b: int) -> int:
+    """Queries padded up to whole 128-lane groups (dummy pads are
+    src==dst==0 queries: best=0 at init, frozen before round one)."""
+    return max(LANES, -(-b // LANES) * LANES)
+
+
+def chunk_rows(wp: int, b_pad: int, n_pad: int) -> int:
+    """Vertex rows per scan chunk: the largest sublane-quantum multiple
+    whose ``[Wp, Tc, B]`` gathered block fits the budget (always >= 8;
+    a too-wide geometry is rejected by :func:`minor_fits` instead)."""
+    raw = CHUNK_BUDGET_BYTES // (wp * b_pad * 4)
+    return int(max(8, min(n_pad, (raw // 8) * 8)))
+
+
+def minor_fits(n_pad: int, width: int, b: int) -> bool:
+    """Whether the batch-minor path handles this (graph, batch) shape:
+    the key-min parent encoding ``(Wp-1)*KS + sentinel`` must stay in
+    int32 (same bound as the fused kernel's, pallas_fused.fused_fits),
+    and one 8-row chunk must fit the working-set budget."""
+    wp = _slot_pad(width)
+    ks = n_pad + 1
+    if wp * ks >= (1 << 31):
+        return False
+    return wp * 8 * pad_batch(b) * 4 <= CHUNK_BUDGET_BYTES
+
+
+def _level_scan(dual, st, nbr_t, deg2, *, tc: int, ks: int, lvl, active_i):
+    """One lock-step level over all queries: scan the vertex axis in
+    ``tc``-row chunks. ``dual [n_pad, B]`` is the round's read-only
+    frontier (bit 0 = source side, bit 1 = target side); ``st`` carries
+    the dist/par planes being rewritten. Returns the updated planes plus
+    the per-query reductions."""
+    dist_s, dist_t, par_s, par_t = st
+    n_pad, b = dual.shape
+    wp = nbr_t.shape[0]
+    num_chunks = n_pad // tc
+    zb = jnp.zeros((b,), jnp.int32)
+    key = (
+        jax.lax.broadcasted_iota(jnp.int32, (wp, tc), 0) * ks
+    )  # + nbr_c per chunk
+
+    def chunk(carry, c):
+        dual_n, ds, dt, ps, pt, cs, ct, sc, mval, midx = carry
+        r0 = c * tc
+        nbr_c = jax.lax.dynamic_slice(nbr_t, (0, r0), (wp, tc))
+        deg_c = jax.lax.dynamic_slice(deg2, (r0,), (tc,))[:, None]
+        dual_c = jax.lax.dynamic_slice(dual, (r0, 0), (tc, b))
+        # THE gather: one contiguous B-wide row per (slot, vertex) index;
+        # the sentinel index n_pad is out of range and reads 0 (fill)
+        vals = jnp.take(dual, nbr_c, axis=0, mode="fill", fill_value=0)
+        keys = key + nbr_c  # [wp, tc] static per chunk
+
+        def side(bit, d_c, p_c):
+            hit = jax.lax.shift_right_logical(vals, bit) & 1
+            anyh = jnp.max(hit, axis=0)  # [tc, b]
+            nf = jnp.where(d_c < INF32, 0, anyh) * active_i[None, :]
+            kmin = jnp.min(
+                jnp.where(hit > 0, keys[:, :, None], _BIG), axis=0
+            )
+            d2 = jnp.where(nf > 0, lvl, d_c)
+            p2 = jnp.where(nf > 0, kmin % ks, p_c)
+            # scanned edges: this side's OLD frontier rows in this chunk
+            fr_old = jax.lax.shift_right_logical(dual_c, bit) & 1
+            return nf, d2, p2, jnp.sum(fr_old * deg_c, axis=0)
+
+        ds_c = jax.lax.dynamic_slice(ds, (r0, 0), (tc, b))
+        dt_c = jax.lax.dynamic_slice(dt, (r0, 0), (tc, b))
+        ps_c = jax.lax.dynamic_slice(ps, (r0, 0), (tc, b))
+        pt_c = jax.lax.dynamic_slice(pt, (r0, 0), (tc, b))
+        nf_s, ds2, ps2, sc_s = side(0, ds_c, ps_c)
+        nf_t, dt2, pt2, sc_t = side(1, dt_c, pt_c)
+
+        # meet vote on the post-update planes (exact level-synchronously)
+        both = (ds2 < INF32) & (dt2 < INF32)
+        sums = jnp.where(both, ds2 + dt2, INF32)
+        mv = jnp.min(sums, axis=0)
+        rowid = r0 + jax.lax.broadcasted_iota(jnp.int32, sums.shape, 0)
+        mi = jnp.min(jnp.where(sums == mv[None, :], rowid, _BIG), axis=0)
+        # chunks walk ids in order, so strict < keeps the lowest-id argmin
+        take = mv < mval
+        carry = (
+            jax.lax.dynamic_update_slice(
+                dual_n, nf_s | jax.lax.shift_left(nf_t, 1), (r0, 0)
+            ),
+            jax.lax.dynamic_update_slice(ds, ds2, (r0, 0)),
+            jax.lax.dynamic_update_slice(dt, dt2, (r0, 0)),
+            jax.lax.dynamic_update_slice(ps, ps2, (r0, 0)),
+            jax.lax.dynamic_update_slice(pt, pt2, (r0, 0)),
+            cs + jnp.sum(nf_s, axis=0),
+            ct + jnp.sum(nf_t, axis=0),
+            sc + (sc_s + sc_t) * active_i,
+            jnp.where(take, mv, mval),
+            jnp.where(take, mi, midx),
+        )
+        return carry, None
+
+    init = (
+        jnp.zeros_like(dual), dist_s, dist_t, par_s, par_t,
+        zb, zb, zb, jnp.full((b,), INF32, jnp.int32),
+        jnp.full((b,), -1, jnp.int32),
+    )
+    out, _ = jax.lax.scan(
+        chunk, init, jnp.arange(num_chunks, dtype=jnp.int32)
+    )
+    return out
+
+
+def _build_minor_kernel(n: int, n_pad2: int, wp: int, tc: int, b: int):
+    """The jitted whole-batch search for one (graph, batch) geometry.
+    Signature ``(nbr, deg, srcs, dsts) -> (best, meet, par_s [B, n_pad],
+    par_t, levels, edges)`` — the same output contract as the vmapped
+    batch kernel, so `dense._materialize_batch` serves both."""
+    ks = n_pad2 + 1
+
+    def kernel(nbr, deg, srcs, dsts):
+        n_rows = nbr.shape[0]
+        nbr_t = sentinel_transposed_table(
+            nbr, deg, n_pad2, n_pad2, wp
+        )  # [wp, n_pad2], sentinel = n_pad2 reads fill 0
+        deg2 = jnp.pad(deg.astype(jnp.int32), (0, n_pad2 - n_rows))
+        qi = jnp.arange(b, dtype=jnp.int32)
+        zplane = jnp.zeros((n_pad2, b), jnp.int32)
+        dual0 = zplane.at[srcs, qi].add(1).at[dsts, qi].add(2)
+        inf_plane = jnp.full((n_pad2, b), INF32, jnp.int32)
+        neg_plane = jnp.full((n_pad2, b), -1, jnp.int32)
+        st0 = dict(
+            dual=dual0,
+            dist_s=inf_plane.at[srcs, qi].set(0),
+            dist_t=inf_plane.at[dsts, qi].set(0),
+            par_s=neg_plane,
+            par_t=neg_plane,
+            best=jnp.where(srcs == dsts, 0, INF32).astype(jnp.int32),
+            meet=jnp.where(srcs == dsts, srcs, -1).astype(jnp.int32),
+            cnt_s=jnp.ones((b,), jnp.int32),
+            cnt_t=jnp.ones((b,), jnp.int32),
+            levels=jnp.zeros((b,), jnp.int32),
+            edges=jnp.zeros((b,), jnp.int32),
+            rnd=jnp.int32(0),
+        )
+
+        def active_of(st):
+            return (
+                (2 * st["rnd"] < st["best"])
+                & (st["cnt_s"] > 0)
+                & (st["cnt_t"] > 0)
+            )
+
+        def cond(st):
+            return jnp.any(active_of(st))
+
+        def body(st):
+            active_i = active_of(st).astype(jnp.int32)
+            lvl = st["rnd"] + 1
+            dual_n, ds, dt, ps, pt, cs, ct, sc, mval, midx = _level_scan(
+                st["dual"],
+                (st["dist_s"], st["dist_t"], st["par_s"], st["par_t"]),
+                nbr_t, deg2, tc=tc, ks=ks, lvl=lvl, active_i=active_i,
+            )
+            take = mval < st["best"]
+            return dict(
+                dual=dual_n, dist_s=ds, dist_t=dt, par_s=ps, par_t=pt,
+                best=jnp.minimum(st["best"], mval),
+                meet=jnp.where(take, midx, st["meet"]),
+                cnt_s=cs, cnt_t=ct,
+                levels=st["levels"] + 2 * active_i,
+                edges=st["edges"] + sc,
+                rnd=lvl,
+            )
+
+        out = jax.lax.while_loop(cond, body, st0)
+        return (
+            out["best"], out["meet"],
+            out["par_s"].T, out["par_t"].T,
+            out["levels"], out["edges"],
+        )
+
+    return kernel
+
+
+@lru_cache(maxsize=None)
+def _get_minor_kernel(n: int, n_pad2: int, wp: int, tc: int, b: int):
+    return jax.jit(_build_minor_kernel(n, n_pad2, wp, tc, b))
+
+
+def _minor_geometry(g, num_pairs: int) -> tuple[int, int, int, int]:
+    """(n_pad2, wp, tc, b_pad) for a DeviceGraph + batch size, after the
+    fit checks. Vertex padding is to whole chunks so the scan covers the
+    plane exactly; pad rows read sentinel slots only and stay inert."""
+    if g.tier_meta:
+        raise ValueError(
+            "batch-minor path is plain-ELL only; tiered graphs route to "
+            "the vmapped batch path (solve_batch_graph mode='sync')"
+        )
+    b_pad = pad_batch(num_pairs)
+    wp = _slot_pad(g.width)
+    if not minor_fits(g.n_pad, g.width, num_pairs):
+        raise ValueError(
+            f"batch-minor geometry does not fit (n_pad={g.n_pad}, "
+            f"width={g.width}, batch={num_pairs}); use the vmapped path"
+        )
+    tc = chunk_rows(wp, b_pad, g.n_pad)
+    n_pad2 = -(-g.n_pad // tc) * tc
+    # the kernel's key stride is n_pad2 + 1 (sentinel included), which
+    # chunk rounding can push past what minor_fits checked with n_pad
+    if wp * (n_pad2 + 1) >= (1 << 31):
+        raise ValueError(
+            f"batch-minor parent key overflows int32 after chunk "
+            f"rounding (n_pad2={n_pad2}, wp={wp}); use the vmapped path"
+        )
+    return n_pad2, wp, tc, b_pad
+
+
+def batch_dispatch(g, pairs):
+    """`dense._batch_dispatch` contract for mode='minor': returns
+    ``(pairs, thunk)`` where the thunk runs the whole batch and blocks.
+    ``pairs`` arrive already normalized and range-checked by the shared
+    `dense._batch_dispatch` entry."""
+    n_pad2, wp, tc, b_pad = _minor_geometry(g, len(pairs))
+    kern = _get_minor_kernel(g.n, n_pad2, wp, tc, b_pad)
+    srcs = np.zeros(b_pad, np.int32)
+    dsts = np.zeros(b_pad, np.int32)
+    srcs[: len(pairs)] = pairs[:, 0]
+    dsts[: len(pairs)] = pairs[:, 1]
+    srcs_a = jnp.asarray(srcs)
+    dsts_a = jnp.asarray(dsts)
+    return pairs, lambda: jax.block_until_ready(
+        kern(g.nbr, g.deg, srcs_a, dsts_a)
+    )

@@ -116,6 +116,31 @@ def main(argv=None) -> int:
         )
         record("dense/batch4/sync/ell", ok, err, t0)
 
+        # batch-MINOR kernel ([n_pad, B] planes, contiguous-row gather;
+        # multi-chunk scan geometry so the audited program includes the
+        # dynamic_slice/update plumbing the big-graph path uses)
+        t0 = time.time()
+        try:
+            from bibfs_tpu.ops.pallas_expand import _slot_pad
+            from bibfs_tpu.solvers.batch_minor import (
+                _build_minor_kernel,
+                chunk_rows,
+                pad_batch,
+            )
+
+            wp = _slot_pad(gell.width)
+            b_pad = pad_batch(256)
+            tc = chunk_rows(wp, b_pad, gell.n_pad)
+            n_pad2 = -(-gell.n_pad // tc) * tc
+            mfn = _build_minor_kernel(gell.n, n_pad2, wp, tc, b_pad)
+            ok, err = aot_compile_tpu(
+                mfn, np.asarray(gell.nbr), np.asarray(gell.deg),
+                np.zeros(b_pad, np.int32), np.full(b_pad, n - 1, np.int32),
+            )
+        except Exception as e:
+            ok, err = False, f"{type(e).__name__}: {e}"
+        record("dense/batch256/minor/ell", ok, err, t0)
+
         # checkpoint chunk kernel (chunked dense execution)
         t0 = time.time()
         try:

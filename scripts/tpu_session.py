@@ -243,6 +243,81 @@ if not any("per_query_us" in v for v in rows2.values()):
 print("RESULT " + json.dumps(out))
 """
 
+# The batch-MINOR layout on the chip (solvers/batch_minor.py): same
+# graph family and sweep shape as ``batch``, so the two items' per-query
+# curves are directly comparable. The vmapped sync control runs FIRST on
+# the same pairs at b=256 (before any size that could wedge the TPU
+# context), and an 8-pair oracle parity gate guards the whole sweep —
+# a fast wrong answer must read as a failure, not a win.
+BATCH_MINOR_SUB = """
+import json, sys, time
+import numpy as np
+sys.path.insert(0, {repo!r})
+from bibfs_tpu.utils.platform import apply_platform_env
+apply_platform_env()
+import jax
+out = dict(item="batch_minor", platform=jax.devices()[0].platform)
+from bibfs_tpu.graph.generate import gnp_random_graph
+from bibfs_tpu.solvers.dense import (
+    DeviceGraph, solve_batch_graph, time_batch_only,
+)
+from bibfs_tpu.solvers.serial import solve_serial
+
+n = 100_000
+edges = gnp_random_graph(n, 2.2 / n, seed=1)
+g = DeviceGraph.build(n, edges)
+rng = np.random.default_rng(0)  # the sweep owns this rng (see batch item)
+
+# oracle parity gate on-chip: 8 mixed pairs incl. src==dst
+gate = np.stack([rng.integers(0, n, 8), rng.integers(0, n, 8)], axis=1)
+gate[3] = (7, 7)
+res = solve_batch_graph(g, gate, mode="minor")
+ok = True
+for (s, d), r in zip(gate, res):
+    ref = solve_serial(n, edges, int(s), int(d))
+    ok = ok and (r.found == ref.found) and (
+        not ref.found or r.hops == ref.hops)
+out["parity_ok"] = bool(ok)
+if not ok:
+    out["error"] = "minor-path hop parity FAILED on chip"
+    print("RESULT " + json.dumps(out))
+    sys.exit(0)
+
+rows = {{}}
+pairs256 = np.stack(
+    [rng.integers(0, n, 256), rng.integers(0, n, 256)], axis=1)
+# vmapped sync control, SAME pairs, before any size that could wedge
+bt = time_batch_only(g, pairs256, repeats=3, mode="sync")
+med = float(np.median(bt))
+out["sync_control_256"] = dict(batch_s=med, per_query_us=med / 256 * 1e6)
+print("sync control", out["sync_control_256"], file=sys.stderr, flush=True)
+
+for b in (32, 128, 256, 1024, 2048, 4096):
+    pairs = (pairs256[:b] if b <= 256 else np.stack(
+        [rng.integers(0, n, b), rng.integers(0, n, b)], axis=1))
+    reps = 5 if b <= 256 else 3
+    try:
+        bt = time_batch_only(g, pairs, repeats=reps, mode="minor")
+        med = float(np.median(bt))
+        rows[str(b)] = dict(batch_s=med, per_query_us=med / b * 1e6)
+        print("minor", b, rows[str(b)], file=sys.stderr, flush=True)
+    except Exception as e:
+        rows[str(b)] = dict(error=str(e)[:200])
+        print("minor", b, rows[str(b)], file=sys.stderr, flush=True)
+        msg = str(e).lower()
+        if "resource" in msg or "memory" in msg or "oom" in msg:
+            break
+        if "unavailable" in msg or "device error" in msg:
+            rows[str(b)]["note"] = (
+                "device-level failure wedges this process's TPU context;"
+                " stopping the escalation")
+            break
+out["minor_100k"] = rows
+if not any("per_query_us" in v for v in rows.values()):
+    out["error"] = next(iter(rows.values()))["error"]
+print("RESULT " + json.dumps(out))
+"""
+
 LEVELS_SUB = """
 import json, sys, time
 import numpy as np
@@ -375,6 +450,7 @@ ITEMS = {
     "pallas": (PALLAS_SUB, 900),
     "mesh1": (MESH1_SUB, 900),
     "batch": (BATCH_SUB, 2100),
+    "batch_minor": (BATCH_MINOR_SUB, 1500),
     "batch_rmat": (BATCH_RMAT_SUB, 900),
     "levels": (LEVELS_SUB, 900),
     # the round-3 dual-fusion A/B (sync vs sync_unfused) on the chip,

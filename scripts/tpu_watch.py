@@ -164,6 +164,10 @@ STEPS = [
     # a separate step gives it independent budget + retry + artifact
     ("session_batch_rmat", _session_argv("batch_rmat"), 1200, 3,
      lambda: session_item_ok("batch_rmat")),
+    # the batch-MINOR layout sweep (contiguous-row expansion gather) —
+    # the round-4 answer to the 26.8 ms/query vmapped asymptote
+    ("session_batch_minor", _session_argv("batch_minor"), 1800, 3,
+     lambda: session_item_ok("batch_minor")),
     ("session_mesh1", _session_argv("mesh1"), 1200, 3,
      lambda: session_item_ok("mesh1")),
     ("session_fusion", _session_argv("fusion"), 1500, 3,
@@ -312,13 +316,24 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--max-hours", type=float, default=11.0)
     ap.add_argument("--poll-s", type=float, default=120.0)
+    ap.add_argument("--skip", nargs="*", default=[],
+                    help="step names to leave alone this run (e.g. a "
+                         "freshness-gated step whose artifact is already "
+                         "committed, after the status file was lost)")
     args = ap.parse_args(argv)
 
+    known = {s[0] for s in STEPS}
+    unknown = set(args.skip) - known
+    if unknown:
+        ap.error(f"--skip: unknown step(s) {sorted(unknown)}; "
+                 f"known: {sorted(known)}")
+    steps = [s for s in STEPS if s[0] not in set(args.skip)]
     deadline = time.time() + args.max_hours * 3600
     st = load_status()
-    log(f"watcher up: pid={os.getpid()} deadline in {args.max_hours}h")
+    log(f"watcher up: pid={os.getpid()} deadline in {args.max_hours}h"
+        + (f" skip={sorted(set(args.skip))}" if args.skip else ""))
     while time.time() < deadline:
-        pending = [s for s in STEPS if step_pending(st, s[0], s[3], s[4])]
+        pending = [s for s in steps if step_pending(st, s[0], s[3], s[4])]
         if not pending:
             log("all steps done (or attempt-capped); watcher exiting")
             break

@@ -1,0 +1,174 @@
+"""Batch-minor batched solver (solvers/batch_minor.py) vs the serial
+oracle and the vmapped batch path.
+
+Same cross-implementation agreement bar as every other backend
+(SURVEY.md §4.3): identical hop counts, valid paths, exact behavior on
+unreachable / src==dst / padded-dummy queries — plus the layout-specific
+legs (forced multi-chunk scan, batch padding, fit guards) and the
+deviceless TPU compile gate the kernel-bearing programs all carry."""
+
+import numpy as np
+import pytest
+
+from bibfs_tpu.graph.csr import build_ell
+from bibfs_tpu.solvers.dense import DeviceGraph, solve_batch_graph
+from bibfs_tpu.solvers.serial import solve_serial
+from tests.conftest import random_graph_cases
+
+CASES = random_graph_cases(num=12, seed=77)
+
+
+def _ell_graph(case):
+    n, edges, _, _ = CASES[case]
+    return n, edges, DeviceGraph.from_ell(build_ell(n, edges))
+
+
+@pytest.mark.parametrize("case", range(0, len(CASES), 2))
+def test_minor_batch_matches_serial(case):
+    n, edges, g = _ell_graph(case)
+    rng = np.random.default_rng(5)
+    pairs = rng.integers(0, n, size=(9, 2))
+    pairs[3] = (min(2, n - 1), min(2, n - 1))  # src == dst
+    got = solve_batch_graph(g, pairs, mode="minor")
+    assert len(got) == len(pairs)
+    for (src, dst), r in zip(pairs, got):
+        ref = solve_serial(n, edges, int(src), int(dst))
+        assert r.found == ref.found
+        if ref.found:
+            assert r.hops == ref.hops
+            r.validate_path(n, edges, int(src), int(dst))
+
+
+def test_minor_matches_vmapped_batch():
+    """Same pairs through both batch layouts: identical found/hops and
+    per-query TEPS accounting (the schedules are the same sync lock-step,
+    so the edge-scan counts must agree exactly, not just the answers)."""
+    n, edges, g = _ell_graph(1)
+    rng = np.random.default_rng(11)
+    pairs = rng.integers(0, n, size=(6, 2))
+    a = solve_batch_graph(g, pairs, mode="sync")
+    b = solve_batch_graph(g, pairs, mode="minor")
+    for ra, rb in zip(a, b):
+        assert ra.found == rb.found
+        assert ra.hops == rb.hops
+        assert ra.levels == rb.levels
+        assert ra.edges_scanned == rb.edges_scanned
+
+
+def test_minor_forced_multichunk():
+    """A tiny forced chunk size must walk the scan path (several chunks
+    per level) and still agree with the single-chunk answer."""
+    from bibfs_tpu.ops.pallas_expand import _slot_pad
+    from bibfs_tpu.solvers.batch_minor import (
+        _get_minor_kernel,
+        pad_batch,
+    )
+    from bibfs_tpu.solvers.dense import _materialize_batch
+
+    n, edges, g = _ell_graph(0)
+    rng = np.random.default_rng(7)
+    pairs = rng.integers(0, n, size=(5, 2))
+    wp = _slot_pad(g.width)
+    tc = 8
+    n_pad2 = -(-g.n_pad // tc) * tc
+    b_pad = pad_batch(len(pairs))
+    kern = _get_minor_kernel(g.n, n_pad2, wp, tc, b_pad)
+    srcs = np.zeros(b_pad, np.int32)
+    dsts = np.zeros(b_pad, np.int32)
+    srcs[: len(pairs)] = pairs[:, 0]
+    dsts[: len(pairs)] = pairs[:, 1]
+    out = kern(g.nbr, g.deg, srcs, dsts)
+    got = _materialize_batch(out, len(pairs), 0.0)
+    assert n_pad2 // tc > 1  # the scan really iterates
+    for (src, dst), r in zip(pairs, got):
+        ref = solve_serial(n, edges, int(src), int(dst))
+        assert r.found == ref.found
+        if ref.found:
+            assert r.hops == ref.hops
+            r.validate_path(n, edges, int(src), int(dst))
+
+
+def test_minor_batch_padding_inert():
+    """A batch far below the 128-lane quantum: the dummy pad queries must
+    not perturb the real ones, and exactly len(pairs) results return."""
+    n = 40
+    edges = np.array([[i, i + 1] for i in range(n - 1)])
+    g = DeviceGraph.from_ell(build_ell(n, edges))
+    pairs = [(0, n - 1), (3, 3), (5, 20)]
+    got = solve_batch_graph(g, pairs, mode="minor")
+    assert len(got) == 3
+    assert got[0].found and got[0].hops == n - 1
+    assert got[1].found and got[1].hops == 0 and got[1].path == [3]
+    assert got[2].found and got[2].hops == 15
+
+
+def test_minor_disconnected_and_counters():
+    edges = np.array([[0, 1], [1, 2], [3, 4]])
+    g = DeviceGraph.from_ell(build_ell(5, edges))
+    got = solve_batch_graph(g, [(0, 4), (0, 2)], mode="minor")
+    assert not got[0].found
+    assert got[1].found and got[1].hops == 2
+    assert got[1].levels >= 2 and got[1].edges_scanned > 0
+
+
+def test_minor_tiered_rejected():
+    from bibfs_tpu.graph.csr import build_tiered
+    from bibfs_tpu.graph.generate import rmat_graph
+
+    n, edges = rmat_graph(7, edge_factor=6, seed=1)
+    g = DeviceGraph.from_tiered(build_tiered(n, edges))
+    with pytest.raises(ValueError, match="plain-ELL only"):
+        solve_batch_graph(g, [(0, 1)], mode="minor")
+
+
+def test_minor_range_check():
+    g = DeviceGraph.from_ell(build_ell(4, np.array([[0, 1]])))
+    with pytest.raises(ValueError):
+        solve_batch_graph(g, [(0, 9)], mode="minor")
+
+
+def test_minor_fits_bounds():
+    """Key-encoding overflow and working-set overflow both reject."""
+    from bibfs_tpu.solvers.batch_minor import (
+        CHUNK_BUDGET_BYTES,
+        minor_fits,
+    )
+
+    assert minor_fits(100_000, 8, 1024)
+    # (Wp-1)*KS + sentinel needs int32: huge n x wide rows overflows
+    assert not minor_fits(1 << 28, 64, 32)
+    # one 8-row chunk over the budget: absurd width x batch
+    too_wide = CHUNK_BUDGET_BYTES // (8 * 128 * 4) + 8
+    assert not minor_fits(1 << 20, too_wide, 128)
+
+
+def test_minor_time_batch_protocol():
+    """The timing entries accept mode='minor' through the shared
+    dispatch (times list length, median, per-query results)."""
+    from bibfs_tpu.solvers.dense import time_batch_graph
+
+    n, edges, g = _ell_graph(2)
+    pairs = [(0, n - 1), (1, 2)]
+    times, got = time_batch_graph(g, pairs, repeats=3, mode="minor")
+    assert len(times) == 3 and len(got) == 2
+    ref = solve_serial(n, edges, 0, n - 1)
+    assert got[0].found == ref.found
+
+
+def test_minor_compiles_deviceless_for_tpu():
+    """The whole batch-minor search program must lower through XLA:TPU
+    (utils/tpu_aot.py — no chip needed); same committed gate as the
+    fused/pallas programs carry."""
+    from bibfs_tpu.solvers.batch_minor import _build_minor_kernel
+    from bibfs_tpu.utils.tpu_aot import aot_compile_tpu
+
+    n, n_pad2, wp, tc, b = 120, 128, 8, 64, 128
+    kern = _build_minor_kernel(n, n_pad2, wp, tc, b)
+    ok, err = aot_compile_tpu(
+        kern,
+        np.zeros((120, 6), "int32"), np.zeros((120,), "int32"),
+        np.zeros((b,), "int32"), np.zeros((b,), "int32"),
+    )
+    if err and "unavailable" in err:
+        pytest.skip(err)
+    assert ok, err

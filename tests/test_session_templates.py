@@ -109,3 +109,13 @@ def test_batch_items_execute():
     assert "error" not in rmat, rmat
     for row in rmat["batch_rmat18"].values():
         assert "per_query_us" in row, rmat
+
+
+@pytest.mark.slow
+def test_batch_minor_item_executes():
+    rec = _run_item("batch_minor", ("parity_ok", "minor_100k",
+                                    "sync_control_256"))
+    assert rec["parity_ok"], rec
+    assert "error" not in rec, rec
+    for row in rec["minor_100k"].values():
+        assert "per_query_us" in row, rec
