@@ -473,6 +473,29 @@ def main():
             except Exception as e:
                 print(f"batch timing failed: {e}", file=sys.stderr)
 
+        # batch-MINOR layout at a throughput-regime size (256): the
+        # [n_pad, B]-plane path whose expansion is a contiguous-row
+        # gather (solvers/batch_minor.py), int32 and int8 planes —
+        # measured against the vmapped batch32 row above
+        if batch_stats is not None and not over_budget():
+            rng = np.random.default_rng(0)
+            mpairs = np.stack(
+                [rng.integers(0, N, size=256), rng.integers(0, N, size=256)],
+                axis=1,
+            )
+            for bmode in ("minor", "minor8"):
+                try:
+                    bt = time_batch_only(
+                        graphs["ell"], mpairs, repeats=3, mode=bmode
+                    )
+                    batch_stats[f"{bmode}256_per_query_us"] = round(
+                        float(np.median(bt)) / 256 * 1e6, 2
+                    )
+                except Exception as e:
+                    print(f"{bmode} batch timing failed: {e}",
+                          file=sys.stderr)
+                    batch_stats[f"{bmode}256_error"] = str(e)[:200]
+
         if not results:
             emit(
                 None,

@@ -921,12 +921,15 @@ def _batch_dispatch(g: DeviceGraph, pairs, mode: str):
     pairs = np.asarray(pairs, dtype=np.int64).reshape(-1, 2)
     if pairs.size and not ((0 <= pairs).all() and (pairs < g.n).all()):
         raise ValueError(f"src/dst out of range for n={g.n}")
-    if mode == "minor":
+    if mode in ("minor", "minor8"):
         # batch-MINOR layout ([n_pad, B] planes, contiguous-row expansion
-        # gather — solvers/batch_minor.py); plain-ELL only by design
+        # gather — solvers/batch_minor.py); "minor8" additionally drops
+        # the dual/dist planes to int8 (4x less gather + reread traffic,
+        # depth-capped queries re-solved via the int32 kernel). Plain-ELL
+        # only by design
         from bibfs_tpu.solvers.batch_minor import batch_dispatch
 
-        return batch_dispatch(g, pairs)
+        return batch_dispatch(g, pairs, dt8=(mode == "minor8"))
     kern = _get_batch_kernel(mode, kernel_cap(mode, g.n_pad), g.tier_meta,
                              _geom_of(g))
     srcs = jnp.asarray(pairs[:, 0], dtype=jnp.int32)

@@ -141,6 +141,22 @@ def main(argv=None) -> int:
             ok, err = False, f"{type(e).__name__}: {e}"
         record("dense/batch256/minor/ell", ok, err, t0)
 
+        # int8-plane variant (mode "minor8"): its own chunk geometry
+        t0 = time.time()
+        try:
+            tc8 = chunk_rows(wp, b_pad, gell.n_pad, itemsize=1)
+            n_pad8 = -(-gell.n_pad // tc8) * tc8
+            m8fn = _build_minor_kernel(
+                gell.n, n_pad8, wp, tc8, b_pad, dt8=True
+            )
+            ok, err = aot_compile_tpu(
+                m8fn, np.asarray(gell.nbr), np.asarray(gell.deg),
+                np.zeros(b_pad, np.int32), np.full(b_pad, n - 1, np.int32),
+            )
+        except Exception as e:
+            ok, err = False, f"{type(e).__name__}: {e}"
+        record("dense/batch256/minor8/ell", ok, err, t0)
+
         # checkpoint chunk kernel (chunked dense execution)
         t0 = time.time()
         try:

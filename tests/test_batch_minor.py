@@ -155,6 +155,105 @@ def test_minor_time_batch_protocol():
     assert got[0].found == ref.found
 
 
+@pytest.mark.parametrize("case", range(1, len(CASES), 3))
+def test_minor8_matches_serial(case):
+    """int8 planes (mode 'minor8'): same oracle bar as 'minor'."""
+    n, edges, g = _ell_graph(case)
+    rng = np.random.default_rng(5)
+    pairs = rng.integers(0, n, size=(9, 2))
+    pairs[4] = (0, 0)
+    got = solve_batch_graph(g, pairs, mode="minor8")
+    for (src, dst), r in zip(pairs, got):
+        ref = solve_serial(n, edges, int(src), int(dst))
+        assert r.found == ref.found
+        if ref.found:
+            assert r.hops == ref.hops
+            r.validate_path(n, edges, int(src), int(dst))
+
+
+def test_minor8_deep_refill():
+    """A query deeper than the int8 cap (MAX_RND8 rounds) must come back
+    EXACT via the transparent int32 refill, spliced alongside shallow
+    queries answered by the int8 kernel — incl. the parent planes the
+    two kernels pad differently."""
+    n = 400  # line graph: 399 hops >> the ~250-hop int8 reach
+    edges = np.array([[i, i + 1] for i in range(n - 1)])
+    g = DeviceGraph.from_ell(build_ell(n, edges))
+    res = solve_batch_graph(g, [(0, n - 1), (0, 10), (5, 5)], mode="minor8")
+    assert res[0].found and res[0].hops == n - 1
+    assert res[0].path == list(range(n))
+    assert res[1].found and res[1].hops == 10
+    assert res[2].found and res[2].hops == 0 and res[2].path == [5]
+
+
+def test_minor8_disconnected():
+    edges = np.array([[0, 1], [1, 2], [3, 4]])
+    g = DeviceGraph.from_ell(build_ell(5, edges))
+    got = solve_batch_graph(g, [(0, 4), (0, 2)], mode="minor8")
+    assert not got[0].found
+    assert got[1].found and got[1].hops == 2
+
+
+def test_minor8_compiles_deviceless_for_tpu():
+    from bibfs_tpu.solvers.batch_minor import _build_minor_kernel
+    from bibfs_tpu.utils.tpu_aot import aot_compile_tpu
+
+    kern = _build_minor_kernel(120, 128, 8, 64, 128, dt8=True)
+    ok, err = aot_compile_tpu(
+        kern,
+        np.zeros((120, 6), "int32"), np.zeros((120,), "int32"),
+        np.zeros((128,), "int32"), np.zeros((128,), "int32"),
+    )
+    if err and "unavailable" in err:
+        pytest.skip(err)
+    assert ok, err
+
+
+@pytest.mark.parametrize("dt8", [False, True])
+def test_dp_batch_matches_serial(dt8):
+    """Data-parallel batch on the 8-device CPU mesh: queries sharded,
+    graph replicated, zero collectives — every pair must agree with the
+    oracle, incl. pairs landing on different device shards."""
+    from bibfs_tpu.solvers.batch_minor import solve_batch_dp
+
+    n, edges, g = _ell_graph(0)
+    rng = np.random.default_rng(13)
+    pairs = rng.integers(0, n, size=(21, 2))  # spans several shards
+    pairs[5] = (3, 3)
+    got = solve_batch_dp(g, pairs, dt8=dt8)
+    assert len(got) == 21
+    for (src, dst), r in zip(pairs, got):
+        ref = solve_serial(n, edges, int(src), int(dst))
+        assert r.found == ref.found
+        if ref.found:
+            assert r.hops == ref.hops
+            r.validate_path(n, edges, int(src), int(dst))
+
+
+def test_dp_batch_deep_refill():
+    """dt8 + a depth-capped query under the mesh: the refill must splice
+    across the sharded output."""
+    from bibfs_tpu.solvers.batch_minor import solve_batch_dp
+
+    n = 400
+    edges = np.array([[i, i + 1] for i in range(n - 1)])
+    g = DeviceGraph.from_ell(build_ell(n, edges))
+    res = solve_batch_dp(g, [(0, n - 1), (2, 9)], dt8=True)
+    assert res[0].found and res[0].hops == n - 1
+    assert res[0].path == list(range(n))
+    assert res[1].found and res[1].hops == 7
+
+
+def test_dp_batch_timing_protocol():
+    from bibfs_tpu.solvers.batch_minor import time_batch_dp
+
+    n, edges, g = _ell_graph(2)
+    times, got = time_batch_dp(g, [(0, n - 1), (1, 2)], repeats=3)
+    assert len(times) == 3 and len(got) == 2
+    ref = solve_serial(n, edges, 0, n - 1)
+    assert got[0].found == ref.found
+
+
 def test_minor_compiles_deviceless_for_tpu():
     """The whole batch-minor search program must lower through XLA:TPU
     (utils/tpu_aot.py — no chip needed); same committed gate as the

@@ -114,8 +114,9 @@ def test_batch_items_execute():
 @pytest.mark.slow
 def test_batch_minor_item_executes():
     rec = _run_item("batch_minor", ("parity_ok", "minor_100k",
-                                    "sync_control_256"))
+                                    "minor8_100k", "sync_control_256"))
     assert rec["parity_ok"], rec
     assert "error" not in rec, rec
-    for row in rec["minor_100k"].values():
-        assert "per_query_us" in row, rec
+    for key in ("minor_100k", "minor8_100k"):
+        for row in rec[key].values():
+            assert "per_query_us" in row, rec
