@@ -73,7 +73,8 @@ def main(argv=None):
         "--mode",
         default=None,
         choices=["sync", "alt", "beamer", "beamer_alt", "pallas",
-                 "pallas_alt", "fused", "fused_alt", "sync_unfused"],
+                 "pallas_alt", "fused", "fused_alt", "sync_unfused",
+                 "minor", "minor8"],
         help="device-kernel schedule for the device backends (default "
         "sync): sync = both sides per round, alt = smaller-frontier-first "
         "alternation; beamer/beamer_alt add push/pull direction "
@@ -82,7 +83,10 @@ def main(argv=None):
         "lock-step level as ONE kernel (dense backend, plain ELL); "
         "pallas/pallas_alt run the "
         "base-table pull as the fused Pallas TPU kernel, hub tiers as XLA "
-        "ops (dense backend; interpreted off-TPU). With --resume, omitting "
+        "ops (dense backend; interpreted off-TPU); minor/minor8 are "
+        "BATCH-only layouts (--pairs, dense backend, plain ELL): per-query "
+        "state on the lane axis so the expansion gathers contiguous rows, "
+        "minor8 with int8 planes. With --resume, omitting "
         "--mode keeps the snapshot's recorded schedule",
     )
     ap.add_argument(
@@ -162,6 +166,13 @@ def main(argv=None):
     ):
         ap.error("--mode fused/fused_alt (whole-level kernel) is only "
                  "supported by the dense and sharded backends")
+    if mode in ("minor", "minor8"):
+        if args.pairs is None or args.backend != "dense":
+            ap.error("--mode minor/minor8 are batch-only layouts: use "
+                     "--pairs FILE with --backend dense (plain ELL)")
+        if args.layout == "tiered":
+            ap.error("--mode minor/minor8 are plain-ELL only; tiered "
+                     "graphs batch through --mode sync")
     if args.pairs is not None:
         if args.backend not in ("dense", "native", "sharded", "sharded2d"):
             ap.error("--pairs batch mode is supported by --backend dense/"

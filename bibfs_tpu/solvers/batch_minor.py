@@ -104,16 +104,18 @@ def chunk_rows(wp: int, b_pad: int, n_pad: int, itemsize: int = 4) -> int:
     return int(max(8, min(n_pad, (raw // 8) * 8)))
 
 
-def minor_fits(n_pad: int, width: int, b: int) -> bool:
+def minor_fits(n_pad: int, width: int, b: int, itemsize: int = 4) -> bool:
     """Whether the batch-minor path handles this (graph, batch) shape:
     the key-min parent encoding ``(Wp-1)*KS + sentinel`` must stay in
     int32 (same bound as the fused kernel's, pallas_fused.fused_fits),
-    and one 8-row chunk must fit the working-set budget."""
+    and one 8-row chunk must fit the working-set budget under the SAME
+    per-element charge :func:`chunk_rows` uses (``itemsize + 4``: the
+    key-select/meet intermediates are int32 regardless of plane dtype)."""
     wp = _slot_pad(width)
     ks = n_pad + 1
     if wp * ks >= (1 << 31):
         return False
-    return wp * 8 * pad_batch(b) * 4 <= CHUNK_BUDGET_BYTES
+    return wp * 8 * pad_batch(b) * (itemsize + 4) <= CHUNK_BUDGET_BYTES
 
 
 def _level_scan(dual, st, nbr_t, deg2, *, tc: int, ks: int, lvl, active_i,
@@ -318,7 +320,8 @@ def _minor_geometry(
         )
     b_pad = pad_batch(num_pairs)
     wp = _slot_pad(g.width)
-    if not minor_fits(g.n_pad, g.width, num_pairs):
+    if not minor_fits(g.n_pad, g.width, num_pairs,
+                      itemsize=1 if dt8 else 4):
         raise ValueError(
             f"batch-minor geometry does not fit (n_pad={g.n_pad}, "
             f"width={g.width}, batch={num_pairs}); use the vmapped path"

@@ -119,43 +119,34 @@ def main(argv=None) -> int:
         # batch-MINOR kernel ([n_pad, B] planes, contiguous-row gather;
         # multi-chunk scan geometry so the audited program includes the
         # dynamic_slice/update plumbing the big-graph path uses)
-        t0 = time.time()
-        try:
-            from bibfs_tpu.ops.pallas_expand import _slot_pad
-            from bibfs_tpu.solvers.batch_minor import (
-                _build_minor_kernel,
-                chunk_rows,
-                pad_batch,
-            )
+        # the EXACT geometry the dispatch runs (incl. its fit + post-
+        # rounding key-overflow checks), via the one shared derivation
+        from types import SimpleNamespace
 
-            wp = _slot_pad(gell.width)
-            b_pad = pad_batch(256)
-            tc = chunk_rows(wp, b_pad, gell.n_pad)
-            n_pad2 = -(-gell.n_pad // tc) * tc
-            mfn = _build_minor_kernel(gell.n, n_pad2, wp, tc, b_pad)
-            ok, err = aot_compile_tpu(
-                mfn, np.asarray(gell.nbr), np.asarray(gell.deg),
-                np.zeros(b_pad, np.int32), np.full(b_pad, n - 1, np.int32),
-            )
-        except Exception as e:
-            ok, err = False, f"{type(e).__name__}: {e}"
-        record("dense/batch256/minor/ell", ok, err, t0)
+        from bibfs_tpu.solvers.batch_minor import (
+            _build_minor_kernel,
+            _minor_geometry,
+        )
 
-        # int8-plane variant (mode "minor8"): its own chunk geometry
-        t0 = time.time()
-        try:
-            tc8 = chunk_rows(wp, b_pad, gell.n_pad, itemsize=1)
-            n_pad8 = -(-gell.n_pad // tc8) * tc8
-            m8fn = _build_minor_kernel(
-                gell.n, n_pad8, wp, tc8, b_pad, dt8=True
-            )
-            ok, err = aot_compile_tpu(
-                m8fn, np.asarray(gell.nbr), np.asarray(gell.deg),
-                np.zeros(b_pad, np.int32), np.full(b_pad, n - 1, np.int32),
-            )
-        except Exception as e:
-            ok, err = False, f"{type(e).__name__}: {e}"
-        record("dense/batch256/minor8/ell", ok, err, t0)
+        gshape = SimpleNamespace(
+            n=gell.n, n_pad=gell.n_pad, width=gell.width, tier_meta=()
+        )
+        for dt8 in (False, True):
+            t0 = time.time()
+            name = "dense/batch256/minor%s/ell" % ("8" if dt8 else "")
+            try:
+                n_pad2, wp, tc, b_pad = _minor_geometry(gshape, 256, dt8)
+                mfn = _build_minor_kernel(
+                    gell.n, n_pad2, wp, tc, b_pad, dt8
+                )
+                ok, err = aot_compile_tpu(
+                    mfn, np.asarray(gell.nbr), np.asarray(gell.deg),
+                    np.zeros(b_pad, np.int32),
+                    np.full(b_pad, n - 1, np.int32),
+                )
+            except Exception as e:
+                ok, err = False, f"{type(e).__name__}: {e}"
+            record(name, ok, err, t0)
 
         # checkpoint chunk kernel (chunked dense execution)
         t0 = time.time()

@@ -323,10 +323,13 @@ for mode in ("minor", "minor8"):
     out["%s_100k" % mode] = rows
 for key in ("minor_100k", "minor8_100k"):
     rows = out[key]
-    if not any("per_query_us" in v for v in rows.values()):
+    if "error" not in out and not any(
+            "per_query_us" in v for v in rows.values()):
         # no measurement landed for this mode (wedged earlier, or every
         # size errored): surface it as a retryable item failure instead
-        # of a clean-looking record the watcher would accept
+        # of a clean-looking record the watcher would accept. First
+        # failure wins — a later mode's derived symptom must not
+        # overwrite the root-cause device error
         out["error"] = (
             next(iter(rows.values()))["error"] if rows
             else "%s: no sizes ran (context wedged earlier)" % key)
