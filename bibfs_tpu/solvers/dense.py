@@ -924,9 +924,9 @@ def _batch_dispatch(g: DeviceGraph, pairs, mode: str):
     if mode in ("minor", "minor8"):
         # batch-MINOR layout ([n_pad, B] planes, contiguous-row expansion
         # gather — solvers/batch_minor.py); "minor8" additionally drops
-        # the dual/dist planes to int8 (4x less gather + reread traffic,
-        # depth-capped queries re-solved via the int32 kernel). Plain-ELL
-        # only by design
+        # ALL loop planes to int8 (slot-coded parents, host-decoded in
+        # ``finish``; depth-capped queries re-solved via the int32
+        # kernel there too). Plain-ELL only by design
         from bibfs_tpu.solvers.batch_minor import batch_dispatch
 
         return batch_dispatch(g, pairs, dt8=(mode == "minor8"))
@@ -934,9 +934,12 @@ def _batch_dispatch(g: DeviceGraph, pairs, mode: str):
                              _geom_of(g))
     srcs = jnp.asarray(pairs[:, 0], dtype=jnp.int32)
     dsts = jnp.asarray(pairs[:, 1], dtype=jnp.int32)
-    return pairs, lambda: jax.block_until_ready(
+    dispatch = lambda: jax.block_until_ready(  # noqa: E731
         kern(g.nbr, g.deg, g.aux, srcs, dsts)
     )
+    # third element: the untimed finish hook (identity for the vmapped
+    # path; the minor8 path decodes slot-parents + refills there)
+    return pairs, dispatch, lambda out: out
 
 
 def _materialize_batch(out, num: int, elapsed: float) -> list[BFSResult]:
@@ -959,12 +962,12 @@ def solve_batch_graph(
     """
     from bibfs_tpu.solvers.timing import force_scalar
 
-    pairs, dispatch = _batch_dispatch(g, pairs, mode)
+    pairs, dispatch, finish = _batch_dispatch(g, pairs, mode)
     t0 = time.perf_counter()
     out = dispatch()
     force_scalar(out)  # execution is lazy until a value read; see timing.py
     elapsed = time.perf_counter() - t0
-    return _materialize_batch(out, pairs.shape[0], elapsed)
+    return _materialize_batch(finish(out), pairs.shape[0], elapsed)
 
 
 def time_batch_graph(
@@ -977,9 +980,11 @@ def time_batch_graph(
     fetch a result would cost real seconds through the tunnel."""
     from bibfs_tpu.solvers.timing import timed_batch_repeats
 
-    pairs, dispatch = _batch_dispatch(g, pairs, mode)
+    pairs, dispatch, finish = _batch_dispatch(g, pairs, mode)
     times, out = timed_batch_repeats(dispatch, repeats)
-    return times, _materialize_batch(out, pairs.shape[0], float(np.median(times)))
+    return times, _materialize_batch(
+        finish(out), pairs.shape[0], float(np.median(times))
+    )
 
 
 def time_batch_only(
@@ -990,7 +995,7 @@ def time_batch_only(
     device program."""
     from bibfs_tpu.solvers.timing import force_scalar, timed_repeats
 
-    _pairs, dispatch = _batch_dispatch(g, pairs, mode)
+    _pairs, dispatch, _finish = _batch_dispatch(g, pairs, mode)
     return timed_repeats(dispatch, None, repeats, force=force_scalar)[0]
 
 

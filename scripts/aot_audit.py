@@ -120,21 +120,24 @@ def main(argv=None) -> int:
         # multi-chunk scan geometry so the audited program includes the
         # dynamic_slice/update plumbing the big-graph path uses)
         # the EXACT geometry the dispatch runs (incl. its fit + post-
-        # rounding key-overflow checks), via the one shared derivation
-        from types import SimpleNamespace
-
-        from bibfs_tpu.solvers.batch_minor import (
-            _build_minor_kernel,
-            _minor_geometry,
-        )
-
-        gshape = SimpleNamespace(
-            n=gell.n, n_pad=gell.n_pad, width=gell.width, tier_meta=()
-        )
+        # rounding key-overflow checks), via the one shared derivation.
+        # Imports stay inside the per-program try so an import failure
+        # records a FAIL row instead of aborting the whole audit
         for dt8 in (False, True):
             t0 = time.time()
             name = "dense/batch256/minor%s/ell" % ("8" if dt8 else "")
             try:
+                from types import SimpleNamespace
+
+                from bibfs_tpu.solvers.batch_minor import (
+                    _build_minor_kernel,
+                    _minor_geometry,
+                )
+
+                gshape = SimpleNamespace(
+                    n=gell.n, n_pad=gell.n_pad, width=gell.width,
+                    tier_meta=(),
+                )
                 n_pad2, wp, tc, b_pad = _minor_geometry(gshape, 256, dt8)
                 mfn = _build_minor_kernel(
                     gell.n, n_pad2, wp, tc, b_pad, dt8

@@ -138,14 +138,14 @@ def test_minor_fits_bounds():
     # (Wp-1)*KS + sentinel needs int32: huge n x wide rows overflows
     assert not minor_fits(1 << 28, 64, 32)
     # one 8-row chunk over the budget: absurd width x batch (charged at
-    # itemsize+4 bytes/element, matching chunk_rows). n = 2^16 keeps the
+    # itemsize+4 bytes/element, matching chunk_rows). n = 2^15 keeps the
     # key encoding in-bounds so the BUDGET check is what rejects
     too_wide = CHUNK_BUDGET_BYTES // (8 * 128 * 8) + 8
-    assert not minor_fits(1 << 16, too_wide, 128)
+    assert not minor_fits(1 << 15, too_wide, 128)
     # the int8 mode charges 1+4: admits wider shapes than int32's 4+4
     barely = CHUNK_BUDGET_BYTES // (8 * 128 * 8) - 8
-    assert minor_fits(1 << 16, barely, 128)
-    assert minor_fits(1 << 16, barely, 128, itemsize=1)
+    assert minor_fits(1 << 15, barely, 128)
+    assert minor_fits(1 << 15, barely, 128, itemsize=1)
 
 
 def test_minor_time_batch_protocol():
