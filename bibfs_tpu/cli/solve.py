@@ -169,10 +169,11 @@ def main(argv=None):
     if mode in ("minor", "minor8"):
         if args.pairs is None or args.backend != "dense":
             ap.error("--mode minor/minor8 are batch-only layouts: use "
-                     "--pairs FILE with --backend dense (plain ELL)")
-        if args.layout == "tiered":
-            ap.error("--mode minor/minor8 are plain-ELL only; tiered "
-                     "graphs batch through --mode sync")
+                     "--pairs FILE with --backend dense")
+        if args.layout == "tiered" and mode == "minor8":
+            ap.error("--mode minor8 is plain-ELL only (slot-coded "
+                     "parents); tiered graphs batch through --mode "
+                     "minor or sync")
     if args.pairs is not None:
         if args.backend not in ("dense", "native", "sharded", "sharded2d"):
             ap.error("--pairs batch mode is supported by --backend dense/"

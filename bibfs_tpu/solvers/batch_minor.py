@@ -32,9 +32,12 @@ is the proven ``lvl_s + lvl_t >= best`` vote per query, and the outputs
 are per-query ``(best, meet, par_s, par_t, levels, edges)`` exactly as
 `dense._materialize_batch` expects.
 
-Plain ELL only: hub-tier tables would gather ``[count_pad, twidth, B]``
-blocks per tier, whose working set needs its own chunking plan — tiered
-graphs route to the vmapped path (`dense._get_batch_kernel`).
+Tiered (power-law) layouts are supported in int32 mode: each hub tier
+runs as its own slab-chunked row-gather pass scattering discoveries
+onto the planes (visited tests on the updated dist planes keep claims
+single), with counts and the meet vote recomputed plane-wide at level
+end. Mode "minor8" stays plain-ELL — its slot-coded parents have no
+tier decode.
 
 Mode "minor8" keeps the same program with int8 dual/dist planes — the
 gather source and the per-level reread, i.e. the two dominant traffic
@@ -222,8 +225,68 @@ def _level_scan(dual, st, nbr_t, deg2, *, tc: int, ks: int, lvl, active_i,
     return out
 
 
+def tier_slab_rows(tw: int, b_pad: int) -> int:
+    """Hub rows per tier-pass slab (same budget discipline as
+    :func:`chunk_rows`; tier vals gathers are int32-keyed either way,
+    so the charge is a flat 8 bytes/element)."""
+    raw = CHUNK_BUDGET_BYTES // (tw * b_pad * 8)
+    return int(max(8, (raw // 8) * 8))
+
+
+def _tier_pass(dual_old, planes, tnbr_m, ids, tw: int, cc: int, *,
+               ks: int, lvl, active_i):
+    """One hub tier's contribution to the level: slab-scan the tier
+    table, row-gather the OLD dual frontier at every tier slot, and
+    scatter the per-side discoveries into the planes. ``tnbr_m`` is the
+    sentinel-masked tier table ([count_pad, tw], dead slots = n_pad2 →
+    gather reads 0), ``ids`` the -1-padded hub vertex ids. ``planes`` =
+    (nfh_s, nfh_t, dist_s, dist_t, par_s, par_t); visited tests read
+    the UPDATED dist planes, so base- or earlier-tier-discovered
+    vertices are not re-claimed (their parent stands)."""
+    count_pad = tnbr_m.shape[0]
+    num_slabs = count_pad // cc
+    col = jax.lax.broadcasted_iota(jnp.int32, (cc, tw), 1)
+    n_pad2 = ks - 1
+
+    def slab(carry, si):
+        nfh_s, nfh_t, ds, dtp, ps, pt = carry
+        r0 = si * cc
+        tn = jax.lax.dynamic_slice(tnbr_m, (r0, 0), (cc, tw))
+        ids_c = jax.lax.dynamic_slice(ids, (r0,), (cc,))
+        tgt = jnp.where(ids_c >= 0, ids_c, n_pad2)  # n_pad2 drops
+        safe = jnp.where(ids_c >= 0, ids_c, 0)
+        vals = jnp.take(dual_old, tn, axis=0, mode="fill", fill_value=0)
+        keys = col * ks + tn  # first-hit slot wins the key-min
+
+        def side(bit, d, p, nfh):
+            hit = jax.lax.shift_right_logical(vals, bit) & 1
+            anyh = jnp.max(hit, axis=1)  # [cc, B]
+            drow = jnp.take(d, safe, axis=0)
+            hub_new = jnp.where(drow < INF32, 0, anyh) * active_i[None, :]
+            kmin = jnp.min(
+                jnp.where(hit > 0, keys[:, :, None], _BIG), axis=1
+            )
+            d = d.at[tgt].min(
+                jnp.where(hub_new > 0, lvl, INF32), mode="drop"
+            )
+            p = p.at[tgt].max(
+                jnp.where(hub_new > 0, kmin % ks, -1), mode="drop"
+            )
+            nfh = nfh.at[tgt].max(hub_new, mode="drop")
+            return d, p, nfh
+
+        ds, ps, nfh_s = side(0, ds, ps, nfh_s)
+        dtp, pt, nfh_t = side(1, dtp, pt, nfh_t)
+        return (nfh_s, nfh_t, ds, dtp, ps, pt), None
+
+    out, _ = jax.lax.scan(
+        slab, planes, jnp.arange(num_slabs, dtype=jnp.int32)
+    )
+    return out
+
+
 def _build_minor_kernel(n: int, n_pad2: int, wp: int, tc: int, b: int,
-                        dt8: bool = False):
+                        dt8: bool = False, tier_meta: tuple = ()):
     """The jitted whole-batch search for one (graph, batch) geometry.
     Signature ``(nbr, deg, srcs, dsts) -> (best, meet, par_s [B, n_pad],
     par_t, levels, edges)`` — the same output contract as the vmapped
@@ -235,17 +298,50 @@ def _build_minor_kernel(n: int, n_pad2: int, wp: int, tc: int, b: int,
     vertex ids), at the cost of a depth cap (round :data:`MAX_RND8`).
     The dt8 kernel returns a seventh output — ``capped bool[B]``,
     queries whose search was still live at the cap — which the finish
-    hook re-solves via the int32 kernel."""
+    hook re-solves via the int32 kernel.
+
+    ``tier_meta`` (``(start, count, width)`` triples, int32 planes
+    only) adds the hub-tier passes: the base scan runs first, each
+    tier's slab scan scatters its discoveries on top (visited tests on
+    the updated dist planes keep claims single), and the counts + meet
+    vote are recomputed plane-wide at level end — the scan-integrated
+    reductions cannot see the scattered hub updates."""
     ks = n_pad2 + 1
     pdt = jnp.int8 if dt8 else jnp.int32
     inf_d = INF8 if dt8 else INF32
+    if tier_meta and dt8:
+        raise ValueError("tiered batch-minor is int32-plane only")
 
-    def kernel(nbr, deg, srcs, dsts):
+    def kernel(nbr, deg, aux, srcs, dsts):
         n_rows = nbr.shape[0]
         nbr_t = sentinel_transposed_table(
             nbr, deg, n_pad2, n_pad2, wp
         )  # [wp, n_pad2], sentinel = n_pad2 reads fill 0
         deg2 = jnp.pad(deg.astype(jnp.int32), (0, n_pad2 - n_rows))
+        # sentinel-mask + pad the tier tables ONCE per solve: dead
+        # slots (past this hub's degree, past the tier's live count, or
+        # pad rows) read dual row n_pad2 = 0, exactly like the base
+        # table's sentinel (ops/expand._tier_valid semantics)
+        tier_tabs = []
+        for (start, count, tw), (tnbr, hub_ids) in zip(tier_meta, aux):
+            count_pad = tnbr.shape[0]
+            cc = min(tier_slab_rows(tw, b), count_pad)
+            rank = jnp.arange(count_pad, dtype=jnp.int32)
+            ids_c = jnp.clip(hub_ids, 0, n_pad2 - 1)
+            slot_count = jnp.clip(deg2[ids_c] - start, 0, tw)
+            cols = jnp.arange(tw, dtype=jnp.int32)[None, :]
+            valid = (
+                (rank < count)[:, None]
+                & (hub_ids >= 0)[:, None]
+                & (cols < slot_count[:, None])
+            )
+            tnbr_m = jnp.where(valid, tnbr.astype(jnp.int32), n_pad2)
+            pad_rows_t = -(-count_pad // cc) * cc - count_pad
+            tnbr_m = jnp.pad(tnbr_m, ((0, pad_rows_t), (0, 0)),
+                             constant_values=n_pad2)
+            ids_p = jnp.pad(hub_ids.astype(jnp.int32), (0, pad_rows_t),
+                            constant_values=-1)
+            tier_tabs.append((tnbr_m, ids_p, tw, cc))
         qi = jnp.arange(b, dtype=jnp.int32)
         zplane = jnp.zeros((n_pad2, b), pdt)
         dual0 = zplane.at[srcs, qi].add(1).at[dsts, qi].add(2)
@@ -293,6 +389,31 @@ def _build_minor_kernel(n: int, n_pad2: int, wp: int, tc: int, b: int,
                 nbr_t, deg2, tc=tc, ks=ks, lvl=lvl, active_i=active_i,
                 inf_d=inf_d, slot_par=dt8,
             )
+            if tier_tabs:
+                zp = jnp.zeros((n_pad2, b), jnp.int32)
+                planes = (zp, zp, ds, dt, ps, pt)
+                for tnbr_m, ids_p, tw, cc in tier_tabs:
+                    planes = _tier_pass(
+                        st["dual"], planes, tnbr_m, ids_p, tw, cc,
+                        ks=ks, lvl=lvl, active_i=active_i,
+                    )
+                nfh_s, nfh_t, ds, dt, ps, pt = planes
+                dual_n = dual_n | nfh_s | jax.lax.shift_left(nfh_t, 1)
+                # the in-scan reductions cannot see the hub scatters:
+                # recompute counts + the meet vote plane-wide
+                cs = jnp.sum(dual_n & 1, axis=0)
+                ct = jnp.sum(
+                    jax.lax.shift_right_logical(dual_n, 1) & 1, axis=0
+                )
+                both = (ds < INF32) & (dt < INF32)
+                sums = jnp.where(both, ds + dt, INF32)
+                mval = jnp.min(sums, axis=0)
+                rowid = jax.lax.broadcasted_iota(
+                    jnp.int32, sums.shape, 0
+                )
+                midx = jnp.min(
+                    jnp.where(sums == mval[None, :], rowid, _BIG), axis=0
+                )
             take = mval < st["best"]
             return dict(
                 dual=dual_n, dist_s=ds, dist_t=dt, par_s=ps, par_t=pt,
@@ -320,8 +441,10 @@ def _build_minor_kernel(n: int, n_pad2: int, wp: int, tc: int, b: int,
 
 @lru_cache(maxsize=None)
 def _get_minor_kernel(n: int, n_pad2: int, wp: int, tc: int, b: int,
-                      dt8: bool = False):
-    return jax.jit(_build_minor_kernel(n, n_pad2, wp, tc, b, dt8))
+                      dt8: bool = False, tier_meta: tuple = ()):
+    return jax.jit(
+        _build_minor_kernel(n, n_pad2, wp, tc, b, dt8, tier_meta)
+    )
 
 
 def _minor_geometry(
@@ -330,10 +453,10 @@ def _minor_geometry(
     """(n_pad2, wp, tc, b_pad) for a DeviceGraph + batch size, after the
     fit checks. Vertex padding is to whole chunks so the scan covers the
     plane exactly; pad rows read sentinel slots only and stay inert."""
-    if g.tier_meta:
+    if g.tier_meta and dt8:
         raise ValueError(
-            "batch-minor path is plain-ELL only; tiered graphs route to "
-            "the vmapped batch path (solve_batch_graph mode='sync')"
+            "minor8 is plain-ELL only (slot-coded parents have no tier "
+            "decode); tiered graphs batch through mode='minor' or 'sync'"
         )
     b_pad = pad_batch(num_pairs)
     wp = _slot_pad(g.width)
@@ -358,6 +481,15 @@ def _minor_geometry(
             f"batch-minor parent key overflows int32 after chunk "
             f"rounding (n_pad2={n_pad2}, wp={wp}); use the vmapped path"
         )
+    for start, count, tw in g.tier_meta:
+        # tier keys are col*ks + nbr, and one 8-row tier slab must fit
+        if tw * (n_pad2 + 1) >= (1 << 31) or (
+            tw * 8 * b_pad * 8 > CHUNK_BUDGET_BYTES
+        ):
+            raise ValueError(
+                f"batch-minor tier (start={start}, width={tw}) does not "
+                f"fit this batch; use the vmapped path"
+            )
     return n_pad2, wp, tc, b_pad
 
 
@@ -388,10 +520,11 @@ def dp_batch_dispatch(g, pairs, mesh=None, dt8: bool = False):
     b_loc = pad_batch(-(-len(pairs) // ndev))
     b_pad = b_loc * ndev
     n_pad2, wp, tc, _ = _minor_geometry(g, b_loc, dt8)
-    dp = _get_dp_program(mesh, g.n, n_pad2, wp, tc, b_loc, dt8)
+    dp = _get_dp_program(mesh, g.n, n_pad2, wp, tc, b_loc, dt8,
+                         g.tier_meta)
     srcs_a, dsts_a = _padded_queries(pairs, b_pad)
     thunk = lambda: jax.block_until_ready(  # noqa: E731
-        dp(g.nbr, g.deg, srcs_a, dsts_a)
+        dp(g.nbr, g.deg, g.tiers, srcs_a, dsts_a)
     )
     if not dt8:
         return pairs, thunk, lambda out: out
@@ -460,16 +593,19 @@ def _refill_capped(g, pairs, out):
 
 @lru_cache(maxsize=None)
 def _get_dp_program(mesh, n: int, n_pad2: int, wp: int, tc: int,
-                    b_loc: int, dt8: bool):
+                    b_loc: int, dt8: bool, tier_meta: tuple = ()):
     """The jitted shard_map program, cached like `_get_minor_kernel` —
     a fresh jit(shard_map(closure)) per call would retrace the whole
     while_loop program every solve. Mesh objects hash by their device
-    grid + axis names, which is exactly the program identity here."""
+    grid + axis names, which is exactly the program identity here. The
+    tier aux pytree (replicated, like the graph) rides along so tiered
+    graphs keep their hub edges under the mesh too."""
     from jax.sharding import PartitionSpec as P
 
     (axis,) = mesh.axis_names
-    kern = _build_minor_kernel(n, n_pad2, wp, tc, b_loc, dt8)
+    kern = _build_minor_kernel(n, n_pad2, wp, tc, b_loc, dt8, tier_meta)
     sh, rep = P(axis), P()
+    aux_spec = tuple((rep, rep) for _ in tier_meta)
     nouts = 7 if dt8 else 6
     # check_vma=False: the kernel's scan carry seeds some planes from
     # REPLICATED graph data (unvarying) and rewrites them with
@@ -480,7 +616,7 @@ def _get_dp_program(mesh, n: int, n_pad2: int, wp: int, tc: int,
     return jax.jit(
         jax.shard_map(
             kern, mesh=mesh,
-            in_specs=(rep, rep, sh, sh),
+            in_specs=(rep, rep, aux_spec, sh, sh),
             out_specs=(sh,) * nouts,
             check_vma=False,
         )
@@ -506,10 +642,11 @@ def batch_dispatch(g, pairs, dt8: bool = False):
     normalized and range-checked by the shared `dense._batch_dispatch`
     entry."""
     n_pad2, wp, tc, b_pad = _minor_geometry(g, len(pairs), dt8)
-    kern = _get_minor_kernel(g.n, n_pad2, wp, tc, b_pad, dt8)
+    kern = _get_minor_kernel(g.n, n_pad2, wp, tc, b_pad, dt8, g.tier_meta)
+    aux = g.tiers  # ((tier_nbr, hub_ids), ...) — () for plain ELL
     srcs_a, dsts_a = _padded_queries(pairs, b_pad)
     thunk = lambda: jax.block_until_ready(  # noqa: E731
-        kern(g.nbr, g.deg, srcs_a, dsts_a)
+        kern(g.nbr, g.deg, aux, srcs_a, dsts_a)
     )
     if not dt8:
         return pairs, thunk, lambda out: out

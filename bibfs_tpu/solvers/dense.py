@@ -923,10 +923,11 @@ def _batch_dispatch(g: DeviceGraph, pairs, mode: str):
         raise ValueError(f"src/dst out of range for n={g.n}")
     if mode in ("minor", "minor8"):
         # batch-MINOR layout ([n_pad, B] planes, contiguous-row expansion
-        # gather — solvers/batch_minor.py); "minor8" additionally drops
-        # ALL loop planes to int8 (slot-coded parents, host-decoded in
-        # ``finish``; depth-capped queries re-solved via the int32
-        # kernel there too). Plain-ELL only by design
+        # gather — solvers/batch_minor.py; tiered layouts run per-tier
+        # slab passes). "minor8" additionally drops ALL loop planes to
+        # int8 (slot-coded parents, host-decoded in ``finish``; depth-
+        # capped queries re-solved via the int32 kernel there too) and
+        # stays plain-ELL
         from bibfs_tpu.solvers.batch_minor import batch_dispatch
 
         return batch_dispatch(g, pairs, dt8=(mode == "minor8"))

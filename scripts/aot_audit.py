@@ -143,7 +143,7 @@ def main(argv=None) -> int:
                     gell.n, n_pad2, wp, tc, b_pad, dt8
                 )
                 ok, err = aot_compile_tpu(
-                    mfn, np.asarray(gell.nbr), np.asarray(gell.deg),
+                    mfn, np.asarray(gell.nbr), np.asarray(gell.deg), (),
                     np.zeros(b_pad, np.int32),
                     np.full(b_pad, n - 1, np.int32),
                 )

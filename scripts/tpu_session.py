@@ -224,17 +224,27 @@ rng = np.random.default_rng(1)
 n2, edges2 = rmat_graph(18, edge_factor=8, seed=1)
 g2 = DeviceGraph.build(n2, edges2, layout="tiered")
 rows2 = {{}}
+wedged = False
+# mode axis: the vmapped batch vs the batch-MINOR tiered layout (slab
+# tier passes; solvers/batch_minor.py) on the SAME pairs per size
+sweep2 = {{}}
 for b in (32, 256):
-    pairs = np.stack(
+    sweep2[b] = np.stack(
         [rng.integers(0, n2, b), rng.integers(0, n2, b)], axis=1)
-    try:
-        bt = time_batch_only(g2, pairs, repeats=3, mode="sync")
-        med = float(np.median(bt))
-        rows2[str(b)] = dict(batch_s=med, per_query_us=med / b * 1e6)
-        print("rmat18 batch", b, rows2[str(b)], file=sys.stderr, flush=True)
-    except Exception as e:
-        rows2[str(b)] = dict(error=str(e)[:200])
-        break  # the context is suspect after any device-level failure
+for mode in ("sync", "minor"):
+    for b, pairs in sweep2.items():
+        if wedged:
+            break
+        key = "%s/%d" % (mode, b)
+        try:
+            bt = time_batch_only(g2, pairs, repeats=3, mode=mode)
+            med = float(np.median(bt))
+            rows2[key] = dict(batch_s=med, per_query_us=med / b * 1e6)
+            print("rmat18", key, rows2[key], file=sys.stderr, flush=True)
+        except Exception as e:
+            rows2[key] = dict(error=str(e)[:200])
+            print("rmat18", key, rows2[key], file=sys.stderr, flush=True)
+            wedged = True  # the context is suspect after any failure
 out["batch_rmat18"] = rows2
 if not any("per_query_us" in v for v in rows2.values()):
     # no measurement landed: surface it as a retryable item failure
@@ -469,7 +479,8 @@ ITEMS = {
     "mesh1": (MESH1_SUB, 900),
     "batch": (BATCH_SUB, 2100),
     "batch_minor": (BATCH_MINOR_SUB, 1500),
-    "batch_rmat": (BATCH_RMAT_SUB, 900),
+    # two modes x two sizes + compiles: needs more than the old 900
+    "batch_rmat": (BATCH_RMAT_SUB, 1500),
     "levels": (LEVELS_SUB, 900),
     # the round-3 dual-fusion A/B (sync vs sync_unfused) on the chip,
     # where the per-level fixed cost the fusion targets actually lives

@@ -162,7 +162,7 @@ STEPS = [
     # its own step, not a leg of session_batch: a device-level failure
     # in either wedges the process's TPU context (2026-07-31 run), and
     # a separate step gives it independent budget + retry + artifact
-    ("session_batch_rmat", _session_argv("batch_rmat"), 1200, 3,
+    ("session_batch_rmat", _session_argv("batch_rmat"), 1800, 3,
      lambda: session_item_ok("batch_rmat")),
     # the batch-MINOR layout sweep (contiguous-row expansion gather) —
     # the round-4 answer to the 26.8 ms/query vmapped asymptote

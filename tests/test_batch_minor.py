@@ -77,7 +77,7 @@ def test_minor_forced_multichunk():
     dsts = np.zeros(b_pad, np.int32)
     srcs[: len(pairs)] = pairs[:, 0]
     dsts[: len(pairs)] = pairs[:, 1]
-    out = kern(g.nbr, g.deg, srcs, dsts)
+    out = kern(g.nbr, g.deg, (), srcs, dsts)
     got = _materialize_batch(out, len(pairs), 0.0)
     assert n_pad2 // tc > 1  # the scan really iterates
     for (src, dst), r in zip(pairs, got):
@@ -111,14 +111,52 @@ def test_minor_disconnected_and_counters():
     assert got[1].levels >= 2 and got[1].edges_scanned > 0
 
 
-def test_minor_tiered_rejected():
+def test_minor_tiered_matches_serial():
+    """Tiered (hub-tier) graphs through the minor layout: RMAT's skewed
+    degrees force real tiers, and the star hub spans multiple tiers —
+    every pair must agree with the oracle, paths valid."""
+    from bibfs_tpu.graph.csr import build_tiered
+    from bibfs_tpu.graph.generate import rmat_graph
+
+    n, edges = rmat_graph(8, edge_factor=6, seed=1)
+    g = DeviceGraph.from_tiered(build_tiered(n, edges))
+    assert g.tier_meta, "case must actually have hub tiers"
+    rng = np.random.default_rng(3)
+    pairs = rng.integers(0, n, size=(9, 2))
+    pairs[2] = (5, 5)
+    got = solve_batch_graph(g, pairs, mode="minor")
+    for (src, dst), r in zip(pairs, got):
+        ref = solve_serial(n, edges, int(src), int(dst))
+        assert r.found == ref.found
+        if ref.found:
+            assert r.hops == ref.hops
+            r.validate_path(n, edges, int(src), int(dst))
+
+
+def test_minor_tiered_star_hub():
+    """A degree-(n-1) star hub needs several geometric tiers; the hub
+    level must pass through the tier slab passes."""
+    from bibfs_tpu.graph.csr import build_tiered
+
+    n = 600
+    edges = np.array([[0, i] for i in range(1, n)] + [[n - 1, n - 2]])
+    g = DeviceGraph.from_tiered(build_tiered(n, edges))
+    got = solve_batch_graph(g, [(1, n - 2), (0, n - 1), (4, 4)],
+                            mode="minor")
+    assert got[0].found and got[0].hops == 2
+    got[0].validate_path(n, edges, 1, n - 2)
+    assert got[1].found and got[1].hops == 1
+    assert got[2].found and got[2].hops == 0
+
+
+def test_minor8_tiered_rejected():
     from bibfs_tpu.graph.csr import build_tiered
     from bibfs_tpu.graph.generate import rmat_graph
 
     n, edges = rmat_graph(7, edge_factor=6, seed=1)
     g = DeviceGraph.from_tiered(build_tiered(n, edges))
     with pytest.raises(ValueError, match="plain-ELL only"):
-        solve_batch_graph(g, [(0, 1)], mode="minor")
+        solve_batch_graph(g, [(0, 1)], mode="minor8")
 
 
 def test_minor_range_check():
@@ -207,7 +245,7 @@ def test_minor8_compiles_deviceless_for_tpu():
     kern = _build_minor_kernel(120, 128, 8, 64, 128, dt8=True)
     ok, err = aot_compile_tpu(
         kern,
-        np.zeros((120, 6), "int32"), np.zeros((120,), "int32"),
+        np.zeros((120, 6), "int32"), np.zeros((120,), "int32"), (),
         np.zeros((128,), "int32"), np.zeros((128,), "int32"),
     )
     if err and "unavailable" in err:
@@ -234,6 +272,25 @@ def test_dp_batch_matches_serial(dt8):
         if ref.found:
             assert r.hops == ref.hops
             r.validate_path(n, edges, int(src), int(dst))
+
+
+def test_dp_batch_tiered_star_hub():
+    """Tiered graphs under the query mesh must keep their hub-tier
+    edges: the star hub's tier-slot neighbors carry the only 2-hop
+    paths, so dropping tiers would miss them (the regression a silent
+    plain-ELL dp kernel would cause)."""
+    from bibfs_tpu.graph.csr import build_tiered
+    from bibfs_tpu.solvers.batch_minor import solve_batch_dp
+
+    n = 600
+    edges = np.array([[0, i] for i in range(1, n)] + [[n - 1, n - 2]])
+    g = DeviceGraph.from_tiered(build_tiered(n, edges))
+    assert g.tier_meta
+    res = solve_batch_dp(g, [(1, n - 2), (0, n - 1), (4, 4)])
+    assert res[0].found and res[0].hops == 2
+    res[0].validate_path(n, edges, 1, n - 2)
+    assert res[1].found and res[1].hops == 1
+    assert res[2].found and res[2].hops == 0
 
 
 def test_dp_batch_deep_refill():
@@ -271,7 +328,7 @@ def test_minor_compiles_deviceless_for_tpu():
     kern = _build_minor_kernel(n, n_pad2, wp, tc, b)
     ok, err = aot_compile_tpu(
         kern,
-        np.zeros((120, 6), "int32"), np.zeros((120,), "int32"),
+        np.zeros((120, 6), "int32"), np.zeros((120,), "int32"), (),
         np.zeros((b,), "int32"), np.zeros((b,), "int32"),
     )
     if err and "unavailable" in err:
