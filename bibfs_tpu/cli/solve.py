@@ -74,7 +74,7 @@ def main(argv=None):
         default=None,
         choices=["sync", "alt", "beamer", "beamer_alt", "pallas",
                  "pallas_alt", "fused", "fused_alt", "sync_unfused",
-                 "minor", "minor8"],
+                 "minor", "minor8", "auto"],
         help="device-kernel schedule for the device backends (default "
         "sync): sync = both sides per round, alt = smaller-frontier-first "
         "alternation; beamer/beamer_alt add push/pull direction "
@@ -84,9 +84,10 @@ def main(argv=None):
         "pallas/pallas_alt run the "
         "base-table pull as the fused Pallas TPU kernel, hub tiers as XLA "
         "ops (dense backend; interpreted off-TPU); minor/minor8 are "
-        "BATCH-only layouts (--pairs, dense backend, plain ELL): per-query "
+        "BATCH-only layouts (--pairs, dense backend): per-query "
         "state on the lane axis so the expansion gathers contiguous rows, "
-        "minor8 with int8 planes. With --resume, omitting "
+        "minor8 with all-int8 planes (plain ELL); auto (batch only) picks "
+        "the best eligible batch layout. With --resume, omitting "
         "--mode keeps the snapshot's recorded schedule",
     )
     ap.add_argument(
@@ -166,9 +167,9 @@ def main(argv=None):
     ):
         ap.error("--mode fused/fused_alt (whole-level kernel) is only "
                  "supported by the dense and sharded backends")
-    if mode in ("minor", "minor8"):
+    if mode in ("minor", "minor8", "auto"):
         if args.pairs is None or args.backend != "dense":
-            ap.error("--mode minor/minor8 are batch-only layouts: use "
+            ap.error("--mode minor/minor8/auto are batch-only: use "
                      "--pairs FILE with --backend dense")
         if args.layout == "tiered" and mode == "minor8":
             ap.error("--mode minor8 is plain-ELL only (slot-coded "

@@ -447,6 +447,23 @@ def _get_minor_kernel(n: int, n_pad2: int, wp: int, tc: int, b: int,
     )
 
 
+def auto_batch_mode(g, num_pairs: int) -> str:
+    """The best eligible batch mode for this (graph, batch) shape, in
+    measured-preference order: ``minor8`` (all-int8 planes) when the
+    graph is plain-ELL and the geometry fits, else ``minor`` (int32
+    planes, tiered supported), else the vmapped ``sync`` path. This is
+    what ``solve_batch_graph(mode="auto")`` resolves through — the
+    explicit mode names remain for measurement work (every A/B in
+    PERF_NOTES pins its modes)."""
+    for mode, dt8 in (("minor8", True), ("minor", False)):
+        try:
+            _minor_geometry(g, num_pairs, dt8)
+            return mode
+        except ValueError:
+            continue
+    return "sync"
+
+
 def _minor_geometry(
     g, num_pairs: int, dt8: bool = False
 ) -> tuple[int, int, int, int]:

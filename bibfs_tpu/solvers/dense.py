@@ -921,6 +921,12 @@ def _batch_dispatch(g: DeviceGraph, pairs, mode: str):
     pairs = np.asarray(pairs, dtype=np.int64).reshape(-1, 2)
     if pairs.size and not ((0 <= pairs).all() and (pairs < g.n).all()):
         raise ValueError(f"src/dst out of range for n={g.n}")
+    if mode == "auto":
+        # best eligible batch layout (minor8 > minor > vmapped sync) —
+        # the measured preference order, solvers/batch_minor.py
+        from bibfs_tpu.solvers.batch_minor import auto_batch_mode
+
+        mode = auto_batch_mode(g, len(pairs))
     if mode in ("minor", "minor8"):
         # batch-MINOR layout ([n_pad, B] planes, contiguous-row expansion
         # gather — solvers/batch_minor.py; tiered layouts run per-tier

@@ -151,6 +151,36 @@ def main(argv=None) -> int:
                 ok, err = False, f"{type(e).__name__}: {e}"
             record(name, ok, err, t0)
 
+        # tiered batch-minor (slab tier passes: scatter .at[].min/max
+        # inside a scan inside the while_loop — the lowering-riskiest
+        # part of the tiered support, so it gets its own audit row)
+        t0 = time.time()
+        try:
+            from types import SimpleNamespace
+
+            from bibfs_tpu.solvers.batch_minor import (
+                _build_minor_kernel,
+                _minor_geometry,
+            )
+
+            t_tiers = t_aux[1]  # same tier-aux tuple as the dense rows
+            gtshape = SimpleNamespace(
+                n=gt.n, n_pad=gt.n_pad, width=gt.width,
+                tier_meta=tier_meta,
+            )
+            n_pad2, wp, tc, b_pad = _minor_geometry(gtshape, 256, False)
+            mtfn = _build_minor_kernel(
+                gt.n, n_pad2, wp, tc, b_pad, False, tier_meta
+            )
+            ok, err = aot_compile_tpu(
+                mtfn, np.asarray(gt.nbr), np.asarray(gt.deg), t_tiers,
+                np.zeros(b_pad, np.int32),
+                np.full(b_pad, gt.n - 1, np.int32),
+            )
+        except Exception as e:
+            ok, err = False, f"{type(e).__name__}: {e}"
+        record("dense/batch256/minor/tiered", ok, err, t0)
+
         # checkpoint chunk kernel (chunked dense execution)
         t0 = time.time()
         try:

@@ -149,6 +149,31 @@ def test_minor_tiered_star_hub():
     assert got[2].found and got[2].hops == 0
 
 
+def test_auto_batch_mode_routing():
+    """mode='auto' picks minor8 for eligible plain-ELL shapes, minor for
+    tiered graphs, and solves correctly through the chosen path."""
+    from bibfs_tpu.graph.csr import build_tiered
+    from bibfs_tpu.graph.generate import rmat_graph
+    from bibfs_tpu.solvers.batch_minor import auto_batch_mode
+
+    n, edges, g = _ell_graph(0)
+    assert auto_batch_mode(g, 8) == "minor8"
+    res = solve_batch_graph(g, [(0, n - 1), (1, 1)], mode="auto")
+    ref = solve_serial(n, edges, 0, n - 1)
+    assert res[0].found == ref.found
+    if ref.found:
+        assert res[0].hops == ref.hops
+
+    nt, et = rmat_graph(8, edge_factor=6, seed=1)
+    gt = DeviceGraph.from_tiered(build_tiered(nt, et))
+    assert gt.tier_meta and auto_batch_mode(gt, 8) == "minor"
+    rt = solve_batch_graph(gt, [(0, nt - 1)], mode="auto")
+    reft = solve_serial(nt, et, 0, nt - 1)
+    assert rt[0].found == reft.found
+    if reft.found:
+        assert rt[0].hops == reft.hops
+
+
 def test_minor8_tiered_rejected():
     from bibfs_tpu.graph.csr import build_tiered
     from bibfs_tpu.graph.generate import rmat_graph

@@ -72,6 +72,26 @@ g2 = Sharded2DGraph(n, edges, make_2d_mesh(2, 4))
 fn2 = _compiled_2d(g2.mesh, 2, 4, "sync", g2.tier_meta)
 out2 = fn2(g2.bnbr, g2.bcnt, g2.deg, g2.aux, jnp.int32({src}), jnp.int32({dst}))
 print("MH2D_RESULT", idx, int(np.asarray(out2[0])), flush=True)
+
+# the data-parallel batch over the SAME global mesh as a QUERY mesh:
+# zero collectives, but placement/dispatch of the sharded query axis
+# now spans the process boundary. Every slot carries the same (src,
+# dst) so each process can verify its ADDRESSABLE shards locally (the
+# global best array is not fully addressable on either host).
+from bibfs_tpu.parallel.mesh import make_1d_mesh as _mk
+from bibfs_tpu.solvers.batch_minor import QUERY_AXIS, dp_batch_dispatch
+from bibfs_tpu.solvers.dense import DeviceGraph
+from bibfs_tpu.graph.csr import build_ell
+
+qmesh = _mk(axis=QUERY_AXIS)
+gd = DeviceGraph.from_ell(build_ell(n, edges))
+dpairs = np.tile([[{src}, {dst}]], (1024, 1)).astype(np.int64)
+_p, run, _finish = dp_batch_dispatch(gd, dpairs, qmesh)
+best = run()[0]
+local = np.concatenate(
+    [np.asarray(s.data) for s in best.addressable_shards])
+assert local.size and (local == local[0]).all(), local
+print("MHDP_RESULT", idx, int(local[0]), flush=True)
 jax.distributed.shutdown()
 """
 
@@ -108,7 +128,8 @@ def test_two_process_mesh_agrees_with_oracle(tmp_path):
             p.kill()
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"proc {i} failed:\n{out[-1500:]}"
-        for tag in ("MH_RESULT", "MHFUSED_RESULT", "MH2D_RESULT"):
+        for tag in ("MH_RESULT", "MHFUSED_RESULT", "MH2D_RESULT",
+                    "MHDP_RESULT"):
             results = [
                 line for line in out.splitlines() if line.startswith(tag)
             ]
