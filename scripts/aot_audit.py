@@ -116,16 +116,23 @@ def main(argv=None) -> int:
         )
         record("dense/batch4/sync/ell", ok, err, t0)
 
-        # batch-MINOR kernel ([n_pad, B] planes, contiguous-row gather;
-        # multi-chunk scan geometry so the audited program includes the
-        # dynamic_slice/update plumbing the big-graph path uses)
-        # the EXACT geometry the dispatch runs (incl. its fit + post-
-        # rounding key-overflow checks), via the one shared derivation.
-        # Imports stay inside the per-program try so an import failure
-        # records a FAIL row instead of aborting the whole audit
-        for dt8 in (False, True):
+        # batch-MINOR kernels ([n_pad, B] planes, contiguous-row gather;
+        # multi-chunk scan geometry so the audited programs include the
+        # dynamic_slice/update plumbing the big-graph path uses). The
+        # tiered case carries the lowering-riskiest new program (scatter
+        # .at[].min/max inside a scan inside the while_loop). Geometry
+        # comes from the EXACT shared derivation the dispatch runs
+        # (incl. its fit + post-rounding key-overflow checks); imports
+        # stay inside the per-program try so an import failure records
+        # a FAIL row instead of aborting the whole audit
+        minor_cases = [
+            ("dense/batch256/minor/ell", gell, (), (), False),
+            ("dense/batch256/minor8/ell", gell, (), (), True),
+            ("dense/batch256/minor/tiered", gt, t_aux[1], tier_meta,
+             False),
+        ]
+        for name_m, gm, aux_m, tm, dt8 in minor_cases:
             t0 = time.time()
-            name = "dense/batch256/minor%s/ell" % ("8" if dt8 else "")
             try:
                 from types import SimpleNamespace
 
@@ -135,51 +142,20 @@ def main(argv=None) -> int:
                 )
 
                 gshape = SimpleNamespace(
-                    n=gell.n, n_pad=gell.n_pad, width=gell.width,
-                    tier_meta=(),
+                    n=gm.n, n_pad=gm.n_pad, width=gm.width, tier_meta=tm
                 )
                 n_pad2, wp, tc, b_pad = _minor_geometry(gshape, 256, dt8)
                 mfn = _build_minor_kernel(
-                    gell.n, n_pad2, wp, tc, b_pad, dt8
+                    gm.n, n_pad2, wp, tc, b_pad, dt8, tm
                 )
                 ok, err = aot_compile_tpu(
-                    mfn, np.asarray(gell.nbr), np.asarray(gell.deg), (),
+                    mfn, np.asarray(gm.nbr), np.asarray(gm.deg), aux_m,
                     np.zeros(b_pad, np.int32),
-                    np.full(b_pad, n - 1, np.int32),
+                    np.full(b_pad, gm.n - 1, np.int32),
                 )
             except Exception as e:
                 ok, err = False, f"{type(e).__name__}: {e}"
-            record(name, ok, err, t0)
-
-        # tiered batch-minor (slab tier passes: scatter .at[].min/max
-        # inside a scan inside the while_loop — the lowering-riskiest
-        # part of the tiered support, so it gets its own audit row)
-        t0 = time.time()
-        try:
-            from types import SimpleNamespace
-
-            from bibfs_tpu.solvers.batch_minor import (
-                _build_minor_kernel,
-                _minor_geometry,
-            )
-
-            t_tiers = t_aux[1]  # same tier-aux tuple as the dense rows
-            gtshape = SimpleNamespace(
-                n=gt.n, n_pad=gt.n_pad, width=gt.width,
-                tier_meta=tier_meta,
-            )
-            n_pad2, wp, tc, b_pad = _minor_geometry(gtshape, 256, False)
-            mtfn = _build_minor_kernel(
-                gt.n, n_pad2, wp, tc, b_pad, False, tier_meta
-            )
-            ok, err = aot_compile_tpu(
-                mtfn, np.asarray(gt.nbr), np.asarray(gt.deg), t_tiers,
-                np.zeros(b_pad, np.int32),
-                np.full(b_pad, gt.n - 1, np.int32),
-            )
-        except Exception as e:
-            ok, err = False, f"{type(e).__name__}: {e}"
-        record("dense/batch256/minor/tiered", ok, err, t0)
+            record(name_m, ok, err, t0)
 
         # checkpoint chunk kernel (chunked dense execution)
         t0 = time.time()

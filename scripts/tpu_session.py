@@ -225,12 +225,32 @@ n2, edges2 = rmat_graph(18, edge_factor=8, seed=1)
 g2 = DeviceGraph.build(n2, edges2, layout="tiered")
 rows2 = {{}}
 wedged = False
+# native C++ control on the SAME pairs: the head-to-head that decides
+# whether the device batch beats the host runtime in the scale regime
+try:
+    from bibfs_tpu.solvers.native import NativeGraph, time_batch_native
+    gn = NativeGraph.build(n2, edges2)
+except Exception as e:
+    gn = None
+    rows2["native"] = dict(error=str(e)[:200])
 # mode axis: the vmapped batch vs the batch-MINOR tiered layout (slab
 # tier passes; solvers/batch_minor.py) on the SAME pairs per size
 sweep2 = {{}}
 for b in (32, 256):
     sweep2[b] = np.stack(
         [rng.integers(0, n2, b), rng.integers(0, n2, b)], axis=1)
+for b, pairs in sweep2.items():
+    if gn is not None:
+        try:
+            tn, _rn = time_batch_native(gn, pairs, repeats=3)
+            medn = float(np.median(tn))
+            rows2["native/%d" % b] = dict(
+                batch_s=medn, per_query_us=medn / b * 1e6)
+        except Exception as e:
+            # the control must never cost the device legs the session
+            rows2["native/%d" % b] = dict(error=str(e)[:200])
+        print("rmat18", "native/%d" % b, rows2["native/%d" % b],
+              file=sys.stderr, flush=True)
 for mode in ("sync", "minor"):
     for b, pairs in sweep2.items():
         if wedged:
@@ -246,10 +266,14 @@ for mode in ("sync", "minor"):
             print("rmat18", key, rows2[key], file=sys.stderr, flush=True)
             wedged = True  # the context is suspect after any failure
 out["batch_rmat18"] = rows2
-if not any("per_query_us" in v for v in rows2.values()):
-    # no measurement landed: surface it as a retryable item failure
-    # instead of a clean-looking record the watcher would accept
-    out["error"] = next(iter(rows2.values()))["error"]
+dev_rows = {{k: v for k, v in rows2.items()
+             if not k.startswith("native")}}
+if not any("per_query_us" in v for v in dev_rows.values()):
+    # no DEVICE measurement landed (the host-native control rows do not
+    # count): surface it as a retryable item failure instead of a
+    # clean-looking record the watcher would accept
+    out["error"] = (next(iter(dev_rows.values()))["error"] if dev_rows
+                    else "no device rows ran")
 print("RESULT " + json.dumps(out))
 """
 
