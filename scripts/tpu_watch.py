@@ -162,12 +162,14 @@ STEPS = [
     # its own step, not a leg of session_batch: a device-level failure
     # in either wedges the process's TPU context (2026-07-31 run), and
     # a separate step gives it independent budget + retry + artifact
-    ("session_batch_rmat", _session_argv("batch_rmat"), 1800, 3,
-     lambda: session_item_ok("batch_rmat")),
     # the batch-MINOR layout sweep (contiguous-row expansion gather) —
-    # the round-4 answer to the 26.8 ms/query vmapped asymptote
+    # the round-4 answer to the 26.8 ms/query vmapped asymptote, and
+    # the single most valuable pending artifact: it goes FIRST among
+    # the not-yet-landed steps in case the tunnel only returns briefly
     ("session_batch_minor", _session_argv("batch_minor"), 1800, 3,
      lambda: session_item_ok("batch_minor")),
+    ("session_batch_rmat", _session_argv("batch_rmat"), 1800, 3,
+     lambda: session_item_ok("batch_rmat")),
     ("session_mesh1", _session_argv("mesh1"), 1200, 3,
      lambda: session_item_ok("mesh1")),
     ("session_fusion", _session_argv("fusion"), 1500, 3,
