@@ -127,6 +127,20 @@ print("RESULT " + json.dumps(out))
 FUSION_ITEM_TEMPLATE = DENSE_LEG.replace("{n}", "100000")
 
 
+def cache_env() -> dict:
+    """Measurement-subprocess environment with the persistent JAX
+    compilation cache enabled: a retried item (or watcher step) re-uses
+    every program a previous — possibly aborted — attempt already
+    compiled on the chip instead of re-paying 20-40 s per program.
+    setdefault semantics: an operator's own cache configuration wins.
+    THE one definition — `tpu_watch.run_step` imports it, so the two
+    harnesses can never write to different caches."""
+    env = dict(os.environ)
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/bibfs_jax_cache")
+    env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+    return env
+
+
 def run_result_subprocess(name: str, code: str, timeout: int) -> dict:
     """THE bounded measurement-subprocess protocol, shared with
     tpu_session.run_item: run ``python -c code``, scan stdout for the
@@ -136,7 +150,7 @@ def run_result_subprocess(name: str, code: str, timeout: int) -> dict:
     try:
         r = subprocess.run(
             [sys.executable, "-c", code], capture_output=True, text=True,
-            timeout=timeout,
+            timeout=timeout, env=cache_env(),
         )
         for line in r.stdout.splitlines():
             if line.startswith("RESULT "):

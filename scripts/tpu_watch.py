@@ -61,8 +61,10 @@ import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "scripts"))
 
 import bench as _bench  # probe contract lives in one place: bench.py
+from ab_fusion import cache_env  # one cache-env definition for both harnesses
 
 STATUS = os.path.join(REPO, "TPU_WATCH_STATUS.json")
 LOG = "/tmp/tpu_watch.log"
@@ -255,11 +257,13 @@ def run_step(name: str, argv: list[str], timeout_s: int, st: dict,
     try:
         # own session: the measurement scripts spawn their own jax
         # subprocesses, and a watchdog kill must take the WHOLE group or
-        # an orphaned grandchild keeps the chip busy into the next step
+        # an orphaned grandchild keeps the chip busy into the next step.
+        # cache_env: a retry after a mid-run tunnel drop re-uses every
+        # program the aborted attempt already compiled on the chip
         p = subprocess.Popen(
             argv, cwd=REPO, stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT, text=True, errors="replace",
-            start_new_session=True,
+            start_new_session=True, env=cache_env(),
         )
         try:
             out, _ = p.communicate(timeout=timeout_s)
