@@ -174,6 +174,28 @@ def test_auto_batch_mode_routing():
         assert rt[0].hops == reft.hops
 
 
+@pytest.mark.parametrize("mode", ["minor", "minor8"])
+def test_minor_tiny_graphs(mode):
+    """Degenerate shapes: n as small as 2, batch padding far exceeding
+    n, single-edge and edgeless graphs — the chunk scan and the pad
+    machinery must stay inert and exact."""
+    cases = [
+        (2, np.array([[0, 1]])),
+        (3, np.array([[0, 1]])),  # vertex 2 isolated
+        (5, np.array([[0, 1], [1, 2], [3, 4]])),
+    ]
+    for n, edges in cases:
+        g = DeviceGraph.from_ell(build_ell(n, edges))
+        pairs = [(0, n - 1), (0, 0), (0, 1)]
+        got = solve_batch_graph(g, pairs, mode=mode)
+        for (src, dst), r in zip(pairs, got):
+            ref = solve_serial(n, edges, int(src), int(dst))
+            assert r.found == ref.found, (n, src, dst, mode)
+            if ref.found:
+                assert r.hops == ref.hops
+                r.validate_path(n, edges, int(src), int(dst))
+
+
 def test_minor8_tiered_rejected():
     from bibfs_tpu.graph.csr import build_tiered
     from bibfs_tpu.graph.generate import rmat_graph
