@@ -288,9 +288,11 @@ def _tier_pass(dual_old, planes, tnbr_m, ids, tw: int, cc: int, *,
 def _build_minor_kernel(n: int, n_pad2: int, wp: int, tc: int, b: int,
                         dt8: bool = False, tier_meta: tuple = ()):
     """The jitted whole-batch search for one (graph, batch) geometry.
-    Signature ``(nbr, deg, srcs, dsts) -> (best, meet, par_s [B, n_pad],
-    par_t, levels, edges)`` — the same output contract as the vmapped
-    batch kernel, so `dense._materialize_batch` serves both.
+    Signature ``(nbr, deg, aux, srcs, dsts) -> (best, meet, par_s
+    [B, n_pad], par_t, levels, edges)`` — ``aux`` is the tier pytree
+    (``((tier_nbr, hub_ids), ...)``, empty for plain ELL), and the
+    outputs share the vmapped batch kernel's contract, so
+    `dense._materialize_batch` serves both.
 
     ``dt8`` selects all-int8 loop planes (mode "minor8"): dual/dist
     directly, parents as ELL SLOTS (decoded to vertex ids by the host
@@ -447,14 +449,28 @@ def _get_minor_kernel(n: int, n_pad2: int, wp: int, tc: int, b: int,
     )
 
 
+# Below this many queries 'auto' keeps the vmapped path: the minor
+# planes pad every batch to 128 lanes (pad_batch), so a B-query batch
+# pays 128/B lane waste against the layout's measured ~11x win at
+# B>=128 (PERF_NOTES §3). That model's crossover is B ~= 128/11 ~= 12;
+# 16 adds margin for the win itself shrinking at small B (unmeasured
+# below 128) while keeping every batch the model says minor wins.
+SMALL_BATCH_SYNC = 16
+
+
 def auto_batch_mode(g, num_pairs: int) -> str:
     """The best eligible batch mode for this (graph, batch) shape, in
     measured-preference order: ``minor8`` (all-int8 planes) when the
     graph is plain-ELL and the geometry fits, else ``minor`` (int32
-    planes, tiered supported), else the vmapped ``sync`` path. This is
-    what ``solve_batch_graph(mode="auto")`` resolves through — the
+    planes, tiered supported), else the vmapped ``sync`` path. Batches
+    under :data:`SMALL_BATCH_SYNC` queries stay on the vmapped path —
+    the minor layout pads to 128 lanes, and below ~32 queries the pad
+    waste outruns the layout's measured win (constant math above). This
+    is what ``solve_batch_graph(mode="auto")`` resolves through — the
     explicit mode names remain for measurement work (every A/B in
     PERF_NOTES pins its modes)."""
+    if num_pairs < SMALL_BATCH_SYNC:
+        return "sync"
     for mode, dt8 in (("minor8", True), ("minor", False)):
         try:
             _minor_geometry(g, num_pairs, dt8)
@@ -555,6 +571,7 @@ def solve_batch_dp(g, pairs, mesh=None, *, dt8: bool = False):
     import time as _time
 
     from bibfs_tpu.solvers.dense import _materialize_batch
+    from bibfs_tpu.solvers.timing import force_scalar
 
     pairs = np.asarray(pairs, dtype=np.int64).reshape(-1, 2)
     if pairs.size and not ((0 <= pairs).all() and (pairs < g.n).all()):
@@ -562,6 +579,7 @@ def solve_batch_dp(g, pairs, mesh=None, *, dt8: bool = False):
     pairs, run, finish = dp_batch_dispatch(g, pairs, mesh, dt8)
     t0 = _time.perf_counter()
     out = run()
+    force_scalar(out)  # block_until_ready lies on the tunneled backend
     elapsed = _time.perf_counter() - t0
     return _materialize_batch(finish(out), len(pairs), elapsed)
 
@@ -592,8 +610,16 @@ def _refill_capped(g, pairs, out):
     # searches — per-level work is tiny by the time depth matters)
     idx = np.flatnonzero(capped[: len(pairs)])
     sub = pairs[idx]
-    _, sub_thunk, _sub_finish = batch_dispatch(g, sub, dt8=False)
-    sub_out = sub_thunk()  # int32 path: finish is the identity
+    try:
+        _, sub_thunk, _sub_finish = batch_dispatch(g, sub, dt8=False)
+    except ValueError:
+        # shapes where int8 planes fit (itemsize+4 = 5 B/elem charge)
+        # but int32 ones do not (8 B/elem): finish on the vmapped sync
+        # kernel, which shares the 6-tuple output contract
+        from bibfs_tpu.solvers.dense import _batch_dispatch
+
+        _, sub_thunk, _sub_finish = _batch_dispatch(g, sub, "sync")
+    sub_out = sub_thunk()  # int32/sync path: finish is the identity
     outs = [np.array(o) for o in out[:-1]]  # writable copies
     for o, so in zip(outs, sub_out):
         so = np.asarray(so)[: len(sub)]

@@ -150,28 +150,72 @@ def test_minor_tiered_star_hub():
 
 
 def test_auto_batch_mode_routing():
-    """mode='auto' picks minor8 for eligible plain-ELL shapes, minor for
-    tiered graphs, and solves correctly through the chosen path."""
+    """mode='auto' picks minor8 for eligible plain-ELL shapes at
+    throughput batch sizes, minor for tiered graphs, sync below the
+    small-batch threshold (the minor planes pad to 128 lanes — a tiny
+    batch would pay the full plane for a handful of queries), and
+    solves correctly through the chosen path."""
     from bibfs_tpu.graph.csr import build_tiered
     from bibfs_tpu.graph.generate import rmat_graph
-    from bibfs_tpu.solvers.batch_minor import auto_batch_mode
+    from bibfs_tpu.solvers.batch_minor import (
+        SMALL_BATCH_SYNC, auto_batch_mode,
+    )
 
     n, edges, g = _ell_graph(0)
-    assert auto_batch_mode(g, 8) == "minor8"
-    res = solve_batch_graph(g, [(0, n - 1), (1, 1)], mode="auto")
-    ref = solve_serial(n, edges, 0, n - 1)
-    assert res[0].found == ref.found
-    if ref.found:
-        assert res[0].hops == ref.hops
+    assert auto_batch_mode(g, SMALL_BATCH_SYNC) == "minor8"
+    assert auto_batch_mode(g, SMALL_BATCH_SYNC - 1) == "sync"
+    assert auto_batch_mode(g, 1) == "sync"
+    # >= SMALL_BATCH_SYNC pairs so the solve really routes minor8
+    pairs = [(0, n - 1), (1, 1)] + [(i % n, (3 * i) % n)
+                                    for i in range(SMALL_BATCH_SYNC)]
+    res = solve_batch_graph(g, pairs, mode="auto")
+    for (s, d), r in zip(pairs, res):
+        ref = solve_serial(n, edges, s, d)
+        assert r.found == ref.found
+        if ref.found:
+            assert r.hops == ref.hops
 
     nt, et = rmat_graph(8, edge_factor=6, seed=1)
     gt = DeviceGraph.from_tiered(build_tiered(nt, et))
-    assert gt.tier_meta and auto_batch_mode(gt, 8) == "minor"
-    rt = solve_batch_graph(gt, [(0, nt - 1)], mode="auto")
-    reft = solve_serial(nt, et, 0, nt - 1)
-    assert rt[0].found == reft.found
-    if reft.found:
-        assert rt[0].hops == reft.hops
+    assert gt.tier_meta and auto_batch_mode(gt, SMALL_BATCH_SYNC) == "minor"
+    pt = [(0, nt - 1)] + [(i % nt, (7 * i) % nt)
+                          for i in range(SMALL_BATCH_SYNC)]
+    rt = solve_batch_graph(gt, pt, mode="auto")
+    for (s, d), r in zip(pt, rt):
+        reft = solve_serial(nt, et, s, d)
+        assert r.found == reft.found
+        if reft.found:
+            assert r.hops == reft.hops
+
+
+def test_refill_capped_geometry_fallback(monkeypatch):
+    """When the int32 re-solve geometry is rejected (int8 fits at 5
+    B/elem but int32 does not at 8), the depth-cap refill must finish on
+    the vmapped sync kernel instead of crashing in the untimed finish
+    (ADVICE r4). Forced by making the int32 minor dispatch raise."""
+    from bibfs_tpu.solvers import batch_minor as bm
+
+    n, edges, g = _ell_graph(1)
+    pairs = np.array([[0, n - 1], [1, 2]])
+    real_dispatch = bm.batch_dispatch
+
+    def failing_int32(g_, pairs_, dt8=False):
+        if not dt8:
+            raise ValueError("forced: int32 minor geometry rejected")
+        return real_dispatch(g_, pairs_, dt8)
+
+    monkeypatch.setattr(bm, "batch_dispatch", failing_int32)
+    _, thunk, finish = real_dispatch(g, pairs, dt8=True)
+    out = list(thunk())
+    # splice a forced 'capped' flag so the refill path actually runs
+    capped = np.zeros(np.asarray(out[-1]).shape, bool)
+    capped[0] = True
+    res = finish(tuple(out[:-1]) + (capped,))
+    best = np.asarray(res[0])
+    ref = solve_serial(n, edges, 0, n - 1)
+    assert (best[0] < 2**30) == ref.found
+    if ref.found:
+        assert int(best[0]) == ref.hops
 
 
 @pytest.mark.parametrize("mode", ["minor", "minor8"])
