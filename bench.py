@@ -52,21 +52,28 @@ BASELINE_V1_100K_S = 0.000115546  # benchmark_results.csv:5
 N = int(os.environ.get("BENCH_N", 100_000))
 AVG_DEG = 2.2000000001  # graphs/make_graphs:8
 REPEATS = int(os.environ.get("BENCH_REPEATS", 30))
-# two probe attempts run before any CPU fallback; 110s each keeps the
-# worst case (dead tunnel: 2 probes + full CPU-platform sweep) inside the
-# driver's budget while still riding out a slow-but-alive backend init
+# per-attempt probe bound; attempts repeat with a short breather across
+# BENCH_PROBE_WINDOW_S (default 480 s, see main) before the CPU fallback,
+# so a tunnel that flaps on minute timescales still gets caught while the
+# worst case (dead tunnel: window + degraded CPU sweep) stays inside the
+# driver's budget
 PROBE_TIMEOUT_S = float(os.environ.get("BENCH_PROBE_TIMEOUT_S", 110))
 HOST_BACKENDS = ["native", "serial"]  # the framework's latency runtimes
-SWEEP = [  # device configs: (mode, layout) — ordered so the historically
-    # best config and the round-4 kernel questions land before the time
-    # budget can skip anything
-    ("sync", "ell"),
-    ("beamer", "tiered"),  # the r2 real-chip winner (116 ms)
-    ("fused", "ell"),  # whole-level kernel: 1 gather + 1 kernel/round
-    ("fused_alt", "ell"),  # same kernel, smaller-frontier-first schedule
-    ("pallas", "ell"),  # v2 expansion kernel
-    ("beamer", "ell"),
-    ("sync", "tiered"),
+SWEEP = [  # device configs: (mode, layout, unroll) — ordered so the
+    # historically best config and the current round's kernel questions
+    # land before the time budget can skip anything
+    ("sync", "ell", 1),
+    ("beamer", "tiered", 1),  # the r2 real-chip winner (116 ms)
+    ("fused", "ell", 1),  # whole-level kernel: 1 gather + 1 kernel/round
+    # round-5 question: k rounds per while iteration amortize the fixed
+    # per-iteration cost (the unexplained ~12 ms/level residual,
+    # VERDICT r4 weak #2) — dense._unrolled, exact semantics
+    ("fused", "ell", 8),
+    ("sync", "ell", 8),
+    ("fused_alt", "ell", 1),  # same kernel, smaller-frontier-first
+    ("pallas", "ell", 1),  # v2 expansion kernel
+    ("beamer", "ell", 1),
+    ("sync", "tiered", 1),
 ]
 # each real device solve through the tunnel costs ~0.2s; cap device repeats
 # so the five device configs fit the driver's budget while host backends
@@ -375,9 +382,36 @@ def main():
             remaining = max(5.0, PROBE_TIMEOUT_S - (t_wait - t_setup))
             plat, err = _finish_probe(probe, remaining)
             probe = None  # joined (or killed by _finish_probe on timeout)
-            if plat is None:
-                plat, err2 = _finish_probe(_start_probe(), PROBE_TIMEOUT_S)
-                err = err2 if plat is None else None
+            # resilient probe (VERDICT r4 missing #2): the round's
+            # official artifact degraded to CPU three rounds running
+            # because the probe got exactly two 110 s shots at a tunnel
+            # that flaps on minute timescales. Keep re-probing with a
+            # short breather between attempts across a bounded window —
+            # sized so the worst case (window + degraded CPU sweep)
+            # still fits the driver's budget — before giving up.
+            # the window is anchored at t_wait (when probing starts),
+            # NOT t_setup — a heavy host phase must not starve the
+            # retries — and at least one full-length retry always runs
+            # (the pre-window behavior, so no run is less resilient
+            # than before)
+            window = float(os.environ.get("BENCH_PROBE_WINDOW_S", 480))
+            deadline = t_wait + window
+            attempts = 1
+            while plat is None and (
+                attempts == 1 or time.time() + 15 < deadline
+            ):
+                t_a = time.time()
+                bound = PROBE_TIMEOUT_S if attempts == 1 else max(
+                    10.0, min(PROBE_TIMEOUT_S, deadline - time.time()))
+                plat, err2 = _finish_probe(_start_probe(), bound)
+                attempts += 1
+                if plat is None:
+                    err = err2 or err
+                    # fast-fail probes breathe before retrying (a dead
+                    # tunnel sometimes wakes between attempts); slow
+                    # timeouts have already spent their breather
+                    time.sleep(max(0.0, 15.0 - (time.time() - t_a)))
+            detail["probe_attempts"] = attempts
             platform = plat or "cpu"
             tpu_error = err if plat is None else None
             if platform == "cpu":
@@ -395,7 +429,7 @@ def main():
         # a single core blows the driver's budget — measured rc=124) and
         # skip the batch row. Small-N CPU smoke tests keep the full sweep.
         degraded = platform == "cpu" and N >= 50_000
-        sweep = [("sync", "ell")] if degraded else SWEEP
+        sweep = [("sync", "ell", 1)] if degraded else SWEEP
         device_repeats = 3 if degraded else DEVICE_REPEATS
         if degraded:
             detail["degraded"] = (
@@ -412,7 +446,7 @@ def main():
         # wait for the platform decision above
         graphs = {
             layout: DeviceGraph.build(N, layout=layout, pairs=pairs)
-            for layout in sorted({lay for _m, lay in sweep})
+            for layout in sorted({lay for _m, lay, _u in sweep})
         }
 
         def over_budget() -> bool:
@@ -427,19 +461,20 @@ def main():
             detail["resolved_modes"] = {
                 m: _resolve_pallas_mode(m, _geom_of(graphs["ell"]))
                 for m in ("pallas", "fused", "fused_alt")
-                if any(mm == m for mm, _l in sweep)
+                if any(mm == m for mm, _l, _u in sweep)
             }
         except Exception as e:
             detail["resolved_modes"] = {"error": str(e)[:200]}
 
-        for mode, layout in sweep:
-            label = f"{mode}/{layout}"
+        for mode, layout, unroll in sweep:
+            label = f"{mode}/{layout}" + (f"/u{unroll}" if unroll > 1 else "")
             if over_budget():
                 failed[label] = "skipped: bench time budget spent"
                 continue
             try:
                 times, res = time_search(
-                    graphs[layout], 0, N - 1, repeats=device_repeats, mode=mode
+                    graphs[layout], 0, N - 1, repeats=device_repeats,
+                    mode=mode, unroll=unroll
                 )
             except Exception as e:
                 failed[label] = f"{type(e).__name__}: {e}"[:300]

@@ -463,9 +463,10 @@ def auto_batch_mode(g, num_pairs: int) -> str:
     measured-preference order: ``minor8`` (all-int8 planes) when the
     graph is plain-ELL and the geometry fits, else ``minor`` (int32
     planes, tiered supported), else the vmapped ``sync`` path. Batches
-    under :data:`SMALL_BATCH_SYNC` queries stay on the vmapped path —
-    the minor layout pads to 128 lanes, and below ~32 queries the pad
-    waste outruns the layout's measured win (constant math above). This
+    under :data:`SMALL_BATCH_SYNC` (16) queries stay on the vmapped
+    path — the minor layout pads to 128 lanes, and below that threshold
+    the pad waste outruns the layout's measured win (crossover math at
+    the constant). This
     is what ``solve_batch_graph(mode="auto")`` resolves through — the
     explicit mode names remain for measurement work (every A/B in
     PERF_NOTES pins its modes)."""

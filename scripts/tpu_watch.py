@@ -170,8 +170,18 @@ STEPS = [
     # the not-yet-landed steps in case the tunnel only returns briefly
     ("session_batch_minor", _session_argv("batch_minor"), 1800, 3,
      lambda: session_item_ok("batch_minor")),
-    ("session_batch_rmat", _session_argv("batch_rmat"), 1800, 3,
+    # per-leg resumable driver (tpu_session.run_batch_rmat): banks each
+    # leg as it lands, so a watchdog kill only costs the in-flight leg.
+    # Worst fresh case = prep + native + four device legs at the 900 s
+    # per-leg bound = 5400 s; 5700 covers it with driver overhead, and
+    # banking means even a kill mid-sweep converges across retries
+    ("session_batch_rmat", _session_argv("batch_rmat"), 5700, 3,
      lambda: session_item_ok("batch_rmat")),
+    # the round-5 multi-level-fusion A/B: does k-rounds-per-while-
+    # iteration amortize the ~12 ms/level fixed residual? Right after
+    # the batch items: it is this round's single-query headline question
+    ("session_unroll", _session_argv("unroll"), 2100, 3,
+     lambda: session_item_ok("unroll")),
     ("session_mesh1", _session_argv("mesh1"), 1200, 3,
      lambda: session_item_ok("mesh1")),
     ("session_fusion", _session_argv("fusion"), 1500, 3,

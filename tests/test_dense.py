@@ -235,6 +235,36 @@ def test_dense_time_search_protocol():
         assert res.hops == ref.hops
 
 
+def test_unroll_parity_every_schedule():
+    """Multi-level unrolling (k rounds per while iteration, each in-block
+    round re-gated by the SAME while cond) must be invisible in every
+    output: best/meet/levels/edges identical to unroll=1 across
+    schedules, on shapes that terminate mid-block (a deep line graph
+    whose round count is not a multiple of k), find no path, or start
+    at src==dst."""
+    from bibfs_tpu.graph.generate import gnp_random_graph
+    from bibfs_tpu.solvers.dense import DeviceGraph, solve_dense_graph
+
+    n = 3_000
+    gn = DeviceGraph.build(n, gnp_random_graph(n, 2.5 / n, seed=4))
+    nl = 41  # line graph: 40 hops -> odd round counts, mid-block stops
+    gl = DeviceGraph.build(nl, np.array([[i, i + 1] for i in range(nl - 1)]))
+    gd = DeviceGraph.build(4, np.array([[0, 1], [2, 3]]))  # no path
+    queries = [(gn, 0, n - 1), (gn, 1, 1), (gl, 0, nl - 1), (gd, 0, 3)]
+    for mode in ("sync", "alt", "fused", "fused_alt", "beamer"):
+        for g, s, d in queries:
+            base = solve_dense_graph(g, s, d, mode=mode)
+            for k in (2, 3, 8):
+                got = solve_dense_graph(g, s, d, mode=mode, unroll=k)
+                assert (got.found, got.hops, got.levels,
+                        got.edges_scanned) == (
+                    base.found, base.hops, base.levels,
+                    base.edges_scanned), (mode, k, s, d)
+    # unroll=0 is a caller bug, not a silent no-op
+    with pytest.raises(ValueError):
+        solve_dense_graph(gn, 0, 1, mode="sync", unroll=0)
+
+
 def test_sync_unfused_control_matches_sync():
     """The A/B control mode (scripts/ab_fusion.py) is the same algorithm:
     identical hops, levels, and edge counts on ELL and tiered layouts."""
