@@ -91,6 +91,16 @@ def main(argv=None):
         "--mode keeps the snapshot's recorded schedule",
     )
     ap.add_argument(
+        "--unroll",
+        type=int,
+        default=1,
+        metavar="K",
+        help="dense/sharded backends: run K search rounds per while-loop "
+        "iteration (exact — each in-block round re-checks the same "
+        "termination vote), amortizing the backend's fixed per-iteration "
+        "cost; 1 = the plain per-level loop",
+    )
+    ap.add_argument(
         "--checkpoint",
         default=None,
         metavar="FILE",
@@ -204,12 +214,22 @@ def main(argv=None):
             ap.error("--resume needs --checkpoint FILE to resume from")
         if args.chunk is not None and args.chunk < 1:
             ap.error("--chunk must be >= 1")
+    if args.unroll < 1:
+        ap.error("--unroll must be >= 1")
+    if args.unroll > 1 and args.backend not in ("dense", "sharded"):
+        ap.error("--unroll applies to the dense/sharded backends only")
+    if args.unroll > 1 and (args.pairs is not None or checkpointed):
+        # reject rather than silently run un-unrolled: the batch and
+        # chunked kernels do not thread the unroll parameter (yet)
+        ap.error("--unroll is single-query only (no --pairs / "
+                 "--checkpoint / --chunk / --resume)")
     kwargs = {}
     if args.devices is not None:
         kwargs["num_devices"] = args.devices
     if args.backend in ("dense", "sharded"):
         kwargs["mode"] = mode
         kwargs["layout"] = args.layout
+        kwargs["unroll"] = args.unroll
     elif args.backend == "sharded2d":
         kwargs["mode"] = mode
         kwargs["rows"] = rows
@@ -242,6 +262,7 @@ def main(argv=None):
                     layout=args.layout,
                     rows=rows,
                     cols=cols,
+                    unroll=args.unroll,
                 )
             else:
                 res = solve(args.backend, n, edges, args.src, args.dst, **kwargs)

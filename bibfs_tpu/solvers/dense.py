@@ -239,7 +239,7 @@ def _cond(st):
     )
 
 
-def _unrolled(body, unroll: int):
+def _unrolled(body, unroll: int, cond=None):
     """Run ``unroll`` search rounds per ``while_loop`` iteration.
 
     The while cond is only evaluated once per block, so the fixed
@@ -248,16 +248,20 @@ def _unrolled(body, unroll: int):
     unexplained ~12 ms/level residual of VERDICT r4 weak #2) is
     amortized over ``unroll`` levels. Correctness is exact, not
     approximate: every in-block round after the first re-checks the SAME
-    :func:`_cond` under ``lax.cond``, so a search that terminates
-    mid-block skips the remaining rounds — nothing runs that the
-    single-level program would not have run."""
+    ``cond`` the while loop uses (default :func:`_cond`; the sharded
+    solver passes its replicated-vote ``_shard_cond``) under
+    ``lax.cond``, so a search that terminates mid-block skips the
+    remaining rounds — nothing runs that the single-level program would
+    not have run."""
+    if cond is None:
+        cond = _cond
     if unroll <= 1:
         return body
 
     def block(st):
         st = body(st)  # round 1: the while cond just approved it
         for _ in range(unroll - 1):
-            st = jax.lax.cond(_cond(st), body, lambda s: s, st)
+            st = jax.lax.cond(cond(st), body, lambda s: s, st)
         return st
 
     return block
@@ -1053,10 +1057,16 @@ def solve_dense(
     *,
     mode: str = "sync",
     layout: str = "ell",
+    unroll: int = 1,
 ) -> BFSResult:
-    return solve_dense_graph(DeviceGraph.build(n, edges, layout=layout), src, dst, mode=mode)
+    return solve_dense_graph(
+        DeviceGraph.build(n, edges, layout=layout), src, dst, mode=mode,
+        unroll=unroll,
+    )
 
 
 @register("dense")
-def _dense_backend(n, edges, src, dst, mode="sync", layout="ell", **_):
-    return solve_dense(n, edges, src, dst, mode=mode, layout=layout)
+def _dense_backend(n, edges, src, dst, mode="sync", layout="ell",
+                   unroll=1, **_):
+    return solve_dense(n, edges, src, dst, mode=mode, layout=layout,
+                       unroll=unroll)

@@ -424,6 +424,7 @@ def _bibfs_shard_body(
     mode: str = "sync",
     push_cap: int = 0,
     tier_meta: tuple = (),
+    unroll: int = 1,
 ):
     """The per-device program. ``nbr``/``deg`` are the LOCAL vertex shard;
     ``src``/``dst`` are replicated scalars; ``aux`` is ``()`` for plain ELL
@@ -472,11 +473,16 @@ def _bibfs_shard_body(
         edges=jnp.int32(0),
     )
 
+    from bibfs_tpu.solvers.dense import _unrolled
+
     body = _make_shard_body(
         nbr, deg, aux, axis=axis, mode=mode, push_cap=push_cap,
         tier_meta=tier_meta,
     )
-    out = jax.lax.while_loop(_shard_cond, body, init)
+    # the replicated-vote cond makes every device take the same lax.cond
+    # branch, so collectives inside the unrolled block stay coherent
+    out = jax.lax.while_loop(
+        _shard_cond, _unrolled(body, unroll, _shard_cond), init)
     return (
         out["best"],
         out["meet"],
@@ -501,7 +507,7 @@ def _sharded_fused_ok(geom: tuple | None, tier_meta: tuple) -> bool:
     return fused_fits(n_loc, id_space=id_space, width=width)
 
 
-def _sharded_fused_prog(axis: str):
+def _sharded_fused_prog(axis: str, unroll: int = 1):
     """Per-shard whole-level-kernel program (mode "fused" on the 1D
     mesh, v2): a lock-step round is ONE bitpacked dual-frontier
     all_gather (``all_gather_bits_dual`` — both word planes in one
@@ -605,7 +611,10 @@ def _sharded_fused_prog(axis: str):
                 "edges": st["edges"] + st["ds_s"] + st["ds_t"],
             }
 
-        out = jax.lax.while_loop(_shard_cond, body, st)
+        from bibfs_tpu.solvers.dense import _unrolled
+
+        out = jax.lax.while_loop(
+            _shard_cond, _unrolled(body, unroll, _shard_cond), st)
         return (
             out["best"],
             out["meet"],
@@ -620,12 +629,13 @@ def _sharded_fused_prog(axis: str):
 
 def _sharded_fn(
     mesh, axis: str, mode: str = "sync", push_cap: int = 0,
-    tier_meta: tuple = (), geom: tuple | None = None,
+    tier_meta: tuple = (), geom: tuple | None = None, unroll: int = 1,
 ):
     """The (unjitted) shard_map'd whole-search program. Pallas modes run
     the fused kernel per shard inside the collective program (the v4
     MPI-driving-CUDA-kernels architecture, mpi_bas.cpp:96-107, reborn as
-    one shard_map program)."""
+    one shard_map program). ``unroll`` runs that many collective rounds
+    per while iteration (dense._unrolled over the replicated-vote cond)."""
     hybrid = SHARDED_MODES[mode][1]
     cap = push_cap if hybrid else 0
     sh = P(axis)
@@ -634,7 +644,7 @@ def _sharded_fn(
     if mode == "fused":
         # router (_compiled_sharded) only sends qualified geometries here
         return jax.shard_map(
-            _sharded_fused_prog(axis),
+            _sharded_fused_prog(axis, unroll),
             mesh=mesh,
             in_specs=(sh, sh, aux_spec, rep, rep),
             out_specs=(rep, rep, sh, sh, rep, rep),
@@ -651,6 +661,7 @@ def _sharded_fn(
             mode=mode,
             push_cap=cap,
             tier_meta=tier_meta,
+            unroll=unroll,
         ),
         mesh=mesh,
         in_specs=(sh, sh, aux_spec, rep, rep),
@@ -690,7 +701,7 @@ def _check_vma_for(mode: str, geom: tuple | None = None) -> bool:
 
 def _compiled_sharded(
     mesh, axis: str, mode: str = "sync", push_cap: int = 0,
-    tier_meta: tuple = (), geom: tuple | None = None,
+    tier_meta: tuple = (), geom: tuple | None = None, unroll: int = 1,
 ):
     # resolve the Mosaic-availability fallback BEFORE the cache key (same
     # rule as dense._get_kernel): a fallen-back 'pallas' shares the
@@ -713,7 +724,7 @@ def _compiled_sharded(
         mode = "pallas"
     return _compiled_sharded_resolved(
         mesh, axis, _resolve_pallas_mode(mode, geom), push_cap, tier_meta,
-        geom,
+        geom, unroll,
     )
 
 
@@ -745,9 +756,10 @@ def _warn_fused_degrade(geom, tier_meta, why: str | None = None,
 @lru_cache(maxsize=None)
 def _compiled_sharded_resolved(
     mesh, axis: str, mode: str = "sync", push_cap: int = 0,
-    tier_meta: tuple = (), geom: tuple | None = None,
+    tier_meta: tuple = (), geom: tuple | None = None, unroll: int = 1,
 ):
-    return jax.jit(_sharded_fn(mesh, axis, mode, push_cap, tier_meta, geom))
+    return jax.jit(
+        _sharded_fn(mesh, axis, mode, push_cap, tier_meta, geom, unroll))
 
 
 def _compiled_sharded_batch(
@@ -890,13 +902,14 @@ def _shard_geom(g: "ShardedGraph") -> tuple:
 
 
 def solve_sharded_graph(
-    g: ShardedGraph, src: int, dst: int, *, mode: str = "sync"
+    g: ShardedGraph, src: int, dst: int, *, mode: str = "sync",
+    unroll: int = 1
 ) -> BFSResult:
     if not (0 <= src < g.n and 0 <= dst < g.n):
         raise ValueError(f"src/dst out of range for n={g.n}")
     fn = _compiled_sharded(
         g.mesh, VERTEX_AXIS, mode, kernel_cap(mode, g.n_pad), g.tier_meta,
-        _shard_geom(g),
+        _shard_geom(g), unroll,
     )
     from bibfs_tpu.solvers.timing import force_scalar
 
@@ -910,7 +923,8 @@ def solve_sharded_graph(
 
 
 def time_search(
-    g: ShardedGraph, src: int, dst: int, *, repeats: int = 30, mode: str = "sync"
+    g: ShardedGraph, src: int, dst: int, *, repeats: int = 30,
+    mode: str = "sync", unroll: int = 1
 ) -> tuple[list[float], BFSResult]:
     """Forced-execution timing loop + one materializing solve (protocol
     and rationale in :mod:`bibfs_tpu.solvers.timing`)."""
@@ -918,13 +932,13 @@ def time_search(
 
     fn = _compiled_sharded(
         g.mesh, VERTEX_AXIS, mode, kernel_cap(mode, g.n_pad), g.tier_meta,
-        _shard_geom(g),
+        _shard_geom(g), unroll,
     )
     src_a = _device_scalar(src)
     dst_a = _device_scalar(dst)
     return timed_repeats(
         lambda: fn(g.nbr, g.deg, g.aux, src_a, dst_a),
-        lambda: solve_sharded_graph(g, src, dst, mode=mode),
+        lambda: solve_sharded_graph(g, src, dst, mode=mode, unroll=unroll),
         repeats,
         force=force_scalar,
     )
@@ -998,19 +1012,22 @@ def solve_sharded(
     num_devices: int | None = None,
     mode: str = "sync",
     layout: str = "ell",
+    unroll: int = 1,
 ) -> BFSResult:
     mesh = make_1d_mesh(num_devices)
     g = ShardedGraph.build(
         n, edges, mesh, layout=layout,
         pad_multiple=default_pad_multiple(mode, int(mesh.devices.size)),
     )
-    return solve_sharded_graph(g, src, dst, mode=mode)
+    return solve_sharded_graph(g, src, dst, mode=mode, unroll=unroll)
 
 
 @register("sharded")
 def _sharded_backend(
-    n, edges, src, dst, num_devices=None, mode="sync", layout="ell", **_
+    n, edges, src, dst, num_devices=None, mode="sync", layout="ell",
+    unroll=1, **_
 ):
     return solve_sharded(
-        n, edges, src, dst, num_devices=num_devices, mode=mode, layout=layout
+        n, edges, src, dst, num_devices=num_devices, mode=mode,
+        layout=layout, unroll=unroll
     )

@@ -117,6 +117,7 @@ def time_backend(
     layout: str = "ell",
     rows: int | None = None,
     cols: int | None = None,
+    unroll: int = 1,
 ) -> tuple[list[float], BFSResult]:
     """Build the graph once for ``backend`` and run the timing protocol.
 
@@ -146,7 +147,8 @@ def time_backend(
         from bibfs_tpu.solvers.dense import DeviceGraph, time_search
 
         g = DeviceGraph.build(n, edges, layout=layout)
-        return time_search(g, src, dst, repeats=repeats, mode=mode)
+        return time_search(g, src, dst, repeats=repeats, mode=mode,
+                           unroll=unroll)
     if backend == "sharded":
         from bibfs_tpu.parallel.mesh import make_1d_mesh
         from bibfs_tpu.solvers.sharded import (
@@ -162,7 +164,8 @@ def time_backend(
                 mode, int(mesh.devices.size)
             ),
         )
-        return time_search(g, src, dst, repeats=repeats, mode=mode)
+        return time_search(g, src, dst, repeats=repeats, mode=mode,
+                           unroll=unroll)
     if backend == "sharded2d":
         from bibfs_tpu.solvers.sharded2d import (
             Sharded2DGraph,

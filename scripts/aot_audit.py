@@ -105,6 +105,19 @@ def main(argv=None) -> int:
             )
             record(name, ok, err, t0)
 
+        # round-5 unrolled programs (k rounds per while iteration via
+        # lax.cond re-gating — dense._unrolled): the on-chip unroll A/B
+        # must never be the first place these compile for TPU
+        for name, mode in (("dense/fused/ell/u8", "fused"),
+                           ("dense/sync/ell/u8", "sync")):
+            t0 = time.time()
+            fn = _build_kernel(mode, kernel_cap(mode, gell.n_pad), (), 8)
+            ok, err = aot_compile_tpu(
+                fn, np.asarray(gell.nbr), np.asarray(gell.deg), (),
+                np.int32(0), np.int32(gell.n - 1),
+            )
+            record(name, ok, err, t0)
+
         # dense batch kernel (vmapped search, B=4)
         t0 = time.time()
         batch_fn = jax.vmap(

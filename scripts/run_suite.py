@@ -1,0 +1,133 @@
+"""Regenerate the reference's headline artifact on the CURRENT platform.
+
+The reference ships ONE published table: every version on every suite
+graph (benchmark_test.sh:61-124 -> benchmark_results.csv /
+benchmark_table.txt). This script rebuilds that table through the
+framework's own CLI core — all four suite graphs x the host backends
+(serial, native) x the device backends (dense, sharded) — and then adds
+the device rows the reference never had: the fused whole-level kernel
+config and the batch-throughput rows (vmapped dense + native host loop
+on the same 64 pairs). Every row carries platform/config stamps
+(VERDICT r4 weak #6: the old CSV could not tell a CPU-substrate row
+from a real device row).
+
+Graphs are generated once into a cache dir and reused across retries;
+the run degrades per-row (cli.bench keeps a sweep alive through
+failures), so a tunnel drop mid-run still yields a labeled partial
+table. Writes benchmark_results.csv + benchmark_table.txt at the repo
+root and prints a RESULT line for the watcher protocol.
+
+Usage: python scripts/run_suite.py [--repeats 5] [--out-dir /tmp/...]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+SUITE_DIR = "/tmp/bibfs_suite_r5"
+
+
+def ensure_graphs(out_dir: str) -> tuple[list[str], str]:
+    """Suite .bin files + a 64-query pairs file, generated once and
+    reused (atomic per-file: generate_with_ground_truth writes whole
+    files; the marker file gates reuse so a killed generation rerun
+    starts clean)."""
+    from bibfs_tpu.graph.suite import SUITE, make_suite
+
+    marker = os.path.join(out_dir, ".complete")
+    paths = [os.path.join(out_dir, f"{label}.bin") for _n, label in SUITE]
+    pairs_path = os.path.join(out_dir, "pairs_100k.txt")
+    if not os.path.exists(marker):
+        make_suite(out_dir, seed=0)
+        import numpy as np
+
+        rng = np.random.default_rng(0)
+        n = SUITE[-1][0]
+        pairs = np.stack(
+            [rng.integers(0, n, 64), rng.integers(0, n, 64)], axis=1)
+        np.savetxt(pairs_path, pairs, fmt="%d")
+        with open(marker, "w") as f:
+            f.write("ok\n")
+    return paths, pairs_path
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--repeats", type=int, default=5)
+    ap.add_argument("--out-dir", default=SUITE_DIR)
+    ap.add_argument("--csv", default=os.path.join(REPO,
+                                                  "benchmark_results.csv"))
+    ap.add_argument("--table", default=os.path.join(REPO,
+                                                    "benchmark_table.txt"))
+    args = ap.parse_args(argv)
+    t0 = time.time()
+
+    from bibfs_tpu.utils.platform import apply_platform_env
+
+    apply_platform_env()
+    from bibfs_tpu.cli.bench import _write_csv, _write_table, run_bench
+
+    graphs, pairs_path = ensure_graphs(args.out_dir)
+    tmp_csv = args.csv + ".leg.tmp"
+    tmp_table = args.table + ".leg.tmp"
+
+    rows = []
+    # leg 1: the reference's own matrix — every backend, sync schedule.
+    # The pairs file indexes the 100k graph, so batch rows only run
+    # there (out-of-range pairs would fail every smaller graph's row)
+    rows += run_bench(
+        graphs[:-1], ["serial", "native", "dense", "sharded"],
+        repeats=args.repeats, mode="sync", layout="ell",
+        csv_path=tmp_csv, table_path=tmp_table,
+    )
+    rows += run_bench(
+        graphs[-1:], ["serial", "native", "dense", "sharded"],
+        repeats=args.repeats, mode="sync", layout="ell",
+        pairs_file=pairs_path, csv_path=tmp_csv, table_path=tmp_table,
+    )
+    # leg 2: the device configs beyond the reference — the whole-level
+    # fused kernel and the measured-best beamer/tiered config, 100k only
+    # (the small graphs answer nothing the sync rows did not)
+    for mode, layout in (("fused", "ell"), ("beamer", "tiered")):
+        rows += run_bench(
+            graphs[-1:], ["dense"], repeats=args.repeats, mode=mode,
+            layout=layout, csv_path=tmp_csv, table_path=tmp_table,
+        )
+    for p in (tmp_csv, tmp_table):
+        try:
+            os.remove(p)
+        except OSError:
+            pass
+    _write_csv(rows, args.csv)
+    _write_table(rows, args.table)
+
+    platforms = sorted({str(r.get("platform")) for r in rows})
+    ok_rows = sum(1 for r in rows if r.get("ok"))
+    out = dict(
+        item="suite", rows=len(rows), ok_rows=ok_rows,
+        platforms=platforms, elapsed_s=round(time.time() - t0, 1),
+        csv=args.csv,
+    )
+    if not any(r.get("platform") not in ("host", "cpu", "?", None)
+               and r.get("ok") and r.get("time_sec")
+               for r in rows):
+        # the watcher wants the table on REAL hardware; a CPU-substrate
+        # or all-rows-failed regeneration is still written (labeled
+        # rows) but not "done" — failed device rows keep their platform
+        # stamp, so the platform alone proves nothing
+        out["error"] = "no successful device-platform rows (tunnel down?)"
+    if ok_rows < len(rows):
+        out["failed_rows"] = len(rows) - ok_rows
+    print("RESULT " + json.dumps(out))
+    return 0 if "error" not in out else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -155,6 +155,30 @@ def test_batch_rmat_driver_executes_and_resumes(tmp_path):
 
 
 @pytest.mark.slow
+def test_deepcap_item_executes():
+    rec = _run_item("deepcap", ("capped_queries", "parity_bad",
+                                "auto_parity_bad"))
+    assert "error" not in rec, rec
+    assert rec["capped_queries"] >= 16, rec
+    assert rec["parity_bad"] == 0 and rec["auto_parity_bad"] == 0, rec
+
+
+@pytest.mark.slow
+def test_profile_item_executes():
+    artifact = os.path.join(REPO, "PROFILE_FUSED.json")
+    before = os.path.getmtime(artifact) if os.path.exists(artifact) else None
+    rec = _run_item("profile", ("hops_ok", "median_solve_s"))
+    assert "error" not in rec, rec
+    assert rec["hops_ok"], rec
+    assert rec.get("per_process_us") and rec.get("top_ops_us"), rec
+    # the committed artifact is chip-only: this CPU-forced smoke must
+    # leave it untouched (assert the NON-WRITE, not just the platform)
+    after = os.path.getmtime(artifact) if os.path.exists(artifact) else None
+    assert before == after, "CPU smoke clobbered PROFILE_FUSED.json"
+    assert rec["platform"] == "cpu"
+
+
+@pytest.mark.slow
 def test_unroll_item_executes():
     rec = _run_item("unroll", ("unroll_100k",))
     assert "error" not in rec, rec

@@ -319,3 +319,29 @@ def test_sharded_batch_beamer_tiered():
     for (s, d), res in zip(pairs, results):
         ref = solve_serial(n, edges, s, d)
         assert res.found == ref.found and (not ref.found or res.hops == ref.hops)
+
+
+def test_sharded_unroll_parity():
+    """k collective rounds per while iteration (dense._unrolled over the
+    replicated-vote cond) must be invisible in every output on the
+    8-device mesh, for both the XLA schedules and the per-shard fused
+    kernel, including a deep graph that terminates mid-block."""
+    from bibfs_tpu.graph.generate import gnp_random_graph
+    from bibfs_tpu.parallel.mesh import make_1d_mesh
+    from bibfs_tpu.solvers.sharded import ShardedGraph, solve_sharded_graph
+
+    mesh = make_1d_mesh(8)
+    n = 2_000
+    gg = ShardedGraph.build(n, gnp_random_graph(n, 2.5 / n, seed=6), mesh)
+    nl = 33  # line: 32 hops, odd round counts -> mid-block stops
+    gl = ShardedGraph.build(
+        nl, np.array([[i, i + 1] for i in range(nl - 1)]), mesh)
+    for mode in ("sync", "alt", "fused"):
+        for g, s, d in ((gg, 0, n - 1), (gl, 0, nl - 1)):
+            base = solve_sharded_graph(g, s, d, mode=mode)
+            for k in (2, 5):
+                got = solve_sharded_graph(g, s, d, mode=mode, unroll=k)
+                assert (got.found, got.hops, got.levels,
+                        got.edges_scanned) == (
+                    base.found, base.hops, base.levels,
+                    base.edges_scanned), (mode, k)
