@@ -450,12 +450,16 @@ def _get_minor_kernel(n: int, n_pad2: int, wp: int, tc: int, b: int,
 
 
 # Below this many queries 'auto' keeps the vmapped path: the minor
-# planes pad every batch to 128 lanes (pad_batch), so a B-query batch
-# pays 128/B lane waste against the layout's measured ~11x win at
-# B>=128 (PERF_NOTES §3). That model's crossover is B ~= 128/11 ~= 12;
-# 16 adds margin for the win itself shrinking at small B (unmeasured
-# below 128) while keeping every batch the model says minor wins.
-SMALL_BATCH_SYNC = 16
+# planes pad every batch to 128 lanes (pad_batch), so a tiny batch pays
+# the full plane for a handful of queries. MEASURED crossover (CPU,
+# n=30k gnp-2.2, timed_batch_repeats, us/query sync vs minor8):
+#   B=8: 7.8k vs 42.5k (sync 5.4x better)   B=16: 9.5k vs 22.4k (2.4x)
+#   B=32: 25.9k vs 22.6k (minor8 1.15x)     B=64: 35.6k vs 7.6k (4.7x)
+# — the naive 128/B-waste-vs-11x-win model put the crossover at ~12,
+# but the layout's win itself shrinks at small B, and the break-even is
+# B ~= 32. (TPU may cross earlier — minor targets the device's gather
+# penalty — but 'auto' routes by what is measured, not hoped.)
+SMALL_BATCH_SYNC = 32
 
 
 def auto_batch_mode(g, num_pairs: int) -> str:
@@ -463,10 +467,9 @@ def auto_batch_mode(g, num_pairs: int) -> str:
     measured-preference order: ``minor8`` (all-int8 planes) when the
     graph is plain-ELL and the geometry fits, else ``minor`` (int32
     planes, tiered supported), else the vmapped ``sync`` path. Batches
-    under :data:`SMALL_BATCH_SYNC` (16) queries stay on the vmapped
-    path — the minor layout pads to 128 lanes, and below that threshold
-    the pad waste outruns the layout's measured win (crossover math at
-    the constant). This
+    under :data:`SMALL_BATCH_SYNC` queries stay on the vmapped
+    path — the minor layout pads to 128 lanes, and the MEASURED
+    break-even (the A/B table at the constant) is B ~= 32. This
     is what ``solve_batch_graph(mode="auto")`` resolves through — the
     explicit mode names remain for measurement work (every A/B in
     PERF_NOTES pins its modes)."""

@@ -518,9 +518,43 @@ for mode in ("fused", "sync"):
             rows[key] = dict(error=str(e)[:200])
         print("unroll", key, rows[key], file=sys.stderr, flush=True)
 out["unroll_100k"] = rows
+# the mesh program's rounds add collectives to the fixed per-iteration
+# cost — two rows on a real-TPU 1-device mesh say whether unrolling
+# amortizes that tax too (collectives unroll under the replicated
+# vote). Own dict + fully guarded: a sharded failure (or OOM building
+# a second 100k graph) must neither discard the dense rows above nor
+# let a sharded success mask a total dense-sweep failure below.
+sh_rows = {{}}
+try:
+    from bibfs_tpu.parallel.mesh import make_1d_mesh
+    from bibfs_tpu.solvers.sharded import ShardedGraph
+    from bibfs_tpu.solvers.sharded import time_search as ts_sh
+
+    gs = ShardedGraph.build(n, edges, make_1d_mesh(1))
+    for k in (1, 8):
+        key = "u%d" % k
+        try:
+            times, res = ts_sh(gs, 0, n - 1, repeats=4,
+                               mode="sync", unroll=k)
+            med = float(np.median(times))
+            sh_rows[key] = dict(
+                median_s=med, levels=int(res.levels),
+                ms_per_level=med / max(res.levels, 1) * 1e3,
+                hops_ok=bool(res.hops == want.hops))
+            if not sh_rows[key]["hops_ok"]:
+                bad = "sharded1/" + key
+        except Exception as e:
+            sh_rows[key] = dict(error=str(e)[:200])
+        print("unroll sharded1", key, sh_rows[key],
+              file=sys.stderr, flush=True)
+except Exception as e:
+    sh_rows["build"] = dict(error=str(e)[:200])
+out["unroll_sharded1"] = sh_rows
 if bad is not None:
     out["error"] = "hop parity FAILED at %s" % bad
 elif not any("median_s" in v for v in rows.values()):
+    # the guard is scoped to the DENSE rows — the item's primary
+    # question — so sharded success cannot mask a dense failure
     out["error"] = next(iter(rows.values()))["error"]
 print("RESULT " + json.dumps(out))
 """

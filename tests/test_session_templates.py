@@ -180,11 +180,13 @@ def test_profile_item_executes():
 
 @pytest.mark.slow
 def test_unroll_item_executes():
-    rec = _run_item("unroll", ("unroll_100k",))
+    rec = _run_item("unroll", ("unroll_100k", "unroll_sharded1"))
     assert "error" not in rec, rec
     for key, row in rec["unroll_100k"].items():
         assert row.get("hops_ok"), (key, rec)
         assert "ms_per_level" in row, (key, rec)
+    for key, row in rec["unroll_sharded1"].items():
+        assert row.get("hops_ok") and "ms_per_level" in row, (key, rec)
 
 
 @pytest.mark.slow
