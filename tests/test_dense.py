@@ -282,3 +282,37 @@ def test_sync_unfused_control_matches_sync():
         b = solve_dense_graph(g, 0, nn - 1, mode="sync_unfused")
         assert (a.found, a.hops, a.levels, a.edges_scanned) == (
             b.found, b.hops, b.levels, b.edges_scanned), layout
+
+
+@pytest.mark.slow
+def test_fuzz_mode_layout_unroll_matrix():
+    """Randomized differential sweep across the full single-query config
+    space: random graphs (sparse to dense-ish, some disconnected, some
+    src==dst) x every schedule x both layouts x unroll in {1, 3, 8},
+    every cell vs the serial oracle. The cross-implementation agreement
+    discipline (SURVEY §4.3) applied to the whole round-5 matrix."""
+    from bibfs_tpu.graph.generate import gnp_random_graph
+    from bibfs_tpu.solvers.dense import DeviceGraph, solve_dense_graph
+
+    rng = np.random.default_rng(20260731)
+    modes = ["sync", "alt", "beamer", "beamer_alt", "pallas", "fused",
+             "fused_alt"]
+    for i in range(12):
+        n = int(rng.integers(8, 300))
+        p = float(rng.uniform(0.5, 4.0)) / n
+        edges = gnp_random_graph(n, p, seed=int(rng.integers(1 << 30)))
+        src = int(rng.integers(n))
+        dst = src if i % 5 == 0 else int(rng.integers(n))
+        ref = solve_serial(n, edges, src, dst)
+        for j, layout in enumerate(("ell", "tiered")):
+            g = DeviceGraph.build(n, edges, layout=layout)
+            # deterministic enumeration: 24 cells cycle through all 7
+            # schedules and all 3 unroll depths (random draws with a
+            # fixed seed left beamer_alt and unroll=1 never sampled)
+            mode = modes[(2 * i + j) % len(modes)]
+            unroll = (1, 3, 8)[(2 * i + j) % 3]
+            got = solve_dense_graph(g, src, dst, mode=mode, unroll=unroll)
+            assert got.found == ref.found, (i, layout, mode, unroll)
+            if ref.found:
+                assert got.hops == ref.hops, (i, layout, mode, unroll)
+                got.validate_path(n, edges, src, dst)
