@@ -80,8 +80,13 @@ SWEEP = [  # device configs: (mode, layout, unroll) — ordered so the
     ("sync", "tiered", 1),
 ]
 # each real device solve through the tunnel costs ~0.2s; cap device repeats
-# so the five device configs fit the driver's budget while host backends
-# keep the full repeat count
+# so the 11-config SWEEP above (schedule x layout x unroll) fits the
+# driver's budget while host backends keep the full repeat count. Even so,
+# tail configs routinely land in the over_budget skip path on a slow
+# tunnel: AOT_AUDIT.json measured the sync/ell/u8-class compile alone at
+# ~258 s, so a late sync-unroll entry being recorded as
+# "skipped: bench time budget spent" is the expected degradation, not a
+# regression.
 DEVICE_REPEATS = int(os.environ.get("BENCH_DEVICE_REPEATS", 10))
 # soft wall-clock ceiling for the WHOLE bench: the host rows (which carry
 # the headline) land in the first minute; device configs and the batch row
@@ -661,5 +666,188 @@ def calibrate_main():
     return 0
 
 
+# --serve defaults: a CPU-friendly graph (the acceptance gate runs on the
+# CPU backend) and the measured flat-asymptote queue depth (calibration
+# batch_flat = 256, PERF_NOTES §3)
+SERVE_N = int(os.environ.get("BENCH_SERVE_N", 10_000))
+SERVE_Q = int(os.environ.get("BENCH_SERVE_Q", 256))
+
+
+def serve_main():
+    """``python bench.py --serve``: engine-vs-naive serving throughput.
+
+    Serves ``SERVE_Q`` queued queries over a G(SERVE_N, 2.2/n) graph
+    three ways — a naive per-query ``api.solve()`` loop (representation
+    rebuilt per call: the usage pattern the serving engine exists to
+    replace), the micro-batching engine cold, and the engine warm
+    (repeat traffic) — with EVERY returned hop count verified against
+    the serial oracle and warm traffic asserted dispatch-free. Emits one
+    compact JSON line on stdout and the full machine-readable artifact
+    to ``bench_serve.json`` (queries/sec, speedups, cache hit rates,
+    executable-reuse counters)."""
+    t_setup = time.time()
+    platform, tpu_error = select_platform()
+    try:
+        from bibfs_tpu.graph.csr import build_csr, canonical_pairs
+        from bibfs_tpu.graph.generate import gnp_random_graph
+        from bibfs_tpu.serve import QueryEngine
+        from bibfs_tpu.solvers.api import solve as api_solve, validate_path
+        from bibfs_tpu.solvers.serial import solve_serial_csr
+
+        n, q = SERVE_N, SERVE_Q
+        edges = gnp_random_graph(n, AVG_DEG / n, seed=1)
+        cpairs = canonical_pairs(n, edges)
+        csr = build_csr(n, pairs=cpairs)
+        rng = np.random.default_rng(0)
+        pairs = np.unique(
+            rng.integers(0, n, size=(2 * q, 2)), axis=0
+        )[:q]
+        rng.shuffle(pairs)
+        oracle = {
+            (int(s), int(d)): solve_serial_csr(n, *csr, int(s), int(d))
+            for s, d in pairs
+        }
+
+        def check(results, label):
+            bad = []
+            for (s, d), res in zip(pairs, results):
+                ref = oracle[(int(s), int(d))]
+                if res.found != ref.found or (
+                    ref.found and res.hops != ref.hops
+                ):
+                    bad.append(f"{label} {s}->{d}: {res.hops} != {ref.hops}")
+                elif ref.found and not validate_path(
+                    csr, res.path, int(s), int(d), hops=res.hops
+                ):
+                    bad.append(f"{label} {s}->{d}: invalid path")
+            return bad
+
+        # naive per-query solve() loop: one warm call excludes the JIT
+        # compile (shared timing protocol), then every query pays the
+        # full per-call representation rebuild + dispatch
+        api_solve("dense", n, edges, int(pairs[0][0]), int(pairs[0][1]))
+        t0 = time.perf_counter()
+        naive_results = [
+            api_solve("dense", n, edges, int(s), int(d)) for s, d in pairs
+        ]
+        naive_s = time.perf_counter() - t0
+        errors = check(naive_results, "naive")
+
+        # engine: a warm-up engine over the same graph compiles the
+        # bucketed device programs (compile excluded, like every bench
+        # row); the TIMED engines are fresh, so their caches start cold
+        # and only executable reuse carries over — exactly the steady
+        # state a serving process reaches after its first graph
+        warm_pairs = np.unique(
+            rng.integers(0, n, size=(2 * q, 2)), axis=0
+        )[:q]
+        QueryEngine(
+            n, edges, pairs=cpairs, device_batches=True
+        ).query_many(warm_pairs)
+        engine = QueryEngine(n, edges, pairs=cpairs)
+        if not engine._use_device():
+            engine._get_host_solver()  # setup, not serving (untimed)
+        t0 = time.perf_counter()
+        cold_results = engine.query_many(pairs)
+        cold_s = time.perf_counter() - t0
+        errors += check(cold_results, "engine")
+
+        # warm repeat traffic must be answered dispatch-free
+        disp_before = (
+            engine.counters["device_batches"],
+            engine.counters["host_queries"],
+        )
+        t0 = time.perf_counter()
+        warm_results = engine.query_many(pairs)
+        warm_s = time.perf_counter() - t0
+        errors += check(warm_results, "warm")
+        disp_after = (
+            engine.counters["device_batches"],
+            engine.counters["host_queries"],
+        )
+        if disp_after != disp_before:
+            errors.append(
+                f"warm traffic dispatched: {disp_before} -> {disp_after}"
+            )
+
+        # the device-batched route, forced (on an accelerator substrate
+        # the adaptive router picks this on its own; on the CPU backend
+        # it is measured here for the record, not the headline — there
+        # is no dispatch tax to amortize, see serve/engine.py)
+        dev_engine = QueryEngine(
+            n, edges, pairs=cpairs, device_batches=True
+        )
+        dev_engine.graph  # graph build + upload is setup (untimed)
+        t0 = time.perf_counter()
+        dev_results = dev_engine.query_many(pairs)
+        dev_s = time.perf_counter() - t0
+        errors += check(dev_results, "device-engine")
+
+        naive_qps = q / naive_s if naive_s > 0 else None
+        engine_qps = q / cold_s if cold_s > 0 else None
+        warm_qps = q / warm_s if warm_s > 0 else None
+        device_engine_qps = q / dev_s if dev_s > 0 else None
+        speedup = (
+            engine_qps / naive_qps if naive_qps and engine_qps else None
+        )
+        stats = engine.stats()
+        line = {
+            "metric": f"bibfs_serve_throughput_{n}",
+            "value": engine_qps,
+            "unit": "queries/s",
+            "queries": q,
+            "graph": f"G({n}, {AVG_DEG:.1f}/n) seed=1",
+            "platform": platform,
+            "naive_qps": naive_qps,
+            "engine_qps": engine_qps,
+            "warm_qps": warm_qps,
+            "device_engine_qps": device_engine_qps,
+            "device_engine_stats": dev_engine.stats(),
+            "speedup_vs_naive": speedup,
+            "speedup_ok": bool(speedup and speedup >= 5.0),
+            "verified_vs_oracle": not errors,
+            "errors": errors[:20],
+            "stats": stats,
+            "total_s": round(time.time() - t_setup, 1),
+        }
+        if tpu_error:
+            line["tpu_error"] = tpu_error[:300]
+        with open(
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "bench_serve.json"), "w"
+        ) as f:
+            json.dump(line, f, indent=1)
+            f.write("\n")
+        print(json.dumps({
+            "metric": line["metric"],
+            "value": None if engine_qps is None else round(engine_qps, 1),
+            "unit": "queries/s",
+            "naive_qps": None if naive_qps is None else round(naive_qps, 1),
+            "warm_qps": None if warm_qps is None else round(warm_qps, 1),
+            "speedup_vs_naive": None if speedup is None else round(speedup, 2),
+            "speedup_ok": line["speedup_ok"],
+            "verified_vs_oracle": line["verified_vs_oracle"],
+            "dist_cache_hits": stats["dist_cache"]["hits"],
+            "exec_programs": stats["exec_cache"]["programs"],
+            "detail_file": "bench_serve.json",
+        }))
+        return 0 if not errors else 1
+    except Exception as e:
+        import traceback
+
+        traceback.print_exc()
+        print(json.dumps({
+            "metric": "bibfs_serve_throughput",
+            "value": None,
+            "error": f"{type(e).__name__}: {e}"[:400],
+        }))
+        return 1
+
+
 if __name__ == "__main__":
-    sys.exit(calibrate_main() if "--calibrate" in sys.argv else main())
+    if "--calibrate" in sys.argv:
+        sys.exit(calibrate_main())
+    elif "--serve" in sys.argv:
+        sys.exit(serve_main())
+    else:
+        sys.exit(main())

@@ -133,6 +133,52 @@ def _batch_row(
     )
 
 
+def _serve_row(label, n, edges, pairs, wants, repeats):
+    """One serving-engine throughput row: all pairs served through a
+    fresh :class:`bibfs_tpu.serve.QueryEngine` per repeat (so every
+    repeat's distance cache starts cold and the row measures solving,
+    not memoization; compiled executables persist process-wide, and the
+    first, discarded run carries compile/warm-up as usual). time_sec is
+    the per-query amortized wall-clock of the median repeat."""
+    from bibfs_tpu.serve import QueryEngine
+
+    times = []
+    results = stats = None
+    for _ in range(max(repeats, 1) + 1):
+        eng = QueryEngine(n, edges)
+        if not eng._use_device():
+            # host route: the solver build (native CSR / oracle CSR) is
+            # per-engine setup, not serving — keep it outside the timed
+            # window like every other row's graph build
+            eng._get_host_solver()
+        t0 = time.time()
+        results = eng.query_many(pairs)
+        times.append(time.time() - t0)
+        stats = eng.stats()
+    times = times[1:]  # warm-up run (device compile) excluded
+    batch_s = float(np.median(times))
+    ok = True
+    hops_total = 0
+    edges_scanned = 0
+    for want, res in zip(wants, results):
+        ok = ok and (res.found == want.found) and (res.hops == want.hops)
+        hops_total += res.hops or 0
+        edges_scanned += res.edges_scanned
+    per_query = batch_s / max(len(results), 1)
+    route = "device" if stats["device_batches_enabled"] else (
+        stats["host_backend"] or "host"
+    )
+    return dict(
+        version=f"serve-batch{len(results)}",
+        graph=label,
+        time_sec=per_query,
+        teps=edges_scanned / batch_s if batch_s > 0 else 0.0,
+        hops=hops_total,
+        ok=ok,
+        config=f"serve/{route}",
+    )
+
+
 def _row_provenance(backend: str, mode: str, layout: str) -> tuple[str, str]:
     """(platform, config) stamps for one row: a reader must be able to
     tell a CPU-substrate row from a real device row — and which schedule
@@ -158,6 +204,7 @@ def run_bench(
     mode: str = "sync",
     layout: str = "ell",
     pairs_file: str | None = None,
+    serve: bool = False,
 ) -> list[dict]:
     rows = []
     for gpath in graphs:
@@ -251,6 +298,30 @@ def run_bench(
                          time_sec=None, teps=None, hops=None, ok=False,
                          platform=plat, config=cfg)
                 )
+        if pairs_file is not None and serve:
+            # amortized serving-engine throughput (adaptive micro-batch
+            # + caches; bibfs_tpu/serve) against the same oracle
+            try:
+                if batch_oracle is None:
+                    batch_oracle = _batch_oracle(n, edges, pairs_file)
+                row = _serve_row(label, n, edges, *batch_oracle, repeats)
+                plat, _cfg = _row_provenance("dense", "serve", "ell")
+                row.setdefault("platform", plat)
+                rows.append(row)
+                print(
+                    f"  {row['version']:8s} {label:6s} "
+                    f"{row['time_sec']:.6e}s/query  "
+                    f"teps={row['teps']:.3e} "
+                    f"{'OK' if row['ok'] else 'MISMATCH vs oracle'}"
+                )
+            except Exception as e:
+                print(f"  serve engine on {label}: FAILED ({e})",
+                      file=sys.stderr)
+                rows.append(
+                    dict(version="serve-batch", graph=label, time_sec=None,
+                         teps=None, hops=None, ok=False,
+                         platform="?", config="serve")
+                )
     _write_csv(rows, csv_path)
     _write_table(rows, table_path)
     return rows
@@ -335,6 +406,13 @@ def main(argv=None):
         "sharded multi-chip) and/or a scratch-reusing host loop (native), "
         "one per-query amortized row per benched backend",
     )
+    ap.add_argument(
+        "--serve",
+        action="store_true",
+        help="with --pairs: add a serving-engine throughput row per "
+        "graph (adaptive micro-batching + distance/executable caches, "
+        "bibfs_tpu/serve) validated against the same oracle",
+    )
     ap.add_argument("--csv", default="benchmark_results.csv")
     ap.add_argument("--table", default="benchmark_table.txt")
     args = ap.parse_args(argv)
@@ -364,6 +442,8 @@ def main(argv=None):
     } & set(backends):
         ap.error("--pairs requires the dense, native, sharded and/or "
                  "sharded2d backend in --backends")
+    if args.serve and args.pairs is None:
+        ap.error("--serve needs --pairs FILE (the served query list)")
     rows = run_bench(
         args.graphs,
         backends,
@@ -374,6 +454,7 @@ def main(argv=None):
         mode=args.mode,
         layout=args.layout,
         pairs_file=args.pairs,
+        serve=args.serve,
     )
     return 0 if all(r["ok"] for r in rows) else 1
 

@@ -227,6 +227,16 @@ def _vma_of(*arrays) -> frozenset:
     return out
 
 
+def _sds(shape, dtype, vma: frozenset = frozenset()):
+    """``jax.ShapeDtypeStruct`` with the vma declaration on jax lines
+    that have the vma system; older lines accept neither the kwarg nor
+    need the declaration (there is no checker for it to feed)."""
+    try:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    except TypeError:
+        return jax.ShapeDtypeStruct(shape, dtype)
+
+
 def _check_kernel_geometry(wp: int, n_rows_p: int, ks: int) -> int:
     """Trace-time guard for DIRECT kernel callers (the solvers gate via
     pallas_fits first): the parent key must not overflow int32, and some
@@ -256,7 +266,7 @@ def _get_pull_call(
     kernel = lambda *refs: _pull_kernel(ks, *refs)  # noqa: E731
     blk = pl.BlockSpec((wp, tc), lambda i: (0, i))
     row = pl.BlockSpec((1, tc), lambda i: (0, i))
-    rs = jax.ShapeDtypeStruct((1, n_rows_p), jnp.int32, vma=vma)
+    rs = _sds((1, n_rows_p), jnp.int32, vma=vma)
     return pl.pallas_call(
         kernel,
         grid=(grid,),
@@ -277,7 +287,7 @@ def _get_dual_call(
     kernel = lambda *refs: _pull_kernel_dual(ks, *refs)  # noqa: E731
     blk = pl.BlockSpec((wp, tc), lambda i: (0, i))
     row = pl.BlockSpec((1, tc), lambda i: (0, i))
-    rs = jax.ShapeDtypeStruct((1, n_rows_p), jnp.int32, vma=vma)
+    rs = _sds((1, n_rows_p), jnp.int32, vma=vma)
     return pl.pallas_call(
         kernel,
         grid=(grid,),

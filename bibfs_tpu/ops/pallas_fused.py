@@ -57,6 +57,7 @@ from jax.experimental import pallas as pl
 
 from bibfs_tpu.ops.pallas_expand import (  # shared table rules
     _slot_pad,
+    _sds,
     _vma_of,
     sentinel_transposed_table,
 )
@@ -304,8 +305,8 @@ def _get_fused_single_call(wp: int, n_rows_p: int, ks: int, bit: int,
     blk = pl.BlockSpec((wp, TILE), lambda i: (0, i))
     row = pl.BlockSpec((1, TILE), lambda i: (0, i))
     one = pl.BlockSpec((1, 1), lambda i: (0, 0))
-    rs = jax.ShapeDtypeStruct((1, n_rows_p), jnp.int32, vma=vma)
-    ss = jax.ShapeDtypeStruct((1, 1), jnp.int32, vma=vma)
+    rs = _sds((1, n_rows_p), jnp.int32, vma=vma)
+    ss = _sds((1, 1), jnp.int32, vma=vma)
     return pl.pallas_call(
         kernel,
         grid=(grid,),
@@ -350,8 +351,8 @@ def _get_fused_call(wp: int, n_rows_p: int, ks: int, interpret: bool,
     blk = pl.BlockSpec((wp, TILE), lambda i: (0, i))
     row = pl.BlockSpec((1, TILE), lambda i: (0, i))
     one = pl.BlockSpec((1, 1), lambda i: (0, 0))
-    rs = jax.ShapeDtypeStruct((1, n_rows_p), jnp.int32, vma=vma)
-    ss = jax.ShapeDtypeStruct((1, 1), jnp.int32, vma=vma)
+    rs = _sds((1, n_rows_p), jnp.int32, vma=vma)
+    ss = _sds((1, 1), jnp.int32, vma=vma)
     return pl.pallas_call(
         kernel,
         grid=(grid,),

@@ -15,6 +15,57 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 VERTEX_AXIS = "x"
 
 
+def shard_map(fn, *, mesh, in_specs, out_specs, check_vma=None):
+    """``jax.shard_map`` across jax versions.
+
+    The top-level export (and its ``check_vma`` kwarg) only exists on
+    newer jax lines; older ones ship the same transform as
+    ``jax.experimental.shard_map`` with the kwarg named ``check_rep``.
+    Every shard_map in this framework goes through here so a version
+    skew degrades to the equivalent call instead of an
+    ``AttributeError`` at first dispatch."""
+    if hasattr(jax, "shard_map"):
+        kwargs = {} if check_vma is None else {"check_vma": check_vma}
+        return jax.shard_map(
+            fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs
+        )
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    # the experimental checker (check_rep) predates replication rules for
+    # while_loop — and every search program here IS one lax.while_loop —
+    # so on these versions the checker can never validate the programs it
+    # would guard; off is the documented workaround, and it is only a
+    # checker (the newer vma checker takes over where available)
+    return _shard_map(
+        fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=False,
+    )
+
+
+def pcast(x, axis, *, to):
+    """``jax.lax.pcast`` across jax versions: the vma (varying-manual-
+    axes) cast exists only on jax lines that ship the vma checker. Older
+    lines have no vma system — there is nothing to pin, every provenance
+    is acceptable to their replication checker, and the cast is the
+    identity."""
+    import jax
+
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(x, axis, to=to)
+    return x
+
+
+def axis_size(axis):
+    """``jax.lax.axis_size`` across jax versions; older lines use the
+    ``psum(1, axis)`` idiom, which constant-folds to the static axis
+    size at trace time."""
+    import jax
+
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis)
+    return jax.lax.psum(1, axis)
+
+
 def make_1d_mesh(num_devices: int | None = None, axis: str = VERTEX_AXIS) -> Mesh:
     """A 1D mesh over the first ``num_devices`` visible devices (all by
     default). Vertex arrays are 1D-sharded over this axis (the real

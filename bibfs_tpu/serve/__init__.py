@@ -1,0 +1,19 @@
+"""Query-throughput serving layer (PR: adaptive micro-batching engine).
+
+Turns the measured batch asymptote (PERF_NOTES.md §3: per-query device
+cost flat by batch ~256) into an end-to-end serving path: an adaptive
+micro-batcher over the batch solvers, a shape-bucketed executable cache,
+and a distance/result cache. See :mod:`bibfs_tpu.serve.engine`.
+"""
+
+from bibfs_tpu.serve.buckets import (  # noqa: F401
+    DEFAULT_EXEC_CACHE,
+    ExecutableCache,
+    bucket_batch,
+    bucket_rows,
+    bucket_shape,
+    bucket_width,
+    bucketed_ell,
+)
+from bibfs_tpu.serve.cache import DistanceCache  # noqa: F401
+from bibfs_tpu.serve.engine import QueryEngine  # noqa: F401

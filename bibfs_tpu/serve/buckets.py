@@ -1,0 +1,138 @@
+"""Shape buckets + executable-reuse accounting for the serving engine.
+
+Why this layer exists: every compiled search program is keyed (via
+``jax.jit``'s shape specialization and the solver-side kernel caches) by
+the PADDED device geometry — ``[n_pad, width]`` tables, ``[B]`` query
+vectors. A serving deployment that accepts arbitrary graphs therefore
+recompiles per graph size, and ``AOT_AUDIT.json`` records single
+compiles up to ~258 s: one odd-sized graph can cost more than a million
+served queries. Here every incoming graph is padded UP to a small
+geometric ladder of shapes (rows x2 from 128, ELL width x2 from 8, batch
+x2 from 128 lanes), so any mix of graph sizes funnels into a handful of
+compiled programs — the classic bucketed-serving trade (a bounded <2x
+pad overhead in table reads buys an O(1) executable working set).
+
+Padding is semantically free: bucket rows are isolated degree-0 vertices
+and bucket width columns sit beyond every true degree, and all use sites
+mask by ``deg`` (the same invariant ``pad_multiple`` already relies on).
+
+:class:`ExecutableCache` is the accounting side: the engine notes the
+(bucket shape, resolved mode, batch bucket) of every device dispatch,
+and because the solver kernel caches key on exactly those padded shapes
+(see ``batch_minor._get_minor_kernel_shape``), a noted *hit* really is a
+reused compiled program, not just a reused label.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from bibfs_tpu.graph.csr import EllGraph, build_ell
+
+# Geometric ladders. Rows start at 128 (one lane group) and double;
+# widths start at the int32 sublane quantum 8 and double; batch buckets
+# start at one 128-lane group and double (bucket_batch). Ratio 2 bounds
+# pad waste at <2x while keeping the ladder ~17 rungs deep to 10M nodes.
+ROW_BUCKET_BASE = 128
+WIDTH_BUCKET_BASE = 8
+BATCH_BUCKET_BASE = 128
+
+
+def _next_rung(base: int, value: int) -> int:
+    rung = base
+    while rung < value:
+        rung *= 2
+    return rung
+
+
+def bucket_rows(n_pad: int) -> int:
+    """Smallest row rung (128 * 2^k) holding ``n_pad`` vertex rows."""
+    return _next_rung(ROW_BUCKET_BASE, max(1, n_pad))
+
+
+def bucket_width(width: int) -> int:
+    """Smallest ELL-width rung (8 * 2^k) holding ``width`` slots."""
+    return _next_rung(WIDTH_BUCKET_BASE, max(1, width))
+
+
+def bucket_batch(num_queries: int) -> int:
+    """Smallest batch rung (128 * 2^k) holding ``num_queries`` — the
+    engine pads every flush to a rung so repeat traffic at any queue
+    depth reuses a handful of compiled batch programs."""
+    return _next_rung(BATCH_BUCKET_BASE, max(1, num_queries))
+
+
+def bucket_shape(n_pad: int, width: int) -> tuple[int, int]:
+    return bucket_rows(n_pad), bucket_width(width)
+
+
+def bucketed_ell(
+    n: int,
+    edges: np.ndarray | None = None,
+    *,
+    pairs: np.ndarray | None = None,
+) -> EllGraph:
+    """`build_ell` padded up to its shape bucket.
+
+    The returned graph reports the bucket as its ``n_pad``/``width``, so
+    everything downstream (device upload, kernel geometry, chunk math)
+    sees only the bucketed shape; ``n`` stays the true vertex count for
+    range checks and result slicing."""
+    g = build_ell(n, edges, pairs=pairs)
+    rows, width = bucket_shape(g.n_pad, g.width)
+    if (rows, width) == (g.n_pad, g.width):
+        return g
+    nbr = np.zeros((rows, width), dtype=np.int32)
+    nbr[: g.n_pad, : g.width] = g.nbr
+    deg = np.zeros(rows, dtype=np.int32)
+    deg[: g.n_pad] = g.deg
+    return EllGraph(
+        n=g.n,
+        n_pad=rows,
+        width=width,
+        num_edges=g.num_edges,
+        nbr=nbr,
+        deg=deg,
+        overflow=g.overflow,
+    )
+
+
+class ExecutableCache:
+    """Hit/miss accounting over compiled-program identities.
+
+    A *program key* is everything the underlying jit caches specialize
+    on for a dispatch: the bucketed table shape, the resolved batch
+    mode, and the batch rung. ``note()`` returns whether that program
+    was already paid for. One process-wide instance
+    (:data:`DEFAULT_EXEC_CACHE`) is shared by default so engines over
+    different graphs in one bucket see each other's compiles — exactly
+    the reuse the buckets exist to create."""
+
+    def __init__(self):
+        self._seen: set = set()
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+
+    def note(self, key) -> bool:
+        """Record a dispatch under ``key``; True iff already compiled."""
+        with self._lock:
+            if key in self._seen:
+                self.hits += 1
+                return True
+            self._seen.add(key)
+            self.misses += 1
+            return False
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "hits": self.hits,
+                "misses": self.misses,
+                "programs": len(self._seen),
+            }
+
+
+DEFAULT_EXEC_CACHE = ExecutableCache()

@@ -1,0 +1,162 @@
+"""Distance/result cache for the serving engine.
+
+Two bounded LRU stores, both host-side and dispatch-free to read:
+
+- **Source forests**, keyed ``(graph_id, root)``: the solved parent
+  array of one side of a bidirectional search. Every search is
+  level-synchronous, so any vertex inside the forest carries its TRUE
+  BFS distance from the root — a follow-up query ``(root, x)`` (or its
+  reverse ``(x, root)``: the graph is undirected) whose ``x`` lies in
+  the forest is answered exactly by walking the parent chain, with zero
+  solver dispatches. Distances are implicit (chain length), so an
+  insert is just an O(n) row copy and a lookup is O(hops).
+
+- **Pair memo**, keyed ``(graph_id, min(a,b), max(a,b))``: whole results
+  including *negative* ones — a partial forest can never prove "no
+  path" (the vertex might merely be unexplored), so unreachable pairs
+  are only servable from this memo.
+
+A forest is PARTIAL: the search stops at the provably-correct meet vote,
+so only the explored region is present. Absence from the forest is a
+cache miss, never an answer.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+
+import numpy as np
+
+
+def walk_parents(par: np.ndarray, root: int, v: int) -> list[int] | None:
+    """The forest path ``[root, ..., v]``, or None if ``v`` is outside
+    the forest. Bounded by the array size, so a corrupt chain cannot
+    loop forever."""
+    if v == root:
+        return [root]
+    if not (0 <= v < par.size) or par[v] < 0:
+        return None
+    chain = [v]
+    u = int(par[v])
+    for _ in range(par.size):
+        chain.append(u)
+        if u == root:
+            chain.reverse()
+            return chain
+        u = int(par[u])
+        if u < 0:
+            return None
+    return None
+
+
+class DistanceCache:
+    """LRU source forests + pair memo (module docstring). ``entries``
+    bounds the forest store (the memory owner: one int32[n] row each);
+    ``pair_entries`` the memo (tiny tuples; defaults to 8x)."""
+
+    def __init__(self, entries: int = 64, pair_entries: int | None = None):
+        self.entries = int(entries)
+        self.pair_entries = int(
+            8 * entries if pair_entries is None else pair_entries
+        )
+        self._forests: OrderedDict = OrderedDict()
+        self._pairs: OrderedDict = OrderedDict()
+        self.forest_hits = 0
+        self.pair_hits = 0
+        self.misses = 0
+        self.inserts = 0
+        self.evictions = 0
+
+    # ---- inserts -----------------------------------------------------
+    def put_forest(self, graph_id, root: int, par: np.ndarray, n: int):
+        """Bank one side's parent array (sliced to the true vertex
+        count; device padding rows are never part of any chain)."""
+        if self.entries <= 0:
+            return
+        key = (graph_id, int(root))
+        self._forests[key] = np.asarray(par[:n], dtype=np.int32).copy()
+        self._forests.move_to_end(key)
+        self.inserts += 1
+        while len(self._forests) > self.entries:
+            self._forests.popitem(last=False)
+            self.evictions += 1
+
+    def put_path(self, graph_id, path, n: int):
+        """Bank a solved shortest path as (partial) forests for BOTH its
+        endpoints. Along a shortest path, vertex ``path[i]`` sits at true
+        BFS distance ``i`` from ``path[0]`` (and ``len-1-i`` from the
+        other end), so each direction of the chain is a valid
+        parent-forest fragment — this is how the host dispatch path
+        (which has no parent planes) still feeds the forest store.
+        Merges into an existing forest when present (already-claimed
+        parents stand; both chains are distance-consistent)."""
+        if self.entries <= 0 or path is None or len(path) < 2:
+            return
+        for chain in (path, list(reversed(path))):
+            key = (graph_id, int(chain[0]))
+            par = self._forests.get(key)
+            if par is None:
+                par = np.full(n, -1, np.int32)
+                self._forests[key] = par
+                self.inserts += 1
+            for prev, v in zip(chain[:-1], chain[1:]):
+                if 0 <= v < par.size and par[v] < 0:
+                    par[v] = prev
+            self._forests.move_to_end(key)
+        while len(self._forests) > self.entries:
+            self._forests.popitem(last=False)
+            self.evictions += 1
+
+    def put_result(self, graph_id, src: int, dst: int,
+                   found: bool, hops, path):
+        """Memoize a whole materialized result, oriented canonically."""
+        if self.pair_entries <= 0 or src == dst:
+            return
+        a, b = (src, dst) if src < dst else (dst, src)
+        if found and path is not None and path[0] != a:
+            path = list(reversed(path))
+        self._pairs[(graph_id, a, b)] = (found, hops, path)
+        self._pairs.move_to_end((graph_id, a, b))
+        while len(self._pairs) > self.pair_entries:
+            self._pairs.popitem(last=False)
+
+    # ---- lookup ------------------------------------------------------
+    def lookup(self, graph_id, src: int, dst: int):
+        """``(found, hops, path src->dst)`` or None (a miss). Tries the
+        pair memo, then the src forest, then the dst forest (reverse
+        twin)."""
+        a, b = (src, dst) if src < dst else (dst, src)
+        memo = self._pairs.get((graph_id, a, b))
+        if memo is not None:
+            self._pairs.move_to_end((graph_id, a, b))
+            self.pair_hits += 1
+            found, hops, path = memo
+            if found and path is not None and src != path[0]:
+                path = list(reversed(path))
+            return found, hops, path
+        for root, leaf, reverse in ((src, dst, False), (dst, src, True)):
+            par = self._forests.get((graph_id, root))
+            if par is None:
+                continue
+            chain = walk_parents(par, root, leaf)
+            if chain is None:
+                continue
+            self._forests.move_to_end((graph_id, root))
+            self.forest_hits += 1
+            if reverse:
+                chain.reverse()  # walk gave [dst..src]; want src->dst
+            return True, len(chain) - 1, chain
+        self.misses += 1
+        return None
+
+    def stats(self) -> dict:
+        return {
+            "forest_hits": self.forest_hits,
+            "pair_hits": self.pair_hits,
+            "hits": self.forest_hits + self.pair_hits,
+            "misses": self.misses,
+            "inserts": self.inserts,
+            "evictions": self.evictions,
+            "forests": len(self._forests),
+            "pairs": len(self._pairs),
+        }

@@ -1,0 +1,171 @@
+"""``bibfs-serve`` — serve shortest-path queries over one graph.
+
+The serving-shaped counterpart of ``bibfs-solve``: instead of one
+process per query (the reference harness's model,
+benchmark_test.sh:44-59) the engine keeps the graph device-resident,
+micro-batches queued queries through one compiled program per flush,
+and answers repeat traffic from the distance cache with zero solver
+dispatches. Queries come from ``--pairs FILE`` or stdin (one
+``src dst`` per line); results print in the ``bibfs-solve --pairs``
+line format, and ``--stats-json`` writes the engine's machine-readable
+serving counters.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _print_result(src, dst, res, no_path: bool) -> None:
+    if res.found:
+        line = f"{src} -> {dst}: length = {res.hops}"
+        if res.path and not no_path:
+            line += "  path: " + " -> ".join(str(v) for v in res.path)
+    else:
+        line = f"{src} -> {dst}: no path"
+    print(line)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Serve (src, dst) queries through the adaptive "
+        "micro-batching engine"
+    )
+    ap.add_argument("graph", help=".bin graph file")
+    ap.add_argument(
+        "--pairs",
+        default=None,
+        metavar="FILE",
+        help='query file of "src dst" lines (default: stream stdin, '
+        "flushing each time the queue fills a batch)",
+    )
+    ap.add_argument(
+        "--mode",
+        default="auto",
+        choices=["auto", "sync", "minor", "minor8"],
+        help="batch layout for device flushes (default auto: the "
+        "measured preference order)",
+    )
+    ap.add_argument(
+        "--layout",
+        default="ell",
+        choices=["ell", "tiered"],
+        help="adjacency layout (ell is shape-bucketed for executable "
+        "reuse; tiered for power-law graphs)",
+    )
+    ap.add_argument(
+        "--threshold",
+        type=int,
+        default=None,
+        help="queue depth at which a flush dispatches as a device batch "
+        "(default: the calibrated batch-vs-latency crossover); below "
+        "it queries run per-query on the host runtime",
+    )
+    ap.add_argument("--max-batch", type=int, default=1024,
+                    help="largest single device flush (default 1024)")
+    ap.add_argument("--cache-entries", type=int, default=64,
+                    help="distance-cache forest capacity (default 64)")
+    ap.add_argument("--no-path", action="store_true",
+                    help="skip path printing")
+    ap.add_argument(
+        "--stats-json",
+        default=None,
+        metavar="FILE",
+        help="write the engine's serving counters (dispatches, cache "
+        "hit rates, executable reuse) to FILE as JSON",
+    )
+    args = ap.parse_args(argv)
+
+    from bibfs_tpu.graph.io import read_graph_bin
+    from bibfs_tpu.serve import QueryEngine
+    from bibfs_tpu.utils.platform import apply_platform_env
+
+    apply_platform_env()
+    try:
+        n, edges = read_graph_bin(args.graph)
+    except (OSError, ValueError) as e:
+        print(f"Error reading graph: {e}", file=sys.stderr)
+        return 2
+
+    try:
+        engine = QueryEngine(
+            n, edges,
+            mode=args.mode,
+            layout=args.layout,
+            flush_threshold=args.threshold,
+            max_batch=args.max_batch,
+            cache_entries=args.cache_entries,
+        )
+    except ValueError as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 2
+
+    try:
+        if args.pairs is not None:
+            import numpy as np
+
+            pairs = np.loadtxt(args.pairs, dtype=np.int64, ndmin=2)
+            if pairs.shape[1] != 2:
+                print(
+                    f"Error: {args.pairs} must have two columns (src dst)",
+                    file=sys.stderr,
+                )
+                return 2
+            results = engine.query_many(pairs)
+            for (src, dst), res in zip(pairs, results):
+                _print_result(src, dst, res, args.no_path)
+        else:
+            # stream stdin: tickets resolve at each engine flush (the
+            # queue fills to max_batch, or EOF drains the remainder)
+            tickets: list = []
+            emitted = 0
+
+            def drain():
+                nonlocal emitted
+                while emitted < len(tickets):
+                    t = tickets[emitted]
+                    if t.result is None:
+                        break
+                    _print_result(t.src, t.dst, t.result, args.no_path)
+                    emitted += 1
+
+            for line in sys.stdin:
+                parts = line.split()
+                if not parts:
+                    continue
+                if len(parts) != 2:
+                    print(f"Error: bad query line {line!r}",
+                          file=sys.stderr)
+                    return 2
+                tickets.append(engine.submit(int(parts[0]), int(parts[1])))
+                drain()
+            engine.flush()
+            drain()
+    except ValueError as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 2
+
+    stats = engine.stats()
+    print(
+        "[Serve] {q} queries: {dq} device-batched ({db} flushes), "
+        "{hq} host, {cs} cache-served; exec programs {ep} "
+        "({eh} reused)".format(
+            q=stats["queries"], dq=stats["device_queries"],
+            db=stats["device_batches"], hq=stats["host_queries"],
+            cs=stats["cache_served"],
+            ep=stats["exec_cache"]["programs"],
+            eh=stats["exec_cache"]["hits"],
+        ),
+        file=sys.stderr,
+    )
+    if args.stats_json:
+        with open(args.stats_json, "w") as f:
+            json.dump(stats, f, indent=1, sort_keys=True)
+            f.write("\n")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,388 @@
+"""Adaptive micro-batching query engine — the serving-shaped hot path.
+
+The measured shape of the problem (PERF_NOTES.md §2-3): a single device
+solve pays a ~67 ms dispatch round trip and ~2 ms per level through the
+tunneled backend, while the batched solvers amortize the same fixed
+costs across every queued query — 26.8 ms/query at batch 256 vs 31.1 ms
+at batch 32, flat by ~256. The reference's serving story is one
+PROCESS per query (benchmark_test.sh:44-59); nothing in this repo until
+now turned the measured batch asymptote into an end-to-end serving
+path. :class:`QueryEngine` is that path:
+
+- **micro-batcher** — ``submit()`` accumulates ``(src, dst)`` queries;
+  ``flush()`` routes the queue through ONE batched device program
+  (``dense._batch_dispatch``, mode resolved by the measured preference
+  order) once it crosses the calibrated batch-vs-latency crossover
+  (``batch_minor.small_batch_threshold``, the round-5 measurement
+  banked in ``calibration.json``), and falls back to per-query
+  native/serial host dispatch below it — small queues are a
+  host-latency problem, not a device problem (PERF_NOTES §3).
+  The routing has a platform dimension, also by measurement: batching
+  exists to amortize the per-dispatch tax, which calibration puts at
+  ~67 ms through the tunneled TPU but ~9 us on the CPU backend — so
+  when the jax substrate IS the host CPU there is nothing to amortize,
+  the device program can never beat the native runtime it shares cores
+  with, and above-crossover flushes route through the scratch-reusing
+  host loop instead (override with ``device_batches=True``; tests do,
+  to exercise the device path on the CPU backend).
+- **shape buckets + executable accounting** — the graph is padded up to
+  the geometric buckets of :mod:`bibfs_tpu.serve.buckets` and every
+  flush is padded to a batch rung, so arbitrary graph sizes and queue
+  depths reuse a handful of compiled programs; hit/miss counters are
+  exposed via :meth:`QueryEngine.stats`.
+- **distance/result cache** — solved parent forests land in the
+  :class:`bibfs_tpu.serve.cache.DistanceCache`; repeated sources (and
+  their undirected reverse twins) answer follow-up queries on the host
+  with ZERO device dispatches.
+
+Every result is a plain :class:`~bibfs_tpu.solvers.api.BFSResult`;
+batch-solved results carry the whole-batch wall clock in ``time_s``
+(the ``solve_batch_graph`` convention), cache hits carry ~0.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from bibfs_tpu.serve.buckets import (
+    DEFAULT_EXEC_CACHE,
+    ExecutableCache,
+    bucket_batch,
+    bucketed_ell,
+)
+from bibfs_tpu.serve.cache import DistanceCache
+from bibfs_tpu.solvers.api import BFSResult
+
+
+class _Pending:
+    """A submitted query's handle; ``result`` lands at flush time."""
+
+    __slots__ = ("src", "dst", "result")
+
+    def __init__(self, src: int, dst: int):
+        self.src = src
+        self.dst = dst
+        self.result: BFSResult | None = None
+
+
+class QueryEngine:
+    """Serve ``(src, dst)`` shortest-path queries over one graph.
+
+    Parameters
+    ----------
+    n, edges : the graph (same contract as ``api.solve``); ``pairs``
+        optionally passes a precomputed ``canonical_pairs`` result.
+    mode : batch mode for device flushes (default ``"auto"``: the
+        measured preference order minor8 > minor > vmapped sync).
+    layout : ``"ell"`` (shape-bucketed; the serving default) or
+        ``"tiered"`` (power-law graphs; exact shapes, no bucketing —
+        tier geometry is per-graph by construction).
+    flush_threshold : queue depth at which a flush goes to the device;
+        below it queries dispatch per-query through the host runtime.
+        Default: the calibrated crossover
+        (``batch_minor.small_batch_threshold``).
+    max_batch : largest single device flush (rounded up to a batch
+        rung); longer queues solve in chunks.
+    cache_entries : distance-cache forest capacity (2 forests bank per
+        solved query; each costs one int32[n] row).
+    host_backend : ``"native"``, ``"serial"`` or None (auto: native
+        when its runtime loads, else serial).
+    device_batches : route above-crossover flushes through the batched
+        device program. None (default) = auto: only when the jax
+        backend is a real accelerator (module docstring — on the CPU
+        substrate there is no dispatch tax to amortize and the host
+        runtime wins every regime).
+    exec_cache : an :class:`ExecutableCache` to share compiled-program
+        accounting across engines (default: the process-wide one).
+    graph_id : distance-cache namespace for this graph (only matters if
+        a :class:`DistanceCache` is ever shared across engines; defaults
+        to a per-engine unique value).
+    """
+
+    def __init__(
+        self,
+        n: int,
+        edges: np.ndarray | None = None,
+        *,
+        pairs: np.ndarray | None = None,
+        mode: str = "auto",
+        layout: str = "ell",
+        flush_threshold: int | None = None,
+        max_batch: int = 1024,
+        cache_entries: int = 64,
+        host_backend: str | None = None,
+        device_batches: bool | None = None,
+        exec_cache: ExecutableCache | None = None,
+        graph_id=None,
+        device=None,
+    ):
+        from bibfs_tpu.graph.csr import canonical_pairs
+        from bibfs_tpu.solvers.batch_minor import small_batch_threshold
+
+        self.n = int(n)
+        if pairs is None:
+            pairs = canonical_pairs(n, edges)
+        self._pairs_host = pairs  # host fallback builders reuse this
+        # the native builder mirrors internally, so hand it the original
+        # undirected list when we have one (pairs are already mirrored)
+        self._edges_host = edges
+        if layout not in ("ell", "tiered"):
+            raise ValueError(
+                f"unknown layout {layout!r} (expected 'ell' or 'tiered')"
+            )
+        # the bucketed device graph is built (and uploaded) lazily on the
+        # first device-routed flush: a host-routed engine — the default
+        # on the CPU substrate — never pays the padded table build
+        self._graph = None
+        self._bucket_key = None
+        self._device = device
+        self.mode = mode
+        self.layout = layout
+        self.flush_threshold = (
+            small_batch_threshold() if flush_threshold is None
+            else int(flush_threshold)
+        )
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        self.max_batch = bucket_batch(max_batch)
+        self.graph_id = id(self) if graph_id is None else graph_id
+        self.dist_cache = DistanceCache(entries=cache_entries)
+        self.exec_cache = (
+            DEFAULT_EXEC_CACHE if exec_cache is None else exec_cache
+        )
+        self._host_backend = host_backend
+        self._device_batches = device_batches
+        self._host_solver = None  # built lazily on first host-routed flush
+        self._pending: list[_Pending] = []
+        self.counters = {
+            "queries": 0,
+            "trivial": 0,  # src == dst, answered inline
+            "cache_served": 0,
+            "device_batches": 0,
+            "device_queries": 0,  # unique queries solved on the device
+            "host_queries": 0,  # unique queries solved host-side
+        }
+
+    @property
+    def graph(self):
+        """The bucketed device-resident graph (built on first use)."""
+        if self._graph is None:
+            from bibfs_tpu.solvers.dense import DeviceGraph
+
+            if self.layout == "ell":
+                ell = bucketed_ell(self.n, pairs=self._pairs_host)
+                self._graph = DeviceGraph.from_ell(ell, device=self._device)
+                self._bucket_key = ("ell", ell.n_pad, ell.width)
+            else:
+                self._graph = DeviceGraph.build(
+                    self.n, layout="tiered", pairs=self._pairs_host,
+                    device=self._device,
+                )
+                self._bucket_key = (
+                    "tiered", self._graph.n_pad, self._graph.width,
+                    self._graph.tier_meta,
+                )
+        return self._graph
+
+    # ---- submission --------------------------------------------------
+    def submit(self, src: int, dst: int) -> _Pending:
+        """Queue one query. Cache hits and trivial queries resolve
+        immediately; everything else resolves at the next flush (an
+        overfull queue flushes itself at ``max_batch``)."""
+        src, dst = int(src), int(dst)
+        if not (0 <= src < self.n and 0 <= dst < self.n):
+            raise ValueError(f"src/dst out of range for n={self.n}")
+        t = _Pending(src, dst)
+        self.counters["queries"] += 1
+        if src == dst:
+            self.counters["trivial"] += 1
+            t.result = BFSResult(True, 0, [src], src, 0.0, 0, 0)
+            return t
+        hit = self.dist_cache.lookup(self.graph_id, src, dst)
+        if hit is not None:
+            found, hops, path = hit
+            self.counters["cache_served"] += 1
+            t.result = BFSResult(
+                found, hops if found else None, path if found else None,
+                None, 0.0, 0, 0,
+            )
+            return t
+        self._pending.append(t)
+        if len(self._pending) >= self.max_batch:
+            self.flush()
+        return t
+
+    def query(self, src: int, dst: int) -> BFSResult:
+        """Submit + flush one query (the low-latency path: a cache hit
+        never touches a solver; a miss dispatches alone, host-side when
+        the crossover says so)."""
+        t = self.submit(src, dst)
+        if t.result is None:
+            self.flush()
+        return t.result
+
+    def query_many(self, pairs) -> list[BFSResult]:
+        """Serve a whole query list through one (chunked) flush."""
+        tickets = [self.submit(int(s), int(d)) for s, d in pairs]
+        self.flush()
+        return [t.result for t in tickets]
+
+    # ---- flushing ----------------------------------------------------
+    def flush(self) -> None:
+        """Resolve every pending query: batched device dispatch at or
+        above the calibrated crossover, per-query host dispatch below."""
+        pend, self._pending = self._pending, []
+        if not pend:
+            return
+        # dedupe exact repeats within one flush: serving traffic repeats,
+        # and a batch slot per duplicate would be pure waste
+        unique: dict[tuple[int, int], list[_Pending]] = {}
+        for t in pend:
+            unique.setdefault((t.src, t.dst), []).append(t)
+        pairs = list(unique)
+        if len(pairs) < self.flush_threshold or not self._use_device():
+            self._flush_host(pairs, unique)
+            return
+        for i in range(0, len(pairs), self.max_batch):
+            chunk = pairs[i: i + self.max_batch]
+            if i and len(chunk) < self.flush_threshold:
+                # a sub-crossover tail after full chunks: host latency
+                # beats padding a whole batch rung for a few stragglers
+                self._flush_host(chunk, unique)
+            else:
+                self._flush_device(chunk, unique)
+
+    def _flush_device(self, pairs, unique) -> None:
+        from bibfs_tpu.solvers.batch_minor import auto_batch_mode
+        from bibfs_tpu.solvers.dense import (
+            _batch_dispatch,
+            _materialize_batch,
+        )
+        from bibfs_tpu.solvers.timing import force_scalar
+
+        graph = self.graph  # lazy build; also sets self._bucket_key
+        rung = min(bucket_batch(len(pairs)), self.max_batch)
+        # pad the flush to its batch rung with inert (0, 0) queries so
+        # every queue depth maps onto a handful of compiled programs
+        padded = np.zeros((rung, 2), dtype=np.int64)
+        padded[: len(pairs)] = pairs
+        mode = self.mode
+        if mode == "auto":
+            mode = auto_batch_mode(graph, rung)
+        self.exec_cache.note((self._bucket_key, mode, rung))
+        _p, dispatch, finish = _batch_dispatch(graph, padded, mode)
+        t0 = time.perf_counter()
+        out = dispatch()
+        force_scalar(out)  # lazy runtimes execute at the value read
+        elapsed = time.perf_counter() - t0
+        outs = finish(out)
+        results = _materialize_batch(outs, len(pairs), elapsed)
+        self.counters["device_batches"] += 1
+        self.counters["device_queries"] += len(pairs)
+        # bank both sides' parent forests: level-synchronous searches
+        # stamp TRUE distances, so each forest answers future queries
+        # about its root (and reverse twins) without any dispatch
+        par_s = np.asarray(outs[2])
+        par_t = np.asarray(outs[3])
+        for i, (src, dst) in enumerate(pairs):
+            self.dist_cache.put_forest(self.graph_id, src, par_s[i], self.n)
+            self.dist_cache.put_forest(self.graph_id, dst, par_t[i], self.n)
+            self._resolve(unique[(src, dst)], src, dst, results[i])
+
+    def _use_device(self) -> bool:
+        """Whether above-crossover flushes go to the device program:
+        auto-routed by substrate (module docstring — the dispatch tax
+        batching amortizes is ~67 ms through the tunneled TPU and ~9 us
+        on the CPU backend, calibration.json), overridable at
+        construction."""
+        if self._device_batches is not None:
+            return self._device_batches
+        import jax
+
+        return jax.default_backend() != "cpu"
+
+    def _flush_host(self, pairs, unique) -> None:
+        solver = self._get_host_solver()
+        for src, dst in pairs:
+            res = solver(src, dst)
+            self.counters["host_queries"] += 1
+            # no parent planes on the host path, but the shortest path
+            # itself is a valid forest fragment for both endpoints — so
+            # repeated-source traffic stays cache-servable on this route
+            if res.found:
+                self.dist_cache.put_path(self.graph_id, res.path, self.n)
+            self._resolve(unique[(src, dst)], src, dst, res)
+
+    def _resolve(self, tickets, src, dst, res: BFSResult) -> None:
+        self.dist_cache.put_result(
+            self.graph_id, src, dst, res.found, res.hops, res.path
+        )
+        for t in tickets:
+            t.result = res
+
+    def _get_host_solver(self):
+        """The sub-crossover per-query path: the native C++ runtime when
+        it loads (the measured latency winner, PERF_NOTES §3), else the
+        NumPy serial oracle."""
+        if self._host_solver is not None:
+            return self._host_solver
+        backend = self._host_backend
+        if backend in (None, "native"):
+            try:
+                from bibfs_tpu.solvers.native import (
+                    NativeGraph,
+                    solve_native_graph,
+                )
+
+                if self._edges_host is not None:
+                    edges = self._edges_host
+                else:
+                    # canonical pairs are already mirrored and the
+                    # native builder mirrors again — feed it each
+                    # undirected edge once (the u < v half)
+                    p = self._pairs_host
+                    edges = p[p[:, 0] < p[:, 1]]
+                ng = NativeGraph.build(self.n, edges)
+                self._host_solver = (
+                    lambda s, d: solve_native_graph(ng, s, d)
+                )
+                self.host_backend_resolved = "native"
+                return self._host_solver
+            except (ImportError, OSError):
+                if backend == "native":
+                    raise
+        from bibfs_tpu.graph.csr import build_csr
+        from bibfs_tpu.solvers.serial import solve_serial_csr
+
+        row_ptr, col_ind = build_csr(self.n, pairs=self._pairs_host)
+        self._host_solver = (
+            lambda s, d: solve_serial_csr(self.n, row_ptr, col_ind, s, d)
+        )
+        self.host_backend_resolved = "serial"
+        return self._host_solver
+
+    # ---- introspection ----------------------------------------------
+    @property
+    def pending(self) -> int:
+        return len(self._pending)
+
+    def stats(self) -> dict:
+        """Machine-readable serving counters (the bench artifact's
+        ``stats`` block)."""
+        c = dict(self.counters)
+        solved = c["device_queries"] + c["host_queries"]
+        return {
+            **c,
+            "solver_dispatch_free": c["queries"] - solved,
+            "dist_cache": self.dist_cache.stats(),
+            "exec_cache": self.exec_cache.stats(),
+            "flush_threshold": self.flush_threshold,
+            "max_batch": self.max_batch,
+            "bucket": (
+                list(self._bucket_key[1:3]) if self._bucket_key else None
+            ),
+            "device_batches_enabled": self._use_device(),
+            "host_backend": getattr(self, "host_backend_resolved", None),
+        }

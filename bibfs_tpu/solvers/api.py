@@ -119,3 +119,20 @@ def solve(
             # the requested backend; the others remain usable
             raise KeyError(f"backend {backend!r} unavailable: {e}") from e
     return SOLVERS[backend](n, edges, src, dst, **kwargs)
+
+
+def solve_many(n: int, edges: np.ndarray, pairs, **engine_kwargs) -> list:
+    """Serve a query list through the adaptive micro-batching engine.
+
+    The multi-query counterpart of :func:`solve`: one call builds a
+    :class:`bibfs_tpu.serve.QueryEngine` (shape-bucketed device graph +
+    distance/result cache), routes the queries through its calibrated
+    batch-vs-latency crossover (batched device program at or above it,
+    per-query host dispatch below), and returns one :class:`BFSResult`
+    per pair. Keep an engine of your own when serving repeat traffic —
+    this convenience rebuilds the caches per call (the compiled
+    executables themselves persist process-wide either way).
+    """
+    from bibfs_tpu.serve import QueryEngine
+
+    return QueryEngine(n, edges, **engine_kwargs).query_many(pairs)

@@ -288,6 +288,10 @@ def _tier_pass(dual_old, planes, tnbr_m, ids, tw: int, cc: int, *,
 def _build_minor_kernel(n: int, n_pad2: int, wp: int, tc: int, b: int,
                         dt8: bool = False, tier_meta: tuple = ()):
     """The jitted whole-batch search for one (graph, batch) geometry.
+    ``n`` is kept for call-site compatibility but never enters the
+    program — the kernel is a pure function of the PADDED geometry, which
+    is what lets the serve layer's shape buckets share one compiled
+    program across real graph sizes.
     Signature ``(nbr, deg, aux, srcs, dsts) -> (best, meet, par_s
     [B, n_pad], par_t, levels, edges)`` — ``aux`` is the tier pytree
     (``((tier_nbr, hub_ids), ...)``, empty for plain ELL), and the
@@ -441,11 +445,22 @@ def _build_minor_kernel(n: int, n_pad2: int, wp: int, tc: int, b: int,
     return kernel
 
 
-@lru_cache(maxsize=None)
 def _get_minor_kernel(n: int, n_pad2: int, wp: int, tc: int, b: int,
                       dt8: bool = False, tier_meta: tuple = ()):
+    """Jitted kernel cache. ``n`` is accepted for call-site compatibility
+    but is NOT part of the cache key: the compiled program reads only the
+    padded geometry (``_build_minor_kernel`` never closes over ``n``), and
+    keying on it would recompile per graph SIZE even when the serve
+    layer's shape buckets (bibfs_tpu/serve/buckets.py) hand several sizes
+    the same padded shape on purpose."""
+    return _get_minor_kernel_shape(n_pad2, wp, tc, b, dt8, tier_meta)
+
+
+@lru_cache(maxsize=None)
+def _get_minor_kernel_shape(n_pad2: int, wp: int, tc: int, b: int,
+                            dt8: bool = False, tier_meta: tuple = ()):
     return jax.jit(
-        _build_minor_kernel(n, n_pad2, wp, tc, b, dt8, tier_meta)
+        _build_minor_kernel(0, n_pad2, wp, tc, b, dt8, tier_meta)
     )
 
 
@@ -462,18 +477,39 @@ def _get_minor_kernel(n: int, n_pad2: int, wp: int, tc: int, b: int,
 SMALL_BATCH_SYNC = 32
 
 
+def small_batch_threshold() -> int:
+    """The routed batch-vs-latency crossover for this platform.
+
+    Mirrors ``dense._auto_push_cap``'s discipline: when
+    ``calibration.json`` carries a measured ``batch_crossover`` for the
+    current platform (the round-5 A/B: the table at
+    :data:`SMALL_BATCH_SYNC`), route on it; a malformed or absent entry
+    falls back to the committed measured default. Shared by
+    ``auto_batch_mode`` and the serving engine's micro-batcher
+    (bibfs_tpu/serve/engine.py), so the two layers cannot disagree about
+    where batching starts to pay."""
+    from bibfs_tpu.utils.calibrate import load_calibration
+
+    cal = load_calibration() or {}
+    crossover = cal.get("batch_crossover")
+    if isinstance(crossover, int) and crossover > 0:
+        return crossover
+    return SMALL_BATCH_SYNC
+
+
 def auto_batch_mode(g, num_pairs: int) -> str:
     """The best eligible batch mode for this (graph, batch) shape, in
     measured-preference order: ``minor8`` (all-int8 planes) when the
     graph is plain-ELL and the geometry fits, else ``minor`` (int32
     planes, tiered supported), else the vmapped ``sync`` path. Batches
-    under :data:`SMALL_BATCH_SYNC` queries stay on the vmapped
+    under :func:`small_batch_threshold` queries stay on the vmapped
     path — the minor layout pads to 128 lanes, and the MEASURED
-    break-even (the A/B table at the constant) is B ~= 32. This
+    break-even (the A/B table at :data:`SMALL_BATCH_SYNC`, routed
+    through the per-platform calibration when present) is B ~= 32. This
     is what ``solve_batch_graph(mode="auto")`` resolves through — the
     explicit mode names remain for measurement work (every A/B in
     PERF_NOTES pins its modes)."""
-    if num_pairs < SMALL_BATCH_SYNC:
+    if num_pairs < small_batch_threshold():
         return "sync"
     for mode, dt8 in (("minor8", True), ("minor", False)):
         try:
@@ -623,7 +659,11 @@ def _refill_capped(g, pairs, out):
         from bibfs_tpu.solvers.dense import _batch_dispatch
 
         _, sub_thunk, _sub_finish = _batch_dispatch(g, sub, "sync")
-    sub_out = sub_thunk()  # int32/sync path: finish is the identity
+    # apply the fallback dispatch's OWN finish hook unconditionally: it is
+    # the identity on today's int32/sync paths, but assuming so here would
+    # silently corrupt the splice the day either path gains a real finish
+    # step (ADVICE r5 #2)
+    sub_out = _sub_finish(sub_thunk())
     outs = [np.array(o) for o in out[:-1]]  # writable copies
     for o, so in zip(outs, sub_out):
         so = np.asarray(so)[: len(sub)]
@@ -638,9 +678,17 @@ def _refill_capped(g, pairs, out):
     return tuple(outs)
 
 
-@lru_cache(maxsize=None)
 def _get_dp_program(mesh, n: int, n_pad2: int, wp: int, tc: int,
                     b_loc: int, dt8: bool, tier_meta: tuple = ()):
+    """Shape-keyed like `_get_minor_kernel`: ``n`` never enters the
+    program, so it is dropped from the cache key."""
+    return _get_dp_program_shape(mesh, n_pad2, wp, tc, b_loc, dt8,
+                                 tier_meta)
+
+
+@lru_cache(maxsize=None)
+def _get_dp_program_shape(mesh, n_pad2: int, wp: int, tc: int,
+                          b_loc: int, dt8: bool, tier_meta: tuple = ()):
     """The jitted shard_map program, cached like `_get_minor_kernel` —
     a fresh jit(shard_map(closure)) per call would retrace the whole
     while_loop program every solve. Mesh objects hash by their device
@@ -649,8 +697,10 @@ def _get_dp_program(mesh, n: int, n_pad2: int, wp: int, tc: int,
     graphs keep their hub edges under the mesh too."""
     from jax.sharding import PartitionSpec as P
 
+    from bibfs_tpu.parallel.mesh import shard_map
+
     (axis,) = mesh.axis_names
-    kern = _build_minor_kernel(n, n_pad2, wp, tc, b_loc, dt8, tier_meta)
+    kern = _build_minor_kernel(0, n_pad2, wp, tc, b_loc, dt8, tier_meta)
     sh, rep = P(axis), P()
     aux_spec = tuple((rep, rep) for _ in tier_meta)
     nouts = 7 if dt8 else 6
@@ -661,7 +711,7 @@ def _get_dp_program(mesh, n: int, n_pad2: int, wp: int, tc: int,
     # placement, and this program contains ZERO collectives — there is
     # nothing for it to protect here.
     return jax.jit(
-        jax.shard_map(
+        shard_map(
             kern, mesh=mesh,
             in_specs=(rep, rep, aux_spec, sh, sh),
             out_specs=(sh,) * nouts,

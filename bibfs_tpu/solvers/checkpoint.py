@@ -49,6 +49,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
+from bibfs_tpu.parallel.mesh import pcast as _pcast
+from bibfs_tpu.parallel.mesh import shard_map as _shard_map
 from bibfs_tpu.solvers.api import BFSResult
 from bibfs_tpu.solvers.dense import (
     DENSE_MODES,
@@ -90,7 +92,7 @@ def _with_transients(st: dict, k: int, *, axis: str | None = None) -> dict:
             # same vma pinning as the sharded seed: fi's provenance
             # alternates between constants and all_gather products across
             # cond branches, so pin it to device-varying
-            fi = jax.lax.pcast(fi, axis, to="varying")
+            fi = _pcast(fi, axis, to="varying")
         st[f"fi_{side}"] = fi
         st[f"ok_{side}"] = jnp.bool_(False)
     return st
@@ -176,7 +178,7 @@ def _sharded_chunk_kernel(
     from bibfs_tpu.solvers.sharded import _check_vma_for
 
     return jax.jit(
-        jax.shard_map(
+        _shard_map(
             fn,
             mesh=mesh,
             in_specs=(sh, sh, aux_spec, st_spec),
@@ -241,7 +243,7 @@ def _sharded2d_chunk_kernel(
         return out
 
     return jax.jit(
-        jax.shard_map(
+        _shard_map(
             fn,
             mesh=mesh,
             in_specs=(blk4, blk3, own, aux_spec, dict(st_spec)),
@@ -424,7 +426,16 @@ def _deg_at(g, v: int) -> int:
     if hasattr(g, "mesh"):
         from bibfs_tpu.parallel.mesh import replicated_spec
 
-        return int(g.deg.at[jnp.int32(v)].get(out_sharding=replicated_spec(g.mesh)))
+        try:
+            return int(
+                g.deg.at[jnp.int32(v)].get(
+                    out_sharding=replicated_spec(g.mesh)
+                )
+            )
+        except TypeError:
+            # older jax: .at[].get has no out_sharding — pull the (one)
+            # sharded vector to host for the scalar seed read instead
+            return int(np.asarray(g.deg)[v])
     return int(jax.device_get(g.deg[v]))
 
 

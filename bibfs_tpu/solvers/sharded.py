@@ -54,7 +54,14 @@ from bibfs_tpu.parallel.collectives import (
     max_allreduce,
     sum_allreduce,
 )
-from bibfs_tpu.parallel.mesh import VERTEX_AXIS, make_1d_mesh, shard_spec
+from bibfs_tpu.parallel.mesh import (
+    VERTEX_AXIS,
+    axis_size as _axis_size,
+    make_1d_mesh,
+    pcast as _pcast,
+    shard_map,
+    shard_spec,
+)
 from bibfs_tpu.solvers.api import BFSResult, register
 from bibfs_tpu.solvers.dense import (
     INF32,
@@ -108,7 +115,7 @@ def _make_shard_body(
             prepare_pallas_tables,
         )
 
-        n_glob = n_loc * jax.lax.axis_size(axis)
+        n_glob = n_loc * _axis_size(axis)
         if pallas_fits(n_loc, n_glob, width=width):
             ptables = prepare_pallas_tables(nbr, deg, id_space=n_glob)
         else:  # chunk loop too long: degrade to the XLA pull path
@@ -449,7 +456,7 @@ def _bibfs_shard_body(
             # provenance alternates between constants (seed), all_gather
             # products (push), and carries (pull) — pin the vma to varying
             # so every cond branch agrees (same reason as par below)
-            fi=jax.lax.pcast(
+            fi=_pcast(
                 jnp.full(k, -1, jnp.int32).at[0].set(v.astype(jnp.int32)),
                 axis,
                 to="varying",
@@ -459,7 +466,7 @@ def _bibfs_shard_body(
             md=sum_allreduce(jnp.sum(jnp.where(fr, deg, 0)), axis),
             # parents start as constants; mark them device-varying so both
             # lax.cond branches (only one of which writes each side) agree
-            par=jax.lax.pcast(jnp.full(n_loc, -1, jnp.int32), axis, to="varying"),
+            par=_pcast(jnp.full(n_loc, -1, jnp.int32), axis, to="varying"),
             dist=jnp.where(fr, 0, INF32).astype(jnp.int32),
             lvl=jnp.int32(0),
         )
@@ -526,7 +533,7 @@ def _sharded_fused_prog(axis: str, unroll: int = 1):
     def prog(nbr, deg, aux, src, dst):
         del aux  # plain ELL only; the router guarantees it
         n_loc = nbr.shape[0]
-        ndev = jax.lax.axis_size(axis)
+        ndev = _axis_size(axis)
         me = jax.lax.axis_index(axis)
         offset = (me * n_loc).astype(jnp.int32)
         n_glob = n_loc * ndev
@@ -543,7 +550,7 @@ def _sharded_fused_prog(axis: str, unroll: int = 1):
                 dist=jnp.where(
                     jnp.pad(fr, (0, n_rows_p - n_loc)), 0, INF32
                 ).astype(jnp.int32).reshape(1, n_rows_p),
-                par=jax.lax.pcast(
+                par=_pcast(
                     jnp.full((1, n_rows_p), -1, jnp.int32), axis,
                     to="varying",
                 ),
@@ -643,14 +650,14 @@ def _sharded_fn(
     aux_spec = (sh, tuple((sh, sh, rep) for _ in tier_meta)) if tier_meta else ()
     if mode == "fused":
         # router (_compiled_sharded) only sends qualified geometries here
-        return jax.shard_map(
+        return shard_map(
             _sharded_fused_prog(axis, unroll),
             mesh=mesh,
             in_specs=(sh, sh, aux_spec, rep, rep),
             out_specs=(rep, rep, sh, sh, rep, rep),
             check_vma=_check_vma_for(mode, geom),
         )
-    return jax.shard_map(
+    return shard_map(
         lambda nbr, deg, aux, src, dst: _bibfs_shard_body(
             nbr,
             deg,

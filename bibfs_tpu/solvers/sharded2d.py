@@ -72,7 +72,13 @@ from bibfs_tpu.parallel.collectives import (
     sum_allreduce,
     unpack_bits,
 )
-from bibfs_tpu.parallel.mesh import COL_AXIS, ROW_AXIS, make_2d_mesh
+from bibfs_tpu.parallel.mesh import (
+    COL_AXIS,
+    ROW_AXIS,
+    make_2d_mesh,
+    pcast as _pcast,
+    shard_map,
+)
 from bibfs_tpu.solvers.api import BFSResult, register
 from bibfs_tpu.solvers.dense import INF32, _device_scalar, _materialize
 
@@ -296,7 +302,7 @@ def _bibfs_2d_body(
         return dict(
             fr=fr,
             cnt=jnp.int32(1),
-            par=jax.lax.pcast(
+            par=_pcast(
                 jnp.full(n_loc, -1, jnp.int32), axes, to="varying"
             ),
             dist=jnp.where(fr, 0, INF32).astype(jnp.int32),
@@ -342,7 +348,7 @@ def _2d_fn(mesh, R: int, C: int, mode: str, tier_meta: tuple = ()):
             bnbr[0, 0], bcnt[0, 0], deg, src, dst, tiers, R=R, C=C, mode=mode
         )
 
-    return jax.shard_map(
+    return shard_map(
         fn,
         mesh=mesh,
         in_specs=(blk4, blk3, own, aux_spec, rep, rep),

@@ -26,6 +26,21 @@ What is measured (all medians over repeats, jit-compiled, blocked):
   than the pull level — the Beamer crossover. ``push_cap_divisor`` =
   n_pad // push_cap generalizes it to other graph sizes.
 
+Two further fields are BANKED measurements rather than ones this module
+re-runs (the round-5 batch A/B sweep, solvers/batch_minor.py table +
+PERF_NOTES.md §3, is a multi-minute device campaign):
+
+- ``batch_crossover``: the measured batch size at which the batched
+  device path starts beating per-query dispatch (round-5 A/B: B ~= 32).
+  ``batch_minor.small_batch_threshold`` and the serving engine's
+  micro-batcher (bibfs_tpu/serve/engine.py) route on it.
+- ``batch_flat``: the batch size by which per-query cost has flattened
+  to its asymptote (round-5 sweep: ~256) — the serve bench's default
+  queue depth.
+
+:func:`write_calibration` MERGES the fresh entry over any existing one,
+so re-calibrating never drops these banked fields.
+
 Two methodology rules, both consequences of measured runtime behavior
 (full account in bibfs_tpu/solvers/timing.py):
 
@@ -184,7 +199,12 @@ def write_calibration(path: str | None = None, **kwargs) -> dict:
     if os.path.exists(path):
         with open(path) as f:
             data = json.load(f)
-    data[result["platform"]] = result["entry"]
+    # merge over the existing platform entry: banked fields produced
+    # elsewhere (batch_crossover/batch_flat — the round-5 device sweep)
+    # must survive a re-run of the local measurements
+    data[result["platform"]] = {
+        **data.get(result["platform"], {}), **result["entry"]
+    }
     with open(path, "w") as f:
         json.dump(data, f, indent=2, sort_keys=True)
         f.write("\n")

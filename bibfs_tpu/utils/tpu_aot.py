@@ -18,6 +18,7 @@ seconds-fast question instead of a tunnel lottery.
 
 from __future__ import annotations
 
+import os
 from functools import lru_cache
 
 import jax
@@ -27,12 +28,33 @@ import jax
 def tpu_topology(name: str = "v5e:2x2"):
     """The abstract TPU topology, or None when libtpu / the topology API
     is unavailable (then AOT checks are skipped, not failed)."""
+    # deviceless compile needs no cloud metadata, but libtpu init probes
+    # the GCP metadata server for worker identity with 30 retries per
+    # variable — measured ~460 s of pure stall on the first topology
+    # touch off-GCP. Give the probe inert identity defaults ONLY for the
+    # duration of the topology construction, then restore the
+    # environment: leaking them (os.environ is inherited by every
+    # subprocess, e.g. the chip-session legs) would force a wrong
+    # accelerator type / worker identity onto a real multi-host TPU
+    # init. Explicit pre-set values always win (setdefault semantics).
+    inert = {
+        "TPU_SKIP_MDS_QUERY": "1",
+        "TPU_ACCELERATOR_TYPE": "v5litepod-4",
+        "TPU_WORKER_ID": "0",
+        "TPU_WORKER_HOSTNAMES": "localhost",
+    }
+    added = [k for k in inert if k not in os.environ]
+    for k in added:
+        os.environ[k] = inert[k]
     try:
         from jax.experimental import topologies
 
         return topologies.get_topology_desc(platform="tpu", topology_name=name)
     except Exception:
         return None
+    finally:
+        for k in added:
+            os.environ.pop(k, None)
 
 
 def aot_available() -> bool:

@@ -315,7 +315,12 @@ def run_batch_rmat(scale: int = 18, ef: int = 8, seed: int = 1,
     one non-cpu ``minor/*`` row; the partial file is removed once every
     device leg has landed."""
     t0 = time.time()
-    cache = "/tmp/bibfs_rmat%d_ef%d_s%d.npz" % (scale, ef, seed)
+    # the sizes tuple is part of the cache identity: the prep writes one
+    # 'p<b>' pairs array per size, so a cache built for a different size
+    # set would fail every device leg with KeyError 'p<b>' until the
+    # stale npz is hand-deleted (ADVICE r5 #1)
+    cache = "/tmp/bibfs_rmat%d_ef%d_s%d_b%s.npz" % (
+        scale, ef, seed, "x".join(str(int(b)) for b in sizes))
     rows = dict(_load_rmat_partial(partial_path).get("rows", {}))
     if not os.path.exists(cache):
         prep = run_result_subprocess(
@@ -344,8 +349,10 @@ def run_batch_rmat(scale: int = 18, ef: int = 8, seed: int = 1,
         for k, v in leg.get("rows", {}).items():
             rows[k] = v
         if "error" in leg:  # the control must not cost the device legs
-            rows["native/%d" % sizes[0]] = dict(
-                error=str(leg["error"])[:200])
+            # dedicated key: writing the error into rows['native/<b>']
+            # could overwrite a previously banked good row when the leg
+            # partially resumed (ADVICE r5 #3)
+            rows["native_error"] = dict(error=str(leg["error"])[:200])
         _save_rmat_partial(partial_path, {"rows": rows})
     for key in dev_keys:
         if dev_done(key):

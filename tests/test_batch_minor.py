@@ -218,6 +218,51 @@ def test_refill_capped_geometry_fallback(monkeypatch):
         assert int(best[0]) == ref.hops
 
 
+def test_refill_capped_applies_finish_hook(monkeypatch):
+    """_refill_capped must run the fallback dispatch's OWN finish hook
+    (ADVICE r5 #2): identity on today's int32/sync paths, but assuming
+    identity silently corrupts the splice the day either path gains a
+    real finish step. Forced here with a non-identity hook that encodes
+    the outputs; the refill only stays correct if the hook's decode
+    actually runs."""
+    from bibfs_tpu.solvers import batch_minor as bm
+
+    n, edges, g = _ell_graph(1)
+    pairs = np.array([[0, n - 1], [1, 2]])
+    real_dispatch = bm.batch_dispatch
+    ran = {}
+
+    def hooked(g_, pairs_, dt8=False):
+        p, thunk, fin = real_dispatch(g_, pairs_, dt8)
+        if dt8:
+            return p, thunk, fin
+        # non-identity finish pair: the thunk's raw output is offset and
+        # only the matching finish hook undoes it
+        enc_thunk = lambda: tuple(  # noqa: E731
+            np.asarray(o) + 5 for o in thunk()
+        )
+
+        def dec_finish(out):
+            ran["finish"] = True
+            return tuple(np.asarray(o) - 5 for o in fin(out))
+
+        return p, enc_thunk, dec_finish
+
+    monkeypatch.setattr(bm, "batch_dispatch", hooked)
+    _, thunk, finish = real_dispatch(g, pairs, dt8=True)
+    out = list(thunk())
+    # force the 'capped' flag so the refill path really runs
+    capped = np.zeros(np.asarray(out[-1]).shape, bool)
+    capped[0] = True
+    res = finish(tuple(out[:-1]) + (capped,))
+    assert ran.get("finish"), "fallback finish hook was not invoked"
+    best = np.asarray(res[0])
+    ref = solve_serial(n, edges, 0, n - 1)
+    assert (best[0] < 2**30) == ref.found
+    if ref.found:
+        assert int(best[0]) == ref.hops
+
+
 @pytest.mark.parametrize("mode", ["minor", "minor8"])
 def test_minor_tiny_graphs(mode):
     """Degenerate shapes: n as small as 2, batch padding far exceeding

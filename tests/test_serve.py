@@ -1,0 +1,383 @@
+"""The serving layer (bibfs_tpu/serve): adaptive micro-batcher routing,
+shape-bucketed executable reuse, and the distance/result cache.
+
+Correctness bar is the usual cross-implementation one (every served
+answer vs the serial oracle, paths CSR-validated), plus the serving
+claims the acceptance gates name: repeated-source traffic after warmup
+is answered with ZERO additional solver dispatches (engine counters
+asserted), and two different graph sizes inside one shape bucket share
+a single compiled batch program (jit cache-hit counters asserted)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from bibfs_tpu.serve import (
+    DistanceCache,
+    ExecutableCache,
+    QueryEngine,
+    bucket_batch,
+    bucket_rows,
+    bucket_width,
+    bucketed_ell,
+)
+from bibfs_tpu.solvers.serial import solve_serial
+
+
+def _skiplink_graph(n: int) -> np.ndarray:
+    """Deterministic shallow graph with max degree 4 (chain + skip
+    links): diameter ~n/7, so no query ever nears the int8 depth cap,
+    and every size buckets to ELL width 8."""
+    edges = [[i, i + 1] for i in range(n - 1)]
+    edges += [[i, i + 7] for i in range(n - 7)]
+    return np.array(edges)
+
+
+def _rand_pairs(rng, n: int, k: int) -> np.ndarray:
+    """k random pairs with src != dst guaranteed (src == dst queries
+    resolve as 'trivial' and would skew dispatch-counter assertions)."""
+    src = rng.integers(0, n, size=k)
+    dst = (src + rng.integers(1, n, size=k)) % n
+    return np.stack([src, dst], axis=1)
+
+
+def _check_oracle(n, edges, pairs, results):
+    for (src, dst), r in zip(pairs, results):
+        ref = solve_serial(n, edges, int(src), int(dst))
+        assert r.found == ref.found, (src, dst)
+        if ref.found:
+            assert r.hops == ref.hops, (src, dst)
+            if r.path is not None:
+                r.validate_path(n, edges, int(src), int(dst))
+
+
+# ---- buckets ---------------------------------------------------------
+def test_bucket_ladders():
+    assert bucket_rows(1) == 128
+    assert bucket_rows(128) == 128
+    assert bucket_rows(129) == 256
+    assert bucket_rows(100_008) == 131072
+    assert bucket_width(1) == 8
+    assert bucket_width(8) == 8
+    assert bucket_width(13) == 16
+    assert bucket_batch(1) == 128
+    assert bucket_batch(300) == 512
+
+
+def test_bucketed_ell_semantics():
+    """Bucket padding must be inert: pad rows isolated, pad columns
+    beyond every true degree, true n preserved."""
+    n = 300
+    edges = _skiplink_graph(n)
+    g = bucketed_ell(n, edges)
+    assert g.n == n
+    assert g.n_pad == 512 and g.width == 8
+    assert g.nbr.shape == (512, 8)
+    assert (g.deg[n:] == 0).all()
+    assert int(g.deg.sum()) == 2 * len(np.unique(edges, axis=0))
+
+
+def test_executable_cache_counters():
+    c = ExecutableCache()
+    assert c.note(("a", 1)) is False
+    assert c.note(("a", 1)) is True
+    assert c.note(("b", 2)) is False
+    assert c.stats() == {"hits": 1, "misses": 2, "programs": 2}
+
+
+# ---- distance cache --------------------------------------------------
+def test_distance_cache_forest_and_memo():
+    cache = DistanceCache(entries=2)
+    # path 0-1-2-3 as a parent forest rooted at 0
+    par = np.array([-1, 0, 1, 2], dtype=np.int32)
+    cache.put_forest("g", 0, par, 4)
+    assert cache.lookup("g", 0, 3) == (True, 3, [0, 1, 2, 3])
+    # reverse twin through the same forest
+    assert cache.lookup("g", 3, 0) == (True, 3, [3, 2, 1, 0])
+    # outside the forest -> miss, never an answer
+    assert cache.lookup("g", 0, 99) is None
+    assert cache.lookup("g", 5, 3) is None
+    # pair memo holds negative results (a forest never can)
+    cache.put_result("g", 7, 9, False, None, None)
+    assert cache.lookup("g", 9, 7) == (False, None, None)
+    st = cache.stats()
+    assert st["forest_hits"] == 2 and st["pair_hits"] == 1
+    # LRU bound on forests
+    cache.put_forest("g", 1, par, 4)
+    cache.put_forest("g", 2, par, 4)
+    assert cache.stats()["forests"] == 2
+    assert cache.stats()["evictions"] == 1
+
+
+# ---- engine: correctness through each route --------------------------
+def test_engine_device_batch_matches_oracle():
+    n = 220
+    edges = _skiplink_graph(n)
+    eng = QueryEngine(n, edges, flush_threshold=8, device_batches=True,
+                      exec_cache=ExecutableCache())
+    rng = np.random.default_rng(0)
+    pairs = _rand_pairs(rng, n, 40)
+    pairs[3] = (9, 9)  # trivial
+    results = eng.query_many(pairs)
+    _check_oracle(n, edges, pairs, results)
+    assert eng.counters["device_batches"] == 1
+    assert eng.counters["host_queries"] == 0
+    assert eng.counters["trivial"] == 1
+
+
+def test_engine_host_fallback_below_crossover():
+    n = 120
+    edges = _skiplink_graph(n)
+    eng = QueryEngine(n, edges, flush_threshold=10, device_batches=True)
+    pairs = [(0, n - 1), (3, 40), (5, 5)]
+    results = eng.query_many(pairs)
+    _check_oracle(n, edges, pairs, results)
+    assert eng.counters["device_batches"] == 0
+    assert eng.counters["host_queries"] == 2  # trivial query never dispatches
+    assert eng.stats()["host_backend"] in ("native", "serial")
+
+
+def test_engine_cpu_substrate_routes_host():
+    """On the CPU backend the auto router must send even above-crossover
+    flushes to the host runtime (there is no dispatch tax to amortize —
+    the premise of the platform routing)."""
+    n = 150
+    edges = _skiplink_graph(n)
+    eng = QueryEngine(n, edges, flush_threshold=4)
+    rng = np.random.default_rng(1)
+    pairs = rng.integers(0, n, size=(12, 2))
+    results = eng.query_many(pairs)
+    _check_oracle(n, edges, pairs, results)
+    assert not eng.stats()["device_batches_enabled"]
+    assert eng.counters["device_batches"] == 0
+    assert eng.counters["host_queries"] > 0
+    # host-solved paths bank as forest fragments: a NEW destination on a
+    # served path answers from the cache with zero further dispatches
+    src, res = next(
+        ((int(s), r) for (s, _d), r in zip(pairs, results)
+         if r.found and r.hops and r.hops >= 2)
+    )
+    before = eng.counters["host_queries"]
+    r2 = eng.query(src, res.path[1])
+    assert r2.found and r2.hops == 1
+    assert eng.counters["host_queries"] == before
+
+
+def test_engine_disconnected_and_memo():
+    edges = np.array([[0, 1], [1, 2], [3, 4]])
+    eng = QueryEngine(5, edges, flush_threshold=1, device_batches=True)
+    r = eng.query(0, 4)
+    assert not r.found
+    before = (eng.counters["device_batches"], eng.counters["host_queries"])
+    r2 = eng.query(4, 0)  # negative repeat (reverse) from the pair memo
+    assert not r2.found
+    assert (eng.counters["device_batches"],
+            eng.counters["host_queries"]) == before
+
+
+# ---- the acceptance gates --------------------------------------------
+def test_repeated_sources_zero_dispatch_after_warmup():
+    """Warmed repeat traffic — exact repeats, reverse twins, and new
+    destinations inside a cached source forest — must be answered from
+    the distance cache with zero additional solver dispatches."""
+    n = 260
+    edges = _skiplink_graph(n)
+    eng = QueryEngine(n, edges, flush_threshold=8, device_batches=True,
+                      exec_cache=ExecutableCache())
+    rng = np.random.default_rng(2)
+    pairs = _rand_pairs(rng, n, 33)
+    pairs[0] = (0, n - 1)
+    warm = eng.query_many(pairs)
+    _check_oracle(n, edges, pairs, warm)
+    dispatches = (eng.counters["device_batches"],
+                  eng.counters["host_queries"])
+    served_before = eng.counters["cache_served"]
+
+    # exact repeats and reverse twins
+    again = eng.query_many(np.concatenate([pairs, pairs[:, ::-1]]))
+    for a, b in zip(again[: len(pairs)], warm):
+        assert a.found == b.found and a.hops == b.hops
+    # a NEW destination lying on a cached source's forest (its own path)
+    path = warm[0].path
+    r = eng.query(0, path[1])
+    assert r.found and r.hops == 1
+    assert (eng.counters["device_batches"],
+            eng.counters["host_queries"]) == dispatches
+    assert eng.counters["cache_served"] >= served_before + 2 * len(pairs)
+    assert eng.dist_cache.stats()["hits"] > 0
+
+
+def test_shape_bucket_single_compilation():
+    """Two different graph sizes in one shape bucket must share ONE
+    compiled batch program: the engines' executable-cache counters say
+    hit, and the solver-side jit kernel cache gains no new entry for
+    the second graph."""
+    from bibfs_tpu.solvers import batch_minor as bm
+
+    n1, n2 = 300, 450  # both bucket to 512 rows x width 8
+    shared = ExecutableCache()
+    rng = np.random.default_rng(3)
+
+    eng1 = QueryEngine(n1, _skiplink_graph(n1), flush_threshold=8,
+                       device_batches=True, exec_cache=shared)
+    eng2 = QueryEngine(n2, _skiplink_graph(n2), flush_threshold=8,
+                       device_batches=True, exec_cache=shared)
+    assert eng1.graph.n_pad == eng2.graph.n_pad == 512
+    assert eng1.graph.width == eng2.graph.width == 8
+
+    p1 = rng.integers(0, n1, size=(40, 2))
+    r1 = eng1.query_many(p1)
+    _check_oracle(n1, _skiplink_graph(n1), p1, r1)
+    info_after_first = bm._get_minor_kernel_shape.cache_info()
+    assert shared.stats() == {"hits": 0, "misses": 1, "programs": 1}
+
+    p2 = rng.integers(0, n2, size=(40, 2))
+    r2 = eng2.query_many(p2)
+    _check_oracle(n2, _skiplink_graph(n2), p2, r2)
+    info_after_second = bm._get_minor_kernel_shape.cache_info()
+    # the second size re-used the first one's compiled program: the
+    # exec accounting says hit AND the jit kernel cache gained nothing
+    assert shared.stats() == {"hits": 1, "misses": 1, "programs": 1}
+    assert info_after_second.misses == info_after_first.misses
+    assert info_after_second.hits > info_after_first.hits
+
+
+# ---- routing knobs ---------------------------------------------------
+def test_flush_threshold_from_calibration(tmp_path, monkeypatch):
+    """The micro-batcher's default crossover is the calibrated
+    measurement (mirroring _auto_push_cap): a platform entry with
+    batch_crossover routes the engine; absence falls back to the
+    committed measured default."""
+    from bibfs_tpu.solvers.batch_minor import (
+        SMALL_BATCH_SYNC,
+        small_batch_threshold,
+    )
+    from bibfs_tpu.utils import calibrate
+
+    cal = tmp_path / "calibration.json"
+    cal.write_text(json.dumps({"cpu": {"batch_crossover": 7}}))
+    monkeypatch.setenv(calibrate.CAL_ENV, str(cal))
+    calibrate._read_calibration_file.cache_clear()
+    try:
+        assert small_batch_threshold() == 7
+        eng = QueryEngine(40, np.array([[0, 1], [1, 2]]))
+        assert eng.flush_threshold == 7
+        # malformed entry -> the committed default, not a crash
+        cal.write_text(json.dumps({"cpu": {"batch_crossover": "x"}}))
+        calibrate._read_calibration_file.cache_clear()
+        assert small_batch_threshold() == SMALL_BATCH_SYNC
+    finally:
+        calibrate._read_calibration_file.cache_clear()
+    monkeypatch.delenv(calibrate.CAL_ENV)
+    calibrate._read_calibration_file.cache_clear()
+    assert small_batch_threshold() == SMALL_BATCH_SYNC
+
+
+def test_max_batch_chunking_and_autoflush():
+    """A queue past max_batch flushes itself and solves in rung-sized
+    chunks; a sub-crossover tail goes to the host path."""
+    n = 200
+    edges = _skiplink_graph(n)
+    eng = QueryEngine(n, edges, flush_threshold=8, max_batch=128,
+                      device_batches=True, exec_cache=ExecutableCache())
+    rng = np.random.default_rng(4)
+    # 131 unique non-trivial pairs: one full 128-rung device chunk plus
+    # a 3-query sub-crossover tail
+    pairs = np.unique(_rand_pairs(rng, n, 400), axis=0)[:131]
+    assert len(pairs) == 131
+    results = eng.query_many(pairs)
+    _check_oracle(n, edges, pairs, results)
+    assert eng.counters["device_batches"] >= 1
+    assert eng.counters["device_queries"] >= 128
+    assert eng.counters["host_queries"] <= 3
+
+
+def test_engine_modes_and_solve_many():
+    n = 180
+    edges = _skiplink_graph(n)
+    rng = np.random.default_rng(5)
+    pairs = rng.integers(0, n, size=(34, 2))
+    for mode in ("sync", "minor", "minor8"):
+        eng = QueryEngine(n, edges, mode=mode, flush_threshold=8,
+                          device_batches=True)
+        _check_oracle(n, edges, pairs, eng.query_many(pairs))
+
+    from bibfs_tpu.solvers.api import solve_many
+
+    res = solve_many(n, edges, pairs[:6], flush_threshold=4,
+                     device_batches=True)
+    _check_oracle(n, edges, pairs[:6], res)
+
+
+def test_engine_tiered_layout():
+    """Power-law graphs serve through the tiered layout (exact shapes,
+    no bucketing) with the same oracle bar."""
+    from bibfs_tpu.graph.generate import rmat_graph
+
+    n, edges = rmat_graph(7, edge_factor=6, seed=1)
+    eng = QueryEngine(n, edges, layout="tiered", flush_threshold=8,
+                      device_batches=True, exec_cache=ExecutableCache())
+    rng = np.random.default_rng(6)
+    pairs = rng.integers(0, n, size=(33, 2))
+    results = eng.query_many(pairs)
+    _check_oracle(n, edges, pairs, results)
+    assert eng.counters["device_batches"] == 1
+    assert eng.graph.tier_meta  # the case really exercised hub tiers
+
+
+def test_engine_range_checks():
+    eng = QueryEngine(10, np.array([[0, 1]]))
+    with pytest.raises(ValueError):
+        eng.query(0, 10)
+    with pytest.raises(ValueError, match="layout"):
+        QueryEngine(10, np.array([[0, 1]]), layout="bogus")
+
+
+# ---- CLI -------------------------------------------------------------
+def test_serve_cli_pairs_and_stats(tmp_path, capsys):
+    from bibfs_tpu.graph.io import write_graph_bin
+    from bibfs_tpu.serve.cli import main as serve_main
+
+    n = 160
+    edges = _skiplink_graph(n)
+    gpath = tmp_path / "g.bin"
+    write_graph_bin(gpath, n, edges)
+    ppath = tmp_path / "pairs.txt"
+    rng = np.random.default_rng(7)
+    pairs = rng.integers(0, n, size=(36, 2))
+    np.savetxt(ppath, pairs, fmt="%d")
+    spath = tmp_path / "stats.json"
+    rc = serve_main([str(gpath), "--pairs", str(ppath), "--no-path",
+                     "--threshold", "8", "--stats-json", str(spath)])
+    assert rc == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    assert len(out) == len(pairs)
+    for (src, dst), line in zip(pairs, out):
+        ref = solve_serial(n, edges, int(src), int(dst))
+        want = (f"{src} -> {dst}: length = {ref.hops}" if ref.found
+                else f"{src} -> {dst}: no path")
+        assert line == want
+    stats = json.loads(spath.read_text())
+    assert stats["queries"] == len(pairs)
+    assert os.path.exists(spath)
+
+
+def test_serve_cli_stdin_stream(tmp_path, capsys, monkeypatch):
+    import io
+
+    from bibfs_tpu.graph.io import write_graph_bin
+    from bibfs_tpu.serve.cli import main as serve_main
+
+    n = 60
+    edges = _skiplink_graph(n)
+    gpath = tmp_path / "g.bin"
+    write_graph_bin(gpath, n, edges)
+    monkeypatch.setattr("sys.stdin", io.StringIO("0 59\n5 5\n"))
+    rc = serve_main([str(gpath), "--no-path"])
+    assert rc == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    ref = solve_serial(n, edges, 0, 59)
+    assert out[0] == f"0 -> 59: length = {ref.hops}"
+    assert out[1] == "5 -> 5: length = 0"

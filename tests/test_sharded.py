@@ -212,7 +212,7 @@ def test_all_gather_bits_matches_bool_gather(n_loc):
     from functools import partial
 
     from bibfs_tpu.parallel.collectives import all_gather_bits
-    from bibfs_tpu.parallel.mesh import VERTEX_AXIS, make_1d_mesh
+    from bibfs_tpu.parallel.mesh import VERTEX_AXIS, make_1d_mesh, shard_map
     from jax.sharding import PartitionSpec as P
 
     mesh = make_1d_mesh(8)
@@ -220,7 +220,7 @@ def test_all_gather_bits_matches_bool_gather(n_loc):
     fr = rng.random(8 * n_loc) < 0.4
 
     @partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         in_specs=P(VERTEX_AXIS),
         out_specs=(P(), P()),
@@ -244,7 +244,7 @@ def test_all_gather_bits_dual_matches_pack_dual(n_loc):
 
     from bibfs_tpu.ops.expand import pack_dual
     from bibfs_tpu.parallel.collectives import all_gather_bits_dual
-    from bibfs_tpu.parallel.mesh import VERTEX_AXIS, make_1d_mesh
+    from bibfs_tpu.parallel.mesh import VERTEX_AXIS, make_1d_mesh, shard_map
     from jax.sharding import PartitionSpec as P
 
     mesh = make_1d_mesh(8)
@@ -253,7 +253,7 @@ def test_all_gather_bits_dual_matches_pack_dual(n_loc):
     fr_t = rng.random(8 * n_loc) < 0.3
 
     @partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         in_specs=(P(VERTEX_AXIS), P(VERTEX_AXIS)),
         out_specs=(P(), P()),
