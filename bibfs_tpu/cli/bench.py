@@ -133,19 +133,24 @@ def _batch_row(
     )
 
 
-def _serve_row(label, n, edges, pairs, wants, repeats):
+def _serve_row(label, n, edges, pairs, wants, repeats, pipelined=False):
     """One serving-engine throughput row: all pairs served through a
-    fresh :class:`bibfs_tpu.serve.QueryEngine` per repeat (so every
+    fresh :class:`bibfs_tpu.serve.QueryEngine` (or, with ``pipelined``,
+    a :class:`bibfs_tpu.serve.PipelinedQueryEngine` — background
+    deadline flusher, dispatch/finish overlap) per repeat (so every
     repeat's distance cache starts cold and the row measures solving,
     not memoization; compiled executables persist process-wide, and the
     first, discarded run carries compile/warm-up as usual). time_sec is
     the per-query amortized wall-clock of the median repeat."""
-    from bibfs_tpu.serve import QueryEngine
+    from bibfs_tpu.serve import PipelinedQueryEngine, QueryEngine
 
     times = []
     results = stats = None
     for _ in range(max(repeats, 1) + 1):
-        eng = QueryEngine(n, edges)
+        eng = (
+            PipelinedQueryEngine(n, edges) if pipelined
+            else QueryEngine(n, edges)
+        )
         if not eng._use_device():
             # host route: the solver build (native CSR / oracle CSR) is
             # per-engine setup, not serving — keep it outside the timed
@@ -155,6 +160,7 @@ def _serve_row(label, n, edges, pairs, wants, repeats):
         results = eng.query_many(pairs)
         times.append(time.time() - t0)
         stats = eng.stats()
+        eng.close()
     times = times[1:]  # warm-up run (device compile) excluded
     batch_s = float(np.median(times))
     ok = True
@@ -168,14 +174,15 @@ def _serve_row(label, n, edges, pairs, wants, repeats):
     route = "device" if stats["device_batches_enabled"] else (
         stats["host_backend"] or "host"
     )
+    name = "serve-pipe" if pipelined else "serve"
     return dict(
-        version=f"serve-batch{len(results)}",
+        version=f"{name}-batch{len(results)}",
         graph=label,
         time_sec=per_query,
         teps=edges_scanned / batch_s if batch_s > 0 else 0.0,
         hops=hops_total,
         ok=ok,
-        config=f"serve/{route}",
+        config=f"{name}/{route}",
     )
 
 
@@ -300,28 +307,32 @@ def run_bench(
                 )
         if pairs_file is not None and serve:
             # amortized serving-engine throughput (adaptive micro-batch
-            # + caches; bibfs_tpu/serve) against the same oracle
-            try:
-                if batch_oracle is None:
-                    batch_oracle = _batch_oracle(n, edges, pairs_file)
-                row = _serve_row(label, n, edges, *batch_oracle, repeats)
-                plat, _cfg = _row_provenance("dense", "serve", "ell")
-                row.setdefault("platform", plat)
-                rows.append(row)
-                print(
-                    f"  {row['version']:8s} {label:6s} "
-                    f"{row['time_sec']:.6e}s/query  "
-                    f"teps={row['teps']:.3e} "
-                    f"{'OK' if row['ok'] else 'MISMATCH vs oracle'}"
-                )
-            except Exception as e:
-                print(f"  serve engine on {label}: FAILED ({e})",
-                      file=sys.stderr)
-                rows.append(
-                    dict(version="serve-batch", graph=label, time_sec=None,
-                         teps=None, hops=None, ok=False,
-                         platform="?", config="serve")
-                )
+            # + caches; bibfs_tpu/serve) against the same oracle —
+            # one row per engine flavor: synchronous and pipelined
+            for pipelined in (False, True):
+                name = "serve-pipe" if pipelined else "serve"
+                try:
+                    if batch_oracle is None:
+                        batch_oracle = _batch_oracle(n, edges, pairs_file)
+                    row = _serve_row(label, n, edges, *batch_oracle,
+                                     repeats, pipelined=pipelined)
+                    plat, _cfg = _row_provenance("dense", name, "ell")
+                    row.setdefault("platform", plat)
+                    rows.append(row)
+                    print(
+                        f"  {row['version']:8s} {label:6s} "
+                        f"{row['time_sec']:.6e}s/query  "
+                        f"teps={row['teps']:.3e} "
+                        f"{'OK' if row['ok'] else 'MISMATCH vs oracle'}"
+                    )
+                except Exception as e:
+                    print(f"  {name} engine on {label}: FAILED ({e})",
+                          file=sys.stderr)
+                    rows.append(
+                        dict(version=f"{name}-batch", graph=label,
+                             time_sec=None, teps=None, hops=None, ok=False,
+                             platform="?", config=name)
+                    )
     _write_csv(rows, csv_path)
     _write_table(rows, table_path)
     return rows

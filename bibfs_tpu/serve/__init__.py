@@ -1,9 +1,13 @@
-"""Query-throughput serving layer (PR: adaptive micro-batching engine).
+"""Query-throughput serving layer.
 
 Turns the measured batch asymptote (PERF_NOTES.md §3: per-query device
 cost flat by batch ~256) into an end-to-end serving path: an adaptive
-micro-batcher over the batch solvers, a shape-bucketed executable cache,
-and a distance/result cache. See :mod:`bibfs_tpu.serve.engine`.
+micro-batcher over the batch solvers (:mod:`bibfs_tpu.serve.engine`), a
+shape-bucketed executable cache, a distance/result cache, a pipelined
+async engine that overlaps device dispatch with host-side finish and
+flushes on a ``max_wait_ms`` latency SLO
+(:mod:`bibfs_tpu.serve.pipeline`), and an open-loop arrival-rate load
+harness (:mod:`bibfs_tpu.serve.loadgen`).
 """
 
 from bibfs_tpu.serve.buckets import (  # noqa: F401
@@ -17,3 +21,8 @@ from bibfs_tpu.serve.buckets import (  # noqa: F401
 )
 from bibfs_tpu.serve.cache import DistanceCache  # noqa: F401
 from bibfs_tpu.serve.engine import QueryEngine  # noqa: F401
+from bibfs_tpu.serve.pipeline import (  # noqa: F401
+    LatencyHistogram,
+    PipelinedQueryEngine,
+    QueryTicket,
+)

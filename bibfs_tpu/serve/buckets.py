@@ -108,10 +108,12 @@ class ExecutableCache:
     was already paid for. One process-wide instance
     (:data:`DEFAULT_EXEC_CACHE`) is shared by default so engines over
     different graphs in one bucket see each other's compiles — exactly
-    the reuse the buckets exist to create."""
+    the reuse the buckets exist to create. Thread-safe throughout: the
+    pipelined engine's flusher notes dispatches concurrently with any
+    number of synchronous engines in the same process."""
 
     def __init__(self):
-        self._seen: set = set()
+        self._seen: dict = {}  # program key -> dispatch count
         self._lock = threading.Lock()
         self.hits = 0
         self.misses = 0
@@ -120,9 +122,10 @@ class ExecutableCache:
         """Record a dispatch under ``key``; True iff already compiled."""
         with self._lock:
             if key in self._seen:
+                self._seen[key] += 1
                 self.hits += 1
                 return True
-            self._seen.add(key)
+            self._seen[key] = 1
             self.misses += 1
             return False
 
@@ -133,6 +136,18 @@ class ExecutableCache:
                 "misses": self.misses,
                 "programs": len(self._seen),
             }
+
+    def program_counts(self, top: int | None = None) -> dict:
+        """Per-program dispatch counts, hottest first — the load
+        harness's view of which compiled executables actually carry
+        traffic (keys stringified for JSON artifacts)."""
+        with self._lock:
+            ranked = sorted(
+                self._seen.items(), key=lambda kv: kv[1], reverse=True
+            )
+        if top is not None:
+            ranked = ranked[:top]
+        return {str(k): v for k, v in ranked}
 
 
 DEFAULT_EXEC_CACHE = ExecutableCache()

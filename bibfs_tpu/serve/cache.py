@@ -19,10 +19,19 @@ Two bounded LRU stores, both host-side and dispatch-free to read:
 A forest is PARTIAL: the search stops at the provably-correct meet vote,
 so only the explored region is present. Absence from the forest is a
 cache miss, never an answer.
+
+Every public method is THREAD-SAFE (one re-entrant lock around both
+stores): the pipelined engine's flusher, finish worker, host workers
+and every submitting client thread all read and write one cache.
+Eviction accounting is complete — forest pops and pair-memo pops each
+feed their own counter, and ``evictions`` is their sum (the pair-memo
+pops used to bypass the counter entirely, so ``stats()`` under-reported
+churn).
 """
 
 from __future__ import annotations
 
+import threading
 from collections import OrderedDict
 
 import numpy as np
@@ -59,13 +68,20 @@ class DistanceCache:
         self.pair_entries = int(
             8 * entries if pair_entries is None else pair_entries
         )
+        self._lock = threading.RLock()
         self._forests: OrderedDict = OrderedDict()
         self._pairs: OrderedDict = OrderedDict()
         self.forest_hits = 0
         self.pair_hits = 0
         self.misses = 0
         self.inserts = 0
-        self.evictions = 0
+        self.forest_evictions = 0
+        self.pair_evictions = 0
+
+    @property
+    def evictions(self) -> int:
+        """Total LRU pops across BOTH stores (the complete churn count)."""
+        return self.forest_evictions + self.pair_evictions
 
     # ---- inserts -----------------------------------------------------
     def put_forest(self, graph_id, root: int, par: np.ndarray, n: int):
@@ -74,12 +90,14 @@ class DistanceCache:
         if self.entries <= 0:
             return
         key = (graph_id, int(root))
-        self._forests[key] = np.asarray(par[:n], dtype=np.int32).copy()
-        self._forests.move_to_end(key)
-        self.inserts += 1
-        while len(self._forests) > self.entries:
-            self._forests.popitem(last=False)
-            self.evictions += 1
+        row = np.asarray(par[:n], dtype=np.int32).copy()
+        with self._lock:
+            self._forests[key] = row
+            self._forests.move_to_end(key)
+            self.inserts += 1
+            while len(self._forests) > self.entries:
+                self._forests.popitem(last=False)
+                self.forest_evictions += 1
 
     def put_path(self, graph_id, path, n: int):
         """Bank a solved shortest path as (partial) forests for BOTH its
@@ -92,20 +110,21 @@ class DistanceCache:
         parents stand; both chains are distance-consistent)."""
         if self.entries <= 0 or path is None or len(path) < 2:
             return
-        for chain in (path, list(reversed(path))):
-            key = (graph_id, int(chain[0]))
-            par = self._forests.get(key)
-            if par is None:
-                par = np.full(n, -1, np.int32)
-                self._forests[key] = par
-                self.inserts += 1
-            for prev, v in zip(chain[:-1], chain[1:]):
-                if 0 <= v < par.size and par[v] < 0:
-                    par[v] = prev
-            self._forests.move_to_end(key)
-        while len(self._forests) > self.entries:
-            self._forests.popitem(last=False)
-            self.evictions += 1
+        with self._lock:
+            for chain in (path, list(reversed(path))):
+                key = (graph_id, int(chain[0]))
+                par = self._forests.get(key)
+                if par is None:
+                    par = np.full(n, -1, np.int32)
+                    self._forests[key] = par
+                    self.inserts += 1
+                for prev, v in zip(chain[:-1], chain[1:]):
+                    if 0 <= v < par.size and par[v] < 0:
+                        par[v] = prev
+                self._forests.move_to_end(key)
+            while len(self._forests) > self.entries:
+                self._forests.popitem(last=False)
+                self.forest_evictions += 1
 
     def put_result(self, graph_id, src: int, dst: int,
                    found: bool, hops, path):
@@ -115,10 +134,12 @@ class DistanceCache:
         a, b = (src, dst) if src < dst else (dst, src)
         if found and path is not None and path[0] != a:
             path = list(reversed(path))
-        self._pairs[(graph_id, a, b)] = (found, hops, path)
-        self._pairs.move_to_end((graph_id, a, b))
-        while len(self._pairs) > self.pair_entries:
-            self._pairs.popitem(last=False)
+        with self._lock:
+            self._pairs[(graph_id, a, b)] = (found, hops, path)
+            self._pairs.move_to_end((graph_id, a, b))
+            while len(self._pairs) > self.pair_entries:
+                self._pairs.popitem(last=False)
+                self.pair_evictions += 1
 
     # ---- lookup ------------------------------------------------------
     def lookup(self, graph_id, src: int, dst: int):
@@ -126,37 +147,41 @@ class DistanceCache:
         pair memo, then the src forest, then the dst forest (reverse
         twin)."""
         a, b = (src, dst) if src < dst else (dst, src)
-        memo = self._pairs.get((graph_id, a, b))
-        if memo is not None:
-            self._pairs.move_to_end((graph_id, a, b))
-            self.pair_hits += 1
-            found, hops, path = memo
-            if found and path is not None and src != path[0]:
-                path = list(reversed(path))
-            return found, hops, path
-        for root, leaf, reverse in ((src, dst, False), (dst, src, True)):
-            par = self._forests.get((graph_id, root))
-            if par is None:
-                continue
-            chain = walk_parents(par, root, leaf)
-            if chain is None:
-                continue
-            self._forests.move_to_end((graph_id, root))
-            self.forest_hits += 1
-            if reverse:
-                chain.reverse()  # walk gave [dst..src]; want src->dst
-            return True, len(chain) - 1, chain
-        self.misses += 1
-        return None
+        with self._lock:
+            memo = self._pairs.get((graph_id, a, b))
+            if memo is not None:
+                self._pairs.move_to_end((graph_id, a, b))
+                self.pair_hits += 1
+                found, hops, path = memo
+                if found and path is not None and src != path[0]:
+                    path = list(reversed(path))
+                return found, hops, path
+            for root, leaf, reverse in ((src, dst, False), (dst, src, True)):
+                par = self._forests.get((graph_id, root))
+                if par is None:
+                    continue
+                chain = walk_parents(par, root, leaf)
+                if chain is None:
+                    continue
+                self._forests.move_to_end((graph_id, root))
+                self.forest_hits += 1
+                if reverse:
+                    chain.reverse()  # walk gave [dst..src]; want src->dst
+                return True, len(chain) - 1, chain
+            self.misses += 1
+            return None
 
     def stats(self) -> dict:
-        return {
-            "forest_hits": self.forest_hits,
-            "pair_hits": self.pair_hits,
-            "hits": self.forest_hits + self.pair_hits,
-            "misses": self.misses,
-            "inserts": self.inserts,
-            "evictions": self.evictions,
-            "forests": len(self._forests),
-            "pairs": len(self._pairs),
-        }
+        with self._lock:
+            return {
+                "forest_hits": self.forest_hits,
+                "pair_hits": self.pair_hits,
+                "hits": self.forest_hits + self.pair_hits,
+                "misses": self.misses,
+                "inserts": self.inserts,
+                "evictions": self.evictions,
+                "forest_evictions": self.forest_evictions,
+                "pair_evictions": self.pair_evictions,
+                "forests": len(self._forests),
+                "pairs": len(self._pairs),
+            }

@@ -9,6 +9,15 @@ dispatches. Queries come from ``--pairs FILE`` or stdin (one
 ``src dst`` per line); results print in the ``bibfs-solve --pairs``
 line format, and ``--stats-json`` writes the engine's machine-readable
 serving counters.
+
+``--pipeline`` swaps in the asynchronous
+:class:`~bibfs_tpu.serve.pipeline.PipelinedQueryEngine`: a background
+flusher overlaps device dispatch with host-side finish and honors the
+``--max-wait-ms`` latency SLO (a sub-crossover queue flushes on
+deadline instead of waiting for depth). ``--load RATE[,RATE...]`` runs
+the open-loop latency-SLO load harness instead of serving: sync vs
+pipelined engines at each offered rate, oracle-verified, p50/p95/p99
+reported (``bibfs_tpu/serve/loadgen``).
 """
 
 from __future__ import annotations
@@ -26,6 +35,58 @@ def _print_result(src, dst, res, no_path: bool) -> None:
     else:
         line = f"{src} -> {dst}: no path"
     print(line)
+
+
+def _run_load(args, n, edges) -> int:
+    from bibfs_tpu.serve.loadgen import compare_engines, sample_query_pairs
+
+    try:
+        rates = [float(r) for r in args.load.split(",") if r.strip()]
+    except ValueError:
+        print(f"Error: bad --load rate list {args.load!r}", file=sys.stderr)
+        return 2
+    if not rates or any(r <= 0 for r in rates):
+        print("Error: --load needs positive rates (queries/s)",
+              file=sys.stderr)
+        return 2
+    pairs = sample_query_pairs(n, args.load_queries)
+    out = compare_engines(
+        n, edges, pairs, rates,
+        max_wait_ms=args.max_wait_ms,
+        verify=not args.no_verify,
+        mode=args.mode, layout=args.layout,
+        flush_threshold=args.threshold, max_batch=args.max_batch,
+        cache_entries=args.cache_entries,
+    )
+    for p in out["rates"]:
+        for flavor in ("sync", "pipelined"):
+            row = p[flavor]
+            print(
+                "[Load] {r:>9.1f} q/s offered | {f:9s} sustained "
+                "{s:>9.1f} q/s  p50 {p50:7.2f} ms  p95 {p95:7.2f} ms  "
+                "p99 {p99:7.2f} ms{bad}".format(
+                    r=p["offered_qps"], f=flavor,
+                    s=row["sustained_qps"] or 0.0,
+                    p50=row["latency_ms"]["p50_ms"],
+                    p95=row["latency_ms"]["p95_ms"],
+                    p99=row["latency_ms"]["p99_ms"],
+                    bad="" if row["ok"] else "  ORACLE MISMATCH",
+                ),
+                file=sys.stderr,
+            )
+    print(
+        "[Load] pipelined_beats_sync={b} deadline_ok={d} "
+        "verified={v}".format(
+            b=out["pipelined_beats_sync"], d=out["deadline_ok"],
+            v=out["verified_vs_oracle"],
+        ),
+        file=sys.stderr,
+    )
+    if args.stats_json:
+        with open(args.stats_json, "w") as f:
+            json.dump(out, f, indent=1, sort_keys=True)
+            f.write("\n")
+    return 0 if (out["verified_vs_oracle"] and out["deadline_ok"]) else 1
 
 
 def main(argv=None):
@@ -67,6 +128,39 @@ def main(argv=None):
                     help="largest single device flush (default 1024)")
     ap.add_argument("--cache-entries", type=int, default=64,
                     help="distance-cache forest capacity (default 64)")
+    ap.add_argument(
+        "--pipeline",
+        action="store_true",
+        help="serve through the pipelined async engine: background "
+        "deadline flusher, device dispatch overlapped with host-side "
+        "finish (bibfs_tpu/serve/pipeline)",
+    )
+    ap.add_argument(
+        "--max-wait-ms",
+        type=float,
+        default=5.0,
+        help="latency SLO for --pipeline/--load: a sub-crossover queue "
+        "flushes once its oldest query has waited this long "
+        "(default 5.0)",
+    )
+    ap.add_argument(
+        "--load",
+        default=None,
+        metavar="RATE[,RATE...]",
+        help="run the open-loop load harness at these offered rates "
+        "(queries/s) instead of serving: sync vs pipelined engines, "
+        "oracle-verified, per-rate latency percentiles; --stats-json "
+        "then writes the full comparison artifact",
+    )
+    ap.add_argument("--load-queries", type=int, default=1000,
+                    help="queries per offered rate under --load "
+                    "(default 1000)")
+    ap.add_argument(
+        "--no-verify",
+        action="store_true",
+        help="skip the per-query serial-oracle check under --load "
+        "(big graphs: the oracle pass can dwarf the measurement)",
+    )
     ap.add_argument("--no-path", action="store_true",
                     help="skip path printing")
     ap.add_argument(
@@ -74,12 +168,13 @@ def main(argv=None):
         default=None,
         metavar="FILE",
         help="write the engine's serving counters (dispatches, cache "
-        "hit rates, executable reuse) to FILE as JSON",
+        "hit rates, executable reuse; under --load the whole "
+        "comparison) to FILE as JSON",
     )
     args = ap.parse_args(argv)
 
     from bibfs_tpu.graph.io import read_graph_bin
-    from bibfs_tpu.serve import QueryEngine
+    from bibfs_tpu.serve import PipelinedQueryEngine, QueryEngine
     from bibfs_tpu.utils.platform import apply_platform_env
 
     apply_platform_env()
@@ -89,15 +184,27 @@ def main(argv=None):
         print(f"Error reading graph: {e}", file=sys.stderr)
         return 2
 
+    if args.load is not None:
+        try:
+            return _run_load(args, n, edges)
+        except ValueError as e:
+            print(f"Error: {e}", file=sys.stderr)
+            return 2
+
     try:
-        engine = QueryEngine(
-            n, edges,
+        kwargs = dict(
             mode=args.mode,
             layout=args.layout,
             flush_threshold=args.threshold,
             max_batch=args.max_batch,
             cache_entries=args.cache_entries,
         )
+        if args.pipeline:
+            engine = PipelinedQueryEngine(
+                n, edges, max_wait_ms=args.max_wait_ms, **kwargs
+            )
+        else:
+            engine = QueryEngine(n, edges, **kwargs)
     except ValueError as e:
         print(f"Error: {e}", file=sys.stderr)
         return 2
@@ -118,17 +225,28 @@ def main(argv=None):
                 _print_result(src, dst, res, args.no_path)
         else:
             # stream stdin: tickets resolve at each engine flush (the
-            # queue fills to max_batch, or EOF drains the remainder)
+            # queue fills to max_batch, or EOF drains the remainder;
+            # under --pipeline the background deadline flusher resolves
+            # them within --max-wait-ms on its own)
             tickets: list = []
             emitted = 0
+            failed = 0
 
             def drain():
-                nonlocal emitted
+                nonlocal emitted, failed
                 while emitted < len(tickets):
                     t = tickets[emitted]
-                    if t.result is None:
+                    err = getattr(t, "error", None)
+                    if err is not None:
+                        # a failed pipelined batch must surface, not
+                        # silently stall everything queued behind it
+                        print(f"Error: {t.src} -> {t.dst}: {err}",
+                              file=sys.stderr)
+                        failed += 1
+                    elif t.result is None:
                         break
-                    _print_result(t.src, t.dst, t.result, args.no_path)
+                    else:
+                        _print_result(t.src, t.dst, t.result, args.no_path)
                     emitted += 1
 
             for line in sys.stdin:
@@ -143,9 +261,13 @@ def main(argv=None):
                 drain()
             engine.flush()
             drain()
+            if failed:
+                return 1
     except ValueError as e:
         print(f"Error: {e}", file=sys.stderr)
         return 2
+    finally:
+        engine.close()
 
     stats = engine.stats()
     print(

@@ -155,6 +155,7 @@ class QueryEngine:
         self._host_backend = host_backend
         self._device_batches = device_batches
         self._host_solver = None  # built lazily on first host-routed flush
+        self._host_native_graph = None  # set alongside a native solver
         self._pending: list[_Pending] = []
         self.counters = {
             "queries": 0,
@@ -163,6 +164,9 @@ class QueryEngine:
             "device_batches": 0,
             "device_queries": 0,  # unique queries solved on the device
             "host_queries": 0,  # unique queries solved host-side
+            # forest-bank inserts skipped by flush-time hygiene (dupe
+            # roots within one flush + roots the LRU would evict anyway)
+            "inserts_skipped": 0,
         }
 
     @property
@@ -226,6 +230,8 @@ class QueryEngine:
     def query_many(self, pairs) -> list[BFSResult]:
         """Serve a whole query list through one (chunked) flush."""
         tickets = [self.submit(int(s), int(d)) for s, d in pairs]
+        if not tickets:
+            return []  # nothing queued: skip the flush entirely
         self.flush()
         return [t.result for t in tickets]
 
@@ -255,12 +261,20 @@ class QueryEngine:
                 self._flush_device(chunk, unique)
 
     def _flush_device(self, pairs, unique) -> None:
+        out, finish, t0 = self._device_launch(pairs)
+        results = self._device_finish(out, finish, t0, pairs)
+        for i, (src, dst) in enumerate(pairs):
+            self._resolve(unique[(src, dst)], src, dst, results[i])
+
+    def _device_launch(self, pairs):
+        """Stage 1 of a device flush: enqueue ONE batched program for
+        ``pairs`` and return ``(out, finish, t0)`` without reading any
+        value back. On the tunneled runtime this returns as soon as the
+        dispatch is in flight, which is exactly the seam the pipelined
+        engine overlaps: batch k+1 launches here while batch k is still
+        inside :meth:`_device_finish` on the finish worker."""
         from bibfs_tpu.solvers.batch_minor import auto_batch_mode
-        from bibfs_tpu.solvers.dense import (
-            _batch_dispatch,
-            _materialize_batch,
-        )
-        from bibfs_tpu.solvers.timing import force_scalar
+        from bibfs_tpu.solvers.dense import _batch_dispatch
 
         graph = self.graph  # lazy build; also sets self._bucket_key
         rung = min(bucket_batch(len(pairs)), self.max_batch)
@@ -275,21 +289,54 @@ class QueryEngine:
         _p, dispatch, finish = _batch_dispatch(graph, padded, mode)
         t0 = time.perf_counter()
         out = dispatch()
+        return out, finish, t0
+
+    def _device_finish(self, out, finish, t0, pairs) -> list[BFSResult]:
+        """Stage 2 of a device flush: force execution, run the host-side
+        finish hook (minor8 parent decode, capped-query refills),
+        materialize per-query results and bank the parent forests.
+        Everything here is host work — the pipelined engine runs it on a
+        worker thread while the flusher dispatches the next batch."""
+        from bibfs_tpu.solvers.dense import _materialize_batch
+        from bibfs_tpu.solvers.timing import force_scalar
+
         force_scalar(out)  # lazy runtimes execute at the value read
         elapsed = time.perf_counter() - t0
         outs = finish(out)
         results = _materialize_batch(outs, len(pairs), elapsed)
         self.counters["device_batches"] += 1
         self.counters["device_queries"] += len(pairs)
-        # bank both sides' parent forests: level-synchronous searches
-        # stamp TRUE distances, so each forest answers future queries
-        # about its root (and reverse twins) without any dispatch
-        par_s = np.asarray(outs[2])
-        par_t = np.asarray(outs[3])
+        self._bank_forests(pairs, np.asarray(outs[2]), np.asarray(outs[3]))
+        return results
+
+    def _bank_forests(self, pairs, par_s, par_t) -> None:
+        """Bank both sides' parent forests: level-synchronous searches
+        stamp TRUE distances, so each forest answers future queries
+        about its root (and reverse twins) without any dispatch.
+
+        Flush-time hygiene: each forest insert copies one int32[n] row,
+        so blindly banking 2 rows per query (~200 MB per 256-query flush
+        at n=100k) mostly feeds inserts the LRU (default 64 entries vs
+        512 rows) evicts before anything reads them. Instead, dedupe
+        repeated roots within the flush (newest plane wins — it is the
+        most recently solved) and bank only the newest
+        ``dist_cache.entries`` roots; everything skipped lands in the
+        ``inserts_skipped`` counter."""
+        planes: dict[int, tuple[np.ndarray, int]] = {}
+        rank: dict[int, int] = {}
+        k = 0
         for i, (src, dst) in enumerate(pairs):
-            self.dist_cache.put_forest(self.graph_id, src, par_s[i], self.n)
-            self.dist_cache.put_forest(self.graph_id, dst, par_t[i], self.n)
-            self._resolve(unique[(src, dst)], src, dst, results[i])
+            for root, plane in ((src, par_s), (dst, par_t)):
+                planes[root] = (plane, i)
+                rank[root] = k  # later occurrence = newer
+                k += 1
+        cap = max(self.dist_cache.entries, 0)
+        newest = sorted(planes, key=rank.__getitem__)
+        keep = newest[-cap:] if cap else []
+        self.counters["inserts_skipped"] += 2 * len(pairs) - len(keep)
+        for root in keep:
+            plane, i = planes[root]
+            self.dist_cache.put_forest(self.graph_id, root, plane[i], self.n)
 
     def _use_device(self) -> bool:
         """Whether above-crossover flushes go to the device program:
@@ -304,16 +351,59 @@ class QueryEngine:
         return jax.default_backend() != "cpu"
 
     def _flush_host(self, pairs, unique) -> None:
-        solver = self._get_host_solver()
-        for src, dst in pairs:
-            res = solver(src, dst)
+        results = self._solve_host(pairs)
+        bank = self._paths_to_bank(results)
+        for i, ((src, dst), res) in enumerate(zip(pairs, results)):
             self.counters["host_queries"] += 1
             # no parent planes on the host path, but the shortest path
             # itself is a valid forest fragment for both endpoints — so
             # repeated-source traffic stays cache-servable on this route
-            if res.found:
+            if i in bank:
                 self.dist_cache.put_path(self.graph_id, res.path, self.n)
             self._resolve(unique[(src, dst)], src, dst, res)
+
+    def _paths_to_bank(self, results) -> set:
+        """Flush-time banking hygiene, host edition: of this flush's
+        found paths, bank only the newest ``dist_cache.entries`` — a
+        flush deeper than the LRU would evict the rest before anything
+        could read them, and each banking is a Python chain-merge the
+        serving hot loop should not pay for nothing. Returns the result
+        indices to bank; the skipped count lands in
+        ``inserts_skipped``."""
+        found = [i for i, r in enumerate(results) if r.found]
+        cap = max(self.dist_cache.entries, 0)
+        bank = set(found[-cap:]) if cap else set()
+        self.counters["inserts_skipped"] += len(found) - len(bank)
+        return bank
+
+    # below this many queries, one threaded-batch call costs more in
+    # thread spin-up + ctypes marshalling than it saves; per-query
+    # dispatch is the measured latency winner there
+    HOST_BATCH_MIN = 4
+
+    def _solve_host(self, pairs) -> list[BFSResult]:
+        """Solve ``pairs`` on the host route: the threaded native C
+        batch (one GIL-free ctypes call, queries striped over C worker
+        threads — ``solvers/native.solve_batch_native_graph``) when the
+        native runtime carries the route and the flush is big enough to
+        amortize it, else the per-query solver loop."""
+        solver = self._get_host_solver()
+        ng = self._host_native_graph
+        if ng is not None and len(pairs) >= self.HOST_BATCH_MIN:
+            from bibfs_tpu.solvers.native import solve_batch_native_graph
+
+            results = solve_batch_native_graph(
+                ng, np.asarray(pairs, dtype=np.int64)
+            )
+            # the batch's per-query path buffer is capped (default 512;
+            # a full n+1 per lane would cost B*(n+1) ints per flush) —
+            # a found result with no path hit that cap, so re-solve just
+            # those per-query, which always carries the full buffer
+            return [
+                solver(src, dst) if (r.found and r.path is None) else r
+                for (src, dst), r in zip(pairs, results)
+            ]
+        return [solver(src, dst) for src, dst in pairs]
 
     def _resolve(self, tickets, src, dst, res: BFSResult) -> None:
         self.dist_cache.put_result(
@@ -329,6 +419,7 @@ class QueryEngine:
         if self._host_solver is not None:
             return self._host_solver
         backend = self._host_backend
+        self._host_native_graph = None
         if backend in (None, "native"):
             try:
                 from bibfs_tpu.solvers.native import (
@@ -336,18 +427,15 @@ class QueryEngine:
                     solve_native_graph,
                 )
 
-                if self._edges_host is not None:
-                    edges = self._edges_host
-                else:
-                    # canonical pairs are already mirrored and the
-                    # native builder mirrors again — feed it each
-                    # undirected edge once (the u < v half)
-                    p = self._pairs_host
-                    edges = p[p[:, 0] < p[:, 1]]
-                ng = NativeGraph.build(self.n, edges)
+                ng = NativeGraph.build(self.n, self._native_edges())
                 self._host_solver = (
                     lambda s, d: solve_native_graph(ng, s, d)
                 )
+                # kept for the threaded C batch route (_solve_host):
+                # bibfs_solve_batch shares only the read-only CSR and
+                # creates per-C-thread scratches, so the handle is safe
+                # to use from any thread
+                self._host_native_graph = ng
                 self.host_backend_resolved = "native"
                 return self._host_solver
             except (ImportError, OSError):
@@ -362,6 +450,30 @@ class QueryEngine:
         )
         self.host_backend_resolved = "serial"
         return self._host_solver
+
+    def _native_edges(self) -> np.ndarray:
+        """The undirected edge list the native builder wants (it mirrors
+        internally): the original list when we have it, else the u < v
+        half of the canonical (already-mirrored) pairs."""
+        if self._edges_host is not None:
+            return self._edges_host
+        p = self._pairs_host
+        return p[p[:, 0] < p[:, 1]]
+
+    # ---- lifecycle ---------------------------------------------------
+    def close(self) -> None:
+        """Resolve anything still queued. The synchronous engine owns no
+        threads, so this is just a drain — it exists so load drivers and
+        ``with`` blocks treat both engine flavors uniformly (the
+        pipelined subclass tears down its worker threads here)."""
+        self.flush()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
 
     # ---- introspection ----------------------------------------------
     @property

@@ -1,0 +1,382 @@
+"""Open-loop arrival-rate load harness — the latency-SLO measurement.
+
+Closed-loop benchmarks (``bench.py --serve``) measure solver throughput:
+the next query is issued only when the previous one finishes, so queue
+wait — the thing users actually feel — never appears. This module
+measures serving: queries arrive on a fixed OPEN-LOOP schedule (query i
+at ``t0 + i/rate``, whether or not the server kept up), every query's
+latency is clocked from its *scheduled* arrival to its resolution, and
+sustained throughput is completed-queries over the whole span including
+the drain. A server that can't keep up shows it here as queue growth
+and a p95/p99 blow-up — exactly the failure mode the deadline-flushing
+pipelined engine exists to bound.
+
+Two drivers, one schedule:
+
+- the **synchronous** :class:`~bibfs_tpu.serve.engine.QueryEngine` can
+  only be driven the way its API forces: the arrival thread itself
+  calls ``flush()`` (at depth, and as a caller-side emulation of the
+  deadline — the sync engine has no clock), so every flush BLOCKS the
+  arrivals behind it;
+- the **pipelined** :class:`~bibfs_tpu.serve.pipeline.PipelinedQueryEngine`
+  is just submitted to — depth and deadline flushing happen on its
+  background flusher, and dispatch/finish overlap.
+
+Every completed result is verified hop-for-hop against a precomputed
+serial-oracle table (paths CSR-edge-validated), and the pipelined run's
+deadline compliance is checked from the engine's own worst-case
+counters: no query may wait in the queue longer than ``max_wait_ms``
+plus one in-flight batch time (plus a small scheduling slack for loaded
+CI boxes).
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import numpy as np
+
+# generator/GIL scheduling grace when checking the deadline bound on a
+# busy box: the flusher thread can lose the CPU for a few ms to the very
+# load being measured without that being an SLO-logic violation
+SCHED_SLACK_MS = 25.0
+
+
+def sample_query_pairs(n: int, q: int, seed: int = 0) -> np.ndarray:
+    """The load workload: up to ``q`` unique non-trivial (src, dst)
+    pairs in shuffled order. Unique so the measurement exercises the
+    solvers, not the caches; shared by every load entry point
+    (``bench.py --serve-load``, ``bibfs-serve --load``) so they measure
+    the same traffic."""
+    rng = np.random.default_rng(seed)
+    pairs = np.unique(rng.integers(0, n, size=(3 * q, 2)), axis=0)
+    pairs = pairs[pairs[:, 0] != pairs[:, 1]][:q]
+    rng.shuffle(pairs)
+    return pairs
+
+
+def _percentiles_ms(lats_s: list[float]) -> dict:
+    if not lats_s:
+        return {"count": 0, "mean_ms": 0.0, "p50_ms": 0.0, "p95_ms": 0.0,
+                "p99_ms": 0.0, "max_ms": 0.0}
+    a = np.sort(np.asarray(lats_s, dtype=np.float64)) * 1e3
+    pick = lambda q: float(a[min(int(q * len(a)), len(a) - 1)])  # noqa: E731
+    return {
+        "count": len(a),
+        "mean_ms": round(float(a.mean()), 4),
+        "p50_ms": round(pick(0.50), 4),
+        "p95_ms": round(pick(0.95), 4),
+        "p99_ms": round(pick(0.99), 4),
+        "max_ms": round(float(a[-1]), 4),
+    }
+
+
+def _verify(pairs, results, oracle, csr) -> list[str]:
+    from bibfs_tpu.solvers.api import validate_path
+
+    errors = []
+    for (s, d), res in zip(pairs, results):
+        s, d = int(s), int(d)
+        ref = oracle[(s, d)]
+        if res is None:
+            errors.append(f"{s}->{d}: unresolved")
+        elif res.found != ref.found or (ref.found and res.hops != ref.hops):
+            errors.append(
+                f"{s}->{d}: hops {res.hops} != oracle {ref.hops}"
+            )
+        elif ref.found and res.path is not None and not validate_path(
+            csr, res.path, s, d, hops=res.hops
+        ):
+            errors.append(f"{s}->{d}: path failed CSR validation")
+    return errors
+
+
+def _drive_pipelined(engine, pairs, rate_qps):
+    """Open-loop schedule against the pipelined engine: submit() never
+    blocks, so arrivals stay on time by construction; latencies read the
+    per-ticket resolve stamps."""
+    t0 = time.perf_counter()
+    tickets = []
+    for i, (s, d) in enumerate(pairs):
+        delay = t0 + i / rate_qps - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+        tickets.append(engine.submit(int(s), int(d)))
+    engine.flush()  # drain
+    elapsed = time.perf_counter() - t0
+    lats = []
+    for i, t in enumerate(tickets):
+        t.wait(timeout=60.0)
+        lats.append(t.t_done - (t0 + i / rate_qps))
+    return lats, elapsed, [t.result for t in tickets]
+
+
+def _drive_sync(engine, pairs, rate_qps, max_wait_ms):
+    """Open-loop schedule against the synchronous engine (module
+    docstring): the arrival thread flushes at depth and emulates the
+    deadline between arrivals, paying each flush as arrival blockage."""
+    wait_s = None if max_wait_ms is None else max(max_wait_ms, 0.0) / 1e3
+    t0 = time.perf_counter()
+    tickets = []
+    resolve_t: dict[int, float] = {}
+    head = 0  # first ticket not yet seen resolved
+    first_pending_t = None  # submit time of the oldest unflushed query
+
+    def note_resolved():
+        nonlocal head, first_pending_t
+        now = time.perf_counter()
+        while head < len(tickets) and tickets[head].result is not None:
+            resolve_t.setdefault(head, now)
+            head += 1
+        if engine.pending == 0:
+            first_pending_t = None
+
+    for i, (s, d) in enumerate(pairs):
+        sched = t0 + i / rate_qps
+        while True:
+            now = time.perf_counter()
+            if now >= sched:
+                break
+            if (wait_s is not None and first_pending_t is not None
+                    and now - first_pending_t >= wait_s):
+                engine.flush()
+                note_resolved()
+                continue
+            until = sched
+            if wait_s is not None and first_pending_t is not None:
+                until = min(until, first_pending_t + wait_s)
+            time.sleep(max(until - now, 0.0))
+        t = engine.submit(int(s), int(d))
+        tickets.append(t)
+        if t.result is not None:
+            # inline resolution (trivial / cache hit): stamp NOW — the
+            # head-contiguous scan below would otherwise defer it to the
+            # next flush, inflating sync latencies vs the pipelined
+            # driver's per-ticket resolve stamps
+            resolve_t.setdefault(len(tickets) - 1, time.perf_counter())
+        elif first_pending_t is None:
+            first_pending_t = time.perf_counter()
+        if engine.pending >= engine.flush_threshold:
+            engine.flush()
+        note_resolved()
+    engine.flush()
+    note_resolved()
+    elapsed = time.perf_counter() - t0
+    lats = [resolve_t[i] - (t0 + i / rate_qps) for i in range(len(tickets))]
+    return lats, elapsed, [t.result for t in tickets]
+
+
+def _load_point_row(rate, sync_row, pipe_row) -> dict:
+    su = None
+    if sync_row["sustained_qps"] and pipe_row["sustained_qps"]:
+        su = round(
+            pipe_row["sustained_qps"] / sync_row["sustained_qps"], 3
+        )
+    return {
+        "offered_qps": round(float(rate), 1),
+        "sync": sync_row,
+        "pipelined": pipe_row,
+        "sustained_speedup": su,
+    }
+
+
+def run_load_point(
+    make_engine, pairs, rate_qps, *, pipelined: bool,
+    max_wait_ms: float | None, oracle=None, csr=None,
+) -> dict:
+    """One (engine flavor, offered rate) measurement on a FRESH engine
+    (cold caches — the point measures solving under load, not
+    memoization). Returns the machine-readable metrics row."""
+    engine = make_engine()
+    try:
+        # setup is untimed, like every bench row's graph build: resolve
+        # the host solver / device graph BEFORE the first arrival so the
+        # measurement sees steady-state serving, not lazy construction
+        if engine._use_device():
+            engine.graph
+        else:
+            engine._get_host_solver()
+        if pipelined:
+            lats, elapsed, results = _drive_pipelined(engine, pairs, rate_qps)
+        else:
+            lats, elapsed, results = _drive_sync(
+                engine, pairs, rate_qps, max_wait_ms
+            )
+        errors = (
+            _verify(pairs, results, oracle, csr)
+            if oracle is not None else []
+        )
+        out = {
+            "offered_qps": round(float(rate_qps), 1),
+            "completed": sum(r is not None for r in results),
+            "elapsed_s": round(elapsed, 4),
+            "sustained_qps": round(len(results) / elapsed, 1)
+            if elapsed > 0 else None,
+            "latency_ms": _percentiles_ms(lats),
+            "ok": not errors,
+            "errors": errors[:10],
+        }
+        if pipelined:
+            stats = engine.stats()
+            pipe = stats["pipeline"]
+            budget_ms = (
+                None if max_wait_ms is None
+                else max_wait_ms + pipe["batch_service_max_ms"]
+                + SCHED_SLACK_MS
+            )
+            out["deadline"] = {
+                "max_wait_ms": max_wait_ms,
+                "queue_wait_max_ms": round(pipe["queue_wait_max_ms"], 3),
+                "batch_service_max_ms": round(
+                    pipe["batch_service_max_ms"], 3
+                ),
+                "budget_ms": None if budget_ms is None
+                else round(budget_ms, 3),
+                "ok": True if budget_ms is None
+                else pipe["queue_wait_max_ms"] <= budget_ms,
+            }
+            out["engine"] = {
+                "flushes": pipe["flushes"],
+                "depth_flushes": pipe["depth_flushes"],
+                "deadline_flushes": pipe["deadline_flushes"],
+                "max_queue_depth": pipe["max_queue_depth"],
+                "overlap": stats["overlap"],
+                "latency_ms": stats["latency_ms"],
+                "host_backend": stats["host_backend"],
+                "device_batches": stats["device_batches"],
+                "host_queries": stats["host_queries"],
+            }
+        return out
+    finally:
+        engine.close()
+
+
+def measure_capacity(make_engine, pairs) -> float:
+    """Closed-loop capacity of a fresh sync engine driven the way the
+    open-loop driver saturates it — flush_threshold-sized batched
+    flushes (queries/s). This is the anchor the offered-rate ladder is
+    scaled from; a per-query estimate would undersell the batch-
+    amortized ceiling by 2-3x and leave the 'saturating' rate
+    unsaturating."""
+    engine = make_engine()
+    try:
+        step = max(engine.flush_threshold, 1)
+        engine.query_many(pairs[:step])  # warm the solver + first batch
+        rest = pairs[step:]
+        if len(rest) == 0:
+            rest = pairs  # tiny pool: re-time the (warmed) chunk
+        t0 = time.perf_counter()
+        for i in range(0, len(rest), step):
+            engine.query_many(rest[i: i + step])
+        dt = time.perf_counter() - t0
+        return len(rest) / dt if dt > 0 else float("inf")
+    finally:
+        engine.close()
+
+
+def compare_engines(
+    n, edges, pairs, rates, *, max_wait_ms: float = 5.0,
+    max_queue: int | None = None, max_inflight: int = 2,
+    top_repeats: int = 1, verify: bool = True, **engine_kwargs,
+) -> dict:
+    """Sync vs pipelined under the same open-loop schedules — the
+    ``bench_load.json`` payload. ``rates`` is the offered-rate ladder
+    (queries/s); each point gets a fresh engine of each flavor. The
+    LAST (saturating) rate runs ``top_repeats`` times per engine and
+    keeps each engine's best sustained row — the headline judgment
+    should reflect each engine's ceiling, not one noisy scheduler
+    window (both sides get the same treatment)."""
+    from bibfs_tpu.graph.csr import build_csr, canonical_pairs
+    from bibfs_tpu.serve.engine import QueryEngine
+    from bibfs_tpu.serve.pipeline import PipelinedQueryEngine
+
+    cpairs = canonical_pairs(n, edges)
+    oracle = csr = None
+    if verify:
+        from bibfs_tpu.solvers.serial import solve_serial_csr
+
+        csr = build_csr(n, pairs=cpairs)
+        oracle = {
+            (int(s), int(d)): solve_serial_csr(n, *csr, int(s), int(d))
+            for s, d in {(int(s), int(d)) for s, d in pairs}
+        }
+
+    def make_sync():
+        return QueryEngine(n, edges, pairs=cpairs, **engine_kwargs)
+
+    def make_pipe():
+        return PipelinedQueryEngine(
+            n, edges, pairs=cpairs, max_wait_ms=max_wait_ms,
+            max_queue=max_queue, max_inflight=max_inflight,
+            **engine_kwargs,
+        )
+
+    points = []
+    # harness-level: the default 5 ms GIL switch interval turns every
+    # producer<->pipeline thread handoff into a multi-ms convoy on small
+    # hosts — measured here as ~5 ms per handoff at sub-ms batch times.
+    # Serving processes tune this; so does the harness (set just around
+    # the driven runs, restored after).
+    old_si = sys.getswitchinterval()
+    sys.setswitchinterval(5e-4)
+    try:
+        for i, rate in enumerate(rates):
+            reps = max(top_repeats, 1) if i == len(rates) - 1 else 1
+            sync_row = pipe_row = None
+            deadline_all_ok = True
+            worst_qwait = 0.0
+            for _ in range(reps):
+                s = run_load_point(
+                    make_sync, pairs, rate, pipelined=False,
+                    max_wait_ms=max_wait_ms, oracle=oracle, csr=csr,
+                )
+                p = run_load_point(
+                    make_pipe, pairs, rate, pipelined=True,
+                    max_wait_ms=max_wait_ms, oracle=oracle, csr=csr,
+                )
+                d = p.get("deadline", {})
+                deadline_all_ok = deadline_all_ok and d.get("ok", True)
+                worst_qwait = max(
+                    worst_qwait, d.get("queue_wait_max_ms", 0.0)
+                )
+                if (sync_row is None
+                        or (s["sustained_qps"] or 0)
+                        > (sync_row["sustained_qps"] or 0)):
+                    sync_row = s
+                if (pipe_row is None
+                        or (p["sustained_qps"] or 0)
+                        > (pipe_row["sustained_qps"] or 0)):
+                    pipe_row = p
+            if "deadline" in pipe_row:
+                # an SLO claim may not select away its counterexamples:
+                # the kept row is the best-throughput one, but deadline
+                # compliance aggregates over EVERY repeat
+                pipe_row["deadline"]["ok"] = (
+                    pipe_row["deadline"]["ok"] and deadline_all_ok
+                )
+                pipe_row["deadline"]["queue_wait_max_ms_all_reps"] = round(
+                    worst_qwait, 3
+                )
+            points.append(_load_point_row(rate, sync_row, pipe_row))
+    finally:
+        sys.setswitchinterval(old_si)
+    top = points[-1] if points else None
+    return {
+        "n": int(n),
+        "queries_per_point": len(pairs),
+        "max_wait_ms": max_wait_ms,
+        "max_queue": max_queue,
+        "rates": points,
+        # the headline claims, judged at the highest (saturating) rate
+        "pipelined_beats_sync": bool(
+            top and top["sustained_speedup"] and top["sustained_speedup"] > 1.0
+        ),
+        "deadline_ok": all(
+            p["pipelined"].get("deadline", {}).get("ok", True)
+            for p in points
+        ),
+        "verified_vs_oracle": all(
+            p["sync"]["ok"] and p["pipelined"]["ok"] for p in points
+        ),
+    }

@@ -121,7 +121,10 @@ def solve(
     return SOLVERS[backend](n, edges, src, dst, **kwargs)
 
 
-def solve_many(n: int, edges: np.ndarray, pairs, **engine_kwargs) -> list:
+def solve_many(
+    n: int, edges: np.ndarray, pairs, *, pipelined: bool = False,
+    **engine_kwargs,
+) -> list:
     """Serve a query list through the adaptive micro-batching engine.
 
     The multi-query counterpart of :func:`solve`: one call builds a
@@ -129,10 +132,20 @@ def solve_many(n: int, edges: np.ndarray, pairs, **engine_kwargs) -> list:
     distance/result cache), routes the queries through its calibrated
     batch-vs-latency crossover (batched device program at or above it,
     per-query host dispatch below), and returns one :class:`BFSResult`
-    per pair. Keep an engine of your own when serving repeat traffic —
-    this convenience rebuilds the caches per call (the compiled
-    executables themselves persist process-wide either way).
+    per pair. ``pipelined=True`` serves through the asynchronous
+    :class:`bibfs_tpu.serve.PipelinedQueryEngine` instead (background
+    deadline flusher, device dispatch overlapped with host-side finish;
+    extra knobs like ``max_wait_ms`` pass through) — worth it for big
+    lists on accelerator substrates, torn down before returning. Keep
+    an engine of your own when serving repeat traffic — this
+    convenience rebuilds the caches per call (the compiled executables
+    themselves persist process-wide either way).
     """
+    if pipelined:
+        from bibfs_tpu.serve import PipelinedQueryEngine
+
+        with PipelinedQueryEngine(n, edges, **engine_kwargs) as eng:
+            return eng.query_many(pairs)
     from bibfs_tpu.serve import QueryEngine
 
     return QueryEngine(n, edges, **engine_kwargs).query_many(pairs)

@@ -111,6 +111,26 @@ def test_distance_cache_forest_and_memo():
     assert cache.stats()["evictions"] == 1
 
 
+def test_pair_memo_eviction_accounting():
+    """Pair-memo pops must feed the eviction counters (they used to
+    bypass ``evictions`` entirely, under-reporting churn), and the
+    total must stay the sum of both stores' pops."""
+    cache = DistanceCache(entries=2, pair_entries=2)
+    for i in range(3):
+        cache.put_result("g", i, i + 10, True, 1, [i, i + 10])
+    st = cache.stats()
+    assert st["pair_evictions"] == 1
+    assert st["pairs"] == 2
+    assert st["evictions"] == st["forest_evictions"] + st["pair_evictions"]
+    # put_path overflow pops land on the forest side of the ledger
+    cache.put_path("g", [0, 1], 4)
+    cache.put_path("g", [2, 3], 4)
+    st = cache.stats()
+    assert st["forest_evictions"] == 2
+    assert st["forests"] == 2
+    assert st["evictions"] == 3
+
+
 # ---- engine: correctness through each route --------------------------
 def test_engine_device_batch_matches_oracle():
     n = 220
@@ -325,6 +345,70 @@ def test_engine_tiered_layout():
     _check_oracle(n, edges, pairs, results)
     assert eng.counters["device_batches"] == 1
     assert eng.graph.tier_meta  # the case really exercised hub tiers
+
+
+def test_query_many_empty_short_circuits():
+    """An empty pairs list must return [] WITHOUT flushing (the flush
+    used to run unconditionally)."""
+    eng = QueryEngine(10, np.array([[0, 1]]))
+    calls = []
+    eng.flush = lambda: calls.append(1)  # would count any flush
+    assert eng.query_many([]) == []
+    assert calls == []
+    assert eng.counters["queries"] == 0
+
+
+def test_device_flush_banking_hygiene():
+    """One device flush must dedupe repeated roots and bank at most
+    ``cache_entries`` newest roots — the rest is counted, not copied
+    (2 int32[n] rows per query just to be LRU-evicted is pure waste)."""
+    n = 220
+    edges = _skiplink_graph(n)
+    eng = QueryEngine(n, edges, flush_threshold=8, device_batches=True,
+                      cache_entries=4, exec_cache=ExecutableCache())
+    pairs = [(0, 40 + i) for i in range(10)]  # src root repeats 10x
+    results = eng.query_many(pairs)
+    _check_oracle(n, edges, np.array(pairs), results)
+    # 20 banking opportunities, 11 unique roots, capacity 4
+    assert eng.counters["inserts_skipped"] == 16
+    st = eng.dist_cache.stats()
+    assert st["inserts"] == 4
+    assert st["forest_evictions"] == 0
+    # the newest roots were the ones kept: the last query's endpoints
+    # are both servable from the cache with zero new dispatches
+    before = (eng.counters["device_batches"], eng.counters["host_queries"])
+    r = eng.query(0, 49)
+    assert r.found and r.hops == results[-1].hops
+    assert (eng.counters["device_batches"],
+            eng.counters["host_queries"]) == before
+
+
+def test_host_flush_banking_hygiene():
+    """The host route caps path banking the same way: only the newest
+    ``cache_entries`` found paths of one flush are merged into the
+    forest store."""
+    n = 150
+    edges = _skiplink_graph(n)
+    eng = QueryEngine(n, edges, flush_threshold=1000, cache_entries=2)
+    pairs = [(i, i + 20) for i in range(8)]
+    results = eng.query_many(pairs)
+    _check_oracle(n, edges, np.array(pairs), results)
+    assert eng.counters["host_queries"] == 8
+    assert eng.counters["inserts_skipped"] == 6  # 8 found paths, cap 2
+
+
+def test_host_batch_long_path_refill():
+    """The threaded-C host batch caps per-query path buffers (default
+    512); a found-but-capped result must be re-solved per-query so the
+    engine still returns FULL paths on high-diameter graphs."""
+    n = 600
+    edges = np.array([[i, i + 1] for i in range(n - 1)])  # pure chain
+    eng = QueryEngine(n, edges, flush_threshold=1000)
+    pairs = [(0, n - 1), (1, n - 1), (0, 5), (3, 9)]  # >= HOST_BATCH_MIN
+    results = eng.query_many(pairs)
+    _check_oracle(n, edges, np.array(pairs), results)
+    assert results[0].hops == n - 1
+    assert results[0].path is not None and len(results[0].path) == n
 
 
 def test_engine_range_checks():

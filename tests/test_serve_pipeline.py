@@ -1,0 +1,369 @@
+"""The pipelined serving layer (bibfs_tpu/serve/pipeline + loadgen).
+
+Correctness bar is the serving layer's usual one — every answer vs the
+serial oracle, paths CSR-validated — plus the async claims this layer
+exists for: a sub-threshold queue resolves within the ``max_wait_ms``
+latency SLO WITHOUT any explicit flush (on both engine routes), N
+threads can submit against one engine concurrently and every ticket
+still verifies, and the open-loop load harness produces the comparison
+artifact with deadline compliance checked from the engine's own
+worst-case counters.
+
+Every wait in this file is bounded (ticket.wait(timeout=...), thread
+joins with timeouts), so a deadlocked pipeline fails fast instead of
+hanging the suite; CI additionally runs these files under
+pytest-timeout.
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from bibfs_tpu.serve import ExecutableCache, PipelinedQueryEngine
+from bibfs_tpu.serve.pipeline import LatencyHistogram
+from bibfs_tpu.solvers.serial import solve_serial
+
+
+def _skiplink_graph(n: int) -> np.ndarray:
+    """Chain + skip links (max degree 4): shallow, connected, and every
+    size buckets to ELL width 8 — the shared serving-test graph."""
+    edges = [[i, i + 1] for i in range(n - 1)]
+    edges += [[i, i + 7] for i in range(n - 7)]
+    return np.array(edges)
+
+
+def _rand_pairs(rng, n: int, k: int) -> np.ndarray:
+    src = rng.integers(0, n, size=k)
+    dst = (src + rng.integers(1, n, size=k)) % n
+    return np.stack([src, dst], axis=1)
+
+
+def _check_oracle(n, edges, pairs, results):
+    for (src, dst), r in zip(pairs, results):
+        ref = solve_serial(n, edges, int(src), int(dst))
+        assert r.found == ref.found, (src, dst)
+        if ref.found:
+            assert r.hops == ref.hops, (src, dst)
+            if r.path is not None:
+                r.validate_path(n, edges, int(src), int(dst))
+
+
+# ---- latency histogram ----------------------------------------------
+def test_latency_histogram_percentiles():
+    h = LatencyHistogram()
+    h.record_many([0.001] * 90 + [0.1] * 10)
+    assert h.count == 100
+    # ~19% bucket resolution: p50 lands on the 1 ms bucket's edge,
+    # p99 on the 100 ms one
+    assert 0.0008 <= h.percentile(0.5) <= 0.0015
+    assert 0.08 <= h.percentile(0.99) <= 0.13
+    assert h.max_s == pytest.approx(0.1)
+    s = h.summary_ms()
+    assert s["count"] == 100 and s["p50_ms"] <= s["p95_ms"] <= s["p99_ms"]
+    empty = LatencyHistogram()
+    assert empty.percentile(0.99) == 0.0
+    assert empty.summary_ms()["count"] == 0
+
+
+# ---- correctness through both routes --------------------------------
+def test_pipelined_host_route_matches_oracle():
+    n = 220
+    edges = _skiplink_graph(n)
+    with PipelinedQueryEngine(n, edges) as eng:
+        rng = np.random.default_rng(0)
+        pairs = _rand_pairs(rng, n, 40)
+        pairs[3] = (9, 9)  # trivial
+        results = eng.query_many(pairs)
+        _check_oracle(n, edges, pairs, results)
+        assert eng.counters["host_queries"] > 0
+        assert eng.counters["device_batches"] == 0
+        assert eng.counters["trivial"] == 1
+        st = eng.stats()
+        assert st["latency_ms"]["count"] == 40
+        assert st["pipeline"]["flushes"] >= 1
+        assert st["overlap"]["wall_s"] >= 0
+
+
+def test_pipelined_device_route_matches_oracle():
+    n = 220
+    edges = _skiplink_graph(n)
+    with PipelinedQueryEngine(
+        n, edges, flush_threshold=8, device_batches=True,
+        exec_cache=ExecutableCache(),
+    ) as eng:
+        rng = np.random.default_rng(1)
+        pairs = _rand_pairs(rng, n, 40)
+        results = eng.query_many(pairs)
+        _check_oracle(n, edges, pairs, results)
+        assert eng.counters["device_batches"] >= 1
+        assert eng.counters["host_queries"] == 0
+        assert eng.exec_cache.stats()["programs"] >= 1
+
+
+def test_pipelined_query_many_empty():
+    with PipelinedQueryEngine(20, np.array([[0, 1]])) as eng:
+        assert eng.query_many([]) == []
+        assert eng.counters["queries"] == 0
+        assert eng.pipe_counters["flushes"] == 0
+
+
+# ---- deadline flushing ----------------------------------------------
+@pytest.mark.parametrize("device", [False, True])
+def test_deadline_flush_without_explicit_flush(device):
+    """A sub-threshold queue must resolve within ~max_wait_ms with NO
+    flush() call, on both the host-routed and device-routed engine
+    configurations — the latency SLO the synchronous engine cannot
+    honor (it would wait for depth forever)."""
+    n = 150
+    edges = _skiplink_graph(n)
+    eng = PipelinedQueryEngine(
+        n, edges, flush_threshold=50, max_wait_ms=40.0,
+        device_batches=device,
+        exec_cache=ExecutableCache() if device else None,
+    )
+    try:
+        t0 = time.perf_counter()
+        t = eng.submit(0, 100)
+        res = t.wait(timeout=30.0)  # NOT eng.flush()
+        waited = time.perf_counter() - t0
+        assert res.found
+        ref = solve_serial(n, edges, 0, 100)
+        assert res.hops == ref.hops
+        assert eng.pipe_counters["deadline_flushes"] >= 1
+        # generous bound for loaded CI boxes; the point is "soon", not
+        # "when depth 50 fills" (which would be never)
+        assert waited < 20.0
+    finally:
+        eng.close()
+
+
+def test_no_deadline_means_depth_only():
+    """max_wait_ms=None restores the synchronous engine's depth-only
+    behavior: a sub-threshold queue sits until an explicit flush."""
+    n = 100
+    edges = _skiplink_graph(n)
+    with PipelinedQueryEngine(
+        n, edges, flush_threshold=50, max_wait_ms=None
+    ) as eng:
+        t = eng.submit(0, 60)
+        time.sleep(0.3)
+        assert not t.done()
+        eng.flush()
+        assert t.done() and t.result.found
+
+
+def test_ticket_wait_timeout():
+    n = 100
+    edges = _skiplink_graph(n)
+    with PipelinedQueryEngine(
+        n, edges, flush_threshold=50, max_wait_ms=None
+    ) as eng:
+        t = eng.submit(0, 60)
+        with pytest.raises(TimeoutError):
+            t.wait(timeout=0.2)
+        eng.flush()
+        assert t.wait(timeout=5.0).found
+
+
+# ---- admission control + lifecycle ----------------------------------
+def test_admission_control_blocks_and_recovers():
+    n = 150
+    edges = _skiplink_graph(n)
+    with PipelinedQueryEngine(
+        n, edges, flush_threshold=1000, max_wait_ms=10.0, max_queue=1
+    ) as eng:
+        tickets = [eng.submit(i, i + 30) for i in range(3)]
+        results = [t.wait(timeout=30.0) for t in tickets]
+        assert all(r.found for r in results)
+        assert eng.pipe_counters["submit_blocked"] >= 1
+
+
+def test_full_queue_flushes_even_depth_only():
+    """max_queue < flush_threshold with max_wait_ms=None must NOT
+    deadlock: a full admission queue is itself a flush trigger (a
+    producer blocked in submit() could never call flush() to break the
+    cycle otherwise)."""
+    n = 150
+    edges = _skiplink_graph(n)
+    with PipelinedQueryEngine(
+        n, edges, flush_threshold=50, max_wait_ms=None, max_queue=4
+    ) as eng:
+        pairs = [(i, i + 40) for i in range(9)]
+        done = []
+        t = threading.Thread(
+            target=lambda: done.append(eng.query_many(pairs))
+        )
+        t.start()
+        t.join(timeout=30.0)
+        assert not t.is_alive(), "submit deadlocked on a full queue"
+        _check_oracle(n, edges, np.array(pairs), done[0])
+
+
+def test_closed_engine_rejects_submits():
+    n = 60
+    edges = _skiplink_graph(n)
+    eng = PipelinedQueryEngine(n, edges)
+    eng.query(0, 30)
+    eng.close()
+    eng.close()  # idempotent
+    with pytest.raises(RuntimeError, match="closed"):
+        eng.submit(1, 2)
+
+
+# ---- concurrency ----------------------------------------------------
+def test_concurrent_submitters_oracle_verified():
+    """N threads submit against ONE pipelined engine; every ticket must
+    resolve and verify against the oracle, with exact query
+    accounting."""
+    n = 300
+    edges = _skiplink_graph(n)
+    threads, per = 4, 25
+    rng = np.random.default_rng(7)
+    plans = [_rand_pairs(rng, n, per) for _ in range(threads)]
+    plans[1][:5] = plans[0][:5]  # cross-thread repeats hit the dedupe
+    with PipelinedQueryEngine(n, edges, max_wait_ms=5.0) as eng:
+        outs: list = [[] for _ in range(threads)]
+        errors: list = []
+
+        def worker(k):
+            try:
+                for s, d in plans[k]:
+                    outs[k].append(((int(s), int(d)),
+                                    eng.submit(int(s), int(d))))
+            except Exception as e:  # pragma: no cover - fail loudly
+                errors.append(e)
+
+        ts = [threading.Thread(target=worker, args=(k,))
+              for k in range(threads)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=30.0)
+            assert not t.is_alive(), "submitter thread hung"
+        assert not errors
+        eng.flush()
+        for out in outs:
+            for (s, d), ticket in out:
+                r = ticket.wait(timeout=30.0)
+                ref = solve_serial(n, edges, s, d)
+                assert r.found == ref.found, (s, d)
+                if ref.found:
+                    assert r.hops == ref.hops, (s, d)
+        assert eng.counters["queries"] == threads * per
+
+
+# ---- repeat traffic stays dispatch-free -----------------------------
+def test_pipelined_repeat_traffic_cache_served():
+    n = 260
+    edges = _skiplink_graph(n)
+    with PipelinedQueryEngine(
+        n, edges, flush_threshold=8, device_batches=True,
+        exec_cache=ExecutableCache(),
+    ) as eng:
+        rng = np.random.default_rng(2)
+        pairs = _rand_pairs(rng, n, 24)
+        warm = eng.query_many(pairs)
+        _check_oracle(n, edges, pairs, warm)
+        dispatches = (eng.counters["device_batches"],
+                      eng.counters["host_queries"])
+        again = eng.query_many(np.concatenate([pairs, pairs[:, ::-1]]))
+        for a, b in zip(again[: len(pairs)], warm):
+            assert a.found == b.found and a.hops == b.hops
+        assert (eng.counters["device_batches"],
+                eng.counters["host_queries"]) == dispatches
+        assert eng.counters["cache_served"] >= 2 * len(pairs)
+
+
+# ---- solve_many passthrough -----------------------------------------
+def test_solve_many_pipelined():
+    from bibfs_tpu.solvers.api import solve_many
+
+    n = 180
+    edges = _skiplink_graph(n)
+    rng = np.random.default_rng(5)
+    pairs = rng.integers(0, n, size=(10, 2))
+    res = solve_many(n, edges, pairs, pipelined=True, max_wait_ms=20.0)
+    _check_oracle(n, edges, pairs, res)
+
+
+# ---- the load harness -----------------------------------------------
+def test_load_harness_compare_engines():
+    """Small end-to-end run of the open-loop harness: both engines at
+    two offered rates, all results oracle-verified, the pipelined rows
+    carrying the deadline-compliance block computed from the engine's
+    own worst-case counters."""
+    from bibfs_tpu.serve.loadgen import compare_engines
+
+    n = 150
+    edges = _skiplink_graph(n)
+    rng = np.random.default_rng(3)
+    pairs = _rand_pairs(rng, n, 60)
+    out = compare_engines(
+        n, edges, pairs, [400.0, 1500.0], max_wait_ms=50.0
+    )
+    assert out["verified_vs_oracle"]
+    assert len(out["rates"]) == 2
+    for p in out["rates"]:
+        for flavor in ("sync", "pipelined"):
+            row = p[flavor]
+            assert row["ok"], row["errors"]
+            assert row["completed"] == len(pairs)
+            assert row["latency_ms"]["count"] == len(pairs)
+            assert row["latency_ms"]["p50_ms"] <= row["latency_ms"]["p95_ms"]
+        d = p["pipelined"]["deadline"]
+        assert d["max_wait_ms"] == 50.0
+        assert d["budget_ms"] >= 50.0
+    # the SLO bound itself: queue wait never exceeded deadline + one
+    # batch time (+ scheduling slack)
+    assert out["deadline_ok"]
+
+
+# ---- CLI -------------------------------------------------------------
+def test_serve_cli_pipeline_pairs(tmp_path, capsys):
+    from bibfs_tpu.graph.io import write_graph_bin
+    from bibfs_tpu.serve.cli import main as serve_main
+
+    n = 120
+    edges = _skiplink_graph(n)
+    gpath = tmp_path / "g.bin"
+    write_graph_bin(gpath, n, edges)
+    ppath = tmp_path / "pairs.txt"
+    rng = np.random.default_rng(4)
+    pairs = rng.integers(0, n, size=(20, 2))
+    np.savetxt(ppath, pairs, fmt="%d")
+    spath = tmp_path / "stats.json"
+    rc = serve_main([str(gpath), "--pairs", str(ppath), "--no-path",
+                     "--pipeline", "--max-wait-ms", "25",
+                     "--stats-json", str(spath)])
+    assert rc == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    assert len(out) == len(pairs)
+    for (src, dst), line in zip(pairs, out):
+        ref = solve_serial(n, edges, int(src), int(dst))
+        want = (f"{src} -> {dst}: length = {ref.hops}" if ref.found
+                else f"{src} -> {dst}: no path")
+        assert line == want
+    stats = json.loads(spath.read_text())
+    assert stats["queries"] == len(pairs)
+    assert "pipeline" in stats and "latency_ms" in stats
+
+
+def test_serve_cli_load(tmp_path, capsys):
+    from bibfs_tpu.graph.io import write_graph_bin
+    from bibfs_tpu.serve.cli import main as serve_main
+
+    n = 100
+    edges = _skiplink_graph(n)
+    gpath = tmp_path / "g.bin"
+    write_graph_bin(gpath, n, edges)
+    spath = tmp_path / "load.json"
+    rc = serve_main([str(gpath), "--load", "500", "--load-queries", "40",
+                     "--max-wait-ms", "50", "--stats-json", str(spath)])
+    assert rc == 0
+    art = json.loads(spath.read_text())
+    assert art["verified_vs_oracle"]
+    assert art["rates"][0]["sync"]["completed"] == 40
+    assert art["rates"][0]["pipelined"]["deadline"]["ok"]
