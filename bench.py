@@ -673,6 +673,41 @@ SERVE_N = int(os.environ.get("BENCH_SERVE_N", 10_000))
 SERVE_Q = int(os.environ.get("BENCH_SERVE_Q", 256))
 
 
+def _trace_setup():
+    """``--trace FILE`` on the serving benches: install the global span
+    tracer (bibfs_tpu/obs/trace) for the run. Returns
+    ``(tracer, path)`` — both None when tracing is off (the measured
+    path: a disabled span is one global check)."""
+    if "--trace" not in sys.argv:
+        return None, None
+    i = sys.argv.index("--trace")
+    if i + 1 >= len(sys.argv) or sys.argv[i + 1].startswith("--"):
+        print("Error: --trace needs a FILE argument", file=sys.stderr)
+        raise SystemExit(2)
+    from bibfs_tpu.obs.trace import Tracer, set_tracer
+
+    tracer = Tracer()
+    set_tracer(tracer)
+    return tracer, sys.argv[i + 1]
+
+
+def _trace_finish(tracer, path, line: dict) -> None:
+    """Write the Chrome-trace file and stamp its location into the
+    bench artifact line. A bad --trace path must not discard the
+    just-measured bench numbers: the helper reports the failure and the
+    artifact write proceeds (with ``trace_error`` recorded)."""
+    if tracer is None:
+        return
+    from bibfs_tpu.obs.trace import uninstall_and_save
+
+    line["trace_file"] = path
+    nev = uninstall_and_save(tracer, path)
+    if nev is None:
+        line["trace_error"] = f"could not write {path}"
+    else:
+        line["trace_events"] = nev
+
+
 def serve_main():
     """``python bench.py --serve``: engine-vs-naive serving throughput.
 
@@ -684,9 +719,12 @@ def serve_main():
     the serial oracle and warm traffic asserted dispatch-free. Emits one
     compact JSON line on stdout and the full machine-readable artifact
     to ``bench_serve.json`` (queries/sec, speedups, cache hit rates,
-    executable-reuse counters)."""
+    executable-reuse counters). ``--trace FILE`` additionally records
+    the engines' tracing spans (flushes, host batches, cache ops) and
+    writes a Perfetto-loadable Chrome-trace JSON."""
     t_setup = time.time()
     platform, tpu_error = select_platform()
+    tracer, trace_path = _trace_setup()
     try:
         from bibfs_tpu.graph.csr import build_csr, canonical_pairs
         from bibfs_tpu.graph.generate import gnp_random_graph
@@ -812,6 +850,7 @@ def serve_main():
         }
         if tpu_error:
             line["tpu_error"] = tpu_error[:300]
+        _trace_finish(tracer, trace_path, line)
         with open(
             os.path.join(os.path.dirname(os.path.abspath(__file__)),
                          "bench_serve.json"), "w"
@@ -866,9 +905,14 @@ def serve_load_main():
     is oracle-verified hop-for-hop (paths CSR-validated) and the
     pipelined engine's deadline compliance is checked from its own
     worst-case queue-wait counter. Emits one compact JSON line on
-    stdout and the full artifact to ``bench_load.json``."""
+    stdout and the full artifact to ``bench_load.json`` — including the
+    full per-rate latency histograms (``latency_hist``, the shared
+    log-bucket type) so the rate ladder is plottable, not just its
+    p50/p95/p99 scalars. ``--trace FILE`` records the pipelined runs'
+    spans as Chrome-trace JSON."""
     t_setup = time.time()
     platform, tpu_error = select_platform()
+    tracer, trace_path = _trace_setup()
     try:
         from bibfs_tpu.graph.csr import canonical_pairs
         from bibfs_tpu.graph.generate import gnp_random_graph
@@ -918,6 +962,7 @@ def serve_load_main():
         }
         if tpu_error:
             line["tpu_error"] = tpu_error[:300]
+        _trace_finish(tracer, trace_path, line)
         with open(
             os.path.join(os.path.dirname(os.path.abspath(__file__)),
                          "bench_load.json"), "w"

@@ -63,6 +63,14 @@ def main(argv=None):
     )
     ap.add_argument("--no-path", action="store_true", help="skip path printing")
     ap.add_argument(
+        "--level-stats",
+        action="store_true",
+        help="record per-level telemetry (frontier sizes, edges scanned, "
+        "push/pull direction, meet level) during the solve and print it "
+        "after the answer — supported by the serial/native/dense "
+        "backends (bibfs_tpu/obs/telemetry); single-query only",
+    )
+    ap.add_argument(
         "--repeat",
         type=int,
         default=1,
@@ -223,7 +231,16 @@ def main(argv=None):
         # chunked kernels do not thread the unroll parameter (yet)
         ap.error("--unroll is single-query only (no --pairs / "
                  "--checkpoint / --chunk / --resume)")
+    if args.level_stats:
+        if args.backend not in ("serial", "native", "dense"):
+            ap.error("--level-stats is supported by the serial/native/"
+                     "dense backends")
+        if args.pairs is not None or checkpointed or args.repeat > 1:
+            ap.error("--level-stats is single-query only (no --pairs / "
+                     "--checkpoint / --repeat)")
     kwargs = {}
+    if args.level_stats:
+        kwargs["telemetry"] = True
     if args.devices is not None:
         kwargs["num_devices"] = args.devices
     if args.backend in ("dense", "sharded"):
@@ -285,6 +302,13 @@ def main(argv=None):
     # scrapeable time line (same shape as v1/main-v1.cpp:101)
     print(f"[Time] {args.backend} bidirectional BFS took {res.time_s:.9f} seconds")
     print(f"[TEPS] {res.teps:.3e} traversed edges/second ({res.edges_scanned} edges)")
+    if args.level_stats and res.level_stats is not None:
+        for lv in res.level_stats["levels"]:
+            print(
+                "[Level] {level:>3} side={side} dir={dir:<4} "
+                "frontier={frontier:>8} edges={edges}".format(**lv)
+            )
+        print(f"[Level] meet_level={res.level_stats['meet_level']}")
     return 0
 
 

@@ -162,11 +162,21 @@ namespace {
 
 // May throw (frontier push_back / path vectors on OOM); the extern "C"
 // wrapper below fences it so no exception crosses the ABI.
+//
+// Optional per-level telemetry (all-or-nothing, enabled when lvl_side is
+// non-null): level i (< lvl_cap) writes the expanded side (0 = source,
+// 1 = target), the post-expansion frontier size, and the edges scanned
+// that level; *out_meet_level gets the 1-based level at which the final
+// best meet candidate was found (-1 if never). Disabled (the existing
+// exports) costs one pointer test per level.
 int solve_impl(uint32_t n, const int64_t* row_ptr, const int32_t* col_ind,
                void* scratch, uint32_t src, uint32_t dst,
                int32_t* out_hops, int32_t* path_buf, int32_t path_cap,
                int32_t* out_path_len, double* out_time_s,
-               int64_t* out_edges, int32_t* out_levels) {
+               int64_t* out_edges, int32_t* out_levels,
+               int32_t lvl_cap = 0, uint8_t* lvl_side = nullptr,
+               int32_t* lvl_frontier = nullptr, int64_t* lvl_edges = nullptr,
+               int32_t* out_meet_level = nullptr) {
   if (src >= n || dst >= n || !scratch) return BIBFS_EARG;
   auto* sc = static_cast<Scratch*>(scratch);
   if (sc->n != n) return BIBFS_EARG;
@@ -174,6 +184,7 @@ int solve_impl(uint32_t n, const int64_t* row_ptr, const int32_t* col_ind,
   *out_path_len = 0;
   *out_edges = 0;
   *out_levels = 0;
+  if (out_meet_level) *out_meet_level = -1;
 
   auto t0 = std::chrono::steady_clock::now();
   auto finish = [&]() {
@@ -217,6 +228,7 @@ int solve_impl(uint32_t n, const int64_t* row_ptr, const int32_t* col_ind,
     int32_t lvl = (s_side ? ++level_s : ++level_t);
 
     A.next.clear();
+    int64_t scanned_before = scanned;
     for (uint32_t u : A.fr) {
       for (int64_t i = row_ptr[u]; i < row_ptr[u + 1]; ++i) {
         ++scanned;
@@ -230,12 +242,18 @@ int solve_impl(uint32_t n, const int64_t* row_ptr, const int32_t* col_ind,
           if (cand < best) {
             best = cand;
             meet = v;
+            if (out_meet_level) *out_meet_level = levels + 1;
           }
         }
       }
     }
     A.fr.swap(A.next);
     ++levels;
+    if (lvl_side && levels <= lvl_cap) {
+      lvl_side[levels - 1] = s_side ? 0 : 1;
+      lvl_frontier[levels - 1] = int32_t(A.fr.size());
+      lvl_edges[levels - 1] = scanned - scanned_before;
+    }
   }
   finish();
   *out_edges = scanned;
@@ -282,6 +300,32 @@ int bibfs_solve_s(uint32_t n, const int64_t* row_ptr, const int32_t* col_ind,
                       path_buf, path_cap, out_path_len, out_time_s,
                       out_edges, out_levels);
   } catch (...) {  // bad_alloc etc. must not cross the C ABI
+    return BIBFS_ENOMEM;
+  }
+}
+
+// Scratch-reusing solve WITH per-level telemetry: identical search to
+// bibfs_solve_s, plus per-level outputs (see solve_impl) for the first
+// lvl_cap levels — side (0=s/1=t), post-expansion frontier size, edges
+// scanned — and the 1-based level of the final best meet candidate.
+// Levels past lvl_cap still run and count; only recording stops.
+int bibfs_solve_levels(uint32_t n, const int64_t* row_ptr,
+                       const int32_t* col_ind, void* scratch, uint32_t src,
+                       uint32_t dst, int32_t* out_hops, int32_t* path_buf,
+                       int32_t path_cap, int32_t* out_path_len,
+                       double* out_time_s, int64_t* out_edges,
+                       int32_t* out_levels, int32_t lvl_cap,
+                       uint8_t* lvl_side, int32_t* lvl_frontier,
+                       int64_t* lvl_edges, int32_t* out_meet_level) {
+  if (!lvl_side || !lvl_frontier || !lvl_edges || !out_meet_level ||
+      lvl_cap < 0)
+    return BIBFS_EARG;
+  try {
+    return solve_impl(n, row_ptr, col_ind, scratch, src, dst, out_hops,
+                      path_buf, path_cap, out_path_len, out_time_s,
+                      out_edges, out_levels, lvl_cap, lvl_side,
+                      lvl_frontier, lvl_edges, out_meet_level);
+  } catch (...) {
     return BIBFS_ENOMEM;
   }
 }

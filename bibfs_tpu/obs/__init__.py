@@ -1,0 +1,35 @@
+"""Unified observability: metrics registry, tracing spans, per-level
+solver telemetry.
+
+Three layers, one subsystem (each documented in its module):
+
+- :mod:`bibfs_tpu.obs.metrics` — process-wide registry of counters /
+  gauges / log-bucket histograms with labels and a Prometheus text
+  renderer; :data:`~bibfs_tpu.obs.metrics.REGISTRY` is the default
+  every serving component lands in.
+- :mod:`bibfs_tpu.obs.http` — the stdlib ``/metrics`` endpoint
+  (``bibfs-serve --metrics-port``).
+- :mod:`bibfs_tpu.obs.trace` — context-manager spans exported as
+  Chrome-trace/Perfetto JSON (``--trace out.json``).
+- :mod:`bibfs_tpu.obs.telemetry` — the opt-in ``telemetry=`` hook
+  recording per-level frontier/edge/direction stats onto
+  ``BFSResult.level_stats``.
+
+No JAX import anywhere in this package: observability must load (and
+serve ``/metrics``) even on hosts where only the native/serial
+backends run.
+"""
+
+from bibfs_tpu.obs.metrics import (  # noqa: F401
+    REGISTRY,
+    LogHistogram,
+    MetricsRegistry,
+    next_instance_label,
+)
+from bibfs_tpu.obs.telemetry import LevelTelemetry  # noqa: F401
+from bibfs_tpu.obs.trace import (  # noqa: F401
+    Tracer,
+    get_tracer,
+    set_tracer,
+    span,
+)

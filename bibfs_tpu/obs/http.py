@@ -1,0 +1,101 @@
+"""Tiny stdlib HTTP endpoint serving the metrics registry.
+
+``bibfs-serve --metrics-port N`` starts this next to the engine:
+``GET /metrics`` renders :data:`bibfs_tpu.obs.metrics.REGISTRY` in
+Prometheus text exposition format (content type
+``text/plain; version=0.0.4``), ``GET /healthz`` answers ``ok`` — the
+two endpoints a scraper and a liveness probe need, and nothing else.
+
+Stdlib only (``http.server`` on a daemon thread), by design: the
+serving process must not grow a web-framework dependency to be
+observable, and a ThreadingHTTPServer is plenty for scrape traffic
+(one request per Prometheus interval). Port 0 binds an ephemeral port;
+the chosen one is on ``server.port`` (and in the startup line the CLI
+prints), which is what the CI endpoint probe parses.
+"""
+
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from bibfs_tpu.obs.metrics import REGISTRY, MetricsRegistry
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def _make_handler(registry: MetricsRegistry):
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):
+            path = self.path.split("?", 1)[0]
+            if path == "/metrics":
+                body = registry.render().encode()
+                self.send_response(200)
+                self.send_header("Content-Type", CONTENT_TYPE)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            elif path == "/healthz":
+                body = b"ok\n"
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            else:
+                self.send_error(404)
+
+        def log_message(self, *a):  # scrapes must not spam stderr
+            pass
+
+    return Handler
+
+
+class MetricsServer:
+    """A running ``/metrics`` endpoint; ``close()`` tears it down."""
+
+    def __init__(
+        self,
+        port: int = 0,
+        registry: MetricsRegistry | None = None,
+        host: str = "127.0.0.1",
+    ):
+        registry = REGISTRY if registry is None else registry
+        self._httpd = ThreadingHTTPServer(
+            (host, int(port)), _make_handler(registry)
+        )
+        self._httpd.daemon_threads = True
+        self.host = host
+        self.port = int(self._httpd.server_address[1])
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name="bibfs-metrics-http",
+            daemon=True,
+        )
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}/metrics"
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=10.0)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+def start_metrics_server(
+    port: int = 0,
+    registry: MetricsRegistry | None = None,
+    host: str = "127.0.0.1",
+) -> MetricsServer:
+    """Start serving ``registry`` (default: the process-wide one) on
+    ``host:port`` (port 0 = ephemeral); returns the running server."""
+    return MetricsServer(port=port, registry=registry, host=host)

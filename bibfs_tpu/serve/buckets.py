@@ -30,6 +30,7 @@ import threading
 import numpy as np
 
 from bibfs_tpu.graph.csr import EllGraph, build_ell
+from bibfs_tpu.obs.metrics import REGISTRY, next_instance_label
 
 # Geometric ladders. Rows start at 128 (one lane group) and double;
 # widths start at the int32 sublane quantum 8 and double; batch buckets
@@ -110,30 +111,74 @@ class ExecutableCache:
     different graphs in one bucket see each other's compiles — exactly
     the reuse the buckets exist to create. Thread-safe throughout: the
     pipelined engine's flusher notes dispatches concurrently with any
-    number of synchronous engines in the same process."""
+    number of synchronous engines in the same process.
 
-    def __init__(self):
+    All accounting lives in the process metrics registry under the
+    stable documented names ``bibfs_exec_cache_events_total{cache,
+    event="hit"|"miss"}``, ``bibfs_exec_programs{cache}`` and
+    ``bibfs_exec_program_dispatches_total{cache,program}``;
+    ``stats()``/``program_counts()`` are snapshot views over them."""
+
+    def __init__(self, metrics_label: str | None = None):
         self._seen: dict = {}  # program key -> dispatch count
         self._lock = threading.Lock()
-        self.hits = 0
-        self.misses = 0
+        self.metrics_label = (
+            next_instance_label("exec") if metrics_label is None
+            else metrics_label
+        )
+        events = REGISTRY.counter(
+            "bibfs_exec_cache_events_total",
+            "Compiled-program reuse accounting (hit = reused executable)",
+            ("cache", "event"),
+        )
+        self._m_hit = events.labels(cache=self.metrics_label, event="hit")
+        self._m_miss = events.labels(cache=self.metrics_label, event="miss")
+        self._g_programs = REGISTRY.gauge(
+            "bibfs_exec_programs",
+            "Distinct compiled programs dispatched through this cache",
+            ("cache",),
+        ).labels(cache=self.metrics_label)
+        self._m_dispatch = REGISTRY.counter(
+            "bibfs_exec_program_dispatches_total",
+            "Dispatches per compiled-program identity",
+            ("cache", "program"),
+        )
+
+    @property
+    def hits(self) -> int:
+        return self._m_hit.value
+
+    @property
+    def misses(self) -> int:
+        return self._m_miss.value
 
     def note(self, key) -> bool:
-        """Record a dispatch under ``key``; True iff already compiled."""
+        """Record a dispatch under ``key``; True iff already compiled.
+
+        The registry cells are lock-free (obs/metrics.py's contract:
+        mutators of one cell serialize externally), so every increment
+        happens under THIS cache's lock — it is the shared
+        DEFAULT_EXEC_CACHE that concurrent engines note into."""
         with self._lock:
             if key in self._seen:
                 self._seen[key] += 1
-                self.hits += 1
-                return True
-            self._seen[key] = 1
-            self.misses += 1
-            return False
+                hit = True
+                self._m_hit.inc()
+            else:
+                self._seen[key] = 1
+                hit = False
+                self._m_miss.inc()
+                self._g_programs.inc()
+            self._m_dispatch.labels(
+                cache=self.metrics_label, program=str(key)
+            ).inc()
+        return hit
 
     def stats(self) -> dict:
-        with self._lock:
+        with self._lock:  # one atomic snapshot: a miss always inserts
             return {
-                "hits": self.hits,
-                "misses": self.misses,
+                "hits": self._m_hit.value,
+                "misses": self._m_miss.value,
                 "programs": len(self._seen),
             }
 
@@ -150,4 +195,4 @@ class ExecutableCache:
         return {str(k): v for k, v in ranked}
 
 
-DEFAULT_EXEC_CACHE = ExecutableCache()
+DEFAULT_EXEC_CACHE = ExecutableCache(metrics_label="default")

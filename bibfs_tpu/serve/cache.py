@@ -26,7 +26,10 @@ and every submitting client thread all read and write one cache.
 Eviction accounting is complete — forest pops and pair-memo pops each
 feed their own counter, and ``evictions`` is their sum (the pair-memo
 pops used to bypass the counter entirely, so ``stats()`` under-reported
-churn).
+churn) — and every counter lives in the process metrics registry
+(``bibfs_dist_cache_events_total{cache,event}``,
+``bibfs_dist_cache_entries{cache,store}``), so one ``/metrics`` scrape
+reads the same ledger ``stats()`` snapshots.
 """
 
 from __future__ import annotations
@@ -35,6 +38,31 @@ import threading
 from collections import OrderedDict
 
 import numpy as np
+
+from bibfs_tpu.obs.metrics import REGISTRY, next_instance_label
+from bibfs_tpu.obs.trace import span
+
+# stable documented metric names (README "Observability")
+_EVENTS = ("forest_hit", "pair_hit", "miss", "insert",
+           "forest_eviction", "pair_eviction")
+
+
+def _cache_cells(label: str) -> tuple[dict, dict]:
+    events = REGISTRY.counter(
+        "bibfs_dist_cache_events_total",
+        "Distance/result cache events by kind",
+        ("cache", "event"),
+    )
+    entries = REGISTRY.gauge(
+        "bibfs_dist_cache_entries",
+        "Live distance-cache entries per store",
+        ("cache", "store"),
+    )
+    return (
+        {e: events.labels(cache=label, event=e) for e in _EVENTS},
+        {s: entries.labels(cache=label, store=s)
+         for s in ("forests", "pairs")},
+    )
 
 
 def walk_parents(par: np.ndarray, root: int, v: int) -> list[int] | None:
@@ -61,22 +89,51 @@ def walk_parents(par: np.ndarray, root: int, v: int) -> list[int] | None:
 class DistanceCache:
     """LRU source forests + pair memo (module docstring). ``entries``
     bounds the forest store (the memory owner: one int32[n] row each);
-    ``pair_entries`` the memo (tiny tuples; defaults to 8x)."""
+    ``pair_entries`` the memo (tiny tuples; defaults to 8x).
+    ``metrics_label`` is the registry ``cache=`` label value (engines
+    pass their own label so one scrape separates engines; standalone
+    caches get a process-unique one)."""
 
-    def __init__(self, entries: int = 64, pair_entries: int | None = None):
+    def __init__(self, entries: int = 64, pair_entries: int | None = None,
+                 metrics_label: str | None = None):
         self.entries = int(entries)
         self.pair_entries = int(
             8 * entries if pair_entries is None else pair_entries
         )
+        self.metrics_label = (
+            next_instance_label("dist") if metrics_label is None
+            else metrics_label
+        )
+        self._m, self._g = _cache_cells(self.metrics_label)
         self._lock = threading.RLock()
         self._forests: OrderedDict = OrderedDict()
         self._pairs: OrderedDict = OrderedDict()
-        self.forest_hits = 0
-        self.pair_hits = 0
-        self.misses = 0
-        self.inserts = 0
-        self.forest_evictions = 0
-        self.pair_evictions = 0
+
+    # counter attributes kept as registry-cell reads (back-compat: these
+    # were plain ints before the obs migration)
+    @property
+    def forest_hits(self) -> int:
+        return self._m["forest_hit"].value
+
+    @property
+    def pair_hits(self) -> int:
+        return self._m["pair_hit"].value
+
+    @property
+    def misses(self) -> int:
+        return self._m["miss"].value
+
+    @property
+    def inserts(self) -> int:
+        return self._m["insert"].value
+
+    @property
+    def forest_evictions(self) -> int:
+        return self._m["forest_eviction"].value
+
+    @property
+    def pair_evictions(self) -> int:
+        return self._m["pair_eviction"].value
 
     @property
     def evictions(self) -> int:
@@ -90,14 +147,16 @@ class DistanceCache:
         if self.entries <= 0:
             return
         key = (graph_id, int(root))
-        row = np.asarray(par[:n], dtype=np.int32).copy()
-        with self._lock:
-            self._forests[key] = row
-            self._forests.move_to_end(key)
-            self.inserts += 1
-            while len(self._forests) > self.entries:
-                self._forests.popitem(last=False)
-                self.forest_evictions += 1
+        with span("cache_put", kind="forest"):
+            row = np.asarray(par[:n], dtype=np.int32).copy()
+            with self._lock:
+                self._forests[key] = row
+                self._forests.move_to_end(key)
+                self._m["insert"].inc()
+                while len(self._forests) > self.entries:
+                    self._forests.popitem(last=False)
+                    self._m["forest_eviction"].inc()
+                self._g["forests"].set(len(self._forests))
 
     def put_path(self, graph_id, path, n: int):
         """Bank a solved shortest path as (partial) forests for BOTH its
@@ -110,21 +169,22 @@ class DistanceCache:
         parents stand; both chains are distance-consistent)."""
         if self.entries <= 0 or path is None or len(path) < 2:
             return
-        with self._lock:
+        with span("cache_put", kind="path"), self._lock:
             for chain in (path, list(reversed(path))):
                 key = (graph_id, int(chain[0]))
                 par = self._forests.get(key)
                 if par is None:
                     par = np.full(n, -1, np.int32)
                     self._forests[key] = par
-                    self.inserts += 1
+                    self._m["insert"].inc()
                 for prev, v in zip(chain[:-1], chain[1:]):
                     if 0 <= v < par.size and par[v] < 0:
                         par[v] = prev
                 self._forests.move_to_end(key)
             while len(self._forests) > self.entries:
                 self._forests.popitem(last=False)
-                self.forest_evictions += 1
+                self._m["forest_eviction"].inc()
+            self._g["forests"].set(len(self._forests))
 
     def put_result(self, graph_id, src: int, dst: int,
                    found: bool, hops, path):
@@ -139,7 +199,8 @@ class DistanceCache:
             self._pairs.move_to_end((graph_id, a, b))
             while len(self._pairs) > self.pair_entries:
                 self._pairs.popitem(last=False)
-                self.pair_evictions += 1
+                self._m["pair_eviction"].inc()
+            self._g["pairs"].set(len(self._pairs))
 
     # ---- lookup ------------------------------------------------------
     def lookup(self, graph_id, src: int, dst: int):
@@ -147,11 +208,11 @@ class DistanceCache:
         pair memo, then the src forest, then the dst forest (reverse
         twin)."""
         a, b = (src, dst) if src < dst else (dst, src)
-        with self._lock:
+        with span("cache_lookup"), self._lock:
             memo = self._pairs.get((graph_id, a, b))
             if memo is not None:
                 self._pairs.move_to_end((graph_id, a, b))
-                self.pair_hits += 1
+                self._m["pair_hit"].inc()
                 found, hops, path = memo
                 if found and path is not None and src != path[0]:
                     path = list(reversed(path))
@@ -164,14 +225,16 @@ class DistanceCache:
                 if chain is None:
                     continue
                 self._forests.move_to_end((graph_id, root))
-                self.forest_hits += 1
+                self._m["forest_hit"].inc()
                 if reverse:
                     chain.reverse()  # walk gave [dst..src]; want src->dst
                 return True, len(chain) - 1, chain
-            self.misses += 1
+            self._m["miss"].inc()
             return None
 
     def stats(self) -> dict:
+        """Snapshot view over this cache's registry cells (the same
+        numbers ``/metrics`` renders under ``cache="{metrics_label}"``)."""
         with self._lock:
             return {
                 "forest_hits": self.forest_hits,

@@ -164,6 +164,26 @@ def main(argv=None):
     ap.add_argument("--no-path", action="store_true",
                     help="skip path printing")
     ap.add_argument(
+        "--metrics-port",
+        type=int,
+        default=None,
+        metavar="PORT",
+        help="serve the process metrics registry over HTTP: GET "
+        "/metrics returns Prometheus text exposition (counters, cache "
+        "hit rates, flush causes, latency histograms — "
+        "bibfs_tpu/obs/metrics), /healthz returns ok. PORT 0 binds an "
+        "ephemeral port; the chosen one is printed to stderr",
+    )
+    ap.add_argument(
+        "--trace",
+        default=None,
+        metavar="FILE",
+        help="record tracing spans (flush, device_launch/device_finish, "
+        "host_batch, cache ops) and write them to FILE as Chrome-trace "
+        "JSON on exit — open in https://ui.perfetto.dev or "
+        "chrome://tracing (bibfs_tpu/obs/trace)",
+    )
+    ap.add_argument(
         "--stats-json",
         default=None,
         metavar="FILE",
@@ -184,13 +204,47 @@ def main(argv=None):
         print(f"Error reading graph: {e}", file=sys.stderr)
         return 2
 
-    if args.load is not None:
-        try:
-            return _run_load(args, n, edges)
-        except ValueError as e:
-            print(f"Error: {e}", file=sys.stderr)
-            return 2
+    # observability surfaces: both wrap the whole serving (or load) run
+    metrics_server = None
+    if args.metrics_port is not None:
+        from bibfs_tpu.obs.http import start_metrics_server
 
+        try:
+            metrics_server = start_metrics_server(args.metrics_port)
+        except OSError as e:
+            print(f"Error: cannot bind metrics port "
+                  f"{args.metrics_port}: {e}", file=sys.stderr)
+            return 2
+        print(f"[Obs] serving /metrics on {metrics_server.url}",
+              file=sys.stderr, flush=True)
+    tracer = None
+    if args.trace is not None:
+        from bibfs_tpu.obs.trace import Tracer, set_tracer
+
+        tracer = Tracer()
+        set_tracer(tracer)
+
+    try:
+        if args.load is not None:
+            try:
+                return _run_load(args, n, edges)
+            except ValueError as e:
+                print(f"Error: {e}", file=sys.stderr)
+                return 2
+        return _serve(args, n, edges, QueryEngine, PipelinedQueryEngine)
+    finally:
+        if tracer is not None:
+            from bibfs_tpu.obs.trace import uninstall_and_save
+
+            # served queries already printed; a bad trace path must not
+            # turn a completed run into a traceback (or skip the
+            # metrics-server teardown below) — the helper reports it
+            uninstall_and_save(tracer, args.trace)
+        if metrics_server is not None:
+            metrics_server.close()
+
+
+def _serve(args, n, edges, QueryEngine, PipelinedQueryEngine):
     try:
         kwargs = dict(
             mode=args.mode,

@@ -46,6 +46,8 @@ import time
 
 import numpy as np
 
+from bibfs_tpu.obs.metrics import REGISTRY, MetricBank, next_instance_label
+from bibfs_tpu.obs.trace import span
 from bibfs_tpu.serve.buckets import (
     DEFAULT_EXEC_CACHE,
     ExecutableCache,
@@ -54,6 +56,41 @@ from bibfs_tpu.serve.buckets import (
 )
 from bibfs_tpu.serve.cache import DistanceCache
 from bibfs_tpu.solvers.api import BFSResult
+
+
+def _engine_counter_bank(label: str) -> MetricBank:
+    """The engine's query-accounting cells, all in the process registry
+    under the stable documented names (README "Observability"). One
+    bank per engine instance (label = ``engine="sync-3"`` etc.), so
+    per-engine ``stats()`` stays exact while ``/metrics`` sees every
+    engine in one scrape."""
+    queries = REGISTRY.counter(
+        "bibfs_queries_total", "Queries submitted to a serving engine",
+        ("engine",),
+    )
+    routed = REGISTRY.counter(
+        "bibfs_queries_routed_total",
+        "Queries by resolution route (trivial/cache/device/host)",
+        ("engine", "route"),
+    )
+    batches = REGISTRY.counter(
+        "bibfs_device_batches_total", "Batched device flush dispatches",
+        ("engine",),
+    )
+    skipped = REGISTRY.counter(
+        "bibfs_cache_inserts_skipped_total",
+        "Forest-bank inserts skipped by flush-time hygiene",
+        ("engine",),
+    )
+    return MetricBank({
+        "queries": queries.labels(engine=label),
+        "trivial": routed.labels(engine=label, route="trivial"),
+        "cache_served": routed.labels(engine=label, route="cache"),
+        "device_batches": batches.labels(engine=label),
+        "device_queries": routed.labels(engine=label, route="device"),
+        "host_queries": routed.labels(engine=label, route="host"),
+        "inserts_skipped": skipped.labels(engine=label),
+    })
 
 
 class _Pending:
@@ -99,7 +136,15 @@ class QueryEngine:
     graph_id : distance-cache namespace for this graph (only matters if
         a :class:`DistanceCache` is ever shared across engines; defaults
         to a per-engine unique value).
+    obs_label : the ``engine=`` label value this engine's counters carry
+        in the process metrics registry (default: a process-unique
+        ``sync-N`` / ``pipe-N``). ``counters`` (and the pipelined
+        subclass's ``pipe_counters``) are dict-style views over those
+        registry cells, so ``stats()`` and a ``/metrics`` scrape always
+        agree.
     """
+
+    _OBS_PREFIX = "sync"
 
     def __init__(
         self,
@@ -117,6 +162,7 @@ class QueryEngine:
         exec_cache: ExecutableCache | None = None,
         graph_id=None,
         device=None,
+        obs_label: str | None = None,
     ):
         from bibfs_tpu.graph.csr import canonical_pairs
         from bibfs_tpu.solvers.batch_minor import small_batch_threshold
@@ -148,7 +194,13 @@ class QueryEngine:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
         self.max_batch = bucket_batch(max_batch)
         self.graph_id = id(self) if graph_id is None else graph_id
-        self.dist_cache = DistanceCache(entries=cache_entries)
+        self.obs_label = (
+            next_instance_label(self._OBS_PREFIX) if obs_label is None
+            else obs_label
+        )
+        self.dist_cache = DistanceCache(
+            entries=cache_entries, metrics_label=self.obs_label
+        )
         self.exec_cache = (
             DEFAULT_EXEC_CACHE if exec_cache is None else exec_cache
         )
@@ -157,17 +209,18 @@ class QueryEngine:
         self._host_solver = None  # built lazily on first host-routed flush
         self._host_native_graph = None  # set alongside a native solver
         self._pending: list[_Pending] = []
-        self.counters = {
-            "queries": 0,
-            "trivial": 0,  # src == dst, answered inline
-            "cache_served": 0,
-            "device_batches": 0,
-            "device_queries": 0,  # unique queries solved on the device
-            "host_queries": 0,  # unique queries solved host-side
-            # forest-bank inserts skipped by flush-time hygiene (dupe
-            # roots within one flush + roots the LRU would evict anyway)
-            "inserts_skipped": 0,
-        }
+        # registry-backed view; keys unchanged from the pre-obs dict:
+        # queries, trivial (src == dst, answered inline), cache_served,
+        # device_batches, device_queries / host_queries (unique queries
+        # solved per route), inserts_skipped (forest-bank inserts skipped
+        # by flush-time hygiene)
+        self.counters = _engine_counter_bank(self.obs_label)
+        # direct cell handles for the per-query submit path (skips the
+        # bank's read-modify-write indirection in the hot loop)
+        self._c_queries = self.counters.cell("queries")
+        self._c_trivial = self.counters.cell("trivial")
+        self._c_cache_served = self.counters.cell("cache_served")
+        self._c_host_queries = self.counters.cell("host_queries")
 
     @property
     def graph(self):
@@ -199,15 +252,15 @@ class QueryEngine:
         if not (0 <= src < self.n and 0 <= dst < self.n):
             raise ValueError(f"src/dst out of range for n={self.n}")
         t = _Pending(src, dst)
-        self.counters["queries"] += 1
+        self._c_queries.inc()
         if src == dst:
-            self.counters["trivial"] += 1
+            self._c_trivial.inc()
             t.result = BFSResult(True, 0, [src], src, 0.0, 0, 0)
             return t
         hit = self.dist_cache.lookup(self.graph_id, src, dst)
         if hit is not None:
             found, hops, path = hit
-            self.counters["cache_served"] += 1
+            self._c_cache_served.inc()
             t.result = BFSResult(
                 found, hops if found else None, path if found else None,
                 None, 0.0, 0, 0,
@@ -242,23 +295,24 @@ class QueryEngine:
         pend, self._pending = self._pending, []
         if not pend:
             return
-        # dedupe exact repeats within one flush: serving traffic repeats,
-        # and a batch slot per duplicate would be pure waste
-        unique: dict[tuple[int, int], list[_Pending]] = {}
-        for t in pend:
-            unique.setdefault((t.src, t.dst), []).append(t)
-        pairs = list(unique)
-        if len(pairs) < self.flush_threshold or not self._use_device():
-            self._flush_host(pairs, unique)
-            return
-        for i in range(0, len(pairs), self.max_batch):
-            chunk = pairs[i: i + self.max_batch]
-            if i and len(chunk) < self.flush_threshold:
-                # a sub-crossover tail after full chunks: host latency
-                # beats padding a whole batch rung for a few stragglers
-                self._flush_host(chunk, unique)
-            else:
-                self._flush_device(chunk, unique)
+        with span("flush", queued=len(pend)):
+            # dedupe exact repeats within one flush: serving traffic
+            # repeats, and a batch slot per duplicate would be pure waste
+            unique: dict[tuple[int, int], list[_Pending]] = {}
+            for t in pend:
+                unique.setdefault((t.src, t.dst), []).append(t)
+            pairs = list(unique)
+            if len(pairs) < self.flush_threshold or not self._use_device():
+                self._flush_host(pairs, unique)
+                return
+            for i in range(0, len(pairs), self.max_batch):
+                chunk = pairs[i: i + self.max_batch]
+                if i and len(chunk) < self.flush_threshold:
+                    # a sub-crossover tail after full chunks: host latency
+                    # beats padding a whole batch rung for a few stragglers
+                    self._flush_host(chunk, unique)
+                else:
+                    self._flush_device(chunk, unique)
 
     def _flush_device(self, pairs, unique) -> None:
         out, finish, t0 = self._device_launch(pairs)
@@ -276,20 +330,21 @@ class QueryEngine:
         from bibfs_tpu.solvers.batch_minor import auto_batch_mode
         from bibfs_tpu.solvers.dense import _batch_dispatch
 
-        graph = self.graph  # lazy build; also sets self._bucket_key
-        rung = min(bucket_batch(len(pairs)), self.max_batch)
-        # pad the flush to its batch rung with inert (0, 0) queries so
-        # every queue depth maps onto a handful of compiled programs
-        padded = np.zeros((rung, 2), dtype=np.int64)
-        padded[: len(pairs)] = pairs
-        mode = self.mode
-        if mode == "auto":
-            mode = auto_batch_mode(graph, rung)
-        self.exec_cache.note((self._bucket_key, mode, rung))
-        _p, dispatch, finish = _batch_dispatch(graph, padded, mode)
-        t0 = time.perf_counter()
-        out = dispatch()
-        return out, finish, t0
+        with span("device_launch", batch=len(pairs)):
+            graph = self.graph  # lazy build; also sets self._bucket_key
+            rung = min(bucket_batch(len(pairs)), self.max_batch)
+            # pad the flush to its batch rung with inert (0, 0) queries so
+            # every queue depth maps onto a handful of compiled programs
+            padded = np.zeros((rung, 2), dtype=np.int64)
+            padded[: len(pairs)] = pairs
+            mode = self.mode
+            if mode == "auto":
+                mode = auto_batch_mode(graph, rung)
+            self.exec_cache.note((self._bucket_key, mode, rung))
+            _p, dispatch, finish = _batch_dispatch(graph, padded, mode)
+            t0 = time.perf_counter()
+            out = dispatch()
+            return out, finish, t0
 
     def _device_finish(self, out, finish, t0, pairs) -> list[BFSResult]:
         """Stage 2 of a device flush: force execution, run the host-side
@@ -300,14 +355,17 @@ class QueryEngine:
         from bibfs_tpu.solvers.dense import _materialize_batch
         from bibfs_tpu.solvers.timing import force_scalar
 
-        force_scalar(out)  # lazy runtimes execute at the value read
-        elapsed = time.perf_counter() - t0
-        outs = finish(out)
-        results = _materialize_batch(outs, len(pairs), elapsed)
-        self.counters["device_batches"] += 1
-        self.counters["device_queries"] += len(pairs)
-        self._bank_forests(pairs, np.asarray(outs[2]), np.asarray(outs[3]))
-        return results
+        with span("device_finish", batch=len(pairs)):
+            force_scalar(out)  # lazy runtimes execute at the value read
+            elapsed = time.perf_counter() - t0
+            outs = finish(out)
+            results = _materialize_batch(outs, len(pairs), elapsed)
+            self.counters["device_batches"] += 1
+            self.counters["device_queries"] += len(pairs)
+            self._bank_forests(
+                pairs, np.asarray(outs[2]), np.asarray(outs[3])
+            )
+            return results
 
     def _bank_forests(self, pairs, par_s, par_t) -> None:
         """Bank both sides' parent forests: level-synchronous searches
@@ -322,6 +380,10 @@ class QueryEngine:
         most recently solved) and bank only the newest
         ``dist_cache.entries`` roots; everything skipped lands in the
         ``inserts_skipped`` counter."""
+        with span("bank_forests", batch=len(pairs)):
+            self._bank_forests_inner(pairs, par_s, par_t)
+
+    def _bank_forests_inner(self, pairs, par_s, par_t) -> None:
         planes: dict[int, tuple[np.ndarray, int]] = {}
         rank: dict[int, int] = {}
         k = 0
@@ -353,8 +415,8 @@ class QueryEngine:
     def _flush_host(self, pairs, unique) -> None:
         results = self._solve_host(pairs)
         bank = self._paths_to_bank(results)
+        self._c_host_queries.inc(len(pairs))
         for i, ((src, dst), res) in enumerate(zip(pairs, results)):
-            self.counters["host_queries"] += 1
             # no parent planes on the host path, but the shortest path
             # itself is a valid forest fragment for both endpoints — so
             # repeated-source traffic stays cache-servable on this route
@@ -387,23 +449,25 @@ class QueryEngine:
         threads — ``solvers/native.solve_batch_native_graph``) when the
         native runtime carries the route and the flush is big enough to
         amortize it, else the per-query solver loop."""
-        solver = self._get_host_solver()
-        ng = self._host_native_graph
-        if ng is not None and len(pairs) >= self.HOST_BATCH_MIN:
-            from bibfs_tpu.solvers.native import solve_batch_native_graph
+        with span("host_batch", batch=len(pairs)):
+            solver = self._get_host_solver()
+            ng = self._host_native_graph
+            if ng is not None and len(pairs) >= self.HOST_BATCH_MIN:
+                from bibfs_tpu.solvers.native import solve_batch_native_graph
 
-            results = solve_batch_native_graph(
-                ng, np.asarray(pairs, dtype=np.int64)
-            )
-            # the batch's per-query path buffer is capped (default 512;
-            # a full n+1 per lane would cost B*(n+1) ints per flush) —
-            # a found result with no path hit that cap, so re-solve just
-            # those per-query, which always carries the full buffer
-            return [
-                solver(src, dst) if (r.found and r.path is None) else r
-                for (src, dst), r in zip(pairs, results)
-            ]
-        return [solver(src, dst) for src, dst in pairs]
+                results = solve_batch_native_graph(
+                    ng, np.asarray(pairs, dtype=np.int64)
+                )
+                # the batch's per-query path buffer is capped (default
+                # 512; a full n+1 per lane would cost B*(n+1) ints per
+                # flush) — a found result with no path hit that cap, so
+                # re-solve just those per-query, which always carries
+                # the full buffer
+                return [
+                    solver(src, dst) if (r.found and r.path is None) else r
+                    for (src, dst), r in zip(pairs, results)
+                ]
+            return [solver(src, dst) for src, dst in pairs]
 
     def _resolve(self, tickets, src, dst, res: BFSResult) -> None:
         self.dist_cache.put_result(

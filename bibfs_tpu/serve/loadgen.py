@@ -56,6 +56,20 @@ def sample_query_pairs(n: int, q: int, seed: int = 0) -> np.ndarray:
     return pairs
 
 
+def _latency_hist(lats_s: list[float]) -> dict:
+    """The full per-rate latency distribution, exported through the
+    shared observability histogram type
+    (:class:`bibfs_tpu.obs.metrics.LogHistogram`) so rate-ladder runs
+    are plottable from ``bench_load.json`` — the p50/p95/p99 scalars
+    alone cannot reconstruct a CDF, and the buckets here are the SAME
+    geometric ladder the engines' ``/metrics`` histograms use."""
+    from bibfs_tpu.obs.metrics import LogHistogram
+
+    h = LogHistogram()
+    h.record_many(lats_s)
+    return h.to_dict()
+
+
 def _percentiles_ms(lats_s: list[float]) -> dict:
     if not lats_s:
         return {"count": 0, "mean_ms": 0.0, "p50_ms": 0.0, "p95_ms": 0.0,
@@ -214,6 +228,7 @@ def run_load_point(
             "sustained_qps": round(len(results) / elapsed, 1)
             if elapsed > 0 else None,
             "latency_ms": _percentiles_ms(lats),
+            "latency_hist": _latency_hist(lats),
             "ok": not errors,
             "errors": errors[:10],
         }

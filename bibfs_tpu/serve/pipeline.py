@@ -50,96 +50,65 @@ mutated under the engine lock or on the single finish worker.
 
 from __future__ import annotations
 
-import math
 import threading
 import time
 from collections import OrderedDict, deque
 from concurrent.futures import ThreadPoolExecutor
 
+from bibfs_tpu.obs.metrics import REGISTRY, LogHistogram, MetricBank
+from bibfs_tpu.obs.trace import span
 from bibfs_tpu.serve.engine import QueryEngine, _Pending
 from bibfs_tpu.solvers.api import BFSResult
 
+# The latency histogram grew into the general observability type
+# (bibfs_tpu/obs/metrics.LogHistogram): same geometric buckets, same
+# percentile reads, now also registry-attachable and Prometheus-rendered.
+# The name stays importable from here (tests and downstream code use it).
+LatencyHistogram = LogHistogram
 
-class LatencyHistogram:
-    """Thread-safe log-bucketed latency histogram.
 
-    O(1) memory at any traffic volume: samples land in geometric buckets
-    (ratio 2^1/4 ≈ 19% resolution, 1 µs .. ~100 s) and percentiles read
-    the bucket upper edge where the cumulative count crosses the rank —
-    a ~19% overestimate bound, which is plenty for an SLO dashboard and
-    never samples away tail events (exact ``max`` is tracked aside)."""
-
-    _BASE = 1e-6  # 1 µs
-    _RATIO = 2 ** 0.25
-    _NBUCKETS = 108  # last edge ~ 1e-6 * 2^(107/4) ≈ 127 s
-
-    def __init__(self):
-        self._lock = threading.Lock()
-        self._counts = [0] * self._NBUCKETS
-        self.count = 0
-        self.total_s = 0.0
-        self.max_s = 0.0
-
-    def _bucket(self, s: float) -> int:
-        if s <= self._BASE:
-            return 0
-        return min(
-            int(math.log(s / self._BASE, self._RATIO)) + 1,
-            self._NBUCKETS - 1,
-        )
-
-    def record(self, seconds: float) -> None:
-        s = max(float(seconds), 0.0)
-        i = self._bucket(s)
-        with self._lock:
-            self._counts[i] += 1
-            self.count += 1
-            self.total_s += s
-            if s > self.max_s:
-                self.max_s = s
-
-    def record_many(self, seconds_list) -> None:
-        """One lock acquisition for a whole batch of samples — the
-        per-query histogram cost in the serving hot loop is the bucket
-        index, not a lock handoff."""
-        if not seconds_list:
-            return
-        samples = [(max(float(s), 0.0)) for s in seconds_list]
-        with self._lock:
-            for s in samples:
-                self._counts[self._bucket(s)] += 1
-                self.total_s += s
-                if s > self.max_s:
-                    self.max_s = s
-            self.count += len(samples)
-
-    def percentile(self, q: float) -> float:
-        """Upper-edge estimate of the ``q``-quantile (0 < q <= 1), in
-        seconds; 0.0 when empty."""
-        with self._lock:
-            if self.count == 0:
-                return 0.0
-            rank = q * self.count
-            seen = 0
-            for i, c in enumerate(self._counts):
-                seen += c
-                if seen >= rank:
-                    return min(self._BASE * self._RATIO ** i, self.max_s)
-            return self.max_s
-
-    def summary_ms(self) -> dict:
-        """The stats() block: count/mean plus the SLO percentiles."""
-        p50, p95, p99 = (self.percentile(q) for q in (0.5, 0.95, 0.99))
-        with self._lock:
-            mean = self.total_s / self.count if self.count else 0.0
-            return {
-                "count": self.count,
-                "mean_ms": round(mean * 1e3, 4),
-                "p50_ms": round(p50 * 1e3, 4),
-                "p95_ms": round(p95 * 1e3, 4),
-                "p99_ms": round(p99 * 1e3, 4),
-                "max_ms": round(self.max_s * 1e3, 4),
-            }
+def _pipe_counter_bank(label: str) -> MetricBank:
+    """The pipelined engine's registry cells (stable names documented in
+    README "Observability"): flush causes as one labeled counter family,
+    watermarks as gauges — same keys the pre-obs ``pipe_counters`` dict
+    had."""
+    flushes = REGISTRY.counter(
+        "bibfs_flushes_total", "Background flusher batches popped",
+        ("engine",),
+    )
+    cause = REGISTRY.counter(
+        "bibfs_flush_cause_total",
+        "Flushes by trigger (depth/deadline/drain)",
+        ("engine", "cause"),
+    )
+    blocked = REGISTRY.counter(
+        "bibfs_submit_blocked_total",
+        "Admissions throttled by the max_queue bound",
+        ("engine",),
+    )
+    depth_max = REGISTRY.gauge(
+        "bibfs_serve_queue_depth_max", "Deepest queue seen", ("engine",)
+    )
+    wait_max = REGISTRY.gauge(
+        "bibfs_queue_wait_max_ms",
+        "Worst submit->pop queue wait (the deadline-compliance witness)",
+        ("engine",),
+    )
+    service_max = REGISTRY.gauge(
+        "bibfs_batch_service_max_ms",
+        "Worst launch->resolved batch service time",
+        ("engine",),
+    )
+    return MetricBank({
+        "flushes": flushes.labels(engine=label),
+        "depth_flushes": cause.labels(engine=label, cause="depth"),
+        "deadline_flushes": cause.labels(engine=label, cause="deadline"),
+        "drain_flushes": cause.labels(engine=label, cause="drain"),
+        "max_queue_depth": depth_max.labels(engine=label),
+        "queue_wait_max_ms": wait_max.labels(engine=label),
+        "batch_service_max_ms": service_max.labels(engine=label),
+        "submit_blocked": blocked.labels(engine=label),
+    })
 
 
 class _StageClock:
@@ -264,6 +233,8 @@ class PipelinedQueryEngine(QueryEngine):
     as a context manager) to drain and tear down the worker threads.
     """
 
+    _OBS_PREFIX = "pipe"
+
     def __init__(
         self,
         n: int,
@@ -293,18 +264,23 @@ class PipelinedQueryEngine(QueryEngine):
         self._flush_req = False
         self._closed = False
         self._inflight = threading.BoundedSemaphore(int(max_inflight))
-        self.latency = LatencyHistogram()
+        self.latency = REGISTRY.histogram(
+            "bibfs_query_latency_seconds",
+            "Per-query submit-to-resolve latency",
+            ("engine",),
+        ).labels(engine=self.obs_label)
+        self._g_queue_depth = REGISTRY.gauge(
+            "bibfs_serve_queue_depth", "Queries currently queued",
+            ("engine",),
+        ).labels(engine=self.obs_label)
         self.stages = _StageClock()
-        self.pipe_counters = {
-            "flushes": 0,
-            "depth_flushes": 0,
-            "deadline_flushes": 0,
-            "drain_flushes": 0,  # explicit flush() / close() induced
-            "max_queue_depth": 0,
-            "queue_wait_max_ms": 0.0,  # submit -> batch pop, worst case
-            "batch_service_max_ms": 0.0,  # launch -> batch resolved
-            "submit_blocked": 0,  # admissions throttled by max_queue
-        }
+        # registry-backed view; keys unchanged from the pre-obs dict:
+        # flushes, depth/deadline/drain_flushes (drain = explicit
+        # flush()/close() induced), max_queue_depth, queue_wait_max_ms
+        # (submit -> batch pop, worst case), batch_service_max_ms
+        # (launch -> batch resolved), submit_blocked (admissions
+        # throttled by max_queue)
+        self.pipe_counters = _pipe_counter_bank(self.obs_label)
         self._errors: list[str] = []
         self._finish_pool = ThreadPoolExecutor(
             1, thread_name_prefix="bibfs-finish"
@@ -328,8 +304,8 @@ class PipelinedQueryEngine(QueryEngine):
             with self._lock:
                 if self._closed:
                     raise RuntimeError("engine is closed")
-                self.counters["queries"] += 1
-                self.counters["trivial"] += 1
+                self._c_queries.inc()
+                self._c_trivial.inc()
             self._finish_ticket(t, BFSResult(True, 0, [src], src, 0.0, 0, 0))
             self.latency.record(t.t_done - t.t_submit)
             return t
@@ -346,8 +322,8 @@ class PipelinedQueryEngine(QueryEngine):
                 with self._lock:
                     if self._closed:
                         raise RuntimeError("engine is closed")
-                    self.counters["queries"] += 1
-                    self.counters["cache_served"] += 1
+                    self._c_queries.inc()
+                    self._c_cache_served.inc()
                 self._finish_ticket(t, BFSResult(
                     found, hops if found else None, path if found else None,
                     None, 0.0, 0, 0,
@@ -371,12 +347,12 @@ class PipelinedQueryEngine(QueryEngine):
                     self._cv.wait(timeout=0.1)
                     if self._closed:
                         raise RuntimeError("engine is closed")
-            self.counters["queries"] += 1
+            self._c_queries.inc()
             self._queue.append(t)
             self._outstanding += 1
             depth = len(self._queue)
-            if depth > self.pipe_counters["max_queue_depth"]:
-                self.pipe_counters["max_queue_depth"] = depth
+            self._g_queue_depth.set(depth)
+            self.pipe_counters.cell("max_queue_depth").set_max(depth)
             # wake the flusher only when this submit can change its
             # decision: arming the deadline timer (empty -> 1), crossing
             # the depth trigger, or filling the admission queue —
@@ -470,15 +446,17 @@ class PipelinedQueryEngine(QueryEngine):
                     self._queue.popleft()
                     for _ in range(min(len(self._queue), self.max_batch))
                 ]
+                self._g_queue_depth.set(len(self._queue))
                 self._cv.notify_all()  # wake producers blocked on max_queue
                 now = time.perf_counter()
                 wait_ms = (now - batch[0].t_submit) * 1e3
-                if wait_ms > self.pipe_counters["queue_wait_max_ms"]:
-                    self.pipe_counters["queue_wait_max_ms"] = wait_ms
+                self.pipe_counters.cell("queue_wait_max_ms").set_max(
+                    wait_ms)
                 self.pipe_counters["flushes"] += 1
                 self.pipe_counters[f"{reason}_flushes"] += 1
             try:
-                self._launch(batch)
+                with span("flush", queued=len(batch), cause=reason):
+                    self._launch(batch)
             except Exception as e:  # never strand a waiter
                 self._record_error(e)
                 self._fail_batch(batch, e)
@@ -523,7 +501,7 @@ class PipelinedQueryEngine(QueryEngine):
         if hits:
             self.latency.record_many(lats)
             with self._cv:
-                self.counters["cache_served"] += hits
+                self._c_cache_served.inc(hits)
                 self._outstanding -= hits
                 self._cv.notify_all()
         return pairs
@@ -605,6 +583,17 @@ class PipelinedQueryEngine(QueryEngine):
                           results, err) -> None:
         self.stages.enter()
         try:
+            with span("host_resolve", batch=len(pairs)):
+                self._host_resolve_inner(pairs, unique, results, err)
+        finally:
+            self.stages.exit()
+            self._inflight.release()
+            self._note_batch_done(
+                t_launch, sum(len(unique[p]) for p in pairs)
+            )
+
+    def _host_resolve_inner(self, pairs, unique, results, err) -> None:
+        try:
             if err is None:
                 lats = []
                 bank = self._paths_to_bank(results)
@@ -622,7 +611,7 @@ class PipelinedQueryEngine(QueryEngine):
                         lats.append(t.t_done - t.t_submit)
                 self.latency.record_many(lats)
                 with self._lock:
-                    self.counters["host_queries"] += len(pairs)
+                    self._c_host_queries.inc(len(pairs))
             else:
                 for key in pairs:
                     for t in unique[key]:
@@ -634,12 +623,6 @@ class PipelinedQueryEngine(QueryEngine):
                 for t in unique[key]:
                     if not t.done():
                         self._fail_ticket(t, e)
-        finally:
-            self.stages.exit()
-            self._inflight.release()
-            self._note_batch_done(
-                t_launch, sum(len(unique[p]) for p in pairs)
-            )
 
     # ---- resolution --------------------------------------------------
     def _finish_ticket(self, t: QueryTicket, res: BFSResult) -> None:
@@ -664,8 +647,8 @@ class PipelinedQueryEngine(QueryEngine):
     def _note_batch_done(self, t_launch: float, tickets: int) -> None:
         service_ms = (time.perf_counter() - t_launch) * 1e3
         with self._cv:
-            if service_ms > self.pipe_counters["batch_service_max_ms"]:
-                self.pipe_counters["batch_service_max_ms"] = service_ms
+            self.pipe_counters.cell("batch_service_max_ms").set_max(
+                service_ms)
             self._outstanding -= tickets
             self._cv.notify_all()
 

@@ -26,6 +26,11 @@ class BFSResult:
     time_s: float  # search loop only, matching reference timed regions
     levels: int  # number of frontier expansions performed
     edges_scanned: int  # directed edges examined (for TEPS)
+    # per-level telemetry (bibfs_tpu/obs/telemetry.py): None unless the
+    # solve was passed the opt-in ``telemetry=`` hook, in which case it
+    # holds {"levels": [{level, side, dir, frontier, edges}, ...],
+    # "meet_level": int|None, "meet": int|None}
+    level_stats: Optional[dict] = None
 
     @property
     def teps(self) -> float:

@@ -872,6 +872,98 @@ def _get_batch_kernel_resolved(mode: str, push_cap: int, tier_meta: tuple = ()):
     )
 
 
+@lru_cache(maxsize=None)
+def _get_traced_side_step(mode: str, cap: int, tier_meta: tuple, side: str):
+    """One jitted single-side expansion round for the telemetry-traced
+    driver (:func:`_solve_dense_traced`) — exactly the ``_side_step``
+    the compiled while_loop bodies run, jitted per (mode, cap, layout,
+    side) so a traced solve pays one compile per side, then per-level
+    dispatches."""
+
+    def fn(nbr, deg, aux, st):
+        return _side_step(st, side, nbr, deg, aux, tier_meta,
+                          push_cap=cap, use_pallas=False)
+
+    return jax.jit(fn)
+
+
+@lru_cache(maxsize=None)
+def _get_traced_vote(delta: int):
+    return jax.jit(lambda st: _meet_vote(st, delta))
+
+
+def _solve_dense_traced(
+    g: DeviceGraph, src: int, dst: int, mode: str, telemetry
+) -> BFSResult:
+    """The per-level telemetry drive of the dense search: the SAME
+    state dict, side steps, meet vote and termination rule as the
+    compiled one-shot program, but stepped level-by-level from the host
+    so each round's frontier size, edges scanned and push/pull choice
+    can be read off and recorded. Pallas/fused modes trace through
+    their XLA-schedule equivalent (the kernels fuse work per level, not
+    across levels, so the per-level numbers are the same); the "sync"
+    schedule steps its sides sequentially — the documented
+    ``sync_unfused`` control body, identical state evolution to the
+    fused dual expansion it A/Bs.
+
+    This is the diagnostic path: every level pays a host sync to read
+    the counters. The ``telemetry=None`` default in
+    :func:`solve_dense_graph` never comes near it."""
+    from bibfs_tpu.obs.telemetry import coerce
+
+    tel = coerce(telemetry)
+    schedule, hybrid, _pl = DENSE_MODES[mode]
+    base_mode = {
+        "pallas": "sync", "pallas_alt": "alt",
+        "fused": "sync", "fused_alt": "alt",
+    }.get(mode, mode)
+    cap = kernel_cap(base_mode, g.n_pad)
+    k = max(cap, 1)
+    span, _ncov = push_span(g.width, g.tier_meta)
+    steps = {
+        s: _get_traced_side_step(base_mode, cap, g.tier_meta, s)
+        for s in ("s", "t")
+    }
+    vote = _get_traced_vote(2 if schedule == "sync" else 1)
+    t0 = time.perf_counter()
+    st = _init_state(g.n_pad, k, _device_scalar(src), _device_scalar(dst),
+                     g.deg)
+
+    def advance(side):
+        """Expand one side; record its pre-step routing and post-step
+        frontier/edge telemetry."""
+        nonlocal st
+        cnt_pre = int(st[f"cnt_{side}"])
+        md_pre = int(st[f"md_{side}"])
+        edges_pre = int(st["edges"])
+        st = steps[side](g.nbr, g.deg, g.aux, st)
+        pushed = hybrid and cap > 0 and cnt_pre <= cap and md_pre <= span
+        tel.record_level(
+            int(st["lvl_s"]) + int(st["lvl_t"]), side,
+            "push" if pushed else "pull",
+            int(st[f"cnt_{side}"]), int(st["edges"]) - edges_pre,
+        )
+
+    while (
+        int(st["lvl_s"]) + int(st["lvl_t"]) < int(st["best"])
+        and int(st["cnt_s"]) > 0
+        and int(st["cnt_t"]) > 0
+    ):
+        best_pre = int(st["best"])
+        if schedule == "sync":
+            advance("s")
+            advance("t")
+        else:  # alt: smaller-frontier-first, the lax.cond's exact rule
+            advance("s" if int(st["cnt_s"]) <= int(st["cnt_t"]) else "t")
+        st = vote(st)
+        if int(st["best"]) < best_pre:
+            tel.note_meet(int(st["levels"]), int(st["meet"]))
+    elapsed = time.perf_counter() - t0
+    res = _materialize(_outputs(st), elapsed)
+    res.level_stats = tel.as_dict()
+    return res
+
+
 def bibfs_dense(nbr, deg, src, dst):
     """Pull-only lock-step search (both sides per round). Kept as the plain
     jittable entry (`__graft_entry__.entry`); see :data:`DENSE_MODES` for
@@ -886,14 +978,21 @@ def bibfs_dense_alt(nbr, deg, src, dst):
 
 def solve_dense_graph(
     g: DeviceGraph, src: int, dst: int, *, mode: str = "sync",
-    unroll: int = 1
+    unroll: int = 1, telemetry=None
 ) -> BFSResult:
     """Run the jitted search on an already-device-resident graph; timing
     covers the search only (reference parity: each version times only the
     hot loop, SURVEY.md §5 tracing). ``unroll`` runs that many rounds per
-    while iteration (:func:`_unrolled`) — exact, any mode."""
+    while iteration (:func:`_unrolled`) — exact, any mode. ``telemetry``
+    (opt-in) swaps in the level-stepped traced drive
+    (:func:`_solve_dense_traced`), recording per-level frontier sizes,
+    edges scanned and the push/pull routing onto the result's
+    ``level_stats``; the default None runs the one-shot compiled program
+    untouched."""
     if not (0 <= src < g.n and 0 <= dst < g.n):
         raise ValueError(f"src/dst out of range for n={g.n}")
+    if telemetry:  # any falsy value (None/False/0) = fully off
+        return _solve_dense_traced(g, src, dst, mode, telemetry)
     from bibfs_tpu.solvers.timing import force_scalar
 
     kern = _get_kernel(mode, kernel_cap(mode, g.n_pad), g.tier_meta,
@@ -1058,15 +1157,16 @@ def solve_dense(
     mode: str = "sync",
     layout: str = "ell",
     unroll: int = 1,
+    telemetry=None,
 ) -> BFSResult:
     return solve_dense_graph(
         DeviceGraph.build(n, edges, layout=layout), src, dst, mode=mode,
-        unroll=unroll,
+        unroll=unroll, telemetry=telemetry,
     )
 
 
 @register("dense")
 def _dense_backend(n, edges, src, dst, mode="sync", layout="ell",
-                   unroll=1, **_):
+                   unroll=1, telemetry=None, **_):
     return solve_dense(n, edges, src, dst, mode=mode, layout=layout,
-                       unroll=unroll)
+                       unroll=unroll, telemetry=telemetry)

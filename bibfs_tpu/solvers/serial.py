@@ -60,19 +60,35 @@ def _expand(
     return uniq, total
 
 
-def solve_serial(n: int, edges: np.ndarray, src: int, dst: int) -> BFSResult:
+def solve_serial(
+    n: int, edges: np.ndarray, src: int, dst: int, *, telemetry=None
+) -> BFSResult:
     row_ptr, col_ind = build_csr(n, edges)
-    return solve_serial_csr(n, row_ptr, col_ind, src, dst)
+    return solve_serial_csr(n, row_ptr, col_ind, src, dst,
+                            telemetry=telemetry)
 
 
 def solve_serial_csr(
-    n: int, row_ptr: np.ndarray, col_ind: np.ndarray, src: int, dst: int
+    n: int, row_ptr: np.ndarray, col_ind: np.ndarray, src: int, dst: int,
+    *, telemetry=None,
 ) -> BFSResult:
+    """``telemetry`` (opt-in, default None = exact pre-telemetry code
+    path): a :class:`bibfs_tpu.obs.telemetry.LevelTelemetry` (or True)
+    recording per-level frontier/edge stats onto the result's
+    ``level_stats`` — serial expansion is frontier-driven, so every
+    recorded direction is "push"."""
     if not (0 <= src < n and 0 <= dst < n):
         raise ValueError(f"src/dst out of range for n={n}")
+    if telemetry is not None:
+        from bibfs_tpu.obs.telemetry import coerce
+
+        telemetry = coerce(telemetry)
     t0 = time.perf_counter()
     if src == dst:
-        return BFSResult(True, 0, [src], src, time.perf_counter() - t0, 0, 0)
+        res = BFSResult(True, 0, [src], src, time.perf_counter() - t0, 0, 0)
+        if telemetry is not None:
+            res.level_stats = telemetry.as_dict()
+        return res
 
     dist_s = np.full(n, _INF, dtype=np.int64)
     dist_t = np.full(n, _INF, dtype=np.int64)
@@ -103,6 +119,11 @@ def solve_serial_csr(
             newly = frontier_t
         levels += 1
         edges_scanned += scanned
+        if telemetry is not None:
+            telemetry.record_level(
+                levels, "s" if newly is frontier_s else "t", "push",
+                newly.size, scanned,
+            )
         if newly.size:
             other = dist_t if newly is frontier_s else dist_s
             mine = dist_s if newly is frontier_s else dist_t
@@ -113,12 +134,18 @@ def solve_serial_csr(
                 if int(sums[k]) < best:
                     best = int(sums[k])
                     meet = int(hit[k])
+                    if telemetry is not None:
+                        telemetry.note_meet(levels, meet)
     elapsed = time.perf_counter() - t0
 
     if best == _INF:
-        return BFSResult(False, None, None, None, elapsed, levels, edges_scanned)
-    path = _reconstruct(parent_s, parent_t, meet)
-    return BFSResult(True, best, path, meet, elapsed, levels, edges_scanned)
+        res = BFSResult(False, None, None, None, elapsed, levels, edges_scanned)
+    else:
+        path = _reconstruct(parent_s, parent_t, meet)
+        res = BFSResult(True, best, path, meet, elapsed, levels, edges_scanned)
+    if telemetry is not None:
+        res.level_stats = telemetry.as_dict()
+    return res
 
 
 def _reconstruct(
@@ -137,5 +164,5 @@ def _reconstruct(
 
 
 @register("serial")
-def _serial_backend(n, edges, src, dst, **_):
-    return solve_serial(n, edges, src, dst)
+def _serial_backend(n, edges, src, dst, telemetry=None, **_):
+    return solve_serial(n, edges, src, dst, telemetry=telemetry)

@@ -1,0 +1,87 @@
+"""The /metrics HTTP endpoint (bibfs_tpu/obs/http): a live engine's
+traffic visible through one Prometheus scrape — the in-process twin of
+the CI workflow's ``scripts/check_metrics_endpoint.py`` subprocess
+probe."""
+
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from bibfs_tpu.obs.http import start_metrics_server
+from bibfs_tpu.obs.metrics import REGISTRY, MetricsRegistry
+from bibfs_tpu.serve import PipelinedQueryEngine
+
+
+def _skiplink_graph(n: int) -> np.ndarray:
+    edges = [[i, i + 1] for i in range(n - 1)]
+    edges += [[i, i + 7] for i in range(n - 7)]
+    return np.array(edges)
+
+
+def _get(url: str) -> tuple[int, str]:
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return r.status, r.read().decode()
+
+
+def test_metrics_endpoint_serves_live_engine_traffic():
+    n = 200
+    edges = _skiplink_graph(n)
+    with start_metrics_server(0) as srv:
+        assert srv.port > 0
+        with PipelinedQueryEngine(n, edges, max_wait_ms=5.0) as eng:
+            rng = np.random.default_rng(0)
+            pairs = rng.integers(0, n, size=(30, 2))
+            eng.query_many(pairs)
+            eng.query_many(pairs)  # repeats feed the cache counters
+            status, body = _get(srv.url)
+        assert status == 200
+        # the documented names, with this engine's label and real counts
+        lbl = eng.obs_label
+        assert f'bibfs_queries_total{{engine="{lbl}"}} 60' in body
+        assert "bibfs_queries_routed_total" in body
+        assert "bibfs_dist_cache_events_total" in body
+        assert "bibfs_flush_cause_total" in body
+        assert "bibfs_serve_queue_depth" in body
+        # latency histogram rendered with cumulative buckets
+        assert f'bibfs_query_latency_seconds_count{{engine="{lbl}"}} 60' \
+            in body
+        assert "bibfs_query_latency_seconds_bucket" in body
+        assert 'le="+Inf"' in body
+
+
+def test_metrics_endpoint_routes():
+    with start_metrics_server(0) as srv:
+        status, body = _get(
+            f"http://127.0.0.1:{srv.port}/healthz"
+        )
+        assert status == 200 and body == "ok\n"
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(f"http://127.0.0.1:{srv.port}/nope")
+        assert e.value.code == 404
+
+
+def test_metrics_server_custom_registry_and_close():
+    reg = MetricsRegistry()
+    reg.counter("only_here_total", "x").inc(2)
+    srv = start_metrics_server(0, registry=reg)
+    try:
+        _status, body = _get(srv.url)
+        assert "only_here_total 2" in body
+        # the custom registry does NOT include the process default's
+        # families (isolation for tests and embedders)
+        assert "bibfs_queries_total" not in body
+    finally:
+        srv.close()
+    with pytest.raises(OSError):
+        _get(srv.url)  # closed server no longer accepts
+
+
+def test_default_registry_is_process_wide():
+    REGISTRY.counter(
+        "bibfs_probe_total", "observability self-check"
+    ).inc()
+    with start_metrics_server(0) as srv:
+        _status, body = _get(srv.url)
+        assert "bibfs_probe_total 1" in body

@@ -1,0 +1,200 @@
+"""The metrics registry (bibfs_tpu/obs/metrics): counter/gauge/histogram
+semantics, Prometheus text exposition, and — the migration contract —
+that every serving component's ``stats()`` dict is a faithful snapshot
+view over its registry cells (the satellite's stats() equivalence
+regression)."""
+
+import re
+
+import numpy as np
+import pytest
+
+from bibfs_tpu.obs.metrics import (
+    REGISTRY,
+    LogHistogram,
+    MetricBank,
+    MetricsRegistry,
+)
+from bibfs_tpu.serve import DistanceCache, ExecutableCache, QueryEngine
+
+
+def _skiplink_graph(n: int) -> np.ndarray:
+    edges = [[i, i + 1] for i in range(n - 1)]
+    edges += [[i, i + 7] for i in range(n - 7)]
+    return np.array(edges)
+
+
+# ---- primitives ------------------------------------------------------
+def test_counter_gauge_semantics():
+    reg = MetricsRegistry()
+    c = reg.counter("t_total", "help", ("k",))
+    c.labels(k="a").inc()
+    c.labels(k="a").inc(4)
+    assert c.labels(k="a").value == 5
+    assert c.labels(k="b").value == 0  # distinct child
+    with pytest.raises(ValueError):
+        c.labels(k="a").inc(-1)  # counters only go up
+    with pytest.raises(ValueError):
+        c.labels(k="a").set(2)  # ... even via assignment
+    g = reg.gauge("t_depth", "help")
+    g.set(7)
+    g.set_max(3)  # watermark keeps the larger value
+    assert g.value == 7
+    g.set_max(11.5)
+    assert g.value == 11.5
+    g.dec(1.5)
+    assert g.value == 10.0
+
+
+def test_registry_get_or_create_idempotent():
+    reg = MetricsRegistry()
+    a = reg.counter("x_total", "h", ("k",))
+    assert reg.counter("x_total", "h", ("k",)) is a
+    with pytest.raises(ValueError):
+        reg.gauge("x_total", "h", ("k",))  # kind mismatch
+    with pytest.raises(ValueError):
+        reg.counter("x_total", "h", ("other",))  # label mismatch
+    with pytest.raises(ValueError):
+        reg.counter("bad name", "h")
+
+
+def test_label_validation():
+    reg = MetricsRegistry()
+    c = reg.counter("y_total", "h", ("a", "b"))
+    with pytest.raises(ValueError):
+        c.labels(a="1")  # missing label
+    with pytest.raises(ValueError):
+        c.labels(a="1", b="2", c="3")  # extra label
+
+
+def test_metric_bank_dict_protocol():
+    reg = MetricsRegistry()
+    c = reg.counter("z_total", "h", ("k",))
+    bank = MetricBank({"x": c.labels(k="x"), "y": c.labels(k="y")})
+    bank["x"] += 1
+    bank["x"] += 2
+    bank.inc("y", 5)
+    assert bank["x"] == 3 and bank["y"] == 5
+    assert dict(bank) == {"x": 3, "y": 5}
+    assert set(bank) == {"x", "y"} and len(bank) == 2 and "x" in bank
+
+
+def test_prometheus_render_format():
+    reg = MetricsRegistry()
+    c = reg.counter("bibfs_t_total", "queries", ("engine",))
+    c.labels(engine="e-1").inc(3)
+    h = reg.histogram("bibfs_t_seconds", "lat", ("engine",))
+    h.labels(engine="e-1").record_many([0.001, 0.001, 0.1])
+    text = reg.render()
+    assert "# HELP bibfs_t_total queries" in text
+    assert "# TYPE bibfs_t_total counter" in text
+    assert 'bibfs_t_total{engine="e-1"} 3' in text
+    assert "# TYPE bibfs_t_seconds histogram" in text
+    # cumulative buckets, +Inf terminal, _sum/_count series
+    buckets = re.findall(
+        r'bibfs_t_seconds_bucket\{engine="e-1",le="([^"]+)"\} (\d+)', text
+    )
+    assert buckets[-1][0] == "+Inf" and buckets[-1][1] == "3"
+    counts = [int(b[1]) for b in buckets]
+    assert counts == sorted(counts)  # cumulative
+    assert 'bibfs_t_seconds_count{engine="e-1"} 3' in text
+    # every non-comment line is "name{labels} value" or "name value"
+    for line in text.strip().splitlines():
+        if line.startswith("#"):
+            continue
+        assert re.match(r'^[A-Za-z_:][\w:]*(\{[^}]*\})? \S+$', line), line
+
+
+def test_log_histogram_to_dict_roundtrip():
+    h = LogHistogram()
+    h.record_many([0.001] * 90 + [0.1] * 10)
+    d = h.to_dict()
+    assert d["count"] == 100
+    assert sum(c for _i, c in d["buckets"]) == 100
+    # edges reconstruct from the exported geometry
+    for i, c in d["buckets"]:
+        edge = d["base_s"] * d["ratio"] ** i
+        assert 0 < edge < 200
+    assert d["max_s"] == pytest.approx(0.1)
+
+
+# ---- stats() equivalence regression (the satellite) ------------------
+def test_exec_cache_stats_are_registry_views():
+    c = ExecutableCache()
+    c.note(("a", 1))
+    c.note(("a", 1))
+    c.note(("b", 2))
+    assert c.stats() == {"hits": 1, "misses": 2, "programs": 2}
+    # same numbers straight from the registry, under this cache's label
+    ev = REGISTRY.get("bibfs_exec_cache_events_total")
+    lbl = c.metrics_label
+    assert ev.labels(cache=lbl, event="hit").value == 1
+    assert ev.labels(cache=lbl, event="miss").value == 2
+    assert REGISTRY.get("bibfs_exec_programs").labels(cache=lbl).value == 2
+    # per-program dispatch counts: stats-side and registry-side agree
+    pc = c.program_counts()
+    assert pc == {str(("a", 1)): 2, str(("b", 2)): 1}
+    disp = REGISTRY.get("bibfs_exec_program_dispatches_total")
+    for key, count in pc.items():
+        assert disp.labels(cache=lbl, program=key).value == count
+
+
+def test_dist_cache_stats_are_registry_views():
+    cache = DistanceCache(entries=2, pair_entries=2)
+    par = np.array([-1, 0, 1, 2], dtype=np.int32)
+    cache.put_forest("g", 0, par, 4)
+    assert cache.lookup("g", 0, 3) is not None
+    assert cache.lookup("g", 5, 3) is None
+    for i in range(3):
+        cache.put_result("g", i, i + 10, True, 1, [i, i + 10])
+    st = cache.stats()
+    ev = REGISTRY.get("bibfs_dist_cache_events_total")
+    lbl = cache.metrics_label
+    for key, event in [
+        ("forest_hits", "forest_hit"), ("pair_hits", "pair_hit"),
+        ("misses", "miss"), ("inserts", "insert"),
+        ("forest_evictions", "forest_eviction"),
+        ("pair_evictions", "pair_eviction"),
+    ]:
+        assert st[key] == ev.labels(cache=lbl, event=event).value, key
+    sizes = REGISTRY.get("bibfs_dist_cache_entries")
+    assert st["forests"] == sizes.labels(cache=lbl, store="forests").value
+    assert st["pairs"] == sizes.labels(cache=lbl, store="pairs").value
+
+
+def test_engine_stats_are_registry_views():
+    n = 150
+    eng = QueryEngine(n, _skiplink_graph(n), flush_threshold=4,
+                      exec_cache=ExecutableCache())
+    rng = np.random.default_rng(0)
+    pairs = rng.integers(0, n, size=(20, 2))
+    eng.query_many(pairs)
+    eng.query_many(pairs)  # repeats: exercises the cache route
+    st = eng.stats()
+    lbl = eng.obs_label
+    q = REGISTRY.get("bibfs_queries_total").labels(engine=lbl)
+    routed = REGISTRY.get("bibfs_queries_routed_total")
+    assert st["queries"] == q.value == 40
+    for key, route in [("trivial", "trivial"), ("cache_served", "cache"),
+                       ("device_queries", "device"),
+                       ("host_queries", "host")]:
+        assert st[key] == routed.labels(engine=lbl, route=route).value, key
+    assert st["device_batches"] == REGISTRY.get(
+        "bibfs_device_batches_total").labels(engine=lbl).value
+    assert st["inserts_skipped"] == REGISTRY.get(
+        "bibfs_cache_inserts_skipped_total").labels(engine=lbl).value
+    # the nested stats blocks are the component views
+    assert st["dist_cache"] == eng.dist_cache.stats()
+    assert st["exec_cache"] == eng.exec_cache.stats()
+
+
+def test_engine_labels_are_per_instance():
+    """Two engines must not share counter cells (per-instance stats
+    were exact before the migration and must stay exact)."""
+    n = 60
+    e1 = QueryEngine(n, _skiplink_graph(n))
+    e2 = QueryEngine(n, _skiplink_graph(n))
+    assert e1.obs_label != e2.obs_label
+    e1.query(0, 30)
+    assert e1.counters["queries"] == 1
+    assert e2.counters["queries"] == 0

@@ -1,0 +1,199 @@
+"""Per-level solver telemetry (the opt-in ``telemetry=`` hook): the
+serial, native, and dense solvers record per-level frontier sizes,
+edges scanned, direction, and the meet level onto
+``BFSResult.level_stats`` — and, the satellite's overhead gate, the
+DISABLED path is bit-identical to the seed behavior and allocates no
+registry objects per query."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from bibfs_tpu.obs.metrics import REGISTRY
+from bibfs_tpu.obs.telemetry import LevelTelemetry
+from bibfs_tpu.solvers.native import solve_native
+from bibfs_tpu.solvers.serial import solve_serial
+
+
+def _skiplink_graph(n: int) -> np.ndarray:
+    edges = [[i, i + 1] for i in range(n - 1)]
+    edges += [[i, i + 7] for i in range(n - 7)]
+    return np.array(edges)
+
+
+N = 200
+EDGES = _skiplink_graph(N)
+DISCONNECTED = np.array([[0, 1], [1, 2], [3, 4]])
+
+
+def _check_level_stats(res, ref):
+    """The internal-consistency bar every instrumented solver meets:
+    entries match the aggregate counters exactly, the meet level is a
+    real level, and the solve result agrees with the serial oracle."""
+    assert res.found == ref.found and res.hops == ref.hops
+    ls = res.level_stats
+    assert ls is not None
+    assert len(ls["levels"]) == res.levels
+    assert sum(lv["edges"] for lv in ls["levels"]) == res.edges_scanned
+    for i, lv in enumerate(ls["levels"]):
+        assert lv["level"] == i + 1
+        assert lv["side"] in ("s", "t")
+        assert lv["dir"] in ("push", "pull")
+        assert lv["frontier"] >= 0 and lv["edges"] >= 0
+    if res.found and res.hops > 0:
+        assert 1 <= ls["meet_level"] <= res.levels
+
+
+# ---- serial ----------------------------------------------------------
+def test_serial_level_stats():
+    ref = solve_serial(N, EDGES, 0, 190)
+    res = solve_serial(N, EDGES, 0, 190, telemetry=True)
+    _check_level_stats(res, ref)
+    # disabled = bit-identical result fields (wall-clock aside)
+    again = solve_serial(N, EDGES, 0, 190)
+    assert again.level_stats is None
+    a, b = dataclasses.asdict(again), dataclasses.asdict(ref)
+    a.pop("time_s"), b.pop("time_s")
+    assert a == b
+
+
+def test_serial_level_stats_unreachable():
+    res = solve_serial(5, DISCONNECTED, 0, 4, telemetry=True)
+    assert not res.found
+    assert res.level_stats["meet_level"] is None
+    assert len(res.level_stats["levels"]) == res.levels
+
+
+def test_telemetry_collector_passthrough():
+    tel = LevelTelemetry()
+    res = solve_serial(N, EDGES, 3, 60, telemetry=tel)
+    assert res.level_stats["levels"] is tel.levels  # caller keeps access
+
+
+# ---- native ----------------------------------------------------------
+def test_native_level_stats_match_serial():
+    """The C runtime's per-level record equals the NumPy oracle's —
+    both are smaller-frontier-first level-synchronous searches with
+    identical tie-breaking (<=)."""
+    ref = solve_serial(N, EDGES, 0, 190, telemetry=True)
+    res = solve_native(N, EDGES, 0, 190, telemetry=True)
+    _check_level_stats(res, ref)
+    assert res.level_stats["levels"] == ref.level_stats["levels"]
+    assert res.level_stats["meet_level"] == ref.level_stats["meet_level"]
+
+
+def test_native_disabled_identical():
+    ref = solve_native(N, EDGES, 2, 150)
+    res = solve_native(N, EDGES, 2, 150, telemetry=True)
+    assert ref.level_stats is None
+    assert (ref.found, ref.hops, ref.path, ref.levels, ref.edges_scanned) \
+        == (res.found, res.hops, res.path, res.levels, res.edges_scanned)
+
+
+def test_native_level_stats_unreachable():
+    res = solve_native(5, DISCONNECTED, 0, 4, telemetry=True)
+    assert not res.found
+    assert res.level_stats["meet_level"] is None
+
+
+# ---- dense -----------------------------------------------------------
+@pytest.mark.parametrize("mode", ["sync", "alt", "beamer", "beamer_alt"])
+def test_dense_level_stats_aggregate_parity(mode):
+    """The traced (telemetry) drive must reproduce the one-shot
+    compiled program's aggregates exactly — same hops, same level
+    count, same edges scanned — while adding the per-level record."""
+    from bibfs_tpu.solvers.dense import solve_dense
+
+    ref = solve_dense(N, EDGES, 0, 190, mode=mode)
+    res = solve_dense(N, EDGES, 0, 190, mode=mode, telemetry=True)
+    assert ref.level_stats is None
+    assert (ref.found, ref.hops, ref.levels, ref.edges_scanned) == \
+        (res.found, res.hops, res.levels, res.edges_scanned)
+    _check_level_stats(res, solve_serial(N, EDGES, 0, 190))
+    dirs = {lv["dir"] for lv in res.level_stats["levels"]}
+    if mode.startswith("beamer"):
+        assert "push" in dirs  # tiny frontiers on this graph DO push
+    else:
+        assert dirs == {"pull"}
+
+
+def test_dense_level_stats_tiered():
+    from bibfs_tpu.graph.generate import rmat_graph
+    from bibfs_tpu.solvers.dense import solve_dense
+
+    n, edges = rmat_graph(7, edge_factor=6, seed=1)
+    ref = solve_serial(n, edges, 3, 90)
+    res = solve_dense(n, edges, 3, 90, mode="alt", layout="tiered",
+                      telemetry=True)
+    _check_level_stats(res, ref)
+
+
+def test_dense_trivial_query():
+    from bibfs_tpu.solvers.dense import solve_dense
+
+    res = solve_dense(N, EDGES, 5, 5, telemetry=True)
+    assert res.found and res.hops == 0
+    assert res.level_stats["levels"] == []
+
+
+# ---- api passthrough -------------------------------------------------
+def test_api_solve_telemetry_passthrough():
+    from bibfs_tpu.solvers.api import solve
+
+    for backend in ("serial", "native", "dense"):
+        res = solve(backend, N, EDGES, 0, 100, telemetry=True)
+        assert res.level_stats is not None, backend
+        assert len(res.level_stats["levels"]) == res.levels
+
+
+# ---- the disabled-overhead gate --------------------------------------
+def test_query_many_allocates_no_registry_objects():
+    """Engine construction mints its registry cells ONCE; serving
+    queries (with telemetry off, the default) must not create any
+    further registry objects — the per-query cost is counter
+    increments into existing cells."""
+    from bibfs_tpu.serve import QueryEngine
+
+    n = 150
+    eng = QueryEngine(n, _skiplink_graph(n), flush_threshold=4)
+    eng.query(0, 30)  # first query resolves lazy solver construction
+    before = REGISTRY.child_count()
+    rng = np.random.default_rng(1)
+    pairs = rng.integers(0, n, size=(60, 2))
+    results = eng.query_many(pairs)
+    assert len(results) == 60
+    assert all(r.level_stats is None for r in results)
+    assert REGISTRY.child_count() == before
+
+
+def test_pipelined_query_many_allocates_no_registry_objects():
+    from bibfs_tpu.serve import PipelinedQueryEngine
+
+    n = 150
+    with PipelinedQueryEngine(n, _skiplink_graph(n)) as eng:
+        eng.query(0, 30)
+        before = REGISTRY.child_count()
+        rng = np.random.default_rng(2)
+        pairs = rng.integers(0, n, size=(60, 2))
+        results = eng.query_many(pairs)
+        assert len(results) == 60
+        assert REGISTRY.child_count() == before
+
+
+def test_query_many_results_identical_to_direct_solvers():
+    """The seed-behavior equivalence half of the overhead satellite:
+    with telemetry never mentioned, engine results carry exactly the
+    fields the per-query host solver produces (hop/path equality, no
+    level_stats anywhere)."""
+    from bibfs_tpu.serve import QueryEngine
+
+    n = 150
+    edges = _skiplink_graph(n)
+    eng = QueryEngine(n, edges, flush_threshold=10_000)  # pure host route
+    pairs = [(i, i + 40) for i in range(3)]  # below HOST_BATCH_MIN
+    results = eng.query_many(pairs)
+    for (s, d), r in zip(pairs, results):
+        ref = solve_serial(n, edges, s, d)
+        assert (r.found, r.hops, r.path) == (ref.found, ref.hops, ref.path)
+        assert r.level_stats is None
