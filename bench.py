@@ -996,9 +996,113 @@ def serve_load_main():
         return 1
 
 
+# --serve-chaos defaults: the soak runs against the device-batched route
+# (the route the fault plan targets) on a CPU-friendly graph; --quick is
+# the CI smoke shape (same fault rate, less traffic)
+CHAOS_N = int(os.environ.get("BENCH_CHAOS_N", 3000))
+CHAOS_Q = int(os.environ.get("BENCH_CHAOS_Q", 500))
+CHAOS_MIN_FRACTION = float(
+    os.environ.get("BENCH_CHAOS_MIN_FRACTION", 0.10)
+)
+CHAOS_RECOVERY_S = float(os.environ.get("BENCH_CHAOS_RECOVERY_S", 15.0))
+
+# the resilience metric families the README documents; the chaos gate
+# asserts a live run's /metrics-equivalent render really carries them
+CHAOS_REQUIRED_METRICS = (
+    "bibfs_errors_total",
+    "bibfs_route_fallbacks_total",
+    "bibfs_breaker_state",
+    "bibfs_health_state",
+    "bibfs_faults_injected_total",
+)
+
+
+def serve_chaos_main():
+    """``python bench.py --serve-chaos``: the fault-injected soak.
+
+    Runs the open-loop load generator against the REAL pipelined engine
+    while a deterministic FaultPlan fails its device flushes at both
+    device seams (run_chaos's default spec; the realized device-seam
+    fraction must reach BENCH_CHAOS_MIN_FRACTION), then clears the
+    faults and measures recovery (bibfs_tpu/serve/loadgen.run_chaos).
+    The gate: zero
+    lost/stranded tickets, every non-failed result oracle-verified,
+    health back to ``ready`` within the recovery bound, faults actually
+    fired, and the documented resilience metric families present in
+    the registry render. ``--quick`` is the CI smoke shape. Artifact:
+    ``bench_chaos.json``."""
+    t_setup = time.time()
+    platform, tpu_error = select_platform()
+    try:
+        from bibfs_tpu.graph.generate import gnp_random_graph
+        from bibfs_tpu.obs.metrics import REGISTRY
+        from bibfs_tpu.serve.loadgen import run_chaos
+
+        quick = "--quick" in sys.argv
+        n = 800 if quick else CHAOS_N
+        q = 160 if quick else CHAOS_Q
+        edges = gnp_random_graph(n, AVG_DEG / n, seed=1)
+        out = run_chaos(
+            n, edges,
+            queries=q,
+            min_fault_fraction=CHAOS_MIN_FRACTION,
+            recovery_bound_s=CHAOS_RECOVERY_S,
+        )
+        render = REGISTRY.render()
+        missing = [m for m in CHAOS_REQUIRED_METRICS if m not in render]
+        line = {
+            "metric": f"bibfs_serve_chaos_{n}",
+            "value": out["faults_injected"],
+            "unit": "faults",
+            "graph": f"G({n}, {AVG_DEG:.1f}/n) seed=1",
+            "platform": platform,
+            "quick": quick,
+            **out,
+            "metrics_missing": missing,
+            "total_s": round(time.time() - t_setup, 1),
+        }
+        line["ok"] = bool(line["ok"] and not missing)
+        if tpu_error:
+            line["tpu_error"] = tpu_error[:300]
+        with open(
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "bench_chaos.json"), "w"
+        ) as f:
+            json.dump(line, f, indent=1)
+            f.write("\n")
+        print(json.dumps({
+            "metric": line["metric"],
+            "value": line["value"],
+            "unit": "faults",
+            "ok": line["ok"],
+            "zero_lost": out["zero_lost"],
+            "verified_vs_oracle": out["verified_vs_oracle"],
+            "recovery_s": out["recovery"]["recovery_s"],
+            "recovery_ok": out["recovery_ok"],
+            "failed_tickets": out["tickets"]["failed"],
+            "fallbacks": out["resilience"]["fallbacks"],
+            "breaker_opens": out["resilience"]["breaker"]["opens"],
+            "metrics_missing": missing,
+            "detail_file": "bench_chaos.json",
+        }))
+        return 0 if line["ok"] else 1
+    except Exception as e:
+        import traceback
+
+        traceback.print_exc()
+        print(json.dumps({
+            "metric": "bibfs_serve_chaos",
+            "value": None,
+            "error": f"{type(e).__name__}: {e}"[:400],
+        }))
+        return 1
+
+
 if __name__ == "__main__":
     if "--calibrate" in sys.argv:
         sys.exit(calibrate_main())
+    elif "--serve-chaos" in sys.argv:
+        sys.exit(serve_chaos_main())
     elif "--serve-load" in sys.argv:
         sys.exit(serve_load_main())
     elif "--serve" in sys.argv:

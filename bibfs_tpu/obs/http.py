@@ -1,10 +1,24 @@
-"""Tiny stdlib HTTP endpoint serving the metrics registry.
+"""Tiny stdlib HTTP endpoint serving the metrics registry + health.
 
 ``bibfs-serve --metrics-port N`` starts this next to the engine:
 ``GET /metrics`` renders :data:`bibfs_tpu.obs.metrics.REGISTRY` in
 Prometheus text exposition format (content type
-``text/plain; version=0.0.4``), ``GET /healthz`` answers ``ok`` — the
-two endpoints a scraper and a liveness probe need, and nothing else.
+``text/plain; version=0.0.4``); ``GET /healthz`` answers from the
+engine's health state machine
+(:class:`bibfs_tpu.serve.resilience.HealthMonitor`) once one is
+attached via :meth:`MetricsServer.set_health`:
+
+- ``ready`` — 200, body ``ok``;
+- ``degraded`` — 200, body ``degraded <reasons>`` (the node still
+  SERVES; a load balancer must not eject an answering node);
+- ``live`` / ``draining`` — 503 (not ready: do not route traffic);
+- no health callback attached (standalone registry server, or the
+  window before the engine finishes constructing) — 200 ``ok``, the
+  pre-resilience behavior.
+
+The body's first token is always the state; the JSON detail (breaker
+state, recent errors, queue depth) follows on the next line for humans
+and probes that want the why.
 
 Stdlib only (``http.server`` on a daemon thread), by design: the
 serving process must not grow a web-framework dependency to be
@@ -16,6 +30,7 @@ prints), which is what the CI endpoint probe parses.
 
 from __future__ import annotations
 
+import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
@@ -24,7 +39,28 @@ from bibfs_tpu.obs.metrics import REGISTRY, MetricsRegistry
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
 
-def _make_handler(registry: MetricsRegistry):
+def _health_body(health_cb) -> tuple[int, bytes]:
+    """(status, body) for /healthz. A crashing health callback is
+    itself a health signal: 503, not a traceback through the scrape."""
+    if health_cb is None:
+        return 200, b"ok\n"
+    try:
+        snap = health_cb()
+        state = snap.get("state", "live")
+    except Exception as e:  # pragma: no cover - defensive
+        return 503, f"error {type(e).__name__}: {e}\n".encode()
+    from bibfs_tpu.serve.resilience import healthz_status
+
+    status = healthz_status(state)
+    head = "ok" if state == "ready" else state
+    reasons = snap.get("reasons") or []
+    if reasons:
+        head += " " + "; ".join(str(r) for r in reasons)
+    body = head + "\n" + json.dumps(snap, sort_keys=True, default=str) + "\n"
+    return status, body.encode()
+
+
+def _make_handler(registry: MetricsRegistry, server: "MetricsServer"):
     class Handler(BaseHTTPRequestHandler):
         def do_GET(self):
             path = self.path.split("?", 1)[0]
@@ -36,8 +72,8 @@ def _make_handler(registry: MetricsRegistry):
                 self.end_headers()
                 self.wfile.write(body)
             elif path == "/healthz":
-                body = b"ok\n"
-                self.send_response(200)
+                status, body = _health_body(server._health_cb)
+                self.send_response(status)
                 self.send_header("Content-Type", "text/plain")
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
@@ -52,17 +88,24 @@ def _make_handler(registry: MetricsRegistry):
 
 
 class MetricsServer:
-    """A running ``/metrics`` endpoint; ``close()`` tears it down."""
+    """A running ``/metrics`` + ``/healthz`` endpoint; ``close()``
+    tears it down. ``health`` (or a later :meth:`set_health`) attaches
+    the engine's health snapshot callable — the CLI builds the server
+    BEFORE the engine so the scrape endpoint exists during engine
+    construction; until the callback lands, ``/healthz`` answers the
+    standalone 200 ``ok``."""
 
     def __init__(
         self,
         port: int = 0,
         registry: MetricsRegistry | None = None,
         host: str = "127.0.0.1",
+        health=None,
     ):
         registry = REGISTRY if registry is None else registry
+        self._health_cb = health
         self._httpd = ThreadingHTTPServer(
-            (host, int(port)), _make_handler(registry)
+            (host, int(port)), _make_handler(registry, self)
         )
         self._httpd.daemon_threads = True
         self.host = host
@@ -74,9 +117,19 @@ class MetricsServer:
         )
         self._thread.start()
 
+    def set_health(self, health_cb) -> None:
+        """Attach (or replace) the health callback ``/healthz`` asks —
+        typically ``engine.health_snapshot``. ``None`` detaches (back
+        to the standalone 200 ``ok``)."""
+        self._health_cb = health_cb
+
     @property
     def url(self) -> str:
         return f"http://{self.host}:{self.port}/metrics"
+
+    @property
+    def health_url(self) -> str:
+        return f"http://{self.host}:{self.port}/healthz"
 
     def close(self) -> None:
         self._httpd.shutdown()
@@ -95,7 +148,11 @@ def start_metrics_server(
     port: int = 0,
     registry: MetricsRegistry | None = None,
     host: str = "127.0.0.1",
+    health=None,
 ) -> MetricsServer:
     """Start serving ``registry`` (default: the process-wide one) on
-    ``host:port`` (port 0 = ephemeral); returns the running server."""
-    return MetricsServer(port=port, registry=registry, host=host)
+    ``host:port`` (port 0 = ephemeral); returns the running server.
+    ``health`` optionally wires ``/healthz`` to an engine's
+    ``health_snapshot`` (attachable later via ``set_health``)."""
+    return MetricsServer(port=port, registry=registry, host=host,
+                         health=health)

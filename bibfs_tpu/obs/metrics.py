@@ -444,6 +444,39 @@ class MetricsRegistry:
     def __init__(self):
         self._lock = threading.Lock()
         self._families: dict[str, MetricFamily] = {}
+        self._collectors: list = []
+
+    def add_collector(self, fn) -> None:
+        """Register a render-time hook: ``fn()`` runs at the top of
+        every :meth:`render` (and :meth:`snapshot`), refreshing gauges
+        whose truth is computed on demand rather than event-driven —
+        the health state machine's ``bibfs_health_state`` is the
+        motivating case (breaker windows elapse and error windows age
+        out with NO event; a /metrics-only scraper must still see the
+        current state). A hook that returns ``False`` is UNREGISTERED —
+        how weakly-bound hooks prune themselves once their component is
+        gone, so engine-churning processes don't accumulate dead hooks
+        on every scrape. Hook failures are swallowed: a broken
+        collector must not take down the scrape that would reveal
+        it."""
+        with self._lock:
+            self._collectors.append(fn)
+
+    def _collect(self) -> None:
+        with self._lock:
+            hooks = list(self._collectors)
+        dead = []
+        for fn in hooks:
+            try:
+                if fn() is False:
+                    dead.append(fn)
+            except Exception:
+                pass
+        if dead:
+            with self._lock:
+                self._collectors = [
+                    f for f in self._collectors if f not in dead
+                ]
 
     def _get_or_create(self, name, help, kind, labelnames):
         with self._lock:
@@ -486,6 +519,7 @@ class MetricsRegistry:
     def render(self) -> str:
         """The whole registry in Prometheus text exposition format
         (version 0.0.4) — the ``/metrics`` payload."""
+        self._collect()
         out = [f.render() for f in sorted(
             self.families(), key=lambda f: f.name
         )]
@@ -494,6 +528,7 @@ class MetricsRegistry:
     def snapshot(self) -> dict:
         """JSON-friendly dump: {family: {label_tuple_str: value}} for
         counters/gauges, histogram summaries for histograms."""
+        self._collect()
         snap = {}
         for fam in self.families():
             entry = {}

@@ -21,8 +21,15 @@ from bibfs_tpu.serve.buckets import (  # noqa: F401
 )
 from bibfs_tpu.serve.cache import DistanceCache  # noqa: F401
 from bibfs_tpu.serve.engine import QueryEngine  # noqa: F401
+from bibfs_tpu.serve.faults import FaultPlan, InjectedFault  # noqa: F401
 from bibfs_tpu.serve.pipeline import (  # noqa: F401
     LatencyHistogram,
     PipelinedQueryEngine,
     QueryTicket,
+)
+from bibfs_tpu.serve.resilience import (  # noqa: F401
+    CircuitBreaker,
+    HealthMonitor,
+    QueryError,
+    RetryPolicy,
 )

@@ -144,6 +144,19 @@ def main(argv=None):
         "(default 5.0)",
     )
     ap.add_argument(
+        "--inject-faults",
+        default=None,
+        metavar="SPEC",
+        help="chaos-test against the REAL engine: inject faults at the "
+        "serving seams per SPEC (grammar in bibfs_tpu/serve/faults — "
+        "e.g. 'device:p=0.1' fails 10%% of device dispatches, "
+        "'host_batch:every=4,kind=latency,ms=20' stalls every 4th "
+        "native batch). The BIBFS_FAULTS env var is the flagless "
+        "equivalent; this flag wins when both are set. The resilience "
+        "layer (retry, fallback ladder, breaker) handles what this "
+        "throws",
+    )
+    ap.add_argument(
         "--load",
         default=None,
         metavar="RATE[,RATE...]",
@@ -231,7 +244,8 @@ def main(argv=None):
             except ValueError as e:
                 print(f"Error: {e}", file=sys.stderr)
                 return 2
-        return _serve(args, n, edges, QueryEngine, PipelinedQueryEngine)
+        return _serve(args, n, edges, QueryEngine, PipelinedQueryEngine,
+                      metrics_server)
     finally:
         if tracer is not None:
             from bibfs_tpu.obs.trace import uninstall_and_save
@@ -244,7 +258,8 @@ def main(argv=None):
             metrics_server.close()
 
 
-def _serve(args, n, edges, QueryEngine, PipelinedQueryEngine):
+def _serve(args, n, edges, QueryEngine, PipelinedQueryEngine,
+           metrics_server=None):
     try:
         kwargs = dict(
             mode=args.mode,
@@ -253,6 +268,17 @@ def _serve(args, n, edges, QueryEngine, PipelinedQueryEngine):
             max_batch=args.max_batch,
             cache_entries=args.cache_entries,
         )
+        if args.inject_faults is not None:
+            import os
+
+            from bibfs_tpu.serve.faults import FaultPlan
+
+            # same seed knob as the BIBFS_FAULTS env path (README
+            # documents BIBFS_FAULTS_SEED for both spec sources)
+            kwargs["faults"] = FaultPlan.parse(
+                args.inject_faults,
+                seed=int(os.environ.get("BIBFS_FAULTS_SEED", 0)),
+            )
         if args.pipeline:
             engine = PipelinedQueryEngine(
                 n, edges, max_wait_ms=args.max_wait_ms, **kwargs
@@ -262,6 +288,10 @@ def _serve(args, n, edges, QueryEngine, PipelinedQueryEngine):
     except ValueError as e:
         print(f"Error: {e}", file=sys.stderr)
         return 2
+    if metrics_server is not None:
+        # /healthz answers from the live engine from here on (the
+        # standalone 'ok' covered the construction window)
+        metrics_server.set_health(engine.health_snapshot)
 
     try:
         if args.pairs is not None:
@@ -281,7 +311,12 @@ def _serve(args, n, edges, QueryEngine, PipelinedQueryEngine):
             # stream stdin: tickets resolve at each engine flush (the
             # queue fills to max_batch, or EOF drains the remainder;
             # under --pipeline the background deadline flusher resolves
-            # them within --max-wait-ms on its own)
+            # them within --max-wait-ms on its own). The REPL is
+            # long-lived by construction, so a malformed line (wrong
+            # arity, non-integer, out-of-range id) answers a structured
+            # ``error ...`` line in the result stream and the loop
+            # CONTINUES — one bad client line must never kill the
+            # server every other client is talking to
             tickets: list = []
             emitted = 0
             failed = 0
@@ -292,10 +327,10 @@ def _serve(args, n, edges, QueryEngine, PipelinedQueryEngine):
                     t = tickets[emitted]
                     err = getattr(t, "error", None)
                     if err is not None:
-                        # a failed pipelined batch must surface, not
+                        # a failed ticket must surface in-stream, not
                         # silently stall everything queued behind it
-                        print(f"Error: {t.src} -> {t.dst}: {err}",
-                              file=sys.stderr)
+                        kind = getattr(err, "kind", "internal")
+                        print(f"error {kind}: {t.src} -> {t.dst}: {err}")
                         failed += 1
                     elif t.result is None:
                         break
@@ -308,10 +343,20 @@ def _serve(args, n, edges, QueryEngine, PipelinedQueryEngine):
                 if not parts:
                     continue
                 if len(parts) != 2:
-                    print(f"Error: bad query line {line!r}",
-                          file=sys.stderr)
-                    return 2
-                tickets.append(engine.submit(int(parts[0]), int(parts[1])))
+                    print("error invalid: expected 'src dst', got "
+                          f"{line.strip()!r}")
+                    continue
+                try:
+                    src, dst = int(parts[0]), int(parts[1])
+                except ValueError:
+                    print("error invalid: non-integer node id in "
+                          f"{line.strip()!r}")
+                    continue
+                try:
+                    tickets.append(engine.submit(src, dst))
+                except ValueError as e:
+                    print(f"error invalid: {src} -> {dst}: {e}")
+                    continue
                 drain()
             engine.flush()
             drain()

@@ -43,6 +43,7 @@ batch-solved results carry the whole-batch wall clock in ``time_s``
 from __future__ import annotations
 
 import time
+import weakref
 
 import numpy as np
 
@@ -55,6 +56,16 @@ from bibfs_tpu.serve.buckets import (
     bucketed_ell,
 )
 from bibfs_tpu.serve.cache import DistanceCache
+from bibfs_tpu.serve.faults import FaultPlan
+from bibfs_tpu.serve.resilience import (
+    BREAKER_STATE_CODES,
+    ERROR_KINDS,
+    CircuitBreaker,
+    HealthMonitor,
+    QueryError,
+    RetryPolicy,
+    to_query_error,
+)
 from bibfs_tpu.solvers.api import BFSResult
 
 
@@ -93,15 +104,92 @@ def _engine_counter_bank(label: str) -> MetricBank:
     })
 
 
-class _Pending:
-    """A submitted query's handle; ``result`` lands at flush time."""
+class _ResilienceCells:
+    """The per-engine resilience registry cells (stable names in README
+    "Robustness"): every cell minted at engine construction so a
+    /metrics scrape shows the families at zero from the first breath —
+    the chaos CI gate asserts they render even before anything fails."""
 
-    __slots__ = ("src", "dst", "result")
+    def __init__(self, label: str):
+        errors = REGISTRY.counter(
+            "bibfs_errors_total",
+            "Per-ticket query failures by taxonomy kind",
+            ("engine", "kind"),
+        )
+        fallbacks = REGISTRY.counter(
+            "bibfs_route_fallbacks_total",
+            "Batches re-routed down the fallback ladder",
+            ("engine", "from", "to"),
+        )
+        retries = REGISTRY.counter(
+            "bibfs_retries_total", "Route retries before fallback",
+            ("engine", "route"),
+        )
+        bisections = REGISTRY.counter(
+            "bibfs_batch_bisections_total",
+            "Poison-batch bisection splits during failure isolation",
+            ("engine",),
+        )
+        self.breaker_gauge = REGISTRY.gauge(
+            "bibfs_breaker_state",
+            "Device-route circuit breaker (0=closed 1=half_open 2=open)",
+            ("engine",),
+        ).labels(engine=label)
+        self._breaker_trans = REGISTRY.counter(
+            "bibfs_breaker_transitions_total",
+            "Circuit breaker state transitions",
+            ("engine", "to"),
+        )
+        self.health_gauge = REGISTRY.gauge(
+            "bibfs_health_state",
+            "Serving health (0=live 1=ready 2=degraded 3=draining)",
+            ("engine",),
+        ).labels(engine=label)
+        self.errors = {
+            k: errors.labels(engine=label, kind=k) for k in ERROR_KINDS
+        }
+        self.fallbacks = {
+            ("device", "host"): fallbacks.labels(
+                **{"engine": label, "from": "device", "to": "host"}
+            ),
+            ("host", "serial"): fallbacks.labels(
+                **{"engine": label, "from": "host", "to": "serial"}
+            ),
+        }
+        self.retries = retries.labels(engine=label, route="device")
+        self.bisections = bisections.labels(engine=label)
+        self._label = label
+
+    def on_breaker_transition(self, state: str) -> None:
+        self.breaker_gauge.set(BREAKER_STATE_CODES[state])
+        self._breaker_trans.labels(to=state, engine=self._label).inc()
+
+    def snapshot(self) -> dict:
+        return {
+            "errors": {k: c.value for k, c in self.errors.items()},
+            "fallbacks": {
+                f"{a}->{b}": c.value
+                for (a, b), c in self.fallbacks.items()
+            },
+            "retries": self.retries.value,
+            "bisections": self.bisections.value,
+        }
+
+
+class _Pending:
+    """A submitted query's handle; ``result`` lands at flush time.
+    Exactly one of ``result`` / ``error`` lands: failure isolation
+    gives a poisoned query a structured
+    :class:`~bibfs_tpu.serve.resilience.QueryError` instead of sinking
+    its whole batch."""
+
+    __slots__ = ("src", "dst", "result", "error")
 
     def __init__(self, src: int, dst: int):
         self.src = src
         self.dst = dst
         self.result: BFSResult | None = None
+        self.error: BaseException | None = None
 
 
 class QueryEngine:
@@ -142,6 +230,21 @@ class QueryEngine:
         subclass's ``pipe_counters``) are dict-style views over those
         registry cells, so ``stats()`` and a ``/metrics`` scrape always
         agree.
+    faults : a :class:`bibfs_tpu.serve.faults.FaultPlan` injecting
+        failures at the engine seams (chaos testing against the real
+        engine). Default: parsed from ``BIBFS_FAULTS`` when set, else
+        None — and a None plan costs one attribute check per seam.
+    retry : :class:`~bibfs_tpu.serve.resilience.RetryPolicy` for the
+        device route (default: 2 attempts, exp backoff + jitter).
+    breaker : :class:`~bibfs_tpu.serve.resilience.CircuitBreaker`
+        gating the device route (default: opens after 3 consecutive
+        failures, half-open probe after 5 s). While open, above-
+        crossover flushes fall back to the host ladder instead of
+        failing — a dead accelerator degrades throughput, not
+        availability.
+    health_window_s : sliding window for the health monitor's recent-
+        error degradation input (default 5.0; the chaos harness
+        shrinks it to measure recovery time).
     """
 
     _OBS_PREFIX = "sync"
@@ -163,6 +266,10 @@ class QueryEngine:
         graph_id=None,
         device=None,
         obs_label: str | None = None,
+        faults: FaultPlan | None = None,
+        retry: RetryPolicy | None = None,
+        breaker: CircuitBreaker | None = None,
+        health_window_s: float = 5.0,
     ):
         from bibfs_tpu.graph.csr import canonical_pairs
         from bibfs_tpu.solvers.batch_minor import small_batch_threshold
@@ -208,6 +315,67 @@ class QueryEngine:
         self._device_batches = device_batches
         self._host_solver = None  # built lazily on first host-routed flush
         self._host_native_graph = None  # set alongside a native solver
+        self._serial_solver = None  # last fallback rung, built lazily
+        # resilience: fault plan (None = zero-cost), device retry policy,
+        # device-route circuit breaker, health state machine. The breaker
+        # transition hook keeps the bibfs_breaker_state gauge exact.
+        self._faults = FaultPlan.from_env() if faults is None else faults
+        self._retry = RetryPolicy() if retry is None else retry
+        self._res_cells = _ResilienceCells(self.obs_label)
+        self._breaker = CircuitBreaker() if breaker is None else breaker
+        # listener, not ownership: a breaker SHARED across engines (one
+        # accelerator, several engines) keeps every engine's gauge exact.
+        # WEAKLY bound like the registry health collector below: a
+        # shared breaker outlives engines that churn per solve_many
+        # call, and a strong subscription would pin every dead engine's
+        # cells and fire its gauge forever under the breaker lock
+        # (returning False unsubscribes)
+        cells_ref = weakref.ref(self._res_cells)
+
+        def _on_breaker_transition(state):
+            cells = cells_ref()
+            if cells is None:
+                return False
+            cells.on_breaker_transition(state)
+            return True
+
+        self._breaker.add_listener(_on_breaker_transition)
+        self._res_cells.breaker_gauge.set(
+            BREAKER_STATE_CODES[self._breaker.state]
+        )
+        # the pipelined subclass rebuilds this with its queue-depth
+        # input once max_queue exists (it sets up after super().__init__)
+        self.health = HealthMonitor(
+            breaker=self._breaker,
+            window_s=health_window_s,
+            gauge=self._res_cells.health_gauge,
+        )
+        self._health_window_s = health_window_s
+        self.health.set_ready()
+        # render-time health refresh: breaker windows elapse and error
+        # windows age out with no event, so a /metrics-only scraper
+        # needs the gauges recomputed at scrape time (state() sets the
+        # health gauge; the breaker gauge needs the same refresh — an
+        # open breaker's window elapsing to half_open fires no
+        # transition listener, it is a read-time reinterpretation).
+        # Late-bound through self.health — the pipelined subclass
+        # swaps the monitor in after this ctor returns. WEAKLY bound:
+        # the registry hook must not pin a dead engine's graph and
+        # caches for process lifetime (engines churn per solve_many
+        # call); only the tiny closure accumulates, like label cells.
+        self_ref = weakref.ref(self)
+
+        def _collect_health():
+            eng = self_ref()
+            if eng is None:
+                return False  # engine collected: unregister this hook
+            eng.health.state()
+            eng._res_cells.breaker_gauge.set(
+                BREAKER_STATE_CODES[eng._breaker.state]
+            )
+            return True
+
+        REGISTRY.add_collector(_collect_health)
         self._pending: list[_Pending] = []
         # registry-backed view; keys unchanged from the pre-obs dict:
         # queries, trivial (src == dst, answered inline), cache_served,
@@ -274,19 +442,63 @@ class QueryEngine:
     def query(self, src: int, dst: int) -> BFSResult:
         """Submit + flush one query (the low-latency path: a cache hit
         never touches a solver; a miss dispatches alone, host-side when
-        the crossover says so)."""
+        the crossover says so). Raises the ticket's
+        :class:`QueryError` if every fallback rung failed it."""
         t = self.submit(src, dst)
-        if t.result is None:
+        if t.result is None and t.error is None:
             self.flush()
+        if t.error is not None:
+            raise t.error
         return t.result
 
-    def query_many(self, pairs) -> list[BFSResult]:
-        """Serve a whole query list through one (chunked) flush."""
-        tickets = [self.submit(int(s), int(d)) for s, d in pairs]
+    def query_many(self, pairs, *, return_errors: bool = False) -> list:
+        """Serve a whole query list through one (chunked) flush.
+
+        ``return_errors=True`` switches to partial-failure mode: the
+        returned list holds one entry per pair — a
+        :class:`~bibfs_tpu.solvers.api.BFSResult` where the query
+        resolved, a :class:`QueryError` where it (alone) failed,
+        including queries rejected at submit time (``kind='invalid'``).
+        The default re-raises the first failure, matching the
+        pre-resilience contract."""
+        tickets = self._submit_collect(pairs, return_errors)
         if not tickets:
             return []  # nothing queued: skip the flush entirely
-        self.flush()
-        return [t.result for t in tickets]
+        if any(isinstance(t, _Pending) for t in tickets):
+            self.flush()
+        out = []
+        for t in tickets:
+            if isinstance(t, QueryError):
+                out.append(t)
+            elif t.error is not None:
+                if not return_errors:
+                    raise t.error
+                out.append(to_query_error(t.error, (t.src, t.dst)))
+            else:
+                out.append(t.result)
+        return out
+
+    def _submit_collect(self, pairs, return_errors: bool) -> list:
+        """Submit every pair; in ``return_errors`` mode a rejected
+        submit becomes a ``kind='invalid'`` :class:`QueryError` slot
+        (submit-time validation is the ONE place that knows it is
+        looking at client input) instead of aborting the whole list.
+        Shared by both engines' ``query_many``."""
+        tickets: list = []
+        for s, d in pairs:
+            try:
+                tickets.append(self.submit(int(s), int(d)))
+            except (ValueError, TypeError) as e:
+                if not return_errors:
+                    raise
+                try:
+                    q = (int(s), int(d))
+                except (ValueError, TypeError):
+                    q = None
+                err = to_query_error(e, q, kind="invalid")
+                self._count_error(err)
+                tickets.append(err)
+        return tickets
 
     # ---- flushing ----------------------------------------------------
     def flush(self) -> None:
@@ -315,10 +527,57 @@ class QueryEngine:
                     self._flush_device(chunk, unique)
 
     def _flush_device(self, pairs, unique) -> None:
-        out, finish, t0 = self._device_launch(pairs)
-        results = self._device_finish(out, finish, t0, pairs)
+        results = self._device_attempt(pairs)
+        if results is None:
+            # every retry burned (or the breaker is open): degrade to
+            # the host ladder instead of failing the batch
+            self._note_fallback("device", "host")
+            self._flush_host(pairs, unique)
+            return
         for i, (src, dst) in enumerate(pairs):
             self._resolve(unique[(src, dst)], src, dst, results[i])
+
+    def _device_attempt(self, pairs) -> list[BFSResult] | None:
+        """The resilient device route: bounded retries with backoff
+        behind the circuit breaker. Returns the batch results, or None
+        when the route is unavailable (breaker open / retries
+        exhausted) — the caller degrades to the host ladder. The
+        fault-free fast path is one ``allow()``/``record_success()``
+        pair per flush."""
+        retry = self._retry
+        if not self._breaker.allow():
+            return None
+        attempt = 0
+        try:
+            while True:
+                try:
+                    out, finish, t0 = self._device_launch(pairs)
+                    results = self._device_finish(out, finish, t0, pairs)
+                except Exception:
+                    self._breaker.record_failure()
+                    attempt += 1
+                    # gate BEFORE counting/sleeping (exactly one allow()
+                    # per launch, every True followed by a record): when
+                    # this failure just opened the breaker there is no
+                    # retry to count and no backoff worth blocking for
+                    if (attempt < retry.attempts
+                            and self._breaker.allow()):
+                        self._res_cells.retries.inc()
+                        time.sleep(retry.delay_s(attempt - 1))
+                        continue
+                    return None
+                self._breaker.record_success()
+                return results
+        except BaseException:
+            # an escape past the Exception handler (KeyboardInterrupt
+            # mid-launch, or during the backoff sleep whose allow() is
+            # already claimed) must not leave the admitting allow()
+            # unrecorded — a leaked half-open probe claim makes allow()
+            # return False forever and the device route never recovers
+            # (the pipelined launch path guards the same way; an extra
+            # record_failure after a counted one is harmless)
+            self._breaker.record_failure()
+            raise
 
     def _device_launch(self, pairs):
         """Stage 1 of a device flush: enqueue ONE batched program for
@@ -331,6 +590,8 @@ class QueryEngine:
         from bibfs_tpu.solvers.dense import _batch_dispatch
 
         with span("device_launch", batch=len(pairs)):
+            if self._faults is not None:
+                self._faults.fire("device", pairs)
             graph = self.graph  # lazy build; also sets self._bucket_key
             rung = min(bucket_batch(len(pairs)), self.max_batch)
             # pad the flush to its batch rung with inert (0, 0) queries so
@@ -356,6 +617,8 @@ class QueryEngine:
         from bibfs_tpu.solvers.timing import force_scalar
 
         with span("device_finish", batch=len(pairs)):
+            if self._faults is not None:
+                self._faults.fire("device_finish", pairs)
             force_scalar(out)  # lazy runtimes execute at the value read
             elapsed = time.perf_counter() - t0
             outs = finish(out)
@@ -413,16 +676,119 @@ class QueryEngine:
         return jax.default_backend() != "cpu"
 
     def _flush_host(self, pairs, unique) -> None:
-        results = self._solve_host(pairs)
-        bank = self._paths_to_bank(results)
-        self._c_host_queries.inc(len(pairs))
+        results = self._solve_host_isolated(pairs)
+        n_ok = self._deliver_host_results(
+            pairs, results,
+            lambda key, res: self._resolve(unique[key], *key, res),
+            lambda key, err: self._resolve_error(unique[key], err),
+        )
+        self._c_host_queries.inc(n_ok)
+
+    def _deliver_host_results(self, pairs, results,
+                              resolve_ok, resolve_err) -> int:
+        """One host batch's delivery skeleton, shared by the sync flush
+        and the pipelined finish-worker paths (which differ only in HOW
+        a ticket resolves/fails): partition the isolator's mixed
+        ``BFSResult | QueryError`` list, remap the banking-hygiene
+        indices (computed over successes only) back onto batch
+        positions, bank, and hand each entry to the right callback.
+        Returns the success count (the ``host_queries`` increment —
+        failures are counted by the error path).
+
+        No parent planes exist on the host route, but each found
+        shortest path is itself a valid forest fragment for both
+        endpoints — so repeated-source traffic stays cache-servable."""
+        ok_idx = [
+            i for i, r in enumerate(results)
+            if not isinstance(r, QueryError)
+        ]
+        bank = self._paths_to_bank([results[i] for i in ok_idx])
+        bank_idx = {ok_idx[j] for j in bank}
         for i, ((src, dst), res) in enumerate(zip(pairs, results)):
-            # no parent planes on the host path, but the shortest path
-            # itself is a valid forest fragment for both endpoints — so
-            # repeated-source traffic stays cache-servable on this route
-            if i in bank:
+            if isinstance(res, QueryError):
+                resolve_err((src, dst), res)
+                continue
+            if i in bank_idx:
                 self.dist_cache.put_path(self.graph_id, res.path, self.n)
-            self._resolve(unique[(src, dst)], src, dst, res)
+            resolve_ok((src, dst), res)
+        return len(ok_idx)
+
+    def _solve_host_isolated(self, pairs):
+        """The host route with failure isolation: the whole batch first
+        (``_solve_host``, zero extra cost when nothing fails); on
+        failure, BISECT — halves re-solve independently, so a poison
+        batch converges in O(log B) extra solves to exactly the queries
+        that are actually bad. A failing singleton gets one last rung
+        (the NumPy serial oracle, independent of both the native
+        runtime and the device stack) and only then a structured
+        :class:`QueryError`. Returns one ``BFSResult | QueryError`` per
+        pair; never raises."""
+        try:
+            return self._solve_host(pairs)
+        except Exception as exc:
+            if len(pairs) == 1:
+                self._note_fallback("host", "serial")
+                try:
+                    src, dst = pairs[0]
+                    return [self._solve_serial_one(src, dst)]
+                except Exception as exc2:
+                    return [to_query_error(exc2, pairs[0])]
+            self._res_cells.bisections.inc()
+            mid = len(pairs) // 2
+            del exc  # halves re-derive their own failure (or succeed)
+            return (
+                self._solve_host_isolated(pairs[:mid])
+                + self._solve_host_isolated(pairs[mid:])
+            )
+
+    def _solve_serial_one(self, src: int, dst: int) -> BFSResult:
+        """The bottom of the fallback ladder: the pure-NumPy serial
+        oracle over a CSR built from the canonical pairs — no native
+        runtime, no device stack, nothing left to be broken but the
+        graph itself."""
+        if self._serial_solver is None:
+            if (getattr(self, "host_backend_resolved", None) == "serial"
+                    and self._host_solver is not None):
+                # the host route already IS the serial oracle: reuse it
+                # instead of building a second identical O(E) CSR
+                self._serial_solver = self._host_solver
+            else:
+                from bibfs_tpu.graph.csr import build_csr
+                from bibfs_tpu.solvers.serial import solve_serial_csr
+
+                row_ptr, col_ind = build_csr(
+                    self.n, pairs=self._pairs_host
+                )
+                self._serial_solver = (
+                    lambda s, d: solve_serial_csr(
+                        self.n, row_ptr, col_ind, s, d
+                    )
+                )
+        return self._serial_solver(int(src), int(dst))
+
+    def _resolve_error(self, tickets, err: QueryError) -> None:
+        """Fail exactly these tickets with a structured error (their
+        batch peers resolve normally) and feed the error telemetry."""
+        self._count_error(err, len(tickets))
+        for t in tickets:
+            t.error = err
+
+    def _count_error(self, err: BaseException, n: int = 1) -> None:
+        from bibfs_tpu.serve.resilience import HEALTH_ERROR_KINDS
+
+        kind = getattr(err, "kind", "internal")
+        cell = self._res_cells.errors.get(kind)
+        if cell is None:
+            cell = self._res_cells.errors["internal"]
+        cell.inc(n)
+        # only SERVER-side failures degrade health: a client submitting
+        # malformed queries or abandoning tickets must not be able to
+        # flip a healthy node's /healthz
+        if kind in HEALTH_ERROR_KINDS:
+            self.health.note_error(n)
+
+    def _note_fallback(self, frm: str, to: str) -> None:
+        self._res_cells.fallbacks[(frm, to)].inc()
 
     def _paths_to_bank(self, results) -> set:
         """Flush-time banking hygiene, host edition: of this flush's
@@ -450,6 +816,8 @@ class QueryEngine:
         native runtime carries the route and the flush is big enough to
         amortize it, else the per-query solver loop."""
         with span("host_batch", batch=len(pairs)):
+            if self._faults is not None:
+                self._faults.fire("host_batch", pairs)
             solver = self._get_host_solver()
             ng = self._host_native_graph
             if ng is not None and len(pairs) >= self.HOST_BATCH_MIN:
@@ -526,11 +894,13 @@ class QueryEngine:
 
     # ---- lifecycle ---------------------------------------------------
     def close(self) -> None:
-        """Resolve anything still queued. The synchronous engine owns no
+        """Resolve anything still queued, then mark the engine draining
+        (``/healthz`` flips to 503). The synchronous engine owns no
         threads, so this is just a drain — it exists so load drivers and
         ``with`` blocks treat both engine flavors uniformly (the
         pipelined subclass tears down its worker threads here)."""
         self.flush()
+        self.health.set_draining()
 
     def __enter__(self):
         return self
@@ -561,4 +931,18 @@ class QueryEngine:
             ),
             "device_batches_enabled": self._use_device(),
             "host_backend": getattr(self, "host_backend_resolved", None),
+            "resilience": {
+                **self._res_cells.snapshot(),
+                "breaker": self._breaker.snapshot(),
+                "retry": self._retry.snapshot(),
+                "faults": (
+                    None if self._faults is None else self._faults.stats()
+                ),
+            },
+            "health": self.health.snapshot(),
         }
+
+    def health_snapshot(self) -> dict:
+        """The ``/healthz`` payload: the health state machine's view
+        (state, reasons, breaker, recent errors)."""
+        return self.health.snapshot()

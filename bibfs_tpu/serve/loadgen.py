@@ -267,6 +267,276 @@ def run_load_point(
         engine.close()
 
 
+def run_chaos(
+    n,
+    edges,
+    *,
+    queries: int = 600,
+    min_fault_fraction: float = 0.10,
+    fault_spec: str | None = None,
+    rate_qps: float = 250.0,
+    # the deadline x rate product must reach the device crossover or
+    # every batch pops sub-threshold and the fault plan's device seams
+    # never run: 250 q/s x 60 ms ~= 15 queries/batch >= threshold 8
+    max_wait_ms: float = 60.0,
+    flush_threshold: int = 8,
+    max_batch: int = 128,
+    breaker_reset_s: float = 0.75,
+    health_window_s: float = 2.0,
+    recovery_bound_s: float = 10.0,
+    seed: int = 0,
+    **engine_kwargs,
+) -> dict:
+    """The chaos/soak measurement (``bench.py --serve-chaos``): the
+    open-loop load generator driven against the REAL pipelined engine
+    while a :class:`~bibfs_tpu.serve.faults.FaultPlan` fails a fraction
+    of its device flushes, then with the faults cleared — asserting the
+    three robustness claims the resilience layer makes:
+
+    1. **zero lost tickets** — every submitted query resolves with a
+       result or a structured :class:`QueryError`; nothing strands;
+    2. **oracle-correct survivors** — every non-failed result matches
+       the serial oracle hop-for-hop (the fallback ladder may not trade
+       correctness for availability);
+    3. **bounded recovery** — after the fault schedule clears, probe
+       traffic returns the health state machine to ``ready`` within
+       ``recovery_bound_s`` (the breaker's half-open probe closes it,
+       the error window ages out).
+
+    Default schedule: phase 1 serves ~2/3 of the traffic (submitted
+    AND drained, so the faults cover the batches' execution, not just
+    their submission) with ``device:every=2; device_finish:every=3``
+    active — deterministic injection at both device seams (the
+    dispatch failure the flusher retries and the mid-execution failure
+    the finish worker recovers), well above the ``min_fault_fraction``
+    gate and reproducible run-to-run where a probabilistic rule over a
+    handful of flushes is a coin toss. Phase 2 serves the rest
+    fault-free, then probe batches drive the breaker's recovery.
+    Returns the machine-readable ``bench_chaos.json`` payload (``ok``
+    aggregates the claims; the injected device-seam fraction must
+    reach ``min_fault_fraction``).
+    """
+    from bibfs_tpu.graph.csr import build_csr, canonical_pairs
+    from bibfs_tpu.serve.buckets import ExecutableCache
+    from bibfs_tpu.serve.faults import FaultPlan
+    from bibfs_tpu.serve.pipeline import PipelinedQueryEngine
+    from bibfs_tpu.serve.resilience import CircuitBreaker
+    from bibfs_tpu.solvers.serial import solve_serial_csr
+
+    if fault_spec is None:
+        # every=2 on the launch seam: the fault phase is guaranteed at
+        # least two device launches (its traffic exceeds one max_batch
+        # pop), so the deterministic rule ALWAYS fires — a sparser rule
+        # can land every fault-phase batch on a non-multiple call count
+        # when backlog-adaptive batching collapses the phase into a
+        # couple of big flushes, and a chaos gate that sometimes
+        # injects nothing is itself flaky
+        fault_spec = "device:every=2;device_finish:every=3"
+    plan = FaultPlan.parse(fault_spec, seed=seed)
+    plan.set_active(False)  # warmup runs clean
+
+    cpairs = canonical_pairs(n, edges)
+    csr = build_csr(n, pairs=cpairs)
+    # traffic + probe pools, all unique so the measurement exercises the
+    # solvers (and the fallback ladder), not the caches. The probe pool
+    # is deep: each recovery poll burns one UNIQUE device-flush batch
+    # (a cache-served probe would never drive the breaker's half-open
+    # probe), and the breaker's reset window must fit inside it
+    pool = sample_query_pairs(
+        n, queries + 512 * flush_threshold, seed=seed
+    )
+    pairs = pool[:queries]
+    probes = pool[queries:]
+    oracle = {
+        (int(s), int(d)): solve_serial_csr(n, *csr, int(s), int(d))
+        for s, d in pairs
+    }
+
+    engine = PipelinedQueryEngine(
+        n, edges, pairs=cpairs,
+        flush_threshold=flush_threshold, max_batch=max_batch,
+        device_batches=True, exec_cache=ExecutableCache(),
+        max_wait_ms=max_wait_ms, faults=plan,
+        breaker=CircuitBreaker(reset_s=breaker_reset_s),
+        health_window_s=health_window_s,
+        **engine_kwargs,
+    )
+    t_setup = time.perf_counter()
+    try:
+        # warm the device program (compile excluded, like every bench)
+        engine.query_many(
+            [(int(s), int(d)) for s, d in probes[:flush_threshold]]
+        )
+        split = max((2 * len(pairs)) // 3, 1)
+
+        def drive(chunk, t0):
+            tickets = []
+            for i, (s, d) in enumerate(chunk):
+                delay = t0 + i / rate_qps - time.perf_counter()
+                if delay > 0:
+                    time.sleep(delay)
+                tickets.append(engine.submit(int(s), int(d)))
+            return tickets
+
+        def drain_bounded() -> bool:
+            """flush() with a bound: a stranded ticket (the bug class
+            this harness exists to catch) must come back as a
+            zero_lost=false verdict, never as a hang that eats the CI
+            timeout with no artifact."""
+            try:
+                engine.flush(timeout=60.0)
+                return True
+            except TimeoutError:
+                return False
+
+        plan.set_active(True)
+        t_fault = time.perf_counter()
+        tickets = drive(pairs[:split], t_fault)
+        drained = drain_bounded()  # faults cover phase 1's EXECUTION
+        plan.set_active(False)
+        t_clear = time.perf_counter()
+        tickets += drive(pairs[split:], t_clear)
+        drained = drain_bounded() and drained
+
+        lost, failed, mismatches = [], [], []
+        # a failed drain already proved stranding: collect the ticket
+        # states fast instead of paying 60 s per stranded waiter
+        wait_s = 60.0 if drained else 2.0
+        for (s, d), t in zip(pairs, tickets):
+            s, d = int(s), int(d)
+            try:
+                res = t.wait(timeout=wait_s)
+            except TimeoutError:
+                lost.append((s, d))
+                wait_s = 2.0  # peers of a stranded ticket fail fast
+                continue
+            except Exception as e:
+                failed.append(
+                    {"query": [s, d], "kind": getattr(e, "kind", "?"),
+                     "error": str(e)[:200]}
+                )
+                continue
+            ref = oracle[(s, d)]
+            if res.found != ref.found or (
+                ref.found and res.hops != ref.hops
+            ):
+                mismatches.append(f"{s}->{d}: {res.hops} != {ref.hops}")
+            elif ref.found and res.path is not None and not _validate(
+                csr, res, s, d
+            ):
+                mismatches.append(f"{s}->{d}: path failed validation")
+
+        # recovery: probe batches give the breaker its half-open probe
+        # and keep the health reads honest (a dead engine would never
+        # flip back to ready on its own). Guard FIRST for stranded
+        # tickets: a probe's query_many would flush(), and flush()
+        # blocks while anything is still outstanding — the harness must
+        # report the zero_lost violation, not hang on it. The bound is
+        # measured from PROBE start (probe_s): the oracle-verify pass
+        # above can eat arbitrary wall time on a loaded box, and slow
+        # verification must not masquerade as slow recovery (recovery_s
+        # still reports wall time since the faults cleared, for the
+        # record)
+        recovery_s = probe_s = None
+        stranded_pre = engine.stats()["pipeline"]["outstanding"]
+        probe_at = flush_threshold  # first threshold pairs warmed up
+        t_probe0 = time.perf_counter()
+        deadline = t_probe0 + recovery_bound_s
+        while not lost and stranded_pre == 0:
+            state = engine.health_snapshot()["state"]
+            if state == "ready":
+                now = time.perf_counter()
+                probe_s = now - t_probe0
+                recovery_s = now - t_clear
+                break
+            if time.perf_counter() > deadline:
+                break
+            batch = probes[probe_at: probe_at + flush_threshold]
+            probe_at += flush_threshold
+            if probe_at + flush_threshold > len(probes):
+                probe_at = flush_threshold  # wrap (cache-served repeats)
+            # bounded probe (submit + flush(timeout), NOT query_many,
+            # whose internal flush has no bound): a ticket stranded
+            # DURING probing must end the measurement with a verdict —
+            # the stats() outstanding count feeds zero_lost below —
+            # never hang the harness
+            for s, d in batch:
+                engine.submit(int(s), int(d))
+            try:
+                engine.flush(timeout=10.0)
+            except TimeoutError:
+                break
+            time.sleep(0.02)
+
+        stats = engine.stats()
+        stranded = stats["pipeline"]["outstanding"]
+        fstats = plan.stats()
+        device_rules = [
+            r for r in fstats["rules"] if r["rule"].startswith("device")
+        ]
+        dev_calls = sum(r["calls"] for r in device_rules)
+        dev_fired = sum(r["fired"] for r in device_rules)
+        fault_fraction = dev_fired / dev_calls if dev_calls else 0.0
+        recovered = probe_s is not None and probe_s <= recovery_bound_s
+        out = {
+            "n": int(n),
+            "queries": len(pairs),
+            "fault_spec": fault_spec,
+            "min_fault_fraction": min_fault_fraction,
+            "device_fault_fraction": round(fault_fraction, 4),
+            "rate_qps": rate_qps,
+            "faults": fstats,
+            "tickets": {
+                "submitted": len(tickets),
+                "resolved": len(tickets) - len(lost) - len(failed),
+                "failed": len(failed),
+                "lost": len(lost),
+                "stranded_outstanding": stranded,
+            },
+            "failed_sample": failed[:10],
+            "mismatches": mismatches[:10],
+            "fault_phase_s": round(t_clear - t_fault, 3),
+            "recovery": {
+                "bound_s": recovery_bound_s,
+                "recovery_s": (
+                    None if recovery_s is None else round(recovery_s, 3)
+                ),
+                "probe_s": (
+                    None if probe_s is None else round(probe_s, 3)
+                ),
+                "recovered": recovered,
+                "final_health": engine.health_snapshot(),
+            },
+            "resilience": stats["resilience"],
+            "engine": {
+                "device_batches": stats["device_batches"],
+                "host_queries": stats["host_queries"],
+                "flushes": stats["pipeline"]["flushes"],
+                "latency_ms": stats["latency_ms"],
+            },
+            "setup_to_drain_s": round(time.perf_counter() - t_setup, 3),
+            # the three claims, plus "nothing stranded in the pipeline"
+            "zero_lost": not lost and stranded == 0 and drained,
+            "verified_vs_oracle": not mismatches,
+            "recovery_ok": recovered,
+            "faults_injected": fstats["fired_total"],
+        }
+        out["ok"] = bool(
+            out["zero_lost"] and out["verified_vs_oracle"]
+            and out["recovery_ok"]
+            and fault_fraction >= min_fault_fraction
+        )
+        return out
+    finally:
+        engine.close()
+
+
+def _validate(csr, res, s, d) -> bool:
+    from bibfs_tpu.solvers.api import validate_path
+
+    return validate_path(csr, res.path, s, d, hops=res.hops)
+
+
 def measure_capacity(make_engine, pairs) -> float:
     """Closed-loop capacity of a fresh sync engine driven the way the
     open-loop driver saturates it — flush_threshold-sized batched

@@ -58,6 +58,11 @@ from concurrent.futures import ThreadPoolExecutor
 from bibfs_tpu.obs.metrics import REGISTRY, LogHistogram, MetricBank
 from bibfs_tpu.obs.trace import span
 from bibfs_tpu.serve.engine import QueryEngine, _Pending
+from bibfs_tpu.serve.resilience import (
+    HealthMonitor,
+    QueryError,
+    to_query_error,
+)
 from bibfs_tpu.solvers.api import BFSResult
 
 # The latency histogram grew into the general observability type
@@ -176,22 +181,57 @@ class QueryTicket(_Pending):
     park on the engine's single condition variable, which resolution
     broadcasts once per BATCH."""
 
-    __slots__ = ("t_submit", "t_done", "error", "_engine")
+    __slots__ = ("t_submit", "t_done", "_engine")
 
     def __init__(self, src: int, dst: int, engine=None):
         super().__init__(src, dst)
         self.t_submit = time.perf_counter()
         self.t_done: float | None = None
-        self.error: BaseException | None = None
         self._engine = engine
 
     def done(self) -> bool:
         return self.result is not None or self.error is not None
 
-    def wait(self, timeout: float | None = None) -> BFSResult:
+    def cancel(self) -> bool:
+        """Abandon this ticket: if it is still QUEUED it is removed
+        from the engine's queue and batch accounting (so a later
+        ``flush()``/``close()`` never waits on it) and fails with a
+        ``kind='timeout'`` :class:`QueryError`. Returns True if this
+        call cancelled it; False if it already resolved, failed, or
+        was popped into an in-flight batch (in-flight tickets resolve
+        normally — the pipeline never tears a launched batch apart).
+
+        This is the post-``wait(timeout=...)`` cleanup: a timed-out
+        waiter that walks away without cancelling leaves the ticket
+        parked in the queue accounting forever."""
+        eng = self._engine
+        if eng is None or self.done():
+            return False
+        with eng._cv:
+            if self.done():
+                return False
+            try:
+                eng._queue.remove(self)
+            except ValueError:
+                return False  # already launched; it will resolve
+            eng._outstanding -= 1
+            eng._g_queue_depth.set(len(eng._queue))
+            self.t_done = time.perf_counter()
+            self.error = QueryError(
+                "cancelled while queued", kind="timeout",
+                query=(self.src, self.dst),
+            )
+            eng._count_error(self.error)
+            eng._cv.notify_all()
+        return True
+
+    def wait(self, timeout: float | None = None, *,
+             cancel_on_timeout: bool = False) -> BFSResult:
         """Block until the pipeline resolves this query and return its
         :class:`BFSResult`; re-raises a pipeline-side failure, raises
-        ``TimeoutError`` if ``timeout`` seconds pass first."""
+        ``TimeoutError`` if ``timeout`` seconds pass first
+        (``cancel_on_timeout=True`` additionally :meth:`cancel` s the
+        ticket so the abandoned query leaves the batch accounting)."""
         if self.result is None and self.error is None:
             eng = self._engine
             deadline = (
@@ -203,6 +243,12 @@ class QueryTicket(_Pending):
                     if deadline is not None:
                         remaining = deadline - time.monotonic()
                         if remaining <= 0:
+                            if (cancel_on_timeout and not self.cancel()
+                                    and self.done()):
+                                # resolved in the deadline->cancel
+                                # window: deliver the result we have
+                                # rather than discarding it
+                                break
                             raise TimeoutError(
                                 f"query ({self.src}, {self.dst}) "
                                 f"unresolved after {timeout}s"
@@ -282,6 +328,27 @@ class PipelinedQueryEngine(QueryEngine):
         # throttled by max_queue)
         self.pipe_counters = _pipe_counter_bank(self.obs_label)
         self._errors: list[str] = []
+        # rebuild the health monitor with the queue-pressure input the
+        # base ctor could not have (max_queue exists only now): a queue
+        # at >= 90% of the admission bound reads as degraded
+        self.health = HealthMonitor(
+            breaker=self._breaker,
+            window_s=self._health_window_s,
+            queue_depth=lambda: len(self._queue),
+            max_queue=self.max_queue,
+            gauge=self._res_cells.health_gauge,
+        )
+        self.health.set_ready()
+        # host solving serializer: the device->host RECOVERY path runs
+        # host solves on the finish worker, concurrently with the
+        # flusher's _launch_host — but the per-query native solver
+        # reuses one NativeGraph scratch (solvers/native.py: explicitly
+        # NOT thread-safe), and the host-solver lazy init is not
+        # synchronized either. Uncontended (the no-failure case: only
+        # the flusher ever takes it), so the fast path pays one free
+        # lock acquisition per host batch. Reentrant: the bisection
+        # isolator recurses through this same override.
+        self._host_solve_lock = threading.RLock()
         self._finish_pool = ThreadPoolExecutor(
             1, thread_name_prefix="bibfs-finish"
         )
@@ -368,18 +435,42 @@ class PipelinedQueryEngine(QueryEngine):
         queue depth — decides when it actually flushes)."""
         return self.submit(src, dst).wait()
 
-    def query_many(self, pairs) -> list[BFSResult]:
-        """Submit a whole query list, drain, and return the results."""
-        tickets = [self.submit(int(s), int(d)) for s, d in pairs]
+    def query_many(self, pairs, *, return_errors: bool = False) -> list:
+        """Submit a whole query list, drain, and return the results.
+
+        ``return_errors=True`` is the partial-failure mode (same
+        contract as the synchronous engine's): per-pair
+        ``BFSResult | QueryError`` instead of raising on the first
+        failed ticket."""
+        tickets = self._submit_collect(pairs, return_errors)
         if not tickets:
             return []
-        self.flush()
-        return [t.wait(timeout=60.0) for t in tickets]
+        if any(isinstance(t, QueryTicket) for t in tickets):
+            self.flush()
+        out = []
+        for t in tickets:
+            if isinstance(t, QueryError):
+                out.append(t)
+                continue
+            try:
+                out.append(t.wait(timeout=60.0))
+            except Exception as e:
+                if not return_errors:
+                    raise
+                out.append(to_query_error(e, (t.src, t.dst)))
+        return out
 
     # ---- flushing ----------------------------------------------------
-    def flush(self) -> None:
+    def flush(self, timeout: float | None = None) -> None:
         """Force the background flusher to drain the queue NOW, then
-        block until every previously submitted query has resolved."""
+        block until every previously submitted query has resolved.
+        ``timeout`` bounds the drain wait (seconds) — on expiry a
+        ``TimeoutError`` reports how many tickets are still
+        outstanding, which is how the chaos harness detects a stranded
+        ticket instead of hanging on it."""
+        deadline = (
+            None if timeout is None else time.monotonic() + timeout
+        )
         with self._cv:
             self._flush_req = True
             self._cv.notify_all()
@@ -388,21 +479,48 @@ class PipelinedQueryEngine(QueryEngine):
                     raise RuntimeError(
                         "pipeline flusher died: " + "; ".join(self._errors)
                     )
+                if (deadline is not None
+                        and time.monotonic() >= deadline):
+                    raise TimeoutError(
+                        f"flush timed out after {timeout}s with "
+                        f"{self._outstanding} tickets outstanding"
+                    )
                 self._cv.wait(timeout=0.1)
 
     def close(self) -> None:
         """Drain the queue, stop the flusher, and join every worker.
-        Idempotent; the engine rejects submissions afterwards."""
+        Idempotent; the engine rejects submissions afterwards (and
+        ``/healthz`` reports ``draining`` from the first moment, so a
+        load balancer stops sending traffic while the drain runs).
+        Tickets already submitted are resolved by the drain; anything
+        left queued after the workers stop (a wedged or dead flusher)
+        is failed with a clear ``engine is closed`` error rather than
+        stranding its waiters."""
         with self._cv:
             if self._closed:
                 already = True
             else:
                 already = False
                 self._closed = True
+                self.health.set_draining()
                 self._cv.notify_all()
         self._flusher.join(timeout=60.0)
         if not already:
             self._finish_pool.shutdown(wait=True)
+            with self._cv:
+                leftovers = [t for t in self._queue if not t.done()]
+                self._queue.clear()
+                for t in leftovers:
+                    # kind=capacity, per the taxonomy ("engine
+                    # draining"): a routine shutdown must not land in
+                    # the internal-failure series operators alert on
+                    self._fail_ticket(t, QueryError(
+                        "engine is closed", kind="capacity",
+                        query=(t.src, t.dst),
+                    ))
+                self._outstanding -= len(leftovers)
+                self._g_queue_depth.set(0)
+                self._cv.notify_all()
 
     # ---- the background flusher --------------------------------------
     def _flush_reason_locked(self):
@@ -473,9 +591,14 @@ class PipelinedQueryEngine(QueryEngine):
         if not pairs:
             return
         if len(pairs) >= self.flush_threshold and self._use_device():
-            self._launch_device(pairs, unique)
-        else:
-            self._launch_host(pairs, unique)
+            # the breaker gates the device route: open = the route is
+            # known-bad, go straight to the host ladder (half-open lets
+            # one probe batch through; its outcome closes or re-opens)
+            if self._breaker.allow():
+                self._launch_device(pairs, unique)
+                return
+            self._note_fallback("device", "host")
+        self._launch_host(pairs, unique)
 
     def _serve_cached(self, unique) -> list[tuple[int, int]]:
         """One cache pass over the deduped batch (submit skips the
@@ -495,8 +618,8 @@ class PipelinedQueryEngine(QueryEngine):
                 None, 0.0, 0, 0,
             )
             for t in tickets:
-                self._finish_ticket(t, res)
-                lats.append(t.t_done - t.t_submit)
+                if self._finish_ticket(t, res):
+                    lats.append(t.t_done - t.t_submit)
             hits += len(tickets)
         if hits:
             self.latency.record_many(lats)
@@ -508,38 +631,95 @@ class PipelinedQueryEngine(QueryEngine):
 
     # -- device route: dispatch on the flusher, finish on the worker --
     def _launch_device(self, pairs, unique) -> None:
+        """Resilient device dispatch: bounded retries with backoff on
+        the flusher (the breaker already admitted this batch); when the
+        launch seam stays dead, release the in-flight slot and degrade
+        the batch to the host ladder instead of failing its tickets.
+        The breaker's success is recorded at FINISH time (a dispatch
+        that enqueues but cannot execute must not close a half-open
+        breaker)."""
         self._inflight.acquire()  # double-buffer backpressure
         # "one batch time" (batch_service_max_ms) is measured from AFTER
         # the in-flight window opens: including the acquire wait would
         # make the deadline budget self-referential under backlog
         t_launch = time.perf_counter()
+        attempt = 0
+        held = True  # our in-flight slot, until handed to the finish job
         try:
-            self.stages.enter()
-            try:
-                out, finish, t0 = self._device_launch(pairs)
-            finally:
-                self.stages.exit()
+            while True:
+                try:
+                    self.stages.enter()
+                    try:
+                        out, finish, t0 = self._device_launch(pairs)
+                    finally:
+                        self.stages.exit()
+                    break
+                except Exception as e:
+                    self._breaker.record_failure()
+                    self._record_error(e)
+                    attempt += 1
+                    # gate BEFORE counting/sleeping: when this failure
+                    # was the one that opened the breaker, there is no
+                    # retry to count and no backoff worth blocking the
+                    # flusher for
+                    if (attempt < self._retry.attempts
+                            and self._breaker.allow()):
+                        self._res_cells.retries.inc()
+                        time.sleep(self._retry.delay_s(attempt - 1))
+                        continue
+                    held = False
+                    self._inflight.release()
+                    self._note_fallback("device", "host")
+                    self._launch_host(pairs, unique)
+                    return
+            self._finish_pool.submit(
+                self._device_finish_job, out, finish, t0, pairs, unique,
+                t_launch,
+            )
         except BaseException:
-            self._inflight.release()
+            # an escape outside the retry loop (KeyboardInterrupt, a
+            # dead finish pool raising on submit) must not leak the
+            # in-flight slot — a leaked slot halves the pipeline, two
+            # wedge it forever — NOR the breaker's half-open probe
+            # claim: the allow() that admitted this batch must get its
+            # record (failure, conservatively; an extra record_failure
+            # after a counted one is harmless) or allow() returns
+            # False forever and the device route never recovers
+            self._breaker.record_failure()
+            if held:
+                self._inflight.release()
             raise
-        self._finish_pool.submit(
-            self._device_finish_job, out, finish, t0, pairs, unique, t_launch
-        )
 
     def _device_finish_job(self, out, finish, t0, pairs, unique, t_launch):
         self.stages.enter()
         try:
-            # counters inside _device_finish are safe un-locked: this
-            # pool has exactly ONE worker, the only device-side mutator
-            results = self._device_finish(out, finish, t0, pairs)
+            try:
+                # counters inside _device_finish are safe un-locked:
+                # this pool has exactly ONE worker, the only
+                # device-side mutator
+                results = self._device_finish(out, finish, t0, pairs)
+            except Exception as e:
+                # mid-execution device failure: the batch is already
+                # off the flusher, so recover it right here on the
+                # finish worker through the host ladder — tickets fail
+                # only if every rung fails them individually
+                self._breaker.record_failure()
+                self._record_error(e)
+                self._note_fallback("device", "host")
+                with span("recover_host", batch=len(pairs)):
+                    self._deliver_host(
+                        pairs, unique, self._solve_host_isolated(pairs)
+                    )
+                return
+            self._breaker.record_success()
             lats = []
             for (src, dst), res in zip(pairs, results):
                 self.dist_cache.put_result(
                     self.graph_id, src, dst, res.found, res.hops, res.path
                 )
                 for t in unique[(src, dst)]:
-                    self._finish_ticket(t, res)
-                    lats.append(t.t_done - t.t_submit)
+                    if self._finish_ticket(t, res):
+                        lats.append(t.t_done - t.t_submit)
             self.latency.record_many(lats)
         except Exception as e:
             self._record_error(e)
@@ -559,32 +739,40 @@ class PipelinedQueryEngine(QueryEngine):
         """Host SOLVE stage, run right here on the flusher: on the
         native route this is one GIL-free threaded-C call for the whole
         batch (``_solve_host`` — the C batch parallelizes internally, so
-        a Python-side worker pool would only add GIL handoffs). The
-        Python-side resolution hands off to the finish worker: batch
-        k+1 solves here while batch k banks and resolves there — the
-        same two-stage overlap the device route gets from its
+        a Python-side worker pool would only add GIL handoffs), behind
+        the bisection isolator, so a poison batch yields per-query
+        ``QueryError`` s instead of an exception. The Python-side
+        resolution hands off to the finish worker: batch k+1 solves
+        here while batch k banks and resolves there — the same
+        two-stage overlap the device route gets from its
         dispatch/finish split."""
         self._inflight.acquire()
         t_launch = time.perf_counter()  # post-acquire; see _launch_device
-        self.stages.enter()
         try:
-            results = self._solve_host(pairs)
-            err = None
-        except Exception as e:
-            results, err = None, e
-            self._record_error(e)
-        finally:
-            self.stages.exit()
-        self._finish_pool.submit(
-            self._host_resolve_job, pairs, unique, t_launch, results, err
-        )
+            self.stages.enter()
+            try:
+                results = self._solve_host_isolated(pairs)
+            finally:
+                self.stages.exit()
+            self._finish_pool.submit(
+                self._host_resolve_job, pairs, unique, t_launch, results
+            )
+        except BaseException:
+            self._inflight.release()  # never leak the in-flight slot
+            raise
 
-    def _host_resolve_job(self, pairs, unique, t_launch,
-                          results, err) -> None:
+    def _host_resolve_job(self, pairs, unique, t_launch, results) -> None:
         self.stages.enter()
         try:
             with span("host_resolve", batch=len(pairs)):
-                self._host_resolve_inner(pairs, unique, results, err)
+                try:
+                    self._deliver_host(pairs, unique, results)
+                except Exception as e:
+                    self._record_error(e)
+                    for key in pairs:
+                        for t in unique[key]:
+                            if not t.done():
+                                self._fail_ticket(t, e)
         finally:
             self.stages.exit()
             self._inflight.release()
@@ -592,49 +780,83 @@ class PipelinedQueryEngine(QueryEngine):
                 t_launch, sum(len(unique[p]) for p in pairs)
             )
 
-    def _host_resolve_inner(self, pairs, unique, results, err) -> None:
-        try:
-            if err is None:
-                lats = []
-                bank = self._paths_to_bank(results)
-                for i, ((src, dst), res) in enumerate(zip(pairs, results)):
-                    if i in bank:
-                        self.dist_cache.put_path(
-                            self.graph_id, res.path, self.n
-                        )
-                    self.dist_cache.put_result(
-                        self.graph_id, src, dst, res.found, res.hops,
-                        res.path,
-                    )
-                    for t in unique[(src, dst)]:
-                        self._finish_ticket(t, res)
-                        lats.append(t.t_done - t.t_submit)
-                self.latency.record_many(lats)
-                with self._lock:
-                    self._c_host_queries.inc(len(pairs))
-            else:
-                for key in pairs:
-                    for t in unique[key]:
-                        if not t.done():
-                            self._fail_ticket(t, err)
-        except Exception as e:
-            self._record_error(e)
-            for key in pairs:
-                for t in unique[key]:
-                    if not t.done():
-                        self._fail_ticket(t, e)
+    def _solve_host_isolated(self, pairs):
+        # serialize ALL host solving (module comment on
+        # _host_solve_lock): flusher host batches and finish-worker
+        # recovery share non-thread-safe native scratch
+        with self._host_solve_lock:
+            return super()._solve_host_isolated(pairs)
+
+    # the resilience cells are the registry's deliberately LOCK-FREE
+    # counters (obs/metrics.py: concurrent mutators of one cell must
+    # hold the component's lock). In the sync engine the caller thread
+    # is the only mutator; here the flusher AND the finish worker both
+    # reach the fallback/error cells (device-finish recovery, fail
+    # paths), so the increments take the engine lock — cold paths only,
+    # the fault-free hot loop never passes through either.
+    def _note_fallback(self, frm: str, to: str) -> None:
+        with self._lock:
+            super()._note_fallback(frm, to)
+
+    def _count_error(self, err: BaseException, n: int = 1) -> None:
+        with self._lock:
+            super()._count_error(err, n)
+
+    def _deliver_host(self, pairs, unique, results) -> None:
+        """Resolve one host-solved batch (finish-worker side) through
+        the shared delivery skeleton
+        (:meth:`QueryEngine._deliver_host_results`): bank and finish
+        the successes, fail exactly the tickets whose query the
+        isolator gave up on. Used by the host route and the
+        device->host recovery path."""
+        lats = []
+
+        def resolve_ok(key, res):
+            self.dist_cache.put_result(
+                self.graph_id, key[0], key[1], res.found, res.hops,
+                res.path,
+            )
+            for t in unique[key]:
+                if self._finish_ticket(t, res):
+                    lats.append(t.t_done - t.t_submit)
+
+        def resolve_err(key, err):
+            for t in unique[key]:
+                if not t.done():
+                    self._fail_ticket(t, err)
+
+        n_ok = self._deliver_host_results(
+            pairs, results, resolve_ok, resolve_err
+        )
+        self.latency.record_many(lats)
+        with self._lock:
+            self._c_host_queries.inc(n_ok)
 
     # ---- resolution --------------------------------------------------
-    def _finish_ticket(self, t: QueryTicket, res: BFSResult) -> None:
+    def _finish_ticket(self, t: QueryTicket, res: BFSResult) -> bool:
         # waiters park on the engine cv and are broadcast to once per
         # batch (_note_batch_done); latency is recorded batchwise by the
-        # resolving stage
+        # resolving stage. A cancelled ticket (error already set) is
+        # left alone — its waiter already saw the cancellation.
+        if t.error is not None:
+            return False
         t.t_done = time.perf_counter()
         t.result = res
+        return True
 
     def _fail_ticket(self, t: QueryTicket, err: BaseException) -> None:
+        """One ticket fails with a STRUCTURED error: whatever the
+        pipeline caught is wrapped into a taxonomy-tagged
+        :class:`QueryError` (and counted in ``bibfs_errors_total`` +
+        the health window) so callers never see a raw backend
+        traceback class."""
+        qerr = (
+            err if isinstance(err, QueryError)
+            else to_query_error(err, (t.src, t.dst))
+        )
+        self._count_error(qerr)
         t.t_done = time.perf_counter()
-        t.error = err
+        t.error = qerr
 
     def _fail_batch(self, batch, err) -> None:
         failed = 0

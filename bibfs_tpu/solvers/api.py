@@ -128,7 +128,7 @@ def solve(
 
 def solve_many(
     n: int, edges: np.ndarray, pairs, *, pipelined: bool = False,
-    **engine_kwargs,
+    return_errors: bool = False, **engine_kwargs,
 ) -> list:
     """Serve a query list through the adaptive micro-batching engine.
 
@@ -145,12 +145,20 @@ def solve_many(
     an engine of your own when serving repeat traffic — this
     convenience rebuilds the caches per call (the compiled executables
     themselves persist process-wide either way).
+
+    ``return_errors=True`` is partial-failure mode: instead of raising
+    on the first failed query, the returned list carries a structured
+    :class:`bibfs_tpu.serve.resilience.QueryError` (taxonomy kinds
+    ``invalid`` / ``timeout`` / ``capacity`` / ``internal``) in that
+    query's slot — one bad query costs one slot, never its batch.
     """
     if pipelined:
         from bibfs_tpu.serve import PipelinedQueryEngine
 
         with PipelinedQueryEngine(n, edges, **engine_kwargs) as eng:
-            return eng.query_many(pairs)
+            return eng.query_many(pairs, return_errors=return_errors)
     from bibfs_tpu.serve import QueryEngine
 
-    return QueryEngine(n, edges, **engine_kwargs).query_many(pairs)
+    return QueryEngine(n, edges, **engine_kwargs).query_many(
+        pairs, return_errors=return_errors
+    )

@@ -1,0 +1,135 @@
+"""The fault-injection layer (bibfs_tpu/serve/faults): spec grammar,
+deterministic and seeded-probabilistic firing, latency vs error kinds,
+pair targeting, env-var construction, and the injected-faults metric.
+Chaos against the real engine is only trustworthy if the thing doing
+the throwing is itself exact."""
+
+import time
+
+import pytest
+
+from bibfs_tpu.serve.faults import ENV_VAR, FaultPlan, InjectedFault
+
+
+def test_parse_grammar_and_describe():
+    plan = FaultPlan.parse(
+        "device:p=0.25; host_batch:every=3,kind=latency,ms=5;"
+        "device_finish:times=2"
+    )
+    st = plan.stats()
+    assert len(st["rules"]) == 3
+    rules = [r["rule"] for r in st["rules"]]
+    assert rules[0] == "device:p=0.25"
+    assert rules[1] == "host_batch:every=3,latency=5.0ms"
+    assert rules[2] == "device_finish:times=2"
+
+
+@pytest.mark.parametrize("bad", [
+    "",                      # empty
+    "warp_core:p=0.5",       # unknown site
+    "device:p=1.5",          # probability out of range
+    "device:kind=meltdown",  # unknown kind
+    "device:every=0",        # every < 1
+    "device:zorp=1",         # unknown field
+    "device:p",              # not key=value
+])
+def test_parse_rejects_bad_specs(bad):
+    with pytest.raises(ValueError):
+        FaultPlan.parse(bad)
+
+
+def test_deterministic_every_and_times():
+    plan = FaultPlan.parse("device:every=3")
+    fired = []
+    for i in range(9):
+        try:
+            plan.fire("device")
+            fired.append(False)
+        except InjectedFault as e:
+            assert e.site == "device"
+            fired.append(True)
+    assert fired == [False, False, True] * 3
+
+    plan2 = FaultPlan.parse("device:times=2")
+    boom = 0
+    for _ in range(5):
+        try:
+            plan2.fire("device")
+        except InjectedFault:
+            boom += 1
+    assert boom == 2  # first two calls only
+    assert plan2.stats()["fired_total"] == 2
+
+
+def test_probabilistic_is_seeded_reproducible():
+    def run(seed):
+        plan = FaultPlan.parse("device:p=0.5", seed=seed)
+        out = []
+        for _ in range(30):
+            try:
+                plan.fire("device")
+                out.append(0)
+            except InjectedFault:
+                out.append(1)
+        return out
+
+    a, b, c = run(7), run(7), run(8)
+    assert a == b  # same seed, same schedule
+    assert a != c  # different seed diverges
+    assert 0 < sum(a) < 30
+
+
+def test_latency_kind_sleeps_instead_of_raising():
+    plan = FaultPlan.parse("host_batch:every=1,kind=latency,ms=30")
+    t0 = time.perf_counter()
+    plan.fire("host_batch")  # must NOT raise
+    assert time.perf_counter() - t0 >= 0.025
+
+
+def test_pair_targeting_and_other_sites_inert():
+    plan = FaultPlan.parse("host_batch:pair=7-19")
+    plan.fire("device")  # other site: nothing
+    plan.fire("host_batch", pairs=[(1, 2), (3, 4)])  # pair absent
+    with pytest.raises(InjectedFault):
+        plan.fire("host_batch", pairs=[(1, 2), (7, 19)])
+    # no pairs context at all -> the targeted rule stays quiet
+    plan.fire("host_batch")
+
+
+def test_set_active_gates_everything():
+    plan = FaultPlan.parse("device:every=1")
+    plan.set_active(False)
+    for _ in range(3):
+        plan.fire("device")  # inert
+    plan.set_active(True)
+    with pytest.raises(InjectedFault):
+        plan.fire("device")
+
+
+def test_from_env(monkeypatch):
+    monkeypatch.delenv(ENV_VAR, raising=False)
+    assert FaultPlan.from_env() is None
+    monkeypatch.setenv(ENV_VAR, "device:every=2")
+    plan = FaultPlan.from_env()
+    assert plan is not None
+    plan.fire("device")
+    with pytest.raises(InjectedFault):
+        plan.fire("device")
+    # malformed env spec fails loudly, not silently uninjected
+    monkeypatch.setenv(ENV_VAR, "device:p=nope")
+    with pytest.raises(ValueError):
+        FaultPlan.from_env()
+
+
+def test_injected_metric_counts():
+    from bibfs_tpu.obs.metrics import REGISTRY
+
+    cell = REGISTRY.counter(
+        "bibfs_faults_injected_total", "", ("site", "kind"),
+    ).labels(site="device", kind="error")
+    before = cell.value
+    plan = FaultPlan.parse("device:every=1")
+    for _ in range(3):
+        with pytest.raises(InjectedFault):
+            plan.fire("device")
+    assert cell.value == before + 3

@@ -56,10 +56,103 @@ def test_metrics_endpoint_routes():
         status, body = _get(
             f"http://127.0.0.1:{srv.port}/healthz"
         )
+        # no engine attached: the standalone fallback stays plain ok
         assert status == 200 and body == "ok\n"
         with pytest.raises(urllib.error.HTTPError) as e:
             _get(f"http://127.0.0.1:{srv.port}/nope")
         assert e.value.code == 404
+
+
+def test_healthz_wired_to_engine_states():
+    """/healthz answers from the engine's health state machine: 200
+    ok while ready, 200 with degraded detail while the breaker is
+    open, 503 once draining — and back to the standalone fallback
+    when detached."""
+    import json as _json
+
+    from bibfs_tpu.serve import ExecutableCache, FaultPlan
+
+    n = 200
+    edges = _skiplink_graph(n)
+    with start_metrics_server(0) as srv:
+        plan = FaultPlan.parse("device:every=1")
+        plan.set_active(False)
+        eng = PipelinedQueryEngine(
+            n, edges, flush_threshold=8, device_batches=True,
+            faults=plan, exec_cache=ExecutableCache(),
+        )
+        srv.set_health(eng.health_snapshot)
+        status, body = _get(srv.health_url)
+        assert status == 200 and body.splitlines()[0] == "ok"
+        detail = _json.loads(body.splitlines()[1])
+        assert detail["state"] == "ready"
+        assert detail["breaker"]["state"] == "closed"
+
+        # open the breaker: degraded is still 200 (the node SERVES),
+        # with the reason in the first line
+        plan.set_active(True)
+        eng.query_many([(i, i + 50) for i in range(10)])
+        eng.query_many([(i, i + 50) for i in range(20, 30)])
+        status, body = _get(srv.health_url)
+        assert status == 200
+        head = body.splitlines()[0]
+        assert head.startswith("degraded") and "breaker" in head
+
+        # draining: 503, do not route traffic here
+        eng.close()
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(srv.health_url)
+        assert e.value.code == 503
+        assert e.value.read().decode().startswith("draining")
+
+        srv.set_health(None)
+        status, body = _get(srv.health_url)
+        assert status == 200 and body == "ok\n"
+
+
+def test_metrics_render_refreshes_health_gauge():
+    """bibfs_health_state must be fresh on a /metrics-ONLY scrape: the
+    registry's render-time collector recomputes it, so a deployment
+    that scrapes /metrics without ever polling /healthz still sees the
+    real state (ready=1 after construction, draining=3 after close) —
+    not the stale value of the last health poll."""
+    n = 100
+    edges = _skiplink_graph(n)
+    with start_metrics_server(0) as srv:
+        eng = PipelinedQueryEngine(n, edges)
+        lbl = eng.obs_label
+        _status, body = _get(srv.url)  # no healthz call ever made
+        assert f'bibfs_health_state{{engine="{lbl}"}} 1' in body
+        eng.close()
+        _status, body = _get(srv.url)
+        assert f'bibfs_health_state{{engine="{lbl}"}} 3' in body
+
+
+def test_healthz_resilience_metrics_render():
+    """The README-documented resilience families render on /metrics
+    from engine construction alone (the chaos CI gate scrapes for
+    them)."""
+    n = 100
+    edges = _skiplink_graph(n)
+    with start_metrics_server(0) as srv:
+        with PipelinedQueryEngine(n, edges) as eng:
+            _status, body = _get(srv.url)
+            lbl = eng.obs_label
+            for name in (
+                "bibfs_errors_total",
+                "bibfs_route_fallbacks_total",
+                "bibfs_breaker_state",
+                "bibfs_health_state",
+            ):
+                assert name in body, name
+            assert (
+                f'bibfs_errors_total{{engine="{lbl}",kind="internal"}} 0'
+                in body
+            )
+            assert (
+                'bibfs_route_fallbacks_total{engine="%s",from="device",'
+                'to="host"} 0' % lbl in body
+            )
 
 
 def test_metrics_server_custom_registry_and_close():

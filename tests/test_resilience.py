@@ -1,0 +1,398 @@
+"""The resilience layer (bibfs_tpu/serve/resilience) and its
+integration through the synchronous engine: error taxonomy, retry
+backoff, circuit-breaker lifecycle, the fallback ladder
+device -> host-native -> serial, poison-batch bisection, partial-
+failure query_many, and the health state machine.
+
+Correctness bar: every query that a fault does NOT unrecoverably
+poison must still resolve oracle-correct THROUGH the failures — the
+fallback ladder may trade throughput for availability, never answers.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from bibfs_tpu.serve import (
+    CircuitBreaker,
+    ExecutableCache,
+    FaultPlan,
+    QueryEngine,
+    QueryError,
+    RetryPolicy,
+)
+from bibfs_tpu.serve.resilience import (
+    HealthMonitor,
+    classify_exception,
+    healthz_status,
+    to_query_error,
+)
+from bibfs_tpu.solvers.serial import solve_serial
+
+
+def _skiplink_graph(n: int) -> np.ndarray:
+    edges = [[i, i + 1] for i in range(n - 1)]
+    edges += [[i, i + 7] for i in range(n - 7)]
+    return np.array(edges)
+
+
+def _check_oracle(n, edges, pairs, results):
+    for (src, dst), r in zip(pairs, results):
+        ref = solve_serial(n, edges, int(src), int(dst))
+        assert r.found == ref.found, (src, dst)
+        if ref.found:
+            assert r.hops == ref.hops, (src, dst)
+
+
+def _fresh(k, lo, span=50):
+    return [(lo + i, lo + i + span) for i in range(k)]
+
+
+# ---- taxonomy --------------------------------------------------------
+def test_query_error_taxonomy_and_classification():
+    e = QueryError("boom", kind="capacity", query=(3, 9))
+    assert e.kind == "capacity" and e.query == (3, 9)
+    assert "capacity" in str(e) and "3->9" in str(e)
+    with pytest.raises(ValueError):
+        QueryError("x", kind="mystery")
+    assert classify_exception(TimeoutError()) == "timeout"
+    assert classify_exception(RuntimeError("x")) == "internal"
+    # a ValueError out of a SOLVER rung is an internal failure — only
+    # submit-time validation may tag invalid, and it does so explicitly
+    assert classify_exception(ValueError("x")) == "internal"
+    w = to_query_error(ValueError("bad id"), (1, 2), kind="invalid")
+    assert isinstance(w, QueryError) and w.kind == "invalid"
+    assert to_query_error(ValueError("x")).kind == "internal"
+    assert to_query_error(w) is w  # already structured: no re-wrap
+
+
+# ---- retry policy ----------------------------------------------------
+def test_retry_policy_backoff_and_jitter_bounds():
+    p = RetryPolicy(attempts=4, base_ms=2.0, max_ms=10.0, jitter=0.5)
+    for attempt, nominal in enumerate([2.0, 4.0, 8.0, 10.0]):
+        for _ in range(20):
+            d_ms = p.delay_s(attempt) * 1e3
+            assert 0.5 * nominal <= d_ms <= 1.5 * nominal
+    with pytest.raises(ValueError):
+        RetryPolicy(attempts=0)
+    # seeded: two same-seed policies give identical schedules
+    a = RetryPolicy(seed=3)
+    b = RetryPolicy(seed=3)
+    assert [a.delay_s(0) for _ in range(5)] == [
+        b.delay_s(0) for _ in range(5)
+    ]
+
+
+# ---- circuit breaker -------------------------------------------------
+def test_breaker_full_lifecycle():
+    t = [0.0]
+    transitions = []
+    br = CircuitBreaker(
+        fail_threshold=2, reset_s=10.0, clock=lambda: t[0],
+        on_transition=transitions.append,
+    )
+    assert br.state == "closed" and br.allow()
+    br.record_failure()
+    assert br.state == "closed"  # below threshold
+    br.record_failure()
+    assert br.state == "open"
+    assert not br.allow()  # open, window not elapsed
+    t[0] = 10.5
+    assert br.state == "half_open"  # window elapsed reads half-open
+    assert br.allow()       # the single probe
+    assert not br.allow()   # second caller blocked while probe in flight
+    br.record_failure()     # probe failed: back to open, timer re-armed
+    assert br.state == "open" and not br.allow()
+    t[0] = 21.0
+    assert br.allow()
+    br.record_success()     # probe succeeded: closed, counters reset
+    assert br.state == "closed" and br.allow()
+    assert transitions == [
+        "open", "half_open", "open", "half_open", "closed"
+    ]
+    assert br.snapshot()["opens"] == 2
+
+
+def test_breaker_success_resets_consecutive_count():
+    br = CircuitBreaker(fail_threshold=3)
+    br.record_failure()
+    br.record_failure()
+    br.record_success()
+    br.record_failure()
+    br.record_failure()
+    assert br.state == "closed"  # never 3 CONSECUTIVE
+
+
+# ---- health monitor --------------------------------------------------
+def test_health_state_machine():
+    t = [0.0]
+    br = CircuitBreaker(fail_threshold=1, reset_s=100.0,
+                        clock=lambda: t[0])
+    depth = [0]
+    h = HealthMonitor(
+        breaker=br, window_s=5.0, queue_depth=lambda: depth[0],
+        max_queue=10, clock=lambda: t[0],
+    )
+    assert h.state()[0] == "live"  # constructed, not ready yet
+    h.set_ready()
+    assert h.state()[0] == "ready"
+    # breaker opens -> degraded with the reason named
+    br.record_failure()
+    state, reasons = h.state()
+    assert state == "degraded" and any("breaker" in r for r in reasons)
+    br.record_success()
+    # recent errors degrade, then AGE OUT (recovery without a restart)
+    h.note_error()
+    assert h.state()[0] == "degraded"
+    t[0] += 6.0
+    assert h.state()[0] == "ready"
+    # queue saturation degrades
+    depth[0] = 9
+    state, reasons = h.state()
+    assert state == "degraded" and any("queue" in r for r in reasons)
+    depth[0] = 0
+    assert h.state()[0] == "ready"
+    # draining is terminal and 503
+    h.set_draining()
+    assert h.state()[0] == "draining"
+    assert healthz_status("ready") == 200
+    assert healthz_status("degraded") == 200
+    assert healthz_status("live") == 503
+    assert healthz_status("draining") == 503
+
+
+# ---- engine integration: the fallback ladder -------------------------
+def test_device_fault_falls_back_to_host_oracle_correct():
+    n = 220
+    edges = _skiplink_graph(n)
+    plan = FaultPlan.parse("device:every=1")
+    eng = QueryEngine(n, edges, flush_threshold=8, device_batches=True,
+                      faults=plan, exec_cache=ExecutableCache())
+    pairs = _fresh(12, 0)
+    results = eng.query_many(pairs)
+    _check_oracle(n, edges, pairs, results)
+    st = eng.stats()["resilience"]
+    assert st["fallbacks"]["device->host"] == 1
+    assert st["retries"] >= 1  # the route was retried before degrading
+    assert st["errors"] == {k: 0 for k in st["errors"]}  # no ticket died
+
+
+def test_transient_device_fault_retries_in_place():
+    """times=1: the first dispatch fails, the RETRY succeeds — no
+    fallback, no ticket failure, breaker stays closed."""
+    n = 220
+    edges = _skiplink_graph(n)
+    plan = FaultPlan.parse("device:times=1")
+    eng = QueryEngine(n, edges, flush_threshold=8, device_batches=True,
+                      faults=plan, exec_cache=ExecutableCache())
+    pairs = _fresh(10, 0)
+    results = eng.query_many(pairs)
+    _check_oracle(n, edges, pairs, results)
+    st = eng.stats()["resilience"]
+    assert st["retries"] == 1
+    assert st["fallbacks"]["device->host"] == 0
+    assert st["breaker"]["state"] == "closed"
+    assert eng.counters["device_batches"] == 1
+
+
+def test_breaker_opens_and_gates_device_then_recovers():
+    n = 220
+    edges = _skiplink_graph(n)
+    plan = FaultPlan.parse("device:every=1")
+    eng = QueryEngine(n, edges, flush_threshold=8, device_batches=True,
+                      faults=plan, exec_cache=ExecutableCache())
+    eng.query_many(_fresh(10, 0))    # 2 consecutive failures
+    eng.query_many(_fresh(10, 60))   # 3rd -> breaker opens
+    st = eng.stats()
+    assert st["resilience"]["breaker"]["state"] == "open"
+    assert st["health"]["state"] == "degraded"
+    # open breaker short-circuits the device route: the fault seam is
+    # never even reached
+    fired = plan.stats()["fired_total"]
+    eng.query_many(_fresh(10, 100))
+    assert plan.stats()["fired_total"] == fired
+    # fault clears; after reset_s a half-open probe closes the breaker
+    plan.set_active(False)
+    eng._breaker.reset_s = 0.01
+    time.sleep(0.05)
+    results = eng.query_many(_fresh(10, 120))
+    _check_oracle(n, edges, _fresh(10, 120), results)
+    st = eng.stats()
+    assert st["resilience"]["breaker"]["state"] == "closed"
+    assert st["health"]["state"] == "ready"
+    assert st["resilience"]["breaker"]["opens"] == 1
+
+
+def test_host_batch_fault_bisects_to_serial_rung():
+    """The native-batch seam dies wholesale -> bisection drills down
+    and every query still resolves through the serial rung (ladder:
+    host-native -> serial), oracle-correct."""
+    n = 150
+    edges = _skiplink_graph(n)
+    plan = FaultPlan.parse("host_batch:every=1")
+    eng = QueryEngine(n, edges, flush_threshold=1000, faults=plan)
+    pairs = _fresh(8, 0)
+    results = eng.query_many(pairs)
+    _check_oracle(n, edges, pairs, results)
+    st = eng.stats()["resilience"]
+    assert st["bisections"] >= 1
+    assert st["fallbacks"]["host->serial"] == 8  # every singleton
+    assert st["errors"]["internal"] == 0
+
+
+def test_poison_query_fails_alone_with_structured_error():
+    """One poisoned query (its batch raises whenever it is present AND
+    its serial rung is broken) must fail exactly ITS ticket with a
+    QueryError — its 7 batch peers resolve oracle-correct."""
+    n = 150
+    edges = _skiplink_graph(n)
+    poison = (3, 53)
+    plan = FaultPlan.parse(f"host_batch:pair={poison[0]}-{poison[1]}")
+    eng = QueryEngine(n, edges, flush_threshold=1000, faults=plan)
+    # break the last rung for the poison query only
+    real_serial = eng._solve_serial_one
+
+    def broken_serial(src, dst):
+        if (src, dst) == poison:
+            raise RuntimeError("serial rung poisoned too")
+        return real_serial(src, dst)
+
+    eng._solve_serial_one = broken_serial
+    pairs = _fresh(8, 0)
+    assert poison in pairs
+    out = eng.query_many(pairs, return_errors=True)
+    for (s, d), r in zip(pairs, out):
+        if (s, d) == poison:
+            assert isinstance(r, QueryError)
+            assert r.kind == "internal" and r.query == poison
+        else:
+            ref = solve_serial(n, edges, s, d)
+            assert r.found == ref.found and r.hops == ref.hops
+    st = eng.stats()["resilience"]
+    assert st["errors"]["internal"] == 1
+    assert st["bisections"] >= 1
+    assert eng.stats()["health"]["state"] == "degraded"
+    # default mode raises that same structured error
+    with pytest.raises(QueryError, match="internal"):
+        eng.query_many([poison])
+
+
+def test_query_many_return_errors_invalid_inputs():
+    n = 50
+    eng = QueryEngine(n, np.array([[0, 1], [1, 2]]))
+    out = eng.query_many(
+        [(0, 2), (0, 10 ** 9), (1, 2)], return_errors=True
+    )
+    assert out[0].found and out[2].found
+    assert isinstance(out[1], QueryError) and out[1].kind == "invalid"
+    assert eng.stats()["resilience"]["errors"]["invalid"] == 1
+    # default mode still raises (pre-resilience contract)
+    with pytest.raises(ValueError):
+        eng.query_many([(0, 10 ** 9)])
+
+
+def test_solve_many_return_errors_passthrough():
+    from bibfs_tpu.solvers.api import solve_many
+
+    n = 80
+    edges = _skiplink_graph(n)
+    out = solve_many(
+        n, edges, [(0, 40), (0, 999)], return_errors=True
+    )
+    assert out[0].found
+    assert isinstance(out[1], QueryError) and out[1].kind == "invalid"
+
+
+def test_latency_fault_slows_but_never_fails():
+    n = 150
+    edges = _skiplink_graph(n)
+    plan = FaultPlan.parse("host_batch:every=1,kind=latency,ms=20")
+    eng = QueryEngine(n, edges, flush_threshold=1000, faults=plan)
+    t0 = time.perf_counter()
+    results = eng.query_many(_fresh(5, 0))
+    assert time.perf_counter() - t0 >= 0.015
+    _check_oracle(n, edges, _fresh(5, 0), results)
+    st = eng.stats()["resilience"]
+    assert st["fallbacks"]["host->serial"] == 0
+    assert st["errors"]["internal"] == 0
+
+
+def test_sync_close_marks_draining():
+    eng = QueryEngine(20, np.array([[0, 1]]))
+    assert eng.health_snapshot()["state"] == "ready"
+    eng.close()
+    assert eng.health_snapshot()["state"] == "draining"
+
+
+def test_faults_from_env_reach_engine(monkeypatch):
+    from bibfs_tpu.serve.faults import ENV_VAR
+
+    n = 150
+    edges = _skiplink_graph(n)
+    monkeypatch.setenv(ENV_VAR, "host_batch:every=1")
+    eng = QueryEngine(n, edges, flush_threshold=1000)
+    results = eng.query_many(_fresh(6, 0))
+    _check_oracle(n, edges, _fresh(6, 0), results)
+    # the env-built plan really fired through the engine seam
+    assert eng.stats()["resilience"]["faults"]["fired_total"] >= 1
+    assert eng.stats()["resilience"]["fallbacks"]["host->serial"] == 6
+
+
+def test_client_errors_do_not_degrade_health():
+    """invalid submits (and caller cancels) are the CLIENT's failures:
+    they count in bibfs_errors_total but must not flip /healthz —
+    otherwise whoever talks to the socket controls the health alerts."""
+    n = 50
+    eng = QueryEngine(n, np.array([[0, 1], [1, 2]]))
+    for _ in range(5):
+        out = eng.query_many([(0, 10 ** 9)], return_errors=True)
+        assert isinstance(out[0], QueryError)
+    st = eng.stats()
+    assert st["resilience"]["errors"]["invalid"] == 5
+    assert st["health"]["state"] == "ready"
+    assert st["health"]["recent_errors"] == 0
+
+
+def test_shared_breaker_updates_every_engines_gauge():
+    """One breaker shared by two engines (one accelerator, several
+    engines): a transition must land on BOTH engines' breaker gauges,
+    not just whichever engine was constructed first."""
+    from bibfs_tpu.obs.metrics import REGISTRY
+
+    edges = np.array([[0, 1], [1, 2]])
+    shared = CircuitBreaker(fail_threshold=1)
+    a = QueryEngine(30, edges, breaker=shared)
+    b = QueryEngine(30, edges, breaker=shared)
+    gauges = [
+        REGISTRY.gauge("bibfs_breaker_state", "", ("engine",))
+        .labels(engine=e.obs_label) for e in (a, b)
+    ]
+    assert [g.value for g in gauges] == [0, 0]
+    shared.record_failure()  # -> open
+    assert [g.value for g in gauges] == [2, 2]
+    assert a.health_snapshot()["state"] == "degraded"
+    assert b.health_snapshot()["state"] == "degraded"
+
+
+def test_breaker_metrics_track_state():
+    from bibfs_tpu.obs.metrics import REGISTRY
+
+    n = 220
+    edges = _skiplink_graph(n)
+    plan = FaultPlan.parse("device:every=1")
+    eng = QueryEngine(
+        n, edges, flush_threshold=8, device_batches=True,
+        faults=plan, exec_cache=ExecutableCache(),
+        breaker=CircuitBreaker(fail_threshold=2),
+    )
+    gauge = REGISTRY.gauge(
+        "bibfs_breaker_state", "", ("engine",)
+    ).labels(engine=eng.obs_label)
+    assert gauge.value == 0
+    eng.query_many(_fresh(10, 0))  # 2 failures -> open
+    assert gauge.value == 2
+    trans = REGISTRY.counter(
+        "bibfs_breaker_transitions_total", "", ("engine", "to"),
+    ).labels(engine=eng.obs_label, to="open")
+    assert trans.value == 1

@@ -351,6 +351,225 @@ def test_serve_cli_pipeline_pairs(tmp_path, capsys):
     assert "pipeline" in stats and "latency_ms" in stats
 
 
+# ---- resilience through the pipeline --------------------------------
+def test_pipelined_device_launch_fault_degrades_not_fails():
+    """Every device dispatch raises -> the flusher retries then
+    degrades the batch to the host ladder: all tickets resolve
+    oracle-correct, zero ticket errors."""
+    from bibfs_tpu.serve import FaultPlan
+
+    n = 220
+    edges = _skiplink_graph(n)
+    plan = FaultPlan.parse("device:every=1")
+    with PipelinedQueryEngine(
+        n, edges, flush_threshold=8, device_batches=True,
+        faults=plan, exec_cache=ExecutableCache(),
+    ) as eng:
+        pairs = [(i, i + 50) for i in range(12)]
+        results = eng.query_many(pairs)
+        _check_oracle(n, edges, np.array(pairs), results)
+        st = eng.stats()["resilience"]
+        assert st["fallbacks"]["device->host"] >= 1
+        assert st["errors"] == {k: 0 for k in st["errors"]}
+        assert plan.stats()["fired_total"] >= 1
+
+
+def test_pipelined_device_finish_fault_recovers_on_finish_worker():
+    """The dispatch succeeds but the finish seam dies mid-execution:
+    the finish worker recovers the batch through the host ladder —
+    the case where the batch is already off the flusher."""
+    from bibfs_tpu.serve import FaultPlan
+
+    n = 220
+    edges = _skiplink_graph(n)
+    plan = FaultPlan.parse("device_finish:every=1")
+    with PipelinedQueryEngine(
+        n, edges, flush_threshold=8, device_batches=True,
+        faults=plan, exec_cache=ExecutableCache(),
+    ) as eng:
+        pairs = [(i, i + 50) for i in range(12)]
+        results = eng.query_many(pairs)
+        _check_oracle(n, edges, np.array(pairs), results)
+        st = eng.stats()["resilience"]
+        assert st["fallbacks"]["device->host"] >= 1
+        assert st["errors"] == {k: 0 for k in st["errors"]}
+        # the FINISH seam really fired (i.e. the dispatch preceding it
+        # succeeded; the fault is downstream of the launch)
+        assert plan.stats()["fired_total"] >= 1
+
+
+def test_pipelined_query_many_return_errors():
+    from bibfs_tpu.serve import QueryError
+
+    n = 100
+    edges = _skiplink_graph(n)
+    with PipelinedQueryEngine(n, edges) as eng:
+        out = eng.query_many(
+            [(0, 50), (0, 10 ** 9), (1, 40)], return_errors=True
+        )
+        assert out[0].found and out[2].found
+        assert isinstance(out[1], QueryError)
+        assert out[1].kind == "invalid"
+
+
+def test_pipelined_failed_ticket_carries_query_error():
+    """Whatever the pipeline catches, the ticket's error is the
+    STRUCTURED QueryError type (taxonomy-tagged), not a raw backend
+    exception class."""
+    from bibfs_tpu.serve import FaultPlan, QueryError
+    from bibfs_tpu.serve.resilience import CircuitBreaker
+
+    n = 150
+    edges = _skiplink_graph(n)
+    # break both host rungs for one pair: the native/host seam via the
+    # plan, the serial rung via monkeypatch -> that ticket must fail
+    poison = (2, 42)
+    plan = FaultPlan.parse(f"host_batch:pair={poison[0]}-{poison[1]}")
+    eng = PipelinedQueryEngine(
+        n, edges, flush_threshold=1000, max_wait_ms=5.0, faults=plan,
+    )
+    real = eng._solve_serial_one
+    eng._solve_serial_one = lambda s, d: (
+        (_ for _ in ()).throw(RuntimeError("serial rung down"))
+        if (s, d) == poison else real(s, d)
+    )
+    try:
+        pairs = [(i, i + 40) for i in range(6)]
+        assert poison in pairs
+        out = eng.query_many(pairs, return_errors=True)
+        for (s, d), r in zip(pairs, out):
+            if (s, d) == poison:
+                assert isinstance(r, QueryError) and r.kind == "internal"
+            else:
+                ref = solve_serial(n, edges, s, d)
+                assert r.found == ref.found and r.hops == ref.hops
+    finally:
+        eng.close()
+
+
+# ---- ticket cancellation --------------------------------------------
+def test_cancel_drops_queued_ticket_from_accounting():
+    """A wait(timeout) that expires + cancel() must drop the ticket
+    from the batch accounting: a later flush() returns instead of
+    waiting forever on the abandoned ticket, and the finish worker is
+    not stranded."""
+    n = 100
+    edges = _skiplink_graph(n)
+    with PipelinedQueryEngine(
+        n, edges, flush_threshold=50, max_wait_ms=None
+    ) as eng:
+        t = eng.submit(0, 60)
+        with pytest.raises(TimeoutError):
+            t.wait(timeout=0.1, cancel_on_timeout=True)
+        assert t.done() and t.error is not None
+        assert t.error.kind == "timeout"
+        assert eng.pending == 0  # removed from the queue
+        # the regression: a post-timeout flush must NOT strand — the
+        # cancelled ticket no longer counts as outstanding
+        t0 = time.perf_counter()
+        eng.flush()
+        assert time.perf_counter() - t0 < 5.0
+        # and the engine still serves (finish worker alive)
+        r = eng.query(0, 30)
+        assert r.found
+        assert eng.stats()["resilience"]["errors"]["timeout"] == 1
+
+
+def test_cancel_after_resolution_is_a_noop():
+    n = 100
+    edges = _skiplink_graph(n)
+    with PipelinedQueryEngine(n, edges, max_wait_ms=5.0) as eng:
+        t = eng.submit(0, 60)
+        res = t.wait(timeout=30.0)
+        assert res.found
+        assert t.cancel() is False  # too late; result stands
+        assert t.error is None and t.result is res
+
+
+# ---- shutdown races (all bounded: a deadlock fails, not hangs) -------
+def test_close_races_with_inflight_submitters():
+    """close() while N threads are mid-submit: every submit() either
+    returns a ticket that RESOLVES, or raises the clear 'engine is
+    closed' error — nothing deadlocks, nothing strands."""
+    n = 200
+    edges = _skiplink_graph(n)
+    eng = PipelinedQueryEngine(n, edges, max_wait_ms=2.0)
+    tickets: list = []
+    rejected: list = []
+    lock = threading.Lock()
+
+    def submitter(k):
+        for i in range(40):
+            try:
+                t = eng.submit((k * 13 + i) % n, (k * 7 + i + 31) % n)
+                with lock:
+                    tickets.append(t)
+            except RuntimeError as e:
+                assert "closed" in str(e)
+                with lock:
+                    rejected.append(e)
+                return
+
+    threads = [threading.Thread(target=submitter, args=(k,))
+               for k in range(4)]
+    for th in threads:
+        th.start()
+    time.sleep(0.02)  # let submissions overlap the close
+    eng.close()
+    for th in threads:
+        th.join(timeout=30.0)
+        assert not th.is_alive(), "submitter deadlocked across close()"
+    # every accepted ticket resolved or failed with the closed error —
+    # none is left forever-pending
+    for t in tickets:
+        assert t.done() or t.result is not None or t.error is not None, (
+            t.src, t.dst
+        )
+
+
+def test_close_while_device_flush_mid_launch():
+    """close() while a device flush is mid-launch (held open by an
+    injected latency fault) must drain cleanly: the in-flight batch
+    resolves, nothing deadlocks (bounded by pytest-timeout in CI)."""
+    from bibfs_tpu.serve import FaultPlan
+
+    n = 200
+    edges = _skiplink_graph(n)
+    plan = FaultPlan.parse("device:every=1,kind=latency,ms=150")
+    eng = PipelinedQueryEngine(
+        n, edges, flush_threshold=8, device_batches=True,
+        faults=plan, exec_cache=ExecutableCache(), max_wait_ms=2.0,
+    )
+    tickets = [eng.submit(i, i + 50) for i in range(12)]
+    time.sleep(0.05)  # flusher is now inside the slowed device launch
+    t0 = time.perf_counter()
+    eng.close()
+    assert time.perf_counter() - t0 < 30.0
+    for t in tickets:
+        assert t.done(), "ticket stranded by close() during launch"
+        if t.error is not None:
+            assert "closed" in str(t.error) or "injected" in str(t.error)
+    with pytest.raises(RuntimeError, match="closed"):
+        eng.submit(0, 1)
+    assert eng.health_snapshot()["state"] == "draining"
+
+
+def test_health_degrades_on_queue_pressure():
+    n = 100
+    edges = _skiplink_graph(n)
+    with PipelinedQueryEngine(
+        n, edges, flush_threshold=1000, max_wait_ms=None, max_queue=10
+    ) as eng:
+        assert eng.health_snapshot()["state"] == "ready"
+        for i in range(9):  # >= 90% of max_queue
+            eng.submit(i, i + 40)
+        snap = eng.health_snapshot()
+        assert snap["state"] == "degraded"
+        assert any("queue" in r for r in snap["reasons"])
+        eng.flush()
+        assert eng.health_snapshot()["state"] == "ready"
+
+
 def test_serve_cli_load(tmp_path, capsys):
     from bibfs_tpu.graph.io import write_graph_bin
     from bibfs_tpu.serve.cli import main as serve_main
