@@ -1098,9 +1098,119 @@ def serve_chaos_main():
         return 1
 
 
+# --serve-update defaults: the churn soak runs the store's full claim
+# set (overlay-exact answers, background + forced hot-swaps racing open-
+# loop traffic, cross-version/cross-graph program reuse) on a CPU-
+# friendly graph; --quick is the CI smoke shape (fewer epochs, less
+# traffic, same gates)
+UPDATE_N = int(os.environ.get("BENCH_UPDATE_N", 3000))
+UPDATE_EPOCHS = int(os.environ.get("BENCH_UPDATE_EPOCHS", 4))
+UPDATE_Q = int(os.environ.get("BENCH_UPDATE_Q", 150))
+UPDATE_EDGES = int(os.environ.get("BENCH_UPDATE_EDGES", 16))
+UPDATE_STALL_MS = float(os.environ.get("BENCH_UPDATE_STALL_MS", 2500.0))
+
+# the store metric families the README documents; the churn gate
+# asserts a live run's /metrics-equivalent render really carries them
+UPDATE_REQUIRED_METRICS = (
+    "bibfs_store_graphs",
+    "bibfs_store_swaps_total",
+    "bibfs_store_delta_edges",
+    "bibfs_store_compactions_total",
+)
+
+
+def serve_update_main():
+    """``python bench.py --serve-update``: the graph-store churn soak.
+
+    Open-loop traffic drives the pipelined engine against a live
+    :class:`~bibfs_tpu.store.GraphStore` — two same-bucket graphs, one
+    taking batched edge updates every epoch — while background
+    compactions and forced synchronous folds hot-swap snapshots under
+    the load (bibfs_tpu/serve/loadgen.run_churn). The gate: zero
+    lost/stranded tickets through every swap, every surviving answer
+    oracle-verified against the POST-update edge set, worst
+    submit-to-resolve latency (which brackets every swap) under the
+    stall bound, zero new compiled programs after warmup across all
+    swaps and both graphs (the same-bucket reuse claim, witnessed by
+    the ExecutableCache hit counters), and the documented store metric
+    families present in the registry render. ``--quick`` is the CI
+    smoke shape. Artifact: ``bench_update.json``."""
+    t_setup = time.time()
+    platform, tpu_error = select_platform()
+    try:
+        from bibfs_tpu.graph.generate import gnp_random_graph
+        from bibfs_tpu.obs.metrics import REGISTRY
+        from bibfs_tpu.serve.loadgen import run_churn
+
+        quick = "--quick" in sys.argv
+        n = 800 if quick else UPDATE_N
+        epochs = 2 if quick else UPDATE_EPOCHS
+        q = 60 if quick else UPDATE_Q
+        upd = 8 if quick else UPDATE_EDGES
+        edges = gnp_random_graph(n, AVG_DEG / n, seed=1)
+        out = run_churn(
+            n, edges,
+            epochs=epochs,
+            queries_per_epoch=q,
+            updates_per_epoch=upd,
+            stall_bound_ms=UPDATE_STALL_MS,
+        )
+        render = REGISTRY.render()
+        missing = [m for m in UPDATE_REQUIRED_METRICS if m not in render]
+        line = {
+            "metric": f"bibfs_serve_update_{n}",
+            "value": out["store"]["swaps"],
+            "unit": "swaps",
+            "graph": f"G({n}, {AVG_DEG:.1f}/n) seed=1 (+ twin)",
+            "platform": platform,
+            "quick": quick,
+            **out,
+            "metrics_missing": missing,
+            "total_s": round(time.time() - t_setup, 1),
+        }
+        line["ok"] = bool(line["ok"] and not missing)
+        if tpu_error:
+            line["tpu_error"] = tpu_error[:300]
+        with open(
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "bench_update.json"), "w"
+        ) as f:
+            json.dump(line, f, indent=1)
+            f.write("\n")
+        print(json.dumps({
+            "metric": line["metric"],
+            "value": line["value"],
+            "unit": "swaps",
+            "ok": line["ok"],
+            "zero_lost": out["zero_lost"],
+            "verified_vs_oracle": out["verified_vs_oracle"],
+            "swap_stall_ok": out["swap_stall_ok"],
+            "max_latency_ms": out["max_latency_ms"],
+            "zero_recompiles": out["zero_recompiles"],
+            "recompiles": out["exec"]["recompiles_during_churn"],
+            "overlay_queries": out["engine"]["overlay_queries"],
+            "compactions": out["store"]["compactions"],
+            "metrics_missing": missing,
+            "detail_file": "bench_update.json",
+        }))
+        return 0 if line["ok"] else 1
+    except Exception as e:
+        import traceback
+
+        traceback.print_exc()
+        print(json.dumps({
+            "metric": "bibfs_serve_update",
+            "value": None,
+            "error": f"{type(e).__name__}: {e}"[:400],
+        }))
+        return 1
+
+
 if __name__ == "__main__":
     if "--calibrate" in sys.argv:
         sys.exit(calibrate_main())
+    elif "--serve-update" in sys.argv:
+        sys.exit(serve_update_main())
     elif "--serve-chaos" in sys.argv:
         sys.exit(serve_chaos_main())
     elif "--serve-load" in sys.argv:

@@ -26,7 +26,17 @@ def write_graph_bin(path: str | os.PathLike, n: int, edges: np.ndarray) -> None:
 
     ``edges`` is an ``(M, 2)`` integer array of endpoint pairs. Each
     undirected edge is stored once, exactly as the reference writer does.
+
+    Endpoints must be in ``[0, n)``: the on-disk dtype is uint32, so a
+    negative endpoint would otherwise WRAP (``-1`` -> ``4294967295``)
+    and write a silently corrupt file.
     """
+    edges = np.asarray(edges).reshape(-1, 2)
+    if edges.size and (int(edges.min()) < 0 or int(edges.max()) >= n):
+        raise ValueError(
+            f"edge endpoints must be in [0, {n}); got "
+            f"[{int(edges.min())}, {int(edges.max())}]"
+        )
     edges = np.ascontiguousarray(edges, dtype=_HEADER_DTYPE).reshape(-1, 2)
     m = edges.shape[0]
     with open(path, "wb") as f:
@@ -52,10 +62,25 @@ def read_graph_bin(path: str | os.PathLike) -> tuple[int, np.ndarray]:
             f"{data.size} payload words"
         )
     edges = data.reshape(m, 2).astype(np.int64)
-    if m and int(edges.max()) >= n:
-        raise ValueError(
-            f"{path}: edge endpoint {int(edges.max())} out of range for n={n}"
-        )
+    if m:
+        # The on-disk dtype is uint32, but every reference reader loads
+        # endpoints into C ``int`` (v1/main-v1.cpp:28, read_in.cpp) — a
+        # word >= 2^31 is a NEGATIVE endpoint there, written by a buggy
+        # (or signed-dtype) generator. Reject it by name: letting it
+        # through as a huge positive id corrupts CSR builds downstream
+        # (or, with n > 2^31, indexes from the end of every array), and
+        # the generic out-of-range message hides what actually happened.
+        top = int(edges.max())
+        if top >= np.int64(2) ** 31:
+            raise ValueError(
+                f"{path}: edge endpoint {top} is negative "
+                f"({top - 2 ** 32} as the int32 the format's readers "
+                f"use) — not a valid vertex id"
+            )
+        if top >= n:
+            raise ValueError(
+                f"{path}: edge endpoint {top} out of range for n={n}"
+            )
     return n, edges
 
 

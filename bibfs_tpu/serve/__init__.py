@@ -18,6 +18,12 @@ from bibfs_tpu.serve.buckets import (  # noqa: F401
     bucket_shape,
     bucket_width,
     bucketed_ell,
+    ell_bucket_key,
+)
+from bibfs_tpu.store import (  # noqa: F401  (the graph-store subsystem)
+    DeltaOverlay,
+    GraphSnapshot,
+    GraphStore,
 )
 from bibfs_tpu.serve.cache import DistanceCache  # noqa: F401
 from bibfs_tpu.serve.engine import QueryEngine  # noqa: F401

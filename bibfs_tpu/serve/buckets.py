@@ -69,6 +69,15 @@ def bucket_shape(n_pad: int, width: int) -> tuple[int, int]:
     return bucket_rows(n_pad), bucket_width(width)
 
 
+def ell_bucket_key(g) -> tuple:
+    """The compiled-program shape identity of an (already bucketed) ELL
+    device table: everything the jit caches specialize on besides batch
+    mode and rung. Two graphs — or two VERSIONS of one graph — with the
+    same key reuse each other's compiled programs, which is what makes
+    a same-bucket hot-swap cost zero recompiles."""
+    return ("ell", g.n_pad, g.width)
+
+
 def bucketed_ell(
     n: int,
     edges: np.ndarray | None = None,

@@ -44,7 +44,7 @@ from bibfs_tpu.obs.trace import span
 
 # stable documented metric names (README "Observability")
 _EVENTS = ("forest_hit", "pair_hit", "miss", "insert",
-           "forest_eviction", "pair_eviction")
+           "forest_eviction", "pair_eviction", "invalidation")
 
 
 def _cache_cells(label: str) -> tuple[dict, dict]:
@@ -140,6 +140,10 @@ class DistanceCache:
         """Total LRU pops across BOTH stores (the complete churn count)."""
         return self.forest_evictions + self.pair_evictions
 
+    @property
+    def invalidations(self) -> int:
+        return self._m["invalidation"].value
+
     # ---- inserts -----------------------------------------------------
     def put_forest(self, graph_id, root: int, par: np.ndarray, n: int):
         """Bank one side's parent array (sliced to the true vertex
@@ -202,6 +206,29 @@ class DistanceCache:
                 self._m["pair_eviction"].inc()
             self._g["pairs"].set(len(self._pairs))
 
+    def invalidate(self, graph_id) -> int:
+        """Drop every forest and pair entry namespaced under
+        ``graph_id`` — the version-scoped invalidation a graph-store
+        hot-swap triggers. Keys are content digests, so entries of a
+        superseded version are already unreachable for new-version
+        queries; this reclaims their memory (one int32[n] row per
+        forest) instead of waiting for LRU churn. Returns the number of
+        entries dropped (also counted under the ``invalidation``
+        event)."""
+        with self._lock:
+            fkeys = [k for k in self._forests if k[0] == graph_id]
+            pkeys = [k for k in self._pairs if k[0] == graph_id]
+            for k in fkeys:
+                del self._forests[k]
+            for k in pkeys:
+                del self._pairs[k]
+            dropped = len(fkeys) + len(pkeys)
+            if dropped:
+                self._m["invalidation"].inc(dropped)
+                self._g["forests"].set(len(self._forests))
+                self._g["pairs"].set(len(self._pairs))
+            return dropped
+
     # ---- lookup ------------------------------------------------------
     def lookup(self, graph_id, src: int, dst: int):
         """``(found, hops, path src->dst)`` or None (a miss). Tries the
@@ -245,6 +272,7 @@ class DistanceCache:
                 "evictions": self.evictions,
                 "forest_evictions": self.forest_evictions,
                 "pair_evictions": self.pair_evictions,
+                "invalidations": self.invalidations,
                 "forests": len(self._forests),
                 "pairs": len(self._pairs),
             }

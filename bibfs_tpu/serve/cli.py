@@ -18,6 +18,22 @@ deadline instead of waiting for depth). ``--load RATE[,RATE...]`` runs
 the open-loop latency-SLO load harness instead of serving: sync vs
 pipelined engines at each offered rate, oracle-verified, p50/p95/p99
 reported (``bibfs_tpu/serve/loadgen``).
+
+``--store DIR`` serves a whole :class:`~bibfs_tpu.store.GraphStore`
+instead of one fixed ``.bin``: every ``DIR/*.bin`` registers under its
+file stem, and the stdin stream grows store commands alongside
+``src dst`` queries —
+
+- ``use NAME`` switches the stream's current graph;
+- ``update add U V`` / ``update del U V`` applies a live edge update
+  (answered exactly through the delta overlay until compaction);
+- ``swap`` forces a synchronous compaction + atomic hot-swap of the
+  current graph (in-flight batches finish on the old snapshot);
+- ``graphs`` lists the registered graphs with versions.
+
+Command replies land in the result stream (``use g: ...``), and a
+malformed command answers an ``error invalid: ...`` line without
+killing the stream — same contract as malformed query lines.
 """
 
 from __future__ import annotations
@@ -25,6 +41,73 @@ from __future__ import annotations
 import argparse
 import json
 import sys
+
+
+_STORE_COMMANDS = ("use", "update", "swap", "graphs")
+
+
+def _store_command(store, current: str, parts: list[str]) -> tuple[str, str]:
+    """Execute one stdin store command. Returns ``(reply_line,
+    current_graph)`` — replies (including malformed-command errors) land
+    in the result stream, same contract as malformed query lines."""
+    cmd = parts[0]
+    if cmd == "graphs":
+        if len(parts) != 1:
+            return "error invalid: usage: graphs", current
+        st = store.stats()["graphs"]
+        listing = " ".join(
+            "{star}{name}(v{v})".format(
+                star="*" if name == current else "", name=name,
+                v=st[name]["version"],
+            )
+            for name in sorted(st)
+        )
+        return f"graphs: {listing}", current
+    if cmd == "use":
+        if len(parts) != 2:
+            return "error invalid: usage: use NAME", current
+        name = parts[1]
+        try:
+            snap = store.current(name)
+        except KeyError as e:
+            return f"error invalid: {e.args[0]}", current
+        return f"use {name}: v{snap.version} digest {snap.digest[:12]}", name
+    if cmd == "swap":
+        if len(parts) != 1:
+            return "error invalid: usage: swap", current
+        old = store.current(current)
+        new = store.compact(current)  # synchronous fold + hot-swap
+        if new.version == old.version:
+            return f"swap {current}: no pending delta (v{old.version})", \
+                current
+        return (
+            f"swap {current}: v{old.version} -> v{new.version} "
+            f"digest {new.digest[:12]}"
+        ), current
+    # update add|del U V
+    if len(parts) != 4 or parts[1] not in ("add", "del"):
+        return "error invalid: usage: update add|del U V", current
+    try:
+        u, v = int(parts[2]), int(parts[3])
+    except ValueError:
+        return (
+            "error invalid: non-integer node id in "
+            f"{' '.join(parts)!r}"
+        ), current
+    try:
+        out = store.update(
+            current,
+            adds=[(u, v)] if parts[1] == "add" else (),
+            dels=[(u, v)] if parts[1] == "del" else (),
+        )
+    except ValueError as e:
+        return f"error invalid: {e}", current
+    return (
+        "update {g}: +{a}/-{d} pending{c}".format(
+            g=current, a=out["adds"], d=out["dels"],
+            c=" (compacting)" if out["compacting"] else "",
+        )
+    ), current
 
 
 def _print_result(src, dst, res, no_path: bool) -> None:
@@ -94,7 +177,37 @@ def main(argv=None):
         description="Serve (src, dst) queries through the adaptive "
         "micro-batching engine"
     )
-    ap.add_argument("graph", help=".bin graph file")
+    ap.add_argument("graph", nargs="?", default=None,
+                    help=".bin graph file (or serve a directory of "
+                    "graphs with --store)")
+    ap.add_argument(
+        "--store",
+        default=None,
+        metavar="DIR",
+        help="serve every *.bin graph in DIR through one versioned "
+        "GraphStore (each registers under its file stem): per-query "
+        "graph routing, live edge updates with exact overlay "
+        "answering, and atomic hot-swap via the stdin commands "
+        "use/update/swap (bibfs_tpu/store). Mutually exclusive with a "
+        "positional .bin and with --load",
+    )
+    ap.add_argument(
+        "--use",
+        default=None,
+        metavar="NAME",
+        help="initial current graph under --store (default: the "
+        "store's first graph, alphabetically)",
+    )
+    ap.add_argument(
+        "--compact-threshold",
+        type=int,
+        default=256,
+        metavar="EDGES",
+        help="pending delta edges at which a store graph compacts in "
+        "the background (rebuild + hot-swap off the serving path; "
+        "default 256). 0 disables auto-compaction (explicit 'swap' "
+        "only)",
+    )
     ap.add_argument(
         "--pairs",
         default=None,
@@ -211,11 +324,42 @@ def main(argv=None):
     from bibfs_tpu.utils.platform import apply_platform_env
 
     apply_platform_env()
-    try:
-        n, edges = read_graph_bin(args.graph)
-    except (OSError, ValueError) as e:
-        print(f"Error reading graph: {e}", file=sys.stderr)
-        return 2
+    n = edges = store = None
+    if args.store is not None:
+        if args.graph is not None:
+            print("Error: pass a .bin graph OR --store DIR, not both",
+                  file=sys.stderr)
+            return 2
+        if args.load is not None:
+            print("Error: --load measures one fixed graph; it does not "
+                  "combine with --store", file=sys.stderr)
+            return 2
+        from bibfs_tpu.store import GraphStore
+
+        try:
+            store = GraphStore.from_dir(
+                args.store,
+                compact_threshold=(args.compact_threshold or None),
+            )
+        except (OSError, ValueError) as e:
+            print(f"Error reading store: {e}", file=sys.stderr)
+            return 2
+        print(
+            "[Store] serving {k} graph(s): {names}".format(
+                k=len(store.names()), names=", ".join(store.names())
+            ),
+            file=sys.stderr, flush=True,
+        )
+    else:
+        if args.graph is None:
+            print("Error: a .bin graph (or --store DIR) is required",
+                  file=sys.stderr)
+            return 2
+        try:
+            n, edges = read_graph_bin(args.graph)
+        except (OSError, ValueError) as e:
+            print(f"Error reading graph: {e}", file=sys.stderr)
+            return 2
 
     # observability surfaces: both wrap the whole serving (or load) run
     metrics_server = None
@@ -244,8 +388,8 @@ def main(argv=None):
             except ValueError as e:
                 print(f"Error: {e}", file=sys.stderr)
                 return 2
-        return _serve(args, n, edges, QueryEngine, PipelinedQueryEngine,
-                      metrics_server)
+        return _serve(args, n, edges, store, QueryEngine,
+                      PipelinedQueryEngine, metrics_server)
     finally:
         if tracer is not None:
             from bibfs_tpu.obs.trace import uninstall_and_save
@@ -258,7 +402,7 @@ def main(argv=None):
             metrics_server.close()
 
 
-def _serve(args, n, edges, QueryEngine, PipelinedQueryEngine,
+def _serve(args, n, edges, store, QueryEngine, PipelinedQueryEngine,
            metrics_server=None):
     try:
         kwargs = dict(
@@ -279,13 +423,17 @@ def _serve(args, n, edges, QueryEngine, PipelinedQueryEngine,
                 args.inject_faults,
                 seed=int(os.environ.get("BIBFS_FAULTS_SEED", 0)),
             )
+        if store is not None:
+            kwargs.update(store=store, graph=args.use)
+        else:
+            kwargs.update(n=n, edges=edges)
         if args.pipeline:
             engine = PipelinedQueryEngine(
-                n, edges, max_wait_ms=args.max_wait_ms, **kwargs
+                max_wait_ms=args.max_wait_ms, **kwargs
             )
         else:
-            engine = QueryEngine(n, edges, **kwargs)
-    except ValueError as e:
+            engine = QueryEngine(**kwargs)
+    except (KeyError, ValueError) as e:
         print(f"Error: {e}", file=sys.stderr)
         return 2
     if metrics_server is not None:
@@ -320,6 +468,10 @@ def _serve(args, n, edges, QueryEngine, PipelinedQueryEngine,
             tickets: list = []
             emitted = 0
             failed = 0
+            current = (
+                None if store is None
+                else (args.use or store.default_graph())
+            )
 
             def drain():
                 nonlocal emitted, failed
@@ -342,6 +494,21 @@ def _serve(args, n, edges, QueryEngine, PipelinedQueryEngine,
                 parts = line.split()
                 if not parts:
                     continue
+                if parts[0] in _STORE_COMMANDS:
+                    if store is None:
+                        print(f"error invalid: {parts[0]!r} needs "
+                              "--store")
+                        continue
+                    # sequential REPL semantics: resolve everything
+                    # queued BEFORE the command mutates store state, so
+                    # a query answers on the graph it was typed against
+                    # (the engine's own swap barrier protects in-flight
+                    # batches; this protects still-queued tickets)
+                    engine.flush()
+                    drain()
+                    reply, current = _store_command(store, current, parts)
+                    print(reply)
+                    continue
                 if len(parts) != 2:
                     print("error invalid: expected 'src dst', got "
                           f"{line.strip()!r}")
@@ -353,7 +520,7 @@ def _serve(args, n, edges, QueryEngine, PipelinedQueryEngine,
                           f"{line.strip()!r}")
                     continue
                 try:
-                    tickets.append(engine.submit(src, dst))
+                    tickets.append(engine.submit(src, dst, current))
                 except ValueError as e:
                     print(f"error invalid: {src} -> {dst}: {e}")
                     continue
@@ -371,16 +538,34 @@ def _serve(args, n, edges, QueryEngine, PipelinedQueryEngine,
     stats = engine.stats()
     print(
         "[Serve] {q} queries: {dq} device-batched ({db} flushes), "
-        "{hq} host, {cs} cache-served; exec programs {ep} "
-        "({eh} reused)".format(
+        "{hq} host, {ov} overlay-exact, {cs} cache-served; exec "
+        "programs {ep} ({eh} reused)".format(
             q=stats["queries"], dq=stats["device_queries"],
             db=stats["device_batches"], hq=stats["host_queries"],
-            cs=stats["cache_served"],
+            ov=stats["overlay_queries"], cs=stats["cache_served"],
             ep=stats["exec_cache"]["programs"],
             eh=stats["exec_cache"]["hits"],
         ),
         file=sys.stderr,
     )
+    if store is not None:
+        store.close()  # join any in-flight background compaction
+        sstats = store.stats()
+        stats["store"] = sstats
+        print(
+            "[Store] {k} graph(s), {sw} swap(s), {co} compaction(s), "
+            "{de} delta edge(s) pending".format(
+                k=len(sstats["graphs"]),
+                sw=sum(g["swaps"] for g in sstats["graphs"].values()),
+                co=sum(
+                    g["compactions"] for g in sstats["graphs"].values()
+                ),
+                de=sum(
+                    g["delta_edges"] for g in sstats["graphs"].values()
+                ),
+            ),
+            file=sys.stderr,
+        )
     if args.stats_json:
         with open(args.stats_json, "w") as f:
             json.dump(stats, f, indent=1, sort_keys=True)

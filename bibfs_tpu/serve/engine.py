@@ -42,8 +42,10 @@ batch-solved results carry the whole-batch wall clock in ``time_s``
 
 from __future__ import annotations
 
+import threading
 import time
 import weakref
+from contextlib import contextmanager
 
 import numpy as np
 
@@ -53,7 +55,7 @@ from bibfs_tpu.serve.buckets import (
     DEFAULT_EXEC_CACHE,
     ExecutableCache,
     bucket_batch,
-    bucketed_ell,
+    ell_bucket_key,
 )
 from bibfs_tpu.serve.cache import DistanceCache
 from bibfs_tpu.serve.faults import FaultPlan
@@ -67,6 +69,7 @@ from bibfs_tpu.serve.resilience import (
     to_query_error,
 )
 from bibfs_tpu.solvers.api import BFSResult
+from bibfs_tpu.store.snapshot import GraphSnapshot
 
 
 def _engine_counter_bank(label: str) -> MetricBank:
@@ -81,7 +84,7 @@ def _engine_counter_bank(label: str) -> MetricBank:
     )
     routed = REGISTRY.counter(
         "bibfs_queries_routed_total",
-        "Queries by resolution route (trivial/cache/device/host)",
+        "Queries by resolution route (trivial/cache/device/host/overlay)",
         ("engine", "route"),
     )
     batches = REGISTRY.counter(
@@ -100,6 +103,7 @@ def _engine_counter_bank(label: str) -> MetricBank:
         "device_batches": batches.labels(engine=label),
         "device_queries": routed.labels(engine=label, route="device"),
         "host_queries": routed.labels(engine=label, route="host"),
+        "overlay_queries": routed.labels(engine=label, route="overlay"),
         "inserts_skipped": skipped.labels(engine=label),
     })
 
@@ -181,15 +185,146 @@ class _Pending:
     Exactly one of ``result`` / ``error`` lands: failure isolation
     gives a poisoned query a structured
     :class:`~bibfs_tpu.serve.resilience.QueryError` instead of sinking
-    its whole batch."""
+    its whole batch. ``graph`` is the store graph name the query is
+    against (None on a store-less engine's single graph)."""
 
-    __slots__ = ("src", "dst", "result", "error")
+    __slots__ = ("src", "dst", "graph", "result", "error")
 
-    def __init__(self, src: int, dst: int):
+    def __init__(self, src: int, dst: int, graph: str | None = None):
         self.src = src
         self.dst = dst
+        self.graph = graph
         self.result: BFSResult | None = None
         self.error: BaseException | None = None
+
+
+class _GraphRuntime:
+    """Everything an engine knows about solving ONE immutable graph
+    snapshot: the lazily built+uploaded device graph and its compiled-
+    program bucket key, the host solvers (native / serial), and the
+    distance-cache namespace. Engines keep one runtime per served graph
+    name and build a fresh one when the store hot-swaps the snapshot; a
+    flush BINDS a runtime for its whole lifetime (``engine._bound``), so
+    in-flight batches finish on the snapshot they started on while new
+    submissions already resolve the new version — the swap barrier.
+
+    ``graph_id`` defaults to the snapshot's content digest: the old
+    ``id(self)`` default was reused by CPython after GC, so two engines
+    sharing a :class:`DistanceCache` could silently alias namespaces.
+    Digests cannot alias (and snapshots built without hashable content
+    fall back to a process-wide monotonic ``anon-N`` — still never
+    reused)."""
+
+    def __init__(self, snapshot: GraphSnapshot, *, layout: str = "ell",
+                 device=None, host_backend: str | None = None,
+                 graph_id=None):
+        self.snapshot = snapshot
+        self.n = snapshot.n
+        self.layout = layout
+        self.graph_id = snapshot.digest if graph_id is None else graph_id
+        self._device = device
+        self._host_backend = host_backend
+        self._lock = threading.Lock()  # lazy builders: the pipelined
+        # engine resolves host solvers from the flusher AND (on the
+        # device->host recovery path) the finish worker
+        self._graph = None
+        self.bucket_key = None
+        self._host_solver = None
+        self.host_native_graph = None
+        self._serial_solver = None
+        self.host_backend_resolved: str | None = None
+
+    @property
+    def graph(self):
+        """The bucketed device-resident graph (built and uploaded on
+        first use: a host-routed runtime — the default on the CPU
+        substrate — never pays the padded table build)."""
+        if self._graph is None:
+            from bibfs_tpu.solvers.dense import DeviceGraph
+
+            with self._lock:
+                if self._graph is None:
+                    if self.layout == "ell":
+                        ell = self.snapshot.ell()
+                        self.bucket_key = ell_bucket_key(ell)
+                        self._graph = DeviceGraph.from_ell(
+                            ell, device=self._device
+                        )
+                    else:
+                        g = DeviceGraph.from_tiered(
+                            self.snapshot.tiered(), device=self._device
+                        )
+                        self.bucket_key = (
+                            "tiered", g.n_pad, g.width, g.tier_meta,
+                        )
+                        self._graph = g
+        return self._graph
+
+    def get_host_solver(self):
+        """The sub-crossover per-query path: the native C++ runtime when
+        it loads (the measured latency winner, PERF_NOTES §3), else the
+        NumPy serial oracle over the snapshot's memoized CSR."""
+        if self._host_solver is not None:
+            return self._host_solver
+        with self._lock:
+            if self._host_solver is not None:
+                return self._host_solver
+            backend = self._host_backend
+            if backend in (None, "native"):
+                try:
+                    from bibfs_tpu.solvers.native import (
+                        NativeGraph,
+                        solve_native_graph,
+                    )
+
+                    ng = NativeGraph.build(
+                        self.n, self.snapshot.undirected_edges()
+                    )
+                    # kept for the threaded C batch route (_solve_host):
+                    # bibfs_solve_batch shares only the read-only CSR and
+                    # creates per-C-thread scratches, so the handle is
+                    # safe to use from any thread
+                    self.host_native_graph = ng
+                    self.host_backend_resolved = "native"
+                    self._host_solver = (
+                        lambda s, d: solve_native_graph(ng, s, d)
+                    )
+                    return self._host_solver
+                except (ImportError, OSError):
+                    if backend == "native":
+                        raise
+            from bibfs_tpu.solvers.serial import solve_serial_csr
+
+            row_ptr, col_ind = self.snapshot.csr()
+            self._host_solver = (
+                lambda s, d: solve_serial_csr(
+                    self.n, row_ptr, col_ind, s, d
+                )
+            )
+            self.host_backend_resolved = "serial"
+            return self._host_solver
+
+    def solve_serial_one(self, src: int, dst: int) -> BFSResult:
+        """The bottom of the fallback ladder: the pure-NumPy serial
+        oracle over the snapshot's CSR — no native runtime, no device
+        stack, nothing left to be broken but the graph itself."""
+        if self._serial_solver is None:
+            with self._lock:
+                if self._serial_solver is None:
+                    if (self.host_backend_resolved == "serial"
+                            and self._host_solver is not None):
+                        # the host route already IS the serial oracle
+                        self._serial_solver = self._host_solver
+                    else:
+                        from bibfs_tpu.solvers.serial import solve_serial_csr
+
+                        row_ptr, col_ind = self.snapshot.csr()
+                        self._serial_solver = (
+                            lambda s, d: solve_serial_csr(
+                                self.n, row_ptr, col_ind, s, d
+                            )
+                        )
+        return self._serial_solver(int(src), int(dst))
 
 
 class QueryEngine:
@@ -199,6 +334,16 @@ class QueryEngine:
     ----------
     n, edges : the graph (same contract as ``api.solve``); ``pairs``
         optionally passes a precomputed ``canonical_pairs`` result.
+        Internally the graph becomes an immutable
+        :class:`~bibfs_tpu.store.snapshot.GraphSnapshot`.
+    store, graph : serve a :class:`~bibfs_tpu.store.GraphStore` instead
+        of one inline graph: ``store=`` attaches the store (mutually
+        exclusive with ``n``/``edges``/``pairs``), ``graph=`` names the
+        default graph (default: the store's). Queries then take a
+        per-query graph name (``submit(s, d, graph="social")``), live
+        edge updates answer exactly through the store's delta overlay,
+        and a hot-swapped snapshot is picked up at the next flush while
+        in-flight flushes finish on the version they started on.
     mode : batch mode for device flushes (default ``"auto"``: the
         measured preference order minor8 > minor > vmapped sync).
     layout : ``"ell"`` (shape-bucketed; the serving default) or
@@ -221,9 +366,18 @@ class QueryEngine:
         runtime wins every regime).
     exec_cache : an :class:`ExecutableCache` to share compiled-program
         accounting across engines (default: the process-wide one).
-    graph_id : distance-cache namespace for this graph (only matters if
-        a :class:`DistanceCache` is ever shared across engines; defaults
-        to a per-engine unique value).
+    dist_cache : a :class:`DistanceCache` to SHARE across engines
+        (default: a private one). Safe to share because entries are
+        namespaced by snapshot content digest (see ``graph_id``).
+    graph_id : distance-cache namespace override for the default graph.
+        Default: the snapshot's content digest — two engines over the
+        same graph share entries, engines over different graphs cannot
+        alias (the old ``id(self)`` default could, after GC reuse). On a
+        store-backed engine the override applies only until the first
+        hot-swap of that graph: the replacement runtime reverts to
+        digest namespacing (and the override namespace is invalidated),
+        because pinning a caller-chosen namespace across versions would
+        let stale version-k entries answer version-k+1 queries.
     obs_label : the ``engine=`` label value this engine's counters carry
         in the process metrics registry (default: a process-unique
         ``sync-N`` / ``pipe-N``). ``counters`` (and the pipelined
@@ -251,9 +405,11 @@ class QueryEngine:
 
     def __init__(
         self,
-        n: int,
+        n: int | None = None,
         edges: np.ndarray | None = None,
         *,
+        store=None,
+        graph: str | None = None,
         pairs: np.ndarray | None = None,
         mode: str = "auto",
         layout: str = "ell",
@@ -263,6 +419,7 @@ class QueryEngine:
         host_backend: str | None = None,
         device_batches: bool | None = None,
         exec_cache: ExecutableCache | None = None,
+        dist_cache: DistanceCache | None = None,
         graph_id=None,
         device=None,
         obs_label: str | None = None,
@@ -271,25 +428,40 @@ class QueryEngine:
         breaker: CircuitBreaker | None = None,
         health_window_s: float = 5.0,
     ):
-        from bibfs_tpu.graph.csr import canonical_pairs
         from bibfs_tpu.solvers.batch_minor import small_batch_threshold
 
-        self.n = int(n)
-        if pairs is None:
-            pairs = canonical_pairs(n, edges)
-        self._pairs_host = pairs  # host fallback builders reuse this
-        # the native builder mirrors internally, so hand it the original
-        # undirected list when we have one (pairs are already mirrored)
-        self._edges_host = edges
+        # cheap argument validation FIRST: below here a store-backed
+        # ctor acquires a snapshot pin, which a later raise would leak
+        # (the swapped-out snapshot would never retire)
         if layout not in ("ell", "tiered"):
             raise ValueError(
                 f"unknown layout {layout!r} (expected 'ell' or 'tiered')"
             )
-        # the bucketed device graph is built (and uploaded) lazily on the
-        # first device-routed flush: a host-routed engine — the default
-        # on the CPU substrate — never pays the padded table build
-        self._graph = None
-        self._bucket_key = None
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        self._store = store
+        if store is not None:
+            if n is not None or edges is not None or pairs is not None:
+                raise ValueError(
+                    "pass the graph inline (n, edges/pairs) OR store=, "
+                    "not both"
+                )
+            self._default_name = (
+                store.default_graph() if graph is None else str(graph)
+            )
+            try:
+                snap = store.acquire(self._default_name)  # the engine's pin
+            except KeyError as e:
+                # ctor misuse is a ValueError like every other bad
+                # argument here (query-time _resolve_graph does the same)
+                raise ValueError(str(e)) from e
+        else:
+            if graph is not None:
+                raise ValueError("graph= names a store graph; pass store=")
+            if n is None:
+                raise ValueError("n (and edges/pairs) required without store=")
+            snap = GraphSnapshot.build(n, edges, pairs=pairs)
+            self._default_name = None
         self._device = device
         self.mode = mode
         self.layout = layout
@@ -297,25 +469,35 @@ class QueryEngine:
             small_batch_threshold() if flush_threshold is None
             else int(flush_threshold)
         )
-        if max_batch < 1:
-            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
         self.max_batch = bucket_batch(max_batch)
-        self.graph_id = id(self) if graph_id is None else graph_id
+        self._host_backend = host_backend
+        # per-graph solving state lives in _GraphRuntime objects (device
+        # table + bucket key built lazily on the first device-routed
+        # flush; host solvers on the first host-routed one). One runtime
+        # per served graph name; a store hot-swap replaces the runtime at
+        # the next resolution while bound flushes finish on the old one.
+        self._rt_lock = threading.RLock()
+        self._flush_tls = threading.local()
+        self._rts_released = False
+        self._runtimes: dict = {
+            self._default_name: _GraphRuntime(
+                snap, layout=layout, device=device,
+                host_backend=host_backend, graph_id=graph_id,
+            )
+        }
         self.obs_label = (
             next_instance_label(self._OBS_PREFIX) if obs_label is None
             else obs_label
         )
-        self.dist_cache = DistanceCache(
-            entries=cache_entries, metrics_label=self.obs_label
+        self.dist_cache = (
+            DistanceCache(entries=cache_entries,
+                          metrics_label=self.obs_label)
+            if dist_cache is None else dist_cache
         )
         self.exec_cache = (
             DEFAULT_EXEC_CACHE if exec_cache is None else exec_cache
         )
-        self._host_backend = host_backend
         self._device_batches = device_batches
-        self._host_solver = None  # built lazily on first host-routed flush
-        self._host_native_graph = None  # set alongside a native solver
-        self._serial_solver = None  # last fallback rung, built lazily
         # resilience: fault plan (None = zero-cost), device retry policy,
         # device-route circuit breaker, health state machine. The breaker
         # transition hook keeps the bibfs_breaker_state gauge exact.
@@ -389,43 +571,166 @@ class QueryEngine:
         self._c_trivial = self.counters.cell("trivial")
         self._c_cache_served = self.counters.cell("cache_served")
         self._c_host_queries = self.counters.cell("host_queries")
+        self._c_overlay = self.counters.cell("overlay_queries")
+
+    # ---- graph resolution (the store seam) ---------------------------
+    def _graph_rt(self, name) -> _GraphRuntime:
+        """The runtime serving ``name``'s CURRENT snapshot — on a
+        version change (hot-swap), build a fresh runtime and release the
+        superseded one (its distance-cache namespace is invalidated; its
+        snapshot retires once in-flight flush pins drop)."""
+        if self._store is None:
+            return self._runtimes[None]
+        rt = self._runtimes.get(name)
+        if self._rts_released:  # post-close stats(): no new pins
+            if rt is None:
+                raise ValueError("engine is closed")
+            return rt
+        if rt is not None and rt.snapshot is self._store.current(name):
+            return rt  # the hot path: same version, no lock
+        with self._rt_lock:
+            rt = self._runtimes.get(name)
+            snap = self._store.acquire(name)
+            if rt is not None and rt.snapshot is snap:
+                snap.release()
+                return rt
+            new = _GraphRuntime(
+                snap, layout=self.layout, device=self._device,
+                host_backend=self._host_backend,
+            )
+            self._runtimes[name] = new
+            if rt is not None:
+                old_id = rt.graph_id
+                rt.snapshot.release()
+                if old_id != new.graph_id:
+                    # version-scoped invalidation: digest keys already
+                    # make version-k entries unreachable for version-k+1
+                    # queries; reclaim their rows now instead of waiting
+                    # for LRU churn
+                    self.dist_cache.invalidate(old_id)
+            return new
+
+    def _resolve_graph(self, graph) -> tuple:
+        """``(name, runtime)`` for a submit-time graph argument; client
+        mistakes (unknown name, a name without a store) surface as
+        ``ValueError`` so ``return_errors`` mode tags them invalid."""
+        if graph is None:
+            name = self._default_name
+        elif self._store is None:
+            raise ValueError(
+                "per-query graph names need an attached store (store=)"
+            )
+        else:
+            name = str(graph)
+        try:
+            return name, self._graph_rt(name)
+        except KeyError as e:
+            raise ValueError(str(e)) from e
+
+    def _pin_rt(self, name) -> _GraphRuntime:
+        """Resolve AND pin in one step, under the runtime lock — a
+        concurrent swap cannot retire the snapshot between the resolve
+        and the pin. The caller owes one ``snapshot.release()`` (or
+        hands the pin to :meth:`_bound`)."""
+        with self._rt_lock:
+            rt = self._graph_rt(name)
+            rt.snapshot.retain()
+        return rt
+
+    @contextmanager
+    def _bound(self, rt: _GraphRuntime):
+        """Make ``rt`` the calling thread's flush target: everything in
+        the with-block (device launch, host solves, banking, cache
+        namespacing) reads THIS runtime through the engine's graph
+        properties, whatever the store swaps to meanwhile — the swap
+        barrier at the flush seams. Consumes one snapshot pin
+        (:meth:`_pin_rt`)."""
+        tls = self._flush_tls
+        prev = getattr(tls, "rt", None)
+        tls.rt = rt
+        try:
+            yield rt
+        finally:
+            tls.rt = prev
+            rt.snapshot.release()
+
+    def _current_rt(self) -> _GraphRuntime:
+        """The thread's bound flush runtime, else the default graph's
+        current one — what the ``n``/``graph``/``graph_id`` properties
+        (and every solver seam) read."""
+        rt = getattr(self._flush_tls, "rt", None)
+        return rt if rt is not None else self._graph_rt(self._default_name)
+
+    def _overlay_pending(self, name):
+        """The graph's pending delta overlay (None when absent): while
+        one exists, queries answer exactly through it and the distance
+        cache stands aside — its entries describe the base snapshot,
+        not the overlaid graph."""
+        if self._store is None:
+            return None
+        return self._store.overlay(name)
+
+    @property
+    def n(self) -> int:
+        """Vertex count of the bound flush graph (outside a flush: the
+        default graph's current snapshot)."""
+        return self._current_rt().n
 
     @property
     def graph(self):
         """The bucketed device-resident graph (built on first use)."""
-        if self._graph is None:
-            from bibfs_tpu.solvers.dense import DeviceGraph
+        return self._current_rt().graph
 
-            if self.layout == "ell":
-                ell = bucketed_ell(self.n, pairs=self._pairs_host)
-                self._graph = DeviceGraph.from_ell(ell, device=self._device)
-                self._bucket_key = ("ell", ell.n_pad, ell.width)
-            else:
-                self._graph = DeviceGraph.build(
-                    self.n, layout="tiered", pairs=self._pairs_host,
-                    device=self._device,
-                )
-                self._bucket_key = (
-                    "tiered", self._graph.n_pad, self._graph.width,
-                    self._graph.tier_meta,
-                )
-        return self._graph
+    @property
+    def graph_id(self):
+        return self._current_rt().graph_id
+
+    @property
+    def _bucket_key(self):
+        return self._current_rt().bucket_key
+
+    @property
+    def _host_native_graph(self):
+        return self._current_rt().host_native_graph
+
+    @property
+    def host_backend_resolved(self):
+        return self._current_rt().host_backend_resolved
 
     # ---- submission --------------------------------------------------
-    def submit(self, src: int, dst: int) -> _Pending:
-        """Queue one query. Cache hits and trivial queries resolve
-        immediately; everything else resolves at the next flush (an
-        overfull queue flushes itself at ``max_batch``)."""
+    def submit(self, src: int, dst: int, graph: str | None = None
+               ) -> _Pending:
+        """Queue one query (``graph`` names a store graph on a
+        store-backed engine; None = the default graph). Cache hits and
+        trivial queries resolve immediately; everything else resolves at
+        the next flush (an overfull queue flushes itself at
+        ``max_batch``)."""
+        if self._rts_released:
+            # the snapshot pins are gone: a later flush could neither
+            # pin nor solve — fail HERE with a clear error instead of
+            # stranding the ticket on a retired-snapshot RuntimeError
+            raise ValueError("engine is closed")
         src, dst = int(src), int(dst)
-        if not (0 <= src < self.n and 0 <= dst < self.n):
-            raise ValueError(f"src/dst out of range for n={self.n}")
-        t = _Pending(src, dst)
+        name, rt = self._resolve_graph(graph)
+        if not (0 <= src < rt.n and 0 <= dst < rt.n):
+            raise ValueError(f"src/dst out of range for n={rt.n}")
+        t = _Pending(src, dst, name)
         self._c_queries.inc()
         if src == dst:
             self._c_trivial.inc()
             t.result = BFSResult(True, 0, [src], src, 0.0, 0, 0)
             return t
-        hit = self.dist_cache.lookup(self.graph_id, src, dst)
+        if self._overlay_pending(name) is not None:
+            hit = None
+        else:
+            # re-resolve AFTER the overlay read: a compaction commits
+            # (overlay -> None, snapshot -> k+1) atomically, so an rt
+            # resolved before the commit plus an overlay read after it
+            # would serve a stale version-k cache entry to a query
+            # submitted after the update. Overlay-read THEN resolve is
+            # safe in both directions (same argument as _flush_graph).
+            rt = self._graph_rt(name)
+            hit = self.dist_cache.lookup(rt.graph_id, src, dst)
         if hit is not None:
             found, hops, path = hit
             self._c_cache_served.inc()
@@ -439,19 +744,21 @@ class QueryEngine:
             self.flush()
         return t
 
-    def query(self, src: int, dst: int) -> BFSResult:
+    def query(self, src: int, dst: int, graph: str | None = None
+              ) -> BFSResult:
         """Submit + flush one query (the low-latency path: a cache hit
         never touches a solver; a miss dispatches alone, host-side when
         the crossover says so). Raises the ticket's
         :class:`QueryError` if every fallback rung failed it."""
-        t = self.submit(src, dst)
+        t = self.submit(src, dst, graph)
         if t.result is None and t.error is None:
             self.flush()
         if t.error is not None:
             raise t.error
         return t.result
 
-    def query_many(self, pairs, *, return_errors: bool = False) -> list:
+    def query_many(self, pairs, *, graph: str | None = None,
+                   return_errors: bool = False) -> list:
         """Serve a whole query list through one (chunked) flush.
 
         ``return_errors=True`` switches to partial-failure mode: the
@@ -461,7 +768,7 @@ class QueryEngine:
         including queries rejected at submit time (``kind='invalid'``).
         The default re-raises the first failure, matching the
         pre-resilience contract."""
-        tickets = self._submit_collect(pairs, return_errors)
+        tickets = self._submit_collect(pairs, return_errors, graph)
         if not tickets:
             return []  # nothing queued: skip the flush entirely
         if any(isinstance(t, _Pending) for t in tickets):
@@ -478,7 +785,8 @@ class QueryEngine:
                 out.append(t.result)
         return out
 
-    def _submit_collect(self, pairs, return_errors: bool) -> list:
+    def _submit_collect(self, pairs, return_errors: bool,
+                        graph: str | None = None) -> list:
         """Submit every pair; in ``return_errors`` mode a rejected
         submit becomes a ``kind='invalid'`` :class:`QueryError` slot
         (submit-time validation is the ONE place that knows it is
@@ -487,7 +795,7 @@ class QueryEngine:
         tickets: list = []
         for s, d in pairs:
             try:
-                tickets.append(self.submit(int(s), int(d)))
+                tickets.append(self.submit(int(s), int(d), graph))
             except (ValueError, TypeError) as e:
                 if not return_errors:
                     raise
@@ -502,18 +810,44 @@ class QueryEngine:
 
     # ---- flushing ----------------------------------------------------
     def flush(self) -> None:
-        """Resolve every pending query: batched device dispatch at or
-        above the calibrated crossover, per-query host dispatch below."""
+        """Resolve every pending query — grouped per graph, each group
+        bound to the snapshot it resolved at flush start (the swap
+        barrier): batched device dispatch at or above the calibrated
+        crossover, per-query host dispatch below, exact overlay solves
+        while the graph has pending live updates."""
         pend, self._pending = self._pending, []
         if not pend:
             return
-        with span("flush", queued=len(pend)):
+        if self._store is None:
+            self._flush_graph(None, pend)
+            return
+        groups: dict = {}
+        for t in pend:
+            groups.setdefault(t.graph, []).append(t)
+        for name, group in groups.items():
+            self._flush_graph(name, group)
+
+    def _flush_graph(self, name, pend) -> None:
+        # overlay BEFORE pin: a compaction commits (snapshot', overlay
+        # =None) atomically under the store lock, so pin-then-read could
+        # pin the pre-update snapshot yet read no overlay — serving the
+        # batch without the folded delta. Read-then-pin is safe in both
+        # directions: a non-None overlay answers exactly on its own
+        # base whatever gets swapped meanwhile, and a None read means
+        # any pin taken after it is the post-compaction (or newer)
+        # snapshot.
+        overlay = self._overlay_pending(name)
+        rt = self._pin_rt(name)
+        with self._bound(rt), span("flush", queued=len(pend)):
             # dedupe exact repeats within one flush: serving traffic
             # repeats, and a batch slot per duplicate would be pure waste
             unique: dict[tuple[int, int], list[_Pending]] = {}
             for t in pend:
                 unique.setdefault((t.src, t.dst), []).append(t)
             pairs = list(unique)
+            if overlay is not None:
+                self._flush_overlay(overlay, pairs, unique)
+                return
             if len(pairs) < self.flush_threshold or not self._use_device():
                 self._flush_host(pairs, unique)
                 return
@@ -525,6 +859,27 @@ class QueryEngine:
                     self._flush_host(chunk, unique)
                 else:
                     self._flush_device(chunk, unique)
+
+    def _flush_overlay(self, overlay, pairs, unique) -> None:
+        """The exact-answering route while live edge updates are
+        pending: every query solves against base+delta on the host
+        (:meth:`DeltaOverlay.solve`), isolated per query. No cache
+        lookup or banking — distance-cache entries are namespaced by
+        snapshot digest, and the overlaid graph is not (yet) any
+        snapshot."""
+        with span("overlay_batch", batch=len(pairs)):
+            corr = overlay.correction()  # one O(delta) capture per batch
+            for key in pairs:
+                try:
+                    res = overlay.solve(*key, correction=corr)
+                except Exception as exc:
+                    self._resolve_error(
+                        unique[key], to_query_error(exc, key)
+                    )
+                    continue
+                self._c_overlay.inc()
+                for t in unique[key]:
+                    t.result = res
 
     def _flush_device(self, pairs, unique) -> None:
         results = self._device_attempt(pairs)
@@ -743,28 +1098,11 @@ class QueryEngine:
 
     def _solve_serial_one(self, src: int, dst: int) -> BFSResult:
         """The bottom of the fallback ladder: the pure-NumPy serial
-        oracle over a CSR built from the canonical pairs — no native
-        runtime, no device stack, nothing left to be broken but the
-        graph itself."""
-        if self._serial_solver is None:
-            if (getattr(self, "host_backend_resolved", None) == "serial"
-                    and self._host_solver is not None):
-                # the host route already IS the serial oracle: reuse it
-                # instead of building a second identical O(E) CSR
-                self._serial_solver = self._host_solver
-            else:
-                from bibfs_tpu.graph.csr import build_csr
-                from bibfs_tpu.solvers.serial import solve_serial_csr
-
-                row_ptr, col_ind = build_csr(
-                    self.n, pairs=self._pairs_host
-                )
-                self._serial_solver = (
-                    lambda s, d: solve_serial_csr(
-                        self.n, row_ptr, col_ind, s, d
-                    )
-                )
-        return self._serial_solver(int(src), int(dst))
+        oracle over the bound graph's CSR — no native runtime, no device
+        stack, nothing left to be broken but the graph itself. (A thin
+        seam over the runtime so chaos tests can break this rung per
+        engine.)"""
+        return self._current_rt().solve_serial_one(src, dst)
 
     def _resolve_error(self, tickets, err: QueryError) -> None:
         """Fail exactly these tickets with a structured error (their
@@ -845,62 +1183,38 @@ class QueryEngine:
             t.result = res
 
     def _get_host_solver(self):
-        """The sub-crossover per-query path: the native C++ runtime when
-        it loads (the measured latency winner, PERF_NOTES §3), else the
-        NumPy serial oracle."""
-        if self._host_solver is not None:
-            return self._host_solver
-        backend = self._host_backend
-        self._host_native_graph = None
-        if backend in (None, "native"):
-            try:
-                from bibfs_tpu.solvers.native import (
-                    NativeGraph,
-                    solve_native_graph,
-                )
-
-                ng = NativeGraph.build(self.n, self._native_edges())
-                self._host_solver = (
-                    lambda s, d: solve_native_graph(ng, s, d)
-                )
-                # kept for the threaded C batch route (_solve_host):
-                # bibfs_solve_batch shares only the read-only CSR and
-                # creates per-C-thread scratches, so the handle is safe
-                # to use from any thread
-                self._host_native_graph = ng
-                self.host_backend_resolved = "native"
-                return self._host_solver
-            except (ImportError, OSError):
-                if backend == "native":
-                    raise
-        from bibfs_tpu.graph.csr import build_csr
-        from bibfs_tpu.solvers.serial import solve_serial_csr
-
-        row_ptr, col_ind = build_csr(self.n, pairs=self._pairs_host)
-        self._host_solver = (
-            lambda s, d: solve_serial_csr(self.n, row_ptr, col_ind, s, d)
-        )
-        self.host_backend_resolved = "serial"
-        return self._host_solver
-
-    def _native_edges(self) -> np.ndarray:
-        """The undirected edge list the native builder wants (it mirrors
-        internally): the original list when we have it, else the u < v
-        half of the canonical (already-mirrored) pairs."""
-        if self._edges_host is not None:
-            return self._edges_host
-        p = self._pairs_host
-        return p[p[:, 0] < p[:, 1]]
+        """The sub-crossover per-query path of the bound graph: the
+        native C++ runtime when it loads (the measured latency winner,
+        PERF_NOTES §3), else the NumPy serial oracle
+        (:meth:`_GraphRuntime.get_host_solver`)."""
+        return self._current_rt().get_host_solver()
 
     # ---- lifecycle ---------------------------------------------------
     def close(self) -> None:
         """Resolve anything still queued, then mark the engine draining
-        (``/healthz`` flips to 503). The synchronous engine owns no
-        threads, so this is just a drain — it exists so load drivers and
-        ``with`` blocks treat both engine flavors uniformly (the
-        pipelined subclass tears down its worker threads here)."""
+        (``/healthz`` flips to 503) and drop the engine's snapshot pins
+        (store-backed snapshots retire once the last pin lands). Later
+        ``submit``/``query`` calls raise a clear ``engine is closed``
+        (post-close ``stats()`` stays readable). The synchronous engine
+        owns no threads, so this is otherwise just a drain — it exists
+        so load drivers and ``with`` blocks treat both engine flavors
+        uniformly (the pipelined subclass tears down its worker threads
+        here)."""
         self.flush()
         self.health.set_draining()
+        self._release_runtimes()
+
+    def _release_runtimes(self) -> None:
+        """Drop the engine's per-runtime snapshot pins, once. Runtimes
+        stay readable afterwards (post-close ``stats()``) but are never
+        re-resolved against the store."""
+        with self._rt_lock:
+            if self._rts_released:
+                return
+            self._rts_released = True
+            rts = list(self._runtimes.values())
+        for rt in rts:
+            rt.snapshot.release()
 
     def __enter__(self):
         return self
@@ -918,7 +1232,10 @@ class QueryEngine:
         """Machine-readable serving counters (the bench artifact's
         ``stats`` block)."""
         c = dict(self.counters)
-        solved = c["device_queries"] + c["host_queries"]
+        rt = self._current_rt()
+        solved = (
+            c["device_queries"] + c["host_queries"] + c["overlay_queries"]
+        )
         return {
             **c,
             "solver_dispatch_free": c["queries"] - solved,
@@ -931,6 +1248,16 @@ class QueryEngine:
             ),
             "device_batches_enabled": self._use_device(),
             "host_backend": getattr(self, "host_backend_resolved", None),
+            "graph": {
+                "n": rt.n,
+                "digest": rt.snapshot.digest,
+                "version": rt.snapshot.version,
+                "store_graph": self._default_name,
+                "graphs_resolved": (
+                    None if self._store is None
+                    else sorted(self._runtimes)
+                ),
+            },
             "resilience": {
                 **self._res_cells.snapshot(),
                 "breaker": self._breaker.snapshot(),

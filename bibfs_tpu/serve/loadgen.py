@@ -33,6 +33,7 @@ CI boxes).
 from __future__ import annotations
 
 import sys
+import threading
 import time
 
 import numpy as np
@@ -529,6 +530,379 @@ def run_chaos(
         return out
     finally:
         engine.close()
+
+
+def run_churn(
+    n,
+    edges,
+    *,
+    epochs: int = 4,
+    queries_per_epoch: int = 150,
+    updates_per_epoch: int = 16,
+    twin_fraction: float = 0.25,
+    rate_qps: float = 200.0,
+    max_wait_ms: float = 40.0,
+    flush_threshold: int = 8,
+    max_batch: int = 64,
+    stall_bound_ms: float = 2500.0,
+    seed: int = 0,
+    **engine_kwargs,
+) -> dict:
+    """The graph-store churn soak (``bench.py --serve-update``): the
+    open-loop load generator driven against a pipelined engine serving a
+    LIVE :class:`~bibfs_tpu.store.GraphStore` while edge updates land
+    and snapshots hot-swap under the traffic — asserting the claims the
+    store makes:
+
+    1. **exact answers under churn** — traffic runs in epochs; each
+       epoch applies one batched edge update (crossing the store's
+       compaction threshold, so a background rebuild + atomic hot-swap
+       races the epoch's own queries; odd epochs also force a
+       synchronous ``compact()`` from a side thread mid-traffic) and
+       every surviving answer must match a from-scratch serial oracle
+       on the POST-UPDATE edge set — whether it resolved through the
+       delta overlay, the old snapshot's in-flight batch, or the
+       swapped-in snapshot;
+    2. **zero lost tickets across swaps** — every submitted query
+       resolves (result or structured error); nothing strands in the
+       pipeline through any number of hot-swaps;
+    3. **bounded swap stall** — the worst submit-to-resolve latency over
+       the whole churn (which brackets every swap) stays under
+       ``stall_bound_ms``: a swap is a pointer flip, not a rebuild on
+       the serving path;
+    4. **zero recompiles** — updates are degree-capped so every rebuilt
+       snapshot lands in the same ELL shape bucket, and a second graph
+       (``twin``: the same graph under a vertex relabeling, so the same
+       bucket by construction) serves ``twin_fraction`` of the traffic;
+       the :class:`~bibfs_tpu.serve.buckets.ExecutableCache` program
+       count after warmup must not grow through all swaps and both
+       graphs (hit counters are the witness — the committed
+       ``bench_update.json`` carries them).
+
+    Returns the machine-readable ``bench_update.json`` payload (``ok``
+    aggregates the gates)."""
+    from bibfs_tpu.graph.csr import build_csr, canonical_pairs
+    from bibfs_tpu.serve.buckets import ExecutableCache
+    from bibfs_tpu.serve.pipeline import PipelinedQueryEngine
+    from bibfs_tpu.solvers.serial import solve_serial_csr
+    from bibfs_tpu.store import GraphStore
+
+    rng = np.random.default_rng(seed)
+    cpairs = canonical_pairs(n, edges)
+    und = cpairs[cpairs[:, 0] < cpairs[:, 1]]
+    # the twin: the same graph under a fixed vertex relabeling — same
+    # degree multiset, same ELL width bucket, different digest/answers
+    perm = rng.permutation(n)
+    twin_und = np.sort(perm[und], axis=1)
+
+    # the threshold sits just ABOVE one epoch's update batch: an even
+    # epoch leaves its delta pending, so the overlay answers that
+    # epoch's main-graph traffic exactly (the route the soak must
+    # exercise); the NEXT epoch's batch crosses the threshold and kicks
+    # the background rebuild racing that epoch's queries, and odd
+    # epochs additionally force a synchronous fold mid-stream.
+    store = GraphStore(compact_threshold=updates_per_epoch + 1)
+    store.add("main", n, pairs=cpairs)
+    store.add("twin", n, twin_und)
+    twin_csr = build_csr(n, twin_und)
+    twin_oracle: dict = {}
+
+    # live main-graph state, maintained edge-exactly by the harness: the
+    # per-epoch oracle rebuilds from this set. Updates never touch the
+    # max-degree vertex and cap every endpoint's degree strictly below
+    # it, so the rebuilt ELL width bucket (and with it the compiled
+    # program identity) provably cannot move.
+    live = set(map(tuple, und.tolist()))
+    deg = np.bincount(und.ravel(), minlength=n)
+    pinned = int(np.argmax(deg))
+    deg_cap = int(deg[pinned]) - 1
+
+    def sample_updates():
+        dels, adds = [], []
+        attempts = 0
+        while len(dels) < updates_per_epoch // 2 and attempts < 10000:
+            attempts += 1
+            e = tuple(map(int, rng.choice(list(live))))
+            if pinned in e or e in dels:
+                continue
+            dels.append(e)
+        pending = set(dels)
+        while (len(adds) + len(dels) < updates_per_epoch
+               and attempts < 20000):
+            attempts += 1
+            u, v = int(rng.integers(0, n)), int(rng.integers(0, n))
+            if u == v:
+                continue
+            e = (u, v) if u < v else (v, u)
+            if e in live and e not in pending:
+                continue  # already present (and not being deleted)
+            if e in adds:
+                continue
+            if pinned in e or deg[u] + 1 > deg_cap or deg[v] + 1 > deg_cap:
+                continue
+            if e in pending:
+                continue  # adding back a same-epoch delete would cancel
+            adds.append(e)
+        return adds, dels
+
+    exec_cache = ExecutableCache()
+    engine = PipelinedQueryEngine(
+        store=store, graph="main",
+        flush_threshold=flush_threshold, max_batch=max_batch,
+        device_batches=True, exec_cache=exec_cache,
+        max_wait_ms=max_wait_ms,
+        **engine_kwargs,
+    )
+    t_setup = time.perf_counter()
+    epochs_out = []
+    lost, failed, mismatches = [], [], []
+    max_lat_s = 0.0
+    try:
+        # warm the (single-rung) batch program through BOTH graphs with
+        # fresh unique pairs per round until the program set stabilizes;
+        # the baseline taken here is what every later swap is gated
+        # against. The twin warms after main: its flushes landing as
+        # pure hits IS the cross-graph reuse claim.
+        warm_pool = sample_query_pairs(n, 8 * max_batch, seed=seed + 99)
+        warm_at = 0
+        programs_after = {}
+        for g in ("main", "twin"):
+            for _ in range(4):
+                before = exec_cache.stats()["programs"]
+                chunk = warm_pool[warm_at: warm_at + max_batch]
+                warm_at += max_batch
+                engine.query_many(
+                    [(int(s), int(d)) for s, d in chunk], graph=g
+                )
+                if before == exec_cache.stats()["programs"] and before:
+                    break
+            programs_after[g] = exec_cache.stats()["programs"]
+        baseline = exec_cache.stats()
+        cross_graph_reuse = (
+            programs_after["twin"] == programs_after["main"]
+        )
+
+        def drain_bounded() -> bool:
+            try:
+                engine.flush(timeout=60.0)
+                return True
+            except TimeoutError:
+                return False
+
+        drained = True
+        versions_seen = {store.current("main").version}
+        for epoch in range(epochs):
+            adds, dels = sample_updates()
+            out = store.update("main", adds=adds, dels=dels)
+            live.difference_update(dels)
+            live.update(adds)
+            for u, v in dels:
+                deg[u] -= 1
+                deg[v] -= 1
+            for u, v in adds:
+                deg[u] += 1
+                deg[v] += 1
+            epoch_edges = np.array(sorted(live), dtype=np.int64)
+            csr = build_csr(n, epoch_edges)
+            pairs = sample_query_pairs(
+                n, queries_per_epoch, seed=seed + 7 * epoch + 1
+            )
+            n_twin = int(len(pairs) * twin_fraction)
+            graphs = (["twin"] * n_twin
+                      + ["main"] * (len(pairs) - n_twin))
+            rng.shuffle(graphs)
+            oracle = {}
+            for (s, d), g in zip(pairs, graphs):
+                s, d = int(s), int(d)
+                if g == "twin":
+                    if (s, d) not in twin_oracle:
+                        twin_oracle[(s, d)] = solve_serial_csr(
+                            n, *twin_csr, s, d
+                        )
+                    oracle[(s, d, "twin")] = twin_oracle[(s, d)]
+                else:
+                    oracle[(s, d, "main")] = solve_serial_csr(
+                        n, *csr, s, d
+                    )
+
+            # odd epochs force a synchronous fold mid-traffic from a
+            # side thread — the REPL `swap` path racing live submits
+            # (even epochs rely on the threshold-triggered background
+            # compaction kicked by the update above)
+            forcer = None
+            forced_at = max(1, (2 * len(pairs)) // 3)
+            t0 = time.perf_counter()
+            tickets = []
+            for i, ((s, d), g) in enumerate(zip(pairs, graphs)):
+                if epoch % 2 == 1 and i == forced_at:
+                    forcer = threading.Thread(
+                        target=lambda: store.compact("main"),
+                        name="bibfs-churn-force-swap", daemon=True,
+                    )
+                    forcer.start()
+                delay = t0 + i / rate_qps - time.perf_counter()
+                if delay > 0:
+                    time.sleep(delay)
+                tickets.append(engine.submit(int(s), int(d), g))
+            ep_drained = drain_bounded()
+            drained = drained and ep_drained
+            if forcer is not None:
+                forcer.join(timeout=60.0)
+
+            ep_lost = ep_failed = ep_bad = 0
+            wait_s = 60.0 if ep_drained else 2.0
+            for (s, d), g, t in zip(pairs, graphs, tickets):
+                s, d = int(s), int(d)
+                try:
+                    res = t.wait(timeout=wait_s)
+                except TimeoutError:
+                    lost.append((s, d, g))
+                    ep_lost += 1
+                    wait_s = 2.0
+                    continue
+                except Exception as e:
+                    failed.append(
+                        {"query": [s, d], "graph": g,
+                         "kind": getattr(e, "kind", "?"),
+                         "error": str(e)[:200]}
+                    )
+                    ep_failed += 1
+                    continue
+                if t.t_done is not None:
+                    max_lat_s = max(max_lat_s, t.t_done - t.t_submit)
+                ref = oracle[(s, d, g)]
+                if res.found != ref.found or (
+                    ref.found and res.hops != ref.hops
+                ):
+                    mismatches.append(
+                        f"epoch {epoch} {g} {s}->{d}: "
+                        f"{res.hops} != {ref.hops}"
+                    )
+                    ep_bad += 1
+            versions_seen.add(store.current("main").version)
+            st = store.stats()["graphs"]["main"]
+            epochs_out.append({
+                "epoch": epoch,
+                "adds": len(adds),
+                "dels": len(dels),
+                "compacting_at_apply": out["compacting"],
+                "forced_swap": epoch % 2 == 1,
+                "version": st["version"],
+                "swaps_total": st["swaps"],
+                "delta_pending": st["delta_edges"],
+                "lost": ep_lost,
+                "failed": ep_failed,
+                "mismatched": ep_bad,
+                "edges": int(epoch_edges.shape[0]),
+            })
+
+        # the final claim, stated on the FINAL graph: fold anything
+        # still pending, then verify a fresh batch end-to-end against
+        # the post-all-updates oracle
+        store.compact("main")
+        final_edges = np.array(sorted(live), dtype=np.int64)
+        final_csr = build_csr(n, final_edges)
+        final_pairs = sample_query_pairs(n, max_batch, seed=seed + 555)
+        final_results = engine.query_many(
+            [(int(s), int(d)) for s, d in final_pairs], graph="main"
+        )
+        final_bad = []
+        for (s, d), res in zip(final_pairs, final_results):
+            s, d = int(s), int(d)
+            ref = solve_serial_csr(n, *final_csr, s, d)
+            if res.found != ref.found or (
+                ref.found and res.hops != ref.hops
+            ):
+                final_bad.append(f"{s}->{d}: {res.hops} != {ref.hops}")
+            elif ref.found and res.path is not None and not _validate(
+                final_csr, res, s, d
+            ):
+                final_bad.append(f"{s}->{d}: path failed validation")
+
+        stats = engine.stats()
+        store_stats = store.stats()
+        ex = exec_cache.stats()
+        stranded = stats["pipeline"]["outstanding"]
+        recompiles = ex["programs"] - baseline["programs"]
+        swaps_total = store_stats["graphs"]["main"]["swaps"]
+        out = {
+            "n": int(n),
+            "epochs": epochs,
+            "queries_per_epoch": queries_per_epoch,
+            "updates_per_epoch": updates_per_epoch,
+            "twin_fraction": twin_fraction,
+            "rate_qps": rate_qps,
+            "stall_bound_ms": stall_bound_ms,
+            "tickets": {
+                "submitted": epochs * queries_per_epoch,
+                "failed": len(failed),
+                "lost": len(lost),
+                "stranded_outstanding": stranded,
+            },
+            "failed_sample": failed[:10],
+            "mismatches": mismatches[:10],
+            "final_graph": {
+                "edges": int(final_edges.shape[0]),
+                "version": store_stats["graphs"]["main"]["version"],
+                "digest": store_stats["graphs"]["main"]["digest"],
+                "verify_queries": int(final_pairs.shape[0]),
+                "mismatches": final_bad[:10],
+            },
+            "store": {
+                "swaps": swaps_total,
+                "compactions":
+                    store_stats["graphs"]["main"]["compactions"],
+                "versions_seen": sorted(versions_seen),
+                "delta_pending":
+                    store_stats["graphs"]["main"]["delta_edges"],
+            },
+            "exec": {
+                "programs_baseline": baseline["programs"],
+                "programs_end": ex["programs"],
+                "recompiles_during_churn": recompiles,
+                "hits": ex["hits"],
+                "misses": ex["misses"],
+                "cross_graph_reuse": cross_graph_reuse,
+            },
+            "engine": {
+                "device_batches": stats["device_batches"],
+                "host_queries": stats["host_queries"],
+                "overlay_queries": stats["overlay_queries"],
+                "cache_served": stats["cache_served"],
+                "latency_ms": stats["latency_ms"],
+            },
+            "max_latency_ms": round(max_lat_s * 1e3, 3),
+            "epochs_detail": epochs_out,
+            "setup_to_drain_s": round(
+                time.perf_counter() - t_setup, 3
+            ),
+            # the gates
+            "zero_lost": not lost and stranded == 0 and drained,
+            # unlike the chaos soak, this run injects NO faults: a
+            # structured QueryError is a real regression, not an
+            # expected casualty — failed tickets gate too (they skip
+            # oracle verification, so verified_vs_oracle alone would
+            # pass a run that errored a third of its traffic)
+            "zero_failed": not failed,
+            "verified_vs_oracle": not mismatches and not final_bad,
+            "swap_stall_ok": max_lat_s * 1e3 <= stall_bound_ms,
+            "zero_recompiles": recompiles == 0 and cross_graph_reuse,
+            "routes_exercised": (
+                stats["overlay_queries"] > 0
+                and stats["device_batches"] > 0
+            ),
+            "swaps_ok": swaps_total >= max(1, epochs // 2),
+        }
+        out["ok"] = bool(
+            out["zero_lost"] and out["zero_failed"]
+            and out["verified_vs_oracle"]
+            and out["swap_stall_ok"] and out["zero_recompiles"]
+            and out["routes_exercised"] and out["swaps_ok"]
+        )
+        return out
+    finally:
+        engine.close()
+        store.close()
 
 
 def _validate(csr, res, s, d) -> bool:

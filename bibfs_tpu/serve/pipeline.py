@@ -183,8 +183,9 @@ class QueryTicket(_Pending):
 
     __slots__ = ("t_submit", "t_done", "_engine")
 
-    def __init__(self, src: int, dst: int, engine=None):
-        super().__init__(src, dst)
+    def __init__(self, src: int, dst: int, engine=None,
+                 graph: str | None = None):
+        super().__init__(src, dst, graph)
         self.t_submit = time.perf_counter()
         self.t_done: float | None = None
         self._engine = engine
@@ -283,7 +284,7 @@ class PipelinedQueryEngine(QueryEngine):
 
     def __init__(
         self,
-        n: int,
+        n: int | None = None,
         edges=None,
         *,
         max_wait_ms: float | None = 5.0,
@@ -291,17 +292,20 @@ class PipelinedQueryEngine(QueryEngine):
         max_queue: int | None = None,
         **kwargs,
     ):
+        # own-argument validation BEFORE super(): the base ctor of a
+        # store-backed engine acquires a snapshot pin that a raise here
+        # would leak
+        if max_inflight < 1:
+            raise ValueError(f"max_inflight must be >= 1, got {max_inflight}")
+        if max_queue is not None and max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1, got {max_queue}")
         super().__init__(n, edges, **kwargs)
         self.max_wait_ms = max_wait_ms
         self._wait_s = (
             None if max_wait_ms is None else max(float(max_wait_ms), 0.0) / 1e3
         )
-        if max_inflight < 1:
-            raise ValueError(f"max_inflight must be >= 1, got {max_inflight}")
         if max_queue is None:
             max_queue = max(self.max_batch, 4 * self.flush_threshold)
-        elif max_queue < 1:
-            raise ValueError(f"max_queue must be >= 1, got {max_queue}")
         self.max_queue = int(max_queue)
         self._lock = threading.RLock()
         self._cv = threading.Condition(self._lock)
@@ -358,15 +362,18 @@ class PipelinedQueryEngine(QueryEngine):
         self._flusher.start()
 
     # ---- submission --------------------------------------------------
-    def submit(self, src: int, dst: int) -> QueryTicket:
-        """Queue one query WITHOUT blocking on any solve. Trivial
-        queries and cache hits resolve before returning; everything else
+    def submit(self, src: int, dst: int, graph: str | None = None
+               ) -> QueryTicket:
+        """Queue one query WITHOUT blocking on any solve (``graph``
+        names a store graph on a store-backed engine). Trivial queries
+        and cache hits resolve before returning; everything else
         resolves when the background flusher's batch lands (depth,
         deadline, or drain — whichever comes first)."""
         src, dst = int(src), int(dst)
-        if not (0 <= src < self.n and 0 <= dst < self.n):
-            raise ValueError(f"src/dst out of range for n={self.n}")
-        t = QueryTicket(src, dst, self)
+        name, rt = self._resolve_graph(graph)
+        if not (0 <= src < rt.n and 0 <= dst < rt.n):
+            raise ValueError(f"src/dst out of range for n={rt.n}")
+        t = QueryTicket(src, dst, self, name)
         if src == dst:
             with self._lock:
                 if self._closed:
@@ -376,14 +383,19 @@ class PipelinedQueryEngine(QueryEngine):
             self._finish_ticket(t, BFSResult(True, 0, [src], src, 0.0, 0, 0))
             self.latency.record(t.t_done - t.t_submit)
             return t
-        if not self._queue:
+        if not self._queue and self._overlay_pending(name) is None:
             # idle fast path: a cache hit answers inline with ~0 latency.
             # Under load the lookup moves to the flusher (_serve_cached,
             # one pass per batch) — at 10k+ qps a per-submit cache-lock
             # handoff between the producer and the resolving stages is a
             # GIL convoy, and the flush-time lookup even sees results
-            # that land AFTER submission
-            hit = self.dist_cache.lookup(self.graph_id, src, dst)
+            # that land AFTER submission. (A graph with pending live
+            # updates skips the cache outright: its entries describe the
+            # base snapshot, not the overlaid graph.) Re-resolve the
+            # runtime AFTER the overlay read — overlay-read-then-resolve
+            # is the swap-race-safe ordering (see the sync submit).
+            rt = self._graph_rt(name)
+            hit = self.dist_cache.lookup(rt.graph_id, src, dst)
             if hit is not None:
                 found, hops, path = hit
                 with self._lock:
@@ -430,19 +442,21 @@ class PipelinedQueryEngine(QueryEngine):
                 self._cv.notify_all()
         return t
 
-    def query(self, src: int, dst: int) -> BFSResult:
+    def query(self, src: int, dst: int, graph: str | None = None
+              ) -> BFSResult:
         """Submit one query and block for its result (the deadline — or
         queue depth — decides when it actually flushes)."""
-        return self.submit(src, dst).wait()
+        return self.submit(src, dst, graph).wait()
 
-    def query_many(self, pairs, *, return_errors: bool = False) -> list:
+    def query_many(self, pairs, *, graph: str | None = None,
+                   return_errors: bool = False) -> list:
         """Submit a whole query list, drain, and return the results.
 
         ``return_errors=True`` is the partial-failure mode (same
         contract as the synchronous engine's): per-pair
         ``BFSResult | QueryError`` instead of raising on the first
         failed ticket."""
-        tickets = self._submit_collect(pairs, return_errors)
+        tickets = self._submit_collect(pairs, return_errors, graph)
         if not tickets:
             return []
         if any(isinstance(t, QueryTicket) for t in tickets):
@@ -521,6 +535,7 @@ class PipelinedQueryEngine(QueryEngine):
                 self._outstanding -= len(leftovers)
                 self._g_queue_depth.set(0)
                 self._cv.notify_all()
+            self._release_runtimes()
 
     # ---- the background flusher --------------------------------------
     def _flush_reason_locked(self):
@@ -580,6 +595,27 @@ class PipelinedQueryEngine(QueryEngine):
                 self._fail_batch(batch, e)
 
     def _launch(self, batch: list[QueryTicket]) -> None:
+        if self._store is None:
+            self._launch_group(None, batch)
+            return
+        # one popped batch can interleave graphs: group per graph, each
+        # group bound to the snapshot it resolves NOW (in-flight groups
+        # keep their pin across a concurrent hot-swap). Failure is
+        # isolated per group: a raise from group k must fail ONLY group
+        # k's tickets — letting it reach _flusher_main's _fail_batch
+        # would also fail (and double-decrement) tickets of earlier
+        # groups already handed to the finish worker.
+        groups: "OrderedDict[str, list[QueryTicket]]" = OrderedDict()
+        for t in batch:
+            groups.setdefault(t.graph, []).append(t)
+        for name, group in groups.items():
+            try:
+                self._launch_group(name, group)
+            except Exception as e:
+                self._record_error(e)
+                self._fail_batch(group, e)
+
+    def _launch_group(self, name, batch: list[QueryTicket]) -> None:
         # dedupe exact repeats within one batch: serving traffic
         # repeats, and a batch slot per duplicate would be pure waste
         unique: "OrderedDict[tuple[int, int], list[QueryTicket]]" = (
@@ -587,18 +623,62 @@ class PipelinedQueryEngine(QueryEngine):
         )
         for t in batch:
             unique.setdefault((t.src, t.dst), []).append(t)
-        pairs = self._serve_cached(unique)
-        if not pairs:
-            return
-        if len(pairs) >= self.flush_threshold and self._use_device():
-            # the breaker gates the device route: open = the route is
-            # known-bad, go straight to the host ladder (half-open lets
-            # one probe batch through; its outcome closes or re-opens)
-            if self._breaker.allow():
-                self._launch_device(pairs, unique)
+        # overlay BEFORE pin — same swap-race ordering as the sync
+        # engine's _flush_graph (see the comment there)
+        overlay = self._overlay_pending(name)
+        rt = self._pin_rt(name)
+        with self._bound(rt):
+            if overlay is not None:
+                self._launch_overlay(overlay, unique)
                 return
-            self._note_fallback("device", "host")
-        self._launch_host(pairs, unique)
+            pairs = self._serve_cached(unique)
+            if not pairs:
+                return
+            if len(pairs) >= self.flush_threshold and self._use_device():
+                # the breaker gates the device route: open = the route is
+                # known-bad, go straight to the host ladder (half-open
+                # lets one probe batch through; its outcome closes or
+                # re-opens)
+                if self._breaker.allow():
+                    self._launch_device(rt, pairs, unique)
+                    return
+                self._note_fallback("device", "host")
+            self._launch_host(rt, pairs, unique)
+
+    def _launch_overlay(self, overlay, unique) -> None:
+        """Exact answering while the graph has pending live updates,
+        pipelined edition: base+delta host solves run right here on the
+        flusher (the route is host-bound anyway) and tickets resolve
+        inline — no cache banking, the overlaid graph is not any
+        snapshot (see the sync engine's ``_flush_overlay``)."""
+        t_launch = time.perf_counter()
+        self.stages.enter()
+        try:
+            with span("overlay_batch", batch=len(unique)):
+                corr = overlay.correction()  # one capture per batch
+                lats = []
+                served = 0
+                for key, tickets in unique.items():
+                    try:
+                        res = overlay.solve(*key, correction=corr)
+                    except Exception as exc:
+                        err = to_query_error(exc, key)
+                        for t in tickets:
+                            if not t.done():
+                                self._fail_ticket(t, err)
+                        continue
+                    served += 1
+                    for t in tickets:
+                        if self._finish_ticket(t, res):
+                            lats.append(t.t_done - t.t_submit)
+                self.latency.record_many(lats)
+                with self._lock:
+                    self._c_overlay.inc(served)
+        finally:
+            self.stages.exit()
+            self._note_batch_done(
+                t_launch, sum(len(ts) for ts in unique.values())
+            )
 
     def _serve_cached(self, unique) -> list[tuple[int, int]]:
         """One cache pass over the deduped batch (submit skips the
@@ -630,14 +710,17 @@ class PipelinedQueryEngine(QueryEngine):
         return pairs
 
     # -- device route: dispatch on the flusher, finish on the worker --
-    def _launch_device(self, pairs, unique) -> None:
+    def _launch_device(self, rt, pairs, unique) -> None:
         """Resilient device dispatch: bounded retries with backoff on
         the flusher (the breaker already admitted this batch); when the
         launch seam stays dead, release the in-flight slot and degrade
         the batch to the host ladder instead of failing its tickets.
         The breaker's success is recorded at FINISH time (a dispatch
         that enqueues but cannot execute must not close a half-open
-        breaker)."""
+        breaker). ``rt`` rides along to the finish worker with its own
+        snapshot pin — the finish of batch k must decode and bank on
+        the snapshot it launched on, even if the store swaps before the
+        worker gets to it."""
         self._inflight.acquire()  # double-buffer backpressure
         # "one batch time" (batch_service_max_ms) is measured from AFTER
         # the in-flight window opens: including the acquire wait would
@@ -645,6 +728,7 @@ class PipelinedQueryEngine(QueryEngine):
         t_launch = time.perf_counter()
         attempt = 0
         held = True  # our in-flight slot, until handed to the finish job
+        job_pin = False  # the finish job's snapshot pin, once taken
         try:
             while True:
                 try:
@@ -670,11 +754,13 @@ class PipelinedQueryEngine(QueryEngine):
                     held = False
                     self._inflight.release()
                     self._note_fallback("device", "host")
-                    self._launch_host(pairs, unique)
+                    self._launch_host(rt, pairs, unique)
                     return
+            rt.snapshot.retain()
+            job_pin = True
             self._finish_pool.submit(
-                self._device_finish_job, out, finish, t0, pairs, unique,
-                t_launch,
+                self._device_finish_job, rt, out, finish, t0, pairs,
+                unique, t_launch,
             )
         except BaseException:
             # an escape outside the retry loop (KeyboardInterrupt, a
@@ -686,41 +772,46 @@ class PipelinedQueryEngine(QueryEngine):
             # after a counted one is harmless) or allow() returns
             # False forever and the device route never recovers
             self._breaker.record_failure()
+            if job_pin:
+                rt.snapshot.release()
             if held:
                 self._inflight.release()
             raise
 
-    def _device_finish_job(self, out, finish, t0, pairs, unique, t_launch):
+    def _device_finish_job(self, rt, out, finish, t0, pairs, unique,
+                           t_launch):
         self.stages.enter()
         try:
-            try:
-                # counters inside _device_finish are safe un-locked:
-                # this pool has exactly ONE worker, the only
-                # device-side mutator
-                results = self._device_finish(out, finish, t0, pairs)
-            except Exception as e:
-                # mid-execution device failure: the batch is already
-                # off the flusher, so recover it right here on the
-                # finish worker through the host ladder — tickets fail
-                # only if every rung fails them individually
-                self._breaker.record_failure()
-                self._record_error(e)
-                self._note_fallback("device", "host")
-                with span("recover_host", batch=len(pairs)):
-                    self._deliver_host(
-                        pairs, unique, self._solve_host_isolated(pairs)
+            with self._bound(rt):  # decode/bank on the LAUNCH snapshot
+                try:
+                    # counters inside _device_finish are safe un-locked:
+                    # this pool has exactly ONE worker, the only
+                    # device-side mutator
+                    results = self._device_finish(out, finish, t0, pairs)
+                except Exception as e:
+                    # mid-execution device failure: the batch is already
+                    # off the flusher, so recover it right here on the
+                    # finish worker through the host ladder — tickets
+                    # fail only if every rung fails them individually
+                    self._breaker.record_failure()
+                    self._record_error(e)
+                    self._note_fallback("device", "host")
+                    with span("recover_host", batch=len(pairs)):
+                        self._deliver_host(
+                            pairs, unique, self._solve_host_isolated(pairs)
+                        )
+                    return
+                self._breaker.record_success()
+                lats = []
+                for (src, dst), res in zip(pairs, results):
+                    self.dist_cache.put_result(
+                        self.graph_id, src, dst, res.found, res.hops,
+                        res.path,
                     )
-                return
-            self._breaker.record_success()
-            lats = []
-            for (src, dst), res in zip(pairs, results):
-                self.dist_cache.put_result(
-                    self.graph_id, src, dst, res.found, res.hops, res.path
-                )
-                for t in unique[(src, dst)]:
-                    if self._finish_ticket(t, res):
-                        lats.append(t.t_done - t.t_submit)
-            self.latency.record_many(lats)
+                    for t in unique[(src, dst)]:
+                        if self._finish_ticket(t, res):
+                            lats.append(t.t_done - t.t_submit)
+                self.latency.record_many(lats)
         except Exception as e:
             self._record_error(e)
             for key in pairs:
@@ -735,7 +826,7 @@ class PipelinedQueryEngine(QueryEngine):
             )
 
     # -- host route: solve on the flusher, resolve on the worker -------
-    def _launch_host(self, pairs, unique) -> None:
+    def _launch_host(self, rt, pairs, unique) -> None:
         """Host SOLVE stage, run right here on the flusher: on the
         native route this is one GIL-free threaded-C call for the whole
         batch (``_solve_host`` — the C batch parallelizes internally, so
@@ -748,23 +839,30 @@ class PipelinedQueryEngine(QueryEngine):
         dispatch/finish split."""
         self._inflight.acquire()
         t_launch = time.perf_counter()  # post-acquire; see _launch_device
+        job_pin = False
         try:
             self.stages.enter()
             try:
                 results = self._solve_host_isolated(pairs)
             finally:
                 self.stages.exit()
+            rt.snapshot.retain()  # the resolve job banks on THIS snapshot
+            job_pin = True
             self._finish_pool.submit(
-                self._host_resolve_job, pairs, unique, t_launch, results
+                self._host_resolve_job, rt, pairs, unique, t_launch,
+                results,
             )
         except BaseException:
+            if job_pin:
+                rt.snapshot.release()
             self._inflight.release()  # never leak the in-flight slot
             raise
 
-    def _host_resolve_job(self, pairs, unique, t_launch, results) -> None:
+    def _host_resolve_job(self, rt, pairs, unique, t_launch,
+                          results) -> None:
         self.stages.enter()
         try:
-            with span("host_resolve", batch=len(pairs)):
+            with self._bound(rt), span("host_resolve", batch=len(pairs)):
                 try:
                     self._deliver_host(pairs, unique, results)
                 except Exception as e:

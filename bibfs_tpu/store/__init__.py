@@ -1,0 +1,19 @@
+"""Versioned graph store: immutable snapshots, live edge updates, and
+atomic multi-graph hot-swap.
+
+The serving-stack analog of model hot-swap in an inference stack: a
+:class:`GraphStore` names graphs and versions them as content-addressed
+:class:`GraphSnapshot` s; a :class:`DeltaOverlay` holds batched edge
+inserts/deletes with exact overlay-corrected query answering until a
+background compaction folds them into a fresh snapshot; the engines
+(``bibfs_tpu/serve``) resolve names to snapshots per flush and finish
+in-flight batches on the version they started on.
+"""
+
+from bibfs_tpu.store.delta import DeltaOverlay  # noqa: F401
+from bibfs_tpu.store.registry import GraphStore  # noqa: F401
+from bibfs_tpu.store.snapshot import (  # noqa: F401
+    GraphSnapshot,
+    content_digest,
+    next_version,
+)

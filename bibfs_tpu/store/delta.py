@@ -1,0 +1,274 @@
+"""Batched live edge updates on top of an immutable snapshot.
+
+Production graphs change, but the serving stack's compiled programs and
+padded device tables are built for ONE immutable shape — rebuilding them
+per edge insert would turn every update into a multi-second stall. A
+:class:`DeltaOverlay` splits the difference the way LSM stores do:
+
+- **the base stays immutable** — the :class:`GraphSnapshot` (and every
+  device table built from it) is untouched; updates accumulate as two
+  small canonical edge sets (``adds``/``dels``);
+- **queries stay exact** — while a delta is pending, queries against the
+  graph run :meth:`solve`: a host-side level-synchronous BFS over the
+  base CSR *corrected by the overlay* (added neighbors appended,
+  deleted edges skipped). For the small deltas the overlay is meant to
+  hold, that is a few extra set probes per scanned edge — far cheaper
+  than a rebuild, and bit-exact against a from-scratch solve on the
+  updated graph (the churn harness gates on it);
+- **compaction is off the hot path** — once ``delta_edges`` crosses the
+  store's threshold, :meth:`snapshot` materializes the merged edge list
+  into a fresh :class:`GraphSnapshot` (new digest, next version) on a
+  background thread, and the store hot-swaps it in. An overlay handed
+  to a reader is never mutated afterwards — updates that raced the
+  compaction are REBASED by the store into a fresh overlay over the
+  new snapshot, so nothing is lost and mid-flight solves stay exact.
+
+Updates are edge-only by design: the vertex set (and therefore ``n``,
+the padded table shapes, and the compiled-program bucket) is fixed at
+snapshot creation, which is what makes a same-bucket hot-swap cost zero
+recompiles.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+from bibfs_tpu.store.snapshot import GraphSnapshot
+
+
+def canonical_edge(n: int, u, v) -> tuple[int, int]:
+    """Validate one undirected edge against the vertex range and return
+    it in canonical ``(min, max)`` orientation."""
+    u, v = int(u), int(v)
+    if not (0 <= u < n and 0 <= v < n):
+        raise ValueError(f"edge endpoint out of range for n={n}: ({u}, {v})")
+    if u == v:
+        raise ValueError(f"self-loop ({u}, {u}) is not a valid edge")
+    return (u, v) if u < v else (v, u)
+
+
+class DeltaOverlay:
+    """Pending edge inserts/deletes over one base snapshot (module
+    docstring). Thread-safe: the store mutates it under update/swap
+    calls while engine flushes read it for exact query answering."""
+
+    def __init__(self, base: GraphSnapshot):
+        self.base = base
+        self._lock = threading.Lock()
+        self._adds: set[tuple[int, int]] = set()
+        self._dels: set[tuple[int, int]] = set()
+        self._base_edges: set | None = None  # lazy membership index
+        self._base_csr = None  # own handle: survives base retirement
+
+    # ---- mutation ----------------------------------------------------
+    def _base_has(self, e: tuple[int, int]) -> bool:
+        if self._base_edges is None:
+            self._base_edges = set(
+                map(tuple, self.base.undirected_edges().tolist())
+            )
+        return e in self._base_edges
+
+    def ensure_index(self) -> None:
+        """Pre-build the O(E) base-edge membership index. The store
+        calls this OUTSIDE its global lock before the first
+        ``apply``/``rebase`` needs it — a Python pass over every base
+        edge under the store lock would stall every serving thread
+        resolving names through the store."""
+        with self._lock:
+            self._base_has((0, 0))
+
+    def apply(self, adds=(), dels=()) -> dict:
+        """Apply one batch of undirected edge updates. An add of an
+        edge the (overlaid) graph already has, or a delete of one it
+        does not, is rejected — silent no-ops would let a typo'd update
+        pass unnoticed. An add cancels a pending delete of the same
+        edge (and vice versa). The batch is atomic: staged on copies
+        and committed only once every edge validates, so a rejected
+        batch leaves the overlay exactly as it was (no half-applied
+        updates leaking into the next compaction). Returns the
+        overlay's post-batch counts."""
+        n = self.base.n
+        with self._lock:
+            stage_a, stage_d = set(self._adds), set(self._dels)
+            for u, v in adds:
+                e = canonical_edge(n, u, v)
+                if e in stage_d:
+                    stage_d.discard(e)
+                elif self._base_has(e) or e in stage_a:
+                    raise ValueError(f"edge {e} already present")
+                else:
+                    stage_a.add(e)
+            for u, v in dels:
+                e = canonical_edge(n, u, v)
+                if e in stage_a:
+                    stage_a.discard(e)
+                elif not self._base_has(e) or e in stage_d:
+                    raise ValueError(f"edge {e} not present")
+                else:
+                    stage_d.add(e)
+            self._adds, self._dels = stage_a, stage_d
+            return {"adds": len(stage_a), "dels": len(stage_d)}
+
+    def capture(self) -> tuple[set, set]:
+        """A consistent copy of the pending sets (what a compaction
+        will fold in)."""
+        with self._lock:
+            return set(self._adds), set(self._dels)
+
+    def rebase(self, adds: set, dels: set) -> tuple[set, set]:
+        """The overlay to carry onto the snapshot built from the
+        captured ``(adds, dels)``: ``(a2, d2)`` such that
+        ``new + a2 - d2`` equals the overlay's LIVE graph right now.
+
+        Not plain set subtraction: an update that lands during the
+        build can CANCEL a captured pending edge (a delete of a
+        captured pending add empties ``_adds`` without recording a
+        delete), so the carried sets must be computed as the edge-wise
+        difference between the live graph ``L = base + a_live - d_live``
+        and the new snapshot ``N = base + adds - dels`` — only edges in
+        one of the four sets can differ."""
+        with self._lock:
+            a_live, d_live = set(self._adds), set(self._dels)
+            a2, d2 = set(), set()
+            for e in a_live | d_live | adds | dels:
+                in_live = (e in a_live
+                           or (self._base_has(e) and e not in d_live))
+                in_new = (e in adds
+                          or (self._base_has(e) and e not in dels))
+                if in_live and not in_new:
+                    a2.add(e)
+                elif in_new and not in_live:
+                    d2.add(e)
+            return a2, d2
+
+    @property
+    def delta_edges(self) -> int:
+        with self._lock:
+            return len(self._adds) + len(self._dels)
+
+    # ---- exact query answering ---------------------------------------
+    def correction(self) -> tuple[set, dict]:
+        """A consistent ``(dels, add_adj)`` correction for
+        :meth:`solve` — capture it ONCE per flush batch and pass it to
+        every solve in the batch: the copy + adjacency build is
+        O(delta) under the overlay lock, pure waste repeated per query
+        (and the shared capture makes the whole batch answer one
+        consistent delta state)."""
+        with self._lock:
+            dels = set(self._dels)
+            add_adj: dict[int, list[int]] = {}
+            for u, v in self._adds:
+                add_adj.setdefault(u, []).append(v)
+                add_adj.setdefault(v, []).append(u)
+        return dels, add_adj
+
+    def solve(self, src: int, dst: int, correction=None):
+        """Exact shortest path on base+delta: level-synchronous BFS over
+        the base CSR with overlay correction (module docstring). Returns
+        a :class:`~bibfs_tpu.solvers.api.BFSResult`; never touches the
+        device stack. ``correction`` is an optional pre-captured
+        :meth:`correction` (per-batch amortization)."""
+        from bibfs_tpu.solvers.api import BFSResult
+
+        src, dst = int(src), int(dst)
+        n = self.base.n
+        if not (0 <= src < n and 0 <= dst < n):
+            raise ValueError(f"src/dst out of range for n={n}")
+        t0 = time.perf_counter()
+        if src == dst:
+            return BFSResult(True, 0, [src], src, 0.0, 0, 0)
+        if self._base_csr is None:
+            # hold our own handle: a swap can retire the base while a
+            # captured overlay still answers a batch on it, and a
+            # retired snapshot's csr() builds UNCACHED — without this,
+            # every solve in that batch would rebuild the full CSR
+            self._base_csr = self.base.csr()
+        row_ptr, col_ind = self._base_csr
+        dels, add_adj = (
+            self.correction() if correction is None else correction
+        )
+        parent = np.full(n, -1, dtype=np.int64)
+        parent[src] = src
+        frontier = [src]
+        levels = 0
+        edges_scanned = 0
+        found = False
+        while frontier and not found:
+            levels += 1
+            nxt = []
+            for u in frontier:
+                base_nbrs = col_ind[row_ptr[u]: row_ptr[u + 1]]
+                extra = add_adj.get(u)
+                for v in (
+                    base_nbrs if extra is None
+                    else list(base_nbrs) + extra
+                ):
+                    v = int(v)
+                    edges_scanned += 1
+                    if dels and (
+                        (u, v) if u < v else (v, u)
+                    ) in dels:
+                        continue
+                    if parent[v] >= 0:
+                        continue
+                    parent[v] = u
+                    if v == dst:
+                        found = True
+                        break
+                    nxt.append(v)
+                if found:
+                    break
+            frontier = nxt
+        if not found:
+            return BFSResult(
+                False, None, None, None,
+                time.perf_counter() - t0, levels, edges_scanned,
+            )
+        path = [dst]
+        while path[-1] != src:
+            path.append(int(parent[path[-1]]))
+        path.reverse()
+        return BFSResult(
+            True, len(path) - 1, path, None,
+            time.perf_counter() - t0, levels, edges_scanned,
+        )
+
+    # ---- compaction --------------------------------------------------
+    def merged_edges(self, adds: set | None = None,
+                     dels: set | None = None) -> np.ndarray:
+        """The undirected base+delta edge list (``u < v`` rows) for the
+        given captured sets (default: the live pending sets)."""
+        if adds is None or dels is None:
+            adds, dels = self.capture()
+        base = self.base.undirected_edges()
+        if dels:
+            # vectorized membership: encode (u, v) as u*n+v scalar keys
+            # — a Python loop over every base edge per compaction would
+            # dominate the rebuild at production edge counts
+            n = np.int64(self.base.n)
+            keys = base[:, 0] * n + base[:, 1]
+            darr = np.array(sorted(dels), dtype=np.int64)
+            base = base[~np.isin(keys, darr[:, 0] * n + darr[:, 1])]
+        if adds:
+            base = np.concatenate(
+                [base, np.array(sorted(adds), dtype=np.int64)], axis=0
+            )
+        return base
+
+    def snapshot(self) -> tuple[GraphSnapshot, set, set]:
+        """Materialize base+delta into a fresh snapshot (the compaction
+        build — run it OFF the serving path). Returns ``(snapshot,
+        adds, dels)`` where the sets are exactly what was folded in, for
+        :meth:`subtract` after the store swaps."""
+        adds, dels = self.capture()
+        snap = GraphSnapshot.build(
+            self.base.n, self.merged_edges(adds, dels)
+        )
+        return snap, adds, dels
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"adds": len(self._adds), "dels": len(self._dels)}

@@ -1,0 +1,390 @@
+"""Named multi-graph store with atomic hot-swap.
+
+One serving process, many graphs, each one live-updatable: the
+:class:`GraphStore` maps names to their current
+:class:`~bibfs_tpu.store.snapshot.GraphSnapshot` (plus a pending
+:class:`~bibfs_tpu.store.delta.DeltaOverlay` when edge updates have
+arrived since the last compaction). The engines resolve a name to a
+snapshot at flush time and pin it for the flush, so a swap is:
+
+1. build the replacement snapshot (compaction — background thread, or
+   any externally built snapshot handed to :meth:`swap`);
+2. under the store lock, point the name at the new snapshot — the swap
+   itself is a pointer flip plus metrics, so serving traffic never
+   waits on a rebuild;
+3. in-flight flushes finish on the OLD snapshot through their pins; the
+   old snapshot retires when the last pin drops
+   (refcount — ``snapshot.release``).
+
+Updates below the compaction threshold serve exactly through the
+overlay (``serve/engine`` routes those queries to
+:meth:`DeltaOverlay.solve`); once ``delta_edges`` reaches
+``compact_threshold`` the store kicks a background compaction that
+rebuilds the ELL into a fresh snapshot off the hot path and swaps it
+in. An overlay is never mutated once handed out: a compaction REBASES
+the updates that raced its build into a fresh overlay over the new
+snapshot, so a flush that grabbed the old overlay keeps answering the
+exact old-base+full-delta graph — which is, by construction, the same
+edge set the new snapshot + rebased overlay describes.
+
+Observability: ``bibfs_store_graphs`` (gauge), ``bibfs_store_swaps_total``
+/ ``bibfs_store_compactions_total`` / ``bibfs_store_compact_failures_total``
+(counters, per graph), ``bibfs_store_delta_edges`` (gauge, per graph) in
+the process registry, plus ``store_swap`` / ``store_compact`` trace
+spans.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+from bibfs_tpu.obs.metrics import REGISTRY, next_instance_label
+from bibfs_tpu.obs.trace import span
+from bibfs_tpu.store.delta import DeltaOverlay
+from bibfs_tpu.store.snapshot import GraphSnapshot
+
+
+class _Entry:
+    """One named graph's mutable slot: current snapshot, pending
+    overlay, and the compaction serializer (one compaction per graph at
+    a time — a forced REPL ``swap`` racing a threshold-triggered
+    background job must not double-build)."""
+
+    __slots__ = ("snapshot", "overlay", "compactor", "compact_lock",
+                 "swaps", "compactions", "compact_failures")
+
+    def __init__(self, snapshot: GraphSnapshot):
+        self.snapshot = snapshot
+        self.overlay: DeltaOverlay | None = None
+        self.compactor: threading.Thread | None = None
+        self.compact_lock = threading.Lock()
+        self.swaps = 0
+        self.compactions = 0
+        self.compact_failures = 0
+
+
+class GraphStore:
+    """Named, versioned, hot-swappable graphs (module docstring).
+
+    Parameters
+    ----------
+    compact_threshold : pending delta edges at which a background
+        compaction (rebuild + swap) is triggered. ``None`` disables
+        auto-compaction (explicit :meth:`compact` / :meth:`swap` only).
+    obs_label : the ``store=`` label value this store's registry cells
+        carry (default: a process-unique ``store-N``).
+    """
+
+    def __init__(self, *, compact_threshold: int | None = 256,
+                 obs_label: str | None = None):
+        self.compact_threshold = (
+            None if compact_threshold is None else int(compact_threshold)
+        )
+        if self.compact_threshold is not None and self.compact_threshold < 1:
+            raise ValueError(
+                f"compact_threshold must be >= 1, got {compact_threshold}"
+            )
+        self.obs_label = (
+            next_instance_label("store") if obs_label is None else obs_label
+        )
+        self._lock = threading.RLock()
+        self._entries: dict[str, _Entry] = {}
+        self._default: str | None = None
+        self._g_graphs = REGISTRY.gauge(
+            "bibfs_store_graphs", "Graphs registered in a graph store",
+            ("store",),
+        ).labels(store=self.obs_label)
+        self._c_swaps = REGISTRY.counter(
+            "bibfs_store_swaps_total",
+            "Atomic snapshot hot-swaps per graph",
+            ("store", "graph"),
+        )
+        self._g_delta = REGISTRY.gauge(
+            "bibfs_store_delta_edges",
+            "Pending overlay edge updates per graph",
+            ("store", "graph"),
+        )
+        self._c_compactions = REGISTRY.counter(
+            "bibfs_store_compactions_total",
+            "Delta compactions (overlay folded into a fresh snapshot)",
+            ("store", "graph"),
+        )
+        self._c_compact_failures = REGISTRY.counter(
+            "bibfs_store_compact_failures_total",
+            "Background compactions that raised (overlay keeps serving; "
+            "the next update re-triggers)",
+            ("store", "graph"),
+        )
+
+    # ---- registration -----------------------------------------------
+    def add(self, name: str, n: int | None = None, edges=None, *,
+            pairs=None, snapshot: GraphSnapshot | None = None
+            ) -> GraphSnapshot:
+        """Register a graph under ``name`` (its version-1 snapshot).
+        The first added graph becomes the default."""
+        name = str(name)
+        if snapshot is None:
+            if n is None:
+                raise ValueError("add() needs n+edges/pairs or snapshot=")
+            snapshot = GraphSnapshot.build(n, edges, pairs=pairs)
+        with self._lock:
+            if name in self._entries:
+                raise ValueError(
+                    f"graph {name!r} already registered (swap() replaces)"
+                )
+            # versions are store-relative: every registered graph starts
+            # at v1, compaction stamps old+1 — so `graphs` output and
+            # stats read as each graph's OWN history, not the order the
+            # process happened to build snapshots in. (The build-time
+            # global stamp remains the fallback for snapshots that never
+            # enter a store.)
+            snapshot.version = 1
+            self._entries[name] = _Entry(snapshot)
+            if self._default is None:
+                self._default = name
+            self._g_graphs.set(len(self._entries))
+            # mint the per-graph cells now so a scrape shows the graph
+            # at zero before its first update/swap
+            self._c_swaps.labels(store=self.obs_label, graph=name)
+            self._g_delta.labels(store=self.obs_label, graph=name).set(0)
+            self._c_compactions.labels(store=self.obs_label, graph=name)
+            self._c_compact_failures.labels(store=self.obs_label, graph=name)
+        return snapshot
+
+    @classmethod
+    def from_dir(cls, path, **kwargs) -> "GraphStore":
+        """A store over every ``*.bin`` graph in a directory, each
+        registered under its file stem (``social.bin`` -> ``social``),
+        sorted so the default graph is deterministic."""
+        from bibfs_tpu.graph.io import read_graph_bin
+
+        store = cls(**kwargs)
+        names = sorted(
+            f for f in os.listdir(path) if f.endswith(".bin")
+        )
+        if not names:
+            raise ValueError(f"no *.bin graphs in {path!r}")
+        for fname in names:
+            n, edges = read_graph_bin(os.path.join(path, fname))
+            store.add(os.path.splitext(fname)[0], n, edges)
+        return store
+
+    # ---- resolution --------------------------------------------------
+    def _entry(self, name: str) -> _Entry:
+        entry = self._entries.get(str(name))
+        if entry is None:
+            raise KeyError(
+                f"unknown graph {name!r} (have: {sorted(self._entries)})"
+            )
+        return entry
+
+    def names(self) -> list[str]:
+        with self._lock:
+            return sorted(self._entries)
+
+    def default_graph(self) -> str:
+        with self._lock:
+            if self._default is None:
+                raise ValueError("store has no graphs")
+            return self._default
+
+    def current(self, name: str) -> GraphSnapshot:
+        """The graph's current snapshot — an identity read (cheap
+        same-version check). Pin with :meth:`acquire` before USING one
+        across a swap window."""
+        with self._lock:
+            return self._entry(name).snapshot
+
+    def acquire(self, name: str) -> GraphSnapshot:
+        """The current snapshot, retained under the store lock — so a
+        concurrent swap cannot retire it between the read and the pin.
+        The caller owns one ``release()``."""
+        with self._lock:
+            return self._entry(name).snapshot.retain()
+
+    def overlay(self, name: str) -> DeltaOverlay | None:
+        """The graph's pending overlay, or None when it has no pending
+        updates — the engines' exact-answering route check."""
+        with self._lock:
+            ov = self._entry(name).overlay
+        if ov is not None and ov.delta_edges == 0:
+            return None
+        return ov
+
+    # ---- live updates ------------------------------------------------
+    def update(self, name: str, adds=(), dels=()) -> dict:
+        """Apply one batch of undirected edge updates to ``name``'s
+        overlay (creating it on first update). Crossing
+        ``compact_threshold`` kicks a background compaction. Returns
+        ``{"adds": ..., "dels": ..., "compacting": bool}``."""
+        name = str(name)
+        while True:
+            with self._lock:
+                entry = self._entry(name)
+                if entry.overlay is None:
+                    entry.overlay = DeltaOverlay(entry.snapshot)
+                overlay = entry.overlay
+            # the first apply against a base needs its O(E) membership
+            # index — build it OFF the store lock (every serving thread
+            # resolves names through that lock; a Python pass over
+            # every edge under it is a serving stall)
+            overlay.ensure_index()
+            with self._lock:
+                if self._entry(name).overlay is not overlay:
+                    # a swap/compaction replaced the overlay while the
+                    # index built: restart against the current state
+                    continue
+                counts = overlay.apply(adds, dels)
+                delta = counts["adds"] + counts["dels"]
+                self._g_delta.labels(
+                    store=self.obs_label, graph=name
+                ).set(delta)
+                compacting = entry.compactor is not None
+                if (not compacting and self.compact_threshold is not None
+                        and delta >= self.compact_threshold):
+                    entry.compactor = threading.Thread(
+                        target=self._compact_job, args=(name, entry),
+                        name=f"bibfs-compact-{name}", daemon=True,
+                    )
+                    entry.compactor.start()
+                    compacting = True
+            return {**counts, "compacting": compacting}
+
+    # ---- compaction + hot-swap ---------------------------------------
+    def _compact_job(self, name: str, entry: _Entry) -> None:
+        try:
+            self._compact_inline(name)
+        except Exception:
+            # the overlay keeps serving exactly and the next update
+            # re-triggers — but a persistently failing compaction means
+            # unbounded delta growth and every query on the host overlay
+            # route, so it must be VISIBLE, not swallowed: count it
+            # (scraped via /metrics and surfaced in stats()).
+            with self._lock:
+                entry.compact_failures += 1
+            self._c_compact_failures.labels(
+                store=self.obs_label, graph=name
+            ).inc()
+        finally:
+            with self._lock:
+                entry.compactor = None
+
+    def _compact_inline(self, name: str) -> GraphSnapshot:
+        """Build base+delta into a fresh snapshot OFF the store lock,
+        swap it in, and REBASE updates that raced the build into a
+        fresh overlay over the new snapshot. The old overlay object is
+        never mutated: flushes that captured it keep answering the
+        exact old-base+full-delta graph (the same edge set)."""
+        with self._lock:
+            entry = self._entry(name)
+        with entry.compact_lock:
+            with self._lock:
+                overlay = entry.overlay
+                if overlay is None or overlay.delta_edges == 0:
+                    return entry.snapshot  # nothing pending: no-op
+            with span("store_compact", graph=name,
+                      delta=overlay.delta_edges):
+                new, adds, dels = overlay.snapshot()  # the heavy build
+                # pre-warm the carried overlay's base index off-lock
+                # too: rebase residue applies under the store lock below
+                rebased = DeltaOverlay(new)
+                rebased.ensure_index()
+                with self._lock:
+                    if self._entry(name).overlay is not overlay:
+                        # an external swap() landed during the build and
+                        # discarded this overlay — its snapshot is the
+                        # caller's declared truth; committing ours would
+                        # silently overwrite it with stale
+                        # old-base+delta content. Abort: the folded
+                        # updates were discarded BY the swap, exactly as
+                        # swap()'s contract states.
+                        return entry.snapshot
+                    # store-relative stamp (see add())
+                    new.version = entry.snapshot.version + 1
+                    self._swap_locked(name, entry, new)
+                    # edge-wise live-vs-new diff, NOT set subtraction: a
+                    # racing update may have CANCELLED a captured
+                    # pending edge, which must become a real update
+                    # against the new snapshot (DeltaOverlay.rebase)
+                    a2, d2 = overlay.rebase(adds, dels)
+                    if a2 or d2:
+                        rebased.apply(sorted(a2), sorted(d2))
+                        entry.overlay = rebased
+                    else:
+                        entry.overlay = None
+                    self._g_delta.labels(
+                        store=self.obs_label, graph=name
+                    ).set(len(a2) + len(d2))
+                    entry.compactions += 1
+                    self._c_compactions.labels(
+                        store=self.obs_label, graph=name
+                    ).inc()
+            return new
+
+    def compact(self, name: str) -> GraphSnapshot:
+        """Force a synchronous compaction+swap NOW (the REPL ``swap``
+        command). Serialized against any in-flight background
+        compaction; folds whatever is pending when its turn comes."""
+        return self._compact_inline(str(name))
+
+    def swap(self, name: str, snapshot: GraphSnapshot) -> GraphSnapshot:
+        """Atomically point ``name`` at an externally built snapshot.
+        Returns the OLD snapshot (already released by the store; it
+        retires once in-flight flush pins drop). Any pending overlay is
+        discarded — the new snapshot is the caller's declared truth."""
+        name = str(name)
+        with self._lock:
+            entry = self._entry(name)
+            old = self._swap_locked(name, entry, snapshot)
+            entry.overlay = None
+            self._g_delta.labels(store=self.obs_label, graph=name).set(0)
+        return old
+
+    def _swap_locked(self, name: str, entry: _Entry,
+                     new: GraphSnapshot) -> GraphSnapshot:
+        old = entry.snapshot
+        if new.version <= old.version:
+            raise ValueError(
+                f"swap must move {name!r} forward: new version "
+                f"{new.version} <= current {old.version}"
+            )
+        with span("store_swap", graph=name, version=new.version,
+                  old_version=old.version):
+            entry.snapshot = new
+            entry.swaps += 1
+            self._c_swaps.labels(store=self.obs_label, graph=name).inc()
+            old.release()  # the store's reference; flush pins remain
+        return old
+
+    # ---- introspection ----------------------------------------------
+    def stats(self) -> dict:
+        with self._lock:
+            graphs = {}
+            for name, entry in self._entries.items():
+                graphs[name] = {
+                    **entry.snapshot.stats(),
+                    "delta_edges": (
+                        0 if entry.overlay is None
+                        else entry.overlay.delta_edges
+                    ),
+                    "swaps": entry.swaps,
+                    "compactions": entry.compactions,
+                    "compact_failures": entry.compact_failures,
+                    "compacting": entry.compactor is not None,
+                }
+            return {
+                "graphs": graphs,
+                "default": self._default,
+                "compact_threshold": self.compact_threshold,
+            }
+
+    def close(self) -> None:
+        """Join in-flight background compactions (test/shutdown aid)."""
+        with self._lock:
+            jobs = [
+                e.compactor for e in self._entries.values()
+                if e.compactor is not None
+            ]
+        for job in jobs:
+            job.join()

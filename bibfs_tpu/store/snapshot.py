@@ -1,0 +1,228 @@
+"""Immutable, versioned graph snapshots — the store's unit of truth.
+
+Until now every serving layer identified "the graph" by the Python
+object that happened to hold it: the engine's distance-cache namespace
+defaulted to ``id(self)`` (which CPython reuses after GC), and each
+engine rebuilt its own CSR/ELL tables from a raw edge list it could not
+prove anyone else shared. A :class:`GraphSnapshot` replaces that with
+content-addressed identity:
+
+- **digest** — a BLAKE2b hash over ``(n, canonical pairs)``. Two
+  snapshots with the same digest ARE the same graph, whatever path the
+  bytes took to arrive; a cache entry keyed by digest can never alias a
+  different graph, across engines, versions, or process lifetimes of
+  the id() counter.
+- **version** — a monotonic stamp. Digests answer "is this the same
+  content"; versions answer "which came first" — the store's hot-swap
+  invariant (a swap only ever moves a name FORWARD) is checked against
+  it. Builds stamp a process-wide counter; a :class:`GraphStore`
+  re-stamps store-relative history on registration/compaction (v1, v2,
+  ...) so each graph's version reads as its own lineage.
+- **memoized builds** — ``pairs``/``csr()``/``ell()``/``tiered()`` each
+  build once under a lock and are shared by every consumer of the
+  snapshot (engine runtimes, overlay solves, oracle checks), so a
+  hot-swap costs one canonicalization pass total, not one per layer.
+- **refcount retirement** — the store holds one reference; every
+  in-flight engine flush pins one more (``retain``/``release``). A
+  swapped-out snapshot is retired (retire hooks fire, memoized tables
+  become collectable) only when the last in-flight flush lands — the
+  swap barrier that lets old batches finish on the graph they started
+  on.
+
+The serving-layout build (``ell()``) imports ``serve.buckets`` lazily:
+the store layer sits beside ``serve``, not above it, and must be
+importable without dragging the engine stack in.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import itertools
+import threading
+
+import numpy as np
+
+# process-wide monotonic version stamps; also the fallback identity
+# counter for snapshots built without hashable content (never reused,
+# unlike id())
+_VERSIONS = itertools.count(1)
+_ANON = itertools.count()
+
+
+def next_version() -> int:
+    """The next process-wide monotonic snapshot version."""
+    return next(_VERSIONS)
+
+
+def content_digest(n: int, pairs: np.ndarray) -> str:
+    """BLAKE2b over ``(n, canonical pairs)`` — the content identity.
+
+    ``pairs`` must already be canonical (mirrored, deduped, sorted —
+    :func:`bibfs_tpu.graph.csr.canonical_pairs`), which makes the hash
+    insensitive to edge order, duplication, and orientation in whatever
+    list the graph arrived as."""
+    h = hashlib.blake2b(digest_size=16)
+    h.update(str(int(n)).encode())
+    h.update(b"|")
+    h.update(np.ascontiguousarray(pairs, dtype=np.int64).tobytes())
+    return h.hexdigest()
+
+
+class GraphSnapshot:
+    """One immutable version of one graph (module docstring).
+
+    Build with :meth:`build` (computes the canonical pairs and digest).
+    ``digest=None`` at direct construction falls back to a process-wide
+    monotonic ``anon-N`` label — still never reused, unlike ``id()``.
+    """
+
+    def __init__(self, n: int, pairs: np.ndarray, *, digest: str | None = None,
+                 version: int | None = None):
+        self.n = int(n)
+        self.pairs = pairs
+        self.digest = (
+            f"anon-{next(_ANON)}" if digest is None else str(digest)
+        )
+        self.version = next_version() if version is None else int(version)
+        self.num_edges = int(pairs.shape[0]) // 2
+        self._lock = threading.Lock()
+        self._refs = 1  # the creator's (usually the store's) reference
+        self._retired = False
+        self._retire_hooks: list = []
+        self._csr = None
+        self._ell = None  # serving-bucketed ELL
+        self._tiered = None
+
+    @classmethod
+    def build(cls, n: int, edges: np.ndarray | None = None, *,
+              pairs: np.ndarray | None = None,
+              version: int | None = None) -> "GraphSnapshot":
+        """Canonicalize ``edges`` (or adopt precomputed ``pairs``) and
+        stamp the content digest + a fresh monotonic version."""
+        from bibfs_tpu.graph.csr import canonical_pairs
+
+        if pairs is None:
+            pairs = canonical_pairs(n, edges)
+        return cls(n, pairs,
+                   digest=content_digest(n, pairs), version=version)
+
+    # ---- memoized builds --------------------------------------------
+    # Each getter reads the memo into a LOCAL before testing it: the
+    # fast path races release() nulling the field, and a bare
+    # `if self._x is None: ... return self._x` could pass the test yet
+    # return the concurrently-nulled None. A post-retire call (an
+    # overlay still answering on a swapped-out base) builds and returns
+    # WITHOUT re-caching — retirement freed the memory for good.
+    def csr(self):
+        """The ``(row_ptr, col_ind)`` CSR adjacency, built once."""
+        t = self._csr
+        if t is None:
+            from bibfs_tpu.graph.csr import build_csr
+
+            with self._lock:
+                t = self._csr
+                if t is None:
+                    t = build_csr(self.n, pairs=self.pairs)
+                    if not self._retired:
+                        self._csr = t
+        return t
+
+    def ell(self):
+        """The serving-bucketed ELL table
+        (:func:`bibfs_tpu.serve.buckets.bucketed_ell`), built once —
+        every engine runtime over this snapshot shares it."""
+        t = self._ell
+        if t is None:
+            from bibfs_tpu.serve.buckets import bucketed_ell
+
+            with self._lock:
+                t = self._ell
+                if t is None:
+                    t = bucketed_ell(self.n, pairs=self.pairs)
+                    if not self._retired:
+                        self._ell = t
+        return t
+
+    def tiered(self):
+        """The tiered-ELL layout (power-law graphs), built once."""
+        t = self._tiered
+        if t is None:
+            from bibfs_tpu.graph.csr import build_tiered
+
+            with self._lock:
+                t = self._tiered
+                if t is None:
+                    t = build_tiered(self.n, pairs=self.pairs)
+                    if not self._retired:
+                        self._tiered = t
+        return t
+
+    def undirected_edges(self) -> np.ndarray:
+        """The ``u < v`` half of the canonical pairs — what the native
+        host builder (which mirrors internally) and the delta-overlay
+        merge both consume."""
+        p = self.pairs
+        return p[p[:, 0] < p[:, 1]]
+
+    # ---- refcount retirement ----------------------------------------
+    def retain(self) -> "GraphSnapshot":
+        with self._lock:
+            if self._retired:
+                raise RuntimeError(
+                    f"snapshot {self.digest} v{self.version} already retired"
+                )
+            self._refs += 1
+        return self
+
+    def release(self) -> bool:
+        """Drop one reference; on the last one, retire: fire the hooks
+        and free the memoized tables. Returns True iff this call
+        retired the snapshot."""
+        with self._lock:
+            self._refs -= 1
+            if self._refs > 0 or self._retired:
+                return False
+            self._retired = True
+            hooks, self._retire_hooks = self._retire_hooks, []
+            # the canonical pairs stay (tiny relative to the tables, and
+            # stats()/digest re-derivation may still read them); the
+            # built adjacency tables are the memory owners
+            self._csr = self._ell = self._tiered = None
+        for hook in hooks:
+            try:
+                hook(self)
+            except Exception:
+                pass  # a broken hook must not break the releasing flush
+        return True
+
+    def on_retire(self, hook) -> None:
+        """Run ``hook(snapshot)`` when the refcount hits zero (fires
+        immediately if it already has)."""
+        with self._lock:
+            if not self._retired:
+                self._retire_hooks.append(hook)
+                return
+        hook(self)
+
+    @property
+    def refs(self) -> int:
+        with self._lock:
+            return self._refs
+
+    @property
+    def retired(self) -> bool:
+        with self._lock:
+            return self._retired
+
+    def stats(self) -> dict:
+        return {
+            "n": self.n,
+            "edges": self.num_edges,
+            "digest": self.digest,
+            "version": self.version,
+            "refs": self.refs,
+        }
+
+    def __repr__(self) -> str:
+        return (f"GraphSnapshot(n={self.n}, edges={self.num_edges}, "
+                f"digest={self.digest[:12]}, version={self.version})")

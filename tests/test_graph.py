@@ -39,6 +39,41 @@ def test_bin_truncated(tmp_path):
         read_graph_bin(p)
 
 
+def test_bin_rejects_negative_endpoint(tmp_path):
+    """A crafted .bin with an int32-negative endpoint (the word a buggy
+    signed-dtype generator writes for -2) must be rejected BY NAME: the
+    on-disk dtype is uint32, so the word used to surface as a huge
+    positive id — confusing below n=2^31 and, above it, passing the old
+    max() >= n check entirely and corrupting CSR builds downstream."""
+    p = tmp_path / "neg.bin"
+    word = (2**32 - 2).to_bytes(4, "little")  # -2 as int32
+    p.write_bytes(
+        (4).to_bytes(4, "little") + (1).to_bytes(4, "little")
+        + (1).to_bytes(4, "little") + word
+    )
+    with pytest.raises(ValueError, match="negative"):
+        read_graph_bin(p)
+    # even a vertex count big enough to admit the id as unsigned must
+    # not let it through — the reference readers would index with -2
+    p.write_bytes(
+        (2**32 - 1).to_bytes(4, "little") + (1).to_bytes(4, "little")
+        + (1).to_bytes(4, "little") + word
+    )
+    with pytest.raises(ValueError, match="negative"):
+        read_graph_bin(p)
+
+
+def test_bin_write_rejects_bad_endpoints(tmp_path):
+    """The writer side of the same hole: casting to the on-disk uint32
+    silently WRAPPED a negative endpoint into a huge valid-looking word.
+    Out-of-range endpoints (either sign) must refuse to serialize."""
+    p = tmp_path / "w.bin"
+    with pytest.raises(ValueError, match=r"\[0, 4\)"):
+        write_graph_bin(p, 4, np.array([[0, -1]]))
+    with pytest.raises(ValueError, match=r"\[0, 4\)"):
+        write_graph_bin(p, 4, np.array([[0, 4]]))
+
+
 def test_csr_symmetric():
     row_ptr, col_ind = build_csr(4, np.array([[0, 1], [1, 2], [0, 3]]))
     assert row_ptr.tolist() == [0, 2, 4, 5, 6]

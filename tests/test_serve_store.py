@@ -1,0 +1,406 @@
+"""Store-backed serving (bibfs_tpu/serve x bibfs_tpu/store): per-query
+graph routing, exact overlay answering under live edge updates, the
+hot-swap barrier at the flush seams, digest-namespaced distance caching
+(version-scoped invalidation, no cross-engine aliasing), and the
+same-bucket zero-recompile guarantee (ExecutableCache counters as the
+witness)."""
+
+import io
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from bibfs_tpu.serve import (
+    DistanceCache,
+    ExecutableCache,
+    GraphSnapshot,
+    GraphStore,
+    QueryEngine,
+)
+from bibfs_tpu.serve.pipeline import PipelinedQueryEngine
+from bibfs_tpu.solvers.serial import solve_serial
+
+
+def _skiplink_graph(n: int) -> np.ndarray:
+    """Chain + skip links (max degree 4): every size buckets to ELL
+    width 8, leaving headroom so degree-capped edge updates provably
+    keep the rebuilt snapshot in the same shape bucket."""
+    edges = [[i, i + 1] for i in range(n - 1)]
+    edges += [[i, i + 7] for i in range(n - 7)]
+    return np.array(edges)
+
+
+def _store_one(n, edges, name="g", threshold=None) -> GraphStore:
+    store = GraphStore(compact_threshold=threshold)
+    store.add(name, n, edges)
+    return store
+
+
+# ---- construction contract ------------------------------------------
+def test_engine_store_arg_validation():
+    n = 20
+    edges = _skiplink_graph(n)
+    store = _store_one(n, edges)
+    with pytest.raises(ValueError, match="not both"):
+        QueryEngine(n, edges, store=store)
+    with pytest.raises(ValueError, match="pass store="):
+        QueryEngine(n, edges, graph="g")
+    with pytest.raises(ValueError, match="required without store"):
+        QueryEngine()
+    with pytest.raises(ValueError, match="unknown graph"):
+        QueryEngine(store=store, graph="nope")
+    eng = QueryEngine(store=store)  # default graph: the store's
+    with pytest.raises(ValueError, match="unknown graph"):
+        eng.query(0, 1, graph="nope")
+    eng.close()
+
+
+def test_engine_ctor_failure_leaks_no_snapshot_pin():
+    """A ctor raise AFTER acquiring the store snapshot would leak the
+    pin: the snapshot could then never retire after a hot-swap, holding
+    its memoized tables for the process lifetime. Cheap-argument
+    validation must run first (both engine flavors)."""
+    n = 20
+    store = _store_one(n, _skiplink_graph(n))
+    snap = store.current("g")
+    for bad in (
+        lambda: QueryEngine(store=store, layout="bogus"),
+        lambda: QueryEngine(store=store, max_batch=0),
+        lambda: PipelinedQueryEngine(store=store, max_inflight=0),
+        lambda: PipelinedQueryEngine(store=store, max_queue=0),
+    ):
+        with pytest.raises(ValueError):
+            bad()
+    assert snap.refs == 1  # only the store's own reference remains
+    store.compact("g")  # no pending delta: no-op, snapshot unchanged
+    store.update("g", adds=[(0, 15)])
+    store.compact("g")
+    assert snap.retired  # the swap retired it — nothing pinned it
+    store.close()
+
+
+def test_engine_post_close_submit_fails_loudly():
+    """submit()/query() after close() must raise a clear `engine is
+    closed` at the submit seam — not strand a ticket on a
+    retired-snapshot RuntimeError inside the next flush."""
+    n = 20
+    eng = QueryEngine(n, _skiplink_graph(n))
+    assert eng.query(0, n - 1).found
+    eng.close()
+    with pytest.raises(ValueError, match="engine is closed"):
+        eng.submit(0, 3)
+    with pytest.raises(ValueError, match="engine is closed"):
+        eng.query(0, 3)
+    eng.flush()  # nothing pending: a no-op, not a crash
+    assert eng.stats()["queries"] == 1  # post-close stats stay readable
+
+
+def test_engine_graph_id_defaults_to_digest():
+    """The distance-cache namespace is the snapshot content digest: two
+    engines over the SAME graph share entries; engines over DIFFERENT
+    graphs can never alias — the id(self) default could, once CPython
+    reused a freed engine's address (the regression this pins)."""
+    n = 120
+    edges = _skiplink_graph(n)
+    shared = DistanceCache()
+    eng1 = QueryEngine(n, edges, dist_cache=shared)
+    assert eng1.graph_id == GraphSnapshot.build(n, edges).digest
+    warm = eng1.query(0, n - 1)
+    dispatches = eng1.counters["host_queries"]
+    eng1.close()
+    del eng1
+
+    # same graph, new engine object (plausibly at the freed address):
+    # digest keying makes the shared entries a HIT, not an accident
+    eng2 = QueryEngine(n, edges, dist_cache=shared)
+    r = eng2.query(0, n - 1)
+    assert r.found and r.hops == warm.hops
+    assert eng2.counters["cache_served"] == 1
+    assert eng2.counters["host_queries"] == 0
+    eng2.close()
+
+    # different graph, same shared cache: distinct namespace, no alias
+    edges3 = edges[:-1]  # drop one skip link: paths change
+    eng3 = QueryEngine(n, edges3, dist_cache=shared)
+    assert eng3.graph_id != GraphSnapshot.build(n, edges).digest
+    r3 = eng3.query(0, n - 1)
+    ref3 = solve_serial(n, edges3, 0, n - 1)
+    assert r3.hops == ref3.hops
+    assert eng3.counters["cache_served"] == 0
+    assert eng3.counters["host_queries"] == dispatches
+    eng3.close()
+
+
+# ---- overlay route ---------------------------------------------------
+def test_engine_overlay_route_exact_and_uncached():
+    """While a graph has pending live updates every query must answer
+    exactly on base+delta through the overlay route — and the distance
+    cache must stand aside entirely (its entries describe the base
+    snapshot, not the overlaid graph)."""
+    n = 80
+    edges = _skiplink_graph(n)
+    store = _store_one(n, edges, threshold=None)
+    eng = QueryEngine(store=store)
+    warm = eng.query(0, n - 1)  # banked against the v1 digest
+    assert eng.counters["cache_served"] == 0
+
+    store.update("g", adds=[(0, n - 1)])
+    for _ in range(2):  # repeats must NOT come from the cache
+        r = eng.query(0, n - 1)
+        assert r.found and r.hops == 1
+    assert eng.counters["overlay_queries"] == 2
+    assert eng.counters["cache_served"] == 0
+
+    # folding the delta moves the graph to a new digest: the v1 entry
+    # cannot answer v2 queries, and the overlay route switches off
+    store.compact("g")
+    r = eng.query(0, n - 1)
+    assert r.hops == 1 and warm.hops > 1
+    assert eng.counters["overlay_queries"] == 2
+    assert eng.dist_cache.stats()["invalidations"] > 0
+    eng.close()
+    store.close()
+
+
+def test_pipelined_mixed_graphs_one_batch():
+    """One popped pipeline batch can interleave store graphs; each
+    group must resolve on its own snapshot."""
+    n = 100
+    e_a = _skiplink_graph(n)
+    e_b = _skiplink_graph(n)[:-1]
+    store = GraphStore(compact_threshold=None)
+    store.add("a", n, e_a)
+    store.add("b", n, e_b)
+    eng = PipelinedQueryEngine(store=store, graph="a",
+                               max_wait_ms=20.0, flush_threshold=64)
+    rng = np.random.default_rng(5)
+    queries = []
+    for _ in range(40):
+        s = int(rng.integers(0, n))
+        d = int((s + 1 + rng.integers(0, n - 1)) % n)
+        g = "a" if rng.random() < 0.5 else "b"
+        queries.append((s, d, g))
+    tickets = [eng.submit(s, d, g) for s, d, g in queries]
+    for (s, d, g), t in zip(queries, tickets):
+        ref = solve_serial(n, e_a if g == "a" else e_b, s, d)
+        res = t.wait(timeout=30)
+        assert res.found == ref.found, (s, d, g)
+        if ref.found:
+            assert res.hops == ref.hops, (s, d, g)
+    eng.close()
+    store.close()
+
+
+# ---- hot-swap --------------------------------------------------------
+def test_swap_barrier_inflight_flush_finishes_on_old_snapshot():
+    """A flush that launched before a hot-swap must finish on the
+    snapshot it launched on — deterministically: the host solve stalls
+    mid-flush, the store swaps underneath it, and the stalled batch
+    still answers on the OLD graph while the next query sees the new
+    one."""
+    n = 60
+    chain = np.array([[i, i + 1] for i in range(n - 1)])
+    v1_edges = np.concatenate([chain, [[0, n - 1]]])  # shortcut: hops 1
+    store = _store_one(n, v1_edges)
+    eng = PipelinedQueryEngine(store=store, max_wait_ms=1.0,
+                               flush_threshold=1000)  # host route
+    entered, proceed = threading.Event(), threading.Event()
+    real = eng._solve_host_isolated
+
+    def stalled(pairs):
+        entered.set()
+        assert proceed.wait(10)
+        return real(pairs)
+
+    eng._solve_host_isolated = stalled
+    t = eng.submit(0, n - 1)
+    assert entered.wait(10)
+    old = store.current("g")
+    new = GraphSnapshot.build(n, chain)  # shortcut removed: hops n-1
+    store.swap("g", new)
+    proceed.set()
+    assert t.wait(timeout=30).hops == 1  # solved on the launch snapshot
+    eng._solve_host_isolated = real
+    assert eng.query(0, n - 1).hops == n - 1  # next flush: new snapshot
+    assert old.retired  # engine re-resolved; last pin dropped
+    eng.close()
+    store.close()
+
+
+def test_swap_stale_cache_never_answers_new_version():
+    """Version-scoped invalidation: forest/pair entries banked at
+    version k must never answer a version k+1 query — including a swap
+    racing a concurrent query_many."""
+    n = 60
+    chain = np.array([[i, i + 1] for i in range(n - 1)])
+    v1_edges = np.concatenate([chain, [[0, n - 1]]])
+    store = _store_one(n, v1_edges)
+    eng = QueryEngine(store=store)
+    assert eng.query(0, n - 1).hops == 1  # banked under the v1 digest
+    assert eng.query(0, n - 1).hops == 1
+    assert eng.counters["cache_served"] == 1
+
+    stop = threading.Event()
+    seen = set()
+    failures = []
+
+    def hammer():
+        while not stop.is_set():
+            try:
+                for r in eng.query_many([(0, n - 1)] * 3):
+                    seen.add(r.hops)
+            except Exception as e:  # pragma: no cover - fail loudly
+                failures.append(e)
+                return
+
+    worker = threading.Thread(target=hammer)
+    worker.start()
+    store.swap("g", GraphSnapshot.build(n, chain))
+    stop.set()
+    worker.join(timeout=30)
+    assert not worker.is_alive() and not failures
+    # racing answers are exact on SOME concurrent version — never a
+    # stale-cache hybrid
+    assert seen <= {1, n - 1}
+    # settled answers are exact on the new version, repeatedly (a stale
+    # v1 forest would say hops 1)
+    for _ in range(3):
+        assert eng.query(0, n - 1).hops == n - 1
+    assert eng.dist_cache.stats()["invalidations"] > 0
+    eng.close()
+    store.close()
+
+
+def test_same_bucket_swap_zero_recompiles():
+    """The acceptance gate's core claim, engine-level: hot-swapping to
+    a same-bucket-shape version (and serving a second same-bucket
+    graph) must reuse the compiled batch program — zero new programs
+    after warmup, witnessed by the ExecutableCache counters."""
+    n = 300  # buckets to 512 rows x width 8
+    edges = _skiplink_graph(n)
+    exec_cache = ExecutableCache()
+    store = GraphStore(compact_threshold=None)
+    store.add("main", n, edges)
+    store.add("twin", n, edges[:-3])
+    eng = QueryEngine(store=store, graph="main", flush_threshold=8,
+                      device_batches=True, exec_cache=exec_cache)
+    rng = np.random.default_rng(6)
+    pairs = [(int(s), int((s + 1 + rng.integers(0, n - 1)) % n))
+             for s in rng.integers(0, n, 24)]
+    eng.query_many(pairs)
+    warm = exec_cache.stats()
+    assert warm["programs"] >= 1
+
+    # same-bucket update (degree-capped adds), folded + swapped
+    store.update("main", adds=[(0, 100), (2, 200)], dels=[(5, 6)])
+    new = store.compact("main")
+    assert new.version > 1
+    post = eng.query_many(pairs, graph="main")
+    merged = np.concatenate(
+        [np.delete(edges, np.where((edges == [5, 6]).all(axis=1)),
+                   axis=0), [[0, 100], [2, 200]]]
+    )
+    for (s, d), r in zip(pairs, post):
+        ref = solve_serial(n, merged, s, d)
+        assert r.found == ref.found and (
+            not ref.found or r.hops == ref.hops
+        ), (s, d)
+    # the second graph rides the same program too
+    eng.query_many(pairs, graph="twin")
+    end = exec_cache.stats()
+    assert end["programs"] == warm["programs"]  # ZERO recompiles
+    assert end["hits"] > warm["hits"]
+    eng.close()
+    store.close()
+
+
+# ---- the CLI ---------------------------------------------------------
+def _write_store_dir(tmp_path, n):
+    from bibfs_tpu.graph.io import write_graph_bin
+
+    (tmp_path / "graphs").mkdir()
+    write_graph_bin(tmp_path / "graphs" / "alpha.bin", n,
+                    _skiplink_graph(n))
+    write_graph_bin(tmp_path / "graphs" / "beta.bin", n,
+                    np.array([[i, i + 1] for i in range(n - 1)]))
+    return tmp_path / "graphs"
+
+
+def test_serve_cli_store_repl(tmp_path, capsys, monkeypatch):
+    from bibfs_tpu.serve.cli import main as serve_main
+
+    n = 40
+    gdir = _write_store_dir(tmp_path, n)
+    script = "\n".join([
+        "graphs",
+        f"0 {n - 1}",          # alpha (default): chain + skips
+        "use beta",
+        f"0 {n - 1}",          # beta: bare chain
+        f"update add 0 {n - 1}",
+        f"0 {n - 1}",          # overlay-exact: the new shortcut
+        "swap",
+        f"0 {n - 1}",          # post-swap snapshot answer
+        "swap",                # nothing pending now
+        "update add 0 0",      # self-loop -> structured error
+        "update del 1 7",      # beta has no (1,7) -> structured error
+        "use nope",            # unknown graph -> structured error
+        "update add x y",      # non-integer -> structured error
+    ]) + "\n"
+    monkeypatch.setattr("sys.stdin", io.StringIO(script))
+    spath = tmp_path / "stats.json"
+    rc = serve_main(["--store", str(gdir), "--no-path",
+                     "--stats-json", str(spath)])
+    assert rc == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    alpha_ref = solve_serial(n, _skiplink_graph(n), 0, n - 1)
+    assert out[0].startswith("graphs: *alpha(v1) beta(v1)")
+    assert out[1] == f"0 -> {n - 1}: length = {alpha_ref.hops}"
+    assert out[2].startswith("use beta: v1")
+    assert out[3] == f"0 -> {n - 1}: length = {n - 1}"
+    assert out[4] == "update beta: +1/-0 pending"
+    assert out[5] == f"0 -> {n - 1}: length = 1"
+    assert out[6].startswith("swap beta: v1 -> v")
+    assert out[7] == f"0 -> {n - 1}: length = 1"
+    assert out[8].startswith("swap beta: no pending delta")
+    assert out[9].startswith("error invalid: self-loop")
+    assert out[10].startswith("error invalid: edge (1, 7) not present")
+    assert out[11].startswith("error invalid: unknown graph 'nope'")
+    assert out[12].startswith("error invalid: non-integer node id")
+    stats = json.loads(spath.read_text())
+    assert stats["store"]["graphs"]["beta"]["swaps"] == 1
+    assert stats["overlay_queries"] == 1
+    assert stats["store"]["default"] == "alpha"
+
+
+def test_serve_cli_store_arg_conflicts(tmp_path, capsys):
+    from bibfs_tpu.graph.io import write_graph_bin
+    from bibfs_tpu.serve.cli import main as serve_main
+
+    gdir = _write_store_dir(tmp_path, 10)
+    gbin = tmp_path / "one.bin"
+    write_graph_bin(gbin, 4, np.array([[0, 1]]))
+    assert serve_main([str(gbin), "--store", str(gdir)]) == 2
+    assert serve_main(["--store", str(gdir), "--load", "100"]) == 2
+    assert serve_main([]) == 2
+    assert serve_main(["--store", str(tmp_path / "missing")]) == 2
+    err = capsys.readouterr().err
+    assert "not both" in err and "--load" in err
+
+
+def test_serve_cli_store_commands_need_store(tmp_path, capsys,
+                                             monkeypatch):
+    from bibfs_tpu.graph.io import write_graph_bin
+    from bibfs_tpu.serve.cli import main as serve_main
+
+    n = 10
+    gbin = tmp_path / "g.bin"
+    write_graph_bin(gbin, n, np.array([[i, i + 1]
+                                       for i in range(n - 1)]))
+    monkeypatch.setattr("sys.stdin", io.StringIO("use x\n0 5\n"))
+    rc = serve_main([str(gbin), "--no-path"])
+    assert rc == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    assert out[0] == "error invalid: 'use' needs --store"
+    assert out[1] == "0 -> 5: length = 5"

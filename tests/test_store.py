@@ -1,0 +1,451 @@
+"""The graph store (bibfs_tpu/store): content-addressed snapshots,
+delta overlays with exact query answering, and the named multi-graph
+registry with atomic hot-swap.
+
+Correctness bar: overlay solves are bit-exact against the serial oracle
+on the post-update edge set; a compaction folds EXACTLY the captured
+delta (updates racing the build are rebased, never lost); swaps only
+move a name forward; and a superseded snapshot retires precisely when
+its last in-flight pin drops (the swap barrier's bookkeeping)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from bibfs_tpu.store import (
+    DeltaOverlay,
+    GraphSnapshot,
+    GraphStore,
+    content_digest,
+)
+from bibfs_tpu.store.delta import canonical_edge
+from bibfs_tpu.solvers.serial import solve_serial
+
+
+def _skiplink_graph(n: int) -> np.ndarray:
+    """Chain + skip links (max degree 4) — same shape the serving tests
+    use; every size buckets to ELL width 8."""
+    edges = [[i, i + 1] for i in range(n - 1)]
+    edges += [[i, i + 7] for i in range(n - 7)]
+    return np.array(edges)
+
+
+# ---- snapshots -------------------------------------------------------
+def test_snapshot_digest_is_content_addressed():
+    """Same edge set — whatever the order, duplication, or orientation
+    of the input list — same digest; different edge set (or different
+    n over the same edges) different digest."""
+    n = 40
+    edges = _skiplink_graph(n)
+    a = GraphSnapshot.build(n, edges)
+    shuffled = edges[np.random.default_rng(0).permutation(len(edges))]
+    b = GraphSnapshot.build(n, np.concatenate([shuffled[:, ::-1], shuffled]))
+    assert a.digest == b.digest
+    assert a.version != b.version  # versions stay distinct stamps
+    c = GraphSnapshot.build(n, edges[:-1])
+    assert c.digest != a.digest
+    d = GraphSnapshot.build(n + 1, edges)
+    assert d.digest != a.digest
+
+
+def test_snapshot_versions_monotonic():
+    n, edges = 16, np.array([[0, 1], [1, 2]])
+    versions = [GraphSnapshot.build(n, edges).version for _ in range(3)]
+    assert versions == sorted(versions)
+    assert len(set(versions)) == 3
+
+
+def test_snapshot_anon_digest_never_reused():
+    """A snapshot constructed without content hashing still gets a
+    process-unique identity — the property id() lacked."""
+    seen = set()
+    for _ in range(3):
+        s = GraphSnapshot(4, np.array([[0, 1], [1, 0]]))
+        assert s.digest.startswith("anon-")
+        assert s.digest not in seen
+        seen.add(s.digest)
+
+
+def test_snapshot_builds_memoized():
+    n = 60
+    snap = GraphSnapshot.build(n, _skiplink_graph(n))
+    assert snap.csr() is snap.csr()
+    assert snap.ell() is snap.ell()
+    assert snap.ell().n == n
+    ref = content_digest(n, snap.pairs)
+    assert snap.digest == ref
+
+
+def test_snapshot_refcount_retirement():
+    n = 30
+    snap = GraphSnapshot.build(n, _skiplink_graph(n))
+    snap.ell()  # build something retirable
+    fired = []
+    snap.on_retire(fired.append)
+    snap.retain()
+    assert snap.refs == 2
+    assert not snap.release() and not snap.retired and not fired
+    assert snap.release() and snap.retired
+    assert fired == [snap]
+    assert snap._ell is None  # memoized tables freed
+    with pytest.raises(RuntimeError, match="retired"):
+        snap.retain()
+    # a hook registered after retirement fires immediately
+    late = []
+    snap.on_retire(late.append)
+    assert late == [snap]
+
+
+# ---- delta overlays --------------------------------------------------
+def test_canonical_edge_validation():
+    assert canonical_edge(5, 3, 1) == (1, 3)
+    with pytest.raises(ValueError, match="out of range"):
+        canonical_edge(5, 0, 5)
+    with pytest.raises(ValueError, match="out of range"):
+        canonical_edge(5, -1, 2)
+    with pytest.raises(ValueError, match="self-loop"):
+        canonical_edge(5, 2, 2)
+
+
+def test_overlay_apply_semantics():
+    n = 20
+    ov = DeltaOverlay(GraphSnapshot.build(n, np.array([[0, 1], [1, 2]])))
+    assert ov.apply(adds=[(3, 4)]) == {"adds": 1, "dels": 0}
+    with pytest.raises(ValueError, match="already present"):
+        ov.apply(adds=[(0, 1)])  # base edge
+    with pytest.raises(ValueError, match="already present"):
+        ov.apply(adds=[(4, 3)])  # pending add, either orientation
+    with pytest.raises(ValueError, match="not present"):
+        ov.apply(dels=[(5, 6)])
+    # a delete cancels the pending add (and vice versa)
+    assert ov.apply(dels=[(3, 4)]) == {"adds": 0, "dels": 0}
+    assert ov.apply(dels=[(1, 2)]) == {"adds": 0, "dels": 1}
+    assert ov.apply(adds=[(2, 1)]) == {"adds": 0, "dels": 0}
+    assert ov.delta_edges == 0
+
+
+def test_overlay_solve_exact_vs_oracle():
+    """Overlay-corrected BFS must be bit-exact (found/hops, and a valid
+    path) against the serial oracle on the merged edge set — adds that
+    shorten paths, dels that lengthen or disconnect."""
+    n = 80
+    base_edges = _skiplink_graph(n)
+    ov = DeltaOverlay(GraphSnapshot.build(n, base_edges))
+    ov.apply(adds=[(0, 70), (20, 60)], dels=[(10, 11), (12, 19)])
+    merged = ov.merged_edges()
+    rng = np.random.default_rng(4)
+    queries = [(0, n - 1), (0, 70), (11, 10), (5, 5)] + [
+        tuple(map(int, rng.integers(0, n, 2))) for _ in range(30)
+    ]
+    for s, d in queries:
+        got = ov.solve(s, d)
+        ref = solve_serial(n, merged, s, d)
+        assert got.found == ref.found, (s, d)
+        if ref.found:
+            assert got.hops == ref.hops, (s, d)
+            got.validate_path(n, merged, s, d)
+
+
+def test_overlay_solve_disconnection():
+    n = 6
+    ov = DeltaOverlay(GraphSnapshot.build(n, np.array([[i, i + 1]
+                                                       for i in range(5)])))
+    ov.apply(dels=[(2, 3)])
+    assert not ov.solve(0, 5).found
+    assert ov.solve(0, 2).hops == 2
+    with pytest.raises(ValueError, match="out of range"):
+        ov.solve(0, n)
+
+
+def test_overlay_snapshot_digest_matches_true_graph():
+    """Compacting the overlay must produce a snapshot content-identical
+    to building the post-update graph from scratch."""
+    n = 50
+    ov = DeltaOverlay(GraphSnapshot.build(n, _skiplink_graph(n)))
+    ov.apply(adds=[(0, 40)], dels=[(3, 4)])
+    snap, adds, dels = ov.snapshot()
+    assert adds == {(0, 40)} and dels == {(3, 4)}
+    ref = GraphSnapshot.build(n, ov.merged_edges())
+    assert snap.digest == ref.digest
+    assert snap.version > ov.base.version
+
+
+# ---- the store -------------------------------------------------------
+def test_store_registration_and_resolution():
+    store = GraphStore(compact_threshold=None)
+    s1 = store.add("a", 10, np.array([[0, 1]]))
+    store.add("b", 12, np.array([[2, 3]]))
+    assert store.names() == ["a", "b"]
+    assert store.default_graph() == "a"
+    assert store.current("a") is s1
+    assert store.overlay("a") is None
+    with pytest.raises(ValueError, match="already registered"):
+        store.add("a", 10, np.array([[0, 1]]))
+    with pytest.raises(KeyError, match="unknown graph"):
+        store.current("nope")
+
+
+def test_store_from_dir(tmp_path):
+    from bibfs_tpu.graph.io import write_graph_bin
+
+    write_graph_bin(tmp_path / "beta.bin", 8, np.array([[0, 1]]))
+    write_graph_bin(tmp_path / "alpha.bin", 6, np.array([[1, 2]]))
+    store = GraphStore.from_dir(tmp_path)
+    assert store.names() == ["alpha", "beta"]
+    assert store.default_graph() == "alpha"  # sorted => deterministic
+    assert store.current("beta").n == 8
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    with pytest.raises(ValueError, match="no .*\\.bin"):
+        GraphStore.from_dir(empty)
+
+
+def test_store_update_overlay_and_forced_swap():
+    n = 40
+    store = GraphStore(compact_threshold=None)
+    store.add("g", n, _skiplink_graph(n))
+    v1 = store.current("g")
+    out = store.update("g", adds=[(0, 30)])
+    assert out == {"adds": 1, "dels": 0, "compacting": False}
+    assert store.overlay("g").delta_edges == 1
+    assert store.stats()["graphs"]["g"]["delta_edges"] == 1
+
+    v2 = store.compact("g")  # the REPL `swap` path
+    assert v2 is store.current("g")
+    assert v2.version > v1.version
+    assert store.overlay("g") is None  # fully folded
+    assert v1.retired  # the store's ref was the last pin
+    st = store.stats()["graphs"]["g"]
+    assert st["swaps"] == 1 and st["compactions"] == 1
+    # idempotent with nothing pending
+    assert store.compact("g") is v2
+
+
+def test_store_threshold_triggers_background_compaction():
+    n = 40
+    store = GraphStore(compact_threshold=2)
+    store.add("g", n, _skiplink_graph(n))
+    out = store.update("g", adds=[(0, 30), (0, 31)])
+    assert out["compacting"]
+    store.close()  # join the background job
+    st = store.stats()["graphs"]["g"]
+    assert st["compactions"] == 1 and st["delta_edges"] == 0
+    assert st["version"] > 1
+
+
+def test_store_swap_forward_only_and_discard():
+    n = 20
+    edges = np.array([[i, i + 1] for i in range(n - 1)])
+    store = GraphStore(compact_threshold=None)
+    old = store.add("g", n, edges)
+    store.update("g", adds=[(0, 10)])
+    new = GraphSnapshot.build(n, edges[:-1])
+    got_old = store.swap("g", new)
+    assert got_old is old
+    assert store.current("g") is new
+    assert store.overlay("g") is None  # declared-truth swap discards
+    with pytest.raises(ValueError, match="forward"):
+        store.swap("g", GraphSnapshot(n, old.pairs, version=new.version))
+
+
+def test_store_compaction_rebases_racing_updates():
+    """An update landing while the compaction builds must survive it:
+    the built snapshot holds the captured delta, the racing update is
+    rebased into a fresh overlay over the new snapshot, and the overlay
+    handed out before the swap is never mutated."""
+    n = 40
+    store = GraphStore(compact_threshold=None)
+    store.add("g", n, _skiplink_graph(n))
+    store.update("g", adds=[(0, 30)])
+    overlay = store.overlay("g")
+
+    building = threading.Event()
+    proceed = threading.Event()
+
+    def stalled_snapshot():
+        # same steps as DeltaOverlay.snapshot, stalled in the race
+        # window between capturing the delta and finishing the build
+        adds, dels = overlay.capture()
+        building.set()
+        assert proceed.wait(10)
+        snap = GraphSnapshot.build(
+            overlay.base.n, overlay.merged_edges(adds, dels)
+        )
+        return snap, adds, dels
+
+    overlay.snapshot = stalled_snapshot
+    worker = threading.Thread(target=store.compact, args=("g",))
+    worker.start()
+    assert building.wait(10)
+    store.update("g", adds=[(0, 31)])  # races the build
+    proceed.set()
+    worker.join(timeout=10)
+    assert not worker.is_alive()
+
+    # the racing add was rebased, not lost — and not folded either
+    snap = store.current("g")
+    assert tuple(map(tuple, snap.undirected_edges().tolist())).count(
+        (0, 30)) == 1
+    rebased = store.overlay("g")
+    assert rebased is not overlay
+    assert rebased.capture() == ({(0, 31)}, set())
+    assert rebased.base is snap
+    # the pre-swap overlay still answers the old-base+full-delta graph
+    assert overlay.capture() == ({(0, 30), (0, 31)}, set())
+    assert store.stats()["graphs"]["g"]["delta_edges"] == 1
+
+
+def test_store_compaction_rebase_survives_cancelling_update():
+    """A racing update that CANCELS a captured pending edge must become
+    a real update against the new snapshot. Plain set subtraction lost
+    it: del-of-a-captured-add empties the overlay's add set without
+    recording a delete, so `live - captured` came out empty while the
+    built snapshot still contained the edge — the user's delete was
+    silently gone forever (and symmetrically for a re-add of a captured
+    pending delete)."""
+    n = 40
+    store = GraphStore(compact_threshold=None)
+    store.add("g", n, _skiplink_graph(n))
+    # (0, 30) is a new edge; (0, 1) is a base edge
+    store.update("g", adds=[(0, 30)], dels=[(0, 1)])
+    overlay = store.overlay("g")
+
+    building = threading.Event()
+    proceed = threading.Event()
+
+    def stalled_snapshot():
+        adds, dels = overlay.capture()
+        building.set()
+        assert proceed.wait(10)
+        snap = GraphSnapshot.build(
+            overlay.base.n, overlay.merged_edges(adds, dels)
+        )
+        return snap, adds, dels
+
+    overlay.snapshot = stalled_snapshot
+    worker = threading.Thread(target=store.compact, args=("g",))
+    worker.start()
+    assert building.wait(10)
+    # both racing updates CANCEL captured pending edges
+    store.update("g", adds=[(0, 1)], dels=[(0, 30)])
+    proceed.set()
+    worker.join(timeout=10)
+    assert not worker.is_alive()
+
+    # the built snapshot folded the captured delta...
+    snap = store.current("g")
+    edges = set(map(tuple, snap.undirected_edges().tolist()))
+    assert (0, 30) in edges and (0, 1) not in edges
+    # ...and the rebased overlay undoes it (the racing truth)
+    rebased = store.overlay("g")
+    assert rebased is not None
+    assert rebased.capture() == ({(0, 1)}, {(0, 30)})
+    # net effect: the live graph equals the original edge set
+    final = store.compact("g")
+    assert set(map(tuple, final.undirected_edges().tolist())) == {
+        tuple(sorted(e)) for e in map(tuple, _skiplink_graph(n).tolist())
+    }
+    store.close()
+
+
+def test_store_compaction_aborts_when_external_swap_races():
+    """An external swap() landing while a compaction builds is the
+    caller's declared truth (and discards the overlay being folded) —
+    the compaction must ABORT, not overwrite the swapped-in snapshot
+    with stale old-base+delta content."""
+    n = 40
+    edges = _skiplink_graph(n)
+    store = GraphStore(compact_threshold=None)
+    store.add("g", n, edges)
+    store.update("g", adds=[(0, 30)])
+    overlay = store.overlay("g")
+
+    building = threading.Event()
+    proceed = threading.Event()
+
+    def stalled_snapshot():
+        adds, dels = overlay.capture()
+        building.set()
+        assert proceed.wait(10)
+        snap = GraphSnapshot.build(
+            overlay.base.n, overlay.merged_edges(adds, dels)
+        )
+        return snap, adds, dels
+
+    overlay.snapshot = stalled_snapshot
+    results = {}
+    worker = threading.Thread(
+        target=lambda: results.update(got=store.compact("g"))
+    )
+    worker.start()
+    assert building.wait(10)
+    declared = GraphSnapshot.build(n, edges[:-1])  # the external truth
+    store.swap("g", declared)
+    proceed.set()
+    worker.join(timeout=10)
+    assert not worker.is_alive()
+
+    assert store.current("g") is declared  # not the compaction's build
+    assert results["got"] is declared  # compact() reports the winner
+    assert store.overlay("g") is None
+    st = store.stats()["graphs"]["g"]
+    assert st["swaps"] == 1 and st["compactions"] == 0
+    store.close()
+
+
+def test_overlay_apply_batch_atomic():
+    """A batch with one invalid edge must leave the overlay EXACTLY as
+    it was — a half-applied batch would leak its valid prefix into the
+    next compaction while the caller believes the whole update was
+    rejected."""
+    n = 20
+    ov = DeltaOverlay(GraphSnapshot.build(n, np.array([[i, i + 1]
+                                                       for i in range(19)])))
+    ov.apply(adds=[(0, 5)])
+    with pytest.raises(ValueError, match="already present"):
+        ov.apply(adds=[(0, 7), (0, 5)])  # (0, 7) valid, (0, 5) dup
+    with pytest.raises(ValueError, match="not present"):
+        ov.apply(dels=[(0, 1), (9, 11)])  # (0, 1) valid, (9, 11) absent
+    assert ov.capture() == ({(0, 5)}, set())
+
+
+def test_store_metrics_minted_and_tracked():
+    from bibfs_tpu.obs.metrics import REGISTRY
+
+    store = GraphStore(compact_threshold=None, obs_label="t-store")
+    store.add("g", 10, np.array([[0, 1], [1, 2]]))
+    render = REGISTRY.render()
+    for name in ("bibfs_store_graphs", "bibfs_store_swaps_total",
+                 "bibfs_store_delta_edges",
+                 "bibfs_store_compactions_total"):
+        assert name in render
+    assert 'bibfs_store_graphs{store="t-store"} 1' in render
+    store.update("g", adds=[(3, 4)])
+    assert ('bibfs_store_delta_edges{store="t-store",graph="g"} 1'
+            in REGISTRY.render())
+    store.compact("g")
+    r = REGISTRY.render()
+    assert 'bibfs_store_swaps_total{store="t-store",graph="g"} 1' in r
+    assert 'bibfs_store_delta_edges{store="t-store",graph="g"} 0' in r
+    assert ('bibfs_store_compactions_total{store="t-store",graph="g"} 1'
+            in r)
+
+
+def test_store_swap_emits_trace_spans():
+    from bibfs_tpu.obs.trace import Tracer, set_tracer
+
+    store = GraphStore(compact_threshold=None)
+    store.add("g", 10, np.array([[0, 1], [1, 2]]))
+    store.update("g", adds=[(3, 4)])
+    t = Tracer()
+    prev = set_tracer(t)
+    try:
+        store.compact("g")
+    finally:
+        set_tracer(prev)
+    names = [e["name"] for e in t.events() if e.get("ph") == "X"]
+    assert "store_compact" in names and "store_swap" in names
+    compact = next(e for e in t.events()
+                   if e.get("name") == "store_compact")
+    assert compact["args"]["graph"] == "g"
