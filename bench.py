@@ -107,6 +107,47 @@ LAST_TPU_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              "bench_last_tpu.json")
 
 
+def bench_meta() -> dict:
+    """The shared provenance block stamped into EVERY ``bench_*.json``
+    artifact (git rev, platform, jax version, timestamp) so trajectory
+    artifacts are comparable across PRs — which run produced a number
+    is part of the number."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    try:
+        rev = subprocess.run(
+            ["git", "rev-parse", "--short=12", "HEAD"],
+            capture_output=True, text=True, timeout=10, cwd=here,
+        ).stdout.strip() or None
+    except Exception:
+        rev = None
+    try:
+        import jax
+
+        jax_ver = jax.__version__
+    except Exception:
+        jax_ver = None
+    uname = os.uname()
+    return {
+        "git_rev": rev,
+        "os": f"{uname.sysname} {uname.release}",
+        "machine": uname.machine,
+        "python": sys.version.split()[0],
+        "jax": jax_ver,
+        "numpy": np.__version__,
+        "timestamp": time.strftime("%Y-%m-%dT%H:%M:%S"),
+    }
+
+
+def _write_artifact(filename: str, line: dict) -> None:
+    """Write one ``bench_*.json`` artifact, stamping the shared
+    :func:`bench_meta` provenance block first."""
+    line.setdefault("meta", bench_meta())
+    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           filename), "w") as f:
+        json.dump(line, f, indent=1)
+        f.write("\n")
+
+
 def emit(value, detail, error=None):
     """One COMPACT JSON line on stdout (the driver keeps only a ~2000-char
     tail, and round-3's full-detail line overflowed it into ``parsed:
@@ -128,9 +169,7 @@ def emit(value, detail, error=None):
         line["error"] = error
     detail_file = "bench_last.json"
     try:
-        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                               "bench_last.json"), "w") as f:
-            json.dump(line, f, indent=1)
+        _write_artifact("bench_last.json", line)
     except OSError as e:
         print(f"could not write bench_last.json: {e}", file=sys.stderr)
         detail_file = None  # never point consumers at a stale file
@@ -851,12 +890,7 @@ def serve_main():
         if tpu_error:
             line["tpu_error"] = tpu_error[:300]
         _trace_finish(tracer, trace_path, line)
-        with open(
-            os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                         "bench_serve.json"), "w"
-        ) as f:
-            json.dump(line, f, indent=1)
-            f.write("\n")
+        _write_artifact("bench_serve.json", line)
         print(json.dumps({
             "metric": line["metric"],
             "value": None if engine_qps is None else round(engine_qps, 1),
@@ -881,6 +915,23 @@ def serve_main():
             "error": f"{type(e).__name__}: {e}"[:400],
         }))
         return 1
+
+
+def _pair_skew_arg() -> float | None:
+    """``--pair-skew [S]``: switch the load workload to the seeded
+    Zipf/hot-pair sampler (``loadgen.sample_skewed_pairs``), with Zipf
+    exponent ``S`` when the next argv token parses as a float (default
+    1.1). None = flag absent (uniform unique pairs, the historical
+    workload)."""
+    if "--pair-skew" not in sys.argv:
+        return None
+    i = sys.argv.index("--pair-skew")
+    if i + 1 < len(sys.argv):
+        try:
+            return float(sys.argv[i + 1])
+        except ValueError:
+            pass
+    return 1.1
 
 
 # --serve-load defaults: a CPU-friendly graph served through the host
@@ -926,7 +977,16 @@ def serve_load_main():
         n, q = LOAD_N, LOAD_Q
         edges = gnp_random_graph(n, AVG_DEG / n, seed=1)
         cpairs = canonical_pairs(n, edges)
-        pairs = sample_query_pairs(n, q)
+        pair_skew = _pair_skew_arg()
+        if pair_skew is not None:
+            from bibfs_tpu.serve.loadgen import sample_skewed_pairs
+
+            deg = np.bincount(cpairs[:, 0], minlength=n)
+            pairs = sample_skewed_pairs(
+                n, q, skew=pair_skew, degrees=deg
+            )
+        else:
+            pairs = sample_query_pairs(n, q)
 
         env_rates = os.environ.get("BENCH_LOAD_RATES")
         capacity = None
@@ -955,6 +1015,7 @@ def serve_load_main():
             "graph": f"G({n}, {AVG_DEG:.1f}/n) seed=1",
             "platform": platform,
             "queries_per_point": q,
+            "pair_skew": pair_skew,
             "sync_capacity_qps": None if capacity is None
             else round(capacity, 1),
             **out,
@@ -963,12 +1024,7 @@ def serve_load_main():
         if tpu_error:
             line["tpu_error"] = tpu_error[:300]
         _trace_finish(tracer, trace_path, line)
-        with open(
-            os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                         "bench_load.json"), "w"
-        ) as f:
-            json.dump(line, f, indent=1)
-            f.write("\n")
+        _write_artifact("bench_load.json", line)
         compact = {
             "metric": line["metric"],
             "value": line["value"],
@@ -1064,12 +1120,7 @@ def serve_chaos_main():
         line["ok"] = bool(line["ok"] and not missing)
         if tpu_error:
             line["tpu_error"] = tpu_error[:300]
-        with open(
-            os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                         "bench_chaos.json"), "w"
-        ) as f:
-            json.dump(line, f, indent=1)
-            f.write("\n")
+        _write_artifact("bench_chaos.json", line)
         print(json.dumps({
             "metric": line["metric"],
             "value": line["value"],
@@ -1171,12 +1222,7 @@ def serve_update_main():
         line["ok"] = bool(line["ok"] and not missing)
         if tpu_error:
             line["tpu_error"] = tpu_error[:300]
-        with open(
-            os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                         "bench_update.json"), "w"
-        ) as f:
-            json.dump(line, f, indent=1)
-            f.write("\n")
+        _write_artifact("bench_update.json", line)
         print(json.dumps({
             "metric": line["metric"],
             "value": line["value"],
@@ -1206,9 +1252,131 @@ def serve_update_main():
         return 1
 
 
+# --serve-oracle defaults: the skew soak runs the distance-oracle tier's
+# full claim set (exactness, hit rate, A/B throughput vs the same stack
+# without the tier, mid-traffic hot-swap staleness) on a road-network-
+# shaped graph — a perforated 4-neighbor lattice. The graph shape is the
+# point: landmark/ALT oracles were invented for large-diameter networks
+# (road maps), where a point-to-point BFS pays a real frontier sweep and
+# a handful of well-placed landmarks pin most distances exactly; G(n,p)
+# small worlds are the OPPOSITE regime (log diameter, bidirectional BFS
+# meets in a few levels, nothing for an index to save). --quick is the
+# CI smoke shape (tiny grid — the qps ratio is reported but not gated
+# there, solve cost ~ per-query overhead makes it noise)
+ORACLE_GRID = os.environ.get("BENCH_ORACLE_GRID", "500x500")
+ORACLE_PERF = float(os.environ.get("BENCH_ORACLE_PERF", 0.02))
+ORACLE_Q = int(os.environ.get("BENCH_ORACLE_Q", 2000))
+# 64 landmarks = one uint64 mask word per vertex in the packed
+# multi-source build — all 64 trees ride a single traversal
+ORACLE_K = int(os.environ.get("BENCH_ORACLE_K", 64))
+ORACLE_SKEW = float(os.environ.get("BENCH_ORACLE_SKEW", 1.3))
+ORACLE_HIT_MIN = float(os.environ.get("BENCH_ORACLE_HIT_RATE", 0.30))
+ORACLE_SPEEDUP_MIN = float(os.environ.get("BENCH_ORACLE_SPEEDUP", 3.0))
+
+# the oracle metric families the README documents; the soak gate asserts
+# a live run's /metrics-equivalent render really carries them
+ORACLE_REQUIRED_METRICS = (
+    "bibfs_oracle_hits_total",
+    "bibfs_oracle_index_builds_total",
+    "bibfs_oracle_index_age_seconds",
+)
+
+
+def serve_oracle_main():
+    """``python bench.py --serve-oracle``: the distance-oracle skew soak.
+
+    Repeat-heavy Zipf traffic (``--pair-skew`` sampler) over a
+    road-network-shaped perforated grid drives two otherwise-identical
+    store-backed sync engines closed-loop — with and without the
+    landmark oracle tier — then a live update + forced mid-traffic
+    hot-swap runs against the oracle engine
+    (bibfs_tpu/serve/loadgen.run_oracle). The gate: every answer of the
+    oracle run equals a fresh ground-truth serial BFS, ``route="oracle"``
+    hit rate >= BENCH_ORACLE_HIT_RATE, oracle-run qps >=
+    BENCH_ORACLE_SPEEDUP x the no-oracle run on the same traffic, zero
+    stale answers across the hot-swap (with ground truth provably
+    changed by the update), zero lost/stranded tickets, and the
+    documented oracle metric families present in the registry render.
+    ``--quick`` is the CI smoke shape (speedup reported, not gated).
+    Artifact: ``bench_oracle.json``."""
+    t_setup = time.time()
+    platform, tpu_error = select_platform()
+    try:
+        from bibfs_tpu.graph.generate import grid_graph
+        from bibfs_tpu.obs.metrics import REGISTRY
+        from bibfs_tpu.serve.loadgen import run_oracle
+
+        quick = "--quick" in sys.argv
+        try:
+            w, h = (int(x) for x in
+                    ("48x48" if quick else ORACLE_GRID).split("x"))
+        except ValueError:
+            print(f"bad BENCH_ORACLE_GRID {ORACLE_GRID!r} "
+                  "(want WxH)", file=sys.stderr)
+            return 1
+        n = w * h
+        q = 400 if quick else ORACLE_Q
+        edges = grid_graph(w, h, perforation=ORACLE_PERF, seed=1)
+        out = run_oracle(
+            n, edges,
+            queries=q,
+            oracle_k=ORACLE_K,
+            skew=ORACLE_SKEW,
+            hit_rate_min=ORACLE_HIT_MIN,
+            speedup_min=None if quick else ORACLE_SPEEDUP_MIN,
+        )
+        render = REGISTRY.render()
+        missing = [m for m in ORACLE_REQUIRED_METRICS if m not in render]
+        line = {
+            "metric": f"bibfs_serve_oracle_{n}",
+            "value": out["oracle"]["qps"],
+            "unit": "queries/s",
+            "graph": f"grid({w}x{h}, perf={ORACLE_PERF}) seed=1",
+            "platform": platform,
+            "quick": quick,
+            **out,
+            "metrics_missing": missing,
+            "total_s": round(time.time() - t_setup, 1),
+        }
+        line["ok"] = bool(line["ok"] and not missing)
+        if tpu_error:
+            line["tpu_error"] = tpu_error[:300]
+        _write_artifact("bench_oracle.json", line)
+        print(json.dumps({
+            "metric": line["metric"],
+            "value": line["value"],
+            "unit": "queries/s",
+            "ok": line["ok"],
+            "exact": out["exact"],
+            "hit_rate": out["oracle"]["hit_rate"],
+            "hit_rate_ok": out["hit_rate_ok"],
+            "baseline_qps": out["baseline"]["qps"],
+            "speedup": out["speedup"],
+            "speedup_ok": out["speedup_ok"],
+            "zero_stale": out["zero_stale"],
+            "changed_answers": out["swap"]["changed_answers"],
+            "zero_lost": out["zero_lost"],
+            "metrics_missing": missing,
+            "detail_file": "bench_oracle.json",
+        }))
+        return 0 if line["ok"] else 1
+    except Exception as e:
+        import traceback
+
+        traceback.print_exc()
+        print(json.dumps({
+            "metric": "bibfs_serve_oracle",
+            "value": None,
+            "error": f"{type(e).__name__}: {e}"[:400],
+        }))
+        return 1
+
+
 if __name__ == "__main__":
     if "--calibrate" in sys.argv:
         sys.exit(calibrate_main())
+    elif "--serve-oracle" in sys.argv:
+        sys.exit(serve_oracle_main())
     elif "--serve-update" in sys.argv:
         sys.exit(serve_update_main())
     elif "--serve-chaos" in sys.argv:

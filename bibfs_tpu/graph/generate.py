@@ -72,6 +72,35 @@ def gnp_random_graph(
     return np.stack([i, j], axis=1)
 
 
+def grid_graph(
+    width: int, height: int, *, perforation: float = 0.0,
+    seed: int | None = None,
+) -> np.ndarray:
+    """``width x height`` 4-neighbor lattice as an ``(M, 2)`` edge array
+    (row-major vertex ids, ``n = width * height`` for the caller).
+
+    The road-network-shaped serving graph: large diameter
+    (``width + height - 2``), so a point-to-point BFS pays a real
+    frontier sweep — the workload landmark/ALT distance oracles were
+    invented for (and the opposite regime from G(n, p)'s
+    log-diameter small worlds, where bidirectional BFS meets after a
+    handful of levels). ``perforation`` removes that fraction of lattice
+    edges uniformly at random (seeded): detours around the holes break
+    the perfect lattice's geodesic regularity so oracle bounds are
+    exercised, not just trivially tight.
+    """
+    if width < 1 or height < 1:
+        raise ValueError(f"grid needs positive dims, got {width}x{height}")
+    vid = np.arange(width * height, dtype=np.int64).reshape(height, width)
+    e_right = np.stack([vid[:, :-1].ravel(), vid[:, 1:].ravel()], axis=1)
+    e_down = np.stack([vid[:-1, :].ravel(), vid[1:, :].ravel()], axis=1)
+    edges = np.concatenate([e_right, e_down])
+    if perforation > 0:
+        rng = np.random.default_rng(seed)
+        edges = edges[rng.random(len(edges)) >= float(perforation)]
+    return edges
+
+
 def rmat_graph(
     scale: int,
     edge_factor: int = 16,

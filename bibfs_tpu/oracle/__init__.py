@@ -1,0 +1,41 @@
+"""Landmark distance-oracle tier — answer hot traffic with no BFS at all.
+
+The serving stack's third answering tier, above the
+:class:`~bibfs_tpu.serve.cache.DistanceCache` and below nothing: a small
+precomputed structure (K landmark BFS trees per graph snapshot) that
+answers most queries at memory-lookup speed and hands the rest a
+provable upper bound the solver can use as a search cutoff. Like
+"Compression and Sieve" (PAPERS.md), the win comes from sieving away
+traversal work before it happens, not from making the traversal faster.
+
+- :mod:`bibfs_tpu.oracle.landmarks` — seeded landmark selection
+  (degree-seeded + farthest-point refinement);
+- :mod:`bibfs_tpu.oracle.trees` — the bitmask-packed multi-source BFS
+  that builds all K landmark distance vectors in one pass (the MPI
+  reference's v2 bitset frontiers, generalized), packaged as an
+  immutable :class:`LandmarkIndex` keyed by the snapshot's content
+  digest, plus exact adds-only incremental repair;
+- :mod:`bibfs_tpu.oracle.oracle` — the :class:`DistanceOracle` that
+  turns one index into per-query answers: ``LB = max_L |d(s,L) -
+  d(L,t)|``, ``UB = min_L d(s,L) + d(L,t)``, served exact when
+  ``LB == UB`` (plus endpoint-is-a-landmark and provably-disconnected
+  pairs), bounds otherwise.
+
+Lifecycle (background builds, incremental repair from live edge
+updates, atomic follow-the-graph swap) lives in
+:class:`bibfs_tpu.store.GraphStore`; routing (oracle consulted before
+the distance cache, ``route="oracle"``) lives in the engines.
+"""
+
+from bibfs_tpu.oracle.landmarks import select_landmarks  # noqa: F401
+from bibfs_tpu.oracle.oracle import (  # noqa: F401
+    DistanceOracle,
+    OracleAnswer,
+    ORACLE_SERVED_KINDS,
+    oracle_cells,
+)
+from bibfs_tpu.oracle.trees import (  # noqa: F401
+    LandmarkIndex,
+    build_index,
+    multi_source_bfs,
+)

@@ -1,0 +1,88 @@
+"""Seeded landmark selection: degree-seeded + farthest-point refinement.
+
+Landmark quality decides the oracle's hit rate: ``LB == UB`` needs a
+landmark sitting ON (a geodesic extension of) the query's shortest
+path, and in the small-world graphs serving traffic runs over, shortest
+paths funnel through the high-degree core — so the first landmarks are
+the highest-degree vertices (which are also exactly the endpoints hot
+traffic hammers: a query touching a landmark is answered exactly for
+free). Degree alone clusters landmarks together, so the rest are
+farthest-point refined: each round picks the vertices farthest from
+every landmark chosen so far — which also lands landmarks in so-far
+uncovered components, and component coverage is what turns
+disconnected pairs into exact no-path answers (``oracle.py``).
+
+The refinement runs in CHUNKS of the bitmask-packed multi-source BFS
+(:func:`bibfs_tpu.oracle.trees.multi_source_bfs`): one packed pass per
+chunk instead of one BFS per landmark, and the passes' distance rows
+ARE the final index columns — selection and construction share every
+traversal. Score ties break by vertex id, so selection is fully
+deterministic AND shares its ranking with traffic modeling: the load
+generator's skewed sampler (``serve/loadgen.sample_skewed_pairs``)
+ranks hot endpoints by the same ``(degree desc, id)`` key, which makes
+"the degree-seeded landmarks are the endpoints hot traffic hammers"
+hold by construction, not by luck. ``seed`` is accepted (and plumbed
+from ``GraphStore(oracle_seed=...)``) for forward compatibility with
+stochastic refinements; current selection ignores it.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from bibfs_tpu.oracle.trees import multi_source_bfs
+
+_UNREACHED = np.int64(1 << 40)  # farther than any real distance
+
+
+def select_landmarks(n: int, row_ptr: np.ndarray, col_ind: np.ndarray,
+                     k: int, *, seed: int = 0, chunk: int | None = None,
+                     return_dist: bool = False):
+    """Pick ``min(k, n)`` landmark vertices (module docstring).
+
+    ``chunk`` is both the packed-BFS batch size and the size of the
+    first, purely degree-ranked batch; the default ``max(8, k // 2)``
+    spends half the landmark budget on the high-degree core (the
+    hot-traffic hit-rate lever) and half on farthest-point coverage
+    (the bounds-quality / component-coverage lever).
+
+    Returns the ``int64`` landmark array, or ``(landmarks, dist)`` with
+    the ``int16 [n, K]`` distance matrix when ``return_dist=True`` (the
+    selection passes already computed it).
+    """
+    k = int(min(int(k), n))
+    if k < 1:
+        raise ValueError(f"need at least 1 landmark, got {k}")
+    if chunk is None:
+        chunk = max(8, k // 2)
+    del seed  # reserved (module docstring); selection is deterministic
+    deg = (row_ptr[1:] - row_ptr[:-1]).astype(np.int64)
+    tie = np.arange(n)  # vertex id breaks ties (module docstring)
+    chosen: list[int] = []
+    cols: list[np.ndarray] = []
+    taken = np.zeros(n, dtype=bool)
+    # min distance to any chosen landmark; unreached sorts farthest, so
+    # farthest-point naturally jumps to uncovered components
+    mindist = np.full(n, _UNREACHED, dtype=np.int64)
+    while len(chosen) < k:
+        want = min(int(chunk), k - len(chosen))
+        # score: farthest first, then degree (the hot-core bias), then
+        # the seeded jitter; np.lexsort keys are least-significant first
+        score = np.where(taken, np.int64(-1), mindist)
+        order = np.lexsort((tie, -deg, -score))
+        batch = order[:want]
+        batch = batch[score[batch] >= 0]  # never re-pick a landmark
+        if batch.size == 0:
+            break  # fewer reachable vertices than requested landmarks
+        taken[batch] = True
+        chosen.extend(int(v) for v in batch)
+        d = multi_source_bfs(n, row_ptr, col_ind, batch)
+        cols.append(d)
+        d64 = np.where(d < 0, _UNREACHED, d.astype(np.int64))
+        np.minimum(mindist, d64.min(axis=1), out=mindist)
+    landmarks = np.asarray(chosen, dtype=np.int64)
+    if not return_dist:
+        return landmarks
+    dist = (np.concatenate(cols, axis=1) if cols
+            else np.zeros((n, 0), dtype=np.int16))
+    return landmarks, dist

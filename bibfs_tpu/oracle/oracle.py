@@ -1,0 +1,185 @@
+"""Per-query answering over one landmark index: bounds, exact kinds.
+
+For a query ``(s, t)`` and landmark distance vectors ``d(L, .)`` the
+triangle inequality gives, over every landmark L reaching both
+endpoints::
+
+    LB = max_L |d(s, L) - d(L, t)|     <=  d(s, t)  <=
+    UB = min_L  d(s, L) + d(L, t)
+
+(the graph is undirected, so ``d(s, L) = d(L, s)``). The oracle serves
+EXACT answers in three cases and never guesses:
+
+- **landmark** — an endpoint IS a landmark L: ``d(s, t) = d(L, other)``
+  directly (this falls out of the bounds — ``d(s, L) = 0`` forces
+  ``LB == UB`` — but is tagged as its own hit kind: hot-endpoint
+  traffic is the tier's whole motivation);
+- **tight** — ``LB == UB``: some landmark lies on a shortest path (or
+  a geodesic extension of one), so the bound pair pins the distance;
+- **disconnected** — the landmark reach-sets of s and t are disjoint
+  and at least one is non-empty: a component containing a landmark
+  cannot be the component of a vertex that landmark does not reach, so
+  the pair is PROVABLY in different components — exact "no path" with
+  no traversal (on sparse G(n, p) serving graphs a sizable fraction of
+  all pairs, the queries whose naive answer costs a full component
+  sweep).
+
+Everything else returns either usable **bounds** (``LB < UB``: the
+engine attaches UB as a search cutoff — seeding bidirectional BFS's
+meet bound with a KNOWN upper bound prunes exploration past it while
+staying exact) or a **miss** (neither endpoint reached by any landmark:
+the oracle knows nothing). Hit kinds land in
+``bibfs_oracle_hits_total{oracle,kind}``.
+
+Oracle-served results carry ``path=None``: the tier trades path
+materialization for lookup speed, exactly like a negative cache entry —
+``found``/``hops`` are exact, and callers needing the vertex list fall
+through to a solver.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from bibfs_tpu.obs.metrics import REGISTRY
+from bibfs_tpu.oracle.trees import LandmarkIndex
+from bibfs_tpu.solvers.api import BFSResult
+
+# consult outcomes that SERVE the query (route="oracle"); "bounds" only
+# arms a cutoff and "miss" is a pure fall-through
+ORACLE_SERVED_KINDS = ("landmark", "tight", "disconnected")
+ORACLE_KINDS = ORACLE_SERVED_KINDS + ("bounds", "miss")
+
+
+def oracle_cells(label: str) -> dict:
+    """Mint (or re-fetch) the ``bibfs_oracle_hits_total`` cells for one
+    oracle instance label — the store pre-mints them at graph
+    registration so a scrape shows the family at zero, and carries them
+    across index rebuilds so one graph's hit history survives its
+    follow-the-graph swaps."""
+    hits = REGISTRY.counter(
+        "bibfs_oracle_hits_total",
+        "Distance-oracle consults by outcome kind (landmark/tight/"
+        "disconnected serve exactly; bounds arms a search cutoff; "
+        "miss falls through)",
+        ("oracle", "kind"),
+    )
+    return {k: hits.labels(oracle=label, kind=k) for k in ORACLE_KINDS}
+
+
+class OracleAnswer:
+    """One consult's outcome. ``result`` is an exact
+    :class:`~bibfs_tpu.solvers.api.BFSResult` for the served kinds,
+    None for ``bounds`` (where ``lb``/``ub`` carry the information)."""
+
+    __slots__ = ("kind", "result", "lb", "ub")
+
+    def __init__(self, kind: str, result: BFSResult | None = None,
+                 lb: int | None = None, ub: int | None = None):
+        self.kind = kind
+        self.result = result
+        self.lb = lb
+        self.ub = ub
+
+    def __repr__(self) -> str:
+        return f"OracleAnswer({self.kind}, lb={self.lb}, ub={self.ub})"
+
+
+class DistanceOracle:
+    """Query answering over one immutable :class:`LandmarkIndex`.
+
+    Stateless beyond the index reference and its metric cells, so the
+    store can hot-swap oracles by pointer assignment (the
+    follow-the-graph swap) while in-flight consults finish on the index
+    they grabbed. ``metrics_label`` is the ``oracle=`` label its
+    registry cells carry (engines/stores pass their own so one
+    ``/metrics`` scrape separates instances); pass ``cells`` to carry
+    the counters across index swaps of the same graph.
+    """
+
+    def __init__(self, index: LandmarkIndex, *,
+                 metrics_label: str = "oracle", cells: dict | None = None):
+        self.index = index
+        self.metrics_label = metrics_label
+        self._m = oracle_cells(metrics_label) if cells is None else cells
+
+    @property
+    def cells(self) -> dict:
+        return self._m
+
+    def consult(self, src: int, dst: int) -> OracleAnswer | None:
+        """The oracle's whole per-query cost. Two tiers:
+
+        - **landmark fast path** — an endpoint IS a landmark L: the
+          answer is ONE matrix cell, ``d(L, other)`` (exact by
+          definition; ``CONSULT_INF`` there proves the pair
+          disconnected — L reaches every vertex of its own component).
+          A dict probe plus one scalar read, no K-wide reduction: hot
+          endpoints are degree-ranked and so are the first landmarks,
+          so under skewed traffic this tier answers most consults;
+        - **general path** — two contiguous row reads of the
+          INF-encoded ``dist32`` matrix and a handful of vectorized
+          reductions over K values (unreachable = ``CONSULT_INF``, so
+          the UB needs no reachability mask: an unreachable landmark's
+          sum is astronomically large and simply loses the min).
+
+        Returns None on a miss (and counts it)."""
+        idx = self.index
+        inf = idx.CONSULT_INF
+        col = idx.lm_col.get(src)
+        other = dst
+        if col is None:
+            col = idx.lm_col.get(dst)
+            other = src
+        if col is not None:
+            d = int(idx.dist32[other, col])
+            if d < inf:
+                self._m["landmark"].inc()
+                return OracleAnswer(
+                    "landmark",
+                    BFSResult(True, d, None, None, 0.0, 0, 0),
+                    lb=d, ub=d,
+                )
+            self._m["disconnected"].inc()
+            return OracleAnswer(
+                "disconnected",
+                BFSResult(False, None, None, None, 0.0, 0, 0),
+            )
+        ds = idx.dist32[src]
+        dt = idx.dist32[dst]
+        su = ds + dt
+        ub = int(su.min())
+        if ub < inf:  # some landmark reaches BOTH endpoints
+            # |ds - dt| is only a valid bound over both-reachable
+            # landmarks; su < INF is exactly that set (each term is
+            # either a real distance << INF or the INF sentinel)
+            lb = int(np.abs(ds - dt)[su < inf].max())
+            if lb == ub:
+                # an endpoint that IS a landmark took the fast path
+                # above, so a pinned bound here means some OTHER
+                # landmark sits on (a geodesic extension of) the path
+                self._m["tight"].inc()
+                return OracleAnswer(
+                    "tight",
+                    BFSResult(True, ub, None, None, 0.0, 0, 0),
+                    lb=lb, ub=ub,
+                )
+            self._m["bounds"].inc()
+            return OracleAnswer("bounds", None, lb=lb, ub=ub)
+        if (ds < inf).any() or (dt < inf).any():
+            # disjoint reach-sets, one non-empty: one endpoint shares a
+            # component with some landmark the other provably does not —
+            # different components, exact no-path (module docstring)
+            self._m["disconnected"].inc()
+            return OracleAnswer(
+                "disconnected",
+                BFSResult(False, None, None, None, 0.0, 0, 0),
+            )
+        self._m["miss"].inc()
+        return None
+
+    def stats(self) -> dict:
+        return {
+            "index": self.index.stats(),
+            "hits": {k: c.value for k, c in self._m.items()},
+        }

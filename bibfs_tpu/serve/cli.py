@@ -31,6 +31,16 @@ file stem, and the stdin stream grows store commands alongside
   current graph (in-flight batches finish on the old snapshot);
 - ``graphs`` lists the registered graphs with versions.
 
+``--oracle K`` enables the landmark distance-oracle tier
+(``bibfs_tpu/oracle``): K landmark BFS trees answer landmark-endpoint,
+bound-pinned, and provably-disconnected queries exactly with no BFS at
+all (``route="oracle"``), and arm an upper-bound search cutoff
+otherwise. Under ``--store`` the store owns one index per graph
+(background builds off the serving path, follow-the-graph swaps); with
+a plain ``.bin`` the engine builds one index at startup. The stdin
+command ``oracle`` (works with or without ``--store``) prints the
+current graph's index status and hit counters in the result stream.
+
 Command replies land in the result stream (``use g: ...``), and a
 malformed command answers an ``error invalid: ...`` line without
 killing the stream — same contract as malformed query lines.
@@ -44,6 +54,34 @@ import sys
 
 
 _STORE_COMMANDS = ("use", "update", "swap", "graphs")
+
+
+def _oracle_status(engine, store, current) -> str:
+    """The stdin ``oracle`` command's reply line: the current graph's
+    index status + hit counters (store-backed or engine-local)."""
+    if store is not None:
+        if store.oracle_k is None:
+            return "oracle: off (serve with --oracle K)"
+        st = store.stats()["graphs"][current]["oracle"]
+        state = ("ready" if st["ready"]
+                 else "building" if st["building"] else "stale")
+        head = (
+            f"oracle {current}: {state} k={st['k']} gen={st['gen']} "
+            f"builds={st['builds']} repairs={st['repairs']}"
+        )
+        idx = st.get("index")
+        if idx is not None:
+            head += f" age={idx['age_s']}s"
+    else:
+        st = engine.stats().get("oracle")
+        if st is None:
+            return "oracle: off (serve with --oracle K)"
+        idx = st["index"]
+        head = f"oracle: ready k={idx['k']} age={idx['age_s']}s"
+    hits = st.get("hits")
+    if hits:
+        head += "  hits " + " ".join(f"{k}={v}" for k, v in hits.items())
+    return head
 
 
 def _store_command(store, current: str, parts: list[str]) -> tuple[str, str]:
@@ -242,6 +280,20 @@ def main(argv=None):
     ap.add_argument("--cache-entries", type=int, default=64,
                     help="distance-cache forest capacity (default 64)")
     ap.add_argument(
+        "--oracle",
+        type=int,
+        default=None,
+        metavar="K",
+        help="enable the landmark distance-oracle tier with K landmark "
+        "BFS trees (bibfs_tpu/oracle): landmark-endpoint, bound-pinned "
+        "and provably-disconnected queries answer exactly with no BFS "
+        'at all (route="oracle"), everything else falls through with '
+        "an upper-bound search cutoff armed. Under --store the store "
+        "owns one index per graph (background builds, follow-the-graph "
+        "swaps); with a .bin graph the engine builds one at startup. "
+        "The stdin command 'oracle' prints index status",
+    )
+    ap.add_argument(
         "--pipeline",
         action="store_true",
         help="serve through the pipelined async engine: background "
@@ -325,6 +377,11 @@ def main(argv=None):
 
     apply_platform_env()
     n = edges = store = None
+    if args.load is not None and args.oracle is not None:
+        print("Error: --load A/Bs the sync vs pipelined engines on one "
+              "fixed graph; the oracle tier's A/B lives in 'python "
+              "bench.py --serve-oracle'", file=sys.stderr)
+        return 2
     if args.store is not None:
         if args.graph is not None:
             print("Error: pass a .bin graph OR --store DIR, not both",
@@ -340,6 +397,7 @@ def main(argv=None):
             store = GraphStore.from_dir(
                 args.store,
                 compact_threshold=(args.compact_threshold or None),
+                oracle_k=args.oracle,
             )
         except (OSError, ValueError) as e:
             print(f"Error reading store: {e}", file=sys.stderr)
@@ -427,6 +485,8 @@ def _serve(args, n, edges, store, QueryEngine, PipelinedQueryEngine,
             kwargs.update(store=store, graph=args.use)
         else:
             kwargs.update(n=n, edges=edges)
+            if args.oracle is not None:
+                kwargs["oracle_k"] = args.oracle
         if args.pipeline:
             engine = PipelinedQueryEngine(
                 max_wait_ms=args.max_wait_ms, **kwargs
@@ -494,6 +554,12 @@ def _serve(args, n, edges, store, QueryEngine, PipelinedQueryEngine,
                 parts = line.split()
                 if not parts:
                     continue
+                if parts[0] == "oracle":
+                    if len(parts) != 1:
+                        print("error invalid: usage: oracle")
+                        continue
+                    print(_oracle_status(engine, store, current))
+                    continue
                 if parts[0] in _STORE_COMMANDS:
                     if store is None:
                         print(f"error invalid: {parts[0]!r} needs "
@@ -538,11 +604,12 @@ def _serve(args, n, edges, store, QueryEngine, PipelinedQueryEngine,
     stats = engine.stats()
     print(
         "[Serve] {q} queries: {dq} device-batched ({db} flushes), "
-        "{hq} host, {ov} overlay-exact, {cs} cache-served; exec "
-        "programs {ep} ({eh} reused)".format(
+        "{hq} host, {ov} overlay-exact, {orc} oracle-served, "
+        "{cs} cache-served; exec programs {ep} ({eh} reused)".format(
             q=stats["queries"], dq=stats["device_queries"],
             db=stats["device_batches"], hq=stats["host_queries"],
             ov=stats["overlay_queries"], cs=stats["cache_served"],
+            orc=stats["oracle_served"],
             ep=stats["exec_cache"]["programs"],
             eh=stats["exec_cache"]["hits"],
         ),

@@ -84,7 +84,8 @@ def _engine_counter_bank(label: str) -> MetricBank:
     )
     routed = REGISTRY.counter(
         "bibfs_queries_routed_total",
-        "Queries by resolution route (trivial/cache/device/host/overlay)",
+        "Queries by resolution route "
+        "(trivial/oracle/cache/device/host/overlay)",
         ("engine", "route"),
     )
     batches = REGISTRY.counter(
@@ -99,6 +100,7 @@ def _engine_counter_bank(label: str) -> MetricBank:
     return MetricBank({
         "queries": queries.labels(engine=label),
         "trivial": routed.labels(engine=label, route="trivial"),
+        "oracle_served": routed.labels(engine=label, route="oracle"),
         "cache_served": routed.labels(engine=label, route="cache"),
         "device_batches": batches.labels(engine=label),
         "device_queries": routed.labels(engine=label, route="device"),
@@ -180,15 +182,42 @@ class _ResilienceCells:
         }
 
 
+def _solve_serial_cutoff_checked(n, row_ptr, col_ind, s, d, cutoff):
+    """Cutoff-armed serial solve with the false-unreachable guard.
+
+    An oracle cutoff is armed at SUBMIT time, against the live graph of
+    that instant; by the time the flush solves, a delete + hot-swap may
+    have raced in and the flush's bound graph can hold a larger true
+    distance than the stale UB — a seeded search would then stop early
+    and report a connected pair unreachable. The asymmetry that saves
+    us: a too-small cutoff can ONLY manifest as found=False, never as a
+    wrong distance (a found result's hops is a real path length <=
+    cutoff, and any real path is >= the true distance — so found
+    answers are exact whatever the cutoff was). So: trust found
+    results, and retry a not-found WITHOUT the seed. The retry fires
+    only when the pair is truly disconnected (one full component sweep,
+    the price of exactness) or the cutoff was stale (rare: a racing
+    delete between submit and flush) — no generation bookkeeping, no
+    race windows."""
+    from bibfs_tpu.solvers.serial import solve_serial_csr
+
+    res = solve_serial_csr(n, row_ptr, col_ind, s, d, cutoff=cutoff)
+    if cutoff is not None and not res.found:
+        res = solve_serial_csr(n, row_ptr, col_ind, s, d)
+    return res
+
+
 class _Pending:
     """A submitted query's handle; ``result`` lands at flush time.
     Exactly one of ``result`` / ``error`` lands: failure isolation
     gives a poisoned query a structured
     :class:`~bibfs_tpu.serve.resilience.QueryError` instead of sinking
     its whole batch. ``graph`` is the store graph name the query is
-    against (None on a store-less engine's single graph)."""
+    against (None on a store-less engine's single graph); ``cutoff`` is
+    the distance oracle's proven upper bound when it had one — the
+    serial host rung seeds its meet bound with it (exact pruning)."""
 
-    __slots__ = ("src", "dst", "graph", "result", "error")
+    __slots__ = ("src", "dst", "graph", "result", "error", "cutoff")
 
     def __init__(self, src: int, dst: int, graph: str | None = None):
         self.src = src
@@ -196,6 +225,7 @@ class _Pending:
         self.graph = graph
         self.result: BFSResult | None = None
         self.error: BaseException | None = None
+        self.cutoff: int | None = None
 
 
 class _GraphRuntime:
@@ -263,7 +293,11 @@ class _GraphRuntime:
     def get_host_solver(self):
         """The sub-crossover per-query path: the native C++ runtime when
         it loads (the measured latency winner, PERF_NOTES §3), else the
-        NumPy serial oracle over the snapshot's memoized CSR."""
+        NumPy serial oracle over the snapshot's memoized CSR. Every
+        solver takes an optional ``cutoff`` (the distance oracle's
+        proven upper bound); the serial rung seeds its meet bound with
+        it, the native runtime ignores it (the C search loop has no
+        seed seam and is fast enough not to need one)."""
         if self._host_solver is not None:
             return self._host_solver
         with self._lock:
@@ -287,7 +321,9 @@ class _GraphRuntime:
                     self.host_native_graph = ng
                     self.host_backend_resolved = "native"
                     self._host_solver = (
-                        lambda s, d: solve_native_graph(ng, s, d)
+                        lambda s, d, cutoff=None: solve_native_graph(
+                            ng, s, d
+                        )
                     )
                     return self._host_solver
                 except (ImportError, OSError):
@@ -297,14 +333,15 @@ class _GraphRuntime:
 
             row_ptr, col_ind = self.snapshot.csr()
             self._host_solver = (
-                lambda s, d: solve_serial_csr(
-                    self.n, row_ptr, col_ind, s, d
+                lambda s, d, cutoff=None: _solve_serial_cutoff_checked(
+                    self.n, row_ptr, col_ind, s, d, cutoff
                 )
             )
             self.host_backend_resolved = "serial"
             return self._host_solver
 
-    def solve_serial_one(self, src: int, dst: int) -> BFSResult:
+    def solve_serial_one(self, src: int, dst: int,
+                         cutoff: int | None = None) -> BFSResult:
         """The bottom of the fallback ladder: the pure-NumPy serial
         oracle over the snapshot's CSR — no native runtime, no device
         stack, nothing left to be broken but the graph itself."""
@@ -320,11 +357,12 @@ class _GraphRuntime:
 
                         row_ptr, col_ind = self.snapshot.csr()
                         self._serial_solver = (
-                            lambda s, d: solve_serial_csr(
-                                self.n, row_ptr, col_ind, s, d
+                            lambda s, d, cutoff=None:
+                            _solve_serial_cutoff_checked(
+                                self.n, row_ptr, col_ind, s, d, cutoff
                             )
                         )
-        return self._serial_solver(int(src), int(dst))
+        return self._serial_solver(int(src), int(dst), cutoff=cutoff)
 
 
 class QueryEngine:
@@ -369,6 +407,17 @@ class QueryEngine:
     dist_cache : a :class:`DistanceCache` to SHARE across engines
         (default: a private one). Safe to share because entries are
         namespaced by snapshot content digest (see ``graph_id``).
+    oracle_k : landmark count for an engine-local distance-oracle tier
+        over the inline graph (``bibfs_tpu/oracle``): K landmark BFS
+        trees are built once at construction and consulted BEFORE the
+        distance cache on every submit — exact answers (endpoint is a
+        landmark, tight bounds, provably-disconnected pair) resolve
+        with no queueing and no solver (``route="oracle"``), and a
+        non-exact consult attaches its upper bound as a search cutoff
+        for the serial host rung. Store-backed engines get their
+        oracles FROM the store (``GraphStore(oracle_k=...)`` — the
+        store owns the index lifecycle across updates and hot-swaps),
+        so combining ``oracle_k`` with ``store=`` is an error.
     graph_id : distance-cache namespace override for the default graph.
         Default: the snapshot's content digest — two engines over the
         same graph share entries, engines over different graphs cannot
@@ -420,6 +469,7 @@ class QueryEngine:
         device_batches: bool | None = None,
         exec_cache: ExecutableCache | None = None,
         dist_cache: DistanceCache | None = None,
+        oracle_k: int | None = None,
         graph_id=None,
         device=None,
         obs_label: str | None = None,
@@ -439,6 +489,15 @@ class QueryEngine:
             )
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        if oracle_k is not None:
+            if store is not None:
+                raise ValueError(
+                    "oracle_k configures an engine-local oracle over an "
+                    "inline graph; a store-backed engine's oracles come "
+                    "from the store (GraphStore(oracle_k=...))"
+                )
+            if int(oracle_k) < 1:
+                raise ValueError(f"oracle_k must be >= 1, got {oracle_k}")
         self._store = store
         if store is not None:
             if n is not None or edges is not None or pairs is not None:
@@ -489,6 +548,21 @@ class QueryEngine:
             next_instance_label(self._OBS_PREFIX) if obs_label is None
             else obs_label
         )
+        # engine-local distance oracle over the inline graph (the
+        # store-backed variant reads per-graph oracles off the store
+        # at submit time instead — _oracle_for)
+        self._oracle = None
+        if oracle_k is not None:
+            from bibfs_tpu.oracle import DistanceOracle, build_index
+
+            row_ptr, col_ind = snap.csr()
+            self._oracle = DistanceOracle(
+                build_index(
+                    snap.n, row_ptr, col_ind, int(oracle_k),
+                    digest=snap.digest, version=snap.version,
+                ),
+                metrics_label=self.obs_label,
+            )
         self.dist_cache = (
             DistanceCache(entries=cache_entries,
                           metrics_label=self.obs_label)
@@ -569,6 +643,7 @@ class QueryEngine:
         # bank's read-modify-write indirection in the hot loop)
         self._c_queries = self.counters.cell("queries")
         self._c_trivial = self.counters.cell("trivial")
+        self._c_oracle = self.counters.cell("oracle_served")
         self._c_cache_served = self.counters.cell("cache_served")
         self._c_host_queries = self.counters.cell("host_queries")
         self._c_overlay = self.counters.cell("overlay_queries")
@@ -670,6 +745,34 @@ class QueryEngine:
             return None
         return self._store.overlay(name)
 
+    def _oracle_for(self, name):
+        """The distance oracle serving ``name`` right now, or None.
+        Store-backed engines read the store's per-graph oracle (whose
+        follow-the-graph gen check guarantees it describes the CURRENT
+        live edge state, pending overlay included — which is why the
+        consult may run BEFORE the overlay route); inline engines use
+        their construction-time oracle over the one immutable graph."""
+        if self._store is None:
+            return self._oracle
+        return self._store.oracle(name)
+
+    def _consult_oracle(self, t: _Pending, name) -> bool:
+        """Consult the oracle tier for one submitted query. True =
+        served exactly (``t.result`` set, ``route="oracle"``); False =
+        fall through (with ``t.cutoff`` armed when the consult produced
+        a usable upper bound)."""
+        orc = self._oracle_for(name)
+        if orc is None:
+            return False
+        ans = orc.consult(t.src, t.dst)
+        if ans is None:
+            return False
+        if ans.result is not None:
+            t.result = ans.result
+            return True
+        t.cutoff = ans.ub
+        return False
+
     @property
     def n(self) -> int:
         """Vertex count of the bound flush graph (outside a flush: the
@@ -719,6 +822,12 @@ class QueryEngine:
         if src == dst:
             self._c_trivial.inc()
             t.result = BFSResult(True, 0, [src], src, 0.0, 0, 0)
+            return t
+        # the oracle tier answers BEFORE the distance cache (and before
+        # the overlay route: a store oracle is only ever returned when
+        # its index describes the current live graph, overlay included)
+        if self._consult_oracle(t, name):
+            self._c_oracle.inc()
             return t
         if self._overlay_pending(name) is not None:
             hit = None
@@ -1030,8 +1139,25 @@ class QueryEngine:
 
         return jax.default_backend() != "cpu"
 
+    @staticmethod
+    def _cutoffs_for(pairs, unique):
+        """Per-pair oracle cutoffs for a host flush (None when no
+        ticket in the flush carried one — the common case costs one
+        list pass). Duplicate tickets of one pair share the tightest
+        bound any of them was armed with."""
+        cutoffs = [
+            min(
+                (t.cutoff for t in unique[key] if t.cutoff is not None),
+                default=None,
+            )
+            for key in pairs
+        ]
+        return cutoffs if any(c is not None for c in cutoffs) else None
+
     def _flush_host(self, pairs, unique) -> None:
-        results = self._solve_host_isolated(pairs)
+        results = self._solve_host_isolated(
+            pairs, self._cutoffs_for(pairs, unique)
+        )
         n_ok = self._deliver_host_results(
             pairs, results,
             lambda key, res: self._resolve(unique[key], *key, res),
@@ -1068,7 +1194,7 @@ class QueryEngine:
             resolve_ok((src, dst), res)
         return len(ok_idx)
 
-    def _solve_host_isolated(self, pairs):
+    def _solve_host_isolated(self, pairs, cutoffs=None):
         """The host route with failure isolation: the whole batch first
         (``_solve_host``, zero extra cost when nothing fails); on
         failure, BISECT — halves re-solve independently, so a poison
@@ -1076,33 +1202,39 @@ class QueryEngine:
         that are actually bad. A failing singleton gets one last rung
         (the NumPy serial oracle, independent of both the native
         runtime and the device stack) and only then a structured
-        :class:`QueryError`. Returns one ``BFSResult | QueryError`` per
-        pair; never raises."""
+        :class:`QueryError`. ``cutoffs`` (oracle upper bounds, aligned
+        with ``pairs``) ride the recursion. Returns one ``BFSResult |
+        QueryError`` per pair; never raises."""
         try:
-            return self._solve_host(pairs)
+            return self._solve_host(pairs, cutoffs)
         except Exception as exc:
             if len(pairs) == 1:
                 self._note_fallback("host", "serial")
                 try:
                     src, dst = pairs[0]
-                    return [self._solve_serial_one(src, dst)]
+                    return [self._solve_serial_one(
+                        src, dst, cutoffs[0] if cutoffs else None
+                    )]
                 except Exception as exc2:
                     return [to_query_error(exc2, pairs[0])]
             self._res_cells.bisections.inc()
             mid = len(pairs) // 2
             del exc  # halves re-derive their own failure (or succeed)
+            c_lo = cutoffs[:mid] if cutoffs else None
+            c_hi = cutoffs[mid:] if cutoffs else None
             return (
-                self._solve_host_isolated(pairs[:mid])
-                + self._solve_host_isolated(pairs[mid:])
+                self._solve_host_isolated(pairs[:mid], c_lo)
+                + self._solve_host_isolated(pairs[mid:], c_hi)
             )
 
-    def _solve_serial_one(self, src: int, dst: int) -> BFSResult:
+    def _solve_serial_one(self, src: int, dst: int,
+                          cutoff: int | None = None) -> BFSResult:
         """The bottom of the fallback ladder: the pure-NumPy serial
         oracle over the bound graph's CSR — no native runtime, no device
         stack, nothing left to be broken but the graph itself. (A thin
         seam over the runtime so chaos tests can break this rung per
         engine.)"""
-        return self._current_rt().solve_serial_one(src, dst)
+        return self._current_rt().solve_serial_one(src, dst, cutoff)
 
     def _resolve_error(self, tickets, err: QueryError) -> None:
         """Fail exactly these tickets with a structured error (their
@@ -1147,12 +1279,14 @@ class QueryEngine:
     # dispatch is the measured latency winner there
     HOST_BATCH_MIN = 4
 
-    def _solve_host(self, pairs) -> list[BFSResult]:
+    def _solve_host(self, pairs, cutoffs=None) -> list[BFSResult]:
         """Solve ``pairs`` on the host route: the threaded native C
         batch (one GIL-free ctypes call, queries striped over C worker
         threads — ``solvers/native.solve_batch_native_graph``) when the
         native runtime carries the route and the flush is big enough to
-        amortize it, else the per-query solver loop."""
+        amortize it, else the per-query solver loop. ``cutoffs``
+        (oracle upper bounds) reach the per-query solvers; the C batch
+        ignores them (no seed seam in the C search loop)."""
         with span("host_batch", batch=len(pairs)):
             if self._faults is not None:
                 self._faults.fire("host_batch", pairs)
@@ -1173,7 +1307,12 @@ class QueryEngine:
                     solver(src, dst) if (r.found and r.path is None) else r
                     for (src, dst), r in zip(pairs, results)
                 ]
-            return [solver(src, dst) for src, dst in pairs]
+            if cutoffs is None:
+                return [solver(src, dst) for src, dst in pairs]
+            return [
+                solver(src, dst, cutoff=c)
+                for (src, dst), c in zip(pairs, cutoffs)
+            ]
 
     def _resolve(self, tickets, src, dst, res: BFSResult) -> None:
         self.dist_cache.put_result(
@@ -1258,6 +1397,11 @@ class QueryEngine:
                     else sorted(self._runtimes)
                 ),
             },
+            # the engine-local inline oracle (store-backed engines
+            # report per-graph oracles through store.stats() instead)
+            "oracle": (
+                None if self._oracle is None else self._oracle.stats()
+            ),
             "resilience": {
                 **self._res_cells.snapshot(),
                 "breaker": self._breaker.snapshot(),

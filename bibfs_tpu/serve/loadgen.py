@@ -57,6 +57,61 @@ def sample_query_pairs(n: int, q: int, seed: int = 0) -> np.ndarray:
     return pairs
 
 
+def sample_skewed_pairs(
+    n: int, q: int, *, seed: int = 0, skew: float = 1.1,
+    repeat_fraction: float = 0.25, pool: int = 64,
+    degrees=None,
+) -> np.ndarray:
+    """The serving-shaped workload: ``q`` (src, dst) pairs whose endpoint
+    popularity is Zipf-distributed and whose pair stream is repeat-heavy
+    — the "millions of users" traffic the distance-oracle tier exists
+    for, seeded and fully reproducible (the ``--pair-skew`` mode on
+    ``bench.py --serve-load`` / ``--serve-oracle``).
+
+    - **endpoint skew**: each endpoint is drawn by Zipf rank
+      (``P(rank r) ∝ r^-skew``) over the vertices ranked by
+      ``(degree desc, id)`` when ``degrees`` is given (ids alone
+      otherwise) — hot traffic hammers the high-degree core, which is
+      exactly the set landmark selection seeds from
+      (``oracle/landmarks.py``: same ranking key, by construction);
+    - **pair repeats**: ``repeat_fraction`` of the stream re-issues
+      pairs from a hot pool of the first ``pool`` sampled pairs, with
+      the pool itself Zipf-weighted — repeat AND near-repeat traffic
+      (same hub, varying far endpoint) in one stream.
+
+    Self-pairs are re-ranked away, so every returned pair is
+    non-trivial. Returns ``int64 [q, 2]``.
+    """
+    if q < 1:
+        return np.zeros((0, 2), dtype=np.int64)
+    rng = np.random.default_rng(seed)
+    order = (
+        np.lexsort((np.arange(n), -np.asarray(degrees)))
+        if degrees is not None else np.arange(n)
+    )
+    w = 1.0 / np.power(np.arange(1, n + 1, dtype=np.float64), float(skew))
+    w /= w.sum()
+    ranks = rng.choice(n, size=(q, 2), p=w)
+    same = ranks[:, 0] == ranks[:, 1]
+    while same.any():  # re-rank the colliding endpoint (stays skewed)
+        ranks[same, 1] = rng.choice(n, size=int(same.sum()), p=w)
+        same = ranks[:, 0] == ranks[:, 1]
+    pairs = order[ranks].astype(np.int64)
+    pool = int(min(pool, q))
+    if pool > 0 and repeat_fraction > 0 and q > pool:
+        hot = pairs[:pool].copy()
+        wp = 1.0 / np.power(
+            np.arange(1, pool + 1, dtype=np.float64), float(skew)
+        )
+        wp /= wp.sum()
+        mask = rng.random(q) < float(repeat_fraction)
+        mask[:pool] = False  # the pool itself stays as drawn
+        m = int(mask.sum())
+        if m:
+            pairs[mask] = hot[rng.choice(pool, size=m, p=wp)]
+    return pairs
+
+
 def _latency_hist(lats_s: list[float]) -> dict:
     """The full per-rate latency distribution, exported through the
     shared observability histogram type
@@ -903,6 +958,371 @@ def run_churn(
     finally:
         engine.close()
         store.close()
+
+
+def run_oracle(
+    n,
+    edges,
+    *,
+    queries: int = 2000,
+    oracle_k: int = 16,
+    skew: float = 1.3,
+    repeat_fraction: float = 0.25,
+    hit_rate_min: float = 0.30,
+    speedup_min: float | None = 3.0,
+    swap_adds: int = 24,
+    swap_dels: int = 8,
+    flush_threshold: int = 8,
+    max_batch: int = 256,
+    index_timeout_s: float = 120.0,
+    seed: int = 0,
+    **engine_kwargs,
+) -> dict:
+    """The distance-oracle skew soak (``bench.py --serve-oracle``):
+    repeat-heavy Zipf traffic (:func:`sample_skewed_pairs`) served
+    through two otherwise-identical store-backed engines — one with the
+    landmark oracle tier, one without — then a mid-traffic live update
+    + forced hot-swap against the oracle engine. The A/B runs drive the
+    synchronous engine closed-loop (submit stream + self-flushing
+    batches: each side's best throughput configuration, so the ratio
+    measures the tier, not producer-thread scheduling; the pipelined
+    engine's oracle route is covered by the serving tests). The four
+    claims the tier makes, all gated:
+
+    1. **exactness** — every answer of the oracle run (oracle-served or
+       fallen-through) equals a fresh from-scratch serial BFS on the
+       graph state it was submitted against; the tier never guesses;
+    2. **hit rate** — ``route="oracle"`` serves at least
+       ``hit_rate_min`` of the skewed stream (the landmark set is
+       degree-seeded, the hot endpoints are degree-ranked: the design
+       point, measured);
+    3. **throughput** — the oracle engine's full-stream qps is at least
+       ``speedup_min`` x the no-oracle engine's on the SAME traffic
+       (``None`` skips the gate and just reports the ratio — the
+       ``--quick`` CI shape, where solve cost on a tiny graph is
+       comparable to per-query overhead and the ratio is noise);
+    4. **zero stale answers across a hot-swap** — an update batch
+       (hub-shortcut adds + hub-edge deletes, chosen so ground-truth
+       answers actually change: ``changed_answers`` must be > 0 or the
+       gate would be vacuous) lands mid-run, a forced compaction
+       hot-swaps the snapshot from a side thread UNDER the traffic, and
+       every post-update answer must match ground truth on the
+       POST-update graph — deletes invalidate the index immediately
+       (gen bump), the rebuilt index must answer for the new snapshot
+       only, and a final phase confirms the rebuilt index actually
+       serves (``route="oracle"`` hits > 0 on post-swap traffic).
+
+    Returns the machine-readable ``bench_oracle.json`` payload (``ok``
+    aggregates the gates; zero lost/stranded tickets throughout is an
+    implicit fifth gate)."""
+    from bibfs_tpu.graph.csr import build_csr, canonical_pairs
+    from bibfs_tpu.serve.engine import QueryEngine
+    from bibfs_tpu.solvers.serial import solve_serial_csr
+    from bibfs_tpu.store import GraphStore
+
+    t_setup = time.perf_counter()
+    cpairs = canonical_pairs(n, edges)
+    csr = build_csr(n, pairs=cpairs)
+    deg = (csr[0][1:] - csr[0][:-1]).astype(np.int64)
+    traffic = sample_skewed_pairs(
+        n, queries, seed=seed, skew=skew,
+        repeat_fraction=repeat_fraction, degrees=deg,
+    )
+
+    def truth_solver(c):
+        """A fresh per-pair ground-truth BFS outside the engines under
+        test — no cache, no oracle, no batching. The native C runtime
+        when it loads (the soak graph is sized so a BFS costs real
+        time; a full NumPy-serial truth pass would dwarf the
+        measurement), else the NumPy serial solver; either way a
+        seeded subsample is cross-checked against ``solve_serial_csr``
+        below, so the truth source itself is audited per run."""
+        try:
+            from bibfs_tpu.solvers.native import (
+                NativeGraph, solve_native_graph,
+            )
+
+            # the ctypes ABI is exact about dtypes (int64 row_ptr,
+            # int32 col_ind); the python-side CSR carries int64 columns
+            ng = NativeGraph(
+                n,
+                np.ascontiguousarray(c[0], dtype=np.int64),
+                np.ascontiguousarray(c[1], dtype=np.int32),
+            )
+            return lambda s, d: solve_native_graph(ng, s, d)
+        except (ImportError, OSError):
+            return lambda s, d: solve_serial_csr(n, *c, s, d)
+
+    def truth_for(pairs, c, solver=None):
+        solver = truth_solver(c) if solver is None else solver
+        out = {}
+        for s, d in pairs:
+            key = (int(s), int(d))
+            if key not in out:
+                out[key] = solver(*key)
+        return out
+
+    def crosscheck(truth, c, rng, sample=32):
+        """Audit the truth table: ``sample`` random entries recomputed
+        with the NumPy serial solver must agree exactly."""
+        keys = list(truth)
+        pick = rng.choice(len(keys), size=min(sample, len(keys)),
+                          replace=False)
+        bad = []
+        for i in pick:
+            s, d = keys[int(i)]
+            ref = solve_serial_csr(n, *c, s, d)
+            got = truth[(s, d)]
+            if got.found != ref.found or (
+                ref.found and got.hops != ref.hops
+            ):
+                bad.append(
+                    f"truth {s}->{d}: {got.found}/{got.hops} != "
+                    f"serial {ref.found}/{ref.hops}"
+                )
+        return bad
+
+    def verify_against(pairs, results, truth, tag):
+        bad = []
+        for (s, d), res in zip(pairs, results):
+            s, d = int(s), int(d)
+            ref = truth[(s, d)]
+            if res is None:
+                bad.append(f"{tag} {s}->{d}: unresolved")
+            elif res.found != ref.found or (
+                ref.found and res.hops != ref.hops
+            ):
+                bad.append(
+                    f"{tag} {s}->{d}: {res.found}/{res.hops} != "
+                    f"{ref.found}/{ref.hops}"
+                )
+        return bad
+
+    def drive_max(engine, pairs, force_at=None, force_fn=None):
+        """Closed-loop full-speed submit stream (oracle/cache hits
+        resolve inline, everything else batches and self-flushes at
+        ``max_batch``), optional side-thread store mutation fired at
+        index ``force_at`` — the mid-traffic hot-swap. Returns
+        (results, elapsed_s, lost)."""
+        forcer = None
+        t0 = time.perf_counter()
+        tickets = []
+        for i, (s, d) in enumerate(pairs):
+            if force_at is not None and i == force_at:
+                forcer = threading.Thread(
+                    target=force_fn, name="bibfs-oracle-force-swap",
+                    daemon=True,
+                )
+                forcer.start()
+            tickets.append(engine.submit(int(s), int(d)))
+        engine.flush()
+        elapsed = time.perf_counter() - t0
+        if forcer is not None:
+            forcer.join(timeout=60.0)
+        results, lost = [], 0
+        for t in tickets:
+            if t.error is not None or t.result is None:
+                results.append(None)
+                lost += 1
+            else:
+                results.append(t.result)
+        return results, elapsed, lost
+
+    truth1 = truth_for(traffic, csr)
+    mm_truth = crosscheck(truth1, csr, np.random.default_rng(seed + 7))
+    warm = sample_query_pairs(n, 4 * flush_threshold, seed=seed + 99)
+    warm = [(int(s), int(d)) for s, d in warm]
+    engine_conf = dict(
+        flush_threshold=flush_threshold, max_batch=max_batch,
+        **engine_kwargs,
+    )
+
+    # ---- baseline: the same store/engine stack, oracle tier OFF ------
+    store_b = GraphStore()
+    store_b.add("g", n, pairs=cpairs)
+    eng_b = QueryEngine(store=store_b, graph="g", **engine_conf)
+    try:
+        eng_b.query_many(warm)
+        res_b, el_b, lost_b = drive_max(eng_b, traffic)
+        stats_b = eng_b.stats()
+    finally:
+        eng_b.close()
+        store_b.close()
+    mm_base = verify_against(traffic, res_b, truth1, "base")
+    qps_base = len(traffic) / el_b if el_b > 0 else None
+
+    # ---- oracle run: same stack + the landmark tier ------------------
+    store_o = GraphStore(oracle_k=oracle_k, oracle_seed=seed)
+    store_o.add("g", n, pairs=cpairs)
+    index_ready = store_o.wait_for_index("g", timeout=index_timeout_s)
+    eng_o = QueryEngine(store=store_o, graph="g", **engine_conf)
+    try:
+        eng_o.query_many(warm)
+        served_0 = eng_o.stats()["oracle_served"]
+        res_o, el_o, lost_o = drive_max(eng_o, traffic)
+        served_a = eng_o.stats()["oracle_served"] - served_0
+        mm_oracle = verify_against(traffic, res_o, truth1, "oracle")
+        qps_oracle = len(traffic) / el_o if el_o > 0 else None
+        hit_rate = served_a / len(traffic) if traffic.size else 0.0
+        speedup = (
+            round(qps_oracle / qps_base, 3)
+            if qps_base and qps_oracle else None
+        )
+
+        # ---- mid-traffic update + forced hot-swap --------------------
+        und = cpairs[cpairs[:, 0] < cpairs[:, 1]]
+        live = set(map(tuple, und.tolist()))
+        rng = np.random.default_rng(seed + 1)
+        order = np.lexsort((np.arange(n), -deg))
+        hubs = [int(v) for v in order[: max(4, oracle_k // 2)]]
+        hub_edges = [
+            e for e in map(tuple, und.tolist())
+            if e[0] in hubs or e[1] in hubs
+        ]
+        rng.shuffle(hub_edges)
+        dels = [tuple(int(x) for x in e)
+                for e in hub_edges[: max(0, int(swap_dels))]]
+        adds, tries = [], 0
+        pend = set(dels)
+        while len(adds) < int(swap_adds) and tries < 20000:
+            tries += 1
+            h = hubs[int(rng.integers(0, len(hubs)))]
+            v = int(rng.integers(0, n))
+            if v == h:
+                continue
+            e = (h, v) if h < v else (v, h)
+            if e in live and e not in pend:
+                continue
+            if e in adds or e in pend:
+                continue
+            adds.append(e)
+        live2 = (live - set(dels)) | set(adds)
+        csr2 = build_csr(n, np.array(sorted(live2), dtype=np.int64))
+
+        traffic_b = sample_skewed_pairs(
+            n, max(queries // 2, 50), seed=seed + 2, skew=skew,
+            repeat_fraction=repeat_fraction, degrees=deg,
+        )
+        truth2 = truth_for(traffic_b, csr2)
+        mm_truth.extend(
+            crosscheck(truth2, csr2, np.random.default_rng(seed + 8))
+        )
+        truth_b1 = truth_for(traffic_b, csr)
+        changed = sum(
+            1 for key, ref in truth2.items()
+            if (ref.found, ref.hops)
+            != (truth_b1[key].found, truth_b1[key].hops)
+        )
+
+        # the deletes invalidate the index HERE (gen bump under the
+        # apply lock): pre-swap phase-B queries must fall through to
+        # the exact overlay/solver routes, never a stale index
+        store_o.update("g", adds=adds, dels=dels)
+        served_b0 = eng_o.stats()["oracle_served"]
+        res_sw, el_sw, lost_sw = drive_max(
+            eng_o, traffic_b,
+            force_at=max(1, len(traffic_b) // 3),
+            force_fn=lambda: store_o.compact("g"),
+        )
+        mm_swap = verify_against(traffic_b, res_sw, truth2, "swap")
+        served_swap = eng_o.stats()["oracle_served"] - served_b0
+
+        # ---- post-swap: the REBUILT index must serve v2 exactly ------
+        index2_ready = store_o.wait_for_index(
+            "g", timeout=index_timeout_s
+        )
+        traffic_c = sample_skewed_pairs(
+            n, max(queries // 4, 50), seed=seed + 3, skew=skew,
+            repeat_fraction=repeat_fraction, degrees=deg,
+        )
+        truth_c = truth_for(traffic_c, csr2)
+        served_c0 = eng_o.stats()["oracle_served"]
+        res_c, el_c, lost_c = drive_max(eng_o, traffic_c)
+        served_c = eng_o.stats()["oracle_served"] - served_c0
+        mm_post = verify_against(traffic_c, res_c, truth_c, "post")
+
+        stats_o = eng_o.stats()
+        store_stats = store_o.stats()
+        orc_stats = store_stats["graphs"]["g"]["oracle"]
+        stranded = eng_o.pending  # post-flush: anything left is a bug
+        lost = lost_o + lost_sw + lost_c
+        out = {
+            "n": int(n),
+            "queries": int(len(traffic)),
+            "oracle_k": int(oracle_k),
+            "skew": float(skew),
+            "repeat_fraction": float(repeat_fraction),
+            "traffic": {
+                "unique_pairs": len(truth1),
+                "swap_queries": int(len(traffic_b)),
+                "post_swap_queries": int(len(traffic_c)),
+            },
+            "baseline": {
+                "qps": None if qps_base is None else round(qps_base, 1),
+                "elapsed_s": round(el_b, 4),
+                "host_queries": stats_b["host_queries"],
+                "cache_served": stats_b["cache_served"],
+                "mismatches": mm_base[:10],
+            },
+            "truth_crosscheck_mismatches": mm_truth[:10],
+            "oracle": {
+                "qps": None if qps_oracle is None
+                else round(qps_oracle, 1),
+                "elapsed_s": round(el_o, 4),
+                "served": int(served_a),
+                "hit_rate": round(hit_rate, 4),
+                "hits_by_kind": orc_stats.get("hits"),
+                "host_queries": stats_o["host_queries"],
+                "cache_served": stats_o["cache_served"],
+                "index": orc_stats,
+                "mismatches": mm_oracle[:10],
+            },
+            "speedup": speedup,
+            "swap": {
+                "adds": len(adds),
+                "dels": len(dels),
+                "changed_answers": int(changed),
+                "oracle_served_during": int(served_swap),
+                "oracle_served_post": int(served_c),
+                "index2_ready": bool(index2_ready),
+                "version": store_stats["graphs"]["g"]["version"],
+                "swaps": store_stats["graphs"]["g"]["swaps"],
+                "mismatches": (mm_swap + mm_post)[:10],
+            },
+            "tickets": {
+                "submitted": int(
+                    len(traffic) * 2 + len(traffic_b) + len(traffic_c)
+                ),
+                "lost": int(lost + lost_b),
+                "stranded_outstanding": int(stranded),
+            },
+            "setup_to_drain_s": round(
+                time.perf_counter() - t_setup, 3
+            ),
+            # the gates
+            "index_ready": bool(index_ready),
+            "exact": not mm_oracle and not mm_base and not mm_truth,
+            "hit_rate_ok": hit_rate >= float(hit_rate_min),
+            "speedup_ok": (
+                True if speedup_min is None
+                else bool(speedup is not None
+                          and speedup >= float(speedup_min))
+            ),
+            "zero_stale": (
+                not mm_swap and not mm_post and changed > 0
+                and index2_ready and served_c > 0
+            ),
+            "zero_lost": lost + lost_b == 0 and stranded == 0,
+        }
+        out["ok"] = bool(
+            out["index_ready"] and out["exact"] and out["hit_rate_ok"]
+            and out["speedup_ok"] and out["zero_stale"]
+            and out["zero_lost"]
+        )
+        return out
+    finally:
+        eng_o.close()
+        store_o.close()
 
 
 def _validate(csr, res, s, d) -> bool:

@@ -383,6 +383,21 @@ class PipelinedQueryEngine(QueryEngine):
             self._finish_ticket(t, BFSResult(True, 0, [src], src, 0.0, 0, 0))
             self.latency.record(t.t_done - t.t_submit)
             return t
+        # the oracle tier answers BEFORE the distance cache, at submit
+        # time (no queueing, no flusher handoff): the consult is two
+        # int16 row reads over an immutable index, and a store oracle is
+        # only returned when its index describes the CURRENT live graph
+        # (overlay included), so it may also answer ahead of the overlay
+        # route. A non-exact consult arms t.cutoff for the host rungs.
+        if self._consult_oracle(t, name):
+            with self._lock:
+                if self._closed:
+                    raise RuntimeError("engine is closed")
+                self._c_queries.inc()
+                self._c_oracle.inc()
+            self._finish_ticket(t, t.result)
+            self.latency.record(t.t_done - t.t_submit)
+            return t
         if not self._queue and self._overlay_pending(name) is None:
             # idle fast path: a cache hit answers inline with ~0 latency.
             # Under load the lookup moves to the flusher (_serve_cached,
@@ -798,7 +813,10 @@ class PipelinedQueryEngine(QueryEngine):
                     self._note_fallback("device", "host")
                     with span("recover_host", batch=len(pairs)):
                         self._deliver_host(
-                            pairs, unique, self._solve_host_isolated(pairs)
+                            pairs, unique, self._solve_host_isolated(
+                                pairs,
+                                self._cutoffs_for(pairs, unique),
+                            )
                         )
                     return
                 self._breaker.record_success()
@@ -843,7 +861,9 @@ class PipelinedQueryEngine(QueryEngine):
         try:
             self.stages.enter()
             try:
-                results = self._solve_host_isolated(pairs)
+                results = self._solve_host_isolated(
+                    pairs, self._cutoffs_for(pairs, unique)
+                )
             finally:
                 self.stages.exit()
             rt.snapshot.retain()  # the resolve job banks on THIS snapshot
@@ -878,12 +898,12 @@ class PipelinedQueryEngine(QueryEngine):
                 t_launch, sum(len(unique[p]) for p in pairs)
             )
 
-    def _solve_host_isolated(self, pairs):
+    def _solve_host_isolated(self, pairs, cutoffs=None):
         # serialize ALL host solving (module comment on
         # _host_solve_lock): flusher host batches and finish-worker
         # recovery share non-thread-safe native scratch
         with self._host_solve_lock:
-            return super()._solve_host_isolated(pairs)
+            return super()._solve_host_isolated(pairs, cutoffs)
 
     # the resilience cells are the registry's deliberately LOCK-FREE
     # counters (obs/metrics.py: concurrent mutators of one cell must

@@ -70,13 +70,25 @@ def solve_serial(
 
 def solve_serial_csr(
     n: int, row_ptr: np.ndarray, col_ind: np.ndarray, src: int, dst: int,
-    *, telemetry=None,
+    *, telemetry=None, cutoff: int | None = None,
 ) -> BFSResult:
     """``telemetry`` (opt-in, default None = exact pre-telemetry code
     path): a :class:`bibfs_tpu.obs.telemetry.LevelTelemetry` (or True)
     recording per-level frontier/edge stats onto the result's
     ``level_stats`` — serial expansion is frontier-driven, so every
-    recorded direction is "push"."""
+    recorded direction is "push".
+
+    ``cutoff`` is a KNOWN upper bound on the true distance (the
+    distance-oracle's UB): it seeds the meet bound at ``cutoff + 1``,
+    so the provably-correct termination rule (``level_s + level_t >=
+    best``) stops expanding past it instead of exploring to the
+    frontier's natural death. Exact by the same invariant the unseeded
+    rule rests on — any path of length ``d <= cutoff`` has a vertex
+    within ``level_s`` of the source and ``level_t`` of the target once
+    ``level_s + level_t >= d``, so the true distance is recorded as a
+    meet candidate before the seeded bound can trigger. A WRONG (too
+    small) cutoff would make a reachable pair report unreachable;
+    callers must only pass a proven bound."""
     if not (0 <= src < n and 0 <= dst < n):
         raise ValueError(f"src/dst out of range for n={n}")
     if telemetry is not None:
@@ -99,7 +111,7 @@ def solve_serial_csr(
     frontier_s = np.array([src], dtype=np.int64)
     frontier_t = np.array([dst], dtype=np.int64)
     level_s = level_t = 0
-    best = _INF
+    best = _INF if cutoff is None else min(_INF, int(cutoff) + 1)
     meet = -1
     levels = 0
     edges_scanned = 0
@@ -138,7 +150,7 @@ def solve_serial_csr(
                         telemetry.note_meet(levels, meet)
     elapsed = time.perf_counter() - t0
 
-    if best == _INF:
+    if meet < 0:  # no meet recorded (best may hold the cutoff seed)
         res = BFSResult(False, None, None, None, elapsed, levels, edges_scanned)
     else:
         path = _reconstruct(parent_s, parent_t, meet)

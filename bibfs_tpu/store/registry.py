@@ -27,32 +27,57 @@ snapshot, so a flush that grabbed the old overlay keeps answering the
 exact old-base+full-delta graph — which is, by construction, the same
 edge set the new snapshot + rebased overlay describes.
 
+**Distance-oracle tier** (``oracle_k=K``): each graph additionally
+carries a landmark :class:`~bibfs_tpu.oracle.DistanceOracle` built as
+background work off the serving path — the same compaction-style
+discipline: build from a consistent capture off the store lock, commit
+under it only if nothing moved. The follow-the-graph invariant is one
+integer: every mutation of a graph's *live* edge state (an update
+batch, a hot-swap, a compaction commit) bumps ``graph_gen``, every
+index is stamped with the gen it was built for, and :meth:`oracle`
+refuses to return an index whose gen is not current — a stale index can
+never answer for a newer graph, by construction rather than by timing.
+Adds-only update batches are repaired INTO a fresh index synchronously
+(exact — see ``oracle/trees.py``; bounded by ``oracle_repair_max``,
+past which a full rebuild is scheduled instead); a delete invalidates
+the index until the next compaction folds it into a snapshot the
+builder can traverse.
+
 Observability: ``bibfs_store_graphs`` (gauge), ``bibfs_store_swaps_total``
 / ``bibfs_store_compactions_total`` / ``bibfs_store_compact_failures_total``
-(counters, per graph), ``bibfs_store_delta_edges`` (gauge, per graph) in
-the process registry, plus ``store_swap`` / ``store_compact`` trace
-spans.
+(counters, per graph), ``bibfs_store_delta_edges`` (gauge, per graph),
+``bibfs_oracle_index_builds_total`` (counter, per graph) and
+``bibfs_oracle_index_age_seconds`` (gauge, per graph, refreshed at
+scrape time) in the process registry, plus ``store_swap`` /
+``store_compact`` / ``store_index_build`` trace spans.
 """
 
 from __future__ import annotations
 
 import os
 import threading
+import time
+import weakref
 
 from bibfs_tpu.obs.metrics import REGISTRY, next_instance_label
 from bibfs_tpu.obs.trace import span
-from bibfs_tpu.store.delta import DeltaOverlay
+from bibfs_tpu.store.delta import DeltaOverlay, canonical_edge
 from bibfs_tpu.store.snapshot import GraphSnapshot
 
 
 class _Entry:
     """One named graph's mutable slot: current snapshot, pending
-    overlay, and the compaction serializer (one compaction per graph at
+    overlay, the compaction serializer (one compaction per graph at
     a time — a forced REPL ``swap`` racing a threshold-triggered
-    background job must not double-build)."""
+    background job must not double-build), and the distance-oracle
+    state (current oracle + its live-graph generation tag, the in-
+    flight builder, per-graph build accounting)."""
 
     __slots__ = ("snapshot", "overlay", "compactor", "compact_lock",
-                 "swaps", "compactions", "compact_failures")
+                 "swaps", "compactions", "compact_failures",
+                 "graph_gen", "oracle", "oracle_builder", "oracle_cells",
+                 "index_builds", "index_aborts", "index_repairs",
+                 "index_failures")
 
     def __init__(self, snapshot: GraphSnapshot):
         self.snapshot = snapshot
@@ -62,6 +87,16 @@ class _Entry:
         self.swaps = 0
         self.compactions = 0
         self.compact_failures = 0
+        # live-graph generation: bumped on every update batch, swap and
+        # compaction commit — the oracle's follow-the-graph tag
+        self.graph_gen = 1
+        self.oracle = None  # DistanceOracle | None
+        self.oracle_builder: threading.Thread | None = None
+        self.oracle_cells: dict | None = None
+        self.index_builds = 0
+        self.index_aborts = 0
+        self.index_repairs = 0
+        self.index_failures = 0
 
 
 class GraphStore:
@@ -72,11 +107,22 @@ class GraphStore:
     compact_threshold : pending delta edges at which a background
         compaction (rebuild + swap) is triggered. ``None`` disables
         auto-compaction (explicit :meth:`compact` / :meth:`swap` only).
+    oracle_k : landmarks per graph for the distance-oracle tier
+        (module docstring). ``None`` (default) disables the tier —
+        :meth:`oracle` then always returns None and nothing is built.
+    oracle_repair_max : adds folded into one index by incremental
+        repair before a full rebuild is scheduled instead (the rebuild
+        threshold; repair is exact either way, this bounds the drift a
+        single index accumulates before re-selection of landmarks).
+    oracle_seed : landmark-selection seed (deterministic rebuilds).
     obs_label : the ``store=`` label value this store's registry cells
         carry (default: a process-unique ``store-N``).
     """
 
     def __init__(self, *, compact_threshold: int | None = 256,
+                 oracle_k: int | None = None,
+                 oracle_repair_max: int = 64,
+                 oracle_seed: int = 0,
                  obs_label: str | None = None):
         self.compact_threshold = (
             None if compact_threshold is None else int(compact_threshold)
@@ -116,6 +162,45 @@ class GraphStore:
             "the next update re-triggers)",
             ("store", "graph"),
         )
+        self.oracle_k = None if oracle_k is None else int(oracle_k)
+        if self.oracle_k is not None and self.oracle_k < 1:
+            raise ValueError(f"oracle_k must be >= 1, got {oracle_k}")
+        self.oracle_repair_max = int(oracle_repair_max)
+        self.oracle_seed = int(oracle_seed)
+        self._c_index_builds = REGISTRY.counter(
+            "bibfs_oracle_index_builds_total",
+            "Full landmark-index builds committed per graph "
+            "(incremental repairs not included)",
+            ("store", "graph"),
+        )
+        self._g_index_age = REGISTRY.gauge(
+            "bibfs_oracle_index_age_seconds",
+            "Age of the graph's CURRENT landmark index (0 when the "
+            "graph has none); refreshed at scrape time",
+            ("store", "graph"),
+        )
+        if self.oracle_k is not None:
+            # scrape-time age refresh, weakly bound like the engines'
+            # health collector: a dead store must unregister itself, not
+            # pin its graphs for process lifetime
+            self_ref = weakref.ref(self)
+
+            def _collect_index_age():
+                st = self_ref()
+                if st is None:
+                    return False
+                now = time.time()
+                with st._lock:
+                    for nm, e in st._entries.items():
+                        st._g_index_age.labels(
+                            store=st.obs_label, graph=nm
+                        ).set(
+                            0.0 if e.oracle is None
+                            else max(now - e.oracle.index.built_at, 0.0)
+                        )
+                return True
+
+            REGISTRY.add_collector(_collect_index_age)
 
     # ---- registration -----------------------------------------------
     def add(self, name: str, n: int | None = None, edges=None, *,
@@ -140,7 +225,8 @@ class GraphStore:
             # global stamp remains the fallback for snapshots that never
             # enter a store.)
             snapshot.version = 1
-            self._entries[name] = _Entry(snapshot)
+            entry = _Entry(snapshot)
+            self._entries[name] = entry
             if self._default is None:
                 self._default = name
             self._g_graphs.set(len(self._entries))
@@ -150,6 +236,19 @@ class GraphStore:
             self._g_delta.labels(store=self.obs_label, graph=name).set(0)
             self._c_compactions.labels(store=self.obs_label, graph=name)
             self._c_compact_failures.labels(store=self.obs_label, graph=name)
+            if self.oracle_k is not None:
+                from bibfs_tpu.oracle import oracle_cells
+
+                entry.oracle_cells = oracle_cells(
+                    self._oracle_label(name)
+                )
+                self._c_index_builds.labels(
+                    store=self.obs_label, graph=name
+                )
+                self._g_index_age.labels(
+                    store=self.obs_label, graph=name
+                ).set(0.0)
+        self._kick_oracle(name, entry)
         return snapshot
 
     @classmethod
@@ -219,6 +318,8 @@ class GraphStore:
         ``compact_threshold`` kicks a background compaction. Returns
         ``{"adds": ..., "dels": ..., "compacting": bool}``."""
         name = str(name)
+        adds = [tuple(e) for e in adds]  # consumed twice when the
+        dels = [tuple(e) for e in dels]  # oracle repairs (below)
         while True:
             with self._lock:
                 entry = self._entry(name)
@@ -236,6 +337,12 @@ class GraphStore:
                     # index built: restart against the current state
                     continue
                 counts = overlay.apply(adds, dels)
+                # the live graph changed: the oracle gen moves forward
+                # IN THE SAME locked section as the apply, so no reader
+                # can pair the new edge state with the old index
+                entry.graph_gen += 1
+                gen_after = entry.graph_gen
+                prev_oracle = entry.oracle
                 delta = counts["adds"] + counts["dels"]
                 self._g_delta.labels(
                     store=self.obs_label, graph=name
@@ -249,7 +356,175 @@ class GraphStore:
                     )
                     entry.compactor.start()
                     compacting = True
+            self._oracle_after_update(
+                name, entry, overlay, adds, dels, gen_after, prev_oracle
+            )
             return {**counts, "compacting": compacting}
+
+    # ---- oracle lifecycle --------------------------------------------
+    def _oracle_label(self, name: str) -> str:
+        return f"{self.obs_label}/{name}"
+
+    def oracle(self, name: str):
+        """The graph's :class:`~bibfs_tpu.oracle.DistanceOracle`, or
+        None when disabled / not (yet) built for the CURRENT live edge
+        state — the follow-the-graph read the engines route through: a
+        gen mismatch means the index describes a superseded graph and
+        is simply not returned, so a stale index can never answer."""
+        if self.oracle_k is None:
+            return None
+        with self._lock:
+            entry = self._entry(name)
+            orc = entry.oracle
+            if orc is None or orc.index.gen != entry.graph_gen:
+                return None
+            return orc
+
+    def wait_for_index(self, name: str, timeout: float = 60.0) -> bool:
+        """Block until ``name`` has a current index (True) or the
+        timeout passes (False) — a test/bench aid; serving code never
+        waits, it just falls through to the solvers until the
+        background build commits. Re-kicks the builder if nothing is
+        in flight (e.g. after an aborted build)."""
+        deadline = time.monotonic() + timeout
+        kicked_gen = None
+        while True:
+            if self.oracle(str(name)) is not None:
+                return True
+            if time.monotonic() >= deadline:
+                return False
+            with self._lock:
+                entry = self._entry(str(name))
+                builder = entry.oracle_builder
+                gen = entry.graph_gen
+            # at most one re-kick per live-graph generation: a builder
+            # that declined (pending deletes) or failed would otherwise
+            # be respawned every poll tick for the whole timeout
+            if builder is None and gen != kicked_gen:
+                self._kick_oracle(str(name), entry)
+                kicked_gen = gen
+            time.sleep(0.02)
+
+    def _oracle_after_update(self, name, entry, overlay, adds, dels,
+                             gen_after, prev_oracle) -> None:
+        """Post-batch index maintenance, OFF the store lock: an
+        adds-only batch against a current index repairs into a fresh
+        index (exact — ``oracle/trees.py``) and commits it iff nothing
+        raced; anything else (a delete, a stale/absent index, repair
+        drift past ``oracle_repair_max``) schedules a full background
+        rebuild instead."""
+        if self.oracle_k is None:
+            return
+        prev_ok = (
+            prev_oracle is not None
+            and prev_oracle.index.gen == gen_after - 1
+        )
+        if (dels or not prev_ok
+                or prev_oracle.index.repaired_edges + len(adds)
+                > self.oracle_repair_max):
+            self._kick_oracle(name, entry)
+            return
+        from bibfs_tpu.oracle import DistanceOracle
+
+        n = entry.snapshot.n
+        canon = [canonical_edge(n, u, v) for u, v in adds]
+        del_set, add_adj = overlay.correction()
+        if del_set:
+            # a valid index implies a dels-free overlay (builds and
+            # repairs both refuse one) — defensive: never repair across
+            # a delete, a relaxation through a deleted base edge would
+            # under-count
+            self._kick_oracle(name, entry)
+            return
+        row_ptr, col_ind = entry.snapshot.csr()
+        with span("store_index_build", graph=name, kind="repair",
+                  adds=len(canon)):
+            index = prev_oracle.index.repair_adds(
+                row_ptr, col_ind, add_adj, canon, gen=gen_after
+            )
+        with self._lock:
+            if (entry.graph_gen == gen_after
+                    and entry.oracle is prev_oracle):
+                entry.oracle = DistanceOracle(
+                    index, metrics_label=self._oracle_label(name),
+                    cells=entry.oracle_cells,
+                )
+                entry.index_repairs += 1
+            # else: a racing mutation superseded this repair — its own
+            # maintenance path (which saw a stale index) rebuilds
+
+    def _kick_oracle(self, name, entry) -> None:
+        """Start a background full index build for ``name``'s live
+        graph unless one is already in flight (or the tier is off)."""
+        if self.oracle_k is None:
+            return
+        with self._lock:
+            if (entry.oracle_builder is not None
+                    and entry.oracle_builder.is_alive()):
+                return
+            entry.oracle_builder = threading.Thread(
+                target=self._oracle_job, args=(name, entry),
+                name=f"bibfs-oracle-{name}", daemon=True,
+            )
+            entry.oracle_builder.start()
+
+    def _oracle_job(self, name, entry) -> None:
+        """The background builder: capture a consistent (snapshot,
+        overlay, gen) off the store lock, traverse, commit under it
+        only if the gen still matches — a swap or update landing
+        mid-build ABORTS the commit (the capture is stale truth) and
+        the build retries against the new state a bounded number of
+        times; past that, the next mutation re-kicks."""
+        from bibfs_tpu.oracle import DistanceOracle, build_index
+
+        try:
+            for _attempt in range(3):
+                with self._lock:
+                    snap = entry.snapshot
+                    overlay = entry.overlay
+                    gen = entry.graph_gen
+                if overlay is not None and overlay.stats()["dels"] > 0:
+                    # no exact repair exists across a delete and the
+                    # overlaid graph is not a snapshot: the next
+                    # compaction folds it and re-kicks this builder
+                    return
+                if overlay is not None and overlay.delta_edges > 0:
+                    from bibfs_tpu.graph.csr import build_csr
+
+                    row_ptr, col_ind = build_csr(
+                        snap.n, overlay.merged_edges()
+                    )
+                else:
+                    row_ptr, col_ind = snap.csr()
+                with span("store_index_build", graph=name,
+                          k=self.oracle_k, gen=gen):
+                    index = build_index(
+                        snap.n, row_ptr, col_ind, self.oracle_k,
+                        seed=self.oracle_seed, digest=snap.digest,
+                        version=snap.version, gen=gen,
+                    )
+                with self._lock:
+                    if entry.graph_gen == gen:
+                        entry.oracle = DistanceOracle(
+                            index,
+                            metrics_label=self._oracle_label(name),
+                            cells=entry.oracle_cells,
+                        )
+                        entry.index_builds += 1
+                        self._c_index_builds.labels(
+                            store=self.obs_label, graph=name
+                        ).inc()
+                        return
+                    entry.index_aborts += 1
+        except Exception:
+            # the tier is an accelerator, not a dependency: a failed
+            # build leaves every query on the solver routes — but it
+            # must be VISIBLE (stats), not silent
+            with self._lock:
+                entry.index_failures += 1
+        finally:
+            with self._lock:
+                entry.oracle_builder = None
 
     # ---- compaction + hot-swap ---------------------------------------
     def _compact_job(self, name: str, entry: _Entry) -> None:
@@ -320,6 +595,9 @@ class GraphStore:
                     self._c_compactions.labels(
                         store=self.obs_label, graph=name
                     ).inc()
+            # the swap dropped the old index (gen moved): rebuild for
+            # the fresh snapshot off the serving path
+            self._kick_oracle(name, entry)
             return new
 
     def compact(self, name: str) -> GraphSnapshot:
@@ -339,6 +617,7 @@ class GraphStore:
             old = self._swap_locked(name, entry, snapshot)
             entry.overlay = None
             self._g_delta.labels(store=self.obs_label, graph=name).set(0)
+        self._kick_oracle(name, entry)
         return old
 
     def _swap_locked(self, name: str, entry: _Entry,
@@ -353,6 +632,14 @@ class GraphStore:
                   old_version=old.version):
             entry.snapshot = new
             entry.swaps += 1
+            # the follow-the-graph swap: gen moves with the snapshot in
+            # ONE locked mutation, and the superseded index is dropped
+            # outright (its memory goes with it) — a caller sees either
+            # (old snapshot, old index) or (new snapshot, no index),
+            # never a cross pairing. Callers kick the rebuild after
+            # releasing the lock.
+            entry.graph_gen += 1
+            entry.oracle = None
             self._c_swaps.labels(store=self.obs_label, graph=name).inc()
             old.release()  # the store's reference; flush pins remain
         return old
@@ -372,19 +659,49 @@ class GraphStore:
                     "compactions": entry.compactions,
                     "compact_failures": entry.compact_failures,
                     "compacting": entry.compactor is not None,
+                    "oracle": self._oracle_stats_locked(entry),
                 }
             return {
                 "graphs": graphs,
                 "default": self._default,
                 "compact_threshold": self.compact_threshold,
+                "oracle_k": self.oracle_k,
             }
 
+    def _oracle_stats_locked(self, entry: _Entry) -> dict | None:
+        if self.oracle_k is None:
+            return None
+        orc = entry.oracle
+        current = orc is not None and orc.index.gen == entry.graph_gen
+        out = {
+            "k": self.oracle_k,
+            "ready": current,
+            "gen": entry.graph_gen,
+            "builds": entry.index_builds,
+            "repairs": entry.index_repairs,
+            "aborts": entry.index_aborts,
+            "failures": entry.index_failures,
+            "building": entry.oracle_builder is not None,
+        }
+        if orc is not None:
+            out["index"] = orc.index.stats()
+            out["hits"] = {k: c.value for k, c in orc.cells.items()}
+        elif entry.oracle_cells is not None:
+            out["hits"] = {
+                k: c.value for k, c in entry.oracle_cells.items()
+            }
+        return out
+
     def close(self) -> None:
-        """Join in-flight background compactions (test/shutdown aid)."""
+        """Join in-flight background compactions and index builds
+        (test/shutdown aid)."""
         with self._lock:
             jobs = [
                 e.compactor for e in self._entries.values()
                 if e.compactor is not None
+            ] + [
+                e.oracle_builder for e in self._entries.values()
+                if e.oracle_builder is not None
             ]
         for job in jobs:
             job.join()

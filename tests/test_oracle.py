@@ -1,0 +1,338 @@
+"""The landmark distance-oracle tier (bibfs_tpu/oracle): selection,
+the bitmask-packed multi-source build, bound invariants, consult kind
+taxonomy, and exact incremental repair.
+
+Correctness bar: every distance column of the packed build is
+bit-exact against a per-source serial BFS; ``LB <= d(s, t) <= UB``
+holds for EVERY pair the oracle claims anything about (connected or
+not, property-tested on random graphs); every exact-served kind equals
+ground truth; and ``repair_adds`` after random adds-only batches is
+exactly equivalent to a fresh rebuild over the merged edge set — the
+invariant that lets the store patch a live index instead of rebuilding
+per update batch."""
+
+import numpy as np
+import pytest
+
+from bibfs_tpu.graph.csr import build_csr, canonical_pairs
+from bibfs_tpu.graph.generate import gnp_random_graph, grid_graph
+from bibfs_tpu.oracle import (
+    DistanceOracle,
+    LandmarkIndex,
+    build_index,
+    multi_source_bfs,
+    select_landmarks,
+)
+from bibfs_tpu.oracle.trees import _as_int16_dist
+from bibfs_tpu.solvers.serial import solve_serial_csr
+
+
+def _csr(n, edges):
+    return build_csr(n, pairs=canonical_pairs(n, edges))
+
+
+def _true_dist(n, csr, src):
+    """Single-source BFS distances by repeated serial solves is absurd;
+    do one frontier sweep."""
+    row_ptr, col_ind = csr
+    dist = np.full(n, -1, dtype=np.int64)
+    dist[src] = 0
+    frontier = np.array([src], dtype=np.int64)
+    level = 0
+    while frontier.size:
+        level += 1
+        nbrs = np.concatenate([
+            col_ind[row_ptr[v]:row_ptr[v + 1]] for v in frontier
+        ]) if frontier.size else np.zeros(0, dtype=np.int64)
+        nbrs = np.unique(nbrs)
+        nbrs = nbrs[dist[nbrs] < 0]
+        dist[nbrs] = level
+        frontier = nbrs
+    return dist
+
+
+# ---- the packed multi-source build -----------------------------------
+@pytest.mark.parametrize("n,p,k,seed", [
+    (60, 0.05, 5, 0),
+    (120, 0.02, 9, 1),     # sparse: disconnected components
+    (200, 0.015, 70, 2),   # k > 64: two mask words
+])
+def test_multi_source_bfs_matches_serial(n, p, k, seed):
+    rng = np.random.default_rng(seed)
+    edges = gnp_random_graph(n, p, seed=seed)
+    csr = _csr(n, edges)
+    sources = rng.choice(n, size=k, replace=False)
+    dist = multi_source_bfs(n, *csr, sources)
+    assert dist.shape == (n, k) and dist.dtype == np.int16
+    for j, s in enumerate(sources):
+        np.testing.assert_array_equal(
+            dist[:, j].astype(np.int64), _true_dist(n, csr, int(s)),
+            err_msg=f"column {j} (source {s})",
+        )
+
+
+def test_multi_source_bfs_edge_cases():
+    csr = _csr(4, np.array([[0, 1]]))
+    assert multi_source_bfs(4, *csr, []).shape == (4, 0)
+    with pytest.raises(ValueError):
+        multi_source_bfs(4, *csr, [4])
+    dup = multi_source_bfs(4, *csr, [1, 1])  # duplicate sources fine
+    np.testing.assert_array_equal(dup[:, 0], dup[:, 1])
+
+
+def test_int16_range_guard():
+    d32 = np.array([[0, 1 << 30], [40000, 2]], dtype=np.int32)
+    with pytest.raises(ValueError, match="int16"):
+        _as_int16_dist(d32)
+    ok = _as_int16_dist(np.array([[0, 1 << 30]], dtype=np.int32))
+    assert ok.tolist() == [[0, -1]]  # INF -> -1 sentinel
+
+
+# ---- landmark selection ----------------------------------------------
+def test_selection_deterministic_and_degree_seeded():
+    n = 150
+    edges = gnp_random_graph(n, 0.03, seed=3)
+    csr = _csr(n, edges)
+    a = select_landmarks(n, *csr, 12)
+    b = select_landmarks(n, *csr, 12)
+    np.testing.assert_array_equal(a, b)
+    assert len(set(a.tolist())) == 12
+    # the first pick is the top-(degree, id) vertex — the hot-traffic
+    # alignment contract with loadgen.sample_skewed_pairs
+    deg = csr[0][1:] - csr[0][:-1]
+    order = np.lexsort((np.arange(n), -deg))
+    assert a[0] == order[0]
+
+
+def test_selection_covers_components():
+    """Farthest-point refinement must land landmarks in so-far
+    uncovered components (that is what turns cross-component pairs
+    into exact no-path answers)."""
+    # three disjoint chains: 0-19, 20-39, 40-59
+    chains = [np.array([[b + i, b + i + 1] for i in range(19)])
+              for b in (0, 20, 40)]
+    n, edges = 60, np.concatenate(chains)
+    # chunk=2: the first two picks are degree-ranked (one component),
+    # every later batch is farthest-point — which must jump components
+    # (an uncovered component sorts at "unreached", farther than
+    # anything covered)
+    lms = select_landmarks(n, *_csr(n, edges), 6, chunk=2)
+    comps = {int(v) // 20 for v in lms}
+    assert comps == {0, 1, 2}
+
+
+def test_selection_k_exceeds_n():
+    n, edges = 5, np.array([[0, 1], [1, 2], [2, 3], [3, 4]])
+    lms = select_landmarks(n, *_csr(n, edges), 64)
+    assert sorted(lms.tolist()) == [0, 1, 2, 3, 4]
+    with pytest.raises(ValueError):
+        select_landmarks(n, *_csr(n, edges), 0)
+
+
+# ---- bound invariants (the property test) ----------------------------
+@pytest.mark.parametrize("n,p,k,seed", [
+    (80, 0.04, 8, 10),
+    (150, 0.012, 6, 11),   # supercritical-sparse: many components
+    (150, 0.004, 4, 12),   # subcritical: MOSTLY disconnected pairs
+])
+def test_bounds_sandwich_every_pair(n, p, k, seed):
+    """For every pair the oracle claims anything about:
+    ``LB <= d(s, t) <= UB`` when connected, and a ``disconnected``
+    serve really is disconnected. Exact kinds equal ground truth."""
+    edges = gnp_random_graph(n, p, seed=seed)
+    csr = _csr(n, edges)
+    orc = DistanceOracle(build_index(n, *csr, k))
+    rng = np.random.default_rng(seed)
+    kinds = set()
+    for _ in range(400):
+        s, d = (int(x) for x in rng.choice(n, size=2, replace=False))
+        truth = solve_serial_csr(n, *csr, s, d)
+        ans = orc.consult(s, d)
+        if ans is None:
+            continue  # miss: the oracle claims nothing
+        kinds.add(ans.kind)
+        if ans.kind == "disconnected":
+            assert not truth.found
+            assert ans.result.found is False
+        elif ans.kind == "bounds":
+            assert truth.found, "bounds imply a shared landmark comp"
+            assert ans.lb <= truth.hops <= ans.ub
+            assert ans.result is None
+        else:  # landmark / tight: exact serve
+            assert truth.found and ans.result.hops == truth.hops
+            assert ans.lb == ans.ub == truth.hops
+    assert "bounds" in kinds or "disconnected" in kinds
+
+
+def test_consult_kind_taxonomy():
+    """Crafted graph pinning each kind: path component 0-1-2-3-4,
+    chain 5-6, isolated 7, 8. k=2 -> landmarks in the two big
+    components only."""
+    n = 9
+    edges = np.array([[0, 1], [1, 2], [2, 3], [3, 4], [5, 6]])
+    csr = _csr(n, edges)
+    idx = build_index(n, *csr, 2)
+    # unique metrics label: the registry cells are process-global, a
+    # default-labelled oracle would accumulate other tests' consults
+    orc = DistanceOracle(idx, metrics_label="test-kind-taxonomy")
+    lm = int(idx.landmarks[0])  # in the path component
+    assert idx.is_landmark(lm)
+    other = 4 if lm != 4 else 0
+    a = orc.consult(lm, other)
+    assert a.kind == "landmark" and a.result.hops > 0
+    # tight: some landmark ON a shortest path between two non-landmarks
+    ends = sorted(v for v in (0, 1, 2, 3, 4) if not idx.is_landmark(v))
+    t = orc.consult(ends[0], ends[-1])
+    if t is not None and t.kind == "tight":
+        assert t.result.hops == abs(ends[-1] - ends[0])
+    # cross-component, both reached by some landmark set
+    d = orc.consult(0, 5)
+    assert d.kind == "disconnected" and d.result.found is False
+    # both endpoints in landmark-free components -> pure miss
+    assert orc.consult(7, 8) is None
+    hits = orc.stats()["hits"]
+    assert hits["landmark"] >= 1 and hits["disconnected"] >= 1
+    assert hits["miss"] == 1
+
+
+def test_landmark_endpoint_fast_path_disconnected():
+    """An endpoint that IS a landmark but cannot reach the other
+    endpoint proves disconnection through one matrix cell."""
+    n = 6
+    edges = np.array([[0, 1], [1, 2], [3, 4], [4, 5]])
+    csr = _csr(n, edges)
+    idx = build_index(n, *csr, 2)
+    lm = int(idx.landmarks[0])
+    far = 3 if lm <= 2 else 0  # other component
+    ans = DistanceOracle(idx).consult(lm, far)
+    assert ans.kind == "disconnected" and ans.result.found is False
+
+
+# ---- incremental repair ≡ fresh rebuild ------------------------------
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_repair_adds_equals_fresh_rebuild(seed):
+    """Random adds-only delta batches folded by ``repair_adds`` produce
+    EXACTLY the index a from-scratch rebuild over the merged edge set
+    produces (same landmarks) — including newly-connected components
+    (distances going from unreachable to finite)."""
+    rng = np.random.default_rng(seed)
+    n = 90
+    edges = gnp_random_graph(n, 0.015, seed=seed)  # sparse: components
+    base = canonical_pairs(n, edges)
+    csr = build_csr(n, pairs=base)
+    idx = build_index(n, *csr, 7)
+    live = set(map(tuple, base[base[:, 0] < base[:, 1]].tolist()))
+    add_adj: dict[int, list[int]] = {}
+    added: list[tuple[int, int]] = []
+    for _ in range(3):  # three stacked batches
+        batch = []
+        while len(batch) < 8:
+            u, v = (int(x) for x in rng.choice(n, size=2, replace=False))
+            e = (u, v) if u < v else (v, u)
+            if e in live:
+                continue
+            live.add(e)
+            batch.append(e)
+        for u, v in batch:
+            add_adj.setdefault(u, []).append(v)
+            add_adj.setdefault(v, []).append(u)
+        added.extend(batch)
+        idx = idx.repair_adds(*csr, add_adj, batch)
+    merged = np.array(sorted(live), dtype=np.int64)
+    fresh = build_index(
+        n, *build_csr(n, canonical_pairs(n, merged)), 7,
+        landmarks=idx.landmarks,
+    )
+    np.testing.assert_array_equal(idx.dist, fresh.dist)
+    assert idx.repaired_edges == len(added)
+    assert idx.gen == 3  # one bump per batch
+
+
+def test_repair_is_a_new_index():
+    """Repair returns a NEW immutable index; the original is untouched
+    (a query thread holding it keeps a consistent matrix)."""
+    n = 10
+    edges = np.array([[i, i + 1] for i in range(n - 2)])  # 9 isolated
+    csr = _csr(n, edges)
+    idx = build_index(n, *csr, 2)
+    before = idx.dist.copy()
+    add = [(0, n - 1)]
+    adj = {0: [n - 1], n - 1: [0]}
+    idx2 = idx.repair_adds(*csr, adj, add)
+    assert idx2 is not idx
+    np.testing.assert_array_equal(idx.dist, before)
+    col0 = int(np.where(idx2.landmarks == 0)[0][0]) \
+        if 0 in idx2.lm_col else None
+    if col0 is not None:
+        assert idx2.dist[n - 1, col0] == 1  # newly connected
+
+
+# ---- cutoff-seeded serial solve --------------------------------------
+@pytest.mark.parametrize("seed", [0, 1])
+def test_cutoff_seeded_serial_exact(seed):
+    """Seeding the meet bound with ANY proven upper bound (the
+    oracle's UB, or the exact distance itself) changes nothing about
+    the answer — only the work."""
+    n = 120
+    edges = gnp_random_graph(n, 0.02, seed=seed)
+    csr = _csr(n, edges)
+    orc = DistanceOracle(build_index(n, *csr, 6))
+    rng = np.random.default_rng(seed + 50)
+    for _ in range(60):
+        s, d = (int(x) for x in rng.choice(n, size=2, replace=False))
+        ref = solve_serial_csr(n, *csr, s, d)
+        ans = orc.consult(s, d)
+        for cutoff in {ref.hops, (None if ans is None else ans.ub)}:
+            if cutoff is None or (ref.found and cutoff < ref.hops):
+                continue
+            got = solve_serial_csr(n, *csr, s, d, cutoff=cutoff)
+            assert got.found == ref.found
+            if ref.found:
+                assert got.hops == ref.hops
+                assert got.edges_scanned <= ref.edges_scanned \
+                    or got.edges_scanned == 0
+
+
+def test_cutoff_never_creates_false_unreachable():
+    """A cutoff exactly equal to the true distance must still find the
+    path (the seeded bound is ``cutoff + 1``)."""
+    n = 30
+    edges = np.array([[i, i + 1] for i in range(n - 1)])
+    csr = _csr(n, edges)
+    got = solve_serial_csr(n, *csr, 0, n - 1, cutoff=n - 1)
+    assert got.found and got.hops == n - 1
+
+
+# ---- generators the soak stands on -----------------------------------
+def test_grid_graph_shape_and_perforation():
+    e = grid_graph(5, 4)
+    assert len(e) == 4 * 4 + 5 * 3  # right + down edges
+    n = 20
+    csr = _csr(n, e)
+    deg = csr[0][1:] - csr[0][:-1]
+    assert deg.max() == 4 and deg.min() == 2  # interior vs corner
+    # corner-to-corner distance is the Manhattan diameter
+    assert solve_serial_csr(n, *csr, 0, n - 1).hops == (5 - 1) + (4 - 1)
+    a = grid_graph(10, 10, perforation=0.3, seed=7)
+    b = grid_graph(10, 10, perforation=0.3, seed=7)
+    np.testing.assert_array_equal(a, b)  # seeded
+    assert len(a) < len(grid_graph(10, 10))
+    with pytest.raises(ValueError):
+        grid_graph(0, 5)
+
+
+def test_sample_skewed_pairs_reproducible_and_skewed():
+    from bibfs_tpu.serve.loadgen import sample_skewed_pairs
+
+    n, q = 200, 600
+    deg = np.arange(n)[::-1].copy()  # vertex 0 is the hottest
+    a = sample_skewed_pairs(n, q, seed=4, skew=1.2, degrees=deg)
+    b = sample_skewed_pairs(n, q, seed=4, skew=1.2, degrees=deg)
+    np.testing.assert_array_equal(a, b)
+    assert a.shape == (q, 2) and (a[:, 0] != a[:, 1]).all()
+    # endpoint mass concentrates on the top-degree vertices
+    top = np.isin(a, np.arange(16)).mean()
+    assert top > 0.35
+    # repeat-heavy: far fewer unique pairs than draws
+    uniq = len({(int(s), int(d)) for s, d in a})
+    assert uniq < 0.8 * q

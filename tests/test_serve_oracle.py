@@ -1,0 +1,351 @@
+"""The oracle tier wired through the serving stack: engine routing
+(oracle before the distance cache, ``route="oracle"``), the store's
+follow-the-graph index lifecycle (background builds, adds-only repair,
+delete invalidation, rebuild-after-swap), the pipelined engine's
+submit-time serve, and the ``bibfs-serve`` surface.
+
+Correctness bar: an oracle-backed engine's answers are bit-exact
+against an oracle-less engine over every pair tried; a store oracle is
+NEVER returned for a superseded live-graph generation (staleness is
+structurally impossible, not timing-dependent); and the repair path's
+index equals a fresh rebuild."""
+
+import numpy as np
+import pytest
+
+from bibfs_tpu.graph.csr import build_csr, canonical_pairs
+from bibfs_tpu.graph.generate import gnp_random_graph
+from bibfs_tpu.serve import PipelinedQueryEngine, QueryEngine
+from bibfs_tpu.solvers.serial import solve_serial_csr
+from bibfs_tpu.store import GraphStore
+
+
+def _graph(n=80, p=0.03, seed=5):
+    return gnp_random_graph(n, p, seed=seed)
+
+
+def _truth(n, edges, s, d):
+    csr = build_csr(n, pairs=canonical_pairs(n, edges))
+    return solve_serial_csr(n, *csr, s, d)
+
+
+# ---- engine-local oracle ---------------------------------------------
+def test_engine_oracle_ctor_validation():
+    n, edges = 20, np.array([[i, i + 1] for i in range(19)])
+    with pytest.raises(ValueError, match="oracle_k"):
+        QueryEngine(n=n, edges=edges, oracle_k=0)
+    store = GraphStore()
+    store.add("g", n, edges)
+    try:
+        with pytest.raises(ValueError, match="store"):
+            QueryEngine(store=store, graph="g", oracle_k=4)
+    finally:
+        store.close()
+
+
+def test_engine_local_oracle_exact_and_routed():
+    """Every answer of an oracle-backed engine equals the oracle-less
+    engine's, and exact consults route as ``oracle`` (no solver, no
+    cache insert)."""
+    n, edges = 100, _graph(100, 0.025)
+    rng = np.random.default_rng(0)
+    pairs = [tuple(int(x) for x in rng.choice(n, 2, replace=False))
+             for _ in range(120)]
+    plain = QueryEngine(n=n, edges=edges)
+    orc = QueryEngine(n=n, edges=edges, oracle_k=8)
+    try:
+        ref = plain.query_many(pairs)
+        got = orc.query_many(pairs)
+        for (s, d), r, g in zip(pairs, ref, got):
+            assert g.found == r.found, (s, d)
+            if r.found:
+                assert g.hops == r.hops, (s, d)
+        st = orc.stats()
+        assert st["oracle_served"] > 0
+        assert st["oracle"] is not None
+        assert st["oracle"]["index"]["k"] == 8
+        # oracle-served queries never touched the solver ladder
+        assert (st["oracle_served"] + st["cache_served"]
+                + st["host_queries"] + st["device_queries"]
+                + st["trivial"]) >= len(pairs)
+        assert plain.stats()["oracle"] is None
+    finally:
+        plain.close()
+        orc.close()
+
+
+def test_oracle_served_results_have_no_path():
+    """The tier trades path materialization for lookup speed: an
+    oracle-served hit carries exact found/hops and ``path=None``."""
+    n = 40
+    edges = np.array([[i, i + 1] for i in range(n - 1)])
+    eng = QueryEngine(n=n, edges=edges, oracle_k=4)
+    try:
+        idx = eng._oracle.index
+        lm = int(idx.landmarks[0])
+        other = (lm + 5) % n
+        res = eng.query(lm, other)
+        assert res.found and res.path is None
+        assert res.hops == abs(lm - other)
+    finally:
+        eng.close()
+
+
+# ---- store lifecycle -------------------------------------------------
+def test_store_builds_index_in_background():
+    n, edges = 60, _graph(60, 0.05, seed=7)
+    store = GraphStore(oracle_k=6)
+    try:
+        store.add("g", n, edges)
+        assert store.wait_for_index("g", timeout=30.0)
+        orc = store.oracle("g")
+        assert orc is not None and orc.index.gen == 1
+        st = store.stats()["graphs"]["g"]["oracle"]
+        assert st["ready"] and st["builds"] == 1
+    finally:
+        store.close()
+
+
+def test_store_oracle_disabled_returns_none():
+    store = GraphStore()  # no oracle_k
+    try:
+        store.add("g", 10, np.array([[0, 1]]))
+        assert store.oracle("g") is None
+        assert store.stats()["graphs"]["g"]["oracle"] is None
+    finally:
+        store.close()
+
+
+def test_delete_invalidates_until_rebuild():
+    """A delete bumps the live-graph gen in the SAME locked section as
+    the overlay apply: the index cannot answer for the new edge state,
+    and comes back (fresh gen) only after a compaction rebuild."""
+    n, edges = 50, np.array([[i, i + 1] for i in range(49)])
+    store = GraphStore(oracle_k=4, compact_threshold=None)
+    try:
+        store.add("g", n, edges)
+        assert store.wait_for_index("g", timeout=30.0)
+        store.update("g", adds=[], dels=[(0, 1)])
+        assert store.oracle("g") is None  # immediately stale
+        store.compact("g")
+        assert store.wait_for_index("g", timeout=30.0)
+        orc = store.oracle("g")
+        assert orc is not None
+        # the rebuilt index answers for the POST-delete graph
+        lm = int(orc.index.landmarks[0])
+        tgt = 0 if lm != 0 else 49
+        ans = orc.consult(lm, tgt)
+        ref = _truth(n, np.array([[i, i + 1] for i in range(1, 49)]),
+                     lm, tgt)
+        if ans is not None and ans.result is not None:
+            assert ans.result.found == ref.found
+            if ref.found:
+                assert ans.result.hops == ref.hops
+    finally:
+        store.close()
+
+
+def test_adds_only_batch_repairs_exactly():
+    """An adds-only update repairs the index synchronously (gen follows
+    the graph) and the repaired matrix equals a fresh rebuild over the
+    merged edges with the same landmarks."""
+    from bibfs_tpu.oracle import build_index
+
+    n = 60
+    edges = np.array([[i, i + 1] for i in range(n - 1)])
+    store = GraphStore(oracle_k=5, compact_threshold=None)
+    try:
+        store.add("g", n, edges)
+        assert store.wait_for_index("g", timeout=30.0)
+        adds = [(0, 30), (10, 50)]
+        store.update("g", adds=adds, dels=[])
+        orc = store.oracle("g")
+        assert orc is not None, "adds-only repair must keep the index live"
+        assert orc.index.gen == 2 and orc.index.repaired_edges == 2
+        assert store.stats()["graphs"]["g"]["oracle"]["repairs"] == 1
+        merged = np.concatenate([edges, np.array(adds)])
+        fresh = build_index(
+            n, *build_csr(n, pairs=canonical_pairs(n, merged)), 5,
+            landmarks=orc.index.landmarks,
+        )
+        np.testing.assert_array_equal(orc.index.dist, fresh.dist)
+    finally:
+        store.close()
+
+
+def test_repair_threshold_schedules_full_rebuild():
+    n = 40
+    edges = np.array([[i, i + 1] for i in range(n - 1)])
+    store = GraphStore(oracle_k=3, oracle_repair_max=1,
+                       compact_threshold=None)
+    try:
+        store.add("g", n, edges)
+        assert store.wait_for_index("g", timeout=30.0)
+        store.update("g", adds=[(0, 20), (5, 30)], dels=[])  # > max
+        assert store.wait_for_index("g", timeout=30.0)
+        orc = store.oracle("g")
+        assert orc is not None and orc.index.repaired_edges == 0
+        assert store.stats()["graphs"]["g"]["oracle"]["builds"] >= 2
+    finally:
+        store.close()
+
+
+def test_swap_drops_index_and_rebuilds_for_new_snapshot():
+    """A hot-swap moves the gen forward: the old index can never answer
+    for the new snapshot; the rebuilt one is keyed to the new digest."""
+    n = 40
+    store = GraphStore(oracle_k=3, compact_threshold=None)
+    try:
+        snap1 = store.add("g", n, np.array([[i, i + 1]
+                                            for i in range(n - 1)]))
+        assert store.wait_for_index("g", timeout=30.0)
+        from bibfs_tpu.store import GraphSnapshot
+
+        other = GraphSnapshot.build(
+            n, np.array([[i, i + 2] for i in range(n - 2)])
+        )
+        store.swap("g", other)
+        assert store.oracle("g") is None or \
+            store.oracle("g").index.digest == other.digest
+        assert store.wait_for_index("g", timeout=30.0)
+        orc = store.oracle("g")
+        assert orc.index.digest == other.digest != snap1.digest
+    finally:
+        store.close()
+
+
+# ---- store-backed engines --------------------------------------------
+@pytest.mark.parametrize("flavor", ["sync", "pipelined"])
+def test_store_backed_engine_serves_via_oracle(flavor):
+    n, edges = 90, _graph(90, 0.03, seed=9)
+    store = GraphStore(oracle_k=8)
+    store.add("g", n, edges)
+    assert store.wait_for_index("g", timeout=30.0)
+    cls = QueryEngine if flavor == "sync" else PipelinedQueryEngine
+    eng = cls(store=store, graph="g")
+    plain = QueryEngine(n=n, edges=edges)
+    try:
+        rng = np.random.default_rng(2)
+        pairs = [tuple(int(x) for x in rng.choice(n, 2, replace=False))
+                 for _ in range(100)]
+        got = eng.query_many(pairs)
+        ref = plain.query_many(pairs)
+        for (s, d), g, r in zip(pairs, got, ref):
+            assert g.found == r.found and (not r.found
+                                           or g.hops == r.hops), (s, d)
+        assert eng.stats()["oracle_served"] > 0
+    finally:
+        eng.close()
+        plain.close()
+        store.close()
+
+
+def test_update_mid_serving_never_stale():
+    """Queries racing an update batch answer on the live edge state:
+    the delete invalidates the index in the same instant the overlay
+    becomes the truth, so an engine consulting the oracle right after
+    ``update()`` returns must fall through to overlay/solver routes."""
+    n = 50
+    edges = np.array([[i, i + 1] for i in range(n - 1)])
+    store = GraphStore(oracle_k=4, compact_threshold=None)
+    store.add("g", n, edges)
+    assert store.wait_for_index("g", timeout=30.0)
+    eng = QueryEngine(store=store, graph="g")
+    try:
+        assert eng.query(0, 49).hops == 49
+        store.update("g", adds=[], dels=[(20, 21)])  # cuts the chain
+        res = eng.query(0, 49)
+        assert not res.found  # overlay truth, not the stale index
+        store.update("g", adds=[(20, 21)], dels=[])  # restore
+        assert eng.query(0, 49).hops == 49
+    finally:
+        eng.close()
+        store.close()
+
+
+def test_stale_cutoff_across_delete_swap_stays_exact():
+    """A ticket armed with a ``bounds`` cutoff at submit, queued across
+    a delete + forced hot-swap, must still answer exactly on the new
+    graph. The stale (now too-small) UB would otherwise seed the serial
+    meet bound and report a connected pair unreachable — the guard
+    retries a not-found without the seed (engine
+    ``_solve_serial_cutoff_checked``)."""
+    n = 20
+    chain = [[i, i + 1] for i in range(n - 1)]
+    edges = np.array(chain + [[4, 16]])  # shortcut: d(2,18)=5, UB<=5
+    store = GraphStore(oracle_k=2, compact_threshold=None)
+    store.add("g", n, edges)
+    assert store.wait_for_index("g", timeout=30.0)
+    orc = store.oracle("g")
+    ans = orc.consult(2, 18)
+    assert ans is not None and ans.kind == "bounds" and ans.ub < 16
+    eng = QueryEngine(store=store, graph="g", flush_threshold=64,
+                      host_backend="serial")
+    try:
+        t = eng.submit(2, 18)       # queues with the cutoff armed
+        assert t.result is None
+        store.update("g", adds=[], dels=[(4, 16)])  # d(2,18) -> 16
+        store.compact("g")          # hot-swap: overlay folded away
+        eng.flush()
+        assert t.result is not None and t.result.found
+        assert t.result.hops == 16  # exact on the POST-delete graph
+    finally:
+        eng.close()
+        store.close()
+
+
+def test_oracle_metrics_families_render():
+    from bibfs_tpu.obs.metrics import REGISTRY
+
+    n, edges = 30, np.array([[i, i + 1] for i in range(29)])
+    store = GraphStore(oracle_k=3)
+    try:
+        store.add("g", n, edges)
+        assert store.wait_for_index("g", timeout=30.0)
+        orc = store.oracle("g")
+        orc.consult(int(orc.index.landmarks[0]), 7)
+        render = REGISTRY.render()
+        for fam in ("bibfs_oracle_hits_total",
+                    "bibfs_oracle_index_builds_total",
+                    "bibfs_oracle_index_age_seconds"):
+            assert fam in render, fam
+    finally:
+        store.close()
+
+
+def test_serve_cli_oracle_command(tmp_path, capsys, monkeypatch):
+    """The stdin ``oracle`` command on a plain .bin serve: status line
+    lands in the result stream; malformed arity answers an error line
+    and the stream continues."""
+    import io
+
+    from bibfs_tpu.graph.io import write_graph_bin
+    from bibfs_tpu.serve.cli import main as serve_main
+
+    n = 30
+    path = tmp_path / "g.bin"
+    write_graph_bin(path, n, np.array([[i, i + 1] for i in range(n - 1)]))
+    script = "\n".join(["oracle", "0 5", "oracle extra", "oracle", ""])
+    monkeypatch.setattr("sys.stdin", io.StringIO(script))
+    rc = serve_main([str(path), "--oracle", "4", "--no-path"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    lines = [ln for ln in out.splitlines() if ln.strip()]
+    assert lines[0].startswith("oracle: ready k=4")
+    assert "error invalid: usage: oracle" in out
+    assert "0 -> 5: length = 5" in out
+
+
+def test_serve_cli_oracle_off_status(tmp_path, capsys, monkeypatch):
+    import io
+
+    from bibfs_tpu.graph.io import write_graph_bin
+    from bibfs_tpu.serve.cli import main as serve_main
+
+    n = 10
+    path = tmp_path / "g.bin"
+    write_graph_bin(path, n, np.array([[i, i + 1] for i in range(n - 1)]))
+    monkeypatch.setattr("sys.stdin", io.StringIO("oracle\n"))
+    rc = serve_main([str(path), "--no-path"])
+    assert rc == 0
+    assert "oracle: off" in capsys.readouterr().out

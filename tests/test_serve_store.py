@@ -209,10 +209,10 @@ def test_swap_barrier_inflight_flush_finishes_on_old_snapshot():
     entered, proceed = threading.Event(), threading.Event()
     real = eng._solve_host_isolated
 
-    def stalled(pairs):
+    def stalled(pairs, cutoffs=None):
         entered.set()
         assert proceed.wait(10)
-        return real(pairs)
+        return real(pairs, cutoffs)
 
     eng._solve_host_isolated = stalled
     t = eng.submit(0, n - 1)
