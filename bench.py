@@ -1372,9 +1372,123 @@ def serve_oracle_main():
         return 1
 
 
+# --serve-fleet defaults: the fleet soak runs the router's full claim
+# set (hash-affinity cache scaling vs one replica, kill/restart chaos
+# with re-routing, a rolling swap under load with mixed-version
+# exactness, hot-graph spill, live /metrics) over many small perforated
+# grids; --quick is the CI smoke shape (fewer/smaller graphs, shorter
+# chaos window, qps ratio reported but not gated — at smoke scale the
+# per-graph hot sets fit ONE replica's cache and the ratio is noise)
+FLEET_REPLICAS = int(os.environ.get("BENCH_FLEET_REPLICAS", 3))
+FLEET_GRAPHS = int(os.environ.get("BENCH_FLEET_GRAPHS", 30))
+FLEET_GRID = os.environ.get("BENCH_FLEET_GRID", "150x150")
+FLEET_Q = int(os.environ.get("BENCH_FLEET_Q", 6000))
+FLEET_CHAOS_Q = int(os.environ.get("BENCH_FLEET_CHAOS_Q", 3000))
+FLEET_CHAOS_SPAN_S = float(os.environ.get("BENCH_FLEET_CHAOS_SPAN_S", 24.0))
+FLEET_QPS_FACTOR = float(os.environ.get("BENCH_FLEET_QPS_FACTOR", 2.0))
+FLEET_RECOVERY_S = float(os.environ.get("BENCH_FLEET_RECOVERY_S", 10.0))
+
+# the fleet metric families (bibfs_tpu.fleet.FLEET_METRIC_FAMILIES —
+# one list, shared with the soak's live-scrape gate so the two checks
+# cannot drift): the gate asserts a LIVE /metrics scrape (HTTP, not
+# just a registry render) carries them
+
+
+def serve_fleet_main():
+    """``python bench.py --serve-fleet``: the fleet serving soak.
+
+    A health-aware router over N in-process engine replicas — each
+    with its own versioned graph store — serves repeat-heavy traffic
+    over many graphs (bibfs_tpu/serve/loadgen.run_fleet): single
+    replica vs fleet on the same workload and driver protocol (the
+    hash-affinity cache-scaling A/B), then open-loop traffic while the
+    hottest graph's replica is killed and restarted and a rolling swap
+    crosses the fleet, then a hot-graph burst through the spill path.
+    The gate: fleet qps >= BENCH_FLEET_QPS_FACTOR x single-replica at
+    >= 3 replicas, zero lost/stranded tickets, every survivor verified
+    against ground truth FOR THE VERSION ITS REPLICA DECLARED,
+    recovery-to-ready within bound, reroutes and spills actually
+    exercised, and the fleet metric families present on a live
+    /metrics scrape. ``--quick`` is the CI smoke shape (qps ratio
+    reported, not gated). Artifact: ``bench_fleet.json``."""
+    t_setup = time.time()
+    platform, tpu_error = select_platform()
+    try:
+        from bibfs_tpu.serve.loadgen import run_fleet
+
+        quick = "--quick" in sys.argv
+        try:
+            w, h = (int(x) for x in
+                    ("48x48" if quick else FLEET_GRID).split("x"))
+        except ValueError:
+            print(f"bad BENCH_FLEET_GRID {FLEET_GRID!r} (want WxH)",
+                  file=sys.stderr)
+            return 1
+        out = run_fleet(
+            replicas=FLEET_REPLICAS,
+            graphs=8 if quick else FLEET_GRAPHS,
+            grid=(w, h),
+            queries=1200 if quick else FLEET_Q,
+            chaos_queries=600 if quick else FLEET_CHAOS_Q,
+            chaos_span_s=10.0 if quick else FLEET_CHAOS_SPAN_S,
+            qps_factor=None if quick else FLEET_QPS_FACTOR,
+            recovery_bound_s=(
+                20.0 if quick else FLEET_RECOVERY_S
+            ),
+        )
+        missing = list(out["metrics"]["missing"])
+        line = {
+            "metric": f"bibfs_serve_fleet_{out['n_per_graph']}",
+            "value": out["qps"]["fleet"],
+            "unit": "queries/s",
+            "graph": "grid({w}x{h}, perf=0.02) x {g} graphs".format(
+                w=w, h=h, g=out["graphs"]
+            ),
+            "platform": platform,
+            "quick": quick,
+            **out,
+            "total_s": round(time.time() - t_setup, 1),
+        }
+        if tpu_error:
+            line["tpu_error"] = tpu_error[:300]
+        _write_artifact("bench_fleet.json", line)
+        print(json.dumps({
+            "metric": line["metric"],
+            "value": line["value"],
+            "unit": "queries/s",
+            "ok": line["ok"],
+            "qps_single": out["qps"]["single"],
+            "qps_ratio": out["qps"]["ratio"],
+            "qps_ok": out["qps_ok"],
+            "zero_lost": out["zero_lost"],
+            "zero_failed": out["zero_failed"],
+            "verified": out["verified_vs_truth"],
+            "recovery_s": out["chaos"]["recovery_s"],
+            "recovery_ok": out["recovery_ok"],
+            "roll_ok": out["roll_ok"],
+            "reroutes": out["router"]["reroutes"],
+            "spills": out["spill"]["spills"],
+            "metrics_missing": missing,
+            "detail_file": "bench_fleet.json",
+        }))
+        return 0 if line["ok"] else 1
+    except Exception as e:
+        import traceback
+
+        traceback.print_exc()
+        print(json.dumps({
+            "metric": "bibfs_serve_fleet",
+            "value": None,
+            "error": f"{type(e).__name__}: {e}"[:400],
+        }))
+        return 1
+
+
 if __name__ == "__main__":
     if "--calibrate" in sys.argv:
         sys.exit(calibrate_main())
+    elif "--serve-fleet" in sys.argv:
+        sys.exit(serve_fleet_main())
     elif "--serve-oracle" in sys.argv:
         sys.exit(serve_oracle_main())
     elif "--serve-update" in sys.argv:

@@ -1,0 +1,27 @@
+"""Fleet serving: a health-aware router over N engine replicas.
+
+The horizontal deployment the north star asks for (ROADMAP item 4):
+:class:`~bibfs_tpu.fleet.router.Router` routes queries by consistent
+hash on graph name (spilling hot graphs to the least-loaded replica)
+across :class:`~bibfs_tpu.fleet.replica.EngineReplica` (in-process
+engines over per-replica graph stores) and
+:class:`~bibfs_tpu.fleet.replica.ProcessReplica` (spawned
+``bibfs-serve`` subprocesses) behind one replica interface; routing
+consumes replica health, failures re-route with retry/backoff, and
+:meth:`~bibfs_tpu.fleet.router.Router.rolling_swap` rolls snapshot
+swaps across the fleet one drained replica at a time. ``bibfs-fleet``
+is the CLI; ``bench.py --serve-fleet`` the kill/restart + rolling-swap
+soak (``bench_fleet.json``).
+"""
+
+from bibfs_tpu.fleet.replica import (  # noqa: F401
+    EngineReplica,
+    ProcessReplica,
+    ReplicaDead,
+    engine_replica,
+)
+from bibfs_tpu.fleet.router import (  # noqa: F401
+    FLEET_METRIC_FAMILIES,
+    FleetTicket,
+    Router,
+)

@@ -1,0 +1,637 @@
+"""Health-aware front-end router over N engine replicas.
+
+The horizontal serving story (ROADMAP item 4): one router owns a fleet
+of replicas (:mod:`bibfs_tpu.fleet.replica`) and gives callers the
+engine-shaped surface — ``submit``/``query``/``query_many`` — while
+underneath:
+
+- **consistent-hash routing with spill.** Each query routes by a
+  consistent hash of its graph name (``vnodes`` virtual nodes per
+  replica on a 64-bit ring), so a graph's traffic sticks to one
+  replica — which is what makes per-replica distance caches, oracle
+  indexes and compiled-program warmth ACCUMULATE instead of being
+  diluted fleet-wide: aggregate cache capacity scales with the replica
+  count, the measured reason a fleet beats one replica on repeat-heavy
+  multi-graph traffic (``bench_fleet.json``). A hot graph spills: when
+  the hash owner's queue depth reaches ``spill_after``, the query goes
+  to the least-loaded healthy replica instead
+  (``bibfs_fleet_spills_total``).
+- **health-driven routing table.** A poller thread polls every
+  replica's ``health_snapshot()`` / ``health`` command each
+  ``poll_interval_s``: ready replicas route, degraded replicas are
+  demoted (used only when nothing is ready), draining/dead/live ones
+  are ejected, and recovery re-admits automatically
+  (``bibfs_fleet_replicas{state}``). A submit that hits a dead replica
+  marks it dead immediately — ejection does not wait for the poll.
+- **failure re-routing.** A replica failure — submit refused, ticket
+  failed with a server-side :class:`QueryError` (``internal`` /
+  ``capacity``), process death — re-routes the query to a peer with
+  the PR 4 retry/backoff taxonomy (:class:`RetryPolicy` bounds
+  attempts; ``bibfs_fleet_reroutes_total`` counts failovers), so one
+  dead replica costs retries, not lost tickets. Client-invalid errors
+  never re-route.
+- **rolling swaps.** :meth:`Router.rolling_swap` rolls an edge-update
+  batch across the fleet one replica at a time: demote -> engine-level
+  drain (submits answer structured capacity refusals while queued
+  tickets resolve) -> flush -> ``store.roll`` (apply + compact + atomic
+  hot-swap on THAT replica's store) -> ready-probe -> re-admit. The
+  fleet serves mixed versions mid-roll; every answer is exact for the
+  version its replica declares, which each
+  :class:`FleetTicket.declared_version` records.
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+import threading
+import time
+
+from bibfs_tpu.fleet.replica import ReplicaDead
+from bibfs_tpu.obs.metrics import REGISTRY, next_instance_label
+from bibfs_tpu.obs.trace import span
+from bibfs_tpu.serve.resilience import (
+    QueryError,
+    RetryPolicy,
+    to_query_error,
+)
+
+#: routing-table states a query may be sent to (in preference order)
+ROUTABLE_STATES = ("ready", "degraded")
+#: every state the table (and the bibfs_fleet_replicas gauge) can hold
+TABLE_STATES = ("live", "ready", "degraded", "draining", "dead")
+
+#: error kinds that re-route to a peer; everything else is the
+#: client's problem (invalid) or the caller's deadline (timeout)
+REROUTE_KINDS = ("internal", "capacity")
+
+#: the fleet metric families a router mints (README "Observability") —
+#: the ONE list the soak's live-render gate and the bench CI gate both
+#: check, so they cannot drift apart; bibfs_build_info rides along
+#: because "which build is this replica" is the fleet question
+FLEET_METRIC_FAMILIES = (
+    "bibfs_fleet_replicas",
+    "bibfs_fleet_routed_total",
+    "bibfs_fleet_reroutes_total",
+    "bibfs_fleet_rolls_total",
+    "bibfs_fleet_spills_total",
+    "bibfs_build_info",
+)
+
+
+def _hash64(key: str) -> int:
+    return int.from_bytes(
+        hashlib.blake2b(key.encode(), digest_size=8).digest(), "big"
+    )
+
+
+class FleetTicket:
+    """A routed query's handle: wraps the serving replica's ticket and
+    re-routes on replica failure when waited/polled (failover is driven
+    by the waiter — the router never parks threads per ticket).
+    ``replica`` / ``declared_version`` name the replica that finally
+    answered and the graph version it declared at dispatch, which is
+    what makes mid-rolling-swap answers verifiable."""
+
+    __slots__ = ("src", "dst", "graph", "replica", "declared_version",
+                 "attempts", "tried", "result", "error", "_router",
+                 "_inner")
+
+    def __init__(self, router, src: int, dst: int, graph: str | None):
+        self.src = src
+        self.dst = dst
+        self.graph = graph
+        self.replica: str | None = None
+        self.declared_version = None
+        self.attempts = 0
+        self.tried: set = set()
+        self.result = None
+        self.error: BaseException | None = None
+        self._router = router
+        self._inner = None
+
+    def done(self) -> bool:
+        return self.result is not None or self.error is not None
+
+    def poll(self) -> bool:
+        """Non-blocking progress check: True once the ticket is FINAL
+        (result or terminal error). A failed inner ticket triggers the
+        re-route right here (non-blocking dispatch, no backoff sleep) —
+        how a streaming caller (the ``bibfs-fleet`` REPL) drives
+        failover without parking a thread."""
+        while True:
+            if self.done():
+                return True
+            inner = self._inner
+            if inner is None:
+                return False
+            if inner.error is not None:
+                if not self._router._reroute(self, inner.error,
+                                             blocking=False):
+                    return True
+                continue
+            if inner.result is not None:
+                self.result = inner.result
+                return True
+            return False
+
+    def wait(self, timeout: float | None = 60.0):
+        """Block for the result, re-routing on replica failure (with
+        the retry policy's backoff) until the attempts bound; raises
+        the final structured error or ``TimeoutError``."""
+        deadline = (
+            None if timeout is None else time.monotonic() + timeout
+        )
+        while True:
+            if self.result is not None:
+                return self.result
+            if self.error is not None:
+                raise self.error
+            remaining = None
+            if deadline is not None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"query ({self.src}, {self.dst}) unresolved "
+                        f"after {timeout}s (replica {self.replica})"
+                    )
+            replica = self._router._replicas[self.replica]
+            try:
+                self.result = replica.wait_ticket(
+                    self._inner, timeout=remaining
+                )
+                return self.result
+            except TimeoutError:
+                raise
+            except (QueryError, ReplicaDead, RuntimeError) as e:
+                if not self._router._reroute(self, e, blocking=True):
+                    raise self.error
+
+
+class Router:
+    """Front-end router over N replicas (module docstring).
+
+    Parameters
+    ----------
+    replicas : the fleet — :class:`EngineReplica` /
+        :class:`ProcessReplica` (or anything replica-shaped). Names
+        must be unique.
+    retry : failover policy (default: 3 attempts, exp backoff +
+        jitter) — ``attempts`` bounds how many replicas one query may
+        try in total.
+    poll_interval_s : health-poll cadence (the re-admit latency floor).
+    spill_after : hash-owner queue depth at which a query spills to the
+        least-loaded healthy replica (0/None disables spilling). Set it
+        ABOVE the replicas' routine flush depth (a multiple of their
+        ``max_batch``): a queue that merely filled to its next batch is
+        the micro-batcher working, not pressure — spilling on it
+        scatters hot-graph traffic and destroys exactly the cache
+        affinity hash routing exists to build (measured: a threshold at
+        half the flush depth spilled ~40% of a steady hot-traffic pass
+        and halved the fleet's hit rate).
+    vnodes : virtual nodes per replica on the hash ring.
+    obs_label : the ``router=`` label on the fleet metric families
+        (default: a process-unique ``router-N``).
+    """
+
+    def __init__(self, replicas, *, retry: RetryPolicy | None = None,
+                 poll_interval_s: float = 0.25, spill_after: int = 256,
+                 vnodes: int = 64, obs_label: str | None = None):
+        replicas = list(replicas)
+        if not replicas:
+            raise ValueError("a router needs at least one replica")
+        names = [r.name for r in replicas]
+        if len(set(names)) != len(names):
+            raise ValueError(f"replica names must be unique: {names}")
+        self._replicas = {r.name: r for r in replicas}
+        self._order = sorted(self._replicas)
+        self._retry = RetryPolicy(attempts=3) if retry is None else retry
+        self.poll_interval_s = float(poll_interval_s)
+        self.spill_after = int(spill_after or 0)
+        ring = []
+        for name in self._order:
+            for i in range(int(vnodes)):
+                ring.append((_hash64(f"{name}#{i}"), name))
+        ring.sort()
+        self._ring = ring
+        self._ring_keys = [h for h, _ in ring]
+        self._table_lock = threading.Lock()
+        self._states = {name: "live" for name in self._order}
+        self._forced_drain: dict[str, bool] = {}
+        self._versions: dict = {}
+        self.obs_label = (
+            next_instance_label("router") if obs_label is None
+            else obs_label
+        )
+        self._g_replicas = REGISTRY.gauge(
+            "bibfs_fleet_replicas",
+            "Fleet replicas by routing-table state",
+            ("router", "state"),
+        )
+        for s in TABLE_STATES:  # render at zero from the first scrape
+            self._g_replicas.labels(router=self.obs_label, state=s).set(0)
+        routed = REGISTRY.counter(
+            "bibfs_fleet_routed_total",
+            "Queries dispatched per replica",
+            ("router", "replica"),
+        )
+        self._routed_cells = {
+            name: routed.labels(router=self.obs_label, replica=name)
+            for name in self._order
+        }
+        self._c_reroutes = REGISTRY.counter(
+            "bibfs_fleet_reroutes_total",
+            "Queries re-routed off a failed/refusing replica",
+            ("router",),
+        ).labels(router=self.obs_label)
+        self._c_spills = REGISTRY.counter(
+            "bibfs_fleet_spills_total",
+            "Hot-graph queries spilled to the least-loaded replica",
+            ("router",),
+        ).labels(router=self.obs_label)
+        self._c_rolls = REGISTRY.counter(
+            "bibfs_fleet_rolls_total",
+            "Fleet-wide rolling swaps completed",
+            ("router",),
+        ).labels(router=self.obs_label)
+        self._closed = False
+        self._poll_once()  # routing works before the first poller tick
+        self._poll_stop = threading.Event()
+        self._poller = threading.Thread(
+            target=self._poll_main, name="bibfs-fleet-poller",
+            daemon=True,
+        )
+        self._poller.start()
+
+    # ---- submission --------------------------------------------------
+    def replica(self, name: str):
+        return self._replicas[name]
+
+    @property
+    def replica_names(self) -> list:
+        return list(self._order)
+
+    def submit(self, src: int, dst: int,
+               graph: str | None = None) -> FleetTicket:
+        """Route one query (hash + health + spill) and return its
+        :class:`FleetTicket`. Submit-time replica refusals fail over
+        immediately; client-invalid input raises ``ValueError`` to the
+        caller unrerouted."""
+        ticket = FleetTicket(self, int(src), int(dst), graph)
+        self._dispatch(ticket)
+        return ticket
+
+    def query(self, src: int, dst: int, graph: str | None = None):
+        return self.submit(src, dst, graph).wait()
+
+    def query_many(self, pairs, *, graph: str | None = None,
+                   return_errors: bool = False) -> list:
+        """Fleet-wide ``query_many``: same contract as the engines'
+        (``return_errors=True`` yields per-pair
+        ``BFSResult | QueryError``)."""
+        tickets: list = []
+        for s, d in pairs:
+            try:
+                tickets.append(self.submit(int(s), int(d), graph))
+            except (ValueError, TypeError) as e:
+                if not return_errors:
+                    raise
+                tickets.append(to_query_error(e, None, kind="invalid"))
+            except QueryError as e:
+                if not return_errors:
+                    raise
+                tickets.append(e)
+        self.flush()
+        out = []
+        for t in tickets:
+            if isinstance(t, QueryError):
+                out.append(t)
+                continue
+            try:
+                out.append(t.wait(timeout=120.0))
+            except Exception as e:
+                if not return_errors:
+                    raise
+                out.append(to_query_error(e, (t.src, t.dst)))
+        return out
+
+    def flush(self, timeout: float | None = None) -> None:
+        """Resolve everything queued on every live replica."""
+        for name in self._order:
+            try:
+                self._replicas[name].flush(timeout=timeout)
+            except Exception:
+                pass  # a dead replica's tickets fail; wait() reroutes
+
+    def _dispatch(self, ticket: FleetTicket,
+                  exclude: set | None = None,
+                  is_reroute: bool = False) -> None:
+        tried = set(exclude or ())
+        last_err = None
+        for _ in range(len(self._replicas) + 1):
+            name = self._pick(ticket.graph, tried)
+            replica = self._replicas[name]
+            # version BEFORE submit: a rolling swap that lands while
+            # this query sits in the replica's queue still resolves it
+            # PRE-swap (the roll's drain flushes the queue before the
+            # store rolls), so the pre-submit version is the one the
+            # answer is exact for — reading after the submit could
+            # attribute a v_k answer to v_k+1
+            version = self._version_of(name, ticket.graph)
+            try:
+                inner = replica.submit(ticket.src, ticket.dst,
+                                       ticket.graph)
+            except (ValueError, TypeError):
+                raise  # client-invalid: the caller's problem, no peer
+                # can answer an out-of-range id differently
+            except QueryError as e:
+                last_err = e
+                tried.add(name)
+                self._c_reroutes.inc()
+                continue  # draining/refusing: straight to a peer
+            except Exception as e:  # ReplicaDead, closed-engine races
+                last_err = e
+                tried.add(name)
+                self._mark_dead(name)  # eject ahead of the next poll
+                self._c_reroutes.inc()
+                continue
+            if is_reroute:
+                self._c_reroutes.inc()
+            ticket._inner = inner
+            ticket.replica = name
+            ticket.attempts += 1
+            ticket.tried.add(name)
+            ticket.declared_version = version
+            self._routed_cells[name].inc()
+            return
+        raise QueryError(
+            "no healthy replica accepted the query",
+            kind="capacity", query=(ticket.src, ticket.dst),
+            cause=last_err,
+        )
+
+    def _reroute(self, ticket: FleetTicket, err: BaseException,
+                 blocking: bool) -> bool:
+        """Failover one failed ticket to a peer. True = re-dispatched
+        (caller keeps waiting/polling); False = terminal
+        (``ticket.error`` set)."""
+        kind = getattr(err, "kind", "internal")
+        retryable = (
+            isinstance(err, (ReplicaDead, RuntimeError))
+            and not isinstance(err, QueryError)
+        ) or kind in REROUTE_KINDS
+        if not retryable or ticket.attempts >= self._retry.attempts:
+            ticket.error = to_query_error(
+                err, (ticket.src, ticket.dst)
+            )
+            return False
+        if blocking:
+            time.sleep(self._retry.delay_s(max(ticket.attempts - 1, 0)))
+        try:
+            with span("fleet_reroute", replica=ticket.replica):
+                self._dispatch(
+                    ticket, exclude=set(ticket.tried), is_reroute=True
+                )
+        except (QueryError, ValueError, TypeError) as e:
+            ticket.error = to_query_error(e, (ticket.src, ticket.dst))
+            return False
+        return True
+
+    # ---- routing policy ---------------------------------------------
+    def owner(self, graph: str | None) -> str:
+        """The graph's hash-ring owner over ALL replicas (health
+        ignored) — the affinity introspection hook load drivers shard
+        by."""
+        return self._ring_walk(str(graph or ""), set(self._order))
+
+    def _ring_walk(self, key: str, avail: set) -> str:
+        h = _hash64(key)
+        i = bisect.bisect_right(self._ring_keys, h)
+        for k in range(len(self._ring)):
+            name = self._ring[(i + k) % len(self._ring)][1]
+            if name in avail:
+                return name
+        return next(iter(avail))
+
+    def _pick(self, graph: str | None, exclude: set) -> str:
+        # hot path: plain dict reads are GIL-atomic and the poller only
+        # assigns whole values — a lock here would put one more convoy
+        # point on every routed query (the fleet's hit traffic is pure
+        # Python, where lock handoffs ARE the cost)
+        states = self._states
+        for want in ROUTABLE_STATES:
+            eligible = {n for n in self._order if states.get(n) == want}
+            if eligible:
+                break
+        else:
+            raise QueryError(
+                f"no healthy replicas (table: {dict(states)})",
+                kind="capacity",
+            )
+        avail = eligible - exclude or eligible
+        target = self._ring_walk(str(graph or ""), avail)
+        if self.spill_after and len(avail) > 1:
+            tload = self._replicas[target].load()
+            if tload >= self.spill_after:
+                alt = min(avail,
+                          key=lambda n: self._replicas[n].load())
+                if alt != target and self._replicas[alt].load() < tload:
+                    self._c_spills.inc()
+                    return alt
+        return target
+
+    def _graph_key(self, graph: str | None) -> str:
+        return str(graph or "")
+
+    def _version_of(self, name: str, graph: str | None):
+        key = (name, self._graph_key(graph))
+        v = self._versions.get(key)  # GIL-atomic read; the miss path
+        # (first query per (replica, graph)) and rolling_swap write
+        # under the table lock
+        if v is not None:
+            return v
+        try:
+            v = self._replicas[name].version(graph)
+        except Exception:
+            v = None
+        with self._table_lock:
+            self._versions[key] = v
+        return v
+
+    # ---- health table ------------------------------------------------
+    def _mark_dead(self, name: str) -> None:
+        with self._table_lock:
+            self._states[name] = "dead"
+            self._drop_versions_locked(name)
+
+    def _drop_versions_locked(self, name: str) -> None:
+        """Forget a dead replica's cached declared versions: a restart
+        may come back on different state (a subprocess respawn reloads
+        its store from disk, losing in-memory rolls), and a stale cache
+        would mis-attribute its answers. The next dispatch re-reads the
+        version from the replica itself."""
+        for key in [k for k in self._versions if k[0] == name]:
+            del self._versions[key]
+
+    def _set_state(self, name: str, state: str) -> None:
+        with self._table_lock:
+            self._states[name] = state
+
+    def _poll_once(self) -> None:
+        counts = {s: 0 for s in TABLE_STATES}
+        for name, replica in self._replicas.items():
+            try:
+                state = replica.health()["state"]
+                if state not in counts:
+                    state = "degraded"
+            except Exception:
+                state = "dead"
+            with self._table_lock:
+                if self._forced_drain.get(name):
+                    state = "draining"  # mid-roll: keep traffic off
+                if (state == "dead"
+                        and self._states.get(name) != "dead"):
+                    self._drop_versions_locked(name)
+                self._states[name] = state
+            counts[state] += 1
+        for s, c in counts.items():
+            self._g_replicas.labels(
+                router=self.obs_label, state=s
+            ).set(c)
+
+    def _poll_main(self) -> None:
+        while not self._poll_stop.wait(self.poll_interval_s):
+            try:
+                self._poll_once()
+            except Exception:
+                pass  # a poll hiccup must not kill the poller
+
+    # ---- rolling swap ------------------------------------------------
+    def rolling_swap(self, graph: str | None = None, adds=(), dels=(),
+                     *, drain_timeout_s: float = 60.0,
+                     ready_timeout_s: float = 30.0) -> dict:
+        """Roll one edge-update batch across the fleet, one replica at
+        a time (module docstring): demote -> drain -> flush ->
+        ``replica.roll`` (apply + compact + hot-swap on that replica's
+        store) -> ready-probe -> re-admit. Returns the per-replica
+        rows; ``ok`` requires every replica rolled and re-probed."""
+        adds = [tuple(e) for e in adds]
+        dels = [tuple(e) for e in dels]
+        rows = []
+        for name in self._order:
+            replica = self._replicas[name]
+            row = {"replica": name, "ok": False}
+            with span("fleet_roll", replica=name,
+                      graph=self._graph_key(graph)):
+                with self._table_lock:
+                    self._forced_drain[name] = True
+                    self._states[name] = "draining"
+                t0 = time.perf_counter()
+                try:
+                    row["engine_drain"] = bool(replica.begin_drain())
+                    replica.flush(timeout=drain_timeout_s)
+                    old_v = replica.version(graph)
+                    new_v = replica.roll(graph, adds=adds, dels=dels)
+                    replica.end_drain()
+                    ready = self._probe_ready(
+                        replica, graph, timeout=ready_timeout_s
+                    )
+                    row.update(
+                        version=[old_v, new_v], ready=ready,
+                        ok=bool(ready and (
+                            not (adds or dels)
+                            or (old_v is not None and new_v > old_v)
+                        )),
+                    )
+                    with self._table_lock:
+                        self._versions[
+                            (name, self._graph_key(graph))
+                        ] = new_v
+                except Exception as e:
+                    row["error"] = f"{type(e).__name__}: {e}"[:300]
+                    try:
+                        replica.end_drain()
+                    except Exception:
+                        pass
+                finally:
+                    row["roll_s"] = round(time.perf_counter() - t0, 3)
+                    with self._table_lock:
+                        self._forced_drain.pop(name, None)
+                        if row.get("ok"):
+                            self._states[name] = "ready"  # re-admit NOW
+            rows.append(row)
+        ok = all(r.get("ok") for r in rows)
+        if ok:
+            # the family is documented as rolling swaps COMPLETED: a
+            # roll with failed replicas must not count as one
+            self._c_rolls.inc()
+        return {
+            "graph": self._graph_key(graph),
+            "adds": len(adds),
+            "dels": len(dels),
+            "replicas": rows,
+            "ok": ok,
+        }
+
+    def _probe_ready(self, replica, graph, timeout: float) -> bool:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            try:
+                if replica.probe(graph, timeout=5.0):
+                    state = replica.health()["state"]
+                    if state == "ready":
+                        return True
+            except Exception:
+                pass
+            time.sleep(0.05)
+        return False
+
+    # ---- introspection / lifecycle ----------------------------------
+    def table(self) -> dict:
+        with self._table_lock:
+            return dict(self._states)
+
+    def stats(self) -> dict:
+        with self._table_lock:
+            states = dict(self._states)
+            versions = {
+                f"{name}:{g}": v
+                for (name, g), v in self._versions.items()
+            }
+        return {
+            "replicas": {
+                name: {
+                    "state": states.get(name),
+                    "kind": getattr(self._replicas[name], "kind", "?"),
+                    "routed": self._routed_cells[name].value,
+                    "load": self._replicas[name].load(),
+                }
+                for name in self._order
+            },
+            "versions": versions,
+            "reroutes": self._c_reroutes.value,
+            "spills": self._c_spills.value,
+            "rolls": self._c_rolls.value,
+            "spill_after": self.spill_after,
+            "poll_interval_s": self.poll_interval_s,
+        }
+
+    def close(self, close_replicas: bool = True) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._poll_stop.set()
+        self._poller.join(timeout=10.0)
+        if close_replicas:
+            for name in self._order:
+                try:
+                    self._replicas[name].close()
+                except Exception:
+                    pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

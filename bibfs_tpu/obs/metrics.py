@@ -36,6 +36,8 @@ from __future__ import annotations
 
 import itertools
 import math
+import os
+import sys
 import threading
 
 # one shared label used by components constructed without an explicit
@@ -60,6 +62,66 @@ def next_instance_label(prefix: str) -> str:
     long-lived engines); bench harnesses that churn engines per rate
     point accept a bounded, run-scoped accumulation."""
     return f"{prefix}-{next(_SEQ)}"
+
+
+_BUILD_INFO: dict | None = None
+
+
+def _read_git_rev() -> str:
+    """The working tree's HEAD commit (12 hex chars), read straight
+    from ``.git`` — no subprocess: this runs at registry construction,
+    which sits on every serving process's import path, and forking git
+    there would tax exactly the processes (replica fleets) the gauge
+    exists to identify."""
+    try:
+        from pathlib import Path
+
+        root = Path(__file__).resolve().parents[2]
+        head = (root / ".git" / "HEAD").read_text().strip()
+        if not head.startswith("ref:"):
+            return head[:12] or "unknown"
+        ref = head.split(None, 1)[1]
+        ref_file = root / ".git" / ref
+        if ref_file.exists():
+            return ref_file.read_text().strip()[:12] or "unknown"
+        packed = root / ".git" / "packed-refs"
+        if packed.exists():
+            for ln in packed.read_text().splitlines():
+                if ln.endswith(" " + ref):
+                    return ln.split()[0][:12]
+    except Exception:
+        pass
+    return "unknown"
+
+
+def build_info_fields() -> dict:
+    """The build-identity labels ``bibfs_build_info`` carries — the
+    same fields every ``bench_*.json`` artifact's ``meta`` block stamps
+    (git rev, os, machine, python, jax, numpy; the meta block's
+    timestamp is run provenance, not build identity, so it stays out).
+    Versions come from package metadata, NOT imports: minting a gauge
+    must never pull jax into a process that wasn't going to use it.
+    Computed once per process."""
+    global _BUILD_INFO
+    if _BUILD_INFO is None:
+        from importlib import metadata
+
+        def _ver(pkg: str) -> str:
+            try:
+                return metadata.version(pkg)
+            except Exception:
+                return "unknown"
+
+        uname = os.uname()
+        _BUILD_INFO = {
+            "git_rev": _read_git_rev(),
+            "os": f"{uname.sysname} {uname.release}",
+            "machine": uname.machine,
+            "python": sys.version.split()[0],
+            "jax": _ver("jax"),
+            "numpy": _ver("numpy"),
+        }
+    return _BUILD_INFO
 
 
 def _validate_name(name: str) -> str:
@@ -445,6 +507,21 @@ class MetricsRegistry:
         self._lock = threading.Lock()
         self._families: dict[str, MetricFamily] = {}
         self._collectors: list = []
+        # bibfs_build_info: minted at registry init so EVERY /metrics
+        # render identifies its build (a fleet of replicas mid-rolling-
+        # restart is exactly when "which build is this node" matters).
+        # Prometheus convention: value is always 1, the labels carry
+        # the identity — join other series against it by instance.
+        try:
+            fields = build_info_fields()
+            self.gauge(
+                "bibfs_build_info",
+                "Build identity of this process (value is always 1; "
+                "labels carry the bench_*.json meta fields)",
+                tuple(sorted(fields)),
+            ).labels(**fields).set(1)
+        except Exception:
+            pass  # provenance must never break metrics
 
     def add_collector(self, fn) -> None:
         """Register a render-time hook: ``fn()`` runs at the top of

@@ -56,6 +56,31 @@ import sys
 _STORE_COMMANDS = ("use", "update", "swap", "graphs")
 
 
+class _SigTerm(Exception):
+    """Raised by the SIGTERM handler out of the blocking stdin read —
+    the graceful-drain path: health flips to draining, in-flight
+    flushes finish, queued results print, and the process exits 0 (the
+    contract a fleet rolling restart relies on)."""
+
+
+def _control_reply(engine, store, cmd: str) -> str:
+    """The stdin ``health`` / ``stats`` commands' one-line JSON reply
+    (``health {...}`` / ``stats {...}`` — same reply-in-the-result-
+    stream grammar as ``oracle``/``graphs``): the control surface a
+    fleet router's subprocess replica driver and a human operator
+    share. Deliberately non-blocking: no flush is forced, so a health
+    probe never perturbs batching."""
+    if cmd == "health":
+        payload = engine.health_snapshot()
+    else:
+        payload = engine.stats()
+        if store is not None:
+            payload["store"] = store.stats()
+    return cmd + " " + json.dumps(
+        payload, sort_keys=True, default=str, separators=(",", ":")
+    )
+
+
 def _oracle_status(engine, store, current) -> str:
     """The stdin ``oracle`` command's reply line: the current graph's
     index status + hit counters (store-backed or engine-local)."""
@@ -462,6 +487,8 @@ def main(argv=None):
 
 def _serve(args, n, edges, store, QueryEngine, PipelinedQueryEngine,
            metrics_server=None):
+    from bibfs_tpu.serve.resilience import QueryError
+
     try:
         kwargs = dict(
             mode=args.mode,
@@ -550,47 +577,116 @@ def _serve(args, n, edges, store, QueryEngine, PipelinedQueryEngine,
                         _print_result(t.src, t.dst, t.result, args.no_path)
                     emitted += 1
 
-            for line in sys.stdin:
-                parts = line.split()
-                if not parts:
-                    continue
-                if parts[0] == "oracle":
-                    if len(parts) != 1:
-                        print("error invalid: usage: oracle")
-                        continue
-                    print(_oracle_status(engine, store, current))
-                    continue
-                if parts[0] in _STORE_COMMANDS:
-                    if store is None:
-                        print(f"error invalid: {parts[0]!r} needs "
-                              "--store")
-                        continue
-                    # sequential REPL semantics: resolve everything
-                    # queued BEFORE the command mutates store state, so
-                    # a query answers on the graph it was typed against
-                    # (the engine's own swap barrier protects in-flight
-                    # batches; this protects still-queued tickets)
-                    engine.flush()
-                    drain()
-                    reply, current = _store_command(store, current, parts)
-                    print(reply)
-                    continue
-                if len(parts) != 2:
-                    print("error invalid: expected 'src dst', got "
-                          f"{line.strip()!r}")
-                    continue
+            # graceful drain on SIGTERM (rolling restarts): the handler
+            # raises out of the blocking stdin read; the except arm
+            # below flips health to draining, finishes in-flight
+            # flushes, prints everything queued, and exits 0
+            import signal
+
+            def _on_sigterm(signum, frame):
+                # one-shot: disarm BEFORE raising, so a second SIGTERM
+                # landing anywhere in the drain path (even inside the
+                # except arm below, before it could disarm) cannot
+                # re-raise outside the try and abort the drain
                 try:
-                    src, dst = int(parts[0]), int(parts[1])
+                    signal.signal(signal.SIGTERM, signal.SIG_IGN)
                 except ValueError:
-                    print("error invalid: non-integer node id in "
-                          f"{line.strip()!r}")
-                    continue
+                    pass
+                raise _SigTerm()
+
+            prev_handler = None
+            sigterm = False
+            try:
+                prev_handler = signal.signal(signal.SIGTERM, _on_sigterm)
+            except ValueError:
+                pass  # not the main thread (in-process embedding)
+            try:
+                for line in sys.stdin:
+                    parts = line.split()
+                    if not parts:
+                        continue
+                    if parts[0] == "oracle":
+                        if len(parts) != 1:
+                            print("error invalid: usage: oracle")
+                            continue
+                        print(_oracle_status(engine, store, current))
+                        continue
+                    if parts[0] in ("health", "stats"):
+                        if len(parts) != 1:
+                            print(f"error invalid: usage: {parts[0]}")
+                            continue
+                        # print already-resolved results FIRST: the
+                        # control reply doubles as the subprocess
+                        # replica driver's result-drain nudge
+                        drain()
+                        print(_control_reply(engine, store, parts[0]))
+                        continue
+                    if parts[0] in _STORE_COMMANDS:
+                        if store is None:
+                            print(f"error invalid: {parts[0]!r} needs "
+                                  "--store")
+                            continue
+                        # sequential REPL semantics: resolve everything
+                        # queued BEFORE the command mutates store state,
+                        # so a query answers on the graph it was typed
+                        # against (the engine's own swap barrier protects
+                        # in-flight batches; this protects still-queued
+                        # tickets)
+                        engine.flush()
+                        drain()
+                        reply, current = _store_command(
+                            store, current, parts
+                        )
+                        print(reply)
+                        continue
+                    if len(parts) != 2:
+                        print("error invalid: expected 'src dst', got "
+                              f"{line.strip()!r}")
+                        continue
+                    try:
+                        src, dst = int(parts[0]), int(parts[1])
+                    except ValueError:
+                        print("error invalid: non-integer node id in "
+                              f"{line.strip()!r}")
+                        continue
+                    try:
+                        tickets.append(engine.submit(src, dst, current))
+                    except QueryError as e:
+                        # a draining engine refuses admissions with a
+                        # structured capacity error: answer it in-stream
+                        # (retryable on a peer replica) and keep serving
+                        # what is already queued
+                        print(f"error {e.kind}: {src} -> {dst}: {e}")
+                        continue
+                    except RuntimeError as e:
+                        print(f"error capacity: {src} -> {dst}: {e}")
+                        continue
+                    except ValueError as e:
+                        print(f"error invalid: {src} -> {dst}: {e}")
+                        continue
+                    drain()
+            except _SigTerm:
+                sigterm = True
+                # restart managers re-send SIGTERM: ignore repeats from
+                # here on — a second signal mid-drain must not raise
+                # outside the try (or, once the previous handler were
+                # restored, kill the process) before the queued results
+                # below get printed
                 try:
-                    tickets.append(engine.submit(src, dst, current))
-                except ValueError as e:
-                    print(f"error invalid: {src} -> {dst}: {e}")
-                    continue
-                drain()
+                    signal.signal(signal.SIGTERM, signal.SIG_IGN)
+                except ValueError:
+                    pass
+                engine.begin_drain()  # health -> draining; submits now
+                # answer structured capacity errors (nothing more will
+                # arrive from stdin — the loop is done)
+                print("[Serve] SIGTERM: draining (finishing in-flight "
+                      "flushes)", file=sys.stderr, flush=True)
+            finally:
+                if prev_handler is not None and not sigterm:
+                    try:
+                        signal.signal(signal.SIGTERM, prev_handler)
+                    except ValueError:
+                        pass
             engine.flush()
             drain()
             if failed:

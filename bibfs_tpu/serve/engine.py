@@ -607,6 +607,11 @@ class QueryEngine:
             gauge=self._res_cells.health_gauge,
         )
         self._health_window_s = health_window_s
+        # drain gate (begin_drain/end_drain): while set, NEW submits are
+        # refused with a kind='capacity' QueryError but everything
+        # already queued still resolves — the replica-at-a-time seam a
+        # fleet rolling swap drains through (bibfs_tpu/fleet)
+        self._draining = False
         self.health.set_ready()
         # render-time health refresh: breaker windows elapse and error
         # windows age out with no event, so a /metrics-only scraper
@@ -813,6 +818,16 @@ class QueryEngine:
             # pin nor solve — fail HERE with a clear error instead of
             # stranding the ticket on a retired-snapshot RuntimeError
             raise ValueError("engine is closed")
+        if self._draining:
+            # draining-replica contract (rolling swaps): new work is
+            # refused with a STRUCTURED capacity error — retryable on a
+            # peer replica — while tickets already queued still resolve
+            # at flush. Deliberately not counted as an engine error:
+            # refusing admissions is the drain working, not a failure.
+            raise QueryError(
+                "engine is draining", kind="capacity",
+                query=(int(src), int(dst)),
+            )
         src, dst = int(src), int(dst)
         name, rt = self._resolve_graph(graph)
         if not (0 <= src < rt.n and 0 <= dst < rt.n):
@@ -1329,6 +1344,42 @@ class QueryEngine:
         return self._current_rt().get_host_solver()
 
     # ---- lifecycle ---------------------------------------------------
+    def begin_drain(self) -> None:
+        """Enter the draining state: ``/healthz`` flips to draining (a
+        router stops sending traffic), NEW submits are refused with a
+        ``kind='capacity'`` :class:`QueryError`, and everything already
+        queued still resolves at the next :meth:`flush`. Reversible via
+        :meth:`end_drain` — this is the replica-at-a-time seam a fleet
+        rolling swap drains through; ``close()`` remains the terminal
+        drain."""
+        self._draining = True
+        self.health.set_draining()
+
+    def end_drain(self) -> None:
+        """Leave the draining state (rolling-swap re-admit): submits
+        are accepted again and health goes back to ready/degraded from
+        its live inputs."""
+        self._draining = False
+        self.health.clear_draining()
+
+    def kill(self) -> None:
+        """Crash-semantics teardown for chaos drills: tickets still
+        QUEUED fail NOW with a structured ``kind='internal'``
+        :class:`QueryError` (a crashed replica cannot solve them — its
+        router reroutes the failures to a peer) instead of being
+        drained, health flips to draining, and the snapshot pins drop.
+        Later submits raise ``engine is closed``. Contrast
+        :meth:`close`, which resolves everything queued first."""
+        self._draining = True
+        pend, self._pending = self._pending, []
+        if pend:
+            self._resolve_error(pend, QueryError(
+                "replica killed: engine torn down with queries queued",
+                kind="internal",
+            ))
+        self.health.set_draining()
+        self._release_runtimes()
+
     def close(self) -> None:
         """Resolve anything still queued, then mark the engine draining
         (``/healthz`` flips to 503) and drop the engine's snapshot pins

@@ -369,6 +369,18 @@ class PipelinedQueryEngine(QueryEngine):
         and cache hits resolve before returning; everything else
         resolves when the background flusher's batch lands (depth,
         deadline, or drain — whichever comes first)."""
+        if self._draining:
+            if self._closed:
+                # a killed/closed engine is TERMINAL — it must not
+                # masquerade as a retryable draining refusal (the sync
+                # engine's post-kill submit raises closed the same way)
+                raise RuntimeError("engine is closed")
+            # draining-replica contract (see the sync engine's submit):
+            # structured capacity refusal, queued tickets still resolve
+            raise QueryError(
+                "engine is draining", kind="capacity",
+                query=(int(src), int(dst)),
+            )
         src, dst = int(src), int(dst)
         name, rt = self._resolve_graph(graph)
         if not (0 <= src < rt.n and 0 <= dst < rt.n):
@@ -515,6 +527,37 @@ class PipelinedQueryEngine(QueryEngine):
                         f"{self._outstanding} tickets outstanding"
                     )
                 self._cv.wait(timeout=0.1)
+
+    def kill(self) -> None:
+        """Crash-semantics teardown for chaos drills: tickets still
+        QUEUED fail NOW with ``kind='internal'`` :class:`QueryError` s
+        (a crashed replica cannot solve them — a fleet router reroutes
+        the failures to a peer) instead of being drained by the
+        flusher; batches already launched still resolve through their
+        finish jobs (they are past the point a real crash could
+        silently unwind without losing tickets, and zero-lost is the
+        invariant every chaos gate holds). Workers are then joined and
+        the snapshot pins drop. Contrast :meth:`close`, which drains
+        the whole queue first."""
+        with self._cv:
+            if self._closed:
+                return
+            self._closed = True
+            self._draining = True
+            self.health.set_draining()
+            leftovers = [t for t in self._queue if not t.done()]
+            self._queue.clear()
+            for t in leftovers:
+                self._fail_ticket(t, QueryError(
+                    "replica killed: engine torn down with queries "
+                    "queued", kind="internal", query=(t.src, t.dst),
+                ))
+            self._outstanding -= len(leftovers)
+            self._g_queue_depth.set(0)
+            self._cv.notify_all()
+        self._flusher.join(timeout=60.0)
+        self._finish_pool.shutdown(wait=True)
+        self._release_runtimes()
 
     def close(self) -> None:
         """Drain the queue, stop the flusher, and join every worker.

@@ -302,6 +302,13 @@ class HealthMonitor:
     def set_draining(self) -> None:
         self._draining = True
 
+    def clear_draining(self) -> None:
+        """Leave the draining state — the rolling-swap re-admit path
+        (``engine.end_drain()``): the monitor goes back to deriving
+        ready/degraded from its live inputs. A ``close()``-style
+        terminal drain simply never calls this."""
+        self._draining = False
+
     def note_error(self, count: int = 1) -> None:
         now = self._clock()
         with self._lock:

@@ -606,6 +606,20 @@ class GraphStore:
         compaction; folds whatever is pending when its turn comes."""
         return self._compact_inline(str(name))
 
+    def roll(self, name: str, adds=(), dels=()) -> GraphSnapshot:
+        """Apply one edge-update batch and synchronously fold it into a
+        fresh, atomically hot-swapped snapshot — the per-replica step of
+        a fleet rolling swap (``bibfs_tpu/fleet``): the router drains a
+        replica, calls ``roll()`` on THAT replica's store, ready-probes,
+        re-admits, and moves to the next, so the fleet serves mixed
+        versions mid-roll while every replica's answers stay exact for
+        the version it declares. With nothing passed and nothing
+        pending this is a no-op returning the current snapshot."""
+        name = str(name)
+        if adds or dels:
+            self.update(name, adds=adds, dels=dels)
+        return self.compact(name)
+
     def swap(self, name: str, snapshot: GraphSnapshot) -> GraphSnapshot:
         """Atomically point ``name`` at an externally built snapshot.
         Returns the OLD snapshot (already released by the store; it

@@ -1,0 +1,487 @@
+"""Fleet serving: the health-aware router's policy logic (stub
+replicas — routing affinity, health-driven table, failure re-routing,
+spill, rolling-swap choreography) and the real-replica integrations
+(in-process engines over per-replica stores; spawned ``bibfs-serve``
+subprocesses)."""
+
+import time
+
+import numpy as np
+import pytest
+
+from bibfs_tpu.fleet import (
+    ReplicaDead,
+    Router,
+    engine_replica,
+)
+from bibfs_tpu.obs.metrics import REGISTRY
+from bibfs_tpu.serve.resilience import QueryError, RetryPolicy
+from bibfs_tpu.solvers.api import BFSResult
+from bibfs_tpu.solvers.serial import solve_serial
+from bibfs_tpu.store import GraphStore
+
+
+def _skiplink_graph(n: int) -> np.ndarray:
+    edges = [[i, i + 1] for i in range(n - 1)]
+    edges += [[i, i + 7] for i in range(n - 7)]
+    return np.array(edges)
+
+
+N = 80
+EDGES = _skiplink_graph(N)
+
+
+class _StubTicket:
+    def __init__(self, src, dst, result=None, error=None):
+        self.src, self.dst = src, dst
+        self.result = result
+        self.error = error
+
+
+class StubReplica:
+    """Replica-shaped test double: resolves every query inline with a
+    recognizable result (hops = src + dst), scriptable health/load and
+    failure modes."""
+
+    kind = "stub"
+
+    def __init__(self, name):
+        self.name = name
+        self.state = "ready"
+        self._load = 0
+        self.fail_submits = False
+        self.fail_tickets = False
+        self.dead = False
+        self.submitted = []
+        self.events = []
+        self._version = 1
+
+    def submit(self, src, dst, graph=None):
+        if self.dead:
+            raise ReplicaDead(self.name)
+        if self.fail_submits:
+            raise QueryError("stub refusing", kind="capacity",
+                             query=(src, dst))
+        self.submitted.append((graph, src, dst))
+        if self.fail_tickets:
+            return _StubTicket(src, dst, error=QueryError(
+                "stub ticket failure", kind="internal",
+                query=(src, dst),
+            ))
+        return _StubTicket(
+            src, dst,
+            result=BFSResult(True, src + dst, None, None, 0.0, 0, 0),
+        )
+
+    def wait_ticket(self, t, timeout=None):
+        if t.error is not None:
+            raise t.error
+        return t.result
+
+    def flush(self, timeout=None):
+        self.events.append("flush")
+
+    def load(self):
+        return self._load
+
+    def health(self):
+        if self.dead:
+            raise ReplicaDead(self.name)
+        return {"state": self.state}
+
+    def stats(self):
+        return {}
+
+    def version(self, graph=None):
+        return self._version
+
+    def begin_drain(self):
+        self.events.append("begin_drain")
+        return True
+
+    def end_drain(self):
+        self.events.append("end_drain")
+        return True
+
+    def roll(self, graph=None, adds=(), dels=()):
+        self.events.append(("roll", graph, len(adds), len(dels)))
+        if adds or dels:
+            self._version += 1
+        return self._version
+
+    def probe(self, graph=None, timeout=5.0):
+        self.events.append("probe")
+        return True
+
+    def kill(self):
+        self.dead = True
+
+    def restart(self):
+        self.dead = False
+
+    def close(self):
+        self.events.append("close")
+
+
+def _stub_router(k=3, **kw):
+    stubs = [StubReplica(f"s{i}") for i in range(k)]
+    kw.setdefault("poll_interval_s", 0.05)
+    return Router(stubs, **kw), stubs
+
+
+def test_hash_affinity_is_stable():
+    router, stubs = _stub_router()
+    try:
+        owners = {g: router.owner(g) for g in ("a", "b", "c", "d")}
+        for g, owner in owners.items():
+            for _ in range(5):
+                t = router.submit(1, 2, g)
+                assert t.replica == owner  # idle fleet: pure affinity
+    finally:
+        router.close(close_replicas=False)
+
+
+def test_degraded_demoted_dead_ejected_readmitted():
+    router, stubs = _stub_router(2)
+    try:
+        owner = router.owner("g")
+        other = next(s for s in stubs if s.name != owner)
+        owner_stub = next(s for s in stubs if s.name == owner)
+        # degraded owner: traffic prefers the ready peer
+        owner_stub.state = "degraded"
+        router._poll_once()
+        assert router.submit(1, 2, "g").replica == other.name
+        # dead owner: ejected (health raises)
+        owner_stub.state = "ready"
+        owner_stub.dead = True
+        router._poll_once()
+        assert router.table()[owner] == "dead"
+        assert router.submit(1, 2, "g").replica == other.name
+        # recovery: re-admitted, affinity restored
+        owner_stub.dead = False
+        router._poll_once()
+        assert router.table()[owner] == "ready"
+        assert router.submit(1, 2, "g").replica == owner
+    finally:
+        router.close(close_replicas=False)
+
+
+def test_submit_failure_reroutes_and_counts():
+    router, stubs = _stub_router(3)
+    try:
+        owner = router.owner("g")
+        owner_stub = next(s for s in stubs if s.name == owner)
+        owner_stub.fail_submits = True
+        before = router.stats()["reroutes"]
+        t = router.submit(3, 4, "g")
+        assert t.replica != owner
+        assert t.wait(timeout=5.0).hops == 7
+        assert router.stats()["reroutes"] > before
+    finally:
+        router.close(close_replicas=False)
+
+
+def test_ticket_failure_reroutes_on_wait():
+    router, stubs = _stub_router(2, retry=RetryPolicy(attempts=3))
+    try:
+        owner = router.owner("g")
+        owner_stub = next(s for s in stubs if s.name == owner)
+        owner_stub.fail_tickets = True
+        t = router.submit(3, 4, "g")
+        assert t.replica == owner  # submit itself succeeded
+        res = t.wait(timeout=10.0)
+        assert res.hops == 7 and t.replica != owner
+        assert t.attempts == 2
+    finally:
+        router.close(close_replicas=False)
+
+
+def test_invalid_never_reroutes():
+    router, stubs = _stub_router(2)
+    try:
+        owner = router.owner("g")
+        owner_stub = next(s for s in stubs if s.name == owner)
+
+        orig = owner_stub.submit
+
+        def bad_submit(src, dst, graph=None):
+            raise ValueError("src/dst out of range")
+
+        owner_stub.submit = bad_submit
+        with pytest.raises(ValueError):
+            router.submit(999, 999, "g")
+        owner_stub.submit = orig
+    finally:
+        router.close(close_replicas=False)
+
+
+def test_all_dead_raises_capacity():
+    router, stubs = _stub_router(2)
+    try:
+        for s in stubs:
+            s.dead = True
+        router._poll_once()
+        with pytest.raises(QueryError) as exc:
+            router.submit(1, 2, "g")
+        assert exc.value.kind == "capacity"
+    finally:
+        router.close(close_replicas=False)
+
+
+def test_spill_to_least_loaded():
+    router, stubs = _stub_router(3, spill_after=4)
+    try:
+        owner = router.owner("hot")
+        for s in stubs:
+            s._load = 0 if s.name != owner else 100
+        before = router.stats()["spills"]
+        t = router.submit(1, 2, "hot")
+        assert t.replica != owner
+        assert router.stats()["spills"] == before + 1
+    finally:
+        router.close(close_replicas=False)
+
+
+def test_rolling_swap_choreography_and_metrics():
+    router, stubs = _stub_router(2)
+    try:
+        out = router.rolling_swap("g", adds=[(0, 1)], dels=[])
+        assert out["ok"], out
+        assert router.stats()["rolls"] == 1
+        for s in stubs:
+            # drain -> flush -> roll -> end_drain -> probe, in order
+            assert s.events[0] == "begin_drain"
+            assert "flush" in s.events
+            roll_i = s.events.index(("roll", "g", 1, 0))
+            assert s.events.index("end_drain") > roll_i
+            assert "probe" in s.events
+            assert s._version == 2
+        for row in out["replicas"]:
+            assert row["version"] == [1, 2]
+        # the fleet families render
+        text = REGISTRY.render()
+        for fam in ("bibfs_fleet_replicas", "bibfs_fleet_routed_total",
+                    "bibfs_fleet_reroutes_total",
+                    "bibfs_fleet_rolls_total",
+                    "bibfs_fleet_spills_total"):
+            assert fam in text, fam
+    finally:
+        router.close(close_replicas=False)
+
+
+# ---- real in-process replicas ---------------------------------------
+
+def _make_engine_replica(idx, graphs=("a",), **kw):
+    store = GraphStore(compact_threshold=None)
+    for g in graphs:
+        store.add(g, N, EDGES)
+    kw.setdefault("cache_entries", 8)
+    kw.setdefault("max_batch", 16)
+    return engine_replica(f"r{idx}", store, **kw)
+
+
+def test_engine_fleet_serves_correctly():
+    router = Router(
+        [_make_engine_replica(i, ("a", "b")) for i in range(3)],
+        poll_interval_s=0.1,
+    )
+    try:
+        pairs = [(0, 50), (3, 40), (11, 70), (2, 2)]
+        for g in ("a", "b"):
+            results = router.query_many(pairs, graph=g)
+            for (s, d), res in zip(pairs, results):
+                ref = solve_serial(N, EDGES, s, d)
+                assert res.found == ref.found
+                assert res.hops == ref.hops, (g, s, d)
+    finally:
+        router.close()
+
+
+def test_engine_fleet_kill_restart_reroute():
+    router = Router(
+        [_make_engine_replica(i) for i in range(3)],
+        poll_interval_s=0.1,
+    )
+    try:
+        owner = router.owner("a")
+        # park a ticket on the owner, then crash it: the failure must
+        # re-route on wait and the answer stay exact
+        t = router.submit(0, 50, "a")
+        assert t.replica == owner
+        router.replica(owner).kill()
+        ref = solve_serial(N, EDGES, 0, 50)
+        assert t.wait(timeout=30.0).hops == ref.hops
+        assert t.replica != owner
+        assert router.stats()["reroutes"] > 0
+        # dead in the table; new traffic avoids it
+        deadline = time.monotonic() + 5.0
+        while (router.table()[owner] != "dead"
+               and time.monotonic() < deadline):
+            time.sleep(0.02)
+        assert router.table()[owner] == "dead"
+        assert router.submit(3, 40, "a").replica != owner
+        # restart over the same store; the poller re-admits
+        router.replica(owner).restart()
+        deadline = time.monotonic() + 5.0
+        while (router.table()[owner] != "ready"
+               and time.monotonic() < deadline):
+            time.sleep(0.02)
+        assert router.table()[owner] == "ready"
+        assert router.query(5, 60, "a").hops == solve_serial(
+            N, EDGES, 5, 60
+        ).hops
+    finally:
+        router.close()
+
+
+def test_engine_fleet_rolling_swap_mixed_versions():
+    """Mid-roll the fleet serves mixed versions, each replica exact for
+    the version it declares; post-roll every replica declares v2 and
+    answers on the updated graph."""
+    router = Router(
+        [_make_engine_replica(i) for i in range(2)],
+        poll_interval_s=0.1,
+    )
+    try:
+        ref_v1 = solve_serial(N, EDGES, 0, N - 1)
+        t0 = router.submit(0, N - 1, "a")
+        assert t0.wait(timeout=30.0).hops == ref_v1.hops
+        assert t0.declared_version == 1
+
+        out = router.rolling_swap("a", adds=[(0, N - 1)], dels=[])
+        assert out["ok"], out
+        for name in router.replica_names:
+            assert router.replica(name).version("a") == 2
+        t1 = router.submit(0, N - 1, "a")
+        assert t1.wait(timeout=30.0).hops == 1  # the added shortcut
+        assert t1.declared_version == 2
+    finally:
+        router.close()
+
+
+def test_engine_fleet_drain_reroutes_live_traffic():
+    """While one replica drains (rolling-swap window), its submits are
+    refused with structured capacity errors and the router routes
+    around it — no caller ever sees the refusal."""
+    router = Router(
+        [_make_engine_replica(i) for i in range(2)],
+        poll_interval_s=0.05,
+    )
+    try:
+        owner = router.owner("a")
+        router.replica(owner).begin_drain()
+        for _ in range(4):
+            t = router.submit(0, 50, "a")
+            assert t.replica != owner
+            assert t.wait(timeout=30.0) is not None
+        router.replica(owner).end_drain()
+    finally:
+        router.close()
+
+
+@pytest.mark.slow
+def test_run_fleet_harness_end_to_end():
+    """A miniature fleet soak through the public harness: qps phases
+    (ratio reported, not gated at this scale), kill/restart with
+    recovery, a rolling swap under load, spill burst, live /metrics —
+    zero lost, all verified. (The CI fleet smoke runs the bench.py
+    wrapper of this same harness; marked slow to keep it out of the
+    tier-1 budget.)"""
+    from bibfs_tpu.serve.loadgen import run_fleet
+
+    out = run_fleet(
+        replicas=3, graphs=6, grid=(24, 24), queries=300,
+        chaos_queries=240, chaos_span_s=6.0, hot_pool=12,
+        cache_entries=16, qps_factor=None, recovery_bound_s=30.0,
+        burst_queries=90,
+    )
+    assert out["zero_lost"], out["tickets"]
+    assert out["zero_failed"], out["failed_sample"]
+    assert out["verified_vs_truth"], out["mismatches"]
+    assert out["recovery_ok"], out["chaos"]
+    assert out["roll_ok"], out["roll"]
+    assert out["reroutes_ok"] and out["spill_ok"]
+    assert out["metrics_ok"], out["metrics"]
+    assert out["ok"]
+
+
+# ---- subprocess replicas --------------------------------------------
+
+@pytest.mark.slow
+def test_process_replica_fleet(tmp_path):
+    """Real ``bibfs-serve`` subprocess replicas behind the router:
+    routing, the health/stats control surface, a REAL process kill
+    (in-flight queries die with the interpreter and re-route), restart
+    and re-admission."""
+    from bibfs_tpu.fleet import ProcessReplica
+    from bibfs_tpu.graph.io import write_graph_bin
+
+    gpath = tmp_path / "g.bin"
+    write_graph_bin(gpath, N, EDGES)
+    router = Router(
+        [ProcessReplica(f"p{i}", str(gpath)) for i in range(2)],
+        poll_interval_s=0.2,
+    )
+    try:
+        results = router.query_many([(0, 50), (3, 40), (0, N - 1)])
+        for (s, d), res in zip([(0, 50), (3, 40), (0, N - 1)], results):
+            assert res.hops == solve_serial(N, EDGES, s, d).hops
+        st = router.replica(router.owner(None)).stats()
+        assert st["queries"] >= 1
+        t = router.submit(5, 60)
+        victim = t.replica
+        router.replica(victim).kill()  # SIGKILL: real crash chaos
+        assert t.wait(timeout=60.0).hops == solve_serial(
+            N, EDGES, 5, 60
+        ).hops
+        assert t.replica != victim
+        router.replica(victim).restart()
+        deadline = time.monotonic() + 30.0
+        while (router.table()[victim] != "ready"
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        assert router.table()[victim] == "ready"
+    finally:
+        router.close()
+
+
+@pytest.mark.slow
+def test_process_replica_store_rolling_swap(tmp_path):
+    """A rolling swap across ``--store`` subprocess replicas: the
+    update batch lands through each child's stdin control surface
+    (``use``/``update``/``swap``), versions advance, and post-roll
+    answers reflect the new edge set."""
+    from bibfs_tpu.fleet import ProcessReplica
+    from bibfs_tpu.graph.io import write_graph_bin
+
+    store_dir = tmp_path / "store"
+    store_dir.mkdir()
+    write_graph_bin(store_dir / "a.bin", N, EDGES)
+    router = Router(
+        [ProcessReplica(f"p{i}", store_dir=str(store_dir))
+         for i in range(2)],
+        poll_interval_s=0.2,
+    )
+    try:
+        ref = solve_serial(N, EDGES, 0, N - 1)
+        assert router.query(0, N - 1, "a").hops == ref.hops
+        out = router.rolling_swap("a", adds=[(0, N - 1)], dels=[])
+        assert out["ok"], out
+        for row in out["replicas"]:
+            assert row["version"] == [1, 2]
+        assert router.query(0, N - 1, "a").hops == 1
+        # a refused `use` (unknown graph) must FAIL the query, never
+        # silently answer it against the child's previous graph
+        rep = router.replica("p0")
+        bad = rep.submit(0, 5, "nope")
+        with pytest.raises(QueryError) as exc:
+            rep.wait_ticket(bad, timeout=30.0)
+        assert exc.value.kind == "invalid"
+        # and the replica recovers: the next good-graph query re-`use`s
+        # (expected hops on the POST-roll graph, shortcut included)
+        edges_v2 = np.vstack([EDGES, [[0, N - 1]]])
+        assert rep.wait_ticket(
+            rep.submit(0, 50, "a"), timeout=30.0
+        ).hops == solve_serial(N, edges_v2, 0, 50).hops
+    finally:
+        router.close()

@@ -198,3 +198,31 @@ def test_engine_labels_are_per_instance():
     e1.query(0, 30)
     assert e1.counters["queries"] == 1
     assert e2.counters["queries"] == 0
+
+
+def test_build_info_gauge_renders():
+    """Every registry mints ``bibfs_build_info`` at construction: value
+    1, labels carrying the bench_*.json meta fields — so any /metrics
+    render identifies its build (which replica runs which build is the
+    question a rolling restart exists to answer)."""
+    from bibfs_tpu.obs.metrics import (
+        MetricsRegistry,
+        build_info_fields,
+    )
+
+    fields = build_info_fields()
+    assert set(fields) == {
+        "git_rev", "os", "machine", "python", "jax", "numpy",
+    }
+    assert fields["python"].count(".") >= 1  # a real version string
+    # the process registry AND any fresh registry carry it
+    for reg in (REGISTRY, MetricsRegistry()):
+        text = reg.render()
+        assert "bibfs_build_info{" in text
+        line = next(
+            ln for ln in text.splitlines()
+            if ln.startswith("bibfs_build_info{")
+        )
+        assert line.endswith(" 1")
+        for k in fields:
+            assert f'{k}="' in line

@@ -1,0 +1,175 @@
+"""Draining-replica semantics at both engines' submit seams, the
+crash-semantics ``kill()``, the ``bibfs-serve`` ``health``/``stats``
+stdin commands, and the SIGTERM graceful drain — the replica
+drain/handoff seams the fleet's rolling swaps ride on."""
+
+import io
+import json
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from bibfs_tpu.graph.io import write_graph_bin
+from bibfs_tpu.serve.engine import QueryEngine
+from bibfs_tpu.serve.pipeline import PipelinedQueryEngine
+from bibfs_tpu.serve.resilience import HealthMonitor, QueryError
+from bibfs_tpu.solvers.serial import solve_serial
+
+
+def _skiplink_graph(n: int) -> np.ndarray:
+    edges = [[i, i + 1] for i in range(n - 1)]
+    edges += [[i, i + 7] for i in range(n - 7)]
+    return np.array(edges)
+
+
+N = 60
+EDGES = _skiplink_graph(N)
+
+
+def test_sync_drain_rejects_new_submits_resolves_queued():
+    """A draining sync engine refuses NEW submits with a structured
+    kind='capacity' QueryError while tickets already queued still
+    resolve at flush; end_drain re-admits."""
+    eng = QueryEngine(N, EDGES, flush_threshold=64)
+    try:
+        queued = eng.submit(0, 50)
+        assert queued.result is None  # parked for the flush
+        eng.begin_drain()
+        assert eng.health_snapshot()["state"] == "draining"
+        with pytest.raises(QueryError) as exc:
+            eng.submit(1, 40)
+        assert exc.value.kind == "capacity"
+        eng.flush()  # in-flight work still completes while draining
+        ref = solve_serial(N, EDGES, 0, 50)
+        assert queued.result.hops == ref.hops
+        eng.end_drain()
+        assert eng.health_snapshot()["state"] == "ready"
+        assert eng.query(1, 40).hops == solve_serial(N, EDGES, 1, 40).hops
+    finally:
+        eng.close()
+
+
+def test_pipelined_drain_rejects_new_submits_resolves_queued():
+    eng = PipelinedQueryEngine(
+        N, EDGES, flush_threshold=64, max_wait_ms=None
+    )
+    try:
+        queued = eng.submit(0, 50)
+        eng.begin_drain()
+        assert eng.health_snapshot()["state"] == "draining"
+        with pytest.raises(QueryError) as exc:
+            eng.submit(1, 40)
+        assert exc.value.kind == "capacity"
+        eng.flush()
+        ref = solve_serial(N, EDGES, 0, 50)
+        assert queued.wait(timeout=30.0).hops == ref.hops
+        eng.end_drain()
+        assert eng.health_snapshot()["state"] == "ready"
+        t = eng.submit(1, 40)  # re-admitted (depth-only flushing: the
+        eng.flush()            # explicit flush resolves it)
+        assert t.wait(timeout=30.0).hops == solve_serial(
+            N, EDGES, 1, 40
+        ).hops
+    finally:
+        eng.close()
+
+
+def test_sync_kill_fails_queued_with_internal_error():
+    eng = QueryEngine(N, EDGES, flush_threshold=64)
+    t = eng.submit(0, 50)
+    eng.kill()
+    assert isinstance(t.error, QueryError)
+    assert t.error.kind == "internal"
+    with pytest.raises(ValueError, match="closed"):
+        eng.submit(1, 2)
+    assert eng.health_snapshot()["state"] == "draining"
+
+
+def test_pipelined_kill_fails_queued_with_internal_error():
+    # max_wait_ms=None + high threshold: the queue holds the ticket
+    # until kill() sweeps it
+    eng = PipelinedQueryEngine(
+        N, EDGES, flush_threshold=64, max_wait_ms=None
+    )
+    t = eng.submit(0, 50)
+    eng.kill()
+    with pytest.raises(QueryError) as exc:
+        t.wait(timeout=5.0)
+    assert exc.value.kind == "internal"
+    with pytest.raises((QueryError, RuntimeError)):
+        eng.submit(1, 2)
+    eng.close()  # idempotent after kill
+
+
+def test_health_monitor_clear_draining():
+    mon = HealthMonitor()
+    mon.set_ready()
+    assert mon.state()[0] == "ready"
+    mon.set_draining()
+    assert mon.state()[0] == "draining"
+    mon.clear_draining()
+    assert mon.state()[0] == "ready"
+
+
+def test_cli_health_stats_commands(tmp_path, capsys, monkeypatch):
+    """The stdin ``health``/``stats`` commands answer one-line JSON
+    replies in the result stream (the subprocess replica driver's
+    control surface) without killing the REPL."""
+    from bibfs_tpu.serve.cli import main as serve_main
+
+    gpath = tmp_path / "g.bin"
+    write_graph_bin(gpath, N, EDGES)
+    monkeypatch.setattr(
+        "sys.stdin", io.StringIO("0 50\nhealth\nstats\nhealth x\n3 40\n")
+    )
+    rc = serve_main([str(gpath), "--no-path"])
+    assert rc == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    health_lines = [ln for ln in out if ln.startswith("health ")]
+    stats_lines = [ln for ln in out if ln.startswith("stats ")]
+    assert len(health_lines) == 1 and len(stats_lines) == 1
+    h = json.loads(health_lines[0][len("health "):])
+    assert h["state"] in ("ready", "degraded")
+    st = json.loads(stats_lines[0][len("stats "):])
+    assert "queries" in st and "dist_cache" in st
+    assert any("usage: health" in ln for ln in out)  # bad arity answers
+    assert sum(": length = " in ln for ln in out) == 2
+
+
+@pytest.mark.slow
+def test_cli_sigterm_graceful_drain(tmp_path):
+    """SIGTERM on a live ``bibfs-serve``: health flips to draining,
+    in-flight flushes finish (queued results PRINT), and the process
+    exits 0 — the clean rolling-restart contract."""
+    gpath = tmp_path / "g.bin"
+    write_graph_bin(gpath, N, EDGES)
+    proc = subprocess.Popen(
+        [sys.executable, "-u", "-m", "bibfs_tpu.serve.cli",
+         str(gpath), "--no-path"],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True,
+    )
+    try:
+        # readiness barrier: the health reply proves the REPL (and its
+        # SIGTERM handler) is installed before the signal fires
+        proc.stdin.write("health\n")
+        proc.stdin.flush()
+        ready = proc.stdout.readline()
+        assert ready.startswith("health "), ready
+        proc.stdin.write("0 50\n3 40\n")
+        proc.stdin.flush()
+        time.sleep(0.3)
+        proc.send_signal(signal.SIGTERM)
+        out, err = proc.communicate(timeout=120)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.communicate()
+    assert proc.returncode == 0, err[-2000:]
+    ref = solve_serial(N, EDGES, 0, 50)
+    assert f"0 -> 50: length = {ref.hops}" in out.splitlines()
+    assert "SIGTERM" in err
