@@ -1388,6 +1388,103 @@ FLEET_CHAOS_SPAN_S = float(os.environ.get("BENCH_FLEET_CHAOS_SPAN_S", 24.0))
 FLEET_QPS_FACTOR = float(os.environ.get("BENCH_FLEET_QPS_FACTOR", 2.0))
 FLEET_RECOVERY_S = float(os.environ.get("BENCH_FLEET_RECOVERY_S", 10.0))
 
+# --serve-crash defaults: the crash-durability soak SIGKILLs a durable
+# bibfs-serve subprocess replica repeatedly mid-update-stream and gates
+# on zero acknowledged-update loss (digest + fresh-native-BFS verified),
+# bounded recovery-to-ready, torn-tail replay, catch-up re-admission,
+# and zero lost tickets on the non-killed replicas; --quick is the CI
+# smoke shape (fewer cycles, smaller grid — the full artifact keeps the
+# >= 3 SIGKILL/restart cycles the acceptance gate requires)
+CRASH_REPLICAS = int(os.environ.get("BENCH_CRASH_REPLICAS", 3))
+CRASH_GRID = os.environ.get("BENCH_CRASH_GRID", "40x40")
+CRASH_CYCLES = int(os.environ.get("BENCH_CRASH_CYCLES", 3))
+CRASH_UPDATES = int(os.environ.get("BENCH_CRASH_UPDATES", 6))
+CRASH_RATE = float(os.environ.get("BENCH_CRASH_RATE", 150.0))
+CRASH_RECOVERY_S = float(os.environ.get("BENCH_CRASH_RECOVERY_S", 30.0))
+
+
+def serve_crash_main():
+    """``python bench.py --serve-crash``: the crash-durability soak.
+
+    A fleet of one DURABLE subprocess replica (``--durable --fsync
+    always``) plus in-process durable replicas serves open-loop routed
+    traffic while the subprocess is SIGKILL'd and respawned
+    repeatedly, immediately after acked edge updates
+    (bibfs_tpu/serve/loadgen.run_crash). The gate: every acked update
+    visible after every recovery (snapshot digest equality + fresh
+    native BFS on re-queried pairs), recovery-to-ready within
+    BENCH_CRASH_RECOVERY_S, torn-tail WAL replay (parent-side copy AND
+    respawned child), catch-up re-admission at the fleet's committed
+    version after a rolling swap, zero lost/stranded tickets on the
+    non-killed replicas (survivors verified vs native BFS, audited vs
+    the serial solver), and the durability metric families on the
+    registry render. Artifact: ``bench_crash.json``."""
+    t_setup = time.time()
+    platform, tpu_error = select_platform()
+    try:
+        from bibfs_tpu.serve.loadgen import run_crash
+
+        quick = "--quick" in sys.argv
+        try:
+            w, h = (int(x) for x in
+                    ("30x30" if quick else CRASH_GRID).split("x"))
+        except ValueError:
+            print(f"bad BENCH_CRASH_GRID {CRASH_GRID!r} (want WxH)",
+                  file=sys.stderr)
+            return 1
+        out = run_crash(
+            replicas=CRASH_REPLICAS,
+            grid=(w, h),
+            kill_cycles=2 if quick else CRASH_CYCLES,
+            updates_per_cycle=4 if quick else CRASH_UPDATES,
+            rate_qps=80.0 if quick else CRASH_RATE,
+            recovery_bound_s=(
+                45.0 if quick else CRASH_RECOVERY_S
+            ),
+        )
+        line = {
+            "metric": f"bibfs_serve_crash_{out['n_per_graph']}",
+            "value": out["recovery_max_s"],
+            "unit": "s (max recovery-to-ready)",
+            "graph": "grid({w}x{h}, perf=0.02)".format(w=w, h=h),
+            "platform": platform,
+            "quick": quick,
+            **out,
+            "total_s": round(time.time() - t_setup, 1),
+        }
+        if tpu_error:
+            line["tpu_error"] = tpu_error[:300]
+        _write_artifact("bench_crash.json", line)
+        print(json.dumps({
+            "metric": line["metric"],
+            "value": line["value"],
+            "unit": line["unit"],
+            "ok": line["ok"],
+            "acked_updates": out["acked_updates"],
+            "zero_acked_loss": out["zero_acked_loss"],
+            "recovery_ok": out["recovery_ok"],
+            "torn_tail_ok": out["torn_tail_ok"],
+            "catchup_ok": out["catchup_ok"],
+            "zero_lost": out["zero_lost"],
+            "zero_failed": out["zero_failed"],
+            "verified": out["verified_vs_truth"],
+            "reroutes": out["router"]["reroutes"],
+            "metrics_missing": out["metrics_missing"],
+            "detail_file": "bench_crash.json",
+        }))
+        return 0 if line["ok"] else 1
+    except Exception as e:
+        import traceback
+
+        traceback.print_exc()
+        print(json.dumps({
+            "metric": "bibfs_serve_crash",
+            "value": None,
+            "error": f"{type(e).__name__}: {e}"[:400],
+        }))
+        return 1
+
+
 # the fleet metric families (bibfs_tpu.fleet.FLEET_METRIC_FAMILIES —
 # one list, shared with the soak's live-scrape gate so the two checks
 # cannot drift): the gate asserts a LIVE /metrics scrape (HTTP, not
@@ -1487,6 +1584,8 @@ def serve_fleet_main():
 if __name__ == "__main__":
     if "--calibrate" in sys.argv:
         sys.exit(calibrate_main())
+    elif "--serve-crash" in sys.argv:
+        sys.exit(serve_crash_main())
     elif "--serve-fleet" in sys.argv:
         sys.exit(serve_fleet_main())
     elif "--serve-oracle" in sys.argv:

@@ -24,11 +24,19 @@ Stdin grows fleet commands alongside ``src dst`` queries:
   routed count, load);
 - ``health`` prints the router's table summary as one JSON line.
 
+SIGTERM drains gracefully — parity with ``bibfs-serve``'s one-shot
+handler: the fleet stops reading stdin, every replica is demoted into
+its drain state (new submits refused with structured capacity errors
+while queued tickets still resolve), everything queued prints, and the
+process exits 0. A second SIGTERM during the drain is ignored — the
+restart manager's re-send must not abort the drain it asked for.
+
 Results print in the ``bibfs-serve`` line format as their tickets
 resolve (failover included). ``--metrics-port`` serves the process
 registry — fleet families ``bibfs_fleet_replicas{state}``,
 ``bibfs_fleet_routed_total{replica}``, ``bibfs_fleet_reroutes_total``,
-``bibfs_fleet_rolls_total``, ``bibfs_fleet_spills_total`` — over HTTP.
+``bibfs_fleet_rolls_total``, ``bibfs_fleet_spills_total``,
+``bibfs_fleet_catchups_total`` — over HTTP.
 """
 
 from __future__ import annotations
@@ -36,6 +44,12 @@ from __future__ import annotations
 import argparse
 import json
 import sys
+
+
+class _SigTerm(Exception):
+    """Raised by the SIGTERM handler out of the blocking stdin read —
+    the graceful-drain path (module docstring), same one-shot contract
+    as ``bibfs-serve``'s handler."""
 
 
 def _print_result(t, no_path: bool) -> None:
@@ -256,106 +270,155 @@ def main(argv=None):
             else:
                 _print_result(t, args.no_path)
 
+    # graceful drain on SIGTERM (rolling restarts): the handler raises
+    # out of the blocking stdin read; the except arm demotes every
+    # replica into its drain state, the shared post-loop path below
+    # flushes, resolves and prints everything queued, and the process
+    # exits 0 — parity with bibfs-serve's one-shot handler
+    import signal
+
+    def _on_sigterm(signum, frame):
+        # one-shot: disarm BEFORE raising, so a second SIGTERM landing
+        # anywhere in the drain path cannot re-raise outside the try
+        # and abort the drain
+        try:
+            signal.signal(signal.SIGTERM, signal.SIG_IGN)
+        except ValueError:
+            pass
+        raise _SigTerm()
+
+    prev_handler = None
+    sigterm = False
     rc = 0
     try:
-        for line in sys.stdin:
-            parts = line.split()
-            if not parts:
-                continue
-            cmd = parts[0]
-            if cmd == "replicas":
-                print(_replicas_listing(router))
-                continue
-            if cmd == "health":
-                print("health " + json.dumps(
-                    router.table(), sort_keys=True
-                ))
-                continue
-            if cmd == "use":
-                if len(parts) != 2:
-                    print("error invalid: usage: use NAME")
-                    continue
-                current = parts[1]
-                print(f"use {current}")
-                continue
-            if cmd == "update":
-                if len(parts) != 4 or parts[1] not in ("add", "del"):
-                    print("error invalid: usage: update add|del U V")
-                    continue
-                try:
-                    u, v = int(parts[2]), int(parts[3])
-                except ValueError:
-                    print("error invalid: non-integer node id")
-                    continue
-                (staged_adds if parts[1] == "add"
-                 else staged_dels).append((u, v))
-                print(
-                    "update staged: +{a}/-{d} (roll applies them)".format(
-                        a=len(staged_adds), d=len(staged_dels)
-                    )
-                )
-                continue
-            if cmd == "roll":
-                if len(parts) != 1:
-                    print("error invalid: usage: roll")
-                    continue
-                router.flush(timeout=120.0)
-                drain()
-                try:
-                    out = router.rolling_swap(
-                        current, adds=staged_adds, dels=staged_dels
-                    )
-                except ValueError as e:
-                    print(f"error invalid: {e}")
-                    continue
-                staged_adds, staged_dels = [], []
-                print("roll {g}: ok={ok} {rows}".format(
-                    g=out["graph"] or "(default)", ok=out["ok"],
-                    rows=" ".join(
-                        "{r}:v{a}->v{b}".format(
-                            r=row["replica"],
-                            a=(row.get("version") or ["?", "?"])[0],
-                            b=(row.get("version") or ["?", "?"])[1],
-                        )
-                        for row in out["replicas"]
-                    ),
-                ))
-                continue
-            if cmd in ("kill", "restart"):
-                if len(parts) != 2:
-                    print(f"error invalid: usage: {cmd} REPLICA")
-                    continue
-                name = parts[1]
-                if name not in router.replica_names:
-                    print(f"error invalid: unknown replica {name!r} "
-                          f"(have: {router.replica_names})")
-                    continue
-                try:
-                    getattr(router.replica(name), cmd)()
-                except Exception as e:
-                    print(f"error internal: {cmd} {name}: {e}")
-                    continue
-                print(f"{cmd} {name}: ok")
-                continue
-            if len(parts) != 2:
-                print("error invalid: expected 'src dst', got "
-                      f"{line.strip()!r}")
-                continue
+        try:
+            # installed INSIDE the try: a signal landing at any point
+            # after this line is caught by the except arm below
             try:
-                src, dst = int(parts[0]), int(parts[1])
+                prev_handler = signal.signal(signal.SIGTERM, _on_sigterm)
             except ValueError:
-                print("error invalid: non-integer node id in "
-                      f"{line.strip()!r}")
-                continue
-            try:
-                tickets.append(router.submit(src, dst, current))
-            except QueryError as e:
-                print(f"error {e.kind}: {src} -> {dst}: {e}")
-                continue
-            except (ValueError, TypeError) as e:
-                print(f"error invalid: {src} -> {dst}: {e}")
-                continue
-            drain()
+                pass  # not the main thread (in-process embedding)
+            for line in sys.stdin:
+                parts = line.split()
+                if not parts:
+                    continue
+                cmd = parts[0]
+                if cmd == "replicas":
+                    print(_replicas_listing(router))
+                    continue
+                if cmd == "health":
+                    print("health " + json.dumps(
+                        router.table(), sort_keys=True
+                    ))
+                    continue
+                if cmd == "use":
+                    if len(parts) != 2:
+                        print("error invalid: usage: use NAME")
+                        continue
+                    current = parts[1]
+                    print(f"use {current}")
+                    continue
+                if cmd == "update":
+                    if len(parts) != 4 or parts[1] not in ("add", "del"):
+                        print("error invalid: usage: update add|del U V")
+                        continue
+                    try:
+                        u, v = int(parts[2]), int(parts[3])
+                    except ValueError:
+                        print("error invalid: non-integer node id")
+                        continue
+                    (staged_adds if parts[1] == "add"
+                     else staged_dels).append((u, v))
+                    print(
+                        "update staged: +{a}/-{d} (roll applies them)".format(
+                            a=len(staged_adds), d=len(staged_dels)
+                        )
+                    )
+                    continue
+                if cmd == "roll":
+                    if len(parts) != 1:
+                        print("error invalid: usage: roll")
+                        continue
+                    router.flush(timeout=120.0)
+                    drain()
+                    try:
+                        out = router.rolling_swap(
+                            current, adds=staged_adds, dels=staged_dels
+                        )
+                    except ValueError as e:
+                        print(f"error invalid: {e}")
+                        continue
+                    staged_adds, staged_dels = [], []
+                    print("roll {g}: ok={ok} {rows}".format(
+                        g=out["graph"] or "(default)", ok=out["ok"],
+                        rows=" ".join(
+                            "{r}:v{a}->v{b}".format(
+                                r=row["replica"],
+                                a=(row.get("version") or ["?", "?"])[0],
+                                b=(row.get("version") or ["?", "?"])[1],
+                            )
+                            for row in out["replicas"]
+                        ),
+                    ))
+                    continue
+                if cmd in ("kill", "restart"):
+                    if len(parts) != 2:
+                        print(f"error invalid: usage: {cmd} REPLICA")
+                        continue
+                    name = parts[1]
+                    if name not in router.replica_names:
+                        print(f"error invalid: unknown replica {name!r} "
+                              f"(have: {router.replica_names})")
+                        continue
+                    try:
+                        getattr(router.replica(name), cmd)()
+                    except Exception as e:
+                        print(f"error internal: {cmd} {name}: {e}")
+                        continue
+                    print(f"{cmd} {name}: ok")
+                    continue
+                if len(parts) != 2:
+                    print("error invalid: expected 'src dst', got "
+                          f"{line.strip()!r}")
+                    continue
+                try:
+                    src, dst = int(parts[0]), int(parts[1])
+                except ValueError:
+                    print("error invalid: non-integer node id in "
+                          f"{line.strip()!r}")
+                    continue
+                try:
+                    tickets.append(router.submit(src, dst, current))
+                except QueryError as e:
+                    print(f"error {e.kind}: {src} -> {dst}: {e}")
+                    continue
+                except (ValueError, TypeError) as e:
+                    print(f"error invalid: {src} -> {dst}: {e}")
+                    continue
+                drain()
+        except _SigTerm:
+            sigterm = True
+            # demote every replica into its drain state: new submits
+            # answer structured capacity refusals while the shared
+            # drain tail below resolves and prints everything queued
+            for name in router.replica_names:
+                try:
+                    router.replica(name).begin_drain()
+                except Exception:
+                    pass
+            print("[Fleet] SIGTERM: draining (resolving queued "
+                  "tickets)", file=sys.stderr, flush=True)
+        # the drain tail runs with SIGTERM IGNORED on the EOF path too:
+        # a restart manager's signal landing during the final flush
+        # (which can take minutes of ticket waits) must not kill the
+        # process mid-drain after a clean stdin close — exactly the
+        # window the graceful-drain contract exists for. The previous
+        # disposition is restored in the outer finally, once everything
+        # queued has printed.
+        try:
+            signal.signal(signal.SIGTERM, signal.SIG_IGN)
+        except ValueError:
+            pass
         router.flush(timeout=120.0)
         # final failover pass: wait() drives any pending re-routes
         for t in list(tickets):
@@ -391,6 +454,16 @@ def main(argv=None):
         router.close()
         if metrics_server is not None:
             metrics_server.close()
+        # restore only on the EOF path (in-process embedders get their
+        # handler back once the drain is done); a SIGNAL-initiated
+        # drain keeps ignoring repeats until the process exits — a
+        # restart manager's re-send landing after the drain but before
+        # exit must not flip a completed run to 143
+        if prev_handler is not None and not sigterm:
+            try:
+                signal.signal(signal.SIGTERM, prev_handler)
+            except ValueError:
+                pass
     return rc
 
 

@@ -83,6 +83,11 @@ class EngineReplica:
         self._lock = threading.RLock()
         self._dead = False
         self._draining = False
+        # incarnation counter: bumped on every restart so the router's
+        # poller detects a death-and-return it never observed live (a
+        # respawn faster than one poll tick) and still runs its
+        # catch-up check before re-admission
+        self.generation = 0
         self._engine = make_engine()
 
     # ---- serving -----------------------------------------------------
@@ -228,6 +233,19 @@ class EngineReplica:
         name = self.store.default_graph() if graph is None else str(graph)
         return int(self.store.roll(name, adds=adds, dels=dels).version)
 
+    def update(self, graph: str | None = None, adds=(), dels=()) -> None:
+        """Apply one live edge-update batch on this replica's store
+        WITHOUT folding it (the overlay answers exactly until the next
+        compaction/roll). Returning IS the store's ack — on a durable
+        store, the batch is WAL-logged first."""
+        if self.store is None:
+            raise ValueError(
+                f"replica {self.name} serves an inline graph; live "
+                "updates need a store-backed replica"
+            )
+        name = self.store.default_graph() if graph is None else str(graph)
+        self.store.update(name, adds=adds, dels=dels)
+
     def probe(self, graph: str | None = None,
               timeout: float = 10.0) -> bool:
         """Ready probe: one trivial query end-to-end through the submit
@@ -254,6 +272,7 @@ class EngineReplica:
         with self._lock:
             if self._engine is None:
                 self._engine = self._make()
+                self.generation += 1
             self._draining = False
             self._dead = False
 
@@ -337,18 +356,24 @@ class ProcessReplica:
 
     def __init__(self, name: str, graph: str | None = None, *,
                  store_dir: str | None = None, max_wait_ms: float = 5.0,
+                 durable: bool = False, fsync: str = "batch",
                  extra_args=(), spawn_timeout_s: float = 180.0):
         if (graph is None) == (store_dir is None):
             raise ValueError("pass a .bin graph path OR store_dir")
+        if durable and store_dir is None:
+            raise ValueError("durable=True needs store_dir")
         self.name = str(name)
         self.store = None  # the store lives in the child
         self._graph_path = graph
         self._store_dir = store_dir
+        self._durable = bool(durable)
+        self._fsync = str(fsync)
         self._max_wait_ms = float(max_wait_ms)
         self._extra = list(extra_args)
         self._spawn_timeout_s = float(spawn_timeout_s)
         self._lock = threading.RLock()
         self._draining = False
+        self.generation = -1  # bumped to 0 by the first _spawn
         self._spawn()
 
     # ---- process plumbing -------------------------------------------
@@ -358,24 +383,39 @@ class ProcessReplica:
             argv.append(self._graph_path)
         else:
             argv += ["--store", self._store_dir]
+            if self._durable:
+                # the child write-ahead-logs every acked update and
+                # RECOVERS manifest+WAL on spawn — a kill()ed replica
+                # respawns at its latest acked state, not the v1 seed
+                argv += ["--durable", "--fsync", self._fsync]
         argv += [
             "--pipeline", "--no-path",
             "--max-wait-ms", str(self._max_wait_ms),
         ] + self._extra
         env = dict(os.environ)
         env["PYTHONUNBUFFERED"] = "1"  # live pipes need live prints
-        self._pending: deque[_ProcTicket] = deque()
-        self._control: deque[_Reply] = deque()
-        self._current_graph: str | None = None
-        self._dead = False
-        self._proc = subprocess.Popen(
-            argv, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
-            stderr=subprocess.DEVNULL, text=True, env=env,
-        )
-        self._reader = threading.Thread(
-            target=self._read_main, args=(self._proc,),
-            name=f"bibfs-fleet-{self.name}-reader", daemon=True,
-        )
+        # tickets left over from a killed incarnation belong to IT:
+        # fail them now, before the reset abandons them unresolvable
+        # (the dead child's reader may not have seen its EOF yet)
+        self._sweep_pending("replica restarted with the query pending")
+        # reset + process swap in ONE locked section: the dead child's
+        # reader EOF-sweeps through _fail_all, whose stale-incarnation
+        # check compares against self._proc — a sweep interleaving a
+        # half-reset respawn could otherwise mark the NEW replica dead
+        with self._lock:
+            self._pending: deque[_ProcTicket] = deque()
+            self._control: deque[_Reply] = deque()
+            self._current_graph: str | None = None
+            self._dead = False
+            self.generation += 1  # the incarnation bump (router catchup)
+            self._proc = subprocess.Popen(
+                argv, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+                stderr=subprocess.DEVNULL, text=True, env=env,
+            )
+            self._reader = threading.Thread(
+                target=self._read_main, args=(self._proc,),
+                name=f"bibfs-fleet-{self.name}-reader", daemon=True,
+            )
         self._reader.start()
         # readiness barrier: the first health reply proves the child
         # imported, built its engine, and is answering the REPL
@@ -402,7 +442,7 @@ class ProcessReplica:
         except (ValueError, OSError):
             pass
         finally:
-            self._fail_all("replica process exited")
+            self._fail_all("replica process exited", proc)
 
     def _pop_control(self, line: str) -> None:
         with self._lock:
@@ -471,11 +511,16 @@ class ProcessReplica:
             t.result = BFSResult(True, hops, None, None, 0.0, 0, 0)
         t.event.set()
 
-    def _fail_all(self, why: str) -> None:
+    def _sweep_pending(self, why: str) -> None:
+        """Fail every outstanding ticket/control reply with ``why``
+        (structured internal errors the router reroutes)."""
         with self._lock:
-            pending, self._pending = list(self._pending), deque()
-            control, self._control = list(self._control), deque()
-            self._dead = True
+            pending = list(getattr(self, "_pending", ()))
+            control = list(getattr(self, "_control", ()))
+            if pending:
+                self._pending.clear()
+            if control:
+                self._control.clear()
         for t in pending:
             if t.result is None and t.error is None:
                 t.error = QueryError(
@@ -484,6 +529,16 @@ class ProcessReplica:
             t.event.set()
         for fut in control:
             fut.event.set()  # line stays None: caller sees ReplicaDead
+
+    def _fail_all(self, why: str, proc=None) -> None:
+        with self._lock:
+            if proc is not None and proc is not self._proc:
+                # a STALE reader (the killed incarnation's EOF sweep
+                # racing a restart): its tickets were swept by _spawn —
+                # it must not mark the respawned replica dead
+                return
+            self._dead = True
+        self._sweep_pending(why)
 
     def _write(self, line: str) -> None:
         try:
@@ -704,21 +759,24 @@ class ProcessReplica:
 
     def roll(self, graph: str | None = None, adds=(), dels=()) -> int:
         """Roll the CHILD's store over its stdin control surface:
-        ``use`` + ``update add/del`` per edge + ``swap``. Needs the
-        replica spawned with ``store_dir``."""
+        ``use`` + ``update add/del`` per edge + ``swap``, written in
+        graph-pinned locked chunks (``_update_commands``: a concurrent
+        submit's ``use`` can never redirect the batch, and the ``swap``
+        goes out only once every update was acked). Needs the replica
+        spawned with ``store_dir``."""
         if self._store_dir is None:
             raise ValueError(
                 f"replica {self.name} serves a fixed .bin; rolling "
                 "swaps need --store children"
             )
-        if graph is not None:
-            self._command_use(graph)
-        for u, v in adds:
-            self._command(f"update add {int(u)} {int(v)}")
-        for u, v in dels:
-            self._command(f"update del {int(u)} {int(v)}")
-        reply = self._command("swap", timeout=120.0)
+        reply = self._update_commands(graph, adds, dels, tail="swap")
         # "swap g: vA -> vB digest ..." | "swap g: no pending delta (vA)"
+        if reply.startswith("error"):
+            # a refused command on a live replica, not a dead one —
+            # classifying it ReplicaDead would eject a healthy replica
+            raise QueryError(
+                f"replica {self.name}: {reply}", kind="invalid"
+            )
         try:
             if "no pending delta" in reply:
                 return int(reply.rsplit("(v", 1)[1].rstrip(")"))
@@ -727,6 +785,130 @@ class ProcessReplica:
             raise ReplicaDead(
                 f"replica {self.name}: bad swap reply {reply!r}"
             ) from None
+
+    def update(self, graph: str | None = None, adds=(), dels=()) -> None:
+        """Apply live edge updates on the CHILD's store over its stdin
+        control surface, one ``update`` command per edge, WITHOUT
+        folding them. Lines land in graph-pinned locked chunks
+        (``_update_commands``): the stream's current graph is global
+        child state, and a concurrent routed submit slipping its own
+        ``use`` into the batch would land updates on the WRONG graph —
+        the silent corruption a fleet may never produce. Each reply is
+        the child store's ack — on a ``durable=True`` child that means
+        the WAL record is durable under its fsync policy before the
+        reply line prints, which is what makes "acked before SIGKILL
+        implies served after respawn" testable at this level. A refused
+        update raises; edges already acked in earlier chunks stay
+        applied (per-edge commands are per-edge acks), un-written later
+        chunks are never sent."""
+        if self._store_dir is None:
+            raise ValueError(
+                f"replica {self.name} serves a fixed .bin; live "
+                "updates need --store children"
+            )
+        self._update_commands(graph, adds, dels)
+
+    #: update lines written per locked chunk: one chunk's lines and
+    #: replies sit far below the OS pipe capacity. Holding the replica
+    #: lock across an UNBOUNDED batched write can deadlock three ways
+    #: at once — the reader thread needs this same lock to drain
+    #: replies, a full child-stdout pipe stops the child reading
+    #: stdin, and a full stdin pipe then blocks our own locked write.
+    _CHUNK_LINES = 128
+
+    def _update_commands(self, graph, adds, dels,
+                         tail: str | None = None) -> str | None:
+        """Write ``use`` + per-edge ``update`` lines in CHUNKS: each
+        chunk's lines land in ONE locked section headed by its own
+        ``use`` switch — so a concurrent submit's ``use`` interleaving
+        BETWEEN chunks can never redirect the rest of the batch to the
+        wrong graph — and the chunk's replies are awaited before the
+        next chunk is written, which bounds in-flight pipe data
+        (deadlock-free at any batch size; see ``_CHUNK_LINES``). The
+        optional ``tail`` command (``roll``'s ``swap``) goes as its own
+        final ``use``+tail section only after EVERY update was acked: a
+        refused edge aborts the batch with nothing folded. Returns the
+        tail's reply line.
+
+        ``graph=None`` is resolved to a concrete pin first (the tracked
+        current graph, else the child's starred default from the
+        ``graphs`` listing): an unpinned batch would mutate whatever
+        graph a concurrent submit last switched the stream to."""
+        if graph is None:
+            graph = self._resolve_graph_pin()
+        edges = [("add", e) for e in adds] + [("del", e) for e in dels]
+        for lo in range(0, len(edges), self._CHUNK_LINES):
+            futs = []
+            with self._lock:
+                if self._dead or self._proc.poll() is not None:
+                    raise ReplicaDead(f"replica {self.name} is dead")
+                if graph is not None:
+                    fut = _Reply(on_line=self._use_reply(graph))
+                    self._control.append(fut)
+                    self._write(f"use {graph}")
+                    self._current_graph = graph
+                    futs.append(("use", fut))
+                for kind, (u, v) in edges[lo: lo + self._CHUNK_LINES]:
+                    fut = _Reply()
+                    self._control.append(fut)
+                    self._write(f"update {kind} {int(u)} {int(v)}")
+                    futs.append((f"update {kind} {u} {v}", fut))
+            self._await_replies(futs)
+        if tail is None:
+            return None
+        futs = []
+        with self._lock:
+            if self._dead or self._proc.poll() is not None:
+                raise ReplicaDead(f"replica {self.name} is dead")
+            if graph is not None:
+                fut = _Reply(on_line=self._use_reply(graph))
+                self._control.append(fut)
+                self._write(f"use {graph}")
+                self._current_graph = graph
+                futs.append(("use", fut))
+            tail_fut = _Reply()
+            self._control.append(tail_fut)
+            self._write(tail)
+        self._await_replies(futs)
+        if not tail_fut.event.wait(120.0):
+            raise TimeoutError(
+                f"replica {self.name}: no reply to {tail!r}"
+            )
+        if tail_fut.line is None:
+            raise ReplicaDead(f"replica {self.name} died mid-command")
+        return tail_fut.line
+
+    def _resolve_graph_pin(self) -> str | None:
+        """The concrete graph name an unqualified update/roll batch
+        must pin: the stream's tracked current graph, else the child's
+        default (the ``*``-starred entry of its ``graphs`` listing)."""
+        with self._lock:
+            g = self._current_graph
+        if g is not None:
+            return g
+        line = self._command("graphs")  # "graphs: *a(v1) b(v2)"
+        for tok in line.partition(": ")[2].split():
+            if tok.startswith("*"):
+                return tok[1:].partition("(")[0]
+        return None
+
+    def _await_replies(self, futs) -> None:
+        """Wait each (what, _Reply) in order; structured errors raise
+        (a refused command must abort what depends on it, not sail
+        past as a bad parse)."""
+        for what, fut in futs:
+            if not fut.event.wait(60.0):
+                raise TimeoutError(
+                    f"replica {self.name}: no reply to {what!r}"
+                )
+            if fut.line is None:
+                raise ReplicaDead(
+                    f"replica {self.name} died mid-command"
+                )
+            if fut.line.startswith("error"):
+                raise QueryError(
+                    f"replica {self.name}: {fut.line}", kind="invalid"
+                )
 
     def probe(self, graph: str | None = None,
               timeout: float = 10.0) -> bool:

@@ -38,6 +38,21 @@ underneath:
   fleet serves mixed versions mid-roll; every answer is exact for the
   version its replica declares, which each
   :class:`FleetTicket.declared_version` records.
+- **catch-up re-admission.** A completed roll records the fleet's
+  COMMITTED version per graph (plus a bounded roll history). A replica
+  coming back from ``dead`` whose declared version lags a committed one
+  is held in the ``catchup`` table state — not routable — until it
+  catches up: the router replays the missed roll batches from its
+  history (contiguously, version by version) onto the recovering
+  replica's store, then re-reads the declared version and only then
+  admits. A durable replica (``store/wal``) usually recovers to the
+  committed version from its own WAL and passes straight through; a
+  replica that lost state (or missed a roll while dead) is repaired
+  rather than silently re-admitted at a stale version — the pre-PR 8
+  failure mode, where a respawned subprocess served v1 answers for a
+  fleet that had rolled to v2. A replica too far behind the retained
+  history stays in ``catchup``, visibly, instead of serving stale data
+  (``bibfs_fleet_catchups_total`` counts completed catch-ups).
 """
 
 from __future__ import annotations
@@ -59,7 +74,15 @@ from bibfs_tpu.serve.resilience import (
 #: routing-table states a query may be sent to (in preference order)
 ROUTABLE_STATES = ("ready", "degraded")
 #: every state the table (and the bibfs_fleet_replicas gauge) can hold
-TABLE_STATES = ("live", "ready", "degraded", "draining", "dead")
+#: — ``catchup`` holds a recovering replica whose declared graph
+#: version lags the fleet's committed one (module docstring)
+TABLE_STATES = ("live", "ready", "degraded", "draining", "dead",
+                "catchup")
+
+#: rolls retained per graph for catch-up replay; a replica further
+#: behind than this stays in ``catchup`` (visibly) instead of being
+#: re-admitted stale
+ROLL_HISTORY_MAX = 8
 
 #: error kinds that re-route to a peer; everything else is the
 #: client's problem (invalid) or the caller's deadline (timeout)
@@ -75,6 +98,7 @@ FLEET_METRIC_FAMILIES = (
     "bibfs_fleet_reroutes_total",
     "bibfs_fleet_rolls_total",
     "bibfs_fleet_spills_total",
+    "bibfs_fleet_catchups_total",
     "bibfs_build_info",
 )
 
@@ -219,6 +243,19 @@ class Router:
         self._states = {name: "live" for name in self._order}
         self._forced_drain: dict[str, bool] = {}
         self._versions: dict = {}
+        # catch-up state (module docstring): fleet-committed version +
+        # bounded roll history per graph, and the replicas whose next
+        # ready transition must be version-checked (set on death)
+        self._committed: dict[str, int] = {}
+        self._roll_history: dict[str, list] = {}
+        self._needs_catchup: set = set()
+        # last seen incarnation per replica: a generation change means
+        # the replica died and came back BETWEEN polls (a respawn
+        # faster than one tick) — the catch-up check must still run
+        self._last_gen: dict[str, int] = {
+            name: getattr(r, "generation", 0)
+            for name, r in self._replicas.items()
+        }
         self.obs_label = (
             next_instance_label("router") if obs_label is None
             else obs_label
@@ -252,6 +289,13 @@ class Router:
         self._c_rolls = REGISTRY.counter(
             "bibfs_fleet_rolls_total",
             "Fleet-wide rolling swaps completed",
+            ("router",),
+        ).labels(router=self.obs_label)
+        self._c_catchups = REGISTRY.counter(
+            "bibfs_fleet_catchups_total",
+            "Recovering replicas caught up to the fleet's committed "
+            "version before re-admission (roll-history replays "
+            "included)",
             ("router",),
         ).labels(router=self.obs_label)
         self._closed = False
@@ -463,6 +507,7 @@ class Router:
         with self._table_lock:
             self._states[name] = "dead"
             self._drop_versions_locked(name)
+            self._needs_catchup.add(name)
 
     def _drop_versions_locked(self, name: str) -> None:
         """Forget a dead replica's cached declared versions: a restart
@@ -486,18 +531,109 @@ class Router:
                     state = "degraded"
             except Exception:
                 state = "dead"
+            gen = getattr(replica, "generation", 0)
             with self._table_lock:
                 if self._forced_drain.get(name):
                     state = "draining"  # mid-roll: keep traffic off
                 if (state == "dead"
                         and self._states.get(name) != "dead"):
                     self._drop_versions_locked(name)
+                    self._needs_catchup.add(name)
+                if gen != self._last_gen.get(name):
+                    # died and respawned between polls: same treatment
+                    # as an observed death
+                    self._last_gen[name] = gen
+                    self._drop_versions_locked(name)
+                    self._needs_catchup.add(name)
+                check_catchup = (
+                    state in ROUTABLE_STATES
+                    and name in self._needs_catchup
+                    and bool(self._committed)
+                )
+                if not check_catchup and state in ROUTABLE_STATES:
+                    self._needs_catchup.discard(name)
+            if check_catchup:
+                # a replica coming back from dead with fleet-committed
+                # versions on record: verify (and repair) its declared
+                # versions BEFORE it becomes routable — gating EVERY
+                # routable state (a recovering replica polled straight
+                # into 'degraded' is still dispatchable and must not
+                # bypass the version check)
+                if not self._try_catchup(name):
+                    state = "catchup"
+            with self._table_lock:
                 self._states[name] = state
             counts[state] += 1
         for s, c in counts.items():
             self._g_replicas.labels(
                 router=self.obs_label, state=s
             ).set(c)
+
+    def _try_catchup(self, name: str) -> bool:
+        """Version-check (and repair) one recovering replica against
+        every fleet-committed graph version. Returns True once every
+        committed graph's declared version has caught up (the caller
+        admits the replica under its polled state); False holds it in
+        ``catchup`` (not routable). Lagging graphs are repaired by
+        replaying the missed roll
+        batches from the bounded history, in version order — a gap
+        beyond the history leaves the replica in ``catchup`` visibly
+        rather than re-admitting stale answers.
+
+        The comparison is numeric, which is sound exactly because
+        fleet-managed graphs mutate ONLY through rolls: every replica's
+        store moves v -> v+1 per committed roll and nothing else bumps
+        versions (fleet updates are staged and land with the roll, so
+        no overlay accumulates to trigger an independent background
+        compaction). Mutating a fleet replica's store out-of-band
+        breaks that comparability — a locally-compacted replica could
+        pass the check while missing a roll's content.
+
+        A replica that crashed BETWEEN a roll's update acks and its
+        swap respawns with the batch re-armed in its overlay: the
+        replay's duplicate adds are refused and the replica stays held
+        here. That is the deliberate trade — safe-but-unroutable
+        (visible in ``stats()["pending_catchup"]``, repaired by an
+        operator restart from clean state) over any automatic fold of
+        partially-recovered pending state, which could re-admit a
+        replica whose declared version matches the fleet while its
+        content does not."""
+        replica = self._replicas[name]
+        with self._table_lock:
+            committed = dict(self._committed)
+            history = {g: list(h) for g, h in self._roll_history.items()}
+        for gkey, want in committed.items():
+            graph = gkey or None
+            try:
+                have = replica.version(graph)
+            except Exception:
+                return False
+            have = 0 if have is None else int(have)
+            if have >= want:
+                continue
+            with span("fleet_catchup", replica=name, graph=gkey,
+                      have=have, want=want):
+                for ver, adds, dels in history.get(gkey, ()):
+                    if ver <= have:
+                        continue
+                    if ver != have + 1:
+                        # history gap: the batches that would bridge it
+                        # were pruned — repairing from here would skip
+                        # acked updates, so hold the replica instead
+                        return False
+                    try:
+                        have = int(replica.roll(graph, adds=adds,
+                                                dels=dels))
+                    except Exception:
+                        return False
+                if have < want:
+                    return False
+        with self._table_lock:
+            self._needs_catchup.discard(name)
+        # the version cache was dropped at death; the next dispatch
+        # re-reads the (now caught-up) declared version from the replica
+        self._c_catchups.inc()
+        return True
 
     def _poll_main(self) -> None:
         while not self._poll_stop.wait(self.poll_interval_s):
@@ -565,6 +701,26 @@ class Router:
             # the family is documented as rolling swaps COMPLETED: a
             # roll with failed replicas must not count as one
             self._c_rolls.inc()
+        new_versions = [
+            r["version"][1] for r in rows
+            if r.get("ok") and r.get("version")
+            and r["version"][1] is not None
+        ]
+        if new_versions and (adds or dels):
+            # the fleet COMMITTED this version on every replica that
+            # rolled; a replica that missed it (dead mid-roll, respawn
+            # from stale state) must catch up before re-admission —
+            # keep the batch in the bounded history so _try_catchup can
+            # replay it
+            key = self._graph_key(graph)
+            newv = int(max(new_versions))
+            with self._table_lock:
+                self._committed[key] = max(
+                    self._committed.get(key, 0), newv
+                )
+                hist = self._roll_history.setdefault(key, [])
+                hist.append((newv, list(adds), list(dels)))
+                del hist[:-ROLL_HISTORY_MAX]
         return {
             "graph": self._graph_key(graph),
             "adds": len(adds),
@@ -598,6 +754,8 @@ class Router:
                 f"{name}:{g}": v
                 for (name, g), v in self._versions.items()
             }
+            committed = dict(self._committed)
+            pending_catchup = sorted(self._needs_catchup)
         return {
             "replicas": {
                 name: {
@@ -609,9 +767,12 @@ class Router:
                 for name in self._order
             },
             "versions": versions,
+            "committed": committed,
+            "pending_catchup": pending_catchup,
             "reroutes": self._c_reroutes.value,
             "spills": self._c_spills.value,
             "rolls": self._c_rolls.value,
+            "catchups": self._c_catchups.value,
             "spill_after": self.spill_after,
             "poll_interval_s": self.poll_interval_s,
         }

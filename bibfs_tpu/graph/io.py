@@ -30,6 +30,12 @@ def write_graph_bin(path: str | os.PathLike, n: int, edges: np.ndarray) -> None:
     Endpoints must be in ``[0, n)``: the on-disk dtype is uint32, so a
     negative endpoint would otherwise WRAP (``-1`` -> ``4294967295``)
     and write a silently corrupt file.
+
+    The write is ATOMIC: bytes land in a same-directory tmp file that is
+    ``os.replace``d onto ``path`` only once fully written and flushed, so
+    a crash mid-write can never leave a torn ``.bin`` behind — readers
+    (and the durable store's checkpoints, which this property anchors)
+    see either the old complete file or the new complete file.
     """
     edges = np.asarray(edges).reshape(-1, 2)
     if edges.size and (int(edges.min()) < 0 or int(edges.max()) >= n):
@@ -39,9 +45,21 @@ def write_graph_bin(path: str | os.PathLike, n: int, edges: np.ndarray) -> None:
         )
     edges = np.ascontiguousarray(edges, dtype=_HEADER_DTYPE).reshape(-1, 2)
     m = edges.shape[0]
-    with open(path, "wb") as f:
-        np.array([n, m], dtype=_HEADER_DTYPE).tofile(f)
-        edges.tofile(f)
+    path = os.fspath(path)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "wb") as f:
+            np.array([n, m], dtype=_HEADER_DTYPE).tofile(f)
+            edges.tofile(f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
 
 
 def read_graph_bin(path: str | os.PathLike) -> tuple[int, np.ndarray]:

@@ -31,6 +31,12 @@ file stem, and the stdin stream grows store commands alongside
   current graph (in-flight batches finish on the old snapshot);
 - ``graphs`` lists the registered graphs with versions.
 
+``--durable`` (with ``--store``) turns on the store's durability layer
+(``bibfs_tpu/store/wal``): every acked update is write-ahead-logged
+before the ack under the ``--fsync`` policy, compactions/swaps commit
+crash-consistent checkpoints, and startup RECOVERS manifest + WAL —
+a killed server respawns at its latest acked state, not the v1 seed.
+
 ``--oracle K`` enables the landmark distance-oracle tier
 (``bibfs_tpu/oracle``): K landmark BFS trees answer landmark-endpoint,
 bound-pinned, and provably-disconnected queries exactly with no BFS at
@@ -262,6 +268,25 @@ def main(argv=None):
         "store's first graph, alphabetically)",
     )
     ap.add_argument(
+        "--durable",
+        action="store_true",
+        help="enable the store's durability layer (requires --store): "
+        "every acked edge update is write-ahead-logged before the ack, "
+        "compactions/swaps checkpoint crash-consistently (atomic .bin "
+        "+ manifest rename + WAL segment switch), and startup RECOVERS "
+        "any graph that left a manifest/WAL behind — manifest + "
+        "ordered replay, torn tails truncated (bibfs_tpu/store/wal)",
+    )
+    ap.add_argument(
+        "--fsync",
+        default="batch",
+        choices=["always", "batch", "off"],
+        help="WAL fsync policy under --durable (what 'durable enough "
+        "to ack' means): always = fsync per update (survives OS/power "
+        "loss), batch = group commit (survives process death; the "
+        "default), off = OS flush only",
+    )
+    ap.add_argument(
         "--compact-threshold",
         type=int,
         default=256,
@@ -423,16 +448,37 @@ def main(argv=None):
                 args.store,
                 compact_threshold=(args.compact_threshold or None),
                 oracle_k=args.oracle,
+                durable=args.durable,
+                fsync=args.fsync,
             )
         except (OSError, ValueError) as e:
             print(f"Error reading store: {e}", file=sys.stderr)
             return 2
         print(
-            "[Store] serving {k} graph(s): {names}".format(
-                k=len(store.names()), names=", ".join(store.names())
+            "[Store] serving {k} graph(s): {names}{d}".format(
+                k=len(store.names()), names=", ".join(store.names()),
+                d=f" (durable, fsync={args.fsync})" if args.durable
+                else "",
             ),
             file=sys.stderr, flush=True,
         )
+        sstats = store.stats()["graphs"]
+        for gname in store.names():
+            rec = (sstats[gname].get("durable") or {}).get("recovered")
+            if rec is not None:
+                print(
+                    "[Store] recovered {g}: v{v}, {r} WAL record(s) "
+                    "replayed{t}".format(
+                        g=gname, v=rec["version"],
+                        r=rec["replayed_records"],
+                        t=(", torn tail truncated"
+                           if rec["torn_tail_truncated"] else ""),
+                    ),
+                    file=sys.stderr, flush=True,
+                )
+    elif args.durable:
+        print("Error: --durable needs --store DIR", file=sys.stderr)
+        return 2
     else:
         if args.graph is None:
             print("Error: a .bin graph (or --store DIR) is required",

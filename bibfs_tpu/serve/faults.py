@@ -15,7 +15,14 @@ seams —
   seam a mid-execution runtime error surfaces at;
 - ``host_batch`` — the threaded native C batch
   (``solvers/native.solve_batch_native_graph``), the native-solver
-  failure seam.
+  failure seam;
+- ``wal_write`` / ``wal_fsync`` — the durable store's write-ahead-log
+  append and fsync (``store/wal.WalWriter``): the dying-disk seams. A
+  fault here makes ``GraphStore.update`` REFUSE the ack with nothing
+  committed in memory — the invariant the durability layer exists for;
+- ``manifest_rename`` — the atomic ``os.replace`` committing a
+  checkpoint manifest (``store/registry``): a fault here leaves the
+  previous manifest governing recovery, never a half-written one.
 
 A rule either raises :class:`InjectedFault` (kind ``error``) or sleeps
 (kind ``latency``), probabilistically (``p=0.1``, seeded — chaos runs
@@ -59,7 +66,8 @@ ENV_VAR = "BIBFS_FAULTS"
 #: seams the serving engines actually fire (parse rejects anything else:
 #: a typo'd site in a chaos spec must fail loudly, not silently inject
 #: nothing and pass the soak)
-KNOWN_SITES = ("device", "device_finish", "host_batch")
+KNOWN_SITES = ("device", "device_finish", "host_batch",
+               "wal_write", "wal_fsync", "manifest_rename")
 
 KINDS = ("error", "latency")
 

@@ -1923,6 +1923,530 @@ def run_fleet(
         metrics_server.close()
 
 
+def run_crash(
+    *,
+    replicas: int = 3,
+    grid: tuple = (40, 40),
+    perforation: float = 0.02,
+    traffic_graphs: int = 3,
+    kill_cycles: int = 3,
+    updates_per_cycle: int = 6,
+    rate_qps: float = 150.0,
+    hot_pool: int = 32,
+    repeat_fraction: float = 0.6,
+    recovery_bound_s: float = 30.0,
+    fsync: str = "always",
+    roll_adds: int = 8,
+    max_batch: int = 64,
+    cache_entries: int = 64,
+    seed: int = 0,
+    workdir: str | None = None,
+) -> dict:
+    """The crash-durability soak (``bench.py --serve-crash``): a fleet
+    of one DURABLE ``bibfs-serve`` subprocess victim (``--durable
+    --fsync always``: every acked update is WAL-fsync'd before the ack
+    reply) plus in-process engine replicas over their own durable
+    stores, under open-loop routed traffic, while the victim is
+    SIGKILL'd and respawned ``kill_cycles`` times mid-update-stream.
+    The claims, all gated:
+
+    1. **zero acknowledged-update loss** — each cycle applies an
+       acked edge-update stream to the victim's ``gu`` graph and
+       SIGKILLs the child IMMEDIATELY after the last ack; after
+       respawn (manifest + WAL replay recovery) every acked update
+       must be visible: sampled pairs are re-queried through the
+       respawned child and checked against fresh native BFS on the
+       seed+acked edge set, and the cycle ends with a forced fold
+       whose snapshot digest must equal the content digest of exactly
+       that edge set — a total-state equality, not a sample;
+    2. **bounded recovery-to-ready** — every respawn must be back in
+       the router's ``ready`` state within ``recovery_bound_s``
+       (subprocess spawn + recovery + health re-admission, catch-up
+       check included);
+    3. **catch-up re-admission** — a rolling swap commits a fleet-wide
+       version mid-soak; the victim killed and respawned after it must
+       re-enter ``ready`` only with its declared version at the
+       committed one (its own WAL provides it — the stale-v1 respawn
+       this layer exists to kill);
+    4. **torn-tail replay** — garbage appended to the victim's live
+       WAL segment (the torn write a crash mid-append leaves) must be
+       truncated by recovery, in-process (a parent-side recovery of a
+       copy of the victim's dir, digest-verified) AND by the respawned
+       child, which still serves every acked update;
+    5. **0 lost / stranded tickets on non-killed replicas** — the
+       routed open-loop traffic flowing through the whole soak loses
+       nothing: victim kills cost reroutes, never tickets, and every
+       survivor answer is verified against fresh native BFS (audited
+       vs the serial solver on a seeded subsample);
+    6. **observability** — the durability metric families
+       (``store/wal.DURABLE_METRIC_FAMILIES``) render on the registry.
+
+    Returns the ``bench_crash.json`` payload."""
+    import os
+    import shutil
+    import tempfile
+
+    from bibfs_tpu.fleet import ProcessReplica, Router, engine_replica
+    from bibfs_tpu.graph.csr import build_csr, canonical_pairs
+    from bibfs_tpu.graph.generate import grid_graph
+    from bibfs_tpu.graph.io import write_graph_bin
+    from bibfs_tpu.obs.metrics import REGISTRY
+    from bibfs_tpu.solvers.serial import solve_serial_csr
+    from bibfs_tpu.store import GraphStore, content_digest
+    from bibfs_tpu.store.wal import DURABLE_METRIC_FAMILIES
+
+    t_setup = time.perf_counter()
+    w, h = int(grid[0]), int(grid[1])
+    n = w * h
+    rng = np.random.default_rng(seed)
+    routed = [f"g{i}" for i in range(int(traffic_graphs))]
+    names = routed + ["gu", "gr"]  # gu: victim update stream; gr: roll
+    edge_sets = {
+        g: grid_graph(w, h, perforation=perforation, seed=seed + i)
+        for i, g in enumerate(names)
+    }
+    csrs = {g: build_csr(n, e) for g, e in edge_sets.items()}
+    und = {
+        g: sorted(map(tuple, np.unique(
+            np.sort(e[e[:, 0] != e[:, 1]], axis=1), axis=0
+        ).tolist()))
+        for g, e in edge_sets.items()
+    }
+
+    def truth_solver(c):
+        try:
+            from bibfs_tpu.solvers.native import (
+                NativeGraph,
+                solve_native_graph,
+            )
+
+            ng = NativeGraph(
+                n,
+                np.ascontiguousarray(c[0], dtype=np.int64),
+                np.ascontiguousarray(c[1], dtype=np.int32),
+            )
+            return lambda s, d: solve_native_graph(ng, s, d)
+        except (ImportError, OSError):
+            return lambda s, d: solve_serial_csr(n, *c, s, d)
+
+    solvers = {g: truth_solver(csrs[g]) for g in routed}
+    truth: dict = {g: {} for g in routed}
+
+    def truth_for(g, s, d):
+        key = (int(s), int(d))
+        if key not in truth[g]:
+            truth[g][key] = solvers[g](*key)
+        return truth[g][key]
+
+    # the victim's acked update stream: long-range shortcut adds into a
+    # large-diameter grid — every one provably changes its endpoints'
+    # distance (grid hops >> 1), so "served after respawn" is decidable
+    # from one query
+    live_u = set(und["gu"])
+    shortcut_pool = []
+    for i in range(n):
+        u, v = i, n - 1 - i
+        e = (u, v) if u < v else (v, u)
+        if u != v and e not in live_u and e not in shortcut_pool:
+            shortcut_pool.append(e)
+        if len(shortcut_pool) >= int(kill_cycles) * int(
+            updates_per_cycle
+        ) + 8:
+            break
+
+    base = tempfile.mkdtemp(prefix="bibfs-crash-") \
+        if workdir is None else os.fspath(workdir)
+    dirs = {}
+    for r in range(int(replicas)):
+        d = os.path.join(base, f"r{r}")
+        os.makedirs(d, exist_ok=True)
+        for g in names:
+            write_graph_bin(os.path.join(d, f"{g}.bin"), n, edge_sets[g])
+        dirs[f"r{r}"] = d
+
+    victim_name = "r0"
+    lost, failed, mismatches, checks = [], [], [], []
+    recoveries = []
+
+    def check(name, ok, detail=""):
+        checks.append({"check": name, "ok": bool(ok),
+                       "detail": str(detail)[:300]})
+        return bool(ok)
+
+    stop = threading.Event()
+    tickets: list = []
+    tickets_lock = threading.Lock()
+
+    fleet = None
+    victim = None
+    old_si = sys.getswitchinterval()
+    sys.setswitchinterval(5e-4)
+    try:
+        victim = ProcessReplica(
+            victim_name, store_dir=dirs[victim_name],
+            durable=True, fsync=fsync,
+        )
+        others = [
+            engine_replica(
+                f"r{i}",
+                GraphStore.from_dir(
+                    dirs[f"r{i}"], durable=True, fsync="batch",
+                    compact_threshold=None,
+                ),
+                cache_entries=cache_entries, max_batch=max_batch,
+            )
+            for i in range(1, int(replicas))
+        ]
+        fleet = Router([victim] + others, poll_interval_s=0.2)
+
+        pools = {}
+        for g in routed:
+            p = np.unique(
+                rng.integers(0, n, size=(3 * int(hot_pool), 2)), axis=0
+            )
+            p = p[p[:, 0] != p[:, 1]][: int(hot_pool)]
+            pools[g] = [(int(s), int(d)) for s, d in p]
+
+        def traffic_main():
+            """Open-loop routed traffic across the whole soak — the
+            plane the crash cycles must not perturb: a victim kill
+            costs reroutes, never tickets."""
+            trng = np.random.default_rng(seed + 77)
+            i = 0
+            t0 = time.perf_counter()
+            while not stop.is_set():
+                g = routed[i % len(routed)]
+                if trng.random() < repeat_fraction:
+                    s, d = pools[g][int(trng.integers(len(pools[g])))]
+                else:
+                    s = int(trng.integers(0, n))
+                    d = int(trng.integers(0, n))
+                    if s == d:
+                        d = (d + 1) % n
+                try:
+                    t = fleet.submit(s, d, g)
+                except Exception as e:
+                    failed.append({
+                        "phase": "traffic-submit", "graph": g,
+                        "query": [s, d],
+                        "kind": getattr(e, "kind", "?"),
+                        "error": str(e)[:200],
+                    })
+                else:
+                    with tickets_lock:
+                        tickets.append((g, s, d, t))
+                i += 1
+                delay = t0 + i / float(rate_qps) - time.perf_counter()
+                if delay > 0:
+                    stop.wait(delay)
+
+        traffic = threading.Thread(
+            target=traffic_main, name="bibfs-crash-traffic", daemon=True
+        )
+        traffic.start()
+
+        def respawn_victim(bound):
+            """Restart the victim and wait for it to be serving again:
+            recovery-to-ready is clocked from BEFORE the respawn (the
+            subprocess spawn + manifest/WAL recovery + health
+            re-admission are all part of what a crash costs). The
+            router table may still read a stale pre-kill "ready" until
+            the poller's generation check lands, so readiness = table
+            ready AND the victim answering a probe end-to-end."""
+            t0 = time.monotonic()
+            victim.restart()
+            deadline = t0 + bound
+            while time.monotonic() < deadline:
+                try:
+                    if (fleet.table().get(victim_name) == "ready"
+                            and victim.probe("gu", timeout=5.0)):
+                        return time.monotonic() - t0
+                except Exception:
+                    pass
+                time.sleep(0.05)
+            return None
+
+        # ---- phase 1: SIGKILL/respawn cycles mid-update-stream -------
+        acked: list = []  # every (u, v) add the victim ever acked
+        shortcut_i = 0
+        for cycle in range(int(kill_cycles)):
+            cycle_adds = []
+            for _ in range(int(updates_per_cycle)):
+                e = shortcut_pool[shortcut_i]
+                shortcut_i += 1
+                # update() returns only after the child's ack reply —
+                # under fsync=always, after the WAL record is fsync'd
+                victim.update("gu", adds=[e])
+                acked.append(e)
+                cycle_adds.append(e)
+            # the regression case: SIGKILL with ZERO gap after the ack
+            victim.kill()
+            time.sleep(0.3)  # let reroutes happen under traffic
+            rec_s = respawn_victim(recovery_bound_s)
+            recoveries.append(rec_s)
+            check(
+                f"cycle{cycle}-recovery",
+                rec_s is not None,
+                f"{rec_s}s (bound {recovery_bound_s}s)",
+            )
+            # every acked update must be visible after recovery: each
+            # shortcut makes its endpoints 1 hop apart (they were far)
+            for u, v in cycle_adds:
+                try:
+                    res = victim.wait_ticket(
+                        victim.submit(u, v, "gu"), timeout=30.0
+                    )
+                    hops = res.hops
+                except Exception as e:
+                    hops = f"error: {e}"
+                if hops != 1:
+                    mismatches.append(
+                        f"cycle{cycle}: acked add ({u},{v}) not served "
+                        f"after respawn (hops={hops})"
+                    )
+            check(
+                f"cycle{cycle}-acked-visible",
+                not any(f"cycle{cycle}" in m for m in mismatches),
+                f"{len(cycle_adds)} adds",
+            )
+            # total-state gate: fold the overlay and compare the
+            # snapshot digest against the expected edge set exactly
+            victim.roll("gu")
+            got = victim.stats()["store"]["graphs"]["gu"]["digest"]
+            expect = content_digest(n, canonical_pairs(
+                n, np.array(sorted(set(und["gu"]) | set(acked)),
+                            dtype=np.int64)
+            ))
+            check(f"cycle{cycle}-digest", got == expect,
+                  f"{got[:12]} vs {expect[:12]}")
+
+        # ---- phase 2: rolling swap commit + catch-up re-admission ----
+        gr_adds = []
+        live_r = set(und["gr"])
+        for i in range(n):
+            u, v = i, n - 1 - i
+            e = (u, v) if u < v else (v, u)
+            if u != v and e not in live_r:
+                gr_adds.append(e)
+            if len(gr_adds) >= int(roll_adds):
+                break
+        catch0 = fleet.stats()["catchups"]
+        roll_out = fleet.rolling_swap("gr", adds=gr_adds, dels=[])
+        committed = fleet.stats()["committed"].get("gr")
+        check("roll-committed", roll_out["ok"] and committed == 2,
+              f"ok={roll_out['ok']} committed={committed}")
+        victim.kill()
+        time.sleep(0.3)
+        rec_s = respawn_victim(recovery_bound_s)
+        recoveries.append(rec_s)
+        check("post-roll-recovery", rec_s is not None, f"{rec_s}s")
+        # the poller's generation check (the catch-up verdict) may land
+        # one tick after the probe succeeds — wait for it explicitly
+        t0w = time.monotonic()
+        while (fleet.stats()["catchups"] <= catch0
+               and time.monotonic() - t0w < recovery_bound_s):
+            time.sleep(0.05)
+        v_after = victim.version("gr")
+        catchup_ok = check(
+            "catchup-version",
+            v_after == committed
+            and fleet.stats()["catchups"] > catch0,
+            f"declared v{v_after} vs committed v{committed}, "
+            f"catchups {catch0} -> {fleet.stats()['catchups']}",
+        )
+        try:
+            res = victim.wait_ticket(
+                victim.submit(*gr_adds[0], "gr"), timeout=30.0
+            )
+            check("catchup-answer", res.hops == 1, f"hops={res.hops}")
+        except Exception as e:
+            check("catchup-answer", False, str(e))
+
+        # ---- phase 3: torn-tail replay -------------------------------
+        victim.update("gu", adds=[shortcut_pool[shortcut_i],
+                                  shortcut_pool[shortcut_i + 1]])
+        acked += shortcut_pool[shortcut_i: shortcut_i + 2]
+        torn_pair = shortcut_pool[shortcut_i]
+        shortcut_i += 2
+        victim.kill()
+        segs = sorted(
+            (int(f.rsplit(".", 1)[1]), f)
+            for f in os.listdir(dirs[victim_name])
+            if f.startswith("gu.wal.") and f.rsplit(".", 1)[1].isdigit()
+        )
+        live_seg = os.path.join(dirs[victim_name], segs[-1][1])
+        # the torn write a crash mid-append leaves: a record header
+        # promising more payload than exists
+        with open(live_seg, "ab") as f:
+            f.write(b"\xff\x00\x00\x00" + b"\xde\xad\xbe\xef" * 3)
+        # parent-side recovery of a COPY: exact, in-process, metric-
+        # minting — the digest gate over the acked state
+        copy_dir = os.path.join(base, "torn-copy")
+        shutil.copytree(dirs[victim_name], copy_dir)
+        st = GraphStore.from_dir(copy_dir, durable=True,
+                                 compact_threshold=None)
+        rec = st.stats()["graphs"]["gu"]["durable"]["recovered"]
+        ov = st.overlay("gu")
+        parent_ok = (
+            rec["torn_tail_truncated"]
+            and rec["replayed_records"] == 2
+            and ov is not None
+            and ov.solve(*torn_pair).hops == 1
+        )
+        st.close()
+        check("torn-parent-recovery", parent_ok, rec)
+        # child-side: the respawn truncates the tail and still serves
+        # every acked update
+        rec_s = respawn_victim(recovery_bound_s)
+        recoveries.append(rec_s)
+        check("torn-recovery", rec_s is not None, f"{rec_s}s")
+        child_rec = (victim.stats()["store"]["graphs"]["gu"]
+                     .get("durable", {}).get("recovered") or {})
+        try:
+            res = victim.wait_ticket(
+                victim.submit(*torn_pair, "gu"), timeout=30.0
+            )
+            torn_child_ok = (
+                res.hops == 1 and child_rec.get("torn_tail_truncated")
+            )
+        except Exception as e:
+            torn_child_ok = False
+            child_rec["error"] = str(e)[:200]
+        check("torn-child-recovery", torn_child_ok, child_rec)
+        torn_ok = bool(parent_ok and torn_child_ok)
+
+        # ---- drain + verify the routed traffic plane -----------------
+        stop.set()
+        traffic.join(timeout=30.0)
+        fleet.flush(timeout=120.0)
+        with tickets_lock:
+            rows = list(tickets)
+        for _g, _s, _d, t in rows:
+            try:
+                t.wait(timeout=120.0)
+            except Exception:
+                pass
+        for g, s, d, t in rows:
+            if t.error is not None:
+                failed.append({
+                    "phase": "traffic", "graph": g, "query": [s, d],
+                    "kind": getattr(t.error, "kind", "?"),
+                    "error": str(t.error)[:200],
+                })
+            elif t.result is None:
+                lost.append((g, s, d))
+            else:
+                ref = truth_for(g, s, d)
+                if t.result.found != ref.found or (
+                    ref.found and t.result.hops != ref.hops
+                ):
+                    mismatches.append(
+                        f"traffic {g} {s}->{d}: "
+                        f"{t.result.found}/{t.result.hops} != "
+                        f"{ref.found}/{ref.hops}"
+                    )
+        # audit the truth source itself on a seeded subsample
+        audit_bad = []
+        arng = np.random.default_rng(seed + 7)
+        for g in routed[:2]:
+            keys = list(truth[g]) or [(0, n - 1)]
+            for i in arng.choice(len(keys),
+                                 size=min(12, len(keys)),
+                                 replace=False):
+                s, d = keys[int(i)]
+                ref = solve_serial_csr(n, *csrs[g], s, d)
+                got = truth_for(g, s, d)
+                if got.found != ref.found or (
+                    ref.found and got.hops != ref.hops
+                ):
+                    audit_bad.append(f"truth {g} {s}->{d}")
+
+        stranded = sum(
+            fleet.replica(r).load() for r in fleet.replica_names
+            if fleet.replica(r).alive
+        )
+        render = REGISTRY.render()
+        metrics_missing = [
+            m for m in DURABLE_METRIC_FAMILIES if m not in render
+        ]
+        fstats = fleet.stats()
+        bound_recs = [r for r in recoveries if r is not None]
+        out = {
+            "n_per_graph": n,
+            "grid": f"{w}x{h}",
+            "replicas": int(replicas),
+            "fsync": fsync,
+            "kill_cycles": int(kill_cycles),
+            "updates_per_cycle": int(updates_per_cycle),
+            "acked_updates": len(acked),
+            "rate_qps": float(rate_qps),
+            "recovery_bound_s": float(recovery_bound_s),
+            "recoveries_s": [
+                None if r is None else round(r, 3) for r in recoveries
+            ],
+            "recovery_max_s": (
+                round(max(bound_recs), 3) if bound_recs else None
+            ),
+            "roll": {"ok": roll_out["ok"], "committed": committed},
+            "checks": checks,
+            "router": {
+                "reroutes": fstats["reroutes"],
+                "catchups": fstats["catchups"],
+                "rolls": fstats["rolls"],
+            },
+            "tickets": {
+                "submitted": len(rows),
+                "failed": len(failed),
+                "lost": len(lost),
+                "stranded_outstanding": int(stranded),
+            },
+            "failed_sample": failed[:10],
+            "mismatches": mismatches[:10],
+            "truth_audit_mismatches": audit_bad[:10],
+            "metrics_missing": metrics_missing,
+            "setup_to_drain_s": round(
+                time.perf_counter() - t_setup, 3
+            ),
+            # the gates
+            "zero_acked_loss": all(
+                c["ok"] for c in checks
+                if "acked-visible" in c["check"]
+                or "digest" in c["check"]
+            ) and not any("acked add" in m for m in mismatches),
+            "recovery_ok": bool(
+                len(bound_recs) == len(recoveries)
+                and all(r <= recovery_bound_s for r in bound_recs)
+            ),
+            "torn_tail_ok": torn_ok,
+            "catchup_ok": bool(catchup_ok),
+            "zero_lost": not lost and stranded == 0,
+            "zero_failed": not failed,
+            "verified_vs_truth": not mismatches and not audit_bad,
+            "wal_metrics_ok": not metrics_missing,
+            # every recorded check verdict, so a red row in checks[]
+            # (roll-committed, catchup-answer, ...) can never coexist
+            # with a green artifact
+            "checks_ok": all(c["ok"] for c in checks),
+        }
+        out["ok"] = bool(
+            out["zero_acked_loss"] and out["recovery_ok"]
+            and out["torn_tail_ok"] and out["catchup_ok"]
+            and out["zero_lost"] and out["zero_failed"]
+            and out["verified_vs_truth"] and out["wal_metrics_ok"]
+            and out["checks_ok"]
+        )
+        return out
+    finally:
+        stop.set()
+        sys.setswitchinterval(old_si)
+        if fleet is not None:
+            fleet.close()
+        elif victim is not None:
+            victim.close()
+        if workdir is None:
+            shutil.rmtree(base, ignore_errors=True)
+
+
 def _validate(csr, res, s, d) -> bool:
     from bibfs_tpu.solvers.api import validate_path
 

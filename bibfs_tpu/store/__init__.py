@@ -7,7 +7,11 @@ The serving-stack analog of model hot-swap in an inference stack: a
 inserts/deletes with exact overlay-corrected query answering until a
 background compaction folds them into a fresh snapshot; the engines
 (``bibfs_tpu/serve``) resolve names to snapshots per flush and finish
-in-flight batches on the version they started on.
+in-flight batches on the version they started on. With ``wal_dir``
+set, a per-graph write-ahead log (``store/wal``) makes every acked
+update crash-durable, compactions double as crash-consistent
+checkpoints (atomic ``.bin`` + manifest rename + WAL segment switch),
+and ``GraphStore.from_dir(durable=True)`` recovers manifest + replay.
 """
 
 from bibfs_tpu.store.delta import DeltaOverlay  # noqa: F401
@@ -16,4 +20,11 @@ from bibfs_tpu.store.snapshot import (  # noqa: F401
     GraphSnapshot,
     content_digest,
     next_version,
+)
+from bibfs_tpu.store.wal import (  # noqa: F401
+    DURABLE_METRIC_FAMILIES,
+    FSYNC_POLICIES,
+    WalWriter,
+    read_wal,
+    repair_wal,
 )

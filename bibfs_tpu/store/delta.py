@@ -80,7 +80,7 @@ class DeltaOverlay:
         with self._lock:
             self._base_has((0, 0))
 
-    def apply(self, adds=(), dels=()) -> dict:
+    def apply(self, adds=(), dels=(), *, commit: bool = True) -> dict:
         """Apply one batch of undirected edge updates. An add of an
         edge the (overlaid) graph already has, or a delete of one it
         does not, is rejected — silent no-ops would let a typo'd update
@@ -89,7 +89,14 @@ class DeltaOverlay:
         and committed only once every edge validates, so a rejected
         batch leaves the overlay exactly as it was (no half-applied
         updates leaking into the next compaction). Returns the
-        overlay's post-batch counts."""
+        overlay's post-batch counts.
+
+        ``commit=False`` runs the full staging validation and returns
+        the would-be counts WITHOUT committing — the durable store's
+        WAL ordering needs "validate, log, then commit" (a rejected
+        batch must never reach the log, a logged batch must never fail
+        the in-memory commit), and the dry run is what makes the second
+        ``apply`` of that sequence infallible under the same lock."""
         n = self.base.n
         with self._lock:
             stage_a, stage_d = set(self._adds), set(self._dels)
@@ -109,7 +116,8 @@ class DeltaOverlay:
                     raise ValueError(f"edge {e} not present")
                 else:
                     stage_d.add(e)
-            self._adds, self._dels = stage_a, stage_d
+            if commit:
+                self._adds, self._dels = stage_a, stage_d
             return {"adds": len(stage_a), "dels": len(stage_d)}
 
     def capture(self) -> tuple[set, set]:
@@ -258,12 +266,16 @@ class DeltaOverlay:
             )
         return base
 
-    def snapshot(self) -> tuple[GraphSnapshot, set, set]:
+    def snapshot(self, adds: set | None = None,
+                 dels: set | None = None) -> tuple[GraphSnapshot, set, set]:
         """Materialize base+delta into a fresh snapshot (the compaction
         build — run it OFF the serving path). Returns ``(snapshot,
         adds, dels)`` where the sets are exactly what was folded in, for
-        :meth:`subtract` after the store swaps."""
-        adds, dels = self.capture()
+        the rebase after the store swaps. The durable store passes sets
+        it captured under its own lock (the WAL segment fence); with
+        none given, a fresh :meth:`capture` is taken here."""
+        if adds is None or dels is None:
+            adds, dels = self.capture()
         snap = GraphSnapshot.build(
             self.base.n, self.merged_edges(adds, dels)
         )

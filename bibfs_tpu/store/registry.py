@@ -43,18 +43,48 @@ past which a full rebuild is scheduled instead); a delete invalidates
 the index until the next compaction folds it into a snapshot the
 builder can traverse.
 
+**Durability** (``wal_dir=DIR``): every acked update batch is appended
+to a per-graph write-ahead log (:mod:`bibfs_tpu.store.wal`) BEFORE it
+commits to the overlay — validate, log, commit, in that order under
+the store lock — and the ack goes out only once the record is durable
+under the ``fsync`` policy (``always``/``batch``/``off``), so a crash
+can never un-ack an acknowledged write. Compactions double as
+crash-consistent checkpoints: the folded snapshot lands as an
+atomically-replaced ``<name>.v<V>.bin`` (``graph/io.write_graph_bin``
+is tmp-file + ``os.replace``), the ``<name>.manifest.json`` commits by
+atomic rename, and the WAL "truncates" by segment switch — the capture
+and the switch share one locked section, so every record is either
+folded into the checkpoint or replays on top of it, never both, never
+neither (the full scheme: ``store/wal.py`` module docstring). Recovery
+(:meth:`from_dir` with ``durable=True``) is always manifest + replay:
+load the manifest's snapshot, replay surviving segments in order
+(truncating a torn tail), re-arm the overlay, and rebuild the landmark
+index at the recovered generation. The fault sites ``wal_write`` /
+``wal_fsync`` / ``manifest_rename`` (``serve/faults``) inject exactly
+the disk failures this machinery must survive: a faulted append
+refuses the ack with nothing committed; a faulted manifest rename
+leaves the previous checkpoint governing recovery with the WAL intact.
+
 Observability: ``bibfs_store_graphs`` (gauge), ``bibfs_store_swaps_total``
 / ``bibfs_store_compactions_total`` / ``bibfs_store_compact_failures_total``
 (counters, per graph), ``bibfs_store_delta_edges`` (gauge, per graph),
 ``bibfs_oracle_index_builds_total`` (counter, per graph) and
 ``bibfs_oracle_index_age_seconds`` (gauge, per graph, refreshed at
-scrape time) in the process registry, plus ``store_swap`` /
-``store_compact`` / ``store_index_build`` trace spans.
+scrape time) in the process registry — durable stores add
+``bibfs_wal_records_total`` / ``bibfs_wal_fsyncs_total`` /
+``bibfs_checkpoints_total`` (counters, per graph),
+``bibfs_recovery_replayed_records`` (counter) and
+``bibfs_recovery_seconds`` (gauge, last recovery) — plus ``store_swap``
+/ ``store_compact`` / ``store_checkpoint`` / ``store_recover`` /
+``store_index_build`` trace spans.
 """
 
 from __future__ import annotations
 
+import json
 import os
+import re
+import sys
 import threading
 import time
 import weakref
@@ -63,6 +93,26 @@ from bibfs_tpu.obs.metrics import REGISTRY, next_instance_label
 from bibfs_tpu.obs.trace import span
 from bibfs_tpu.store.delta import DeltaOverlay, canonical_edge
 from bibfs_tpu.store.snapshot import GraphSnapshot
+from bibfs_tpu.store.wal import (
+    FSYNC_POLICIES,
+    WalWriter,
+    fsync_dir,
+    list_segments,
+    read_wal,
+    repair_wal,
+    segment_path,
+)
+
+#: checkpoint snapshots (``<name>.v<V>.<digest12>.bin``) — excluded
+#: from :meth:`GraphStore.from_dir`'s seed enumeration (the manifest,
+#: not the directory listing, says which one is current). The digest
+#: suffix makes the filename content-unique (two racing checkpoint
+#: writers at the same version can only collide on byte-identical
+#: files, never overwrite each other's committed snapshot) — and it is
+#: REQUIRED here, so a user's own seed file that merely looks
+#: versioned (``roads.v2.bin``) is neither hidden from enumeration nor
+#: ever eligible for checkpoint gc.
+_CKPT_BIN_RE = re.compile(r"\.v(\d+)\.[0-9a-f]{6,32}\.bin$")
 
 
 class _Entry:
@@ -77,7 +127,8 @@ class _Entry:
                  "swaps", "compactions", "compact_failures",
                  "graph_gen", "oracle", "oracle_builder", "oracle_cells",
                  "index_builds", "index_aborts", "index_repairs",
-                 "index_failures")
+                 "index_failures",
+                 "wal", "wal_seq", "bin_file", "checkpoints", "recovered")
 
     def __init__(self, snapshot: GraphSnapshot):
         self.snapshot = snapshot
@@ -97,6 +148,12 @@ class _Entry:
         self.index_aborts = 0
         self.index_repairs = 0
         self.index_failures = 0
+        # durability state (None/unused on non-durable stores)
+        self.wal: WalWriter | None = None
+        self.wal_seq = 0
+        self.bin_file: str | None = None
+        self.checkpoints = 0
+        self.recovered: dict | None = None
 
 
 class GraphStore:
@@ -117,13 +174,28 @@ class GraphStore:
     oracle_seed : landmark-selection seed (deterministic rebuilds).
     obs_label : the ``store=`` label value this store's registry cells
         carry (default: a process-unique ``store-N``).
+    wal_dir : directory rooting the durability layer (module
+        docstring): per-graph write-ahead log segments, checkpoint
+        ``.bin`` snapshots and ``manifest.json`` files. ``None``
+        (default) disables durability — acked updates then live only in
+        process memory, exactly the pre-WAL behavior.
+    fsync : WAL fsync policy, ``always`` / ``batch`` / ``off``
+        (``store/wal.py`` module docstring — what "durable enough to
+        ack" means). Default ``batch``.
+    fsync_batch_records : group-commit size under ``fsync="batch"``.
+    faults : a :class:`bibfs_tpu.serve.faults.FaultPlan` injecting at
+        the durability seams (``wal_write``/``wal_fsync``/
+        ``manifest_rename``); default: built from ``BIBFS_FAULTS`` when
+        set, else no injection.
     """
 
     def __init__(self, *, compact_threshold: int | None = 256,
                  oracle_k: int | None = None,
                  oracle_repair_max: int = 64,
                  oracle_seed: int = 0,
-                 obs_label: str | None = None):
+                 obs_label: str | None = None,
+                 wal_dir=None, fsync: str = "batch",
+                 fsync_batch_records: int = 64, faults=None):
         self.compact_threshold = (
             None if compact_threshold is None else int(compact_threshold)
         )
@@ -162,6 +234,50 @@ class GraphStore:
             "the next update re-triggers)",
             ("store", "graph"),
         )
+        if fsync not in FSYNC_POLICIES:
+            raise ValueError(
+                f"unknown fsync policy {fsync!r} "
+                f"(known: {', '.join(FSYNC_POLICIES)})"
+            )
+        self.wal_dir = None if wal_dir is None else os.fspath(wal_dir)
+        self.fsync = fsync
+        self.fsync_batch_records = int(fsync_batch_records)
+        if faults is None:
+            from bibfs_tpu.serve.faults import FaultPlan
+
+            faults = FaultPlan.from_env()
+        self._faults = faults
+        self.load_errors: list[dict] = []
+        if self.wal_dir is not None:
+            if not os.path.isdir(self.wal_dir):
+                raise ValueError(f"wal_dir {self.wal_dir!r} is not a directory")
+            self._c_wal_records = REGISTRY.counter(
+                "bibfs_wal_records_total",
+                "Write-ahead-log records appended (one acked update "
+                "batch each)",
+                ("store", "graph"),
+            )
+            self._c_wal_fsyncs = REGISTRY.counter(
+                "bibfs_wal_fsyncs_total",
+                "Write-ahead-log fsyncs issued (policy-dependent)",
+                ("store", "graph"),
+            )
+            self._c_checkpoints = REGISTRY.counter(
+                "bibfs_checkpoints_total",
+                "Crash-consistent checkpoints committed (snapshot .bin "
+                "+ manifest + WAL segment switch)",
+                ("store", "graph"),
+            )
+            self._c_recovery_replayed = REGISTRY.counter(
+                "bibfs_recovery_replayed_records",
+                "WAL records replayed during recovery",
+                ("store", "graph"),
+            )
+            self._g_recovery_seconds = REGISTRY.gauge(
+                "bibfs_recovery_seconds",
+                "Duration of the graph's last manifest+replay recovery",
+                ("store", "graph"),
+            )
         self.oracle_k = None if oracle_k is None else int(oracle_k)
         if self.oracle_k is not None and self.oracle_k < 1:
             raise ValueError(f"oracle_k must be >= 1, got {oracle_k}")
@@ -207,12 +323,48 @@ class GraphStore:
             pairs=None, snapshot: GraphSnapshot | None = None
             ) -> GraphSnapshot:
         """Register a graph under ``name`` (its version-1 snapshot).
-        The first added graph becomes the default."""
+        The first added graph becomes the default. On a durable store
+        this also writes the graph's seed ``.bin`` (if absent), its
+        v1 manifest, and opens its first WAL segment — and REFUSES a
+        name that already has durable state on disk (recover it with
+        ``from_dir(durable=True)`` instead; silently appending to a
+        dead process's WAL would interleave two histories)."""
         name = str(name)
         if snapshot is None:
             if n is None:
                 raise ValueError("add() needs n+edges/pairs or snapshot=")
             snapshot = GraphSnapshot.build(n, edges, pairs=pairs)
+        if self.wal_dir is not None and (
+            os.path.exists(self._manifest_path(name))
+            or list_segments(self.wal_dir, name)
+        ):
+            raise ValueError(
+                f"graph {name!r} has durable state in {self.wal_dir!r}; "
+                "recover it with GraphStore.from_dir(..., durable=True)"
+            )
+        entry = self._register(name, snapshot)
+        if self.wal_dir is not None:
+            try:
+                self._durable_register(name, entry)
+            except BaseException:
+                # UNREGISTER: a half-registered graph would keep
+                # serving and acking updates with entry.wal None —
+                # volatile acks on a store the caller believes durable,
+                # the exact hole this layer closes
+                with self._lock:
+                    self._entries.pop(name, None)
+                    if self._default == name:
+                        self._default = min(self._entries, default=None)
+                    self._g_graphs.set(len(self._entries))
+                raise
+        self._kick_oracle(name, entry)
+        return snapshot
+
+    def _register(self, name: str, snapshot: GraphSnapshot, *,
+                  version: int = 1) -> _Entry:
+        """The in-memory half of registration (shared with the recovery
+        path, which re-registers at the manifest's version instead of
+        1)."""
         with self._lock:
             if name in self._entries:
                 raise ValueError(
@@ -224,7 +376,7 @@ class GraphStore:
             # process happened to build snapshots in. (The build-time
             # global stamp remains the fallback for snapshots that never
             # enter a store.)
-            snapshot.version = 1
+            snapshot.version = int(version)
             entry = _Entry(snapshot)
             self._entries[name] = entry
             if self._default is None:
@@ -248,26 +400,349 @@ class GraphStore:
                 self._g_index_age.labels(
                     store=self.obs_label, graph=name
                 ).set(0.0)
-        self._kick_oracle(name, entry)
-        return snapshot
+        return entry
 
     @classmethod
-    def from_dir(cls, path, **kwargs) -> "GraphStore":
+    def from_dir(cls, path, *, durable: bool = False,
+                 **kwargs) -> "GraphStore":
         """A store over every ``*.bin`` graph in a directory, each
         registered under its file stem (``social.bin`` -> ``social``),
-        sorted so the default graph is deterministic."""
+        sorted so the default graph is deterministic.
+
+        ``durable=True`` roots the durability layer in the SAME
+        directory (``wal_dir=path`` unless overridden) and RECOVERS any
+        graph that left a manifest or WAL behind: manifest snapshot +
+        ordered segment replay, torn tail truncated, overlay re-armed
+        (module docstring). Checkpoint ``.bin`` files
+        (``<name>.v<V>.bin``) are never treated as seed graphs.
+
+        A corrupt or unreadable graph (torn ``.bin``, bad manifest,
+        digest mismatch) is SKIPPED with a counted, visible warning —
+        recorded in ``store.load_errors`` — instead of aborting the
+        whole registry load; only a directory with no loadable graph at
+        all raises."""
         from bibfs_tpu.graph.io import read_graph_bin
 
+        path = os.fspath(path)
+        if durable:
+            kwargs.setdefault("wal_dir", path)
         store = cls(**kwargs)
-        names = sorted(
-            f for f in os.listdir(path) if f.endswith(".bin")
-        )
+        names = set()
+        for fname in os.listdir(path):
+            if fname.endswith(".bin") and not _CKPT_BIN_RE.search(fname):
+                names.add(fname[: -len(".bin")])
+            elif fname.endswith(".manifest.json"):
+                names.add(fname[: -len(".manifest.json")])
         if not names:
             raise ValueError(f"no *.bin graphs in {path!r}")
-        for fname in names:
-            n, edges = read_graph_bin(os.path.join(path, fname))
-            store.add(os.path.splitext(fname)[0], n, edges)
+        for name in sorted(names):
+            try:
+                if store.wal_dir is not None and (
+                    os.path.exists(store._manifest_path(name))
+                    or list_segments(store.wal_dir, name)
+                ):
+                    store._recover_graph(name)
+                else:
+                    n, edges = read_graph_bin(
+                        os.path.join(path, f"{name}.bin")
+                    )
+                    store.add(name, n, edges)
+            except (OSError, ValueError, KeyError,
+                    json.JSONDecodeError) as e:
+                store.load_errors.append({
+                    "graph": name,
+                    "error": f"{type(e).__name__}: {e}"[:300],
+                })
+                print(
+                    f"[Store] skipping graph {name!r}: {e}",
+                    file=sys.stderr,
+                )
+        if not store.names():
+            raise ValueError(
+                f"no readable graph in {path!r} "
+                f"({len(store.load_errors)} skipped)"
+            )
         return store
+
+    # ---- durability (WAL + checkpoints + recovery) -------------------
+    def _fire(self, site: str) -> None:
+        if self._faults is not None:
+            self._faults.fire(site)
+
+    def _manifest_path(self, name: str) -> str:
+        return os.path.join(self.wal_dir, f"{name}.manifest.json")
+
+    def _open_segment(self, name: str, seq: int) -> WalWriter:
+        rec = self._c_wal_records.labels(store=self.obs_label, graph=name)
+        fsn = self._c_wal_fsyncs.labels(store=self.obs_label, graph=name)
+        return WalWriter(
+            segment_path(self.wal_dir, name, seq),
+            fsync=self.fsync,
+            batch_records=self.fsync_batch_records,
+            fire=self._fire,
+            on_record=rec.inc,
+            on_fsync=fsn.inc,
+        )
+
+    def _durable_register(self, name: str, entry: _Entry) -> None:
+        """Fresh durable registration: seed ``.bin`` (written atomically
+        if absent; digest-verified against the registered snapshot if
+        present — the manifest will reference it, and a mismatched seed
+        would make every later recovery refuse the graph), v1 manifest,
+        first WAL segment."""
+        from bibfs_tpu.graph.io import read_graph_bin, write_graph_bin
+
+        entry.bin_file = f"{name}.bin"
+        seed = os.path.join(self.wal_dir, entry.bin_file)
+        if not os.path.exists(seed):
+            write_graph_bin(
+                seed, entry.snapshot.n, entry.snapshot.undirected_edges()
+            )
+        else:
+            n, edges = read_graph_bin(seed)
+            on_disk = GraphSnapshot.build(n, edges)
+            if on_disk.digest != entry.snapshot.digest:
+                raise ValueError(
+                    f"{entry.bin_file} already exists with different "
+                    f"content (digest {on_disk.digest} != registered "
+                    f"{entry.snapshot.digest}); refusing to register a "
+                    "graph its own seed could not recover"
+                )
+        entry.wal_seq = 1
+        self._c_checkpoints.labels(store=self.obs_label, graph=name)
+        self._c_recovery_replayed.labels(store=self.obs_label, graph=name)
+        self._g_recovery_seconds.labels(
+            store=self.obs_label, graph=name
+        ).set(0.0)
+        with self._lock:
+            self._write_manifest_locked(name, entry)
+        entry.wal = self._open_segment(name, entry.wal_seq)
+
+    def _write_manifest_locked(self, name: str, entry: _Entry, *,
+                               snapshot: GraphSnapshot | None = None,
+                               bin_file: str | None = None) -> None:
+        """Commit the graph's manifest by atomic rename: tmp file,
+        flush+fsync, ``os.replace`` (the ``manifest_rename`` fault
+        seam), directory fsync. A crash (or injected fault) anywhere in
+        here leaves the PREVIOUS manifest governing recovery — with the
+        superseded WAL segments still on disk, so nothing acked is
+        lost, only replayed from one checkpoint further back.
+        ``snapshot``/``bin_file`` override the entry's (``swap()``
+        commits durably BEFORE the in-memory flip)."""
+        snapshot = entry.snapshot if snapshot is None else snapshot
+        manifest = {
+            "graph": name,
+            "version": snapshot.version,
+            "digest": snapshot.digest,
+            "n": snapshot.n,
+            "edges": snapshot.num_edges,
+            "bin": entry.bin_file if bin_file is None else bin_file,
+            "wal": f"{name}.wal.{entry.wal_seq}",
+            "wal_seq": entry.wal_seq,
+            "wal_offset": 0,
+            "checkpoints": entry.checkpoints,
+        }
+        path = self._manifest_path(name)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(manifest, f, indent=1, sort_keys=True)
+                f.write("\n")
+                f.flush()
+                os.fsync(f.fileno())
+            self._fire("manifest_rename")
+            os.replace(tmp, path)
+        except BaseException:
+            self._unlink_quiet(tmp)
+            raise
+        fsync_dir(self.wal_dir)
+
+    def _wal_roll_locked(self, name: str, entry: _Entry) -> int:
+        """Switch the graph to a fresh WAL segment (the crash-safe form
+        of truncation — ``store/wal.py`` module docstring). MUST run in
+        the same locked section as the overlay capture it fences."""
+        old = entry.wal
+        entry.wal_seq += 1
+        entry.wal = self._open_segment(name, entry.wal_seq)
+        if old is not None:
+            old.close()  # flushes + fsyncs the completed segment
+        return entry.wal_seq
+
+    def _checkpoint_locked(self, name: str, entry: _Entry,
+                           bin_file: str) -> None:
+        """Commit a checkpoint for the CURRENT (just-swapped) snapshot:
+        point the manifest at ``bin_file`` (already atomically written)
+        and the current WAL segment. Counted + spanned."""
+        with span("store_checkpoint", graph=name,
+                  version=entry.snapshot.version, wal_seq=entry.wal_seq):
+            entry.bin_file = bin_file
+            self._write_manifest_locked(name, entry)
+            entry.checkpoints += 1
+            self._c_checkpoints.labels(
+                store=self.obs_label, graph=name
+            ).inc()
+
+    def _unlink_quiet(self, path) -> None:
+        if not path:
+            return
+        try:
+            os.unlink(path if os.path.isabs(str(path))
+                      else os.path.join(self.wal_dir, str(path)))
+        except OSError:
+            pass
+
+    def _ckpt_bin_name(self, name: str, snapshot: GraphSnapshot) -> str:
+        """Checkpoint snapshot filename: version + content-digest
+        prefix, so concurrent writers can only ever collide on
+        byte-identical files (``_CKPT_BIN_RE``)."""
+        return f"{name}.v{snapshot.version}.{snapshot.digest[:12]}.bin"
+
+    def _gc_durable(self, name: str, entry: _Entry) -> None:
+        """Delete superseded checkpoint bins and WAL segments (below
+        the committed manifest) — best-effort, after the manifest
+        rename made them unreachable. The manifest's current bin and
+        the seed ``<name>.bin`` are always kept (the seed is the
+        directory's human-visible original and the non-durable
+        ``from_dir`` fallback)."""
+        cur_v = entry.snapshot.version
+        cur_seq = entry.wal_seq
+        keep = entry.bin_file
+        for seq, path in list_segments(self.wal_dir, name):
+            if seq < cur_seq:
+                self._unlink_quiet(path)
+        prefix = f"{name}.v"
+        for fname in os.listdir(self.wal_dir):
+            if not fname.startswith(prefix) or fname == keep:
+                continue
+            m = _CKPT_BIN_RE.search(fname)
+            if (m is not None and fname[: m.start()] == name
+                    and int(m.group(1)) <= cur_v):
+                self._unlink_quiet(os.path.join(self.wal_dir, fname))
+
+    def _recover_graph(self, name: str) -> None:
+        """Manifest + replay recovery (module docstring): load the
+        manifest's snapshot (digest-verified), replay every surviving
+        WAL segment ``>= wal_seq`` in order — truncating a torn tail on
+        the live segment — re-arm the overlay, and leave the landmark
+        index rebuilding at the recovered generation. Raises (BEFORE
+        registering anything) on a broken base, a digest mismatch, a
+        torn NON-final segment, or a record its own prefix rejects —
+        ``from_dir`` then skips the graph with a counted warning: a
+        graph whose durable history cannot be fully proven is refused,
+        never served approximately."""
+        from bibfs_tpu.graph.io import read_graph_bin
+
+        t0 = time.perf_counter()
+        mpath = self._manifest_path(name)
+        manifest = None
+        if os.path.exists(mpath):
+            with open(mpath) as f:
+                manifest = json.load(f)
+        bin_file = (
+            f"{name}.bin" if manifest is None else str(manifest["bin"])
+        )
+        version = 1 if manifest is None else int(manifest["version"])
+        wal_seq = 1 if manifest is None else int(manifest["wal_seq"])
+        n, edges = read_graph_bin(os.path.join(self.wal_dir, bin_file))
+        snap = GraphSnapshot.build(n, edges)
+        if manifest is not None and manifest.get("digest") is not None \
+                and manifest["digest"] != snap.digest:
+            raise ValueError(
+                f"{bin_file}: content digest {snap.digest} does not "
+                f"match manifest {manifest['digest']} — refusing to "
+                "serve a snapshot that is not the one checkpointed"
+            )
+        replayed = 0
+        truncated = False
+        overlay = None
+        segments = [
+            (s, p) for s, p in list_segments(self.wal_dir, name)
+            if s >= wal_seq
+        ]
+        # replay is PROVEN before anything registers: a raise below
+        # (torn non-final segment, inconsistent record) must leave the
+        # store without a half-registered graph for from_dir to skip
+        with span("store_recover", graph=name, version=version,
+                  segments=len(segments)):
+            for i, (seq, spath) in enumerate(segments):
+                last = i == len(segments) - 1
+                if last:
+                    # truncate a torn tail in place so appends resume
+                    # on a provably-valid prefix (the one tear a
+                    # process crash can legitimately leave: mid-append
+                    # on the live segment)
+                    records, torn = repair_wal(spath)
+                    truncated = truncated or torn
+                else:
+                    records, _good, torn = read_wal(spath)
+                    if torn:
+                        # a non-final segment was completed and flushed
+                        # before its switch, so a tear there is damage
+                        # outside our control — and records in LATER
+                        # segments depend on the lost ones. Serving the
+                        # provable prefix while accepting new acks
+                        # would fork the history (replay could never
+                        # reach them): refuse the graph instead, the
+                        # digest-mismatch contract
+                        raise ValueError(
+                            f"{os.path.basename(spath)}: torn "
+                            "non-final WAL segment — acked records "
+                            "beyond it are unrecoverable; refusing to "
+                            "serve a forked history"
+                        )
+                for _rec_version, adds, dels in records:
+                    if overlay is None:
+                        overlay = DeltaOverlay(snap)
+                        overlay.ensure_index()
+                    try:
+                        overlay.apply(adds, dels)
+                    except ValueError as e:
+                        # a CRC-valid record its own prefix rejects is
+                        # logic-level corruption — same contract
+                        raise ValueError(
+                            f"{os.path.basename(spath)}: WAL record "
+                            f"inconsistent with its own prefix ({e}); "
+                            "refusing to serve a forked history"
+                        ) from e
+                    replayed += 1
+            entry = self._register(name, snap, version=version)
+            entry.bin_file = bin_file
+            self._c_checkpoints.labels(store=self.obs_label, graph=name)
+            entry.graph_gen += replayed  # one live-graph gen per batch
+            entry.wal_seq = segments[-1][0] if segments else wal_seq
+            entry.wal = self._open_segment(name, entry.wal_seq)
+            delta = 0
+            if overlay is not None and overlay.delta_edges > 0:
+                entry.overlay = overlay
+                delta = overlay.delta_edges
+            self._g_delta.labels(store=self.obs_label, graph=name).set(delta)
+        dt = time.perf_counter() - t0
+        self._c_recovery_replayed.labels(
+            store=self.obs_label, graph=name
+        ).inc(replayed)
+        self._g_recovery_seconds.labels(
+            store=self.obs_label, graph=name
+        ).set(dt)
+        entry.recovered = {
+            "version": version,
+            "replayed_records": replayed,
+            "torn_tail_truncated": truncated,
+            "segments": len(segments),
+            "delta_edges": delta,
+            "recovery_s": round(dt, 6),
+        }
+        if (self.compact_threshold is not None
+                and delta >= self.compact_threshold):
+            # a long replay re-armed a big overlay: fold it off the
+            # serving path now rather than waiting for the next update
+            with self._lock:
+                if entry.compactor is None:
+                    entry.compactor = threading.Thread(
+                        target=self._compact_job, args=(name, entry),
+                        name=f"bibfs-compact-{name}", daemon=True,
+                    )
+                    entry.compactor.start()
+        self._kick_oracle(name, entry)
 
     # ---- resolution --------------------------------------------------
     def _entry(self, name: str) -> _Entry:
@@ -316,7 +791,24 @@ class GraphStore:
         """Apply one batch of undirected edge updates to ``name``'s
         overlay (creating it on first update). Crossing
         ``compact_threshold`` kicks a background compaction. Returns
-        ``{"adds": ..., "dels": ..., "compacting": bool}``."""
+        ``{"adds": ..., "dels": ..., "compacting": bool}``.
+
+        On a durable store the batch is WAL-logged between validation
+        and the in-memory commit — validate, log, commit, one locked
+        section — and this method returning IS the ack: it happens only
+        after the record is durable under the fsync policy. A failed
+        append (disk fault, injected ``wal_write``/``wal_fsync``)
+        raises with NOTHING committed: the update is refused rather
+        than accepted-but-volatile.
+
+        The locked section is what fences the append against a
+        checkpoint's capture+segment-switch, so under ``fsync=always``
+        the fsync runs while holding the store lock: updates (a
+        control-plane path) then serialize against name resolution for
+        one fsync's latency. Serving reads are pointer reads — the
+        stall is bounded and deliberate; a per-graph WAL lock would buy
+        that latency back at the price of a second lock order across
+        every capture seam."""
         name = str(name)
         adds = [tuple(e) for e in adds]  # consumed twice when the
         dels = [tuple(e) for e in dels]  # oracle repairs (below)
@@ -336,6 +828,14 @@ class GraphStore:
                     # a swap/compaction replaced the overlay while the
                     # index built: restart against the current state
                     continue
+                if entry.wal is not None:
+                    # validate, log, commit: the dry run rejects a bad
+                    # batch BEFORE it can reach the log, and makes the
+                    # committing apply below infallible — so the WAL
+                    # never holds a record the overlay refused, and the
+                    # overlay never holds a batch the WAL lost
+                    overlay.apply(adds, dels, commit=False)
+                    entry.wal.append(entry.snapshot.version, adds, dels)
                 counts = overlay.apply(adds, dels)
                 # the live graph changed: the oracle gen moves forward
                 # IN THE SAME locked section as the apply, so no reader
@@ -550,7 +1050,16 @@ class GraphStore:
         swap it in, and REBASE updates that raced the build into a
         fresh overlay over the new snapshot. The old overlay object is
         never mutated: flushes that captured it keep answering the
-        exact old-base+full-delta graph (the same edge set)."""
+        exact old-base+full-delta graph (the same edge set).
+
+        On a durable store a compaction IS a checkpoint: the capture
+        and the WAL segment switch share one locked section (updates
+        append+apply under that same lock, so every record is either in
+        the capture — folded into the new ``.bin`` — or in the fresh
+        segment, replayed on top of it), the snapshot lands as an
+        atomically-replaced ``<name>.v<V>.bin``, and the manifest
+        rename commits the whole thing; superseded segments/bins are
+        deleted only after that rename."""
         with self._lock:
             entry = self._entry(name)
         with entry.compact_lock:
@@ -558,9 +1067,24 @@ class GraphStore:
                 overlay = entry.overlay
                 if overlay is None or overlay.delta_edges == 0:
                     return entry.snapshot  # nothing pending: no-op
+                adds, dels = overlay.capture()
+                base_version = entry.snapshot.version
+                if entry.wal is not None:
+                    self._wal_roll_locked(name, entry)
             with span("store_compact", graph=name,
-                      delta=overlay.delta_edges):
-                new, adds, dels = overlay.snapshot()  # the heavy build
+                      delta=len(adds) + len(dels)):
+                # the heavy build, on the sets captured under the lock
+                new, adds, dels = overlay.snapshot(adds, dels)
+                bin_file = None
+                if entry.wal is not None:
+                    from bibfs_tpu.graph.io import write_graph_bin
+
+                    new.version = base_version + 1  # re-stamped at commit
+                    bin_file = self._ckpt_bin_name(name, new)
+                    write_graph_bin(
+                        os.path.join(self.wal_dir, bin_file),
+                        new.n, new.undirected_edges(),
+                    )
                 # pre-warm the carried overlay's base index off-lock
                 # too: rebase residue applies under the store lock below
                 rebased = DeltaOverlay(new)
@@ -573,7 +1097,14 @@ class GraphStore:
                         # silently overwrite it with stale
                         # old-base+delta content. Abort: the folded
                         # updates were discarded BY the swap, exactly as
-                        # swap()'s contract states.
+                        # swap()'s contract states. (The switched WAL
+                        # segment is harmless — recovery replays
+                        # segments in order regardless of which
+                        # checkpoint ends up committed; the orphan bin
+                        # is removed unless the racing swap committed
+                        # the byte-identical file.)
+                        if entry.bin_file != bin_file:
+                            self._unlink_quiet(bin_file)
                         return entry.snapshot
                     # store-relative stamp (see add())
                     new.version = entry.snapshot.version + 1
@@ -595,6 +1126,17 @@ class GraphStore:
                     self._c_compactions.labels(
                         store=self.obs_label, graph=name
                     ).inc()
+                    if entry.wal is not None:
+                        # the manifest rename is the checkpoint commit;
+                        # a failure here (injected manifest_rename, a
+                        # full disk) raises out as a counted compact
+                        # failure with the in-memory swap already live —
+                        # consistent either way, because the OLD
+                        # manifest still governs recovery and every
+                        # segment it needs is still on disk
+                        self._checkpoint_locked(name, entry, bin_file)
+            if entry.wal is not None:
+                self._gc_durable(name, entry)
             # the swap dropped the old index (gen moved): rebuild for
             # the fresh snapshot off the serving path
             self._kick_oracle(name, entry)
@@ -624,13 +1166,83 @@ class GraphStore:
         """Atomically point ``name`` at an externally built snapshot.
         Returns the OLD snapshot (already released by the store; it
         retires once in-flight flush pins drop). Any pending overlay is
-        discarded — the new snapshot is the caller's declared truth."""
+        discarded — the new snapshot is the caller's declared truth.
+
+        On a durable store the declared truth is checkpointed too: the
+        snapshot lands as an atomic ``<name>.v<V>.<digest>.bin``, the
+        WAL switches to a fresh segment, and the manifest rename
+        commits — all BEFORE the in-memory flip, in the same continuous
+        locked section. The ordering matters here in a way it does not
+        for compaction: a swap DISCARDS the pending overlay, so an
+        in-memory-first commit whose manifest rename then failed would
+        fork history (the live process acks updates validated against
+        the new snapshot while the old manifest still replays the
+        discarded overlay). Durable-commit-first means a manifest
+        failure raises with the in-memory state — and therefore every
+        future ack — unchanged; a crash between the rename and the flip
+        just recovers to the declared truth the caller asked for."""
         name = str(name)
+        bin_file = None
         with self._lock:
             entry = self._entry(name)
-            old = self._swap_locked(name, entry, snapshot)
-            entry.overlay = None
-            self._g_delta.labels(store=self.obs_label, graph=name).set(0)
+            if entry.wal is not None:
+                if snapshot.version <= entry.snapshot.version:
+                    raise ValueError(
+                        f"swap must move {name!r} forward: new version "
+                        f"{snapshot.version} <= current "
+                        f"{entry.snapshot.version}"
+                    )
+                bin_file = self._ckpt_bin_name(name, snapshot)
+        if bin_file is not None:
+            # the heavy write, OFF the store lock; an abort below
+            # leaves only a cleaned-up orphan
+            from bibfs_tpu.graph.io import write_graph_bin
+
+            write_graph_bin(
+                os.path.join(self.wal_dir, bin_file),
+                snapshot.n, snapshot.undirected_edges(),
+            )
+        try:
+            with self._lock:
+                entry = self._entry(name)
+                if entry.wal is not None:
+                    # re-validate under THIS lock hold (the bin write
+                    # above ran off-lock): from here to the in-memory
+                    # flip nothing can interleave, so the durable
+                    # commit and the flip cannot disagree
+                    if snapshot.version <= entry.snapshot.version:
+                        raise ValueError(
+                            f"swap must move {name!r} forward: new "
+                            f"version {snapshot.version} <= current "
+                            f"{entry.snapshot.version}"
+                        )
+                    self._wal_roll_locked(name, entry)
+                    with span("store_checkpoint", graph=name,
+                              version=snapshot.version,
+                              wal_seq=entry.wal_seq):
+                        self._write_manifest_locked(
+                            name, entry,
+                            snapshot=snapshot, bin_file=bin_file,
+                        )
+                        entry.bin_file = bin_file
+                        entry.checkpoints += 1
+                        self._c_checkpoints.labels(
+                            store=self.obs_label, graph=name
+                        ).inc()
+                old = self._swap_locked(name, entry, snapshot)
+                entry.overlay = None
+                self._g_delta.labels(
+                    store=self.obs_label, graph=name
+                ).set(0)
+        except BaseException:
+            # never unlink a file a COMMITTED manifest references: a
+            # racing checkpoint can only have produced this exact path
+            # with byte-identical content (digest-suffixed name)
+            if entry.bin_file != bin_file:
+                self._unlink_quiet(bin_file)
+            raise
+        if entry.wal is not None:
+            self._gc_durable(name, entry)
         self._kick_oracle(name, entry)
         return old
 
@@ -675,11 +1287,22 @@ class GraphStore:
                     "compacting": entry.compactor is not None,
                     "oracle": self._oracle_stats_locked(entry),
                 }
+                if entry.wal is not None:
+                    graphs[name]["durable"] = {
+                        "wal_seq": entry.wal_seq,
+                        "wal": entry.wal.stats(),
+                        "bin": entry.bin_file,
+                        "checkpoints": entry.checkpoints,
+                        "recovered": entry.recovered,
+                    }
             return {
                 "graphs": graphs,
                 "default": self._default,
                 "compact_threshold": self.compact_threshold,
                 "oracle_k": self.oracle_k,
+                "durable": self.wal_dir is not None,
+                "fsync": self.fsync if self.wal_dir is not None else None,
+                "load_errors": list(self.load_errors),
             }
 
     def _oracle_stats_locked(self, entry: _Entry) -> dict | None:
@@ -707,8 +1330,9 @@ class GraphStore:
         return out
 
     def close(self) -> None:
-        """Join in-flight background compactions and index builds
-        (test/shutdown aid)."""
+        """Join in-flight background compactions and index builds, and
+        close the WAL writers (final fsync barrier) — test/shutdown
+        aid."""
         with self._lock:
             jobs = [
                 e.compactor for e in self._entries.values()
@@ -719,3 +1343,13 @@ class GraphStore:
             ]
         for job in jobs:
             job.join()
+        with self._lock:
+            wals = [
+                e.wal for e in self._entries.values()
+                if e.wal is not None
+            ]
+        for w in wals:
+            try:
+                w.close()
+            except OSError:
+                pass

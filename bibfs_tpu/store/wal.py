@@ -1,0 +1,309 @@
+"""Per-graph write-ahead log — the durability floor under live updates.
+
+Every live edge update a :class:`~bibfs_tpu.store.GraphStore` acks used
+to live only in the in-process :class:`~bibfs_tpu.store.DeltaOverlay`:
+a SIGKILL'd serving process respawned from its seed ``.bin`` at v1,
+silently discarding every acknowledged update. The WAL closes that
+hole: :meth:`GraphStore.update` appends the batch here BEFORE it
+commits to the overlay, and the ack only goes out once the record is
+durable under the active fsync policy — so "acked" means "survives a
+crash", by construction.
+
+**Record format** (little-endian, length-prefixed, CRC-checked)::
+
+    file   := header record*
+    header := b"BWAL1\\n"                     (6 bytes)
+    record := u32 payload_len | u32 crc32(payload) | payload
+    payload:= u64 snapshot_version | u32 n_adds | u32 n_dels
+              | n_adds x (u32 u, u32 v) | n_dels x (u32 u, u32 v)
+
+A batch is one record: replay applies it atomically or not at all,
+mirroring the overlay's staged-apply contract. Replay
+(:func:`read_wal`) stops at the first torn or bad-CRC record — a crash
+mid-append leaves a tail the next open truncates away
+(:func:`repair_wal`); everything before it is intact because appends
+are serialized and flushed in record order.
+
+**Fsync policy** (``always`` / ``batch`` / ``off``) defines what
+"durable" means for the ack:
+
+- ``always`` — ``os.fsync`` after every append: an acked record
+  survives OS/power loss. The strongest (and slowest) setting; the
+  crash soak's regression gate ("an acked update is provably served
+  after SIGKILL") runs under it.
+- ``batch`` — group commit: the record is flushed to the OS on every
+  append (surviving PROCESS death, the SIGKILL case) and fsync'd every
+  ``batch_records`` appends and at every checkpoint/close. A bounded
+  window of acked records can be lost to OS/power failure — the
+  standard throughput trade, and the default.
+- ``off`` — flush to the OS only; fsync only at checkpoint/close.
+
+**Segments, not offsets.** One logical WAL per graph is stored as a
+sequence of segment files ``<graph>.wal.<seq>``: a checkpoint captures
+the overlay under the store lock and *switches to a fresh segment* in
+the same locked section, so every record that races the checkpoint
+build lands in the new segment and replays cleanly against the new
+snapshot. The manifest records the first segment a recovery must
+replay (``wal_seq``, with ``wal_offset`` always 0 — the byte offset a
+single-file WAL would need is exactly what the segment switch makes
+unnecessary); superseded segments are deleted after the manifest
+commits, which is the crash-safe form of "truncate the WAL". Recovery
+replays all surviving segments ``>= wal_seq`` in sequence order —
+segments that outrun the manifest (a checkpoint that crashed between
+the segment switch and the manifest commit) simply replay on top, in
+the exact order their records were acked.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import threading
+import zlib
+
+_MAGIC = b"BWAL1\n"
+_REC_HEAD = struct.Struct("<II")        # payload_len, crc32
+_PAYLOAD_HEAD = struct.Struct("<QII")   # version, n_adds, n_dels
+
+#: fsync policies (module docstring); parse/ctor reject anything else —
+#: a typo'd policy must fail loudly, not silently weaken durability
+FSYNC_POLICIES = ("always", "batch", "off")
+
+#: the durability metric families (README "Observability") — ONE list
+#: shared by the crash soak's render gate and the bench CI gate, the
+#: fleet.FLEET_METRIC_FAMILIES pattern
+DURABLE_METRIC_FAMILIES = (
+    "bibfs_wal_records_total",
+    "bibfs_wal_fsyncs_total",
+    "bibfs_checkpoints_total",
+    "bibfs_recovery_replayed_records",
+    "bibfs_recovery_seconds",
+)
+
+
+def _encode_record(version: int, adds, dels) -> bytes:
+    parts = [_PAYLOAD_HEAD.pack(int(version), len(adds), len(dels))]
+    for u, v in adds:
+        parts.append(struct.pack("<II", int(u), int(v)))
+    for u, v in dels:
+        parts.append(struct.pack("<II", int(u), int(v)))
+    payload = b"".join(parts)
+    return _REC_HEAD.pack(len(payload), zlib.crc32(payload)) + payload
+
+
+def _decode_payload(payload: bytes):
+    version, n_adds, n_dels = _PAYLOAD_HEAD.unpack_from(payload, 0)
+    need = _PAYLOAD_HEAD.size + 8 * (n_adds + n_dels)
+    if len(payload) != need:
+        raise ValueError(
+            f"payload length {len(payload)} != declared {need}"
+        )
+    off = _PAYLOAD_HEAD.size
+    adds = [
+        struct.unpack_from("<II", payload, off + 8 * i)
+        for i in range(n_adds)
+    ]
+    off += 8 * n_adds
+    dels = [
+        struct.unpack_from("<II", payload, off + 8 * i)
+        for i in range(n_dels)
+    ]
+    return version, adds, dels
+
+
+def read_wal(path) -> tuple[list, int, bool]:
+    """Replay one segment file. Returns ``(records, good_bytes, torn)``
+    where ``records`` is a list of ``(version, adds, dels)`` batches,
+    ``good_bytes`` is the byte length of the valid prefix, and ``torn``
+    flags a torn/bad-CRC tail after it (replay stops there — the
+    records beyond a corrupt point cannot be trusted). A missing file
+    reads as empty; a file with a bad magic header reads as torn at
+    byte 0 (nothing salvageable)."""
+    try:
+        with open(path, "rb") as f:
+            data = f.read()
+    except FileNotFoundError:
+        return [], 0, False
+    if not data.startswith(_MAGIC):
+        return [], 0, bool(data)
+    records = []
+    off = len(_MAGIC)
+    while off < len(data):
+        if off + _REC_HEAD.size > len(data):
+            return records, off, True  # torn record header
+        length, crc = _REC_HEAD.unpack_from(data, off)
+        end = off + _REC_HEAD.size + length
+        if length > len(data) or end > len(data):
+            return records, off, True  # torn payload
+        payload = data[off + _REC_HEAD.size: end]
+        if zlib.crc32(payload) != crc:
+            return records, off, True  # bad CRC
+        try:
+            records.append(_decode_payload(payload))
+        except (ValueError, struct.error):
+            return records, off, True  # internally inconsistent
+        off = end
+    return records, off, False
+
+
+def repair_wal(path) -> tuple[list, bool]:
+    """Replay a segment and TRUNCATE any torn/bad-CRC tail in place, so
+    subsequent appends extend a provably-valid prefix. Returns
+    ``(records, truncated)``."""
+    records, good, torn = read_wal(path)
+    if torn:
+        with open(path, "r+b") as f:
+            f.truncate(good)
+    return records, torn
+
+
+class WalWriter:
+    """Append side of one segment file (module docstring format).
+
+    Thread-safe (the store appends under its own lock anyway, but a
+    checkpoint's final ``sync()`` may race a closing writer). ``fire``
+    is the store's fault-injection hook — called with ``"wal_write"``
+    before each append and ``"wal_fsync"`` before each fsync, so a
+    chaos plan can fail exactly the seams a dying disk would.
+    ``on_record``/``on_fsync`` are metric callbacks (registry counter
+    cells in the store)."""
+
+    def __init__(self, path, *, fsync: str = "batch",
+                 batch_records: int = 64, fire=None,
+                 on_record=None, on_fsync=None):
+        if fsync not in FSYNC_POLICIES:
+            raise ValueError(
+                f"unknown fsync policy {fsync!r} "
+                f"(known: {', '.join(FSYNC_POLICIES)})"
+            )
+        self.path = os.fspath(path)
+        self.fsync = fsync
+        self.batch_records = max(int(batch_records), 1)
+        self._fire = fire
+        self._on_record = on_record
+        self._on_fsync = on_fsync
+        self._lock = threading.Lock()
+        self.records = 0
+        self.fsyncs = 0
+        self._since_fsync = 0
+        self._f = open(self.path, "ab")
+        if self._f.tell() == 0:
+            self._f.write(_MAGIC)
+            self._f.flush()
+
+    def append(self, version: int, adds=(), dels=()) -> None:
+        """Append one update batch and make it durable under the active
+        policy (module docstring). Raises on write/fsync failure — the
+        caller must NOT ack (or commit in-memory state) if this does —
+        and ROLLS THE FILE BACK to the pre-append offset first: a
+        refused append may leave no bytes behind. Without the rollback
+        a post-write fsync failure leaves a valid record the caller was
+        told was refused (replayed on recovery, and a retried batch
+        then replays as a duplicate the graph refuses wholesale), and a
+        partial write leaves a mid-file tear every LATER acked record
+        would vanish behind. If even the rollback fails the segment is
+        POISONED (closed — subsequent appends raise, so the store
+        refuses acks): no log beats a forked one."""
+        rec = _encode_record(version, adds, dels)
+        with self._lock:
+            if self._f.closed:
+                raise OSError(
+                    f"WAL segment {self.path} poisoned by an earlier "
+                    "failed append (or closed); refusing the ack"
+                )
+            if self._fire is not None:
+                self._fire("wal_write")
+            pos = self._f.tell()
+            try:
+                self._f.write(rec)
+                self._f.flush()
+                if self.fsync == "always" or (
+                    self.fsync == "batch"
+                    and self._since_fsync + 1 >= self.batch_records
+                ):
+                    self._fsync_locked()
+                else:
+                    self._since_fsync += 1
+            except BaseException:
+                try:
+                    self._f.truncate(pos)
+                    self._f.seek(pos)
+                    self._f.flush()
+                except OSError:
+                    self._f.close()
+                raise
+            self.records += 1
+            if self._on_record is not None:
+                self._on_record()
+
+    def _fsync_locked(self) -> None:
+        if self._fire is not None:
+            self._fire("wal_fsync")
+        os.fsync(self._f.fileno())
+        self.fsyncs += 1
+        self._since_fsync = 0
+        if self._on_fsync is not None:
+            self._on_fsync()
+
+    def sync(self) -> None:
+        """Force an fsync now (checkpoint/close barrier) regardless of
+        policy — except a closed writer, where it is a no-op."""
+        with self._lock:
+            if not self._f.closed and self._since_fsync:
+                self._fsync_locked()
+
+    def close(self) -> None:
+        """Close the segment, fsyncing any pending records first under
+        EVERY policy — close is the checkpoint/shutdown barrier the
+        ``batch``/``off`` policies promise (module docstring): a
+        checkpoint's segment switch closes the completed segment, so
+        its records are on stable storage before the manifest that
+        supersedes them can commit."""
+        with self._lock:
+            if self._f.closed:
+                return
+            if self._since_fsync:
+                self._fsync_locked()
+            self._f.close()
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "path": os.path.basename(self.path),
+                "fsync": self.fsync,
+                "records": self.records,
+                "fsyncs": self.fsyncs,
+            }
+
+
+def segment_path(wal_dir, name: str, seq: int) -> str:
+    return os.path.join(os.fspath(wal_dir), f"{name}.wal.{int(seq)}")
+
+
+def list_segments(wal_dir, name: str) -> list[tuple[int, str]]:
+    """All of ``name``'s segment files, sorted by sequence number."""
+    prefix = f"{name}.wal."
+    out = []
+    for fname in os.listdir(os.fspath(wal_dir)):
+        if not fname.startswith(prefix):
+            continue
+        tail = fname[len(prefix):]
+        if tail.isdigit():
+            out.append((int(tail), os.path.join(os.fspath(wal_dir), fname)))
+    out.sort()
+    return out
+
+
+def fsync_dir(path) -> None:
+    """Best-effort directory fsync after an ``os.replace`` — makes the
+    rename itself durable on POSIX; harmless where unsupported."""
+    try:
+        fd = os.open(os.fspath(path), os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)

@@ -133,3 +133,21 @@ def test_injected_metric_counts():
         with pytest.raises(InjectedFault):
             plan.fire("device")
     assert cell.value == before + 3
+
+
+def test_durability_sites_parse_and_fire():
+    """The wal_write / wal_fsync / manifest_rename seams (the durable
+    store's disk-failure injection points) are first-class sites: they
+    parse, fire, and count like the engine seams."""
+    plan = FaultPlan.parse(
+        "wal_write:times=1;wal_fsync:every=2;manifest_rename:times=1"
+    )
+    with pytest.raises(InjectedFault, match="wal_write"):
+        plan.fire("wal_write")
+    plan.fire("wal_write")  # times=1 exhausted
+    plan.fire("wal_fsync")  # every=2: first call passes
+    with pytest.raises(InjectedFault, match="wal_fsync"):
+        plan.fire("wal_fsync")
+    with pytest.raises(InjectedFault, match="manifest_rename"):
+        plan.fire("manifest_rename")
+    assert plan.stats()["fired_total"] == 3

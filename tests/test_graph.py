@@ -294,3 +294,36 @@ def test_legacy_dense_matrix_rejects_self_loops(tmp_path):
 
     with pytest.raises(ValueError, match="self-loops"):
         write_dense_matrix(str(tmp_path / "l.bin"), 4, np.array([[1, 1]]))
+
+
+def test_write_graph_bin_is_atomic(tmp_path, monkeypatch):
+    """write_graph_bin lands via tmp file + os.replace: a crash (or any
+    failure) mid-write can never leave a torn .bin — readers see the
+    old complete file or the new complete file, nothing between. The
+    durable store's checkpoints are built on this property."""
+    from bibfs_tpu.graph.io import read_graph_bin, write_graph_bin
+
+    path = tmp_path / "g.bin"
+    old = np.array([[0, 1], [1, 2]])
+    write_graph_bin(path, 3, old)
+    assert [f.name for f in tmp_path.iterdir()] == ["g.bin"]
+
+    # a failure mid-write (the simulated crash) leaves the ORIGINAL
+    # intact and no tmp litter behind
+    real_replace = os.replace
+
+    def boom(src, dst):
+        raise OSError("disk gone")
+
+    monkeypatch.setattr(os, "replace", boom)
+    with pytest.raises(OSError, match="disk gone"):
+        write_graph_bin(path, 4, np.array([[0, 3]]))
+    monkeypatch.setattr(os, "replace", real_replace)
+    assert [f.name for f in tmp_path.iterdir()] == ["g.bin"]
+    n, edges = read_graph_bin(path)
+    assert n == 3 and edges.tolist() == old.tolist()
+
+    # a successful overwrite replaces wholesale
+    write_graph_bin(path, 4, np.array([[0, 3]]))
+    n, edges = read_graph_bin(path)
+    assert n == 4 and edges.tolist() == [[0, 3]]

@@ -173,3 +173,42 @@ def test_cli_sigterm_graceful_drain(tmp_path):
     ref = solve_serial(N, EDGES, 0, 50)
     assert f"0 -> 50: length = {ref.hops}" in out.splitlines()
     assert "SIGTERM" in err
+
+
+@pytest.mark.slow
+def test_fleet_cli_sigterm_graceful_drain(tmp_path):
+    """``bibfs-fleet`` SIGTERM parity with ``bibfs-serve``'s one-shot
+    handler: the router's replicas are demoted into their drain state,
+    everything queued resolves and PRINTS, and the process exits 0 —
+    with a second SIGTERM mid-drain ignored (a restart manager's
+    re-send must not abort the drain it asked for)."""
+    gpath = tmp_path / "g.bin"
+    write_graph_bin(gpath, N, EDGES)
+    proc = subprocess.Popen(
+        [sys.executable, "-u", "-m", "bibfs_tpu.fleet.cli",
+         str(gpath), "--replicas", "2", "--no-path"],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True,
+    )
+    try:
+        # readiness barrier: the health reply proves the REPL (and its
+        # SIGTERM handler) is installed before the signal fires
+        proc.stdin.write("health\n")
+        proc.stdin.flush()
+        ready = proc.stdout.readline()
+        assert ready.startswith("health "), ready
+        proc.stdin.write("0 50\n3 40\n")
+        proc.stdin.flush()
+        time.sleep(0.3)
+        proc.send_signal(signal.SIGTERM)
+        time.sleep(0.2)
+        proc.send_signal(signal.SIGTERM)  # ignored mid-drain
+        out, err = proc.communicate(timeout=120)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.communicate()
+    assert proc.returncode == 0, err[-2000:]
+    ref = solve_serial(N, EDGES, 0, 50)
+    assert f"0 -> 50: length = {ref.hops}" in out.splitlines()
+    assert "SIGTERM" in err

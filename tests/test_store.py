@@ -263,10 +263,12 @@ def test_store_compaction_rebases_racing_updates():
     building = threading.Event()
     proceed = threading.Event()
 
-    def stalled_snapshot():
-        # same steps as DeltaOverlay.snapshot, stalled in the race
-        # window between capturing the delta and finishing the build
-        adds, dels = overlay.capture()
+    def stalled_snapshot(adds=None, dels=None):
+        # same steps as DeltaOverlay.snapshot (which receives the sets
+        # the store captured under its lock), stalled in the race
+        # window between that capture and finishing the build
+        if adds is None or dels is None:
+            adds, dels = overlay.capture()
         building.set()
         assert proceed.wait(10)
         snap = GraphSnapshot.build(
@@ -314,8 +316,9 @@ def test_store_compaction_rebase_survives_cancelling_update():
     building = threading.Event()
     proceed = threading.Event()
 
-    def stalled_snapshot():
-        adds, dels = overlay.capture()
+    def stalled_snapshot(adds=None, dels=None):
+        if adds is None or dels is None:
+            adds, dels = overlay.capture()
         building.set()
         assert proceed.wait(10)
         snap = GraphSnapshot.build(
@@ -364,8 +367,9 @@ def test_store_compaction_aborts_when_external_swap_races():
     building = threading.Event()
     proceed = threading.Event()
 
-    def stalled_snapshot():
-        adds, dels = overlay.capture()
+    def stalled_snapshot(adds=None, dels=None):
+        if adds is None or dels is None:
+            adds, dels = overlay.capture()
         building.set()
         assert proceed.wait(10)
         snap = GraphSnapshot.build(

@@ -1,0 +1,373 @@
+"""Durable graph store: WAL-before-ack ordering, crash-consistent
+checkpoints, manifest+replay recovery, corrupt-file skip, fault seams,
+and the oracle rebuilding at the recovered generation
+(bibfs_tpu/store/registry + store/wal)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from bibfs_tpu.graph.csr import canonical_pairs
+from bibfs_tpu.graph.io import write_graph_bin
+from bibfs_tpu.obs.metrics import REGISTRY
+from bibfs_tpu.serve.faults import FaultPlan, InjectedFault
+from bibfs_tpu.store import GraphStore, GraphSnapshot, content_digest
+from bibfs_tpu.store.wal import DURABLE_METRIC_FAMILIES, read_wal
+
+
+def _chain(n):
+    return np.array([[i, i + 1] for i in range(n - 1)])
+
+
+N = 50
+EDGES = _chain(N)
+
+
+def _seed_dir(tmp_path, names=("g",)):
+    d = tmp_path / "store"
+    d.mkdir(exist_ok=True)
+    for name in names:
+        write_graph_bin(d / f"{name}.bin", N, EDGES)
+    return str(d)
+
+
+def _edge_digest(extra_adds=(), dels=()):
+    edges = {(int(u), int(v)) for u, v in EDGES}
+    edges |= {tuple(e) for e in extra_adds}
+    edges -= {tuple(e) for e in dels}
+    return content_digest(N, canonical_pairs(
+        N, np.array(sorted(edges), dtype=np.int64)
+    ))
+
+
+def test_update_recovery_roundtrip(tmp_path):
+    """Acked updates survive a process 'death' (reopen from disk): the
+    overlay is re-armed with exactly the acked batches, in order."""
+    d = _seed_dir(tmp_path)
+    st = GraphStore.from_dir(d, durable=True, fsync="always",
+                             compact_threshold=None)
+    st.update("g", adds=[(0, 49), (0, 25)])
+    st.update("g", dels=[(0, 25)])  # cancels the pending add
+    st.close()
+
+    st2 = GraphStore.from_dir(d, durable=True, compact_threshold=None)
+    rec = st2.stats()["graphs"]["g"]["durable"]["recovered"]
+    assert rec["replayed_records"] == 2
+    assert not rec["torn_tail_truncated"]
+    ov = st2.overlay("g")
+    assert ov.stats() == {"adds": 1, "dels": 0}
+    assert ov.solve(0, 49).hops == 1
+    st2.close()
+
+
+def test_wal_before_ack_a_faulted_append_refuses(tmp_path):
+    """The validate-log-commit ordering: a wal_write (or wal_fsync)
+    fault makes update() raise with NOTHING committed — no overlay
+    mutation, no WAL record, no ack."""
+    d = _seed_dir(tmp_path)
+    st = GraphStore.from_dir(
+        d, durable=True, fsync="always", compact_threshold=None,
+        faults=FaultPlan.parse("wal_write:times=1;wal_fsync:times=1"),
+    )
+    with pytest.raises(InjectedFault):
+        st.update("g", adds=[(0, 49)])
+    assert st.overlay("g") is None
+    # the fsync fault fires on the NEXT append (wal_write exhausted)
+    with pytest.raises(InjectedFault):
+        st.update("g", adds=[(0, 49)])
+    assert st.overlay("g") is None
+    seg = [f for f in os.listdir(d) if ".wal." in f]
+    records, _good, torn = read_wal(os.path.join(d, seg[0]))
+    # the fsync-faulted record was written before its fsync failed —
+    # and ROLLED BACK: a refused append leaves no bytes behind, so a
+    # retried batch can never replay as a duplicate
+    assert not torn and len(records) == 0
+    # with faults exhausted the same batch acks and commits
+    st.update("g", adds=[(0, 49)])
+    assert st.overlay("g").stats()["adds"] == 1
+    st.close()
+
+
+def test_rejected_batch_never_reaches_the_wal(tmp_path):
+    d = _seed_dir(tmp_path)
+    st = GraphStore.from_dir(d, durable=True, compact_threshold=None)
+    with pytest.raises(ValueError, match="already present"):
+        st.update("g", adds=[(0, 1)])  # a base edge
+    st.close()
+    seg = [f for f in os.listdir(d) if ".wal." in f]
+    records, _good, _torn = read_wal(os.path.join(d, seg[0]))
+    assert records == []
+
+
+def test_compaction_checkpoints_and_gc(tmp_path):
+    """A compaction commits snapshot .bin + manifest + segment switch,
+    deletes the superseded segment, and recovery needs no replay."""
+    d = _seed_dir(tmp_path)
+    st = GraphStore.from_dir(d, durable=True, compact_threshold=None)
+    st.update("g", adds=[(0, 49)])
+    snap = st.compact("g")
+    assert snap.version == 2
+    st.close()
+
+    files = sorted(os.listdir(d))
+    ckpt = f"g.v2.{snap.digest[:12]}.bin"  # content-unique filename
+    assert ckpt in files and "g.wal.2" in files
+    assert "g.wal.1" not in files  # superseded segment gc'd
+    assert "g.bin" in files        # the seed is always kept
+    manifest = json.load(open(os.path.join(d, "g.manifest.json")))
+    assert manifest["version"] == 2
+    assert manifest["bin"] == ckpt
+    assert manifest["wal_seq"] == 2
+    assert manifest["wal_offset"] == 0
+    assert manifest["digest"] == _edge_digest([(0, 49)])
+
+    st2 = GraphStore.from_dir(d, durable=True, compact_threshold=None)
+    g = st2.stats()["graphs"]["g"]
+    assert g["version"] == 2
+    assert g["durable"]["recovered"]["replayed_records"] == 0
+    assert g["digest"] == _edge_digest([(0, 49)])
+    st2.close()
+
+
+def test_update_after_checkpoint_replays_on_new_snapshot(tmp_path):
+    d = _seed_dir(tmp_path)
+    st = GraphStore.from_dir(d, durable=True, compact_threshold=None)
+    st.update("g", adds=[(0, 49)])
+    st.compact("g")
+    st.update("g", dels=[(0, 49)], adds=[(1, 30)])
+    st.close()
+
+    st2 = GraphStore.from_dir(d, durable=True, compact_threshold=None)
+    assert st2.stats()["graphs"]["g"]["durable"]["recovered"][
+        "replayed_records"] == 1
+    final = st2.compact("g")
+    assert final.digest == _edge_digest([(1, 30)])
+    st2.close()
+
+
+def test_swap_checkpoints_declared_truth(tmp_path):
+    d = _seed_dir(tmp_path)
+    st = GraphStore.from_dir(d, durable=True, compact_threshold=None)
+    st.update("g", adds=[(0, 49)])  # will be discarded by the swap
+    declared = GraphSnapshot.build(N, EDGES[:-1])
+    st.swap("g", declared)
+    st.close()
+
+    st2 = GraphStore.from_dir(d, durable=True, compact_threshold=None)
+    g = st2.stats()["graphs"]["g"]
+    assert g["version"] == declared.version
+    assert g["digest"] == declared.digest
+    assert st2.overlay("g") is None  # the discarded update stays gone
+    st2.close()
+
+
+def test_torn_tail_truncated_on_recovery(tmp_path):
+    d = _seed_dir(tmp_path)
+    st = GraphStore.from_dir(d, durable=True, fsync="always",
+                             compact_threshold=None)
+    st.update("g", adds=[(0, 49)])
+    st.close()
+    seg = next(f for f in os.listdir(d) if ".wal." in f)
+    with open(os.path.join(d, seg), "ab") as f:
+        f.write(b"\xff\x00\x00\x00\xde\xad")  # torn record
+    st2 = GraphStore.from_dir(d, durable=True, compact_threshold=None)
+    rec = st2.stats()["graphs"]["g"]["durable"]["recovered"]
+    assert rec["torn_tail_truncated"]
+    assert rec["replayed_records"] == 1
+    assert st2.overlay("g").solve(0, 49).hops == 1
+    # the truncation repaired the file: appends resume cleanly
+    st2.update("g", adds=[(1, 30)])
+    st2.close()
+    st3 = GraphStore.from_dir(d, durable=True, compact_threshold=None)
+    assert st3.stats()["graphs"]["g"]["durable"]["recovered"][
+        "replayed_records"] == 2
+    st3.close()
+
+
+def test_manifest_rename_fault_leaves_previous_checkpoint(tmp_path):
+    """A faulted manifest rename fails the checkpoint VISIBLY (the
+    compaction raises / is counted) while recovery still serves every
+    acked update from the previous manifest + intact WAL."""
+    d = _seed_dir(tmp_path)
+    st = GraphStore.from_dir(d, durable=True, compact_threshold=None)
+    # arm AFTER registration: the v1 manifest write shares the seam
+    st._faults = FaultPlan.parse("manifest_rename:times=1")
+    st.update("g", adds=[(0, 49)])
+    with pytest.raises(InjectedFault):
+        st.compact("g")
+    st.close()
+    st2 = GraphStore.from_dir(d, durable=True, compact_threshold=None)
+    g = st2.stats()["graphs"]["g"]
+    assert g["version"] == 1  # previous manifest governs
+    assert g["durable"]["recovered"]["replayed_records"] == 1
+    assert st2.overlay("g").solve(0, 49).hops == 1
+    st2.close()
+
+
+def test_from_dir_skips_corrupt_bin_with_visible_warning(
+    tmp_path, capsys
+):
+    """A corrupt/unreadable .bin skips THAT graph with a counted,
+    visible warning instead of aborting the whole registry load."""
+    d = _seed_dir(tmp_path, names=("good",))
+    with open(os.path.join(d, "bad.bin"), "wb") as f:
+        f.write(b"\x03\x00\x00\x00")  # truncated header
+    st = GraphStore.from_dir(d)
+    assert st.names() == ["good"]
+    assert len(st.load_errors) == 1
+    assert st.load_errors[0]["graph"] == "bad"
+    assert st.stats()["load_errors"] == st.load_errors
+    assert "skipping graph 'bad'" in capsys.readouterr().err
+    st.close()
+
+
+def test_from_dir_all_corrupt_raises(tmp_path):
+    d = tmp_path / "store"
+    d.mkdir()
+    (d / "bad.bin").write_bytes(b"\x00")
+    with pytest.raises(ValueError, match="no readable graph"):
+        GraphStore.from_dir(str(d))
+
+
+def test_recovery_digest_mismatch_skips_graph(tmp_path):
+    """A checkpoint .bin that does not hash to its manifest's digest is
+    corruption — the graph is skipped (visible), not served wrong."""
+    d = _seed_dir(tmp_path, names=("g", "ok"))
+    st = GraphStore.from_dir(d, durable=True, compact_threshold=None)
+    st.update("g", adds=[(0, 49)])
+    st.compact("g")
+    st.close()
+    ckpt = json.load(open(os.path.join(d, "g.manifest.json")))["bin"]
+    write_graph_bin(os.path.join(d, ckpt), N, EDGES[:-2])
+    st2 = GraphStore.from_dir(d, durable=True, compact_threshold=None)
+    assert st2.names() == ["ok"]
+    assert st2.load_errors and "digest" in st2.load_errors[0]["error"]
+    st2.close()
+
+
+def test_add_refuses_leftover_durable_state(tmp_path):
+    d = _seed_dir(tmp_path)
+    st = GraphStore.from_dir(d, durable=True, compact_threshold=None)
+    st.update("g", adds=[(0, 49)])
+    st.close()
+    st2 = GraphStore(wal_dir=d, compact_threshold=None)
+    with pytest.raises(ValueError, match="durable state"):
+        st2.add("g", N, EDGES)
+    st2.close()
+
+
+def test_programmatic_add_writes_seed_and_manifest(tmp_path):
+    d = tmp_path / "wal"
+    d.mkdir()
+    st = GraphStore(wal_dir=str(d), compact_threshold=None)
+    st.add("g", N, EDGES)
+    st.update("g", adds=[(0, 49)])
+    st.close()
+    assert sorted(os.listdir(d)) == [
+        "g.bin", "g.manifest.json", "g.wal.1"
+    ]
+    st2 = GraphStore.from_dir(str(d), durable=True,
+                              compact_threshold=None)
+    assert st2.overlay("g").solve(0, 49).hops == 1
+    st2.close()
+
+
+def test_recovery_triggers_threshold_compaction(tmp_path):
+    d = _seed_dir(tmp_path)
+    st = GraphStore.from_dir(d, durable=True, compact_threshold=None)
+    st.update("g", adds=[(0, i) for i in range(10, 16)])
+    st.close()
+    st2 = GraphStore.from_dir(d, durable=True, compact_threshold=4)
+    st2.close()  # joins the recovery-kicked compaction
+    assert st2.current("g").version == 2
+    assert st2.current("g").digest == _edge_digest(
+        [(0, i) for i in range(10, 16)]
+    )
+
+
+def test_oracle_rebuilds_at_recovered_gen(tmp_path):
+    """Recovery re-arms the overlay and the landmark index is rebuilt
+    for the RECOVERED generation — a recovered store's oracle answers
+    the recovered (post-update) graph, never the seed."""
+    d = _seed_dir(tmp_path)
+    st = GraphStore.from_dir(d, durable=True, compact_threshold=None)
+    st.update("g", adds=[(0, 49)])
+    st.close()
+    st2 = GraphStore.from_dir(d, durable=True, compact_threshold=None,
+                              oracle_k=4)
+    try:
+        # replayed records bumped graph_gen past registration: the
+        # index must carry the recovered gen to be served at all...
+        assert st2.wait_for_index("g", timeout=30.0)
+        orc = st2.oracle("g")
+        assert orc is not None
+        assert orc.index.gen == st2.stats()["graphs"]["g"]["oracle"]["gen"]
+        # ...and its distances sandwich the RECOVERED truth: the (0,49)
+        # shortcut makes the true distance 1 — an index built on the
+        # seed chain would put lb at 49 for a 0-endpoint landmark
+        out = orc.consult(0, 49)
+        assert out is not None and out.kind != "miss"
+        if out.result is not None:
+            assert out.result.hops == 1
+        else:
+            assert out.lb <= 1 and (out.ub is None or out.ub >= 1)
+    finally:
+        st2.close()
+
+
+def test_durable_metrics_render(tmp_path):
+    d = _seed_dir(tmp_path)
+    st = GraphStore.from_dir(d, durable=True, fsync="always",
+                             compact_threshold=None)
+    st.update("g", adds=[(0, 49)])
+    st.compact("g")
+    st.close()
+    st2 = GraphStore.from_dir(d, durable=True, compact_threshold=None)
+    render = REGISTRY.render()
+    for family in DURABLE_METRIC_FAMILIES:
+        assert family in render, family
+    st2.close()
+
+
+def test_fsync_policy_wiring(tmp_path, monkeypatch):
+    counts = {"n": 0}
+    real = os.fsync
+    monkeypatch.setattr(
+        os, "fsync", lambda fd: (counts.__setitem__("n", counts["n"] + 1),
+                                 real(fd))[1]
+    )
+    d = _seed_dir(tmp_path)
+    st = GraphStore.from_dir(d, durable=True, fsync="always",
+                             compact_threshold=None)
+    before = counts["n"]
+    st.update("g", adds=[(0, 49)])
+    assert counts["n"] > before  # the ack waited on an fsync
+    st.close()
+    with pytest.raises(ValueError, match="fsync policy"):
+        GraphStore(wal_dir=d, fsync="sometimes")
+
+
+def test_torn_nonfinal_segment_refuses_the_graph(tmp_path):
+    """A torn NON-final segment means acked records beyond it are
+    unrecoverable — recovery must REFUSE the graph (skip + warn, like a
+    digest mismatch), never serve the provable prefix while accepting
+    new acks onto a forked history."""
+    d = _seed_dir(tmp_path, names=("g", "ok"))
+    st = GraphStore.from_dir(d, durable=True, compact_threshold=None)
+    st._faults = FaultPlan.parse("manifest_rename:times=1")
+    st.update("g", adds=[(0, 49)])
+    with pytest.raises(InjectedFault):
+        st.compact("g")  # segment switched, checkpoint NOT committed
+    st.update("g", adds=[(1, 30)])  # lands in segment 2
+    st.close()
+    segs = sorted(f for f in os.listdir(d) if f.startswith("g.wal."))
+    assert segs == ["g.wal.1", "g.wal.2"]
+    with open(os.path.join(d, segs[0]), "r+b") as f:
+        f.truncate(os.path.getsize(os.path.join(d, segs[0])) - 3)
+    st2 = GraphStore.from_dir(d, durable=True, compact_threshold=None)
+    assert st2.names() == ["ok"]
+    assert st2.load_errors
+    assert "forked history" in st2.load_errors[0]["error"]
+    st2.close()

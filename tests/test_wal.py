@@ -1,0 +1,152 @@
+"""Write-ahead log unit tests: record round-trip, CRC/torn-tail
+truncation, fsync policies, segment helpers (bibfs_tpu/store/wal)."""
+
+import os
+import struct
+
+import pytest
+
+from bibfs_tpu.store.wal import (
+    FSYNC_POLICIES,
+    WalWriter,
+    list_segments,
+    read_wal,
+    repair_wal,
+    segment_path,
+)
+
+BATCHES = [
+    (1, [(0, 5), (2, 7)], []),
+    (1, [], [(0, 5)]),
+    (2, [(9, 4)], [(3, 8)]),
+    (2, [], []),
+]
+
+
+def _write(path, batches, **kw):
+    w = WalWriter(path, **kw)
+    for version, adds, dels in batches:
+        w.append(version, adds, dels)
+    w.close()
+    return w
+
+
+def test_roundtrip(tmp_path):
+    p = tmp_path / "g.wal.1"
+    _write(p, BATCHES)
+    records, good, torn = read_wal(p)
+    assert not torn
+    assert good == os.path.getsize(p)
+    assert [(v, [tuple(e) for e in a], [tuple(e) for e in d])
+            for v, a, d in records] == BATCHES
+
+
+def test_missing_file_reads_empty(tmp_path):
+    records, good, torn = read_wal(tmp_path / "nope.wal.1")
+    assert records == [] and good == 0 and not torn
+
+
+def test_bad_magic_is_torn_at_zero(tmp_path):
+    p = tmp_path / "g.wal.1"
+    p.write_bytes(b"NOTAWAL\x00\x01")
+    records, good, torn = read_wal(p)
+    assert records == [] and torn
+
+
+@pytest.mark.parametrize("cut", ["header", "payload"])
+def test_torn_tail_truncates_to_last_good(tmp_path, cut):
+    """A crash mid-append leaves a partial record: replay keeps every
+    complete record before it and repair_wal truncates the tail so
+    appends resume on a valid prefix."""
+    p = tmp_path / "g.wal.1"
+    _write(p, BATCHES)
+    whole = os.path.getsize(p)
+    with open(p, "ab") as f:
+        if cut == "header":
+            f.write(b"\x10")  # 1 byte of a would-be header
+        else:
+            # header promising 1000 payload bytes, then 4 actual
+            f.write(struct.pack("<II", 1000, 0) + b"\x00" * 4)
+    records, torn = repair_wal(p)
+    assert torn and len(records) == len(BATCHES)
+    assert os.path.getsize(p) == whole
+    # appends continue on the repaired prefix
+    w = WalWriter(p)
+    w.append(3, [(1, 2)], [])
+    w.close()
+    records, _good, torn = read_wal(p)
+    assert not torn and len(records) == len(BATCHES) + 1
+
+
+def test_bad_crc_truncates(tmp_path):
+    p = tmp_path / "g.wal.1"
+    _write(p, BATCHES)
+    # flip one byte in the LAST record's payload
+    size = os.path.getsize(p)
+    with open(p, "r+b") as f:
+        f.seek(size - 1)
+        b = f.read(1)
+        f.seek(size - 1)
+        f.write(bytes([b[0] ^ 0xFF]))
+    records, _good, torn = read_wal(p)
+    assert torn and len(records) == len(BATCHES) - 1
+
+
+def test_fsync_policies(tmp_path, monkeypatch):
+    calls = []
+    real_fsync = os.fsync
+    monkeypatch.setattr(
+        os, "fsync", lambda fd: (calls.append(fd), real_fsync(fd))[1]
+    )
+    # always: one fsync per append
+    calls.clear()
+    w = _write(tmp_path / "a.wal.1", BATCHES, fsync="always")
+    assert w.fsyncs == len(BATCHES) == len(calls)
+    # batch: group commit every batch_records, plus the close barrier
+    calls.clear()
+    w = _write(tmp_path / "b.wal.1", BATCHES, fsync="batch",
+               batch_records=3)
+    assert w.fsyncs == 2  # one at record 3, one at close
+    # off: no per-append fsync — only the close/checkpoint barrier
+    calls.clear()
+    w = _write(tmp_path / "c.wal.1", BATCHES, fsync="off")
+    assert w.fsyncs == 1 and len(calls) == 1
+    # sync() forces one regardless of policy
+    w = WalWriter(tmp_path / "d.wal.1", fsync="off")
+    w.append(1, [(0, 1)], [])
+    w.sync()
+    assert w.fsyncs == 1
+    w.close()
+
+
+def test_unknown_policy_rejected(tmp_path):
+    with pytest.raises(ValueError, match="fsync policy"):
+        WalWriter(tmp_path / "g.wal.1", fsync="sometimes")
+    assert "batch" in FSYNC_POLICIES
+
+
+def test_append_failure_raises_before_count(tmp_path):
+    """A failed append must raise (the store then refuses the ack) —
+    the wal_write fault seam."""
+    boom = RuntimeError("disk on fire")
+
+    def fire(site):
+        if site == "wal_write":
+            raise boom
+
+    w = WalWriter(tmp_path / "g.wal.1", fire=fire)
+    with pytest.raises(RuntimeError, match="disk on fire"):
+        w.append(1, [(0, 1)], [])
+    assert w.records == 0
+    records, _good, torn = read_wal(tmp_path / "g.wal.1")
+    assert records == [] and not torn
+    w.close()
+
+
+def test_segment_helpers(tmp_path):
+    for seq in (3, 1, 10):
+        _write(segment_path(tmp_path, "g", seq), BATCHES[:1])
+    (tmp_path / "g.wal.notanum").write_bytes(b"x")
+    (tmp_path / "other.wal.2").write_bytes(b"x")
+    segs = list_segments(tmp_path, "g")
+    assert [s for s, _ in segs] == [1, 3, 10]
