@@ -1062,15 +1062,18 @@ CHAOS_MIN_FRACTION = float(
 )
 CHAOS_RECOVERY_S = float(os.environ.get("BENCH_CHAOS_RECOVERY_S", 15.0))
 
-# the resilience metric families the README documents; the chaos gate
-# asserts a live run's /metrics-equivalent render really carries them
-CHAOS_REQUIRED_METRICS = (
-    "bibfs_errors_total",
-    "bibfs_route_fallbacks_total",
-    "bibfs_breaker_state",
-    "bibfs_health_state",
-    "bibfs_faults_injected_total",
+# the resilience metric families the README documents (the FULL group
+# from the canonical list, bibfs_tpu/obs/names.py — every family is
+# minted at engine construction, so all of them must render); the
+# chaos gate asserts a live run's /metrics-equivalent render really
+# carries them
+from bibfs_tpu.obs.names import (  # noqa: E402
+    ORACLE_METRIC_FAMILIES,
+    RESILIENCE_METRIC_FAMILIES,
+    STORE_METRIC_FAMILIES,
 )
+
+CHAOS_REQUIRED_METRICS = RESILIENCE_METRIC_FAMILIES
 
 
 def serve_chaos_main():
@@ -1160,14 +1163,10 @@ UPDATE_Q = int(os.environ.get("BENCH_UPDATE_Q", 150))
 UPDATE_EDGES = int(os.environ.get("BENCH_UPDATE_EDGES", 16))
 UPDATE_STALL_MS = float(os.environ.get("BENCH_UPDATE_STALL_MS", 2500.0))
 
-# the store metric families the README documents; the churn gate
-# asserts a live run's /metrics-equivalent render really carries them
-UPDATE_REQUIRED_METRICS = (
-    "bibfs_store_graphs",
-    "bibfs_store_swaps_total",
-    "bibfs_store_delta_edges",
-    "bibfs_store_compactions_total",
-)
+# the store metric families the README documents (the full canonical
+# group — obs/names.py); the churn gate asserts a live run's
+# /metrics-equivalent render really carries them
+UPDATE_REQUIRED_METRICS = STORE_METRIC_FAMILIES
 
 
 def serve_update_main():
@@ -1273,13 +1272,10 @@ ORACLE_SKEW = float(os.environ.get("BENCH_ORACLE_SKEW", 1.3))
 ORACLE_HIT_MIN = float(os.environ.get("BENCH_ORACLE_HIT_RATE", 0.30))
 ORACLE_SPEEDUP_MIN = float(os.environ.get("BENCH_ORACLE_SPEEDUP", 3.0))
 
-# the oracle metric families the README documents; the soak gate asserts
-# a live run's /metrics-equivalent render really carries them
-ORACLE_REQUIRED_METRICS = (
-    "bibfs_oracle_hits_total",
-    "bibfs_oracle_index_builds_total",
-    "bibfs_oracle_index_age_seconds",
-)
+# the oracle metric families the README documents (the full canonical
+# group — obs/names.py); the soak gate asserts a live run's
+# /metrics-equivalent render really carries them
+ORACLE_REQUIRED_METRICS = ORACLE_METRIC_FAMILIES
 
 
 def serve_oracle_main():

@@ -1,0 +1,30 @@
+"""Correctness tooling for the serving stack: ``bibfs-lint`` + the
+dynamic lock-order detector.
+
+PRs 4-8 turned the reproduction into a concurrent serving system whose
+hardest bugs were never solver math — they were lock-ordering,
+atomicity and ack-durability invariants that lived only in prose
+(CHANGES.md's "round-2/round-3 hardening" entries are the fossil
+record). This package turns those invariants into machine checks that
+fail CI when a future change regresses them silently:
+
+- :mod:`bibfs_tpu.analysis.lint` — static AST lints over the package
+  (rule framework + the rules in :mod:`bibfs_tpu.analysis.rules`):
+  atomic served-file writes, ``@guarded_by`` lock-discipline on shared
+  attributes, no blocking I/O under locks, the ``QueryError`` taxonomy,
+  the canonical metric-name list, no bare excepts. ``bibfs-lint`` is
+  the CLI; CI gates on zero unsuppressed findings.
+- :mod:`bibfs_tpu.analysis.lockgraph` — an opt-in
+  (``BIBFS_LOCK_CHECK=1``) instrumented wrapper for ``threading.Lock``
+  / ``RLock`` / ``Condition`` that records per-thread held-lock stacks,
+  builds the global lock-acquisition-order graph, fails fast on cycles
+  (both acquisition stacks printed), and flags blocking calls made
+  while holding an instrumented lock. Wired through
+  ``tests/conftest.py``, so the serving test suite doubles as the race
+  harness; ``bibfs-lint --lock-report`` renders the JSON artifact.
+
+:func:`guarded_by` is the runtime-inert class annotation the
+``guarded-by`` rule reads.
+"""
+
+from bibfs_tpu.analysis.annotations import guarded_by  # noqa: F401

@@ -1,0 +1,56 @@
+"""``@guarded_by`` — declare which lock protects which shared attributes.
+
+The serving stack's locking discipline was previously documented only
+in comments ("mutated under the engine lock or on the single finish
+worker"); this annotation makes it declarative and machine-checkable:
+
+    @guarded_by("_table_lock", "_states", "_versions")
+    class Router: ...
+
+reads "``self._states`` and ``self._versions`` may only be MUTATED
+inside a ``with self._table_lock:`` block". The ``guarded-by`` lint
+rule (:mod:`bibfs_tpu.analysis.rules.guarded_by`) enforces it
+statically, with two deliberate exemptions matching the codebase's
+conventions:
+
+- ``__init__``/``__new__`` — construction happens-before publication;
+- methods named ``*_locked`` — the existing callee-holds-the-lock
+  naming convention (``_write_manifest_locked``, ``_swap_locked``, ...).
+
+The first argument may be a tuple of names when several attributes
+alias ONE lock (the pipelined engine's ``_lock`` / ``_cv`` pair — the
+Condition wraps the same RLock). Lock-free READS remain legal (and are
+load-bearing on the hot paths: GIL-atomic snapshot reads are a
+documented idiom here); the rule checks mutations only.
+
+At runtime the decorator is inert beyond attaching metadata
+(``__bibfs_guarded_by__``: attr -> tuple of guard names, merged down
+the MRO) for introspection and tests.
+"""
+
+from __future__ import annotations
+
+
+def guarded_by(lock, *attrs):
+    """Class decorator: ``attrs`` are mutated only under ``self.<lock>``
+    (``lock`` may be a tuple of aliases for the same underlying lock).
+    Stackable — each application merges into the class metadata."""
+    guards = (lock,) if isinstance(lock, str) else tuple(lock)
+    if not guards or not all(isinstance(g, str) for g in guards):
+        raise TypeError("guarded_by needs a lock attribute name (or a "
+                        "tuple of alias names)")
+    if not attrs or not all(isinstance(a, str) for a in attrs):
+        raise TypeError("guarded_by needs at least one guarded "
+                        "attribute name")
+
+    def deco(cls):
+        merged = {}
+        for base in reversed(cls.__mro__[1:]):
+            merged.update(getattr(base, "__bibfs_guarded_by__", {}))
+        merged.update(cls.__dict__.get("__bibfs_guarded_by__", {}))
+        for a in attrs:
+            merged[a] = guards
+        cls.__bibfs_guarded_by__ = merged
+        return cls
+
+    return deco

@@ -1,0 +1,284 @@
+"""``bibfs-lint`` — static invariant lints for the serving stack.
+
+The framework half of :mod:`bibfs_tpu.analysis` (the rules live in
+:mod:`bibfs_tpu.analysis.rules`): parse every package source file once,
+run each registered rule over the project, apply per-line suppressions,
+and exit non-zero on any unsuppressed finding — the CI gate shape.
+
+**Suppressions.** A finding is silenced by a marker on its own line or
+on a standalone comment line directly above it::
+
+    self._f.write(rec)  # bibfs: allow(lock-io): WAL append IS the ack
+
+The justification after the colon is REQUIRED — a suppression without
+one is itself a finding (``suppression``), as is a suppression that no
+finding matched (the allow-list must not rot). Suppressions are for
+deliberate, documented trades; bugs get fixed.
+
+**Scope.** The default project is every ``*.py`` under ``bibfs_tpu/``
+plus the README cross-checks; rules narrow further where the invariant
+is local (``atomic-write`` covers the served-data modules ``store/`` +
+``graph/``). Tests and benches are out of scope — they may construct
+whatever bad states they like.
+
+CLI::
+
+    bibfs-lint [PATHS...]        # lint (default: the whole package)
+    bibfs-lint --list-rules      # one line per rule
+    bibfs-lint --json            # machine-readable findings
+    bibfs-lint --lock-report F   # render a lockgraph JSON artifact
+                                 # (exit 1 if it recorded cycles)
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import io
+import json
+import os
+import re
+import sys
+import tokenize
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*bibfs:\s*allow\(\s*([a-z0-9_\-, ]+?)\s*\)\s*(?::\s*(\S.*))?$"
+)
+
+
+class Finding:
+    """One lint finding, anchored to file:line."""
+
+    __slots__ = ("rule", "path", "line", "message")
+
+    def __init__(self, rule: str, path: str, line: int, message: str):
+        self.rule = rule
+        self.path = path
+        self.line = int(line)
+        self.message = message
+
+    def __repr__(self):
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+    def to_dict(self) -> dict:
+        return {"rule": self.rule, "path": self.path,
+                "line": self.line, "message": self.message}
+
+
+class _Suppression:
+    __slots__ = ("line", "rules", "justification", "used")
+
+    def __init__(self, line: int, rules, justification):
+        self.line = line
+        self.rules = frozenset(rules)
+        self.justification = justification
+        self.used = False
+
+
+class ParsedFile:
+    """One source file: AST + lines + its suppression markers."""
+
+    def __init__(self, path: str, rel: str, source: str):
+        self.path = path
+        self.rel = rel
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=rel)
+        # line -> suppression; a marker on a pure-comment line also
+        # covers the next line (long expressions keep their markers
+        # readable). Markers are read from COMMENT tokens only — a
+        # docstring that merely quotes the syntax is not a suppression.
+        self.suppressions: dict[int, _Suppression] = {}
+        try:
+            tokens = list(tokenize.generate_tokens(
+                io.StringIO(source).readline
+            ))
+        except tokenize.TokenError:
+            tokens = []
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _SUPPRESS_RE.search(tok.string)
+            if m is None:
+                continue
+            i = tok.start[0]
+            rules = [r.strip() for r in m.group(1).split(",") if r.strip()]
+            supp = _Suppression(i, rules, (m.group(2) or "").strip())
+            self.suppressions[i] = supp
+            if self.lines[i - 1].lstrip().startswith("#"):
+                self.suppressions.setdefault(i + 1, supp)
+
+
+class Project:
+    """The lint unit of work: a set of parsed files under one root.
+
+    ``complete=True`` (the default full-package scan) additionally
+    enables the whole-project cross-checks — "every canonical metric
+    name is minted somewhere", the README table reconciliation — that
+    make no sense over a test fixture's file or two."""
+
+    def __init__(self, root: str, files, *, complete: bool):
+        self.root = os.path.abspath(root)
+        self.files: list[ParsedFile] = list(files)
+        self.complete = complete
+        self.errors: list[Finding] = []
+
+    @classmethod
+    def load(cls, root: str, paths=None) -> "Project":
+        root = os.path.abspath(root)
+        complete = paths is None
+        if paths is None:
+            paths = []
+            pkg = os.path.join(root, "bibfs_tpu")
+            for dirpath, dirnames, filenames in os.walk(pkg):
+                dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        paths.append(os.path.join(dirpath, fn))
+        files, errors = [], []
+        for p in sorted(paths):
+            rel = os.path.relpath(p, root)
+            try:
+                with open(p, encoding="utf-8") as f:
+                    src = f.read()
+                files.append(ParsedFile(p, rel, src))
+            except (OSError, SyntaxError) as e:
+                errors.append(Finding(
+                    "parse", rel, getattr(e, "lineno", 0) or 0,
+                    f"unparseable: {type(e).__name__}: {e}",
+                ))
+        proj = cls(root, files, complete=complete)
+        proj.errors = errors
+        return proj
+
+    def readme(self) -> str | None:
+        path = os.path.join(self.root, "README.md")
+        try:
+            with open(path, encoding="utf-8") as f:
+                return f.read()
+        except OSError:
+            return None
+
+
+def run(project: Project):
+    """Run every registered rule; returns
+    ``(findings, suppressed, suppression_findings)`` where ``findings``
+    is the unsuppressed list the gate fails on."""
+    from bibfs_tpu.analysis.rules import RULES
+
+    raw: list[Finding] = list(project.errors)
+    for rule in RULES:
+        raw.extend(rule.check(project))
+    by_rel = {f.rel: f for f in project.files}
+    open_findings: list[Finding] = []
+    suppressed: list[Finding] = []
+    for finding in raw:
+        pf = by_rel.get(finding.path)
+        supp = None
+        if pf is not None:
+            supp = pf.suppressions.get(finding.line)
+            if supp is not None and finding.rule not in supp.rules:
+                supp = None
+        if supp is None:
+            open_findings.append(finding)
+        else:
+            supp.used = True
+            suppressed.append(finding)
+    # the suppression ledger must stay honest: every marker needs a
+    # justification, and must actually silence something
+    for pf in project.files:
+        seen = set()
+        for supp in pf.suppressions.values():
+            if id(supp) in seen:
+                continue
+            seen.add(id(supp))
+            if not supp.justification:
+                open_findings.append(Finding(
+                    "suppression", pf.rel, supp.line,
+                    "suppression without a justification — write "
+                    "`# bibfs: allow(<rule>): <why this trade is "
+                    "deliberate>`",
+                ))
+            if not supp.used:
+                open_findings.append(Finding(
+                    "suppression", pf.rel, supp.line,
+                    f"unused suppression for "
+                    f"{', '.join(sorted(supp.rules))} — nothing fires "
+                    "here; remove the stale marker",
+                ))
+    open_findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    suppressed.sort(key=lambda f: (f.path, f.line, f.rule))
+    return open_findings, suppressed
+
+
+def _repo_root() -> str:
+    """The repository root: the directory holding ``bibfs_tpu/``."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    return os.path.dirname(os.path.dirname(here))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="bibfs-lint",
+        description="static invariant lints for the bibfs serving "
+                    "stack (+ lock-order report renderer)",
+    )
+    ap.add_argument("paths", nargs="*", help="files to lint (default: "
+                    "every bibfs_tpu/ source + project cross-checks)")
+    ap.add_argument("--root", default=None,
+                    help="project root (default: this checkout)")
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--json", action="store_true",
+                    help="emit findings as JSON")
+    ap.add_argument("--show-suppressed", action="store_true",
+                    help="also print findings silenced by allow markers")
+    ap.add_argument("--lock-report", metavar="JSON", default=None,
+                    help="render a lock-graph artifact recorded under "
+                    "BIBFS_LOCK_CHECK=1 instead of linting")
+    args = ap.parse_args(argv)
+
+    if args.lock_report is not None:
+        from bibfs_tpu.analysis.lockgraph import render_report_file
+
+        text, ok = render_report_file(args.lock_report)
+        try:
+            print(text)
+        except BrokenPipeError:
+            # `bibfs-lint --lock-report f | head` closing the pipe is
+            # not an error; the verdict is what matters
+            sys.stderr.close()
+        return 0 if ok else 1
+
+    from bibfs_tpu.analysis.rules import RULES
+
+    if args.list_rules:
+        for rule in RULES:
+            print(f"{rule.name:16s} {rule.summary}")
+        return 0
+
+    root = args.root or _repo_root()
+    project = Project.load(root, args.paths or None)
+    findings, suppressed = run(project)
+    if args.json:
+        print(json.dumps({
+            "findings": [f.to_dict() for f in findings],
+            "suppressed": [f.to_dict() for f in suppressed],
+        }, indent=1))
+    else:
+        for f in findings:
+            print(f"{f.path}:{f.line}: [{f.rule}] {f.message}")
+        if args.show_suppressed:
+            for f in suppressed:
+                print(f"{f.path}:{f.line}: [{f.rule}] (suppressed) "
+                      f"{f.message}")
+        print(
+            f"bibfs-lint: {len(findings)} finding(s), "
+            f"{len(suppressed)} suppressed, "
+            f"{len(project.files)} files",
+            file=sys.stderr,
+        )
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,532 @@
+"""Dynamic lock-order race detector (``BIBFS_LOCK_CHECK=1``).
+
+The static lints prove lexical discipline; what they cannot prove is
+the GLOBAL acquisition order across 15 modules' worth of locks — the
+property whose violations surfaced as review-round deadlock arguments
+in PRs 5-8 (store lock vs WAL writer, replica lock vs reader thread,
+engine condvar vs runtime lock). This module proves it dynamically, on
+the real test suite:
+
+- :func:`install` monkeypatches ``threading.Lock`` / ``RLock`` /
+  ``Condition`` so that every lock **created from bibfs_tpu source**
+  (the creation site decides — third-party and interpreter-internal
+  locks stay raw and untaxed) is wrapped in an instrumented primitive
+  that records, per thread, the stack of currently held locks.
+- Every acquisition while other instrumented locks are held records a
+  directed edge ``held -> acquiring`` (first-observation acquisition
+  stack kept per edge) in one process-global graph. A **new edge that
+  closes a cycle raises** :class:`LockOrderError` *before* the inner
+  acquire — fail-fast with both acquisition stacks printed, and no
+  half-taken lock leaked — and the cycle is also recorded in the
+  report, so a cycle raised inside a swallow-and-count background
+  thread (a compaction job) still fails the session gate.
+- Blocking primitives (``os.fsync``, ``time.sleep``,
+  ``subprocess.Popen``) are wrapped to record a **blocking-under-lock
+  event** whenever called with instrumented locks held — the dynamic
+  counterpart of the ``lock-io`` lint, catching what lexical analysis
+  cannot see through call indirection.
+
+Wiring: ``tests/conftest.py`` installs this when ``BIBFS_LOCK_CHECK=1``
+*before* the serving modules import, so the whole serving suite doubles
+as the race harness, and writes the JSON report
+(``BIBFS_LOCK_REPORT``, default ``lockgraph.json``) at session end —
+failing the session if any cycle was recorded. ``bibfs-lint
+--lock-report FILE`` renders the artifact for humans.
+
+Condition support: an instrumented RLock implements the
+``_release_save`` / ``_acquire_restore`` / ``_is_owned`` protocol, so
+``threading.Condition(instrumented_rlock)`` waits release (and their
+re-acquisition re-records order edges) exactly like the raw primitive.
+RLock re-entry by the owning thread records nothing — only the first
+acquisition orders.
+
+Soundness note: edges are recorded for every acquisition *attempt*
+(including non-blocking ``acquire(False)``), which over-approximates —
+a try-lock protocol that tolerates inversion by design would need its
+edge suppressed here. The codebase has none; prefer keeping it that
+way.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import traceback
+import _thread
+
+_REPO_MARKER = os.sep + "bibfs_tpu" + os.sep
+
+# originals captured once, before any patching
+_ORIG_LOCK = threading.Lock
+_ORIG_RLOCK = threading.RLock
+_ORIG_CONDITION = threading.Condition
+_ORIG_FSYNC = os.fsync
+_ORIG_SLEEP = None  # captured at install (time may be patched by tests)
+_ORIG_POPEN = None
+
+_STATE: "LockGraph | None" = None
+_STACK_LIMIT = 18
+
+
+class LockOrderError(RuntimeError):
+    """A lock acquisition would close a cycle in the global
+    acquisition-order graph — a latent deadlock."""
+
+
+def _site(depth: int = 2) -> str:
+    """``file.py:line`` of the instrumenting caller, repo-relative."""
+    try:
+        frame = sys._getframe(depth)
+    except ValueError:
+        return "?"
+    fn = frame.f_code.co_filename
+    i = fn.rfind(_REPO_MARKER)
+    if i >= 0:
+        fn = fn[i + 1:]
+    return f"{fn}:{frame.f_lineno}"
+
+
+def _in_scope(depth: int = 2) -> bool:
+    return _REPO_MARKER in sys._getframe(depth).f_code.co_filename
+
+
+def _stack() -> list:
+    """The current acquisition stack, repo-trimmed and bounded."""
+    out = []
+    for fr in traceback.extract_stack(limit=_STACK_LIMIT + 6)[:-3]:
+        fn = fr.filename
+        i = fn.rfind(_REPO_MARKER)
+        if i >= 0:
+            fn = fn[i + 1:]
+        out.append(f"{fn}:{fr.lineno} in {fr.name}")
+    return out[-_STACK_LIMIT:]
+
+
+class LockGraph:
+    """The process-global acquisition-order graph (module docstring)."""
+
+    def __init__(self):
+        # raw primitives only: the detector must never recurse into
+        # itself, and its mutex must never join the graph it guards
+        self._mu = _thread.allocate_lock()
+        self._tls = threading.local()
+        self._seq = 0
+        self._locks: dict[int, dict] = {}      # gid -> {site, kind}
+        self._edges: dict[tuple, dict] = {}    # (a,b) -> edge record
+        self._adj: dict[int, set] = {}         # a -> {b}
+        self._cycles: list[dict] = []
+        self._blocking: dict[tuple, dict] = {}  # dedup key -> event
+
+    # ---- bookkeeping --------------------------------------------------
+    def _register(self, kind: str, site: str) -> int:
+        with self._mu:
+            self._seq += 1
+            gid = self._seq
+            self._locks[gid] = {"id": gid, "kind": kind, "site": site,
+                                "acquisitions": 0}
+            return gid
+
+    def _held(self) -> list:
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = self._tls.stack = []
+        return stack
+
+    def _path(self, src: int, dst: int):
+        """Edge path src -> ... -> dst in the current graph, or None."""
+        stack = [(src, ())]
+        seen = {src}
+        while stack:
+            node, path = stack.pop()
+            if node == dst:
+                return path
+            for nxt in self._adj.get(node, ()):
+                if nxt not in seen:
+                    seen.add(nxt)
+                    stack.append((nxt, path + ((node, nxt),)))
+        return None
+
+    def note_acquire(self, lock) -> None:
+        """Record order edges for one impending acquisition; raises
+        :class:`LockOrderError` (before the caller blocks on the inner
+        primitive) when a new edge closes a cycle."""
+        held = self._held()
+        self._locks[lock._gid]["acquisitions"] += 1
+        if not held:
+            return
+        for holder in held:
+            if holder is lock:
+                # a re-probe of an already-held lock is not an order
+                # edge: Condition's stdlib _is_owned fallback probes
+                # acquire(False) on the very lock the thread holds, and
+                # a (gid, gid) self-edge would read as a cycle
+                continue
+            key = (holder._gid, lock._gid)
+            edge = self._edges.get(key)
+            if edge is not None:
+                edge["count"] += 1
+                continue
+            with self._mu:
+                if key in self._edges:
+                    self._edges[key]["count"] += 1
+                    continue
+                back = self._path(lock._gid, holder._gid)
+                self._edges[key] = {
+                    "from": holder._gid,
+                    "to": lock._gid,
+                    "count": 1,
+                    "thread": threading.current_thread().name,
+                    "stack": _stack(),
+                }
+                self._adj.setdefault(holder._gid, set()).add(lock._gid)
+                if back is None:
+                    continue
+                cycle_edges = [self._edge_info(a, b) for a, b in back]
+                cycle_edges.append(self._edge_info(*key))
+                record = {
+                    "closing_edge": self._edge_info(*key),
+                    "cycle": cycle_edges,
+                }
+                self._cycles.append(record)
+            raise LockOrderError(self._format_cycle(record))
+
+    def _edge_info(self, a: int, b: int) -> dict:
+        e = self._edges[(a, b)]
+        return {
+            "from": self._locks[a]["site"],
+            "to": self._locks[b]["site"],
+            "count": e["count"],
+            "thread": e["thread"],
+            "stack": e["stack"],
+        }
+
+    def _format_cycle(self, record: dict) -> str:
+        lines = ["lock-order cycle detected (latent deadlock):"]
+        for e in record["cycle"]:
+            lines.append(f"  {e['from']}  ->  {e['to']}   "
+                         f"[thread {e['thread']}, seen x{e['count']}]")
+            for fr in e["stack"]:
+                lines.append(f"      {fr}")
+        lines.append("every lock pair must be acquired in one global "
+                     "order; one of the stacks above must move")
+        return "\n".join(lines)
+
+    def push_held(self, lock) -> None:
+        self._held().append(lock)
+
+    def pop_held(self, lock) -> None:
+        held = self._held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] is lock:
+                del held[i]
+                return
+
+    def note_blocking(self, label: str) -> None:
+        held = getattr(self._tls, "stack", None)
+        if not held:
+            return
+        # attribute to the innermost repo frame (the wrapped primitive
+        # may be reached through stdlib indirection, e.g. Popen via
+        # subprocess.run)
+        site = _site(3)
+        try:
+            frame = sys._getframe(3)
+        except ValueError:
+            frame = None
+        while frame is not None:
+            fn = frame.f_code.co_filename
+            if _REPO_MARKER in fn:
+                i = fn.rfind(_REPO_MARKER)
+                site = f"{fn[i + 1:]}:{frame.f_lineno}"
+                break
+            frame = frame.f_back
+        locks = tuple(sorted({h.site for h in held}))
+        key = (label, site, locks)
+        with self._mu:
+            ev = self._blocking.get(key)
+            if ev is not None:
+                ev["count"] += 1
+                return
+            self._blocking[key] = {
+                "call": label,
+                "site": site,
+                "held": list(locks),
+                "count": 1,
+                "thread": threading.current_thread().name,
+                "stack": _stack(),
+            }
+
+    # ---- reporting ----------------------------------------------------
+    def cycles(self) -> list:
+        with self._mu:
+            return list(self._cycles)
+
+    def report(self) -> dict:
+        """The JSON artifact, aggregated by creation SITE: the graph is
+        tracked per lock instance (cycle precision — two engines' locks
+        must not alias), but per-site aggregation is what a human (and
+        a stable committed artifact) wants: one row per lock-creation
+        site, one row per ordered site pair."""
+        with self._mu:
+            locks: dict[str, dict] = {}
+            for info in self._locks.values():
+                row = locks.setdefault(info["site"], {
+                    "site": info["site"], "kind": info["kind"],
+                    "instances": 0, "acquisitions": 0,
+                })
+                row["instances"] += 1
+                row["acquisitions"] += info["acquisitions"]
+            edges: dict[tuple, dict] = {}
+            for (a, b), e in self._edges.items():
+                key = (self._locks[a]["site"], self._locks[b]["site"])
+                row = edges.get(key)
+                if row is None:
+                    edges[key] = {
+                        "from": key[0], "to": key[1],
+                        "count": e["count"],
+                        "thread": e["thread"],
+                        "stack": e["stack"],
+                    }
+                else:
+                    row["count"] += e["count"]
+            blocking = sorted(self._blocking.values(),
+                              key=lambda e: (e["call"], e["site"]))
+            return {
+                "schema": "bibfs-lockgraph-v1",
+                "locks": sorted(locks.values(), key=lambda r: r["site"]),
+                "edges": sorted(edges.values(),
+                                key=lambda r: (r["from"], r["to"])),
+                "cycles": list(self._cycles),
+                "blocking_under_lock": blocking,
+            }
+
+
+class _Instrumented:
+    """Shared plumbing for the wrapped primitives."""
+
+    def __init__(self, inner, graph: LockGraph, kind: str, site: str):
+        self._inner = inner
+        self._graph = graph
+        self.site = site
+        self._gid = graph._register(kind, site)
+
+    def locked(self):
+        return self._inner.locked()
+
+    def __repr__(self):
+        return f"<{type(self).__name__} {self.site}>"
+
+
+class InstrumentedLock(_Instrumented):
+    def __init__(self, graph, site):
+        super().__init__(_ORIG_LOCK(), graph, "Lock", site)
+
+    def acquire(self, blocking=True, timeout=-1):
+        self._graph.note_acquire(self)
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            self._graph.push_held(self)
+        return got
+
+    def release(self):
+        self._graph.pop_held(self)
+        self._inner.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+
+class InstrumentedRLock(_Instrumented):
+    def __init__(self, graph, site):
+        super().__init__(_ORIG_RLOCK(), graph, "RLock", site)
+        self._owner = None
+        self._depth = 0
+
+    def acquire(self, blocking=True, timeout=-1):
+        me = _thread.get_ident()
+        if self._owner == me:
+            self._inner.acquire(blocking, timeout)
+            self._depth += 1
+            return True
+        self._graph.note_acquire(self)
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            self._owner = me
+            self._depth = 1
+            self._graph.push_held(self)
+        return got
+
+    def release(self):
+        if self._owner != _thread.get_ident():
+            raise RuntimeError("cannot release un-acquired lock")
+        self._depth -= 1
+        if self._depth == 0:
+            self._owner = None
+            self._graph.pop_held(self)
+        self._inner.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    # Condition protocol — threading.Condition lifts these from a
+    # custom lock so cv.wait() fully releases and restores re-entrant
+    # holds; the bookkeeping must mirror the real release/acquire
+    def _release_save(self):
+        depth, self._depth = self._depth, 0
+        self._owner = None
+        self._graph.pop_held(self)
+        state = self._inner._release_save()
+        return (state, depth)
+
+    def _acquire_restore(self, saved):
+        state, depth = saved
+        self._graph.note_acquire(self)
+        self._inner._acquire_restore(state)
+        self._owner = _thread.get_ident()
+        self._depth = depth
+        self._graph.push_held(self)
+
+    def _is_owned(self):
+        return self._inner._is_owned()
+
+    def locked(self):
+        return self._inner._is_owned() or self._owner is not None
+
+
+# ---- installation -----------------------------------------------------
+def _patched_lock():
+    if _STATE is not None and _in_scope():
+        return InstrumentedLock(_STATE, _site())
+    return _ORIG_LOCK()
+
+
+def _patched_rlock():
+    if _STATE is not None and _in_scope():
+        return InstrumentedRLock(_STATE, _site())
+    return _ORIG_RLOCK()
+
+
+def _patched_condition(lock=None):
+    if lock is None and _STATE is not None and _in_scope():
+        lock = InstrumentedRLock(_STATE, _site())
+    return _ORIG_CONDITION(lock)
+
+
+def _wrap_blocking(label, orig):
+    def wrapped(*args, **kwargs):
+        state = _STATE
+        if state is not None:
+            state.note_blocking(label)
+        return orig(*args, **kwargs)
+
+    wrapped.__name__ = getattr(orig, "__name__", label)
+    return wrapped
+
+
+def install() -> LockGraph:
+    """Activate the detector process-wide (idempotent). Must run before
+    the modules under test construct their locks — conftest wires it at
+    import time, ahead of any serving import."""
+    global _STATE, _ORIG_SLEEP, _ORIG_POPEN
+    if _STATE is not None:
+        return _STATE
+    _STATE = LockGraph()
+    threading.Lock = _patched_lock
+    threading.RLock = _patched_rlock
+    threading.Condition = _patched_condition
+    import subprocess
+    import time
+
+    _ORIG_SLEEP = time.sleep
+    _ORIG_POPEN = subprocess.Popen
+    os.fsync = _wrap_blocking("os.fsync", _ORIG_FSYNC)
+    time.sleep = _wrap_blocking("time.sleep", _ORIG_SLEEP)
+    subprocess.Popen = _wrap_blocking("subprocess.Popen", _ORIG_POPEN)
+    return _STATE
+
+
+def enabled() -> bool:
+    return _STATE is not None
+
+
+def graph() -> LockGraph | None:
+    return _STATE
+
+
+def cycles() -> list:
+    return [] if _STATE is None else _STATE.cycles()
+
+
+def save_report(path: str) -> dict:
+    """Write the JSON artifact (the committed ``lockgraph.json`` shape)
+    and return the report dict. Safe to call with the detector off
+    (writes an empty report)."""
+    rep = (
+        _STATE.report() if _STATE is not None
+        else {"schema": "bibfs-lockgraph-v1", "locks": [], "edges": [],
+              "cycles": [], "blocking_under_lock": []}
+    )
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(rep, f, indent=1, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, path)
+    return rep
+
+
+# ---- renderer (bibfs-lint --lock-report) ------------------------------
+def render_report(rep: dict) -> tuple[str, bool]:
+    """Human-readable rendering of a report dict; ``ok`` is False when
+    the run recorded lock-order cycles."""
+    lines = []
+    locks = rep.get("locks", [])
+    edges = rep.get("edges", [])
+    cyc = rep.get("cycles", [])
+    blocking = rep.get("blocking_under_lock", [])
+    lines.append(
+        f"lock graph: {len(locks)} instrumented locks, "
+        f"{len(edges)} order edges, {len(cyc)} cycles, "
+        f"{len(blocking)} blocking-under-lock sites"
+    )
+    lines.append("")
+    lines.append("acquisition order (held -> acquired):")
+    for e in edges:
+        lines.append(f"  {e['from']}  ->  {e['to']}   x{e['count']}"
+                     f"   [{e['thread']}]")
+    if blocking:
+        lines.append("")
+        lines.append("blocking calls under a held lock "
+                     "(deliberate trades show up here too — compare "
+                     "against the lock-io allowlist):")
+        for ev in blocking:
+            held = ", ".join(ev["held"])
+            lines.append(f"  {ev['call']} at {ev['site']}   "
+                         f"x{ev['count']}   holding [{held}]")
+    if cyc:
+        lines.append("")
+        lines.append("CYCLES (latent deadlocks — the build gate fails):")
+        for rec in cyc:
+            for e in rec["cycle"]:
+                lines.append(f"  {e['from']}  ->  {e['to']}")
+                for fr in e["stack"]:
+                    lines.append(f"      {fr}")
+            lines.append("  ----")
+    return "\n".join(lines), not cyc
+
+
+def render_report_file(path: str) -> tuple[str, bool]:
+    with open(path) as f:
+        rep = json.load(f)
+    return render_report(rep)

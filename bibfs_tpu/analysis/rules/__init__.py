@@ -1,0 +1,26 @@
+"""The ``bibfs-lint`` rule registry.
+
+Each rule module exports ``RULE`` (a
+:class:`bibfs_tpu.analysis.rules.common.Rule`); registration order is
+display order. Adding a rule: write the module, import it here, add a
+good/bad fixture pair to ``tests/test_lint.py`` (every rule must both
+fire and stay quiet) and a row to the README "Static analysis" table.
+"""
+
+from bibfs_tpu.analysis.rules import (
+    atomic_write,
+    bare_except,
+    error_kind,
+    guarded_by,
+    lock_io,
+    metric_mint,
+)
+
+RULES = (
+    atomic_write.RULE,
+    guarded_by.RULE,
+    lock_io.RULE,
+    error_kind.RULE,
+    metric_mint.RULE,
+    bare_except.RULE,
+)

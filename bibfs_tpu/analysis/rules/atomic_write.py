@@ -1,0 +1,103 @@
+"""``atomic-write`` — served data files commit by tmp + ``os.replace``.
+
+The durability layer's whole recovery argument rests on one property:
+a reader (or a recovering process) sees either the old complete file or
+the new complete file, never a torn middle. ``graph/io.write_graph_bin``
+and ``store/registry._write_manifest_locked`` earn that with the
+same-directory-tmp + ``os.replace`` idiom; a future helper that opens a
+served ``.bin``/manifest path for writing directly would silently void
+it — exactly the class of regression a reviewer won't spot in a +500
+line PR.
+
+The rule: in the served-data modules (``bibfs_tpu/store/``,
+``bibfs_tpu/graph/``), any ``open(...)`` with a write-creating mode
+(``"w"``, ``"wb"``, ``"w+"``, ...) must sit in a function that also
+calls ``os.replace`` (the tmp+rename idiom — the open is then the tmp
+side). Append (``"ab"`` — the WAL's own format is append-only with CRC
+framing) and in-place repair (``"r+b"`` — ``repair_wal``'s tail
+truncation) modes are legal.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from bibfs_tpu.analysis.lint import Finding
+from bibfs_tpu.analysis.rules.common import Rule, attr_chain
+
+_SCOPES = ("bibfs_tpu/store/", "bibfs_tpu/graph/")
+
+
+def _write_mode(call: ast.Call) -> str | None:
+    """The mode string when this ``open`` creates/truncates a file."""
+    mode = None
+    if len(call.args) >= 2:
+        mode = call.args[1]
+    for kw in call.keywords:
+        if kw.arg == "mode":
+            mode = kw.value
+    if mode is None:
+        return None  # default "r"
+    if isinstance(mode, ast.Constant) and isinstance(mode.value, str):
+        return mode.value if "w" in mode.value or "x" in mode.value else None
+    return "<dynamic>"  # a computed mode can't be proven read-only
+
+
+def _own_nodes(func):
+    """Every AST node lexically owned by ``func``, EXCLUDING nested
+    function/lambda bodies — those are analyzed as their own units (an
+    ``os.replace`` inside a nested helper must not legalize the
+    enclosing function's direct write, and a nested function's open
+    belongs to the nested function)."""
+
+    def walk(node):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                continue
+            yield child
+            yield from walk(child)
+
+    yield from walk(func)
+
+
+def _check(project):
+    findings = []
+    for pf in project.files:
+        if not any(s in pf.rel.replace("\\", "/") for s in _SCOPES):
+            continue
+        # each function (nested ones included) is its own unit: the
+        # open and the os.replace must live in the SAME function
+        for func in [n for n in ast.walk(pf.tree)
+                     if isinstance(n, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef))]:
+            opens = []
+            replaces = False
+            for node in _own_nodes(func):
+                if not isinstance(node, ast.Call):
+                    continue
+                chain = attr_chain(node.func)
+                if chain[-2:] == ("os", "replace"):
+                    replaces = True
+                elif chain == ("open",):
+                    mode = _write_mode(node)
+                    if mode is not None:
+                        opens.append((node, mode))
+            if replaces:
+                continue  # the tmp side of the tmp+replace idiom
+            for node, mode in opens:
+                findings.append(Finding(
+                    "atomic-write", pf.rel, node.lineno,
+                    f"{func.name} opens a served-data path with mode "
+                    f"{mode!r} and never os.replace()s — write to a "
+                    "same-directory tmp file and commit by rename "
+                    "(graph/io.write_graph_bin is the idiom)",
+                ))
+    return findings
+
+
+RULE = Rule(
+    "atomic-write",
+    "served .bin/manifest writes commit via tmp + os.replace",
+    _check,
+)

@@ -1,0 +1,39 @@
+"""``no-bare-except`` — failure handling names what it catches.
+
+The breaker/flush/drain seams are exactly where a bare ``except:`` does
+the most damage: it swallows ``KeyboardInterrupt`` and ``SystemExit``,
+which is how a Ctrl-C mid-probe leaks a half-open breaker claim or a
+drain loop becomes unkillable — both bugs this codebase has already
+fixed once (CHANGES.md PR 4 review hardening) and must not re-grow.
+``except Exception:`` (and deliberate ``except BaseException:`` with a
+re-raise) remain legal; it is the anonymous catch-everything that is
+banned.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from bibfs_tpu.analysis.lint import Finding
+from bibfs_tpu.analysis.rules.common import Rule
+
+
+def _check(project):
+    findings = []
+    for pf in project.files:
+        for node in ast.walk(pf.tree):
+            if isinstance(node, ast.ExceptHandler) and node.type is None:
+                findings.append(Finding(
+                    "no-bare-except", pf.rel, node.lineno,
+                    "bare `except:` swallows KeyboardInterrupt/"
+                    "SystemExit — catch Exception (or BaseException "
+                    "with a re-raise) and name the intent",
+                ))
+    return findings
+
+
+RULE = Rule(
+    "no-bare-except",
+    "no bare `except:` at failure-handling seams",
+    _check,
+)

@@ -1,0 +1,181 @@
+"""Shared machinery for lint rules: the Rule type plus AST helpers for
+attribute chains, class/method iteration, ``@guarded_by`` declarations,
+and lexical with-lock region tracking."""
+
+from __future__ import annotations
+
+import ast
+import re
+
+
+class Rule:
+    """One registered lint rule: a name, a one-line summary, and a
+    ``check(project) -> list[Finding]`` callable."""
+
+    def __init__(self, name: str, summary: str, check):
+        self.name = name
+        self.summary = summary
+        self._check = check
+
+    def check(self, project):
+        return self._check(project)
+
+
+def attr_chain(node) -> tuple:
+    """The dotted-name chain of a Name/Attribute expression:
+    ``self._proc.stdin.write`` -> ``("self", "_proc", "stdin",
+    "write")``. A non-name base (a call result, a subscript) appears as
+    ``"?"`` so suffix matches still work."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    else:
+        parts.append("?")
+    return tuple(reversed(parts))
+
+
+def iter_classes(tree):
+    """Top-level and nested ClassDefs with their qualnames."""
+    def walk(nodes, prefix):
+        for node in nodes:
+            if isinstance(node, ast.ClassDef):
+                qual = f"{prefix}{node.name}"
+                yield qual, node
+                yield from walk(node.body, f"{qual}.")
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from walk(node.body, f"{prefix}{node.name}.")
+    yield from walk(tree.body, "")
+
+
+def iter_methods(classdef):
+    for node in classdef.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def guard_decls(classdef) -> dict:
+    """The class's ``@guarded_by`` declarations: attr -> tuple of guard
+    names (empty dict when unannotated). Stacked decorators merge."""
+    out: dict[str, tuple] = {}
+    for deco in classdef.decorator_list:
+        if not (isinstance(deco, ast.Call)
+                and attr_chain(deco.func)[-1] == "guarded_by"
+                and deco.args):
+            continue
+        lock = deco.args[0]
+        if isinstance(lock, ast.Constant) and isinstance(lock.value, str):
+            guards = (lock.value,)
+        elif isinstance(lock, (ast.Tuple, ast.List)):
+            guards = tuple(
+                e.value for e in lock.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, str)
+            )
+        else:
+            continue
+        for arg in deco.args[1:]:
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                out[arg.value] = guards
+    return out
+
+
+#: attribute names that read as locks for the lexical with-lock scan
+#: (the package convention: ``_lock``, ``_rt_lock``, ``_table_lock``,
+#: ``compact_lock``, ``_cv``, ``_host_solve_lock``, ...)
+LOCKISH_RE = re.compile(r"(^|_)(lock|locks|cv|cond|condition|mutex)$")
+
+
+def with_lock_names(stmt, extra=()) -> set:
+    """The self-attribute locks a ``with`` statement acquires (empty
+    set when it is not a lock acquisition)."""
+    names = set()
+    if isinstance(stmt, (ast.With, ast.AsyncWith)):
+        for item in stmt.items:
+            expr = item.context_expr
+            # `with self._lock:` / `with self._cv:`; a Call
+            # (`with span(...)`) is not a lock
+            if (isinstance(expr, ast.Attribute)
+                    and isinstance(expr.value, ast.Name)
+                    and expr.value.id == "self"
+                    and (LOCKISH_RE.search(expr.attr) or expr.attr in extra)):
+                names.add(expr.attr)
+    return names
+
+
+def iter_nodes_with_held(func, extra_locks=(), initial=frozenset()):
+    """Yield ``(node, held)`` for every AST node in ``func``'s body,
+    where ``held`` is the frozenset of self-lock attribute names
+    lexically held at that node. Nested function/lambda bodies reset to
+    no-locks-held (a closure runs later, wherever it is called);
+    nested class bodies are skipped (their methods are visited as
+    their own functions by callers)."""
+
+    def walk(node, held):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                yield child, held
+                yield from walk(child, frozenset())
+                continue
+            if isinstance(child, ast.ClassDef):
+                continue
+            new = with_lock_names(child, extra=extra_locks)
+            yield child, held
+            yield from walk(child, held | new if new else held)
+
+    yield from walk(func, frozenset(initial))
+
+
+#: container methods that mutate their receiver in place
+MUTATING_METHODS = frozenset((
+    "append", "appendleft", "extend", "insert", "remove", "pop",
+    "popleft", "popitem", "clear", "update", "add", "discard",
+    "setdefault", "sort", "reverse",
+))
+
+
+def _self_attr_of(node):
+    """``X`` when ``node`` is ``self.X`` or ``self.X[...]``, else None."""
+    if isinstance(node, ast.Subscript):
+        node = node.value
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def self_mutations(node):
+    """``(attr, node)`` pairs for every mutation of a ``self``
+    attribute this single AST node performs: assignment / augmented
+    assignment / deletion of ``self.X`` or ``self.X[...]``, and calls
+    of in-place container methods (``self.X.append(...)``)."""
+    out = []
+    if isinstance(node, ast.Assign):
+        targets = []
+        for t in node.targets:
+            targets.extend(t.elts if isinstance(t, (ast.Tuple, ast.List))
+                           else [t])
+        for t in targets:
+            attr = _self_attr_of(t)
+            if attr is not None:
+                out.append((attr, node))
+    elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+        attr = _self_attr_of(node.target)
+        if attr is not None and not (isinstance(node, ast.AnnAssign)
+                                     and node.value is None):
+            out.append((attr, node))
+    elif isinstance(node, ast.Delete):
+        for t in node.targets:
+            attr = _self_attr_of(t)
+            if attr is not None:
+                out.append((attr, node))
+    elif (isinstance(node, ast.Call)
+          and isinstance(node.func, ast.Attribute)
+          and node.func.attr in MUTATING_METHODS):
+        attr = _self_attr_of(node.func.value)
+        if attr is not None:
+            out.append((attr, node))
+    return out

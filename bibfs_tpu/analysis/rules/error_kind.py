@@ -1,0 +1,66 @@
+"""``error-kind`` — ``QueryError`` carries only the four taxonomy kinds.
+
+The error taxonomy (``serve/resilience.ERROR_KINDS``: ``invalid`` /
+``timeout`` / ``capacity`` / ``internal``) is load-bearing far beyond
+logging: the fleet router re-routes ``internal``/``capacity`` and never
+``invalid``; only server-side kinds degrade ``/healthz``; the chaos
+gates assert per-kind counters. A ``QueryError(..., kind="transient")``
+would parse, serialize over the subprocess protocol, and silently fall
+into the ``internal`` bucket at the far end — the ctor raises at
+runtime, but only on the path that constructs it, which chaos coverage
+may never drive.
+
+The rule: every ``QueryError(...)`` construction must either omit
+``kind`` or pass a string literal from the taxonomy. Non-literal kinds
+are allowed only in ``serve/resilience.py`` itself (``to_query_error``
+is the one sanctioned dynamic constructor — it validates through the
+ctor on a path tests do drive).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from bibfs_tpu.analysis.lint import Finding
+from bibfs_tpu.analysis.rules.common import Rule, attr_chain
+
+_TAXONOMY_HOME = "bibfs_tpu/serve/resilience.py"
+
+
+def _check(project):
+    from bibfs_tpu.serve.resilience import ERROR_KINDS
+
+    findings = []
+    for pf in project.files:
+        if pf.rel.replace("\\", "/").endswith(_TAXONOMY_HOME):
+            continue
+        for node in ast.walk(pf.tree):
+            if not (isinstance(node, ast.Call)
+                    and attr_chain(node.func)[-1] == "QueryError"):
+                continue
+            kind = None
+            for kw in node.keywords:
+                if kw.arg == "kind":
+                    kind = kw.value
+            if kind is None:
+                continue  # defaults to "internal"
+            if isinstance(kind, ast.Constant) and kind.value in ERROR_KINDS:
+                continue
+            shown = (
+                repr(kind.value) if isinstance(kind, ast.Constant)
+                else "<non-literal>"
+            )
+            findings.append(Finding(
+                "error-kind", pf.rel, node.lineno,
+                f"QueryError kind={shown} is not a literal taxonomy "
+                f"kind {ERROR_KINDS}; use to_query_error() for dynamic "
+                "classification",
+            ))
+    return findings
+
+
+RULE = Rule(
+    "error-kind",
+    "QueryError constructed only with the four taxonomy kinds",
+    _check,
+)

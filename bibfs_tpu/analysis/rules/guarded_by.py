@@ -1,0 +1,110 @@
+"""``guarded-by`` — shared attributes mutate only under their declared lock.
+
+Classes annotate their locking discipline with
+:func:`bibfs_tpu.analysis.guarded_by`::
+
+    @guarded_by("_table_lock", "_states", "_versions")
+    class Router: ...
+
+and this rule enforces the mutation half statically: every assignment,
+augmented assignment, deletion or in-place container call on a declared
+``self.<attr>`` must sit lexically inside a ``with self.<guard>:``
+block. Lock-free reads stay legal — GIL-atomic snapshot reads are a
+documented hot-path idiom in this codebase (the router's routing table,
+the engines' runtime map); it is unsynchronized WRITES that the PR 5-8
+review cycles kept catching.
+
+Exemptions (the package's existing conventions, see
+``analysis/annotations.py``): ``__init__``/``__new__`` (construction
+happens-before publication) and ``*_locked``-named methods (the callee-
+holds-the-lock convention). A mutation inside a nested function counts
+as unguarded even when the ``def`` sits in a locked block — the closure
+runs later, wherever it is called.
+
+Declarations are INHERITED: a subclass is checked against its own
+``@guarded_by`` merged over every base class's (resolved project-wide
+by class name, transitively — the static mirror of the decorator's MRO
+merge), so ``PipelinedQueryEngine`` cannot silently mutate the base
+engine's ``_runtimes`` outside ``_rt_lock`` just because its own
+decorator only declares the queue attributes.
+"""
+
+from __future__ import annotations
+
+from bibfs_tpu.analysis.lint import Finding
+from bibfs_tpu.analysis.rules.common import (
+    Rule,
+    attr_chain,
+    guard_decls,
+    iter_classes,
+    iter_methods,
+    iter_nodes_with_held,
+    self_mutations,
+)
+
+_EXEMPT = ("__init__", "__new__")
+
+
+def _class_table(project):
+    """Project-wide class registry: simple name -> (base names, own
+    @guarded_by decls). Simple-name resolution matches how the bases
+    are spelled at the class statement; a cross-file name collision
+    resolves to the last definition (acceptable for one package's
+    annotated classes, which are unique here)."""
+    table = {}
+    for pf in project.files:
+        for _qual, cls in iter_classes(pf.tree):
+            bases = [attr_chain(b)[-1] for b in cls.bases
+                     if attr_chain(b)[-1] != "?"]
+            table[cls.name] = (bases, guard_decls(cls))
+    return table
+
+
+def _resolved_decls(name, table, seen=frozenset()):
+    """``guard_decls`` merged down the (statically resolved) MRO:
+    bases first, own declarations override — the same merge the
+    runtime decorator performs."""
+    entry = table.get(name)
+    if entry is None or name in seen:
+        return {}
+    bases, own = entry
+    merged = {}
+    for base in bases:
+        merged.update(_resolved_decls(base, table, seen | {name}))
+    merged.update(own)
+    return merged
+
+
+def _check(project):
+    findings = []
+    table = _class_table(project)
+    for pf in project.files:
+        for qual, cls in iter_classes(pf.tree):
+            decls = _resolved_decls(cls.name, table)
+            if not decls:
+                continue
+            all_guards = {g for gs in decls.values() for g in gs}
+            for method in iter_methods(cls):
+                if method.name in _EXEMPT or method.name.endswith("_locked"):
+                    continue
+                for node, held in iter_nodes_with_held(
+                        method, extra_locks=all_guards):
+                    for attr, site in self_mutations(node):
+                        guards = decls.get(attr)
+                        if guards is None or held.intersection(guards):
+                            continue
+                        findings.append(Finding(
+                            "guarded-by", pf.rel, site.lineno,
+                            f"{qual}.{method.name} mutates self.{attr} "
+                            f"outside `with self."
+                            f"{'`/`self.'.join(guards)}`"
+                            f" (declared @guarded_by)",
+                        ))
+    return findings
+
+
+RULE = Rule(
+    "guarded-by",
+    "@guarded_by-declared attributes mutate only under their lock",
+    _check,
+)

@@ -1,0 +1,157 @@
+"""``lock-io`` — no blocking I/O while holding a lock.
+
+Every serving-path stall traced in PRs 5-8 had the same shape: a thread
+holding a lock every other thread resolves names/queues through, doing
+something that can block for milliseconds-to-seconds — an fsync, a
+subprocess spawn, a pipe write into a possibly-full buffer. This rule
+flags, lexically, calls to known-blocking primitives made inside a
+``with self.<lock>:`` block (any self attribute that reads as a lock —
+``common.LOCKISH_RE`` — plus the class's ``@guarded_by`` guards) or
+inside a ``*_locked``-named method (the callee-holds-the-lock
+convention).
+
+The blocklist (deliberately conservative — the dynamic
+``analysis/lockgraph`` harness catches what lexical analysis cannot):
+
+- ``os.fsync`` / ``fsync_dir`` / ``os.replace`` — disk commits;
+- ``open(...)`` — file open/creation;
+- ``subprocess.Popen/run/call/check_call/check_output``, ``*.communicate``;
+- ``time.sleep`` — a backoff under a lock convoys every peer;
+- ``socket.*`` calls;
+- pipe I/O: ``*.stdin/stdout.write/flush/read/readline``, and the
+  ``self._write`` pipe-writer convention (``fleet/replica.py``);
+- ``*.wal.append`` — the WAL append whose fsync IS the ack barrier.
+
+**Built-in allowlist.** Two documented, deliberate trades hold blocking
+I/O under a lock by design and are allowlisted here (rather than
+suppressed inline) because the invariant is structural, argued at
+length at the seam itself:
+
+- the fsync-under-store-lock trade in ``store/registry.py``: an acked
+  update must be durable BEFORE the overlay commit, and the append must
+  be fenced against a checkpoint's capture+segment-switch — so
+  ``GraphStore.update`` appends (and, under ``fsync=always``, fsyncs)
+  inside the store lock, and ``_write_manifest_locked`` commits
+  manifests there (``update()``'s docstring carries the latency
+  analysis); ``WalWriter.append``/``_fsync_locked`` are the
+  writer-side halves of the same contract.
+- ``fleet/replica.py`` ``ProcessReplica``: child-stdin writes happen
+  under the replica lock so a concurrent submit's ``use`` switch can
+  never redirect an update batch — bounded by ``_CHUNK_LINES`` far
+  below pipe capacity, with replies awaited OUTSIDE the lock
+  (deadlock-free by construction; the ``_update_commands`` docstring
+  carries the proof), and ``_spawn`` swaps the process inside the lock
+  so a stale reader's EOF sweep cannot mark the new incarnation dead.
+
+Anything else needs an inline ``# bibfs: allow(lock-io): <why>``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from bibfs_tpu.analysis.lint import Finding
+from bibfs_tpu.analysis.rules.common import (
+    Rule,
+    attr_chain,
+    guard_decls,
+    iter_classes,
+    iter_methods,
+    iter_nodes_with_held,
+)
+
+#: (path suffix, method qualname, reason) — the documented trades above
+ALLOWLIST = (
+    ("bibfs_tpu/store/registry.py", "GraphStore.update",
+     "validate-log-commit under the capture lock is the ack contract"),
+    ("bibfs_tpu/store/registry.py", "GraphStore._write_manifest_locked",
+     "manifest rename commits under the store lock by design"),
+    ("bibfs_tpu/store/wal.py", "WalWriter._fsync_locked",
+     "the fsync under the writer lock IS the durability ack barrier"),
+    ("bibfs_tpu/fleet/replica.py", "ProcessReplica._spawn",
+     "locked process swap defeats the stale-reader EOF sweep race"),
+    ("bibfs_tpu/fleet/replica.py", "ProcessReplica.submit",
+     "graph-pinned chunked pipe writes (see _CHUNK_LINES)"),
+    ("bibfs_tpu/fleet/replica.py", "ProcessReplica._nudge",
+     "graph-pinned chunked pipe writes (see _CHUNK_LINES)"),
+    ("bibfs_tpu/fleet/replica.py", "ProcessReplica._command",
+     "graph-pinned chunked pipe writes (see _CHUNK_LINES)"),
+    ("bibfs_tpu/fleet/replica.py", "ProcessReplica._command_use",
+     "graph-pinned chunked pipe writes (see _CHUNK_LINES)"),
+    ("bibfs_tpu/fleet/replica.py", "ProcessReplica._update_commands",
+     "graph-pinned chunked pipe writes (see _CHUNK_LINES)"),
+)
+
+_SUBPROCESS = frozenset(("Popen", "run", "call", "check_call",
+                         "check_output"))
+_PIPE_ENDS = frozenset(("write", "flush", "read", "readline"))
+
+
+def _blocking_label(call: ast.Call) -> str | None:
+    chain = attr_chain(call.func)
+    last = chain[-1]
+    if chain[-2:] in (("os", "fsync"), ("os", "replace")):
+        return ".".join(chain[-2:])
+    if last == "fsync_dir":
+        return "fsync_dir"
+    if chain == ("open",):
+        return "open"
+    if len(chain) >= 2 and chain[-2] == "subprocess" and last in _SUBPROCESS:
+        return f"subprocess.{last}"
+    if last == "communicate":
+        return "communicate"
+    if chain[-2:] == ("time", "sleep"):
+        return "time.sleep"
+    if "socket" in chain[:-1]:
+        return ".".join(chain[-2:])
+    if last in _PIPE_ENDS and any(p in ("stdin", "stdout") for p in chain):
+        return ".".join(chain[-3:])
+    if last == "_write" and chain[0] == "self":
+        return "self._write (pipe write)"
+    if chain[-2:] == ("wal", "append"):
+        return "wal.append (fsync-bearing)"
+    return None
+
+
+def _allowlisted(rel: str, qual: str) -> bool:
+    for suffix, method, _reason in ALLOWLIST:
+        if rel.endswith(suffix) and qual == method:
+            return True
+    return False
+
+
+def _check(project):
+    findings = []
+    for pf in project.files:
+        for cls_qual, cls in iter_classes(pf.tree):
+            guards = {g for gs in guard_decls(cls).values() for g in gs}
+            for method in iter_methods(cls):
+                qual = f"{cls_qual}.{method.name}"
+                initial = (
+                    frozenset((f"<{method.name}>",))
+                    if method.name.endswith("_locked") else frozenset()
+                )
+                if _allowlisted(pf.rel, qual):
+                    continue
+                for node, held in iter_nodes_with_held(
+                        method, extra_locks=guards, initial=initial):
+                    if not held or not isinstance(node, ast.Call):
+                        continue
+                    label = _blocking_label(node)
+                    if label is None:
+                        continue
+                    lock = ", ".join(sorted(h.strip("<>") for h in held))
+                    findings.append(Finding(
+                        "lock-io", pf.rel, node.lineno,
+                        f"{qual} calls blocking {label} while holding "
+                        f"`{lock}` — move the I/O off the lock or "
+                        "document the trade",
+                    ))
+    return findings
+
+
+RULE = Rule(
+    "lock-io",
+    "no blocking I/O (fsync/spawn/pipe/socket/sleep) under a held lock",
+    _check,
+)

@@ -1,0 +1,153 @@
+"""``metric-mint`` — one canonical metric-name list, everywhere, minted
+at construction.
+
+The stable metric names are an API: dashboards, the soak gates, the
+live-endpoint CI probe and the README tables all key on them. Before
+``obs/names.py`` they were declared in five places by hand; this rule
+pins every surface to that one list:
+
+- every ``REGISTRY.counter/gauge/histogram("name", ...)`` mint in
+  ``bibfs_tpu/`` uses a string literal (a computed name can't be
+  audited) that is in ``obs.names.ALL_METRIC_NAMES``;
+- every other ``bibfs_*`` string literal in the package resolves to a
+  canonical family (modulo the histogram ``_bucket``/``_count``/
+  ``_sum`` exposition suffixes) — a gate list or test helper cannot
+  drift from the registry;
+- [full-project scans only] every canonical name is actually minted
+  somewhere — the list cannot grow dead entries — and the README's
+  ``bibfs_*`` tokens reconcile with it in BOTH directions: nothing
+  documented that doesn't exist, nothing existing that isn't
+  documented.
+
+The "minted at registry/ctor init" half of the invariant is structural:
+because every mint site must use a canonical literal, and the soak
+gates assert the families render at zero before traffic, a name that
+only appears at first-use would fail the render gates — the lint keeps
+the name set closed, the gates keep minting eager.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from bibfs_tpu.analysis.lint import Finding
+from bibfs_tpu.analysis.rules.common import Rule, attr_chain
+from bibfs_tpu.obs.names import (
+    ALL_METRIC_NAMES,
+    NON_METRIC_TOKENS,
+    canonical_family,
+)
+
+_MINT_METHODS = frozenset(("counter", "gauge", "histogram"))
+_METRIC_TOKEN = re.compile(r"^bibfs_[a-z0-9_]+$")
+_README_TOKEN = re.compile(r"\bbibfs_[a-z0-9_]+\b")
+_NAMES_MODULE = "bibfs_tpu/obs/names.py"
+
+
+def _mint_name(call: ast.Call):
+    """The literal name a ``*.counter/gauge/histogram(...)`` mint call
+    registers, or (None, True) when the call mints with a non-literal
+    name, or (None, False) when it is not a mint call."""
+    func = call.func
+    if not (isinstance(func, ast.Attribute) and func.attr in _MINT_METHODS):
+        return None, False
+    chain = attr_chain(func)
+    # REGISTRY.counter(...), self.gauge(...) in the registry itself;
+    # anything else named .counter() (e.g. itertools.count) won't have
+    # a bibfs_ literal and is filtered by the argument check below
+    if chain[0] not in ("REGISTRY", "self"):
+        return None, False
+    if not call.args:
+        return None, False
+    name = call.args[0]
+    if isinstance(name, ast.Constant) and isinstance(name.value, str):
+        if name.value.startswith("bibfs_"):
+            return name.value, True
+        return None, False
+    return None, chain[0] == "REGISTRY"
+
+
+def _check(project):
+    findings = []
+    minted: set[str] = set()
+    for pf in project.files:
+        rel = pf.rel.replace("\\", "/")
+        if rel.endswith("obs/names.py"):
+            continue  # the canonical list itself
+        mint_lines = set()
+        for node in ast.walk(pf.tree):
+            if isinstance(node, ast.Call):
+                name, is_mint = _mint_name(node)
+                if name is not None:
+                    minted.add(name)
+                    mint_lines.add(node.lineno)
+                    if name not in ALL_METRIC_NAMES:
+                        findings.append(Finding(
+                            "metric-mint", pf.rel, node.lineno,
+                            f"mints {name!r}, which is not in the "
+                            "canonical list (bibfs_tpu/obs/names.py) — "
+                            "add it there (and to the README table)",
+                        ))
+                elif is_mint:
+                    findings.append(Finding(
+                        "metric-mint", pf.rel, node.lineno,
+                        "REGISTRY mint with a non-literal metric name "
+                        "— names must be auditable string literals "
+                        "from obs/names.py",
+                    ))
+        for node in ast.walk(pf.tree):
+            if not (isinstance(node, ast.Constant)
+                    and isinstance(node.value, str)
+                    and _METRIC_TOKEN.match(node.value)):
+                continue
+            tok = node.value
+            if tok in NON_METRIC_TOKENS or node.lineno in mint_lines:
+                continue
+            if canonical_family(tok) is None:
+                findings.append(Finding(
+                    "metric-mint", pf.rel, node.lineno,
+                    f"string literal {tok!r} looks like a metric name "
+                    "but is not in the canonical list "
+                    "(bibfs_tpu/obs/names.py)",
+                ))
+    if not project.complete:
+        return findings
+    for name in sorted(ALL_METRIC_NAMES - minted):
+        findings.append(Finding(
+            "metric-mint", _NAMES_MODULE, 1,
+            f"canonical metric {name!r} is never minted by any "
+            "REGISTRY call — dead documentation; remove it or mint it",
+        ))
+    readme = project.readme()
+    if readme is not None:
+        documented: set[str] = set()
+        for i, line in enumerate(readme.splitlines(), start=1):
+            for tok in _README_TOKEN.findall(line):
+                if tok in NON_METRIC_TOKENS:
+                    continue
+                fam = canonical_family(tok)
+                if fam is None:
+                    findings.append(Finding(
+                        "metric-mint", "README.md", i,
+                        f"README names {tok!r}, which is not a "
+                        "canonical metric family "
+                        "(bibfs_tpu/obs/names.py)",
+                    ))
+                else:
+                    documented.add(fam)
+        for name in sorted(ALL_METRIC_NAMES - documented):
+            findings.append(Finding(
+                "metric-mint", "README.md", 1,
+                f"canonical metric {name!r} is missing from the README "
+                "metric tables",
+            ))
+    return findings
+
+
+RULE = Rule(
+    "metric-mint",
+    "metric names come from the one canonical list (obs/names.py), "
+    "minted as literals, README-reconciled",
+    _check,
+)

@@ -41,6 +41,7 @@ import threading
 import time
 from collections import deque
 
+from bibfs_tpu.analysis import guarded_by
 from bibfs_tpu.serve.engine import QueryEngine
 from bibfs_tpu.serve.pipeline import PipelinedQueryEngine
 from bibfs_tpu.serve.resilience import ERROR_KINDS, QueryError
@@ -53,6 +54,7 @@ class ReplicaDead(RuntimeError):
     replica dead ahead of the next health poll."""
 
 
+@guarded_by("_lock", "_engine")
 class EngineReplica:
     """An in-process serving engine behind the replica interface.
 
@@ -338,6 +340,12 @@ _CONTROL_PREFIXES = (
 )
 
 
+# the reply-matching queues, the tracked stream state and the process
+# handle are shared between submitters, the reader thread and restart;
+# _draining stays un-annotated by design (lock-free fast-refusal read,
+# re-checked inside the lock where it matters — submit's roll race)
+@guarded_by("_lock", "_pending", "_control", "_current_graph", "_dead",
+            "_proc")
 class ProcessReplica:
     """A spawned ``bibfs-serve`` subprocess behind the replica
     interface (module docstring). The child runs ``--pipeline`` so
@@ -495,6 +503,7 @@ class ProcessReplica:
             kind = head[1] if len(head) > 1 else "internal"
             if kind not in ERROR_KINDS:
                 kind = "internal"
+            # bibfs: allow(error-kind): deserializes the child's wire kind — validated against ERROR_KINDS on the line above, unknowns coerced to internal
             t.error = QueryError(line, kind=kind, query=(t.src, t.dst))
         elif "no path" in line:
             t.result = BFSResult(False, None, None, None, 0.0, 0, 0)

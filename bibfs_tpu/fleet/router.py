@@ -62,6 +62,7 @@ import hashlib
 import threading
 import time
 
+from bibfs_tpu.analysis import guarded_by
 from bibfs_tpu.fleet.replica import ReplicaDead
 from bibfs_tpu.obs.metrics import REGISTRY, next_instance_label
 from bibfs_tpu.obs.trace import span
@@ -88,19 +89,12 @@ ROLL_HISTORY_MAX = 8
 #: client's problem (invalid) or the caller's deadline (timeout)
 REROUTE_KINDS = ("internal", "capacity")
 
-#: the fleet metric families a router mints (README "Observability") —
-#: the ONE list the soak's live-render gate and the bench CI gate both
-#: check, so they cannot drift apart; bibfs_build_info rides along
-#: because "which build is this replica" is the fleet question
-FLEET_METRIC_FAMILIES = (
-    "bibfs_fleet_replicas",
-    "bibfs_fleet_routed_total",
-    "bibfs_fleet_reroutes_total",
-    "bibfs_fleet_rolls_total",
-    "bibfs_fleet_spills_total",
-    "bibfs_fleet_catchups_total",
-    "bibfs_build_info",
-)
+# the fleet metric families a router mints (README "Observability") —
+# re-exported from the ONE canonical list (obs/names.py) the soak's
+# live-render gate, the bench CI gate and the metric-mint lint all
+# share, so they cannot drift apart; bibfs_build_info rides along
+# because "which build is this replica" is the fleet question
+from bibfs_tpu.obs.names import FLEET_METRIC_FAMILIES  # noqa: E402,F401
 
 
 def _hash64(key: str) -> int:
@@ -192,6 +186,12 @@ class FleetTicket:
                     raise self.error
 
 
+# the routing table and every catch-up/version structure the poller,
+# the dispatch path and rolling_swap share; reads stay lock-free by
+# design (_pick's GIL-atomic table read is the hot path)
+@guarded_by("_table_lock", "_states", "_versions", "_committed",
+            "_roll_history", "_needs_catchup", "_forced_drain",
+            "_last_gen")
 class Router:
     """Front-end router over N replicas (module docstring).
 

@@ -21,6 +21,31 @@ import numpy as np
 _HEADER_DTYPE = np.dtype("<u4")
 
 
+def _atomic_replace(path, write_payload, *, mode: str = "wb") -> None:
+    """Commit a file atomically: ``write_payload(f)`` lands in a
+    same-directory tmp file that is flushed, fsynced and
+    ``os.replace``d onto ``path`` only once fully written — readers see
+    either the old complete file or the new complete file, never a torn
+    middle. On any failure the tmp is removed and the error re-raised.
+    The one writer idiom every served/ground-truth file in this module
+    (and the store's checkpoints, which anchor recovery on this
+    property) goes through."""
+    path = os.fspath(path)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, mode) as f:
+            write_payload(f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
 def write_graph_bin(path: str | os.PathLike, n: int, edges: np.ndarray) -> None:
     """Write an undirected edge list in the reference binary format.
 
@@ -45,21 +70,12 @@ def write_graph_bin(path: str | os.PathLike, n: int, edges: np.ndarray) -> None:
         )
     edges = np.ascontiguousarray(edges, dtype=_HEADER_DTYPE).reshape(-1, 2)
     m = edges.shape[0]
-    path = os.fspath(path)
-    tmp = f"{path}.tmp.{os.getpid()}"
-    try:
-        with open(tmp, "wb") as f:
-            np.array([n, m], dtype=_HEADER_DTYPE).tofile(f)
-            edges.tofile(f)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, path)
-    except BaseException:
-        try:
-            os.unlink(tmp)
-        except OSError:
-            pass
-        raise
+
+    def _payload(f):
+        np.array([n, m], dtype=_HEADER_DTYPE).tofile(f)
+        edges.tofile(f)
+
+    _atomic_replace(path, _payload)
 
 
 def read_graph_bin(path: str | os.PathLike) -> tuple[int, np.ndarray]:
@@ -150,9 +166,12 @@ def write_dense_matrix(
     mat = np.zeros((n, n), dtype=np.uint8)
     mat[edges[:, 0], edges[:, 1]] = 1
     mat[edges[:, 1], edges[:, 0]] = 1
-    with open(path, "wb") as f:
+
+    def _payload(f):
         np.array([n], dtype=_HEADER_DTYPE).tofile(f)
         mat.tofile(f)
+
+    _atomic_replace(path, _payload)
 
 
 def write_ground_truth(
@@ -169,8 +188,9 @@ def write_ground_truth(
         "hop_count": None if hop_count is None else int(hop_count),
         "nodes": None if nodes is None else [int(v) for v in nodes],
     }
-    with open(path, "w") as f:
-        json.dump(payload, f)
+    # atomic: the sidecar is ground truth for its .bin — a torn JSON
+    # next to a complete graph would fail suites that trust the pair
+    _atomic_replace(path, lambda f: json.dump(payload, f), mode="w")
 
 
 def read_ground_truth(path: str | os.PathLike) -> dict:

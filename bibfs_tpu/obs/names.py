@@ -1,0 +1,183 @@
+"""Canonical metric-name registry — the ONE list every surface derives.
+
+Before this module, the stable metric names lived in five places with
+nothing holding them together: the mint sites (``REGISTRY.counter(...)``
+calls scattered across the serving stack), the README "Observability"
+tables, the soak gates' family lists (``fleet.FLEET_METRIC_FAMILIES``,
+``store/wal.DURABLE_METRIC_FAMILIES``, three inline tuples in
+``bench.py``), and the live-endpoint CI probe
+(``scripts/check_metrics_endpoint.py``). Every PR that added a family
+had to update them in lockstep by hand — and the ``bibfs-lint``
+``metric-mint`` rule (``bibfs_tpu/analysis/rules/metric_mint.py``) now
+machine-checks exactly that lockstep:
+
+- every name minted anywhere in ``bibfs_tpu/`` must appear here;
+- every name here must be minted somewhere (no dead documentation);
+- every ``bibfs_*`` string literal in the package must resolve to a
+  name here (modulo the Prometheus histogram ``_bucket``/``_count``/
+  ``_sum`` exposition suffixes);
+- the README metric tables must list exactly these names.
+
+This module is deliberately import-light (stdlib-free, data only):
+``bench.py``, CI scripts and the lint all import it without pulling the
+serving stack.
+
+Adding a metric: mint it at component construction (so it renders at
+zero — the soak gates scrape families before traffic), add it to its
+group below, and add a README table row. The lint fails until all
+three agree.
+"""
+
+from __future__ import annotations
+
+#: sync/pipelined engine query accounting (serve/engine.py)
+ENGINE_METRIC_FAMILIES = (
+    "bibfs_queries_total",
+    "bibfs_queries_routed_total",
+    "bibfs_device_batches_total",
+    "bibfs_cache_inserts_skipped_total",
+)
+
+#: pipelined-engine flusher/queue instrumentation (serve/pipeline.py)
+PIPELINE_METRIC_FAMILIES = (
+    "bibfs_flushes_total",
+    "bibfs_flush_cause_total",
+    "bibfs_submit_blocked_total",
+    "bibfs_serve_queue_depth",
+    "bibfs_serve_queue_depth_max",
+    "bibfs_queue_wait_max_ms",
+    "bibfs_batch_service_max_ms",
+    "bibfs_query_latency_seconds",
+)
+
+#: distance/executable cache accounting (serve/cache.py, serve/buckets.py)
+CACHE_METRIC_FAMILIES = (
+    "bibfs_dist_cache_events_total",
+    "bibfs_dist_cache_entries",
+    "bibfs_exec_cache_events_total",
+    "bibfs_exec_programs",
+    "bibfs_exec_program_dispatches_total",
+)
+
+#: failure-handling telemetry (serve/resilience threading + serve/faults);
+#: all minted at engine construction, so the chaos gate asserts the FULL
+#: group renders — not the hand-picked subset it used to
+RESILIENCE_METRIC_FAMILIES = (
+    "bibfs_errors_total",
+    "bibfs_route_fallbacks_total",
+    "bibfs_retries_total",
+    "bibfs_batch_bisections_total",
+    "bibfs_breaker_state",
+    "bibfs_breaker_transitions_total",
+    "bibfs_health_state",
+    "bibfs_faults_injected_total",
+)
+
+#: versioned graph store (store/registry.py)
+STORE_METRIC_FAMILIES = (
+    "bibfs_store_graphs",
+    "bibfs_store_swaps_total",
+    "bibfs_store_delta_edges",
+    "bibfs_store_compactions_total",
+    "bibfs_store_compact_failures_total",
+)
+
+#: WAL durability layer (store/wal.py + store/registry.py); the crash
+#: soak's render gate and the bench CI gate share this exact tuple
+DURABLE_METRIC_FAMILIES = (
+    "bibfs_wal_records_total",
+    "bibfs_wal_fsyncs_total",
+    "bibfs_checkpoints_total",
+    "bibfs_recovery_replayed_records",
+    "bibfs_recovery_seconds",
+)
+
+#: landmark distance-oracle tier (oracle/oracle.py + store/registry.py)
+ORACLE_METRIC_FAMILIES = (
+    "bibfs_oracle_hits_total",
+    "bibfs_oracle_index_builds_total",
+    "bibfs_oracle_index_age_seconds",
+)
+
+#: build identity (obs/metrics.py; minted at every registry init)
+BUILD_INFO_METRIC = "bibfs_build_info"
+
+#: fleet router (fleet/router.py) — bibfs_build_info rides along in the
+#: gate tuple below because "which build is this replica" is the fleet
+#: question a rolling restart asks
+_FLEET_ONLY = (
+    "bibfs_fleet_replicas",
+    "bibfs_fleet_routed_total",
+    "bibfs_fleet_reroutes_total",
+    "bibfs_fleet_rolls_total",
+    "bibfs_fleet_spills_total",
+    "bibfs_fleet_catchups_total",
+)
+FLEET_METRIC_FAMILIES = _FLEET_ONLY + (BUILD_INFO_METRIC,)
+
+#: every metric family the process can mint, grouped — the metric-mint
+#: lint rule's ground truth
+ALL_METRIC_NAMES = frozenset(
+    ENGINE_METRIC_FAMILIES
+    + PIPELINE_METRIC_FAMILIES
+    + CACHE_METRIC_FAMILIES
+    + RESILIENCE_METRIC_FAMILIES
+    + STORE_METRIC_FAMILIES
+    + DURABLE_METRIC_FAMILIES
+    + ORACLE_METRIC_FAMILIES
+    + _FLEET_ONLY
+    + (BUILD_INFO_METRIC,)
+)
+
+#: families rendered with Prometheus histogram exposition (each also
+#: renders ``<name>_bucket{le=}`` / ``<name>_count`` / ``<name>_sum``
+#: series — :func:`exposition_names`)
+HISTOGRAM_METRIC_NAMES = frozenset((
+    "bibfs_query_latency_seconds",
+))
+
+#: ``bibfs_``-prefixed tokens that are NOT metric names (package paths,
+#: reference source files) — the lint's literal/README scans skip these
+NON_METRIC_TOKENS = frozenset((
+    "bibfs_tpu",        # the package itself (paths in prose)
+    "bibfs_cuda_only",  # the reference's v3 CUDA source file
+))
+
+#: the names the live-endpoint CI probe
+#: (scripts/check_metrics_endpoint.py) asserts on a real
+#: ``bibfs-serve --metrics-port`` scrape — the minimal always-on
+#: pipelined-serving surface (store/fleet/oracle families need those
+#: subsystems attached and are gated by their own soaks)
+SERVE_ENDPOINT_METRICS = (
+    "bibfs_queries_total",
+    "bibfs_queries_routed_total",
+    "bibfs_dist_cache_events_total",
+    "bibfs_flush_cause_total",
+    "bibfs_flushes_total",
+    "bibfs_query_latency_seconds",
+    "bibfs_serve_queue_depth",
+)
+
+
+def exposition_names(name: str) -> tuple:
+    """The text-exposition series one family renders: the family name
+    itself for counters/gauges, the ``_bucket``/``_count``/``_sum``
+    triple for histograms."""
+    if name in HISTOGRAM_METRIC_NAMES:
+        return (f"{name}_bucket", f"{name}_count", f"{name}_sum")
+    return (name,)
+
+
+def canonical_family(token: str) -> str | None:
+    """Resolve a ``bibfs_*`` token to its canonical family name: the
+    name itself, or the histogram family a ``_bucket``/``_count``/
+    ``_sum`` exposition series belongs to. None if the token is not a
+    known metric."""
+    if token in ALL_METRIC_NAMES:
+        return token
+    for suffix in ("_bucket", "_count", "_sum"):
+        if token.endswith(suffix):
+            base = token[: -len(suffix)]
+            if base in HISTOGRAM_METRIC_NAMES:
+                return base
+    return None

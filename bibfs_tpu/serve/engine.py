@@ -49,6 +49,7 @@ from contextlib import contextmanager
 
 import numpy as np
 
+from bibfs_tpu.analysis import guarded_by
 from bibfs_tpu.obs.metrics import REGISTRY, MetricBank, next_instance_label
 from bibfs_tpu.obs.trace import span
 from bibfs_tpu.serve.buckets import (
@@ -228,6 +229,8 @@ class _Pending:
         self.cutoff: int | None = None
 
 
+@guarded_by("_lock", "_graph", "bucket_key", "_host_solver",
+            "host_native_graph", "_serial_solver", "host_backend_resolved")
 class _GraphRuntime:
     """Everything an engine knows about solving ONE immutable graph
     snapshot: the lazily built+uploaded device graph and its compiled-
@@ -365,6 +368,7 @@ class _GraphRuntime:
         return self._serial_solver(int(src), int(dst), cutoff=cutoff)
 
 
+@guarded_by("_rt_lock", "_runtimes", "_rts_released")
 class QueryEngine:
     """Serve ``(src, dst)`` shortest-path queries over one graph.
 

@@ -55,6 +55,7 @@ import time
 from collections import OrderedDict, deque
 from concurrent.futures import ThreadPoolExecutor
 
+from bibfs_tpu.analysis import guarded_by
 from bibfs_tpu.obs.metrics import REGISTRY, LogHistogram, MetricBank
 from bibfs_tpu.obs.trace import span
 from bibfs_tpu.serve.engine import QueryEngine, _Pending
@@ -260,6 +261,12 @@ class QueryTicket(_Pending):
         return self.result
 
 
+# _lock and _cv alias ONE RLock (the Condition wraps it): every queue/
+# accounting mutation goes through that lock, whichever name the call
+# site uses — the "mutated under the engine lock or on the single
+# finish worker" contract, now machine-checked
+@guarded_by(("_lock", "_cv"), "_queue", "_outstanding", "_flush_req",
+            "_closed", "_errors")
 class PipelinedQueryEngine(QueryEngine):
     """Asynchronous, deadline-flushing :class:`QueryEngine` (module
     docstring). Extra parameters on top of the base engine's:

@@ -89,6 +89,7 @@ import threading
 import time
 import weakref
 
+from bibfs_tpu.analysis import guarded_by
 from bibfs_tpu.obs.metrics import REGISTRY, next_instance_label
 from bibfs_tpu.obs.trace import span
 from bibfs_tpu.store.delta import DeltaOverlay, canonical_edge
@@ -156,6 +157,7 @@ class _Entry:
         self.recovered: dict | None = None
 
 
+@guarded_by("_lock", "_entries", "_default")
 class GraphStore:
     """Named, versioned, hot-swappable graphs (module docstring).
 

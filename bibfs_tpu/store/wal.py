@@ -61,6 +61,13 @@ import struct
 import threading
 import zlib
 
+from bibfs_tpu.analysis import guarded_by
+
+# the durability metric families (README "Observability") — re-exported
+# from the ONE canonical list (obs/names.py) the crash soak's render
+# gate, the bench CI gate and the metric-mint lint all share
+from bibfs_tpu.obs.names import DURABLE_METRIC_FAMILIES  # noqa: F401
+
 _MAGIC = b"BWAL1\n"
 _REC_HEAD = struct.Struct("<II")        # payload_len, crc32
 _PAYLOAD_HEAD = struct.Struct("<QII")   # version, n_adds, n_dels
@@ -68,17 +75,6 @@ _PAYLOAD_HEAD = struct.Struct("<QII")   # version, n_adds, n_dels
 #: fsync policies (module docstring); parse/ctor reject anything else —
 #: a typo'd policy must fail loudly, not silently weaken durability
 FSYNC_POLICIES = ("always", "batch", "off")
-
-#: the durability metric families (README "Observability") — ONE list
-#: shared by the crash soak's render gate and the bench CI gate, the
-#: fleet.FLEET_METRIC_FAMILIES pattern
-DURABLE_METRIC_FAMILIES = (
-    "bibfs_wal_records_total",
-    "bibfs_wal_fsyncs_total",
-    "bibfs_checkpoints_total",
-    "bibfs_recovery_replayed_records",
-    "bibfs_recovery_seconds",
-)
 
 
 def _encode_record(version: int, adds, dels) -> bytes:
@@ -157,6 +153,7 @@ def repair_wal(path) -> tuple[list, bool]:
     return records, torn
 
 
+@guarded_by("_lock", "records", "fsyncs", "_since_fsync", "_f")
 class WalWriter:
     """Append side of one segment file (module docstring format).
 

@@ -24,15 +24,16 @@ import urllib.request
 
 import numpy as np
 
+# derived from the ONE canonical metric list (bibfs_tpu/obs/names.py —
+# the metric-mint lint keeps it, the mint sites and the README in
+# lockstep); histograms expand to their _bucket/_count/_sum exposition
+# series
+from bibfs_tpu.obs.names import SERVE_ENDPOINT_METRICS, exposition_names
+
 REQUIRED_NAMES = [
-    "bibfs_queries_total",
-    "bibfs_queries_routed_total",
-    "bibfs_dist_cache_events_total",
-    "bibfs_flush_cause_total",
-    "bibfs_flushes_total",
-    "bibfs_query_latency_seconds_bucket",
-    "bibfs_query_latency_seconds_count",
-    "bibfs_serve_queue_depth",
+    series
+    for family in SERVE_ENDPOINT_METRICS
+    for series in exposition_names(family)
 ]
 
 

@@ -16,6 +16,17 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# Dynamic lock-order race detector (bibfs_tpu/analysis/lockgraph):
+# BIBFS_LOCK_CHECK=1 instruments every Lock/RLock/Condition the bibfs
+# modules create, so the whole suite doubles as the race harness. Must
+# install BEFORE the serving modules import and construct their locks —
+# which is why it sits above every other bibfs import here.
+_LOCK_CHECK = os.environ.get("BIBFS_LOCK_CHECK", "") not in ("", "0")
+if _LOCK_CHECK:
+    from bibfs_tpu.analysis import lockgraph as _lockgraph
+
+    _lockgraph.install()
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
@@ -26,6 +37,27 @@ import pytest  # noqa: E402
 from bibfs_tpu.utils.platform import apply_platform_env  # noqa: E402
 
 apply_platform_env()
+
+
+@pytest.fixture(autouse=True, scope="session")
+def _lockgraph_gate():
+    """Under BIBFS_LOCK_CHECK=1: write the lock-graph JSON artifact at
+    session end (BIBFS_LOCK_REPORT, default lockgraph.json) and FAIL
+    the session if any lock-order cycle was recorded — a cycle raised
+    inside a swallow-and-count background thread (e.g. a compaction
+    job) would otherwise pass silently."""
+    yield
+    if not _LOCK_CHECK:
+        return
+    path = os.environ.get("BIBFS_LOCK_REPORT", "lockgraph.json")
+    rep = _lockgraph.save_report(path)
+    assert not rep["cycles"], (
+        "lock-order cycles recorded during the session (see "
+        f"{path}):\n" + "\n".join(
+            f"{e['from']} -> {e['to']}"
+            for rec in rep["cycles"] for e in rec["cycle"]
+        )
+    )
 
 
 @pytest.fixture

@@ -1,0 +1,510 @@
+"""bibfs-lint rule tests: every rule must FIRE on a bad fixture and
+stay QUIET on the good twin, suppressions must silence (and be policed
+for justification/staleness), and the real tree must lint clean — the
+last one is the CI gate in tier-1 form."""
+
+import textwrap
+
+import pytest
+
+from bibfs_tpu.analysis import lint as lint_mod
+from bibfs_tpu.analysis.lint import Project, run
+
+
+def project_for(tmp_path, files: dict) -> Project:
+    paths = []
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+        paths.append(str(p))
+    return Project.load(str(tmp_path), paths)
+
+
+def rule_findings(tmp_path, files, rule):
+    findings, suppressed = run(project_for(tmp_path, files))
+    return [f for f in findings if f.rule == rule], suppressed
+
+
+# ---- atomic-write ----------------------------------------------------
+BAD_ATOMIC = {
+    "bibfs_tpu/store/writer.py": """
+    def write_served(path, data):
+        with open(path, "wb") as f:
+            f.write(data)
+    """,
+}
+
+GOOD_ATOMIC = {
+    "bibfs_tpu/store/writer.py": """
+    import os
+
+    def write_served(path, data):
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)
+
+    def append_log(path, rec):
+        with open(path, "ab") as f:
+            f.write(rec)
+
+    def repair_in_place(path, good):
+        with open(path, "r+b") as f:
+            f.truncate(good)
+    """,
+}
+
+
+def test_atomic_write_fires(tmp_path):
+    found, _ = rule_findings(tmp_path, BAD_ATOMIC, "atomic-write")
+    assert len(found) == 1 and "os.replace" in found[0].message
+
+
+def test_atomic_write_quiet_on_idiom(tmp_path):
+    found, _ = rule_findings(tmp_path, GOOD_ATOMIC, "atomic-write")
+    assert found == []
+
+
+def test_atomic_write_nested_replace_does_not_legalize(tmp_path):
+    # an os.replace inside a NESTED helper must not legalize the
+    # enclosing function's direct torn write: open and replace must
+    # live in the same function
+    files = {"bibfs_tpu/store/n.py": """
+    import os
+
+    def outer(path, data):
+        def helper(p):
+            os.replace(p + ".tmp", p)
+        with open(path, "wb") as f:     # still a torn write
+            f.write(data)
+        return helper
+    """}
+    found, _ = rule_findings(tmp_path, files, "atomic-write")
+    assert len(found) == 1 and found[0].message.startswith("outer ")
+
+
+def test_atomic_write_scoped_to_served_modules(tmp_path):
+    # the same direct write outside store/ and graph/ is out of scope
+    files = {"bibfs_tpu/obs/export.py":
+             BAD_ATOMIC["bibfs_tpu/store/writer.py"]}
+    found, _ = rule_findings(tmp_path, files, "atomic-write")
+    assert found == []
+
+
+# ---- guarded-by ------------------------------------------------------
+BAD_GUARDED = {
+    "bibfs_tpu/store/box.py": """
+    import threading
+
+    from bibfs_tpu.analysis import guarded_by
+
+    @guarded_by("_lock", "_items", "_closed")
+    class Box:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._items = []
+            self._closed = False
+
+        def add(self, x):
+            self._items.append(x)      # unguarded mutation
+
+        def close(self):
+            with self._lock:
+                self._items.clear()
+            self._closed = True        # outside the with block
+    """,
+}
+
+GOOD_GUARDED = {
+    "bibfs_tpu/store/box.py": """
+    import threading
+
+    from bibfs_tpu.analysis import guarded_by
+
+    @guarded_by("_lock", "_items", "_closed")
+    class Box:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._items = []           # ctor: happens-before publication
+            self._closed = False
+
+        def add(self, x):
+            with self._lock:
+                self._items.append(x)
+
+        def peek(self):
+            return len(self._items)    # lock-free READS stay legal
+
+        def _drop_locked(self):
+            self._items.clear()        # *_locked: callee holds the lock
+
+        def close(self):
+            with self._lock:
+                self._closed = True
+                self._drop_locked()
+    """,
+}
+
+
+def test_guarded_by_fires(tmp_path):
+    found, _ = rule_findings(tmp_path, BAD_GUARDED, "guarded-by")
+    assert len(found) == 2
+    assert any("_items" in f.message for f in found)
+    assert any("_closed" in f.message for f in found)
+
+
+def test_guarded_by_quiet_on_discipline(tmp_path):
+    found, _ = rule_findings(tmp_path, GOOD_GUARDED, "guarded-by")
+    assert found == []
+
+
+def test_guarded_by_alias_guards(tmp_path):
+    files = {"bibfs_tpu/serve/q.py": """
+    import threading
+
+    from bibfs_tpu.analysis import guarded_by
+
+    @guarded_by(("_lock", "_cv"), "_queue")
+    class Q:
+        def __init__(self):
+            self._lock = threading.RLock()
+            self._cv = threading.Condition(self._lock)
+            self._queue = []
+
+        def put(self, x):
+            with self._cv:          # the alias satisfies the guard
+                self._queue.append(x)
+    """}
+    found, _ = rule_findings(tmp_path, files, "guarded-by")
+    assert found == []
+
+
+def test_guarded_by_declarations_inherit(tmp_path):
+    # the decorator merges down the MRO at runtime; the static rule
+    # must mirror that — a subclass mutating an inherited guarded
+    # attribute outside the lock is a finding even though its own
+    # decorator never names it
+    files = {"bibfs_tpu/serve/sub.py": """
+    import threading
+
+    from bibfs_tpu.analysis import guarded_by
+
+    @guarded_by("_lock", "_items")
+    class Base:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._items = []
+
+    @guarded_by("_other", "_extra")
+    class Child(Base):
+        def bad(self):
+            self._items = None       # inherited guard violated
+
+        def good(self):
+            with self._lock:
+                self._items = []
+    """}
+    found, _ = rule_findings(tmp_path, files, "guarded-by")
+    assert len(found) == 1 and "_items" in found[0].message
+    assert "Child.bad" in found[0].message
+
+
+def test_guarded_by_closure_is_not_guarded(tmp_path):
+    files = {"bibfs_tpu/serve/c.py": """
+    import threading
+
+    from bibfs_tpu.analysis import guarded_by
+
+    @guarded_by("_lock", "_items")
+    class C:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._items = []
+
+        def hook(self):
+            with self._lock:
+                def later():
+                    self._items.append(1)   # runs after the lock drops
+                return later
+    """}
+    found, _ = rule_findings(tmp_path, files, "guarded-by")
+    assert len(found) == 1
+
+
+# ---- lock-io ---------------------------------------------------------
+BAD_LOCK_IO = {
+    "bibfs_tpu/serve/w.py": """
+    import os
+    import subprocess
+    import threading
+    import time
+
+    class W:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def commit(self, f):
+            with self._lock:
+                os.fsync(f)
+
+        def backoff(self):
+            with self._lock:
+                time.sleep(0.1)
+
+        def spawn_locked(self):
+            subprocess.Popen(["true"])
+    """,
+}
+
+GOOD_LOCK_IO = {
+    "bibfs_tpu/serve/w.py": """
+    import os
+    import threading
+    import time
+
+    class W:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def commit(self, f):
+            with self._lock:
+                pending = f
+            os.fsync(pending)       # I/O off the lock
+
+        def backoff(self):
+            time.sleep(0.1)
+    """,
+}
+
+
+def test_lock_io_fires(tmp_path):
+    found, _ = rule_findings(tmp_path, BAD_LOCK_IO, "lock-io")
+    msgs = "\n".join(f.message for f in found)
+    assert len(found) == 3
+    assert "os.fsync" in msgs and "time.sleep" in msgs
+    assert "subprocess.Popen" in msgs  # *_locked method => lock held
+
+
+def test_lock_io_quiet_off_lock(tmp_path):
+    found, _ = rule_findings(tmp_path, GOOD_LOCK_IO, "lock-io")
+    assert found == []
+
+
+def test_lock_io_suppression_silences(tmp_path):
+    files = {"bibfs_tpu/serve/w.py": """
+    import os
+    import threading
+
+    class W:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def commit(self, f):
+            with self._lock:
+                os.fsync(f)  # bibfs: allow(lock-io): the fsync IS the ack barrier here
+    """}
+    found, suppressed = rule_findings(tmp_path, files, "lock-io")
+    assert found == []
+    assert len(suppressed) == 1 and suppressed[0].rule == "lock-io"
+
+
+# ---- error-kind ------------------------------------------------------
+def test_error_kind_fires(tmp_path):
+    files = {"bibfs_tpu/serve/x.py": """
+    from bibfs_tpu.serve.resilience import QueryError
+
+    def f(kind):
+        raise QueryError("nope", kind="transient")
+
+    def g(kind):
+        raise QueryError("nope", kind=kind)
+    """}
+    found, _ = rule_findings(tmp_path, files, "error-kind")
+    assert len(found) == 2
+    assert any("'transient'" in f.message for f in found)
+    assert any("<non-literal>" in f.message for f in found)
+
+
+def test_error_kind_quiet_on_taxonomy(tmp_path):
+    files = {"bibfs_tpu/serve/x.py": """
+    from bibfs_tpu.serve.resilience import QueryError
+
+    def f():
+        raise QueryError("full", kind="capacity")
+
+    def g():
+        raise QueryError("boom")    # defaults to internal
+    """}
+    found, _ = rule_findings(tmp_path, files, "error-kind")
+    assert found == []
+
+
+# ---- metric-mint -----------------------------------------------------
+def test_metric_mint_fires_on_unknown_mint(tmp_path):
+    files = {"bibfs_tpu/obs/x.py": """
+    from bibfs_tpu.obs.metrics import REGISTRY
+
+    C = REGISTRY.counter("bibfs_bogus_total", "not canonical")
+    """}
+    found, _ = rule_findings(tmp_path, files, "metric-mint")
+    assert len(found) == 1 and "bibfs_bogus_total" in found[0].message
+
+
+def test_metric_mint_fires_on_non_literal_mint(tmp_path):
+    files = {"bibfs_tpu/obs/x.py": """
+    from bibfs_tpu.obs.metrics import REGISTRY
+
+    def mint(name):
+        return REGISTRY.counter(name, "dynamic")
+    """}
+    found, _ = rule_findings(tmp_path, files, "metric-mint")
+    assert len(found) == 1 and "non-literal" in found[0].message
+
+
+def test_metric_mint_fires_on_drifted_literal(tmp_path):
+    files = {"bibfs_tpu/serve/gates.py": """
+    FAMILIES = ("bibfs_queries_total", "bibfs_totally_made_up")
+    """}
+    found, _ = rule_findings(tmp_path, files, "metric-mint")
+    assert len(found) == 1 and "bibfs_totally_made_up" in found[0].message
+
+
+def test_metric_mint_quiet_on_canonical(tmp_path):
+    files = {"bibfs_tpu/serve/gates.py": """
+    from bibfs_tpu.obs.metrics import REGISTRY
+
+    C = REGISTRY.counter("bibfs_queries_total", "canonical",
+                         ("engine",))
+    FAMILIES = ("bibfs_errors_total", "bibfs_query_latency_seconds_bucket")
+    """}
+    found, _ = rule_findings(tmp_path, files, "metric-mint")
+    assert found == []
+
+
+def test_metric_mint_histogram_suffixes_resolve(tmp_path):
+    from bibfs_tpu.obs.names import canonical_family
+
+    assert canonical_family("bibfs_query_latency_seconds_bucket") == \
+        "bibfs_query_latency_seconds"
+    assert canonical_family("bibfs_queries_total_bucket") is None
+    assert canonical_family("bibfs_nope") is None
+
+
+# ---- no-bare-except --------------------------------------------------
+def test_bare_except_fires(tmp_path):
+    files = {"bibfs_tpu/serve/b.py": """
+    def f():
+        try:
+            return 1
+        except:
+            pass
+    """}
+    found, _ = rule_findings(tmp_path, files, "no-bare-except")
+    assert len(found) == 1
+
+
+def test_bare_except_quiet_on_named(tmp_path):
+    files = {"bibfs_tpu/serve/b.py": """
+    def f():
+        try:
+            return 1
+        except Exception:
+            return 0
+        finally:
+            pass
+    """}
+    found, _ = rule_findings(tmp_path, files, "no-bare-except")
+    assert found == []
+
+
+# ---- suppression policing --------------------------------------------
+def test_unjustified_suppression_is_a_finding(tmp_path):
+    files = {"bibfs_tpu/serve/b.py": """
+    def f():
+        try:
+            return 1
+        except:  # bibfs: allow(no-bare-except)
+            pass
+    """}
+    findings, suppressed = run(project_for(tmp_path, files))
+    assert len(suppressed) == 1
+    assert [f.rule for f in findings] == ["suppression"]
+    assert "justification" in findings[0].message
+
+
+def test_unused_suppression_is_a_finding(tmp_path):
+    files = {"bibfs_tpu/serve/b.py": """
+    # bibfs: allow(lock-io): nothing here actually blocks
+    def f():
+        return 1
+    """}
+    findings, _ = run(project_for(tmp_path, files))
+    assert [f.rule for f in findings] == ["suppression"]
+    assert "unused" in findings[0].message
+
+
+def test_suppression_only_matches_its_rule(tmp_path):
+    files = {"bibfs_tpu/serve/b.py": """
+    def f():
+        try:
+            return 1
+        except:  # bibfs: allow(lock-io): wrong rule name
+            pass
+    """}
+    findings, suppressed = run(project_for(tmp_path, files))
+    assert suppressed == []
+    rules = sorted(f.rule for f in findings)
+    assert rules == ["no-bare-except", "suppression"]
+
+
+def test_docstring_mention_is_not_a_suppression(tmp_path):
+    files = {"bibfs_tpu/serve/b.py": '''
+    def f():
+        """Write `# bibfs: allow(lock-io): why` to suppress."""
+        return 1
+    '''}
+    findings, suppressed = run(project_for(tmp_path, files))
+    assert findings == [] and suppressed == []
+
+
+# ---- the real tree ---------------------------------------------------
+def test_repo_lints_clean():
+    """The CI gate in tier-1 form: the shipped tree has zero
+    unsuppressed findings (and so stays lintable offline)."""
+    project = Project.load(lint_mod._repo_root())
+    findings, _suppressed = run(project)
+    assert findings == [], "\n".join(map(repr, findings))
+
+
+def test_cli_list_rules_and_exit_codes(tmp_path, capsys):
+    assert lint_mod.main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for name in ("atomic-write", "guarded-by", "lock-io", "error-kind",
+                 "metric-mint", "no-bare-except"):
+        assert name in out
+    bad = tmp_path / "bibfs_tpu" / "store" / "w.py"
+    bad.parent.mkdir(parents=True)
+    bad.write_text(textwrap.dedent(
+        BAD_ATOMIC["bibfs_tpu/store/writer.py"]
+    ))
+    rc = lint_mod.main(["--root", str(tmp_path), str(bad)])
+    assert rc == 1
+
+
+def test_annotation_metadata_merges():
+    from bibfs_tpu.serve.pipeline import PipelinedQueryEngine
+
+    meta = PipelinedQueryEngine.__bibfs_guarded_by__
+    # own declaration plus the base engine's, merged down the MRO
+    assert meta["_queue"] == ("_lock", "_cv")
+    assert meta["_runtimes"] == ("_rt_lock",)
+
+
+def test_guarded_by_decorator_validates():
+    from bibfs_tpu.analysis import guarded_by
+
+    with pytest.raises(TypeError):
+        guarded_by("_lock")  # no attrs
+    with pytest.raises(TypeError):
+        guarded_by(3, "_x")
