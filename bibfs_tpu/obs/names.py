@@ -99,6 +99,17 @@ ORACLE_METRIC_FAMILIES = (
     "bibfs_oracle_index_age_seconds",
 )
 
+#: mesh-sharded serving route (serve/routes/mesh.py); minted at route
+#: construction (engines configured with ``mesh=``), so a mesh-enabled
+#: process renders the whole group at zero before any mesh traffic
+MESH_METRIC_FAMILIES = (
+    "bibfs_mesh_shards",
+    "bibfs_mesh_batches_total",
+    "bibfs_mesh_exchange_bytes_total",
+    "bibfs_mesh_breaker_state",
+    "bibfs_mesh_crossover_reroutes_total",
+)
+
 #: build identity (obs/metrics.py; minted at every registry init)
 BUILD_INFO_METRIC = "bibfs_build_info"
 
@@ -125,6 +136,7 @@ ALL_METRIC_NAMES = frozenset(
     + STORE_METRIC_FAMILIES
     + DURABLE_METRIC_FAMILIES
     + ORACLE_METRIC_FAMILIES
+    + MESH_METRIC_FAMILIES
     + _FLEET_ONLY
     + (BUILD_INFO_METRIC,)
 )

@@ -74,8 +74,91 @@ def ell_bucket_key(g) -> tuple:
     device table: everything the jit caches specialize on besides batch
     mode and rung. Two graphs — or two VERSIONS of one graph — with the
     same key reuse each other's compiled programs, which is what makes
-    a same-bucket hot-swap cost zero recompiles."""
+    a same-bucket hot-swap cost zero recompiles.
+
+    This is the SINGLE-DEVICE identity. A mesh program over the same
+    padded shape compiles a different executable (shard geometry is
+    part of what the jit specializes on), so mesh dispatches key
+    through :func:`placement_bucket_key` — a bare padded-shape key
+    would silently collide the two."""
     return ("ell", g.n_pad, g.width)
+
+
+def placement_bucket_key(base_key: tuple, *, kind: str, shards: int,
+                         extra: tuple = ()) -> tuple:
+    """Extend a shape bucket key with its mesh/shard placement.
+
+    The compiled-program caches specialize on the SPMD partitioning as
+    much as on the padded shape: a ``[n_pad, width]`` table compiled
+    for one device and the same table 1D-sharded over 8 are different
+    executables, and before this helper the ExecutableCache would have
+    counted the second as a hit on the first. ``kind`` names the
+    placement family (``"mesh1d"`` vertex-sharded, ``"dp"``
+    query-sharded), ``shards`` the mesh size, ``extra`` any further
+    program discriminators (collective mode, plane dtype, batch
+    rung)."""
+    return base_key + ((kind, int(shards)) + tuple(extra),)
+
+
+#: row alignment of the dp-batch replicated table (below). 1024 rows of
+#: int8 shard plane x 128 lanes = 128 KiB per rung — fine enough that
+#: pad waste stays under ~10% from 10k vertices up, coarse enough that
+#: the dp program ladder stays bounded (one program per 1024-row rung x
+#: width rung x lane rung).
+DP_ROW_ALIGN = 1024
+
+
+def dp_aligned_ell(
+    n: int,
+    edges: np.ndarray | None = None,
+    *,
+    pairs: np.ndarray | None = None,
+    row_align: int = DP_ROW_ALIGN,
+) -> EllGraph:
+    """The dp-batch (query-sharded) serving table: rows aligned to a
+    FINE ladder, width bucketed to the geometric rung.
+
+    The dp route deliberately does NOT reuse :func:`bucketed_ell`'s
+    geometric row ladder: the batch-minor kernel's working set per
+    shard is the ``[n_pad, b_loc]`` int8 plane, and the measured 1.5-2x
+    dp advantage over the single-device device route (bench_mesh.json)
+    comes precisely from that plane staying cache-resident — rounding
+    rows UP to the next power-of-two rung (e.g. 10240 -> 16384) spills
+    it and erases the win. Width stays on the geometric rung (measured
+    free for this kernel), so the compiled-program ladder is one
+    program per (1024-row rung x width rung x lane rung) — finer than
+    the geometric buckets, still bounded, and every dispatch is noted
+    in the ExecutableCache under its :func:`placement_bucket_key` so
+    the trade stays visible in the reuse counters."""
+    g = build_ell(n, edges, pairs=pairs, pad_multiple=max(int(row_align), 8))
+    w = bucket_width(g.width)
+    if w == g.width:
+        return g
+    nbr = np.zeros((g.n_pad, w), dtype=np.int32)
+    nbr[:, : g.width] = g.nbr
+    return EllGraph(
+        n=g.n, n_pad=g.n_pad, width=w, num_edges=g.num_edges,
+        nbr=nbr, deg=g.deg, overflow=g.overflow,
+    )
+
+
+def repad_rows(g: EllGraph, multiple: int) -> EllGraph:
+    """Re-pad an ELL table's vertex rows up to a multiple (isolated
+    degree-0 rows, the same semantically-free padding the buckets use)
+    — the mesh route's shard-divisibility fix for meshes whose size
+    does not divide the bucket rung."""
+    mult = max(int(multiple), 1)
+    if g.n_pad % mult == 0:
+        return g
+    rows = -(-g.n_pad // mult) * mult
+    nbr = np.zeros((rows, g.width), dtype=np.int32)
+    nbr[: g.n_pad] = g.nbr
+    deg = np.zeros(rows, dtype=np.int32)
+    deg[: g.n_pad] = g.deg
+    return EllGraph(
+        n=g.n, n_pad=rows, width=g.width, num_edges=g.num_edges,
+        nbr=nbr, deg=deg, overflow=g.overflow,
+    )
 
 
 def bucketed_ell(

@@ -325,6 +325,20 @@ def main(argv=None):
         "(default: the calibrated batch-vs-latency crossover); below "
         "it queries run per-query on the host runtime",
     )
+    ap.add_argument(
+        "--mesh",
+        default=None,
+        metavar="DEVICES",
+        help='enable route="mesh": serve batches from a DEVICES-wide '
+        "device mesh (serve/routes/mesh.py) — dp-batch flushes "
+        "(query-sharded, zero collectives) for throughput, the "
+        "1D vertex-sharded program with the bitpacked frontier "
+        "exchange for mesh-scale graphs. 'auto' uses every visible "
+        "device. Below-crossover traffic (calibration.json, the "
+        "platform entry's mesh block) reroutes to the single-device "
+        "rungs automatically; the mesh rung carries its own breaker "
+        "and retry policy",
+    )
     ap.add_argument("--max-batch", type=int, default=1024,
                     help="largest single device flush (default 1024)")
     ap.add_argument("--cache-entries", type=int, default=64,
@@ -543,6 +557,10 @@ def _serve(args, n, edges, store, QueryEngine, PipelinedQueryEngine,
             max_batch=args.max_batch,
             cache_entries=args.cache_entries,
         )
+        if args.mesh is not None:
+            kwargs["mesh"] = (
+                "auto" if args.mesh == "auto" else int(args.mesh)
+            )
         if args.inject_faults is not None:
             import os
 
@@ -745,10 +763,12 @@ def _serve(args, n, edges, store, QueryEngine, PipelinedQueryEngine,
 
     stats = engine.stats()
     print(
-        "[Serve] {q} queries: {dq} device-batched ({db} flushes), "
-        "{hq} host, {ov} overlay-exact, {orc} oracle-served, "
-        "{cs} cache-served; exec programs {ep} ({eh} reused)".format(
-            q=stats["queries"], dq=stats["device_queries"],
+        "[Serve] {q} queries: {mq} mesh, {dq} device-batched "
+        "({db} flushes), {hq} host, {ov} overlay-exact, "
+        "{orc} oracle-served, {cs} cache-served; "
+        "exec programs {ep} ({eh} reused)".format(
+            q=stats["queries"], mq=stats["mesh_queries"],
+            dq=stats["device_queries"],
             db=stats["device_batches"], hq=stats["host_queries"],
             ov=stats["overlay_queries"], cs=stats["cache_served"],
             orc=stats["oracle_served"],

@@ -86,7 +86,7 @@ def _engine_counter_bank(label: str) -> MetricBank:
     routed = REGISTRY.counter(
         "bibfs_queries_routed_total",
         "Queries by resolution route "
-        "(trivial/oracle/cache/device/host/overlay)",
+        "(trivial/oracle/cache/mesh/device/host/overlay)",
         ("engine", "route"),
     )
     batches = REGISTRY.counter(
@@ -107,6 +107,7 @@ def _engine_counter_bank(label: str) -> MetricBank:
         "device_queries": routed.labels(engine=label, route="device"),
         "host_queries": routed.labels(engine=label, route="host"),
         "overlay_queries": routed.labels(engine=label, route="overlay"),
+        "mesh_queries": routed.labels(engine=label, route="mesh"),
         "inserts_skipped": skipped.labels(engine=label),
     })
 
@@ -117,7 +118,7 @@ class _ResilienceCells:
     /metrics scrape shows the families at zero from the first breath —
     the chaos CI gate asserts they render even before anything fails."""
 
-    def __init__(self, label: str):
+    def __init__(self, label: str, *, mesh: bool = False):
         errors = REGISTRY.counter(
             "bibfs_errors_total",
             "Per-ticket query failures by taxonomy kind",
@@ -155,17 +156,48 @@ class _ResilienceCells:
         self.errors = {
             k: errors.labels(engine=label, kind=k) for k in ERROR_KINDS
         }
+        # every ladder transition minted eagerly (the chaos gate asserts
+        # the families render at zero); a mesh-configured engine adds
+        # its rung's two exits (next-eligible device, or straight to
+        # host on a CPU substrate / finish-worker recovery)
+        self._fallback_family = fallbacks
+        pairs = [("device", "host"), ("host", "serial")]
+        if mesh:
+            pairs = [("mesh", "device"), ("mesh", "host")] + pairs
         self.fallbacks = {
-            ("device", "host"): fallbacks.labels(
-                **{"engine": label, "from": "device", "to": "host"}
-            ),
-            ("host", "serial"): fallbacks.labels(
-                **{"engine": label, "from": "host", "to": "serial"}
-            ),
+            (a, b): fallbacks.labels(**{"engine": label, "from": a, "to": b})
+            for a, b in pairs
         }
-        self.retries = retries.labels(engine=label, route="device")
+        self._retry_family = retries
+        self._retry_cells = {
+            "device": retries.labels(engine=label, route="device"),
+        }
+        if mesh:
+            self._retry_cells["mesh"] = retries.labels(
+                engine=label, route="mesh"
+            )
         self.bisections = bisections.labels(engine=label)
         self._label = label
+
+    def retry_cell(self, route: str):
+        """The ``bibfs_retries_total{route=...}`` cell for one route
+        (labelled on demand for routes outside the eager set)."""
+        cell = self._retry_cells.get(route)
+        if cell is None:
+            cell = self._retry_family.labels(
+                engine=self._label, route=route
+            )
+            self._retry_cells[route] = cell
+        return cell
+
+    def fallback_cell(self, frm: str, to: str):
+        cell = self.fallbacks.get((frm, to))
+        if cell is None:
+            cell = self._fallback_family.labels(
+                **{"engine": self._label, "from": frm, "to": to}
+            )
+            self.fallbacks[(frm, to)] = cell
+        return cell
 
     def on_breaker_transition(self, state: str) -> None:
         self.breaker_gauge.set(BREAKER_STATE_CODES[state])
@@ -178,7 +210,7 @@ class _ResilienceCells:
                 f"{a}->{b}": c.value
                 for (a, b), c in self.fallbacks.items()
             },
-            "retries": self.retries.value,
+            "retries": sum(c.value for c in self._retry_cells.values()),
             "bisections": self.bisections.value,
         }
 
@@ -230,7 +262,8 @@ class _Pending:
 
 
 @guarded_by("_lock", "_graph", "bucket_key", "_host_solver",
-            "host_native_graph", "_serial_solver", "host_backend_resolved")
+            "host_native_graph", "_serial_solver", "host_backend_resolved",
+            "_mesh_graph", "mesh_bucket_key", "_dp_graph", "dp_bucket_key")
 class _GraphRuntime:
     """Everything an engine knows about solving ONE immutable graph
     snapshot: the lazily built+uploaded device graph and its compiled-
@@ -262,6 +295,10 @@ class _GraphRuntime:
         # device->host recovery path) the finish worker
         self._graph = None
         self.bucket_key = None
+        self._mesh_graph = None
+        self.mesh_bucket_key = None
+        self._dp_graph = None
+        self.dp_bucket_key = None
         self._host_solver = None
         self.host_native_graph = None
         self._serial_solver = None
@@ -292,6 +329,54 @@ class _GraphRuntime:
                         )
                         self._graph = g
         return self._graph
+
+    def mesh_graph(self, route):
+        """The vertex-sharded device graph for the mesh route (built
+        and uploaded on first mesh-routed flush — a runtime that never
+        routes mesh never pays the shard build). Rows are re-padded to
+        the mesh size when the bucket rung does not divide, and the
+        compiled-program identity lands in ``mesh_bucket_key`` WITH the
+        shard geometry (:func:`placement_bucket_key`) so it can never
+        collide with the single-device key of the same padded shape.
+        Rebuilt per runtime, so a store hot-swap re-shards the new
+        snapshot the same way it re-uploads the dense table."""
+        g = self._mesh_graph
+        if g is None:
+            from bibfs_tpu.serve.buckets import repad_rows
+            from bibfs_tpu.solvers.sharded import ShardedGraph
+
+            with self._lock:
+                g = self._mesh_graph
+                if g is None:
+                    ell = repad_rows(self.snapshot.ell(), route.ndev)
+                    g = ShardedGraph(ell, route.mesh)
+                    self.mesh_bucket_key = ell_bucket_key(ell)
+                    self._mesh_graph = g
+        return g
+
+    def dp_graph(self):
+        """The dp-batch replicated table for the mesh route's
+        query-sharded sub-path, on the FINE row ladder
+        (:func:`bibfs_tpu.serve.buckets.dp_aligned_ell` — the measured
+        dp win over the device route is shard-plane cache residency,
+        which the geometric row buckets would spill). Built lazily on
+        the first dp-routed flush; rebuilt per runtime across
+        hot-swaps like every other device table."""
+        g = self._dp_graph
+        if g is None:
+            from bibfs_tpu.serve.buckets import dp_aligned_ell
+            from bibfs_tpu.solvers.dense import DeviceGraph
+
+            with self._lock:
+                g = self._dp_graph
+                if g is None:
+                    ell = dp_aligned_ell(
+                        self.snapshot.n, pairs=self.snapshot.pairs
+                    )
+                    g = DeviceGraph.from_ell(ell, device=self._device)
+                    self.dp_bucket_key = ell_bucket_key(ell)
+                    self._dp_graph = g
+        return g
 
     def get_host_solver(self):
         """The sub-crossover per-query path: the native C++ runtime when
@@ -449,6 +534,16 @@ class QueryEngine:
         crossover flushes fall back to the host ladder instead of
         failing — a dead accelerator degrades throughput, not
         availability.
+    mesh : enable ``route="mesh"`` — serve batches from the device
+        mesh (``serve/routes/mesh.py``): an int (mesh device count),
+        ``"auto"`` (every visible device), or a
+        :class:`~bibfs_tpu.serve.routes.MeshConfig`. The mesh rung
+        leads the fallback ladder (mesh -> device -> host) with its own
+        circuit breaker and retry policy; below-crossover traffic is
+        rerouted to the single-device rungs (calibrated constants, the
+        platform's ``mesh`` block in ``calibration.json``) and counted
+        in ``bibfs_mesh_crossover_reroutes_total``. Default None: no
+        mesh rung, the pre-mesh ladder exactly.
     health_window_s : sliding window for the health monitor's recent-
         error degradation input (default 5.0; the chaos harness
         shrinks it to measure recovery time).
@@ -481,7 +576,9 @@ class QueryEngine:
         retry: RetryPolicy | None = None,
         breaker: CircuitBreaker | None = None,
         health_window_s: float = 5.0,
+        mesh=None,
     ):
+        from bibfs_tpu.serve.routes import MeshConfig, mesh_prebuild
         from bibfs_tpu.solvers.batch_minor import small_batch_threshold
 
         # cheap argument validation FIRST: below here a store-backed
@@ -493,6 +590,14 @@ class QueryEngine:
             )
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        # mesh validation (config coercion AND mesh construction) also
+        # runs pre-pin: make_1d_mesh raises on an over-sized device
+        # count, and raising after the pin would leak it
+        self._mesh_cfg = None
+        mesh_pre = None
+        if mesh is not None:
+            self._mesh_cfg = MeshConfig.coerce(mesh)
+            mesh_pre = mesh_prebuild(self._mesh_cfg)
         if oracle_k is not None:
             if store is not None:
                 raise ValueError(
@@ -581,7 +686,9 @@ class QueryEngine:
         # transition hook keeps the bibfs_breaker_state gauge exact.
         self._faults = FaultPlan.from_env() if faults is None else faults
         self._retry = RetryPolicy() if retry is None else retry
-        self._res_cells = _ResilienceCells(self.obs_label)
+        self._res_cells = _ResilienceCells(
+            self.obs_label, mesh=self._mesh_cfg is not None
+        )
         self._breaker = CircuitBreaker() if breaker is None else breaker
         # listener, not ownership: a breaker SHARED across engines (one
         # accelerator, several engines) keeps every engine's gauge exact.
@@ -648,6 +755,15 @@ class QueryEngine:
         # solved per route), inserts_skipped (forest-bank inserts skipped
         # by flush-time hygiene)
         self.counters = _engine_counter_bank(self.obs_label)
+        # the pluggable route set + fallback ladder (serve/routes):
+        # oracle/overlay answer from their own seams, the batch ladder
+        # runs mesh -> device -> host with serial reached per-query
+        # through the host isolator
+        from bibfs_tpu.serve.routes import build_routes
+
+        self.routes, self._ladder = build_routes(
+            self, self._mesh_cfg, mesh_pre
+        )
         # direct cell handles for the per-query submit path (skips the
         # bank's read-modify-write indirection in the hot loop)
         self._c_queries = self.counters.cell("queries")
@@ -766,21 +882,12 @@ class QueryEngine:
         return self._store.oracle(name)
 
     def _consult_oracle(self, t: _Pending, name) -> bool:
-        """Consult the oracle tier for one submitted query. True =
+        """Consult the oracle tier for one submitted query (delegates
+        to the :class:`~bibfs_tpu.serve.routes.OracleRoute`). True =
         served exactly (``t.result`` set, ``route="oracle"``); False =
         fall through (with ``t.cutoff`` armed when the consult produced
         a usable upper bound)."""
-        orc = self._oracle_for(name)
-        if orc is None:
-            return False
-        ans = orc.consult(t.src, t.dst)
-        if ans is None:
-            return False
-        if ans.result is not None:
-            t.result = ans.result
-            return True
-        t.cutoff = ans.ub
-        return False
+        return self.routes["oracle"].consult(t, name)
 
     @property
     def n(self) -> int:
@@ -976,91 +1083,75 @@ class QueryEngine:
             if overlay is not None:
                 self._flush_overlay(overlay, pairs, unique)
                 return
-            if len(pairs) < self.flush_threshold or not self._use_device():
-                self._flush_host(pairs, unique)
-                return
             for i in range(0, len(pairs), self.max_batch):
-                chunk = pairs[i: i + self.max_batch]
-                if i and len(chunk) < self.flush_threshold:
-                    # a sub-crossover tail after full chunks: host latency
-                    # beats padding a whole batch rung for a few stragglers
-                    self._flush_host(chunk, unique)
-                else:
-                    self._flush_device(chunk, unique)
+                self._flush_ladder(pairs[i: i + self.max_batch], unique)
 
     def _flush_overlay(self, overlay, pairs, unique) -> None:
         """The exact-answering route while live edge updates are
-        pending: every query solves against base+delta on the host
-        (:meth:`DeltaOverlay.solve`), isolated per query. No cache
-        lookup or banking — distance-cache entries are namespaced by
-        snapshot digest, and the overlaid graph is not (yet) any
-        snapshot."""
+        pending (:class:`~bibfs_tpu.serve.routes.OverlayRoute`): every
+        query solves against base+delta on the host, isolated per
+        query. No cache lookup or banking — distance-cache entries are
+        namespaced by snapshot digest, and the overlaid graph is not
+        (yet) any snapshot."""
         with span("overlay_batch", batch=len(pairs)):
-            corr = overlay.correction()  # one O(delta) capture per batch
-            for key in pairs:
-                try:
-                    res = overlay.solve(*key, correction=corr)
-                except Exception as exc:
-                    self._resolve_error(
-                        unique[key], to_query_error(exc, key)
-                    )
+            for key, res in self.routes["overlay"].solve_iter(
+                overlay, pairs
+            ):
+                if isinstance(res, QueryError):
+                    self._resolve_error(unique[key], res)
                     continue
                 self._c_overlay.inc()
                 for t in unique[key]:
                     t.result = res
 
-    def _flush_device(self, pairs, unique) -> None:
-        results = self._device_attempt(pairs)
-        if results is None:
-            # every retry burned (or the breaker is open): degrade to
-            # the host ladder instead of failing the batch
-            self._note_fallback("device", "host")
-            self._flush_host(pairs, unique)
-            return
-        for i, (src, dst) in enumerate(pairs):
-            self._resolve(unique[(src, dst)], src, dst, results[i])
+    def _next_rung(self, i: int, rt, pairs) -> str:
+        """The rung a failed/ineligible ladder step actually degrades
+        TO: the next ladder name that is terminal (``host``) or
+        eligible for this batch — the ``to`` label of the fallback
+        counter must name where the batch really went."""
+        for name in self._ladder[i + 1:]:
+            if name == "host" or self.routes[name].eligible(rt, pairs):
+                return name
+        return "host"
 
-    def _device_attempt(self, pairs) -> list[BFSResult] | None:
-        """The resilient device route: bounded retries with backoff
-        behind the circuit breaker. Returns the batch results, or None
-        when the route is unavailable (breaker open / retries
-        exhausted) — the caller degrades to the host ladder. The
-        fault-free fast path is one ``allow()``/``record_success()``
-        pair per flush."""
-        retry = self._retry
-        if not self._breaker.allow():
-            return None
-        attempt = 0
-        try:
-            while True:
-                try:
-                    out, finish, t0 = self._device_launch(pairs)
-                    results = self._device_finish(out, finish, t0, pairs)
-                except Exception:
-                    self._breaker.record_failure()
-                    attempt += 1
-                    # gate BEFORE counting/sleeping (exactly one allow()
-                    # per launch, every True followed by a record): when
-                    # this failure just opened the breaker there is no
-                    # retry to count and no backoff worth blocking for
-                    if (attempt < retry.attempts
-                            and self._breaker.allow()):
-                        self._res_cells.retries.inc()
-                        time.sleep(retry.delay_s(attempt - 1))
-                        continue
-                    return None
-                self._breaker.record_success()
-                return results
-        except BaseException:
-            # an escape past the Exception handler (KeyboardInterrupt
-            # mid-launch, or during the backoff sleep whose allow() is
-            # already claimed) must not leave the admitting allow()
-            # unrecorded — a leaked half-open probe claim makes allow()
-            # return False forever and the device route never recovers
-            # (the pipelined launch path guards the same way; an extra
-            # record_failure after a counted one is harmless)
-            self._breaker.record_failure()
-            raise
+    def _note_crossover(self) -> None:
+        """A below-crossover batch skipped the mesh rung — a routing
+        decision, counted apart from failures."""
+        mesh = self.routes.get("mesh")
+        if mesh is not None:
+            mesh.cells.reroutes.inc()
+
+    def _flush_ladder(self, pairs, unique) -> None:
+        """Walk the fallback ladder for one chunk: each eligible rung
+        gets a resilient :meth:`~bibfs_tpu.serve.routes.Route.attempt`
+        (bounded retries behind its own breaker); an unavailable rung
+        degrades to the next (counted in
+        ``bibfs_route_fallbacks_total``), and the terminal host rung
+        absorbs whatever is left behind its bisection isolator. A
+        sub-crossover chunk (including the tail after full device
+        chunks) skips straight past the ineligible dispatch rungs —
+        host latency beats padding a whole batch rung for a few
+        stragglers."""
+        rt = self._current_rt()
+        for i, name in enumerate(self._ladder):
+            if name == "host":
+                break
+            route = self.routes[name]
+            if not route.eligible(rt, pairs):
+                if name == "mesh":
+                    self._note_crossover()
+                continue
+            results = route.attempt(
+                rt, pairs, self._cutoffs_for(pairs, unique)
+            )
+            if results is not None:
+                for j, (src, dst) in enumerate(pairs):
+                    self._resolve(unique[(src, dst)], src, dst, results[j])
+                return
+            # every retry burned (or the breaker is open): degrade down
+            # the ladder instead of failing the batch
+            self._note_fallback(name, self._next_rung(i, rt, pairs))
+        self._flush_host(pairs, unique)
 
     def _device_launch(self, pairs):
         """Stage 1 of a device flush: enqueue ONE batched program for
@@ -1248,12 +1339,15 @@ class QueryEngine:
 
     def _solve_serial_one(self, src: int, dst: int,
                           cutoff: int | None = None) -> BFSResult:
-        """The bottom of the fallback ladder: the pure-NumPy serial
-        oracle over the bound graph's CSR — no native runtime, no device
-        stack, nothing left to be broken but the graph itself. (A thin
-        seam over the runtime so chaos tests can break this rung per
-        engine.)"""
-        return self._current_rt().solve_serial_one(src, dst, cutoff)
+        """The bottom of the fallback ladder
+        (:class:`~bibfs_tpu.serve.routes.SerialRoute`): the pure-NumPy
+        serial oracle over the bound graph's CSR — no native runtime,
+        no device stack, nothing left to be broken but the graph
+        itself. (A thin seam over the route so chaos tests can break
+        this rung per engine.)"""
+        return self.routes["serial"].solve_one(
+            self._current_rt(), src, dst, cutoff
+        )
 
     def _resolve_error(self, tickets, err: QueryError) -> None:
         """Fail exactly these tickets with a structured error (their
@@ -1277,7 +1371,7 @@ class QueryEngine:
             self.health.note_error(n)
 
     def _note_fallback(self, frm: str, to: str) -> None:
-        self._res_cells.fallbacks[(frm, to)].inc()
+        self._res_cells.fallback_cell(frm, to).inc()
 
     def _paths_to_bank(self, results) -> set:
         """Flush-time banking hygiene, host edition: of this flush's
@@ -1428,11 +1522,16 @@ class QueryEngine:
         c = dict(self.counters)
         rt = self._current_rt()
         solved = (
-            c["device_queries"] + c["host_queries"] + c["overlay_queries"]
+            c["device_queries"] + c["host_queries"]
+            + c["overlay_queries"] + c["mesh_queries"]
         )
         return {
             **c,
             "solver_dispatch_free": c["queries"] - solved,
+            "ladder": list(self._ladder),
+            "routes": {
+                name: route.stats() for name, route in self.routes.items()
+            },
             "dist_cache": self.dist_cache.stats(),
             "exec_cache": self.exec_cache.stats(),
             "flush_threshold": self.flush_threshold,

@@ -66,8 +66,8 @@ ENV_VAR = "BIBFS_FAULTS"
 #: seams the serving engines actually fire (parse rejects anything else:
 #: a typo'd site in a chaos spec must fail loudly, not silently inject
 #: nothing and pass the soak)
-KNOWN_SITES = ("device", "device_finish", "host_batch",
-               "wal_write", "wal_fsync", "manifest_rename")
+KNOWN_SITES = ("device", "device_finish", "mesh", "mesh_finish",
+               "host_batch", "wal_write", "wal_fsync", "manifest_rename")
 
 KINDS = ("error", "latency")
 

@@ -699,15 +699,27 @@ class PipelinedQueryEngine(QueryEngine):
             pairs = self._serve_cached(unique)
             if not pairs:
                 return
-            if len(pairs) >= self.flush_threshold and self._use_device():
-                # the breaker gates the device route: open = the route is
-                # known-bad, go straight to the host ladder (half-open
-                # lets one probe batch through; its outcome closes or
-                # re-opens)
-                if self._breaker.allow():
-                    self._launch_device(rt, pairs, unique)
-                    return
-                self._note_fallback("device", "host")
+            # the fallback ladder, pipelined edition: each eligible
+            # dispatch rung (mesh, device) is gated by its OWN breaker
+            # (open = the route is known-bad, go straight down; a
+            # half-open breaker lets one probe batch through and its
+            # outcome closes or re-opens it), launches on the flusher
+            # and finishes on the worker; the terminal host rung solves
+            # right here behind the bisection isolator
+            for i, rung in enumerate(self._ladder):
+                if rung == "host":
+                    break
+                route = self.routes[rung]
+                if not route.eligible(rt, pairs):
+                    if rung == "mesh":
+                        self._note_crossover()
+                    continue
+                if route.breaker is None or route.breaker.allow():
+                    if self._launch_dispatch(route, rt, pairs, unique):
+                        return
+                self._note_fallback(
+                    rung, self._next_rung(i, rt, pairs)
+                )
             self._launch_host(rt, pairs, unique)
 
     def _launch_overlay(self, overlay, unique) -> None:
@@ -720,17 +732,16 @@ class PipelinedQueryEngine(QueryEngine):
         self.stages.enter()
         try:
             with span("overlay_batch", batch=len(unique)):
-                corr = overlay.correction()  # one capture per batch
                 lats = []
                 served = 0
-                for key, tickets in unique.items():
-                    try:
-                        res = overlay.solve(*key, correction=corr)
-                    except Exception as exc:
-                        err = to_query_error(exc, key)
+                for key, res in self.routes["overlay"].solve_iter(
+                    overlay, list(unique)
+                ):
+                    tickets = unique[key]
+                    if isinstance(res, QueryError):
                         for t in tickets:
                             if not t.done():
-                                self._fail_ticket(t, err)
+                                self._fail_ticket(t, res)
                         continue
                     served += 1
                     for t in tickets:
@@ -774,18 +785,22 @@ class PipelinedQueryEngine(QueryEngine):
                 self._cv.notify_all()
         return pairs
 
-    # -- device route: dispatch on the flusher, finish on the worker --
-    def _launch_device(self, rt, pairs, unique) -> None:
-        """Resilient device dispatch: bounded retries with backoff on
-        the flusher (the breaker already admitted this batch); when the
-        launch seam stays dead, release the in-flight slot and degrade
-        the batch to the host ladder instead of failing its tickets.
-        The breaker's success is recorded at FINISH time (a dispatch
-        that enqueues but cannot execute must not close a half-open
+    # -- dispatch rungs (mesh, device): launch on the flusher, finish
+    # -- on the worker
+    def _launch_dispatch(self, route, rt, pairs, unique) -> bool:
+        """Resilient dispatch for one ladder rung: bounded retries with
+        backoff on the flusher (the route's breaker already admitted
+        this batch); when the launch seam stays dead, release the
+        in-flight slot and return False — the ladder walk degrades the
+        batch to the next rung instead of failing its tickets. The
+        breaker's success is recorded at FINISH time (a dispatch that
+        enqueues but cannot execute must not close a half-open
         breaker). ``rt`` rides along to the finish worker with its own
         snapshot pin — the finish of batch k must decode and bank on
         the snapshot it launched on, even if the store swaps before the
         worker gets to it."""
+        breaker = route.breaker
+        retry = route.retry
         self._inflight.acquire()  # double-buffer backpressure
         # "one batch time" (batch_service_max_ms) is measured from AFTER
         # the in-flight window opens: including the acquire wait would
@@ -799,34 +814,33 @@ class PipelinedQueryEngine(QueryEngine):
                 try:
                     self.stages.enter()
                     try:
-                        out, finish, t0 = self._device_launch(pairs)
+                        out, finish, t0 = route.launch(rt, pairs)
                     finally:
                         self.stages.exit()
                     break
                 except Exception as e:
-                    self._breaker.record_failure()
+                    breaker.record_failure()
                     self._record_error(e)
                     attempt += 1
                     # gate BEFORE counting/sleeping: when this failure
                     # was the one that opened the breaker, there is no
                     # retry to count and no backoff worth blocking the
                     # flusher for
-                    if (attempt < self._retry.attempts
-                            and self._breaker.allow()):
-                        self._res_cells.retries.inc()
-                        time.sleep(self._retry.delay_s(attempt - 1))
+                    if (retry is not None and attempt < retry.attempts
+                            and breaker.allow()):
+                        self._res_cells.retry_cell(route.name).inc()
+                        time.sleep(retry.delay_s(attempt - 1))
                         continue
                     held = False
                     self._inflight.release()
-                    self._note_fallback("device", "host")
-                    self._launch_host(rt, pairs, unique)
-                    return
+                    return False
             rt.snapshot.retain()
             job_pin = True
             self._finish_pool.submit(
-                self._device_finish_job, rt, out, finish, t0, pairs,
-                unique, t_launch,
+                self._dispatch_finish_job, route, rt, out, finish, t0,
+                pairs, unique, t_launch,
             )
+            return True
         except BaseException:
             # an escape outside the retry loop (KeyboardInterrupt, a
             # dead finish pool raising on submit) must not leak the
@@ -835,32 +849,33 @@ class PipelinedQueryEngine(QueryEngine):
             # claim: the allow() that admitted this batch must get its
             # record (failure, conservatively; an extra record_failure
             # after a counted one is harmless) or allow() returns
-            # False forever and the device route never recovers
-            self._breaker.record_failure()
+            # False forever and the route never recovers
+            breaker.record_failure()
             if job_pin:
                 rt.snapshot.release()
             if held:
                 self._inflight.release()
             raise
 
-    def _device_finish_job(self, rt, out, finish, t0, pairs, unique,
-                           t_launch):
+    def _dispatch_finish_job(self, route, rt, out, finish, t0, pairs,
+                             unique, t_launch):
         self.stages.enter()
         try:
             with self._bound(rt):  # decode/bank on the LAUNCH snapshot
                 try:
-                    # counters inside _device_finish are safe un-locked:
+                    # counters inside route.finish are safe un-locked:
                     # this pool has exactly ONE worker, the only
-                    # device-side mutator
-                    results = self._device_finish(out, finish, t0, pairs)
+                    # dispatch-side mutator
+                    results = route.finish(out, finish, t0, pairs)
                 except Exception as e:
-                    # mid-execution device failure: the batch is already
-                    # off the flusher, so recover it right here on the
-                    # finish worker through the host ladder — tickets
-                    # fail only if every rung fails them individually
-                    self._breaker.record_failure()
+                    # mid-execution dispatch failure: the batch is
+                    # already off the flusher, so recover it right here
+                    # on the finish worker through the host ladder —
+                    # tickets fail only if every rung fails them
+                    # individually
+                    route.breaker.record_failure()
                     self._record_error(e)
-                    self._note_fallback("device", "host")
+                    self._note_fallback(route.name, "host")
                     with span("recover_host", batch=len(pairs)):
                         self._deliver_host(
                             pairs, unique, self._solve_host_isolated(
@@ -869,7 +884,7 @@ class PipelinedQueryEngine(QueryEngine):
                             )
                         )
                     return
-                self._breaker.record_success()
+                route.breaker.record_success()
                 lats = []
                 for (src, dst), res in zip(pairs, results):
                     self.dist_cache.put_result(
@@ -906,7 +921,7 @@ class PipelinedQueryEngine(QueryEngine):
         two-stage overlap the device route gets from its
         dispatch/finish split."""
         self._inflight.acquire()
-        t_launch = time.perf_counter()  # post-acquire; see _launch_device
+        t_launch = time.perf_counter()  # post-acquire; see _launch_dispatch
         job_pin = False
         try:
             self.stages.enter()
@@ -965,6 +980,10 @@ class PipelinedQueryEngine(QueryEngine):
     def _note_fallback(self, frm: str, to: str) -> None:
         with self._lock:
             super()._note_fallback(frm, to)
+
+    def _note_crossover(self) -> None:
+        with self._lock:
+            super()._note_crossover()
 
     def _count_error(self, err: BaseException, n: int = 1) -> None:
         with self._lock:

@@ -1,0 +1,65 @@
+"""Pluggable serving routes (see :mod:`bibfs_tpu.serve.routes.base`).
+
+``build_routes`` is the one place the engines assemble their route set
+and fallback ladder: oracle and overlay answer from their own seams
+(submit time / the overlay-read barrier), the ladder proper runs
+``mesh -> device -> host`` with ``serial`` reached per-query through
+the host isolator. The mesh rung only exists when the engine was
+configured with ``mesh=`` — and then it carries its OWN circuit
+breaker and retry policy, so a dead mesh degrades to the single-device
+rungs exactly the way a dead accelerator degrades to the host ladder.
+"""
+
+from __future__ import annotations
+
+from bibfs_tpu.serve.routes.base import Route
+from bibfs_tpu.serve.routes.device import DeviceRoute
+from bibfs_tpu.serve.routes.host import HostRoute, SerialRoute
+from bibfs_tpu.serve.routes.mesh import MeshConfig, MeshRoute, mesh_prebuild
+from bibfs_tpu.serve.routes.oracle import OracleRoute
+from bibfs_tpu.serve.routes.overlay import OverlayRoute
+
+__all__ = [
+    "Route",
+    "DeviceRoute",
+    "HostRoute",
+    "SerialRoute",
+    "MeshConfig",
+    "MeshRoute",
+    "OracleRoute",
+    "OverlayRoute",
+    "build_routes",
+    "mesh_prebuild",
+]
+
+
+def build_routes(engine, mesh_cfg=None, mesh_pre=None):
+    """The engine's route set and fallback ladder.
+
+    ``mesh_cfg``/``mesh_pre`` come from the engine ctor's early
+    validation (:func:`mesh_prebuild` runs BEFORE the store snapshot is
+    pinned, so a bad mesh argument cannot leak a pin). Returns
+    ``(routes, ladder)`` — ``ladder`` is the ordered batch rungs
+    (``host`` terminal); oracle/overlay/serial sit outside it.
+    """
+    from bibfs_tpu.serve.resilience import CircuitBreaker, RetryPolicy
+
+    routes = {
+        "oracle": OracleRoute(engine),
+        "overlay": OverlayRoute(engine),
+        "device": DeviceRoute(
+            engine, retry=engine._retry, breaker=engine._breaker
+        ),
+        "host": HostRoute(engine),
+        "serial": SerialRoute(engine),
+    }
+    ladder = ("device", "host")
+    if mesh_cfg is not None:
+        vmesh, qmesh = mesh_pre
+        routes["mesh"] = MeshRoute(
+            engine, mesh_cfg, vmesh, qmesh,
+            retry=RetryPolicy(), breaker=CircuitBreaker(),
+            label=engine.obs_label,
+        )
+        ladder = ("mesh",) + ladder
+    return routes, ladder

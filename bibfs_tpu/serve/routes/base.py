@@ -1,0 +1,139 @@
+"""The pluggable Route seam — one dispatch contract for every way a
+query batch can resolve.
+
+Before this package the route ladder was hand-woven through both query
+engines and ``serve/resilience.py``: the device path carried its own
+retry/breaker loop (``QueryEngine._device_attempt``), the host path its
+own bisection isolator, the overlay path two near-identical batch loops
+(sync + pipelined), and adding a route meant re-threading all of it.
+A :class:`Route` object owns one way of solving a ``(src, dst)`` batch
+against a bound :class:`~bibfs_tpu.serve.engine._GraphRuntime`, plus
+the failure policy that wraps it:
+
+- ``eligible(rt, pairs)`` — the routing predicate (calibrated
+  crossovers, substrate checks, batch-depth thresholds);
+- ``launch(rt, pairs)`` / ``finish(out, fin, t0, pairs)`` — the
+  two-stage solve seam. Dispatch-shaped routes (device, mesh) return a
+  lazily-executing handle from ``launch`` so the pipelined engine can
+  overlap batch k's ``finish`` with batch k+1's ``launch``; host-shaped
+  routes solve in ``launch`` and make ``finish`` the identity.
+- ``attempt(rt, pairs, cutoffs)`` — the resilient synchronous wrapper:
+  bounded retries with backoff behind the route's own
+  :class:`~bibfs_tpu.serve.resilience.CircuitBreaker`. Returns the
+  batch results, or None when the route is unavailable (breaker open /
+  retries exhausted) — the caller degrades down the fallback ladder.
+
+The engines keep the orchestration (swap barriers, ticket resolution,
+banking, the pipelined finish workers); routes own *how a batch
+solves* and *when that way is worth trying*. ``oracle`` and ``overlay``
+are routes too (submit-time consult / exact base+delta answering), so
+every ``bibfs_queries_routed_total{route=...}`` label value now names a
+Route object behind one seam.
+"""
+
+from __future__ import annotations
+
+import time
+
+from bibfs_tpu.solvers.api import BFSResult
+
+
+class Route:
+    """One way of resolving a query batch (module docstring).
+
+    ``engine`` is the owning engine (routes live and die with it);
+    ``retry``/``breaker`` are the route's failure policy (None = the
+    route is not retried / not breaker-gated). ``is_dispatch`` marks
+    routes whose ``launch`` returns a lazily-executing handle worth
+    overlapping (device, mesh); the pipelined engine runs their
+    ``finish`` on its worker thread.
+    """
+
+    name: str = "route"
+    is_dispatch = False
+
+    def __init__(self, engine, *, retry=None, breaker=None):
+        self.engine = engine
+        self.retry = retry
+        self.breaker = breaker
+
+    # ---- selection ---------------------------------------------------
+    def eligible(self, rt, pairs) -> bool:
+        """Whether this route should carry ``pairs`` against ``rt``
+        right now (calibrated crossovers, substrate, batch depth). An
+        ineligible route is skipped silently — it is a routing
+        decision, not a failure."""
+        return True
+
+    # ---- the two-stage solve seam ------------------------------------
+    def launch(self, rt, pairs):
+        """Stage 1: start solving ``pairs``. Returns ``(out, fin, t0)``
+        for :meth:`finish`. Dispatch routes only enqueue here."""
+        raise NotImplementedError
+
+    def finish(self, out, fin, t0, pairs) -> list[BFSResult]:
+        """Stage 2: force execution and materialize per-query results
+        (host-side work — the pipelined engine runs it on a worker)."""
+        raise NotImplementedError
+
+    def solve(self, rt, pairs, cutoffs=None) -> list[BFSResult]:
+        """One synchronous launch+finish (no retry policy applied)."""
+        out, fin, t0 = self.launch(rt, pairs)
+        return self.finish(out, fin, t0, pairs)
+
+    # ---- the resilient synchronous wrapper ---------------------------
+    def attempt(self, rt, pairs, cutoffs=None) -> list[BFSResult] | None:
+        """Bounded retries with backoff behind the route breaker —
+        the generalization of the old ``QueryEngine._device_attempt``.
+        Returns the batch results, or None when the route is
+        unavailable (breaker open / retries exhausted) and the caller
+        should degrade down the ladder. The fault-free fast path is one
+        ``allow()``/``record_success()`` pair per batch."""
+        breaker = self.breaker
+        retry = self.retry
+        if breaker is not None and not breaker.allow():
+            return None
+        n_try = 0
+        try:
+            while True:
+                try:
+                    results = self.solve(rt, pairs, cutoffs)
+                except Exception:
+                    if breaker is not None:
+                        breaker.record_failure()
+                    n_try += 1
+                    # gate BEFORE counting/sleeping (exactly one allow()
+                    # per launch, every True followed by a record): when
+                    # this failure just opened the breaker there is no
+                    # retry to count and no backoff worth blocking for
+                    if (retry is not None and n_try < retry.attempts
+                            and (breaker is None or breaker.allow())):
+                        self._note_retry()
+                        time.sleep(retry.delay_s(n_try - 1))
+                        continue
+                    return None
+                if breaker is not None:
+                    breaker.record_success()
+                return results
+        except BaseException:
+            # an escape past the Exception handler (KeyboardInterrupt
+            # mid-launch, or during the backoff sleep whose allow() is
+            # already claimed) must not leave the admitting allow()
+            # unrecorded — a leaked half-open probe claim makes allow()
+            # return False forever and the route never recovers (an
+            # extra record_failure after a counted one is harmless)
+            if breaker is not None:
+                breaker.record_failure()
+            raise
+
+    def _note_retry(self) -> None:
+        self.engine._res_cells.retry_cell(self.name).inc()
+
+    # ---- introspection -----------------------------------------------
+    def stats(self) -> dict:
+        out: dict = {"name": self.name, "dispatch": self.is_dispatch}
+        if self.breaker is not None:
+            out["breaker"] = self.breaker.snapshot()
+        if self.retry is not None:
+            out["retry"] = self.retry.snapshot()
+        return out

@@ -1,0 +1,39 @@
+"""``route="device"`` — the single-device batched program as a Route.
+
+The launch/finish split (enqueue one batched program; force + decode +
+bank later) is the seam the pipelined engine overlaps, so the route
+exposes exactly that: ``launch`` delegates to the engine's
+``_device_launch`` (which pads the flush to a batch rung, resolves the
+batch mode, and notes the compiled-program identity) and ``finish`` to
+``_device_finish`` (forced value read, minor8 decode, result
+materialization, forest banking) — both read the thread-bound flush
+runtime, which is how the swap barrier reaches this route.
+
+Eligibility is the calibrated batch-vs-latency crossover plus the
+substrate check: batching exists to amortize the per-dispatch tax
+(~67 ms through the tunneled TPU, ~9 µs on the CPU backend —
+``calibration.json``), so on a CPU substrate the host route wins every
+regime and this route stands aside unless ``device_batches=True``
+forces it.
+"""
+
+from __future__ import annotations
+
+from bibfs_tpu.serve.routes.base import Route
+
+
+class DeviceRoute(Route):
+    """The batched single-device dispatch rung of the ladder."""
+
+    name = "device"
+    is_dispatch = True
+
+    def eligible(self, rt, pairs) -> bool:
+        return (len(pairs) >= self.engine.flush_threshold
+                and self.engine._use_device())
+
+    def launch(self, rt, pairs):
+        return self.engine._device_launch(pairs)
+
+    def finish(self, out, fin, t0, pairs):
+        return self.engine._device_finish(out, fin, t0, pairs)

@@ -1,0 +1,40 @@
+"""``route="host"`` and ``route="serial"`` — the host rungs as Routes.
+
+The host route is the ladder's terminal batch rung: it solves through
+the threaded native C batch (one GIL-free ctypes call) when the native
+runtime carries it, per-query otherwise, and it never returns
+unavailable — failure isolation happens INSIDE it (the engine's
+bisection isolator), converging a poison batch to per-query
+``QueryError`` s with the serial rung as each singleton's last chance.
+``serial`` is that bottom rung: the pure-NumPy oracle over the bound
+snapshot's CSR — no native runtime, no device stack, nothing left to be
+broken but the graph itself. It stays a first-class Route so chaos
+tests can break it per engine and so the route taxonomy is complete,
+but it is reached per-query through the isolator rather than batchwise
+from the ladder.
+"""
+
+from __future__ import annotations
+
+from bibfs_tpu.serve.routes.base import Route
+
+
+class HostRoute(Route):
+    """The terminal batch rung: native C batch / per-query host solve
+    with bisection failure isolation (never unavailable)."""
+
+    name = "host"
+
+    def solve(self, rt, pairs, cutoffs=None):
+        # the isolator returns BFSResult | QueryError per pair and
+        # never raises; the engine's delivery skeleton partitions them
+        return self.engine._solve_host_isolated(pairs, cutoffs)
+
+
+class SerialRoute(Route):
+    """The bottom rung, reached per-query through the host isolator."""
+
+    name = "serial"
+
+    def solve_one(self, rt, src: int, dst: int, cutoff: int | None = None):
+        return rt.solve_serial_one(src, dst, cutoff)

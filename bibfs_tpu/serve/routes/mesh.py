@@ -1,0 +1,410 @@
+"""``route="mesh"`` — mesh-sharded serving over the device mesh.
+
+The multichip solvers have passed 8-device dryruns since round 3 (1D
+vertex-sharded, 2D 2x4, the dp-batch query mesh) and the bitpacked
+frontier exchange measures ~8x fewer wire bytes than bool
+(BENCH_r02.json), but until this route the query engines only ever
+dispatched to one device. :class:`MeshRoute` puts the mesh behind the
+same Route seam as every other rung, with two sub-paths chosen per
+batch:
+
+- **dp** (query-sharded, graph replicated): the flush's batch axis is
+  sharded over the query mesh and each device runs the whole
+  batch-minor search on its slice — zero collectives, so throughput
+  scales with chips (``solvers/batch_minor.dp_batch_dispatch``). This
+  is the throughput path; it is lane-efficient only once every shard's
+  128-lane group fills, which is exactly the measured crossover
+  (``dp_min_batch``, default ``ndev * 128``).
+- **sharded** (vertex-sharded, 1D mesh): the graph's ELL rows are
+  1D-sharded across the mesh (``solvers/sharded.ShardedGraph``) and the
+  per-level frontier exchange crosses the ICI BITPACKED — uint32 words,
+  32 vertices each, n/8 wire bytes instead of n bool bytes
+  (``parallel/collectives.all_gather_bits_dual``). This is the
+  graphs-bigger-than-one-device path (``shard_min_n``); the
+  ``bibfs_mesh_exchange_bytes_total{encoding}`` cells account the
+  packed payload against its bool counterfactual per served batch.
+
+Below-crossover traffic is NOT a mesh failure: ``eligible()`` returns
+False, the engine counts ``bibfs_mesh_crossover_reroutes_total`` and
+the ladder falls through to the single-device rungs. The crossover
+constants are calibrated per substrate (``calibration.json``, the
+platform entry's ``mesh`` block — written by ``bench.py
+--serve-mesh``); the committed CPU-substrate numbers put the dp
+crossover at batch 1024 on graphs of ≥ 5000 vertices (measured 1.5-1.8x
+the single-device device route there, bench_mesh.json).
+
+Snapshot identity is untouched: the mesh route serves the SAME content
+digest (the store/WAL/oracle machinery carries over), and only the
+``ExecutableCache`` bucket keys grow the shard geometry
+(:func:`bibfs_tpu.serve.buckets.placement_bucket_key`) so a mesh
+program can never collide with a single-device program of the same
+padded shape.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import weakref
+from dataclasses import dataclass
+
+import numpy as np
+
+from bibfs_tpu.analysis import guarded_by
+from bibfs_tpu.obs.metrics import REGISTRY
+from bibfs_tpu.obs.trace import span
+from bibfs_tpu.serve.buckets import bucket_batch, placement_bucket_key
+from bibfs_tpu.serve.resilience import BREAKER_STATE_CODES
+from bibfs_tpu.serve.routes.base import Route
+
+#: committed dryrun-substrate defaults, overridden by the calibrated
+#: ``mesh`` block of the platform's calibration.json entry. dp_min_n is
+#: the measured graph-size crossover at the lane-efficient batch depth
+#: (bench_mesh.json: at n=3000 the dp mesh only reaches ~1.45x the
+#: single-device route; at n>=10000 it clears 1.5x). shard_min_n keeps
+#: the vertex-sharded path for graphs beyond comfortable single-device
+#: residence — on the CPU dryrun substrate that path is for parity and
+#: exchange accounting, not speed, so the default keeps it out of the
+#: way until a deployment calibrates it down.
+DEFAULT_DP_MIN_N = 5000
+DEFAULT_SHARD_MIN_N = 1 << 20
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    """Mesh-route configuration (``QueryEngine(mesh=...)``).
+
+    ``devices`` — mesh size (None = every visible device);
+    ``dp_min_batch`` / ``dp_min_n`` / ``shard_min_n`` — crossover
+    overrides (None = the calibrated constants, see module docstring);
+    ``dt8`` — force the int8-plane dp kernel on/off (None = auto: int8
+    when the minor8 geometry fits, int32 otherwise);
+    ``mode`` — the vertex-sharded path's collective schedule.
+    """
+
+    devices: int | None = None
+    dp_min_batch: int | None = None
+    dp_min_n: int | None = None
+    shard_min_n: int | None = None
+    dt8: bool | None = None
+    mode: str = "sync"
+
+    @classmethod
+    def coerce(cls, mesh) -> "MeshConfig":
+        """Normalize the engine's ``mesh=`` argument: a ready config,
+        a device count, or ``"auto"`` (all visible devices)."""
+        if isinstance(mesh, cls):
+            return mesh
+        if mesh == "auto":
+            return cls()
+        if isinstance(mesh, bool):  # bool is an int; reject explicitly
+            raise ValueError(
+                "mesh= takes a device count, 'auto', or a MeshConfig"
+            )
+        if isinstance(mesh, int):
+            if mesh < 1:
+                raise ValueError(f"mesh devices must be >= 1, got {mesh}")
+            return cls(devices=mesh)
+        raise ValueError(
+            f"mesh= takes a device count, 'auto', or a MeshConfig; "
+            f"got {mesh!r}"
+        )
+
+
+def mesh_calibration() -> dict:
+    """The current platform's calibrated ``mesh`` crossover block
+    (empty when absent — callers fall back to the committed
+    defaults)."""
+    from bibfs_tpu.utils.calibrate import load_calibration
+
+    cal = load_calibration()
+    if not cal:
+        return {}
+    block = cal.get("mesh")
+    return block if isinstance(block, dict) else {}
+
+
+class _MeshCells:
+    """The mesh route's registry cells (stable names in README "Mesh
+    serving"), minted at route construction so a /metrics scrape shows
+    the families at zero before any mesh traffic."""
+
+    def __init__(self, label: str):
+        self.shards = REGISTRY.gauge(
+            "bibfs_mesh_shards",
+            "Devices in the serving mesh (0 = mesh route not configured)",
+            ("engine",),
+        ).labels(engine=label)
+        batches = REGISTRY.counter(
+            "bibfs_mesh_batches_total",
+            "Mesh-route batch dispatches by sub-path (dp/sharded)",
+            ("engine", "path"),
+        )
+        self.batches = {
+            "dp": batches.labels(engine=label, path="dp"),
+            "sharded": batches.labels(engine=label, path="sharded"),
+        }
+        exch = REGISTRY.counter(
+            "bibfs_mesh_exchange_bytes_total",
+            "Frontier-exchange wire bytes by encoding (packed = the "
+            "bitpacked payload actually shipped; bool = the unpacked "
+            "counterfactual)",
+            ("engine", "encoding"),
+        )
+        self.exchange = {
+            "packed": exch.labels(engine=label, encoding="packed"),
+            "bool": exch.labels(engine=label, encoding="bool"),
+        }
+        self.breaker_gauge = REGISTRY.gauge(
+            "bibfs_mesh_breaker_state",
+            "Mesh-route circuit breaker (0=closed 1=half_open 2=open)",
+            ("engine",),
+        ).labels(engine=label)
+        self.reroutes = REGISTRY.counter(
+            "bibfs_mesh_crossover_reroutes_total",
+            "Below-crossover batches routed to the single-device path",
+            ("engine",),
+        ).labels(engine=label)
+
+    def snapshot(self) -> dict:
+        return {
+            "shards": self.shards.value,
+            "batches": {k: c.value for k, c in self.batches.items()},
+            "exchange_bytes": {
+                k: c.value for k, c in self.exchange.items()
+            },
+            "crossover_reroutes": self.reroutes.value,
+        }
+
+
+def mesh_prebuild(cfg: MeshConfig):
+    """Build the vertex mesh and query mesh for ``cfg`` — separated
+    from :class:`MeshRoute` construction so the engine ctor can fail a
+    bad device count BEFORE it pins a store snapshot (a post-pin raise
+    would leak the pin)."""
+    from bibfs_tpu.parallel.mesh import make_1d_mesh
+    from bibfs_tpu.solvers.batch_minor import QUERY_AXIS
+
+    vmesh = make_1d_mesh(cfg.devices)
+    qmesh = make_1d_mesh(cfg.devices, axis=QUERY_AXIS)
+    return vmesh, qmesh
+
+
+@guarded_by("_lock", "_dt8_by_key")
+class MeshRoute(Route):
+    """The mesh-sharded rung of the fallback ladder (module
+    docstring). Owns its own circuit breaker and retry policy — a dead
+    mesh degrades to the single-device rungs, never to unavailability."""
+
+    name = "mesh"
+    is_dispatch = True
+
+    def __init__(self, engine, cfg: MeshConfig, vmesh, qmesh, *,
+                 retry, breaker, label: str):
+        super().__init__(engine, retry=retry, breaker=breaker)
+        self.config = cfg
+        self.mesh = vmesh
+        self.qmesh = qmesh
+        self.ndev = int(vmesh.devices.size)
+        from bibfs_tpu.solvers.batch_minor import LANES
+
+        cal = mesh_calibration()
+        try:
+            cal_devs = int(cal.get("devices", -1))
+        except (TypeError, ValueError):
+            cal_devs = -1
+        if cal_devs != self.ndev:
+            # the crossover constants are mesh-size-specific (the dp
+            # lane crossover is ndev * LANES by construction): a mesh
+            # sized differently from the calibrating run falls back to
+            # the committed defaults instead of inheriting a wrong
+            # dp_min_batch
+            cal = {}
+        self.dp_min_batch = int(
+            cfg.dp_min_batch if cfg.dp_min_batch is not None
+            else cal.get("dp_min_batch", self.ndev * LANES)
+        )
+        self.dp_min_n = int(
+            cfg.dp_min_n if cfg.dp_min_n is not None
+            else cal.get("dp_min_n", DEFAULT_DP_MIN_N)
+        )
+        self.shard_min_n = int(
+            cfg.shard_min_n if cfg.shard_min_n is not None
+            else cal.get("shard_min_n", DEFAULT_SHARD_MIN_N)
+        )
+        self._lock = threading.Lock()
+        # bucket key -> resolved dp plane dtype (True = int8): the
+        # minor8 geometry probe raises per shape, and re-raising it on
+        # every flush would turn a static property into per-batch cost
+        self._dt8_by_key: dict = {}
+        self.cells = _MeshCells(label)
+        self.cells.shards.set(self.ndev)
+        # weakly-bound breaker gauge listener, same contract as the
+        # engine's device-breaker subscription: a shared breaker must
+        # not pin dead cells (returning False unsubscribes)
+        cells_ref = weakref.ref(self.cells)
+
+        def _on_transition(state):
+            cells = cells_ref()
+            if cells is None:
+                return False
+            cells.breaker_gauge.set(BREAKER_STATE_CODES[state])
+            return True
+
+        breaker.add_listener(_on_transition)
+        self.cells.breaker_gauge.set(BREAKER_STATE_CODES[breaker.state])
+
+    # ---- selection ---------------------------------------------------
+    def eligible(self, rt, pairs) -> bool:
+        """Above-crossover only: dp once the batch fills the mesh's
+        lane groups on a big-enough graph, sharded once the graph
+        itself is mesh-scale. Anything below falls to the single-device
+        rungs (counted as a crossover reroute by the engine)."""
+        return rt.n >= self.shard_min_n or (
+            len(pairs) >= self.dp_min_batch and rt.n >= self.dp_min_n
+        )
+
+    def _use_dp(self, rt, pairs) -> bool:
+        # a mesh-scale graph (n >= shard_min_n) always takes the
+        # vertex-sharded path: the dp sub-path replicates the full
+        # table on every device, which is exactly what such graphs
+        # cannot afford
+        return (rt.n < self.shard_min_n
+                and len(pairs) >= self.dp_min_batch
+                and rt.n >= self.dp_min_n)
+
+    # ---- the two-stage solve seam ------------------------------------
+    def launch(self, rt, pairs):
+        eng = self.engine
+        with span("mesh_launch", batch=len(pairs), shards=self.ndev):
+            if eng._faults is not None:
+                eng._faults.fire("mesh", pairs)
+            if self._use_dp(rt, pairs):
+                return self._launch_dp(rt, pairs)
+            return self._launch_sharded(rt, pairs)
+
+    def _resolve_dt8(self, g, key, b_loc: int) -> bool:
+        """Whether this graph/batch geometry runs the int8-plane dp
+        kernel (the measured winner: the [n_pad, B] planes at int8 keep
+        a shard's working set cache-resident). Explicit ``dt8`` config
+        wins; auto probes the minor8 geometry once per bucket key."""
+        if self.config.dt8 is not None:
+            return self.config.dt8
+        memo_key = (key, b_loc)
+        with self._lock:
+            hit = self._dt8_by_key.get(memo_key)
+        if hit is not None:
+            return hit
+        from bibfs_tpu.solvers.batch_minor import _minor_geometry
+
+        try:
+            _minor_geometry(g, b_loc, True)
+            fits = True
+        except ValueError:
+            fits = False
+        with self._lock:
+            self._dt8_by_key[memo_key] = fits
+        return fits
+
+    def _launch_dp(self, rt, pairs):
+        from bibfs_tpu.solvers.batch_minor import (
+            dp_batch_dispatch,
+            pad_batch,
+        )
+
+        # the fine-ladder replicated table (NOT the geometric serving
+        # bucket: buckets.dp_aligned_ell documents the measured why)
+        g = rt.dp_graph()
+        b_loc = pad_batch(-(-len(pairs) // self.ndev))
+        dt8 = self._resolve_dt8(g, rt.dp_bucket_key, b_loc)
+        self.engine.exec_cache.note(placement_bucket_key(
+            rt.dp_bucket_key, kind="dp", shards=self.ndev,
+            extra=("dt8" if dt8 else "i32", b_loc),
+        ))
+        arr = np.asarray(pairs, dtype=np.int64).reshape(-1, 2)
+        _p, run, fin = dp_batch_dispatch(g, arr, self.qmesh, dt8)
+        t0 = time.perf_counter()
+        out = run()  # lazy on tunneled runtimes; finish forces the read
+        return out, ("dp", fin, None), t0
+
+    def _launch_sharded(self, rt, pairs):
+        from bibfs_tpu.solvers import sharded as _sharded
+
+        sg = rt.mesh_graph(self)
+        rung = min(bucket_batch(len(pairs)), self.engine.max_batch)
+        # pad to the batch rung with inert (0, 0) queries so arbitrary
+        # queue depths reuse a handful of compiled mesh programs (the
+        # vmapped program specializes on B; the single-device route
+        # does the same)
+        padded = np.zeros((rung, 2), dtype=np.int64)
+        padded[: len(pairs)] = pairs
+        self.engine.exec_cache.note(placement_bucket_key(
+            rt.mesh_bucket_key, kind="mesh1d", shards=self.ndev,
+            extra=(self.config.mode, rung),
+        ))
+        _p, dispatch = _sharded._batch_dispatch(
+            sg, padded, self.config.mode
+        )
+        t0 = time.perf_counter()
+        out = dispatch()
+        return out, ("sharded", None, sg), t0
+
+    def finish(self, out, fin, t0, pairs):
+        from bibfs_tpu.solvers.dense import _materialize_batch
+        from bibfs_tpu.solvers.timing import force_scalar
+
+        kind, hook, sg = fin
+        with span("mesh_finish", batch=len(pairs), path=kind):
+            eng = self.engine
+            if eng._faults is not None:
+                eng._faults.fire("mesh_finish", pairs)
+            force_scalar(out)  # lazy runtimes execute at the value read
+            elapsed = time.perf_counter() - t0
+            if kind == "dp":
+                results = _materialize_batch(hook(out), len(pairs), elapsed)
+            else:
+                rung = int(np.asarray(out[0]).shape[0])
+                results = _materialize_batch(out, rung, elapsed)[: len(pairs)]
+                # account the PADDED rung: the vmapped program ships
+                # every lane's plane each round, pad lanes included
+                self._note_exchange(sg, rung, results)
+            # counters are single-mutator here by construction: the
+            # sync engine finishes on the flushing thread, the
+            # pipelined engine on its one finish worker
+            self.cells.batches[kind].inc()
+            eng.counters["mesh_queries"] += len(pairs)
+            return results
+
+    def _note_exchange(self, sg, rung: int, results) -> None:
+        """Account the sharded batch's frontier-exchange wire traffic:
+        the lock-step program ships both sides' BITPACKED planes once
+        per round (``all_gather_bits_dual``), so per round each of the
+        ``rung`` query lanes (the PADDED batch — pad lanes ship their
+        plane too) pays ``2 * ceil(n_loc/32) * 4`` bytes per device —
+        against the ``2 * n_loc`` bool counterfactual the round-1
+        exchange shipped. The dp path contributes nothing here: it has
+        ZERO collectives, which is its whole point."""
+        from bibfs_tpu.parallel.collectives import frontier_exchange_bytes
+
+        n_loc = sg.n_pad // self.ndev
+        rounds = max(
+            (-(-r.levels // 2) for r in results if r.levels), default=0
+        )
+        lanes = rounds * rung * 2 * self.ndev
+        self.cells.exchange["packed"].inc(
+            lanes * frontier_exchange_bytes(n_loc, True)
+        )
+        self.cells.exchange["bool"].inc(
+            lanes * frontier_exchange_bytes(n_loc, False)
+        )
+
+    # ---- introspection -----------------------------------------------
+    def stats(self) -> dict:
+        out = super().stats()
+        out.update(self.cells.snapshot())
+        out["crossover"] = {
+            "dp_min_batch": self.dp_min_batch,
+            "dp_min_n": self.dp_min_n,
+            "shard_min_n": self.shard_min_n,
+        }
+        return out

@@ -1,0 +1,42 @@
+"""``route="oracle"`` — the landmark distance-oracle tier as a Route.
+
+The consult itself (two int16 row reads over an immutable index) lives
+in :mod:`bibfs_tpu.oracle`; this route is the dispatch seam: it answers
+at SUBMIT time (no queueing, no solver), which is why it sits outside
+the flush ladder — both engines consult it before the distance cache
+and before the overlay route (a store oracle is only ever returned when
+its index describes the CURRENT live graph, pending overlay included).
+A non-exact consult arms the ticket's ``cutoff`` with the proven upper
+bound for the host rungs.
+"""
+
+from __future__ import annotations
+
+from bibfs_tpu.serve.routes.base import Route
+
+
+class OracleRoute(Route):
+    """Submit-time exact answering from the landmark index."""
+
+    name = "oracle"
+
+    def eligible(self, rt, pairs) -> bool:
+        # consulted per ticket at submit time, never from the ladder
+        return False
+
+    def consult(self, ticket, graph_name) -> bool:
+        """Consult the oracle tier for one submitted query. True =
+        served exactly (``ticket.result`` set, ``route="oracle"``);
+        False = fall through (with ``ticket.cutoff`` armed when the
+        consult produced a usable upper bound)."""
+        orc = self.engine._oracle_for(graph_name)
+        if orc is None:
+            return False
+        ans = orc.consult(ticket.src, ticket.dst)
+        if ans is None:
+            return False
+        if ans.result is not None:
+            ticket.result = ans.result
+            return True
+        ticket.cutoff = ans.ub
+        return False

@@ -1,0 +1,43 @@
+"""``route="overlay"`` — exact answering while live edge updates are
+pending, as a Route.
+
+While a graph has a pending delta overlay, queries answer exactly
+against base+delta on the host (:meth:`DeltaOverlay.solve`), isolated
+per query, and the distance cache stands aside — its entries describe
+the base snapshot, not the overlaid graph. Both engines used to carry
+their own copy of this loop (sync ``_flush_overlay`` + pipelined
+``_launch_overlay``); the route is now the ONE implementation, yielding
+per-key outcomes so each engine applies its own ticket-resolution
+mechanics (inline result fields vs. finish-ticket broadcasts).
+"""
+
+from __future__ import annotations
+
+from bibfs_tpu.serve.resilience import to_query_error
+from bibfs_tpu.serve.routes.base import Route
+
+
+class OverlayRoute(Route):
+    """Exact base+delta answering for graphs with pending updates."""
+
+    name = "overlay"
+
+    def eligible(self, rt, pairs) -> bool:
+        # the engines route to the overlay from the overlay-read seam
+        # (ordering vs the snapshot pin is load-bearing; see
+        # QueryEngine._flush_graph), never from the fallback ladder
+        return False
+
+    def solve_iter(self, overlay, keys):
+        """Solve each ``(src, dst)`` key against base+delta, yielding
+        ``(key, BFSResult | QueryError)`` — failure is isolated per
+        query, the batch never sinks. One O(delta) correction capture
+        serves the whole batch."""
+        corr = overlay.correction()
+        for key in keys:
+            try:
+                res = overlay.solve(*key, correction=corr)
+            except Exception as exc:
+                yield key, to_query_error(exc, key)
+                continue
+            yield key, res

@@ -212,6 +212,27 @@ def write_calibration(path: str | None = None, **kwargs) -> dict:
     return data
 
 
+def merge_calibration_block(platform: str, key: str, entry: dict,
+                            path: str | None = None) -> dict:
+    """Merge one named sub-block (e.g. the mesh crossover constants)
+    into a platform's calibration entry — the same read-modify-write-
+    and-invalidate protocol as :func:`write_calibration`, kept HERE so
+    external writers (``bench.py --serve-mesh``) cannot drift from the
+    file's merge semantics."""
+    path = path or os.path.join(_REPO_ROOT, CAL_FILENAME)
+    data = {}
+    if os.path.exists(path):
+        with open(path) as f:
+            data = json.load(f)
+    plat = data.setdefault(platform, {})
+    plat[key] = {**plat.get(key, {}), **entry}
+    with open(path, "w") as f:
+        json.dump(data, f, indent=2, sort_keys=True)
+        f.write("\n")
+    _read_calibration_file.cache_clear()
+    return data
+
+
 @lru_cache(maxsize=None)
 def _read_calibration_file() -> dict:
     path = os.environ.get(CAL_ENV)

@@ -1,0 +1,289 @@
+"""The pluggable Route seam (bibfs_tpu/serve/routes): registry/ladder
+shape, per-route resolution parity against the serial oracle, the
+fallback ladder with per-route breakers and retry cells, crossover
+rerouting, and the placement-aware ExecutableCache keys.
+
+Runs on the conftest-forced 8-device virtual CPU mesh — the same
+dryrun substrate as the multichip solver tests."""
+
+import numpy as np
+import pytest
+
+from bibfs_tpu.serve.buckets import (
+    ExecutableCache,
+    ell_bucket_key,
+    placement_bucket_key,
+    repad_rows,
+)
+from bibfs_tpu.serve.engine import QueryEngine
+from bibfs_tpu.serve.faults import FaultPlan
+from bibfs_tpu.serve.pipeline import PipelinedQueryEngine
+from bibfs_tpu.serve.routes import MeshConfig
+from bibfs_tpu.solvers.serial import solve_serial
+from bibfs_tpu.store import GraphStore
+
+N = 400
+SEED = 7
+
+
+def _graph(n=N, seed=SEED):
+    from bibfs_tpu.graph.generate import gnp_random_graph
+
+    return gnp_random_graph(n, 2.2 / n, seed=seed)
+
+
+def _pairs(n, count, seed=0):
+    rng = np.random.default_rng(seed)
+    pairs = np.unique(rng.integers(0, n, size=(3 * count, 2)), axis=0)
+    pairs = pairs[pairs[:, 0] != pairs[:, 1]]  # trivial pairs never
+    # reach a route; the mesh_queries == len(pairs) assertions need
+    # every pair to be an actual solve
+    rng.shuffle(pairs)
+    assert pairs.shape[0] >= count
+    return pairs[:count]
+
+
+def _assert_matches_oracle(n, edges, pairs, results, label=""):
+    for (s, d), res in zip(pairs, results):
+        ref = solve_serial(n, edges, int(s), int(d))
+        assert res.found == ref.found, f"{label} {s}->{d} found"
+        if ref.found:
+            assert res.hops == ref.hops, f"{label} {s}->{d} hops"
+
+
+# ---- registry / ladder shape ----------------------------------------
+def test_route_registry_and_ladder_default():
+    eng = QueryEngine(N, _graph())
+    assert set(eng.routes) == {"oracle", "overlay", "device", "host",
+                               "serial"}
+    assert eng._ladder == ("device", "host")
+    st = eng.stats()
+    assert st["ladder"] == ["device", "host"]
+    assert set(st["routes"]) == set(eng.routes)
+
+
+def test_route_registry_with_mesh():
+    eng = QueryEngine(N, _graph(), mesh=MeshConfig(shard_min_n=0))
+    assert eng._ladder == ("mesh", "device", "host")
+    mesh = eng.routes["mesh"]
+    assert mesh.is_dispatch
+    # per-route failure policy: the mesh rung's breaker is its OWN, not
+    # the device route's
+    assert mesh.breaker is not eng._breaker
+    assert eng.routes["device"].breaker is eng._breaker
+    assert mesh.stats()["shards"] == 8
+
+
+def test_mesh_config_coerce():
+    assert MeshConfig.coerce(8).devices == 8
+    assert MeshConfig.coerce("auto").devices is None
+    cfg = MeshConfig(dp_min_batch=16)
+    assert MeshConfig.coerce(cfg) is cfg
+    with pytest.raises(ValueError):
+        MeshConfig.coerce(0)
+    with pytest.raises(ValueError):
+        MeshConfig.coerce(True)
+    with pytest.raises(ValueError):
+        MeshConfig.coerce("yes")
+
+
+def test_mesh_too_many_devices_fails_before_store_pin():
+    store = GraphStore()
+    store.add("g", N, _graph())
+    with pytest.raises(ValueError):
+        QueryEngine(store=store, graph="g", mesh=4096)
+    # the failed ctor must not have leaked a snapshot pin
+    assert store.current("g").refs == 1
+
+
+# ---- per-route resolution parity ------------------------------------
+def test_every_route_matches_serial_oracle():
+    """Every configured route resolves identically to the NumPy serial
+    oracle on the same traffic — the refactor's parity contract."""
+    n, edges = N, _graph()
+    pairs = _pairs(n, 24)
+    configs = {
+        "host": dict(),
+        "serial": dict(host_backend="serial"),
+        "device": dict(device_batches=True, flush_threshold=1),
+        "mesh-sharded": dict(mesh=MeshConfig(shard_min_n=0),
+                             flush_threshold=4),
+        "mesh-dp": dict(mesh=MeshConfig(dp_min_batch=8, dp_min_n=0),
+                        flush_threshold=4),
+        "oracle": dict(oracle_k=4),
+    }
+    for label, kwargs in configs.items():
+        eng = QueryEngine(n, edges, **kwargs)
+        results = eng.query_many(pairs)
+        _assert_matches_oracle(n, edges, pairs, results, label)
+        st = eng.stats()
+        if label.startswith("mesh"):
+            assert st["mesh_queries"] == len(pairs), label
+        if label == "device":
+            assert st["device_queries"] == len(pairs), label
+
+
+def test_overlay_route_matches_post_update_oracle():
+    n, edges = N, _graph()
+    store = GraphStore(compact_threshold=None)
+    store.add("g", n, edges)
+    eng = QueryEngine(store=store, graph="g",
+                      mesh=MeshConfig(shard_min_n=0), flush_threshold=4)
+    adds = [[0, n - 1], [2, n - 3]]
+    store.update("g", adds=adds)  # pending overlay, no compaction
+    pairs = _pairs(n, 12)
+    results = eng.query_many(pairs)
+    edges2 = np.vstack([edges, adds])
+    _assert_matches_oracle(n, edges2, pairs, results, "overlay")
+    st = eng.stats()
+    # the overlay route answered (exactly), not the mesh rung
+    assert st["overlay_queries"] == len(pairs)
+    assert st["mesh_queries"] == 0
+    eng.close()
+
+
+# ---- the fallback ladder --------------------------------------------
+def test_mesh_fault_degrades_to_host_with_counters():
+    n, edges = N, _graph()
+    eng = QueryEngine(
+        n, edges, mesh=MeshConfig(shard_min_n=0), flush_threshold=4,
+        faults=FaultPlan.parse("mesh:p=1.0"),
+    )
+    pairs = _pairs(n, 12)
+    results = eng.query_many(pairs)
+    _assert_matches_oracle(n, edges, pairs, results, "mesh-faulted")
+    st = eng.stats()
+    res = st["resilience"]
+    # device is ineligible on the CPU substrate, so the mesh rung
+    # degrades straight to host — and says so in the fallback labels
+    assert res["fallbacks"]["mesh->host"] >= 1
+    assert res["retries"] >= 1
+    assert st["host_queries"] == len(pairs)
+    assert st["mesh_queries"] == 0
+
+
+def test_mesh_breaker_opens_and_gauge_tracks():
+    from bibfs_tpu.obs.metrics import REGISTRY
+
+    n, edges = N, _graph()
+    eng = QueryEngine(
+        n, edges, mesh=MeshConfig(shard_min_n=0), flush_threshold=2,
+        faults=FaultPlan.parse("mesh:p=1.0"),
+    )
+    pairs = _pairs(n, 30)
+    # 3 consecutive failed batches (2 tries each) open the breaker
+    for i in range(0, 30, 10):
+        eng.query_many(pairs[i: i + 10])
+    mesh = eng.routes["mesh"]
+    assert mesh.breaker.snapshot()["opens"] >= 1
+    gauge = REGISTRY.get("bibfs_mesh_breaker_state").labels(
+        engine=eng.obs_label
+    )
+    assert gauge.value == 2  # open
+    # an open mesh breaker still serves traffic (host ladder)
+    more = eng.query_many(pairs[:6])
+    _assert_matches_oracle(n, edges, pairs[:6], more, "breaker-open")
+
+
+def test_crossover_reroute_counts_not_fails():
+    n, edges = N, _graph()
+    # dp-only mesh with a high batch crossover: small flushes are
+    # below-crossover by construction
+    eng = QueryEngine(n, edges, mesh=MeshConfig(dp_min_batch=512,
+                                                dp_min_n=0))
+    pairs = _pairs(n, 16)
+    results = eng.query_many(pairs)
+    _assert_matches_oracle(n, edges, pairs, results, "below-crossover")
+    st = eng.stats()
+    assert st["routes"]["mesh"]["crossover_reroutes"] >= 1
+    assert st["mesh_queries"] == 0
+    assert st["resilience"]["fallbacks"]["mesh->host"] == 0  # a reroute
+    # is a routing decision, not a fallback
+
+
+def test_retry_cell_is_per_route():
+    from bibfs_tpu.obs.metrics import REGISTRY
+
+    n, edges = N, _graph()
+    eng = QueryEngine(
+        n, edges, mesh=MeshConfig(shard_min_n=0), flush_threshold=4,
+        faults=FaultPlan.parse("mesh:p=1.0"),
+    )
+    eng.query_many(_pairs(n, 8))
+    retries = REGISTRY.get("bibfs_retries_total")
+    assert retries.labels(engine=eng.obs_label, route="mesh").value >= 1
+    assert retries.labels(engine=eng.obs_label, route="device").value == 0
+
+
+# ---- pipelined engine -----------------------------------------------
+def test_pipelined_mesh_parity_and_fault_degrade():
+    n, edges = N, _graph()
+    pairs = _pairs(n, 16)
+    with PipelinedQueryEngine(
+        n, edges, mesh=MeshConfig(shard_min_n=0), flush_threshold=4,
+    ) as eng:
+        results = eng.query_many(pairs)
+        _assert_matches_oracle(n, edges, pairs, results, "pipe-mesh")
+        assert eng.stats()["mesh_queries"] == len(pairs)
+    with PipelinedQueryEngine(
+        n, edges, mesh=MeshConfig(shard_min_n=0), flush_threshold=4,
+        faults=FaultPlan.parse("mesh:p=1.0"),
+    ) as eng:
+        results = eng.query_many(pairs)
+        _assert_matches_oracle(n, edges, pairs, results, "pipe-faulted")
+        st = eng.stats()
+        assert st["resilience"]["fallbacks"]["mesh->host"] >= 1
+        assert st["mesh_queries"] == 0
+
+
+# ---- placement-aware executable keys --------------------------------
+def test_placement_bucket_key_distinguishes_mesh_from_device():
+    base = ("ell", 1024, 16)
+    k_mesh = placement_bucket_key(base, kind="mesh1d", shards=8,
+                                  extra=("sync", 128))
+    k_dp = placement_bucket_key(base, kind="dp", shards=8,
+                                extra=("dt8", 128))
+    assert base != k_mesh != k_dp
+    cache = ExecutableCache(metrics_label="test-placement")
+    assert cache.note(base) is False
+    # the old collision: a mesh program of the same padded shape must
+    # NOT count as a hit on the single-device executable
+    assert cache.note(k_mesh) is False
+    assert cache.note(k_dp) is False
+    assert cache.note(k_mesh) is True
+
+
+def test_engine_notes_distinct_keys_per_placement():
+    n, edges = N, _graph()
+    cache = ExecutableCache(metrics_label="test-routes-exec")
+    pairs = _pairs(n, 12)
+    e_dev = QueryEngine(n, edges, device_batches=True, flush_threshold=1,
+                        exec_cache=cache)
+    e_dev.query_many(pairs)
+    e_mesh = QueryEngine(n, edges, mesh=MeshConfig(shard_min_n=0),
+                         flush_threshold=4, exec_cache=cache)
+    e_mesh.query_many(pairs)
+    keys = list(cache.program_counts())
+    mesh_keys = [k for k in keys if "mesh1d" in k]
+    dev_keys = [k for k in keys if "mesh1d" not in k and "dp" not in k]
+    assert mesh_keys and dev_keys
+
+
+def test_repad_rows_for_non_dividing_mesh():
+    from bibfs_tpu.serve.buckets import bucketed_ell
+
+    g = bucketed_ell(100, _graph(100, seed=3))
+    g2 = repad_rows(g, 7)
+    assert g2.n_pad % 7 == 0
+    assert g2.n == g.n and g2.width == g.width
+    assert (g2.deg[g.n_pad:] == 0).all()
+    # already-dividing tables come back untouched
+    assert repad_rows(g, 8) is g
+
+
+def test_dp_aligned_ell_geometry():
+    from bibfs_tpu.serve.buckets import DP_ROW_ALIGN, dp_aligned_ell
+
+    g = dp_aligned_ell(1500, _graph(1500, seed=4))
+    assert g.n_pad % DP_ROW_ALIGN == 0
+    assert g.width in (8, 16, 32)  # the geometric width rung
