@@ -1774,6 +1774,364 @@ def serve_mesh_main():
         return 1
 
 
+# --serve-blocked defaults: the MXU-native blocked-expansion soak runs
+# on the CPU substrate (the plane dtype resolves to f32 there — same
+# program, Eigen's sgemm fast path; int8 is the TPU/MXU input format)
+# and gates the four blocked claims: blocked-route answers exact vs the
+# serial oracle on EVERY query including across one mid-traffic
+# hot-swap, blocked qps >= BENCH_BLOCKED_QPS_FACTOR x the device route
+# on at least one committed A/B geometry (dense-ish or grid) in the
+# same run, the adaptive policy demonstrably LEARNS (a graph whose
+# first-flush route differs from its steady-state route), and a
+# respawned durable replica serves its first flush on the learned
+# route (the warm-start gate). --quick is the CI smoke shape (smaller
+# geometries, one timed repeat, qps ratio reported not gated — tiny
+# batches sit near the crossover where the ratio is noise).
+BLOCKED_N = int(os.environ.get("BENCH_BLOCKED_N", 2000))
+BLOCKED_DEG = float(os.environ.get("BENCH_BLOCKED_DEG", 64.0))
+BLOCKED_B = int(os.environ.get("BENCH_BLOCKED_B", 512))
+BLOCKED_GRID = os.environ.get("BENCH_BLOCKED_GRID", "64x64")
+BLOCKED_QPS_FACTOR = float(os.environ.get("BENCH_BLOCKED_QPS_FACTOR", 1.3))
+
+from bibfs_tpu.obs.names import (  # noqa: E402
+    ADAPTIVE_METRIC_FAMILIES,
+    BLOCKED_METRIC_FAMILIES,
+)
+
+
+def _write_blocked_calibration(entry: dict) -> None:
+    """Bank the measured blocked crossover constants in the ``cpu``
+    platform entry's ``blocked`` block (the soak forces the cpu
+    substrate) via the shared calibration merge protocol."""
+    from bibfs_tpu.utils.calibrate import CAL_FILENAME, merge_calibration_block
+
+    merge_calibration_block(
+        "cpu", "blocked", entry,
+        path=os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          CAL_FILENAME),
+    )
+
+
+def serve_blocked_main():
+    """``python bench.py --serve-blocked``: the blocked-expansion +
+    adaptive-routing soak (module comment above the constants).
+
+    Four portions in one process (one artifact, ``bench_blocked.json``):
+    (1) a store-backed ``route="blocked"`` engine serving a dense-ish
+    graph exactly, one live update + forced compaction hot-swapping the
+    snapshot mid-traffic (post-swap answers verified against the
+    post-update edge set, both sides served by the blocked route);
+    (2) the A/B — the same above-crossover traffic through a blocked
+    engine vs an otherwise-identical engine forced onto the ELL device
+    route, on a dense-ish G(n, p) AND a perforated grid, all answers
+    verified against the NumPy serial oracle, best geometry gated at
+    >= 1.3x; (3) the routing gates witnessed: a sparse random graph the
+    tile-compactness gate refuses, and a below-crossover batch the
+    blocked rung stands aside from; (4) the learning loop — an adaptive
+    engine over a DURABLE store explores, learns, and steady-states on
+    a different route than its first flush, then a respawned
+    ``ProcessReplica(durable=True)`` warm-starts from the policy
+    sidecar and serves its FIRST flush on the learned route. The
+    measured crossover constants land in ``calibration.json`` (the cpu
+    entry's ``blocked`` block)."""
+    t_setup = time.time()
+    os.environ["JAX_PLATFORMS"] = "cpu"  # the committed-substrate soak
+    try:
+        from bibfs_tpu.utils.platform import apply_platform_env
+
+        apply_platform_env()
+
+        import tempfile
+
+        from bibfs_tpu.fleet.replica import ProcessReplica
+        from bibfs_tpu.graph.csr import build_csr, canonical_pairs
+        from bibfs_tpu.graph.generate import gnp_random_graph, grid_graph
+        from bibfs_tpu.obs.metrics import REGISTRY
+        from bibfs_tpu.serve.engine import QueryEngine
+        from bibfs_tpu.solvers.serial import solve_serial_csr
+        from bibfs_tpu.store import GraphStore
+
+        quick = "--quick" in sys.argv
+        repeats = 1 if quick else 3
+        n_ab = 1200 if quick else BLOCKED_N
+        b_ab = 256 if quick else BLOCKED_B
+        errors: list[str] = []
+        rng = np.random.default_rng(0)
+
+        def check(label, n, csr, qpairs, results):
+            for (s, d), res in zip(qpairs, results):
+                ref = solve_serial_csr(n, *csr, int(s), int(d))
+                if res.found != ref.found or (
+                    ref.found and res.hops != ref.hops
+                ):
+                    errors.append(
+                        f"{label} {s}->{d}: {res.hops} != {ref.hops}"
+                    )
+
+        # ---- portion 1: exactness + mid-traffic hot-swap -------------
+        n_s = 800 if quick else 1200
+        edges_s = gnp_random_graph(n_s, 24.0 / n_s, seed=1)
+        store = GraphStore(compact_threshold=None)
+        store.add("g", n_s, edges_s)
+        eng_s = QueryEngine(store=store, graph="g", blocked=True,
+                            cache_entries=0, flush_threshold=4)
+        spairs = _mesh_unique_pairs(rng, n_s, 192)
+        csr_s = build_csr(n_s, pairs=canonical_pairs(n_s, edges_s))
+        pre = eng_s.query_many(spairs)
+        check("blocked-pre-swap", n_s, csr_s, spairs, pre)
+        have = set(map(tuple, canonical_pairs(n_s, edges_s)))
+        adds = [[u, v] for u in range(16) for v in range(n_s - 16, n_s)
+                if (u, v) not in have][:4]
+        store.update("g", adds=adds)
+        store.compact("g")
+        edges_s2 = np.vstack([edges_s, adds])
+        csr_s2 = build_csr(n_s, pairs=canonical_pairs(n_s, edges_s2))
+        post = eng_s.query_many(spairs)
+        check("blocked-post-swap", n_s, csr_s2, spairs, post)
+        st_s = eng_s.stats()
+        swap_served_blocked = st_s["blocked_queries"] == 2 * len(spairs)
+        eng_s.close()
+
+        # ---- portion 2: the A/B (dense-ish + grid geometries) --------
+        def ab_geometry(label, n, edges, b):
+            cpairs = canonical_pairs(n, edges)
+            csr = build_csr(n, pairs=cpairs)
+            eng_blk = QueryEngine(
+                n, edges, pairs=cpairs, blocked=True, cache_entries=0,
+            )
+            eng_dev = QueryEngine(
+                n, edges, pairs=cpairs, device_batches=True,
+                cache_entries=0,
+            )
+            warm = _mesh_unique_pairs(rng, n, b)
+            eng_blk.query_many(warm)
+            eng_dev.query_many(warm)
+            blk_times, dev_times = [], []
+            for r in range(repeats):
+                rep = _mesh_unique_pairs(rng, n, b)
+                t0 = time.perf_counter()
+                rb = eng_blk.query_many(rep)
+                blk_times.append(time.perf_counter() - t0)
+                t0 = time.perf_counter()
+                rd = eng_dev.query_many(rep)
+                dev_times.append(time.perf_counter() - t0)
+                check(f"{label}-blocked-r{r}", n, csr, rep, rb)
+                check(f"{label}-device-r{r}", n, csr, rep, rd)
+            served_blocked = (
+                eng_blk.stats()["blocked_queries"] == b * (repeats + 1)
+            )
+            eng_blk.close()
+            eng_dev.close()
+            blk_qps = b / float(np.median(blk_times))
+            dev_qps = b / float(np.median(dev_times))
+            return {
+                "geometry": label, "n": n, "batch": b,
+                "blocked_qps": round(blk_qps, 1),
+                "device_qps": round(dev_qps, 1),
+                "ratio": round(blk_qps / dev_qps, 3) if dev_qps else None,
+                "served_by_blocked": served_blocked,
+                "repeats": repeats,
+            }
+
+        gw, gh = (int(x) for x in
+                  ("48x48" if quick else BLOCKED_GRID).split("x"))
+        ab = [
+            ab_geometry(
+                f"gnp-deg{BLOCKED_DEG:.0f}", n_ab,
+                gnp_random_graph(n_ab, BLOCKED_DEG / n_ab, seed=1), b_ab,
+            ),
+            ab_geometry(
+                f"grid{gw}x{gh}", gw * gh,
+                grid_graph(gw, gh, perforation=0.02, seed=1),
+                256 if quick else min(512, BLOCKED_B),
+            ),
+        ]
+        best = max(ab, key=lambda row: row["ratio"] or 0)
+        qps_ok = bool(
+            best["ratio"] and best["ratio"] >= BLOCKED_QPS_FACTOR
+            and all(row["served_by_blocked"] for row in ab)
+        ) or (quick and all(row["served_by_blocked"] for row in ab))
+
+        # ---- portion 3: the routing gates witnessed ------------------
+        n_sp = 3000
+        edges_sp = gnp_random_graph(n_sp, AVG_DEG / n_sp, seed=2)
+        eng_sp = QueryEngine(n_sp, edges_sp, blocked=True,
+                             cache_entries=0, flush_threshold=4)
+        rt_sp = eng_sp._graph_rt(None)
+        sparse_refused = not eng_sp.routes["blocked"].eligible(
+            rt_sp, [(0, 1)] * 512
+        )
+        eng_sp.close()
+        eng_small = QueryEngine(
+            n_ab, gnp_random_graph(n_ab, BLOCKED_DEG / n_ab, seed=1),
+            blocked=True, cache_entries=0, flush_threshold=4,
+        )
+        small = _mesh_unique_pairs(rng, n_ab, 32)
+        eng_small.query_many(small)
+        below_stays_off = eng_small.stats()["blocked_queries"] == 0
+        eng_small.close()
+        crossover_ok = sparse_refused and below_stays_off
+
+        # ---- portion 4: adaptive learning + durable warm start -------
+        n_l = 800 if quick else 1200
+        edges_l = gnp_random_graph(n_l, 24.0 / n_l, seed=3)
+        csr_l = build_csr(n_l, pairs=canonical_pairs(n_l, edges_l))
+        tmp = tempfile.mkdtemp(prefix="bibfs-blocked-soak-")
+        store_l = GraphStore(wal_dir=tmp, compact_threshold=None)
+        store_l.add("g", n_l, edges_l)
+        eng_l = QueryEngine(store=store_l, graph="g", blocked=True,
+                            adaptive=True, device_batches=True,
+                            cache_entries=0, flush_threshold=4)
+        # enough flushes to leave the exploration phase (min_obs per
+        # rung x 2 rungs) and settle into the learned ordering
+        for _ in range(6):
+            lp = _mesh_unique_pairs(rng, n_l, 192)
+            check("adaptive", n_l, csr_l, lp, eng_l.query_many(lp))
+        st_l = eng_l.stats()["adaptive"]
+        first = st_l["first_decision"] or {}
+        digest = first.get("digest")
+        steady = (
+            st_l["digests"].get(digest, {}).get("last", {})
+            if digest else {}
+        )
+        learned_ok = bool(
+            first and steady
+            and first["route"] != steady.get("route")
+            and steady.get("reason") == "learned"
+        )
+        eng_l.close()  # persists the policy sidecar
+
+        # deadline + threshold above the submission window so the
+        # child's first flush holds the whole batch (a deadline firing
+        # mid-submission splits it below the blocked crossover)
+        replica = ProcessReplica(
+            "warm0", store_dir=tmp, durable=True, max_wait_ms=1000.0,
+            extra_args=["--blocked", "--adaptive", "--threshold", "4096"],
+        )
+        warm_ok = False
+        warm_detail: dict = {}
+        try:
+            wp = _mesh_unique_pairs(rng, n_l, 192)
+            tickets = [
+                replica.submit(int(s), int(d), "g") for s, d in wp
+            ]
+            for t, (s, d) in zip(tickets, wp):
+                res = replica.wait_ticket(t, timeout=120.0)
+                ref = solve_serial_csr(n_l, *csr_l, int(s), int(d))
+                if res.found != ref.found or (
+                    ref.found and res.hops != ref.hops
+                ):
+                    errors.append(f"warm {s}->{d}: {res.hops} != {ref.hops}")
+            st_w = replica.stats()
+            wfirst = (st_w.get("adaptive") or {}).get("first_decision") or {}
+            warm_detail = {
+                "loaded": (st_w.get("adaptive") or {}).get("loaded"),
+                "first_decision": wfirst,
+                "blocked_queries": st_w.get("blocked_queries"),
+            }
+            warm_ok = bool(
+                warm_detail["loaded"]
+                and wfirst.get("reason") == "learned"
+                and wfirst.get("route") == steady.get("route")
+                and st_w.get("blocked_queries", 0) >= 1
+            )
+        finally:
+            replica.close()
+
+        render = REGISTRY.render()
+        missing = [
+            m for m in BLOCKED_METRIC_FAMILIES + ADAPTIVE_METRIC_FAMILIES
+            if m not in render
+        ]
+        ok = bool(
+            not errors and qps_ok and swap_served_blocked
+            and crossover_ok and learned_ok and warm_ok and not missing
+        )
+        cal_entry = {
+            "min_batch": 128,
+            "waste_cap": 128.0,
+            "measured": {
+                row["geometry"]: {
+                    "n": row["n"], "batch": row["batch"],
+                    "blocked_qps": row["blocked_qps"],
+                    "device_qps": row["device_qps"],
+                    "ratio": row["ratio"],
+                }
+                for row in ab
+            },
+        }
+        try:
+            _write_blocked_calibration(cal_entry)
+        except OSError as e:
+            print(f"could not write calibration.json: {e}",
+                  file=sys.stderr)
+        line = {
+            "metric": f"bibfs_serve_blocked_{best['n']}",
+            "value": best["blocked_qps"],
+            "unit": "queries/s",
+            "graph": f"G({n_ab}, {BLOCKED_DEG:.0f}/n) + "
+                     f"grid({gw}x{gh}, perf=0.02)",
+            "platform": "cpu",
+            "quick": quick,
+            "ok": ok,
+            "exact": not errors,
+            "errors": errors[:20],
+            "qps": {
+                "ab": ab,
+                "best_ratio": best["ratio"],
+                "factor_required": BLOCKED_QPS_FACTOR,
+                "gated": not quick,
+                "ok": qps_ok,
+            },
+            "hot_swap": {
+                "served_by_blocked": swap_served_blocked,
+                "queries_per_side": len(spairs),
+            },
+            "crossover": {
+                "sparse_refused": sparse_refused,
+                "below_min_batch_stays_off": below_stays_off,
+                "ok": crossover_ok,
+                "calibration": cal_entry,
+            },
+            "adaptive": {
+                "first_decision": first,
+                "steady_state": steady,
+                "learned_ok": learned_ok,
+                "warm_start": warm_detail,
+                "warm_ok": warm_ok,
+            },
+            "metrics_missing": missing,
+            "total_s": round(time.time() - t_setup, 1),
+        }
+        _write_artifact("bench_blocked.json", line)
+        print(json.dumps({
+            "metric": line["metric"],
+            "value": line["value"],
+            "unit": "queries/s",
+            "ok": ok,
+            "exact": line["exact"],
+            "qps_ratio": best["ratio"],
+            "qps_ok": qps_ok,
+            "hot_swap_blocked": swap_served_blocked,
+            "crossover_ok": crossover_ok,
+            "learned_ok": learned_ok,
+            "warm_ok": warm_ok,
+            "metrics_missing": missing,
+            "detail_file": "bench_blocked.json",
+        }))
+        return 0 if ok else 1
+    except Exception as e:
+        import traceback
+
+        traceback.print_exc()
+        print(json.dumps({
+            "metric": "bibfs_serve_blocked",
+            "value": None,
+            "error": f"{type(e).__name__}: {e}"[:400],
+        }))
+        return 1
+
+
 # the fleet metric families (bibfs_tpu.fleet.FLEET_METRIC_FAMILIES —
 # one list, shared with the soak's live-scrape gate so the two checks
 # cannot drift): the gate asserts a LIVE /metrics scrape (HTTP, not
@@ -1877,6 +2235,8 @@ if __name__ == "__main__":
         sys.exit(serve_crash_main())
     elif "--serve-mesh" in sys.argv:
         sys.exit(serve_mesh_main())
+    elif "--serve-blocked" in sys.argv:
+        sys.exit(serve_blocked_main())
     elif "--serve-fleet" in sys.argv:
         sys.exit(serve_fleet_main())
     elif "--serve-oracle" in sys.argv:

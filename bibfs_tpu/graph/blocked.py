@@ -1,0 +1,177 @@
+"""Blocked (tiled) adjacency — the MXU-native layout.
+
+BLEST and "Graph Traversal on Tensor Cores" (PAPERS.md) reformulate a
+BFS level as blocked masked matrix products over a tiled adjacency; on
+TPU the analogous statement is that a level's frontier expansion
+
+    next[u] = OR_v  A[u, v] AND frontier[v]
+
+is a boolean matrix-vector product — and a *batched* level over B
+queries is a boolean matrix-MATRIX product ``A @ F`` with ``F`` the
+``[n, B]`` frontier plane, which is exactly the ``128 x 128``
+systolic-array workload the MXU runs at full rate while the ELL
+gather-based expansion (``ops/expand.py``, ``solvers/batch_minor.py``)
+issues element-at-a-time loads. The trade is arithmetic for locality:
+the blocked product touches ``tile`` candidate neighbors per vertex per
+stored block instead of ``width`` ELL slots, so it wins exactly on
+dense-ish and banded (grid) graphs where the nonempty-tile structure is
+compact — the eligibility/adaptive layer (``serve/routes/blocked.py``,
+``serve/policy.py``) owns that routing decision.
+
+Layout (block-sparse, only nonempty tiles materialized):
+
+- the vertex space is padded to ``tile`` (=128, the MXU edge) and cut
+  into ``nblocks`` tile-rows x tile-cols;
+- a tile (bi, bj) is *nonempty* when any edge (u, v) has
+  ``u // tile == bi`` and ``v // tile == bj`` (pairs are canonical —
+  mirrored — so the tile structure is symmetric);
+- nonempty tiles are packed ELL-style per block row: ``bcol[bi, k]``
+  is the k-th nonempty tile's block-column (sentinel ``nblocks`` past
+  ``bwidth_row[bi]``), and ``tab[bi, k]`` is its dense ``tile x tile``
+  int8 0/1 adjacency — int8 is the native MXU input dtype (the Pallas
+  guide's (32, 128) int8 tiling), and the storage format whatever
+  plane dtype the kernel resolves per substrate
+  (:func:`bibfs_tpu.ops.blocked_expand.resolve_plane_dtype`).
+
+This is CSR-of-blocks flattened to ELL-of-blocks: ``bwidth`` is the max
+nonempty tiles in any block row, so the device table is one static
+``[nblocks, bwidth, tile, tile]`` array and the per-level product needs
+no data-dependent shapes. Empty block ROWS (isolated/pad vertices) are
+all-sentinel and contribute zero, like every other padding here.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from bibfs_tpu.graph.csr import canonical_pairs
+
+#: the MXU systolic-array edge; also the lane quantum, so [tile, B]
+#: frontier sub-planes are whole vector registers
+TILE = 128
+
+
+@dataclasses.dataclass
+class BlockedGraph:
+    """Host-side blocked adjacency (module docstring).
+
+    - ``tab``: int8 ``[nblocks, bwidth, tile, tile]`` — slot k of block
+      row bi is the dense adjacency tile against block column
+      ``bcol[bi, k]`` (all-zero for sentinel slots).
+    - ``bcol``: int32 ``[nblocks, bwidth]`` block-column indices,
+      sentinel ``nblocks`` for dead slots (the kernel pads the frontier
+      plane with one zero tile at index ``nblocks``).
+    - ``deg``: int32 ``[n_pad]`` true degrees (edge-scan accounting).
+    """
+
+    n: int
+    n_pad: int
+    tile: int
+    nblocks: int
+    bwidth: int
+    num_edges: int  # undirected unique edge count
+    nnz_blocks: int  # nonempty tiles actually materialized
+    tab: np.ndarray
+    bcol: np.ndarray
+    deg: np.ndarray
+
+    @property
+    def tab_bytes(self) -> int:
+        return int(self.tab.nbytes)
+
+    @property
+    def block_density(self) -> float:
+        """Fraction of the full block grid actually materialized."""
+        return self.nnz_blocks / float(self.nblocks * self.nblocks or 1)
+
+
+def _tile_grid(n: int, tile: int) -> tuple[int, int]:
+    """``(n_pad, nblocks)`` of the tile grid — the ONE place the
+    padding formula lives (build, meta precheck and the serving
+    eligibility gate must agree on the grid by construction)."""
+    tile = int(tile)
+    n_pad = max(tile, -(-int(n) // tile) * tile)
+    return n_pad, n_pad // tile
+
+
+def blocked_meta(n: int, pairs: np.ndarray, *,
+                 tile: int = TILE) -> tuple[int, int, int]:
+    """``(nblocks, bwidth, nnz_blocks)`` of the tiling WITHOUT
+    materializing the table — one sorted pass over the canonical
+    pairs. The serving route's eligibility precheck reads this, so it
+    shares the grid/key math with :func:`build_blocked` and can never
+    gate on a different layout than the one a routed flush builds."""
+    tile = int(tile)
+    _n_pad, nblocks = _tile_grid(n, tile)
+    if pairs is None or not pairs.size:
+        return nblocks, 1, 0
+    keys = np.unique(
+        (pairs[:, 0] // tile) * nblocks + pairs[:, 1] // tile
+    )
+    counts = np.bincount(keys // nblocks, minlength=nblocks)
+    return nblocks, max(1, int(counts.max())), int(keys.size)
+
+
+def build_blocked(
+    n: int,
+    edges: np.ndarray | None = None,
+    *,
+    pairs: np.ndarray | None = None,
+    tile: int = TILE,
+) -> BlockedGraph:
+    """Tile the canonical pairs into a :class:`BlockedGraph`.
+
+    Fully vectorized: one sort over the directed pairs' (block-row,
+    block-col) keys yields the nonempty-tile list, per-row slot ranks
+    and the scatter into ``tab`` without a Python loop over tiles."""
+    if pairs is None:
+        pairs = canonical_pairs(n, edges)
+    tile = int(tile)
+    n_pad, nblocks = _tile_grid(n, tile)
+    deg = np.zeros(n_pad, dtype=np.int32)
+    if pairs.size:
+        deg[:n] = np.bincount(pairs[:, 0], minlength=n)
+    if not pairs.size:
+        return BlockedGraph(
+            n=int(n), n_pad=n_pad, tile=tile, nblocks=nblocks, bwidth=1,
+            num_edges=0, nnz_blocks=0,
+            tab=np.zeros((nblocks, 1, tile, tile), dtype=np.int8),
+            bcol=np.full((nblocks, 1), nblocks, dtype=np.int32),
+            deg=deg,
+        )
+    br = pairs[:, 0] // tile
+    bc = pairs[:, 1] // tile
+    keys = br * nblocks + bc
+    # nonempty tiles + each directed pair's tile, in one sorted pass
+    uniq, inv = np.unique(keys, return_inverse=True)
+    rows = (uniq // nblocks).astype(np.int64)
+    cols = (uniq % nblocks).astype(np.int64)
+    counts = np.bincount(rows, minlength=nblocks)
+    bwidth = max(1, int(counts.max()))
+    # slot rank of each nonempty tile within its block row (uniq is
+    # sorted, so tiles of one row are consecutive)
+    row_start = np.zeros(nblocks + 1, dtype=np.int64)
+    np.cumsum(counts, out=row_start[1:])
+    slot = np.arange(uniq.size) - row_start[rows]
+    bcol = np.full((nblocks, bwidth), nblocks, dtype=np.int32)
+    bcol[rows, slot] = cols
+    tab = np.zeros((nblocks, bwidth, tile, tile), dtype=np.int8)
+    tab[br, slot[inv], pairs[:, 0] % tile, pairs[:, 1] % tile] = 1
+    return BlockedGraph(
+        n=int(n), n_pad=n_pad, tile=tile, nblocks=nblocks, bwidth=bwidth,
+        num_edges=int(pairs.shape[0]) // 2, nnz_blocks=int(uniq.size),
+        tab=tab, bcol=bcol, deg=deg,
+    )
+
+
+def blocked_bucket_key(g: BlockedGraph) -> tuple:
+    """The compiled-program shape identity of a blocked table — the
+    analog of :func:`bibfs_tpu.serve.buckets.ell_bucket_key` for the
+    blocked layout. Distinct by construction from the ``("ell", ...)``
+    single-device keys and extended with its placement via
+    ``placement_bucket_key(kind="blocked")`` at the dispatch site, so a
+    blocked program can never count as a hit on a device/mesh
+    executable of the same padded vertex shape."""
+    return ("blocked", g.nblocks, g.bwidth, g.tile)

@@ -110,6 +110,21 @@ MESH_METRIC_FAMILIES = (
     "bibfs_mesh_crossover_reroutes_total",
 )
 
+#: blocked (MXU-tile) serving route (serve/routes/blocked.py); minted
+#: at route construction (engines configured with ``blocked=``), so a
+#: blocked-enabled process renders the group at zero before any traffic
+BLOCKED_METRIC_FAMILIES = (
+    "bibfs_blocked_batches_total",
+    "bibfs_blocked_breaker_state",
+)
+
+#: telemetry-driven adaptive routing (serve/policy.py; the frontier
+#: histogram is fed by every telemetry-enabled solve, obs/telemetry.py)
+ADAPTIVE_METRIC_FAMILIES = (
+    "bibfs_routes_adaptive_total",
+    "bibfs_level_frontier_fraction",
+)
+
 #: build identity (obs/metrics.py; minted at every registry init)
 BUILD_INFO_METRIC = "bibfs_build_info"
 
@@ -137,6 +152,8 @@ ALL_METRIC_NAMES = frozenset(
     + DURABLE_METRIC_FAMILIES
     + ORACLE_METRIC_FAMILIES
     + MESH_METRIC_FAMILIES
+    + BLOCKED_METRIC_FAMILIES
+    + ADAPTIVE_METRIC_FAMILIES
     + _FLEET_ONLY
     + (BUILD_INFO_METRIC,)
 )
@@ -146,6 +163,7 @@ ALL_METRIC_NAMES = frozenset(
 #: series — :func:`exposition_names`)
 HISTOGRAM_METRIC_NAMES = frozenset((
     "bibfs_query_latency_seconds",
+    "bibfs_level_frontier_fraction",
 ))
 
 #: ``bibfs_``-prefixed tokens that are NOT metric names (package paths,

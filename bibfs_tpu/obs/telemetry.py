@@ -27,18 +27,51 @@ Beamer gate's actual choice).
 
 from __future__ import annotations
 
+_FRACTION_HIST = None
+
+
+def frontier_fraction_hist():
+    """The process-wide ``bibfs_level_frontier_fraction`` histogram:
+    per-level frontier size as a fraction of ``n``, fed by every
+    telemetry-enabled solve that knows its graph size (``n`` set on the
+    collector). The adaptive routing layer (``serve/policy.py``) mints
+    it at construction so it renders at zero; solves that record into
+    it share the same cell."""
+    global _FRACTION_HIST
+    if _FRACTION_HIST is None:
+        from bibfs_tpu.obs.metrics import REGISTRY
+
+        _FRACTION_HIST = REGISTRY.histogram(
+            "bibfs_level_frontier_fraction",
+            "Per-level frontier size / n of telemetry-enabled solves "
+            "(the push/pull and route-shape signal the adaptive "
+            "routing policy learns from)",
+        )
+    return _FRACTION_HIST
+
 
 class LevelTelemetry:
     """Collector one solve fills. Pass an instance (or ``telemetry=True``,
     which the solvers turn into one) to ``solve_serial_csr`` /
-    ``solve_native_graph`` / ``solve_dense_graph`` / ``api.solve``."""
+    ``solve_native_graph`` / ``solve_dense_graph`` / ``api.solve``.
 
-    __slots__ = ("levels", "meet_level", "meet")
+    ``n`` (the solved graph's vertex count; the solvers re-stamp it at
+    every solve, so a collector reused across graphs records each
+    solve against the RIGHT n) additionally lands each level's
+    frontier/n in the process ``bibfs_level_frontier_fraction``
+    histogram — the observable shape signal
+    ``serve/policy.AdaptiveRouter`` learns push/pull behavior from.
+    Pass ``n=0`` to opt out of the registry traffic entirely (the
+    solvers never overwrite 0): levels then record exactly as before
+    this histogram existed."""
 
-    def __init__(self):
+    __slots__ = ("levels", "meet_level", "meet", "n")
+
+    def __init__(self, n: int | None = None):
         self.levels: list[dict] = []
         self.meet_level: int | None = None
         self.meet: int | None = None
+        self.n = n
 
     def record_level(
         self, level: int, side: str, direction: str,
@@ -51,6 +84,8 @@ class LevelTelemetry:
             "frontier": int(frontier),
             "edges": int(edges),
         })
+        if self.n:
+            frontier_fraction_hist().observe(frontier / self.n)
 
     def note_meet(self, level: int, meet: int | None = None) -> None:
         """Record the round where the best meet candidate (so far) was

@@ -1,0 +1,111 @@
+"""Blocked frontier expansion — one BFS level as masked block matmuls.
+
+The op this module owns::
+
+    reach = A @ F  >  0
+
+with ``A`` the block-sparse tiled adjacency of
+:class:`bibfs_tpu.graph.blocked.BlockedGraph` and ``F`` the ``[n_pad,
+C]`` frontier plane (``C`` = both sides of every query in the batch —
+the dual-side batched solvers stack the source-side columns ``0..B-1``
+and target-side columns ``B..2B-1`` into ONE plane so a single
+adjacency sweep advances every search). Each nonempty ``128 x 128``
+int8 tile multiplies against its block-column's ``[128, C]`` frontier
+sub-plane in one ``dot_general`` batched over block rows, contracting
+(slot, in-tile column) at once — on TPU that is the MXU's native
+int8 systolic workload; the CPU dryrun substrate runs the SAME program
+with f32 planes (:func:`resolve_plane_dtype`) because Eigen's sgemm is
+that backend's fast matmul path. Products of 0/1 values are exact in
+either dtype (counts are bounded by ``bwidth * tile`` ≪ 2^24), and the
+saturating OR-accumulate is the ``> 0`` readout of the integer count.
+
+The block-row axis is chunked (static Python slices — ``nblocks`` is a
+compile-time constant, so no dynamic shapes and no pad rows) to keep
+the gathered ``[rc, bwidth, tile, C]`` frontier block plus its int32
+accumulator inside a fixed working-set budget at any graph size, the
+same discipline as ``batch_minor.chunk_rows``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from bibfs_tpu.graph.blocked import TILE
+
+#: working-set budget for one level-scan chunk: the gathered frontier
+#: block F [rc, bwidth, tile, C] at the plane dtype plus the int32/f32
+#: dot accumulator [rc, tile, C] — same ceiling philosophy as
+#: batch_minor.CHUNK_BUDGET_BYTES (validated there by measurement).
+BLOCKED_CHUNK_BUDGET_BYTES = 384 * 2**20
+
+#: ceiling on the resident blocked table; past it the layout stops
+#: being a win (a table this padded means the block structure is not
+#: compact and the ELL routes carry the graph better anyway)
+BLOCKED_TAB_BUDGET_BYTES = 256 * 2**20
+
+
+def resolve_plane_dtype(dt=None):
+    """The frontier-plane dtype for the current substrate: int8 where
+    the MXU takes int8 natively (TPU), f32 on the CPU dryrun substrate
+    (measured: the XLA CPU int8 dot lowers to scalar int32 loops at
+    ~4-8x the latency of the Eigen sgemm the f32 program hits — the
+    blocked win flips sign). ``dt`` forces a choice (tests pin both)."""
+    if dt is not None:
+        return jnp.dtype(dt)
+    return jnp.dtype(
+        jnp.int8 if jax.default_backend() == "tpu" else jnp.float32
+    )
+
+
+def chunk_block_rows(bwidth: int, c: int, itemsize: int,
+                     tile: int = TILE) -> int:
+    """Block rows per expansion chunk under the working-set budget
+    (always >= 1: one block row's sweep is the indivisible unit)."""
+    per_row = tile * c * (bwidth * itemsize + 4)
+    return max(1, BLOCKED_CHUNK_BUDGET_BYTES // max(per_row, 1))
+
+
+def blocked_fits(nblocks: int, bwidth: int, b: int,
+                 itemsize: int = 4) -> bool:
+    """Whether the blocked path handles this (graph, batch) shape: the
+    resident int8 table under its budget, and the dual-plane state
+    (frontier + dist at ``[n_pad, 2B]``) under the chunk budget — past
+    either, the ELL routes carry the batch."""
+    tab_bytes = nblocks * bwidth * TILE * TILE  # int8 storage
+    if tab_bytes > BLOCKED_TAB_BUDGET_BYTES:
+        return False
+    plane_bytes = nblocks * TILE * 2 * b * (itemsize + 4)
+    return plane_bytes <= BLOCKED_CHUNK_BUDGET_BYTES
+
+
+def expand_blocked_plane(fr, tab, bcol, *, rc: int):
+    """One frontier-plane expansion: ``(A @ fr) > 0``.
+
+    ``fr``: plane-dtype ``[n_pad, C]`` 0/1 frontier (C = all query
+    columns); ``tab``: int8 ``[nblocks, bwidth, tile, tile]``;
+    ``bcol``: int32 ``[nblocks, bwidth]`` with sentinel ``nblocks``
+    (reads the appended zero tile). Returns bool ``[n_pad, C]`` — every
+    vertex with at least one frontier neighbor, discovered-or-not (the
+    level body masks by its dist plane)."""
+    nblocks, bwidth = bcol.shape
+    tile = tab.shape[2]  # the table IS the tile-size authority here
+    c = fr.shape[1]
+    dt = fr.dtype
+    acc_t = jnp.float32 if dt == jnp.float32 else jnp.int32
+    f2 = fr.reshape(nblocks, tile, c)
+    f2p = jnp.concatenate([f2, jnp.zeros((1, tile, c), dt)], axis=0)
+    outs = []
+    for i0 in range(0, nblocks, rc):
+        tab_c = tab[i0: i0 + rc].astype(dt)
+        # THE gather+matmul: one [tile, C] frontier sub-plane per
+        # (block row, slot), contracted against the int8 tile over
+        # (slot, in-tile column) in a single batched dot_general —
+        # counts of frontier neighbors per (vertex, query column)
+        fr_c = jnp.take(f2p, bcol[i0: i0 + rc], axis=0)
+        outs.append(jax.lax.dot_general(
+            tab_c, fr_c,
+            dimension_numbers=(((1, 3), (1, 2)), ((0,), (0,))),
+            preferred_element_type=acc_t,
+        ))
+    return jnp.concatenate(outs, axis=0).reshape(nblocks * tile, c) > 0

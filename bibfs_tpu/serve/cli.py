@@ -339,6 +339,28 @@ def main(argv=None):
         "rungs automatically; the mesh rung carries its own breaker "
         "and retry policy",
     )
+    ap.add_argument(
+        "--blocked",
+        action="store_true",
+        help='enable route="blocked": MXU-native blocked-adjacency '
+        "frontier expansion (serve/routes/blocked.py) — above-crossover "
+        "flushes on tile-compact (dense-ish/grid) graphs advance as "
+        "masked block matmuls over the 128x128 int8 tiled adjacency "
+        "instead of ELL gathers. The blocked rung leads the "
+        "single-device ladder (blocked -> device -> host) with its own "
+        "breaker and retry policy; eligibility constants come from "
+        "calibration.json (the platform entry's blocked block)",
+    )
+    ap.add_argument(
+        "--adaptive",
+        action="store_true",
+        help="telemetry-driven adaptive routing (serve/policy.py): "
+        "learn a per-graph-digest route ordering from measured "
+        "per-route latencies + sampled level telemetry instead of the "
+        "static ladder. With --store --durable the learned policy "
+        "persists as policy.json next to the checkpoints, so a "
+        "respawned replica serves its first flush on the learned route",
+    )
     ap.add_argument("--max-batch", type=int, default=1024,
                     help="largest single device flush (default 1024)")
     ap.add_argument("--cache-entries", type=int, default=64,
@@ -561,6 +583,10 @@ def _serve(args, n, edges, store, QueryEngine, PipelinedQueryEngine,
             kwargs["mesh"] = (
                 "auto" if args.mesh == "auto" else int(args.mesh)
             )
+        if args.blocked:
+            kwargs["blocked"] = True
+        if args.adaptive:
+            kwargs["adaptive"] = True
         if args.inject_faults is not None:
             import os
 
@@ -695,8 +721,18 @@ def _serve(args, n, edges, store, QueryEngine, PipelinedQueryEngine,
                         # so a query answers on the graph it was typed
                         # against (the engine's own swap barrier protects
                         # in-flight batches; this protects still-queued
-                        # tickets)
-                        engine.flush()
+                        # tickets). Only force the flush when something
+                        # IS unresolved: a no-op flush still arms the
+                        # pipelined flusher's drain request, and a `use`
+                        # arriving just ahead of a query burst would
+                        # then pop a partial below-crossover batch the
+                        # moment the flusher thread wakes
+                        if any(
+                            t.result is None
+                            and getattr(t, "error", None) is None
+                            for t in tickets[emitted:]
+                        ):
+                            engine.flush()
                         drain()
                         reply, current = _store_command(
                             store, current, parts

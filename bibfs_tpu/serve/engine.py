@@ -42,6 +42,7 @@ batch-solved results carry the whole-batch wall clock in ``time_s``
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 import weakref
@@ -86,7 +87,7 @@ def _engine_counter_bank(label: str) -> MetricBank:
     routed = REGISTRY.counter(
         "bibfs_queries_routed_total",
         "Queries by resolution route "
-        "(trivial/oracle/cache/mesh/device/host/overlay)",
+        "(trivial/oracle/cache/mesh/blocked/device/host/overlay)",
         ("engine", "route"),
     )
     batches = REGISTRY.counter(
@@ -108,6 +109,7 @@ def _engine_counter_bank(label: str) -> MetricBank:
         "host_queries": routed.labels(engine=label, route="host"),
         "overlay_queries": routed.labels(engine=label, route="overlay"),
         "mesh_queries": routed.labels(engine=label, route="mesh"),
+        "blocked_queries": routed.labels(engine=label, route="blocked"),
         "inserts_skipped": skipped.labels(engine=label),
     })
 
@@ -118,7 +120,8 @@ class _ResilienceCells:
     /metrics scrape shows the families at zero from the first breath —
     the chaos CI gate asserts they render even before anything fails."""
 
-    def __init__(self, label: str, *, mesh: bool = False):
+    def __init__(self, label: str, *, mesh: bool = False,
+                 blocked: bool = False):
         errors = REGISTRY.counter(
             "bibfs_errors_total",
             "Per-ticket query failures by taxonomy kind",
@@ -162,8 +165,14 @@ class _ResilienceCells:
         # host on a CPU substrate / finish-worker recovery)
         self._fallback_family = fallbacks
         pairs = [("device", "host"), ("host", "serial")]
+        if blocked:
+            # the blocked rung's two exits: the next dispatch rung, or
+            # straight to host when device is ineligible
+            pairs = [("blocked", "device"), ("blocked", "host")] + pairs
         if mesh:
             pairs = [("mesh", "device"), ("mesh", "host")] + pairs
+            if blocked:
+                pairs = [("mesh", "blocked")] + pairs
         self.fallbacks = {
             (a, b): fallbacks.labels(**{"engine": label, "from": a, "to": b})
             for a, b in pairs
@@ -175,6 +184,10 @@ class _ResilienceCells:
         if mesh:
             self._retry_cells["mesh"] = retries.labels(
                 engine=label, route="mesh"
+            )
+        if blocked:
+            self._retry_cells["blocked"] = retries.labels(
+                engine=label, route="blocked"
             )
         self.bisections = bisections.labels(engine=label)
         self._label = label
@@ -263,7 +276,8 @@ class _Pending:
 
 @guarded_by("_lock", "_graph", "bucket_key", "_host_solver",
             "host_native_graph", "_serial_solver", "host_backend_resolved",
-            "_mesh_graph", "mesh_bucket_key", "_dp_graph", "dp_bucket_key")
+            "_mesh_graph", "mesh_bucket_key", "_dp_graph", "dp_bucket_key",
+            "_blocked_graph", "blocked_bucket_key", "_blocked_meta")
 class _GraphRuntime:
     """Everything an engine knows about solving ONE immutable graph
     snapshot: the lazily built+uploaded device graph and its compiled-
@@ -299,6 +313,9 @@ class _GraphRuntime:
         self.mesh_bucket_key = None
         self._dp_graph = None
         self.dp_bucket_key = None
+        self._blocked_graph = None
+        self.blocked_bucket_key = None
+        self._blocked_meta = None
         self._host_solver = None
         self.host_native_graph = None
         self._serial_solver = None
@@ -376,6 +393,48 @@ class _GraphRuntime:
                     g = DeviceGraph.from_ell(ell, device=self._device)
                     self.dp_bucket_key = ell_bucket_key(ell)
                     self._dp_graph = g
+        return g
+
+    def blocked_meta(self) -> tuple:
+        """``(nblocks, bwidth, nnz_blocks)`` of the snapshot's blocked
+        layout WITHOUT materializing the table
+        (:func:`bibfs_tpu.graph.blocked.blocked_meta` — shares the
+        build's grid math), so the blocked route's ``eligible()`` can
+        gate on tile compactness before anything is built. Cached per
+        runtime like the other lazy builders."""
+        m = self._blocked_meta
+        if m is None:
+            from bibfs_tpu.graph.blocked import blocked_meta
+
+            with self._lock:
+                m = self._blocked_meta
+                if m is None:
+                    m = blocked_meta(self.n, self.snapshot.pairs)
+                    self._blocked_meta = m
+        return m
+
+    def blocked_graph(self):
+        """The MXU-tile blocked device table for ``route="blocked"``
+        (built from the snapshot's memoized
+        :meth:`~bibfs_tpu.store.snapshot.GraphSnapshot.blocked` layout
+        and uploaded on the first blocked-routed flush — a runtime that
+        never routes blocked never pays the tile build). Rebuilt per
+        runtime, so a store hot-swap re-tiles the new snapshot through
+        the same machinery as every other device table."""
+        g = self._blocked_graph
+        if g is None:
+            from bibfs_tpu.graph.blocked import blocked_bucket_key
+            from bibfs_tpu.solvers.dense import BlockedDeviceGraph
+
+            with self._lock:
+                g = self._blocked_graph
+                if g is None:
+                    bg = self.snapshot.blocked()
+                    g = BlockedDeviceGraph.from_host(
+                        bg, device=self._device
+                    )
+                    self.blocked_bucket_key = blocked_bucket_key(bg)
+                    self._blocked_graph = g
         return g
 
     def get_host_solver(self):
@@ -544,6 +603,24 @@ class QueryEngine:
         platform's ``mesh`` block in ``calibration.json``) and counted
         in ``bibfs_mesh_crossover_reroutes_total``. Default None: no
         mesh rung, the pre-mesh ladder exactly.
+    blocked : enable ``route="blocked"`` — MXU-native blocked-adjacency
+        expansion (``serve/routes/blocked.py``): ``True`` or a
+        :class:`~bibfs_tpu.serve.routes.BlockedConfig`. The blocked
+        rung sits ahead of device in the fallback ladder
+        (``blocked -> device -> host``) with its own circuit breaker,
+        retry policy and chaos sites; eligibility is the calibrated
+        batch crossover plus the tile-compactness gate (the platform's
+        ``blocked`` block in ``calibration.json``). Default None: no
+        blocked rung, the pre-blocked ladder exactly.
+    adaptive : telemetry-driven adaptive routing
+        (:class:`~bibfs_tpu.serve.policy.AdaptiveRouter`): ``True``
+        learns a per-graph-digest ladder ordering from measured
+        per-route latencies and sampled level telemetry, counted in
+        ``bibfs_routes_adaptive_total{route,reason}`` and persisted as
+        a ``policy.json`` sidecar next to a durable store's
+        checkpoints — a respawned replica serves its first flush on
+        the learned route. Pass a ready ``AdaptiveRouter`` to share one
+        across engines. Default None: the static ladder, exactly.
     health_window_s : sliding window for the health monitor's recent-
         error degradation input (default 5.0; the chaos harness
         shrinks it to measure recovery time).
@@ -577,8 +654,14 @@ class QueryEngine:
         breaker: CircuitBreaker | None = None,
         health_window_s: float = 5.0,
         mesh=None,
+        blocked=None,
+        adaptive=None,
     ):
-        from bibfs_tpu.serve.routes import MeshConfig, mesh_prebuild
+        from bibfs_tpu.serve.routes import (
+            BlockedConfig,
+            MeshConfig,
+            mesh_prebuild,
+        )
         from bibfs_tpu.solvers.batch_minor import small_batch_threshold
 
         # cheap argument validation FIRST: below here a store-backed
@@ -598,6 +681,18 @@ class QueryEngine:
         if mesh is not None:
             self._mesh_cfg = MeshConfig.coerce(mesh)
             mesh_pre = mesh_prebuild(self._mesh_cfg)
+        # blocked/adaptive validation is pre-pin for the same reason
+        self._blocked_cfg = (
+            None if not blocked else BlockedConfig.coerce(blocked)
+        )
+        if adaptive is not None and not isinstance(adaptive, bool):
+            from bibfs_tpu.serve.policy import AdaptiveRouter
+
+            if not isinstance(adaptive, AdaptiveRouter):
+                raise ValueError(
+                    "adaptive= takes True/None or an AdaptiveRouter; "
+                    f"got {adaptive!r}"
+                )
         if oracle_k is not None:
             if store is not None:
                 raise ValueError(
@@ -687,7 +782,8 @@ class QueryEngine:
         self._faults = FaultPlan.from_env() if faults is None else faults
         self._retry = RetryPolicy() if retry is None else retry
         self._res_cells = _ResilienceCells(
-            self.obs_label, mesh=self._mesh_cfg is not None
+            self.obs_label, mesh=self._mesh_cfg is not None,
+            blocked=self._blocked_cfg is not None,
         )
         self._breaker = CircuitBreaker() if breaker is None else breaker
         # listener, not ownership: a breaker SHARED across engines (one
@@ -762,8 +858,31 @@ class QueryEngine:
         from bibfs_tpu.serve.routes import build_routes
 
         self.routes, self._ladder = build_routes(
-            self, self._mesh_cfg, mesh_pre
+            self, self._mesh_cfg, mesh_pre, self._blocked_cfg
         )
+        # telemetry-driven adaptive routing (serve/policy.py): learned
+        # per-digest ladder ordering, persisted as a sidecar next to
+        # the store's checkpoints when the store is durable so a
+        # respawned replica serves its first flush on the learned route
+        self._policy = None
+        if adaptive:
+            from bibfs_tpu.serve.policy import (
+                POLICY_SIDECAR,
+                AdaptiveRouter,
+            )
+
+            if isinstance(adaptive, bool):
+                sidecar = None
+                if store is not None and getattr(
+                    store, "wal_dir", None
+                ) is not None:
+                    sidecar = os.path.join(store.wal_dir, POLICY_SIDECAR)
+                self._policy = AdaptiveRouter(
+                    label=self.obs_label, routes=self._ladder,
+                    path=sidecar,
+                )
+            else:
+                self._policy = adaptive
         # direct cell handles for the per-query submit path (skips the
         # bank's read-modify-write indirection in the hot loop)
         self._c_queries = self.counters.cell("queries")
@@ -1104,15 +1223,74 @@ class QueryEngine:
                 for t in unique[key]:
                     t.result = res
 
-    def _next_rung(self, i: int, rt, pairs) -> str:
+    def _next_rung(self, i: int, rt, pairs, ladder=None) -> str:
         """The rung a failed/ineligible ladder step actually degrades
         TO: the next ladder name that is terminal (``host``) or
         eligible for this batch — the ``to`` label of the fallback
         counter must name where the batch really went."""
-        for name in self._ladder[i + 1:]:
+        ladder = self._ladder if ladder is None else ladder
+        for name in ladder[i + 1:]:
             if name == "host" or self.routes[name].eligible(rt, pairs):
                 return name
         return "host"
+
+    def _ladder_for(self, rt, pairs):
+        """The ladder this flush walks: the adaptive policy's per-digest
+        ordering when the engine runs adaptive
+        (:meth:`~bibfs_tpu.serve.policy.AdaptiveRouter.order` — counted
+        in ``bibfs_routes_adaptive_total``), else the static ladder."""
+        if self._policy is None:
+            return self._ladder
+        order, _reason = self._policy.order(
+            rt.snapshot.digest, len(pairs), self._ladder
+        )
+        return order
+
+    def _note_route_time(self, rt, route: str, pairs, seconds) -> None:
+        """Feed the adaptive policy one resolved batch's measurement,
+        plus its periodic level-shape sample: one telemetry-enabled
+        serial solve of the batch's first pair (~1.5% of flushes),
+        recording push/pull choices and frontier fractions into the
+        per-digest policy and the ``bibfs_level_frontier_fraction``
+        histogram. The sample runs on a BACKGROUND thread with its own
+        snapshot pin — a full serial BFS on a big graph must not stall
+        the flush (or the pipelined engine's one finish worker) for a
+        diagnostic."""
+        if self._policy is None:
+            return
+        digest = rt.snapshot.digest
+        if not self._policy.note(digest, route, len(pairs), seconds):
+            return
+        try:
+            snap = rt.snapshot.retain()
+        except RuntimeError:
+            # racing retirement: skip this sample (and release the
+            # claimed one-in-flight slot, or sampling stops forever)
+            self._policy.sample_done()
+            return
+        policy = self._policy
+        n = rt.n
+        src, dst = (int(v) for v in pairs[0])
+
+        def _sample():
+            try:
+                from bibfs_tpu.obs.telemetry import LevelTelemetry
+                from bibfs_tpu.solvers.serial import solve_serial_csr
+
+                tel = LevelTelemetry(n=n)
+                row_ptr, col_ind = snap.csr()
+                solve_serial_csr(n, row_ptr, col_ind, src, dst,
+                                 telemetry=tel)
+                policy.observe_levels(digest, tel.as_dict(), n)
+            except Exception:
+                pass  # a diagnostic sample must never fail anything
+            finally:
+                snap.release()
+                policy.sample_done()  # release the one-in-flight slot
+
+        threading.Thread(
+            target=_sample, name="bibfs-policy-sample", daemon=True
+        ).start()
 
     def _note_crossover(self) -> None:
         """A below-crossover batch skipped the mesh rung — a routing
@@ -1133,7 +1311,8 @@ class QueryEngine:
         host latency beats padding a whole batch rung for a few
         stragglers."""
         rt = self._current_rt()
-        for i, name in enumerate(self._ladder):
+        ladder = self._ladder_for(rt, pairs)
+        for i, name in enumerate(ladder):
             if name == "host":
                 break
             route = self.routes[name]
@@ -1145,13 +1324,29 @@ class QueryEngine:
                 rt, pairs, self._cutoffs_for(pairs, unique)
             )
             if results is not None:
+                # the solver-stamped whole-batch wall clock of the
+                # SUCCESSFUL attempt (launch t0 -> finish), not the
+                # attempt() wall time: retry backoff sleeps in a
+                # transient-failure flush would otherwise double the
+                # learned latency of a healthy route (the pipelined
+                # engine's launch_s + finish split makes the same
+                # exclusion)
+                self._note_route_time(
+                    rt, name, pairs, results[0].time_s
+                )
                 for j, (src, dst) in enumerate(pairs):
                     self._resolve(unique[(src, dst)], src, dst, results[j])
                 return
             # every retry burned (or the breaker is open): degrade down
             # the ladder instead of failing the batch
-            self._note_fallback(name, self._next_rung(i, rt, pairs))
-        self._flush_host(pairs, unique)
+            self._note_fallback(name, self._next_rung(i, rt, pairs, ladder))
+        # _flush_host returns its SOLVE time (delivery/banking
+        # excluded), comparable to the dispatch rungs' solver-stamped
+        # batch clocks — wall-timing the whole call would bias the
+        # learned crossover against host
+        self._note_route_time(
+            rt, "host", pairs, self._flush_host(pairs, unique)
+        )
 
     def _device_launch(self, pairs):
         """Stage 1 of a device flush: enqueue ONE batched program for
@@ -1264,16 +1459,21 @@ class QueryEngine:
         ]
         return cutoffs if any(c is not None for c in cutoffs) else None
 
-    def _flush_host(self, pairs, unique) -> None:
+    def _flush_host(self, pairs, unique) -> float:
+        """Solve + deliver one host batch; returns the SOLVE seconds
+        (the adaptive policy's comparable measurement)."""
+        t0 = time.perf_counter()
         results = self._solve_host_isolated(
             pairs, self._cutoffs_for(pairs, unique)
         )
+        solve_s = time.perf_counter() - t0
         n_ok = self._deliver_host_results(
             pairs, results,
             lambda key, res: self._resolve(unique[key], *key, res),
             lambda key, err: self._resolve_error(unique[key], err),
         )
         self._c_host_queries.inc(n_ok)
+        return solve_s
 
     def _deliver_host_results(self, pairs, results,
                               resolve_ok, resolve_err) -> int:
@@ -1503,6 +1703,13 @@ class QueryEngine:
             rts = list(self._runtimes.values())
         for rt in rts:
             rt.snapshot.release()
+        if self._policy is not None:
+            try:
+                self._policy.save()  # the learned-policy sidecar is
+                # best-effort at teardown: a full disk must not turn a
+                # clean close (or a kill() chaos drill) into a raise
+            except OSError:
+                pass
 
     def __enter__(self):
         return self
@@ -1524,6 +1731,7 @@ class QueryEngine:
         solved = (
             c["device_queries"] + c["host_queries"]
             + c["overlay_queries"] + c["mesh_queries"]
+            + c["blocked_queries"]
         )
         return {
             **c,
@@ -1555,6 +1763,9 @@ class QueryEngine:
             # report per-graph oracles through store.stats() instead)
             "oracle": (
                 None if self._oracle is None else self._oracle.stats()
+            ),
+            "adaptive": (
+                None if self._policy is None else self._policy.stats()
             ),
             "resilience": {
                 **self._res_cells.snapshot(),

@@ -67,6 +67,7 @@ ENV_VAR = "BIBFS_FAULTS"
 #: a typo'd site in a chaos spec must fail loudly, not silently inject
 #: nothing and pass the soak)
 KNOWN_SITES = ("device", "device_finish", "mesh", "mesh_finish",
+               "blocked", "blocked_finish",
                "host_batch", "wal_write", "wal_fsync", "manifest_rename")
 
 KINDS = ("error", "latency")

@@ -706,7 +706,8 @@ class PipelinedQueryEngine(QueryEngine):
             # outcome closes or re-opens it), launches on the flusher
             # and finishes on the worker; the terminal host rung solves
             # right here behind the bisection isolator
-            for i, rung in enumerate(self._ladder):
+            ladder = self._ladder_for(rt, pairs)
+            for i, rung in enumerate(ladder):
                 if rung == "host":
                     break
                 route = self.routes[rung]
@@ -718,7 +719,7 @@ class PipelinedQueryEngine(QueryEngine):
                     if self._launch_dispatch(route, rt, pairs, unique):
                         return
                 self._note_fallback(
-                    rung, self._next_rung(i, rt, pairs)
+                    rung, self._next_rung(i, rt, pairs, ladder)
                 )
             self._launch_host(rt, pairs, unique)
 
@@ -812,11 +813,20 @@ class PipelinedQueryEngine(QueryEngine):
         try:
             while True:
                 try:
+                    t_try = time.perf_counter()
                     self.stages.enter()
                     try:
                         out, finish, t0 = route.launch(rt, pairs)
                     finally:
                         self.stages.exit()
+                    # the SUCCESSFUL attempt's launch cost (excludes
+                    # failed tries + backoff): half of the adaptive
+                    # policy's route-time sample, the finish worker
+                    # adds its own half — so the measurement never
+                    # includes the finish pool's queue wait, which
+                    # would penalize dispatch routes exactly when they
+                    # carry traffic
+                    launch_s = time.perf_counter() - t_try
                     break
                 except Exception as e:
                     breaker.record_failure()
@@ -838,7 +848,7 @@ class PipelinedQueryEngine(QueryEngine):
             job_pin = True
             self._finish_pool.submit(
                 self._dispatch_finish_job, route, rt, out, finish, t0,
-                pairs, unique, t_launch,
+                pairs, unique, t_launch, launch_s,
             )
             return True
         except BaseException:
@@ -858,7 +868,7 @@ class PipelinedQueryEngine(QueryEngine):
             raise
 
     def _dispatch_finish_job(self, route, rt, out, finish, t0, pairs,
-                             unique, t_launch):
+                             unique, t_launch, launch_s=0.0):
         self.stages.enter()
         try:
             with self._bound(rt):  # decode/bank on the LAUNCH snapshot
@@ -866,6 +876,7 @@ class PipelinedQueryEngine(QueryEngine):
                     # counters inside route.finish are safe un-locked:
                     # this pool has exactly ONE worker, the only
                     # dispatch-side mutator
+                    t_fin = time.perf_counter()
                     results = route.finish(out, finish, t0, pairs)
                 except Exception as e:
                     # mid-execution dispatch failure: the batch is
@@ -885,6 +896,22 @@ class PipelinedQueryEngine(QueryEngine):
                         )
                     return
                 route.breaker.record_success()
+                # the adaptive sample: two upper bounds on the true
+                # solve cost exist here — launch_s + finish wall
+                # (excludes the finish pool's queue wait, includes the
+                # untimed epilogue) and the solver-stamped time_s
+                # (t0 -> force: excludes the epilogue, includes the
+                # queue wait). min() is tighter than either and
+                # collapses to the sync engine's convention
+                # (results[0].time_s) whenever the pool is idle, so
+                # the shared sidecar never blends a loaded pipeline's
+                # queue wait OR a big batch's epilogue into a route's
+                # learned latency
+                self._note_route_time(
+                    rt, route.name, pairs,
+                    min(launch_s + time.perf_counter() - t_fin,
+                        results[0].time_s if results else 0.0),
+                )
                 lats = []
                 for (src, dst), res in zip(pairs, results):
                     self.dist_cache.put_result(
@@ -928,6 +955,9 @@ class PipelinedQueryEngine(QueryEngine):
             try:
                 results = self._solve_host_isolated(
                     pairs, self._cutoffs_for(pairs, unique)
+                )
+                self._note_route_time(
+                    rt, "host", pairs, time.perf_counter() - t_launch
                 )
             finally:
                 self.stages.exit()

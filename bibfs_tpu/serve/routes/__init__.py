@@ -3,16 +3,21 @@
 ``build_routes`` is the one place the engines assemble their route set
 and fallback ladder: oracle and overlay answer from their own seams
 (submit time / the overlay-read barrier), the ladder proper runs
-``mesh -> device -> host`` with ``serial`` reached per-query through
-the host isolator. The mesh rung only exists when the engine was
-configured with ``mesh=`` — and then it carries its OWN circuit
-breaker and retry policy, so a dead mesh degrades to the single-device
-rungs exactly the way a dead accelerator degrades to the host ladder.
+``mesh -> blocked -> device -> host`` with ``serial`` reached per-query
+through the host isolator. The mesh and blocked rungs only exist when
+the engine was configured with ``mesh=`` / ``blocked=`` — and then
+each carries its OWN circuit breaker and retry policy, so a dead rung
+degrades to the ones below it exactly the way a dead accelerator
+degrades to the host ladder. When the engine runs adaptive routing
+(``adaptive=``), the per-flush walk order over these rungs is the
+:class:`~bibfs_tpu.serve.policy.AdaptiveRouter`'s decision; the static
+ladder stays the default and the fallback semantics are unchanged.
 """
 
 from __future__ import annotations
 
 from bibfs_tpu.serve.routes.base import Route
+from bibfs_tpu.serve.routes.blocked import BlockedConfig, BlockedRoute
 from bibfs_tpu.serve.routes.device import DeviceRoute
 from bibfs_tpu.serve.routes.host import HostRoute, SerialRoute
 from bibfs_tpu.serve.routes.mesh import MeshConfig, MeshRoute, mesh_prebuild
@@ -21,6 +26,8 @@ from bibfs_tpu.serve.routes.overlay import OverlayRoute
 
 __all__ = [
     "Route",
+    "BlockedConfig",
+    "BlockedRoute",
     "DeviceRoute",
     "HostRoute",
     "SerialRoute",
@@ -33,14 +40,15 @@ __all__ = [
 ]
 
 
-def build_routes(engine, mesh_cfg=None, mesh_pre=None):
+def build_routes(engine, mesh_cfg=None, mesh_pre=None, blocked_cfg=None):
     """The engine's route set and fallback ladder.
 
     ``mesh_cfg``/``mesh_pre`` come from the engine ctor's early
     validation (:func:`mesh_prebuild` runs BEFORE the store snapshot is
-    pinned, so a bad mesh argument cannot leak a pin). Returns
-    ``(routes, ladder)`` — ``ladder`` is the ordered batch rungs
-    (``host`` terminal); oracle/overlay/serial sit outside it.
+    pinned, so a bad mesh argument cannot leak a pin); ``blocked_cfg``
+    adds the blocked rung ahead of device. Returns ``(routes, ladder)``
+    — ``ladder`` is the ordered batch rungs (``host`` terminal);
+    oracle/overlay/serial sit outside it.
     """
     from bibfs_tpu.serve.resilience import CircuitBreaker, RetryPolicy
 
@@ -54,6 +62,13 @@ def build_routes(engine, mesh_cfg=None, mesh_pre=None):
         "serial": SerialRoute(engine),
     }
     ladder = ("device", "host")
+    if blocked_cfg is not None:
+        routes["blocked"] = BlockedRoute(
+            engine, blocked_cfg,
+            retry=RetryPolicy(), breaker=CircuitBreaker(),
+            label=engine.obs_label,
+        )
+        ladder = ("blocked",) + ladder
     if mesh_cfg is not None:
         vmesh, qmesh = mesh_pre
         routes["mesh"] = MeshRoute(

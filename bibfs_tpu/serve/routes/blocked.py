@@ -1,0 +1,237 @@
+"""``route="blocked"`` — MXU-native blocked-adjacency expansion as a
+peer rung of the fallback ladder (``blocked -> device -> host``).
+
+The compute story lives in ``graph/blocked.py`` /
+``ops/blocked_expand.py`` / the blocked bodies in ``solvers/dense.py``
++ ``solvers/batch_minor.py``: a flush's whole ``[n_pad, 2B]`` dual-side
+frontier plane advances per level as masked block matmuls over the
+tiled int8 adjacency — the MXU's native workload where the ELL device
+route issues element-at-a-time gathers, and measured 1.4-8x the device
+route on dense-ish and grid graphs on the CPU substrate too
+(bench_blocked.json; the plane dtype is resolved per substrate,
+``ops/blocked_expand.resolve_plane_dtype``).
+
+Routing: the blocked table trades arithmetic for locality, so it loses
+on graphs whose tile structure is NOT compact (high-diameter sparse
+random graphs light up nearly every tile at ~3 edges each). The static
+gate is the candidate-waste ratio — stored tile candidates per true
+directed edge — under ``waste_cap``, plus the batch crossover and the
+working-set fit; all three are calibrated (``calibration.json``, the
+platform entry's ``blocked`` block, written by ``bench.py
+--serve-blocked``) and the per-graph ordering on top of the static
+gate is owned by the :class:`~bibfs_tpu.serve.policy.AdaptiveRouter`
+when the engine runs adaptive. The route carries its own circuit
+breaker and retry policy — a broken blocked rung degrades to
+device/host exactly like a dead mesh — and its own chaos sites
+(``blocked`` / ``blocked_finish``).
+
+Executable identity: blocked programs are keyed through
+``placement_bucket_key(kind="blocked")`` over the blocked shape key
+(``graph/blocked.blocked_bucket_key``), so a blocked program can never
+count as a hit on a device or mesh executable of the same padded
+vertex shape.
+"""
+
+from __future__ import annotations
+
+import time
+import weakref
+from dataclasses import dataclass
+
+import numpy as np
+
+from bibfs_tpu.obs.metrics import REGISTRY
+from bibfs_tpu.obs.trace import span
+from bibfs_tpu.serve.buckets import bucket_batch, placement_bucket_key
+from bibfs_tpu.serve.resilience import BREAKER_STATE_CODES
+from bibfs_tpu.serve.routes.base import Route
+
+#: committed defaults, overridden by the calibrated ``blocked`` block
+#: of the platform's calibration.json entry (written by the soak).
+#: min_batch: the plane layout pads to 128 lanes per side, so the
+#: measured win starts once a flush fills a lane group. waste_cap:
+#: stored tile candidates per true directed edge — the measured wins
+#: (grid ~99, dense-ish gnp ~32-96) sit under 128; the sparse random
+#: regime where blocked loses badly sits in the thousands.
+DEFAULT_BLOCKED_MIN_BATCH = 128
+DEFAULT_BLOCKED_WASTE_CAP = 128.0
+
+
+@dataclass(frozen=True)
+class BlockedConfig:
+    """Blocked-route configuration (``QueryEngine(blocked=...)``).
+
+    ``min_batch`` / ``waste_cap`` override the calibrated crossover
+    constants (None = calibration, else the committed defaults);
+    ``dt`` forces the frontier-plane dtype (None = auto per substrate:
+    int8 on the MXU, f32 on the CPU dryrun)."""
+
+    min_batch: int | None = None
+    waste_cap: float | None = None
+    dt: str | None = None
+
+    @classmethod
+    def coerce(cls, blocked) -> "BlockedConfig":
+        if isinstance(blocked, cls):
+            return blocked
+        if blocked is True:
+            return cls()
+        raise ValueError(
+            f"blocked= takes True or a BlockedConfig; got {blocked!r}"
+        )
+
+
+def blocked_calibration() -> dict:
+    """The current platform's calibrated ``blocked`` crossover block
+    (empty when absent — callers fall back to the committed
+    defaults)."""
+    from bibfs_tpu.utils.calibrate import load_calibration
+
+    cal = load_calibration()
+    if not cal:
+        return {}
+    block = cal.get("blocked")
+    return block if isinstance(block, dict) else {}
+
+
+class _BlockedCells:
+    """The blocked route's registry cells (stable names in README
+    "Blocked expansion & adaptive routing"), minted at route
+    construction so a /metrics scrape shows the family at zero before
+    any blocked traffic."""
+
+    def __init__(self, label: str):
+        self.batches = REGISTRY.counter(
+            "bibfs_blocked_batches_total",
+            "Blocked-route batch dispatches (masked block-matmul "
+            "expansion)",
+            ("engine",),
+        ).labels(engine=label)
+        self.breaker_gauge = REGISTRY.gauge(
+            "bibfs_blocked_breaker_state",
+            "Blocked-route circuit breaker (0=closed 1=half_open 2=open)",
+            ("engine",),
+        ).labels(engine=label)
+
+    def snapshot(self) -> dict:
+        return {"batches": self.batches.value}
+
+
+class BlockedRoute(Route):
+    """The MXU-tile rung of the fallback ladder (module docstring).
+    Owns its own circuit breaker and retry policy — a broken blocked
+    rung degrades to the single-device rungs, never to
+    unavailability."""
+
+    name = "blocked"
+    is_dispatch = True
+
+    def __init__(self, engine, cfg: BlockedConfig, *, retry, breaker,
+                 label: str):
+        super().__init__(engine, retry=retry, breaker=breaker)
+        from bibfs_tpu.ops.blocked_expand import resolve_plane_dtype
+
+        self.config = cfg
+        cal = blocked_calibration()
+        self.min_batch = int(
+            cfg.min_batch if cfg.min_batch is not None
+            else cal.get("min_batch", DEFAULT_BLOCKED_MIN_BATCH)
+        )
+        self.waste_cap = float(
+            cfg.waste_cap if cfg.waste_cap is not None
+            else cal.get("waste_cap", DEFAULT_BLOCKED_WASTE_CAP)
+        )
+        self.dt = resolve_plane_dtype(cfg.dt)
+        self.cells = _BlockedCells(label)
+        # weakly-bound breaker gauge listener, the mesh route's exact
+        # contract: a shared breaker must not pin dead cells
+        cells_ref = weakref.ref(self.cells)
+
+        def _on_transition(state):
+            cells = cells_ref()
+            if cells is None:
+                return False
+            cells.breaker_gauge.set(BREAKER_STATE_CODES[state])
+            return True
+
+        breaker.add_listener(_on_transition)
+        self.cells.breaker_gauge.set(BREAKER_STATE_CODES[breaker.state])
+
+    # ---- selection ---------------------------------------------------
+    def eligible(self, rt, pairs) -> bool:
+        """Above the batch crossover, on a graph whose tile structure
+        is compact enough to pay for itself, within the working-set
+        fit. The meta check reads counts only — the blocked table
+        itself is built lazily on the first routed flush."""
+        if len(pairs) < self.min_batch:
+            return False
+        from bibfs_tpu.graph.blocked import TILE
+        from bibfs_tpu.ops.blocked_expand import blocked_fits
+
+        nblocks, bwidth, _nnz = rt.blocked_meta()
+        edges2 = 2 * rt.snapshot.num_edges
+        if edges2 == 0:
+            return False
+        waste = bwidth * TILE * nblocks * TILE / edges2
+        if waste > self.waste_cap:
+            return False
+        return blocked_fits(
+            nblocks, bwidth, bucket_batch(len(pairs)),
+            itemsize=self.dt.itemsize,
+        )
+
+    # ---- the two-stage solve seam ------------------------------------
+    def launch(self, rt, pairs):
+        from bibfs_tpu.solvers.batch_minor import blocked_batch_dispatch
+
+        with span("blocked_launch", batch=len(pairs)):
+            eng = self.engine
+            if eng._faults is not None:
+                eng._faults.fire("blocked", pairs)
+            g = rt.blocked_graph()
+            rung = min(bucket_batch(len(pairs)), eng.max_batch)
+            # pad to the batch rung with inert (0, 0) queries so every
+            # queue depth reuses a handful of compiled blocked programs
+            padded = np.zeros((rung, 2), dtype=np.int64)
+            padded[: len(pairs)] = pairs
+            eng.exec_cache.note(placement_bucket_key(
+                rt.blocked_bucket_key, kind="blocked", shards=1,
+                extra=(self.dt.name, rung),
+            ))
+            _p, thunk = blocked_batch_dispatch(g, padded, dt=self.dt)
+            t0 = time.perf_counter()
+            out = thunk()  # lazy on tunneled runtimes; finish forces
+            return out, rung, t0
+
+    def finish(self, out, rung, t0, pairs):
+        from bibfs_tpu.solvers.dense import _materialize_blocked_batch
+        from bibfs_tpu.solvers.timing import force_scalar
+
+        with span("blocked_finish", batch=len(pairs)):
+            eng = self.engine
+            if eng._faults is not None:
+                eng._faults.fire("blocked_finish", pairs)
+            force_scalar(out)  # lazy runtimes execute at the value read
+            elapsed = time.perf_counter() - t0
+            # the bound flush runtime's memoized CSR carries the path
+            # walk — the same snapshot the planes were solved on
+            csr = eng._current_rt().snapshot.csr()
+            results = _materialize_blocked_batch(
+                out, pairs, elapsed, *csr
+            )
+            # single-mutator by construction (sync: flushing thread;
+            # pipelined: the one finish worker), like the mesh cells
+            self.cells.batches.inc()
+            eng.counters["blocked_queries"] += len(pairs)
+            return results
+
+    # ---- introspection -----------------------------------------------
+    def stats(self) -> dict:
+        out = super().stats()
+        out.update(self.cells.snapshot())
+        out["crossover"] = {
+            "min_batch": self.min_batch,
+            "waste_cap": self.waste_cap,
+            "plane_dtype": self.dt.name,
+        }
+        return out

@@ -750,6 +750,37 @@ def batch_dispatch(g, pairs, dt8: bool = False):
     return pairs, thunk, lambda out: _finish_dt8(g, pairs, out)
 
 
+def blocked_batch_dispatch(g, pairs, dt=None):
+    """Dispatch one flush through the blocked-matmul kernel — the
+    batched variant of the MXU-native expansion (``graph/blocked.py``,
+    ``ops/blocked_expand.py``): ONE ``[n_pad, 2B]`` dual-side frontier
+    plane rides each adjacency sweep, so the whole flush amortizes the
+    blocked table exactly the way the dp-mesh batch amortizes its
+    L2-resident shard plane. ``g`` is a
+    :class:`~bibfs_tpu.solvers.dense.BlockedDeviceGraph`; returns
+    ``(pairs, thunk)`` — the thunk is the TIMED unit, and the untimed
+    epilogue (`dense._materialize_blocked_batch`) reconstructs paths
+    from the dist planes over the host CSR."""
+    from bibfs_tpu.ops.blocked_expand import (
+        chunk_block_rows,
+        resolve_plane_dtype,
+    )
+    from bibfs_tpu.solvers.dense import _get_blocked_kernel
+
+    dt = resolve_plane_dtype(dt)
+    b_pad = pad_batch(len(pairs))
+    rc = min(
+        chunk_block_rows(g.bwidth, 2 * b_pad, dt.itemsize, g.tile),
+        g.nblocks,
+    )
+    kern = _get_blocked_kernel(g.nblocks, g.bwidth, b_pad, dt, rc, g.tile)
+    srcs_a, dsts_a = _padded_queries(pairs, b_pad)
+    thunk = lambda: jax.block_until_ready(  # noqa: E731
+        kern(g.tab, g.bcol, g.deg, srcs_a, dsts_a)
+    )
+    return pairs, thunk
+
+
 def _finish_dt8(g, pairs, out):
     """The untimed dt8 epilogue: slot-parent decode + capped refill."""
     out = _decode_slot_parents(g, out)

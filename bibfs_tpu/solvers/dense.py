@@ -143,6 +143,227 @@ class DeviceGraph:
         raise ValueError(f"unknown layout {layout!r} (expected 'ell' or 'tiered')")
 
 
+@dataclasses.dataclass
+class BlockedDeviceGraph:
+    """The MXU-tile blocked adjacency resident in device HBM — the
+    upload of :class:`bibfs_tpu.graph.blocked.BlockedGraph`, done once
+    per graph like :meth:`DeviceGraph.from_ell`. ``tab`` stays int8 on
+    device (the MXU's native input dtype; the CPU substrate's kernel
+    casts to its resolved plane dtype at the dot)."""
+
+    n: int
+    n_pad: int
+    tile: int
+    nblocks: int
+    bwidth: int
+    num_edges: int
+    tab: jax.Array  # int8 [nblocks, bwidth, tile, tile]
+    bcol: jax.Array  # int32 [nblocks, bwidth], sentinel nblocks
+    deg: jax.Array  # int32 [n_pad]
+
+    @classmethod
+    def from_host(cls, bg, device=None) -> "BlockedDeviceGraph":
+        put = (
+            partial(jax.device_put, device=device) if device
+            else jax.device_put
+        )
+        return cls(
+            n=bg.n, n_pad=bg.n_pad, tile=bg.tile, nblocks=bg.nblocks,
+            bwidth=bg.bwidth, num_edges=bg.num_edges,
+            tab=put(bg.tab), bcol=put(bg.bcol), deg=put(bg.deg),
+        )
+
+
+_BIGI = 2147483647  # int32 max: never wins a min
+
+
+def _blocked_active(st):
+    """Per-query live mask, the minor kernel's exact rule: both sides
+    advance lock-step, so a query stops once ``2 * rnd >= best`` or
+    either frontier empties."""
+    return (
+        (2 * st["rnd"] < st["best"])
+        & (st["cnt_s"] > 0)
+        & (st["cnt_t"] > 0)
+    )
+
+
+def _make_blocked_body(tab, bcol, deg, b: int, rc: int):
+    """The blocked level body ``st -> st``: advance BOTH sides of all
+    ``b`` queries one level as masked block matmuls
+    (:func:`bibfs_tpu.ops.blocked_expand.expand_blocked_plane`). The
+    dual-side plane ``fr [n_pad, 2b]`` (source columns ``0..b-1``,
+    target columns ``b..2b-1``) rides ONE adjacency sweep per round —
+    the whole flush amortizes the table, which is the route's point.
+    Discovery masking, per-query freeze, the plane-wide meet vote and
+    the ``lvl_s + lvl_t >= best`` stop are the batch-minor kernel's
+    exact rules; parents are NOT tracked (a matmul has no argmin seam)
+    — paths reconstruct from the dist planes on the host
+    (:func:`_materialize_blocked_batch`), outside the timed region."""
+    from bibfs_tpu.ops.blocked_expand import expand_blocked_plane
+
+    def body(st):
+        act = _blocked_active(st)
+        actc = jnp.concatenate([act, act])
+        acti = act.astype(jnp.int32)
+        lvl = st["rnd"] + 1
+        # edges scanned this round = the CURRENT frontiers' degree sums
+        scanned = jnp.sum(
+            jnp.where(st["fr"] > 0, deg[:, None], 0), axis=0
+        )
+        reach = expand_blocked_plane(st["fr"], tab, bcol, rc=rc)
+        new = reach & (st["dist"] >= INF32) & actc[None, :]
+        dist = jnp.where(new, lvl, st["dist"])
+        ds, dtp = dist[:, :b], dist[:, b:]
+        sums = jnp.where((ds < INF32) & (dtp < INF32), ds + dtp, INF32)
+        mval = jnp.min(sums, axis=0)
+        rowid = jax.lax.broadcasted_iota(jnp.int32, sums.shape, 0)
+        midx = jnp.min(
+            jnp.where(sums == mval[None, :], rowid, _BIGI), axis=0
+        )
+        take = mval < st["best"]
+        return dict(
+            fr=new.astype(st["fr"].dtype),
+            dist=dist,
+            best=jnp.minimum(st["best"], mval),
+            meet=jnp.where(take, midx, st["meet"]),
+            cnt_s=jnp.sum(new[:, :b], axis=0, dtype=jnp.int32),
+            cnt_t=jnp.sum(new[:, b:], axis=0, dtype=jnp.int32),
+            levels=st["levels"] + 2 * acti,
+            edges=st["edges"] + (scanned[:b] + scanned[b:]) * acti,
+            rnd=lvl,
+        )
+
+    return body
+
+
+def _build_blocked_kernel(nblocks: int, bwidth: int, b: int, dt, rc: int,
+                          tile: int = 128):
+    """The jitted whole-batch blocked search for one (table, batch)
+    geometry: ``(tab, bcol, deg, srcs, dsts) -> (best, meet,
+    dist [n_pad, 2b], levels, edges)``. Like the minor kernel, a pure
+    function of the PADDED geometry — the graph's true ``n`` never
+    enters the program."""
+    n_pad = nblocks * tile
+
+    def kernel(tab, bcol, deg, srcs, dsts):
+        qi = jnp.arange(b, dtype=jnp.int32)
+        fr = (
+            jnp.zeros((n_pad, 2 * b), dt)
+            .at[srcs, qi].set(1).at[dsts, b + qi].set(1)
+        )
+        dist = (
+            jnp.full((n_pad, 2 * b), INF32, jnp.int32)
+            .at[srcs, qi].set(0).at[dsts, b + qi].set(0)
+        )
+        st = dict(
+            fr=fr, dist=dist,
+            best=jnp.where(srcs == dsts, 0, INF32).astype(jnp.int32),
+            meet=jnp.where(srcs == dsts, srcs, -1).astype(jnp.int32),
+            cnt_s=jnp.ones((b,), jnp.int32),
+            cnt_t=jnp.ones((b,), jnp.int32),
+            levels=jnp.zeros((b,), jnp.int32),
+            edges=jnp.zeros((b,), jnp.int32),
+            rnd=jnp.int32(0),
+        )
+        body = _make_blocked_body(tab, bcol, deg, b, rc)
+        out = jax.lax.while_loop(
+            lambda st: jnp.any(_blocked_active(st)), body, st
+        )
+        return (
+            out["best"], out["meet"], out["dist"],
+            out["levels"], out["edges"],
+        )
+
+    return kernel
+
+
+@lru_cache(maxsize=None)
+def _get_blocked_kernel(nblocks: int, bwidth: int, b: int, dt, rc: int,
+                        tile: int = 128):
+    return jax.jit(_build_blocked_kernel(nblocks, bwidth, b, dt, rc, tile))
+
+
+def _walk_dist_plane(row_ptr, col_ind, dvec, v: int) -> list[int]:
+    """Walk ``v`` back to its side's root along strictly-decreasing
+    level stamps. Level-synchronous dists make this exact: every
+    stamped vertex at level l > 0 has at least one neighbor stamped
+    l - 1 (the one that discovered it)."""
+    path = [v]
+    lvl = int(dvec[v])
+    while lvl > 0:
+        for u in col_ind[row_ptr[v]: row_ptr[v + 1]]:
+            if dvec[u] == lvl - 1:
+                v = int(u)
+                lvl -= 1
+                path.append(v)
+                break
+        else:  # impossible for a level-synchronous stamping
+            raise RuntimeError(
+                f"blocked dist plane inconsistent at vertex {v}"
+            )
+    return path
+
+
+def _materialize_blocked_batch(
+    out, pairs, elapsed: float, row_ptr, col_ind
+) -> list[BFSResult]:
+    """The blocked route's untimed epilogue: one device->host transfer
+    per output, then per-query path reconstruction from the dist
+    planes over the host CSR — the walk costs ``hops * deg`` per found
+    query, cheaper than shipping (or even computing) parent planes."""
+    best, meet, dist, levels, edges = (np.asarray(o) for o in out)
+    b_pad = dist.shape[1] // 2
+    results = []
+    for i, (src, dst) in enumerate(pairs):
+        if best[i] >= INF32:
+            results.append(BFSResult(
+                False, None, None, None, elapsed,
+                int(levels[i]), int(edges[i]),
+            ))
+            continue
+        m = int(meet[i])
+        left = _walk_dist_plane(row_ptr, col_ind, dist[:, i], m)
+        right = _walk_dist_plane(row_ptr, col_ind, dist[:, b_pad + i], m)
+        results.append(BFSResult(
+            True, int(best[i]), left[::-1] + right[1:], m, elapsed,
+            int(levels[i]), int(edges[i]),
+        ))
+    return results
+
+
+def solve_blocked_batch(
+    g: BlockedDeviceGraph, pairs, *, csr, dt=None
+) -> list[BFSResult]:
+    """Solve many (src, dst) queries through the blocked-matmul kernel
+    (``solve_batch_graph`` contract: ``time_s`` is the whole-batch wall
+    clock). ``csr`` is the host ``(row_ptr, col_ind)`` the path
+    reconstruction walks."""
+    from bibfs_tpu.solvers.batch_minor import blocked_batch_dispatch
+    from bibfs_tpu.solvers.timing import force_scalar
+
+    pairs = np.asarray(pairs, dtype=np.int64).reshape(-1, 2)
+    if pairs.size and not ((0 <= pairs).all() and (pairs < g.n).all()):
+        raise ValueError(f"src/dst out of range for n={g.n}")
+    pairs, thunk = blocked_batch_dispatch(g, pairs, dt=dt)
+    t0 = time.perf_counter()
+    out = thunk()
+    force_scalar(out)  # lazy runtimes execute at the value read
+    elapsed = time.perf_counter() - t0
+    return _materialize_blocked_batch(out, pairs, elapsed, *csr)
+
+
+def solve_blocked_graph(
+    g: BlockedDeviceGraph, src: int, dst: int, *, csr, dt=None
+) -> BFSResult:
+    """One query through the blocked kernel (a B=1 plane — the batched
+    form is where the layout pays; this exists for parity tests and
+    completeness)."""
+    if not (0 <= src < g.n and 0 <= dst < g.n):
+        raise ValueError(f"src/dst out of range for n={g.n}")
+    return solve_blocked_batch(g, [(src, dst)], csr=csr, dt=dt)[0]
+
+
 def _auto_push_cap(n_pad: int) -> int:
     """Frontier size below which push beats pull. Push costs ~K*width
     scattered elements (element-at-a-time scatter/gather), pull costs
@@ -912,6 +1133,9 @@ def _solve_dense_traced(
     from bibfs_tpu.obs.telemetry import coerce
 
     tel = coerce(telemetry)
+    if tel.n != 0:
+        # re-stamp per solve (see solve_serial_csr; n=0 opts out)
+        tel.n = g.n
     schedule, hybrid, _pl = DENSE_MODES[mode]
     base_mode = {
         "pallas": "sync", "pallas_alt": "alt",

@@ -186,6 +186,9 @@ def solve_native_graph(
         from bibfs_tpu.obs.telemetry import coerce
 
         tel = coerce(telemetry)
+        if tel is not None and tel.n != 0:
+            # re-stamp per solve (see solve_serial_csr; n=0 opts out)
+            tel.n = int(g.n)
     if tel is None:
         _check(lib.bibfs_solve_s(*common), "solve")
     else:

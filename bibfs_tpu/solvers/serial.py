@@ -95,6 +95,10 @@ def solve_serial_csr(
         from bibfs_tpu.obs.telemetry import coerce
 
         telemetry = coerce(telemetry)
+        if telemetry is not None and telemetry.n != 0:
+            # re-stamp per solve: a collector reused across graphs
+            # must record THIS graph's fractions (n=0 opts out)
+            telemetry.n = int(n)
     t0 = time.perf_counter()
     if src == dst:
         res = BFSResult(True, 0, [src], src, time.perf_counter() - t0, 0, 0)

@@ -92,6 +92,7 @@ class GraphSnapshot:
         self._csr = None
         self._ell = None  # serving-bucketed ELL
         self._tiered = None
+        self._blocked = None  # MXU tile layout (graph/blocked.py)
 
     @classmethod
     def build(cls, n: int, edges: np.ndarray | None = None, *,
@@ -157,6 +158,24 @@ class GraphSnapshot:
                         self._tiered = t
         return t
 
+    def blocked(self):
+        """The MXU-tile blocked adjacency
+        (:func:`bibfs_tpu.graph.blocked.build_blocked`), built once —
+        the ``route="blocked"`` runtimes of every engine over this
+        snapshot share it, and a hot-swap rebuilds it through the same
+        machinery as CSR/ELL."""
+        t = self._blocked
+        if t is None:
+            from bibfs_tpu.graph.blocked import build_blocked
+
+            with self._lock:
+                t = self._blocked
+                if t is None:
+                    t = build_blocked(self.n, pairs=self.pairs)
+                    if not self._retired:
+                        self._blocked = t
+        return t
+
     def undirected_edges(self) -> np.ndarray:
         """The ``u < v`` half of the canonical pairs — what the native
         host builder (which mirrors internally) and the delta-overlay
@@ -187,7 +206,7 @@ class GraphSnapshot:
             # the canonical pairs stay (tiny relative to the tables, and
             # stats()/digest re-derivation may still read them); the
             # built adjacency tables are the memory owners
-            self._csr = self._ell = self._tiered = None
+            self._csr = self._ell = self._tiered = self._blocked = None
         for hook in hooks:
             try:
                 hook(self)

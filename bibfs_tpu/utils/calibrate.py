@@ -63,6 +63,14 @@ import numpy as np
 
 CAL_ENV = "BIBFS_CALIBRATION"
 CAL_FILENAME = "calibration.json"
+
+#: a cached-arg dispatch slower than this means the calibrating probe
+#: itself was degraded (the committed tpu block's 66747.8 µs is a
+#: tunneled backend timing out on metadata retries, not a healthy
+#: device) — consumers routing off such a block get one visible
+#: warning per platform instead of silently tuning to junk
+DEGRADED_DISPATCH_US = 1000.0
+_warned_degraded: set = set()
 _REPO_ROOT = os.path.dirname(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 )
@@ -175,7 +183,19 @@ def run_calibration(
         if push_level_us[str(k)] < pull_level_us:
             push_cap = k
 
+    import datetime
+    import platform as _platform
+
     entry = {
+        # provenance stamp: consumers can tell a fresh measurement from
+        # a stale banked block (the degraded-probe warning below names
+        # it); pre-stamp blocks simply lack the field
+        "measured_on": {
+            "timestamp": datetime.datetime.now(
+                datetime.timezone.utc
+            ).strftime("%Y-%m-%dT%H:%M:%SZ"),
+            "machine": _platform.node(),
+        },
         "n_pad": g.n_pad,
         "width": width,
         "repeats": repeats,
@@ -270,4 +290,36 @@ def load_calibration() -> dict | None:
         platform = jax.devices()[0].platform
     except RuntimeError:
         return None
-    return data.get(platform)
+    entry = data.get(platform)
+    if entry is not None:
+        _warn_if_degraded(platform, entry)
+    return entry
+
+
+def _warn_if_degraded(platform: str, entry: dict) -> None:
+    """One visible warning per platform when the calibration block
+    being routed off was measured by a clearly-degraded probe
+    (:data:`DEGRADED_DISPATCH_US` — the committed tpu block's 66.7 ms
+    cached dispatch is a tunneled backend stalling, and every constant
+    derived from that session inherits the stall)."""
+    if platform in _warned_degraded:
+        return
+    try:
+        cached = float(entry.get("dispatch_cached_us", 0.0))
+    except (TypeError, ValueError):
+        return
+    if cached <= DEGRADED_DISPATCH_US:
+        return
+    _warned_degraded.add(platform)
+    import sys
+
+    stamp = entry.get("measured_on")
+    print(
+        f"warning: calibration block for platform {platform!r} was "
+        f"measured on a degraded substrate (dispatch_cached_us="
+        f"{cached:.1f} > {DEGRADED_DISPATCH_US:.0f}; measured_on="
+        f"{stamp if stamp else 'unstamped'}) — routing constants from "
+        "it may be junk; re-run `python bench.py --calibrate` on "
+        "healthy hardware",
+        file=sys.stderr,
+    )

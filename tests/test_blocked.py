@@ -1,0 +1,194 @@
+"""Blocked (MXU-tile) adjacency + masked-matmul expansion properties.
+
+The correctness contract: the blocked layout stores exactly the
+canonical edge set, one expansion of a frontier plane equals the
+NumPy neighbor expansion LEVEL BY LEVEL (so the equivalence is proven
+per round, not just on final answers), and the end-to-end blocked
+solver matches the serial oracle — on random, grid and disconnected
+graphs, including vertex counts that do not divide the 128 tile and
+graphs whose tile grid has empty block rows.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from bibfs_tpu.graph.blocked import TILE, blocked_bucket_key, build_blocked
+from bibfs_tpu.graph.csr import build_csr, canonical_pairs
+from bibfs_tpu.graph.generate import gnp_random_graph, grid_graph
+from bibfs_tpu.ops.blocked_expand import (
+    blocked_fits,
+    chunk_block_rows,
+    expand_blocked_plane,
+    resolve_plane_dtype,
+)
+from bibfs_tpu.solvers.dense import (
+    BlockedDeviceGraph,
+    solve_blocked_batch,
+    solve_blocked_graph,
+)
+from bibfs_tpu.solvers.serial import solve_serial_csr
+from bibfs_tpu.store.snapshot import GraphSnapshot
+
+CASES = [
+    # (name, n, edges): non-128-dividing n throughout; the clustered
+    # case leaves whole block rows empty (vertices 150.. are isolated)
+    ("random", 300, gnp_random_graph(300, 6 / 300, seed=1)),
+    ("dense-ish", 500, gnp_random_graph(500, 24 / 500, seed=2)),
+    ("grid", 15 * 17, grid_graph(15, 17, perforation=0.1, seed=3)),
+    ("disconnected", 400, gnp_random_graph(400, 0.8 / 400, seed=4)),
+    ("empty-block-rows", 600,
+     gnp_random_graph(150, 5 / 150, seed=5)),  # edges only in tile 0-1
+    ("edgeless", 200, np.zeros((0, 2), dtype=np.int64)),
+]
+
+
+def _adj_sets(n, pairs):
+    adj = [[] for _ in range(n)]
+    for u, v in pairs:
+        adj[u].append(v)
+    return adj
+
+
+@pytest.mark.parametrize("name,n,edges", CASES, ids=[c[0] for c in CASES])
+def test_build_blocked_stores_exact_edge_set(name, n, edges):
+    pairs = canonical_pairs(n, edges)
+    g = build_blocked(n, pairs=pairs)
+    assert g.n_pad % TILE == 0 and g.n_pad >= n
+    assert g.tab.shape == (g.nblocks, g.bwidth, TILE, TILE)
+    # reconstruct the directed pair list from the tiles
+    got = []
+    for bi in range(g.nblocks):
+        for k in range(g.bwidth):
+            bj = g.bcol[bi, k]
+            if bj == g.nblocks:  # sentinel slot must be all-zero
+                assert not g.tab[bi, k].any()
+                continue
+            r, c = np.nonzero(g.tab[bi, k])
+            got.extend(zip(bi * TILE + r, bj * TILE + c))
+    got = np.array(sorted(map(tuple, got)) or np.zeros((0, 2)),
+                   dtype=np.int64).reshape(-1, 2)
+    assert np.array_equal(got, pairs)
+    assert g.nnz_blocks <= g.nblocks * g.nblocks
+    assert g.num_edges == pairs.shape[0] // 2
+
+
+@pytest.mark.parametrize("name,n,edges", CASES, ids=[c[0] for c in CASES])
+@pytest.mark.parametrize("dt", ["float32", "int8"])
+def test_expand_equals_numpy_level_by_level(name, n, edges, dt):
+    """One op call == one NumPy frontier expansion, iterated from a
+    seed until the BFS closes — the per-ROUND equivalence the solver's
+    exactness rests on."""
+    pairs = canonical_pairs(n, edges)
+    g = build_blocked(n, pairs=pairs)
+    adj = _adj_sets(n, pairs)
+    dtj = resolve_plane_dtype(dt)
+    rc = min(chunk_block_rows(g.bwidth, 2, dtj.itemsize), g.nblocks)
+    tab = jnp.asarray(g.tab)
+    bcol = jnp.asarray(g.bcol)
+    for seed in (0, n // 2, n - 1):
+        frontier = {seed}
+        visited = {seed}
+        for _round in range(n):
+            fr = np.zeros((g.n_pad, 2), dtype=dtj)
+            fr[list(frontier), 0] = 1
+            reach = np.asarray(
+                expand_blocked_plane(jnp.asarray(fr), tab, bcol, rc=rc)
+            )
+            expect = set()
+            for v in frontier:
+                expect.update(adj[v])
+            assert set(np.nonzero(reach[:, 0])[0]) == expect
+            assert not reach[:, 1].any()  # the empty column stays empty
+            frontier = expect - visited
+            if not frontier:
+                break
+            visited |= frontier
+
+
+@pytest.mark.parametrize("name,n,edges", CASES, ids=[c[0] for c in CASES])
+@pytest.mark.parametrize("dt", ["float32", "int8"])
+def test_blocked_batch_matches_serial(name, n, edges, dt, rng):
+    pairs = canonical_pairs(n, edges)
+    csr = build_csr(n, pairs=pairs)
+    g = BlockedDeviceGraph.from_host(build_blocked(n, pairs=pairs))
+    qp = rng.integers(0, n, size=(24, 2))
+    qp = np.vstack([qp, [[0, 0], [0, n - 1]]])  # trivial + corner
+    results = solve_blocked_batch(g, qp, csr=csr, dt=dt)
+    edge_set = set(map(tuple, pairs))
+    for (s, d), res in zip(qp, results):
+        ref = solve_serial_csr(n, *csr, int(s), int(d))
+        assert res.found == ref.found, (s, d)
+        if not ref.found:
+            assert res.hops is None and res.path is None
+            continue
+        assert res.hops == ref.hops, (s, d)
+        assert res.path[0] == s and res.path[-1] == d
+        assert len(res.path) == res.hops + 1
+        for a, b in zip(res.path, res.path[1:]):
+            assert (a, b) in edge_set
+
+
+def test_blocked_single_query_and_range_check():
+    n = 130  # one tile + 2 rows
+    edges = gnp_random_graph(n, 4 / n, seed=7)
+    pairs = canonical_pairs(n, edges)
+    csr = build_csr(n, pairs=pairs)
+    g = BlockedDeviceGraph.from_host(build_blocked(n, pairs=pairs))
+    ref = solve_serial_csr(n, *csr, 1, n - 1)
+    res = solve_blocked_graph(g, 1, n - 1, csr=csr)
+    assert (res.found, res.hops) == (ref.found, ref.hops)
+    with pytest.raises(ValueError):
+        solve_blocked_graph(g, 0, n, csr=csr)
+
+
+def test_snapshot_memoizes_blocked_and_frees_on_retire():
+    n = 200
+    snap = GraphSnapshot.build(n, gnp_random_graph(n, 3 / n, seed=8))
+    b1 = snap.blocked()
+    assert snap.blocked() is b1  # memoized, shared by every consumer
+    snap.release()
+    assert snap._blocked is None  # retirement freed the table
+
+
+def test_blocked_fits_bounds():
+    assert blocked_fits(8, 8, 256)
+    # a table past the resident budget is refused
+    assert not blocked_fits(4096, 4096, 128, itemsize=1)
+
+
+def test_placement_key_never_collides_with_device_or_mesh():
+    """A blocked executable of the same padded vertex shape must never
+    count as a hit on the single-device or mesh program — the
+    ExecutableCache keys must differ structurally."""
+    from bibfs_tpu.serve.buckets import (
+        bucketed_ell,
+        ell_bucket_key,
+        placement_bucket_key,
+    )
+
+    n = 1000
+    edges = gnp_random_graph(n, 8 / n, seed=9)
+    pairs = canonical_pairs(n, edges)
+    ell = bucketed_ell(n, pairs=pairs)
+    bg = build_blocked(n, pairs=pairs)
+    rung = 256
+    dev_key = (ell_bucket_key(ell), "minor8", rung)
+    mesh_key = placement_bucket_key(
+        ell_bucket_key(ell), kind="mesh1d", shards=8, extra=("sync", rung)
+    )
+    dp_key = placement_bucket_key(
+        ell_bucket_key(ell), kind="dp", shards=8, extra=("dt8", rung)
+    )
+    blk_key = placement_bucket_key(
+        blocked_bucket_key(bg), kind="blocked", shards=1,
+        extra=("float32", rung),
+    )
+    keys = {dev_key, mesh_key, dp_key, blk_key}
+    assert len(keys) == 4
+    # and two dtype variants of the blocked program are distinct too
+    blk8 = placement_bucket_key(
+        blocked_bucket_key(bg), kind="blocked", shards=1,
+        extra=("int8", rung),
+    )
+    assert blk8 != blk_key

@@ -1,0 +1,306 @@
+"""route="blocked" serving + telemetry-driven adaptive routing.
+
+Covers: blocked-route exactness on both engines (vs the serial
+oracle), the eligibility gates (batch crossover, tile compactness),
+fault-driven degradation behind the route's own breaker, the metric
+families, mid-traffic hot-swap exactness, the adaptive
+explore->learn->steady-state arc, policy sidecar persistence (round
+trip, merge, corrupt tolerance) and the durable-respawn warm start
+through a real ProcessReplica.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from bibfs_tpu.graph.csr import build_csr, canonical_pairs
+from bibfs_tpu.graph.generate import gnp_random_graph
+from bibfs_tpu.obs.metrics import REGISTRY
+from bibfs_tpu.obs.names import (
+    ADAPTIVE_METRIC_FAMILIES,
+    BLOCKED_METRIC_FAMILIES,
+)
+from bibfs_tpu.serve.engine import QueryEngine
+from bibfs_tpu.serve.faults import FaultPlan
+from bibfs_tpu.serve.pipeline import PipelinedQueryEngine
+from bibfs_tpu.serve.policy import AdaptiveRouter
+from bibfs_tpu.serve.routes import BlockedConfig
+from bibfs_tpu.solvers.serial import solve_serial_csr
+from bibfs_tpu.store import GraphStore
+
+N = 700
+DEG = 30.0  # dense-ish: the compact-tile regime the route exists for
+
+
+def _graph(n=N, deg=DEG, seed=1):
+    edges = gnp_random_graph(n, deg / n, seed=seed)
+    pairs = canonical_pairs(n, edges)
+    return edges, pairs, build_csr(n, pairs=pairs)
+
+
+def _pairs(rng, n, count):
+    qp = np.unique(rng.integers(0, n, size=(3 * count, 2)), axis=0)
+    qp = qp[qp[:, 0] != qp[:, 1]]
+    rng.shuffle(qp)
+    return qp[:count]
+
+
+def _check_exact(n, csr, qp, results):
+    for (s, d), res in zip(qp, results):
+        ref = solve_serial_csr(n, *csr, int(s), int(d))
+        assert res.found == ref.found, (s, d)
+        if ref.found:
+            assert res.hops == ref.hops, (s, d)
+
+
+@pytest.mark.parametrize("engine_cls", [QueryEngine, PipelinedQueryEngine])
+def test_blocked_route_exact_both_engines(engine_cls, rng):
+    edges, pairs, csr = _graph()
+    eng = engine_cls(N, edges, pairs=pairs, blocked=True,
+                     cache_entries=0, flush_threshold=4)
+    try:
+        qp = _pairs(rng, N, 180)
+        results = eng.query_many(qp)
+        _check_exact(N, csr, qp, results)
+        st = eng.stats()
+        assert st["blocked_queries"] == len(qp)
+        assert st["routes"]["blocked"]["batches"] >= 1
+        assert st["device_queries"] == 0
+    finally:
+        eng.close()
+
+
+def test_blocked_metric_families_render_at_zero():
+    edges, pairs, _csr = _graph(seed=2)
+    eng = QueryEngine(N, edges, pairs=pairs, blocked=True, adaptive=True)
+    try:
+        render = REGISTRY.render()
+        for fam in BLOCKED_METRIC_FAMILIES + ADAPTIVE_METRIC_FAMILIES:
+            assert fam in render, fam
+    finally:
+        eng.close()
+
+
+def test_blocked_stands_aside_below_crossover_and_on_sparse(rng):
+    edges, pairs, csr = _graph()
+    eng = QueryEngine(N, edges, pairs=pairs, blocked=True,
+                      cache_entries=0, flush_threshold=4)
+    try:
+        qp = _pairs(rng, N, 40)  # below the 128 batch crossover
+        _check_exact(N, csr, qp, eng.query_many(qp))
+        assert eng.stats()["blocked_queries"] == 0
+    finally:
+        eng.close()
+    # a sparse random graph lights up nearly every tile at a few edges
+    # each: the candidate-waste gate must refuse it
+    n2 = 4000
+    edges2 = gnp_random_graph(n2, 2.2 / n2, seed=3)
+    pairs2 = canonical_pairs(n2, edges2)
+    eng2 = QueryEngine(n2, edges2, pairs=pairs2, blocked=True,
+                       cache_entries=0, flush_threshold=4)
+    try:
+        rt = eng2._graph_rt(None)
+        assert not eng2.routes["blocked"].eligible(
+            rt, [(0, 1)] * 256
+        )
+    finally:
+        eng2.close()
+
+
+def test_blocked_fault_degrades_to_host_and_breaker_opens(rng):
+    edges, pairs, csr = _graph(seed=4)
+    eng = QueryEngine(
+        N, edges, pairs=pairs, blocked=True, cache_entries=0,
+        flush_threshold=4,
+        faults=FaultPlan.parse("blocked:times=4"),
+    )
+    try:
+        # two faulted flushes: the first burns the retry budget (2
+        # attempts), the second's failure is the breaker's third
+        # consecutive — it opens
+        for seed_round in range(2):
+            qp = _pairs(rng, N, 160)
+            results = eng.query_many(qp)
+            _check_exact(N, csr, qp, results)  # degraded, never wrong
+        st = eng.stats()
+        assert st["blocked_queries"] == 0
+        fb = st["resilience"]["fallbacks"]
+        assert fb.get("blocked->device", 0) + fb.get("blocked->host", 0) >= 1
+        # 3 consecutive failures open the route's own breaker; the
+        # device/host rungs keep serving
+        assert st["routes"]["blocked"]["breaker"]["opens"] >= 1
+        render = REGISTRY.render()
+        assert "bibfs_blocked_breaker_state" in render
+    finally:
+        eng.close()
+
+
+def test_blocked_store_hot_swap_exact(rng):
+    n = 600
+    edges, pairs, csr = _graph(n=n, deg=24, seed=5)
+    store = GraphStore(compact_threshold=None)
+    store.add("g", n, edges)
+    eng = QueryEngine(store=store, graph="g", blocked=True,
+                      cache_entries=0, flush_threshold=4)
+    try:
+        qp = _pairs(rng, n, 150)
+        _check_exact(n, csr, qp, eng.query_many(qp))
+        have = set(map(tuple, pairs))
+        adds = [
+            [u, v] for u in range(0, 20) for v in range(n - 20, n)
+            if (u, v) not in have
+        ][:3]
+        store.update("g", adds=adds)
+        store.compact("g")
+        edges2 = np.vstack([edges, adds])
+        csr2 = build_csr(n, pairs=canonical_pairs(n, edges2))
+        _check_exact(n, csr2, qp, eng.query_many(qp))
+        # both sides of the swap rode the blocked route
+        assert eng.stats()["blocked_queries"] == 2 * len(qp)
+    finally:
+        eng.close()
+
+
+def test_adaptive_first_flush_differs_from_steady_state(rng):
+    """The learning arc: flush 1 explores the rung the static ladder
+    would try last (device), the steady state rides the measured
+    winner (blocked on this dense-ish graph)."""
+    edges, pairs, csr = _graph(seed=6)
+    eng = QueryEngine(N, edges, pairs=pairs, blocked=True, adaptive=True,
+                      device_batches=True, cache_entries=0,
+                      flush_threshold=4)
+    try:
+        for _ in range(6):
+            qp = _pairs(rng, N, 160)
+            _check_exact(N, csr, qp, eng.query_many(qp))
+        st = eng.stats()["adaptive"]
+        first = st["first_decision"]
+        digest = first["digest"]
+        last = st["digests"][digest]["last"]
+        assert first["reason"] == "explore"
+        assert last["reason"] == "learned"
+        assert first["route"] != last["route"]
+        assert last["route"] == "blocked"
+    finally:
+        eng.close()
+
+
+def test_policy_sidecar_round_trip_and_merge(tmp_path):
+    path = str(tmp_path / "policy.json")
+    p1 = AdaptiveRouter(label="t1", routes=("blocked", "device", "host"),
+                        path=path)
+    for _ in range(3):
+        p1.note("digA", "blocked", 256, 0.01)
+        p1.note("digA", "device", 256, 0.05)
+        p1.note("digA", "host", 256, 0.2)
+    p1.observe_levels("digA", {"levels": [
+        {"level": 1, "side": "s", "dir": "push", "frontier": 40,
+         "edges": 200},
+        {"level": 2, "side": "t", "dir": "pull", "frontier": 200,
+         "edges": 900},
+    ]}, 700)
+    p1.save()
+    # round trip: a fresh policy over the same sidecar is warm
+    p2 = AdaptiveRouter(label="t2", routes=("blocked", "device", "host"),
+                        path=path)
+    assert p2.loaded
+    order, reason = p2.order("digA", 256, ("blocked", "device", "host"))
+    assert reason == "learned" and order[0] == "blocked"
+    assert order[-1] == "host"
+    # the learned policy triple survives the trip
+    stats = p2.stats()["digests"]["digA"]
+    assert stats["levels"]["push_frontier_max"] == 40
+    assert p2.batch_crossover("digA", 9999) == 256
+    # merge-on-save: a second engine's digest composes, digA survives
+    p2.note("digB", "device", 128, 0.01)
+    p2.note("digB", "device", 128, 0.01)
+    p2.save()
+    data = json.load(open(path))
+    assert set(data["digests"]) == {"digA", "digB"}
+    # a corrupt sidecar is a cold start, never a crash
+    with open(path, "w") as f:
+        f.write("{not json")
+    p3 = AdaptiveRouter(label="t3", routes=("blocked",), path=path)
+    assert not p3.loaded
+
+
+def test_policy_explore_cap_unblocks_learning():
+    """A rung that is permanently ineligible for a graph (never
+    produces a sample however often exploration promotes it) must not
+    pin the policy in the explore phase: after EXPLORE_CAP fruitless
+    promotions it is treated as unmeasurable and the measured ordering
+    of the rungs that DO serve engages, unmeasurable rungs behind
+    them."""
+    p = AdaptiveRouter(label="t-cap", routes=("blocked", "device", "host"))
+    for _ in range(10):
+        p.order("dig", 256, ("blocked", "device", "host"))
+        # blocked never serves (ineligible); device/host carry the flush
+        p.note("dig", "device", 256, 0.01)
+        p.note("dig", "host", 256, 0.05)
+    order, reason = p.order("dig", 256, ("blocked", "device", "host"))
+    assert reason == "learned"
+    assert order[0] == "device"
+    assert order.index("blocked") > order.index("device")
+
+
+def test_policy_unknown_digest_defaults():
+    p = AdaptiveRouter(label="t4", routes=("blocked", "device", "host"))
+    order, reason = p.order("nope", 256, ("blocked", "device", "host"))
+    # nothing measured anywhere: explore from the reverse end
+    assert reason == "explore" and order[-1] == "host"
+    assert p.batch_crossover("nope", 32) == 32
+
+
+def test_durable_respawn_warm_starts_on_learned_route(tmp_path, rng):
+    """The warm-start gate: learn + persist through a durable store,
+    then a respawned ProcessReplica(durable=True) serves its FIRST
+    flush on the learned route — the policy sidecar rides the same
+    directory the WAL/checkpoint recovery machinery ships."""
+    from bibfs_tpu.fleet.replica import ProcessReplica
+
+    n = 600
+    edges, pairs, csr = _graph(n=n, deg=24, seed=7)
+    store = GraphStore(wal_dir=str(tmp_path), compact_threshold=None)
+    store.add("g", n, edges)
+    eng = QueryEngine(store=store, graph="g", blocked=True,
+                      adaptive=True, device_batches=True,
+                      cache_entries=0, flush_threshold=4)
+    try:
+        for _ in range(5):
+            qp = _pairs(rng, n, 160)
+            eng.query_many(qp)
+        learned = eng.stats()["adaptive"]
+        digest = learned["first_decision"]["digest"]
+        assert learned["digests"][digest]["last"]["route"] == "blocked"
+    finally:
+        eng.close()  # saves the sidecar
+    assert os.path.exists(tmp_path / "policy.json")
+
+    # deadline + threshold both above the submission window: the
+    # child's first flush must be the ONE deadline flush holding the
+    # whole submitted batch — a deadline firing mid-submission would
+    # split it below the blocked crossover and the witness would read
+    # a (correct) host-served partial flush instead of the learned route
+    replica = ProcessReplica(
+        "r0", store_dir=str(tmp_path), durable=True, max_wait_ms=1000.0,
+        extra_args=["--blocked", "--adaptive", "--threshold", "1000"],
+    )
+    try:
+        qp = _pairs(rng, n, 160)
+        tickets = [replica.submit(int(s), int(d), "g") for s, d in qp]
+        for t, (s, d) in zip(tickets, qp):
+            res = replica.wait_ticket(t, timeout=60.0)
+            ref = solve_serial_csr(n, *csr, int(s), int(d))
+            assert res.found == ref.found
+            if ref.found:
+                assert res.hops == ref.hops
+        st = replica.stats()
+        first = st["adaptive"]["first_decision"]
+        assert st["adaptive"]["loaded"]
+        assert first["reason"] == "learned"
+        assert first["route"] == "blocked"
+        assert st["blocked_queries"] >= 1
+    finally:
+        replica.close()
