@@ -2138,6 +2138,102 @@ def serve_blocked_main():
 # just a registry render) carries them
 
 
+# --serve-queries defaults: the query-taxonomy soak (msbfs/weighted/
+# kshortest/as-of through the kind routes, with history rolls and
+# per-kind fault injection) on a CPU-friendly graph; --quick is the CI
+# smoke shape (smaller graph, less traffic, same gates)
+QUERIES_N = int(os.environ.get("BENCH_QUERIES_N", 3000))
+QUERIES_Q = int(os.environ.get("BENCH_QUERIES_Q", 200))
+QUERIES_MS_TRAFFIC = int(os.environ.get("BENCH_QUERIES_MS_TRAFFIC", 24))
+QUERIES_MIN_SPEEDUP = float(
+    os.environ.get("BENCH_QUERIES_MIN_SPEEDUP", 3.0)
+)
+
+
+def serve_queries_main():
+    """``python bench.py --serve-queries``: the query-taxonomy soak.
+
+    Runs :func:`bibfs_tpu.serve.loadgen.run_queries` — a durable,
+    history-retaining store rolled v1 -> v2 -> v3 under live as-of +
+    point-to-point traffic (one roll lands mid-stream), a
+    ``--mix``-shaped mixed-taxonomy stream with every answer verified
+    against its kind's independent oracle (Dijkstra for weighted,
+    serial solves for msbfs per-source hops, CSR edge validation for
+    k-shortest paths), the msbfs-vs-per-query-pt speedup measurement,
+    and per-kind fault-injected degrades. The gate: as-of exact for
+    >= 2 historical versions across the mid-traffic hot-swap, every
+    mixed answer exact, msbfs >= BENCH_QUERIES_MIN_SPEEDUP x the
+    per-query point-to-point qps on 64-source traffic, every kind
+    degrading (not failing) under injected faults, and the
+    ``bibfs_query_*`` metric families present in the registry render.
+    ``--mix pt=0.4,ms=0.2,weighted=0.2,kshortest=0.1,asof=0.1``
+    overrides the traffic mix. Artifact: ``bench_queries.json``."""
+    t_setup = time.time()
+    platform, tpu_error = select_platform()
+    try:
+        from bibfs_tpu.graph.generate import gnp_random_graph
+        from bibfs_tpu.obs.metrics import REGISTRY
+        from bibfs_tpu.obs.names import QUERY_METRIC_FAMILIES
+        from bibfs_tpu.serve.loadgen import parse_query_mix, run_queries
+
+        quick = "--quick" in sys.argv
+        mix = None
+        if "--mix" in sys.argv:
+            mix = parse_query_mix(
+                sys.argv[sys.argv.index("--mix") + 1]
+            )
+        n = 800 if quick else QUERIES_N
+        q = 120 if quick else QUERIES_Q
+        ms_traffic = 8 if quick else QUERIES_MS_TRAFFIC
+        edges = gnp_random_graph(n, AVG_DEG / n, seed=1)
+        out = run_queries(
+            n, edges, queries=q, mix=mix, ms_traffic=ms_traffic,
+            msbfs_min_speedup=QUERIES_MIN_SPEEDUP,
+        )
+        render = REGISTRY.render()
+        missing = [m for m in QUERY_METRIC_FAMILIES if m not in render]
+        line = {
+            "metric": f"bibfs_serve_queries_{n}",
+            "value": out["msbfs"]["speedup"],
+            "unit": "x_vs_per_query_pt",
+            "graph": f"G({n}, {AVG_DEG:.1f}/n) seed=1",
+            "platform": platform,
+            "quick": quick,
+            **out,
+            "metrics_missing": missing,
+            "total_s": round(time.time() - t_setup, 1),
+        }
+        line["ok"] = bool(line["ok"] and not missing)
+        if tpu_error:
+            line["tpu_error"] = tpu_error[:300]
+        _write_artifact("bench_queries.json", line)
+        print(json.dumps({
+            "metric": line["metric"],
+            "value": line["value"],
+            "unit": line["unit"],
+            "ok": line["ok"],
+            "asof_ok": out["asof"]["ok"],
+            "mixed_ok": out["mixed"]["ok"],
+            "served_by_kind": out["mixed"]["served_by_kind"],
+            "msbfs_qps": out["msbfs"]["msbfs_qps"],
+            "pt_qps": out["msbfs"]["pt_qps"],
+            "resilience_ok": out["resilience"]["ok"],
+            "metrics_missing": missing,
+            "detail_file": "bench_queries.json",
+        }))
+        return 0 if line["ok"] else 1
+    except Exception as e:
+        import traceback
+
+        traceback.print_exc()
+        print(json.dumps({
+            "metric": "bibfs_serve_queries",
+            "value": None,
+            "error": f"{type(e).__name__}: {e}"[:400],
+        }))
+        return 1
+
+
 def serve_fleet_main():
     """``python bench.py --serve-fleet``: the fleet serving soak.
 
@@ -2239,6 +2335,8 @@ if __name__ == "__main__":
         sys.exit(serve_blocked_main())
     elif "--serve-fleet" in sys.argv:
         sys.exit(serve_fleet_main())
+    elif "--serve-queries" in sys.argv:
+        sys.exit(serve_queries_main())
     elif "--serve-oracle" in sys.argv:
         sys.exit(serve_oracle_main())
     elif "--serve-update" in sys.argv:

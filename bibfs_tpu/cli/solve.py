@@ -63,6 +63,38 @@ def main(argv=None):
     )
     ap.add_argument("--no-path", action="store_true", help="skip path printing")
     ap.add_argument(
+        "--sources",
+        default=None,
+        metavar="S1,S2,...",
+        help="multi-source query (bibfs_tpu/query): hop distance from "
+        "EVERY listed source to dst, answered by one bitmask-packed "
+        "msBFS sweep per 64 sources (replaces the positional src — "
+        "put the dst positional BEFORE this flag: "
+        "`bibfs-solve g.bin DST --sources S1,S2`; host tier)",
+    )
+    ap.add_argument(
+        "--kshortest",
+        type=int,
+        default=None,
+        metavar="K",
+        help="the K shortest loopless src->dst paths (Yen's over the "
+        "restricted-BFS machinery; host tier), non-decreasing in length",
+    )
+    ap.add_argument(
+        "--weighted",
+        action="store_true",
+        help="weighted shortest path via delta-stepping, edge weights "
+        "derived from the seeded symmetric hash (--weight-seed); "
+        "host tier",
+    )
+    ap.add_argument(
+        "--weight-seed",
+        type=int,
+        default=0,
+        help="weight-derivation seed for --weighted (same seed = same "
+        "weights on every replica; default 0)",
+    )
+    ap.add_argument(
         "--level-stats",
         action="store_true",
         help="record per-level telemetry (frontier sizes, edges scanned, "
@@ -156,6 +188,20 @@ def main(argv=None):
     except (OSError, ValueError) as e:
         print(f"Error reading graph: {e}", file=sys.stderr)
         return 2
+
+    taxonomy = (
+        args.sources is not None or args.kshortest is not None
+        or args.weighted
+    )
+    if taxonomy:
+        if sum((args.sources is not None, args.kshortest is not None,
+                args.weighted)) > 1:
+            ap.error("--sources / --kshortest / --weighted are mutually "
+                     "exclusive query kinds")
+        if args.pairs is not None or args.repeat > 1 or args.level_stats:
+            ap.error("taxonomy queries are single-query (no --pairs / "
+                     "--repeat / --level-stats)")
+        return _taxonomy_main(ap, args, n, edges)
 
     if args.layout == "tiered" and args.backend not in ("dense", "sharded"):
         ap.error("--layout tiered is only supported by the dense/sharded backends")
@@ -447,6 +493,76 @@ def _batch_main(args, n, edges, tracer, mode, rows=None, cols=None):
         f"[Time] {args.backend} batch of {len(results)} searches took "
         f"{batch_s:.9f} seconds ({batch_s / max(len(results), 1):.9f} s/query)"
     )
+    return 0
+
+
+def _taxonomy_main(ap, args, n, edges):
+    """``--sources`` / ``--kshortest`` / ``--weighted``: the typed
+    query kinds (bibfs_tpu/query) through :func:`api.solve_query`,
+    host tier, with the reference's scrapeable output shapes kept
+    where they apply."""
+    from bibfs_tpu.query import KShortest, MultiSource, Weighted
+    from bibfs_tpu.solvers.api import solve_query
+
+    if args.dst is None:
+        # --sources replaces src only; every kind still needs a dst
+        # (with --sources the one positional argument IS the dst)
+        if args.sources is not None and args.src is not None:
+            args.dst, args.src = args.src, None
+        else:
+            ap.error("taxonomy queries need a destination vertex")
+    if args.sources is not None:
+        if args.src is not None:
+            ap.error("--sources replaces the positional src")
+        try:
+            sources = tuple(
+                int(x) for x in args.sources.split(",") if x.strip()
+            )
+        except ValueError:
+            ap.error(f"--sources must be a comma list of ints, got "
+                     f"{args.sources!r}")
+        q = MultiSource(sources, args.dst)
+    elif args.kshortest is not None:
+        if args.src is None:
+            ap.error("--kshortest needs positional src and dst")
+        q = KShortest(args.src, args.dst, k=args.kshortest)
+    else:
+        if args.src is None:
+            ap.error("--weighted needs positional src and dst")
+        q = Weighted(args.src, args.dst, weight_seed=args.weight_seed)
+    try:
+        res = solve_query(n, edges, q)
+    except ValueError as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 2
+    if isinstance(q, MultiSource):
+        for s, hops in zip(q.sources, res.per_source):
+            print(f"{s} -> {q.dst}: "
+                  + (f"length = {hops}" if hops is not None else "no path"))
+        if res.found and res.path and not args.no_path:
+            print(f"Best ({q.sources[res.best]}): Path: "
+                  + " -> ".join(str(v) for v in res.path))
+        print(f"[Time] msbfs {res.sweeps} sweep(s) over {len(q.sources)} "
+              f"sources took {res.time_s:.9f} seconds")
+    elif isinstance(q, KShortest):
+        if not res.found:
+            print("No path found.")
+        for i, (p, hops) in enumerate(zip(res.paths, res.hops), 1):
+            line = f"[{i}] length = {hops}"
+            if not args.no_path:
+                line += "  path: " + " -> ".join(str(v) for v in p)
+            print(line)
+        print(f"[Time] kshortest k={q.k} took {res.time_s:.9f} seconds")
+    else:
+        if res.found:
+            print(f"Weighted distance = {res.dist:g} ({res.hops} edges)")
+            if res.path and not args.no_path:
+                print("Path: " + " -> ".join(str(v) for v in res.path))
+        else:
+            print("No path found.")
+        print(f"[Time] weighted delta-stepping took {res.time_s:.9f} "
+              f"seconds ({res.buckets} buckets, "
+              f"{res.relaxations} relaxations)")
     return 0
 
 

@@ -125,6 +125,15 @@ ADAPTIVE_METRIC_FAMILIES = (
     "bibfs_level_frontier_fraction",
 )
 
+#: query taxonomy routes (serve/routes/taxonomy.py); minted at
+#: route-set construction on EVERY engine, so any serving process
+#: renders the group at zero before the first taxonomy query
+QUERY_METRIC_FAMILIES = (
+    "bibfs_query_total",
+    "bibfs_query_asof_replay_seconds",
+    "bibfs_msbfs_breaker_state",
+)
+
 #: build identity (obs/metrics.py; minted at every registry init)
 BUILD_INFO_METRIC = "bibfs_build_info"
 
@@ -154,6 +163,7 @@ ALL_METRIC_NAMES = frozenset(
     + MESH_METRIC_FAMILIES
     + BLOCKED_METRIC_FAMILIES
     + ADAPTIVE_METRIC_FAMILIES
+    + QUERY_METRIC_FAMILIES
     + _FLEET_ONLY
     + (BUILD_INFO_METRIC,)
 )
@@ -181,6 +191,7 @@ NON_METRIC_TOKENS = frozenset((
 SERVE_ENDPOINT_METRICS = (
     "bibfs_queries_total",
     "bibfs_queries_routed_total",
+    "bibfs_query_total",
     "bibfs_dist_cache_events_total",
     "bibfs_flush_cause_total",
     "bibfs_flushes_total",

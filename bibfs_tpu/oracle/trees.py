@@ -98,12 +98,18 @@ def multi_source_bfs(n: int, row_ptr: np.ndarray, col_ind: np.ndarray,
         rows = touched[gained]
         newbits = new[gained]
         mask[rows] |= newbits
-        # unpack this level's arrivals into the distance matrix: test
-        # each live bit only against the rows that gained something
-        for j in range(k):
-            got = (newbits[:, bit_word[j]] & bit_val[j]).astype(bool)
-            if got.any():
-                dist[rows[got], j] = level
+        # unpack this level's arrivals into the distance matrix in ONE
+        # vectorized pass: little-endian bit explosion of the gained
+        # words, nonzero -> (row, search) scatter. The old per-search
+        # loop cost 64 masked passes per level — the difference between
+        # the sweep beating and losing to 64 per-query solves when this
+        # primitive serves the msbfs query route (query/msbfs.py).
+        bits = np.unpackbits(
+            newbits.view(np.uint8).reshape(rows.size, words * 8),
+            axis=1, bitorder="little",
+        )[:, :k]
+        rr, jj = np.nonzero(bits)
+        dist[rows[rr], jj] = level
         # pending is zero outside the live frontier by invariant: clear
         # last level's rows, stamp this level's (a vertex in both keeps
         # only its NEW bits — the old ones were pushed above)

@@ -1,0 +1,48 @@
+"""The query taxonomy subsystem (``bibfs_tpu/query``).
+
+Typed queries (:mod:`bibfs_tpu.query.types`) and the host-tier solver
+implementations behind the non-point-to-point kinds: bitmask-packed
+multi-source answering (:mod:`bibfs_tpu.query.msbfs`), delta-stepping
+weighted shortest paths with a Dijkstra validation oracle
+(:mod:`bibfs_tpu.query.weighted`), and Yen's k-shortest
+(:mod:`bibfs_tpu.query.kshortest`). The serving integration — routes,
+breakers, chaos seams, metrics — lives in
+:mod:`bibfs_tpu.serve.routes.taxonomy`; the time-travel reconstruction
+behind :class:`AsOf` lives in :mod:`bibfs_tpu.store.history`.
+
+Import-light by design: importing the taxonomy pulls neither JAX nor
+the serving stack, so ``solvers/api.py`` and the CLIs can type their
+signatures against it for free.
+"""
+
+from bibfs_tpu.query.types import (
+    MSBFS_WORD,
+    QUERY_KINDS,
+    AsOf,
+    KShortest,
+    KShortestResult,
+    MultiSource,
+    MultiSourceResult,
+    PointToPoint,
+    Query,
+    Weighted,
+    WeightedResult,
+    coerce_query,
+    result_found,
+)
+
+__all__ = [
+    "MSBFS_WORD",
+    "QUERY_KINDS",
+    "AsOf",
+    "KShortest",
+    "KShortestResult",
+    "MultiSource",
+    "MultiSourceResult",
+    "PointToPoint",
+    "Query",
+    "Weighted",
+    "WeightedResult",
+    "coerce_query",
+    "result_found",
+]

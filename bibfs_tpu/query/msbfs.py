@@ -1,0 +1,107 @@
+"""Multi-source query answering over the bitmask-packed msBFS sweep.
+
+:func:`bibfs_tpu.oracle.trees.multi_source_bfs` has carried the
+oracle tier since PR 6 as an INDEX BUILDER — K landmark BFS trees in
+one level-synchronous pass, one ``uint64`` reachability word per
+vertex, the reference MPI version's bitset-frontier idea
+(v2/second_try.cpp) word-packed and vectorized. This module promotes
+it to a first-class ANSWERING primitive for the ``msbfs`` query kind:
+one packed sweep computes all 64 sources' full distance vectors, so a
+flush holding any number of :class:`~bibfs_tpu.query.types.MultiSource`
+queries costs ``ceil(distinct_sources / 64)`` sweeps total — against
+one full bidirectional solve per (source, dst) pair on the
+point-to-point route. The per-query read afterwards is two array
+lookups per source, and a shortest PATH for the best source falls out
+of its distance vector by greedy descent (every vertex at distance d
+has a neighbor at d-1, by BFS construction).
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from bibfs_tpu.query.types import MSBFS_WORD, MultiSourceResult
+
+
+def path_from_dist(row_ptr: np.ndarray, col_ind: np.ndarray,
+                   dist_col: np.ndarray, src: int, dst: int):
+    """A shortest ``src``->``dst`` path recovered from the full
+    distance vector ``dist_col`` (distances FROM ``src``; -1 =
+    unreachable): walk from ``dst`` down the distance gradient. Cost
+    O(hops * deg) — no parent array needed, which is exactly why the
+    packed sweep (which stores none) can still answer with paths."""
+    d = int(dist_col[dst])
+    if d < 0:
+        return None
+    path = [int(dst)]
+    cur = int(dst)
+    for step in range(d, 0, -1):
+        row = col_ind[row_ptr[cur]: row_ptr[cur + 1]]
+        down = row[dist_col[row] == step - 1]
+        if down.size == 0:  # cannot happen on a consistent vector
+            return None
+        cur = int(down[0])
+        path.append(cur)
+    path.reverse()
+    return path
+
+
+def solve_multi_source(n: int, row_ptr: np.ndarray, col_ind: np.ndarray,
+                       queries, *, with_paths: bool = True):
+    """Answer a batch of :class:`MultiSource` queries with packed
+    sweeps: the DISTINCT sources across the whole batch ride sweeps of
+    64, then every query reads its ``(source, dst)`` cells from the
+    shared distance planes. Returns one
+    :class:`~bibfs_tpu.query.types.MultiSourceResult` per query."""
+    from bibfs_tpu.oracle.trees import multi_source_bfs
+
+    t0 = time.perf_counter()
+    distinct: list[int] = []
+    col_of: dict[int, int] = {}
+    for q in queries:
+        for s in q.sources:
+            s = int(s)
+            if s not in col_of:
+                col_of[s] = len(distinct)
+                distinct.append(s)
+    planes = []  # one int16 [n, <=64] plane per sweep
+    sweeps = 0
+    for lo in range(0, len(distinct), MSBFS_WORD):
+        chunk = np.asarray(distinct[lo: lo + MSBFS_WORD], dtype=np.int64)
+        planes.append(multi_source_bfs(n, row_ptr, col_ind, chunk))
+        sweeps += 1
+    elapsed = time.perf_counter() - t0
+
+    def col(s: int) -> np.ndarray:
+        i = col_of[int(s)]
+        return planes[i // MSBFS_WORD][:, i % MSBFS_WORD]
+
+    out = []
+    for q in queries:
+        dst = int(q.dst)
+        per = tuple(
+            (lambda d: None if d < 0 else int(d))(int(col(s)[dst]))
+            for s in q.sources
+        )
+        best = None
+        for i, h in enumerate(per):
+            if h is not None and (best is None or h < per[best]):
+                best = i
+        path = None
+        if best is not None and with_paths:
+            path = path_from_dist(
+                row_ptr, col_ind, col(q.sources[best]),
+                int(q.sources[best]), dst,
+            )
+        out.append(MultiSourceResult(
+            found=best is not None,
+            per_source=per,
+            best=best,
+            hops=per[best] if best is not None else None,
+            path=path,
+            time_s=elapsed,
+            sweeps=sweeps,
+        ))
+    return out

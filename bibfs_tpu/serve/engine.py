@@ -70,6 +70,7 @@ from bibfs_tpu.serve.resilience import (
     RetryPolicy,
     to_query_error,
 )
+from bibfs_tpu.query.types import PointToPoint, Query, coerce_query
 from bibfs_tpu.solvers.api import BFSResult
 from bibfs_tpu.store.snapshot import GraphSnapshot
 
@@ -261,9 +262,13 @@ class _Pending:
     its whole batch. ``graph`` is the store graph name the query is
     against (None on a store-less engine's single graph); ``cutoff`` is
     the distance oracle's proven upper bound when it had one — the
-    serial host rung seeds its meet bound with it (exact pruning)."""
+    serial host rung seeds its meet bound with it (exact pruning).
+    ``query`` carries the typed taxonomy query on non-point-to-point
+    tickets (None = the classic ``(src, dst)`` shape; ``src``/``dst``
+    then hold a representative pair for error reporting)."""
 
-    __slots__ = ("src", "dst", "graph", "result", "error", "cutoff")
+    __slots__ = ("src", "dst", "graph", "result", "error", "cutoff",
+                 "query")
 
     def __init__(self, src: int, dst: int, graph: str | None = None):
         self.src = src
@@ -272,12 +277,14 @@ class _Pending:
         self.result: BFSResult | None = None
         self.error: BaseException | None = None
         self.cutoff: int | None = None
+        self.query: Query | None = None
 
 
 @guarded_by("_lock", "_graph", "bucket_key", "_host_solver",
             "host_native_graph", "_serial_solver", "host_backend_resolved",
             "_mesh_graph", "mesh_bucket_key", "_dp_graph", "dp_bucket_key",
-            "_blocked_graph", "blocked_bucket_key", "_blocked_meta")
+            "_blocked_graph", "blocked_bucket_key", "_blocked_meta",
+            "_weights")
 class _GraphRuntime:
     """Everything an engine knows about solving ONE immutable graph
     snapshot: the lazily built+uploaded device graph and its compiled-
@@ -320,6 +327,10 @@ class _GraphRuntime:
         self.host_native_graph = None
         self._serial_solver = None
         self.host_backend_resolved: str | None = None
+        # per-seed derived edge weights for the weighted query kind
+        # (seed -> float64 aligned with the snapshot CSR), built on
+        # first weighted-routed flush like the other lazy tables
+        self._weights: dict = {}
 
     @property
     def graph(self):
@@ -486,6 +497,33 @@ class _GraphRuntime:
             )
             self.host_backend_resolved = "serial"
             return self._host_solver
+
+    #: memoized weight derivations kept per runtime — each costs one
+    #: float64 per CSR entry and the seed is CLIENT input, so the memo
+    #: must be bounded (FIFO eviction) or a seed-scanning client pins
+    #: O(seeds * E) memory for the snapshot's lifetime
+    WEIGHT_SEEDS_MAX = 8
+
+    def weights_for(self, seed: int, row_ptr, col_ind) -> "np.ndarray":
+        """The snapshot's derived edge weights for one ``weight_seed``
+        (:func:`bibfs_tpu.query.weighted.synthetic_weights`), memoized
+        per runtime — every weighted query of one seed against one
+        snapshot shares one derivation (bounded: ``WEIGHT_SEEDS_MAX``
+        seeds, FIFO). Only valid for the snapshot's own CSR (the
+        weighted route derives fresh over an overlay-merged CSR)."""
+        w = self._weights.get(int(seed))
+        if w is None:
+            from bibfs_tpu.query.weighted import synthetic_weights
+
+            with self._lock:
+                w = self._weights.get(int(seed))
+                if w is None:
+                    w = synthetic_weights(row_ptr, col_ind, int(seed))
+                    while len(self._weights) >= self.WEIGHT_SEEDS_MAX:
+                        # dicts iterate in insert order: FIFO eviction
+                        self._weights.pop(next(iter(self._weights)))
+                    self._weights[int(seed)] = w
+        return w
 
     def solve_serial_one(self, src: int, dst: int,
                          cutoff: int | None = None) -> BFSResult:
@@ -855,8 +893,17 @@ class QueryEngine:
         # oracle/overlay answer from their own seams, the batch ladder
         # runs mesh -> device -> host with serial reached per-query
         # through the host isolator
-        from bibfs_tpu.serve.routes import build_routes
+        from bibfs_tpu.serve.routes import (
+            KindResultCache,
+            QueryKindCells,
+            build_routes,
+        )
 
+        # taxonomy query accounting + result cache (serve/routes/
+        # taxonomy.py): minted BEFORE the routes so every family the
+        # kind routes touch renders at zero from construction
+        self._query_cells = QueryKindCells(self.obs_label)
+        self._kind_cache = KindResultCache()
         self.routes, self._ladder = build_routes(
             self, self._mesh_cfg, mesh_pre, self._blocked_cfg
         )
@@ -927,6 +974,7 @@ class QueryEngine:
                     # queries; reclaim their rows now instead of waiting
                     # for LRU churn
                     self.dist_cache.invalidate(old_id)
+                    self._kind_cache.invalidate(old_id)
             return new
 
     def _resolve_graph(self, graph) -> tuple:
@@ -1111,6 +1159,70 @@ class QueryEngine:
             raise t.error
         return t.result
 
+    @staticmethod
+    def _query_rep_pair(q: Query) -> tuple[int, int]:
+        """A representative ``(src, dst)`` for a taxonomy query — what
+        error messages and pair-targeted chaos rules key on."""
+        from bibfs_tpu.query.types import AsOf, MultiSource
+
+        if isinstance(q, AsOf):
+            return QueryEngine._query_rep_pair(q.inner)
+        if isinstance(q, MultiSource):
+            return int(q.sources[0]), int(q.dst)
+        return int(q.src), int(q.dst)
+
+    def submit_query(self, q, graph: str | None = None) -> _Pending:
+        """Queue one TYPED query (:mod:`bibfs_tpu.query`): the
+        taxonomy counterpart of :meth:`submit`. A
+        :class:`~bibfs_tpu.query.PointToPoint` (or a bare pair)
+        delegates to the classic ladder unchanged; the other kinds
+        (msbfs/weighted/kshortest/asof) queue for their kind routes
+        and resolve at the next flush — grouped per kind, packed
+        sweeps shared across the flush's MultiSource queries, results
+        cached per (snapshot digest, query key)."""
+        q = coerce_query(q)
+        if isinstance(q, PointToPoint):
+            self._query_cells.cell("pt", "ladder").inc()
+            return self.submit(q.src, q.dst, graph)
+        if self._rts_released:
+            raise ValueError("engine is closed")
+        src, dst = self._query_rep_pair(q)
+        if self._draining:
+            raise QueryError(
+                "engine is draining", kind="capacity", query=(src, dst),
+            )
+        name, rt = self._resolve_graph(graph)
+        q.validate(rt.n)
+        t = _Pending(src, dst, name)
+        t.query = q
+        self._c_queries.inc()
+        if self._overlay_pending(name) is None:
+            # overlay-read-then-resolve, the swap-race-safe ordering
+            # (see submit); while updates are pending the cache stands
+            # aside — its entries describe the base snapshot
+            rt = self._graph_rt(name)
+            hit = self._kind_cache.lookup(rt.graph_id, q.cache_key())
+            if hit is not None:
+                self._query_cells.cell(q.kind, "cache").inc()
+                t.result = hit
+                return t
+        self._pending.append(t)
+        if len(self._pending) >= self.max_batch:
+            self.flush()
+        return t
+
+    def query_one(self, q, graph: str | None = None):
+        """Submit + flush one typed query; returns its kind's result
+        type (``BFSResult`` / ``MultiSourceResult`` /
+        ``WeightedResult`` / ``KShortestResult``). Raises the
+        ticket's :class:`QueryError` on failure."""
+        t = self.submit_query(q, graph)
+        if t.result is None and t.error is None:
+            self.flush()
+        if t.error is not None:
+            raise t.error
+        return t.result
+
     def query_many(self, pairs, *, graph: str | None = None,
                    return_errors: bool = False) -> list:
         """Serve a whole query list through one (chunked) flush.
@@ -1145,17 +1257,26 @@ class QueryEngine:
         submit becomes a ``kind='invalid'`` :class:`QueryError` slot
         (submit-time validation is the ONE place that knows it is
         looking at client input) instead of aborting the whole list.
-        Shared by both engines' ``query_many``."""
+        Accepts bare ``(s, d)`` pairs and typed taxonomy queries,
+        mixed freely. Shared by both engines' ``query_many``."""
         tickets: list = []
-        for s, d in pairs:
+        for item in pairs:
             try:
-                tickets.append(self.submit(int(s), int(d), graph))
+                if isinstance(item, Query):
+                    tickets.append(self.submit_query(item, graph))
+                else:
+                    s, d = item
+                    tickets.append(self.submit(int(s), int(d), graph))
             except (ValueError, TypeError) as e:
                 if not return_errors:
                     raise
                 try:
-                    q = (int(s), int(d))
-                except (ValueError, TypeError):
+                    if isinstance(item, Query):
+                        q = self._query_rep_pair(item)
+                    else:
+                        s, d = item
+                        q = (int(s), int(d))
+                except (ValueError, TypeError, IndexError):
                     q = None
                 err = to_query_error(e, q, kind="invalid")
                 self._count_error(err)
@@ -1193,6 +1314,12 @@ class QueryEngine:
         overlay = self._overlay_pending(name)
         rt = self._pin_rt(name)
         with self._bound(rt), span("flush", queued=len(pend)):
+            tax = [t for t in pend if t.query is not None]
+            if tax:
+                pend = [t for t in pend if t.query is None]
+                self._flush_taxonomy(name, tax, overlay)
+                if not pend:
+                    return
             # dedupe exact repeats within one flush: serving traffic
             # repeats, and a batch slot per duplicate would be pure waste
             unique: dict[tuple[int, int], list[_Pending]] = {}
@@ -1222,6 +1349,91 @@ class QueryEngine:
                 self._c_overlay.inc()
                 for t in unique[key]:
                     t.result = res
+
+    # ---- taxonomy flushing (serve/routes/taxonomy.py) ----------------
+    def _flush_taxonomy(self, name, tickets, overlay) -> None:
+        """Resolve this flush's typed taxonomy tickets against the
+        flush-bound truth: the snapshot's memoized CSR normally, the
+        overlay-merged live CSR while edge updates are pending (every
+        kind answers EXACTLY on the live edge set — the overlay-route
+        contract, extended to the whole taxonomy; caching stands aside
+        there). Kinds are grouped so the msbfs rung packs the whole
+        flush's sources into shared sweeps."""
+        from bibfs_tpu.serve.routes import KindCtx
+
+        rt = self._current_rt()
+        if overlay is not None:
+            from bibfs_tpu.graph.csr import build_csr
+
+            row_ptr, col_ind = build_csr(rt.n, overlay.merged_edges())
+            ctx = KindCtx(rt.n, row_ptr, col_ind, base=False,
+                          name=name, graph_id=rt.graph_id)
+        else:
+            row_ptr, col_ind = rt.snapshot.csr()
+            ctx = KindCtx(rt.n, row_ptr, col_ind, base=True,
+                          name=name, graph_id=rt.graph_id)
+        groups: dict[str, list[_Pending]] = {}
+        for t in tickets:
+            groups.setdefault(t.query.kind, []).append(t)
+        for kind in sorted(groups):
+            self._flush_kind(kind, groups[kind], rt, ctx)
+
+    def _flush_kind(self, kind, tickets, rt, ctx) -> None:
+        """One kind group through its resilient rung pair: the kind
+        route's :meth:`~bibfs_tpu.serve.routes.base.Route.attempt`
+        (bounded retries behind its own breaker), degrading to the
+        kind's per-query-isolated ``fallback`` — counted in
+        ``bibfs_route_fallbacks_total{from=<kind>,to=host}`` — so an
+        injected (or real) fault on the primary costs throughput,
+        never availability. The walk order is the adaptive policy's
+        per-(digest, kind) decision when the engine runs adaptive."""
+        from bibfs_tpu.serve.routes import KIND_ROUTES
+
+        route_name = KIND_ROUTES[kind]
+        route = self.routes[route_name]
+        # dedupe identical queries within the flush (cache_key is the
+        # exact-repeat identity, same motivation as the pt flush)
+        unique: dict[tuple, list[_Pending]] = {}
+        for t in tickets:
+            unique.setdefault(t.query.cache_key(), []).append(t)
+        queries = [unique[k][0].query for k in unique]
+        ladder = (route_name, "host")
+        if self._policy is not None:
+            ladder, _why = self._policy.order(
+                rt.snapshot.digest, len(queries), ladder, kind=kind
+            )
+        results = None
+        used = "host"
+        t0 = time.perf_counter()
+        for rung in ladder:
+            if rung == "host":
+                break
+            results = route.attempt(rt, queries, ctx)
+            if results is not None:
+                used = rung
+                break
+            self._note_fallback(route_name, "host")
+        if results is None:
+            results = route.fallback(rt, queries, ctx)
+        elapsed = time.perf_counter() - t0
+        if self._policy is not None:
+            # whole-rung wall time (the taxonomy rungs are host-tier:
+            # there is no solver-stamped dispatch clock to prefer)
+            self._policy.note(
+                rt.snapshot.digest, used, len(queries), elapsed,
+                kind=kind,
+            )
+        cell = self._query_cells.cell(kind, used)
+        for key, res in zip(unique, results):
+            ts = unique[key]
+            if isinstance(res, QueryError):
+                self._resolve_error(ts, res)
+                continue
+            cell.inc(len(ts))
+            if ctx.base:
+                self._kind_cache.put(ctx.graph_id, key, res)
+            for t in ts:
+                t.result = res
 
     def _next_rung(self, i: int, rt, pairs, ladder=None) -> str:
         """The rung a failed/ineligible ladder step actually degrades
@@ -1733,9 +1945,18 @@ class QueryEngine:
             + c["overlay_queries"] + c["mesh_queries"]
             + c["blocked_queries"]
         )
+        kinds = self._query_cells.snapshot()
+        # taxonomy queries resolved by a solver rung (anything but the
+        # kind cache) count as solved for the dispatch-free figure
+        solved += sum(
+            v for kind, routes in kinds.items() if kind != "pt"
+            for route, v in routes.items() if route != "cache"
+        )
         return {
             **c,
             "solver_dispatch_free": c["queries"] - solved,
+            "query_kinds": kinds,
+            "kind_cache": self._kind_cache.stats(),
             "ladder": list(self._ladder),
             "routes": {
                 name: route.stats() for name, route in self.routes.items()

@@ -68,7 +68,11 @@ ENV_VAR = "BIBFS_FAULTS"
 #: nothing and pass the soak)
 KNOWN_SITES = ("device", "device_finish", "mesh", "mesh_finish",
                "blocked", "blocked_finish",
-               "host_batch", "wal_write", "wal_fsync", "manifest_rename")
+               "host_batch", "wal_write", "wal_fsync", "manifest_rename",
+               # taxonomy query kinds (serve/routes/taxonomy.py): the
+               # packed multi-source sweep, the delta-stepping solve,
+               # the Yen's batch, and the as-of historical replay
+               "msbfs", "weighted", "kshortest", "asof_replay")
 
 KINDS = ("error", "latency")
 

@@ -112,6 +112,100 @@ def sample_skewed_pairs(
     return pairs
 
 
+# ---- query-mix traffic (the taxonomy workload) -----------------------
+
+#: mix-spec aliases -> canonical query kinds (bibfs_tpu/query)
+_MIX_ALIASES = {
+    "pt": "pt", "p2p": "pt",
+    "ms": "msbfs", "msbfs": "msbfs",
+    "weighted": "weighted", "w": "weighted",
+    "kshortest": "kshortest", "ks": "kshortest",
+    "asof": "asof",
+}
+
+
+def parse_query_mix(spec: str) -> dict:
+    """Parse a ``--mix`` spec (``pt=0.7,ms=0.2,weighted=0.1``) into
+    normalized per-kind weights over the canonical kinds
+    (``pt``/``msbfs``/``weighted``/``kshortest``/``asof``). Unknown
+    kinds and non-positive totals fail loudly — a typo'd mix must not
+    silently soak the wrong taxonomy."""
+    weights: dict[str, float] = {}
+    for field in filter(None, (f.strip() for f in str(spec).split(","))):
+        key, eq, val = field.partition("=")
+        kind = _MIX_ALIASES.get(key.strip().lower())
+        if not eq or kind is None:
+            raise ValueError(
+                f"bad mix field {field!r} (expected kind=weight with "
+                f"kind in {sorted(set(_MIX_ALIASES))})"
+            )
+        w = float(val)
+        if w < 0:
+            raise ValueError(f"negative mix weight in {field!r}")
+        weights[kind] = weights.get(kind, 0.0) + w
+    total = sum(weights.values())
+    if total <= 0:
+        raise ValueError(f"query mix {spec!r} sums to zero")
+    return {k: w / total for k, w in weights.items() if w > 0}
+
+
+def sample_query_mix(n: int, q: int, mix: dict, *, seed: int = 0,
+                     ms_sources: int = 16, k: int = 3,
+                     weight_seed: int = 0, versions=()) -> list:
+    """``q`` typed taxonomy queries drawn from a ``parse_query_mix``
+    mix — the traffic shape for mixed-taxonomy soaks (``bench.py
+    --serve-queries``, ``--mix`` on the CLIs). ``ms_sources`` is each
+    MultiSource query's source-set size, ``versions`` the historical
+    store versions ``asof`` queries draw from (an ``asof`` weight with
+    no versions falls back to ``pt`` — the mix parser cannot know the
+    store's history). Self-pairs are re-drawn; fully reproducible per
+    seed."""
+    from bibfs_tpu.query import (
+        AsOf,
+        KShortest,
+        MultiSource,
+        PointToPoint,
+        Weighted,
+    )
+
+    mix = dict(mix)
+    if mix.get("asof") and not versions:
+        mix["pt"] = mix.get("pt", 0.0) + mix.pop("asof")
+    kinds = sorted(mix)
+    probs = np.array([mix[kd] for kd in kinds], dtype=np.float64)
+    probs /= probs.sum()
+    rng = np.random.default_rng(seed)
+    draws = rng.choice(len(kinds), size=q, p=probs)
+
+    def pair():
+        s = int(rng.integers(n))
+        d = int(rng.integers(n))
+        while d == s:
+            d = int(rng.integers(n))
+        return s, d
+
+    out = []
+    for i in range(q):
+        kind = kinds[draws[i]]
+        s, d = pair()
+        if kind == "pt":
+            out.append(PointToPoint(s, d))
+        elif kind == "msbfs":
+            m = min(int(ms_sources), n - 1)
+            sources = rng.choice(n, size=m, replace=False)
+            out.append(MultiSource(
+                tuple(int(x) for x in sources), d,
+            ))
+        elif kind == "weighted":
+            out.append(Weighted(s, d, weight_seed=int(weight_seed)))
+        elif kind == "kshortest":
+            out.append(KShortest(s, d, k=int(k)))
+        else:  # asof
+            v = int(versions[int(rng.integers(len(versions)))])
+            out.append(AsOf(PointToPoint(s, d), v))
+    return out
+
+
 def _latency_hist(lats_s: list[float]) -> dict:
     """The full per-rate latency distribution, exported through the
     shared observability histogram type
@@ -2445,6 +2539,352 @@ def run_crash(
             victim.close()
         if workdir is None:
             shutil.rmtree(base, ignore_errors=True)
+
+
+def run_queries(n: int, edges, *, queries: int = 200,
+                mix: dict | None = None, ms_traffic: int = 24,
+                msbfs_min_speedup: float = 3.0, seed: int = 0,
+                wal_dir: str | None = None) -> dict:
+    """The query-taxonomy soak (``bench.py --serve-queries``).
+
+    Four phases against ONE durable, history-retaining store
+    (``retain_history=True`` — the as-of read path's ground truth):
+
+    1. **history build + mid-traffic as-of**: the graph rolls v1 ->
+       v2 -> v3 under live ``as_of`` + point-to-point traffic (the
+       second roll lands MID-STREAM), and every historical answer is
+       verified hop-exact against a Python-tracked reference edge set
+       for its version — the "time-travel reads stay exact across a
+       hot-swap" gate.
+    2. **mixed taxonomy traffic**: a ``--mix``-shaped stream
+       (default ``pt=0.4,ms=0.2,weighted=0.2,kshortest=0.1,
+       asof=0.1``) through one engine; every weighted answer is
+       checked exact against the NumPy Dijkstra oracle, every msbfs
+       per-source hop against independent serial solves, every
+       k-shortest path CSR-edge-validated + non-decreasing, every pt
+       answer against the serial oracle.
+    3. **msbfs speedup**: ``ms_traffic`` 64-source MultiSource
+       queries (shared source set, distinct destinations) served in
+       one flush — packed sweeps shared across the flush — timed
+       against the SAME (source, dst) units as per-query
+       point-to-point solves on a fresh engine; gated at
+       ``msbfs_min_speedup`` x qps, with the msbfs hop answers
+       cross-checked against the pt answers.
+    4. **per-kind resilience**: each kind's chaos seam
+       (``msbfs``/``weighted``/``kshortest``/``asof_replay`` +
+       ``host_batch`` for pt) injected on a fresh engine; the gate is
+       every query still answering THROUGH the degrade, with the
+       fallback/bisection witnessed in the resilience counters.
+    """
+    import os
+    import tempfile
+
+    from bibfs_tpu.graph.csr import build_csr
+    from bibfs_tpu.query import (
+        AsOf,
+        KShortest,
+        MultiSource,
+        PointToPoint,
+        Weighted,
+    )
+    from bibfs_tpu.query.weighted import dijkstra_numpy, synthetic_weights
+    from bibfs_tpu.serve import QueryEngine
+    from bibfs_tpu.serve.faults import FaultPlan
+    from bibfs_tpu.serve.resilience import QueryError
+    from bibfs_tpu.solvers.serial import solve_serial_csr
+    from bibfs_tpu.store import GraphStore
+    from bibfs_tpu.store.delta import canonical_edge
+
+    rng = np.random.default_rng(seed)
+    if wal_dir is None:
+        wal_dir = tempfile.mkdtemp(prefix="bibfs-queries-")
+    os.makedirs(wal_dir, exist_ok=True)
+    store = GraphStore(
+        compact_threshold=None, wal_dir=wal_dir,
+        retain_history=True, fsync="always",
+    )
+    store.add("g", n, edges)
+
+    def edge_set():
+        return set(
+            map(tuple, store.current("g").undirected_edges().tolist())
+        )
+
+    def rand_edges(count, existing):
+        out = set()
+        while len(out) < count:
+            u = int(rng.integers(n))
+            v = int(rng.integers(n))
+            if u == v:
+                continue
+            e = canonical_edge(n, u, v)
+            if e not in existing and e not in out:
+                out.add(e)
+        return sorted(out)
+
+    refs = {1: edge_set()}
+    csrs = {1: build_csr(n, np.array(sorted(refs[1]), dtype=np.int64))}
+
+    def roll(adds, dels):
+        store.roll("g", adds=adds, dels=dels)
+        v = store.current("g").version
+        refs[v] = edge_set()
+        csrs[v] = build_csr(n, np.array(sorted(refs[v]), dtype=np.int64))
+        return v
+
+    # ---- phase 1: history + mid-traffic as-of ------------------------
+    cur = refs[1]
+    v2 = roll(rand_edges(8, cur), sorted(rng.permutation(
+        np.array(sorted(cur), dtype=np.int64))[:4].tolist()
+    ))
+    eng = QueryEngine(store=store, graph="g")
+    asof_q = max(queries // 4, 16)
+    checked = {1: 0, 2: 0}
+    failures: list[str] = []
+    rolled_mid = False
+    for i in range(asof_q):
+        if i == asof_q // 2 and not rolled_mid:
+            # the MID-TRAFFIC hot-swap: v3 commits while as-of
+            # queries for v1/v2 are in flight either side of it
+            roll(rand_edges(6, refs[v2]), [])
+            rolled_mid = True
+        v = 1 if i % 2 == 0 else 2
+        s = int(rng.integers(n))
+        d = int(rng.integers(n))
+        res = eng.query_one(AsOf(PointToPoint(s, d), v))
+        truth = solve_serial_csr(n, *csrs[v], s, d)
+        if (res.found, res.hops) != (truth.found, truth.hops):
+            failures.append(
+                f"asof v{v} ({s},{d}): {res.found, res.hops} != "
+                f"{truth.found, truth.hops}"
+            )
+        else:
+            checked[v] += 1
+    asof_ok = not failures and rolled_mid and min(checked.values()) > 0
+    cur_v = store.current("g").version
+    cur_csr = csrs[cur_v]
+
+    # ---- phase 2: mixed taxonomy traffic -----------------------------
+    if mix is None:
+        mix = {"pt": 0.4, "msbfs": 0.2, "weighted": 0.2,
+               "kshortest": 0.1, "asof": 0.1}
+    stream = sample_query_mix(
+        n, queries, mix, seed=seed + 1, ms_sources=16,
+        weight_seed=seed, versions=(1, v2),
+    )
+    pre_mixed_failures = len(failures)
+    t0 = time.perf_counter()
+    results = eng.query_many(stream, return_errors=True)
+    mixed_s = time.perf_counter() - t0
+    served = {k: 0 for k in ("pt", "msbfs", "weighted",
+                             "kshortest", "asof")}
+    w_cache: dict = {}
+    for q, res in zip(stream, results):
+        if isinstance(res, QueryError):
+            failures.append(f"{q.kind} {q}: {res}")
+            continue
+        served[q.kind] += 1
+        if isinstance(q, PointToPoint):
+            truth = solve_serial_csr(n, *cur_csr, q.src, q.dst)
+            if (res.found, res.hops) != (truth.found, truth.hops):
+                failures.append(f"pt ({q.src},{q.dst}) wrong hops")
+        elif isinstance(q, Weighted):
+            key = int(q.weight_seed)
+            if key not in w_cache:
+                w_cache[key] = synthetic_weights(*cur_csr, key)
+            dist, _par = dijkstra_numpy(
+                n, *cur_csr, w_cache[key], q.src, q.dst
+            )
+            ref = dist[q.dst]
+            if res.found != bool(np.isfinite(ref)) or (
+                res.found and abs(res.dist - float(ref)) > 1e-9
+            ):
+                failures.append(
+                    f"weighted ({q.src},{q.dst}): {res.dist} != {ref}"
+                )
+        elif isinstance(q, MultiSource):
+            for s, hops in zip(q.sources, res.per_source):
+                truth = solve_serial_csr(n, *cur_csr, int(s), q.dst)
+                want = truth.hops if truth.found else None
+                if hops != want:
+                    failures.append(
+                        f"msbfs ({s}->{q.dst}): {hops} != {want}"
+                    )
+            if res.found and not _validate(
+                cur_csr, res, res.path[0], q.dst
+            ):
+                failures.append(f"msbfs path invalid -> {q.dst}")
+        elif isinstance(q, KShortest):
+            if res.hops != sorted(res.hops):
+                failures.append(f"kshortest ({q.src},{q.dst}) unsorted")
+            for p, h in zip(res.paths, res.hops):
+                from bibfs_tpu.solvers.api import validate_path
+
+                if not validate_path(cur_csr, p, q.src, q.dst, hops=h):
+                    failures.append(
+                        f"kshortest ({q.src},{q.dst}) invalid path"
+                    )
+        elif isinstance(q, AsOf):
+            truth = solve_serial_csr(
+                n, *csrs[int(q.version)], q.inner.src, q.inner.dst
+            )
+            if (res.found, res.hops) != (truth.found, truth.hops):
+                failures.append(
+                    f"asof-mixed v{q.version} wrong answer"
+                )
+    # only kinds the MIX actually carries must be served: a caller's
+    # --mix pt=1 override is a valid single-kind soak, not a failure
+    mixed_ok = len(failures) == pre_mixed_failures and all(
+        served[k] > 0 for k in served if mix.get(k)
+    )
+    mixed_stats = eng.stats()
+    eng.close()
+
+    # ---- phase 3: msbfs speedup over per-query pt solves -------------
+    m_src = min(64, n - 1)
+    sources = tuple(
+        int(x) for x in rng.choice(n, size=m_src, replace=False)
+    )
+    dsts = [int(x) for x in rng.choice(n, size=ms_traffic, replace=True)]
+    ms_queries = [MultiSource(sources, d) for d in dsts]
+    ms_eng = QueryEngine(store=store, graph="g")
+    t0 = time.perf_counter()
+    ms_results = ms_eng.query_many(ms_queries, return_errors=True)
+    ms_s = time.perf_counter() - t0
+    ms_eng.close()
+    pt_pairs = [(s, d) for d in dsts for s in sources]
+    # the gate's baseline: PER-QUERY point-to-point serving — one
+    # submit+flush per (source, dst) unit, the shape a client issuing
+    # independent queries gets (the acceptance criterion's wording);
+    # the engine's own batched route over the same units is measured
+    # alongside for the full picture (pt_batched_qps)
+    pt_eng = QueryEngine(store=store, graph="g")
+    t0 = time.perf_counter()
+    pt_results = [pt_eng.query(s, d) for s, d in pt_pairs]
+    pt_s = time.perf_counter() - t0
+    pt_eng.close()
+    ptb_eng = QueryEngine(store=store, graph="g")
+    t0 = time.perf_counter()
+    ptb_eng.query_many(pt_pairs, return_errors=True)
+    ptb_s = time.perf_counter() - t0
+    ptb_eng.close()
+    units = len(pt_pairs)
+    ms_qps = units / ms_s if ms_s > 0 else float("inf")
+    pt_qps = units / pt_s if pt_s > 0 else float("inf")
+    ptb_qps = units / ptb_s if ptb_s > 0 else float("inf")
+    speedup = ms_qps / pt_qps if pt_qps > 0 else float("inf")
+    cross_ok = True
+    it = iter(pt_results)
+    for q, res in zip(ms_queries, ms_results):
+        if isinstance(res, QueryError):
+            cross_ok = False
+            # keep the pt iterator aligned: this query still owns
+            # len(sources) reference slots — skipping them silently
+            # would pair every LATER comparison with the wrong pt
+            # answer and bury the real failure under fabricated ones
+            for _ in q.sources:
+                next(it)
+            continue
+        for s, hops in zip(q.sources, res.per_source):
+            ref = next(it)
+            want = (
+                ref.hops if not isinstance(ref, QueryError) and ref.found
+                else None
+            )
+            if hops != want:
+                cross_ok = False
+                failures.append(
+                    f"msbfs-vs-pt ({s}->{q.dst}): {hops} != {want}"
+                )
+    msbfs_ok = cross_ok and speedup >= float(msbfs_min_speedup)
+
+    # ---- phase 4: per-kind fault-injected degrade --------------------
+    kind_sites = {
+        "pt": "host_batch",
+        "msbfs": "msbfs",
+        "weighted": "weighted",
+        "kshortest": "kshortest",
+        "asof": "asof_replay",
+    }
+    resilience: dict = {}
+    for kind, site in kind_sites.items():
+        plan = FaultPlan.parse(f"{site}:times=4", seed=seed)
+        keng = QueryEngine(store=store, graph="g", faults=plan)
+        kqs: list = []
+        for _ in range(4):
+            s = int(rng.integers(n))
+            d = int(rng.integers(n))
+            if kind == "pt":
+                kqs.append(PointToPoint(s, d))
+            elif kind == "msbfs":
+                kqs.append(MultiSource((s, (s + 1) % n), d))
+            elif kind == "weighted":
+                kqs.append(Weighted(s, d, weight_seed=seed))
+            elif kind == "kshortest":
+                kqs.append(KShortest(s, d, k=2))
+            else:
+                kqs.append(AsOf(PointToPoint(s, d), 1))
+        kres = keng.query_many(kqs, return_errors=True)
+        kstats = keng.stats()
+        keng.close()
+        res_block = kstats["resilience"]
+        answered = sum(
+            1 for r in kres if not isinstance(r, QueryError)
+        )
+        degrade = (
+            sum(res_block["fallbacks"].values())
+            + res_block["bisections"]
+        )
+        fired = kstats["resilience"]["faults"]["fired_total"]
+        resilience[kind] = {
+            "site": site,
+            "answered": answered,
+            "of": len(kqs),
+            "faults_fired": fired,
+            "fallbacks": {
+                k: v for k, v in res_block["fallbacks"].items() if v
+            },
+            "retries": res_block["retries"],
+            "ok": answered == len(kqs) and fired > 0 and degrade > 0,
+        }
+    resilience_ok = all(r["ok"] for r in resilience.values())
+    store.close()
+
+    ok = bool(asof_ok and mixed_ok and msbfs_ok and resilience_ok
+              and not failures)
+    return {
+        "ok": ok,
+        "n": n,
+        "queries": queries,
+        "mix": mix,
+        "failures": failures[:20],
+        "asof": {
+            "ok": asof_ok,
+            "versions_checked": checked,
+            "mid_traffic_swap": rolled_mid,
+            "final_version": cur_v,
+        },
+        "mixed": {
+            "ok": mixed_ok,
+            "served_by_kind": served,
+            "wall_s": round(mixed_s, 3),
+            "query_kinds": mixed_stats["query_kinds"],
+            "kind_cache": mixed_stats["kind_cache"],
+        },
+        "msbfs": {
+            "ok": msbfs_ok,
+            "speedup": round(speedup, 2),
+            "min_speedup": float(msbfs_min_speedup),
+            "msbfs_qps": round(ms_qps, 1),
+            "pt_qps": round(pt_qps, 1),
+            "pt_batched_qps": round(ptb_qps, 1),
+            "units": units,
+            "sources": m_src,
+            "traffic": ms_traffic,
+            "cross_checked": cross_ok,
+        },
+        "resilience": {"ok": resilience_ok, **resilience},
+    }
 
 
 def _validate(csr, res, s, d) -> bool:

@@ -482,6 +482,62 @@ class PipelinedQueryEngine(QueryEngine):
         queue depth — decides when it actually flushes)."""
         return self.submit(src, dst, graph).wait()
 
+    def submit_query(self, q, graph: str | None = None) -> QueryTicket:
+        """The typed taxonomy submit (:meth:`QueryEngine.submit_query`),
+        pipelined flavor: a point-to-point query rides the background
+        flusher unchanged; the other kinds are host-tier solves with
+        no dispatch to overlap, so they resolve ON THE SUBMITTING
+        THREAD through the same kind-route machinery (breaker, retry,
+        fallback, caching) and return an already-done ticket — the
+        pipeline stays dedicated to the dispatch-shaped work it
+        exists to overlap."""
+        from bibfs_tpu.query.types import PointToPoint, coerce_query
+
+        q = coerce_query(q)
+        if isinstance(q, PointToPoint):
+            self._query_cells.cell("pt", "ladder").inc()
+            return self.submit(q.src, q.dst, graph)
+        if self._draining:
+            if self._closed:
+                raise RuntimeError("engine is closed")
+            raise QueryError(
+                "engine is draining", kind="capacity",
+                query=self._query_rep_pair(q),
+            )
+        name, rt = self._resolve_graph(graph)
+        q.validate(rt.n)
+        src, dst = self._query_rep_pair(q)
+        t = QueryTicket(src, dst, self, name)
+        t.query = q
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("engine is closed")
+            self._c_queries.inc()
+        overlay = self._overlay_pending(name)
+        if overlay is None:
+            rt = self._graph_rt(name)  # overlay-read-then-resolve
+            hit = self._kind_cache.lookup(rt.graph_id, q.cache_key())
+            if hit is not None:
+                self._query_cells.cell(q.kind, "cache").inc()
+                self._finish_ticket(t, hit)
+                self.latency.record(t.t_done - t.t_submit)
+                return t
+        rt = self._pin_rt(name)
+        # the host-solve serializer also covers taxonomy solves: the
+        # kind fallbacks share the per-runtime serial machinery with
+        # the flusher's host rung
+        with self._host_solve_lock, self._bound(rt):
+            self._flush_taxonomy(name, [t], overlay)
+        t.t_done = time.perf_counter()
+        self.latency.record(t.t_done - t.t_submit)
+        with self._cv:
+            self._cv.notify_all()  # wake any wait() already parked
+        return t
+
+    def query_one(self, q, graph: str | None = None):
+        """Submit one typed query and block for its kind's result."""
+        return self.submit_query(q, graph).wait()
+
     def query_many(self, pairs, *, graph: str | None = None,
                    return_errors: bool = False) -> list:
         """Submit a whole query list, drain, and return the results.

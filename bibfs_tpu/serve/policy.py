@@ -257,11 +257,24 @@ class AdaptiveRouter:
             self._digests[digest] = e
         return e
 
+    @staticmethod
+    def policy_key(digest: str, kind: str | None = None) -> str:
+        """The per-(graph, query-kind) policy namespace: point-to-point
+        stays keyed by bare digest (the pre-taxonomy sidecar format —
+        old sidecars keep warm-starting), every other kind gets its own
+        ``digest#kind`` entry so the msbfs ladder's learned ordering
+        never leaks into the pt ladder's."""
+        if kind in (None, "pt"):
+            return str(digest)
+        return f"{digest}#{kind}"
+
     def note(self, digest: str, route: str, batch: int,
-             seconds: float) -> bool:
-        """Record one resolved batch's measured latency. Returns True
+             seconds: float, *, kind: str | None = None) -> bool:
+        """Record one resolved batch's measured latency (``kind``
+        namespaces taxonomy kinds — :meth:`policy_key`). Returns True
         when the caller should run the periodic telemetry sample
         (:meth:`observe_levels` with a fresh level-stats dict)."""
+        digest = self.policy_key(digest, kind)
         per_q = float(seconds) / max(int(batch), 1) * 1e6
         bucket = str(bucket_batch(batch))
         save = False
@@ -397,10 +410,15 @@ class AdaptiveRouter:
                     best = (d, c)
         return best[1] if best else {"lat_us": None, "n": 0}
 
-    def order(self, digest: str, batch: int, ladder) -> tuple:
+    def order(self, digest: str, batch: int, ladder, *,
+              kind: str | None = None) -> tuple:
         """The ladder this flush walks (``host`` stays terminal) and
         why — see the module docstring's decision rules. Counted in
-        ``bibfs_routes_adaptive_total{route,reason}``."""
+        ``bibfs_routes_adaptive_total{route,reason}``. ``kind``
+        namespaces taxonomy kinds (:meth:`policy_key`): each kind's
+        ladder — e.g. ``(msbfs, host)`` — explores and learns its own
+        per-digest ordering."""
+        digest = self.policy_key(digest, kind)
         rungs = [r for r in ladder if r != "host"]
         tail = [r for r in ladder if r == "host"]
         bucket = str(bucket_batch(batch))

@@ -23,6 +23,17 @@ from bibfs_tpu.serve.routes.host import HostRoute, SerialRoute
 from bibfs_tpu.serve.routes.mesh import MeshConfig, MeshRoute, mesh_prebuild
 from bibfs_tpu.serve.routes.oracle import OracleRoute
 from bibfs_tpu.serve.routes.overlay import OverlayRoute
+from bibfs_tpu.serve.routes.taxonomy import (
+    KIND_ROUTES,
+    AsOfRoute,
+    KindCtx,
+    KindResultCache,
+    KShortestRoute,
+    MsbfsRoute,
+    QueryKindCells,
+    WeightedRoute,
+    build_taxonomy_routes,
+)
 
 __all__ = [
     "Route",
@@ -35,7 +46,16 @@ __all__ = [
     "MeshRoute",
     "OracleRoute",
     "OverlayRoute",
+    "KIND_ROUTES",
+    "AsOfRoute",
+    "KindCtx",
+    "KindResultCache",
+    "KShortestRoute",
+    "MsbfsRoute",
+    "QueryKindCells",
+    "WeightedRoute",
     "build_routes",
+    "build_taxonomy_routes",
     "mesh_prebuild",
 ]
 
@@ -61,6 +81,10 @@ def build_routes(engine, mesh_cfg=None, mesh_pre=None, blocked_cfg=None):
         "host": HostRoute(engine),
         "serial": SerialRoute(engine),
     }
+    # the taxonomy kind routes (msbfs/weighted/kshortest/asof) ride
+    # every engine — kind-dispatched at flush time, never from the
+    # point-to-point ladder below
+    routes.update(build_taxonomy_routes(engine, engine.obs_label))
     ladder = ("device", "host")
     if blocked_cfg is not None:
         routes["blocked"] = BlockedRoute(

@@ -137,7 +137,10 @@ def solve_many(
     distance/result cache), routes the queries through its calibrated
     batch-vs-latency crossover (batched device program at or above it,
     per-query host dispatch below), and returns one :class:`BFSResult`
-    per pair. ``pipelined=True`` serves through the asynchronous
+    per pair. ``pairs`` may mix bare ``(src, dst)`` pairs with typed
+    taxonomy queries (:mod:`bibfs_tpu.query` — multi-source, weighted,
+    k-shortest), whose slots then carry their kind's result type.
+    ``pipelined=True`` serves through the asynchronous
     :class:`bibfs_tpu.serve.PipelinedQueryEngine` instead (background
     deadline flusher, device dispatch overlapped with host-side finish;
     extra knobs like ``max_wait_ms`` pass through) — worth it for big
@@ -146,19 +149,60 @@ def solve_many(
     convenience rebuilds the caches per call (the compiled executables
     themselves persist process-wide either way).
 
-    ``return_errors=True`` is partial-failure mode: instead of raising
-    on the first failed query, the returned list carries a structured
-    :class:`bibfs_tpu.serve.resilience.QueryError` (taxonomy kinds
-    ``invalid`` / ``timeout`` / ``capacity`` / ``internal``) in that
-    query's slot — one bad query costs one slot, never its batch.
+    A query that is INVALID on its own (out-of-range node id, bad
+    arity) never fails its batch-mates: its slot carries a structured
+    ``kind='invalid'`` :class:`bibfs_tpu.serve.resilience.QueryError`
+    and every other query still resolves — one bad query costs one
+    slot, never its batch. ``return_errors=True`` extends that
+    partial-failure contract to EVERY failure kind (``timeout`` /
+    ``capacity`` / ``internal``); the default re-raises the first
+    non-invalid failure, matching the pre-resilience contract for
+    real solver errors.
     """
     if pipelined:
         from bibfs_tpu.serve import PipelinedQueryEngine
 
         with PipelinedQueryEngine(n, edges, **engine_kwargs) as eng:
-            return eng.query_many(pairs, return_errors=return_errors)
-    from bibfs_tpu.serve import QueryEngine
+            results = eng.query_many(pairs, return_errors=True)
+    else:
+        from bibfs_tpu.serve import QueryEngine
 
-    return QueryEngine(n, edges, **engine_kwargs).query_many(
-        pairs, return_errors=return_errors
-    )
+        results = QueryEngine(n, edges, **engine_kwargs).query_many(
+            pairs, return_errors=True
+        )
+    if not return_errors:
+        from bibfs_tpu.serve.resilience import QueryError
+
+        for r in results:
+            if isinstance(r, QueryError) and r.kind != "invalid":
+                raise r
+    return results
+
+
+def solve_query(n: int, edges: np.ndarray, query, *,
+                backend: str = "serial", **kwargs):
+    """Solve ONE typed taxonomy query (:mod:`bibfs_tpu.query`) over an
+    inline graph, host-tier: the single-shot counterpart of threading
+    a :class:`~bibfs_tpu.query.Query` through a serving engine's
+    ``submit_query``. A :class:`~bibfs_tpu.query.PointToPoint` routes
+    through :func:`solve` with ``backend`` (any registered backend);
+    the other kinds solve on their host implementations
+    (:mod:`bibfs_tpu.query.host`). ``AsOf`` needs a store to resolve
+    versions against — use a store-backed engine's ``submit_query``.
+    """
+    from bibfs_tpu.query.host import solve_query_csr
+    from bibfs_tpu.query.types import AsOf, PointToPoint, coerce_query
+
+    q = coerce_query(query)
+    if isinstance(q, PointToPoint):
+        return solve(backend, n, edges, q.src, q.dst, **kwargs)
+    if isinstance(q, AsOf):
+        raise ValueError(
+            "AsOf queries resolve against a store's version history; "
+            "serve them through QueryEngine(store=...).submit_query"
+        )
+    from bibfs_tpu.graph.csr import build_csr
+
+    row_ptr, col_ind = build_csr(n, edges)
+    q.validate(n)
+    return solve_query_csr(n, row_ptr, col_ind, q)

@@ -181,6 +181,13 @@ class GraphStore:
         ``.bin`` snapshots and ``manifest.json`` files. ``None``
         (default) disables durability — acked updates then live only in
         process memory, exactly the pre-WAL behavior.
+    retain_history : keep superseded checkpoint bins and WAL segments
+        instead of GC'ing them after each manifest commit, so every
+        committed version stays reconstructible for ``as_of``
+        time-travel queries (:meth:`reconstruct_version`,
+        ``store/history.py``). Requires ``wal_dir``. Default False:
+        the PR 8 GC behavior exactly (history stays readable only for
+        versions whose artifacts happen to survive).
     fsync : WAL fsync policy, ``always`` / ``batch`` / ``off``
         (``store/wal.py`` module docstring — what "durable enough to
         ack" means). Default ``batch``.
@@ -197,7 +204,8 @@ class GraphStore:
                  oracle_seed: int = 0,
                  obs_label: str | None = None,
                  wal_dir=None, fsync: str = "batch",
-                 fsync_batch_records: int = 64, faults=None):
+                 fsync_batch_records: int = 64, faults=None,
+                 retain_history: bool = False):
         self.compact_threshold = (
             None if compact_threshold is None else int(compact_threshold)
         )
@@ -242,6 +250,12 @@ class GraphStore:
                 f"(known: {', '.join(FSYNC_POLICIES)})"
             )
         self.wal_dir = None if wal_dir is None else os.fspath(wal_dir)
+        self.retain_history = bool(retain_history)
+        if self.retain_history and self.wal_dir is None:
+            raise ValueError(
+                "retain_history=True needs a durable store (wal_dir=): "
+                "history is reconstructed from the WAL + checkpoints"
+            )
         self.fsync = fsync
         self.fsync_batch_records = int(fsync_batch_records)
         if faults is None:
@@ -558,6 +572,35 @@ class GraphStore:
             self._unlink_quiet(tmp)
             raise
         fsync_dir(self.wal_dir)
+        # record the committed version in the graph's history file
+        # (store/history.py) — the as_of read path's index. ONLY on a
+        # retain_history store: without retention the artifacts an
+        # entry points at are GC'd at the very next commit (the entry
+        # could never reconstruct), and the read-rewrite + two fsyncs
+        # per commit under the store lock would be pure cost growing
+        # with version count. Best-effort AFTER the manifest commit: a
+        # failed history write must not un-commit a checkpoint that is
+        # already governing recovery; that version just reads as
+        # unreconstructible, loudly.
+        if not self.retain_history:
+            return
+        from bibfs_tpu.store.history import append_history
+
+        try:
+            append_history(self.wal_dir, name, {
+                "version": snapshot.version,
+                "digest": snapshot.digest,
+                "bin": manifest["bin"],
+                "wal_seq": entry.wal_seq,
+                "n": snapshot.n,
+                "edges": snapshot.num_edges,
+            })
+        except OSError as e:
+            print(
+                f"[Store] history append failed for {name!r} "
+                f"v{snapshot.version}: {e}",
+                file=sys.stderr,
+            )
 
     def _wal_roll_locked(self, name: str, entry: _Entry) -> int:
         """Switch the graph to a fresh WAL segment (the crash-safe form
@@ -605,7 +648,11 @@ class GraphStore:
         rename made them unreachable. The manifest's current bin and
         the seed ``<name>.bin`` are always kept (the seed is the
         directory's human-visible original and the non-durable
-        ``from_dir`` fallback)."""
+        ``from_dir`` fallback). A ``retain_history`` store skips GC
+        entirely — superseded bins and segments ARE the time-travel
+        read path (``store/history.py``)."""
+        if self.retain_history:
+            return
         cur_v = entry.snapshot.version
         cur_seq = entry.wal_seq
         keep = entry.bin_file
@@ -1272,6 +1319,44 @@ class GraphStore:
             old.release()  # the store's reference; flush pins remain
         return old
 
+    # ---- time-travel reads (store/history.py) ------------------------
+    def history(self, name: str) -> list[dict]:
+        """The graph's committed version history entries (empty on a
+        non-durable store or before the first commit)."""
+        if self.wal_dir is None:
+            return []
+        from bibfs_tpu.store.history import load_history
+
+        return load_history(self.wal_dir, str(name))
+
+    def reconstruct_version(self, name: str, version: int) -> GraphSnapshot:
+        """The graph as of committed ``version`` — a FRESH, unpinned
+        :class:`~bibfs_tpu.store.snapshot.GraphSnapshot` the caller
+        owns (digest-verified against the history recorded at commit
+        time; ``store/history.py``). The current version answers from
+        the live base snapshot's canonical pairs without touching
+        disk. Raises ``ValueError`` for an unknown or no-longer-
+        provable version — a history read is exact or refused, never
+        approximate."""
+        name, version = str(name), int(version)
+        with self._lock:
+            cur = self._entry(name).snapshot
+        if version == cur.version:
+            # fresh object sharing the immutable pairs array: the
+            # caller's refcount lifecycle stays decoupled from the
+            # store's (a later hot-swap retires only the store's)
+            return GraphSnapshot(
+                cur.n, cur.pairs, digest=cur.digest, version=version
+            )
+        if self.wal_dir is None:
+            raise ValueError(
+                f"as_of version {version} != current {cur.version} "
+                f"needs a durable store (wal_dir=) to reconstruct from"
+            )
+        from bibfs_tpu.store.history import reconstruct_version
+
+        return reconstruct_version(self.wal_dir, name, version)
+
     # ---- introspection ----------------------------------------------
     def stats(self) -> dict:
         with self._lock:
@@ -1303,6 +1388,7 @@ class GraphStore:
                 "compact_threshold": self.compact_threshold,
                 "oracle_k": self.oracle_k,
                 "durable": self.wal_dir is not None,
+                "retain_history": self.retain_history,
                 "fsync": self.fsync if self.wal_dir is not None else None,
                 "load_errors": list(self.load_errors),
             }

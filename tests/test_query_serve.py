@@ -1,0 +1,414 @@
+"""The query taxonomy through the serving stack (serve/routes/
+taxonomy.py): kind routes on both engines, per-kind resilience
+(injected faults degrade, never fail), the kind result cache, metrics
+render-at-zero, overlay-exact answers, as-of time-travel reads against
+replayed WAL history across hot-swaps, and the loadgen query-mix
+spec."""
+
+import numpy as np
+import pytest
+
+from bibfs_tpu.graph.csr import build_csr
+from bibfs_tpu.graph.generate import gnp_random_graph
+from bibfs_tpu.obs.metrics import REGISTRY
+from bibfs_tpu.obs.names import QUERY_METRIC_FAMILIES
+from bibfs_tpu.query import (
+    AsOf,
+    KShortest,
+    KShortestResult,
+    MultiSource,
+    MultiSourceResult,
+    PointToPoint,
+    Weighted,
+    WeightedResult,
+)
+from bibfs_tpu.query.weighted import dijkstra_numpy, synthetic_weights
+from bibfs_tpu.serve import PipelinedQueryEngine, QueryEngine
+from bibfs_tpu.serve.faults import FaultPlan
+from bibfs_tpu.serve.resilience import QueryError
+from bibfs_tpu.solvers.serial import solve_serial_csr
+from bibfs_tpu.store import GraphStore
+
+N = 250
+SEED = 3
+
+
+def _graph(n=N, seed=SEED):
+    return gnp_random_graph(n, 3.5 / n, seed=seed)
+
+
+# ---- kind routes on the sync engine ----------------------------------
+def test_sync_engine_serves_every_kind():
+    edges = _graph()
+    csr = build_csr(N, edges)
+    eng = QueryEngine(N, edges)
+    try:
+        pt = eng.query_one(PointToPoint(0, 9))
+        ref = solve_serial_csr(N, *csr, 0, 9)
+        assert (pt.found, pt.hops) == (ref.found, ref.hops)
+
+        ms = eng.query_one(MultiSource((0, 1, 2, 3), 9))
+        assert isinstance(ms, MultiSourceResult)
+        for s, hops in zip((0, 1, 2, 3), ms.per_source):
+            r = solve_serial_csr(N, *csr, s, 9)
+            assert hops == (r.hops if r.found else None)
+
+        w = eng.query_one(Weighted(0, 9, weight_seed=5))
+        assert isinstance(w, WeightedResult)
+        wt = synthetic_weights(*csr, 5)
+        dist, _ = dijkstra_numpy(N, *csr, wt, 0, 9)
+        if np.isfinite(dist[9]):
+            assert w.dist == pytest.approx(float(dist[9]))
+        else:
+            assert not w.found
+
+        ks = eng.query_one(KShortest(0, 9, k=3))
+        assert isinstance(ks, KShortestResult)
+        if ref.found:
+            assert ks.hops[0] == ref.hops
+            assert ks.hops == sorted(ks.hops)
+
+        kinds = eng.stats()["query_kinds"]
+        assert kinds["pt"]["ladder"] == 1
+        assert kinds["msbfs"]["msbfs"] == 1
+        assert kinds["weighted"]["weighted"] == 1
+        assert kinds["kshortest"]["kshortest"] == 1
+    finally:
+        eng.close()
+
+
+def test_kind_cache_serves_repeats():
+    edges = _graph()
+    eng = QueryEngine(N, edges)
+    try:
+        q = Weighted(2, 77, weight_seed=1)
+        r1 = eng.query_one(q)
+        r2 = eng.query_one(Weighted(2, 77, weight_seed=1))
+        assert r2 is r1  # the cached result object itself
+        st = eng.stats()
+        assert st["query_kinds"]["weighted"] == {
+            "weighted": 1, "cache": 1,
+        }
+        assert st["kind_cache"]["hits"] == 1
+    finally:
+        eng.close()
+
+
+def test_query_metric_families_render_at_zero():
+    label = "tax-zero-test"
+    eng = QueryEngine(N, _graph(), obs_label=label)
+    try:
+        render = REGISTRY.render()
+        for fam in QUERY_METRIC_FAMILIES:
+            assert fam in render, fam
+        # the eager kind x route label set renders before any traffic
+        assert f'bibfs_query_total{{engine="{label}",kind="msbfs",' \
+               f'route="msbfs"}} 0' in render
+        assert f'bibfs_msbfs_breaker_state{{engine="{label}"}} 0' in render
+    finally:
+        eng.close()
+
+
+def test_msbfs_route_breaker_and_fallback():
+    """An injected msbfs fault burns the retries, opens the fallback
+    path, and the queries still answer — degrade, never failure."""
+    edges = _graph()
+    csr = build_csr(N, edges)
+    plan = FaultPlan.parse("msbfs:times=6")
+    eng = QueryEngine(N, edges, faults=plan)
+    try:
+        res = eng.query_one(MultiSource((4, 5, 6), 80))
+        r = solve_serial_csr(N, *csr, 4, 80)
+        assert res.per_source[0] == (r.hops if r.found else None)
+        st = eng.stats()
+        assert st["resilience"]["fallbacks"].get("msbfs->host", 0) == 1
+        assert st["query_kinds"]["msbfs"] == {"host": 1}
+        assert st["routes"]["msbfs"]["breaker"]["consecutive_failures"] > 0
+    finally:
+        eng.close()
+
+
+@pytest.mark.parametrize("site,make_q", [
+    ("weighted", lambda: Weighted(1, 60)),
+    ("kshortest", lambda: KShortest(1, 60, k=2)),
+])
+def test_kind_fault_degrades_not_fails(site, make_q):
+    plan = FaultPlan.parse(f"{site}:times=6")
+    eng = QueryEngine(N, _graph(), faults=plan)
+    try:
+        res = eng.query_one(make_q())
+        assert res is not None and not isinstance(res, QueryError)
+        st = eng.stats()
+        assert st["resilience"]["fallbacks"].get(f"{site}->host", 0) == 1
+        assert st["resilience"]["retries"] >= 1
+    finally:
+        eng.close()
+
+
+def test_overlay_pending_taxonomy_answers_exactly():
+    """While live updates are pending (no compaction yet), every kind
+    answers on the MERGED edge set — the overlay-route exactness
+    contract extended to the taxonomy."""
+    edges = _graph()
+    store = GraphStore(compact_threshold=None)
+    store.add("g", N, edges)
+    # a shortcut edge between two far vertices, left PENDING
+    csr0 = build_csr(N, edges)
+    far = solve_serial_csr(N, *csr0, 0, 200)
+    store.update("g", adds=[(0, 200)])
+    assert store.overlay("g") is not None
+    merged = np.vstack([edges, [[0, 200]]])
+    csr1 = build_csr(N, merged)
+    eng = QueryEngine(store=store, graph="g")
+    try:
+        ms = eng.query_one(MultiSource((0,), 200))
+        assert ms.per_source[0] == 1  # the pending edge is visible
+        if far.found:
+            assert far.hops > 1  # the overlay genuinely changed it
+        w = eng.query_one(Weighted(0, 200, weight_seed=2))
+        wt = synthetic_weights(*csr1, 2)
+        dist, _ = dijkstra_numpy(N, *csr1, wt, 0, 200)
+        assert w.dist == pytest.approx(float(dist[200]))
+        # exact-but-uncached: the overlay graph is not a snapshot
+        assert eng.stats()["kind_cache"]["entries"] == 0
+    finally:
+        eng.close()
+
+
+# ---- the pipelined engine --------------------------------------------
+def test_pipelined_engine_taxonomy():
+    edges = _graph()
+    csr = build_csr(N, edges)
+    with PipelinedQueryEngine(N, edges, max_wait_ms=5.0) as eng:
+        t = eng.submit_query(MultiSource((1, 2), 90))
+        assert t.done()  # host-tier kinds resolve at submit
+        res = t.wait()
+        r = solve_serial_csr(N, *csr, 1, 90)
+        assert res.per_source[0] == (r.hops if r.found else None)
+        # pt delegates to the background pipeline
+        ref = eng.query_one(PointToPoint(1, 90))
+        assert (ref.found, ref.hops) == (r.found, r.hops)
+        out = eng.query_many(
+            [(0, 7), KShortest(0, 7, k=2), Weighted(0, 7)],
+            return_errors=True,
+        )
+        assert [type(x).__name__ for x in out] == [
+            "BFSResult", "KShortestResult", "WeightedResult",
+        ]
+        # cache round trip through the pipelined submit path
+        t2 = eng.submit_query(MultiSource((1, 2), 90))
+        assert t2.wait() is res
+
+
+def test_pipelined_invalid_taxonomy_is_per_query():
+    with PipelinedQueryEngine(N, _graph()) as eng:
+        out = eng.query_many(
+            [(0, 5), Weighted(0, N + 7), (1, 6)], return_errors=True
+        )
+        assert isinstance(out[1], QueryError)
+        assert out[1].kind == "invalid"
+        assert out[0].found is not None and out[2].found is not None
+
+
+# ---- as-of time-travel reads -----------------------------------------
+def _durable_store(tmp_path, n, edges):
+    store = GraphStore(
+        compact_threshold=None, wal_dir=str(tmp_path),
+        retain_history=True, fsync="always",
+    )
+    store.add("g", n, edges)
+    return store
+
+
+def test_asof_exact_across_hot_swap(tmp_path):
+    """as_of answers stay exact for every historical version — checked
+    against a replayed reference edge set — including when the queries
+    straddle a mid-traffic hot-swap."""
+    n = 150
+    edges = gnp_random_graph(n, 3.0 / n, seed=7)
+    store = _durable_store(tmp_path, n, edges)
+    refs = {1: set(map(tuple, store.current("g").undirected_edges()
+                       .tolist()))}
+    store.roll("g", adds=[(0, 100), (1, 101)], dels=[])
+    refs[2] = set(map(tuple, store.current("g").undirected_edges()
+                      .tolist()))
+    eng = QueryEngine(store=store, graph="g")
+    try:
+        rng = np.random.default_rng(0)
+        csrs = {
+            v: build_csr(n, np.array(sorted(r), dtype=np.int64))
+            for v, r in refs.items()
+        }
+
+        def check(v, count=6):
+            for _ in range(count):
+                s, d = (int(x) for x in rng.integers(0, n, 2))
+                res = eng.query_one(AsOf(PointToPoint(s, d), v))
+                ref = solve_serial_csr(n, *csrs[v], s, d)
+                assert (res.found, res.hops) == (ref.found, ref.hops)
+
+        check(1)
+        check(2)
+        # the mid-traffic swap: v3 commits while v1/v2 time-travel
+        # queries continue on both sides of it
+        store.roll("g", adds=[(2, 102)], dels=[])
+        check(1)
+        check(2)
+        # as_of the NEW current version answers the live graph
+        live = eng.query_one(PointToPoint(2, 102))
+        asof3 = eng.query_one(AsOf(PointToPoint(2, 102), 3))
+        assert (live.hops, asof3.hops) == (1, 1)
+        assert eng.routes["asof"].replays >= 2
+    finally:
+        eng.close()
+        store.close()
+
+
+def test_asof_inner_kinds(tmp_path):
+    n = 120
+    edges = gnp_random_graph(n, 3.0 / n, seed=8)
+    store = _durable_store(tmp_path, n, edges)
+    store.roll("g", adds=[(0, 60)], dels=[])
+    eng = QueryEngine(store=store, graph="g")
+    try:
+        snap1 = store.reconstruct_version("g", 1)
+        csr1 = snap1.csr()
+        ms = eng.query_one(AsOf(MultiSource((0, 1), 60), 1))
+        r0 = solve_serial_csr(n, *csr1, 0, 60)
+        assert ms.per_source[0] == (r0.hops if r0.found else None)
+        w = eng.query_one(AsOf(Weighted(0, 60, weight_seed=4), 1))
+        wt = synthetic_weights(*csr1, 4)
+        dist, _ = dijkstra_numpy(n, *csr1, wt, 0, 60)
+        if np.isfinite(dist[60]):
+            assert w.dist == pytest.approx(float(dist[60]))
+        ks = eng.query_one(AsOf(KShortest(0, 60, k=2), 1))
+        if r0.found:
+            assert ks.hops[0] == r0.hops
+    finally:
+        eng.close()
+        store.close()
+
+
+def test_asof_unknown_version_is_invalid_error(tmp_path):
+    n = 80
+    store = _durable_store(tmp_path, n, gnp_random_graph(n, 3.0 / n,
+                                                         seed=9))
+    eng = QueryEngine(store=store, graph="g")
+    try:
+        out = eng.query_many(
+            [AsOf(PointToPoint(0, 5), 99)], return_errors=True
+        )
+        assert isinstance(out[0], QueryError)
+        assert out[0].kind == "invalid"
+    finally:
+        eng.close()
+        store.close()
+
+
+def test_asof_invalid_version_does_not_poison_breaker(tmp_path):
+    """Bad client input (an unknown version) must cost its own slots
+    only: no breaker failures, no fallback, and valid as-of traffic
+    still serves on the primary rung afterwards."""
+    n = 80
+    store = _durable_store(tmp_path, n, gnp_random_graph(n, 3.0 / n,
+                                                         seed=11))
+    eng = QueryEngine(store=store, graph="g")
+    try:
+        bad = [AsOf(PointToPoint(i, i + 1), 99) for i in range(6)]
+        out = eng.query_many(bad, return_errors=True)
+        assert all(
+            isinstance(r, QueryError) and r.kind == "invalid"
+            for r in out
+        )
+        st = eng.stats()
+        assert st["routes"]["asof"]["breaker"]["state"] == "closed"
+        assert st["resilience"]["fallbacks"].get("asof->host", 0) == 0
+        res = eng.query_one(AsOf(PointToPoint(0, 5), 1))
+        assert res is not None
+        assert eng.stats()["query_kinds"]["asof"].get("asof") == 1
+    finally:
+        eng.close()
+        store.close()
+
+
+def test_asof_inline_engine_current_version_only():
+    eng = QueryEngine(N, _graph())
+    try:
+        v = eng._current_rt().snapshot.version
+        res = eng.query_one(AsOf(PointToPoint(0, 5), v))
+        ref = eng.query_one(PointToPoint(0, 5))
+        assert (res.found, res.hops) == (ref.found, ref.hops)
+        out = eng.query_many(
+            [AsOf(PointToPoint(0, 5), v + 1)], return_errors=True
+        )
+        assert isinstance(out[0], QueryError)
+        assert out[0].kind == "invalid"
+    finally:
+        eng.close()
+
+
+def test_store_reconstruct_version_digest_verified(tmp_path):
+    n = 100
+    edges = gnp_random_graph(n, 3.0 / n, seed=10)
+    store = _durable_store(tmp_path, n, edges)
+    d1 = store.current("g").digest
+    store.roll("g", adds=[(0, 50)], dels=[])
+    snap = store.reconstruct_version("g", 1)
+    assert snap.digest == d1
+    hist = store.history("g")
+    assert [e["version"] for e in hist] == [1, 2]
+    store.close()
+
+
+# ---- loadgen mix spec ------------------------------------------------
+def test_parse_query_mix():
+    from bibfs_tpu.serve.loadgen import parse_query_mix
+
+    mix = parse_query_mix("pt=0.7,ms=0.2,weighted=0.1")
+    assert mix == pytest.approx(
+        {"pt": 0.7, "msbfs": 0.2, "weighted": 0.1}
+    )
+    assert parse_query_mix("ks=1") == {"kshortest": 1.0}
+    with pytest.raises(ValueError):
+        parse_query_mix("bogus=1")
+    with pytest.raises(ValueError):
+        parse_query_mix("pt=0")
+
+
+def test_sample_query_mix_shapes():
+    from bibfs_tpu.serve.loadgen import parse_query_mix, sample_query_mix
+
+    mix = parse_query_mix("pt=0.4,ms=0.3,weighted=0.1,ks=0.1,asof=0.1")
+    qs = sample_query_mix(200, 120, mix, seed=1, versions=(1, 2))
+    kinds = {q.kind for q in qs}
+    assert kinds == {"pt", "msbfs", "weighted", "kshortest", "asof"}
+    # reproducible
+    qs2 = sample_query_mix(200, 120, mix, seed=1, versions=(1, 2))
+    assert qs == qs2
+    # asof weight folds into pt when no history exists
+    qs3 = sample_query_mix(200, 50, parse_query_mix("asof=1"), seed=2)
+    assert {q.kind for q in qs3} == {"pt"}
+
+
+def test_engine_serves_mixed_stream_exactly():
+    from bibfs_tpu.serve.loadgen import parse_query_mix, sample_query_mix
+
+    edges = _graph()
+    csr = build_csr(N, edges)
+    mix = parse_query_mix("pt=0.5,ms=0.2,weighted=0.2,ks=0.1")
+    stream = sample_query_mix(N, 60, mix, seed=4, ms_sources=8)
+    eng = QueryEngine(N, edges)
+    try:
+        out = eng.query_many(stream, return_errors=True)
+        assert not any(isinstance(r, QueryError) for r in out)
+        for q, res in zip(stream, out):
+            if isinstance(q, PointToPoint):
+                ref = solve_serial_csr(N, *csr, q.src, q.dst)
+                assert (res.found, res.hops) == (ref.found, ref.hops)
+            elif isinstance(q, MultiSource):
+                ref = solve_serial_csr(N, *csr, q.sources[0], q.dst)
+                assert res.per_source[0] == (
+                    ref.hops if ref.found else None
+                )
+    finally:
+        eng.close()

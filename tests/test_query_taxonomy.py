@@ -1,0 +1,259 @@
+"""The query taxonomy (bibfs_tpu/query): typed queries, delta-stepping
+vs the Dijkstra oracle, msBFS vs independent serial solves, Yen's
+k-shortest path properties, and the api-level entries — property-style
+over random / grid / disconnected graphs."""
+
+import numpy as np
+import pytest
+
+from bibfs_tpu.graph.csr import build_csr
+from bibfs_tpu.graph.generate import gnp_random_graph, grid_graph
+from bibfs_tpu.query import (
+    AsOf,
+    KShortest,
+    MultiSource,
+    PointToPoint,
+    Weighted,
+    coerce_query,
+)
+from bibfs_tpu.query.kshortest import yen_k_shortest
+from bibfs_tpu.query.msbfs import path_from_dist, solve_multi_source
+from bibfs_tpu.query.weighted import (
+    delta_stepping,
+    dijkstra_numpy,
+    path_weight,
+    synthetic_weights,
+)
+from bibfs_tpu.solvers.api import solve_query, validate_path
+from bibfs_tpu.solvers.serial import solve_serial_csr
+
+
+def _graphs():
+    """Random / grid / disconnected — the shapes the acceptance tests
+    name. Disconnected: two gnp halves with no bridge."""
+    out = []
+    n = 120
+    out.append(("gnp", n, gnp_random_graph(n, 3.0 / n, seed=4)))
+    out.append(("grid", 72, grid_graph(8, 9)))
+    half = gnp_random_graph(50, 3.0 / 50, seed=5)
+    other = gnp_random_graph(50, 3.0 / 50, seed=6) + 50
+    out.append(("disconnected", 100, np.vstack([half, other])))
+    return out
+
+
+# ---- types -----------------------------------------------------------
+def test_query_types_validate_and_coerce():
+    q = coerce_query((3, 7))
+    assert isinstance(q, PointToPoint) and (q.src, q.dst) == (3, 7)
+    assert coerce_query(q) is q
+    with pytest.raises(ValueError):
+        coerce_query("nope")
+    with pytest.raises(ValueError):
+        PointToPoint(0, 50).validate(10)
+    with pytest.raises(ValueError):
+        MultiSource((), 1).validate(10)
+    with pytest.raises(ValueError):
+        MultiSource((1, 99), 1).validate(10)
+    with pytest.raises(ValueError):
+        KShortest(0, 1, k=0).validate(10)
+    with pytest.raises(ValueError):
+        AsOf(PointToPoint(0, 1), 0).validate(10)
+    with pytest.raises(ValueError):
+        AsOf(AsOf(PointToPoint(0, 1), 1), 2)
+    # cache keys are per-kind distinct for the same endpoints
+    keys = {
+        PointToPoint(1, 2).cache_key(),
+        Weighted(1, 2).cache_key(),
+        Weighted(1, 2, weight_seed=9).cache_key(),
+        KShortest(1, 2, k=3).cache_key(),
+        MultiSource((1,), 2).cache_key(),
+        AsOf(PointToPoint(1, 2), 4).cache_key(),
+    }
+    assert len(keys) == 6
+
+
+def test_synthetic_weights_symmetric_deterministic():
+    n = 150
+    edges = gnp_random_graph(n, 4.0 / n, seed=1)
+    row_ptr, col_ind = build_csr(n, edges)
+    w1 = synthetic_weights(row_ptr, col_ind, seed=3)
+    w2 = synthetic_weights(row_ptr, col_ind, seed=3)
+    assert np.array_equal(w1, w2)
+    assert (w1 >= 1).all()
+    assert not np.array_equal(w1, synthetic_weights(row_ptr, col_ind, 4))
+    # symmetry: weight(u->v) == weight(v->u) for every CSR entry
+    src = np.repeat(np.arange(n), np.diff(row_ptr))
+    for i in np.random.default_rng(0).choice(
+        col_ind.size, size=min(64, col_ind.size), replace=False
+    ):
+        u, v = int(src[i]), int(col_ind[i])
+        lo, hi = int(row_ptr[v]), int(row_ptr[v + 1])
+        j = lo + int(np.searchsorted(col_ind[lo:hi], u))
+        assert w1[i] == w1[j]
+
+
+# ---- weighted vs the Dijkstra oracle ---------------------------------
+@pytest.mark.parametrize("name,n,edges", _graphs())
+def test_delta_stepping_exact_vs_dijkstra(name, n, edges):
+    row_ptr, col_ind = build_csr(n, edges)
+    w = synthetic_weights(row_ptr, col_ind, seed=2)
+    rng = np.random.default_rng(8)
+    for _ in range(12):
+        s, d = (int(x) for x in rng.integers(0, n, 2))
+        res = delta_stepping(n, row_ptr, col_ind, w, s, d)
+        dist, _par = dijkstra_numpy(n, row_ptr, col_ind, w, s, d)
+        if not np.isfinite(dist[d]):
+            assert not res.found
+            continue
+        assert res.found
+        assert res.dist == pytest.approx(float(dist[d]), abs=1e-9)
+        # the reported path is a real path of exactly that weight
+        assert res.path[0] == s and res.path[-1] == d
+        assert path_weight(row_ptr, col_ind, w, res.path) == (
+            pytest.approx(res.dist, abs=1e-9)
+        )
+
+
+def test_delta_stepping_unit_weights_match_bfs():
+    n = 100
+    edges = gnp_random_graph(n, 3.0 / n, seed=9)
+    row_ptr, col_ind = build_csr(n, edges)
+    w = np.ones(col_ind.size, dtype=np.float64)
+    rng = np.random.default_rng(1)
+    for _ in range(8):
+        s, d = (int(x) for x in rng.integers(0, n, 2))
+        res = delta_stepping(n, row_ptr, col_ind, w, s, d, delta=1.0)
+        ref = solve_serial_csr(n, row_ptr, col_ind, s, d)
+        assert res.found == ref.found
+        if ref.found:
+            assert int(res.dist) == ref.hops == res.hops
+
+
+# ---- msBFS vs independent serial solves ------------------------------
+@pytest.mark.parametrize("name,n,edges", _graphs())
+def test_msbfs_matches_independent_serial_solves(name, n, edges):
+    row_ptr, col_ind = build_csr(n, edges)
+    rng = np.random.default_rng(11)
+    k = min(64, n)
+    sources = tuple(
+        int(x) for x in rng.choice(n, size=k, replace=False)
+    )
+    dst = int(rng.integers(n))
+    q = MultiSource(sources, dst)
+    [res] = solve_multi_source(n, row_ptr, col_ind, [q])
+    for s, hops in zip(sources, res.per_source):
+        ref = solve_serial_csr(n, row_ptr, col_ind, s, dst)
+        assert hops == (ref.hops if ref.found else None), (name, s, dst)
+    if res.found:
+        assert res.hops == min(
+            h for h in res.per_source if h is not None
+        )
+        assert validate_path(
+            (row_ptr, col_ind), res.path, res.path[0], dst,
+            hops=res.hops,
+        )
+    else:
+        assert all(h is None for h in res.per_source)
+
+
+def test_msbfs_shared_sweep_across_queries():
+    n = 90
+    edges = gnp_random_graph(n, 4.0 / n, seed=3)
+    row_ptr, col_ind = build_csr(n, edges)
+    sources = tuple(range(20))
+    qs = [MultiSource(sources, d) for d in (30, 40, 50)]
+    results = solve_multi_source(n, row_ptr, col_ind, qs)
+    # one packed sweep serves every query in the batch: 20 distinct
+    # sources fit one 64-bit word
+    assert all(r.sweeps == 1 for r in results)
+    for q, r in zip(qs, results):
+        ref = solve_serial_csr(n, row_ptr, col_ind, sources[0], q.dst)
+        assert r.per_source[0] == (ref.hops if ref.found else None)
+
+
+def test_path_from_dist_descends_gradient():
+    from bibfs_tpu.oracle.trees import multi_source_bfs
+
+    gn, ge = 36, grid_graph(6, 6)
+    row_ptr, col_ind = build_csr(gn, ge)
+    dist = multi_source_bfs(gn, row_ptr, col_ind, [0])
+    ref = solve_serial_csr(gn, row_ptr, col_ind, 0, gn - 1)
+    p = path_from_dist(row_ptr, col_ind, dist[:, 0], 0, gn - 1)
+    assert validate_path((row_ptr, col_ind), p, 0, gn - 1, hops=ref.hops)
+    # unreachable target: no path, no crash
+    assert path_from_dist(
+        row_ptr, col_ind, np.full(gn, -1, dtype=np.int16), 0, 5
+    ) is None
+
+
+# ---- k-shortest ------------------------------------------------------
+@pytest.mark.parametrize("name,n,edges", _graphs())
+def test_kshortest_properties(name, n, edges):
+    row_ptr, col_ind = build_csr(n, edges)
+    rng = np.random.default_rng(13)
+    for _ in range(5):
+        s, d = (int(x) for x in rng.integers(0, n, 2))
+        if s == d:
+            continue
+        res = yen_k_shortest(n, row_ptr, col_ind, s, d, 4)
+        ref = solve_serial_csr(n, row_ptr, col_ind, s, d)
+        assert res.found == ref.found
+        if not ref.found:
+            assert res.paths == []
+            continue
+        # shortest first, and it matches the BFS oracle exactly
+        assert res.hops[0] == ref.hops
+        # non-decreasing lengths, loopless, distinct, every edge real
+        assert res.hops == sorted(res.hops)
+        seen = set()
+        for p, h in zip(res.paths, res.hops):
+            assert validate_path((row_ptr, col_ind), p, s, d, hops=h)
+            assert len(set(p)) == len(p), "loop in path"
+            assert tuple(p) not in seen
+            seen.add(tuple(p))
+
+
+def test_kshortest_k1_is_bfs():
+    gn, ge = 35, grid_graph(5, 7)
+    row_ptr, col_ind = build_csr(gn, ge)
+    res = yen_k_shortest(gn, row_ptr, col_ind, 0, gn - 1, 1)
+    ref = solve_serial_csr(gn, row_ptr, col_ind, 0, gn - 1)
+    assert len(res.paths) == 1 and res.hops[0] == ref.hops
+
+
+# ---- api entries -----------------------------------------------------
+def test_solve_query_host_tier():
+    n = 80
+    edges = gnp_random_graph(n, 4.0 / n, seed=2)
+    ref = solve_query(n, edges, (0, 9))
+    assert ref.found is not None
+    ms = solve_query(n, edges, MultiSource((0, 1, 2), 9))
+    assert len(ms.per_source) == 3
+    w = solve_query(n, edges, Weighted(0, 9))
+    ks = solve_query(n, edges, KShortest(0, 9, k=2))
+    if ref.found:
+        assert w.found and ks.found
+        assert ks.hops[0] == ref.hops
+    with pytest.raises(ValueError):
+        solve_query(n, edges, AsOf(PointToPoint(0, 9), 1))
+    with pytest.raises(ValueError):
+        solve_query(n, edges, Weighted(0, n + 5))
+
+
+def test_solve_many_invalid_pair_is_per_query():
+    """Regression (ISSUE 13 satellite): one out-of-range pair used to
+    fail the whole batch in default mode — now it costs exactly its
+    own slot, both modes."""
+    from bibfs_tpu.serve.resilience import QueryError
+    from bibfs_tpu.solvers.api import solve_many
+
+    n = 60
+    edges = np.array([[i, i + 1] for i in range(n - 1)])
+    pairs = [(0, 5), (3, n + 40), (2, 7)]
+    for flag in (False, True):
+        out = solve_many(n, edges, pairs, return_errors=flag)
+        assert len(out) == 3
+        assert out[0].found and out[0].hops == 5
+        assert isinstance(out[1], QueryError)
+        assert out[1].kind == "invalid"
+        assert out[2].found and out[2].hops == 5

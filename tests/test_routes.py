@@ -55,7 +55,10 @@ def _assert_matches_oracle(n, edges, pairs, results, label=""):
 def test_route_registry_and_ladder_default():
     eng = QueryEngine(N, _graph())
     assert set(eng.routes) == {"oracle", "overlay", "device", "host",
-                               "serial"}
+                               "serial",
+                               # the taxonomy kind routes ride every
+                               # engine (serve/routes/taxonomy.py)
+                               "msbfs", "weighted", "kshortest", "asof"}
     assert eng._ladder == ("device", "host")
     st = eng.stats()
     assert st["ladder"] == ["device", "host"]

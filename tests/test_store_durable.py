@@ -266,6 +266,8 @@ def test_programmatic_add_writes_seed_and_manifest(tmp_path):
     st.update("g", adds=[(0, 49)])
     st.close()
     assert sorted(os.listdir(d)) == [
+        # no g.history.json: the as-of commit index is written only by
+        # retain_history stores (store/history.py)
         "g.bin", "g.manifest.json", "g.wal.1"
     ]
     st2 = GraphStore.from_dir(str(d), durable=True,
