@@ -2150,6 +2150,19 @@ QUERIES_MIN_SPEEDUP = float(
 )
 
 
+def _write_queries_calibration(entry: dict) -> None:
+    """Bank the measured query-kind device crossovers in the ``cpu``
+    platform entry's ``queries`` block (the soak forces the cpu dryrun
+    substrate) via the shared calibration merge protocol."""
+    from bibfs_tpu.utils.calibrate import CAL_FILENAME, merge_calibration_block
+
+    merge_calibration_block(
+        "cpu", "queries", entry,
+        path=os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          CAL_FILENAME),
+    )
+
+
 def serve_queries_main():
     """``python bench.py --serve-queries``: the query-taxonomy soak.
 
@@ -2160,17 +2173,36 @@ def serve_queries_main():
     against its kind's independent oracle (Dijkstra for weighted,
     serial solves for msbfs per-source hops, CSR edge validation for
     k-shortest paths), the msbfs-vs-per-query-pt speedup measurement,
-    and per-kind fault-injected degrades. The gate: as-of exact for
-    >= 2 historical versions across the mid-traffic hot-swap, every
-    mixed answer exact, msbfs >= BENCH_QUERIES_MIN_SPEEDUP x the
-    per-query point-to-point qps on 64-source traffic, every kind
-    degrading (not failing) under injected faults, and the
-    ``bibfs_query_*`` metric families present in the registry render.
-    ``--mix pt=0.4,ms=0.2,weighted=0.2,kshortest=0.1,asof=0.1``
-    overrides the traffic mix. Artifact: ``bench_queries.json``."""
+    the DEVICE-tier A/B (per-kind host-vs-device rows on identical
+    traffic; the measured crossovers land in the platform entry's
+    ``queries`` block of ``calibration.json``), and per-kind
+    fault-injected degrades covering the device rungs' chaos sites.
+    The gate: as-of exact for >= 2 historical versions across the
+    mid-traffic hot-swap, every mixed answer exact, msbfs >=
+    BENCH_QUERIES_MIN_SPEEDUP x the per-query point-to-point qps on
+    64-source traffic, the DEVICE msbfs sweep >= the same factor x
+    the host packed-sweep qps (full runs; exact on every query
+    including across a second mid-traffic hot-swap), device
+    k-shortest identical to host Yen's, every kind degrading (not
+    failing) under injected faults, and the ``bibfs_query_*`` metric
+    families present in the registry render. ``--mix pt=0.4,ms=0.2,
+    weighted=0.2,kshortest=0.1,asof=0.1`` overrides the traffic mix.
+    Artifact: ``bench_queries.json``."""
     t_setup = time.time()
+    # the device rungs verify on the multi-device dryrun substrate,
+    # forced BEFORE any jax import (the mesh soak's discipline)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
     platform, tpu_error = select_platform()
     try:
+        from bibfs_tpu.utils.platform import apply_platform_env
+
+        apply_platform_env()
+
         from bibfs_tpu.graph.generate import gnp_random_graph
         from bibfs_tpu.obs.metrics import REGISTRY
         from bibfs_tpu.obs.names import QUERY_METRIC_FAMILIES
@@ -2188,8 +2220,12 @@ def serve_queries_main():
         edges = gnp_random_graph(n, AVG_DEG / n, seed=1)
         out = run_queries(
             n, edges, queries=q, mix=mix, ms_traffic=ms_traffic,
-            msbfs_min_speedup=QUERIES_MIN_SPEEDUP,
+            msbfs_min_speedup=QUERIES_MIN_SPEEDUP, quick=quick,
         )
+        if not quick:
+            # bank the measured device crossovers (full runs only —
+            # smoke-scale timings would overwrite real measurements)
+            _write_queries_calibration(out["device"]["crossovers"])
         render = REGISTRY.render()
         missing = [m for m in QUERY_METRIC_FAMILIES if m not in render]
         line = {
@@ -2217,6 +2253,10 @@ def serve_queries_main():
             "served_by_kind": out["mixed"]["served_by_kind"],
             "msbfs_qps": out["msbfs"]["msbfs_qps"],
             "pt_qps": out["msbfs"]["pt_qps"],
+            "device_ok": out["device"]["ok"],
+            "device_msbfs_speedup":
+                out["device"]["msbfs"]["speedup_vs_host_sweep"],
+            "device_crossovers": out["device"]["crossovers"],
             "resilience_ok": out["resilience"]["ok"],
             "metrics_missing": missing,
             "detail_file": "bench_queries.json",

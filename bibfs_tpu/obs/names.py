@@ -132,6 +132,7 @@ QUERY_METRIC_FAMILIES = (
     "bibfs_query_total",
     "bibfs_query_asof_replay_seconds",
     "bibfs_msbfs_breaker_state",
+    "bibfs_query_device_breaker_state",
 )
 
 #: build identity (obs/metrics.py; minted at every registry init)

@@ -1,0 +1,422 @@
+"""Device-tier bitmask-packed multi-source BFS — the oracle/query
+sweep as ONE jitted program.
+
+:func:`bibfs_tpu.oracle.trees.multi_source_bfs` runs the packed sweep
+in NumPy: per level it gathers the frontier's pending reach-bits,
+scatter-ORs them onto neighbors, and unpacks the newly gained bits
+into the distance matrix. Every one of those steps is a handful of
+host temporaries and an unbuffered ``ufunc.at`` — fine for one index
+build, but the msbfs QUERY route (PR 13) runs the sweep per flush,
+and ROADMAP item 3 calls out lifting the 64-source amortization onto
+the accelerator. This module is that lift: the whole level loop as one
+``lax.while_loop`` in one dispatch, two kernel shapes:
+
+- **ELL sweep** (:func:`msbfs_plane_graph` / :func:`msbfs_plane_csr`):
+  each vertex carries ``ceil(K/32)`` ``uint32`` mask words (JAX's
+  default x64-off world has no uint64 — two words stand in for the
+  host sweep's one), one chunked slot-major gather + OR-reduce per
+  level advances every search at once, and the level's arrivals are
+  unpacked into the ``[n, K]`` int32 distance plane by a vectorized
+  shift-and-mask — the device twin of the host sweep's
+  ``np.unpackbits`` pass, high words included.
+- **blocked-matmul sweep** (:func:`msbfs_plane_blocked`): the frontier
+  plane IS the K-column bitmask — ``[n_pad, K]`` 0/1, one column per
+  source — so a level advance is exactly the masked block-matmul of
+  ``ops/blocked_expand.expand_blocked_plane`` and the MXU route
+  applies to multi-source traffic unchanged.
+
+Both return the host sweep's contract (``int16 [n, K]``, ``-1`` =
+unreachable) and are pinned bit-equal to it in tests, including K > 64
+multi-word masks. :func:`bibfs_tpu.oracle.trees.multi_source_dist`
+routes between this module and the NumPy sweep (device when present or
+forced, host fallback intact), which is how K x n oracle index builds
+come off the host when an accelerator exists.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bibfs_tpu.ops.pallas_expand import _slot_pad, sentinel_transposed_table
+
+#: bits per device mask word (uint32 — uint64 needs jax x64, which the
+#: serving stack never enables)
+WORD_BITS = 32
+
+#: "unreachable" while relaxing (same headroom argument as the host
+#: sweep's _INF32: +1 cannot wrap, distinguishable from any level)
+INF32 = 1 << 30
+
+#: working-set budget for one gathered [wp, tc, words] chunk — the
+#: batch-minor discipline at the msbfs plane's much smaller row cost
+MSBFS_CHUNK_BUDGET_BYTES = 256 * 2**20
+
+#: carry-save counter planes per mask word (vertical SWAR counters:
+#: plane j holds bit j of every search's level count) and the levels
+#: between decodes — 5 planes count to 31, flushing every 30 levels
+#: into the int32 plane keeps them from ever wrapping
+SWAR_PLANES = 5
+FLUSH_LEVELS = 30
+
+#: device sweeps run since process start (test/bench witness that the
+#: oracle builder really routed here; monotonic, never reset)
+_sweeps_run = 0
+
+
+def sweeps_run() -> int:
+    """How many device sweeps this process has dispatched (both kernel
+    shapes) — the routing witness the dryrun tests assert on."""
+    return _sweeps_run
+
+
+def plane_words(k: int) -> int:
+    """Mask words per vertex for a K-source sweep."""
+    return max(1, -(-int(k) // WORD_BITS))
+
+
+def _chunk_rows(wp: int, words: int, n_pad: int) -> int:
+    """Vertex rows per level-scan chunk under the working-set budget
+    (sublane-quantum multiples, >= 8 — the batch_minor.chunk_rows
+    shape at this kernel's [wp, tc, words] uint32 block)."""
+    raw = MSBFS_CHUNK_BUDGET_BYTES // max(wp * words * 4, 1)
+    return int(max(8, min(n_pad, (raw // 8) * 8)))
+
+
+def _build_msbfs_kernel(n_pad2: int, wp: int, tc: int, words: int):
+    """The jitted K-source sweep for one padded ELL geometry.
+
+    Signature ``(nbr, deg, sources) -> (dist, levels)``: ``sources`` is
+    int32 ``[words * 32]`` padded with -1; ``dist`` comes back int32
+    ``[n_pad2, words * 32]`` with :data:`INF32` = unreachable. The
+    program is a pure function of the padded geometry (the
+    batch-minor cache-key discipline), so serving buckets share it
+    across real graph sizes."""
+    kp = words * WORD_BITS
+    num_chunks = n_pad2 // tc
+    shifts32 = jnp.arange(WORD_BITS, dtype=jnp.uint32)[None, None, :]
+
+    def unpack32(mask_words):
+        """The vectorized level unpack, device edition: broadcast
+        shift-and-mask explodes each mask word into its 32 columns
+        (bit k lives at word k//32, bit k%32 — little-endian, high
+        words included: the K > 64 case the host unpack covers with
+        np.unpackbits). Runs only at counter DECODES (once per
+        :data:`FLUSH_LEVELS` and once at the end), never per level."""
+        return (
+            (mask_words[:, :, None] >> shifts32) & jnp.uint32(1)
+        ).reshape(n_pad2, kp)
+
+    def kernel(nbr, deg, sources):
+        nbr_t = sentinel_transposed_table(nbr, deg, n_pad2, n_pad2, wp)
+        k_idx = jnp.arange(kp, dtype=jnp.int32)
+        w_idx = k_idx // WORD_BITS
+        b_idx = (k_idx % WORD_BITS).astype(jnp.uint32)
+        valid = sources >= 0
+        srcs = jnp.where(valid, sources, 0)
+        bitv = jnp.where(
+            valid, jnp.uint32(1) << b_idx, jnp.uint32(0)
+        )
+        # distinct (word, bit) per column, so the scatter-add IS a
+        # scatter-or; padded columns contribute 0
+        mask0 = jnp.zeros((n_pad2, words), jnp.uint32).at[
+            srcs, w_idx
+        ].add(bitv)
+
+        def accumulate(pending):
+            """OR of the frontier's pending words onto every vertex's
+            neighbors — the level's one gather, chunked over the
+            vertex axis so the working set stays inside the budget at
+            any graph size. The slot loop is UNROLLED (wp ORs of
+            [tc, words] row-gathers off the dump-row-padded plane):
+            measured ~2x the take+variadic-reduce lowering on CPU."""
+            pend_p = jnp.concatenate(
+                [pending, jnp.zeros((1, words), jnp.uint32)]
+            )  # sentinel index n_pad2 reads the zero dump row
+
+            def chunk(acc, c):
+                r0 = c * tc
+                nbr_c = jax.lax.dynamic_slice(nbr_t, (0, r0), (wp, tc))
+                acc_c = pend_p[nbr_c[0]]
+                for i in range(1, wp):
+                    acc_c = acc_c | pend_p[nbr_c[i]]
+                return jax.lax.dynamic_update_slice(
+                    acc, acc_c, (r0, 0)
+                ), None
+
+            acc, _ = jax.lax.scan(
+                chunk,
+                jnp.zeros((n_pad2, words), jnp.uint32),
+                jnp.arange(num_chunks, dtype=jnp.int32),
+            )
+            return acc
+
+        zw = jnp.zeros((n_pad2, words), jnp.uint32)
+
+        def decode(planes):
+            """The SWAR counters' int32 value plane: Σ bit-plane j's
+            unpacked bits << j — the only K-wide work in the sweep,
+            run once per FLUSH_LEVELS, not per level."""
+            d = jnp.zeros((n_pad2, kp), jnp.int32)
+            for j in range(SWAR_PLANES):
+                d = d + (
+                    unpack32(planes[j]).astype(jnp.int32) << j
+                )
+            return d
+
+        def _flush(planes, hi):
+            # fold the carry-save counters into the int32 plane and
+            # restart them — once per FLUSH_LEVELS, so deep
+            # (grid-shaped) searches never wrap the 5-bit counters
+            return (zw,) * SWAR_PLANES, hi + decode(planes)
+
+        def _keep(planes, hi):
+            return planes, hi
+
+        def cond(st):
+            return st[4]
+
+        def body(st):
+            mask, pending, planes, level, _go, hi = st
+            # distances by COUNTING in carry-save form: each level,
+            # every still-unreached bit increments its VERTICAL
+            # counter (bit-plane ripple carry in the packed [n, words]
+            # domain — O(n * words * planes) bit ops per level instead
+            # of any K-wide plane work), so a vertex first reached at
+            # level L accumulates exactly L. Measured ~2.5x the whole
+            # sweep vs per-level K-wide accumulation on CPU; the
+            # counting formulation also makes overshoot levels
+            # harmless — only never-reached bits keep counting, and
+            # they are masked to INF at the end.
+            inc = ~mask
+            rippled = []
+            for j in range(SWAR_PLANES):
+                rippled.append(planes[j] ^ inc)
+                inc = planes[j] & inc
+            planes = tuple(rippled)
+            new = accumulate(pending) & ~mask
+            level = level + 1
+            planes, hi = jax.lax.cond(
+                level % FLUSH_LEVELS == 0, _flush, _keep, planes, hi
+            )
+            return (
+                mask | new, new, planes, level,
+                jnp.any(new != jnp.uint32(0)), hi,
+            )
+
+        st = (
+            mask0, mask0, (zw,) * SWAR_PLANES,
+            jnp.int32(0), jnp.any(mask0 != jnp.uint32(0)),
+            jnp.zeros((n_pad2, kp), jnp.int32),
+        )
+        mask, _pending, planes, level, _go, hi = jax.lax.while_loop(
+            cond, body, st
+        )
+        cnt = hi + decode(planes)
+        reached = unpack32(mask) > 0
+        # finalize ON the device: the host contract's int16 plane with
+        # -1 = unreachable, plus the max reached distance (the int16
+        # range check) — the host wrapper only slices
+        dist16 = jnp.where(
+            reached, cnt, jnp.int32(-1)
+        ).astype(jnp.int16)
+        dmax = jnp.max(jnp.where(reached, cnt, 0))
+        return dist16, dmax, level
+
+    return kernel
+
+
+@lru_cache(maxsize=None)
+def _get_msbfs_kernel(n_pad2: int, wp: int, tc: int, words: int):
+    return jax.jit(_build_msbfs_kernel(n_pad2, wp, tc, words))
+
+
+def _finalize_plane(dist, n: int, k: int) -> np.ndarray:
+    """Device plane -> the host sweep's contract: int16 ``[n, K]``
+    with -1 = unreachable (the oracle tier's storage encoding)."""
+    from bibfs_tpu.oracle.trees import _as_int16_dist
+
+    return _as_int16_dist(np.asarray(dist)[:n, :k])
+
+
+def _finalize_plane16(dist16, dmax, n: int, k: int) -> np.ndarray:
+    """The ELL kernel's device-finalized plane: already int16/-1, the
+    host only range-checks (the ``_as_int16_dist`` contract) and
+    slices the padding off."""
+    if int(dmax) > np.iinfo(np.int16).max:
+        raise ValueError("graph diameter exceeds int16 distance range")
+    return np.asarray(dist16)[:n, :k]
+
+
+def _padded_sources(sources, kp: int):
+    sources = np.asarray(sources, dtype=np.int64).ravel()
+    out = np.full(kp, -1, np.int32)
+    out[: sources.size] = sources
+    return jnp.asarray(out)
+
+
+def msbfs_plane_ell(n: int, nbr, deg, sources) -> np.ndarray:
+    """The K-source distance plane over one host ELL table (``nbr``
+    int32 ``[n_pad, width]``, ``deg`` int32 ``[n_pad]``) — uploads the
+    table and runs the jitted sweep. Returns ``int16 [n, K]``."""
+    global _sweeps_run
+    sources = np.asarray(sources, dtype=np.int64).ravel()
+    k = int(sources.size)
+    if k == 0:
+        return np.zeros((n, 0), dtype=np.int16)
+    if int(sources.min()) < 0 or int(sources.max()) >= n:
+        raise ValueError(f"source out of range for n={n}")
+    n_pad, width = nbr.shape
+    wp = _slot_pad(width)
+    words = plane_words(k)
+    tc = _chunk_rows(wp, words, n_pad)
+    n_pad2 = -(-n_pad // tc) * tc
+    kern = _get_msbfs_kernel(n_pad2, wp, tc, words)
+    dist16, dmax, _levels = jax.block_until_ready(kern(
+        jnp.asarray(nbr), jnp.asarray(deg),
+        _padded_sources(sources, words * WORD_BITS),
+    ))
+    _sweeps_run += 1
+    return _finalize_plane16(dist16, dmax, n, k)
+
+
+def msbfs_plane_graph(g, sources) -> np.ndarray:
+    """The sweep over an uploaded serving table
+    (:class:`~bibfs_tpu.solvers.dense.DeviceGraph`, plain ELL — hub
+    tiers carry edges the mask gather would miss, so tiered layouts
+    are refused and stay on the host sweep)."""
+    global _sweeps_run
+    if getattr(g, "tier_meta", ()):
+        raise ValueError("device msBFS is plain-ELL only (tiered "
+                         "layouts keep the host sweep)")
+    sources = np.asarray(sources, dtype=np.int64).ravel()
+    k = int(sources.size)
+    if k == 0:
+        return np.zeros((g.n, 0), dtype=np.int16)
+    if int(sources.min()) < 0 or int(sources.max()) >= g.n:
+        raise ValueError(f"source out of range for n={g.n}")
+    wp = _slot_pad(g.width)
+    words = plane_words(k)
+    tc = _chunk_rows(wp, words, g.n_pad)
+    n_pad2 = -(-g.n_pad // tc) * tc
+    kern = _get_msbfs_kernel(n_pad2, wp, tc, words)
+    dist16, dmax, _levels = jax.block_until_ready(kern(
+        g.nbr, g.deg, _padded_sources(sources, words * WORD_BITS),
+    ))
+    _sweeps_run += 1
+    return _finalize_plane16(dist16, dmax, g.n, k)
+
+
+def _ell_from_csr(n: int, row_ptr, col_ind):
+    """A plain host ELL table straight from a CSR (the oracle builder's
+    input shape) — one vectorized fill, no canonicalization re-run."""
+    deg = np.diff(row_ptr).astype(np.int64)
+    width = max(1, int(deg.max()) if deg.size else 0)
+    n_pad = -(-n // 8) * 8
+    nbr = np.zeros((n_pad, width), dtype=np.int32)
+    if col_ind.size:
+        rows = np.repeat(np.arange(n, dtype=np.int64), deg)
+        rank = np.arange(col_ind.size, dtype=np.int64) - np.repeat(
+            row_ptr[:-1].astype(np.int64), deg
+        )
+        nbr[rows, rank] = col_ind
+    deg_pad = np.zeros(n_pad, dtype=np.int32)
+    deg_pad[:n] = deg
+    return nbr, deg_pad
+
+
+def msbfs_plane_csr(n: int, row_ptr, col_ind, sources) -> np.ndarray:
+    """The sweep from a raw CSR — what the oracle index builder holds.
+    Builds the ELL table host-side (O(E), once per build) and runs the
+    jitted sweep."""
+    nbr, deg = _ell_from_csr(n, np.asarray(row_ptr), np.asarray(col_ind))
+    return msbfs_plane_ell(n, nbr, deg, sources)
+
+
+# ---- blocked-matmul variant ------------------------------------------
+
+def _build_msbfs_blocked_kernel(nblocks: int, bwidth: int, kp: int,
+                                dt, rc: int, tile: int):
+    """The MXU-route sweep: the frontier plane is the K-column bitmask
+    (``[n_pad, kp]`` 0/1, one column per source), each level one masked
+    block-matmul over the tiled adjacency
+    (:func:`bibfs_tpu.ops.blocked_expand.expand_blocked_plane`)."""
+    from bibfs_tpu.ops.blocked_expand import expand_blocked_plane
+
+    n_pad = nblocks * tile
+
+    def kernel(tab, bcol, sources):
+        k_idx = jnp.arange(kp, dtype=jnp.int32)
+        valid = sources >= 0
+        srcs = jnp.where(valid, sources, 0)
+        seed = jnp.zeros((n_pad, kp), dt).at[srcs, k_idx].max(
+            jnp.where(valid, 1, 0).astype(dt)
+        )
+        dist0 = jnp.full((n_pad, kp), INF32, jnp.int32).at[
+            srcs, k_idx
+        ].min(jnp.where(valid, 0, INF32))
+
+        def cond(st):
+            return st[3]
+
+        def body(st):
+            visited, pending, dist, _go, level = st
+            level = level + 1
+            reached = expand_blocked_plane(pending, tab, bcol, rc=rc)
+            new = reached & (visited == 0)
+            dist = jnp.where(new, level, dist)
+            newp = new.astype(dt)
+            return (
+                visited + newp, newp, dist, jnp.any(new), level,
+            )
+
+        st = (seed, seed, dist0, jnp.any(seed > 0), jnp.int32(0))
+        _v, _p, dist, _go, _level = jax.lax.while_loop(cond, body, st)
+        return dist
+
+    return kernel
+
+
+@lru_cache(maxsize=None)
+def _get_msbfs_blocked_kernel(nblocks: int, bwidth: int, kp: int,
+                              dt, rc: int, tile: int):
+    return jax.jit(
+        _build_msbfs_blocked_kernel(nblocks, bwidth, kp, dt, rc, tile)
+    )
+
+
+def msbfs_plane_blocked(g, sources, dt=None) -> np.ndarray:
+    """The blocked-matmul sweep over an uploaded
+    :class:`~bibfs_tpu.solvers.dense.BlockedDeviceGraph` — the same
+    ``int16 [n, K]`` contract as the ELL sweep."""
+    global _sweeps_run
+    from bibfs_tpu.ops.blocked_expand import (
+        chunk_block_rows,
+        resolve_plane_dtype,
+    )
+
+    sources = np.asarray(sources, dtype=np.int64).ravel()
+    k = int(sources.size)
+    if k == 0:
+        return np.zeros((g.n, 0), dtype=np.int16)
+    if int(sources.min()) < 0 or int(sources.max()) >= g.n:
+        raise ValueError(f"source out of range for n={g.n}")
+    dt = resolve_plane_dtype(dt)
+    # pad the source columns to whole lane groups like the batch planes
+    kp = max(8, -(-k // 8) * 8)
+    rc = min(
+        chunk_block_rows(g.bwidth, kp, dt.itemsize, g.tile), g.nblocks
+    )
+    kern = _get_msbfs_blocked_kernel(
+        g.nblocks, g.bwidth, kp, dt, rc, g.tile
+    )
+    srcs = np.full(kp, -1, np.int32)
+    srcs[:k] = sources
+    dist = jax.block_until_ready(
+        kern(g.tab, g.bcol, jnp.asarray(srcs))
+    )
+    _sweeps_run += 1
+    return _finalize_plane(dist, g.n, k)

@@ -30,7 +30,7 @@ from __future__ import annotations
 
 import numpy as np
 
-from bibfs_tpu.oracle.trees import multi_source_bfs
+from bibfs_tpu.oracle.trees import multi_source_dist
 
 _UNREACHED = np.int64(1 << 40)  # farther than any real distance
 
@@ -76,7 +76,10 @@ def select_landmarks(n: int, row_ptr: np.ndarray, col_ind: np.ndarray,
             break  # fewer reachable vertices than requested landmarks
         taken[batch] = True
         chosen.extend(int(v) for v in batch)
-        d = multi_source_bfs(n, row_ptr, col_ind, batch)
+        # tier-routed (device kernel when present, host fallback) —
+        # the refinement rows ARE index columns, so they must come
+        # from the same routed sweep the rebuild uses
+        d = multi_source_dist(n, row_ptr, col_ind, batch)
         cols.append(d)
         d64 = np.where(d < 0, _UNREACHED, d.astype(np.int64))
         np.minimum(mindist, d64.min(axis=1), out=mindist)

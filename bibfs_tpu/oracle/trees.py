@@ -26,6 +26,8 @@ the index until the next compaction rebuild.
 
 from __future__ import annotations
 
+import os
+import sys
 import time
 
 import numpy as np
@@ -117,6 +119,48 @@ def multi_source_bfs(n: int, row_ptr: np.ndarray, col_ind: np.ndarray,
         pending[rows] = newbits
         frontier = rows
     return _as_int16_dist(dist)
+
+
+def _device_sweep_wanted() -> bool:
+    """Whether the packed sweep should run on the device tier:
+    ``BIBFS_MSBFS_DEVICE`` forces it on (``1``) or off (``0``); absent
+    that, the sweep follows the substrate — an accelerator backend
+    routes device, the CPU substrate keeps the NumPy sweep (the same
+    auto-by-substrate rule as ``QueryEngine._use_device``). Never
+    initializes a backend on its own: with jax unimported the answer
+    is host (an oracle build must not pay a backend boot)."""
+    env = os.environ.get("BIBFS_MSBFS_DEVICE", "")
+    if env in ("0", "1"):
+        return env == "1"
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return False
+    try:
+        return jax.default_backend() != "cpu"
+    except RuntimeError:
+        return False
+
+
+def multi_source_dist(n: int, row_ptr: np.ndarray, col_ind: np.ndarray,
+                      sources, *, device: bool | None = None) -> np.ndarray:
+    """One packed K-source sweep, routed by tier: the jitted device
+    kernel (:mod:`bibfs_tpu.ops.msbfs_device`) when a device is present
+    or forced, the NumPy sweep otherwise — identical ``int16 [n, K]``
+    output either way (parity-pinned in tests), so K x n oracle index
+    builds come off the host whenever an accelerator exists. A device
+    failure falls back to the host sweep: the oracle tier's build path
+    degrades, it never dies with the accelerator."""
+    use = _device_sweep_wanted() if device is None else bool(device)
+    if use:
+        try:
+            from bibfs_tpu.ops.msbfs_device import msbfs_plane_csr
+
+            return msbfs_plane_csr(n, row_ptr, col_ind, sources)
+        except Exception:
+            # host fallback intact — a broken device stack costs the
+            # build its speedup, never the index
+            pass
+    return multi_source_bfs(n, row_ptr, col_ind, sources)
 
 
 class LandmarkIndex:
@@ -266,6 +310,6 @@ def build_index(n: int, row_ptr: np.ndarray, col_ind: np.ndarray,
         )
     else:
         landmarks = np.asarray(landmarks, dtype=np.int64)
-        dist = multi_source_bfs(n, row_ptr, col_ind, landmarks)
+        dist = multi_source_dist(n, row_ptr, col_ind, landmarks)
     return LandmarkIndex(n, landmarks, dist, digest=digest,
                          version=version, gen=gen)

@@ -49,40 +49,66 @@ def path_from_dist(row_ptr: np.ndarray, col_ind: np.ndarray,
 
 
 def solve_multi_source(n: int, row_ptr: np.ndarray, col_ind: np.ndarray,
-                       queries, *, with_paths: bool = True):
-    """Answer a batch of :class:`MultiSource` queries with packed
-    sweeps: the DISTINCT sources across the whole batch ride sweeps of
-    64, then every query reads its ``(source, dst)`` cells from the
-    shared distance planes. Returns one
-    :class:`~bibfs_tpu.query.types.MultiSourceResult` per query."""
+                       queries, *, with_paths: bool = True,
+                       dist_fn=None):
+    """Answer a batch of :class:`MultiSource` queries with ONE packed
+    sweep: the DISTINCT sources across the whole batch ride a single
+    multi-word sweep (``ceil(distinct / 64)`` mask words per vertex —
+    the K > 64 case is one wider pass, not a loop of 64-wide ones),
+    then every query reads its ``(source, dst)`` cells from the shared
+    distance plane — one contiguous ``plane[dst]`` row read per query,
+    not a strided column per source. Returns one
+    :class:`~bibfs_tpu.query.types.MultiSourceResult` per query.
+
+    ``dist_fn(sources) -> int16 [n, K]`` overrides the sweep
+    implementation — the device rung
+    (:class:`~bibfs_tpu.serve.routes.taxonomy_device.MsbfsDeviceRoute`)
+    passes the jitted kernel over its uploaded table; the default is
+    the host NumPy sweep. ``sweeps`` in the results stays in 64-source
+    sweep units (the amortization figure the metrics report)."""
     from bibfs_tpu.oracle.trees import multi_source_bfs
 
     t0 = time.perf_counter()
-    distinct: list[int] = []
     col_of: dict[int, int] = {}
-    for q in queries:
-        for s in q.sources:
-            s = int(s)
-            if s not in col_of:
-                col_of[s] = len(distinct)
-                distinct.append(s)
-    planes = []  # one int16 [n, <=64] plane per sweep
-    sweeps = 0
-    for lo in range(0, len(distinct), MSBFS_WORD):
-        chunk = np.asarray(distinct[lo: lo + MSBFS_WORD], dtype=np.int64)
-        planes.append(multi_source_bfs(n, row_ptr, col_ind, chunk))
-        sweeps += 1
+    first = queries[0].sources if queries else ()
+    shared = all(
+        q.sources is first or q.sources == first for q in queries
+    )
+    if shared:
+        # the serving shape: one shared source set across the flush
+        # (64-source traffic) — index it once, not per (query, source)
+        col_of = {int(s): i for i, s in enumerate(first)}
+    if not shared or len(col_of) != len(first):
+        # distinct sources per query, or a DUPLICATE inside the shared
+        # tuple (validate() allows it): positional indexing would read
+        # past the deduped plane — take the deduping walk instead
+        col_of = {}
+        distinct = []
+        for q in queries:
+            for s in q.sources:
+                s = int(s)
+                if s not in col_of:
+                    col_of[s] = len(distinct)
+                    distinct.append(s)
+    else:
+        distinct = list(col_of)
+    src_arr = np.asarray(distinct, dtype=np.int64)
+    if dist_fn is None:
+        plane = multi_source_bfs(n, row_ptr, col_ind, src_arr)
+    else:
+        plane = dist_fn(src_arr)
+    sweeps = -(-len(distinct) // MSBFS_WORD)
     elapsed = time.perf_counter() - t0
 
     def col(s: int) -> np.ndarray:
-        i = col_of[int(s)]
-        return planes[i // MSBFS_WORD][:, i % MSBFS_WORD]
+        return plane[:, col_of[int(s)]]
 
     out = []
     for q in queries:
         dst = int(q.dst)
+        row = plane[dst]
         per = tuple(
-            (lambda d: None if d < 0 else int(d))(int(col(s)[dst]))
+            (lambda d: None if d < 0 else int(d))(int(row[col_of[int(s)]]))
             for s in q.sources
         )
         best = None

@@ -33,19 +33,16 @@ import numpy as np
 _INF = np.float64(np.inf)
 
 
-def synthetic_weights(row_ptr: np.ndarray, col_ind: np.ndarray,
-                      seed: int = 0, *, max_w: int = 9) -> np.ndarray:
-    """Per-entry positive integer weights in ``[1, max_w]``, SYMMETRIC
-    (the (u,v) and (v,u) CSR entries hash identically — undirected
-    consistency) and deterministic in ``seed``. Vectorized: one mixing
-    pass over the CSR, no Python per-edge loop."""
-    n = row_ptr.shape[0] - 1
-    src = np.repeat(
-        np.arange(n, dtype=np.int64), np.diff(row_ptr).astype(np.int64)
-    )
-    dst = col_ind.astype(np.int64)
-    a = np.minimum(src, dst)
-    b = np.maximum(src, dst)
+def edge_weight_hash(src: np.ndarray, dst: np.ndarray, seed: int = 0,
+                     *, max_w: int = 9) -> np.ndarray:
+    """The ONE weight derivation: positive integer weights in
+    ``[1, max_w]`` for arbitrary (src, dst) endpoint arrays, SYMMETRIC
+    (hashing the canonical (min, max) pair) and deterministic in
+    ``seed``. Shared by the CSR derivation below and the device rung's
+    ELL-aligned table (:func:`ell_weights`) — the two layouts MUST
+    weigh every edge identically or the device answers drift."""
+    a = np.minimum(src, dst).astype(np.uint64)
+    b = np.maximum(src, dst).astype(np.uint64)
     # splitmix-style avalanche over the canonical (min, max, seed)
     # triple — uint64 wraparound is the point, silence the warnings
     with np.errstate(over="ignore"):
@@ -53,13 +50,42 @@ def synthetic_weights(row_ptr: np.ndarray, col_ind: np.ndarray,
             ((int(seed) & 0xFFFFFFFF) * 0x94D049BB133111EB)
             & 0xFFFFFFFFFFFFFFFF
         )
-        h = (a.astype(np.uint64) * np.uint64(0x9E3779B97F4A7C15)
-             ^ b.astype(np.uint64) * np.uint64(0xBF58476D1CE4E5B9)
+        h = (a * np.uint64(0x9E3779B97F4A7C15)
+             ^ b * np.uint64(0xBF58476D1CE4E5B9)
              ^ seed_mix)
         h ^= h >> np.uint64(31)
         h *= np.uint64(0xD6E8FEB86659FD93)
         h ^= h >> np.uint64(27)
     return (1 + (h % np.uint64(int(max_w)))).astype(np.float64)
+
+
+def synthetic_weights(row_ptr: np.ndarray, col_ind: np.ndarray,
+                      seed: int = 0, *, max_w: int = 9) -> np.ndarray:
+    """Per-CSR-entry weights via :func:`edge_weight_hash` — one
+    vectorized mixing pass over the CSR, no Python per-edge loop."""
+    n = row_ptr.shape[0] - 1
+    src = np.repeat(
+        np.arange(n, dtype=np.int64), np.diff(row_ptr).astype(np.int64)
+    )
+    return edge_weight_hash(src, col_ind.astype(np.int64), seed,
+                            max_w=max_w)
+
+
+def ell_weights(nbr: np.ndarray, deg: np.ndarray, seed: int = 0, *,
+                max_w: int = 9) -> np.ndarray:
+    """The same derived weights aligned with an ELL table: ``float32
+    [n_pad, width]``, ``+inf`` at dead/pad slots (a dead slot's
+    relaxation candidate must never win a scatter-min). The live
+    entries hash identically to :func:`synthetic_weights` over the
+    same graph — the device delta-stepping rung's exactness leans on
+    it."""
+    n_pad, width = nbr.shape
+    rows = np.repeat(np.arange(n_pad, dtype=np.int64), width)
+    w = edge_weight_hash(
+        rows, nbr.astype(np.int64).ravel(), seed, max_w=max_w
+    ).reshape(n_pad, width).astype(np.float32)
+    alive = np.arange(width, dtype=np.int64)[None, :] < deg[:, None]
+    return np.where(alive, w, np.float32(np.inf))
 
 
 def delta_stepping(n: int, row_ptr: np.ndarray, col_ind: np.ndarray,

@@ -284,7 +284,7 @@ class _Pending:
             "host_native_graph", "_serial_solver", "host_backend_resolved",
             "_mesh_graph", "mesh_bucket_key", "_dp_graph", "dp_bucket_key",
             "_blocked_graph", "blocked_bucket_key", "_blocked_meta",
-            "_weights")
+            "_weights", "_wtables")
 class _GraphRuntime:
     """Everything an engine knows about solving ONE immutable graph
     snapshot: the lazily built+uploaded device graph and its compiled-
@@ -331,6 +331,9 @@ class _GraphRuntime:
         # (seed -> float64 aligned with the snapshot CSR), built on
         # first weighted-routed flush like the other lazy tables
         self._weights: dict = {}
+        # the weighted DEVICE rung's uploaded (targets, weights) ELL
+        # tables, seed-keyed like _weights (bounded the same way)
+        self._wtables: dict = {}
 
     @property
     def graph(self):
@@ -524,6 +527,27 @@ class _GraphRuntime:
                         self._weights.pop(next(iter(self._weights)))
                     self._weights[int(seed)] = w
         return w
+
+    def weighted_device_tables(self, seed: int):
+        """The weighted device rung's uploaded relaxation tables for
+        one ``weight_seed`` (:func:`bibfs_tpu.solvers.query_device.
+        delta_tables` over the snapshot's serving ELL), memoized per
+        runtime like :meth:`weights_for` — one upload per (snapshot,
+        seed), freed with the runtime on hot-swap. Bounded by the same
+        ``WEIGHT_SEEDS_MAX`` argument: the seed is client input."""
+        t = self._wtables.get(int(seed))
+        if t is None:
+            from bibfs_tpu.solvers.query_device import delta_tables
+
+            with self._lock:
+                t = self._wtables.get(int(seed))
+                if t is None:
+                    t = delta_tables(self.snapshot.ell(), int(seed))
+                    while len(self._wtables) >= self.WEIGHT_SEEDS_MAX:
+                        # dicts iterate in insert order: FIFO eviction
+                        self._wtables.pop(next(iter(self._wtables)))
+                    self._wtables[int(seed)] = t
+        return t
 
     def solve_serial_one(self, src: int, dst: int,
                          cutoff: int | None = None) -> BFSResult:
@@ -1379,25 +1403,28 @@ class QueryEngine:
             self._flush_kind(kind, groups[kind], rt, ctx)
 
     def _flush_kind(self, kind, tickets, rt, ctx) -> None:
-        """One kind group through its resilient rung pair: the kind
-        route's :meth:`~bibfs_tpu.serve.routes.base.Route.attempt`
-        (bounded retries behind its own breaker), degrading to the
-        kind's per-query-isolated ``fallback`` — counted in
-        ``bibfs_route_fallbacks_total{from=<kind>,to=host}`` — so an
-        injected (or real) fault on the primary costs throughput,
-        never availability. The walk order is the adaptive policy's
-        per-(digest, kind) decision when the engine runs adaptive."""
-        from bibfs_tpu.serve.routes import KIND_ROUTES
+        """One kind group through its resilient rung ladder
+        (:data:`~bibfs_tpu.serve.routes.taxonomy.KIND_LADDERS` — the
+        device rung ahead of the host-tier kind rung): each eligible
+        rung gets a resilient
+        :meth:`~bibfs_tpu.serve.routes.base.Route.attempt` (bounded
+        retries behind its own breaker), an ineligible rung is skipped
+        silently (a routing decision), and an unavailable one degrades
+        to the next — counted in ``bibfs_route_fallbacks_total{from=
+        <rung>,to=<next>}`` — down to the kind's per-query-isolated
+        ``fallback``, so an injected (or real) fault on any rung costs
+        throughput, never availability. The walk order is the adaptive
+        policy's per-(digest, kind) decision when the engine runs
+        adaptive."""
+        from bibfs_tpu.serve.routes import KIND_LADDERS, KIND_ROUTES
 
-        route_name = KIND_ROUTES[kind]
-        route = self.routes[route_name]
+        ladder = KIND_LADDERS[kind]
         # dedupe identical queries within the flush (cache_key is the
         # exact-repeat identity, same motivation as the pt flush)
         unique: dict[tuple, list[_Pending]] = {}
         for t in tickets:
             unique.setdefault(t.query.cache_key(), []).append(t)
         queries = [unique[k][0].query for k in unique]
-        ladder = (route_name, "host")
         if self._policy is not None:
             ladder, _why = self._policy.order(
                 rt.snapshot.digest, len(queries), ladder, kind=kind
@@ -1405,16 +1432,23 @@ class QueryEngine:
         results = None
         used = "host"
         t0 = time.perf_counter()
-        for rung in ladder:
+        for i, rung in enumerate(ladder):
             if rung == "host":
                 break
+            route = self.routes[rung]
+            if not route.kind_eligible(rt, queries, ctx):
+                continue
             results = route.attempt(rt, queries, ctx)
             if results is not None:
                 used = rung
                 break
-            self._note_fallback(route_name, "host")
+            self._note_fallback(
+                rung, self._next_kind_rung(ladder, i, rt, queries, ctx)
+            )
         if results is None:
-            results = route.fallback(rt, queries, ctx)
+            results = self.routes[KIND_ROUTES[kind]].fallback(
+                rt, queries, ctx
+            )
         elapsed = time.perf_counter() - t0
         if self._policy is not None:
             # whole-rung wall time (the taxonomy rungs are host-tier:
@@ -1434,6 +1468,17 @@ class QueryEngine:
                 self._kind_cache.put(ctx.graph_id, key, res)
             for t in ts:
                 t.result = res
+
+    def _next_kind_rung(self, ladder, i: int, rt, queries, ctx) -> str:
+        """The rung a failed kind-ladder step actually degrades TO
+        (the ``to`` label of the fallback counter — the kind-ladder
+        twin of :meth:`_next_rung`)."""
+        for name in ladder[i + 1:]:
+            if name == "host" or self.routes[name].kind_eligible(
+                rt, queries, ctx
+            ):
+                return name
+        return "host"
 
     def _next_rung(self, i: int, rt, pairs, ladder=None) -> str:
         """The rung a failed/ineligible ladder step actually degrades

@@ -72,7 +72,11 @@ KNOWN_SITES = ("device", "device_finish", "mesh", "mesh_finish",
                # taxonomy query kinds (serve/routes/taxonomy.py): the
                # packed multi-source sweep, the delta-stepping solve,
                # the Yen's batch, and the as-of historical replay
-               "msbfs", "weighted", "kshortest", "asof_replay")
+               "msbfs", "weighted", "kshortest", "asof_replay",
+               # the kinds' DEVICE rungs (serve/routes/
+               # taxonomy_device.py): each degrades to its host kind
+               # rung when faulted
+               "msbfs_device", "weighted_device", "kshortest_device")
 
 KINDS = ("error", "latency")
 

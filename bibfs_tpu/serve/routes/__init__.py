@@ -24,6 +24,7 @@ from bibfs_tpu.serve.routes.mesh import MeshConfig, MeshRoute, mesh_prebuild
 from bibfs_tpu.serve.routes.oracle import OracleRoute
 from bibfs_tpu.serve.routes.overlay import OverlayRoute
 from bibfs_tpu.serve.routes.taxonomy import (
+    KIND_LADDERS,
     KIND_ROUTES,
     AsOfRoute,
     KindCtx,
@@ -33,6 +34,11 @@ from bibfs_tpu.serve.routes.taxonomy import (
     QueryKindCells,
     WeightedRoute,
     build_taxonomy_routes,
+)
+from bibfs_tpu.serve.routes.taxonomy_device import (
+    KShortestDeviceRoute,
+    MsbfsDeviceRoute,
+    WeightedDeviceRoute,
 )
 
 __all__ = [
@@ -46,14 +52,18 @@ __all__ = [
     "MeshRoute",
     "OracleRoute",
     "OverlayRoute",
+    "KIND_LADDERS",
     "KIND_ROUTES",
     "AsOfRoute",
     "KindCtx",
     "KindResultCache",
     "KShortestRoute",
+    "KShortestDeviceRoute",
     "MsbfsRoute",
+    "MsbfsDeviceRoute",
     "QueryKindCells",
     "WeightedRoute",
+    "WeightedDeviceRoute",
     "build_routes",
     "build_taxonomy_routes",
     "mesh_prebuild",

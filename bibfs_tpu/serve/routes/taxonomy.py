@@ -72,8 +72,9 @@ from bibfs_tpu.serve.resilience import (
 )
 from bibfs_tpu.serve.routes.base import Route
 
-#: kind -> the Route name serving it (the primary rung; ``host`` is
-#: every kind's fallback rung name in the ladder/fallback counters)
+#: kind -> the Route name serving it (the HOST-tier primary rung; its
+#: ``fallback`` is every kind's terminal answering machinery, and
+#: ``host`` is the terminal rung name in the ladder/fallback counters)
 KIND_ROUTES = {
     "msbfs": "msbfs",
     "weighted": "weighted",
@@ -81,13 +82,28 @@ KIND_ROUTES = {
     "asof": "asof",
 }
 
+#: the per-kind ladder ``QueryEngine._flush_kind`` walks: the device
+#: rung (serve/routes/taxonomy_device.py) ahead of the host-tier kind
+#: rung, ``host`` terminal — an ineligible device rung is skipped
+#: silently (a routing decision), an UNAVAILABLE one (breaker open /
+#: retries burned) degrades with a counted fallback. Per-kind adaptive
+#: policies reorder the non-terminal rungs per graph digest.
+KIND_LADDERS = {
+    "msbfs": ("msbfs_device", "msbfs", "host"),
+    "weighted": ("weighted_device", "weighted", "host"),
+    "kshortest": ("kshortest_device", "kshortest", "host"),
+    "asof": ("asof", "host"),
+}
+
 #: eagerly minted (kind, route) label pairs — the render-at-zero set
 KIND_ROUTE_LABELS = (
     ("pt", "ladder"),
-    ("msbfs", "msbfs"), ("msbfs", "host"), ("msbfs", "cache"),
-    ("weighted", "weighted"), ("weighted", "host"), ("weighted", "cache"),
-    ("kshortest", "kshortest"), ("kshortest", "host"),
-    ("kshortest", "cache"),
+    ("msbfs", "msbfs"), ("msbfs", "msbfs_device"),
+    ("msbfs", "host"), ("msbfs", "cache"),
+    ("weighted", "weighted"), ("weighted", "weighted_device"),
+    ("weighted", "host"), ("weighted", "cache"),
+    ("kshortest", "kshortest"), ("kshortest", "kshortest_device"),
+    ("kshortest", "host"), ("kshortest", "cache"),
     ("asof", "asof"), ("asof", "host"), ("asof", "cache"),
 )
 
@@ -216,6 +232,14 @@ class TaxonomyRoute(Route):
 
     def eligible(self, rt, pairs) -> bool:
         return False  # kind-dispatched, never from the pt ladder
+
+    def kind_eligible(self, rt, queries, ctx) -> bool:
+        """The kind-ladder routing predicate (``_flush_kind`` skips an
+        ineligible rung silently — a routing decision, not a failure).
+        Host-tier kind rungs carry anything; the device rungs
+        (serve/routes/taxonomy_device.py) gate on substrate, snapshot
+        base, layout, and their calibrated crossovers."""
+        return True
 
     def solve(self, rt, queries, ctx=None):
         out, fin, t0 = self.launch(rt, queries, ctx)
@@ -585,10 +609,16 @@ def build_taxonomy_routes(engine, label: str) -> dict:
     """The kind-route set every engine carries (``build_routes`` calls
     this unconditionally — the taxonomy is part of the serving
     contract, not an opt-in), each rung with its OWN retry policy and
-    circuit breaker."""
+    circuit breaker. The device rungs ride along as ladder peers
+    (serve/routes/taxonomy_device.py) — ineligible until the engine
+    routes device at all, so a CPU-substrate engine's behavior is
+    unchanged until it opts in."""
     from bibfs_tpu.serve.resilience import CircuitBreaker, RetryPolicy
+    from bibfs_tpu.serve.routes.taxonomy_device import (
+        build_taxonomy_device_routes,
+    )
 
-    return {
+    routes = {
         "msbfs": MsbfsRoute(
             engine, retry=RetryPolicy(), breaker=CircuitBreaker(),
             label=label,
@@ -603,3 +633,5 @@ def build_taxonomy_routes(engine, label: str) -> dict:
             engine, retry=RetryPolicy(), breaker=CircuitBreaker(),
         ),
     }
+    routes.update(build_taxonomy_device_routes(engine, label))
+    return routes

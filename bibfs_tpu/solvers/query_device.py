@@ -1,0 +1,364 @@
+"""Device-tier solvers for the weighted and k-shortest query kinds.
+
+Two jitted programs, both reusing the serving stack's ELL machinery:
+
+- **delta-stepping** (:func:`delta_stepping_device`): the bucket
+  relaxation loop of :mod:`bibfs_tpu.query.weighted` as ONE
+  ``lax.while_loop`` program — light edges (weight <= delta) relaxed
+  to a fixpoint per bucket, heavy edges once per settled bucket, every
+  relaxation one ELL-wide scatter-min (``dist.at[tgt].min(cand)`` —
+  the segment-min over edge relaxations) instead of the host's
+  per-bucket gather/sort/unique pass. Exact for any positive delta;
+  the s-t early exit (every remaining bucket's floor beyond
+  ``dist[dst]``) matches the host rung's pruning. The kernel returns
+  the distance VECTOR; the path descends host-side over the CSR
+  weights (strictly-decreasing exact sums — integer weights are exact
+  in f32 far beyond any bench graph's diameter).
+- **restricted batch BFS** (:func:`restricted_batch_dists` /
+  :func:`restricted_batch_paths`): one ``[n_pad, B]`` plane solves
+  every spur candidate of a Yen iteration at once — per-candidate
+  node masks ride a blocked plane, per-candidate banned spur edges
+  are folded into the level-1 seeding host-side (every banned edge
+  leaves the spur vertex, so the first hop IS the edge restriction),
+  and each query column freezes the level after its ``dst`` is
+  reached. Paths descend through the SAME canonical min-id rule as
+  the host rung (:func:`bibfs_tpu.query.kshortest.descend_min_id`),
+  so batched k-shortest output is IDENTICAL to host Yen's, not just
+  equal-length.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bibfs_tpu.ops.pallas_expand import _slot_pad, sentinel_transposed_table
+
+#: "unreachable" on the f32 distance line (far above any real path
+#: weight; +w cannot reach another finite value's range)
+F_INF = np.float32(3e38)
+
+#: unreachable in the restricted-BFS int32 planes
+INF32 = 1 << 30
+
+
+# ---- device delta-stepping -------------------------------------------
+
+def _build_delta_kernel(n_pad: int, width: int):
+    """The jitted single-source delta-stepping program for one ELL
+    geometry. Signature ``(tgt, wts, src, dst, delta) -> (dist,
+    buckets, relaxations)``: ``tgt`` int32 ``[n_pad, width]`` neighbor
+    targets with dead slots pointing at the dump row ``n_pad``;
+    ``wts`` f32 ``[n_pad, width]`` with ``+inf`` at dead slots (their
+    candidates never win the scatter-min); ``src``/``dst``/``delta``
+    traced, so one compiled program serves every query and seed of
+    the geometry."""
+
+    def kernel(tgt, wts, src, dst, delta):
+        light = wts <= delta
+        dist0 = jnp.full((n_pad,), F_INF, jnp.float32).at[src].set(0.0)
+
+        def in_bucket(dist, bi):
+            return (dist >= bi * delta) & (dist < (bi + 1) * delta)
+
+        def relax(dist, frontier, sel):
+            """One ELL-wide relaxation pass from ``frontier`` over the
+            ``sel`` edge class, formulated as a PULL: the graph is
+            undirected and the weight hash symmetric, so every vertex
+            can gather ``dist[nbr] + w`` over its own row and take the
+            row min — the segment-min over edge relaxations as pure
+            contiguous gathers (the scatter-min formulation lowers to
+            element-at-a-time loops on CPU; measured ~20x slower)."""
+            fr_p = jnp.concatenate(
+                [frontier, jnp.zeros((1,), bool)]
+            )  # dump-row slot: never a frontier source
+            d_p = jnp.concatenate([dist, jnp.full((1,), F_INF)])
+            cand = jnp.where(
+                fr_p[tgt] & sel, d_p[tgt] + wts, F_INF
+            )
+            nd = jnp.minimum(dist, jnp.min(cand, axis=1))
+            return nd, jnp.sum(cand < F_INF)
+
+        def outer_cond(st):
+            dist, bi, _buckets, _relaxed = st
+            pending = jnp.any((dist < F_INF) & (dist >= bi * delta))
+            # dst settled: every remaining vertex is provably farther
+            return pending & (dist[dst] >= bi * delta)
+
+        def outer_body(st):
+            dist, bi, buckets, relaxed = st
+
+            def light_cond(s):
+                return s[1]
+
+            def light_body(s):
+                d, _changed, rel = s
+                nd, cnt = relax(d, in_bucket(d, bi), light)
+                return nd, jnp.any(nd < d), rel + cnt
+
+            # light fixpoint: reinsertions within the bucket re-relax
+            # (members can only be ADDED — dist never drops below the
+            # bucket floor under light relaxation from inside it)
+            dist, _c, relaxed = jax.lax.while_loop(
+                light_cond, light_body, (dist, True, relaxed)
+            )
+            settled = in_bucket(dist, bi)
+            had = jnp.any(settled)
+            # heavy phase: once, from everything the bucket settled
+            dist, cnt = relax(dist, settled, ~light)
+            return (
+                dist, bi + 1,
+                buckets + had.astype(jnp.int32), relaxed + cnt,
+            )
+
+        dist, _bi, buckets, relaxed = jax.lax.while_loop(
+            outer_cond, outer_body,
+            (dist0, jnp.int32(0), jnp.int32(0), jnp.int32(0)),
+        )
+        return dist, buckets, relaxed
+
+    return kernel
+
+
+@lru_cache(maxsize=None)
+def _get_delta_kernel(n_pad: int, width: int):
+    return jax.jit(_build_delta_kernel(n_pad, width))
+
+
+def delta_tables(ell, seed: int):
+    """The device relaxation tables for one (ELL, seed): masked
+    targets (dead slots -> the dump row) and the ELL-aligned derived
+    weights (:func:`bibfs_tpu.query.weighted.ell_weights` — the same
+    hash the CSR derivation uses). Uploaded once and memoized per
+    runtime by the serving layer."""
+    from bibfs_tpu.query.weighted import ell_weights
+
+    alive = (
+        np.arange(ell.width, dtype=np.int64)[None, :]
+        < ell.deg[:, None]
+    )
+    tgt = np.where(alive, ell.nbr.astype(np.int32), np.int32(ell.n_pad))
+    wts = ell_weights(ell.nbr, ell.deg, seed)
+    return jnp.asarray(tgt), jnp.asarray(wts)
+
+
+def delta_stepping_device(n: int, row_ptr, col_ind, weights, tables,
+                          src: int, dst: int, *,
+                          delta: float | None = None):
+    """Exact single-source shortest path to ``dst`` on the device tier
+    (module docstring). ``weights`` is the CSR-aligned float64
+    derivation (the path-descent truth and the delta default);
+    ``tables`` the uploaded ``(tgt, wts)`` pair from
+    :func:`delta_tables`. Returns a
+    :class:`~bibfs_tpu.query.types.WeightedResult` matching the host
+    rung's ``found``/``dist``/path-validity contract."""
+    import time
+
+    from bibfs_tpu.query.types import WeightedResult
+
+    t0 = time.perf_counter()
+    src, dst = int(src), int(dst)
+    if delta is None:
+        delta = float(weights.mean()) if weights.size else 1.0
+    delta = float(delta)
+    if delta <= 0:
+        raise ValueError(f"delta must be > 0, got {delta}")
+    tgt, wts = tables
+    n_pad = int(tgt.shape[0])
+    kern = _get_delta_kernel(n_pad, int(tgt.shape[1]))
+    dist, buckets, relaxed = jax.block_until_ready(kern(
+        tgt, wts, jnp.int32(src), jnp.int32(dst),
+        jnp.float32(delta),
+    ))
+    dval = float(np.asarray(dist)[dst])
+    found = dval < float(F_INF) / 2
+    path = None
+    if found:
+        path = _descend_weighted(
+            np.asarray(dist), row_ptr, col_ind, weights, src, dst
+        )
+    return WeightedResult(
+        found=found,
+        dist=dval if found else None,
+        hops=len(path) - 1 if found else None,
+        path=path,
+        time_s=time.perf_counter() - t0,
+        relaxations=int(relaxed),
+        buckets=int(buckets),
+    )
+
+
+def _descend_weighted(dist, row_ptr, col_ind, weights, src, dst):
+    """A shortest weighted path off the distance vector: from ``dst``,
+    step to the lowest-CSR-position neighbor whose distance plus the
+    edge weight lands exactly on ours (integer weights: the f32 sums
+    are exact, the float64 CSR weights agree bit-for-bit)."""
+    path = [dst]
+    cur = dst
+    while cur != src:
+        lo, hi = int(row_ptr[cur]), int(row_ptr[cur + 1])
+        row = col_ind[lo:hi]
+        cand = dist[row] + weights[lo:hi].astype(np.float32)
+        step = np.flatnonzero(
+            np.isclose(cand, dist[cur], rtol=0.0, atol=1e-3)
+        )
+        if step.size == 0:  # cannot happen on a consistent vector
+            return None
+        cur = int(row[step[0]])
+        path.append(cur)
+    path.reverse()
+    return path
+
+
+# ---- batched restricted BFS (Yen spur candidates) --------------------
+
+def _pad_candidates(b: int) -> int:
+    """Candidate columns padded to sublane groups (a Yen iteration has
+    path-length many candidates — pow2 rungs keep the compiled-program
+    ladder bounded without 128-lane waste on short paths)."""
+    b = max(8, int(b))
+    return 1 << (b - 1).bit_length()
+
+
+def _build_restricted_kernel(n_pad2: int, wp: int, tc: int, b: int):
+    """The jitted batched restricted BFS for one padded geometry.
+    Signature ``(nbr, deg, seed_dist, blocked, dsts) -> dist``:
+    ``seed_dist`` int32 ``[n_pad2, b]`` carries level 0 (the spur) and
+    the ALLOWED level-1 frontier per candidate (banned spur edges
+    already folded out host-side); ``blocked`` int8 marks each
+    candidate's banned nodes; every column freezes after the level
+    that reaches its ``dst`` completes, so all distances ``<=
+    dist[dst]`` are final — exactly what the canonical descent
+    reads."""
+    num_chunks = n_pad2 // tc
+
+    def kernel(nbr, deg, seed_dist, blocked, dsts):
+        nbr_t = sentinel_transposed_table(nbr, deg, n_pad2, n_pad2, wp)
+        qi = jnp.arange(b, dtype=jnp.int32)
+        frontier0 = (seed_dist == 1).astype(jnp.int8)
+
+        def cond(st):
+            return st[3]
+
+        def body(st):
+            dist, frontier, level, _go = st
+            level = level + 1
+            # per-candidate freeze: once dst is stamped the column
+            # stops discovering (its plane below dst's level is final)
+            act = (dist[dsts, qi] >= INF32).astype(jnp.int8)
+            fr_p = jnp.concatenate(
+                [frontier, jnp.zeros((1, b), jnp.int8)]
+            )  # sentinel index n_pad2 reads the zero dump row
+
+            def chunk(carry, c):
+                dist_c2, newf, cnt = carry
+                r0 = c * tc
+                nbr_c = jax.lax.dynamic_slice(nbr_t, (0, r0), (wp, tc))
+                anyh = fr_p[nbr_c[0]]
+                for i in range(1, wp):
+                    anyh = anyh | fr_p[nbr_c[i]]
+                d_c = jax.lax.dynamic_slice(dist, (r0, 0), (tc, b))
+                blk_c = jax.lax.dynamic_slice(blocked, (r0, 0), (tc, b))
+                nf = jnp.where(
+                    (d_c >= INF32) & (blk_c == 0), anyh, 0
+                ) * act[None, :]
+                d2 = jnp.where(nf > 0, level, d_c)
+                return (
+                    jax.lax.dynamic_update_slice(dist_c2, d2, (r0, 0)),
+                    jax.lax.dynamic_update_slice(newf, nf, (r0, 0)),
+                    cnt + jnp.sum(nf.astype(jnp.int32), axis=0),
+                ), None
+
+            (dist, newf, cnt), _ = jax.lax.scan(
+                chunk,
+                (dist, jnp.zeros((n_pad2, b), jnp.int8),
+                 jnp.zeros((b,), jnp.int32)),
+                jnp.arange(num_chunks, dtype=jnp.int32),
+            )
+            return dist, newf, level, jnp.any(cnt > 0)
+
+        st = (seed_dist, frontier0, jnp.int32(1),
+              jnp.any(frontier0 > 0))
+        dist, _f, _lvl, _go = jax.lax.while_loop(cond, body, st)
+        return dist
+
+    return kernel
+
+
+@lru_cache(maxsize=None)
+def _get_restricted_kernel(n_pad2: int, wp: int, tc: int, b: int):
+    return jax.jit(_build_restricted_kernel(n_pad2, wp, tc, b))
+
+
+def restricted_batch_dists(g, row_ptr, col_ind, dst: int, cands):
+    """Solve one Yen iteration's spur candidates as ONE batched device
+    program over the uploaded serving table ``g``
+    (:class:`~bibfs_tpu.solvers.dense.DeviceGraph`, plain ELL).
+    ``cands`` is the ``(spur, banned_nodes set, banned_edges set)``
+    list the host solver takes; returns the int32 ``[n, B]`` restricted
+    distance planes (INF32 = unreached)."""
+    from bibfs_tpu.query.kshortest import first_hops
+
+    if getattr(g, "tier_meta", ()):
+        raise ValueError("batched restricted BFS is plain-ELL only")
+    b_pad = _pad_candidates(len(cands))
+    wp = _slot_pad(g.width)
+    # the per-chunk gathered block is [wp, tc, b] int8 — reuse the
+    # msbfs budget discipline at the int8 itemsize
+    from bibfs_tpu.ops.msbfs_device import MSBFS_CHUNK_BUDGET_BYTES
+
+    raw = MSBFS_CHUNK_BUDGET_BYTES // max(wp * b_pad, 1)
+    tc = int(max(8, min(g.n_pad, (raw // 8) * 8)))
+    n_pad2 = -(-g.n_pad // tc) * tc
+    seed = np.full((n_pad2, b_pad), INF32, dtype=np.int32)
+    blocked = np.zeros((n_pad2, b_pad), dtype=np.int8)
+    dsts = np.zeros(b_pad, dtype=np.int32)
+    mask = np.zeros(g.n, dtype=bool)
+    for j, (spur, banned_nodes, banned_edges) in enumerate(cands):
+        spur = int(spur)
+        mask[:] = False
+        for v in banned_nodes:
+            mask[int(v)] = True
+        rows = np.fromiter(
+            (int(v) for v in banned_nodes), dtype=np.int64,
+            count=len(banned_nodes),
+        )
+        blocked[rows, j] = 1
+        seed[spur, j] = 0
+        hops = first_hops(
+            row_ptr, col_ind, spur,
+            banned_mask=mask, banned_edges=banned_edges,
+        )
+        seed[hops, j] = np.minimum(seed[hops, j], 1)
+        dsts[j] = int(dst)
+    kern = _get_restricted_kernel(n_pad2, wp, tc, b_pad)
+    dist = jax.block_until_ready(kern(
+        g.nbr, g.deg, jnp.asarray(seed), jnp.asarray(blocked),
+        jnp.asarray(dsts),
+    ))
+    return np.asarray(dist)[: g.n, : len(cands)]
+
+
+def restricted_batch_paths(g, n, row_ptr, col_ind, dst: int, cands):
+    """The device ``spur_batch`` for
+    :func:`bibfs_tpu.query.kshortest.yen_k_shortest`: batched
+    restricted distance planes + the canonical min-id descent — one
+    tail-path-or-None per candidate, IDENTICAL to the host solver's
+    answers."""
+    from bibfs_tpu.query.kshortest import descend_min_id
+
+    if not cands:
+        return []
+    planes = restricted_batch_dists(g, row_ptr, col_ind, dst, cands)
+    out = []
+    for j, (spur, _bn, banned_edges) in enumerate(cands):
+        col = planes[:, j]
+        dist = np.where(col >= INF32, np.int32(-1), col)
+        out.append(descend_min_id(
+            row_ptr, col_ind, dist, spur, dst,
+            banned_edges=banned_edges,
+        ))
+    return out

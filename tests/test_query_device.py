@@ -1,0 +1,457 @@
+"""Device-tier query taxonomy: msBFS sweep kernels, device
+delta-stepping, batched restricted solves, the oracle build routing,
+and the serving rungs (exactness, hot-swap, fault degrade)."""
+
+import numpy as np
+import pytest
+
+from bibfs_tpu.graph.csr import build_csr, build_ell
+from bibfs_tpu.graph.generate import gnp_random_graph, grid_graph
+from bibfs_tpu.obs.metrics import REGISTRY
+from bibfs_tpu.oracle import trees
+from bibfs_tpu.ops import msbfs_device
+from bibfs_tpu.query import KShortest, MultiSource, PointToPoint, Weighted
+from bibfs_tpu.query.kshortest import yen_k_shortest
+from bibfs_tpu.query.weighted import (
+    delta_stepping,
+    dijkstra_numpy,
+    ell_weights,
+    path_weight,
+    synthetic_weights,
+)
+from bibfs_tpu.serve import PipelinedQueryEngine, QueryEngine
+from bibfs_tpu.serve.faults import FaultPlan
+from bibfs_tpu.serve.resilience import QueryError
+from bibfs_tpu.solvers.dense import DeviceGraph
+from bibfs_tpu.solvers.query_device import (
+    delta_stepping_device,
+    delta_tables,
+    restricted_batch_paths,
+)
+from bibfs_tpu.solvers.serial import solve_serial_csr
+from bibfs_tpu.store import GraphStore
+
+
+def _graphs():
+    return [
+        ("gnp", 300, gnp_random_graph(300, 8 / 300, seed=2)),
+        ("grid", 48, grid_graph(6, 8)),
+        ("subcritical", 200, gnp_random_graph(200, 1.5 / 200, seed=7)),
+    ]
+
+
+# ---- msBFS kernels ---------------------------------------------------
+@pytest.mark.parametrize("name,n,edges", _graphs())
+@pytest.mark.parametrize("k", [1, 5, 64, 65, 128])
+def test_msbfs_device_matches_host_sweep(name, n, edges, k):
+    """The jitted ELL sweep is bit-equal to the NumPy packed sweep —
+    including multi-word masks (K = 65/128 exercise the high words)."""
+    rp, ci = build_csr(n, edges)
+    srcs = np.random.default_rng(k).choice(n, size=min(k, n),
+                                           replace=False)
+    host = trees.multi_source_bfs(n, rp, ci, srcs)
+    dev = msbfs_device.msbfs_plane_csr(n, rp, ci, srcs)
+    assert dev.dtype == host.dtype and dev.shape == host.shape
+    assert (host == dev).all()
+
+
+@pytest.mark.parametrize("k", [5, 64, 70])
+def test_msbfs_blocked_variant_matches_host_sweep(k):
+    """The blocked-matmul variant (frontier plane = the K-column
+    bitmask) agrees with the host sweep too."""
+    from bibfs_tpu.graph.blocked import build_blocked
+    from bibfs_tpu.solvers.dense import BlockedDeviceGraph
+
+    n = 256
+    edges = gnp_random_graph(n, 10 / n, seed=3)
+    rp, ci = build_csr(n, edges)
+    bg = BlockedDeviceGraph.from_host(build_blocked(n, edges))
+    srcs = np.random.default_rng(k).choice(n, size=k, replace=False)
+    host = trees.multi_source_bfs(n, rp, ci, srcs)
+    assert (host == msbfs_device.msbfs_plane_blocked(bg, srcs)).all()
+
+
+def test_msbfs_device_rejects_tiered_and_bad_sources():
+    n = 64
+    edges = grid_graph(8, 8)
+    rp, ci = build_csr(n, edges)
+    with pytest.raises(ValueError):
+        msbfs_device.msbfs_plane_csr(n, rp, ci, [n + 3])
+
+    class _Tiered:
+        tier_meta = ((0, 1, 8),)
+        n = 64
+
+    with pytest.raises(ValueError):
+        msbfs_device.msbfs_plane_graph(_Tiered(), [1])
+
+
+# ---- oracle build routing --------------------------------------------
+def test_multi_source_dist_routes_device_and_falls_back(monkeypatch):
+    """Forced device routing runs the kernel (sweep counter moves,
+    output exact); a broken device kernel falls back to the host sweep
+    — the build path degrades, never dies."""
+    n = 200
+    edges = gnp_random_graph(n, 6 / n, seed=1)
+    rp, ci = build_csr(n, edges)
+    srcs = np.arange(24, dtype=np.int64) * 7 % n
+    host = trees.multi_source_bfs(n, rp, ci, srcs)
+    before = msbfs_device.sweeps_run()
+    routed = trees.multi_source_dist(n, rp, ci, srcs, device=True)
+    assert msbfs_device.sweeps_run() == before + 1
+    assert (routed == host).all()
+    # explicit host routing never touches the kernel
+    routed = trees.multi_source_dist(n, rp, ci, srcs, device=False)
+    assert msbfs_device.sweeps_run() == before + 1
+    assert (routed == host).all()
+
+    def _boom(*a, **k):
+        raise RuntimeError("device stack down")
+
+    monkeypatch.setattr(msbfs_device, "msbfs_plane_csr", _boom)
+    routed = trees.multi_source_dist(n, rp, ci, srcs, device=True)
+    assert (routed == host).all()
+
+
+def test_oracle_index_build_routes_device(monkeypatch):
+    """``build_index`` (the store's rebuild primitive) and the
+    landmark selection chunks ride the routed sweep: with the device
+    tier forced on (the dryrun stand-in for an accelerator substrate)
+    the whole K x n index comes off the device kernel and equals the
+    host-tier build bit-for-bit."""
+    n = 300
+    edges = gnp_random_graph(n, 8 / n, seed=9)
+    rp, ci = build_csr(n, edges)
+    host_idx = trees.build_index(n, rp, ci, 16)
+    monkeypatch.setenv("BIBFS_MSBFS_DEVICE", "1")
+    before = msbfs_device.sweeps_run()
+    dev_idx = trees.build_index(n, rp, ci, 16)
+    assert msbfs_device.sweeps_run() > before
+    assert (dev_idx.landmarks == host_idx.landmarks).all()
+    assert (dev_idx.dist == host_idx.dist).all()
+    monkeypatch.setenv("BIBFS_MSBFS_DEVICE", "0")
+    before = msbfs_device.sweeps_run()
+    off_idx = trees.build_index(n, rp, ci, 16)
+    assert msbfs_device.sweeps_run() == before
+    assert (off_idx.dist == host_idx.dist).all()
+
+
+# ---- device delta-stepping -------------------------------------------
+@pytest.mark.parametrize("name,n,edges", _graphs())
+def test_delta_device_exact_vs_dijkstra(name, n, edges):
+    rp, ci = build_csr(n, edges)
+    w = synthetic_weights(rp, ci, 3)
+    tables = delta_tables(build_ell(n, edges), 3)
+    rng = np.random.default_rng(5)
+    for _ in range(10):
+        s, d = (int(x) for x in rng.integers(0, n, 2))
+        res = delta_stepping_device(n, rp, ci, w, tables, s, d)
+        ref, _par = dijkstra_numpy(n, rp, ci, w, s, d)
+        want = ref[d]
+        assert res.found == bool(np.isfinite(want))
+        host = delta_stepping(n, rp, ci, w, s, d)
+        assert res.found == host.found
+        if res.found:
+            assert abs(res.dist - float(want)) < 1e-9
+            assert res.path[0] == s and res.path[-1] == d
+            assert abs(path_weight(rp, ci, w, res.path) - res.dist) < 1e-9
+            assert len(res.path) == len(set(res.path))
+
+
+def test_ell_weights_match_csr_derivation():
+    """The ELL-aligned derivation weighs every live slot exactly like
+    the CSR derivation (same hash, same canonical pair), dead slots
+    +inf."""
+    n = 120
+    edges = gnp_random_graph(n, 7 / n, seed=4)
+    rp, ci = build_csr(n, edges)
+    ell = build_ell(n, edges)
+    w_csr = synthetic_weights(rp, ci, 11)
+    w_ell = ell_weights(ell.nbr, ell.deg, 11)
+    for v in range(n):
+        lo, hi = int(rp[v]), int(rp[v + 1])
+        row = ci[lo:hi]
+        for j, u in enumerate(row):
+            col = int(np.flatnonzero(ell.nbr[v, : ell.deg[v]] == u)[0])
+            assert w_ell[v, col] == np.float32(w_csr[lo + j])
+    dead = np.arange(ell.width)[None, :] >= ell.deg[:, None]
+    assert np.isinf(w_ell[dead]).all()
+
+
+# ---- batched k-shortest ----------------------------------------------
+@pytest.mark.parametrize("name,n,edges", _graphs())
+def test_kshortest_batched_identical_to_host(name, n, edges):
+    """Device-batched Yen's output is IDENTICAL to host Yen's — same
+    paths edge-for-edge, not just equal lengths (the shared canonical
+    descent)."""
+    rp, ci = build_csr(n, edges)
+    g = DeviceGraph.from_ell(build_ell(n, edges))
+    rng = np.random.default_rng(13)
+    for _ in range(6):
+        s, d = (int(x) for x in rng.integers(0, n, 2))
+        if s == d:
+            continue
+        host = yen_k_shortest(n, rp, ci, s, d, 4)
+
+        def spur_batch(cands, _d=d):
+            return restricted_batch_paths(g, n, rp, ci, _d, cands)
+
+        dev = yen_k_shortest(n, rp, ci, s, d, 4, spur_batch=spur_batch)
+        assert host.paths == dev.paths
+        assert host.hops == dev.hops
+        assert host.found == dev.found
+
+
+# ---- serving rungs ---------------------------------------------------
+def _force_device_rungs(eng):
+    """Pin the device rungs ON regardless of what a bench soak banked
+    in calibration.json — these tests assert rung behavior, not the
+    box's measured crossovers."""
+    eng.routes["msbfs_device"].min_sources = 1
+    eng.routes["weighted_device"].min_batch = 1
+    eng.routes["kshortest_device"].min_k = 2
+    return eng
+
+
+def _mixed_queries(n, rng, sources):
+    return (
+        [MultiSource(sources, int(rng.integers(n))) for _ in range(4)]
+        + [Weighted(int(rng.integers(n)), int(rng.integers(n)),
+                    weight_seed=2) for _ in range(4)]
+        + [KShortest(int(rng.integers(n)), int(rng.integers(n)), k=3)
+           for _ in range(4)]
+    )
+
+
+def _assert_same_answers(qs, host, dev):
+    for q, a, b in zip(qs, host, dev):
+        assert not isinstance(a, QueryError)
+        assert not isinstance(b, QueryError)
+        if q.kind == "msbfs":
+            assert a.per_source == b.per_source and a.hops == b.hops
+        elif q.kind == "weighted":
+            assert (a.found, a.dist) == (b.found, b.dist)
+        else:
+            assert a.paths == b.paths and a.hops == b.hops
+
+
+def test_engine_device_rungs_exact_and_counted():
+    """A device-routing engine answers every kind exactly like the
+    host-tier twin, the ``bibfs_query_total`` device cells count the
+    traffic, and device executables land under placement-distinct
+    keys."""
+    n = 400
+    edges = gnp_random_graph(n, 7 / n, seed=4)
+    rng = np.random.default_rng(0)
+    sources = tuple(
+        int(x) for x in rng.choice(n, size=16, replace=False)
+    )
+    qs = _mixed_queries(n, rng, sources)
+    host_eng = QueryEngine(n, edges)
+    dev_eng = _force_device_rungs(
+        QueryEngine(n, edges, device_batches=True)
+    )
+    host = host_eng.query_many(list(qs), return_errors=True)
+    dev = dev_eng.query_many(list(qs), return_errors=True)
+    _assert_same_answers(qs, host, dev)
+    kinds = dev_eng.stats()["query_kinds"]
+    assert kinds["msbfs"].get("msbfs_device", 0) == 4
+    assert kinds["weighted"].get("weighted_device", 0) == 4
+    assert kinds["kshortest"].get("kshortest_device", 0) == 4
+    hk = host_eng.stats()["query_kinds"]
+    assert "msbfs_device" not in hk["msbfs"]  # host twin stayed host
+    host_eng.close()
+    dev_eng.close()
+
+
+def test_pipelined_engine_device_rungs_exact():
+    n = 300
+    edges = gnp_random_graph(n, 7 / n, seed=6)
+    rng = np.random.default_rng(2)
+    sources = tuple(
+        int(x) for x in rng.choice(n, size=12, replace=False)
+    )
+    qs = _mixed_queries(n, rng, sources)
+    host_eng = QueryEngine(n, edges)
+    dev_eng = _force_device_rungs(
+        PipelinedQueryEngine(n, edges, device_batches=True)
+    )
+    host = host_eng.query_many(list(qs), return_errors=True)
+    dev = dev_eng.query_many(list(qs), return_errors=True)
+    _assert_same_answers(qs, host, dev)
+    kinds = dev_eng.stats()["query_kinds"]
+    assert kinds["msbfs"].get("msbfs_device", 0) == 4
+    host_eng.close()
+    dev_eng.close()
+
+
+def test_device_rung_crossover_stands_aside():
+    """Below the calibrated source crossover the msbfs device rung is
+    a routing decision, not a fallback: the host kind rung serves and
+    no fallback is counted."""
+    n = 200
+    edges = gnp_random_graph(n, 6 / n, seed=8)
+    eng = _force_device_rungs(QueryEngine(n, edges, device_batches=True))
+    eng.routes["msbfs_device"].min_sources = 64
+    res = eng.query_one(MultiSource((1, 2, 3), 9))
+    ref = solve_serial_csr(n, *build_csr(n, edges), 1, 9)
+    assert res.per_source[0] == (ref.hops if ref.found else None)
+    kinds = eng.stats()["query_kinds"]
+    assert kinds["msbfs"] == {"msbfs": 1}
+    assert all(v == 0 for v in
+               eng.stats()["resilience"]["fallbacks"].values())
+    eng.close()
+
+
+def test_overlay_pending_keeps_host_rungs():
+    """While live updates are pending the flush truth is the
+    overlay-merged CSR — no device table describes it, so the device
+    rungs stand aside and answers stay exact on the live edge set."""
+    n = 64
+    edges = grid_graph(8, 8)
+    store = GraphStore()
+    store.add("g", n, edges)
+    eng = _force_device_rungs(
+        QueryEngine(store=store, graph="g", device_batches=True)
+    )
+    store.update("g", adds=[(0, 63)])
+    res = eng.query_one(MultiSource((0,), 63))
+    assert res.hops == 1  # the pending edge answered exactly
+    kinds = eng.stats()["query_kinds"]
+    assert "msbfs_device" not in kinds.get("msbfs", {})
+    eng.close()
+    store.close()
+
+
+@pytest.mark.parametrize("site,make_q", [
+    ("msbfs_device",
+     lambda n, rng: MultiSource(
+         tuple(int(x) for x in rng.choice(n, 12, replace=False)),
+         int(rng.integers(n)))),
+    ("weighted_device",
+     lambda n, rng: Weighted(int(rng.integers(n)), int(rng.integers(n)),
+                             weight_seed=1)),
+    ("kshortest_device",
+     lambda n, rng: KShortest(int(rng.integers(n)),
+                              int(rng.integers(n)), k=2)),
+])
+def test_device_rung_fault_degrades_to_host_rung(site, make_q):
+    """A faulted device rung degrades to the existing host kind rung
+    with zero lost tickets: every query answers exactly, the fallback
+    is counted ``{from=<kind>_device, to=<kind>}``, and enough
+    consecutive failures drive the rung's breaker gauge to 2 (open)
+    while the kind keeps serving."""
+    n = 200
+    edges = gnp_random_graph(n, 7 / n, seed=3)
+    rp, ci = build_csr(n, edges)
+    kind = site[: -len("_device")]
+    plan = FaultPlan.parse(f"{site}:times=50", seed=0)
+    eng = _force_device_rungs(
+        QueryEngine(n, edges, device_batches=True, faults=plan)
+    )
+    rng = np.random.default_rng(4)
+    host_eng = QueryEngine(n, edges)
+    for _ in range(4):
+        q = make_q(n, rng)
+        res = eng.query_one(q)
+        ref = host_eng.query_one(q)
+        assert not isinstance(res, QueryError)
+        if kind == "msbfs":
+            assert res.per_source == ref.per_source
+        elif kind == "weighted":
+            assert (res.found, res.dist) == (ref.found, ref.dist)
+        else:
+            assert res.paths == ref.paths
+    st = eng.stats()
+    assert st["resilience"]["fallbacks"].get(f"{site}->{kind}", 0) >= 4
+    kinds = st["query_kinds"]
+    assert kinds[kind].get(kind, 0) == 4  # host rung served them all
+    render = REGISTRY.render()
+    assert (
+        f'bibfs_query_device_breaker_state{{engine="{eng.obs_label}"'
+        f',kind="{kind}"}} 2' in render
+    )
+    eng.close()
+    host_eng.close()
+
+
+def test_device_rungs_exact_across_hot_swap(tmp_path):
+    """Mid-traffic hot-swap: device-rung answers are exact against the
+    edge set of the snapshot each flush bound — before AND after a
+    store roll (the device tables rebuild through the swap barrier
+    like every other device table)."""
+    n = 150
+    edges = gnp_random_graph(n, 7 / n, seed=5)
+    store = GraphStore(wal_dir=str(tmp_path))
+    store.add("g", n, edges)
+    eng = _force_device_rungs(
+        QueryEngine(store=store, graph="g", device_batches=True)
+    )
+    rng = np.random.default_rng(7)
+    sources = tuple(
+        int(x) for x in rng.choice(n, size=12, replace=False)
+    )
+
+    def check(csr):
+        for _ in range(3):
+            d = int(rng.integers(n))
+            res = eng.query_one(MultiSource(sources, d))
+            for s, hops in zip(sources, res.per_source):
+                ref = solve_serial_csr(n, *csr, int(s), d)
+                assert hops == (ref.hops if ref.found else None)
+            wq = Weighted(int(rng.integers(n)), d, weight_seed=3)
+            wres = eng.query_one(wq)
+            w = synthetic_weights(*csr, 3)
+            dist, _ = dijkstra_numpy(n, *csr, w, wq.src, wq.dst)
+            assert wres.found == bool(np.isfinite(dist[wq.dst]))
+            if wres.found:
+                assert abs(wres.dist - float(dist[wq.dst])) < 1e-9
+
+    v1 = store.current("g")
+    check(v1.csr())
+    adds = [(int(a), int(b)) for a, b in
+            [(0, n - 1), (1, n - 2), (2, n - 3)]]
+    store.roll("g", adds=adds, dels=[])
+    v2 = store.current("g")
+    assert v2.version > v1.version
+    check(v2.csr())
+    kinds = eng.stats()["query_kinds"]
+    assert kinds["msbfs"].get("msbfs_device", 0) >= 6
+    assert kinds["weighted"].get("weighted_device", 0) >= 6
+    eng.close()
+    store.close()
+
+
+def test_placement_keys_distinct_per_device_kind():
+    """msbfs/weighted/kshortest device programs note placement-keyed
+    executables that can never collide with each other or the pt
+    device route's keys."""
+    n = 200
+    edges = gnp_random_graph(n, 7 / n, seed=2)
+    rng = np.random.default_rng(3)
+    eng = _force_device_rungs(QueryEngine(n, edges, device_batches=True))
+    sources = tuple(
+        int(x) for x in rng.choice(n, size=12, replace=False)
+    )
+    eng.query_one(MultiSource(sources, 5))
+    eng.query_one(Weighted(1, 9, weight_seed=0))
+    eng.query_one(KShortest(2, 11, k=2))
+    keys = list(eng.exec_cache.program_counts())  # stringified keys
+    for placement in ("msbfs_device", "weighted_device",
+                      "kshortest_device"):
+        assert any(placement in k for k in keys), (placement, keys)
+    assert len(keys) == len(set(keys))  # no cross-kind collisions
+    eng.close()
+
+
+def test_query_device_breaker_family_renders_at_zero():
+    n = 64
+    eng = QueryEngine(n, grid_graph(8, 8))
+    render = REGISTRY.render()
+    assert "bibfs_query_device_breaker_state" in render
+    for kind in ("msbfs", "weighted", "kshortest"):
+        assert (
+            f'bibfs_query_device_breaker_state{{engine="{eng.obs_label}"'
+            f',kind="{kind}"}} 0' in render
+        )
+    eng.close()

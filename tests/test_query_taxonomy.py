@@ -171,6 +171,84 @@ def test_msbfs_shared_sweep_across_queries():
         assert r.per_source[0] == (ref.hops if ref.found else None)
 
 
+@pytest.mark.parametrize("k", [65, 128])
+def test_msbfs_multiword_masks_match_serial(k):
+    """The K > 64 multi-word case: one packed sweep over 65/128
+    distinct sources (two mask words — the HIGH word carries searches
+    64+) equals per-source serial BFS on every (source, dst) cell, and
+    the vectorized level unpack stamps the high-word searches'
+    distances correctly."""
+    from bibfs_tpu.oracle.trees import multi_source_bfs
+
+    n = 200
+    edges = gnp_random_graph(n, 6.0 / n, seed=21)
+    row_ptr, col_ind = build_csr(n, edges)
+    rng = np.random.default_rng(k)
+    sources = tuple(
+        int(x) for x in rng.choice(n, size=k, replace=False)
+    )
+    # the raw sweep: every column (high words included) vs serial
+    plane = multi_source_bfs(
+        n, row_ptr, col_ind, np.asarray(sources, dtype=np.int64)
+    )
+    for j in (0, 63, 64, k - 1):  # both sides of the word boundary
+        for v in (0, n // 2, n - 1):
+            ref = solve_serial_csr(n, row_ptr, col_ind, sources[j], v)
+            want = ref.hops if ref.found else -1
+            assert int(plane[v, j]) == want, (k, j, v)
+    # the query route: one MultiSource query carrying every source
+    # rides ONE multi-word sweep (sweeps stays in 64-source units)
+    dst = int(rng.integers(n))
+    [res] = solve_multi_source(
+        n, row_ptr, col_ind, [MultiSource(sources, dst)]
+    )
+    assert res.sweeps == -(-k // 64)
+    for s, hops in zip(sources, res.per_source):
+        ref = solve_serial_csr(n, row_ptr, col_ind, s, dst)
+        assert hops == (ref.hops if ref.found else None), (k, s, dst)
+    if res.found:
+        assert validate_path(
+            (row_ptr, col_ind), res.path, res.path[0], dst,
+            hops=res.hops,
+        )
+
+
+def test_msbfs_duplicate_sources_in_shared_tuple():
+    """validate() allows duplicate sources; the shared-source fast
+    path must not misindex the deduped plane (regression: positional
+    indexing read past it)."""
+    n = 90
+    edges = gnp_random_graph(n, 4.0 / n, seed=3)
+    row_ptr, col_ind = build_csr(n, edges)
+    qs = [MultiSource((1, 1, 3), 40), MultiSource((1, 1, 3), 50)]
+    results = solve_multi_source(n, row_ptr, col_ind, qs)
+    for q, res in zip(qs, results):
+        for s, hops in zip(q.sources, res.per_source):
+            ref = solve_serial_csr(n, row_ptr, col_ind, int(s), q.dst)
+            assert hops == (ref.hops if ref.found else None)
+
+
+def test_bfs_restricted_honors_non_src_banned_edges():
+    """General banned edges (not leaving src) are honored by the
+    PATH, not just the distance vector (regression: the canonical
+    descent stepped through a banned mid-path edge)."""
+    from bibfs_tpu.query.kshortest import bfs_restricted
+
+    # diamond: 0-1, 0-2, 1-3, 2-3; ban the (1, 3) edge
+    n = 4
+    edges = np.array([[0, 1], [0, 2], [1, 3], [2, 3]])
+    row_ptr, col_ind = build_csr(n, edges)
+    path = bfs_restricted(
+        n, row_ptr, col_ind, 0, 3, banned_edges={(1, 3)}
+    )
+    assert path == [0, 2, 3]
+    # both directions banned on the upper arm: only the lower remains
+    path = bfs_restricted(
+        n, row_ptr, col_ind, 0, 3, banned_edges={(0, 2), (1, 3)}
+    )
+    assert path is None
+
+
 def test_path_from_dist_descends_gradient():
     from bibfs_tpu.oracle.trees import multi_source_bfs
 

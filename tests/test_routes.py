@@ -57,8 +57,12 @@ def test_route_registry_and_ladder_default():
     assert set(eng.routes) == {"oracle", "overlay", "device", "host",
                                "serial",
                                # the taxonomy kind routes ride every
-                               # engine (serve/routes/taxonomy.py)
-                               "msbfs", "weighted", "kshortest", "asof"}
+                               # engine (serve/routes/taxonomy.py),
+                               # device rungs included
+                               # (serve/routes/taxonomy_device.py)
+                               "msbfs", "weighted", "kshortest", "asof",
+                               "msbfs_device", "weighted_device",
+                               "kshortest_device"}
     assert eng._ladder == ("device", "host")
     st = eng.stats()
     assert st["ladder"] == ["device", "host"]
