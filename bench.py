@@ -1233,6 +1233,7 @@ def serve_update_main():
             "max_latency_ms": out["max_latency_ms"],
             "zero_recompiles": out["zero_recompiles"],
             "recompiles": out["exec"]["recompiles_during_churn"],
+            "compile_events": out["exec"]["compile_events_during_churn"],
             "overlay_queries": out["engine"]["overlay_queries"],
             "compactions": out["store"]["compactions"],
             "metrics_missing": missing,

@@ -22,6 +22,12 @@ fail CI when a future change regresses them silently:
   while holding an instrumented lock. Wired through
   ``tests/conftest.py``, so the serving test suite doubles as the race
   harness; ``bibfs-lint --lock-report`` renders the JSON artifact.
+- :mod:`bibfs_tpu.analysis.compilegraph` — the lockgraph's
+  compile-discipline twin (``BIBFS_COMPILE_CHECK=1``): every JAX
+  compilation event attributed to a declared program family with a
+  compile budget; anonymous or over-budget compiles fail the session
+  with the repo call site named. ``bibfs-lint --compile-report``
+  renders ``compilegraph.json``.
 
 :func:`guarded_by` is the runtime-inert class annotation the
 ``guarded-by`` rule reads.

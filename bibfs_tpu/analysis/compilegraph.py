@@ -1,0 +1,549 @@
+"""Dynamic retrace sentinel (``BIBFS_COMPILE_CHECK=1``) — the
+lockgraph's compile-discipline twin.
+
+The static ``jit-cache`` / ``jit-static-args`` lints prove the lexical
+half of compile discipline: every ``jax.jit`` site sits in a memoized
+builder and static Python config is declared static. What they cannot
+prove is the DYNAMIC property the serving stack actually depends on —
+that under live traffic **no compiled program is created outside the
+declared program families, and no family compiles more often than its
+shape ladder allows**. One weak-typed scalar or anonymously-jitted
+helper turns a ~20 µs dispatch into a multi-second XLA compile, and it
+never shows up in ``ExecutableCache.program_counts()`` because nothing
+routed it there. This module proves the property on the real test
+suite:
+
+- :func:`install` hooks JAX's lowering choke point (the
+  ``Compiling <fun> with global shapes and types <avals>`` record that
+  ``jax._src.interpreters.pxla`` emits once per trace+lower+compile —
+  the log *record* is the hook, no jax internals are monkeypatched, and
+  the handler never lets an instrumentation error escape into the
+  compile itself). Every compilation event records its **program
+  label** (the traced callable's name), its **creation call-site frame
+  in repo code** (the innermost ``bibfs_tpu`` frame on the stack at
+  compile time — compiles are synchronous, so the dispatching line is
+  on the stack), its **abstract-value signature**, and the
+  **ExecutableCache key** the dispatch was accounted under, if any
+  (``ExecutableCache.note`` publishes the key thread-locally just
+  before the solve that may compile).
+- Programs are identified as ``<repo-module>:<label>`` and must appear
+  in :data:`PROGRAM_BUDGETS` with a **declared compile budget** — the
+  number of distinct shape/mode specializations a full serving-suite
+  run is allowed to pay for that family. A compile whose program id is
+  undeclared is **anonymous**; a family that exceeds its budget is a
+  **retrace leak**. Both fail the session gate.
+- ``tests/conftest.py`` installs this under ``BIBFS_COMPILE_CHECK=1``
+  and writes the JSON report (``BIBFS_COMPILE_REPORT``, default
+  ``compilegraph.json``) at session end, failing the session on any
+  violation; ``bibfs-lint --compile-report FILE`` renders the artifact
+  for humans; the bench soaks' ``zero_recompiles`` gates re-derive
+  from :meth:`CompileGraph.total_compiles` deltas instead of
+  hand-diffed ``program_counts()`` snapshots — the sentinel counts
+  *actual XLA compiles*, which is strictly stronger than cache-key
+  accounting.
+
+Compiles triggered with **no** repo frame on the stack (a test or
+script jitting directly) are recorded under ``external`` and reported
+but not gated — the package cannot own their discipline.
+
+Soundness note: the hook fires once per trace+lowering. A persistent
+XLA compilation cache could make the *backend* compile cheap while the
+retrace still burns the dispatch path — counting lowerings (not
+backend compiles) is therefore the right currency for the serving
+invariant. While installed, the sentinel owns the pxla compile log
+record (``propagate`` is disabled on that one logger) so enabling it
+does not spray DEBUG lines through the session's logging config.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+import traceback
+import _thread
+
+ENV_VAR = "BIBFS_COMPILE_CHECK"
+REPORT_ENV = "BIBFS_COMPILE_REPORT"
+DEFAULT_REPORT = "compilegraph.json"
+
+_REPO_MARKER = os.sep + "bibfs_tpu" + os.sep
+_ANALYSIS_MARKER = os.sep + "analysis" + os.sep
+
+#: declared compile budgets per program family, keyed
+#: ``<repo-module>:<traced-callable name>`` where the module is the
+#: repo-relative file of the DISPATCH call site (the innermost
+#: bibfs_tpu frame at compile time — stable across line churn, unlike
+#: line numbers). The budget is the number of distinct compiled
+#: specializations a full serving-suite session may pay: one per
+#: (padded shape x mode x batch rung x mesh geometry) the suite's
+#: traffic legitimately reaches, with ~2x headroom so a new test adds
+#: a shape without tripping the gate — while a per-call retrace leak
+#: (hundreds of compiles) still fails loudly. A program NOT in this
+#: table is an anonymous compile and fails the session outright: new
+#: kernels must declare themselves here (and route their dispatch
+#: accounting through ExecutableCache — the jit-cache lint's other
+#: half).
+PROGRAM_BUDGETS: dict[str, int] = {
+    # single-device point-to-point kernels (solvers/dense.py builders,
+    # dispatched from the batch-minor/dense dispatch seams)
+    "bibfs_tpu/solvers/batch_minor.py:minor_kernel": 256,
+    "bibfs_tpu/solvers/dense.py:dense_kernel": 192,
+    "bibfs_tpu/solvers/dense.py:dense_fused_kernel": 32,
+    "bibfs_tpu/solvers/dense.py:dense_fused_alt_kernel": 32,
+    "bibfs_tpu/solvers/dense.py:traced_side_step": 64,
+    "bibfs_tpu/solvers/dense.py:traced_meet_vote": 16,
+    "bibfs_tpu/solvers/dense.py:blocked_kernel": 96,
+    "bibfs_tpu/solvers/batch_minor.py:blocked_kernel": 96,
+    # multi-source / weighted / k-shortest device programs
+    "bibfs_tpu/ops/msbfs_device.py:msbfs_kernel": 96,
+    "bibfs_tpu/ops/msbfs_device.py:msbfs_blocked_kernel": 48,
+    "bibfs_tpu/oracle/trees.py:msbfs_kernel": 48,
+    "bibfs_tpu/solvers/query_device.py:delta_kernel": 64,
+    "bibfs_tpu/solvers/query_device.py:restricted_kernel": 96,
+    # mesh-sharded programs (1D vertex-sharded, dp query-sharded, 2D)
+    "bibfs_tpu/solvers/sharded.py:sharded_kernel": 96,
+    "bibfs_tpu/solvers/sharded.py:sharded_fused_kernel": 32,
+    "bibfs_tpu/solvers/sharded2d.py:sharded2d_kernel": 64,
+    "bibfs_tpu/solvers/batch_minor.py:dp_minor_kernel": 96,
+    # checkpoint/resume chunked drives + pallas table prep
+    "bibfs_tpu/solvers/checkpoint.py:dense_chunk_kernel": 48,
+    "bibfs_tpu/solvers/checkpoint.py:sharded_chunk_kernel": 48,
+    "bibfs_tpu/solvers/checkpoint.py:sharded2d_chunk_kernel": 48,
+    "bibfs_tpu/solvers/checkpoint.py:prepare_pallas_tables": 16,
+    "bibfs_tpu/ops/pallas_expand.py:prepare_pallas_tables": 16,
+    # calibration probes (bench-time only; tiny)
+    "bibfs_tpu/utils/calibrate.py:dispatch_probe": 16,
+    "bibfs_tpu/utils/calibrate.py:pull_loop": 16,
+    "bibfs_tpu/utils/calibrate.py:push_loop": 16,
+}
+
+#: incidental jax-library programs legitimately compiled FROM repo code
+#: (device uploads, scalar reads, implicit conversions) — a shared
+#: generous budget each, still bounded so an accidental per-call
+#: host-op in a hot loop cannot hide here. Keyed by label only: these
+#: are jax-internal callables reached from many repo modules.
+INCIDENTAL_BUDGET = 64
+INCIDENTAL_LABELS = frozenset((
+    # jnp wrapper closures and the eager-op jits jax compiles when repo
+    # host code runs jnp operations outside a kernel (decode paths, the
+    # blocked route's chunked eager matmuls, upload prep). The names
+    # are jax's own (lax primitive wrappers); a session hitting a NEW
+    # one fails with the exact label to add here — deliberate review
+    # friction, since an unrecognized label is also what a leaked
+    # helper looks like. Generic throwaway names (fn, kernel, wrapped)
+    # stay OUT of this list on purpose: they are what a leaked helper
+    # is actually called.
+    "_where", "where", "select_n",
+    "_threefry_seed", "_threefry_split", "_uniform",
+    "convert_element_type", "_convert_element_type",
+    "reshape", "ravel", "_squeeze", "squeeze", "expand_dims",
+    "broadcast_in_dim", "concatenate", "transpose", "tile", "pad",
+    "iota", "_multi_slice", "dynamic_slice", "_take", "take",
+    "_take_along_axis", "gather", "scatter", "dot_general",
+    "add", "subtract", "multiply", "true_divide", "floor_divide",
+    "remainder", "_power", "maximum", "minimum", "clip",
+    "greater", "greater_equal", "less", "less_equal", "equal",
+    "not_equal", "logical_or", "logical_and", "logical_not",
+    "bitwise_or", "bitwise_and", "invert",
+    "_reduce_sum", "_reduce_max", "_reduce_min", "_reduce_or",
+    "_reduce_and", "sum", "amax", "amin", "any", "all",
+    "argmax", "argmin", "cumsum", "sort", "argsort", "searchsorted",
+))
+
+#: anonymous events retained in full (stack and avals); the total
+#: count keeps incrementing past the cap and still fails the gate
+_ANON_KEEP = 100
+
+#: routed-key claim window: a first compile starts within microseconds
+#: of its dispatch's note() — generous slack for a slow trace under
+#: load, still far below the gap to an unrelated later compile
+_KEY_TTL_S = 10.0
+
+_STATE: "CompileGraph | None" = None
+_INSTALLED: "tuple | None" = None  # (handler, [(logger, level, propagate)])
+
+
+class CompileGraph:
+    """The process-global compile-event graph (module docstring)."""
+
+    def __init__(self):
+        # raw primitive: under BIBFS_LOCK_CHECK the lockgraph patches
+        # threading.Lock for bibfs-created locks — the sentinels must
+        # not tax (or deadlock-order) each other
+        self._mu = _thread.allocate_lock()
+        self._tls = threading.local()
+        self._total = 0
+        self._programs: dict[str, dict] = {}
+        self._anonymous: list[dict] = []
+        self._anonymous_total = 0
+        self._external: dict[str, dict] = {}
+
+    # ---- dispatch-side attribution -----------------------------------
+    def note_routed_key(self, key) -> None:
+        """Publish the ExecutableCache key of the dispatch this thread
+        is about to run — a compile event on this thread attributes to
+        it (compiles are synchronous with the dispatch that pays them).
+
+        The ``routed`` column is best-effort DIAGNOSTIC attribution
+        (the gates never read it); three bounds keep it honest: the
+        key is SINGLE-SHOT (the first declared-family compile that
+        reads it consumes it), superseded by the next publication on
+        the thread, and it EXPIRES after ``_KEY_TTL_S`` seconds — so a
+        key published for a dispatch that never compiled (first-seen
+        cache key over an already-warm kernel memo, or an accounting
+        call with no solve) cannot be claimed by an unrelated compile
+        long after. :meth:`clear_routed_key` retires it early on a
+        cache HIT: no first compile is expected there, and a retrace
+        that reuses a noted key is exactly a compile the accounting
+        layer did NOT pay for — reporting it unrouted is the signal."""
+        self._tls.key = str(key)
+        self._tls.key_ts = time.monotonic()
+
+    def clear_routed_key(self) -> None:
+        self._tls.key = None
+
+    def _take_routed_key(self) -> str | None:
+        key = getattr(self._tls, "key", None)
+        self._tls.key = None
+        if key is None:
+            return None
+        if time.monotonic() - getattr(self._tls, "key_ts", 0.0) > _KEY_TTL_S:
+            return None  # expired: published for a dispatch long gone
+        return key
+
+    # ---- the compile hook --------------------------------------------
+    def note_compile(self, label: str, avals: str) -> None:
+        """Record one compilation event (called by the log hook)."""
+        site, module = _repo_site()
+        if module is None:
+            self._note_external(label, site)
+            return
+        pid = f"{module}:{label}"
+        declared = pid in PROGRAM_BUDGETS
+        budget = PROGRAM_BUDGETS.get(pid)
+        if budget is None and label in INCIDENTAL_LABELS:
+            budget = INCIDENTAL_BUDGET
+        # only a DECLARED family's compile consumes the published key:
+        # incidental jax-library programs compiled mid-trace must not
+        # eat (or claim) the dispatch's attribution
+        key = self._take_routed_key() if declared else None
+        with self._mu:
+            self._total += 1
+            if budget is None:
+                # bounded retention: in the pathological case this
+                # sentinel exists for (a per-call retrace leak in a
+                # long soak) the event list must not grow with the
+                # leak — keep the first _ANON_KEEP full events, count
+                # the rest (the count still fails the gate)
+                self._anonymous_total += 1
+                if len(self._anonymous) >= _ANON_KEEP:
+                    return
+                self._anonymous.append({
+                    "program": pid,
+                    "label": label,
+                    "site": site,
+                    "avals": avals,
+                    "routed_key": key,
+                    "thread": threading.current_thread().name,
+                    "stack": _stack(),
+                })
+                return
+            row = self._programs.get(pid)
+            if row is None:
+                row = self._programs[pid] = {
+                    "program": pid,
+                    "label": label,
+                    "budget": budget,
+                    "compiles": 0,
+                    "sites": set(),
+                    "routed_keys": set(),
+                    "avals_sample": avals,
+                }
+            row["compiles"] += 1
+            row["sites"].add(site)
+            if key is not None:
+                row["routed_keys"].add(key)
+
+    def _note_external(self, label: str, site: str | None) -> None:
+        key = f"{site or '?'}:{label}"
+        with self._mu:
+            self._total += 1
+            row = self._external.get(key)
+            if row is None:
+                self._external[key] = {
+                    "label": label, "site": site or "?", "compiles": 1,
+                }
+            else:
+                row["compiles"] += 1
+
+    # ---- introspection -----------------------------------------------
+    def total_compiles(self) -> int:
+        """Every compilation event recorded so far — the soak gates'
+        currency: a ``zero_recompiles`` window is a zero DELTA here."""
+        with self._mu:
+            return self._total
+
+    def violations(self) -> dict:
+        """``{"anonymous": [...], "over_budget": [...]}`` — the session
+        gate fails when either list is non-empty."""
+        with self._mu:
+            over = [
+                {
+                    "program": r["program"],
+                    "compiles": r["compiles"],
+                    "budget": r["budget"],
+                    "sites": sorted(r["sites"]),
+                }
+                for r in self._programs.values()
+                if r["compiles"] > r["budget"]
+            ]
+            return {
+                "anonymous": list(self._anonymous),
+                "over_budget": over,
+            }
+
+    def report(self) -> dict:
+        """The JSON artifact (the committed ``compilegraph.json``
+        shape): one row per declared program family, the anonymous and
+        external event lists, and the gate verdicts."""
+        with self._mu:
+            programs = sorted((
+                {
+                    "program": r["program"],
+                    "label": r["label"],
+                    "compiles": r["compiles"],
+                    "budget": r["budget"],
+                    "over_budget": r["compiles"] > r["budget"],
+                    "routed": bool(r["routed_keys"]),
+                    "sites": sorted(r["sites"]),
+                    "routed_keys": sorted(r["routed_keys"])[:8],
+                    "avals_sample": r["avals_sample"][:200],
+                }
+                for r in self._programs.values()
+            ), key=lambda r: r["program"])
+            return {
+                "schema": "bibfs-compilegraph-v1",
+                "total_compiles": self._total,
+                "programs": programs,
+                "anonymous": list(self._anonymous),
+                "anonymous_total": self._anonymous_total,
+                "external": sorted(
+                    self._external.values(),
+                    key=lambda r: (r["site"], r["label"]),
+                ),
+            }
+
+
+def _repo_site() -> tuple[str | None, str | None]:
+    """``(site, module)`` of the innermost repo frame on the stack:
+    ``site`` is ``file.py:line``, ``module`` the repo-relative file the
+    program id keys on. ``(external_site, None)`` when no repo frame is
+    present (a test/script compiling directly)."""
+    fallback = None
+    for fr in reversed(traceback.extract_stack()):
+        fn = fr.filename
+        i = fn.rfind(_REPO_MARKER)
+        if i >= 0:
+            rel = fn[i + 1:]
+            if _ANALYSIS_MARKER in rel:
+                continue  # the sentinel itself never owns a program
+            return f"{rel}:{fr.lineno}", rel
+        if (fallback is None
+                and "site-packages" not in fn
+                and os.sep + "logging" + os.sep not in fn
+                and not fn.startswith("<")):
+            fallback = f"{os.path.basename(fn)}:{fr.lineno}"
+    return fallback, None
+
+
+_STACK_LIMIT = 14
+
+
+def _stack() -> list:
+    out = []
+    for fr in traceback.extract_stack(limit=_STACK_LIMIT + 8)[:-3]:
+        fn = fr.filename
+        i = fn.rfind(_REPO_MARKER)
+        if i >= 0:
+            fn = fn[i + 1:]
+        out.append(f"{fn}:{fr.lineno} in {fr.name}")
+    return out[-_STACK_LIMIT:]
+
+
+def _make_handler(state: CompileGraph):
+    """The hook: a logging.Handler over the one pxla record emitted per
+    trace+lower+compile. Defined lazily (logging imported at install)
+    so this module stays import-light for bench.py/CI scripts."""
+    import logging
+
+    class Handler(logging.Handler):
+        def emit(self, record):
+            try:
+                if not str(record.msg).startswith("Compiling"):
+                    return
+                args = record.args or ()
+                label = str(args[0]) if args else "?"
+                avals = str(args[1]) if len(args) > 1 else ""
+                state.note_compile(label, avals)
+            except Exception:  # pragma: no cover - never break a compile
+                pass
+
+    return Handler(level=logging.DEBUG)
+
+
+#: the loggers that emit the per-compile record (both the pjit path and
+#: the jit(pmap) legacy path log from interpreters/pxla)
+_HOOKED_LOGGERS = ("jax._src.interpreters.pxla",)
+
+
+def install() -> CompileGraph:
+    """Activate the sentinel process-wide (idempotent). Needs no jax
+    import and no patching of jax internals — attaching the handler
+    before jax itself imports is fine (logger objects are created on
+    first ``getLogger`` and shared). :func:`uninstall` undoes it
+    completely (handler off, logger level/propagate restored) — a
+    scoped user like the churn soak must not leave jax's own compile
+    logging hijacked for the rest of an embedding process."""
+    global _STATE, _INSTALLED
+    if _STATE is not None:
+        return _STATE
+    import logging
+
+    _STATE = CompileGraph()
+    handler = _make_handler(_STATE)
+    saved = []
+    for name in _HOOKED_LOGGERS:
+        lg = logging.getLogger(name)
+        saved.append((lg, lg.level, lg.propagate))
+        lg.addHandler(handler)
+        lg.setLevel(logging.DEBUG)
+        # the sentinel owns this record while installed: without this a
+        # DEBUG-configured root handler would spray one line per compile
+        lg.propagate = False
+    _INSTALLED = (handler, saved)
+    return _STATE
+
+
+def uninstall() -> None:
+    """Deactivate the sentinel and restore every hooked logger to its
+    pre-install level/propagation (no-op when not installed)."""
+    global _STATE, _INSTALLED
+    if _INSTALLED is None:
+        return
+    handler, saved = _INSTALLED
+    for lg, level, propagate in saved:
+        lg.removeHandler(handler)
+        lg.setLevel(level)
+        lg.propagate = propagate
+    _INSTALLED = None
+    _STATE = None
+
+
+def enabled() -> bool:
+    return _STATE is not None
+
+
+def graph() -> CompileGraph | None:
+    return _STATE
+
+
+def note_routed_key(key) -> None:
+    """ExecutableCache's attribution seam — no-op when the sentinel is
+    off (one global read on the dispatch path)."""
+    state = _STATE
+    if state is not None:
+        state.note_routed_key(key)
+
+
+def clear_routed_key() -> None:
+    """Retire the published key (a cache HIT: the dispatch expects no
+    first compile, so nothing later may claim its attribution)."""
+    state = _STATE
+    if state is not None:
+        state.clear_routed_key()
+
+
+def total_compiles() -> int:
+    return 0 if _STATE is None else _STATE.total_compiles()
+
+
+def save_report(path: str) -> dict:
+    """Write the JSON artifact (the committed ``compilegraph.json``
+    shape) atomically and return the report dict. Safe with the
+    sentinel off (writes an empty report)."""
+    rep = (
+        _STATE.report() if _STATE is not None
+        else {"schema": "bibfs-compilegraph-v1", "total_compiles": 0,
+              "programs": [], "anonymous": [], "anonymous_total": 0,
+              "external": []}
+    )
+    from bibfs_tpu.graph.io import _atomic_replace
+
+    def _payload(f):
+        f.write(json.dumps(rep, indent=1, sort_keys=True))
+        f.write("\n")
+
+    _atomic_replace(path, _payload, mode="w")
+    return rep
+
+
+# ---- renderer (bibfs-lint --compile-report) ---------------------------
+def render_report(rep: dict) -> tuple[str, bool]:
+    """Human-readable rendering of a report dict; ``ok`` is False when
+    the run recorded anonymous or over-budget compiles."""
+    programs = rep.get("programs", [])
+    anonymous = rep.get("anonymous", [])
+    anon_total = rep.get("anonymous_total", len(anonymous))
+    external = rep.get("external", [])
+    over = [r for r in programs if r.get("over_budget")]
+    lines = [
+        f"compile graph: {rep.get('total_compiles', 0)} compile events, "
+        f"{len(programs)} declared program families, "
+        f"{anon_total} anonymous, {len(over)} over budget, "
+        f"{len(external)} external",
+        "",
+        "declared programs (compiles/budget, routed = accounted in an "
+        "ExecutableCache):",
+    ]
+    for r in programs:
+        routed = "routed" if r.get("routed") else "unrouted"
+        lines.append(
+            f"  {r['program']:48s} {r['compiles']:4d}/{r['budget']:<4d}"
+            f" {routed}"
+        )
+    if external:
+        lines.append("")
+        lines.append("external compiles (no repo frame — not gated):")
+        for r in external:
+            lines.append(f"  {r['site']:40s} {r['label']:24s}"
+                         f" x{r['compiles']}")
+    if anonymous:
+        lines.append("")
+        lines.append("ANONYMOUS COMPILES (undeclared program families — "
+                     "the build gate fails):")
+        for ev in anonymous:
+            lines.append(f"  {ev['program']}  at {ev['site']}")
+            for fr in ev.get("stack", []):
+                lines.append(f"      {fr}")
+        if anon_total > len(anonymous):
+            lines.append(f"  ... and {anon_total - len(anonymous)} more "
+                         "(event retention capped)")
+    if over:
+        lines.append("")
+        lines.append("OVER-BUDGET PROGRAMS (retrace leaks — the build "
+                     "gate fails):")
+        for r in over:
+            lines.append(f"  {r['program']}: {r['compiles']} compiles "
+                         f"> budget {r['budget']}")
+            for s in r["sites"][:6]:
+                lines.append(f"      dispatched at {s}")
+    return "\n".join(lines), not anonymous and not over
+
+
+def render_report_file(path: str) -> tuple[str, bool]:
+    with open(path) as f:
+        rep = json.load(f)
+    return render_report(rep)

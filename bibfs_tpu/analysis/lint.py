@@ -23,11 +23,13 @@ whatever bad states they like.
 
 CLI::
 
-    bibfs-lint [PATHS...]        # lint (default: the whole package)
-    bibfs-lint --list-rules      # one line per rule
-    bibfs-lint --json            # machine-readable findings
-    bibfs-lint --lock-report F   # render a lockgraph JSON artifact
-                                 # (exit 1 if it recorded cycles)
+    bibfs-lint [PATHS...]          # lint (default: the whole package)
+    bibfs-lint --list-rules        # one line per rule
+    bibfs-lint --json              # machine-readable findings
+    bibfs-lint --lock-report F     # render a lockgraph JSON artifact
+                                   # (exit 1 if it recorded cycles)
+    bibfs-lint --compile-report F  # render a compilegraph JSON artifact
+                                   # (exit 1 on anonymous/over-budget)
 """
 
 from __future__ import annotations
@@ -235,19 +237,38 @@ def main(argv=None) -> int:
     ap.add_argument("--lock-report", metavar="JSON", default=None,
                     help="render a lock-graph artifact recorded under "
                     "BIBFS_LOCK_CHECK=1 instead of linting")
+    ap.add_argument("--compile-report", metavar="JSON", default=None,
+                    help="render a compile-graph artifact recorded "
+                    "under BIBFS_COMPILE_CHECK=1 instead of linting "
+                    "(exit 1 on anonymous or over-budget compiles)")
     args = ap.parse_args(argv)
 
-    if args.lock_report is not None:
-        from bibfs_tpu.analysis.lockgraph import render_report_file
+    if args.lock_report is not None or args.compile_report is not None:
+        renders = []
+        if args.lock_report is not None:
+            from bibfs_tpu.analysis.lockgraph import (
+                render_report_file as render_lock,
+            )
+            renders.append((render_lock, args.lock_report))
+        if args.compile_report is not None:
+            from bibfs_tpu.analysis.compilegraph import (
+                render_report_file as render_compile,
+            )
+            renders.append((render_compile, args.compile_report))
 
-        text, ok = render_report_file(args.lock_report)
+        # both flags render both artifacts; exit 1 if EITHER gate is
+        # red. Every verdict is computed BEFORE any printing so a
+        # consumer closing the pipe early (`... | head`) cannot skip a
+        # red gate.
+        rendered = [render(path) for render, path in renders]
+        all_ok = all(ok for _text, ok in rendered)
         try:
-            print(text)
+            print("\n\n".join(text for text, _ok in rendered))
         except BrokenPipeError:
             # `bibfs-lint --lock-report f | head` closing the pipe is
             # not an error; the verdict is what matters
             sys.stderr.close()
-        return 0 if ok else 1
+        return 0 if all_ok else 1
 
     from bibfs_tpu.analysis.rules import RULES
 

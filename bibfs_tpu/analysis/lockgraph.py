@@ -478,11 +478,18 @@ def save_report(path: str) -> dict:
         else {"schema": "bibfs-lockgraph-v1", "locks": [], "edges": [],
               "cycles": [], "blocking_under_lock": []}
     )
-    tmp = f"{path}.tmp.{os.getpid()}"
-    with open(tmp, "w") as f:
+    # graph/io's one atomic-commit idiom (flush + fsync + replace): the
+    # --lock-report CI step parses this artifact, and a teardown crash
+    # mid-write must leave the previous complete report, never a torn
+    # one — the bare tmp+replace this used to hand-roll skipped the
+    # fsync, exactly the divergence _atomic_replace exists to end
+    from bibfs_tpu.graph.io import _atomic_replace
+
+    def _payload(f):
         json.dump(rep, f, indent=1, sort_keys=True)
         f.write("\n")
-    os.replace(tmp, path)
+
+    _atomic_replace(path, _payload, mode="w")
     return rep
 
 

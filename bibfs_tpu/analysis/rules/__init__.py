@@ -10,10 +10,15 @@ fire and stay quiet) and a row to the README "Static analysis" table.
 from bibfs_tpu.analysis.rules import (
     atomic_write,
     bare_except,
+    chaos_site,
     error_kind,
     guarded_by,
+    jit_cache,
+    jit_static_args,
+    launch_host_sync,
     lock_io,
     metric_mint,
+    wallclock_trace,
 )
 
 RULES = (
@@ -23,4 +28,9 @@ RULES = (
     error_kind.RULE,
     metric_mint.RULE,
     bare_except.RULE,
+    jit_cache.RULE,
+    jit_static_args.RULE,
+    launch_host_sync.RULE,
+    wallclock_trace.RULE,
+    chaos_site.RULE,
 )

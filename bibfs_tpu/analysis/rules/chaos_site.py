@@ -1,0 +1,221 @@
+"""``chaos-site`` — the fault-seam registry reconciled, both ways.
+
+``serve/faults.KNOWN_SITES`` is the contract between the chaos harness
+and the engine seams, and it rots silently in two directions:
+
+- a seam is renamed/removed in engine code while its site stays
+  declared (or a spec keeps referencing the old name): ``fire()`` on an
+  unknown site is a no-op, so a chaos soak "passes" while injecting
+  nothing — the dead-seam failure mode the KNOWN_SITES parse guard only
+  catches for *parsed* specs;
+- a seam is fired in engine code under a name the registry never
+  declared, so no spec can ever reach it.
+
+Checks (the first two run on any scan, the rest need the full tree):
+
+1. every literal site fired in package code
+   (``*.fire("<site>", ...)`` / ``self._fire("<site>")``) is declared
+   in KNOWN_SITES;
+2. every fault-spec string literal in package code and ``bench.py``
+   (the soak drivers — tests are exempt: they construct bad specs on
+   purpose to assert rejection) names only declared sites;
+3. every declared site is actually fired somewhere in the package
+   (a declared-but-never-fired site is a dead seam);
+4. every declared site is exercised by at least one test or soak — a
+   spec string or literal site reference under ``tests/`` /
+   ``bench.py`` / the loadgen soak drivers. A seam no chaos run can
+   reach proves nothing.
+"""
+
+from __future__ import annotations
+
+import ast
+import glob
+import os
+import re
+
+from bibfs_tpu.analysis.lint import Finding
+from bibfs_tpu.analysis.rules.common import Rule, attr_chain
+
+_FAULTS_REL = "bibfs_tpu/serve/faults.py"
+_SPEC_RE = re.compile(
+    r"([a-z][a-z0-9_]*):(?:p|every|times|kind|ms|pair)=", re.ASCII
+)
+
+
+def _known_sites(pf):
+    """(KNOWN_SITES tuple, lineno) parsed from the faults module."""
+    for node in ast.walk(pf.tree):
+        if (isinstance(node, ast.Assign)
+                and any(isinstance(t, ast.Name) and t.id == "KNOWN_SITES"
+                        for t in node.targets)
+                and isinstance(node.value, (ast.Tuple, ast.List))):
+            sites = tuple(
+                e.value for e in node.value.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, str)
+            )
+            return sites, node.lineno
+    return None, 0
+
+
+def _fired_sites(pf):
+    """``(site, lineno)`` for every literal first arg of a
+    ``*.fire(...)`` / ``*._fire(...)`` call."""
+    out = []
+    for node in ast.walk(pf.tree):
+        if (isinstance(node, ast.Call)
+                and attr_chain(node.func)[-1] in ("fire", "_fire")
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)):
+            out.append((node.args[0].value, node.lineno))
+    return out
+
+
+def _code_strings(tree):
+    """``(text, lineno)`` for every string constant that is CODE, not
+    prose — f-string literal fragments included (spec prefixes live in
+    the literal half of ``f"{site}:every={n}"``-style strings).
+    Docstring positions (a bare string expression opening a
+    module/class/def body) are excluded, so a docstring *mentioning* a
+    site neither counts as exercising it nor fails the build when it
+    quotes a stale spec example. Comments never reach the AST at
+    all."""
+    doc_ids = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Module, ast.ClassDef, ast.FunctionDef,
+                             ast.AsyncFunctionDef)):
+            body = node.body
+            if (body and isinstance(body[0], ast.Expr)
+                    and isinstance(body[0].value, ast.Constant)
+                    and isinstance(body[0].value.value, str)):
+                doc_ids.add(id(body[0].value))
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Constant)
+                and isinstance(node.value, str)
+                and id(node) not in doc_ids):
+            yield node.value, node.lineno
+
+
+def check(project):
+    findings = []
+    faults_pf = None
+    for pf in project.files:
+        if pf.rel.replace("\\", "/").endswith("serve/faults.py"):
+            faults_pf = pf
+            break
+    if faults_pf is None:
+        return findings  # fixture scans without the registry: nothing to do
+    sites, decl_line = _known_sites(faults_pf)
+    if sites is None:
+        findings.append(Finding(
+            "chaos-site", faults_pf.rel, 1,
+            "KNOWN_SITES tuple not found/parseable in the faults module",
+        ))
+        return findings
+    known = set(sites)
+
+    fired: dict[str, list] = {}
+    for pf in project.files:
+        if pf is faults_pf:
+            continue  # FaultPlan.fire's own definition is not a seam
+        for site, lineno in _fired_sites(pf):
+            fired.setdefault(site, []).append((pf.rel, lineno))
+            if site not in known:
+                findings.append(Finding(
+                    "chaos-site", pf.rel, lineno,
+                    f"fired fault site {site!r} is not declared in "
+                    "serve/faults.KNOWN_SITES — no spec can ever "
+                    "target it (fire() on an unknown site injects "
+                    "nothing, silently)",
+                ))
+        # spec literals in package drivers must parse to known sites
+        for text, lineno in _code_strings(pf.tree):
+            for m in _SPEC_RE.finditer(text):
+                if m.group(1) not in known:
+                    findings.append(Finding(
+                        "chaos-site", pf.rel, lineno,
+                        f"fault spec references unknown site "
+                        f"{m.group(1)!r} — the seam was renamed or "
+                        "never existed; this spec injects nothing",
+                    ))
+
+    if not project.complete:
+        return findings
+
+    # bench.py is a soak DRIVER outside the package walk: its spec
+    # literals must parse to known sites too (direction 2) — a renamed
+    # seam in a bench soak spec is exactly the silent dead-seam this
+    # rule exists for. Tests stay exempt from this direction: they
+    # construct bad specs on purpose to assert rejection.
+    bench_path = os.path.join(project.root, "bench.py")
+    try:
+        with open(bench_path, encoding="utf-8") as f:
+            bench_tree = ast.parse(f.read())
+    except (OSError, SyntaxError):
+        bench_tree = None
+    if bench_tree is not None:
+        for text, lineno in _code_strings(bench_tree):
+            for m in _SPEC_RE.finditer(text):
+                if m.group(1) not in known:
+                    findings.append(Finding(
+                        "chaos-site", "bench.py", lineno,
+                        f"fault spec references unknown site "
+                        f"{m.group(1)!r} — the seam was renamed or "
+                        "never existed; this spec injects nothing",
+                    ))
+
+    # full-tree cross-checks: declared => fired, declared => exercised.
+    # "Exercised" means a site reference in an actual string literal —
+    # AST-collected, docstrings excluded — under tests/, bench.py, or
+    # the loadgen soak drivers: a deleted injection test must not stay
+    # green because prose somewhere still quotes the site name.
+    exercised: set[str] = set()
+    scan_paths = sorted(glob.glob(os.path.join(project.root, "tests",
+                                               "*.py")))
+    scan_paths.append(bench_path)
+    literals = []
+    for path in scan_paths:
+        try:
+            with open(path, encoding="utf-8") as f:
+                literals.extend(
+                    t for t, _ in _code_strings(ast.parse(f.read()))
+                )
+        except (OSError, SyntaxError):
+            continue
+    # the loadgen soak drivers count as soaks (bench.py drives them)
+    for pf in project.files:
+        if pf.rel.replace("\\", "/").endswith("serve/loadgen.py"):
+            literals.extend(t for t, _ in _code_strings(pf.tree))
+    for site in sites:
+        pat = re.compile(
+            rf"(?<![a-z0-9_]){re.escape(site)}(?![a-z0-9_])"
+        )
+        if any(pat.search(text) for text in literals):
+            exercised.add(site)
+
+    for site in sites:
+        if site not in fired:
+            findings.append(Finding(
+                "chaos-site", faults_pf.rel, decl_line,
+                f"declared fault site {site!r} is never fired by any "
+                "engine seam — a dead seam: remove it or wire the "
+                "fire() call",
+            ))
+        if site not in exercised:
+            findings.append(Finding(
+                "chaos-site", faults_pf.rel, decl_line,
+                f"declared fault site {site!r} is not exercised by "
+                "any test or soak (no spec or site literal under "
+                "tests/, bench.py, or the loadgen drivers) — an "
+                "uninjected seam proves nothing",
+            ))
+    return findings
+
+
+RULE = Rule(
+    "chaos-site",
+    "serve/faults.KNOWN_SITES reconciled: every declared site fired "
+    "and exercised, every fired/spec'd site declared",
+    check,
+)

@@ -128,6 +128,136 @@ def iter_nodes_with_held(func, extra_locks=(), initial=frozenset()):
     yield from walk(func, frozenset(initial))
 
 
+def is_jit_call(node) -> bool:
+    """True when ``node`` is a ``jax.jit(...)`` / ``jit(...)`` call."""
+    return (isinstance(node, ast.Call)
+            and attr_chain(node.func)[-1] == "jit"
+            and attr_chain(node.func)[0] in ("jax", "jit"))
+
+
+def jit_decorator(deco):
+    """The jit Call/Name when ``deco`` is a jit decorator — handles
+    ``@jax.jit``, ``@jit``, and ``@partial(jax.jit, ...)`` /
+    ``@functools.partial(jit, ...)`` — else None."""
+    if isinstance(deco, ast.Call):
+        if attr_chain(deco.func)[-1] == "partial" and deco.args:
+            inner = deco.args[0]
+            if attr_chain(inner)[-1] == "jit" \
+                    and attr_chain(inner)[0] in ("jax", "jit"):
+                return deco
+        if is_jit_call(deco):
+            return deco
+    elif attr_chain(deco)[-1] == "jit" \
+            and attr_chain(deco)[0] in ("jax", "jit"):
+        return deco
+    return None
+
+
+def jit_static_decls(call) -> tuple[set, set]:
+    """``(static_argnums, static_argnames)`` literals declared on a jit
+    call (or a partial(jax.jit, ...) decorator); non-literal
+    declarations contribute nothing."""
+    nums: set[int] = set()
+    names: set[str] = set()
+    if not isinstance(call, ast.Call):
+        return nums, names
+    for kw in call.keywords:
+        vals = []
+        if isinstance(kw.value, (ast.Tuple, ast.List)):
+            vals = list(kw.value.elts)
+        elif isinstance(kw.value, ast.Constant):
+            vals = [kw.value]
+        if kw.arg == "static_argnums":
+            nums.update(v.value for v in vals
+                        if isinstance(v, ast.Constant)
+                        and isinstance(v.value, int))
+        elif kw.arg == "static_argnames":
+            names.update(v.value for v in vals
+                         if isinstance(v, ast.Constant)
+                         and isinstance(v.value, str))
+    return nums, names
+
+
+#: call names whose function arguments trace (their bodies run under
+#: jax's tracer, same as a jitted body)
+TRACING_CALLS = frozenset((
+    "while_loop", "fori_loop", "scan", "cond", "switch", "vmap",
+    "shard_map", "pmap", "checkpoint", "remat", "custom_vjp", "grad",
+))
+
+
+def traced_functions(tree) -> dict:
+    """``{FunctionDef: why}`` for every def in ``tree`` whose body runs
+    under the jax tracer: jit-decorated defs, defs passed by name to a
+    jit call (unwrapped through vmap/shard_map wrappers), every def
+    nested inside a *builder* whose call result feeds a jit call (the
+    memoized-builder idiom: ``jax.jit(_build_kernel(...))`` traces the
+    kernel the builder returns), defs passed to ``lax.while_loop`` /
+    ``scan`` / ``cond`` / ... by name, and defs nested inside any of
+    the above."""
+    defs_by_name: dict[str, list] = {}
+    parent_func: dict = {}
+
+    def index(node, parent):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs_by_name.setdefault(child.name, []).append(child)
+                parent_func[child] = parent
+                index(child, child)
+            else:
+                index(child, parent)
+
+    index(tree, None)
+
+    traced: dict = {}
+
+    def mark(fn, why):
+        if fn in traced:
+            return
+        traced[fn] = why
+        for child in ast.walk(fn):
+            if child is not fn and isinstance(
+                    child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                traced.setdefault(child, why)
+
+    def mark_name(name, why):
+        for fn in defs_by_name.get(name, ()):
+            mark(fn, why)
+
+    def mark_jit_operand(node, why):
+        """A jit (or wrapper) operand: a Name marks that def; a Call of
+        a local function marks the defs nested in it (the builder
+        pattern) and recurses into wrapper args (vmap(build(...)))."""
+        if isinstance(node, ast.Name):
+            mark_name(node.id, why)
+        elif isinstance(node, ast.Call):
+            fname = attr_chain(node.func)[-1]
+            for fn in defs_by_name.get(fname, ()):
+                for child in ast.walk(fn):
+                    if isinstance(child, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef)):
+                        mark(child, why)
+            for arg in node.args:
+                mark_jit_operand(arg, why)
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for deco in node.decorator_list:
+                if jit_decorator(deco) is not None:
+                    mark(node, "jit-decorated")
+        if not isinstance(node, ast.Call):
+            continue
+        if is_jit_call(node):
+            for arg in node.args:
+                mark_jit_operand(arg, "passed to jax.jit")
+        elif attr_chain(node.func)[-1] in TRACING_CALLS:
+            for arg in node.args:
+                if isinstance(arg, ast.Name):
+                    mark_name(arg.id, f"passed to "
+                              f"{attr_chain(node.func)[-1]}")
+    return traced
+
+
 #: container methods that mutate their receiver in place
 MUTATING_METHODS = frozenset((
     "append", "appendleft", "extend", "insert", "remove", "pop",

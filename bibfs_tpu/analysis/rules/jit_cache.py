@@ -1,0 +1,181 @@
+"""``jit-cache`` — every compiled program must be paid for once and
+accounted for.
+
+The serving stack's compile discipline has two lexical halves, and this
+rule checks both:
+
+1. **Every ``jax.jit`` site in the compiled-program modules
+   (``serve/``, ``solvers/``, ``ops/``) must sit inside a memoized
+   builder** — a function decorated ``functools.lru_cache`` /
+   ``functools.cache``. An anonymous module-level jit (or a fresh
+   ``jax.jit(...)`` in straight-line code) creates a NEW traced
+   callable per call: jax's program cache keys on the callable's
+   identity, so the program retraces and recompiles per padded shape
+   per call — a ~20 µs dispatch becomes a multi-second compile under
+   live traffic — and the leak never shows in
+   ``ExecutableCache.program_counts()`` because the cache only counts
+   what dispatch code notes into it. The builder-memo idiom
+   (``@lru_cache def _get_kernel(shape...): return jax.jit(build(...))``)
+   is what every kernel in the tree uses; the dynamic sentinel
+   (``analysis/compilegraph.py``) proves the same property at runtime.
+
+2. **Route-level dispatch accounting keys on placement.** In
+   ``serve/routes/``, every ``exec_cache.note(...)`` must derive its
+   key through ``placement_bucket_key(...)`` (a bare padded-shape key
+   silently collides a mesh/blocked/kind program with the
+   single-device executable of the same shape — the bug
+   ``placement_bucket_key`` was built to end), and every dispatch
+   route (``is_dispatch = True``) must note its programs at all —
+   either its own ``exec_cache.note`` call or by delegating to the
+   engine's ``_device_launch`` (which notes the single-device base
+   key).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from bibfs_tpu.analysis.lint import Finding
+from bibfs_tpu.analysis.rules.common import (
+    Rule,
+    attr_chain,
+    is_jit_call,
+    iter_classes,
+    jit_decorator,
+)
+
+#: the modules whose jits compile serving programs; analysis fixtures
+#: and utils probes are out of scope (utils/tpu_aot compiles ON PURPOSE
+#: per audit entry, utils/calibrate per measurement)
+SCOPE_PREFIXES = (
+    "bibfs_tpu/serve/",
+    "bibfs_tpu/solvers/",
+    "bibfs_tpu/ops/",
+)
+
+_MEMO_DECORATORS = frozenset(("lru_cache", "cache"))
+
+
+def _in_scope(rel: str) -> bool:
+    return rel.replace("\\", "/").startswith(SCOPE_PREFIXES)
+
+
+def _has_memo_decorator(fn) -> bool:
+    for deco in fn.decorator_list:
+        target = deco.func if isinstance(deco, ast.Call) else deco
+        if attr_chain(target)[-1] in _MEMO_DECORATORS:
+            return True
+    return False
+
+
+def _jit_sites(tree):
+    """``(node, enclosing_defs)`` for every jit call/decorator, with
+    the lexical chain of enclosing FunctionDefs (outermost first).
+    Decorators are attributed to the ENCLOSING scope (the def they
+    decorate is not 'inside' itself) and visited exactly once — the
+    body recursion below deliberately excludes ``decorator_list`` so a
+    call-form ``@jax.jit(...)`` is not double-counted."""
+    out = []
+
+    def walk(children, chain):
+        for child in children:
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for deco in child.decorator_list:
+                    if jit_decorator(deco) is not None:
+                        out.append((deco, chain))
+                    # a decorator's arguments may still CONTAIN a jit
+                    # call of their own (recursing over the CHILDREN
+                    # never re-visits the decorator node itself)
+                    walk(ast.iter_child_nodes(deco), chain)
+                walk(ast.iter_child_nodes(child.args), chain)
+                walk(child.body, chain + (child,))
+                continue
+            if is_jit_call(child):
+                out.append((child, chain))
+            walk(ast.iter_child_nodes(child), chain)
+
+    walk(ast.iter_child_nodes(tree), ())
+    return out
+
+
+def check(project):
+    findings = []
+    for pf in project.files:
+        rel = pf.rel.replace("\\", "/")
+        if not _in_scope(rel):
+            continue
+        for node, chain in _jit_sites(pf.tree):
+            if any(_has_memo_decorator(fn) for fn in chain):
+                continue
+            where = (f"in {chain[-1].name}" if chain
+                     else "at module level")
+            findings.append(Finding(
+                "jit-cache", pf.rel, node.lineno,
+                f"jax.jit {where} outside a memoized builder — an "
+                "un-memoized jit retraces+recompiles per call per "
+                "padded shape and never appears in "
+                "ExecutableCache.program_counts(); wrap the builder "
+                "in functools.lru_cache and declare the program in "
+                "analysis/compilegraph.PROGRAM_BUDGETS",
+            ))
+        if not rel.startswith("bibfs_tpu/serve/routes/"):
+            continue
+        # half 2a: route-level notes must key on placement
+        for node in ast.walk(pf.tree):
+            if not (isinstance(node, ast.Call)
+                    and attr_chain(node.func)[-1] == "note"
+                    and len(attr_chain(node.func)) >= 3
+                    and attr_chain(node.func)[-2] == "exec_cache"):
+                continue
+            arg = node.args[0] if node.args else None
+            if not (isinstance(arg, ast.Call)
+                    and attr_chain(arg.func)[-1]
+                    == "placement_bucket_key"):
+                findings.append(Finding(
+                    "jit-cache", pf.rel, node.lineno,
+                    "route-level exec_cache.note() without a "
+                    "placement_bucket_key(...)-derived key — a bare "
+                    "padded-shape key counts a mesh/blocked/kind "
+                    "program as a hit on the single-device executable "
+                    "of the same shape",
+                ))
+        # half 2b: every dispatch route accounts its programs
+        for qual, cls in iter_classes(pf.tree):
+            if not any(
+                isinstance(stmt, ast.Assign)
+                and any(isinstance(t, ast.Name) and t.id == "is_dispatch"
+                        for t in stmt.targets)
+                and isinstance(stmt.value, ast.Constant)
+                and stmt.value.value is True
+                for stmt in cls.body
+            ):
+                continue
+            notes = any(
+                isinstance(n, ast.Call)
+                and attr_chain(n.func)[-1] == "note"
+                and "exec_cache" in attr_chain(n.func)
+                for n in ast.walk(cls)
+            )
+            delegates = any(
+                isinstance(n, ast.Call)
+                and attr_chain(n.func)[-1].endswith("_device_launch")
+                for n in ast.walk(cls)
+            )
+            if not notes and not delegates:
+                findings.append(Finding(
+                    "jit-cache", pf.rel, cls.lineno,
+                    f"dispatch route {qual} never notes its compiled "
+                    "programs into an ExecutableCache (and does not "
+                    "delegate to the engine's _device_launch) — its "
+                    "executables are invisible to the reuse counters "
+                    "and the zero_recompiles gates",
+                ))
+    return findings
+
+
+RULE = Rule(
+    "jit-cache",
+    "jax.jit only inside lru_cache'd builders; route dispatch "
+    "accounting keys on placement_bucket_key",
+    check,
+)

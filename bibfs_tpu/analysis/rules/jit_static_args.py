@@ -1,0 +1,157 @@
+"""``jit-static-args`` — Python-static parameters of jitted callables
+must be DECLARED static.
+
+The weak-type retrace trap: a jitted callable that takes a Python
+scalar (an ``int`` crossover, a ``str`` mode, a ``tuple`` of tier
+metadata) without declaring it in ``static_argnums``/``static_argnames``
+gets that value embedded as a weakly-typed traced operand — jax then
+specializes (retraces + recompiles) on every distinct VALUE, or worse,
+silently promotes dtypes per call. The serving stack's convention is to
+close static config over the builder (``_build_kernel(mode, cap)``
+returns a kernel whose jit signature is arrays only); when a def IS
+jitted directly, its scalar-shaped parameters must be declared.
+
+Two checks, both lexical:
+
+- a def that is jit-decorated (``@jax.jit`` / ``@partial(jax.jit,
+  ...)``) or passed by name to ``jax.jit(...)`` in the same file, with
+  a parameter whose annotation or default value is a Python scalar /
+  tuple (``int``, ``float``, ``bool``, ``str``, tuple literal), where
+  that parameter is not covered by the jit call's literal
+  ``static_argnums``/``static_argnames``;
+- a call of a known-jitted name passing an **unhashable literal**
+  (list/dict/set display) in a declared-static position — static args
+  key the program cache, and an unhashable key raises at dispatch
+  time, under traffic, instead of at review time.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from bibfs_tpu.analysis.lint import Finding
+from bibfs_tpu.analysis.rules.common import (
+    Rule,
+    attr_chain,
+    is_jit_call,
+    jit_decorator,
+    jit_static_decls,
+)
+
+_SCALAR_ANNOTATIONS = frozenset(("int", "float", "bool", "str", "tuple"))
+
+
+def _scalar_param_reason(arg, default):
+    ann = arg.annotation
+    if ann is not None:
+        names = {n.id for n in ast.walk(ann) if isinstance(n, ast.Name)}
+        hit = names & _SCALAR_ANNOTATIONS
+        if hit:
+            return f"annotated {sorted(hit)[0]}"
+    if default is not None:
+        if isinstance(default, ast.Constant) and isinstance(
+                default.value, (int, float, bool, str)
+        ) and not isinstance(default.value, type(...)):
+            return f"default {default.value!r}"
+        if isinstance(default, ast.Tuple):
+            return "tuple default"
+    return None
+
+
+def _param_defaults(fn):
+    """``(arg, default_node|None, positional_index|None)`` over every
+    named parameter: positional-only and positional-or-keyword params
+    carry their ``static_argnums`` index; keyword-only params carry
+    ``None`` — only ``static_argnames`` can declare those static."""
+    pos = list(fn.args.posonlyargs) + list(fn.args.args)
+    defaults = [None] * (len(pos) - len(fn.args.defaults)) \
+        + list(fn.args.defaults)
+    rows = [(a, d, i) for i, (a, d) in enumerate(zip(pos, defaults))]
+    rows += [(a, d, None) for a, d in
+             zip(fn.args.kwonlyargs, fn.args.kw_defaults)]
+    return rows
+
+
+def _check_def(pf, fn, jit_call, findings):
+    nums, names = jit_static_decls(jit_call)
+    for arg, default, idx in _param_defaults(fn):
+        if arg.arg in ("self", "cls"):
+            continue
+        reason = _scalar_param_reason(arg, default)
+        if reason is None:
+            continue
+        if (idx is not None and idx in nums) or arg.arg in names:
+            continue
+        findings.append(Finding(
+            "jit-static-args", pf.rel, fn.lineno,
+            f"jitted {fn.name}(...{arg.arg}...) takes a Python-static "
+            f"parameter ({reason}) not declared in static_argnums/"
+            "static_argnames — jax retraces per distinct value (the "
+            "weak-type retrace trap); declare it static or close it "
+            "over the builder",
+        ))
+
+
+def check(project):
+    findings = []
+    for pf in project.files:
+        defs_by_name = {
+            n.name: n for n in ast.walk(pf.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        # names bound to a jit call in a single-target assignment —
+        #   g = jax.jit(f, static_argnums=(1,)); ... g(x, [..])
+        # — mapped by the Call node's identity so the main walk can
+        # look the target name up without re-walking the tree per call
+        assign_target_by_call: dict[int, str] = {}
+        for node in ast.walk(pf.tree):
+            if (isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Call)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)):
+                assign_target_by_call[id(node.value)] = node.targets[0].id
+        jitted_statics: dict[str, set] = {}
+        for node in ast.walk(pf.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for deco in node.decorator_list:
+                    jd = jit_decorator(deco)
+                    if jd is not None:
+                        _check_def(pf, node, jd, findings)
+            if not (isinstance(node, ast.Call) and is_jit_call(node)):
+                continue
+            if node.args and isinstance(node.args[0], ast.Name):
+                fn = defs_by_name.get(node.args[0].id)
+                if fn is not None:
+                    _check_def(pf, fn, node, findings)
+            target = assign_target_by_call.get(id(node))
+            if target is not None:
+                nums, _names = jit_static_decls(node)
+                if nums:
+                    jitted_statics[target] = nums
+        for node in ast.walk(pf.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)):
+                continue
+            nums = jitted_statics.get(node.func.id)
+            if not nums:
+                continue
+            for idx in nums:
+                if idx < len(node.args) and isinstance(
+                        node.args[idx],
+                        (ast.List, ast.Dict, ast.Set)):
+                    findings.append(Finding(
+                        "jit-static-args", pf.rel, node.lineno,
+                        f"unhashable literal passed in static position "
+                        f"{idx} of jitted {node.func.id}(...) — static "
+                        "args key the program cache and must hash; "
+                        "this raises at dispatch time under traffic",
+                    ))
+    return findings
+
+
+RULE = Rule(
+    "jit-static-args",
+    "Python-scalar/tuple params of jitted defs must be declared "
+    "static; static positions must receive hashables",
+    check,
+)

@@ -1,0 +1,188 @@
+"""``launch-host-sync`` — no host synchronization in the launch stage.
+
+The pipelined engine's whole point (PR 2) is the launch/finish overlap:
+``launch`` enqueues one batched device program and returns while the
+previous batch decodes on the finish worker. A host sync lexically
+inside launch-stage code — ``force_scalar`` / ``.block_until_ready()``
+/ ``jax.device_get`` / ``.item()`` / ``np.asarray(out)`` on the
+dispatch result — serializes the two stages: the flusher blocks on
+batch k's execution before batch k+1 can dispatch, silently reverting
+the pipeline to synchronous serving. Host syncs belong to ``finish``.
+
+Scope: ``launch`` / ``_launch_*`` methods of dispatch routes
+(``is_dispatch = True`` classes under ``serve/routes/``, resolved
+through locally-visible base classes) and the engines' own
+``_device_launch``. Host-shaped routes (overlay, taxonomy host rungs)
+deliberately solve inside ``launch`` and are out of scope — their
+``finish`` is the identity and there is nothing to overlap.
+
+What fires:
+
+- ``*.block_until_ready(...)``, ``jax.device_get(...)``,
+  ``force_scalar(...)``, ``*.item()`` — unconditional: these exist to
+  block on device values;
+- ``np.asarray(v)`` / ``np.array(v)`` / ``float(v)`` / ``int(v)``
+  where ``v`` tracks to the dispatch output (a name bound by calling a
+  hook unpacked from a ``*_dispatch(...)`` call) — reading the output
+  forces execution on lazy runtimes (PERF_NOTES.md: values execute at
+  the read). Host-array construction (``np.zeros`` padding,
+  ``np.asarray(pairs)`` over Python lists) stays legal.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from bibfs_tpu.analysis.lint import Finding
+from bibfs_tpu.analysis.rules.common import Rule, attr_chain, iter_classes
+
+_ALWAYS_SYNC_ATTRS = frozenset(("block_until_ready", "item"))
+_ALWAYS_SYNC_CALLS = frozenset(("force_scalar", "device_get"))
+_READERS = frozenset(("asarray", "array", "float", "int"))
+
+
+def _class_index(project):
+    """One project-wide pass shared by every file check: ``direct`` =
+    class names setting ``is_dispatch = True`` in their own body,
+    ``by_file`` = every ClassDef by name (bases resolve by name across
+    the project)."""
+    direct: set[str] = set()
+    by_file: dict = {}
+    for qpf in project.files:
+        for qual, cls in iter_classes(qpf.tree):
+            by_file.setdefault(cls.name, []).append(cls)
+            if any(
+                isinstance(stmt, ast.Assign)
+                and any(isinstance(t, ast.Name) and t.id == "is_dispatch"
+                        for t in stmt.targets)
+                and isinstance(stmt.value, ast.Constant)
+                and stmt.value.value is True
+                for stmt in cls.body
+            ):
+                direct.add(cls.name)
+    return direct, by_file
+
+
+def _dispatch_classes(pf, index):
+    """ClassDefs in ``pf`` that are dispatch routes: ``is_dispatch =
+    True`` in their own body, or inherited from a base (by name) that
+    sets it anywhere in the project."""
+    direct, by_file = index
+
+    def dispatchy(cls, seen=()):
+        if cls.name in direct:
+            return True
+        for base in cls.bases:
+            name = attr_chain(base)[-1]
+            if name in seen:
+                continue
+            for bcls in by_file.get(name, ()):
+                if dispatchy(bcls, seen + (name,)):
+                    return True
+        return False
+
+    return [
+        (qual, cls) for qual, cls in iter_classes(pf.tree)
+        if dispatchy(cls)
+    ]
+
+
+def _launch_functions(pf, index):
+    rel = pf.rel.replace("\\", "/")
+    out = []
+    if rel.startswith("bibfs_tpu/serve/routes/"):
+        for qual, cls in _dispatch_classes(pf, index):
+            for stmt in cls.body:
+                if isinstance(stmt, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)) and (
+                        stmt.name == "launch"
+                        or stmt.name.startswith("_launch")):
+                    out.append((f"{qual}.{stmt.name}", stmt))
+    if rel.startswith("bibfs_tpu/serve/"):
+        for node in ast.walk(pf.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node.name == "_device_launch":
+                out.append((node.name, node))
+    return out
+
+
+def _device_output_names(fn) -> set:
+    """Names in ``fn`` that hold the dispatch output: hooks unpacked
+    from ``*_dispatch(...)`` calls, and results of calling a hook."""
+    hooks: set[str] = set()
+    outs: set[str] = set()
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Assign):
+            continue
+        value = node.value
+        if isinstance(value, ast.Call):
+            callee = attr_chain(value.func)[-1]
+            targets = []
+            for t in node.targets:
+                targets.extend(
+                    t.elts if isinstance(t, (ast.Tuple, ast.List))
+                    else [t])
+            names = [t.id for t in targets if isinstance(t, ast.Name)]
+            if callee.endswith("_dispatch") or callee == "dispatch":
+                hooks.update(names)
+            elif isinstance(value.func, ast.Name) \
+                    and value.func.id in hooks:
+                outs.update(names)
+    return outs
+
+
+def _base_name(node):
+    while isinstance(node, (ast.Subscript, ast.Attribute)):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+def check(project):
+    findings = []
+    index = _class_index(project)
+    for pf in project.files:
+        if not pf.rel.replace("\\", "/").startswith("bibfs_tpu/serve/"):
+            continue
+        for qual, fn in _launch_functions(pf, index):
+            outs = _device_output_names(fn)
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                chain = attr_chain(node.func)
+                if chain[-1] in _ALWAYS_SYNC_ATTRS \
+                        and len(chain) > 1:
+                    findings.append(Finding(
+                        "launch-host-sync", pf.rel, node.lineno,
+                        f"{chain[-1]}() in launch-stage {qual} — a "
+                        "host sync here serializes the launch/finish "
+                        "overlap; move it to the finish stage",
+                    ))
+                    continue
+                if chain[-1] in _ALWAYS_SYNC_CALLS:
+                    findings.append(Finding(
+                        "launch-host-sync", pf.rel, node.lineno,
+                        f"{'.'.join(chain)}(...) in launch-stage "
+                        f"{qual} — forcing execution belongs to the "
+                        "finish stage (the pipelined engine overlaps "
+                        "batch k+1's launch with batch k's finish)",
+                    ))
+                    continue
+                if chain[-1] in _READERS and node.args:
+                    base = _base_name(node.args[0])
+                    if base is not None and base in outs:
+                        findings.append(Finding(
+                            "launch-host-sync", pf.rel, node.lineno,
+                            f"{chain[-1]}({base}...) reads the "
+                            f"dispatch output in launch-stage {qual} "
+                            "— on lazy runtimes the value read IS the "
+                            "execution barrier; decode in finish",
+                        ))
+    return findings
+
+
+RULE = Rule(
+    "launch-host-sync",
+    "no host syncs (force_scalar/block_until_ready/device reads) in "
+    "dispatch-route launch stages",
+    check,
+)

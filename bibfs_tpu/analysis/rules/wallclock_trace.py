@@ -1,0 +1,91 @@
+"""``no-wallclock-in-trace`` — no ``time.*`` inside traced bodies.
+
+A ``time.time()`` / ``time.perf_counter()`` inside a function that jax
+traces does not measure anything: it runs ONCE, at trace time, and the
+Python float it returns is baked into the compiled program as a
+constant — every later dispatch replays the stale value. Worse, a
+``time.sleep`` traces into nothing at all (the compiled program skips
+it) while still stalling every RE-trace, so a retrace leak shows up as
+mysterious latency. Timing belongs outside the program (the
+``solvers/timing.py`` force-read protocol); traced bodies own math
+only.
+
+Traced bodies are resolved lexically per file: jit-decorated defs,
+defs passed by name to ``jax.jit`` (unwrapped through ``vmap`` /
+``shard_map``), every def nested inside a builder whose call result
+feeds a jit (``jax.jit(_build_kernel(...))``), and defs passed to
+``lax.while_loop`` / ``fori_loop`` / ``scan`` / ``cond``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from bibfs_tpu.analysis.lint import Finding
+from bibfs_tpu.analysis.rules.common import (
+    Rule,
+    attr_chain,
+    traced_functions,
+)
+
+_TIME_CALLS = frozenset((
+    "time", "perf_counter", "perf_counter_ns", "monotonic",
+    "monotonic_ns", "process_time", "sleep", "time_ns",
+))
+
+
+def _time_names(tree):
+    """``(module_aliases, bare_names)``: every local name the ``time``
+    module (or one of its clock functions) is bound to in this file —
+    ``import time``, ``import time as _time``, ``from time import
+    perf_counter [as pc]`` all count; the call-site check resolves
+    through them so an alias is not a lint bypass."""
+    modules, bare = {"time"}, {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "time":
+                    modules.add(a.asname or a.name)
+        elif isinstance(node, ast.ImportFrom) and node.module == "time":
+            for a in node.names:
+                if a.name in _TIME_CALLS:
+                    bare[a.asname or a.name] = a.name
+    return modules, bare
+
+
+def check(project):
+    findings = []
+    for pf in project.files:
+        traced = traced_functions(pf.tree)
+        if not traced:
+            continue
+        modules, bare = _time_names(pf.tree)
+        seen_lines = set()
+        for fn, why in traced.items():
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                chain = attr_chain(node.func)
+                if len(chain) == 1 and chain[0] in bare:
+                    chain = ("time", bare[chain[0]])
+                if len(chain) >= 2 and chain[0] in modules \
+                        and chain[-1] in _TIME_CALLS:
+                    if node.lineno in seen_lines:
+                        continue  # nested defs are marked twice
+                    seen_lines.add(node.lineno)
+                    findings.append(Finding(
+                        "no-wallclock-in-trace", pf.rel, node.lineno,
+                        f"time.{chain[-1]}() inside traced body "
+                        f"{fn.name} ({why}) — it runs once at trace "
+                        "time and bakes a constant into the compiled "
+                        "program; time outside the program "
+                        "(solvers/timing.py's force-read protocol)",
+                    ))
+    return findings
+
+
+RULE = Rule(
+    "no-wallclock-in-trace",
+    "no time.* calls inside jit/lax-traced bodies",
+    check,
+)

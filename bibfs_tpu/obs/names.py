@@ -57,6 +57,7 @@ CACHE_METRIC_FAMILIES = (
     "bibfs_exec_cache_events_total",
     "bibfs_exec_programs",
     "bibfs_exec_program_dispatches_total",
+    "bibfs_exec_compiles_total",
 )
 
 #: failure-handling telemetry (serve/resilience threading + serve/faults);

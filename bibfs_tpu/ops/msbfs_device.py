@@ -110,7 +110,7 @@ def _build_msbfs_kernel(n_pad2: int, wp: int, tc: int, words: int):
             (mask_words[:, :, None] >> shifts32) & jnp.uint32(1)
         ).reshape(n_pad2, kp)
 
-    def kernel(nbr, deg, sources):
+    def msbfs_kernel(nbr, deg, sources):
         nbr_t = sentinel_transposed_table(nbr, deg, n_pad2, n_pad2, wp)
         k_idx = jnp.arange(kp, dtype=jnp.int32)
         w_idx = k_idx // WORD_BITS
@@ -226,7 +226,7 @@ def _build_msbfs_kernel(n_pad2: int, wp: int, tc: int, words: int):
         dmax = jnp.max(jnp.where(reached, cnt, 0))
         return dist16, dmax, level
 
-    return kernel
+    return msbfs_kernel
 
 
 @lru_cache(maxsize=None)
@@ -348,7 +348,7 @@ def _build_msbfs_blocked_kernel(nblocks: int, bwidth: int, kp: int,
 
     n_pad = nblocks * tile
 
-    def kernel(tab, bcol, sources):
+    def msbfs_blocked_kernel(tab, bcol, sources):
         k_idx = jnp.arange(kp, dtype=jnp.int32)
         valid = sources >= 0
         srcs = jnp.where(valid, sources, 0)
@@ -377,7 +377,7 @@ def _build_msbfs_blocked_kernel(nblocks: int, bwidth: int, kp: int,
         _v, _p, dist, _go, _level = jax.lax.while_loop(cond, body, st)
         return dist
 
-    return kernel
+    return msbfs_blocked_kernel
 
 
 @lru_cache(maxsize=None)

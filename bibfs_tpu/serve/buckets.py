@@ -29,6 +29,7 @@ import threading
 
 import numpy as np
 
+from bibfs_tpu.analysis import compilegraph as _compilegraph
 from bibfs_tpu.graph.csr import EllGraph, build_ell
 from bibfs_tpu.obs.metrics import REGISTRY, next_instance_label
 
@@ -235,6 +236,17 @@ class ExecutableCache:
             "Dispatches per compiled-program identity",
             ("cache", "program"),
         )
+        # minted at construction so the family renders at zero: compiles
+        # are a first-class scrape-time signal — in steady state
+        # rate(bibfs_exec_compiles_total) must be 0, and an alert on it
+        # catches a retrace leak without waiting for a bench-time
+        # program_counts() diff
+        self._m_compile = REGISTRY.counter(
+            "bibfs_exec_compiles_total",
+            "First-seen compiled programs (a steady-state serving "
+            "process must not pay new compiles)",
+            ("cache", "program"),
+        )
 
     @property
     def hits(self) -> int:
@@ -250,7 +262,19 @@ class ExecutableCache:
         The registry cells are lock-free (obs/metrics.py's contract:
         mutators of one cell serialize externally), so every increment
         happens under THIS cache's lock — it is the shared
-        DEFAULT_EXEC_CACHE that concurrent engines note into."""
+        DEFAULT_EXEC_CACHE that concurrent engines note into.
+
+        Under ``BIBFS_COMPILE_CHECK=1`` a MISS also publishes the key
+        to the compile sentinel thread-locally: a first-seen program's
+        solve compiles synchronously on this thread, so the compile
+        event it triggers attributes to this key — that is how
+        ``compilegraph.json`` knows which compiles were routed
+        (single-shot + expiring on the sentinel side, so a miss whose
+        kernel was already warm leaves nothing claimable). A HIT
+        retires any published key instead: no first compile is
+        expected, and a compile that happens anyway (a retrace reusing
+        a noted key) is one the accounting layer did NOT pay for —
+        reporting it unrouted is the signal."""
         with self._lock:
             if key in self._seen:
                 self._seen[key] += 1
@@ -261,9 +285,16 @@ class ExecutableCache:
                 hit = False
                 self._m_miss.inc()
                 self._g_programs.inc()
+                self._m_compile.labels(
+                    cache=self.metrics_label, program=str(key)
+                ).inc()
             self._m_dispatch.labels(
                 cache=self.metrics_label, program=str(key)
             ).inc()
+        if hit:
+            _compilegraph.clear_routed_key()
+        else:
+            _compilegraph.note_routed_key(key)
         return hit
 
     def stats(self) -> dict:

@@ -723,10 +723,16 @@ def run_churn(
        snapshot lands in the same ELL shape bucket, and a second graph
        (``twin``: the same graph under a vertex relabeling, so the same
        bucket by construction) serves ``twin_fraction`` of the traffic;
-       the :class:`~bibfs_tpu.serve.buckets.ExecutableCache` program
-       count after warmup must not grow through all swaps and both
-       graphs (hit counters are the witness — the committed
-       ``bench_update.json`` carries them).
+       the gate is derived from the compile SENTINEL
+       (:mod:`bibfs_tpu.analysis.compilegraph`, installed for the
+       soak): zero compilation events recorded after warmup, through
+       all swaps and both graphs. The sentinel counts actual XLA
+       trace+lower events, which is strictly stronger than the old
+       hand-diffed :class:`~bibfs_tpu.serve.buckets.ExecutableCache`
+       ``program_counts()`` snapshot — a retrace that reuses a noted
+       key would pass the counter diff and still stall the serving
+       path; the counter diff rides along in the artifact as the
+       accounting view.
 
     Returns the machine-readable ``bench_update.json`` payload (``ok``
     aggregates the gates)."""
@@ -794,19 +800,30 @@ def run_churn(
             adds.append(e)
         return adds, dels
 
-    exec_cache = ExecutableCache()
-    engine = PipelinedQueryEngine(
-        store=store, graph="main",
-        flush_threshold=flush_threshold, max_batch=max_batch,
-        device_batches=True, exec_cache=exec_cache,
-        max_wait_ms=max_wait_ms,
-        **engine_kwargs,
-    )
-    t_setup = time.perf_counter()
-    epochs_out = []
-    lost, failed, mismatches = [], [], []
-    max_lat_s = 0.0
+    # the retrace sentinel IS the zero-recompiles gate (docstring):
+    # installed before the engine exists so warmup compiles are
+    # visible — and UNINSTALLED on the way out unless something else
+    # (conftest under BIBFS_COMPILE_CHECK=1) owned it first: the soak
+    # must not leave jax's pxla compile logging hijacked for the rest
+    # of an embedding process that never opted in
+    from bibfs_tpu.analysis import compilegraph
+
+    _owns_sentinel = not compilegraph.enabled()
+    sentinel = compilegraph.install()
+    engine = None
     try:
+        exec_cache = ExecutableCache()
+        engine = PipelinedQueryEngine(
+            store=store, graph="main",
+            flush_threshold=flush_threshold, max_batch=max_batch,
+            device_batches=True, exec_cache=exec_cache,
+            max_wait_ms=max_wait_ms,
+            **engine_kwargs,
+        )
+        t_setup = time.perf_counter()
+        epochs_out = []
+        lost, failed, mismatches = [], [], []
+        max_lat_s = 0.0
         # warm the (single-rung) batch program through BOTH graphs with
         # fresh unique pairs per round until the program set stabilizes;
         # the baseline taken here is what every later swap is gated
@@ -827,6 +844,7 @@ def run_churn(
                     break
             programs_after[g] = exec_cache.stats()["programs"]
         baseline = exec_cache.stats()
+        compiles_baseline = sentinel.total_compiles()
         cross_graph_reuse = (
             programs_after["twin"] == programs_after["main"]
         )
@@ -973,6 +991,10 @@ def run_churn(
         ex = exec_cache.stats()
         stranded = stats["pipeline"]["outstanding"]
         recompiles = ex["programs"] - baseline["programs"]
+        # the gate's currency: actual trace+lower events since warmup
+        recompiles_sentinel = (
+            sentinel.total_compiles() - compiles_baseline
+        )
         swaps_total = store_stats["graphs"]["main"]["swaps"]
         out = {
             "n": int(n),
@@ -1009,6 +1031,7 @@ def run_churn(
                 "programs_baseline": baseline["programs"],
                 "programs_end": ex["programs"],
                 "recompiles_during_churn": recompiles,
+                "compile_events_during_churn": recompiles_sentinel,
                 "hits": ex["hits"],
                 "misses": ex["misses"],
                 "cross_graph_reuse": cross_graph_reuse,
@@ -1035,7 +1058,13 @@ def run_churn(
             "zero_failed": not failed,
             "verified_vs_oracle": not mismatches and not final_bad,
             "swap_stall_ok": max_lat_s * 1e3 <= stall_bound_ms,
-            "zero_recompiles": recompiles == 0 and cross_graph_reuse,
+            # gated on the SENTINEL's event count (docstring): a
+            # retrace that reuses a noted key passes the counter diff
+            # (recompiles) but not the trace+lower count
+            "zero_recompiles": (
+                recompiles == 0 and recompiles_sentinel == 0
+                and cross_graph_reuse
+            ),
             "routes_exercised": (
                 stats["overlay_queries"] > 0
                 and stats["device_batches"] > 0
@@ -1050,8 +1079,11 @@ def run_churn(
         )
         return out
     finally:
-        engine.close()
+        if engine is not None:
+            engine.close()
         store.close()
+        if _owns_sentinel:
+            compilegraph.uninstall()
 
 
 def run_oracle(

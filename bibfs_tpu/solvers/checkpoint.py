@@ -114,7 +114,7 @@ def _dense_chunk_kernel(mode: str, push_cap: int, tier_meta: tuple, chunk: int):
     cap = push_cap if DENSE_MODES[mode][1] else 0
     k = max(cap, 1)
 
-    def kernel(nbr, deg, aux, st):
+    def dense_chunk_kernel(nbr, deg, aux, st):
         body = _make_body(mode, cap, tier_meta, nbr, deg, aux)
 
         def cond2(c):
@@ -132,7 +132,7 @@ def _dense_chunk_kernel(mode: str, push_cap: int, tier_meta: tuple, chunk: int):
     # (st = step(st)), so the previous buffers are dead — without donation
     # each dispatch holds TWO full copies of the vertex state, which is
     # what pushed the scale-24 dense run over single-chip HBM
-    return jax.jit(kernel, donate_argnums=3)
+    return jax.jit(dense_chunk_kernel, donate_argnums=3)
 
 
 @lru_cache(maxsize=None)
@@ -158,7 +158,7 @@ def _sharded_chunk_kernel(
     st_spec = {key: sh for key in _VERTEX_KEYS}
     st_spec.update({key: rep for key in _SCALAR_KEYS})
 
-    def fn(nbr, deg, aux, st):
+    def sharded_chunk_kernel(nbr, deg, aux, st):
         body = _make_shard_body(
             nbr, deg, aux, axis=axis, mode=mode, push_cap=cap,
             tier_meta=tier_meta,
@@ -179,7 +179,7 @@ def _sharded_chunk_kernel(
 
     return jax.jit(
         _shard_map(
-            fn,
+            sharded_chunk_kernel,
             mesh=mesh,
             in_specs=(sh, sh, aux_spec, st_spec),
             out_specs=dict(st_spec),
@@ -219,7 +219,7 @@ def _sharded2d_chunk_kernel(
     st_spec = {key: own for key in _VERTEX_KEYS}
     st_spec.update({key: rep for key in _SCALAR_KEYS})
 
-    def fn(bnbr, bcnt, deg, aux, st):
+    def sharded2d_chunk_kernel(bnbr, bcnt, deg, aux, st):
         tiers = tuple(
             (start, tn[0, 0], ti[0, 0])
             for (start, _kp, _wt), (tn, ti) in zip(tier_meta, aux)
@@ -244,7 +244,7 @@ def _sharded2d_chunk_kernel(
 
     return jax.jit(
         _shard_map(
-            fn,
+            sharded2d_chunk_kernel,
             mesh=mesh,
             in_specs=(blk4, blk3, own, aux_spec, dict(st_spec)),
             out_specs=dict(st_spec),

@@ -246,7 +246,7 @@ def _build_blocked_kernel(nblocks: int, bwidth: int, b: int, dt, rc: int,
     enters the program."""
     n_pad = nblocks * tile
 
-    def kernel(tab, bcol, deg, srcs, dsts):
+    def blocked_kernel(tab, bcol, deg, srcs, dsts):
         qi = jnp.arange(b, dtype=jnp.int32)
         fr = (
             jnp.zeros((n_pad, 2 * b), dt)
@@ -275,7 +275,7 @@ def _build_blocked_kernel(nblocks: int, bwidth: int, b: int, dt, rc: int,
             out["levels"], out["edges"],
         )
 
-    return kernel
+    return blocked_kernel
 
 
 @lru_cache(maxsize=None)
@@ -766,7 +766,7 @@ def _build_fused_kernel(tier_meta: tuple = (), unroll: int = 1):
 
     assert FINF == INF32
 
-    def kernel(nbr, deg, aux, src, dst):
+    def dense_fused_kernel(nbr, deg, aux, src, dst):
         n_pad = nbr.shape[0]
         if tier_meta or not fused_fits(n_pad, width=nbr.shape[1]):
             # degrade to the round-3 kernel path (which may itself degrade
@@ -836,7 +836,7 @@ def _build_fused_kernel(tier_meta: tuple = (), unroll: int = 1):
             out["edges"],
         )
 
-    return kernel
+    return dense_fused_kernel
 
 
 def _build_fused_alt_kernel(tier_meta: tuple = (), unroll: int = 1):
@@ -852,7 +852,7 @@ def _build_fused_alt_kernel(tier_meta: tuple = (), unroll: int = 1):
         prepare_fused_tables,
     )
 
-    def kernel(nbr, deg, aux, src, dst):
+    def dense_fused_alt_kernel(nbr, deg, aux, src, dst):
         n_pad = nbr.shape[0]
         if tier_meta or not fused_fits(n_pad, width=nbr.shape[1]):
             return _build_kernel("pallas_alt", 0, tier_meta, unroll)(
@@ -929,7 +929,7 @@ def _build_fused_alt_kernel(tier_meta: tuple = (), unroll: int = 1):
             out["edges"],
         )
 
-    return kernel
+    return dense_fused_alt_kernel
 
 
 def _build_kernel(mode: str, push_cap: int, tier_meta: tuple = (),
@@ -952,7 +952,7 @@ def _build_kernel(mode: str, push_cap: int, tier_meta: tuple = (),
     cap = push_cap if DENSE_MODES[mode][1] else 0
     k = max(cap, 1)
 
-    def kernel(nbr, deg, aux, src, dst):
+    def dense_kernel(nbr, deg, aux, src, dst):
         n_pad = nbr.shape[0]
         kmode = mode
         if DENSE_MODES[mode][2]:
@@ -977,7 +977,7 @@ def _build_kernel(mode: str, push_cap: int, tier_meta: tuple = (),
         return _outputs(
             jax.lax.while_loop(_cond, _unrolled(body, unroll), init))
 
-    return kernel
+    return dense_kernel
 
 
 @lru_cache(maxsize=None)
@@ -1101,16 +1101,19 @@ def _get_traced_side_step(mode: str, cap: int, tier_meta: tuple, side: str):
     side) so a traced solve pays one compile per side, then per-level
     dispatches."""
 
-    def fn(nbr, deg, aux, st):
+    def traced_side_step(nbr, deg, aux, st):
         return _side_step(st, side, nbr, deg, aux, tier_meta,
                           push_cap=cap, use_pallas=False)
 
-    return jax.jit(fn)
+    return jax.jit(traced_side_step)
 
 
 @lru_cache(maxsize=None)
 def _get_traced_vote(delta: int):
-    return jax.jit(lambda st: _meet_vote(st, delta))
+    def traced_meet_vote(st):
+        return _meet_vote(st, delta)
+
+    return jax.jit(traced_meet_vote)
 
 
 def _solve_dense_traced(

@@ -57,7 +57,7 @@ def _build_delta_kernel(n_pad: int, width: int):
     traced, so one compiled program serves every query and seed of
     the geometry."""
 
-    def kernel(tgt, wts, src, dst, delta):
+    def delta_kernel(tgt, wts, src, dst, delta):
         light = wts <= delta
         dist0 = jnp.full((n_pad,), F_INF, jnp.float32).at[src].set(0.0)
 
@@ -120,7 +120,7 @@ def _build_delta_kernel(n_pad: int, width: int):
         )
         return dist, buckets, relaxed
 
-    return kernel
+    return delta_kernel
 
 
 @lru_cache(maxsize=None)
@@ -235,7 +235,7 @@ def _build_restricted_kernel(n_pad2: int, wp: int, tc: int, b: int):
     reads."""
     num_chunks = n_pad2 // tc
 
-    def kernel(nbr, deg, seed_dist, blocked, dsts):
+    def restricted_kernel(nbr, deg, seed_dist, blocked, dsts):
         nbr_t = sentinel_transposed_table(nbr, deg, n_pad2, n_pad2, wp)
         qi = jnp.arange(b, dtype=jnp.int32)
         frontier0 = (seed_dist == 1).astype(jnp.int8)
@@ -285,7 +285,7 @@ def _build_restricted_kernel(n_pad2: int, wp: int, tc: int, b: int):
         dist, _f, _lvl, _go = jax.lax.while_loop(cond, body, st)
         return dist
 
-    return kernel
+    return restricted_kernel
 
 
 @lru_cache(maxsize=None)

@@ -530,7 +530,7 @@ def _sharded_fused_prog(axis: str, unroll: int = 1):
         prepare_fused_tables,
     )
 
-    def prog(nbr, deg, aux, src, dst):
+    def sharded_fused_kernel(nbr, deg, aux, src, dst):
         del aux  # plain ELL only; the router guarantees it
         n_loc = nbr.shape[0]
         ndev = _axis_size(axis)
@@ -631,7 +631,7 @@ def _sharded_fused_prog(axis: str, unroll: int = 1):
             out["edges"],
         )
 
-    return prog
+    return sharded_fused_kernel
 
 
 def _sharded_fn(
@@ -657,8 +657,11 @@ def _sharded_fn(
             out_specs=(rep, rep, sh, sh, rep, rep),
             check_vma=_check_vma_for(mode, geom),
         )
-    return shard_map(
-        lambda nbr, deg, aux, src, dst: _bibfs_shard_body(
+    def sharded_kernel(nbr, deg, aux, src, dst):
+        # named def, not a lambda: the compile sentinel keys program
+        # budgets on the traced callable's name — '<lambda>' is
+        # exactly the anonymous label the gate rejects
+        return _bibfs_shard_body(
             nbr,
             deg,
             aux,
@@ -669,7 +672,10 @@ def _sharded_fn(
             push_cap=cap,
             tier_meta=tier_meta,
             unroll=unroll,
-        ),
+        )
+
+    return shard_map(
+        sharded_kernel,
         mesh=mesh,
         in_specs=(sh, sh, aux_spec, rep, rep),
         out_specs=(rep, rep, sh, sh, rep, rep),

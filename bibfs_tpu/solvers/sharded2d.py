@@ -339,7 +339,7 @@ def _2d_fn(mesh, R: int, C: int, mode: str, tier_meta: tuple = ()):
     rep = P()
     aux_spec = tuple((blk4, blk3) for _ in tier_meta)
 
-    def fn(bnbr, bcnt, deg, aux, src, dst):
+    def sharded2d_kernel(bnbr, bcnt, deg, aux, src, dst):
         tiers = tuple(
             (start, tn[0, 0], ti[0, 0])
             for (start, _kp, _wt), (tn, ti) in zip(tier_meta, aux)
@@ -349,7 +349,7 @@ def _2d_fn(mesh, R: int, C: int, mode: str, tier_meta: tuple = ()):
         )
 
     return shard_map(
-        fn,
+        sharded2d_kernel,
         mesh=mesh,
         in_specs=(blk4, blk3, own, aux_spec, rep, rep),
         out_specs=(rep, rep, own, own, rep, rep),

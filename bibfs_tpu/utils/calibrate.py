@@ -106,7 +106,10 @@ def run_calibration(
     width = g.width
 
     # --- dispatch stall: cached vs fresh device-scalar argument ---------
-    noop = jax.jit(lambda d, s: d[s] + 1)
+    def dispatch_probe(d, s):
+        return d[s] + 1
+
+    noop = jax.jit(dispatch_probe)
     cached = jnp.int32(3)
     dispatch_cached_us = _median_us(lambda: noop(deg, cached), repeats)
     # a FRESH eager scalar per call is exactly what _device_scalar avoids

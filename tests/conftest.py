@@ -27,6 +27,20 @@ if _LOCK_CHECK:
 
     _lockgraph.install()
 
+# Dynamic retrace sentinel (bibfs_tpu/analysis/compilegraph):
+# BIBFS_COMPILE_CHECK=1 hooks JAX's per-compile lowering record so every
+# compilation event is attributed to a declared program family with a
+# compile budget — the suite doubles as the compile-discipline harness
+# the same way it doubles as the race harness. Install order does not
+# matter for correctness (the hook is a logger, created on demand), but
+# it sits here with its twin so every compile from the first import on
+# is recorded.
+_COMPILE_CHECK = os.environ.get("BIBFS_COMPILE_CHECK", "") not in ("", "0")
+if _COMPILE_CHECK:
+    from bibfs_tpu.analysis import compilegraph as _compilegraph
+
+    _compilegraph.install()
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
@@ -45,7 +59,10 @@ def _lockgraph_gate():
     session end (BIBFS_LOCK_REPORT, default lockgraph.json) and FAIL
     the session if any lock-order cycle was recorded — a cycle raised
     inside a swallow-and-count background thread (e.g. a compaction
-    job) would otherwise pass silently."""
+    job) would otherwise pass silently. The write goes through
+    graph/io._atomic_replace: the --lock-report CI step parses this
+    file, and a teardown crash mid-write must leave the previous
+    complete artifact, not a torn one."""
     yield
     if not _LOCK_CHECK:
         return
@@ -56,6 +73,32 @@ def _lockgraph_gate():
         f"{path}):\n" + "\n".join(
             f"{e['from']} -> {e['to']}"
             for rec in rep["cycles"] for e in rec["cycle"]
+        )
+    )
+
+
+@pytest.fixture(autouse=True, scope="session")
+def _compilegraph_gate():
+    """Under BIBFS_COMPILE_CHECK=1: write the compile-graph JSON
+    artifact at session end (BIBFS_COMPILE_REPORT, default
+    compilegraph.json — atomic, like its lockgraph twin) and FAIL the
+    session on any anonymous compile (a program family no budget
+    declares — the anonymously-jitted-helper retrace trap) or any
+    over-budget family (a retrace leak: more compiles than its shape
+    ladder allows). Render with `bibfs-lint --compile-report`."""
+    yield
+    if not _COMPILE_CHECK:
+        return
+    path = os.environ.get("BIBFS_COMPILE_REPORT", "compilegraph.json")
+    _compilegraph.save_report(path)
+    bad = _compilegraph.graph().violations()
+    assert not bad["anonymous"] and not bad["over_budget"], (
+        "compile-discipline violations recorded during the session "
+        f"(see {path}):\n" + "\n".join(
+            [f"anonymous compile {ev['program']} at {ev['site']}"
+             for ev in bad["anonymous"]]
+            + [f"over budget: {r['program']} x{r['compiles']} "
+               f"(budget {r['budget']})" for r in bad["over_budget"]]
         )
     )
 

@@ -13,7 +13,7 @@ import numpy as np
 import jax.numpy as jnp
 import pytest
 
-from bibfs_tpu.graph.blocked import TILE, blocked_bucket_key, build_blocked
+from bibfs_tpu.graph.blocked import TILE, build_blocked
 from bibfs_tpu.graph.csr import build_csr, canonical_pairs
 from bibfs_tpu.graph.generate import gnp_random_graph, grid_graph
 from bibfs_tpu.ops.blocked_expand import (
@@ -157,38 +157,3 @@ def test_blocked_fits_bounds():
     assert not blocked_fits(4096, 4096, 128, itemsize=1)
 
 
-def test_placement_key_never_collides_with_device_or_mesh():
-    """A blocked executable of the same padded vertex shape must never
-    count as a hit on the single-device or mesh program — the
-    ExecutableCache keys must differ structurally."""
-    from bibfs_tpu.serve.buckets import (
-        bucketed_ell,
-        ell_bucket_key,
-        placement_bucket_key,
-    )
-
-    n = 1000
-    edges = gnp_random_graph(n, 8 / n, seed=9)
-    pairs = canonical_pairs(n, edges)
-    ell = bucketed_ell(n, pairs=pairs)
-    bg = build_blocked(n, pairs=pairs)
-    rung = 256
-    dev_key = (ell_bucket_key(ell), "minor8", rung)
-    mesh_key = placement_bucket_key(
-        ell_bucket_key(ell), kind="mesh1d", shards=8, extra=("sync", rung)
-    )
-    dp_key = placement_bucket_key(
-        ell_bucket_key(ell), kind="dp", shards=8, extra=("dt8", rung)
-    )
-    blk_key = placement_bucket_key(
-        blocked_bucket_key(bg), kind="blocked", shards=1,
-        extra=("float32", rung),
-    )
-    keys = {dev_key, mesh_key, dp_key, blk_key}
-    assert len(keys) == 4
-    # and two dtype variants of the blocked program are distinct too
-    blk8 = placement_bucket_key(
-        blocked_bucket_key(bg), kind="blocked", shards=1,
-        extra=("int8", rung),
-    )
-    assert blk8 != blk_key

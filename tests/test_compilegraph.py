@@ -1,0 +1,236 @@
+"""Compile-sentinel tests (bibfs_tpu/analysis/compilegraph): program
+accounting, budgets, report render/gate, the ExecutableCache key
+attribution seam — and the seeded-retrace canary: a deliberately
+shape-leaky jit spliced into the REAL serving stack must be caught
+with its call site named, in a subprocess session of its own. If a
+future edit disables the sentinel, the canary is the test that fails
+red."""
+
+import json
+import subprocess
+import sys
+import textwrap
+
+from bibfs_tpu.analysis import compilegraph
+from bibfs_tpu.analysis import lint as lint_mod
+from bibfs_tpu.analysis.compilegraph import (
+    CompileGraph,
+    PROGRAM_BUDGETS,
+    render_report,
+)
+
+DENSE_PID = "bibfs_tpu/solvers/dense.py:dense_kernel"
+
+
+def _repo_sited(monkeypatch, lineno=100):
+    monkeypatch.setattr(
+        compilegraph, "_repo_site",
+        lambda: (f"bibfs_tpu/solvers/dense.py:{lineno}",
+                 "bibfs_tpu/solvers/dense.py"),
+    )
+
+
+def test_declared_program_accounting(monkeypatch):
+    _repo_sited(monkeypatch)
+    g = CompileGraph()
+    g.note_routed_key(("ell", 256, 8))
+    g.note_compile("dense_kernel", "[ShapedArray(int32[256,8])]")
+    g.note_compile("dense_kernel", "[ShapedArray(int32[512,8])]")
+    assert g.total_compiles() == 2
+    bad = g.violations()
+    assert bad["anonymous"] == [] and bad["over_budget"] == []
+    rep = g.report()
+    (row,) = rep["programs"]
+    assert row["program"] == DENSE_PID
+    assert row["compiles"] == 2 and not row["over_budget"]
+    assert row["routed"] and str(("ell", 256, 8)) in row["routed_keys"]
+
+
+def test_anonymous_compile_is_a_violation(monkeypatch):
+    _repo_sited(monkeypatch)
+    g = CompileGraph()
+    g.note_compile("mystery_kernel", "[ShapedArray(f32[4])]")
+    bad = g.violations()
+    assert len(bad["anonymous"]) == 1
+    ev = bad["anonymous"][0]
+    assert ev["program"] == "bibfs_tpu/solvers/dense.py:mystery_kernel"
+    assert ev["site"] == "bibfs_tpu/solvers/dense.py:100"
+    text, ok = render_report(g.report())
+    assert not ok and "ANONYMOUS" in text and "mystery_kernel" in text
+
+
+def test_over_budget_is_a_violation(monkeypatch):
+    _repo_sited(monkeypatch)
+    g = CompileGraph()
+    budget = PROGRAM_BUDGETS[DENSE_PID]
+    for i in range(budget + 1):
+        g.note_compile("dense_kernel", f"[shape{i}]")
+    bad = g.violations()
+    assert bad["anonymous"] == []
+    (over,) = bad["over_budget"]
+    assert over["program"] == DENSE_PID
+    assert over["compiles"] == budget + 1
+    text, ok = render_report(g.report())
+    assert not ok and "OVER-BUDGET" in text
+
+
+def test_incidental_labels_share_a_budget(monkeypatch):
+    _repo_sited(monkeypatch)
+    g = CompileGraph()
+    g.note_compile("convert_element_type", "[i32[4]]")
+    assert g.violations()["anonymous"] == []
+    (row,) = g.report()["programs"]
+    assert row["budget"] == compilegraph.INCIDENTAL_BUDGET
+
+
+def test_anonymous_retention_capped_but_counted(monkeypatch):
+    """A per-call retrace leak in a long soak must not grow the event
+    list with the leak: full events cap at _ANON_KEEP, the true count
+    keeps incrementing (and still fails the gate/render)."""
+    _repo_sited(monkeypatch)
+    g = CompileGraph()
+    extra = 7
+    for i in range(compilegraph._ANON_KEEP + extra):
+        g.note_compile("mystery_kernel", f"[shape{i}]")
+    rep = g.report()
+    assert len(rep["anonymous"]) == compilegraph._ANON_KEEP
+    assert rep["anonymous_total"] == compilegraph._ANON_KEEP + extra
+    assert g.total_compiles() == compilegraph._ANON_KEEP + extra
+    text, ok = render_report(rep)
+    assert not ok and f"and {extra} more" in text
+
+
+def test_routed_key_is_single_shot_and_cleared_on_hit(monkeypatch):
+    """The attribution seam must never let a stale dispatch key claim
+    a later compile: a declared-family compile consumes the key, and
+    an ExecutableCache HIT retires it (no first compile expected — a
+    retrace reusing a noted key reports unrouted, which is the
+    signal)."""
+    from bibfs_tpu.serve.buckets import ExecutableCache
+
+    _repo_sited(monkeypatch)
+    g = CompileGraph()
+    monkeypatch.setattr(compilegraph, "_STATE", g)
+    cache = ExecutableCache(metrics_label="routed-key-test")
+    key = ("ell", 128, 8)
+    cache.note(key)  # miss: publishes the key
+    g.note_compile("dense_kernel", "[i32[128,8]]")  # consumes it
+    g.note_compile("dense_kernel", "[i32[256,8]]")  # no key left
+    (row,) = g.report()["programs"]
+    assert row["routed_keys"] == [str(key)]
+    cache.note(key)  # HIT: retires the fresh key
+    g.note_compile("dense_fused_kernel", "[i32[128,8]]")
+    rows = {r["label"]: r for r in g.report()["programs"]}
+    assert not rows["dense_fused_kernel"]["routed"]
+
+
+def test_external_compiles_recorded_not_gated():
+    # called from THIS test file: no bibfs frame on the stack
+    g = CompileGraph()
+    g.note_compile("somebody_elses_fn", "[f32[2]]")
+    assert g.total_compiles() == 1
+    assert g.violations() == {"anonymous": [], "over_budget": []}
+    rep = g.report()
+    assert rep["programs"] == []
+    assert len(rep["external"]) == 1
+    assert rep["external"][0]["label"] == "somebody_elses_fn"
+
+
+def test_save_report_atomic_and_empty_when_off(tmp_path):
+    path = tmp_path / "compilegraph.json"
+    rep = compilegraph.save_report(str(path))
+    on_disk = json.loads(path.read_text())
+    assert on_disk["schema"] == "bibfs-compilegraph-v1"
+    assert on_disk["total_compiles"] == rep["total_compiles"]
+    assert not list(tmp_path.glob("*.tmp.*"))  # committed, no debris
+
+
+def test_compile_report_cli(tmp_path, capsys, monkeypatch):
+    _repo_sited(monkeypatch)
+    g = CompileGraph()
+    g.note_compile("dense_kernel", "[i32[8]]")
+    clean = tmp_path / "clean.json"
+    clean.write_text(json.dumps(g.report()))
+    assert lint_mod.main(["--compile-report", str(clean)]) == 0
+    assert "dense_kernel" in capsys.readouterr().out
+    g.note_compile("mystery_kernel", "[i32[8]]")
+    dirty = tmp_path / "dirty.json"
+    dirty.write_text(json.dumps(g.report()))
+    assert lint_mod.main(["--compile-report", str(dirty)]) == 1
+
+
+_CANARY = textwrap.dedent("""
+    import os
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    from bibfs_tpu.analysis import compilegraph
+
+    cg = compilegraph.install()
+
+    import jax
+    import numpy as np
+
+    import bibfs_tpu.solvers.batch_minor as bm
+    from bibfs_tpu.graph.generate import gnp_random_graph
+    from bibfs_tpu.serve.engine import QueryEngine
+
+    # the seeded retrace: splice an anonymously-jitted helper over the
+    # memoized kernel builder — exactly the regression the jit-cache
+    # lint forbids lexically, reproduced dynamically. Every flush now
+    # creates a FRESH traced callable, so jax recompiles per call.
+    orig_build = bm._build_minor_kernel
+
+    def leaky(n_pad2, wp, tc, b, dt8=False, tier_meta=()):
+        fn = orig_build(0, n_pad2, wp, tc, b, dt8, tier_meta)
+
+        def canary_leaky_kernel(*args):
+            return fn(*args)
+
+        return jax.jit(canary_leaky_kernel)
+
+    bm._get_minor_kernel_shape = leaky  # bypasses the lru_cache memo
+
+    n = 800
+    edges = gnp_random_graph(n, 3.0 / n, seed=7)
+    eng = QueryEngine(n, edges, device_batches=True, cache_entries=0)
+    rng = np.random.default_rng(0)
+    for _round in range(2):
+        pairs = [(int(rng.integers(n)), int(rng.integers(n)))
+                 for _ in range(300)]
+        eng.query_many(pairs)
+    eng.close()
+
+    bad = cg.violations()
+    leaks = [ev for ev in bad["anonymous"]
+             if ev["label"] == "canary_leaky_kernel"]
+    assert len(leaks) >= 2, bad  # one fresh compile PER flush
+    for ev in leaks:
+        # caught with its call site named, in repo code
+        assert ev["site"].startswith("bibfs_tpu/solvers/"), ev
+    compilegraph.save_report("compilegraph.json")
+    print("CANARY_TRIPPED", len(leaks))
+""")
+
+
+def test_seeded_retrace_canary_trips_the_sentinel(tmp_path):
+    """The acceptance-criteria canary: a shape-leaky jit spliced into
+    the real engine is caught (anonymous, repo call site named) by a
+    real install() in a subprocess session. Editing the sentinel into
+    a no-op makes this test fail red."""
+    proc = subprocess.run(
+        [sys.executable, "-c", _CANARY],
+        cwd=tmp_path, capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stderr[-4000:]
+    assert "CANARY_TRIPPED" in proc.stdout
+    rep = json.loads((tmp_path / "compilegraph.json").read_text())
+    assert any(ev["label"] == "canary_leaky_kernel"
+               for ev in rep["anonymous"])
+    # the conftest session gate fails on exactly this report shape
+    assert rep["anonymous"]
+
+
+def test_budget_table_keys_are_repo_modules():
+    for pid in PROGRAM_BUDGETS:
+        mod, _, label = pid.rpartition(":")
+        assert mod.startswith("bibfs_tpu/") and mod.endswith(".py"), pid
+        assert label.isidentifier(), pid

@@ -418,6 +418,406 @@ def test_bare_except_quiet_on_named(tmp_path):
     assert found == []
 
 
+# ---- jit-cache -------------------------------------------------------
+BAD_JIT_CACHE = {
+    "bibfs_tpu/solvers/k.py": """
+    import jax
+
+    def _build(mode):
+        def kernel(x):
+            return x
+        return kernel
+
+    HOT = jax.jit(_build("sync"))        # anonymous module-level jit
+
+    def dispatch(x):
+        return jax.jit(_build("sync"))(x)   # fresh jit per call
+    """,
+}
+
+GOOD_JIT_CACHE = {
+    "bibfs_tpu/solvers/k.py": """
+    from functools import lru_cache
+
+    import jax
+
+    def _build(mode):
+        def kernel(x):
+            return x
+        return kernel
+
+    @lru_cache(maxsize=None)
+    def _get_kernel(mode):
+        return jax.jit(_build(mode))
+    """,
+}
+
+
+def test_jit_cache_fires_outside_memo(tmp_path):
+    found, _ = rule_findings(tmp_path, BAD_JIT_CACHE, "jit-cache")
+    assert len(found) == 2
+    assert any("module level" in f.message for f in found)
+    assert any("in dispatch" in f.message for f in found)
+
+
+def test_jit_cache_quiet_on_memoized_builder(tmp_path):
+    found, _ = rule_findings(tmp_path, GOOD_JIT_CACHE, "jit-cache")
+    assert found == []
+
+
+def test_jit_cache_scoped_to_program_modules(tmp_path):
+    # the same anonymous jit outside serve/solvers/ops is out of scope
+    # (utils/tpu_aot compiles per audit entry on purpose)
+    files = {"bibfs_tpu/utils/probe.py":
+             BAD_JIT_CACHE["bibfs_tpu/solvers/k.py"]}
+    found, _ = rule_findings(tmp_path, files, "jit-cache")
+    assert found == []
+
+
+def test_jit_cache_route_note_must_use_placement_key(tmp_path):
+    files = {"bibfs_tpu/serve/routes/r.py": """
+    from bibfs_tpu.serve.buckets import placement_bucket_key
+
+    class MeshyRoute:
+        is_dispatch = True
+
+        def launch(self, rt, pairs):
+            self.engine.exec_cache.note(("ell", 1024, 16))  # bare shape
+
+    class GoodRoute:
+        is_dispatch = True
+
+        def launch(self, rt, pairs):
+            self.engine.exec_cache.note(placement_bucket_key(
+                ("ell", 1024, 16), kind="mesh1d", shards=8,
+            ))
+
+    class SilentRoute:
+        is_dispatch = True
+
+        def launch(self, rt, pairs):
+            return rt.solve(pairs)   # never notes, never delegates
+    """}
+    found, _ = rule_findings(tmp_path, files, "jit-cache")
+    assert len(found) == 2
+    assert any("placement_bucket_key" in f.message for f in found)
+    assert any("SilentRoute" in f.message for f in found)
+
+
+# ---- jit-static-args -------------------------------------------------
+def test_jit_static_args_fires_on_undeclared_scalar(tmp_path):
+    files = {"bibfs_tpu/solvers/s.py": """
+    import jax
+
+    @jax.jit
+    def step(x, mode: str, cap=4):
+        return x
+
+    def fn(x, width: int):
+        return x
+
+    STEP2 = jax.jit(fn)
+    """}
+    found, _ = rule_findings(tmp_path, files, "jit-static-args")
+    msgs = "\n".join(f.message for f in found)
+    assert len(found) == 3
+    assert "mode" in msgs and "cap" in msgs and "width" in msgs
+
+
+def test_jit_static_args_quiet_when_declared(tmp_path):
+    files = {"bibfs_tpu/solvers/s.py": """
+    from functools import partial
+
+    import jax
+
+    @partial(jax.jit, static_argnames=("mode", "cap"))
+    def step(x, mode: str, cap=4):
+        return x
+
+    def fn(x, width: int):
+        return x
+
+    STEP2 = jax.jit(fn, static_argnums=(1,))
+    """}
+    found, _ = rule_findings(tmp_path, files, "jit-static-args")
+    assert found == []
+
+
+def test_jit_static_args_covers_kwonly_and_posonly(tmp_path):
+    """Keyword-only and positional-only scalar params are the same
+    retrace trap: a `*, mode` escaping the scan would let the
+    codebase's dominant keyword-only style lint clean while jax
+    retraces per distinct value. static_argnums indexes count
+    positional-only params; static_argnames is the only declaration
+    that reaches a keyword-only param."""
+    files = {"bibfs_tpu/solvers/s.py": """
+    from functools import partial
+
+    import jax
+
+    @jax.jit
+    def step(x, *, mode: str = "sync"):
+        return x
+
+    @jax.jit
+    def step2(cap: int, x, /):
+        return x
+
+    @partial(jax.jit, static_argnames=("mode",))
+    def declared(x, *, mode: str = "sync"):
+        return x
+
+    @partial(jax.jit, static_argnums=(0,))
+    def declared2(cap: int, x, /):
+        return x
+    """}
+    found, _ = rule_findings(tmp_path, files, "jit-static-args")
+    msgs = "\n".join(f.message for f in found)
+    assert len(found) == 2
+    assert "step(...mode...)" in msgs and "step2(...cap...)" in msgs
+
+
+def test_jit_static_args_fires_on_unhashable_static(tmp_path):
+    files = {"bibfs_tpu/solvers/s.py": """
+    import jax
+
+    def fn(x, meta):
+        return x
+
+    STEP = jax.jit(fn, static_argnums=(1,))
+
+    def caller(x):
+        return STEP(x, [1, 2])   # unhashable static arg
+    """}
+    found, _ = rule_findings(tmp_path, files, "jit-static-args")
+    assert len(found) == 1 and "unhashable" in found[0].message
+
+
+# ---- launch-host-sync ------------------------------------------------
+BAD_LAUNCH_SYNC = {
+    "bibfs_tpu/serve/routes/r.py": """
+    import numpy as np
+
+    from bibfs_tpu.solvers.timing import force_scalar
+
+    class LeakyRoute:
+        is_dispatch = True
+
+        def launch(self, rt, pairs):
+            _p, run, fin = rt.dp_batch_dispatch(pairs)
+            out = run()
+            force_scalar(out)              # sync in launch
+            out.block_until_ready()        # sync in launch
+            planes = np.asarray(out)       # reads the dispatch output
+            return planes, fin, 0.0
+    """,
+}
+
+GOOD_LAUNCH_SYNC = {
+    "bibfs_tpu/serve/routes/r.py": """
+    import numpy as np
+
+    from bibfs_tpu.solvers.timing import force_scalar
+
+    class CleanRoute:
+        is_dispatch = True
+
+        def launch(self, rt, pairs):
+            padded = np.zeros((128, 2))          # host padding: legal
+            arr = np.asarray(pairs)              # host list: legal
+            _p, run, fin = rt.dp_batch_dispatch(arr)
+            out = run()
+            return out, fin, 0.0
+
+        def finish(self, out, fin, t0, pairs):
+            force_scalar(out)                    # finish stage: legal
+            return np.asarray(out)
+
+    class HostRoute:
+        # host-shaped (no is_dispatch): solves in launch by design
+        def launch(self, rt, pairs):
+            out = rt.solve(pairs)
+            return float(out[0]), None, 0.0
+    """,
+}
+
+
+def test_launch_host_sync_fires(tmp_path):
+    found, _ = rule_findings(tmp_path, BAD_LAUNCH_SYNC,
+                             "launch-host-sync")
+    msgs = "\n".join(f.message for f in found)
+    assert len(found) == 3
+    assert "force_scalar" in msgs and "block_until_ready" in msgs
+    assert "asarray(out" in msgs
+
+
+def test_launch_host_sync_quiet_on_clean_and_host_routes(tmp_path):
+    found, _ = rule_findings(tmp_path, GOOD_LAUNCH_SYNC,
+                             "launch-host-sync")
+    assert found == []
+
+
+# ---- no-wallclock-in-trace -------------------------------------------
+def test_wallclock_in_trace_fires(tmp_path):
+    files = {"bibfs_tpu/solvers/t.py": """
+    import time
+    from functools import lru_cache
+
+    import jax
+
+    def _build(mode):
+        def kernel(x):
+            t0 = time.perf_counter()    # traces to a constant
+            return x + t0
+        return kernel
+
+    @lru_cache(maxsize=None)
+    def _get(mode):
+        return jax.jit(_build(mode))
+
+    @jax.jit
+    def stamped(x):
+        return x * time.time()          # same trap, decorated form
+    """}
+    found, _ = rule_findings(tmp_path, files, "no-wallclock-in-trace")
+    msgs = "\n".join(f.message for f in found)
+    assert len(found) == 2
+    assert "perf_counter" in msgs and "time.time()" in msgs
+
+
+def test_wallclock_fires_through_aliases(tmp_path):
+    """`import time as _time` and `from time import perf_counter` are
+    the same trap under a different name — the rule resolves both, so
+    an alias is not a lint bypass."""
+    files = {"bibfs_tpu/solvers/t.py": """
+    import time as _time
+    from time import perf_counter as _pc
+    from functools import lru_cache
+
+    import jax
+
+    def _build(mode):
+        def kernel(x):
+            t0 = _time.monotonic()      # module alias
+            return x + t0 + _pc()       # from-import alias
+        return kernel
+
+    @lru_cache(maxsize=None)
+    def _get(mode):
+        return jax.jit(_build(mode))
+    """}
+    found, _ = rule_findings(tmp_path, files, "no-wallclock-in-trace")
+    msgs = "\n".join(f.message for f in found)
+    assert len(found) == 2
+    assert "monotonic" in msgs and "perf_counter" in msgs
+
+
+def test_wallclock_quiet_outside_trace(tmp_path):
+    files = {"bibfs_tpu/solvers/t.py": """
+    import time
+    from functools import lru_cache
+
+    import jax
+
+    def _build(mode):
+        def kernel(x):
+            return x
+        return kernel
+
+    @lru_cache(maxsize=None)
+    def _get(mode):
+        return jax.jit(_build(mode))
+
+    def dispatch(x):
+        t0 = time.perf_counter()        # host code: timing is legal
+        out = _get("sync")(x)
+        return out, time.perf_counter() - t0
+    """}
+    found, _ = rule_findings(tmp_path, files, "no-wallclock-in-trace")
+    assert found == []
+
+
+# ---- chaos-site ------------------------------------------------------
+def test_chaos_site_fires_both_directions(tmp_path):
+    files = {
+        "bibfs_tpu/serve/faults.py": """
+        KNOWN_SITES = ("device", "ghost")
+
+        class FaultPlan:
+            def fire(self, site, pairs=None):
+                pass
+        """,
+        "bibfs_tpu/serve/engine.py": """
+        SPEC = "phantom:every=2"
+
+        class Engine:
+            def flush(self, pairs):
+                self._faults.fire("typo", pairs)
+                self._faults.fire("device", pairs)
+        """,
+    }
+    found, _ = rule_findings(tmp_path, files, "chaos-site")
+    msgs = "\n".join(f.message for f in found)
+    assert len(found) == 2
+    assert "'typo'" in msgs           # fired but never declared
+    assert "'phantom'" in msgs        # spec'd but never declared
+
+
+def test_chaos_site_quiet_when_reconciled(tmp_path):
+    files = {
+        "bibfs_tpu/serve/faults.py": """
+        KNOWN_SITES = ("device",)
+
+        class FaultPlan:
+            def fire(self, site, pairs=None):
+                pass
+        """,
+        "bibfs_tpu/serve/engine.py": """
+        SPEC = "device:every=2"
+
+        class Engine:
+            def flush(self, pairs):
+                self._faults.fire("device", pairs)
+        """,
+    }
+    found, _ = rule_findings(tmp_path, files, "chaos-site")
+    assert found == []
+
+
+def test_chaos_site_docstring_spec_is_prose(tmp_path):
+    """A docstring quoting a stale spec example must not fail the
+    build — the spec-literal direction scans code strings only, the
+    same exclusion the exercised-site direction already applies."""
+    files = {
+        "bibfs_tpu/serve/faults.py": """
+        KNOWN_SITES = ("device",)
+
+        class FaultPlan:
+            def fire(self, site, pairs=None):
+                pass
+        """,
+        "bibfs_tpu/serve/engine.py": '''
+        """Spec syntax example: "old_renamed_site:p=0.5"."""
+
+        class Engine:
+            def flush(self, pairs):
+                self._faults.fire("device", pairs)
+        ''',
+    }
+    found, _ = rule_findings(tmp_path, files, "chaos-site")
+    assert found == []
+
+
+def test_chaos_site_full_tree_reconciles():
+    """The real tree passes both full-scan directions: every declared
+    site fired by an engine seam AND exercised by a test/soak (the
+    mesh_finish/blocked_finish gap this rule's first run surfaced is
+    now covered)."""
+    project = Project.load(lint_mod._repo_root())
+    findings, _ = run(project)
+    assert [f for f in findings if f.rule == "chaos-site"] == []
+
+
 # ---- suppression policing --------------------------------------------
 def test_unjustified_suppression_is_a_finding(tmp_path):
     files = {"bibfs_tpu/serve/b.py": """

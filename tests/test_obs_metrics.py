@@ -139,6 +139,25 @@ def test_exec_cache_stats_are_registry_views():
         assert disp.labels(cache=lbl, program=key).value == count
 
 
+def test_exec_compiles_total_renders_at_zero_and_counts_misses():
+    """bibfs_exec_compiles_total: the family renders before any
+    traffic (minted at cache construction — compiles are a scrape-time
+    signal, not a bench-time diff), and each first-seen program counts
+    exactly one compile no matter how many dispatches follow."""
+    c = ExecutableCache(metrics_label="compiles-test")
+    assert "bibfs_exec_compiles_total" in REGISTRY.render()
+    c.note(("k", 1))
+    c.note(("k", 1))
+    c.note(("k", 2))
+    fam = REGISTRY.get("bibfs_exec_compiles_total")
+    assert fam.labels(cache="compiles-test",
+                      program=str(("k", 1))).value == 1
+    assert fam.labels(cache="compiles-test",
+                      program=str(("k", 2))).value == 1
+    # total compiles across the cache == distinct programs
+    assert c.stats()["programs"] == 2
+
+
 def test_dist_cache_stats_are_registry_views():
     cache = DistanceCache(entries=2, pair_entries=2)
     par = np.array([-1, 0, 1, 2], dtype=np.int32)

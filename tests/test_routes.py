@@ -169,6 +169,27 @@ def test_mesh_fault_degrades_to_host_with_counters():
     assert st["mesh_queries"] == 0
 
 
+def test_mesh_finish_fault_degrades_to_host():
+    """The finish-stage seam (``mesh_finish``) is its own injection
+    point: launch succeeds, the forced value read fails — the route
+    must degrade exactly like a launch-seam fault (every declared
+    chaos site is exercised; the chaos-site lint holds this door
+    open)."""
+    n, edges = N, _graph()
+    eng = QueryEngine(
+        n, edges, mesh=MeshConfig(shard_min_n=0), flush_threshold=4,
+        faults=FaultPlan.parse("mesh_finish:p=1.0"),
+    )
+    pairs = _pairs(n, 12)
+    results = eng.query_many(pairs)
+    _assert_matches_oracle(n, edges, pairs, results, "mesh-finish-faulted")
+    st = eng.stats()
+    assert st["resilience"]["fallbacks"]["mesh->host"] >= 1
+    assert st["mesh_queries"] == 0
+    assert st["host_queries"] == len(pairs)
+    eng.close()
+
+
 def test_mesh_breaker_opens_and_gauge_tracks():
     from bibfs_tpu.obs.metrics import REGISTRY
 
@@ -244,20 +265,55 @@ def test_pipelined_mesh_parity_and_fault_degrade():
 
 
 # ---- placement-aware executable keys --------------------------------
-def test_placement_bucket_key_distinguishes_mesh_from_device():
+#: every placement family the serving stack keys executables under —
+#: the exhaustive matrix replaces the per-PR pairwise collision tests
+#: (mesh-vs-device, blocked-vs-mesh, kind-vs-kind) that each new route
+#: used to add by hand
+PLACEMENT_KINDS = {
+    "mesh1d": dict(shards=8, extra=("sync", 128)),
+    "dp": dict(shards=8, extra=("dt8", 128)),
+    "blocked": dict(shards=1, extra=("float32", 128)),
+    "msbfs": dict(shards=1, extra=(2,)),
+    "msbfs_device": dict(shards=1, extra=(2,)),
+    "weighted_device": dict(shards=1),
+    "kshortest_device": dict(shards=1),
+}
+
+
+def test_placement_bucket_key_exhaustive_distinctness():
+    """ALL placement kinds on IDENTICAL padded shapes produce pairwise
+    distinct executable keys — and none collides with the bare
+    single-device base key. One matrix, every pair: a new placement
+    family added to PLACEMENT_KINDS is collision-checked against every
+    existing one for free."""
     base = ("ell", 1024, 16)
-    k_mesh = placement_bucket_key(base, kind="mesh1d", shards=8,
-                                  extra=("sync", 128))
-    k_dp = placement_bucket_key(base, kind="dp", shards=8,
-                                extra=("dt8", 128))
-    assert base != k_mesh != k_dp
-    cache = ExecutableCache(metrics_label="test-placement")
-    assert cache.note(base) is False
-    # the old collision: a mesh program of the same padded shape must
-    # NOT count as a hit on the single-device executable
-    assert cache.note(k_mesh) is False
-    assert cache.note(k_dp) is False
-    assert cache.note(k_mesh) is True
+    keys = {"<device-base>": base}
+    for kind, kw in PLACEMENT_KINDS.items():
+        keys[kind] = placement_bucket_key(base, kind=kind, **kw)
+    names = list(keys)
+    for i, a in enumerate(names):
+        for b in names[i + 1:]:
+            assert keys[a] != keys[b], (a, b, keys[a])
+    # the ExecutableCache agrees: each key is its own first-seen program
+    cache = ExecutableCache(metrics_label="test-placement-matrix")
+    for key in keys.values():
+        assert cache.note(key) is False
+    assert cache.stats()["programs"] == len(keys)
+    for key in keys.values():
+        assert cache.note(key) is True
+    # same kind, different shard count / extra => different program
+    for kind, kw in PLACEMENT_KINDS.items():
+        grown = dict(kw, shards=kw["shards"] * 2)
+        assert placement_bucket_key(base, kind=kind, **grown) \
+            != keys[kind], kind
+        stretched = dict(kw, extra=tuple(kw.get("extra", ())) + ("x",))
+        assert placement_bucket_key(base, kind=kind, **stretched) \
+            != keys[kind], kind
+    # and a different base shape never aliases across kinds either
+    other = ("ell", 2048, 16)
+    for kind, kw in PLACEMENT_KINDS.items():
+        assert placement_bucket_key(other, kind=kind, **kw) \
+            not in keys.values(), kind
 
 
 def test_engine_notes_distinct_keys_per_placement():

@@ -137,6 +137,27 @@ def test_blocked_fault_degrades_to_host_and_breaker_opens(rng):
         eng.close()
 
 
+def test_blocked_finish_fault_degrades(rng):
+    """The finish-stage seam (``blocked_finish``): the blocked launch
+    lands, the decode fails — degrade like a launch fault, never
+    answer wrong (every declared chaos site is exercised; the
+    chaos-site lint holds this door open)."""
+    edges, pairs, csr = _graph(seed=11)
+    eng = QueryEngine(
+        N, edges, pairs=pairs, blocked=True, cache_entries=0,
+        flush_threshold=4,
+        faults=FaultPlan.parse("blocked_finish:times=2"),
+    )
+    try:
+        qp = _pairs(rng, N, 160)
+        results = eng.query_many(qp)
+        _check_exact(N, csr, qp, results)
+        fb = eng.stats()["resilience"]["fallbacks"]
+        assert fb.get("blocked->device", 0) + fb.get("blocked->host", 0) >= 1
+    finally:
+        eng.close()
+
+
 def test_blocked_store_hot_swap_exact(rng):
     n = 600
     edges, pairs, csr = _graph(n=n, deg=24, seed=5)
