@@ -107,11 +107,25 @@ LAST_TPU_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              "bench_last_tpu.json")
 
 
+def _peak_rss_bytes() -> int | None:
+    """Peak resident set (``VmHWM``) of THIS process, read from
+    ``/proc/self/status`` — subprocess-free, so stamping an artifact
+    never perturbs the memory number it reports. None off-Linux."""
+    try:
+        with open("/proc/self/status") as f:
+            for ln in f:
+                if ln.startswith("VmHWM:"):
+                    return int(ln.split()[1]) * 1024
+    except (OSError, ValueError, IndexError):
+        pass
+    return None
+
+
 def bench_meta() -> dict:
     """The shared provenance block stamped into EVERY ``bench_*.json``
-    artifact (git rev, platform, jax version, timestamp) so trajectory
-    artifacts are comparable across PRs — which run produced a number
-    is part of the number."""
+    artifact (git rev, platform, jax version, peak RSS, timestamp) so
+    trajectory artifacts are comparable across PRs — which run (and how
+    much memory it took) produced a number is part of the number."""
     here = os.path.dirname(os.path.abspath(__file__))
     try:
         rev = subprocess.run(
@@ -134,6 +148,7 @@ def bench_meta() -> dict:
         "python": sys.version.split()[0],
         "jax": jax_ver,
         "numpy": np.__version__,
+        "peak_rss_bytes": _peak_rss_bytes(),
         "timestamp": time.strftime("%Y-%m-%dT%H:%M:%S"),
     }
 
@@ -2365,9 +2380,96 @@ def serve_fleet_main():
         return 1
 
 
+# --serve-memtier defaults: the memory-tier soak serves one streamed
+# RMAT graph (scale 24 ≈ 16.7M nodes full / scale 14 quick) from ONE
+# durable store dir through a fleet of mmap-recovering subprocess
+# replicas and gates on shared-page-cache residency (aggregate PSS
+# <= 1.4x one private copy), exact answers vs fresh native BFS,
+# SIGKILL recovery-by-remap beating the --no-mmap rebuild, zero
+# compile-sentinel events post-warmup, and the cold-tier codec/
+# accountant; --quick is the CI smoke shape (every leg runs; the
+# machine-shape-sensitive RSS and remap-speed ratios are reported, not
+# gated — at smoke scale the interpreter dominates both)
+MEMTIER_SCALE = int(os.environ.get("BENCH_MEMTIER_SCALE", 24))
+MEMTIER_EDGE_FACTOR = int(os.environ.get("BENCH_MEMTIER_EDGE_FACTOR", 8))
+MEMTIER_REPLICAS = int(os.environ.get("BENCH_MEMTIER_REPLICAS", 3))
+MEMTIER_Q = int(os.environ.get("BENCH_MEMTIER_Q", 48))
+MEMTIER_RSS_FACTOR = float(os.environ.get("BENCH_MEMTIER_RSS_FACTOR", 1.4))
+
+
+def serve_memtier_main():
+    """``python bench.py --serve-memtier``: the memory-tier scale soak.
+
+    A 10M+-node streamed RMAT graph in one durable store directory,
+    served by a 3-replica subprocess fleet that memory-maps the same
+    checkpointed arrays sidecar (bibfs_tpu/serve/loadgen.run_memtier).
+    Gates: aggregate fleet PSS bounded by ~1.4x one private copy, exact
+    answers vs fresh native BFS on every replica and after a SIGKILL
+    respawn, recovery-by-remap faster than the --no-mmap rebuild at the
+    exact store digest, zero compile-sentinel events post-warmup, and
+    the compressed cold tier round-tripping bit-exactly under the
+    residency accountant. Artifact: ``bench_memtier.json``."""
+    t_setup = time.time()
+    platform, tpu_error = select_platform()
+    try:
+        from bibfs_tpu.serve.loadgen import run_memtier
+
+        quick = "--quick" in sys.argv
+        out = run_memtier(
+            scale=14 if quick else MEMTIER_SCALE,
+            edge_factor=MEMTIER_EDGE_FACTOR,
+            replicas=MEMTIER_REPLICAS,
+            queries=24 if quick else MEMTIER_Q,
+            rss_factor=MEMTIER_RSS_FACTOR,
+            quick=quick,
+        )
+        line = {
+            "metric": f"bibfs_serve_memtier_{out['n']}",
+            "value": out["rss_ratio"],
+            "unit": "x (fleet PSS / one private copy)",
+            "graph": "rmat(scale={s}, ef={f})".format(
+                s=out["scale"], f=out["edge_factor"]
+            ),
+            "platform": platform,
+            "quick": quick,
+            **out,
+            "total_s": round(time.time() - t_setup, 1),
+        }
+        if tpu_error:
+            line["tpu_error"] = tpu_error[:300]
+        _write_artifact("bench_memtier.json", line)
+        print(json.dumps({
+            "metric": line["metric"],
+            "value": line["value"],
+            "unit": line["unit"],
+            "ok": line["ok"],
+            "rss_ratio": out["rss_ratio"],
+            "rss_ok": out["rss_ok"],
+            "rebuild_ready_s": out["rebuild_ready_s"],
+            "remap_ready_s": out["remap_ready_s"],
+            "compile_events": out["compile_events"],
+            "cold_ratio": out["cold_tier"]["ratio"],
+            "decode_mb_s": out["cold_tier"]["decode_mb_s"],
+            "detail_file": "bench_memtier.json",
+        }))
+        return 0 if line["ok"] else 1
+    except Exception as e:
+        import traceback
+
+        traceback.print_exc()
+        print(json.dumps({
+            "metric": "bibfs_serve_memtier",
+            "value": None,
+            "error": f"{type(e).__name__}: {e}"[:400],
+        }))
+        return 1
+
+
 if __name__ == "__main__":
     if "--calibrate" in sys.argv:
         sys.exit(calibrate_main())
+    elif "--serve-memtier" in sys.argv:
+        sys.exit(serve_memtier_main())
     elif "--serve-crash" in sys.argv:
         sys.exit(serve_crash_main())
     elif "--serve-mesh" in sys.argv:

@@ -1,4 +1,4 @@
-"""``atomic-write`` — served data files commit by tmp + ``os.replace``.
+"""``atomic-write`` — served data files commit by tmp + rename.
 
 The durability layer's whole recovery argument rests on one property:
 a reader (or a recovering process) sees either the old complete file or
@@ -9,11 +9,29 @@ served ``.bin``/manifest path for writing directly would silently void
 it — exactly the class of regression a reviewer won't spot in a +500
 line PR.
 
-The rule: in the served-data modules (``bibfs_tpu/store/``,
-``bibfs_tpu/graph/``), any ``open(...)`` with a write-creating mode
-(``"w"``, ``"wb"``, ``"w+"``, ...) must sit in a function that also
-calls ``os.replace`` (the tmp+rename idiom — the open is then the tmp
-side). Append (``"ab"`` — the WAL's own format is append-only with CRC
+Two commit idioms are recognized:
+
+- **single file**: write a same-directory tmp, ``os.replace`` onto the
+  final path (``graph/io._atomic_replace``);
+- **directory manifest**: populate a same-directory tmp DIRECTORY
+  (several array files + a manifest), then publish it with ONE
+  ``os.rename`` (``store/sidecar.write_sidecar`` — the arrays-sidecar
+  checkpoint recovery ``np.memmap``s).
+
+The rules:
+
+- in the served-data modules (``bibfs_tpu/store/``,
+  ``bibfs_tpu/graph/``), any ``open(...)`` with a write-creating mode
+  (``"w"``, ``"wb"``, ``"w+"``, ...) must sit in a function that also
+  calls ``os.replace``/``os.rename`` (the open is then the tmp side),
+  OR in a helper every same-module caller of which commits by rename
+  AFTER calling it (the sidecar's per-array writer);
+- **rename-last**: in a committing function, every write-mode open must
+  precede the final rename — a write landing after the commit mutates
+  the already-published path, which is exactly the torn state the idiom
+  exists to rule out.
+
+Append (``"ab"`` — the WAL's own format is append-only with CRC
 framing) and in-place repair (``"r+b"`` — ``repair_wal``'s tail
 truncation) modes are legal.
 """
@@ -61,34 +79,86 @@ def _own_nodes(func):
     yield from walk(func)
 
 
+class _FuncInfo:
+    __slots__ = ("func", "opens", "commit_lines", "calls")
+
+    def __init__(self, func):
+        self.func = func
+        self.opens: list = []        # (node, mode)
+        self.commit_lines: list = []  # linenos of os.replace/os.rename
+        self.calls: list = []         # (callee name, lineno)
+
+
+def _scan_file(pf):
+    """Per-function facts + a same-module call map (by bare name)."""
+    infos = {}
+    for func in [n for n in ast.walk(pf.tree)
+                 if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]:
+        info = _FuncInfo(func)
+        for node in _own_nodes(func):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = attr_chain(node.func)
+            if chain[-2:] in (("os", "replace"), ("os", "rename")):
+                info.commit_lines.append(node.lineno)
+            elif chain == ("open",):
+                mode = _write_mode(node)
+                if mode is not None:
+                    info.opens.append((node, mode))
+            elif len(chain) == 1 and chain[0]:
+                info.calls.append((chain[0], node.lineno))
+        # methods shadow by bare name too rarely to matter; last def wins
+        infos[func.name] = info
+    return infos
+
+
+def _committing_caller_covers(infos, name) -> bool:
+    """True when every same-module caller of ``name`` commits by
+    rename/replace AFTER the call site — the helper is then provably
+    the tmp side of its callers' commit (the sidecar per-array writer
+    pattern). No caller at all is NOT covered: an unreferenced writer
+    must carry its own commit."""
+    covered = False
+    for info in infos.values():
+        for callee, lineno in info.calls:
+            if callee != name:
+                continue
+            if not info.commit_lines or max(info.commit_lines) < lineno:
+                return False
+            covered = True
+    return covered
+
+
 def _check(project):
     findings = []
     for pf in project.files:
         if not any(s in pf.rel.replace("\\", "/") for s in _SCOPES):
             continue
-        # each function (nested ones included) is its own unit: the
-        # open and the os.replace must live in the SAME function
-        for func in [n for n in ast.walk(pf.tree)
-                     if isinstance(n, (ast.FunctionDef,
-                                       ast.AsyncFunctionDef))]:
-            opens = []
-            replaces = False
-            for node in _own_nodes(func):
-                if not isinstance(node, ast.Call):
-                    continue
-                chain = attr_chain(node.func)
-                if chain[-2:] == ("os", "replace"):
-                    replaces = True
-                elif chain == ("open",):
-                    mode = _write_mode(node)
-                    if mode is not None:
-                        opens.append((node, mode))
-            if replaces:
-                continue  # the tmp side of the tmp+replace idiom
-            for node, mode in opens:
+        infos = _scan_file(pf)
+        for name, info in infos.items():
+            if not info.opens:
+                continue
+            if info.commit_lines:
+                # the tmp side of the tmp+rename idiom — but only
+                # writes BEFORE the publishing rename are the tmp side
+                last = max(info.commit_lines)
+                for node, mode in info.opens:
+                    if node.lineno > last:
+                        findings.append(Finding(
+                            "atomic-write", pf.rel, node.lineno,
+                            f"{name} opens a served-data path with mode "
+                            f"{mode!r} AFTER its committing rename "
+                            f"(line {last}) — the directory/file is "
+                            "already published; all writes must land "
+                            "before the rename-last commit",
+                        ))
+                continue
+            if _committing_caller_covers(infos, name):
+                continue  # helper: every caller renames after it
+            for node, mode in info.opens:
                 findings.append(Finding(
                     "atomic-write", pf.rel, node.lineno,
-                    f"{func.name} opens a served-data path with mode "
+                    f"{name} opens a served-data path with mode "
                     f"{mode!r} and never os.replace()s — write to a "
                     "same-directory tmp file and commit by rename "
                     "(graph/io.write_graph_bin is the idiom)",
@@ -98,6 +168,6 @@ def _check(project):
 
 RULE = Rule(
     "atomic-write",
-    "served .bin/manifest writes commit via tmp + os.replace",
+    "served .bin/manifest writes commit via tmp + rename (rename-last)",
     _check,
 )

@@ -190,6 +190,13 @@ class EngineReplica:
         out["dead"] = self._dead
         return out
 
+    def memory(self) -> dict:
+        """Per-graph memory-tier stats (store-backed replicas only):
+        tier, resident/mapped bytes, residency-budget headroom."""
+        if self.store is None:
+            raise ValueError(f"replica {self.name} has no store")
+        return self.store.memory_stats()
+
     def version(self, graph: str | None = None) -> int | None:
         """The snapshot version this replica currently declares for
         ``graph`` — what makes a mid-roll answer attributable."""
@@ -336,7 +343,8 @@ class _Reply:
 #: stdout prefixes that are control replies, not query results (the
 #: swap reply contains " -> " too, so prefixes are checked FIRST)
 _CONTROL_PREFIXES = (
-    "health ", "stats ", "use ", "swap ", "update ", "graphs:", "oracle",
+    "health ", "stats ", "memory ", "use ", "swap ", "update ",
+    "graphs:", "oracle",
 )
 
 
@@ -744,6 +752,18 @@ class ProcessReplica:
             )
         return json.loads(line[len("stats "):])
 
+    def memory(self, timeout: float | None = None) -> dict:
+        """The child's ``memory`` control reply: per-graph tier, mapped
+        bytes and residency-budget headroom (``--store`` children
+        only — a fixed-graph child answers with a usage error, raised
+        here as :class:`ReplicaDead`-shaped ValueError)."""
+        line = self._command("memory", timeout or 60.0)
+        if not line.startswith("memory "):
+            raise ValueError(
+                f"replica {self.name}: bad memory reply {line!r}"
+            )
+        return json.loads(line[len("memory "):])
+
     def version(self, graph: str | None = None) -> int | None:
         if self._store_dir is not None and graph is not None:
             reply = self._command_use(graph)
@@ -923,6 +943,14 @@ class ProcessReplica:
               timeout: float = 10.0) -> bool:
         ticket = self.submit(0, 0, graph)
         return self.wait_ticket(ticket, timeout=timeout) is not None
+
+    @property
+    def pid(self) -> int | None:
+        """The child's OS pid — the memory-tier soak samples
+        ``/proc/<pid>/smaps_rollup`` to prove M replicas share one
+        page-cache copy of the mapped arrays."""
+        proc = getattr(self, "_proc", None)
+        return proc.pid if proc is not None else None
 
     # ---- chaos / lifecycle ------------------------------------------
     def kill(self) -> None:

@@ -74,13 +74,19 @@ RESILIENCE_METRIC_FAMILIES = (
     "bibfs_faults_injected_total",
 )
 
-#: versioned graph store (store/registry.py)
+#: versioned graph store (store/registry.py); the memory-tier trio
+#: (mmap_bytes / tier / remap) is minted at store construction and
+#: per-graph registration like the rest, so every group member renders
+#: at zero before the first checkpoint or recovery
 STORE_METRIC_FAMILIES = (
     "bibfs_store_graphs",
     "bibfs_store_swaps_total",
     "bibfs_store_delta_edges",
     "bibfs_store_compactions_total",
     "bibfs_store_compact_failures_total",
+    "bibfs_store_mmap_bytes",
+    "bibfs_store_tier",
+    "bibfs_store_remap_total",
 )
 
 #: WAL durability layer (store/wal.py + store/registry.py); the crash

@@ -70,7 +70,8 @@ class _SigTerm(Exception):
 
 
 def _control_reply(engine, store, cmd: str) -> str:
-    """The stdin ``health`` / ``stats`` commands' one-line JSON reply
+    """The stdin ``health`` / ``stats`` / ``memory`` commands' one-line
+    JSON reply
     (``health {...}`` / ``stats {...}`` — same reply-in-the-result-
     stream grammar as ``oracle``/``graphs``): the control surface a
     fleet router's subprocess replica driver and a human operator
@@ -78,6 +79,10 @@ def _control_reply(engine, store, cmd: str) -> str:
     probe never perturbs batching."""
     if cmd == "health":
         payload = engine.health_snapshot()
+    elif cmd == "memory":
+        # the memory-tier probe: per-graph tier + resident/mapped bytes
+        # and residency-budget headroom (store/registry.memory_stats)
+        payload = store.memory_stats()
     else:
         payload = engine.stats()
         if store is not None:
@@ -297,6 +302,24 @@ def main(argv=None):
         "only)",
     )
     ap.add_argument(
+        "--residency-budget",
+        type=int,
+        default=None,
+        metavar="BYTES",
+        help="store residency budget: private (non-mapped) snapshot "
+        "bytes above which the store demotes least-recently-acquired "
+        "graphs to the compressed cold tier (promoted back on access; "
+        "default: unlimited). The stdin command 'memory' prints "
+        "per-graph tier + bytes",
+    )
+    ap.add_argument(
+        "--no-mmap",
+        action="store_true",
+        help="disable the arrays sidecar: durable recovery rebuilds "
+        "snapshots from the .bin instead of memory-mapping the "
+        "checkpointed arrays (each replica then holds a private copy)",
+    )
+    ap.add_argument(
         "--pairs",
         default=None,
         metavar="FILE",
@@ -486,6 +509,8 @@ def main(argv=None):
                 oracle_k=args.oracle,
                 durable=args.durable,
                 fsync=args.fsync,
+                mmap_arrays=not args.no_mmap,
+                residency_budget=args.residency_budget,
             )
         except (OSError, ValueError) as e:
             print(f"Error reading store: {e}", file=sys.stderr)
@@ -701,9 +726,12 @@ def _serve(args, n, edges, store, QueryEngine, PipelinedQueryEngine,
                             continue
                         print(_oracle_status(engine, store, current))
                         continue
-                    if parts[0] in ("health", "stats"):
+                    if parts[0] in ("health", "stats", "memory"):
                         if len(parts) != 1:
                             print(f"error invalid: usage: {parts[0]}")
+                            continue
+                        if parts[0] == "memory" and store is None:
+                            print("error invalid: 'memory' needs --store")
                             continue
                         # print already-resolved results FIRST: the
                         # control reply doubles as the subprocess

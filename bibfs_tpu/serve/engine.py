@@ -472,9 +472,22 @@ class _GraphRuntime:
                         solve_native_graph,
                     )
 
-                    ng = NativeGraph.build(
-                        self.n, self.snapshot.undirected_edges()
-                    )
+                    mapped = self.snapshot.native_csr()
+                    if mapped is not None:
+                        # zero-copy: the sidecar's csr32 table is exactly
+                        # the (int64 row_ptr, int32 col_ind) layout the C
+                        # runtime consumes, so M replicas mapping one
+                        # store dir share a single page-cache copy of
+                        # the adjacency instead of M private builds
+                        ng = NativeGraph(
+                            n=self.n,
+                            row_ptr=np.ascontiguousarray(mapped[0]),
+                            col_ind=mapped[1],
+                        )
+                    else:
+                        ng = NativeGraph.build(
+                            self.n, self.snapshot.undirected_edges()
+                        )
                     # kept for the threaded C batch route (_solve_host):
                     # bibfs_solve_batch shares only the read-only CSR and
                     # creates per-C-thread scratches, so the handle is

@@ -69,6 +69,11 @@ ENV_VAR = "BIBFS_FAULTS"
 KNOWN_SITES = ("device", "device_finish", "mesh", "mesh_finish",
                "blocked", "blocked_finish",
                "host_batch", "wal_write", "wal_fsync", "manifest_rename",
+               # arrays-sidecar directory commit (store/sidecar.py):
+               # fires just before the rename-last that publishes the
+               # mmap-able checkpoint arrays — the crash soak's torn-
+               # sidecar recovery leg targets it
+               "sidecar_rename",
                # taxonomy query kinds (serve/routes/taxonomy.py): the
                # packed multi-source sweep, the delta-stepping solve,
                # the Yen's batch, and the as-of historical replay

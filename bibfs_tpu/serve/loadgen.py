@@ -2573,6 +2573,350 @@ def run_crash(
             shutil.rmtree(base, ignore_errors=True)
 
 
+def _proc_mem(pid: int) -> dict | None:
+    """One ``/proc/<pid>/smaps_rollup`` sample in bytes: ``rss`` (all
+    resident pages, shared mapped ones counted in full per process),
+    ``pss`` (proportional — shared pages divided among mappers, so a
+    fleet-wide PSS sum counts one page-cache copy ONCE), ``private``
+    (pages only this process holds). None when the process is gone or
+    the platform has no smaps_rollup."""
+    want = {"Rss:": "rss", "Pss:": "pss",
+            "Private_Clean:": "private", "Private_Dirty:": "private"}
+    out = {"rss": 0, "pss": 0, "private": 0}
+    try:
+        with open(f"/proc/{pid}/smaps_rollup") as f:
+            for ln in f:
+                key = want.get(ln.split(None, 1)[0])
+                if key is not None:
+                    out[key] += int(ln.split()[1]) * 1024
+    except (OSError, ValueError, IndexError):
+        return None
+    return out
+
+
+def run_memtier(
+    *,
+    scale: int = 24,
+    edge_factor: int = 8,
+    replicas: int = 3,
+    queries: int = 48,
+    rss_factor: float = 1.4,
+    residency_probe_budget: int = 1,
+    seed: int = 0,
+    quick: bool = False,
+    spawn_timeout_s: float = 900.0,
+    workdir: str | None = None,
+) -> dict:
+    """The memory-tier soak (``bench.py --serve-memtier``): one durable
+    store directory holding a streamed RMAT graph (scale 24 ≈ 16.7M
+    nodes in the full run) served by a fleet of ``bibfs-serve``
+    subprocess replicas that all ``np.memmap`` the SAME checkpointed
+    arrays sidecar. The claims, gated in the full run (``quick`` runs
+    every leg but only reports the machine-shape-sensitive RSS and
+    remap-speed ratios):
+
+    1. **one page-cache copy, M replicas** — aggregate fleet PSS
+       (proportional RSS: shared mapped pages counted once across the
+       fleet) stays within ``rss_factor`` of the private copy a single
+       ``--no-mmap`` replica costs;
+    2. **exact answers** — every routed query from every replica (and
+       after the respawn) is verified hop-for-hop against fresh native
+       BFS built independently from the ``.bin``;
+    3. **recovery-by-remap beats rebuild** — a SIGKILL'd replica
+       respawns to ready by mapping the sidecar, faster than the
+       ``--no-mmap`` baseline's rebuild-from-``.bin`` spawn, at the
+       exact store digest (verified over the ``memory`` control
+       surface);
+    4. **zero compile-sentinel events post-warmup** — the executable
+       cache reports no new compiles on any replica across the traffic
+       window;
+    5. **cold tier round-trips** — the varint+delta compressed CSR
+       decodes bit-exactly (digest-verified promote after demote),
+       decode bandwidth is benched, and the residency accountant
+       demotes under a starvation budget and promotes on access.
+
+    Returns the ``bench_memtier.json`` payload."""
+    import os
+    import shutil
+    import tempfile
+
+    from bibfs_tpu.fleet import ProcessReplica
+    from bibfs_tpu.graph.compress import decode_csr, encode_snapshot_csr
+    from bibfs_tpu.graph.csr import build_csr
+    from bibfs_tpu.graph.generate import rmat_stream_bin
+    from bibfs_tpu.graph.io import read_graph_bin
+    from bibfs_tpu.store import GraphStore, content_digest
+
+    t_all = time.perf_counter()
+    base = tempfile.mkdtemp(prefix="bibfs-memtier-") \
+        if workdir is None else os.fspath(workdir)
+    store_dir = os.path.join(base, "store")
+    os.makedirs(store_dir, exist_ok=True)
+    rng = np.random.default_rng(seed)
+    checks: list = []
+
+    def check(name, ok, detail=""):
+        checks.append({"check": name, "ok": bool(ok),
+                       "detail": str(detail)[:300]})
+        return bool(ok)
+
+    fleet: list = []
+    baseline = None
+    try:
+        # ---- generate (streamed — never materializes the edge list) --
+        t0 = time.perf_counter()
+        bin_path = os.path.join(base, "rmat.bin")
+        gen = rmat_stream_bin(
+            bin_path, scale, edge_factor, seed=seed,
+        )
+        gen_s = time.perf_counter() - t0
+        n, m = gen["n"], gen["m"]
+
+        # ---- seed the durable store (writes .bin ckpt + sidecar) -----
+        t0 = time.perf_counter()
+        _, edges = read_graph_bin(bin_path)
+        seed_store = GraphStore(
+            wal_dir=store_dir, fsync="off", compact_threshold=None,
+        )
+        seed_store.add("rmat", n, edges)
+        digest = seed_store.current("rmat").digest
+        arrays_dir = seed_store.stats()["graphs"]["rmat"]["durable"]["arrays"]
+        seed_store.close()
+        build_s = time.perf_counter() - t0
+        check("sidecar_written", arrays_dir is not None, arrays_dir)
+
+        # ---- independent truth: fresh native BFS from the .bin -------
+        row_ptr, col_ind = build_csr(n, edges)
+        del edges
+        try:
+            from bibfs_tpu.solvers.native import (
+                NativeGraph,
+                solve_native_graph,
+            )
+
+            ng = NativeGraph(
+                n, np.ascontiguousarray(row_ptr, dtype=np.int64),
+                np.ascontiguousarray(col_ind, dtype=np.int32),
+            )
+
+            def truth(s, d):
+                r = solve_native_graph(ng, s, d)
+                return r.hops if r.found else None
+        except (ImportError, OSError):
+            from bibfs_tpu.solvers.serial import solve_serial_csr
+
+            def truth(s, d):
+                r = solve_serial_csr(n, row_ptr, col_ind, s, d)
+                return r.hops if r.found else None
+
+        pairs = []
+        while len(pairs) < int(queries):
+            s, d = (int(x) for x in rng.integers(0, n, size=2))
+            if s != d:
+                pairs.append((s, d))
+
+        def drive(replica_list, plist):
+            """Round-robin the pairs across the replicas; verify every
+            answer hop-for-hop vs the fresh native truth."""
+            bad = []
+            for i, (s, d) in enumerate(plist):
+                r = replica_list[i % len(replica_list)]
+                res = r.wait_ticket(r.submit(s, d), timeout=120.0)
+                want = truth(s, d)
+                got = None if res is None else (
+                    res.hops if res.found else None
+                )
+                if got != want:
+                    bad.append({"pair": (s, d), "got": got,
+                                "want": want, "replica": r.name})
+            return bad
+
+        # ---- baseline: ONE --no-mmap replica (private copy) ----------
+        t0 = time.perf_counter()
+        baseline = ProcessReplica(
+            "base", store_dir=store_dir, durable=True, fsync="off",
+            extra_args=["--no-mmap"], spawn_timeout_s=spawn_timeout_s,
+        )
+        rebuild_ready_s = time.perf_counter() - t0
+        base_bad = drive([baseline], pairs[: max(8, len(pairs) // 4)])
+        check("baseline_exact", not base_bad, base_bad[:3])
+        base_mem_probe = baseline.memory()
+        check("baseline_tier_hot",
+              base_mem_probe["graphs"]["rmat"]["tier"] == "hot",
+              base_mem_probe["graphs"]["rmat"]["tier"])
+        base_mem = _proc_mem(baseline.pid) or {}
+        baseline.close()
+        baseline = None
+
+        # ---- the fleet: M replicas mapping ONE sidecar ---------------
+        ready_times = []
+        for i in range(int(replicas)):
+            t0 = time.perf_counter()
+            fleet.append(ProcessReplica(
+                f"m{i}", store_dir=store_dir, durable=True,
+                fsync="off", spawn_timeout_s=spawn_timeout_s,
+            ))
+            ready_times.append(round(time.perf_counter() - t0, 3))
+
+        probes = [r.memory() for r in fleet]
+        check(
+            "fleet_tier_mapped",
+            all(p["graphs"]["rmat"]["tier"] == "mapped" for p in probes),
+            [p["graphs"]["rmat"]["tier"] for p in probes],
+        )
+        check(
+            "fleet_mapped_bytes",
+            all(p["graphs"]["rmat"]["mapped_bytes"] > 0 for p in probes),
+        )
+        check(
+            "fleet_digest",
+            all(p["graphs"]["rmat"]["digest"] == digest for p in probes),
+        )
+
+        # warmup (each replica's host solver builds over the mapped
+        # csr32), then the measured window with the compile sentinel
+        warm_bad = drive(fleet, pairs[: len(fleet)])
+        compiles_before = [
+            r.stats()["exec_cache"]["misses"] for r in fleet
+        ]
+        fleet_bad = drive(fleet, pairs)
+        check("fleet_exact", not (warm_bad or fleet_bad),
+              (warm_bad + fleet_bad)[:3])
+        compiles_after = [
+            r.stats()["exec_cache"]["misses"] for r in fleet
+        ]
+        compile_events = sum(
+            a - b for a, b in zip(compiles_after, compiles_before)
+        )
+        check("zero_compile_events", compile_events == 0, compile_events)
+
+        mem_samples = []
+        for _ in range(3):
+            mem_samples.append({
+                r.name: _proc_mem(r.pid) for r in fleet
+            })
+            time.sleep(0.2)
+        sums = [
+            {k: sum((s[r] or {}).get(k, 0) for r in s)
+             for k in ("rss", "pss", "private")}
+            for s in mem_samples if all(s.values())
+        ]
+        fleet_pss = max((s["pss"] for s in sums), default=0)
+        rss_ratio = (
+            round(fleet_pss / base_mem["rss"], 3)
+            if base_mem.get("rss") else None
+        )
+        rss_ok = rss_ratio is not None and rss_ratio <= float(rss_factor)
+        if not quick:
+            check("fleet_rss_bounded", rss_ok,
+                  f"sum(pss)={fleet_pss} vs {rss_factor}x "
+                  f"baseline rss={base_mem.get('rss')}")
+
+        # ---- SIGKILL + recovery-by-remap -----------------------------
+        victim = fleet[0]
+        victim.kill()
+        t0 = time.perf_counter()
+        victim.restart()
+        remap_ready_s = time.perf_counter() - t0
+        post = victim.memory()
+        check("respawn_tier_mapped",
+              post["graphs"]["rmat"]["tier"] == "mapped",
+              post["graphs"]["rmat"]["tier"])
+        check("respawn_digest",
+              post["graphs"]["rmat"]["digest"] == digest)
+        respawn_bad = drive([victim], pairs[: max(8, len(pairs) // 4)])
+        check("respawn_exact", not respawn_bad, respawn_bad[:3])
+        if not quick:
+            check(
+                "remap_beats_rebuild", remap_ready_s < rebuild_ready_s,
+                f"remap {remap_ready_s:.2f}s vs rebuild "
+                f"{rebuild_ready_s:.2f}s",
+            )
+        for r in fleet:
+            r.close()
+        fleet = []
+
+        # ---- cold tier: codec bench + residency accountant -----------
+        cold_store = GraphStore.from_dir(
+            store_dir, durable=True, compact_threshold=None,
+            mmap_arrays=False,
+            residency_budget=int(residency_probe_budget),
+        )
+        ms0 = cold_store.memory_stats()
+        check("accountant_demoted",
+              ms0["graphs"]["rmat"]["tier"] == "cold",
+              ms0["graphs"]["rmat"]["tier"])
+        snap = cold_store.acquire("rmat")
+        t0 = time.perf_counter()
+        _ = snap.pairs  # decode-promote on access
+        promote_s = time.perf_counter() - t0
+        check("accountant_promoted", snap.tier == "hot", snap.tier)
+        check("promote_digest_exact",
+              content_digest(snap.n, snap.pairs) == digest)
+        t0 = time.perf_counter()
+        comp = encode_snapshot_csr(snap)
+        encode_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        d_rp, d_ci = decode_csr(comp)
+        decode_s = time.perf_counter() - t0
+        s_rp, s_ci = snap.csr()
+        check("codec_roundtrip",
+              np.array_equal(d_rp, s_rp) and np.array_equal(d_ci, s_ci))
+        cold = {
+            "ratio": comp.ratio,
+            "compressed_bytes": comp.compressed_bytes,
+            "raw_bytes": comp.raw_bytes,
+            "encode_s": round(encode_s, 3),
+            "decode_s": round(decode_s, 4),
+            "decode_mb_s": round(
+                comp.raw_bytes / max(decode_s, 1e-9) / 1e6, 1
+            ),
+            "promote_s": round(promote_s, 4),
+        }
+        snap.release()
+        cold_store.close()
+
+        ok = all(c["ok"] for c in checks)
+        return {
+            "ok": ok,
+            "n": n,
+            "m": m,
+            "scale": scale,
+            "edge_factor": edge_factor,
+            "generate": {**gen, "gen_s": round(gen_s, 1)},
+            "store_build_s": round(build_s, 1),
+            "replicas": int(replicas),
+            "queries": len(pairs),
+            "rebuild_ready_s": round(rebuild_ready_s, 2),
+            "remap_ready_s": round(remap_ready_s, 2),
+            "fleet_ready_s": ready_times,
+            "baseline_mem": base_mem,
+            "fleet_mem_samples": mem_samples,
+            "fleet_pss_max": fleet_pss,
+            "rss_ratio": rss_ratio,
+            "rss_factor": float(rss_factor),
+            "rss_ok": rss_ok,
+            "compile_events": compile_events,
+            "memory_probe": probes[0] if probes else None,
+            "cold_tier": cold,
+            "checks": checks,
+            "total_s": round(time.perf_counter() - t_all, 1),
+        }
+    finally:
+        for r in fleet:
+            try:
+                r.close()
+            except Exception:
+                pass
+        if baseline is not None:
+            try:
+                baseline.close()
+            except Exception:
+                pass
+        if workdir is None:
+            shutil.rmtree(base, ignore_errors=True)
+
+
 def run_queries(n: int, edges, *, queries: int = 200,
                 mix: dict | None = None, ms_traffic: int = 24,
                 msbfs_min_speedup: float = 3.0, seed: int = 0,

@@ -12,10 +12,21 @@ set, a per-graph write-ahead log (``store/wal``) makes every acked
 update crash-durable, compactions double as crash-consistent
 checkpoints (atomic ``.bin`` + manifest rename + WAL segment switch),
 and ``GraphStore.from_dir(durable=True)`` recovers manifest + replay.
+Checkpoints additionally commit an **arrays sidecar**
+(``store/sidecar``) that recovery ``np.memmap``s instead of
+rebuilding — replicas on one store directory share a single
+page-cache-resident copy — and a ``residency_budget`` arms the
+cold-tier accountant (``graph/compress``).
 """
 
 from bibfs_tpu.store.delta import DeltaOverlay  # noqa: F401
 from bibfs_tpu.store.registry import GraphStore  # noqa: F401
+from bibfs_tpu.store.sidecar import (  # noqa: F401
+    SidecarMap,
+    load_sidecar,
+    sidecar_dir_name,
+    write_sidecar,
+)
 from bibfs_tpu.store.snapshot import (  # noqa: F401
     GraphSnapshot,
     content_digest,

@@ -65,6 +65,28 @@ the disk failures this machinery must survive: a faulted append
 refuses the ack with nothing committed; a faulted manifest rename
 leaves the previous checkpoint governing recovery with the WAL intact.
 
+**Memory tiers** (``store/sidecar.py``, ``store/snapshot.py`` module
+docstrings): on a durable store every checkpoint commit also writes
+the snapshot's **arrays sidecar** (``<name>.v<V>.<digest12>.arrays/``
+— canonical pairs, CSR row pointers and the native int32 column table
+as raw files under a digest-verified manifest, committed rename-last),
+and the manifest's ``arrays`` key points at it. Recovery then MAPS
+instead of rebuilding: ``np.memmap`` views over the sidecar
+(``GraphSnapshot.from_sidecar``, content-digest verified on the mapped
+bytes) — so M replicas recovering the same store directory share ONE
+page-cache-resident copy and respawn is bounded by a verify pass, not
+an O(E log E) canonicalization (counted in
+``bibfs_store_remap_total``; the ``.bin`` rebuild path remains the
+fallback whenever the sidecar is missing, torn, or ``mmap_arrays``
+is off). A **residency budget** (``residency_budget=`` bytes) arms the
+store-level accountant: when the private resident total exceeds it,
+least-recently-acquired hot graphs are demoted to the compressed cold
+tier (varint+delta CSR — ``graph/compress.py``); any access promotes
+back, exactly. Per-graph tier, mapped bytes and budget headroom are
+reported by :meth:`memory_stats` (the ``bibfs-serve`` stdin ``memory``
+command) and refreshed into ``bibfs_store_mmap_bytes`` /
+``bibfs_store_tier`` at scrape time.
+
 Observability: ``bibfs_store_graphs`` (gauge), ``bibfs_store_swaps_total``
 / ``bibfs_store_compactions_total`` / ``bibfs_store_compact_failures_total``
 (counters, per graph), ``bibfs_store_delta_edges`` (gauge, per graph),
@@ -115,6 +137,11 @@ from bibfs_tpu.store.wal import (
 #: ever eligible for checkpoint gc.
 _CKPT_BIN_RE = re.compile(r"\.v(\d+)\.[0-9a-f]{6,32}\.bin$")
 
+#: "no override" sentinel for ``_write_manifest_locked``'s
+#: ``arrays_dir`` — None is a real value there ("this checkpoint has no
+#: sidecar"), unlike ``bin_file`` where None can mean "use the entry's"
+_UNSET = object()
+
 
 class _Entry:
     """One named graph's mutable slot: current snapshot, pending
@@ -129,7 +156,8 @@ class _Entry:
                  "graph_gen", "oracle", "oracle_builder", "oracle_cells",
                  "index_builds", "index_aborts", "index_repairs",
                  "index_failures",
-                 "wal", "wal_seq", "bin_file", "checkpoints", "recovered")
+                 "wal", "wal_seq", "bin_file", "checkpoints", "recovered",
+                 "arrays_dir", "touched")
 
     def __init__(self, snapshot: GraphSnapshot):
         self.snapshot = snapshot
@@ -155,6 +183,11 @@ class _Entry:
         self.bin_file: str | None = None
         self.checkpoints = 0
         self.recovered: dict | None = None
+        # memory-tier state: the committed arrays sidecar (durable
+        # stores) and the last-acquire stamp the residency accountant's
+        # LRU demotion order reads
+        self.arrays_dir: str | None = None
+        self.touched = time.monotonic()
 
 
 @guarded_by("_lock", "_entries", "_default")
@@ -188,6 +221,15 @@ class GraphStore:
         ``store/history.py``). Requires ``wal_dir``. Default False:
         the PR 8 GC behavior exactly (history stays readable only for
         versions whose artifacts happen to survive).
+    mmap_arrays : write arrays sidecars at checkpoint commits and
+        recover by mmap when a manifest points at one (module
+        docstring). Default True; False forces the pre-sidecar
+        rebuild-from-``.bin`` behavior everywhere (the soak's baseline
+        replica runs this way to measure one private copy).
+    residency_budget : process-private resident bytes across all of
+        this store's snapshots past which the accountant demotes
+        least-recently-acquired hot graphs to the compressed cold tier
+        (module docstring). ``None`` (default) disables demotion.
     fsync : WAL fsync policy, ``always`` / ``batch`` / ``off``
         (``store/wal.py`` module docstring — what "durable enough to
         ack" means). Default ``batch``.
@@ -205,7 +247,9 @@ class GraphStore:
                  obs_label: str | None = None,
                  wal_dir=None, fsync: str = "batch",
                  fsync_batch_records: int = 64, faults=None,
-                 retain_history: bool = False):
+                 retain_history: bool = False,
+                 mmap_arrays: bool = True,
+                 residency_budget: int | None = None):
         self.compact_threshold = (
             None if compact_threshold is None else int(compact_threshold)
         )
@@ -244,6 +288,46 @@ class GraphStore:
             "the next update re-triggers)",
             ("store", "graph"),
         )
+        self.mmap_arrays = bool(mmap_arrays)
+        self.residency_budget = (
+            None if residency_budget is None else int(residency_budget)
+        )
+        if self.residency_budget is not None and self.residency_budget < 0:
+            raise ValueError(
+                f"residency_budget must be >= 0 bytes, "
+                f"got {residency_budget}"
+            )
+        self._g_mmap_bytes = REGISTRY.gauge(
+            "bibfs_store_mmap_bytes",
+            "Sidecar bytes the graph's current snapshot keeps mapped "
+            "(shared page-cache-backed, not process-private)",
+            ("store", "graph"),
+        )
+        self._g_tier = REGISTRY.gauge(
+            "bibfs_store_tier",
+            "Graphs currently in each memory tier (mapped/hot/cold)",
+            ("store", "tier"),
+        )
+        for t in ("mapped", "hot", "cold"):  # render at zero pre-traffic
+            self._g_tier.labels(store=self.obs_label, tier=t).set(0)
+        self._c_remaps = REGISTRY.counter(
+            "bibfs_store_remap_total",
+            "Recoveries served by mapping an arrays sidecar instead of "
+            "rebuilding from the checkpoint .bin",
+            ("store", "graph"),
+        )
+        # scrape-time tier/mapped-bytes refresh, weakly bound like the
+        # index-age collector below: a dead store unregisters itself
+        mem_ref = weakref.ref(self)
+
+        def _collect_memory():
+            st = mem_ref()
+            if st is None:
+                return False
+            st._refresh_memory_metrics()
+            return True
+
+        REGISTRY.add_collector(_collect_memory)
         if fsync not in FSYNC_POLICIES:
             raise ValueError(
                 f"unknown fsync policy {fsync!r} "
@@ -374,6 +458,7 @@ class GraphStore:
                     self._g_graphs.set(len(self._entries))
                 raise
         self._kick_oracle(name, entry)
+        self._maybe_rebalance()
         return snapshot
 
     def _register(self, name: str, snapshot: GraphSnapshot, *,
@@ -404,6 +489,10 @@ class GraphStore:
             self._g_delta.labels(store=self.obs_label, graph=name).set(0)
             self._c_compactions.labels(store=self.obs_label, graph=name)
             self._c_compact_failures.labels(store=self.obs_label, graph=name)
+            self._g_mmap_bytes.labels(store=self.obs_label, graph=name).set(
+                snapshot.mapped_bytes()
+            )
+            self._c_remaps.labels(store=self.obs_label, graph=name)
             if self.oracle_k is not None:
                 from bibfs_tpu.oracle import oracle_cells
 
@@ -524,6 +613,16 @@ class GraphStore:
                     f"{entry.snapshot.digest}); refusing to register a "
                     "graph its own seed could not recover"
                 )
+        if self.mmap_arrays:
+            # the seed's arrays sidecar, BEFORE the manifest references
+            # it — heavy (O(E) writes + hashes) but off the store lock,
+            # and what makes a respawn of this very graph map instead
+            # of rebuild
+            from bibfs_tpu.store.sidecar import write_sidecar
+
+            entry.arrays_dir = write_sidecar(
+                self.wal_dir, name, entry.snapshot, fire=self._fire
+            )
         entry.wal_seq = 1
         self._c_checkpoints.labels(store=self.obs_label, graph=name)
         self._c_recovery_replayed.labels(store=self.obs_label, graph=name)
@@ -536,7 +635,8 @@ class GraphStore:
 
     def _write_manifest_locked(self, name: str, entry: _Entry, *,
                                snapshot: GraphSnapshot | None = None,
-                               bin_file: str | None = None) -> None:
+                               bin_file: str | None = None,
+                               arrays_dir=_UNSET) -> None:
         """Commit the graph's manifest by atomic rename: tmp file,
         flush+fsync, ``os.replace`` (the ``manifest_rename`` fault
         seam), directory fsync. A crash (or injected fault) anywhere in
@@ -553,6 +653,11 @@ class GraphStore:
             "n": snapshot.n,
             "edges": snapshot.num_edges,
             "bin": entry.bin_file if bin_file is None else bin_file,
+            # the mmap recovery path's pointer; None when the store
+            # writes no sidecars — recovery then always rebuilds
+            "arrays": (
+                entry.arrays_dir if arrays_dir is _UNSET else arrays_dir
+            ),
             "wal": f"{name}.wal.{entry.wal_seq}",
             "wal_seq": entry.wal_seq,
             "wal_offset": 0,
@@ -614,13 +719,16 @@ class GraphStore:
         return entry.wal_seq
 
     def _checkpoint_locked(self, name: str, entry: _Entry,
-                           bin_file: str) -> None:
+                           bin_file: str,
+                           arrays_dir: str | None = None) -> None:
         """Commit a checkpoint for the CURRENT (just-swapped) snapshot:
-        point the manifest at ``bin_file`` (already atomically written)
-        and the current WAL segment. Counted + spanned."""
+        point the manifest at ``bin_file`` and ``arrays_dir`` (both
+        already atomically written/renamed) and the current WAL
+        segment. Counted + spanned."""
         with span("store_checkpoint", graph=name,
                   version=entry.snapshot.version, wal_seq=entry.wal_seq):
             entry.bin_file = bin_file
+            entry.arrays_dir = arrays_dir
             self._write_manifest_locked(name, entry)
             entry.checkpoints += 1
             self._c_checkpoints.labels(
@@ -653,9 +761,15 @@ class GraphStore:
         read path (``store/history.py``)."""
         if self.retain_history:
             return
+        from bibfs_tpu.store.sidecar import (
+            ARRAYS_DIR_RE,
+            remove_sidecar_quiet,
+        )
+
         cur_v = entry.snapshot.version
         cur_seq = entry.wal_seq
         keep = entry.bin_file
+        keep_arrays = entry.arrays_dir
         for seq, path in list_segments(self.wal_dir, name):
             if seq < cur_seq:
                 self._unlink_quiet(path)
@@ -667,6 +781,22 @@ class GraphStore:
             if (m is not None and fname[: m.start()] == name
                     and int(m.group(1)) <= cur_v):
                 self._unlink_quiet(os.path.join(self.wal_dir, fname))
+                continue
+            if fname == keep_arrays:
+                continue
+            # superseded arrays sidecars go with their bins; a dead
+            # writer's ``<...>.arrays.tmp.<pid>`` orphan (never
+            # committed by rename) goes too — version-bounded either
+            # way, so an in-flight writer targeting a NEWER version is
+            # never swept from under its rename
+            m = ARRAYS_DIR_RE.search(fname)
+            if m is None:
+                m = re.search(
+                    r"\.v(\d+)\.[0-9a-f]{6,32}\.arrays\.tmp\.\d+$", fname
+                )
+            if (m is not None and fname[: m.start()] == name
+                    and int(m.group(1)) <= cur_v):
+                remove_sidecar_quiet(os.path.join(self.wal_dir, fname))
 
     def _recover_graph(self, name: str) -> None:
         """Manifest + replay recovery (module docstring): load the
@@ -692,8 +822,45 @@ class GraphStore:
         )
         version = 1 if manifest is None else int(manifest["version"])
         wal_seq = 1 if manifest is None else int(manifest["wal_seq"])
-        n, edges = read_graph_bin(os.path.join(self.wal_dir, bin_file))
-        snap = GraphSnapshot.build(n, edges)
+        arrays_dir = (
+            None if manifest is None else manifest.get("arrays")
+        )
+        snap = None
+        remapped = False
+        if arrays_dir is not None and self.mmap_arrays:
+            # recovery-by-remap: map the committed sidecar read-only —
+            # bounded by a sequential verify pass over shared
+            # page-cache bytes, not an O(E log E) rebuild. The content
+            # digest is recomputed FROM THE MAPPED BYTES
+            # (from_sidecar), so what serves is proven to be what was
+            # checkpointed. Any failure (torn, missing, foreign)
+            # falls through to the .bin rebuild below, loudly.
+            from bibfs_tpu.store.sidecar import load_sidecar
+
+            try:
+                smap = load_sidecar(
+                    os.path.join(self.wal_dir, str(arrays_dir)),
+                    verify="size",
+                )
+                if (manifest.get("digest") is not None
+                        and smap.digest != manifest["digest"]):
+                    raise ValueError(
+                        f"sidecar digest {smap.digest} != manifest "
+                        f"{manifest['digest']} (stale sidecar)"
+                    )
+                snap = GraphSnapshot.from_sidecar(smap, version=version)
+                remapped = True
+            except (OSError, ValueError, KeyError) as e:
+                print(
+                    f"[Store] sidecar remap failed for {name!r} "
+                    f"({arrays_dir}): {e}; rebuilding from {bin_file}",
+                    file=sys.stderr,
+                )
+                snap = None
+        if snap is None:
+            arrays_dir = None  # the manifest's sidecar is not servable
+            n, edges = read_graph_bin(os.path.join(self.wal_dir, bin_file))
+            snap = GraphSnapshot.build(n, edges)
         if manifest is not None and manifest.get("digest") is not None \
                 and manifest["digest"] != snap.digest:
             raise ValueError(
@@ -756,6 +923,9 @@ class GraphStore:
                     replayed += 1
             entry = self._register(name, snap, version=version)
             entry.bin_file = bin_file
+            entry.arrays_dir = (
+                None if arrays_dir is None else str(arrays_dir)
+            )
             self._c_checkpoints.labels(store=self.obs_label, graph=name)
             entry.graph_gen += replayed  # one live-graph gen per batch
             entry.wal_seq = segments[-1][0] if segments else wal_seq
@@ -772,6 +942,11 @@ class GraphStore:
         self._g_recovery_seconds.labels(
             store=self.obs_label, graph=name
         ).set(dt)
+        if remapped:
+            self._c_remaps.labels(store=self.obs_label, graph=name).inc()
+            self._g_mmap_bytes.labels(store=self.obs_label, graph=name).set(
+                snap.mapped_bytes()
+            )
         entry.recovered = {
             "version": version,
             "replayed_records": replayed,
@@ -779,6 +954,7 @@ class GraphStore:
             "segments": len(segments),
             "delta_edges": delta,
             "recovery_s": round(dt, 6),
+            "remapped": remapped,
         }
         if (self.compact_threshold is not None
                 and delta >= self.compact_threshold):
@@ -792,6 +968,7 @@ class GraphStore:
                     )
                     entry.compactor.start()
         self._kick_oracle(name, entry)
+        self._maybe_rebalance()
 
     # ---- resolution --------------------------------------------------
     def _entry(self, name: str) -> _Entry:
@@ -824,7 +1001,9 @@ class GraphStore:
         concurrent swap cannot retire it between the read and the pin.
         The caller owns one ``release()``."""
         with self._lock:
-            return self._entry(name).snapshot.retain()
+            entry = self._entry(name)
+            entry.touched = time.monotonic()  # the accountant's LRU stamp
+            return entry.snapshot.retain()
 
     def overlay(self, name: str) -> DeltaOverlay | None:
         """The graph's pending overlay, or None when it has no pending
@@ -908,6 +1087,7 @@ class GraphStore:
             self._oracle_after_update(
                 name, entry, overlay, adds, dels, gen_after, prev_oracle
             )
+            self._maybe_rebalance()
             return {**counts, "compacting": compacting}
 
     # ---- oracle lifecycle --------------------------------------------
@@ -1125,6 +1305,7 @@ class GraphStore:
                 # the heavy build, on the sets captured under the lock
                 new, adds, dels = overlay.snapshot(adds, dels)
                 bin_file = None
+                arrays_dir = None
                 if entry.wal is not None:
                     from bibfs_tpu.graph.io import write_graph_bin
 
@@ -1134,6 +1315,13 @@ class GraphStore:
                         os.path.join(self.wal_dir, bin_file),
                         new.n, new.undirected_edges(),
                     )
+                    if self.mmap_arrays:
+                        # the servable twin, same off-lock discipline
+                        from bibfs_tpu.store.sidecar import write_sidecar
+
+                        arrays_dir = write_sidecar(
+                            self.wal_dir, name, new, fire=self._fire
+                        )
                 # pre-warm the carried overlay's base index off-lock
                 # too: rebase residue applies under the store lock below
                 rebased = DeltaOverlay(new)
@@ -1154,6 +1342,15 @@ class GraphStore:
                         # the byte-identical file.)
                         if entry.bin_file != bin_file:
                             self._unlink_quiet(bin_file)
+                        if (arrays_dir is not None
+                                and entry.arrays_dir != arrays_dir):
+                            from bibfs_tpu.store.sidecar import (
+                                remove_sidecar_quiet,
+                            )
+
+                            remove_sidecar_quiet(
+                                os.path.join(self.wal_dir, arrays_dir)
+                            )
                         return entry.snapshot
                     # store-relative stamp (see add())
                     new.version = entry.snapshot.version + 1
@@ -1183,12 +1380,15 @@ class GraphStore:
                         # consistent either way, because the OLD
                         # manifest still governs recovery and every
                         # segment it needs is still on disk
-                        self._checkpoint_locked(name, entry, bin_file)
+                        self._checkpoint_locked(
+                            name, entry, bin_file, arrays_dir
+                        )
             if entry.wal is not None:
                 self._gc_durable(name, entry)
             # the swap dropped the old index (gen moved): rebuild for
             # the fresh snapshot off the serving path
             self._kick_oracle(name, entry)
+            self._maybe_rebalance()
             return new
 
     def compact(self, name: str) -> GraphSnapshot:
@@ -1232,6 +1432,7 @@ class GraphStore:
         just recovers to the declared truth the caller asked for."""
         name = str(name)
         bin_file = None
+        arrays_dir = None
         with self._lock:
             entry = self._entry(name)
             if entry.wal is not None:
@@ -1243,14 +1444,20 @@ class GraphStore:
                     )
                 bin_file = self._ckpt_bin_name(name, snapshot)
         if bin_file is not None:
-            # the heavy write, OFF the store lock; an abort below
-            # leaves only a cleaned-up orphan
+            # the heavy writes, OFF the store lock; an abort below
+            # leaves only cleaned-up orphans
             from bibfs_tpu.graph.io import write_graph_bin
 
             write_graph_bin(
                 os.path.join(self.wal_dir, bin_file),
                 snapshot.n, snapshot.undirected_edges(),
             )
+            if self.mmap_arrays:
+                from bibfs_tpu.store.sidecar import write_sidecar
+
+                arrays_dir = write_sidecar(
+                    self.wal_dir, name, snapshot, fire=self._fire
+                )
         try:
             with self._lock:
                 entry = self._entry(name)
@@ -1272,8 +1479,10 @@ class GraphStore:
                         self._write_manifest_locked(
                             name, entry,
                             snapshot=snapshot, bin_file=bin_file,
+                            arrays_dir=arrays_dir,
                         )
                         entry.bin_file = bin_file
+                        entry.arrays_dir = arrays_dir
                         entry.checkpoints += 1
                         self._c_checkpoints.labels(
                             store=self.obs_label, graph=name
@@ -1318,6 +1527,90 @@ class GraphStore:
             self._c_swaps.labels(store=self.obs_label, graph=name).inc()
             old.release()  # the store's reference; flush pins remain
         return old
+
+    # ---- residency accountant (memory tiers, module docstring) -------
+    def _refresh_memory_metrics(self) -> None:
+        """Scrape-time gauge refresh: per-graph mapped bytes + the
+        tier census. Snapshot reads only (each snapshot's own lock
+        nests inside the store lock, the established order)."""
+        with self._lock:
+            snaps = {
+                name: e.snapshot for name, e in self._entries.items()
+            }
+        tiers = {"mapped": 0, "hot": 0, "cold": 0}
+        for name, snap in snaps.items():
+            self._g_mmap_bytes.labels(
+                store=self.obs_label, graph=name
+            ).set(snap.mapped_bytes())
+            tiers[snap.tier] += 1
+        for tier, count in tiers.items():
+            self._g_tier.labels(store=self.obs_label, tier=tier).set(count)
+
+    def _maybe_rebalance(self) -> None:
+        if self.residency_budget is not None:
+            self.rebalance()
+
+    def rebalance(self) -> dict:
+        """One accountant pass: while the store's process-private
+        resident total exceeds ``residency_budget``, demote the
+        least-recently-acquired hot graph to the compressed cold tier
+        (``GraphSnapshot.demote`` — encode runs off the store lock; the
+        serving pointer never moves, a cold graph just decodes back on
+        its next access). Called after every registration, update batch
+        and compaction commit; callable any time. Returns what it did."""
+        with self._lock:
+            candidates = [
+                (e.touched, name, e.snapshot)
+                for name, e in self._entries.items()
+            ]
+        total = sum(s.resident_bytes() for _, _, s in candidates)
+        demoted: list[str] = []
+        freed = 0
+        if self.residency_budget is not None:
+            for _touched, name, snap in sorted(
+                    candidates, key=lambda c: c[0]):
+                if total <= self.residency_budget:
+                    break
+                if snap.tier != "hot":
+                    continue
+                got = snap.demote()
+                if got > 0:
+                    total -= got
+                    freed += got
+                    demoted.append(name)
+        self._refresh_memory_metrics()
+        return {
+            "demoted": demoted,
+            "freed_bytes": freed,
+            "resident_bytes": total,
+        }
+
+    def memory_stats(self) -> dict:
+        """Per-graph tier / resident / mapped bytes plus the budget
+        headroom — the ``bibfs-serve`` stdin ``memory`` command's
+        payload and the memtier soak's probe."""
+        with self._lock:
+            per = {}
+            for name, entry in self._entries.items():
+                per[name] = {
+                    **entry.snapshot.memory(),
+                    "version": entry.snapshot.version,
+                    "digest": entry.snapshot.digest,
+                    "arrays": entry.arrays_dir,
+                }
+        resident = sum(g["resident_bytes"] for g in per.values())
+        mapped = sum(g["mapped_bytes"] for g in per.values())
+        budget = self.residency_budget
+        return {
+            "graphs": per,
+            "resident_bytes": resident,
+            "mapped_bytes": mapped,
+            "residency_budget": budget,
+            "headroom_bytes": (
+                None if budget is None else budget - resident
+            ),
+            "mmap_arrays": self.mmap_arrays,
+        }
 
     # ---- time-travel reads (store/history.py) ------------------------
     def history(self, name: str) -> list[dict]:
@@ -1379,6 +1672,7 @@ class GraphStore:
                         "wal_seq": entry.wal_seq,
                         "wal": entry.wal.stats(),
                         "bin": entry.bin_file,
+                        "arrays": entry.arrays_dir,
                         "checkpoints": entry.checkpoints,
                         "recovered": entry.recovered,
                     }

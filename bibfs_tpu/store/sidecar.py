@@ -1,0 +1,326 @@
+"""Arrays sidecar — the zero-copy on-disk twin of a checkpoint ``.bin``.
+
+A checkpoint bin (``<name>.v<V>.<digest12>.bin``) is the *portable*
+truth: uint32 edge pairs any reference reader can load — but loading it
+means re-canonicalizing O(E log E) and materializing every derived
+table privately per process. The **arrays sidecar**
+(``<name>.v<V>.<digest12>.arrays/``) is the *servable* truth: the
+snapshot's derived arrays laid out as raw little-endian files a
+process can ``np.memmap`` read-only —
+
+- ``pairs``        int64 ``[D, 2]``   canonical directed pairs (the
+  digest's hash input; ``pairs[:, 1]`` doubles as the CSR ``col_ind``
+  because canonical order IS CSR expansion order);
+- ``csr.indptr``   int64 ``[n+1]``    CSR row pointers;
+- ``csr32.indices`` int32 ``[D]``     contiguous int32 neighbor ids —
+  exactly the native C solver's column format, so every replica's host
+  route shares ONE page-cache copy instead of each building a private
+  CSR from the edge list;
+- optional groups, written only when already materialized on the
+  snapshot at checkpoint time (a checkpoint never forces a build):
+  ``ell.*`` (serving ELL table), ``blocked.*`` (MXU tile tables),
+  ``oracle.*`` (landmark K×n distance matrix + landmark ids).
+
+``manifest.json`` inside the directory binds it all: graph identity
+(content digest, version, n, edges), per-file dtype/shape/BLAKE2b, and
+the scalar metadata needed to reconstruct the dataclasses
+(``EllGraph`` width/padding, ``BlockedGraph`` tiling, oracle gen).
+
+**Commit protocol — rename-last.** All files (manifest included) land
+in a same-directory ``<final>.tmp.<pid>`` directory, each flushed and
+fsynced, the tmp directory fsynced, and only then is the tmp
+``os.rename``d onto the final name and the parent fsynced. A crash
+anywhere before the rename leaves a ``*.tmp.*`` orphan that loaders
+never match and the next write cleans up; after it, a complete
+sidecar. Nothing is ever written into a visible ``.arrays`` directory
+— the ``atomic-write`` lint rule (analysis/rules/atomic_write.py)
+enforces rename-last on this module. The digest-suffixed name gives
+the same no-overwrite guarantee as checkpoint bins: two racing writers
+can only collide on byte-identical content, so an already-present
+final directory is simply kept.
+
+Loading (``load_sidecar``) maps every file read-only, validates sizes
+against the manifest always, and (by default) re-hashes file contents
+against the manifest BLAKE2bs — a sequential page-cache read, far
+cheaper than a rebuild, and the pages it faults in are the very pages
+serving will use. A sidecar that fails any check raises; the store's
+recovery falls back to the ``.bin`` rebuild path, never serves a
+half-proven mapping.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import re
+import shutil
+
+import numpy as np
+
+from bibfs_tpu.store.wal import fsync_dir
+
+SIDECAR_FORMAT = 1
+
+#: sidecar directories (``<name>.v<V>.<digest12>.arrays``) — same
+#: shape contract as ``_CKPT_BIN_RE`` in store/registry.py, and like it
+#: the digest suffix is REQUIRED for gc eligibility.
+ARRAYS_DIR_RE = re.compile(r"\.v(\d+)\.[0-9a-f]{6,32}\.arrays$")
+
+#: hash chunk: big enough to stream at disk bandwidth, small enough to
+#: keep the hasher's working set out of the way
+_HASH_CHUNK = 1 << 24
+
+
+def sidecar_dir_name(name: str, snapshot) -> str:
+    """``roads.v3.1f2a9c0d4e5b.arrays`` — version + digest prefix, the
+    checkpoint-bin naming contract applied to the directory."""
+    return f"{name}.v{snapshot.version}.{snapshot.digest[:12]}.arrays"
+
+
+def _hash_bytes(buf) -> str:
+    h = hashlib.blake2b(digest_size=16)
+    if getattr(buf, "size", len(buf)) > 0:
+        # empty arrays can't cast (zero in shape); their hash is of b""
+        mv = memoryview(buf).cast("B")
+        for off in range(0, len(mv), _HASH_CHUNK):
+            h.update(mv[off:off + _HASH_CHUNK])
+    return h.hexdigest()
+
+
+def _write_array(dirpath: str, fname: str, arr: np.ndarray) -> dict:
+    """One raw array file inside the (still-tmp) sidecar directory:
+    little-endian C-order bytes, flushed and fsynced. Returns its
+    manifest entry."""
+    arr = np.ascontiguousarray(arr)
+    if arr.dtype.byteorder == ">":  # raw files are little-endian
+        arr = arr.astype(arr.dtype.newbyteorder("<"))
+    path = os.path.join(dirpath, fname)
+    with open(path, "wb") as f:
+        arr.tofile(f)
+        f.flush()
+        os.fsync(f.fileno())
+    return {
+        "file": fname,
+        "dtype": arr.dtype.str,
+        "shape": list(arr.shape),
+        "blake2b": _hash_bytes(arr),
+    }
+
+
+def _csr_indptr(n: int, pairs: np.ndarray) -> np.ndarray:
+    """Row pointers straight from the canonical pairs — deliberately
+    NOT ``snapshot.csr()``: the writer must not memoize an O(E) int64
+    ``col_ind`` copy into the parent process just to checkpoint it."""
+    deg = np.bincount(pairs[:, 0], minlength=n)
+    row_ptr = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum(deg, out=row_ptr[1:])
+    return row_ptr
+
+
+def write_sidecar(root, name: str, snapshot, *, oracle_index=None,
+                  fire=None) -> str:
+    """Write (or keep) the snapshot's arrays sidecar under ``root``.
+    Returns the committed directory name (relative to ``root``).
+    Idempotent: an already-committed sidecar for this (version, digest)
+    is kept as-is — the digest-suffixed name makes it byte-equivalent.
+
+    ``oracle_index`` (a ``LandmarkIndex``) adds the ``oracle.*`` group;
+    ``fire`` is the store's fault-injection hook (site
+    ``sidecar_rename`` guards the commit point).
+    """
+    root = os.fspath(root)
+    dirname = sidecar_dir_name(name, snapshot)
+    final = os.path.join(root, dirname)
+    if os.path.isdir(final):
+        return dirname
+    tmp = f"{final}.tmp.{os.getpid()}"
+    try:
+        if os.path.isdir(tmp):  # a dead writer's orphan
+            shutil.rmtree(tmp)
+        os.makedirs(tmp)
+        pairs = np.ascontiguousarray(snapshot.pairs, dtype=np.int64)
+        arrays = {
+            "pairs": _write_array(tmp, "pairs.bin", pairs),
+            "csr.indptr": _write_array(
+                tmp, "csr_indptr.bin", _csr_indptr(snapshot.n, pairs)
+            ),
+            # transient int32 copy, dropped as soon as it is on disk
+            "csr32.indices": _write_array(
+                tmp, "csr32_indices.bin",
+                pairs[:, 1].astype(np.int32),
+            ),
+        }
+        meta: dict = {}
+        # optional groups: ONLY what the snapshot already materialized
+        # (peek the private memos — a checkpoint must never force an
+        # O(E) layout build onto the commit path)
+        ell = snapshot._ell
+        if ell is not None:
+            arrays["ell.nbr"] = _write_array(tmp, "ell_nbr.bin", ell.nbr)
+            arrays["ell.deg"] = _write_array(tmp, "ell_deg.bin", ell.deg)
+            arrays["ell.overflow"] = _write_array(
+                tmp, "ell_overflow.bin", ell.overflow
+            )
+            meta["ell"] = {
+                "n": ell.n, "n_pad": ell.n_pad, "width": ell.width,
+                "num_edges": ell.num_edges,
+            }
+        blocked = snapshot._blocked
+        if blocked is not None:
+            arrays["blocked.tab"] = _write_array(
+                tmp, "blocked_tab.bin", blocked.tab
+            )
+            arrays["blocked.bcol"] = _write_array(
+                tmp, "blocked_bcol.bin", blocked.bcol
+            )
+            arrays["blocked.deg"] = _write_array(
+                tmp, "blocked_deg.bin", blocked.deg
+            )
+            meta["blocked"] = {
+                "n": blocked.n, "n_pad": blocked.n_pad,
+                "tile": blocked.tile, "nblocks": blocked.nblocks,
+                "bwidth": blocked.bwidth,
+                "num_edges": blocked.num_edges,
+                "nnz_blocks": blocked.nnz_blocks,
+            }
+        if oracle_index is not None:
+            arrays["oracle.dist"] = _write_array(
+                tmp, "oracle_dist.bin", oracle_index.dist
+            )
+            arrays["oracle.landmarks"] = _write_array(
+                tmp, "oracle_landmarks.bin", oracle_index.landmarks
+            )
+            meta["oracle"] = {
+                "gen": oracle_index.gen,
+                "built_at": oracle_index.built_at,
+                "repaired_edges": oracle_index.repaired_edges,
+            }
+        manifest = {
+            "format": SIDECAR_FORMAT,
+            "graph": name,
+            "digest": snapshot.digest,
+            "version": snapshot.version,
+            "n": snapshot.n,
+            "edges": snapshot.num_edges,
+            "arrays": arrays,
+            "meta": meta,
+        }
+        with open(os.path.join(tmp, "manifest.json"), "w") as f:
+            json.dump(manifest, f, indent=1, sort_keys=True)
+            f.write("\n")
+            f.flush()
+            os.fsync(f.fileno())
+        fsync_dir(tmp)
+        if fire is not None:
+            fire("sidecar_rename")
+        os.rename(tmp, final)
+    except BaseException:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+    fsync_dir(root)
+    return dirname
+
+
+class SidecarMap:
+    """A loaded sidecar: the manifest plus read-only ``np.memmap``
+    views of every array file. Holding a reference keeps the mappings
+    alive; dropping the last reference lets the GC unmap (there is no
+    explicit close — in-flight readers of a view must never see their
+    buffer yanked, the snapshot-retire contract)."""
+
+    def __init__(self, path: str, manifest: dict,
+                 arrays: dict[str, np.ndarray]):
+        self.path = path
+        self.manifest = manifest
+        self.arrays = arrays
+
+    @property
+    def digest(self) -> str:
+        return str(self.manifest["digest"])
+
+    @property
+    def version(self) -> int:
+        return int(self.manifest["version"])
+
+    @property
+    def n(self) -> int:
+        return int(self.manifest["n"])
+
+    def meta(self, group: str) -> dict:
+        return self.manifest.get("meta", {}).get(group, {})
+
+    def has(self, *keys: str) -> bool:
+        return all(k in self.arrays for k in keys)
+
+    @property
+    def mapped_bytes(self) -> int:
+        return sum(int(a.nbytes) for a in self.arrays.values())
+
+    def stats(self) -> dict:
+        return {
+            "path": self.path,
+            "digest": self.digest,
+            "version": self.version,
+            "mapped_bytes": self.mapped_bytes,
+            "arrays": sorted(self.arrays),
+        }
+
+
+def load_sidecar(path, *, verify: str = "full") -> SidecarMap:
+    """Map a committed sidecar directory read-only.
+
+    ``verify="full"`` (default) re-hashes every file against its
+    manifest BLAKE2b — one sequential pass that also pre-faults the
+    pages serving will read. ``verify="size"`` checks only byte sizes
+    (shape x itemsize vs the file) — the property a torn write cannot
+    fake past the rename-last commit, for callers that will content-
+    verify another way (recovery re-derives the graph digest from the
+    mapped pairs). Any mismatch raises ``ValueError``.
+    """
+    if verify not in ("full", "size"):
+        raise ValueError(f"unknown verify mode {verify!r}")
+    path = os.fspath(path)
+    with open(os.path.join(path, "manifest.json")) as f:
+        manifest = json.load(f)
+    fmt = int(manifest.get("format", 0))
+    if fmt != SIDECAR_FORMAT:
+        raise ValueError(
+            f"{path}: sidecar format {fmt} != supported {SIDECAR_FORMAT}"
+        )
+    arrays: dict[str, np.ndarray] = {}
+    for key, spec in manifest["arrays"].items():
+        fpath = os.path.join(path, str(spec["file"]))
+        dtype = np.dtype(str(spec["dtype"]))
+        shape = tuple(int(s) for s in spec["shape"])
+        expected = dtype.itemsize * int(np.prod(shape)) if shape else \
+            dtype.itemsize
+        actual = os.path.getsize(fpath)
+        if actual != expected:
+            raise ValueError(
+                f"{fpath}: {actual} bytes on disk, manifest claims "
+                f"{expected} ({dtype.str}{list(shape)})"
+            )
+        if expected == 0:
+            arr = np.zeros(shape, dtype=dtype)
+        else:
+            arr = np.memmap(fpath, dtype=dtype, mode="r", shape=shape)
+        if verify == "full" and expected:
+            got = _hash_bytes(arr)
+            if got != spec["blake2b"]:
+                raise ValueError(
+                    f"{fpath}: content hash {got} != manifest "
+                    f"{spec['blake2b']} — refusing to map a torn or "
+                    "foreign array"
+                )
+        arrays[key] = arr
+    return SidecarMap(path, manifest, arrays)
+
+
+def remove_sidecar_quiet(path) -> None:
+    """Best-effort removal (gc of superseded sidecars + their orphaned
+    ``*.tmp.*`` siblings)."""
+    try:
+        shutil.rmtree(path)
+    except OSError:
+        pass

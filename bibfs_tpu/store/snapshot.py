@@ -29,6 +29,28 @@ content-addressed identity:
   swap barrier that lets old batches finish on the graph they started
   on.
 
+**Memory tiers.** A snapshot lives in one of three tiers:
+
+- ``mapped`` — built by :meth:`GraphSnapshot.from_sidecar` over an
+  arrays sidecar (``store/sidecar.py``): ``pairs``, the CSR and the
+  native int32 column table are read-only ``np.memmap`` views, so M
+  processes serving the same graph share ONE page-cache-resident copy
+  and recovery maps instead of rebuilding. Retirement keeps the
+  no-unmapped-reads contract the in-memory tiers have: ``release()``
+  only NULLS references (the ``SidecarMap`` holds the mappings), so an
+  in-flight flush that pinned a view keeps a valid buffer until the
+  GC drops the last holder — nothing ever calls ``munmap`` under a
+  live reader.
+- ``hot`` — the original behavior: private in-memory arrays.
+- ``cold`` — past the store's residency budget: the adjacency is held
+  ONLY as a varint+delta :class:`~bibfs_tpu.graph.compress.CompressedCSR`
+  (``demote()``); ``pairs``/``csr()`` transparently decode back on the
+  next access (``promote`` — exact, the codec round-trips bit-for-bit,
+  and canonical pair order IS CSR expansion order so pairs are
+  reconstructed rather than stored twice). The store's residency
+  accountant (``store/registry.py``) drives demotions; any access
+  promotes.
+
 The serving-layout build (``ell()``) imports ``serve.buckets`` lazily:
 the store layer sits beside ``serve``, not above it, and must be
 importable without dragging the engine stack in.
@@ -54,6 +76,13 @@ def next_version() -> int:
     return next(_VERSIONS)
 
 
+#: digest hash chunk — bounds the hasher's transient working set; the
+#: chunked loop (not ``tobytes()``) is what lets the digest of an
+#: mmap-backed pairs array stream through the page cache instead of
+#: materializing a private O(E) byte copy
+_DIGEST_CHUNK = 1 << 24
+
+
 def content_digest(n: int, pairs: np.ndarray) -> str:
     """BLAKE2b over ``(n, canonical pairs)`` — the content identity.
 
@@ -64,7 +93,10 @@ def content_digest(n: int, pairs: np.ndarray) -> str:
     h = hashlib.blake2b(digest_size=16)
     h.update(str(int(n)).encode())
     h.update(b"|")
-    h.update(np.ascontiguousarray(pairs, dtype=np.int64).tobytes())
+    arr = np.ascontiguousarray(pairs, dtype=np.int64)
+    mv = memoryview(arr).cast("B") if arr.size else memoryview(b"")
+    for off in range(0, len(mv), _DIGEST_CHUNK):
+        h.update(mv[off:off + _DIGEST_CHUNK])
     return h.hexdigest()
 
 
@@ -79,13 +111,15 @@ class GraphSnapshot:
     def __init__(self, n: int, pairs: np.ndarray, *, digest: str | None = None,
                  version: int | None = None):
         self.n = int(n)
-        self.pairs = pairs
+        self._pairs = pairs
         self.digest = (
             f"anon-{next(_ANON)}" if digest is None else str(digest)
         )
         self.version = next_version() if version is None else int(version)
         self.num_edges = int(pairs.shape[0]) // 2
-        self._lock = threading.Lock()
+        # RLock: a memoized builder holding the lock reads self.pairs,
+        # and on a cold snapshot that property re-enters to promote
+        self._lock = threading.RLock()
         self._refs = 1  # the creator's (usually the store's) reference
         self._retired = False
         self._retire_hooks: list = []
@@ -93,6 +127,55 @@ class GraphSnapshot:
         self._ell = None  # serving-bucketed ELL
         self._tiered = None
         self._blocked = None  # MXU tile layout (graph/blocked.py)
+        # memory-tier state (module docstring)
+        self._sidecar = None  # SidecarMap pinning the mmap views
+        self._native32 = None  # (row_ptr i64, col_ind i32) native format
+        self._cold = None  # CompressedCSR once demoted
+        self._promotions = 0
+        self._demotions = 0
+
+    @property
+    def pairs(self) -> np.ndarray:
+        """The canonical directed pairs. On a cold snapshot the access
+        IS the promotion: decode back to hot (exact) before returning —
+        post-retire the decode still answers but is not re-cached,
+        matching the memoized builders."""
+        p = self._pairs
+        if p is not None:
+            return p
+        with self._lock:
+            if self._pairs is not None:
+                return self._pairs
+            if self._cold is None:
+                raise RuntimeError(
+                    f"snapshot {self.digest} has neither pairs nor a "
+                    "cold-tier encoding"
+                )
+            pairs, csr = self._decode_cold()
+            if not self._retired:
+                self._pairs = pairs
+                if self._csr is None:
+                    self._csr = csr
+                self._promotions += 1
+            return pairs
+
+    @pairs.setter
+    def pairs(self, value: np.ndarray) -> None:
+        self._pairs = value
+
+    def _decode_cold(self):
+        """Cold-tier decode: the exact CSR, and the canonical pairs
+        rebuilt from it (canonical order is CSR expansion order — the
+        inverse of ``build_csr``)."""
+        from bibfs_tpu.graph.compress import decode_csr
+
+        row_ptr, col = decode_csr(self._cold)
+        pairs = np.empty((col.shape[0], 2), dtype=np.int64)
+        pairs[:, 0] = np.repeat(
+            np.arange(self.n, dtype=np.int64), np.diff(row_ptr)
+        )
+        pairs[:, 1] = col
+        return pairs, (row_ptr, col)
 
     @classmethod
     def build(cls, n: int, edges: np.ndarray | None = None, *,
@@ -106,6 +189,69 @@ class GraphSnapshot:
             pairs = canonical_pairs(n, edges)
         return cls(n, pairs,
                    digest=content_digest(n, pairs), version=version)
+
+    @classmethod
+    def from_sidecar(cls, smap, *, version: int | None = None,
+                     verify_digest: bool = True) -> "GraphSnapshot":
+        """A ``mapped``-tier snapshot over a loaded arrays sidecar
+        (:func:`bibfs_tpu.store.sidecar.load_sidecar`): pairs, CSR and
+        the native int32 columns are read-only memmap views — zero
+        private copies, shared page cache across processes.
+
+        ``verify_digest=True`` recomputes :func:`content_digest` over
+        the mapped pairs and requires it to equal the sidecar's — the
+        bit-identical-to-in-memory-build property, proven on the very
+        bytes about to serve (a chunked stream, not a copy). Raises
+        ``ValueError`` on mismatch; callers fall back to a rebuild."""
+        n = smap.n
+        pairs = smap.arrays["pairs"]
+        if verify_digest:
+            got = content_digest(n, pairs)
+            if got != smap.digest:
+                raise ValueError(
+                    f"{smap.path}: mapped pairs digest {got} != sidecar "
+                    f"manifest {smap.digest} — refusing to serve a "
+                    "mapping that is not the checkpointed graph"
+                )
+        snap = cls(
+            n, pairs, digest=smap.digest,
+            version=smap.version if version is None else version,
+        )
+        snap._sidecar = smap
+        indptr = smap.arrays.get("csr.indptr")
+        if indptr is not None:
+            # col_ind is a VIEW of the mapped pairs (canonical order is
+            # CSR expansion order) — strided, still zero-copy; the one
+            # consumer needing contiguity (the native solver) gets the
+            # dedicated csr32 table below
+            snap._csr = (indptr, pairs[:, 1])
+            c32 = smap.arrays.get("csr32.indices")
+            if c32 is not None:
+                snap._native32 = (indptr, c32)
+        if smap.has("ell.nbr", "ell.deg", "ell.overflow"):
+            from bibfs_tpu.graph.csr import EllGraph
+
+            m = smap.meta("ell")
+            snap._ell = EllGraph(
+                n=int(m["n"]), n_pad=int(m["n_pad"]),
+                width=int(m["width"]), num_edges=int(m["num_edges"]),
+                nbr=smap.arrays["ell.nbr"], deg=smap.arrays["ell.deg"],
+                overflow=smap.arrays["ell.overflow"],
+            )
+        if smap.has("blocked.tab", "blocked.bcol", "blocked.deg"):
+            from bibfs_tpu.graph.blocked import BlockedGraph
+
+            m = smap.meta("blocked")
+            snap._blocked = BlockedGraph(
+                n=int(m["n"]), n_pad=int(m["n_pad"]),
+                tile=int(m["tile"]), nblocks=int(m["nblocks"]),
+                bwidth=int(m["bwidth"]), num_edges=int(m["num_edges"]),
+                nnz_blocks=int(m["nnz_blocks"]),
+                tab=smap.arrays["blocked.tab"],
+                bcol=smap.arrays["blocked.bcol"],
+                deg=smap.arrays["blocked.deg"],
+            )
+        return snap
 
     # ---- memoized builds --------------------------------------------
     # Each getter reads the memo into a LOCAL before testing it: the
@@ -183,6 +329,103 @@ class GraphSnapshot:
         p = self.pairs
         return p[p[:, 0] < p[:, 1]]
 
+    # ---- memory tiers (module docstring) -----------------------------
+    def native_csr(self):
+        """``(row_ptr int64, col_ind int32)`` in exactly the native C
+        solver's format when this snapshot is sidecar-mapped (one
+        shared page-cache copy per machine), else None — the engine's
+        host route then builds its private :class:`NativeGraph`."""
+        return self._native32
+
+    @property
+    def tier(self) -> str:
+        """``mapped`` / ``hot`` / ``cold`` (module docstring)."""
+        if self._sidecar is not None:
+            return "mapped"
+        if self._pairs is None and self._cold is not None:
+            return "cold"
+        return "hot"
+
+    def demote(self) -> int:
+        """Move a ``hot`` snapshot to the ``cold`` tier: encode the CSR
+        into a :class:`~bibfs_tpu.graph.compress.CompressedCSR` and
+        drop the resident arrays (pairs included — they decode back
+        exactly). Returns resident bytes freed (0 when already cold,
+        mapped, or retired — mapped arrays are the page cache's to
+        reclaim, not ours). The encode runs OFF the snapshot lock; only
+        the pointer drops run under it."""
+        with self._lock:
+            if (self._retired or self._sidecar is not None
+                    or self._pairs is None):
+                return 0
+            before = self.resident_bytes()
+            cold = self._cold
+        if cold is None:
+            from bibfs_tpu.graph.compress import encode_csr
+
+            cold = encode_csr(*self.csr())
+        with self._lock:
+            if self._retired or self._pairs is None:
+                return 0
+            self._cold = cold
+            self._pairs = None
+            self._csr = self._ell = self._tiered = self._blocked = None
+            self._native32 = None
+            self._demotions += 1
+            return max(before - self.resident_bytes(), 0)
+
+    def promote(self) -> bool:
+        """Decode a ``cold`` snapshot back to ``hot`` now (any
+        pairs/CSR access does this implicitly). True iff a decode
+        happened."""
+        with self._lock:
+            if self._pairs is not None or self._cold is None:
+                return False
+            decoded = self.pairs  # the property's locked decode-and-cache
+            return decoded is not None
+
+    @staticmethod
+    def _owned_bytes(obj) -> int:
+        """Private resident bytes of one memo — memmap views cost page
+        cache, not process-private memory, and are counted by
+        ``mapped_bytes`` instead."""
+        if obj is None:
+            return 0
+        if isinstance(obj, np.ndarray):
+            return 0 if isinstance(obj, np.memmap) else int(obj.nbytes)
+        if isinstance(obj, tuple):
+            return sum(GraphSnapshot._owned_bytes(o) for o in obj)
+        total = 0
+        for f in ("nbr", "deg", "overflow", "tab", "bcol",
+                  "row_ptr", "data"):
+            a = getattr(obj, f, None)
+            if isinstance(a, np.ndarray) and not isinstance(a, np.memmap):
+                total += int(a.nbytes)
+        return total
+
+    def resident_bytes(self) -> int:
+        """Process-private bytes this snapshot pins (pairs + memoized
+        tables + the cold encoding; mapped views excluded)."""
+        return sum(self._owned_bytes(o) for o in (
+            self._pairs, self._csr, self._ell, self._tiered,
+            self._blocked, self._native32, self._cold,
+        ))
+
+    def mapped_bytes(self) -> int:
+        """Bytes of sidecar arrays this snapshot keeps mapped (shared,
+        page-cache-backed — reclaimable by the OS under pressure)."""
+        return 0 if self._sidecar is None else self._sidecar.mapped_bytes
+
+    def memory(self) -> dict:
+        return {
+            "tier": self.tier,
+            "resident_bytes": self.resident_bytes(),
+            "mapped_bytes": self.mapped_bytes(),
+            "cold_bytes": self._owned_bytes(self._cold),
+            "promotions": self._promotions,
+            "demotions": self._demotions,
+        }
+
     # ---- refcount retirement ----------------------------------------
     def retain(self) -> "GraphSnapshot":
         with self._lock:
@@ -204,9 +447,16 @@ class GraphSnapshot:
             self._retired = True
             hooks, self._retire_hooks = self._retire_hooks, []
             # the canonical pairs stay (tiny relative to the tables, and
-            # stats()/digest re-derivation may still read them); the
-            # built adjacency tables are the memory owners
+            # stats()/digest re-derivation may still read them — on a
+            # cold snapshot the CompressedCSR stays for the same
+            # reason); the built adjacency tables are the memory
+            # owners. Mapped views are only UNREFERENCED, never
+            # explicitly unmapped: an in-flight flush that pinned a
+            # table keeps a valid buffer until the GC collects the last
+            # holder — no reader ever observes munmap.
             self._csr = self._ell = self._tiered = self._blocked = None
+            self._native32 = None
+            self._sidecar = None
         for hook in hooks:
             try:
                 hook(self)
@@ -240,6 +490,7 @@ class GraphSnapshot:
             "digest": self.digest,
             "version": self.version,
             "refs": self.refs,
+            "tier": self.tier,
         }
 
     def __repr__(self) -> str:

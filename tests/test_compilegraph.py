@@ -216,9 +216,20 @@ def test_seeded_retrace_canary_trips_the_sentinel(tmp_path):
     the real engine is caught (anonymous, repo call site named) by a
     real install() in a subprocess session. Editing the sentinel into
     a no-op makes this test fail red."""
+    # the child runs from tmp_path (so the report lands there) — put
+    # the source tree on its path explicitly; without an installed
+    # bibfs_tpu the import otherwise rides the parent's cwd by luck
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + (
+        os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else ""
+    )
     proc = subprocess.run(
         [sys.executable, "-c", _CANARY],
         cwd=tmp_path, capture_output=True, text=True, timeout=300,
+        env=env,
     )
     assert proc.returncode == 0, proc.stderr[-4000:]
     assert "CANARY_TRIPPED" in proc.stdout

@@ -92,6 +92,71 @@ def test_atomic_write_scoped_to_served_modules(tmp_path):
     assert found == []
 
 
+def test_atomic_write_rename_last_fires(tmp_path):
+    # a write-mode open AFTER the publishing rename mutates the
+    # already-committed path — the directory-manifest idiom's one
+    # ordering rule
+    files = {"bibfs_tpu/store/sc.py": """
+    import os
+
+    def publish(tmp, final, data):
+        with open(tmp + "/a.bin", "wb") as f:
+            f.write(data)
+        os.rename(tmp, final)
+        with open(final + "/late.bin", "wb") as f:  # torn: post-commit
+            f.write(data)
+    """}
+    found, _ = rule_findings(tmp_path, files, "atomic-write")
+    assert len(found) == 1
+    assert "AFTER its committing rename" in found[0].message
+
+
+def test_atomic_write_directory_manifest_good_twin(tmp_path):
+    # the sidecar shape: a per-array helper with NO commit of its own
+    # is legal because every same-module caller renames AFTER it —
+    # the helper is provably the tmp side of the caller's commit
+    files = {"bibfs_tpu/store/sc.py": """
+    import os
+
+    def _write_array(d, name, data):
+        with open(d + "/" + name, "wb") as f:
+            f.write(data)
+
+    def write_sidecar(tmp, final, arrays):
+        for name, data in arrays:
+            _write_array(tmp, name, data)
+        with open(tmp + "/manifest.json", "w") as f:
+            f.write("{}")
+        os.rename(tmp, final)
+    """}
+    found, _ = rule_findings(tmp_path, files, "atomic-write")
+    assert found == []
+
+
+def test_atomic_write_helper_needs_all_callers_committing(tmp_path):
+    # ONE caller that never commits (or commits before the call) voids
+    # the helper's coverage — the helper then writes a served path with
+    # no rename downstream of it
+    files = {"bibfs_tpu/store/sc.py": """
+    import os
+
+    def _write_array(d, name, data):
+        with open(d + "/" + name, "wb") as f:
+            f.write(data)
+
+    def write_sidecar(tmp, final, arrays):
+        for name, data in arrays:
+            _write_array(tmp, name, data)
+        os.rename(tmp, final)
+
+    def patch_in_place(final, data):
+        _write_array(final, "a.bin", data)  # no commit: torn
+    """}
+    found, _ = rule_findings(tmp_path, files, "atomic-write")
+    assert len(found) == 1
+    assert found[0].message.startswith("_write_array ")
+
+
 # ---- guarded-by ------------------------------------------------------
 BAD_GUARDED = {
     "bibfs_tpu/store/box.py": """

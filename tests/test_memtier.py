@@ -1,0 +1,219 @@
+"""Memory-tier integration: the residency accountant's promote/demote
+ledger, ``memory_stats()``/``memory`` command shape, the engine's
+zero-copy native solver over a mapped snapshot, render-at-zero for the
+three store memory metrics, and the satellite regression — TWO
+``ProcessReplica``s serving one durable store directory, with
+SIGKILL/respawn recovering by remap and the recovered digest verified."""
+
+import numpy as np
+import pytest
+
+from bibfs_tpu.graph.io import write_graph_bin
+from bibfs_tpu.obs.metrics import REGISTRY
+from bibfs_tpu.solvers.serial import solve_serial
+from bibfs_tpu.store import GraphStore, content_digest
+
+N = 60
+EDGES = np.array([[i, i + 1] for i in range(N - 1)]
+                 + [[i, i + 7] for i in range(N - 7)])
+
+
+def _seed_dir(tmp_path):
+    d = tmp_path / "store"
+    d.mkdir(exist_ok=True)
+    write_graph_bin(d / "g.bin", N, EDGES)
+    return str(d)
+
+
+# ---- metrics --------------------------------------------------------
+def test_memtier_metrics_render_at_zero():
+    """All three memory-tier families render BEFORE any traffic — a
+    dashboard pointed at a fresh store sees zeros, not absent series."""
+    st = GraphStore(compact_threshold=None, obs_label="t-mem0")
+    r = REGISTRY.render()
+    for name in ("bibfs_store_mmap_bytes", "bibfs_store_tier",
+                 "bibfs_store_remap_total"):
+        assert name in r
+    for tier in ("mapped", "hot", "cold"):
+        assert f'bibfs_store_tier{{store="t-mem0",tier="{tier}"}} 0' in r
+    st.add("g", 10, np.array([[0, 1], [1, 2]]))
+    r = REGISTRY.render()
+    # per-graph series mint at zero on add (no sidecar, no remap yet)
+    assert 'bibfs_store_mmap_bytes{store="t-mem0",graph="g"} 0' in r
+    assert 'bibfs_store_remap_total{store="t-mem0",graph="g"} 0' in r
+    assert 'bibfs_store_tier{store="t-mem0",tier="hot"} 1' in r
+    st.close()
+
+
+def test_memtier_metrics_track_remap(tmp_path):
+    d = _seed_dir(tmp_path)
+    GraphStore.from_dir(d, durable=True, compact_threshold=None,
+                        obs_label="t-mem1").close()
+    st = GraphStore.from_dir(d, durable=True, compact_threshold=None,
+                             obs_label="t-mem1")
+    r = REGISTRY.render()
+    assert 'bibfs_store_remap_total{store="t-mem1",graph="g"} 1' in r
+    assert 'bibfs_store_tier{store="t-mem1",tier="mapped"} 1' in r
+    mapped = st.memory_stats()["graphs"]["g"]["mapped_bytes"]
+    assert (f'bibfs_store_mmap_bytes{{store="t-mem1",graph="g"}} '
+            f'{mapped}') in r
+    st.close()
+
+
+# ---- accountant -----------------------------------------------------
+def test_memory_stats_shape():
+    st = GraphStore(compact_threshold=None)
+    st.add("g", 10, np.array([[0, 1], [1, 2]]))
+    ms = st.memory_stats()
+    for key in ("graphs", "resident_bytes", "mapped_bytes",
+                "residency_budget", "headroom_bytes", "mmap_arrays"):
+        assert key in ms
+    g = ms["graphs"]["g"]
+    for key in ("tier", "resident_bytes", "mapped_bytes", "cold_bytes",
+                "promotions", "demotions", "version", "digest",
+                "arrays"):
+        assert key in g
+    assert g["tier"] == "hot" and g["resident_bytes"] > 0
+    assert ms["residency_budget"] is None
+    st.close()
+
+
+def test_residency_accountant_demotes_and_promotes_exactly():
+    """Budget pressure demotes hot graphs to the compressed cold tier;
+    ANY access promotes back bit-exactly (digest-verified) and the
+    ledger counts both directions."""
+    st = GraphStore(compact_threshold=None, residency_budget=1)
+    rng = np.random.default_rng(11)
+    st.add("g1", 80, rng.integers(0, 80, size=(200, 2)))
+    st.add("g2", 80, rng.integers(0, 80, size=(200, 2)))
+    ms = st.memory_stats()
+    assert ms["headroom_bytes"] < 0
+    for g in ("g1", "g2"):
+        assert ms["graphs"][g]["tier"] == "cold"
+        assert ms["graphs"][g]["demotions"] >= 1
+        assert ms["graphs"][g]["cold_bytes"] > 0
+    digest = ms["graphs"]["g1"]["digest"]
+    snap = st.acquire("g1")
+    try:
+        # touching pairs promotes — and the promoted bytes are EXACT
+        assert content_digest(snap.n, snap.pairs) == digest
+        assert snap.tier == "hot"
+        assert st.memory_stats()["graphs"]["g1"]["promotions"] >= 1
+    finally:
+        snap.release()
+    st.rebalance()  # pressure still over budget: demoted again
+    assert st.memory_stats()["graphs"]["g1"]["tier"] == "cold"
+    # solves against the re-promoted graph still answer exactly
+    res = st.current("g1")
+    rp, ci = res.csr()
+    assert rp[-1] == ci.size
+    st.close()
+
+
+def test_accountant_respects_budget_headroom():
+    st = GraphStore(compact_threshold=None,
+                    residency_budget=1 << 30)
+    st.add("g", 10, np.array([[0, 1], [1, 2]]))
+    ms = st.memory_stats()
+    assert ms["graphs"]["g"]["tier"] == "hot"  # plenty of headroom
+    assert ms["headroom_bytes"] > 0
+    st.close()
+
+
+def test_rejects_negative_budget():
+    with pytest.raises(ValueError, match="residency_budget"):
+        GraphStore(compact_threshold=None, residency_budget=-1)
+
+
+# ---- engine zero-copy -----------------------------------------------
+def test_runtime_host_solver_is_zero_copy_on_mapped(tmp_path):
+    """The serving win: a runtime over a MAPPED snapshot hands the C
+    solver the sidecar's csr32 table directly — the column array is the
+    memmap itself, nothing was copied resident, and answers are exact."""
+    from bibfs_tpu.serve.engine import _GraphRuntime
+
+    d = _seed_dir(tmp_path)
+    GraphStore.from_dir(d, durable=True, compact_threshold=None).close()
+    st = GraphStore.from_dir(d, durable=True, compact_threshold=None)
+    snap = st.acquire("g")
+    try:
+        assert snap.tier == "mapped"
+        rt = _GraphRuntime(snap)
+        solver = rt.get_host_solver()
+        if rt.host_backend_resolved != "native":
+            pytest.skip("native runtime unavailable")
+        assert isinstance(rt.host_native_graph.col_ind, np.memmap)
+        for s, t in ((0, N - 1), (3, 40), (7, 7)):
+            assert solver(s, t).hops == solve_serial(N, EDGES, s, t).hops
+        # serving never touched .pairs: the snapshot stayed on the map
+        assert snap.resident_bytes() == 0
+    finally:
+        snap.release()
+        st.close()
+
+
+# ---- replicas -------------------------------------------------------
+def test_inprocess_replica_memory_command(tmp_path):
+    from bibfs_tpu.fleet import engine_replica
+
+    st = GraphStore(compact_threshold=None)
+    st.add("g", N, EDGES)
+    rep = engine_replica("m0", st)
+    try:
+        ms = rep.memory()
+        assert ms["graphs"]["g"]["tier"] == "hot"
+    finally:
+        rep.close()
+
+    from bibfs_tpu.fleet.replica import EngineReplica
+    from bibfs_tpu.serve.engine import QueryEngine
+
+    st2 = GraphStore(compact_threshold=None)
+    st2.add("g", N, EDGES)
+    lone = EngineReplica("m1", lambda: QueryEngine(store=st2, graph="g"))
+    try:
+        with pytest.raises(ValueError, match="no store"):
+            lone.memory()
+    finally:
+        lone.close()
+        st2.close()
+
+
+def test_two_process_replicas_share_one_durable_dir(tmp_path):
+    """Satellite regression: TWO ProcessReplicas over ONE durable store
+    dir both serve exact answers from the MAPPED tier (one page-cache
+    copy, zero python-resident adjacency), and a SIGKILL/respawn
+    recovers by remap with the recovered digest verified."""
+    from bibfs_tpu.fleet.replica import ProcessReplica
+
+    d = _seed_dir(tmp_path)
+    st = GraphStore.from_dir(d, durable=True, compact_threshold=None)
+    digest = st.current("g").digest
+    st.close()
+
+    reps = [ProcessReplica(f"m{i}", store_dir=d, durable=True,
+                           fsync="off") for i in range(2)]
+    try:
+        for rep in reps:
+            mem = rep.memory(timeout=30.0)
+            g = mem["graphs"]["g"]
+            assert g["tier"] == "mapped", g
+            assert g["mapped_bytes"] > 0
+            assert g["resident_bytes"] == 0  # bounded private copy
+            assert g["digest"] == digest
+            for s, t in ((0, N - 1), (5, 44)):
+                got = rep.wait_ticket(rep.submit(s, t, "g"),
+                                      timeout=60.0)
+                assert got.hops == solve_serial(N, EDGES, s, t).hops
+        # chaos: SIGKILL one replica, respawn — recovery must REMAP
+        victim = reps[0]
+        victim.kill()
+        victim.restart()
+        g = victim.memory(timeout=30.0)["graphs"]["g"]
+        assert g["tier"] == "mapped" and g["digest"] == digest
+        got = victim.wait_ticket(victim.submit(0, N - 1, "g"),
+                                 timeout=60.0)
+        assert got.hops == solve_serial(N, EDGES, 0, N - 1).hops
+    finally:
+        for rep in reps:
+            rep.close()

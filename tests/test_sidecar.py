@@ -1,0 +1,236 @@
+"""Arrays sidecar (bibfs_tpu/store/sidecar) + mmap snapshot tier: the
+directory-manifest rename-last commit, digest-verified loads, bit-exact
+mmap-vs-in-memory equivalence, recovery-by-remap with fallback to the
+``.bin`` rebuild, GC of superseded sidecars, and the no-unmapped-reads
+retirement contract."""
+
+import os
+
+import numpy as np
+import pytest
+
+from bibfs_tpu.graph.generate import grid_graph, rmat_graph
+from bibfs_tpu.graph.io import write_graph_bin
+from bibfs_tpu.serve.faults import FaultPlan, InjectedFault
+from bibfs_tpu.store import (
+    GraphSnapshot,
+    GraphStore,
+    content_digest,
+    load_sidecar,
+    sidecar_dir_name,
+    write_sidecar,
+)
+from bibfs_tpu.store.sidecar import ARRAYS_DIR_RE, remove_sidecar_quiet
+
+
+def _snap(seed=0, n=120, m=360):
+    rng = np.random.default_rng(seed)
+    return GraphSnapshot.build(n, rng.integers(0, n, size=(m, 2)))
+
+
+# ---- write/load/from_sidecar ----------------------------------------
+def test_sidecar_roundtrip_and_digest_equality(tmp_path):
+    """The tentpole property: a snapshot mapped from its sidecar is
+    BIT-IDENTICAL to the in-memory build — same content digest, same
+    CSR, same solves — across graph families."""
+    n_r, e_r = rmat_graph(9, 6, seed=3)
+    cases = [
+        (120, _snap(1).pairs),
+        (23 * 17, grid_graph(23, 17, perforation=0.03, seed=2)),
+        (n_r, e_r),
+    ]
+    for n, edges in cases:
+        mem = GraphSnapshot.build(n, edges)
+        d = write_sidecar(str(tmp_path), "g", mem)
+        smap = load_sidecar(os.path.join(str(tmp_path), d))
+        mapped = GraphSnapshot.from_sidecar(smap, version=mem.version)
+        assert mapped.digest == mem.digest
+        assert mapped.tier == "mapped"
+        assert np.array_equal(mapped.pairs, mem.pairs)
+        rp_a, ci_a = mapped.csr()
+        rp_b, ci_b = mem.csr()
+        assert np.array_equal(rp_a, rp_b)
+        assert np.array_equal(ci_a, ci_b)
+        assert isinstance(mapped.pairs, np.memmap)
+        remove_sidecar_quiet(os.path.join(str(tmp_path), d))
+
+
+def test_sidecar_digest_property_random(tmp_path):
+    """Property test: mmap digest == in-memory digest on a spread of
+    random graphs (sizes, densities, empty)."""
+    rng = np.random.default_rng(7)
+    for i in range(8):
+        n = int(rng.integers(2, 300))
+        m = int(rng.integers(0, 5 * n))
+        mem = GraphSnapshot.build(n, rng.integers(0, n, size=(m, 2)))
+        d = write_sidecar(str(tmp_path), f"g{i}", mem)
+        smap = load_sidecar(os.path.join(str(tmp_path), d),
+                            verify="full")
+        mapped = GraphSnapshot.from_sidecar(smap)
+        assert mapped.digest == mem.digest
+        assert content_digest(mapped.n, mapped.pairs) == mem.digest
+
+
+def test_sidecar_native32_is_mapped_and_solves(tmp_path):
+    mem = _snap(4)
+    d = write_sidecar(str(tmp_path), "g", mem)
+    mapped = GraphSnapshot.from_sidecar(
+        load_sidecar(os.path.join(str(tmp_path), d))
+    )
+    rp, c32 = mapped.native_csr()
+    assert c32.dtype == np.int32 and isinstance(c32, np.memmap)
+    assert np.array_equal(c32, mem.csr()[1].astype(np.int32))
+
+
+def test_sidecar_idempotent_and_name_stable(tmp_path):
+    mem = _snap(5)
+    d1 = write_sidecar(str(tmp_path), "g", mem)
+    d2 = write_sidecar(str(tmp_path), "g", mem)  # existing dir kept
+    assert d1 == d2 == sidecar_dir_name("g", mem)
+    assert ARRAYS_DIR_RE.search(d1)
+
+
+def test_sidecar_load_rejects_corruption(tmp_path):
+    mem = _snap(6)
+    d = os.path.join(str(tmp_path), write_sidecar(str(tmp_path), "g", mem))
+    target = os.path.join(d, "pairs.bin")
+    with open(target, "r+b") as f:
+        f.seek(8)
+        f.write(b"\xff\xff\xff\xff")
+    load_sidecar(d, verify="size")  # size-only: passes
+    with pytest.raises(ValueError, match="content hash"):
+        load_sidecar(d, verify="full")
+    # from_sidecar recomputes the content digest over the mapped pairs
+    # even after a size-only load — torn arrays cannot serve
+    with pytest.raises(ValueError):
+        GraphSnapshot.from_sidecar(load_sidecar(d, verify="size"))
+
+
+def test_sidecar_load_rejects_truncation(tmp_path):
+    mem = _snap(8)
+    d = os.path.join(str(tmp_path), write_sidecar(str(tmp_path), "g", mem))
+    target = os.path.join(d, "csr32_indices.bin")
+    with open(target, "r+b") as f:
+        f.truncate(os.path.getsize(target) - 4)
+    with pytest.raises(ValueError, match="bytes on disk"):
+        load_sidecar(d, verify="size")
+
+
+def test_sidecar_rename_fault_cleans_tmp(tmp_path):
+    """A fault at the publishing rename leaves NO final dir and no tmp
+    orphan — the rename-last discipline's crash story."""
+    mem = _snap(9)
+    plan = FaultPlan.parse("sidecar_rename:times=1")
+    with pytest.raises(InjectedFault, match="sidecar_rename"):
+        write_sidecar(str(tmp_path), "g", mem, fire=plan.fire)
+    assert os.listdir(str(tmp_path)) == []
+    # next attempt (fault exhausted) succeeds
+    d = write_sidecar(str(tmp_path), "g", mem, fire=plan.fire)
+    assert os.path.isdir(os.path.join(str(tmp_path), d))
+
+
+# ---- store integration ----------------------------------------------
+N = 60
+EDGES = np.array([[i, i + 1] for i in range(N - 1)]
+                 + [[i, i + 7] for i in range(N - 7)])
+
+
+def _seed_dir(tmp_path):
+    d = tmp_path / "store"
+    d.mkdir(exist_ok=True)
+    write_graph_bin(d / "g.bin", N, EDGES)
+    return str(d)
+
+
+def test_store_recovery_by_remap(tmp_path):
+    d = _seed_dir(tmp_path)
+    st = GraphStore.from_dir(d, durable=True, compact_threshold=None)
+    digest = st.current("g").digest
+    arrays = st.stats()["graphs"]["g"]["durable"]["arrays"]
+    assert arrays and ARRAYS_DIR_RE.search(arrays)
+    st.close()
+
+    st2 = GraphStore.from_dir(d, durable=True, compact_threshold=None)
+    rec = st2.stats()["graphs"]["g"]["durable"]["recovered"]
+    assert rec["remapped"] is True
+    snap = st2.current("g")
+    assert snap.tier == "mapped"
+    assert snap.digest == digest
+    assert snap.mapped_bytes() > 0 and snap.resident_bytes() == 0
+    st2.close()
+
+
+def test_store_compact_supersedes_sidecar_and_gcs(tmp_path):
+    d = _seed_dir(tmp_path)
+    st = GraphStore.from_dir(d, durable=True, compact_threshold=None)
+    a1 = st.stats()["graphs"]["g"]["durable"]["arrays"]
+    st.update("g", adds=[(0, 50)])
+    st.compact("g")
+    a2 = st.stats()["graphs"]["g"]["durable"]["arrays"]
+    assert a2 != a1
+    assert not os.path.exists(os.path.join(d, a1)), "superseded gc'd"
+    assert os.path.isdir(os.path.join(d, a2))
+    st.close()
+    st2 = GraphStore.from_dir(d, durable=True, compact_threshold=None)
+    assert st2.stats()["graphs"]["g"]["durable"]["recovered"]["remapped"]
+    # the folded edge is served from the REMAPPED v2 arrays
+    rp, ci = st2.current("g").csr()
+    assert 50 in ci[rp[0]:rp[1]]
+    st2.close()
+
+
+def test_store_recovery_falls_back_on_torn_sidecar(tmp_path, capsys):
+    """A corrupted sidecar must NEVER block recovery: the store warns
+    visibly and rebuilds from the .bin + WAL — same answers, hot tier,
+    remapped=False."""
+    d = _seed_dir(tmp_path)
+    st = GraphStore.from_dir(d, durable=True, compact_threshold=None)
+    digest = st.current("g").digest
+    arrays = st.stats()["graphs"]["g"]["durable"]["arrays"]
+    st.close()
+    with open(os.path.join(d, arrays, "pairs.bin"), "r+b") as f:
+        f.seek(0)
+        f.write(b"\x00" * 16)
+
+    st2 = GraphStore.from_dir(d, durable=True, compact_threshold=None)
+    rec = st2.stats()["graphs"]["g"]["durable"]["recovered"]
+    assert rec["remapped"] is False
+    snap = st2.current("g")
+    assert snap.tier == "hot"
+    assert snap.digest == digest  # rebuilt exactly
+    assert "sidecar remap failed" in capsys.readouterr().err
+    st2.close()
+
+
+def test_store_no_mmap_opt_out(tmp_path):
+    d = _seed_dir(tmp_path)
+    st = GraphStore.from_dir(d, durable=True, compact_threshold=None,
+                             mmap_arrays=False)
+    assert st.stats()["graphs"]["g"]["durable"]["arrays"] is None
+    assert st.current("g").tier == "hot"
+    st.close()
+    # a later mmap-enabled open of the same dir still works (no stale
+    # manifest arrays key)
+    st2 = GraphStore.from_dir(d, durable=True, compact_threshold=None)
+    assert st2.current("g").tier in ("hot", "mapped")
+    st2.close()
+
+
+def test_mapped_snapshot_survives_retirement_reads(tmp_path):
+    """The no-unmapped-reads contract: a pinned mapped snapshot keeps
+    serving byte-identical reads after the store retires it — release
+    drops references, never munmaps."""
+    d = _seed_dir(tmp_path)
+    st = GraphStore.from_dir(d, durable=True, compact_threshold=None)
+    st.close()
+    st2 = GraphStore.from_dir(d, durable=True, compact_threshold=None)
+    snap = st2.acquire("g")
+    assert snap.tier == "mapped"
+    before = snap.pairs.copy()
+    st2.update("g", adds=[(0, 45)])
+    st2.compact("g")  # hot-swap: old snapshot will retire
+    assert np.array_equal(snap.pairs, before)  # pinned: still mapped
+    rp, ci = snap.csr()
+    assert rp[-1] == before.shape[0]
+    snap.release()
+    st2.close()

@@ -233,18 +233,34 @@ def test_from_dir_all_corrupt_raises(tmp_path):
 
 def test_recovery_digest_mismatch_skips_graph(tmp_path):
     """A checkpoint .bin that does not hash to its manifest's digest is
-    corruption — the graph is skipped (visible), not served wrong."""
+    corruption — with no digest-verified arrays sidecar to remap, the
+    graph is skipped (visible), not served wrong. A VALID sidecar is a
+    first-class recovery source: it rescues the graph exactly (the
+    mapped pairs recompute to the manifest digest) even over a torn
+    .bin."""
+    import shutil
+
     d = _seed_dir(tmp_path, names=("g", "ok"))
     st = GraphStore.from_dir(d, durable=True, compact_threshold=None)
     st.update("g", adds=[(0, 49)])
     st.compact("g")
+    digest = st.current("g").digest
+    arrays = st.stats()["graphs"]["g"]["durable"]["arrays"]
     st.close()
     ckpt = json.load(open(os.path.join(d, "g.manifest.json")))["bin"]
     write_graph_bin(os.path.join(d, ckpt), N, EDGES[:-2])
+    # sidecar intact: recovery remaps and serves the EXACT snapshot
     st2 = GraphStore.from_dir(d, durable=True, compact_threshold=None)
-    assert st2.names() == ["ok"]
-    assert st2.load_errors and "digest" in st2.load_errors[0]["error"]
+    assert sorted(st2.names()) == ["g", "ok"]
+    assert st2.current("g").digest == digest
+    assert st2.stats()["graphs"]["g"]["durable"]["recovered"]["remapped"]
     st2.close()
+    # sidecar gone: the torn .bin is the only source — skipped, loudly
+    shutil.rmtree(os.path.join(d, arrays))
+    st3 = GraphStore.from_dir(d, durable=True, compact_threshold=None)
+    assert st3.names() == ["ok"]
+    assert st3.load_errors and "digest" in st3.load_errors[0]["error"]
+    st3.close()
 
 
 def test_add_refuses_leftover_durable_state(tmp_path):
@@ -265,7 +281,12 @@ def test_programmatic_add_writes_seed_and_manifest(tmp_path):
     st.add("g", N, EDGES)
     st.update("g", adds=[(0, 49)])
     st.close()
-    assert sorted(os.listdir(d)) == [
+    from bibfs_tpu.store.sidecar import ARRAYS_DIR_RE
+
+    listing = sorted(os.listdir(d))
+    sidecars = [x for x in listing if ARRAYS_DIR_RE.search(x)]
+    assert len(sidecars) == 1  # the seed snapshot's arrays sidecar
+    assert [x for x in listing if x not in sidecars] == [
         # no g.history.json: the as-of commit index is written only by
         # retain_history stores (store/history.py)
         "g.bin", "g.manifest.json", "g.wal.1"
