@@ -2380,6 +2380,167 @@ def serve_fleet_main():
         return 1
 
 
+# --serve-net defaults: the network front door soak drives a spawned
+# bibfs-serve --port child and the in-process pipelined engine on
+# IDENTICAL open-loop socket traffic (grid graph, sampled pairs), then
+# the wire-only legs: per-request deadlines end-to-end, per-tenant
+# quota admission, a NetReplica fleet SIGKILL + respawn with zero lost
+# acked tickets, and a live /metrics scrape of the bibfs_net_*
+# families; the full run appends the two-process jax.distributed pod
+# dryrun (merged as the artifact's "pod" block). --quick is the CI
+# smoke shape (every leg runs; the machine-sensitive net/in-process
+# qps ratio is reported, not gated).
+NET_GRID = os.environ.get("BENCH_NET_GRID", "64x64")
+NET_Q = int(os.environ.get("BENCH_NET_Q", 400))
+NET_RATES = os.environ.get("BENCH_NET_RATES", "100,400,1200")
+NET_CONNECTIONS = int(os.environ.get("BENCH_NET_CONNECTIONS", 64))
+NET_FLOOR = float(os.environ.get("BENCH_NET_FLOOR", 0.8))
+NET_RECOVERY_S = float(os.environ.get("BENCH_NET_RECOVERY_S", 20.0))
+
+
+def serve_net_main():
+    """``python bench.py --serve-net``: the network front door soak.
+
+    The concurrent framed-TCP serving path judged against the
+    in-process pipelined engine on identical open-loop traffic
+    (bibfs_tpu/serve/loadgen.run_net), plus the claims only a real
+    socket harness can make: deadline SLO end-to-end (generous
+    deadlines never time out, impossible ones fail STRUCTURED and are
+    counted), per-tenant token-bucket quotas (greedy tenant refused
+    with structured capacity errors, polite tenant untouched, every
+    accepted answer exact), a Router over NetReplica children taking a
+    mid-stream SIGKILL + respawn with zero lost acked tickets, and the
+    ``bibfs_net_*`` metric families on a live /metrics scrape. The
+    full run appends the two-process ``jax.distributed`` pod dryrun
+    (run_pod_dryrun) as the ``pod`` block and gates on it. Artifact:
+    ``bench_net.json``."""
+    t_setup = time.time()
+    platform, tpu_error = select_platform()
+    try:
+        from bibfs_tpu.graph.generate import grid_graph
+        from bibfs_tpu.serve.loadgen import run_net, run_pod_dryrun
+
+        quick = "--quick" in sys.argv
+        grid_spec = "32x32" if quick else NET_GRID
+        try:
+            w, h = (int(x) for x in grid_spec.split("x"))
+        except ValueError:
+            print(f"bad BENCH_NET_GRID {NET_GRID!r} (want WxH)",
+                  file=sys.stderr)
+            return 1
+        rates = tuple(
+            float(r) for r in
+            ("50,200" if quick else NET_RATES).split(",")
+        )
+        edges = grid_graph(w, h, perforation=0.02, seed=0)
+        out = run_net(
+            w * h, edges,
+            queries=120 if quick else NET_Q,
+            rates=rates,
+            connections=16 if quick else NET_CONNECTIONS,
+            net_floor=0.0 if quick else NET_FLOOR,
+            chaos_queries=120 if quick else 300,
+            chaos_span_s=5.0 if quick else 8.0,
+            recovery_bound_s=NET_RECOVERY_S,
+        )
+        if not quick:
+            pod = run_pod_dryrun()
+            out["pod"] = pod
+            # a platform without multi-process jax SKIPS with a
+            # reason; where it runs, the dryrun's own gates decide
+            out["gates"]["pod_ok"] = bool(
+                pod.get("ok") or "skipped" in pod
+            )
+            out["ok"] = bool(out["ok"]) and out["gates"]["pod_ok"]
+        line = {
+            "metric": f"bibfs_serve_net_{w * h}",
+            "value": out["net_vs_inprocess"]["net_qps"],
+            "unit": "queries/s",
+            "graph": f"grid({w}x{h}, perf=0.02)",
+            "platform": platform,
+            "quick": quick,
+            **out,
+            "total_s": round(time.time() - t_setup, 1),
+        }
+        if tpu_error:
+            line["tpu_error"] = tpu_error[:300]
+        _write_artifact("bench_net.json", line)
+        print(json.dumps({
+            "metric": line["metric"],
+            "value": line["value"],
+            "unit": "queries/s",
+            "ok": line["ok"],
+            "net_ratio": out["net_vs_inprocess"]["ratio"],
+            "gates": out["gates"],
+            "deadline_misses_scraped": out["metrics"].get(
+                "deadline_misses_scraped"
+            ),
+            "fleet_recovery_s": out["fleet_phase"]["recovery_s"],
+            "pod": {
+                k: v for k, v in out.get("pod", {}).items()
+                if k in ("ok", "skipped", "mesh_queries_pre_roll",
+                         "mesh_queries_post_roll", "exit_codes")
+            } or None,
+            "detail_file": "bench_net.json",
+        }))
+        return 0 if line["ok"] else 1
+    except Exception as e:
+        import traceback
+
+        traceback.print_exc()
+        print(json.dumps({
+            "metric": "bibfs_serve_net",
+            "value": None,
+            "error": f"{type(e).__name__}: {e}"[:400],
+        }))
+        return 1
+
+
+def pod_dryrun_main():
+    """``python bench.py --pod-dryrun``: the multi-process mesh
+    replica dryrun alone (the CI multi-process step). Two REAL
+    ``jax.distributed`` processes on the CPU backend serve the framed
+    front door as ONE logical replica — every answer gated exact vs
+    the serial oracle AND mesh-served (the bitpacked dual-frontier
+    exchange crossed a process boundary), across a mid-traffic roll
+    hot-swap, with clean SIGTERM exits. Exits 0 on pass OR a skip
+    with a reason (platforms without multi-process jax)."""
+    t_setup = time.time()
+    platform, tpu_error = select_platform()
+    try:
+        from bibfs_tpu.serve.loadgen import run_pod_dryrun
+
+        quick = "--quick" in sys.argv
+        out = run_pod_dryrun(
+            grid=(24, 24) if quick else (32, 32),
+            queries=24 if quick else 48,
+        )
+        skipped = "skipped" in out
+        print(json.dumps({
+            "metric": "bibfs_pod_dryrun",
+            "value": out.get("mesh_queries_post_roll"),
+            "unit": "mesh-served queries",
+            "platform": platform,
+            "quick": quick,
+            "ok": bool(out.get("ok")),
+            "skipped": out.get("skipped"),
+            **{k: v for k, v in out.items()
+               if k not in ("logs", "skipped")},
+            "total_s": round(time.time() - t_setup, 1),
+        }))
+        return 0 if (skipped or out.get("ok")) else 1
+    except Exception as e:
+        import traceback
+
+        traceback.print_exc()
+        print(json.dumps({
+            "metric": "bibfs_pod_dryrun",
+            "value": None,
+            "error": f"{type(e).__name__}: {e}"[:400],
+        }))
+        return 1
+
+
 # --serve-memtier defaults: the memory-tier soak serves one streamed
 # RMAT graph (scale 24 ≈ 16.7M nodes full / scale 14 quick) from ONE
 # durable store dir through a fleet of mmap-recovering subprocess
@@ -2468,6 +2629,10 @@ def serve_memtier_main():
 if __name__ == "__main__":
     if "--calibrate" in sys.argv:
         sys.exit(calibrate_main())
+    elif "--serve-net" in sys.argv:
+        sys.exit(serve_net_main())
+    elif "--pod-dryrun" in sys.argv:
+        sys.exit(pod_dryrun_main())
     elif "--serve-memtier" in sys.argv:
         sys.exit(serve_memtier_main())
     elif "--serve-crash" in sys.argv:

@@ -6,7 +6,10 @@ hash on graph name (spilling hot graphs to the least-loaded replica)
 across :class:`~bibfs_tpu.fleet.replica.EngineReplica` (in-process
 engines over per-replica graph stores) and
 :class:`~bibfs_tpu.fleet.replica.ProcessReplica` (spawned
-``bibfs-serve`` subprocesses) behind one replica interface; routing
+``bibfs-serve`` subprocesses over stdin pipes) and
+:class:`~bibfs_tpu.fleet.netreplica.NetReplica` (spawned
+``bibfs-serve --port`` children over the framed TCP front door)
+behind one replica interface; routing
 consumes replica health, failures re-route with retry/backoff, and
 :meth:`~bibfs_tpu.fleet.router.Router.rolling_swap` rolls snapshot
 swaps across the fleet one drained replica at a time. ``bibfs-fleet``
@@ -14,6 +17,7 @@ is the CLI; ``bench.py --serve-fleet`` the kill/restart + rolling-swap
 soak (``bench_fleet.json``).
 """
 
+from bibfs_tpu.fleet.netreplica import NetReplica  # noqa: F401
 from bibfs_tpu.fleet.replica import (  # noqa: F401
     EngineReplica,
     ProcessReplica,

@@ -142,6 +142,18 @@ QUERY_METRIC_FAMILIES = (
     "bibfs_query_device_breaker_state",
 )
 
+#: network front door (serve/net.py); minted at NetServer construction
+#: so a ``bibfs-serve --port`` process renders the whole group at zero
+#: before the first connection. Rejection reasons are tenant-less
+#: labels (reason= only — tenant ids are unbounded cardinality)
+NET_METRIC_FAMILIES = (
+    "bibfs_net_connections",
+    "bibfs_net_requests_total",
+    "bibfs_net_rejections_total",
+    "bibfs_net_bytes_total",
+    "bibfs_net_deadline_misses_total",
+)
+
 #: build identity (obs/metrics.py; minted at every registry init)
 BUILD_INFO_METRIC = "bibfs_build_info"
 
@@ -172,6 +184,7 @@ ALL_METRIC_NAMES = frozenset(
     + BLOCKED_METRIC_FAMILIES
     + ADAPTIVE_METRIC_FAMILIES
     + QUERY_METRIC_FAMILIES
+    + NET_METRIC_FAMILIES
     + _FLEET_ONLY
     + (BUILD_INFO_METRIC,)
 )

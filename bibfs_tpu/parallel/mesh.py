@@ -109,6 +109,96 @@ def replicated_spec(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, PartitionSpec())
 
 
+class DistributedContext:
+    """What :func:`init_distributed` proved about the joined job: this
+    process's index, the job size, and the local/global device split —
+    the numbers a pod-serving primary checks before it trusts a global
+    mesh (a worker that silently joined with 0 local devices would
+    otherwise surface only as a hang inside the first collective)."""
+
+    __slots__ = ("process_index", "process_count", "local_device_count",
+                 "global_device_count", "coordinator_address")
+
+    def __init__(self, process_index, process_count, local_device_count,
+                 global_device_count, coordinator_address):
+        self.process_index = int(process_index)
+        self.process_count = int(process_count)
+        self.local_device_count = int(local_device_count)
+        self.global_device_count = int(global_device_count)
+        self.coordinator_address = coordinator_address
+
+    @property
+    def is_primary(self) -> bool:
+        """Process 0 — the one that owns the serving front door in a
+        pod-mesh replica (:mod:`bibfs_tpu.parallel.podmesh`)."""
+        return self.process_index == 0
+
+    def asdict(self) -> dict:
+        return {
+            "process_index": self.process_index,
+            "process_count": self.process_count,
+            "local_device_count": self.local_device_count,
+            "global_device_count": self.global_device_count,
+            "coordinator_address": self.coordinator_address,
+        }
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return (
+            f"DistributedContext(process {self.process_index}/"
+            f"{self.process_count}, devices "
+            f"{self.local_device_count}/{self.global_device_count})"
+        )
+
+
+def init_distributed(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+    *,
+    auto: bool = False,
+) -> DistributedContext:
+    """Join a multi-process SPMD job and report what was joined.
+
+    The served-configuration entry point behind ``bibfs-serve``'s
+    ``--coordinator`` flags: same :func:`jax.distributed.initialize`
+    contract as :func:`init_multihost` (explicit coordinator triple, or
+    ``auto=True`` for cluster auto-detection; bare calls raise
+    :class:`ValueError` instead of hanging in connection retry), but
+    returns a :class:`DistributedContext` carrying process index/count
+    and local/global device visibility so callers can ASSERT the
+    topology they asked for before building a global mesh over it.
+    Must run before anything touches a backend (jax requirement).
+    """
+    if coordinator_address is None and not auto:
+        raise ValueError(
+            "init_distributed needs a coordinator_address, or auto=True "
+            "to use jax's cluster auto-detection (TPU pod / GKE / SLURM "
+            "/ MPI); on a single host just build a mesh with "
+            "make_1d_mesh()"
+        )
+    # XLA's default CPU collectives stop at the process boundary
+    # ("Multiprocess computations aren't implemented on the CPU
+    # backend"); gloo does the real wire exchange, which the CPU
+    # dryruns of the pod-serving soak depend on. Config must land
+    # before the backend initializes — this function's contract.
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass  # a jaxlib without the knob: TPU/GPU jobs don't need it
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    return DistributedContext(
+        process_index=jax.process_index(),
+        process_count=jax.process_count(),
+        local_device_count=jax.local_device_count(),
+        global_device_count=len(jax.devices()),
+        coordinator_address=coordinator_address,
+    )
+
+
 def init_multihost(
     coordinator_address: str | None = None,
     num_processes: int | None = None,
@@ -146,9 +236,6 @@ def init_multihost(
             "use jax's cluster auto-detection (TPU pod / GKE / SLURM / "
             "MPI); on a single host just build a mesh with make_1d_mesh()"
         )
-    jax.distributed.initialize(
-        coordinator_address=coordinator_address,
-        num_processes=num_processes,
-        process_id=process_id,
-    )
-    return jax.process_index()
+    return init_distributed(
+        coordinator_address, num_processes, process_id, auto=auto,
+    ).process_index

@@ -1,0 +1,446 @@
+"""Pod-mesh serving plumbing — the control plane that lets ONE logical
+replica span MULTIPLE ``jax.distributed`` processes.
+
+The data plane needs no help: once :func:`bibfs_tpu.parallel.mesh.
+init_distributed` has joined the job, the vertex-sharded batch program
+(:mod:`bibfs_tpu.solvers.sharded`) runs as one SPMD program over the
+global mesh and its bitpacked dual-frontier all_gathers
+(``parallel/collectives.all_gather_bits_dual``) cross the process
+boundary on their own — ``tests/test_multihost.py`` has proven that
+exactness since round 7. What multi-process SERVING adds is a control
+problem: every process must enter the same collectives in the same
+order with the same operands, but only process 0 (the primary) sees
+the query stream, the store, and the network front door. This module
+is that missing lockstep:
+
+- :class:`PodPrimary` (process 0) owns one TCP control connection per
+  worker (the same length-prefixed JSON frames as
+  :mod:`bibfs_tpu.serve.net` — one wire format for the whole PR) and
+  broadcasts ``graph`` / ``solve`` / ``shutdown`` descriptors;
+- :func:`run_pod_worker` (process > 0) executes descriptors strictly
+  in receipt order: rebuild the sharded graph on a ``graph``
+  descriptor, dispatch the IDENTICAL padded batch program on a
+  ``solve`` descriptor, ack each phase back;
+- :class:`bibfs_tpu.serve.routes.pod.PodMeshRoute` drives the primary
+  side from inside the engine's existing mesh rung.
+
+**The join barrier.** A ``solve`` is acked twice: ``join`` once the
+worker has validated the graph digest and built the dispatch (it is
+now committed to entering the collective), ``done`` once its
+``block_until_ready`` returned (carrying its replicated ``best``
+vector so the primary can assert cross-process agreement). The
+primary awaits every ``join`` BEFORE entering the collective itself:
+a worker that refuses (digest mismatch, build failure) fails the
+launch as a :class:`PodError` while the primary is still on the host,
+and the engine's fallback ladder re-runs the batch on the local
+single-device rungs — degraded throughput, never a hang and never a
+wrong answer. (A worker dying INSIDE the collective is the one fault
+this cannot catch; that is ``jax.distributed``'s heartbeat timeout's
+job, exactly as it was ``MPI_Allreduce``'s.)
+
+**Graph identity.** A ``graph`` descriptor ships the snapshot's
+canonical pairs + content digest; the worker rebuilds the SAME
+``GraphSnapshot -> bucketed ELL -> repad_rows -> ShardedGraph``
+chain the primary's engine runtime built, verifying the digest over
+the received pairs first. Same pairs + same mesh => bit-identical
+shapes and content => the same compiled SPMD program on every
+process. A store hot-swap on the primary needs no special casing:
+the next launch sees a new digest and re-broadcasts before solving —
+the mid-traffic hot-swap the soak gates.
+
+Thread discipline (lockgraph-checked): descriptor SENDS happen only
+on the engine's flusher thread (launches are serialized by
+construction; ``shutdown`` only after the engine is closed), so the
+sockets have a single writer and no send lock. Acks are consumed by
+one daemon reader thread per worker into a mailbox guarded by
+``_lock``; waiters block on the mailbox condition, never on a socket.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+import time
+
+import numpy as np
+
+from bibfs_tpu.analysis import guarded_by
+from bibfs_tpu.serve.net import MAX_FRAME_BYTES, encode_frame, extract_frames
+
+#: default pod control port offset from the jax.distributed coordinator
+#: port — ``bibfs-serve --coordinator host:P`` listens for workers on
+#: ``P + POD_PORT_OFFSET`` unless ``--pod-port`` overrides it
+POD_PORT_OFFSET = 1
+
+
+class PodError(RuntimeError):
+    """A pod control-plane failure (worker refused/died/timed out).
+    Raised out of the mesh rung's launch/finish, where the engine's
+    resilience ladder catches it and re-runs the batch on the local
+    single-device rungs — exact answers, degraded throughput."""
+
+
+def _recv_frames(sock, buf: bytearray):
+    """Blocking read -> complete DECODED frames (empty list on a short
+    read that completed no frame). Raises ConnectionError on EOF and
+    ValueError on a frame that is not a JSON object."""
+    data = sock.recv(1 << 16)
+    if not data:
+        raise ConnectionError("pod peer closed the control connection")
+    buf.extend(data)
+    out = []
+    for raw in extract_frames(buf, MAX_FRAME_BYTES):
+        msg = json.loads(raw.decode("utf-8"))
+        if not isinstance(msg, dict):
+            raise ValueError(f"pod frame is not an object: {msg!r}")
+        out.append(msg)
+    return out
+
+
+@guarded_by("_lock", "_acks", "_dead", "_seq", "_workers")
+class PodPrimary:
+    """Process 0's side of the pod control plane (module docstring).
+
+    ``accept_workers`` blocks until every worker has connected and
+    introduced itself, then starts one reader thread per connection.
+    ``post_*`` broadcast a descriptor (single-writer by construction:
+    the engine flusher); ``await_phase`` blocks on the ack mailbox.
+    """
+
+    def __init__(self, num_workers: int, *, host: str = "",
+                 port: int = 0, accept_timeout_s: float = 120.0):
+        self.num_workers = int(num_workers)
+        self._accept_timeout_s = float(accept_timeout_s)
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._seq = 0
+        self._workers: dict = {}       # process_index -> socket
+        self._acks: dict = {}          # (seq, phase) -> {pidx: msg}
+        self._dead: dict = {}          # process_index -> reason
+        self._last_digest: str | None = None  # flusher-only state
+        self._closed = False
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, int(port)))
+        self._listener.listen(self.num_workers or 1)
+        self.port = self._listener.getsockname()[1]
+
+    # ---- join --------------------------------------------------------
+    def accept_workers(self) -> None:
+        """Block until all ``num_workers`` workers connected and sent
+        their hello; start their reader threads. Raises
+        :class:`PodError` past the accept timeout."""
+        deadline = time.monotonic() + self._accept_timeout_s
+        joined: dict = {}
+        while len(joined) < self.num_workers:
+            self._listener.settimeout(
+                max(0.1, deadline - time.monotonic())
+            )
+            try:
+                sock, _addr = self._listener.accept()
+            except (socket.timeout, OSError):
+                raise PodError(
+                    f"pod: {len(joined)}/{self.num_workers} workers "
+                    f"joined within {self._accept_timeout_s}s"
+                ) from None
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            hello = self._read_hello(sock, deadline)
+            pidx = int(hello.get("process", -1))
+            if pidx < 1:
+                sock.close()
+                continue
+            joined[pidx] = sock
+        with self._lock:
+            self._workers = joined
+        for pidx, sock in joined.items():
+            threading.Thread(
+                target=self._reader, args=(pidx, sock),
+                name=f"bibfs-pod-ack-{pidx}", daemon=True,
+            ).start()
+
+    @staticmethod
+    def _read_hello(sock, deadline: float) -> dict:
+        buf = bytearray()
+        while True:
+            sock.settimeout(max(0.1, deadline - time.monotonic()))
+            try:
+                frames = _recv_frames(sock, buf)
+            except (ConnectionError, socket.timeout, OSError,
+                    ValueError) as e:
+                raise PodError(f"pod: worker hello failed: {e}") from e
+            if frames:
+                return frames[0]
+
+    # ---- ack plumbing ------------------------------------------------
+    def _reader(self, pidx: int, sock) -> None:
+        buf = bytearray()
+        why = "worker closed the control connection"
+        try:
+            while True:
+                for msg in _recv_frames(sock, buf):
+                    with self._lock:
+                        key = (int(msg.get("seq", -1)),
+                               str(msg.get("phase", "done")))
+                        self._acks.setdefault(key, {})[pidx] = msg
+                        self._cv.notify_all()
+        except (ConnectionError, OSError, ValueError) as e:
+            why = str(e) or why
+        with self._lock:
+            self._dead[pidx] = why
+            self._cv.notify_all()
+
+    def await_phase(self, seq: int, phase: str,
+                    timeout: float = 120.0) -> dict:
+        """Block until EVERY worker acked ``(seq, phase)`` ok; returns
+        ``{process_index: ack}``. Raises :class:`PodError` on a dead
+        worker, a not-ok ack, or timeout."""
+        deadline = time.monotonic() + timeout
+        key = (int(seq), phase)
+        with self._lock:
+            while True:
+                if self._dead:
+                    pidx, why = next(iter(self._dead.items()))
+                    raise PodError(f"pod worker {pidx} died: {why}")
+                got = self._acks.get(key, {})
+                if len(got) >= len(self._workers):
+                    del self._acks[key]
+                    break
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    raise PodError(
+                        f"pod: {len(got)}/{len(self._workers)} workers "
+                        f"acked seq {seq} phase {phase!r} within "
+                        f"{timeout}s"
+                    )
+                self._cv.wait(left)
+        for pidx, msg in got.items():
+            if not msg.get("ok", False):
+                raise PodError(
+                    f"pod worker {pidx} failed seq {seq} "
+                    f"({phase}): {msg.get('error', 'unspecified')}"
+                )
+        return got
+
+    # ---- broadcasts (engine-flusher thread only) ---------------------
+    def _post(self, desc: dict) -> int:
+        with self._lock:
+            if self._closed:
+                raise PodError("pod control plane is closed")
+            if self._dead:
+                pidx, why = next(iter(self._dead.items()))
+                raise PodError(f"pod worker {pidx} died: {why}")
+            self._seq += 1
+            seq = self._seq
+            workers = dict(self._workers)
+        desc = dict(desc, seq=seq)
+        data = encode_frame(desc)
+        # single writer by construction (module docstring): sendall
+        # happens OUTSIDE the lock, on the one broadcasting thread
+        for pidx, sock in workers.items():
+            try:
+                sock.sendall(data)
+            except OSError as e:
+                with self._lock:
+                    self._dead[pidx] = f"broadcast failed: {e}"
+                    self._cv.notify_all()
+                raise PodError(
+                    f"pod worker {pidx}: broadcast failed: {e}"
+                ) from e
+        return seq
+
+    def ensure_graph(self, snapshot, build=None,
+                     timeout: float = 120.0):
+        """Broadcast ``snapshot`` (canonical pairs + digest) if it is
+        not the workers' current graph, run the primary's own ``build``
+        callable, then await the workers' rebuild acks — in THAT order,
+        because building the sharded graph (``jax.device_put`` onto the
+        global mesh) is itself collective on a multi-process backend:
+        the primary building before the workers have the descriptor
+        deadlocks in the transfer layer's rendezvous. Returns
+        ``build()``'s result. Flusher-thread only; the digest memo
+        makes the steady-state cost one string compare per launch."""
+        if snapshot.digest == self._last_digest:
+            return build() if build is not None else None
+        seq = self._post({
+            "op": "graph",
+            "n": int(snapshot.n),
+            "digest": snapshot.digest,
+            "version": int(snapshot.version),
+            "pairs": np.asarray(
+                snapshot.pairs, dtype=np.int64).ravel().tolist(),
+        })
+        out = build() if build is not None else None
+        self.await_phase(seq, "done", timeout)
+        self._last_digest = snapshot.digest
+        return out
+
+    def post_solve(self, digest: str, mode: str, padded,
+                   count: int) -> int:
+        """Broadcast one padded solve batch; returns its seq. The
+        caller awaits ``join`` before entering the collective and
+        ``done`` (with per-worker ``best``) in finish."""
+        return self._post({
+            "op": "solve",
+            "digest": digest,
+            "mode": mode,
+            "count": int(count),
+            "pairs": np.asarray(padded, dtype=np.int64).ravel().tolist(),
+        })
+
+    # ---- lifecycle ---------------------------------------------------
+    def shutdown(self, timeout: float = 30.0) -> None:
+        """Broadcast shutdown and wait for the workers' goodbyes (best
+        effort — a worker already gone is fine at this point)."""
+        try:
+            seq = self._post({"op": "shutdown"})
+            self.await_phase(seq, "done", timeout)
+        except PodError:
+            pass
+        self.close()
+
+    def close(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            workers = dict(self._workers)
+        for sock in workers.values():
+            try:
+                sock.close()
+            except OSError:
+                pass
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+
+
+def _connect_retry(host: str, port: int, timeout_s: float):
+    deadline = time.monotonic() + timeout_s
+    while True:
+        try:
+            sock = socket.create_connection((host, port), timeout=5.0)
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            sock.settimeout(None)
+            return sock
+        except OSError:
+            if time.monotonic() >= deadline:
+                raise
+            time.sleep(0.1)
+
+
+def _build_worker_graph(msg: dict, mesh):
+    """Rebuild the primary's sharded graph from a ``graph`` descriptor:
+    verify the content digest over the received pairs, then run the
+    SAME snapshot -> bucketed ELL -> repad -> shard chain the engine
+    runtime runs (``serve/engine._GraphRuntime.mesh_graph``) so shapes
+    and content are bit-identical across processes."""
+    from bibfs_tpu.serve.buckets import repad_rows
+    from bibfs_tpu.solvers.sharded import ShardedGraph
+    from bibfs_tpu.store.snapshot import GraphSnapshot, content_digest
+
+    n = int(msg["n"])
+    pairs = np.asarray(msg["pairs"], dtype=np.int64).reshape(-1, 2)
+    digest = str(msg["digest"])
+    got = content_digest(n, pairs)
+    if got != digest:
+        raise ValueError(
+            f"pod graph digest mismatch: wire {digest} != rebuilt {got}"
+        )
+    snap = GraphSnapshot(n, pairs, digest=digest,
+                         version=int(msg.get("version", 0)))
+    ell = repad_rows(snap.ell(), int(mesh.devices.size))
+    return digest, ShardedGraph(ell, mesh)
+
+
+def run_pod_worker(host: str, port: int, *, process_index: int,
+                   connect_timeout_s: float = 120.0, log=None) -> int:
+    """The worker process's main loop (module docstring): connect to
+    the primary's pod control port, then execute descriptors strictly
+    in receipt order until ``shutdown`` (returns 0) or the primary
+    closes the connection (returns 0 too — a vanished primary is a
+    normal teardown, the jax.distributed layer owns crash detection).
+    """
+    from bibfs_tpu.parallel.mesh import make_1d_mesh
+    from bibfs_tpu.solvers import sharded as _sharded
+    from bibfs_tpu.solvers.timing import force_scalar
+
+    def say(msg: str) -> None:
+        if log is not None:
+            log(msg)
+
+    mesh = make_1d_mesh()  # the global mesh, spanning every process
+    sock = _connect_retry(host, port, connect_timeout_s)
+    sock.sendall(encode_frame(
+        {"op": "hello", "process": int(process_index)}
+    ))
+    say(f"[Pod] worker {process_index}: joined {host}:{port} "
+        f"({mesh.devices.size}-device global mesh)")
+    graphs: dict = {}  # digest -> ShardedGraph (current only)
+    buf = bytearray()
+
+    def ack(seq, phase, ok, **extra):
+        sock.sendall(encode_frame(
+            dict(extra, seq=seq, phase=phase, ok=ok)
+        ))
+
+    try:
+        while True:
+            try:
+                frames = _recv_frames(sock, buf)
+            except (ConnectionError, ValueError):
+                return 0
+            for msg in frames:
+                op = msg.get("op")
+                seq = int(msg.get("seq", -1))
+                if op == "shutdown":
+                    ack(seq, "done", True)
+                    return 0
+                if op == "graph":
+                    try:
+                        digest, sg = _build_worker_graph(msg, mesh)
+                    except (KeyError, TypeError, ValueError) as e:
+                        ack(seq, "done", False, error=str(e))
+                        continue
+                    graphs.clear()  # one served graph at a time
+                    graphs[digest] = sg
+                    ack(seq, "done", True, digest=digest)
+                    say(f"[Pod] worker {process_index}: graph "
+                        f"{digest[:12]} n={sg.n}")
+                    continue
+                if op == "solve":
+                    sg = graphs.get(str(msg.get("digest")))
+                    if sg is None:
+                        # refuse BEFORE the join ack: the primary
+                        # aborts on the host, nobody enters a
+                        # collective short one participant
+                        ack(seq, "join", False,
+                            error="unknown graph digest "
+                                  f"{msg.get('digest')!r}")
+                        continue
+                    try:
+                        padded = np.asarray(
+                            msg["pairs"], dtype=np.int64
+                        ).reshape(-1, 2)
+                        _p, dispatch = _sharded._batch_dispatch(
+                            sg, padded, str(msg.get("mode", "sync"))
+                        )
+                    except (KeyError, TypeError, ValueError) as e:
+                        ack(seq, "join", False, error=str(e))
+                        continue
+                    ack(seq, "join", True)
+                    out = dispatch()
+                    force_scalar(out)
+                    # best/meet are REPLICATED outputs: addressable on
+                    # this host (the sharded parent planes are not —
+                    # test_multihost.py documents the split)
+                    best = [int(b) for b in np.asarray(out[0])]
+                    ack(seq, "done", True, best=best)
+                    continue
+                ack(seq, "done", False, error=f"unknown op {op!r}")
+    finally:
+        try:
+            sock.close()
+        except OSError:
+            pass

@@ -410,6 +410,109 @@ def main(argv=None):
         "finish (bibfs_tpu/serve/pipeline)",
     )
     ap.add_argument(
+        "--port",
+        type=int,
+        default=None,
+        metavar="PORT",
+        help="serve the concurrent network front door instead of the "
+        "stdin REPL (bibfs_tpu/serve/net): length-prefixed JSON frames "
+        "over TCP, correlation ids, per-request deadlines feeding the "
+        "--max-wait-ms SLO, per-tenant token-bucket quotas, structured "
+        "capacity refusals, graceful drain on SIGTERM. PORT 0 binds an "
+        "ephemeral port (printed to stderr; see --port-file). Requires "
+        "--pipeline (the background flusher is what resolves framed "
+        "submits)",
+    )
+    ap.add_argument(
+        "--port-file",
+        default=None,
+        metavar="FILE",
+        help="atomically write 'host port' to FILE once the --port "
+        "listener is bound — the readiness handshake the NetReplica "
+        "fleet driver polls instead of parsing stderr",
+    )
+    ap.add_argument(
+        "--net-host",
+        default="127.0.0.1",
+        metavar="HOST",
+        help="bind address for --port (default 127.0.0.1; 0.0.0.0 to "
+        "serve off-host)",
+    )
+    ap.add_argument(
+        "--net-max-inflight",
+        type=int,
+        default=512,
+        metavar="N",
+        help="admission-controlled in-flight request cap for --port "
+        "(default 512): excess submits answer structured capacity "
+        "errors instead of queueing behind the engine's blocking "
+        "backpressure",
+    )
+    ap.add_argument(
+        "--net-quota-qps",
+        type=float,
+        default=None,
+        metavar="RATE",
+        help="per-tenant token-bucket quota for --port (queries/s, "
+        "sustained; default: unlimited). Over-quota submits answer "
+        "structured capacity errors with reason=quota",
+    )
+    ap.add_argument(
+        "--net-quota-burst",
+        type=float,
+        default=None,
+        metavar="TOKENS",
+        help="per-tenant burst allowance above --net-quota-qps "
+        "(default: 2x the rate)",
+    )
+    ap.add_argument(
+        "--net-deadline-ms",
+        type=float,
+        default=None,
+        metavar="MS",
+        help="default per-request deadline for --port queries that "
+        "carry none (default: none — requests wait for their result)",
+    )
+    ap.add_argument(
+        "--coordinator",
+        default=None,
+        metavar="HOST:PORT",
+        help="join a multi-process jax.distributed job before touching "
+        "any backend (parallel/mesh.init_distributed): one logical "
+        "replica spans every process's devices as a global mesh. "
+        "Process 0 serves; processes > 0 run the pod worker loop "
+        "(parallel/podmesh) and execute the broadcast mesh batches in "
+        "lockstep. Use with --num-processes and --process-id",
+    )
+    ap.add_argument(
+        "--num-processes", type=int, default=None, metavar="N",
+        help="job size for --coordinator",
+    )
+    ap.add_argument(
+        "--process-id", type=int, default=None, metavar="I",
+        help="this process's index for --coordinator (0 = the serving "
+        "primary)",
+    )
+    ap.add_argument(
+        "--pod-port",
+        type=int,
+        default=None,
+        metavar="PORT",
+        help="pod control-plane port (default: the --coordinator port "
+        "+ 1): the primary listens here for worker control "
+        "connections; workers connect to it on the coordinator host",
+    )
+    ap.add_argument(
+        "--mesh-shard-min-n",
+        type=int,
+        default=None,
+        metavar="N",
+        help="override the mesh rung's vertex-sharded crossover "
+        "(graphs with >= N vertices route sharded; default: the "
+        "calibrated constant). The multi-process dryrun pins this to 1 "
+        "so every batch exercises the cross-process exchange",
+    )
+    ap.add_argument(
         "--max-wait-ms",
         type=float,
         default=5.0,
@@ -485,6 +588,47 @@ def main(argv=None):
     from bibfs_tpu.utils.platform import apply_platform_env
 
     apply_platform_env()
+    podctx = None
+    if args.coordinator is not None:
+        # must run before anything touches a backend (jax requirement);
+        # apply_platform_env only sets env vars, so this is still first
+        from bibfs_tpu.parallel.mesh import init_distributed
+
+        try:
+            podctx = init_distributed(
+                args.coordinator, args.num_processes, args.process_id
+            )
+        except (RuntimeError, ValueError) as e:
+            print(f"Error joining distributed job: {e}", file=sys.stderr)
+            return 2
+        print(
+            "[Pod] joined: process {i}/{p}, devices {ld}/{gd}".format(
+                i=podctx.process_index, p=podctx.process_count,
+                ld=podctx.local_device_count,
+                gd=podctx.global_device_count,
+            ),
+            file=sys.stderr, flush=True,
+        )
+        if podctx.process_index > 0:
+            # workers never open the store or build an engine: they
+            # run the descriptor loop until the primary says shutdown
+            from bibfs_tpu.parallel.podmesh import run_pod_worker
+
+            host, port = _pod_control_addr(args)
+            return run_pod_worker(
+                host, port, process_index=podctx.process_index,
+                log=lambda m: print(m, file=sys.stderr, flush=True),
+            )
+    if args.port is not None:
+        if not args.pipeline:
+            print("Error: --port needs --pipeline (the background "
+                  "flusher resolves framed submits)", file=sys.stderr)
+            return 2
+        if args.pairs is not None or args.load is not None:
+            print("Error: --port serves the network front door; it "
+                  "does not combine with --pairs/--load",
+                  file=sys.stderr)
+            return 2
     n = edges = store = None
     if args.load is not None and args.oracle is not None:
         print("Error: --load A/Bs the sync vs pipelined engines on one "
@@ -579,7 +723,7 @@ def main(argv=None):
                 print(f"Error: {e}", file=sys.stderr)
                 return 2
         return _serve(args, n, edges, store, QueryEngine,
-                      PipelinedQueryEngine, metrics_server)
+                      PipelinedQueryEngine, metrics_server, podctx)
     finally:
         if tracer is not None:
             from bibfs_tpu.obs.trace import uninstall_and_save
@@ -592,10 +736,81 @@ def main(argv=None):
             metrics_server.close()
 
 
+def _pod_control_addr(args) -> tuple:
+    """The pod control plane's (host, port): the coordinator host, on
+    ``--pod-port`` or the coordinator port + POD_PORT_OFFSET."""
+    from bibfs_tpu.parallel.podmesh import POD_PORT_OFFSET
+
+    host, _, port = args.coordinator.rpartition(":")
+    pod_port = (args.pod_port if args.pod_port is not None
+                else int(port) + POD_PORT_OFFSET)
+    return host or "127.0.0.1", pod_port
+
+
+def _serve_net(args, engine, store) -> int:
+    """The ``--port`` serving loop: bind the framed front door, park
+    until SIGTERM/SIGINT, then drain gracefully (new queries refused
+    with structured capacity errors, pending tickets resolved, reply
+    buffers flushed) before the caller's engine teardown."""
+    import signal
+    import threading
+
+    from bibfs_tpu.serve.net import NetServer, write_port_file
+
+    try:
+        server = NetServer(
+            engine, store=store, host=args.net_host, port=args.port,
+            max_inflight=args.net_max_inflight,
+            quota_qps=args.net_quota_qps,
+            quota_burst=args.net_quota_burst,
+            default_deadline_ms=args.net_deadline_ms,
+        )
+    except OSError as e:
+        print(f"Error: cannot bind --port {args.port}: {e}",
+              file=sys.stderr)
+        return 2
+    try:
+        if args.port_file:
+            write_port_file(args.port_file, server.host, server.port)
+        print(f"[Net] serving on {server.host}:{server.port}",
+              file=sys.stderr, flush=True)
+        stop = threading.Event()
+
+        def _on_signal(signum, frame):
+            stop.set()
+
+        prev = {}
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            try:
+                prev[sig] = signal.signal(sig, _on_signal)
+            except ValueError:
+                pass  # not the main thread (in-process embedding)
+        try:
+            while not stop.wait(0.5):
+                pass
+        except KeyboardInterrupt:
+            pass
+        finally:
+            for sig, handler in prev.items():
+                try:
+                    signal.signal(sig, handler)
+                except ValueError:
+                    pass
+        print("[Net] SIGTERM: draining (refusing new queries, "
+              "finishing in-flight)", file=sys.stderr, flush=True)
+        server.drain(timeout=30.0)
+        engine.begin_drain()
+        engine.flush()
+    finally:
+        server.close()
+    return 0
+
+
 def _serve(args, n, edges, store, QueryEngine, PipelinedQueryEngine,
-           metrics_server=None):
+           metrics_server=None, podctx=None):
     from bibfs_tpu.serve.resilience import QueryError
 
+    pod = None
     try:
         kwargs = dict(
             mode=args.mode,
@@ -604,10 +819,24 @@ def _serve(args, n, edges, store, QueryEngine, PipelinedQueryEngine,
             max_batch=args.max_batch,
             cache_entries=args.cache_entries,
         )
-        if args.mesh is not None:
-            kwargs["mesh"] = (
-                "auto" if args.mesh == "auto" else int(args.mesh)
-            )
+        mesh_devices = None
+        want_mesh = args.mesh is not None or (
+            podctx is not None and podctx.process_count > 1
+        )
+        if args.mesh is not None and args.mesh != "auto":
+            mesh_devices = int(args.mesh)
+        if want_mesh:
+            if args.mesh_shard_min_n is not None:
+                from bibfs_tpu.serve.routes import MeshConfig
+
+                kwargs["mesh"] = MeshConfig(
+                    devices=mesh_devices,
+                    shard_min_n=args.mesh_shard_min_n,
+                )
+            else:
+                kwargs["mesh"] = (
+                    "auto" if mesh_devices is None else mesh_devices
+                )
         if args.blocked:
             kwargs["blocked"] = True
         if args.adaptive:
@@ -642,9 +871,38 @@ def _serve(args, n, edges, store, QueryEngine, PipelinedQueryEngine,
         # /healthz answers from the live engine from here on (the
         # standalone 'ok' covered the construction window)
         metrics_server.set_health(engine.health_snapshot)
+    if podctx is not None and podctx.process_count > 1:
+        # the pod control plane: accept every worker, then swap the
+        # mesh rung for the broadcasting pod rung (routes/pod.py)
+        from bibfs_tpu.parallel.podmesh import PodError, PodPrimary
+        from bibfs_tpu.serve.routes.pod import attach_pod
+
+        _host, pod_port = _pod_control_addr(args)
+        try:
+            pod = PodPrimary(podctx.process_count - 1, port=pod_port)
+            print(
+                f"[Pod] waiting for {pod.num_workers} worker(s) on "
+                f"port {pod.port}", file=sys.stderr, flush=True,
+            )
+            pod.accept_workers()
+            attach_pod(engine, pod)
+        except (OSError, PodError, ValueError) as e:
+            print(f"Error: pod control plane: {e}", file=sys.stderr)
+            engine.close()
+            if pod is not None:
+                pod.close()
+            return 2
+        print(
+            f"[Pod] {podctx.process_count}-process mesh replica ready",
+            file=sys.stderr, flush=True,
+        )
 
     try:
-        if args.pairs is not None:
+        if args.port is not None:
+            rc = _serve_net(args, engine, store)
+            if rc:
+                return rc
+        elif args.pairs is not None:
             import numpy as np
 
             pairs = np.loadtxt(args.pairs, dtype=np.int64, ndmin=2)
@@ -824,6 +1082,10 @@ def _serve(args, n, edges, store, QueryEngine, PipelinedQueryEngine,
         return 2
     finally:
         engine.close()
+        if pod is not None:
+            # after engine.close(): the last mesh flush needed the
+            # workers in the collective; only now may they exit
+            pod.shutdown()
 
     stats = engine.stats()
     print(

@@ -32,6 +32,7 @@ CI boxes).
 
 from __future__ import annotations
 
+import os
 import sys
 import threading
 import time
@@ -3724,3 +3725,880 @@ def compare_engines(
             p["sync"]["ok"] and p["pipelined"]["ok"] for p in points
         ),
     }
+
+
+# ---------------------------------------------------------------------
+# network front door (bench.py --serve-net)
+# ---------------------------------------------------------------------
+
+#: wire grace on top of the engine deadline budget: two framed hops,
+#: the server's 50 ms selector tick and the completer's 10 ms poll are
+#: all between a net query's scheduled arrival and its reply landing
+NET_SLACK_MS = 75.0
+
+
+def _connect_many(addr, k: int, *, tenant: str | None = None) -> list:
+    """``k`` independent framed connections to one front door — each
+    gets its own reader thread, so resolution latency never serializes
+    behind a single socket's reply stream."""
+    from bibfs_tpu.serve.net import NetClient
+
+    clients = []
+    try:
+        for _ in range(int(k)):
+            clients.append(NetClient(addr[0], addr[1], tenant=tenant))
+    except Exception:
+        for c in clients:
+            c.close()
+        raise
+    return clients
+
+
+def _close_many(clients) -> None:
+    for c in clients:
+        try:
+            c.close()
+        except Exception:
+            pass
+
+
+def _drive_net(clients, pairs, rate_qps, *, graph=None,
+               deadline_ms: float | None = None,
+               wait_timeout_s: float = 120.0):
+    """The socket twin of :func:`_drive_pipelined`: one open-loop
+    global schedule, queries striped round-robin across the client
+    connections, latency clocked from each query's SCHEDULED arrival
+    to the reader thread's resolve stamp (``NetTicket.t_done``).
+    Refused submissions (dead connection) become error-shaped entries
+    so callers classify rather than crash."""
+    C = len(clients)
+    t0 = time.perf_counter()
+    tickets = []
+    for i, (s, d) in enumerate(pairs):
+        delay = t0 + i / rate_qps - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+        try:
+            tickets.append(clients[i % C].submit(
+                int(s), int(d), graph, deadline_ms=deadline_ms,
+            ))
+        except ConnectionError as e:
+            tickets.append(_RefusedNet(int(s), int(d), e))
+    for t in tickets:
+        t.event.wait(timeout=wait_timeout_s)
+    elapsed = time.perf_counter() - t0
+    lats = [
+        t.t_done - (t0 + i / rate_qps)
+        for i, t in enumerate(tickets)
+        if t.result is not None and t.t_done is not None
+    ]
+    return tickets, lats, elapsed
+
+
+class _RefusedNet:
+    """A submit the transport refused outright; rides the ticket rows
+    so the verify pass classifies it (the run_fleet convention)."""
+
+    def __init__(self, src, dst, err):
+        self.src, self.dst = src, dst
+        self.result = None
+        self.error = err
+        self.t_done = None
+        self.event = threading.Event()
+        self.event.set()
+
+
+def _verify_net(pairs, tickets, oracle) -> list[str]:
+    """Hop-exactness of every RESOLVED net ticket against the serial
+    oracle (the wire carries found/hops, never paths)."""
+    errors = []
+    for (s, d), t in zip(pairs, tickets):
+        s, d = int(s), int(d)
+        if t.result is None:
+            continue  # refusals/timeouts are classified by the caller
+        ref = oracle[(s, d)]
+        if t.result.found != ref.found or (
+            ref.found and t.result.hops != ref.hops
+        ):
+            errors.append(
+                f"{s}->{d}: {t.result.found}/{t.result.hops} != "
+                f"oracle {ref.found}/{ref.hops}"
+            )
+    return errors
+
+
+def _net_point(rep, pairs, rate, *, connections, max_wait_ms, oracle):
+    """One offered-rate point against a live front door: open-loop
+    multi-connection drive, hop-verified, with the engine's OWN
+    deadline counters (fetched over a control frame) judged against
+    the same budget the in-process driver uses plus wire slack."""
+    clients = _connect_many(rep.addr, connections)
+    try:
+        tickets, lats, elapsed = _drive_net(clients, pairs, rate)
+    finally:
+        _close_many(clients)
+    errors = _verify_net(pairs, tickets, oracle)
+    unresolved = sum(
+        1 for t in tickets if t.result is None and t.error is None
+    )
+    transport_failed = sum(
+        1 for t in tickets
+        if t.error is not None and not hasattr(t.error, "kind")
+    )
+    completed = sum(t.result is not None for t in tickets)
+    stats = rep.stats()
+    pipe = stats.get("pipeline", {})
+    budget_ms = (
+        max_wait_ms + pipe.get("batch_service_max_ms", 0.0)
+        + SCHED_SLACK_MS + NET_SLACK_MS
+    )
+    return {
+        "offered_qps": round(float(rate), 1),
+        "connections": int(connections),
+        "completed": completed,
+        "unresolved": unresolved,
+        "transport_failed": transport_failed,
+        "elapsed_s": round(elapsed, 4),
+        "sustained_qps": round(completed / elapsed, 1)
+        if elapsed > 0 else None,
+        "latency_ms": _percentiles_ms(lats),
+        "latency_hist": _latency_hist(lats),
+        "deadline": {
+            "max_wait_ms": max_wait_ms,
+            "queue_wait_max_ms": round(
+                pipe.get("queue_wait_max_ms", 0.0), 3
+            ),
+            "batch_service_max_ms": round(
+                pipe.get("batch_service_max_ms", 0.0), 3
+            ),
+            "budget_ms": round(budget_ms, 3),
+            "ok": pipe.get("queue_wait_max_ms", 0.0) <= budget_ms,
+        },
+        "ok": not errors and unresolved == 0 and transport_failed == 0,
+        "errors": errors[:10],
+    }
+
+
+def run_net(
+    n: int,
+    edges,
+    *,
+    queries: int = 400,
+    rates=(100.0, 400.0, 1200.0),
+    connections: int = 64,
+    max_wait_ms: float = 5.0,
+    net_floor: float = 0.8,
+    quota_qps: float = 50.0,
+    quota_burst: float = 10.0,
+    fleet_replicas: int = 2,
+    chaos_queries: int = 300,
+    chaos_span_s: float = 8.0,
+    recovery_bound_s: float = 20.0,
+    seed: int = 0,
+    workdir: str | None = None,
+) -> dict:
+    """The network front door soak (``bench.py --serve-net``): the
+    in-process pipelined engine and a spawned ``bibfs-serve --port``
+    child judged on IDENTICAL open-loop traffic, plus the wire-only
+    claims no in-process harness can make. Gates:
+
+    1. **net throughput** — at the saturating rate the front door
+       sustains at least ``net_floor`` (default 0.8) of the in-process
+       pipelined engine on the same pairs/rates (the protocol tax is
+       bounded, not hand-waved);
+    2. **deadline SLO end-to-end** — every net point's engine-side
+       queue-wait stays within the in-process budget plus wire slack,
+       a generous per-request ``deadline_ms`` produces zero timeout
+       replies, and an impossible one produces ONLY structured
+       ``kind='timeout'`` replies (counted by the server's
+       ``bibfs_net_deadline_misses_total``);
+    3. **quota admission** — a greedy tenant blowing through its
+       token bucket gets structured ``capacity`` refusals naming the
+       quota, a polite tenant sharing the same door gets none, and
+       every accepted answer stays exact;
+    4. **fleet chaos, zero lost acked tickets** — a
+       :class:`~bibfs_tpu.fleet.Router` over :class:`NetReplica`
+       children takes a mid-stream SIGKILL + respawn: every acked
+       ticket resolves or fails STRUCTURED (then reroutes exactly on
+       resubmit), the victim re-admits within ``recovery_bound_s``,
+       and nothing hangs;
+    5. **observability** — the ``bibfs_net_*`` families all render on
+       a LIVE ``/metrics`` scrape of the serving child.
+
+    The multi-process pod dryrun is its own phase in ``bench.py``
+    (it spawns jax.distributed processes and merges into the same
+    artifact). Returns the ``bench_net.json`` payload body."""
+    import shutil
+    import socket as _socket
+    import tempfile
+    import urllib.request
+
+    from bibfs_tpu.fleet import NetReplica, Router
+    from bibfs_tpu.graph.csr import build_csr, canonical_pairs
+    from bibfs_tpu.graph.io import write_graph_bin
+    from bibfs_tpu.obs.names import NET_METRIC_FAMILIES
+    from bibfs_tpu.serve.pipeline import PipelinedQueryEngine
+    from bibfs_tpu.serve.resilience import QueryError
+    from bibfs_tpu.solvers.serial import solve_serial_csr
+
+    t_all = time.perf_counter()
+    cpairs = canonical_pairs(n, edges)
+    csr = build_csr(n, pairs=cpairs)
+    pairs = sample_query_pairs(n, int(queries), seed=seed + 1)
+    oracle = {
+        (int(s), int(d)): solve_serial_csr(n, *csr, int(s), int(d))
+        for s, d in {(int(s), int(d)) for s, d in pairs}
+    }
+
+    own_workdir = workdir is None
+    workdir = workdir or tempfile.mkdtemp(prefix="bibfs-net-soak-")
+    gpath = os.path.join(workdir, "g.bin")
+    write_graph_bin(gpath, n, cpairs)
+
+    def free_port() -> int:
+        s = _socket.socket()
+        s.bind(("127.0.0.1", 0))
+        p = s.getsockname()[1]
+        s.close()
+        return p
+
+    out: dict = {
+        "n": int(n),
+        "queries_per_point": len(pairs),
+        "connections": int(connections),
+        "max_wait_ms": max_wait_ms,
+        "net_floor": net_floor,
+    }
+    old_si = sys.getswitchinterval()
+    sys.setswitchinterval(5e-4)
+    try:
+        # ---- phase 1: in-process pipelined ladder (the baseline) ----
+        def make_pipe():
+            return PipelinedQueryEngine(
+                n, edges, pairs=cpairs, max_wait_ms=max_wait_ms,
+            )
+
+        baseline = [
+            run_load_point(
+                make_pipe, pairs, rate, pipelined=True,
+                max_wait_ms=max_wait_ms, oracle=oracle, csr=csr,
+            )
+            for rate in rates
+        ]
+        out["inprocess"] = baseline
+
+        # ---- phase 2: the net ladder, fresh child per point ---------
+        # (cold caches each point, the run_load_point convention; the
+        # LAST child also carries the /metrics endpoint for phase 5
+        # and stays up for the deadline phase)
+        metrics_port = free_port()
+        net_points = []
+        rep = None
+        deadline_phase: dict = {}
+        scrape: dict = {}
+        try:
+            for i, rate in enumerate(rates):
+                last = i == len(rates) - 1
+                rep = NetReplica(
+                    f"net{i}", gpath, max_wait_ms=max_wait_ms,
+                    extra_args=(
+                        ["--metrics-port", str(metrics_port)]
+                        if last else []
+                    ),
+                )
+                net_points.append(_net_point(
+                    rep, pairs, rate, connections=connections,
+                    max_wait_ms=max_wait_ms, oracle=oracle,
+                ))
+                if not last:
+                    rep.close()
+                    rep = None
+            out["net"] = net_points
+
+            # ---- phase 3: per-request deadlines, end to end ---------
+            # FRESH pairs per sub-phase: a cache-served query resolves
+            # inline and never meets the deadline machinery, so reusing
+            # the ladder's (warmed) pairs would test nothing
+            dl_n = max(64, len(pairs) // 4)
+            dl_pairs = sample_query_pairs(n, dl_n, seed=seed + 11)
+            tight_pairs = sample_query_pairs(n, dl_n, seed=seed + 13)
+            for s, d in {
+                (int(s), int(d))
+                for p in (dl_pairs, tight_pairs) for s, d in p
+            }:
+                if (s, d) not in oracle:
+                    oracle[(s, d)] = solve_serial_csr(n, *csr, s, d)
+            generous_ms = (
+                max_wait_ms
+                + net_points[-1]["deadline"]["batch_service_max_ms"]
+                + 1000.0
+            )
+            clients = _connect_many(rep.addr, min(8, connections))
+            try:
+                tk_g, _, _ = _drive_net(
+                    clients, dl_pairs, 200.0, deadline_ms=generous_ms,
+                )
+                # near-simultaneous arrivals + an already-expired
+                # deadline: every queued (non-inline) query must come
+                # back as a structured timeout, never a hang
+                tk_t, _, _ = _drive_net(
+                    clients, tight_pairs, 5000.0, deadline_ms=0.01,
+                )
+            finally:
+                _close_many(clients)
+            generous_timeouts = sum(
+                1 for t in tk_g
+                if getattr(t.error, "kind", None) == "timeout"
+            )
+            tight_timeouts = sum(
+                1 for t in tk_t
+                if getattr(t.error, "kind", None) == "timeout"
+            )
+            tight_unstructured = sum(
+                1 for t in tk_t
+                if t.result is None
+                and getattr(t.error, "kind", None) not in (
+                    "timeout", "capacity", "invalid", "internal",
+                )
+            )
+            deadline_phase = {
+                "generous_deadline_ms": round(generous_ms, 1),
+                "generous_completed": sum(
+                    t.result is not None for t in tk_g
+                ),
+                "generous_timeouts": generous_timeouts,
+                "generous_errors": _verify_net(dl_pairs, tk_g, oracle)[:5],
+                "tight_deadline_ms": 0.01,
+                "tight_timeouts": tight_timeouts,
+                "tight_unstructured": tight_unstructured,
+                "ok": (
+                    generous_timeouts == 0
+                    and not _verify_net(dl_pairs, tk_g, oracle)
+                    and sum(t.result is not None for t in tk_g)
+                    == len(dl_pairs)
+                    and tight_timeouts > 0
+                    and tight_unstructured == 0
+                ),
+            }
+            out["deadline_phase"] = deadline_phase
+
+            # ---- phase 5 (early: same child): live /metrics scrape --
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{metrics_port}/metrics", timeout=10
+            ) as resp:
+                render = resp.read().decode()
+            missing = [m for m in NET_METRIC_FAMILIES
+                       if m not in render]
+            miss_line = next(
+                (ln for ln in render.splitlines()
+                 if ln.startswith("bibfs_net_deadline_misses_total")),
+                "",
+            )
+            try:
+                misses_scraped = float(miss_line.split()[-1])
+            except (IndexError, ValueError):
+                misses_scraped = None
+            scrape = {
+                "live": True,
+                "metrics_missing": missing,
+                "deadline_misses_scraped": misses_scraped,
+                # the tight-deadline phase above MUST show up in the
+                # scraped counter — the families are live, not minted
+                "ok": not missing and bool(misses_scraped),
+            }
+            out["metrics"] = scrape
+        finally:
+            if rep is not None:
+                rep.close()
+
+        # ---- phase 4: quota admission, two tenants ------------------
+        qrep = NetReplica(
+            "quota", gpath, max_wait_ms=max_wait_ms,
+            extra_args=[
+                "--net-quota-qps", str(quota_qps),
+                "--net-quota-burst", str(quota_burst),
+            ],
+        )
+        try:
+            greedy = _connect_many(qrep.addr, 4, tenant="greedy")
+            polite = _connect_many(qrep.addr, 1, tenant="polite")
+            try:
+                q_pairs = pairs[: min(200, len(pairs))]
+                # 8x the refill rate: the bucket must run dry
+                tk_greedy, _, _ = _drive_net(
+                    greedy, q_pairs, 8.0 * quota_qps,
+                )
+                tk_polite, _, _ = _drive_net(
+                    polite, pairs[:20], 0.5 * quota_qps,
+                )
+            finally:
+                _close_many(greedy)
+                _close_many(polite)
+        finally:
+            qrep.close()
+
+        def quota_rejects(tickets):
+            return [
+                t for t in tickets
+                if getattr(t.error, "kind", None) == "capacity"
+                and "quota" in str(t.error)
+            ]
+
+        g_rej = quota_rejects(tk_greedy)
+        g_unstructured = sum(
+            1 for t in tk_greedy
+            if t.result is None and not hasattr(t.error, "kind")
+        )
+        quota_phase = {
+            "quota_qps": quota_qps,
+            "quota_burst": quota_burst,
+            "greedy_offered": len(q_pairs),
+            "greedy_accepted": sum(
+                t.result is not None for t in tk_greedy
+            ),
+            "greedy_quota_rejected": len(g_rej),
+            "greedy_unstructured": g_unstructured,
+            "polite_rejected": len(quota_rejects(tk_polite)),
+            "polite_completed": sum(
+                t.result is not None for t in tk_polite
+            ),
+            "accepted_errors": (
+                _verify_net(q_pairs, tk_greedy, oracle)[:5]
+                + _verify_net(pairs[:20], tk_polite, oracle)[:5]
+            ),
+            "ok": (
+                len(g_rej) > 0
+                and g_unstructured == 0
+                and len(quota_rejects(tk_polite)) == 0
+                and sum(t.result is not None for t in tk_polite)
+                == len(pairs[:20])
+                and not _verify_net(q_pairs, tk_greedy, oracle)
+                and not _verify_net(pairs[:20], tk_polite, oracle)
+            ),
+        }
+        out["quota_phase"] = quota_phase
+
+        # ---- phase 6: NetReplica fleet chaos ------------------------
+        stores = []
+        for i in range(int(fleet_replicas)):
+            sd = os.path.join(workdir, f"store{i}")
+            os.makedirs(sd, exist_ok=True)
+            shutil.copy(gpath, os.path.join(sd, "a.bin"))
+            stores.append(sd)
+        fleet = Router(
+            [
+                NetReplica(
+                    f"f{i}", store_dir=stores[i],
+                    max_wait_ms=max_wait_ms,
+                )
+                for i in range(int(fleet_replicas))
+            ],
+            poll_interval_s=0.2,
+        )
+        chaos_rows = []
+        resubmitted = []
+        recovery_s = None
+        try:
+            stream = sample_query_pairs(
+                n, int(chaos_queries), seed=seed + 5
+            )
+            for s, d in {(int(s), int(d)) for s, d in stream}:
+                if (s, d) not in oracle:
+                    oracle[(s, d)] = solve_serial_csr(n, *csr, s, d)
+            rate = len(stream) / float(chaos_span_s)
+            k_kill = max(1, int(0.2 * len(stream)))
+            k_restart = max(k_kill + 1, int(0.5 * len(stream)))
+            victim = fleet.replica_names[0]
+            t_restart = None
+            t0 = time.perf_counter()
+            for i, (s, d) in enumerate(stream):
+                if i == k_kill:
+                    fleet.replica(victim).kill()
+                elif i == k_restart:
+                    fleet.replica(victim).restart()
+                    t_restart = time.monotonic()
+                delay = t0 + i / rate - time.perf_counter()
+                if delay > 0:
+                    time.sleep(delay)
+                try:
+                    chaos_rows.append(
+                        (int(s), int(d), fleet.submit(int(s), int(d)))
+                    )
+                except QueryError as e:
+                    chaos_rows.append(
+                        (int(s), int(d), _RefusedNet(int(s), int(d), e))
+                    )
+            fleet.flush(timeout=120.0)
+            for _s, _d, t in chaos_rows:
+                try:
+                    t.wait(timeout=120.0)
+                except Exception:
+                    pass
+            # re-admission: the poller must mark the victim ready again
+            if t_restart is not None:
+                bound = t_restart + recovery_bound_s
+                while time.monotonic() < bound:
+                    if fleet.table().get(victim) == "ready":
+                        recovery_s = time.monotonic() - t_restart
+                        break
+                    time.sleep(0.05)
+            # every acked ticket resolves or fails STRUCTURED; the
+            # failures reroute exactly on resubmit — zero lost
+            lost = [
+                (s, d) for s, d, t in chaos_rows
+                if t.result is None and t.error is None
+            ]
+            unstructured = [
+                (s, d) for s, d, t in chaos_rows
+                if t.result is None and t.error is not None
+                and not hasattr(t.error, "kind")
+            ]
+            failed = [
+                (s, d) for s, d, t in chaos_rows
+                if t.result is None and hasattr(t.error, "kind")
+            ]
+            for s, d in failed:
+                t = fleet.submit(s, d)
+                try:
+                    t.wait(timeout=60.0)
+                except Exception:
+                    pass
+                resubmitted.append((s, d, t))
+            mism = _verify_net(
+                [(s, d) for s, d, _ in chaos_rows],
+                [t for _, _, t in chaos_rows], oracle,
+            ) + _verify_net(
+                [(s, d) for s, d, _ in resubmitted],
+                [t for _, _, t in resubmitted], oracle,
+            )
+            resub_unserved = sum(
+                1 for _, _, t in resubmitted if t.result is None
+            )
+            fleet_phase = {
+                "replicas": int(fleet_replicas),
+                "queries": len(stream),
+                "offered_qps": round(rate, 1),
+                "killed_at": k_kill,
+                "restarted_at": k_restart,
+                "failed_structured": len(failed),
+                "failed_unstructured": len(unstructured),
+                "lost": len(lost),
+                "resubmitted": len(resubmitted),
+                "resubmit_unserved": resub_unserved,
+                "recovery_s": (
+                    None if recovery_s is None else round(recovery_s, 2)
+                ),
+                "mismatches": mism[:10],
+                "ok": (
+                    not lost and not unstructured and not mism
+                    and resub_unserved == 0
+                    and recovery_s is not None
+                ),
+            }
+            out["fleet_phase"] = fleet_phase
+        finally:
+            fleet.close()
+
+        # ---- the headline gates -------------------------------------
+        top_base = baseline[-1]["sustained_qps"] or 0.0
+        top_net = net_points[-1]["sustained_qps"] or 0.0
+        ratio = round(top_net / top_base, 3) if top_base else None
+        out["net_vs_inprocess"] = {
+            "inprocess_qps": top_base,
+            "net_qps": top_net,
+            "ratio": ratio,
+            "floor": net_floor,
+        }
+        out["elapsed_s"] = round(time.perf_counter() - t_all, 1)
+        out["gates"] = {
+            "net_throughput_ok": bool(ratio and ratio >= net_floor),
+            "verified_vs_oracle": all(
+                p["ok"] for p in net_points
+            ) and all(p["ok"] for p in baseline),
+            "deadline_ladder_ok": all(
+                p["deadline"]["ok"] for p in net_points
+            ),
+            "deadline_e2e_ok": bool(deadline_phase.get("ok")),
+            "quota_ok": bool(quota_phase["ok"]),
+            "fleet_zero_lost_ok": bool(out["fleet_phase"]["ok"]),
+            "metrics_ok": bool(scrape.get("ok")),
+            "metrics_missing": scrape.get("metrics_missing"),
+        }
+        out["ok"] = all(
+            v for k, v in out["gates"].items()
+            if k.endswith("_ok")
+        )
+        return out
+    finally:
+        sys.setswitchinterval(old_si)
+        if own_workdir:
+            shutil.rmtree(workdir, ignore_errors=True)
+
+
+def run_pod_dryrun(
+    *,
+    grid=(32, 32),
+    local_devices: int = 2,
+    queries: int = 48,
+    roll_adds: int = 6,
+    max_wait_ms: float = 5.0,
+    mesh_shard_min_n: int = 64,
+    spawn_timeout_s: float = 300.0,
+    seed: int = 0,
+    workdir: str | None = None,
+) -> dict:
+    """The multi-process mesh replica dryrun (``bench.py
+    --pod-dryrun``, merged into ``bench_net.json`` by the full
+    ``--serve-net`` run): a REAL two-process ``jax.distributed`` job on
+    the CPU backend — ``bibfs-serve --process-id 0`` builds the store,
+    the engine and the network front door; ``--process-id 1`` joins as
+    a pod worker — served over the framed TCP protocol and gated exact
+    against the NumPy serial oracle, across a mid-traffic hot-swap:
+
+    1. every query answered over the wire matches the serial oracle
+       AND was served by the mesh route (``stats.mesh_queries`` — the
+       bitpacked dual-frontier exchange crossed process boundaries,
+       not a single-host fallback that happens to be right);
+    2. a ``roll`` control frame (edge adds that provably change
+       answers) hot-swaps the snapshot on BOTH processes mid-traffic —
+       post-roll answers match the post-roll oracle, still mesh-served;
+    3. SIGTERM on the primary drains the front door and shuts the pod
+       down; both processes exit 0 (the worker's shutdown descriptor /
+       EOF path, not a crash).
+
+    Skips (``{"skipped": reason}``) where multi-process jax is
+    unavailable. Returns the ``pod`` block of ``bench_net.json``."""
+    import shutil
+    import socket as _socket
+    import subprocess
+    import tempfile
+
+    from bibfs_tpu.graph.csr import build_csr
+    from bibfs_tpu.graph.generate import grid_graph
+    from bibfs_tpu.graph.io import write_graph_bin
+    from bibfs_tpu.serve.net import NetClient, read_port_file
+    from bibfs_tpu.solvers.serial import solve_serial_csr
+
+    try:
+        import jax.distributed  # noqa: F401
+    except ImportError as e:
+        return {"skipped": f"jax.distributed unavailable: {e}"}
+
+    t_all = time.perf_counter()
+    w, h = int(grid[0]), int(grid[1])
+    n = w * h
+    edges = grid_graph(w, h, perforation=0.02, seed=seed)
+    und = np.unique(
+        np.sort(edges[edges[:, 0] != edges[:, 1]], axis=1), axis=0
+    )
+    csr1 = build_csr(n, edges)
+
+    own_workdir = workdir is None
+    workdir = workdir or tempfile.mkdtemp(prefix="bibfs-pod-dryrun-")
+    store = os.path.join(workdir, "store")
+    os.makedirs(store, exist_ok=True)
+    write_graph_bin(os.path.join(store, "a.bin"), n, und)
+    port_file = os.path.join(workdir, "net.port")
+    try:  # a reused workdir must not hand us a stale port
+        os.unlink(port_file)
+    except OSError:
+        pass
+
+    def free_port() -> int:
+        s = _socket.socket()
+        s.bind(("127.0.0.1", 0))
+        p = s.getsockname()[1]
+        s.close()
+        return p
+
+    coord = f"127.0.0.1:{free_port()}"
+    pod_port = free_port()
+    env = dict(os.environ)
+    env["PYTHONUNBUFFERED"] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={int(local_devices)} "
+        + env.get("XLA_FLAGS", "")
+    ).strip()
+    common = [
+        "--coordinator", coord, "--num-processes", "2",
+        "--pod-port", str(pod_port),
+    ]
+    argv0 = [
+        sys.executable, "-u", "-m", "bibfs_tpu.serve.cli",
+        "--store", store, "--pipeline", "--no-path",
+        "--max-wait-ms", str(max_wait_ms),
+        "--port", "0", "--port-file", port_file,
+        "--mesh-shard-min-n", str(int(mesh_shard_min_n)),
+        *common, "--process-id", "0",
+    ]
+    argv1 = [
+        sys.executable, "-u", "-m", "bibfs_tpu.serve.cli",
+        *common, "--process-id", "1",
+    ]
+    logs = [os.path.join(workdir, f"proc{i}.log") for i in (0, 1)]
+    handles = [open(p, "w") for p in logs]
+    procs = [
+        subprocess.Popen(
+            argv, stdin=subprocess.DEVNULL, stdout=handle,
+            stderr=subprocess.STDOUT, env=env,
+        )
+        for argv, handle in zip((argv0, argv1), handles)
+    ]
+
+    def tails() -> dict:
+        out = {}
+        for i, p in enumerate(logs):
+            try:
+                with open(p) as f:
+                    out[f"proc{i}"] = f.read()[-2000:]
+            except OSError:
+                pass
+        return out
+
+    def reap(sig_primary: bool) -> list:
+        if sig_primary and procs[0].poll() is None:
+            procs[0].terminate()
+        rcs = []
+        for p in procs:
+            try:
+                rcs.append(p.wait(timeout=60.0))
+            except subprocess.TimeoutExpired:
+                p.kill()
+                try:
+                    p.wait(timeout=10.0)
+                except subprocess.TimeoutExpired:
+                    pass
+                rcs.append(None)
+        return rcs
+
+    client = None
+    try:
+        deadline = time.monotonic() + float(spawn_timeout_s)
+        addr = None
+        while addr is None:
+            for i, p in enumerate(procs):
+                if p.poll() is not None:
+                    reap(sig_primary=False)
+                    return {
+                        "skipped": (
+                            f"pod process {i} exited rc="
+                            f"{p.returncode} before serving"
+                        ),
+                        "logs": tails(),
+                    }
+            if time.monotonic() >= deadline:
+                reap(sig_primary=True)
+                return {
+                    "skipped": (
+                        f"pod did not serve within {spawn_timeout_s}s"
+                    ),
+                    "logs": tails(),
+                }
+            addr = read_port_file(port_file)
+            if addr is None:
+                time.sleep(0.2)
+
+        client = NetClient(addr[0], addr[1], connect_timeout=60.0)
+        pairs = sample_query_pairs(n, int(queries), seed=seed + 1)
+
+        def drive(ps, csr) -> tuple:
+            tickets = [
+                client.submit(int(s), int(d)) for s, d in ps
+            ]
+            bad = []
+            for (s, d), t in zip(ps, tickets):
+                try:
+                    res = t.wait(timeout=120.0)
+                except Exception as e:
+                    bad.append(f"{s}->{d}: {type(e).__name__}: {e}")
+                    continue
+                ref = solve_serial_csr(n, *csr, int(s), int(d))
+                if res.found != ref.found or (
+                    ref.found and res.hops != ref.hops
+                ):
+                    bad.append(
+                        f"{s}->{d}: {res.found}/{res.hops} != "
+                        f"serial {ref.found}/{ref.hops}"
+                    )
+            return tickets, bad
+
+        def mesh_count() -> int:
+            return int(client.request("stats").get("mesh_queries", 0))
+
+        _tk1, bad1 = drive(pairs, csr1)
+        mesh1 = mesh_count()
+        v1 = client.request("version").get("version")
+
+        # the hot-swap: long-range shortcuts that provably change hops
+        live = set(map(tuple, und.tolist()))
+        adds = []
+        for i in range(n):
+            if len(adds) >= int(roll_adds):
+                break
+            u, v = i, n - 1 - i
+            e = (u, v) if u < v else (v, u)
+            if u != v and e not in live and e not in adds:
+                adds.append(e)
+        rolled = client.request(
+            "roll", timeout=180.0,
+            adds=[[int(u), int(v)] for u, v in adds],
+        )
+        live2 = sorted(live | set(adds))
+        csr2 = build_csr(n, np.array(live2, dtype=np.int64))
+        changed = sum(
+            1 for s, d in pairs
+            if (solve_serial_csr(n, *csr1, int(s), int(d)).hops
+                != solve_serial_csr(n, *csr2, int(s), int(d)).hops)
+        )
+        _tk2, bad2 = drive(pairs, csr2)
+        mesh2 = mesh_count()
+
+        client.close()
+        client = None
+        rcs = reap(sig_primary=True)
+        out = {
+            "n": n,
+            "processes": 2,
+            "local_devices_per_process": int(local_devices),
+            "queries_per_pass": len(pairs),
+            "mesh_queries_pre_roll": mesh1,
+            "mesh_queries_post_roll": mesh2,
+            "version_pre_roll": v1,
+            "version_post_roll": rolled.get("version"),
+            "answers_changed_by_roll": changed,
+            "mismatches": (bad1 + bad2)[:10],
+            "exit_codes": rcs,
+            "elapsed_s": round(time.perf_counter() - t_all, 1),
+            "exact_ok": not bad1 and not bad2,
+            "mesh_used_ok": mesh1 > 0 and mesh2 > mesh1,
+            "swap_ok": (
+                rolled.get("version") == (v1 or 1) + 1 and changed > 0
+                and not bad2
+            ),
+            "clean_exit_ok": rcs == [0, 0],
+        }
+        out["ok"] = (
+            out["exact_ok"] and out["mesh_used_ok"]
+            and out["swap_ok"] and out["clean_exit_ok"]
+        )
+        if not out["ok"]:
+            out["logs"] = tails()
+        return out
+    except Exception as e:
+        reap(sig_primary=True)
+        return {
+            "ok": False,
+            "error": f"{type(e).__name__}: {e}"[:300],
+            "logs": tails(),
+        }
+    finally:
+        if client is not None:
+            client.close()
+        for handle in handles:
+            try:
+                handle.close()
+            except OSError:
+                pass
+        if own_workdir:
+            shutil.rmtree(workdir, ignore_errors=True)

@@ -1,0 +1,178 @@
+"""``route="mesh"`` across PROCESS boundaries — the pod-mesh rung.
+
+:class:`PodMeshRoute` subclasses :class:`~bibfs_tpu.serve.routes.mesh.
+MeshRoute` to drive the primary's half of the pod lockstep
+(:mod:`bibfs_tpu.parallel.podmesh`): every mesh-routed batch is
+broadcast to the worker processes, every process dispatches the
+identical vertex-sharded SPMD program over the GLOBAL mesh, and the
+bitpacked dual-frontier exchange crosses real process boundaries.
+
+Two deliberate deviations from the single-process rung:
+
+- **No dp sub-path.** The dp batch's global best array is sharded over
+  the query mesh: in a multi-process job no process can address all of
+  it, so ``_use_dp`` is pinned False and every pod batch takes the
+  vertex-sharded program — whose best/meet/levels/edges outputs are
+  REPLICATED (addressable on every host; ``tests/test_multihost.py``
+  documents the split).
+- **Replicated-only materialization.** The base route's
+  ``_materialize_batch`` pulls ALL outputs to host, including the
+  vertex-SHARDED parent planes — a crash across processes. The pod
+  finish reads only the replicated outputs and returns path-less
+  results (``BFSResult(found, hops, None, ...)``), which is exactly
+  what the network front door serves anyway (found/hops; the REPL's
+  path printing was never part of the wire contract).
+
+Failure story: any pod control-plane fault (worker refused the digest,
+died, timed out) raises :class:`~bibfs_tpu.parallel.podmesh.PodError`
+out of launch/finish BEFORE or AFTER the collective — never inside it
+(the join barrier, podmesh docstring) — and the engine's resilience
+ladder re-runs the batch on the local single-device rungs: exact
+answers at degraded throughput, the same degradation contract every
+other rung honors. The ``done`` ack carries each worker's replicated
+``best`` vector and finish asserts it equals the primary's — the
+cross-process exactness gate runs on every served batch, not just in
+the soak.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from bibfs_tpu.parallel.podmesh import PodError
+from bibfs_tpu.serve.buckets import bucket_batch, placement_bucket_key
+from bibfs_tpu.serve.routes.mesh import MeshRoute
+from bibfs_tpu.solvers.api import BFSResult
+
+
+def _materialize_replicated(out, num: int, elapsed: float):
+    """Per-query results from the REPLICATED outputs only (best, meet,
+    levels, edges — indices 0/1/4/5 of the sharded program's output
+    tuple); the sharded parent planes are never touched, so this works
+    when they are not fully addressable (multi-process meshes)."""
+    from bibfs_tpu.solvers.dense import INF32
+
+    best = np.asarray(out[0])
+    meet = np.asarray(out[1])
+    levels = np.asarray(out[4])
+    edges = np.asarray(out[5])
+    results = []
+    for i in range(num):
+        b = int(best[i])
+        if b >= int(INF32):
+            results.append(BFSResult(
+                False, None, None, None, elapsed,
+                int(levels[i]), int(edges[i]),
+            ))
+        else:
+            results.append(BFSResult(
+                True, b, None, int(meet[i]), elapsed,
+                int(levels[i]), int(edges[i]),
+            ))
+    return results
+
+
+class PodMeshRoute(MeshRoute):
+    """The multi-process mesh rung (module docstring). Same route name
+    and metrics families as :class:`MeshRoute` — to the engine, the
+    router and the dashboards it IS the mesh rung, just wider."""
+
+    name = "mesh"
+    is_dispatch = True
+
+    def __init__(self, engine, cfg, vmesh, qmesh, *, retry, breaker,
+                 label: str, pod, ack_timeout_s: float = 120.0):
+        super().__init__(engine, cfg, vmesh, qmesh, retry=retry,
+                         breaker=breaker, label=label)
+        self._pod = pod
+        self._ack_timeout_s = float(ack_timeout_s)
+
+    def _use_dp(self, rt, pairs) -> bool:
+        # dp's global best array is not fully addressable across
+        # processes (module docstring): every pod batch goes sharded
+        return False
+
+    def _launch_sharded(self, rt, pairs):
+        from bibfs_tpu.solvers import sharded as _sharded
+
+        snap = rt.snapshot
+        # broadcast the snapshot if the workers don't hold it yet (the
+        # hot-swap seam: a store roll shows up here as a new digest),
+        # building the primary's sharded graph BETWEEN the broadcast
+        # and the ack barrier — device placement onto the global mesh
+        # is collective, so primary and workers must build concurrently
+        sg = self._pod.ensure_graph(
+            snap, build=lambda: rt.mesh_graph(self),
+            timeout=self._ack_timeout_s,
+        )
+        rung = min(bucket_batch(len(pairs)), self.engine.max_batch)
+        padded = np.zeros((rung, 2), dtype=np.int64)
+        padded[: len(pairs)] = pairs
+        seq = self._pod.post_solve(
+            snap.digest, self.config.mode, padded, len(pairs)
+        )
+        # the join barrier: every worker committed to the collective
+        # before the primary enters it (PodError here aborts on-host)
+        self._pod.await_phase(seq, "join", timeout=self._ack_timeout_s)
+        self.engine.exec_cache.note(placement_bucket_key(
+            rt.mesh_bucket_key, kind="mesh1d", shards=self.ndev,
+            extra=(self.config.mode, rung),
+        ))
+        _p, dispatch = _sharded._batch_dispatch(
+            sg, padded, self.config.mode
+        )
+        t0 = time.perf_counter()
+        out = dispatch()
+        return out, ("pod", seq, sg), t0
+
+    def finish(self, out, fin, t0, pairs):
+        from bibfs_tpu.obs.trace import span
+        from bibfs_tpu.solvers.timing import force_scalar
+
+        _kind, seq, sg = fin
+        with span("pod_mesh_finish", batch=len(pairs)):
+            eng = self.engine
+            if eng._faults is not None:
+                eng._faults.fire("mesh_finish", pairs)
+            force_scalar(out)
+            elapsed = time.perf_counter() - t0
+            best = np.asarray(out[0])
+            rung = int(best.shape[0])
+            results = _materialize_replicated(
+                out, rung, elapsed)[: len(pairs)]
+            acks = self._pod.await_phase(
+                seq, "done", timeout=self._ack_timeout_s
+            )
+            mine = [int(b) for b in best]
+            for pidx, msg in acks.items():
+                theirs = msg.get("best")
+                if theirs is not None and list(theirs) != mine:
+                    raise PodError(
+                        f"pod worker {pidx} diverged on seq {seq}: "
+                        f"its replicated best != the primary's"
+                    )
+            self._note_exchange(sg, rung, results)
+            self.cells.batches["sharded"].inc()
+            eng.counters["mesh_queries"] += len(pairs)
+            return results
+
+
+def attach_pod(engine, pod, *, ack_timeout_s: float = 120.0):
+    """Swap a mesh-configured engine's mesh rung for the pod rung,
+    reusing the existing rung's config, meshes, retry policy and
+    breaker (so calibrated crossovers and breaker history carry over).
+    Raises ValueError on an engine built without ``mesh=``."""
+    base = engine.routes.get("mesh")
+    if base is None:
+        raise ValueError(
+            "pod serving needs a mesh-configured engine (mesh=...)"
+        )
+    route = PodMeshRoute(
+        engine, base.config, base.mesh, base.qmesh,
+        retry=base.retry, breaker=base.breaker,
+        label=engine.obs_label, pod=pod, ack_timeout_s=ack_timeout_s,
+    )
+    engine.routes["mesh"] = route
+    return route

@@ -65,12 +65,19 @@ CAL_ENV = "BIBFS_CALIBRATION"
 CAL_FILENAME = "calibration.json"
 
 #: a cached-arg dispatch slower than this means the calibrating probe
-#: itself was degraded (the committed tpu block's 66747.8 µs is a
-#: tunneled backend timing out on metadata retries, not a healthy
-#: device) — consumers routing off such a block get one visible
-#: warning per platform instead of silently tuning to junk
+#: itself was degraded (PR 16's committed tpu block recorded 66747.8 µs
+#: — a tunneled backend timing out on metadata retries, not a healthy
+#: device) — :func:`load_calibration` REFUSES such a block: consumers
+#: get None and fall back to their uncalibrated defaults, with one
+#: visible warning per platform and every refusal counted in
+#: :data:`degraded_refusals`
 DEGRADED_DISPATCH_US = 1000.0
 _warned_degraded: set = set()
+#: per-platform count of load_calibration calls that refused a
+#: degraded block this process — tests and health surfaces read it to
+#: prove the fallback actually fired (it is a running total, not a
+#: latch like the warning)
+degraded_refusals: dict = {}
 _REPO_ROOT = os.path.dirname(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 )
@@ -294,35 +301,42 @@ def load_calibration() -> dict | None:
     except RuntimeError:
         return None
     entry = data.get(platform)
-    if entry is not None:
-        _warn_if_degraded(platform, entry)
+    if entry is not None and _refuse_if_degraded(platform, entry):
+        return None
     return entry
 
 
-def _warn_if_degraded(platform: str, entry: dict) -> None:
-    """One visible warning per platform when the calibration block
-    being routed off was measured by a clearly-degraded probe
-    (:data:`DEGRADED_DISPATCH_US` — the committed tpu block's 66.7 ms
-    cached dispatch is a tunneled backend stalling, and every constant
-    derived from that session inherits the stall)."""
-    if platform in _warned_degraded:
-        return
+def _refuse_if_degraded(platform: str, entry: dict) -> bool:
+    """True when the block was measured by a clearly-degraded probe
+    (:data:`DEGRADED_DISPATCH_US` — e.g. a 66.7 ms cached dispatch is a
+    tunneled backend stalling, and every constant derived from that
+    session inherits the stall). A degraded block is REFUSED, not
+    merely flagged: :func:`load_calibration` returns None so consumers
+    take their uncalibrated defaults — exact answers tuned by
+    heuristics beat exact answers tuned by junk. Every refusal is
+    counted in :data:`degraded_refusals`; the warning prints once per
+    platform."""
     try:
         cached = float(entry.get("dispatch_cached_us", 0.0))
     except (TypeError, ValueError):
-        return
+        return False
     if cached <= DEGRADED_DISPATCH_US:
-        return
+        return False
+    degraded_refusals[platform] = degraded_refusals.get(platform, 0) + 1
+    if platform in _warned_degraded:
+        return True
     _warned_degraded.add(platform)
     import sys
 
     stamp = entry.get("measured_on")
     print(
-        f"warning: calibration block for platform {platform!r} was "
-        f"measured on a degraded substrate (dispatch_cached_us="
-        f"{cached:.1f} > {DEGRADED_DISPATCH_US:.0f}; measured_on="
-        f"{stamp if stamp else 'unstamped'}) — routing constants from "
-        "it may be junk; re-run `python bench.py --calibrate` on "
-        "healthy hardware",
+        f"warning: REFUSING calibration block for platform "
+        f"{platform!r}: measured on a degraded substrate "
+        f"(dispatch_cached_us={cached:.1f} > "
+        f"{DEGRADED_DISPATCH_US:.0f}; measured_on="
+        f"{stamp if stamp else 'unstamped'}) — falling back to "
+        "uncalibrated defaults; re-run `python bench.py --calibrate` "
+        "on healthy hardware",
         file=sys.stderr,
     )
+    return True

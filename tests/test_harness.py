@@ -347,3 +347,43 @@ def test_calibration_roundtrip(tmp_path, monkeypatch):
     loaded = calibrate.load_calibration()
     assert loaded is not None and "push_cap" in loaded
     calibrate._read_calibration_file.cache_clear()
+
+
+def test_calibration_degraded_block_refused(tmp_path, monkeypatch, capsys):
+    """A platform block measured by a degraded probe (dispatch_cached_us
+    over the staleness threshold) is REFUSED by load_calibration — the
+    caller gets None and falls back to uncalibrated defaults — with
+    every refusal counted and the warning printed once per platform."""
+    import json
+
+    import jax
+
+    from bibfs_tpu.utils import calibrate
+
+    platform = jax.devices()[0].platform
+    path = str(tmp_path / "cal.json")
+    with open(path, "w") as f:
+        json.dump({platform: {
+            "dispatch_cached_us": calibrate.DEGRADED_DISPATCH_US * 50,
+            "push_cap": 512,
+        }}, f)
+    monkeypatch.setenv(calibrate.CAL_ENV, path)
+    monkeypatch.setattr(calibrate, "_warned_degraded", set())
+    monkeypatch.setattr(calibrate, "degraded_refusals", {})
+    calibrate._read_calibration_file.cache_clear()
+    try:
+        assert calibrate.load_calibration() is None  # refused, not warned-and-returned
+        assert calibrate.degraded_refusals[platform] == 1
+        assert calibrate.load_calibration() is None
+        assert calibrate.degraded_refusals[platform] == 2  # counts every refusal
+        assert capsys.readouterr().err.count("REFUSING") == 1  # warns once
+        # a healthy block for the same platform loads normally
+        with open(path, "w") as f:
+            json.dump({platform: {
+                "dispatch_cached_us": 5.0, "push_cap": 512,
+            }}, f)
+        calibrate._read_calibration_file.cache_clear()
+        loaded = calibrate.load_calibration()
+        assert loaded is not None and loaded["push_cap"] == 512
+    finally:
+        calibrate._read_calibration_file.cache_clear()

@@ -1,0 +1,150 @@
+"""``init_distributed`` for real: two-process ``jax.distributed`` CPU
+jobs joined over a localhost coordinator — the served-configuration
+entry point behind ``bibfs-serve --coordinator`` — plus the full
+pod-serving dryrun (two processes, framed TCP front door, mid-traffic
+hot-swap, oracle-exact). Spawn tests are ``slow``; they skip with a
+reason where the jaxlib cannot do multi-process CPU collectives."""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _gloo_supported() -> bool:
+    """The CPU dryruns need gloo collectives; a jaxlib without the
+    knob only has single-process CPU collectives."""
+    import jax
+
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        return True
+    except Exception:
+        return False
+
+
+def test_init_distributed_bare_call_raises():
+    from bibfs_tpu.parallel.mesh import init_distributed
+
+    with pytest.raises(ValueError, match="coordinator_address"):
+        init_distributed()
+
+
+DIST_WORKER = """
+import json, os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+sys.path.insert(0, {repo!r})
+from bibfs_tpu.utils.platform import apply_platform_env
+apply_platform_env()
+
+from bibfs_tpu.parallel.mesh import init_distributed
+ctx = init_distributed(
+    "localhost:{port}", num_processes=2, process_id={pid}
+)
+assert ctx.process_index == {pid}, ctx.process_index
+assert ctx.process_count == 2, ctx.process_count
+assert ctx.is_primary == ({pid} == 0)
+
+# the context's device split must describe a REAL global backend...
+import jax
+assert ctx.local_device_count == jax.local_device_count()
+assert ctx.global_device_count == jax.device_count()
+
+# ...and the collectives must actually cross the process boundary
+# (the gloo wire exchange init_distributed configures)
+import numpy as np
+import jax.numpy as jnp
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+mesh = Mesh(np.asarray(jax.devices()), ("x",))
+total = shard_map(
+    lambda v: jax.lax.psum(v, "x"),
+    mesh=mesh, in_specs=P("x"), out_specs=P(),
+)(jnp.arange(8, dtype=jnp.int32))
+print("DIST_CTX", json.dumps({{
+    "pid": {pid},
+    "ctx": ctx.asdict(),
+    "psum": int(np.asarray(total)[0]),
+}}), flush=True)
+"""
+
+
+@pytest.mark.slow
+def test_init_distributed_two_process_cpu():
+    """Two processes join through ``init_distributed`` on a localhost
+    coordinator: each sees its own index, the global device split, and
+    a psum whose result could only come from BOTH processes' shards."""
+    if not _gloo_supported():
+        pytest.skip("jaxlib has no gloo CPU collectives: "
+                    "multi-process CPU jobs unsupported here")
+    port = _free_port()
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c",
+             DIST_WORKER.format(repo=REPO, port=port, pid=i)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=300)
+            outs.append(out)
+    finally:
+        for p in procs:
+            p.kill()
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"proc {i} failed:\n{out[-1500:]}"
+        lines = [ln for ln in out.splitlines()
+                 if ln.startswith("DIST_CTX")]
+        assert lines, f"proc {i} printed no DIST_CTX:\n{out[-1500:]}"
+        msg = json.loads(lines[-1].split(" ", 1)[1])
+        assert msg["pid"] == i
+        assert msg["ctx"]["process_count"] == 2
+        assert msg["ctx"]["local_device_count"] == 4
+        assert msg["ctx"]["global_device_count"] == 8
+        # sum(range(8)) across shards held by different PROCESSES
+        assert msg["psum"] == 28
+
+
+@pytest.mark.slow
+def test_pod_serve_dryrun_exact(tmp_path):
+    """The full pod-serving dryrun: a two-process mesh replica served
+    over the framed TCP door, every answer oracle-exact and
+    mesh-routed, a mid-traffic hot-swap, clean SIGTERM exits."""
+    if not _gloo_supported():
+        pytest.skip("jaxlib has no gloo CPU collectives: "
+                    "multi-process CPU jobs unsupported here")
+    from bibfs_tpu.serve.loadgen import run_pod_dryrun
+
+    out = run_pod_dryrun(
+        grid=(24, 24), queries=24, roll_adds=4,
+        workdir=str(tmp_path),
+    )
+    if "skipped" in out:
+        pytest.skip(f"pod dryrun skipped itself: {out['skipped']}")
+    brief = {k: v for k, v in out.items() if k != "logs"}
+    assert out.get("exact_ok"), brief
+    assert out.get("mesh_used_ok"), brief
+    assert out.get("swap_ok"), brief
+    assert out.get("clean_exit_ok"), brief
+    assert out["ok"], brief

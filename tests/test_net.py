@@ -1,0 +1,332 @@
+"""The network front door (bibfs_tpu/serve/net.py) in-process: frame
+codec, port-file handshake, token buckets, correlation-id query
+round-trips, the wire error taxonomy, per-tenant quota admission,
+per-request deadlines, graceful drain, and the ``bibfs_net_*`` metric
+families rendering at zero from server construction."""
+
+import json
+import socket
+import struct
+import threading
+
+import numpy as np
+import pytest
+
+from bibfs_tpu.obs.metrics import MetricsRegistry
+from bibfs_tpu.obs.names import NET_METRIC_FAMILIES
+from bibfs_tpu.serve.net import (
+    MAX_FRAME_BYTES,
+    FrameError,
+    NetClient,
+    NetServer,
+    TokenBucket,
+    encode_frame,
+    extract_frames,
+    read_port_file,
+    write_port_file,
+)
+from bibfs_tpu.serve.pipeline import PipelinedQueryEngine
+from bibfs_tpu.serve.resilience import QueryError
+from bibfs_tpu.solvers.serial import solve_serial
+
+
+def _skiplink_graph(n: int) -> np.ndarray:
+    edges = [[i, i + 1] for i in range(n - 1)]
+    edges += [[i, i + 7] for i in range(n - 7)]
+    return np.array(edges)
+
+
+N = 400
+EDGES = _skiplink_graph(N)
+
+# fresh-pair source: deadline/capacity tests need queries the engine
+# cannot resolve inline from its pair cache (an inline resolution
+# replies immediately and never enters the server's pending table)
+_FRESH = iter((s, s + 7 * k) for k in range(1, 50)
+              for s in range(0, N - 7 * k, 11))
+
+
+def _fresh_pair():
+    return next(_FRESH)
+
+
+# ---- codec ----------------------------------------------------------
+
+def test_frame_codec_roundtrip_and_partial_feed():
+    frames = [{"op": "ping", "id": i} for i in range(3)]
+    wire = b"".join(encode_frame(f) for f in frames)
+    buf = bytearray()
+    got = []
+    # feed one byte at a time: the extractor must hold partial frames
+    for b in wire:
+        buf.append(b)
+        got += [json.loads(raw.decode()) for raw in extract_frames(buf)]
+    assert got == frames
+    assert not buf  # fully consumed
+
+
+def test_frame_codec_bounds():
+    with pytest.raises(ValueError):
+        encode_frame({"blob": "x" * MAX_FRAME_BYTES})
+    buf = bytearray(struct.pack(">I", MAX_FRAME_BYTES + 1) + b"xx")
+    with pytest.raises(FrameError):
+        extract_frames(buf)
+
+
+def test_port_file_roundtrip(tmp_path):
+    path = str(tmp_path / "srv.port")
+    assert read_port_file(path) is None
+    write_port_file(path, "127.0.0.1", 4242)
+    assert read_port_file(path) == ("127.0.0.1", 4242)
+    with open(path, "w") as f:
+        f.write("garbage")
+    assert read_port_file(path) is None
+
+
+def test_token_bucket_deterministic():
+    import time as _time
+
+    b = TokenBucket(rate=10.0, burst=2.0)
+    t0 = _time.monotonic()  # the stamp clock; explicit from here on
+    assert b.allow(t0) and b.allow(t0)  # the burst
+    assert not b.allow(t0)  # bucket empty at the same instant
+    assert b.allow(t0 + 0.1)  # one refill at 10/s
+    assert not b.allow(t0 + 0.1)
+
+
+# ---- served round-trips ---------------------------------------------
+
+@pytest.fixture(scope="module")
+def served():
+    """One pipelined engine + front door + client for the happy-path
+    tests (drain/quota/deadline tests build their own servers)."""
+    eng = PipelinedQueryEngine(N, EDGES, max_wait_ms=5.0)
+    server = NetServer(eng)
+    client = NetClient(server.host, server.port)
+    yield eng, server, client
+    client.close()
+    server.close()
+    eng.close()
+
+
+def test_query_roundtrip_exact(served):
+    _eng, _server, client = served
+    pairs = [(0, 399), (3, 250), (11, 11), (5, 100)]
+    tickets = [client.submit(s, d) for s, d in pairs]
+    for (s, d), t in zip(pairs, tickets):
+        res = t.wait(timeout=30.0)
+        ref = solve_serial(N, EDGES, s, d)
+        assert res.found == ref.found
+        assert res.hops == ref.hops
+
+
+def test_concurrent_clients_correlation(served):
+    _eng, server, _client = served
+    pairs = [(i, N - 1 - i) for i in range(0, 40, 2)]
+    refs = {p: solve_serial(N, EDGES, *p) for p in pairs}
+    errs = []
+
+    def drive():
+        c = NetClient(server.host, server.port)
+        try:
+            tickets = [c.submit(s, d) for s, d in pairs]
+            for (s, d), t in zip(pairs, tickets):
+                res = t.wait(timeout=30.0)
+                if res.hops != refs[(s, d)].hops:
+                    errs.append((s, d, res.hops))
+        finally:
+            c.close()
+
+    threads = [threading.Thread(target=drive) for _ in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60.0)
+    assert not errs
+
+
+def test_control_ops_roundtrip(served):
+    _eng, _server, client = served
+    assert client.request("ping") == {"pong": True}
+    assert client.request("health")["state"] in ("ready", "degraded")
+    stats = client.request("stats")
+    assert stats["graph"]["n"] == N
+    ver = client.request("version")
+    assert ver["version"] == stats["graph"]["version"]
+
+
+def test_error_taxonomy_on_the_wire(served):
+    _eng, _server, client = served
+    # out-of-range endpoint: structured invalid, connection survives
+    t = client.submit(0, N + 5)
+    with pytest.raises(QueryError) as exc:
+        t.wait(timeout=30.0)
+    assert exc.value.kind == "invalid"
+    # unknown op: structured invalid
+    with pytest.raises(QueryError) as exc:
+        client.request("frobnicate")
+    assert exc.value.kind == "invalid"
+    # memory needs a store: structured invalid (engine has none here)
+    with pytest.raises(QueryError) as exc:
+        client.request("memory")
+    assert exc.value.kind == "invalid"
+    # and the connection still serves after every refusal
+    assert client.request("ping") == {"pong": True}
+
+
+def test_malformed_frame_survived(served):
+    _eng, server, _client = served
+    sock = socket.create_connection((server.host, server.port))
+    try:
+        payload = b"not json at all"
+        sock.sendall(struct.pack(">I", len(payload)) + payload)
+        buf = bytearray()
+        reply = None
+        sock.settimeout(10.0)
+        while reply is None:
+            data = sock.recv(1 << 16)
+            assert data, "server closed instead of replying"
+            buf += data
+            for raw in extract_frames(buf):
+                reply = json.loads(raw.decode())
+        assert reply["ok"] is False
+        assert reply["kind"] == "invalid"
+        # the connection survives malformed JSON inside a good frame
+        sock.sendall(encode_frame({"op": "ping", "id": 1}))
+        data = sock.recv(1 << 16)
+        assert data
+    finally:
+        sock.close()
+
+
+def test_oversize_prefix_closes_connection(served):
+    _eng, server, _client = served
+    sock = socket.create_connection((server.host, server.port))
+    try:
+        sock.sendall(struct.pack(">I", MAX_FRAME_BYTES + 1))
+        sock.settimeout(10.0)
+        # framing is unrecoverable: the server sends one structured
+        # refusal frame, then hangs up
+        buf = bytearray()
+        while True:
+            data = sock.recv(1 << 16)
+            if not data:
+                break
+            buf += data
+        (raw,) = extract_frames(buf)
+        reply = json.loads(raw.decode())
+        assert reply["ok"] is False
+        assert reply["kind"] == "invalid"
+        assert "closing connection" in reply["error"]
+    finally:
+        sock.close()
+
+
+# ---- admission ------------------------------------------------------
+
+def test_quota_greedy_refused_polite_untouched():
+    eng = PipelinedQueryEngine(N, EDGES, max_wait_ms=5.0)
+    server = NetServer(eng, quota_qps=1.0, quota_burst=2.0)
+    client = NetClient(server.host, server.port)
+    try:
+        tickets = [
+            client.submit(0, 399, tenant="greedy") for _ in range(6)
+        ]
+        refused = 0
+        for t in tickets:
+            try:
+                t.wait(timeout=30.0)
+            except QueryError as e:
+                assert e.kind == "capacity"
+                assert "quota" in str(e)
+                refused += 1
+        assert refused >= 3  # burst 2 + maybe one refill pass
+        # the polite tenant's bucket is its own
+        res = client.submit(3, 250, tenant="polite").wait(timeout=30.0)
+        assert res.hops == solve_serial(N, EDGES, 3, 250).hops
+    finally:
+        client.close()
+        server.close()
+        eng.close()
+
+
+def test_inflight_capacity_refusal_structured():
+    eng = PipelinedQueryEngine(N, EDGES, max_wait_ms=300.0)
+    server = NetServer(eng, max_inflight=1)
+    client = NetClient(server.host, server.port)
+    try:
+        first = client.submit(*_fresh_pair())  # parks for the flush
+        second = client.submit(*_fresh_pair())
+        with pytest.raises(QueryError) as exc:
+            second.wait(timeout=30.0)
+        assert exc.value.kind == "capacity"
+        assert "capacity" in str(exc.value)
+        assert first.wait(timeout=30.0) is not None
+    finally:
+        client.close()
+        server.close()
+        eng.close()
+
+
+def test_deadline_miss_structured_and_counted():
+    reg = MetricsRegistry()
+    eng = PipelinedQueryEngine(N, EDGES, max_wait_ms=300.0)
+    server = NetServer(eng, registry=reg)
+    client = NetClient(server.host, server.port)
+    try:
+        # the flush SLO (300ms) cannot beat a 5ms deadline: the
+        # completer must answer with a structured timeout anyway
+        t = client.submit(*_fresh_pair(), deadline_ms=5.0)
+        with pytest.raises(QueryError) as exc:
+            t.wait(timeout=30.0)
+        assert exc.value.kind == "timeout"
+        text = reg.render()
+        assert "bibfs_net_deadline_misses_total 1" in text
+        # a generous deadline resolves normally
+        s, d = _fresh_pair()
+        res = client.submit(s, d, deadline_ms=30_000.0).wait(
+            timeout=30.0
+        )
+        assert res.hops == solve_serial(N, EDGES, s, d).hops
+    finally:
+        client.close()
+        server.close()
+        eng.close()
+
+
+def test_drain_refuses_queries_answers_control():
+    eng = PipelinedQueryEngine(N, EDGES, max_wait_ms=5.0)
+    server = NetServer(eng)
+    client = NetClient(server.host, server.port)
+    try:
+        assert client.submit(0, 399).wait(timeout=30.0) is not None
+        assert server.drain(timeout=10.0)
+        t = client.submit(3, 250)
+        with pytest.raises(QueryError) as exc:
+            t.wait(timeout=30.0)
+        assert exc.value.kind == "capacity"
+        assert "draining" in str(exc.value)
+        # control ops still answer on a draining door
+        assert client.request("ping") == {"pong": True}
+    finally:
+        client.close()
+        server.close()
+        eng.close()
+
+
+# ---- observability --------------------------------------------------
+
+def test_net_metric_families_render_at_zero():
+    reg = MetricsRegistry()
+    eng = PipelinedQueryEngine(N, EDGES, max_wait_ms=5.0)
+    server = NetServer(eng, registry=reg)
+    try:
+        text = reg.render()
+        for family in NET_METRIC_FAMILIES:
+            assert family in text, family
+        # label-zero rows, not just HELP lines
+        assert 'bibfs_net_requests_total{op="query"} 0' in text
+        assert 'bibfs_net_rejections_total{reason="quota"} 0' in text
+    finally:
+        server.close()
+        eng.close()

@@ -1,0 +1,183 @@
+"""The network front door end-to-end: spawned ``bibfs-serve --port``
+children spoken over the framed TCP protocol — the raw CLI path, the
+:class:`~bibfs_tpu.fleet.netreplica.NetReplica` driver behind the
+router (routing, kill/reroute/restart, rolling swaps), and SIGTERM
+graceful drain exiting 0. All spawn tests are ``slow`` (subprocess +
+jax import per child), matching the ProcessReplica suite."""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from bibfs_tpu.fleet import NetReplica, Router
+from bibfs_tpu.serve.net import NetClient, read_port_file
+from bibfs_tpu.serve.resilience import QueryError
+from bibfs_tpu.solvers.serial import solve_serial
+
+
+def _skiplink_graph(n: int) -> np.ndarray:
+    edges = [[i, i + 1] for i in range(n - 1)]
+    edges += [[i, i + 7] for i in range(n - 7)]
+    return np.array(edges)
+
+
+N = 80
+EDGES = _skiplink_graph(N)
+
+
+@pytest.mark.slow
+def test_serve_port_cli_end_to_end(tmp_path):
+    """``bibfs-serve g.bin --port 0``: port file appears atomically,
+    a raw NetClient round-trips queries and control ops, and SIGTERM
+    drains the door and exits 0."""
+    from bibfs_tpu.graph.io import write_graph_bin
+
+    gpath = tmp_path / "g.bin"
+    write_graph_bin(gpath, N, EDGES)
+    port_file = str(tmp_path / "net.port")
+    proc = subprocess.Popen(
+        [sys.executable, "-u", "-m", "bibfs_tpu.serve.cli",
+         str(gpath), "--pipeline", "--no-path",
+         "--max-wait-ms", "5", "--port", "0",
+         "--port-file", port_file],
+        stdin=subprocess.DEVNULL, stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+        env={**os.environ, "PYTHONUNBUFFERED": "1"},
+    )
+    client = None
+    try:
+        deadline = time.monotonic() + 180.0
+        addr = None
+        while addr is None:
+            assert proc.poll() is None, "child died before binding"
+            assert time.monotonic() < deadline, "no port file"
+            addr = read_port_file(port_file)
+            if addr is None:
+                time.sleep(0.05)
+        client = NetClient(addr[0], addr[1])
+        pairs = [(0, 50), (3, 40), (0, N - 1)]
+        tickets = [client.submit(s, d) for s, d in pairs]
+        for (s, d), t in zip(pairs, tickets):
+            assert t.wait(timeout=60.0).hops == solve_serial(
+                N, EDGES, s, d
+            ).hops
+        assert client.request("ping") == {"pong": True}
+        assert client.request("stats")["graph"]["n"] == N
+        proc.send_signal(signal.SIGTERM)
+        assert proc.wait(timeout=60.0) == 0  # graceful drain, rc 0
+    finally:
+        if client is not None:
+            client.close()
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=10.0)
+
+
+@pytest.mark.slow
+def test_net_replica_fleet(tmp_path):
+    """NetReplica children behind the router: routing exactness, the
+    framed control surface, a REAL SIGKILL (pending tickets fail
+    structured, the router re-routes), restart and re-admission —
+    the ProcessReplica fleet contract over the network door."""
+    from bibfs_tpu.graph.io import write_graph_bin
+
+    gpath = tmp_path / "g.bin"
+    write_graph_bin(gpath, N, EDGES)
+    router = Router(
+        [NetReplica(f"n{i}", str(gpath)) for i in range(2)],
+        poll_interval_s=0.2,
+    )
+    try:
+        pairs = [(0, 50), (3, 40), (0, N - 1)]
+        for (s, d), res in zip(pairs, router.query_many(pairs)):
+            assert res.hops == solve_serial(N, EDGES, s, d).hops
+        owner = router.replica(router.owner(None))
+        assert owner.stats()["queries"] >= 1
+        assert owner.health()["state"] in ("ready", "degraded")
+        gen0 = owner.generation
+        # a fixed-graph child refuses memory (the store-only surface)
+        with pytest.raises(ValueError):
+            owner.memory()
+        t = router.submit(5, 60)
+        victim = t.replica
+        router.replica(victim).kill()
+        assert t.wait(timeout=60.0).hops == solve_serial(
+            N, EDGES, 5, 60
+        ).hops
+        assert t.replica != victim
+        router.replica(victim).restart()
+        deadline = time.monotonic() + 60.0
+        while (router.table()[victim] != "ready"
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        assert router.table()[victim] == "ready"
+        assert router.replica(victim).generation >= 1
+        assert owner.generation == gen0 or owner.name == victim
+    finally:
+        router.close()
+
+
+@pytest.mark.slow
+def test_net_replica_store_rolling_swap(tmp_path):
+    """A rolling swap across ``--store`` NetReplica children: the edge
+    batch ships in ONE framed ``roll`` per child, versions advance,
+    post-roll answers reflect the new edge set, and a bad graph name
+    fails structured without wedging the connection."""
+    from bibfs_tpu.graph.io import write_graph_bin
+
+    store_dir = tmp_path / "store"
+    store_dir.mkdir()
+    write_graph_bin(store_dir / "a.bin", N, EDGES)
+    router = Router(
+        [NetReplica(f"n{i}", store_dir=str(store_dir))
+         for i in range(2)],
+        poll_interval_s=0.2,
+    )
+    try:
+        ref = solve_serial(N, EDGES, 0, N - 1)
+        assert router.query(0, N - 1, "a").hops == ref.hops
+        out = router.rolling_swap("a", adds=[(0, N - 1)], dels=[])
+        assert out["ok"], out
+        for row in out["replicas"]:
+            assert row["version"] == [1, 2]
+        assert router.query(0, N - 1, "a").hops == 1
+        rep = router.replica("n0")
+        bad = rep.submit(0, 5, "nope")
+        with pytest.raises(QueryError) as exc:
+            rep.wait_ticket(bad, timeout=30.0)
+        assert exc.value.kind == "invalid"
+        edges_v2 = np.vstack([EDGES, [[0, N - 1]]])
+        assert rep.wait_ticket(
+            rep.submit(0, 50, "a"), timeout=30.0
+        ).hops == solve_serial(N, edges_v2, 0, 50).hops
+        # live updates land through one framed request too
+        rep.update("a", adds=[(1, 70)], dels=[])
+        edges_v3 = np.vstack([edges_v2, [[1, 70]]])
+        assert rep.wait_ticket(
+            rep.submit(1, 70, "a"), timeout=30.0
+        ).hops == solve_serial(N, edges_v3, 1, 70).hops
+    finally:
+        router.close()
+
+
+@pytest.mark.slow
+def test_net_replica_close_is_graceful(tmp_path):
+    """``close()`` SIGTERMs the child and the child exits 0: answered
+    tickets stay answered, the drain handler refuses late arrivals
+    instead of dropping them."""
+    from bibfs_tpu.graph.io import write_graph_bin
+
+    gpath = tmp_path / "g.bin"
+    write_graph_bin(gpath, N, EDGES)
+    rep = NetReplica("g0", str(gpath))
+    try:
+        res = rep.wait_ticket(rep.submit(0, 50), timeout=60.0)
+        assert res.hops == solve_serial(N, EDGES, 0, 50).hops
+    finally:
+        rep.close()
+    assert rep._proc.returncode == 0
