@@ -24,22 +24,33 @@ is that missing lockstep:
 - :class:`bibfs_tpu.serve.routes.pod.PodMeshRoute` drives the primary
   side from inside the engine's existing mesh rung.
 
-**The join barrier.** A ``solve`` is acked twice: ``join`` once the
-worker has validated the graph digest and built the dispatch (it is
-now committed to entering the collective), ``done`` once its
+**The join barrier (two-phase).** A ``solve`` launch is a
+commit/abort protocol: the worker acks ``join`` once it has validated
+the graph digest and built the dispatch, then PARKS until the primary
+broadcasts a verdict — ``go`` (every worker joined ok: everyone,
+primary included, enters the collective) or ``abort`` (some worker
+refused, died, or timed out: the parked workers skip the batch and
+return to the descriptor loop). The verdict phase is what makes the
+failure story sound with >1 worker: without it, a worker that acked
+would already be inside the collective when the primary aborted
+on-host, wedging the pod until the ``jax.distributed`` heartbeat.
+After ``go``, each worker acks ``done`` once its
 ``block_until_ready`` returned (carrying its replicated ``best``
-vector so the primary can assert cross-process agreement). The
-primary awaits every ``join`` BEFORE entering the collective itself:
-a worker that refuses (digest mismatch, build failure) fails the
-launch as a :class:`PodError` while the primary is still on the host,
-and the engine's fallback ladder re-runs the batch on the local
-single-device rungs — degraded throughput, never a hang and never a
-wrong answer. (A worker dying INSIDE the collective is the one fault
-this cannot catch; that is ``jax.distributed``'s heartbeat timeout's
-job, exactly as it was ``MPI_Allreduce``'s.)
+vector so the primary can assert cross-process agreement). Any
+refused/dead/timed-out join fails the launch as a :class:`PodError`
+while every process is still on the host, and the engine's fallback
+ladder re-runs the batch on the local single-device rungs — degraded
+throughput, never a hang and never a wrong answer. (A process dying
+INSIDE the collective — between ``go`` and ``done`` — is the one
+fault this cannot catch; that is ``jax.distributed``'s heartbeat
+timeout's job, exactly as it was ``MPI_Allreduce``'s.)
 
 **Graph identity.** A ``graph`` descriptor ships the snapshot's
-canonical pairs + content digest; the worker rebuilds the SAME
+canonical pairs + content digest — as a header frame followed by
+``chunks`` ``graph_chunk`` frames of :data:`GRAPH_CHUNK_EDGES` edges
+each, because a realistically-sized graph (the PR 16 RMAT soaks) far
+exceeds the 1 MiB frame bound as a single JSON frame. The worker
+reassembles the stream and rebuilds the SAME
 ``GraphSnapshot -> bucketed ELL -> repad_rows -> ShardedGraph``
 chain the primary's engine runtime built, verifying the digest over
 the received pairs first. Same pairs + same mesh => bit-identical
@@ -62,6 +73,7 @@ import json
 import socket
 import threading
 import time
+from collections import deque
 
 import numpy as np
 
@@ -72,6 +84,11 @@ from bibfs_tpu.serve.net import MAX_FRAME_BYTES, encode_frame, extract_frames
 #: port — ``bibfs-serve --coordinator host:P`` listens for workers on
 #: ``P + POD_PORT_OFFSET`` unless ``--pod-port`` overrides it
 POD_PORT_OFFSET = 1
+
+#: edges per ``graph_chunk`` frame. Worst case an int64 edge is two
+#: 20-digit values + separators ≈ 42 JSON bytes, so 20k edges ≈ 840 KiB
+#: — under the 1 MiB frame bound with envelope headroom.
+GRAPH_CHUNK_EDGES = 20_000
 
 
 class PodError(RuntimeError):
@@ -183,6 +200,15 @@ class PodPrimary:
                         key = (int(msg.get("seq", -1)),
                                str(msg.get("phase", "done")))
                         self._acks.setdefault(key, {})[pidx] = msg
+                        # sweep acks that straggled in after their seq
+                        # was abandoned (await_phase pops the key it
+                        # waits on; a late ack re-creates it): launches
+                        # are serialized, so nothing legitimately waits
+                        # this far behind the current seq
+                        stale = [k for k in self._acks
+                                 if k[0] + 64 < self._seq]
+                        for k in stale:
+                            del self._acks[k]
                         self._cv.notify_all()
         except (ConnectionError, OSError, ValueError) as e:
             why = str(e) or why
@@ -198,22 +224,28 @@ class PodPrimary:
         deadline = time.monotonic() + timeout
         key = (int(seq), phase)
         with self._lock:
-            while True:
-                if self._dead:
-                    pidx, why = next(iter(self._dead.items()))
-                    raise PodError(f"pod worker {pidx} died: {why}")
-                got = self._acks.get(key, {})
-                if len(got) >= len(self._workers):
-                    del self._acks[key]
-                    break
-                left = deadline - time.monotonic()
-                if left <= 0:
-                    raise PodError(
-                        f"pod: {len(got)}/{len(self._workers)} workers "
-                        f"acked seq {seq} phase {phase!r} within "
-                        f"{timeout}s"
-                    )
-                self._cv.wait(left)
+            # the key is popped on EVERY exit (success, dead worker,
+            # timeout): an abandoned seq must not leave its partial
+            # ack dict — worker `best` vectors included — in the
+            # mailbox forever
+            try:
+                while True:
+                    if self._dead:
+                        pidx, why = next(iter(self._dead.items()))
+                        raise PodError(f"pod worker {pidx} died: {why}")
+                    got = self._acks.get(key, {})
+                    if len(got) >= len(self._workers):
+                        break
+                    left = deadline - time.monotonic()
+                    if left <= 0:
+                        raise PodError(
+                            f"pod: {len(got)}/{len(self._workers)} "
+                            f"workers acked seq {seq} phase {phase!r} "
+                            f"within {timeout}s"
+                        )
+                    self._cv.wait(left)
+            finally:
+                self._acks.pop(key, None)
         for pidx, msg in got.items():
             if not msg.get("ok", False):
                 raise PodError(
@@ -234,7 +266,12 @@ class PodPrimary:
             seq = self._seq
             workers = dict(self._workers)
         desc = dict(desc, seq=seq)
-        data = encode_frame(desc)
+        try:
+            data = encode_frame(desc)
+        except ValueError as e:
+            # the flusher's resilience ladder speaks PodError; a raw
+            # encode ValueError would escape it
+            raise PodError(f"pod descriptor encode failed: {e}") from e
         # single writer by construction (module docstring): sendall
         # happens OUTSIDE the lock, on the one broadcasting thread
         for pidx, sock in workers.items():
@@ -262,18 +299,37 @@ class PodPrimary:
         makes the steady-state cost one string compare per launch."""
         if snapshot.digest == self._last_digest:
             return build() if build is not None else None
+        seq = self.post_graph(snapshot)
+        out = build() if build is not None else None
+        self.await_phase(seq, "done", timeout)
+        self._last_digest = snapshot.digest
+        return out
+
+    def post_graph(self, snapshot) -> int:
+        """Broadcast one graph descriptor as a chunked frame stream:
+        a header frame (n/digest/version/chunk count) followed by that
+        many ``graph_chunk`` frames of at most
+        :data:`GRAPH_CHUNK_EDGES` edges each, keyed to the header by
+        ``for`` — the frame bound is 1 MiB and realistic graphs are
+        far bigger as JSON. Returns the header's seq (the one the
+        workers ack ``done`` on after rebuilding)."""
+        flat = np.asarray(snapshot.pairs, dtype=np.int64).ravel()
+        step = 2 * GRAPH_CHUNK_EDGES
+        chunks = [flat[i: i + step].tolist()
+                  for i in range(0, len(flat), step)]
         seq = self._post({
             "op": "graph",
             "n": int(snapshot.n),
             "digest": snapshot.digest,
             "version": int(snapshot.version),
-            "pairs": np.asarray(
-                snapshot.pairs, dtype=np.int64).ravel().tolist(),
+            "chunks": len(chunks),
         })
-        out = build() if build is not None else None
-        self.await_phase(seq, "done", timeout)
-        self._last_digest = snapshot.digest
-        return out
+        for i, chunk in enumerate(chunks):
+            self._post({
+                "op": "graph_chunk", "for": seq, "i": i,
+                "pairs": chunk,
+            })
+        return seq
 
     def post_solve(self, digest: str, mode: str, padded,
                    count: int) -> int:
@@ -287,6 +343,45 @@ class PodPrimary:
             "count": int(count),
             "pairs": np.asarray(padded, dtype=np.int64).ravel().tolist(),
         })
+
+    def commit_solve(self, seq: int) -> None:
+        """Broadcast the ``go`` verdict for ``seq``: every worker
+        acked ``join``, so every process (primary included) may enter
+        the collective. Raises :class:`PodError` if a worker socket is
+        gone mid-broadcast — the primary then aborts on-host without
+        entering the collective (a worker that already got its ``go``
+        is inside one short a participant, which is the dead-worker
+        case the ``jax.distributed`` heartbeat owns anyway)."""
+        self._post({"op": "go", "for": int(seq)})
+
+    def abort_solve(self, seq: int) -> None:
+        """Best-effort ``abort`` verdict for ``seq`` after a failed
+        join barrier: workers parked in their verdict wait skip the
+        collective instead of entering it short the primary. Sends to
+        every worker not known dead and never raises — the launch is
+        already failing with its own :class:`PodError`."""
+        with self._lock:
+            if self._closed:
+                return
+            self._seq += 1
+            vseq = self._seq
+            workers = {p: s for p, s in self._workers.items()
+                       if p not in self._dead}
+        try:
+            data = encode_frame(
+                {"op": "abort", "for": int(seq), "seq": vseq}
+            )
+        except ValueError:
+            return
+        for pidx, sock in workers.items():
+            try:
+                sock.sendall(data)
+            except OSError as e:
+                with self._lock:
+                    self._dead.setdefault(
+                        pidx, f"broadcast failed: {e}"
+                    )
+                    self._cv.notify_all()
 
     # ---- lifecycle ---------------------------------------------------
     def shutdown(self, timeout: float = 30.0) -> None:
@@ -330,18 +425,22 @@ def _connect_retry(host: str, port: int, timeout_s: float):
             time.sleep(0.1)
 
 
-def _build_worker_graph(msg: dict, mesh):
-    """Rebuild the primary's sharded graph from a ``graph`` descriptor:
-    verify the content digest over the received pairs, then run the
-    SAME snapshot -> bucketed ELL -> repad -> shard chain the engine
-    runtime runs (``serve/engine._GraphRuntime.mesh_graph``) so shapes
-    and content are bit-identical across processes."""
+def _build_worker_graph(msg: dict, parts: list, mesh):
+    """Rebuild the primary's sharded graph from a ``graph`` header and
+    its reassembled ``graph_chunk`` pair lists: verify the content
+    digest over the received pairs, then run the SAME snapshot ->
+    bucketed ELL -> repad -> shard chain the engine runtime runs
+    (``serve/engine._GraphRuntime.mesh_graph``) so shapes and content
+    are bit-identical across processes."""
     from bibfs_tpu.serve.buckets import repad_rows
     from bibfs_tpu.solvers.sharded import ShardedGraph
     from bibfs_tpu.store.snapshot import GraphSnapshot, content_digest
 
     n = int(msg["n"])
-    pairs = np.asarray(msg["pairs"], dtype=np.int64).reshape(-1, 2)
+    flat = (np.concatenate(
+        [np.asarray(p, dtype=np.int64).ravel() for p in parts]
+    ) if parts else np.zeros(0, dtype=np.int64))
+    pairs = flat.reshape(-1, 2)
     digest = str(msg["digest"])
     got = content_digest(n, pairs)
     if got != digest:
@@ -379,66 +478,117 @@ def run_pod_worker(host: str, port: int, *, process_index: int,
         f"({mesh.devices.size}-device global mesh)")
     graphs: dict = {}  # digest -> ShardedGraph (current only)
     buf = bytearray()
+    pending: deque = deque()  # decoded frames not yet dispatched
+
+    def next_msg() -> dict:
+        while not pending:
+            pending.extend(_recv_frames(sock, buf))
+        return pending.popleft()
 
     def ack(seq, phase, ok, **extra):
         sock.sendall(encode_frame(
             dict(extra, seq=seq, phase=phase, ok=ok)
         ))
 
+    def await_verdict(seq: int) -> bool:
+        """Park for the primary's commit/abort verdict on ``seq``
+        (module docstring): True on ``go``, False on ``abort``.
+        Verdicts for other seqs are stale (a late abort for a batch
+        this worker already refused) and skipped. Any OTHER descriptor
+        means the primary moved on without a verdict — impossible
+        under the single-writer discipline, but a control-plane bug
+        must degrade to a skipped batch, not a worker wedged inside a
+        collective: push it back and treat the solve as aborted."""
+        while True:
+            m = next_msg()
+            mop = m.get("op")
+            if mop in ("go", "abort"):
+                if int(m.get("for", -1)) == seq:
+                    return mop == "go"
+                continue
+            pending.appendleft(m)
+            return False
+
     try:
         while True:
             try:
-                frames = _recv_frames(sock, buf)
+                msg = next_msg()
             except (ConnectionError, ValueError):
                 return 0
-            for msg in frames:
-                op = msg.get("op")
-                seq = int(msg.get("seq", -1))
-                if op == "shutdown":
-                    ack(seq, "done", True)
+            op = msg.get("op")
+            seq = int(msg.get("seq", -1))
+            if op == "shutdown":
+                ack(seq, "done", True)
+                return 0
+            if op in ("go", "abort"):
+                # a verdict for a seq this worker already refused (or
+                # never joined): stale, skip
+                continue
+            if op == "graph":
+                nchunks = int(msg.get("chunks", 0))
+                parts, bad = [], None
+                for i in range(nchunks):
+                    try:
+                        m = next_msg()
+                    except (ConnectionError, ValueError):
+                        return 0
+                    if (m.get("op") != "graph_chunk"
+                            or int(m.get("for", -1)) != seq):
+                        bad = (f"expected graph_chunk {i} for seq "
+                               f"{seq}, got {m.get('op')!r}")
+                        pending.appendleft(m)
+                        break
+                    parts.append(m.get("pairs", ()))
+                if bad is not None:
+                    ack(seq, "done", False, error=bad)
+                    continue
+                try:
+                    digest, sg = _build_worker_graph(msg, parts, mesh)
+                except (KeyError, TypeError, ValueError) as e:
+                    ack(seq, "done", False, error=str(e))
+                    continue
+                graphs.clear()  # one served graph at a time
+                graphs[digest] = sg
+                ack(seq, "done", True, digest=digest)
+                say(f"[Pod] worker {process_index}: graph "
+                    f"{digest[:12]} n={sg.n}")
+                continue
+            if op == "solve":
+                sg = graphs.get(str(msg.get("digest")))
+                if sg is None:
+                    # refuse BEFORE the join ack: the primary aborts
+                    # on the host, nobody enters a collective short
+                    # one participant
+                    ack(seq, "join", False,
+                        error="unknown graph digest "
+                              f"{msg.get('digest')!r}")
+                    continue
+                try:
+                    padded = np.asarray(
+                        msg["pairs"], dtype=np.int64
+                    ).reshape(-1, 2)
+                    _p, dispatch = _sharded._batch_dispatch(
+                        sg, padded, str(msg.get("mode", "sync"))
+                    )
+                except (KeyError, TypeError, ValueError) as e:
+                    ack(seq, "join", False, error=str(e))
+                    continue
+                ack(seq, "join", True)
+                try:
+                    committed = await_verdict(seq)
+                except (ConnectionError, ValueError):
                     return 0
-                if op == "graph":
-                    try:
-                        digest, sg = _build_worker_graph(msg, mesh)
-                    except (KeyError, TypeError, ValueError) as e:
-                        ack(seq, "done", False, error=str(e))
-                        continue
-                    graphs.clear()  # one served graph at a time
-                    graphs[digest] = sg
-                    ack(seq, "done", True, digest=digest)
-                    say(f"[Pod] worker {process_index}: graph "
-                        f"{digest[:12]} n={sg.n}")
+                if not committed:
                     continue
-                if op == "solve":
-                    sg = graphs.get(str(msg.get("digest")))
-                    if sg is None:
-                        # refuse BEFORE the join ack: the primary
-                        # aborts on the host, nobody enters a
-                        # collective short one participant
-                        ack(seq, "join", False,
-                            error="unknown graph digest "
-                                  f"{msg.get('digest')!r}")
-                        continue
-                    try:
-                        padded = np.asarray(
-                            msg["pairs"], dtype=np.int64
-                        ).reshape(-1, 2)
-                        _p, dispatch = _sharded._batch_dispatch(
-                            sg, padded, str(msg.get("mode", "sync"))
-                        )
-                    except (KeyError, TypeError, ValueError) as e:
-                        ack(seq, "join", False, error=str(e))
-                        continue
-                    ack(seq, "join", True)
-                    out = dispatch()
-                    force_scalar(out)
-                    # best/meet are REPLICATED outputs: addressable on
-                    # this host (the sharded parent planes are not —
-                    # test_multihost.py documents the split)
-                    best = [int(b) for b in np.asarray(out[0])]
-                    ack(seq, "done", True, best=best)
-                    continue
-                ack(seq, "done", False, error=f"unknown op {op!r}")
+                out = dispatch()
+                force_scalar(out)
+                # best/meet are REPLICATED outputs: addressable on
+                # this host (the sharded parent planes are not —
+                # test_multihost.py documents the split)
+                best = [int(b) for b in np.asarray(out[0])]
+                ack(seq, "done", True, best=best)
+                continue
+            ack(seq, "done", False, error=f"unknown op {op!r}")
     finally:
         try:
             sock.close()

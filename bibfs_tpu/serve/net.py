@@ -35,11 +35,13 @@ the ticket cancelled so an unlaunched query never burns a solve.
 Requests without a deadline ride the engine's ``max_wait_ms`` flush SLO
 unchanged.
 
-**Admission.** Per-tenant token buckets (``quota_qps``/``quota_burst``,
-refused as ``capacity`` reason=quota) plus a server-wide in-flight
-bound (``max_inflight``, reason=capacity) sized to stay under the
-pipelined engine's blocking admission queue — the IO thread must never
-park inside ``engine.submit``, because it is the thread every other
+**Admission.** A server-wide in-flight bound (``max_inflight``,
+refused as ``capacity`` reason=capacity) checked first, then per-tenant
+token buckets (``quota_qps``/``quota_burst``, reason=quota) — in that
+order, so a request refused for capacity never burns the tenant's
+quota token. The in-flight bound is sized to stay under the pipelined
+engine's blocking admission queue: the IO thread must never park
+inside ``engine.submit``, because it is the thread every other
 connection's reads ride on.
 
 **Threads.** One selector-based IO thread owns the listener and every
@@ -166,12 +168,20 @@ class TokenBucket:
 
     def allow(self, now: float | None = None) -> bool:
         now = time.monotonic() if now is None else now
-        self.tokens = min(
-            self.burst, self.tokens + (now - self.stamp) * self.rate
-        )
-        self.stamp = now
-        if self.tokens >= 1.0:
-            self.tokens -= 1.0
+        # a caller-supplied ``now`` may predate the construction stamp
+        # (the server anchors it at frame arrival, the bucket is built
+        # later under the lock): elapsed clamps at zero so the burst
+        # is never silently shaved
+        elapsed = max(0.0, now - self.stamp)
+        self.tokens = min(self.burst, self.tokens + elapsed * self.rate)
+        self.stamp = max(self.stamp, now)
+        # the refill is computed as (now - stamp) * rate in floats: a
+        # token earned over an interval like 0.1s can land ~1e-11 shy
+        # of 1.0 depending on the magnitude of ``now``. An epsilon on
+        # the spend keeps "waited exactly one token's worth" admitted
+        # instead of rounding-refused
+        if self.tokens >= 1.0 - 1e-9:
+            self.tokens = max(0.0, self.tokens - 1.0)
             return True
         return False
 
@@ -354,10 +364,15 @@ class NetServer:
                     except OSError:
                         pass
                 else:
-                    if mask & selectors.EVENT_READ:
-                        self._read_ready(data)
-                    if mask & selectors.EVENT_WRITE:
-                        self._write_ready(data)
+                    try:
+                        if mask & selectors.EVENT_READ:
+                            self._read_ready(data)
+                        if mask & selectors.EVENT_WRITE:
+                            self._write_ready(data)
+                    except Exception:
+                        # the handlers contain their own faults; this
+                        # is the listener's last line of defense
+                        self._close_conn(data)
 
     def _wake(self) -> None:
         try:
@@ -419,7 +434,18 @@ class NetServer:
             self._flush_then_close(conn)
             return
         for raw in frames:
-            self._handle_frame(conn, raw)
+            try:
+                self._handle_frame(conn, raw)
+            except Exception as e:
+                # a handler bug costs this one connection, never the
+                # IO thread every other connection's reads ride on
+                self._enqueue(conn, {
+                    "id": None, "ok": False, "kind": "internal",
+                    "error": f"{type(e).__name__}: {e}; "
+                             "closing connection",
+                })
+                self._flush_then_close(conn)
+                return
 
     def _write_ready(self, conn: _Conn) -> None:
         with self._lock:
@@ -508,12 +534,38 @@ class NetServer:
             })
 
     def _handle_query(self, conn: _Conn, msg: dict, rid) -> None:
+        # the deadline SLO is measured from frame arrival (module
+        # docstring): anchor it here, before admission and submit
+        now = time.monotonic()
         tenant = str(msg.get("tenant") or "default")
+        # deadline_ms is client-controlled: it must parse BEFORE any
+        # admission state moves, so a junk value can neither burn a
+        # quota token nor leak the _submitting count
+        dl_ms = msg.get("deadline_ms", self._default_deadline_ms)
+        if dl_ms is not None:
+            try:
+                dl_ms = float(dl_ms)
+            except (TypeError, ValueError):
+                with self._lock:
+                    self._m_requests.labels(op="query").inc()
+                    self._m_rejects.labels(reason="malformed").inc()
+                self._enqueue(conn, {
+                    "id": rid, "ok": False, "kind": "invalid",
+                    "error": "deadline_ms must be a number, got "
+                             f"{msg.get('deadline_ms')!r}",
+                })
+                return
+        deadline = None if dl_ms is None else now + dl_ms / 1e3
         reason = None
         with self._lock:
             self._m_requests.labels(op="query").inc()
             if self._state != "serving":
                 reason = "draining"
+            elif (len(self._pending) + self._submitting
+                    >= self._max_inflight):
+                # the server-wide bound comes BEFORE the tenant bucket:
+                # a capacity refusal must not also cost a quota token
+                reason = "capacity"
             elif self._quota_qps is not None:
                 bucket = self._buckets.get(tenant)
                 if bucket is None:
@@ -521,11 +573,8 @@ class NetServer:
                         self._quota_qps, self._quota_burst
                     )
                     self._buckets[tenant] = bucket
-                if not bucket.allow():
+                if not bucket.allow(now):
                     reason = "quota"
-            if (reason is None and len(self._pending)
-                    + self._submitting >= self._max_inflight):
-                reason = "capacity"
             if reason is None:
                 self._submitting += 1
             else:
@@ -568,9 +617,6 @@ class NetServer:
                 "error": f"{e}",
             })
             return
-        now = time.monotonic()
-        dl_ms = msg.get("deadline_ms", self._default_deadline_ms)
-        deadline = None if dl_ms is None else now + float(dl_ms) / 1e3
         if ticket.result is not None or ticket.error is not None:
             # inline-resolved (cache/trivial/oracle): reply immediately
             # instead of waiting for the next completer wake

@@ -113,9 +113,20 @@ class PodMeshRoute(MeshRoute):
         seq = self._pod.post_solve(
             snap.digest, self.config.mode, padded, len(pairs)
         )
-        # the join barrier: every worker committed to the collective
-        # before the primary enters it (PodError here aborts on-host)
-        self._pod.await_phase(seq, "join", timeout=self._ack_timeout_s)
+        # the join barrier, phase 1: every worker validated the batch
+        # and parked for the verdict
+        try:
+            self._pod.await_phase(
+                seq, "join", timeout=self._ack_timeout_s
+            )
+        except PodError:
+            # phase 2, abort verdict: parked workers skip the
+            # collective instead of entering it short the primary
+            self._pod.abort_solve(seq)
+            raise
+        # phase 2, go verdict: only now does anyone enter the
+        # collective
+        self._pod.commit_solve(seq)
         self.engine.exec_cache.note(placement_bucket_key(
             rt.mesh_bucket_key, kind="mesh1d", shards=self.ndev,
             extra=(self.config.mode, rung),

@@ -222,6 +222,44 @@ def test_oversize_prefix_closes_connection(served):
         sock.close()
 
 
+def test_non_numeric_deadline_structured_server_survives(served):
+    """A well-framed query with a junk ``deadline_ms`` gets a
+    structured invalid reply, leaks no in-flight accounting, and the
+    server keeps serving — regression: the float() used to raise out
+    of the IO thread AFTER submit, killing the listener and leaking
+    ``_submitting``."""
+    _eng, server, client = served
+    sock = socket.create_connection((server.host, server.port))
+    try:
+        sock.settimeout(10.0)
+        buf = bytearray()
+
+        def roundtrip(frame):
+            sock.sendall(encode_frame(frame))
+            while True:
+                data = sock.recv(1 << 16)
+                assert data, "server closed the connection"
+                buf.extend(data)
+                frames = extract_frames(buf)
+                if frames:
+                    return json.loads(frames[0].decode())
+
+        for bad in ("abc", [5.0], {"ms": 5}):
+            reply = roundtrip({"op": "query", "id": 7, "src": 0,
+                               "dst": 399, "deadline_ms": bad})
+            assert reply["ok"] is False
+            assert reply["kind"] == "invalid"
+            assert "deadline_ms" in reply["error"]
+        # the offending connection still answers
+        assert roundtrip({"op": "ping", "id": 8})["ok"] is True
+        # no leaked in-flight slot, and the listener still accepts
+        assert server.pending_count() == 0
+        res = client.submit(0, 399).wait(timeout=30.0)
+        assert res.found
+    finally:
+        sock.close()
+
+
 # ---- admission ------------------------------------------------------
 
 def test_quota_greedy_refused_polite_untouched():
@@ -262,6 +300,31 @@ def test_inflight_capacity_refusal_structured():
         assert exc.value.kind == "capacity"
         assert "capacity" in str(exc.value)
         assert first.wait(timeout=30.0) is not None
+    finally:
+        client.close()
+        server.close()
+        eng.close()
+
+
+def test_capacity_refusal_spares_quota_token():
+    """The server-wide in-flight bound is checked BEFORE the tenant
+    bucket, so a capacity refusal does not also burn a quota token:
+    with burst 1 and a negligible refill rate, the tenant's single
+    token must still buy a query after the refusal."""
+    eng = PipelinedQueryEngine(N, EDGES, max_wait_ms=300.0)
+    server = NetServer(eng, max_inflight=1, quota_qps=0.001,
+                       quota_burst=1.0)
+    client = NetClient(server.host, server.port)
+    try:
+        first = client.submit(*_fresh_pair(), tenant="filler")
+        refused = client.submit(*_fresh_pair(), tenant="t")
+        with pytest.raises(QueryError) as exc:
+            refused.wait(timeout=30.0)
+        assert exc.value.kind == "capacity"
+        assert "capacity" in str(exc.value)
+        assert first.wait(timeout=30.0) is not None
+        ok = client.submit(*_fresh_pair(), tenant="t")
+        assert ok.wait(timeout=30.0) is not None
     finally:
         client.close()
         server.close()

@@ -1,0 +1,234 @@
+"""Pod control-plane protocol units (bibfs_tpu/parallel/podmesh.py)
+against scripted fake workers on plain sockets — no jax, no engine:
+the chunked graph broadcast past the 1 MiB frame bound, the two-phase
+(join -> go/abort) solve barrier, ack-mailbox hygiene on abandoned
+seqs, and PodError wrapping of descriptor encode failures. The real
+two-process loop is exercised end-to-end by tests/test_mesh_distributed.
+"""
+
+import json
+import socket
+import threading
+import time
+from collections import deque
+
+import numpy as np
+import pytest
+
+from bibfs_tpu.parallel.podmesh import (
+    GRAPH_CHUNK_EDGES,
+    PodError,
+    PodPrimary,
+)
+from bibfs_tpu.serve.net import MAX_FRAME_BYTES, encode_frame, extract_frames
+
+
+class _FakeWorker:
+    """A worker's control socket driven from the test: decoded-frame
+    reads and raw phase acks, no jax behind it."""
+
+    def __init__(self, port: int, process_index: int = 1):
+        self.sock = socket.create_connection(("127.0.0.1", port))
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self.buf = bytearray()
+        self.pending = deque()
+        self.sock.sendall(encode_frame(
+            {"op": "hello", "process": int(process_index)}
+        ))
+
+    def recv_msg(self, timeout: float = 10.0) -> dict:
+        self.sock.settimeout(timeout)
+        while not self.pending:
+            data = self.sock.recv(1 << 16)
+            assert data, "primary closed the control connection"
+            self.buf += data
+            for raw in extract_frames(self.buf):
+                self.pending.append(json.loads(raw.decode()))
+        return self.pending.popleft()
+
+    def ack(self, seq, phase, ok=True, **extra):
+        self.sock.sendall(encode_frame(
+            dict(extra, seq=seq, phase=phase, ok=ok)
+        ))
+
+    def close(self):
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class _Snap:
+    """The four snapshot attributes post_graph/ensure_graph read."""
+
+    def __init__(self, n, pairs, digest, version=1):
+        self.n = n
+        self.pairs = pairs
+        self.digest = digest
+        self.version = version
+
+
+def _pod(num_workers: int):
+    primary = PodPrimary(num_workers, host="127.0.0.1")
+    workers = [_FakeWorker(primary.port, i + 1)
+               for i in range(num_workers)]
+    primary.accept_workers()
+    return primary, workers
+
+
+def test_graph_broadcast_chunked_past_frame_bound():
+    """A graph whose pairs exceed the 1 MiB frame bound as one JSON
+    frame arrives as a header + graph_chunk stream that reassembles
+    bit-exactly — regression: ensure_graph used to ship the whole
+    array in ONE frame and raise a raw ValueError for any realistic
+    graph."""
+    rng = np.random.default_rng(7)
+    pairs = rng.integers(10**11, 10**12, size=(3 * GRAPH_CHUNK_EDGES
+                                               + 123, 2), dtype=np.int64)
+    assert len(json.dumps(pairs.ravel().tolist())) > MAX_FRAME_BYTES
+    snap = _Snap(n=10**12, pairs=pairs, digest="d" * 16, version=3)
+    primary, (fw,) = _pod(1)
+    got = {}
+
+    def worker_main():
+        header = fw.recv_msg()
+        flat = []
+        for i in range(header["chunks"]):
+            c = fw.recv_msg()
+            assert c["op"] == "graph_chunk"
+            assert c["for"] == header["seq"]
+            assert c["i"] == i
+            flat.extend(c["pairs"])
+        got["header"] = header
+        got["flat"] = flat
+        fw.ack(header["seq"], "done", True, digest=header["digest"])
+
+    t = threading.Thread(target=worker_main, daemon=True)
+    try:
+        t.start()
+        out = primary.ensure_graph(snap, build=lambda: "built",
+                                   timeout=30.0)
+        assert out == "built"
+        t.join(timeout=10.0)
+        assert not t.is_alive()
+        assert got["header"]["op"] == "graph"
+        assert got["header"]["digest"] == snap.digest
+        assert got["header"]["chunks"] == 4
+        assert got["flat"] == pairs.ravel().tolist()
+        # the digest memo: a second ensure_graph with the same digest
+        # must return from build() without posting (it would otherwise
+        # block on an ack nobody sends)
+        assert primary.ensure_graph(snap, build=lambda: "again",
+                                    timeout=1.0) == "again"
+    finally:
+        fw.close()
+        primary.close()
+
+
+def test_solve_join_then_go_verdict():
+    """The happy-path two-phase barrier: join ack -> go verdict keyed
+    to the solve's seq."""
+    primary, (fw,) = _pod(1)
+    try:
+        padded = np.zeros((4, 2), dtype=np.int64)
+        seq = primary.post_solve("d" * 16, "sync", padded, 4)
+        msg = fw.recv_msg()
+        assert msg["op"] == "solve" and msg["seq"] == seq
+        fw.ack(seq, "join", True)
+        primary.await_phase(seq, "join", timeout=10.0)
+        primary.commit_solve(seq)
+        verdict = fw.recv_msg()
+        assert verdict["op"] == "go"
+        assert verdict["for"] == seq
+    finally:
+        fw.close()
+        primary.close()
+
+
+def test_refused_join_aborts_parked_workers():
+    """One worker refuses the join: the primary's await raises
+    PodError, and abort_solve releases the worker that DID join —
+    regression: it used to stay parked and enter (or starve before)
+    the collective with the primary absent."""
+    primary, (fw1, fw2) = _pod(2)
+    try:
+        padded = np.zeros((4, 2), dtype=np.int64)
+        seq = primary.post_solve("d" * 16, "sync", padded, 4)
+        assert fw1.recv_msg()["op"] == "solve"
+        assert fw2.recv_msg()["op"] == "solve"
+        fw1.ack(seq, "join", False, error="digest mismatch")
+        fw2.ack(seq, "join", True)
+        with pytest.raises(PodError, match="digest mismatch"):
+            primary.await_phase(seq, "join", timeout=10.0)
+        primary.abort_solve(seq)
+        for fw in (fw1, fw2):
+            verdict = fw.recv_msg()
+            assert verdict["op"] == "abort"
+            assert verdict["for"] == seq
+    finally:
+        fw1.close()
+        fw2.close()
+        primary.close()
+
+
+def test_join_timeout_aborts_and_leaves_no_ack_residue():
+    """A worker that never acks times the join barrier out: PodError,
+    an abort on the wire for the workers that did ack, and the
+    abandoned seq's partial ack dict popped from the mailbox."""
+    primary, (fw1, fw2) = _pod(2)
+    try:
+        padded = np.zeros((4, 2), dtype=np.int64)
+        seq = primary.post_solve("d" * 16, "sync", padded, 4)
+        fw1.ack(seq, "join", True)  # fw2 stays silent
+        with pytest.raises(PodError, match="1/2"):
+            primary.await_phase(seq, "join", timeout=0.4)
+        with primary._lock:
+            assert (seq, "join") not in primary._acks
+        primary.abort_solve(seq)
+        # both workers are still considered alive and get the verdict
+        fw1.recv_msg()  # the solve descriptor
+        verdict = fw1.recv_msg()
+        assert verdict["op"] == "abort" and verdict["for"] == seq
+    finally:
+        fw1.close()
+        fw2.close()
+        primary.close()
+
+
+def test_reader_sweeps_stale_acks():
+    """An ack that straggles in long after its seq was abandoned is
+    swept once the live seq has moved far enough past it — the mailbox
+    stays bounded under repeated degraded launches."""
+    primary, (fw,) = _pod(1)
+    try:
+        with primary._lock:
+            primary._acks[(1, "join")] = {1: {"ok": True}}
+            primary._seq = 100
+        fw.ack(99, "done", True)
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            with primary._lock:
+                if (99, "done") in primary._acks:
+                    break
+            time.sleep(0.01)
+        with primary._lock:
+            assert (99, "done") in primary._acks
+            assert (1, "join") not in primary._acks
+    finally:
+        fw.close()
+        primary.close()
+
+
+def test_oversize_descriptor_raises_poderror():
+    """A descriptor that cannot fit one frame fails as PodError (the
+    type the engine's resilience ladder catches), not a raw
+    ValueError out of the flusher thread."""
+    primary, (fw,) = _pod(1)
+    try:
+        huge = np.full((MAX_FRAME_BYTES // 8, 2), 10**15,
+                       dtype=np.int64)
+        with pytest.raises(PodError, match="encode"):
+            primary.post_solve("d" * 16, "sync", huge, len(huge))
+    finally:
+        fw.close()
+        primary.close()
