@@ -43,6 +43,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 
 
@@ -61,6 +62,49 @@ def _print_result(t, no_path: bool) -> None:
     else:
         line = f"{t.src} -> {t.dst}: no path"
     print(line)
+
+
+def _relabel_metrics(text: str, replica: str) -> str:
+    """Inject ``replica="name"`` as the first label of every sample
+    line in a child replica's Prometheus text (comment lines dropped —
+    the local registry already declared the families)."""
+    out = []
+    tag = f'replica="{replica}"'
+    for ln in text.splitlines():
+        if not ln or ln.startswith("#"):
+            continue
+        name, sep, rest = ln.partition("{")
+        if sep:
+            out.append(f"{name}{{{tag},{rest}")
+        else:
+            fam, _, val = ln.partition(" ")
+            out.append(f"{fam}{{{tag}}} {val}")
+    return "\n".join(out) + ("\n" if out else "")
+
+
+class _FleetScrape:
+    """The aggregated fleet scrape behind ``--metrics-port``: the
+    router process's own registry plus every out-of-process replica's
+    registry (fetched over its control surface at scrape time), each
+    sample re-labelled with its replica name. Duck-types the registry
+    interface the metrics server renders (``render()``)."""
+
+    def __init__(self):
+        self.router = None  # set once the Router is built
+
+    def render(self) -> str:
+        from bibfs_tpu.obs.metrics import REGISTRY
+
+        parts = [REGISTRY.render()]
+        if self.router is not None:
+            try:
+                snap = self.router.metrics_snapshot()
+            except Exception:
+                snap = {}
+            for name in sorted(snap):
+                if snap[name]:
+                    parts.append(_relabel_metrics(snap[name], name))
+        return "".join(parts)
 
 
 def _replicas_listing(router) -> str:
@@ -128,6 +172,19 @@ def main(argv=None):
         help="serve /metrics (fleet families included) and /healthz "
         "over HTTP; PORT 0 binds an ephemeral port",
     )
+    ap.add_argument(
+        "--trace-spool", default=None, metavar="DIR",
+        help="distributed tracing: spool this process's spans to "
+        "DIR/fleet.<pid>.jsonl; ProcessReplica children inherit the "
+        "env knob and spool alongside (merge with 'bibfs-trace merge "
+        "DIR'). Equivalent to BIBFS_TRACE_SPOOL",
+    )
+    ap.add_argument(
+        "--trace-sample", type=float, default=None, metavar="RATE",
+        help="fraction of router-ingress queries to sample into the "
+        "distributed trace spool (default 1.0 when --trace-spool is "
+        "set). Equivalent to BIBFS_TRACE_SAMPLE",
+    )
     ap.add_argument("--stats-json", default=None, metavar="FILE",
                     help="write the router stats to FILE as JSON on "
                     "exit")
@@ -136,6 +193,16 @@ def main(argv=None):
     from bibfs_tpu.utils.platform import apply_platform_env
 
     apply_platform_env()
+
+    # the trace flags set the env knobs install_from_env (and every
+    # spawned replica, which inherits os.environ) reads — one config
+    # surface whether tracing came from the CLI or the environment
+    from bibfs_tpu.obs import dtrace
+
+    if args.trace_spool is not None:
+        os.environ[dtrace.ENV_SPOOL] = args.trace_spool
+    if args.trace_sample is not None:
+        os.environ[dtrace.ENV_SAMPLE] = str(args.trace_sample)
 
     if (args.graph is None) == (args.store is None):
         print("Error: pass a .bin graph OR --store DIR", file=sys.stderr)
@@ -164,8 +231,6 @@ def main(argv=None):
                 ))
         else:
             if args.store is not None:
-                import os
-
                 from bibfs_tpu.graph.io import read_graph_bin
                 from bibfs_tpu.store import GraphStore
 
@@ -194,7 +259,6 @@ def main(argv=None):
                 from bibfs_tpu.store import GraphStore
 
                 n, edges = read_graph_bin(args.graph)
-                import os
 
                 stem = os.path.splitext(
                     os.path.basename(args.graph)
@@ -223,22 +287,34 @@ def main(argv=None):
                 pass
         return 2
 
+    # per-process distributed-trace spool (BIBFS_TRACE_SPOOL): the
+    # router is a trace ingress — sampled queries carry their context
+    # onto whichever replica wire protocol serves them
+    from bibfs_tpu.obs import dtrace
+
+    dtracer = dtrace.install_from_env("fleet")
+
     metrics_server = None
+    scrape = _FleetScrape()
     if args.metrics_port is not None:
         from bibfs_tpu.obs.http import start_metrics_server
 
         try:
-            metrics_server = start_metrics_server(args.metrics_port)
+            metrics_server = start_metrics_server(
+                args.metrics_port, registry=scrape
+            )
         except OSError as e:
             print(f"Error: cannot bind metrics port: {e}",
                   file=sys.stderr)
             for r in replicas:
                 r.close()
             return 2
-        print(f"[Obs] serving /metrics on {metrics_server.url}",
+        print(f"[Obs] serving /metrics on {metrics_server.url} "
+              "(fleet-aggregated: replica-labelled child registries)",
               file=sys.stderr, flush=True)
 
     router = Router(replicas, spill_after=args.spill_after)
+    scrape.router = router
     print(
         "[Fleet] {k} replica(s): {names}".format(
             k=len(replicas),
@@ -454,6 +530,9 @@ def main(argv=None):
         router.close()
         if metrics_server is not None:
             metrics_server.close()
+        if dtracer is not None:
+            dtrace.set_dtracer(None)
+            dtracer.close()
         # restore only on the EOF path (in-process embedders get their
         # handler back once the drain is done); a SIGNAL-initiated
         # drain keeps ignoring repeats until the process exits — a

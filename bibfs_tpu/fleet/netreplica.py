@@ -161,7 +161,8 @@ class NetReplica:
             return (not self._dead and client is not None
                     and client.alive and self._proc.poll() is None)
 
-    def submit(self, src: int, dst: int, graph: str | None = None):
+    def submit(self, src: int, dst: int, graph: str | None = None,
+               ctx=None):
         src, dst = int(src), int(dst)
         if self._draining:  # fast refusal outside the lock
             raise QueryError(
@@ -170,7 +171,9 @@ class NetReplica:
             )
         client = self._require_client()
         try:
-            return client.submit(src, dst, graph)
+            # the router's sampled trace context rides the query frame
+            # (NetClient stamps the trace/span fields)
+            return client.submit(src, dst, graph, ctx=ctx)
         except ConnectionError as e:
             raise ReplicaDead(
                 f"replica {self.name} connection lost: {e}"
@@ -229,6 +232,22 @@ class NetReplica:
             return self._request("memory", timeout)
         except QueryError as e:
             raise ValueError(f"replica {self.name}: {e}") from e
+
+    def metrics_render(self, timeout: float | None = None) -> str:
+        """The child's Prometheus text exposition over the framed
+        ``metrics`` op — the fleet's aggregated /metrics re-labels and
+        re-exposes it (same contract as ProcessReplica)."""
+        out = self._request("metrics", timeout)
+        return out.get("render", "") if isinstance(out, dict) else ""
+
+    def flightrec(self, dump: bool = False,
+                  timeout: float | None = None) -> dict:
+        """The child's flight-recorder ring over the framed
+        ``flightrec`` op (``dump=True`` also writes its
+        ``.flightrec.json`` server-side)."""
+        return self._request(
+            "flightrec", timeout, **({"dump": True} if dump else {})
+        )
 
     def version(self, graph: str | None = None) -> int | None:
         out = self._request(

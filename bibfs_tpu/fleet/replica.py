@@ -42,6 +42,7 @@ import time
 from collections import deque
 
 from bibfs_tpu.analysis import guarded_by
+from bibfs_tpu.obs.dtrace import ctx_token
 from bibfs_tpu.serve.engine import QueryEngine
 from bibfs_tpu.serve.pipeline import PipelinedQueryEngine
 from bibfs_tpu.serve.resilience import ERROR_KINDS, QueryError
@@ -101,13 +102,16 @@ class EngineReplica:
     def alive(self) -> bool:
         return not self._dead and self._engine is not None
 
-    def submit(self, src: int, dst: int, graph: str | None = None):
+    def submit(self, src: int, dst: int, graph: str | None = None,
+               ctx=None):
         """Queue one query; returns the engine's ticket. Fast-fails
         BEFORE the replica lock: a draining replica answers capacity
         (retryable on a peer) and a dead one raises
         :class:`ReplicaDead` — neither may block behind a drain's
         in-flight flush, or the router's re-route would stall on
-        exactly the replica it is routing around."""
+        exactly the replica it is routing around. ``ctx`` is the
+        router's sampled trace context, threaded into the engine
+        ticket (None, the common case, costs nothing)."""
         if self._dead:
             raise ReplicaDead(f"replica {self.name} is dead")
         if self._draining:
@@ -119,7 +123,7 @@ class EngineReplica:
             eng = self._engine
             if self._dead or eng is None:
                 raise ReplicaDead(f"replica {self.name} is dead")
-            return eng.submit(src, dst, graph)
+            return eng.submit(src, dst, graph, ctx=ctx)
 
     def wait_ticket(self, ticket, timeout: float | None = None):
         """Resolve one of this replica's tickets: the pipelined ticket
@@ -344,7 +348,7 @@ class _Reply:
 #: swap reply contains " -> " too, so prefixes are checked FIRST)
 _CONTROL_PREFIXES = (
     "health ", "stats ", "memory ", "use ", "swap ", "update ",
-    "graphs:", "oracle",
+    "graphs:", "oracle", "flightrec ",
 )
 
 
@@ -592,7 +596,8 @@ class ProcessReplica:
                 )
             t.event.set()
 
-    def submit(self, src: int, dst: int, graph: str | None = None):
+    def submit(self, src: int, dst: int, graph: str | None = None,
+               ctx=None):
         src, dst = int(src), int(dst)
         if self._draining:  # fast refusal outside the lock
             raise QueryError(
@@ -640,7 +645,13 @@ class ProcessReplica:
                 self._current_graph = graph
             self._pending.append(t)
             try:
-                self._write(f"{src} {dst}")
+                # sampled queries carry their trace context as the
+                # line protocol's trailing '@t:' token — the child's
+                # REPL adopts it instead of sampling its own
+                if ctx is not None:
+                    self._write(f"{src} {dst} {ctx_token(ctx)}")
+                else:
+                    self._write(f"{src} {dst}")
             except ReplicaDead:
                 self._pending.remove(t)
                 raise
@@ -751,6 +762,24 @@ class ProcessReplica:
                 f"replica {self.name}: bad stats reply {line!r}"
             )
         return json.loads(line[len("stats "):])
+
+    def metrics_render(self, timeout: float | None = None) -> str:
+        """The child's Prometheus text exposition (it rides the stats
+        reply — a subprocess replica has no HTTP port of its own).
+        The fleet's aggregated /metrics re-labels and re-exposes it."""
+        return self.stats(timeout).get("metrics_render", "")
+
+    def flightrec(self, dump: bool = False,
+                  timeout: float | None = None) -> dict:
+        """The child's flight-recorder ring (``dump=True`` also writes
+        its ``.flightrec.json`` next to the trace spool)."""
+        cmd = "flightrec dump" if dump else "flightrec"
+        line = self._command(cmd, timeout or 60.0)
+        if not line.startswith("flightrec "):
+            raise ValueError(
+                f"replica {self.name}: bad flightrec reply {line!r}"
+            )
+        return json.loads(line[len("flightrec "):])
 
     def memory(self, timeout: float | None = None) -> dict:
         """The child's ``memory`` control reply: per-graph tier, mapped

@@ -64,6 +64,7 @@ import time
 
 from bibfs_tpu.analysis import guarded_by
 from bibfs_tpu.fleet.replica import ReplicaDead
+from bibfs_tpu.obs.dtrace import FLIGHT, dspan, sample_ctx
 from bibfs_tpu.obs.metrics import REGISTRY, next_instance_label
 from bibfs_tpu.obs.trace import span
 from bibfs_tpu.serve.resilience import (
@@ -113,9 +114,10 @@ class FleetTicket:
 
     __slots__ = ("src", "dst", "graph", "replica", "declared_version",
                  "attempts", "tried", "result", "error", "_router",
-                 "_inner")
+                 "_inner", "ctx")
 
-    def __init__(self, router, src: int, dst: int, graph: str | None):
+    def __init__(self, router, src: int, dst: int, graph: str | None,
+                 ctx=None):
         self.src = src
         self.dst = dst
         self.graph = graph
@@ -127,6 +129,7 @@ class FleetTicket:
         self.error: BaseException | None = None
         self._router = router
         self._inner = None
+        self.ctx = ctx  # sampled trace context (None = unsampled)
 
     def done(self) -> bool:
         return self.result is not None or self.error is not None
@@ -315,13 +318,18 @@ class Router:
     def replica_names(self) -> list:
         return list(self._order)
 
-    def submit(self, src: int, dst: int,
-               graph: str | None = None) -> FleetTicket:
+    def submit(self, src: int, dst: int, graph: str | None = None,
+               ctx=None) -> FleetTicket:
         """Route one query (hash + health + spill) and return its
         :class:`FleetTicket`. Submit-time replica refusals fail over
         immediately; client-invalid input raises ``ValueError`` to the
-        caller unrerouted."""
-        ticket = FleetTicket(self, int(src), int(dst), graph)
+        caller unrerouted. The router is a trace ingress: with no
+        upstream ``ctx``, the sampler may mint one here, and the
+        context then rides the replica's wire protocol (stdin token /
+        net frame fields) into the serving process."""
+        if ctx is None:
+            ctx = sample_ctx()
+        ticket = FleetTicket(self, int(src), int(dst), graph, ctx)
         self._dispatch(ticket)
         return ticket
 
@@ -383,8 +391,20 @@ class Router:
             # attribute a v_k answer to v_k+1
             version = self._version_of(name, ticket.graph)
             try:
-                inner = replica.submit(ticket.src, ticket.dst,
-                                       ticket.graph)
+                if ticket.ctx is not None:
+                    sp = dspan("route", ticket.ctx, replica=name,
+                               reroute=is_reroute)
+                    with sp:
+                        inner = replica.submit(ticket.src, ticket.dst,
+                                               ticket.graph, ctx=sp.ctx)
+                    FLIGHT.note(
+                        "route", trace=ticket.ctx.trace_id,
+                        replica=name, reroute=is_reroute,
+                        version=version,
+                    )
+                else:
+                    inner = replica.submit(ticket.src, ticket.dst,
+                                           ticket.graph)
             except (ValueError, TypeError):
                 raise  # client-invalid: the caller's problem, no peer
                 # can answer an out-of-range id differently
@@ -776,6 +796,26 @@ class Router:
             "spill_after": self.spill_after,
             "poll_interval_s": self.poll_interval_s,
         }
+
+    def metrics_snapshot(self) -> dict:
+        """Per-replica Prometheus text — the fleet-wide scrape's raw
+        material. Out-of-process replicas (subprocess REPL, net child)
+        answer over their control surface; in-process EngineReplicas
+        mint into THIS process's registry already and return None (the
+        aggregator must not double-count them). A dead replica's entry
+        is None too — a scrape never fails because one replica is
+        down."""
+        out: dict = {}
+        for name in self._order:
+            fn = getattr(self._replicas[name], "metrics_render", None)
+            if fn is None:
+                out[name] = None
+                continue
+            try:
+                out[name] = fn()
+            except Exception:
+                out[name] = None
+        return out
 
     def close(self, close_replicas: bool = True) -> None:
         if self._closed:

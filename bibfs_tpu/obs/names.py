@@ -154,6 +154,18 @@ NET_METRIC_FAMILIES = (
     "bibfs_net_deadline_misses_total",
 )
 
+#: distributed tracing + per-query cost attribution (obs/dtrace.py):
+#: the span-spool counter mints at DTracer construction, the
+#: flight-recorder dump counter at module import (process-singleton
+#: recorder), and the stage histogram at engine / front-door
+#: construction via ``dtrace.stage_histogram()`` — all render at zero
+#: before the first sampled query
+DTRACE_METRIC_FAMILIES = (
+    "bibfs_stage_seconds",
+    "bibfs_trace_spans_total",
+    "bibfs_flightrec_dumps_total",
+)
+
 #: build identity (obs/metrics.py; minted at every registry init)
 BUILD_INFO_METRIC = "bibfs_build_info"
 
@@ -185,6 +197,7 @@ ALL_METRIC_NAMES = frozenset(
     + ADAPTIVE_METRIC_FAMILIES
     + QUERY_METRIC_FAMILIES
     + NET_METRIC_FAMILIES
+    + DTRACE_METRIC_FAMILIES
     + _FLEET_ONLY
     + (BUILD_INFO_METRIC,)
 )
@@ -195,6 +208,7 @@ ALL_METRIC_NAMES = frozenset(
 HISTOGRAM_METRIC_NAMES = frozenset((
     "bibfs_query_latency_seconds",
     "bibfs_level_frontier_fraction",
+    "bibfs_stage_seconds",
 ))
 
 #: ``bibfs_``-prefixed tokens that are NOT metric names (package paths,
@@ -218,6 +232,9 @@ SERVE_ENDPOINT_METRICS = (
     "bibfs_flushes_total",
     "bibfs_query_latency_seconds",
     "bibfs_serve_queue_depth",
+    # per-query cost attribution: pre-labeled at engine construction,
+    # so a live /metrics renders every stage cell at zero
+    "bibfs_stage_seconds",
 )
 
 

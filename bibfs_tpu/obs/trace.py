@@ -143,15 +143,22 @@ class Tracer:
             return list(self._events)
 
     def save(self, path: str) -> int:
-        """Write the Chrome-trace JSON array, one event per line.
-        Returns the number of events written."""
+        """Write the Chrome-trace JSON array, one event per line,
+        committed atomically (same-dir tmp + fsync + rename — a crash
+        mid-save leaves the previous trace, never a torn one). Returns
+        the number of events written."""
+        from bibfs_tpu.graph.io import _atomic_replace
+
         evs = self.events()
-        with open(path, "w") as f:
+
+        def _payload(f):
             f.write("[\n")
             for i, ev in enumerate(evs):
                 comma = "," if i < len(evs) - 1 else ""
                 f.write(json.dumps(ev, separators=(",", ":")) + comma + "\n")
             f.write("]\n")
+
+        _atomic_replace(path, _payload, mode="w")
         return len(evs)
 
 

@@ -78,6 +78,7 @@ from collections import deque
 import numpy as np
 
 from bibfs_tpu.analysis import guarded_by
+from bibfs_tpu.obs.dtrace import ctx_fields, ctx_from_fields, dspan
 from bibfs_tpu.serve.net import MAX_FRAME_BYTES, encode_frame, extract_frames
 
 #: default pod control port offset from the jax.distributed coordinator
@@ -332,17 +333,29 @@ class PodPrimary:
         return seq
 
     def post_solve(self, digest: str, mode: str, padded,
-                   count: int) -> int:
+                   count: int, ctx=None) -> int:
         """Broadcast one padded solve batch; returns its seq. The
         caller awaits ``join`` before entering the collective and
-        ``done`` (with per-worker ``best``) in finish."""
-        return self._post({
+        ``done`` (with per-worker ``best``) in finish. ``ctx`` is a
+        sampled query's trace context: the broadcast span parents
+        every worker's ``pod_worker_solve`` span, and the descriptor
+        carries the context fields across the process boundary."""
+        desc = {
             "op": "solve",
             "digest": digest,
             "mode": mode,
             "count": int(count),
             "pairs": np.asarray(padded, dtype=np.int64).ravel().tolist(),
-        })
+        }
+        if ctx is None:
+            return self._post(desc)
+        sp = dspan("pod_broadcast", ctx, count=int(count),
+                   workers=self.num_workers)
+        desc.update(ctx_fields(sp.ctx))
+        try:
+            return self._post(desc)
+        finally:
+            sp.finish()
 
     def commit_solve(self, seq: int) -> None:
         """Broadcast the ``go`` verdict for ``seq``: every worker
@@ -580,8 +593,14 @@ def run_pod_worker(host: str, port: int, *, process_index: int,
                     return 0
                 if not committed:
                     continue
-                out = dispatch()
-                force_scalar(out)
+                # sampled queries carry their trace context on the
+                # descriptor: this worker's solve span lands in ITS
+                # spool, parented by the primary's pod_broadcast span
+                with dspan("pod_worker_solve", ctx_from_fields(msg),
+                           worker=int(process_index),
+                           count=int(msg.get("count", 0))):
+                    out = dispatch()
+                    force_scalar(out)
                 # best/meet are REPLICATED outputs: addressable on
                 # this host (the sharded parent planes are not —
                 # test_multihost.py documents the split)

@@ -87,6 +87,13 @@ def _control_reply(engine, store, cmd: str) -> str:
         payload = engine.stats()
         if store is not None:
             payload["store"] = store.stats()
+        # the fleet metrics aggregator's scrape path: a subprocess
+        # replica has no HTTP port of its own, so the Prometheus text
+        # rides the stats reply and the router re-exposes it with a
+        # replica label (fleet/cli.py)
+        from bibfs_tpu.obs.metrics import REGISTRY
+
+        payload["metrics_render"] = REGISTRY.render()
     return cmd + " " + json.dumps(
         payload, sort_keys=True, default=str, separators=(",", ":")
     )
@@ -574,6 +581,27 @@ def main(argv=None):
         "chrome://tracing (bibfs_tpu/obs/trace)",
     )
     ap.add_argument(
+        "--trace-spool",
+        default=None,
+        metavar="DIR",
+        help="distributed tracing: append this process's spans to "
+        "DIR/<proc>.<pid>.jsonl (crash-tolerant line spool; merge the "
+        "fleet's spools with 'bibfs-trace merge DIR'). Queries sampled "
+        "at ingress carry their trace context across the net frames, "
+        "the stdin line protocol, and the pod control plane "
+        "(bibfs_tpu/obs/dtrace). Equivalent to BIBFS_TRACE_SPOOL",
+    )
+    ap.add_argument(
+        "--trace-sample",
+        type=float,
+        default=None,
+        metavar="RATE",
+        help="fraction of ingress queries to sample into the "
+        "distributed trace spool (default 1.0 when --trace-spool is "
+        "set; 0 disables sampling but keeps propagating contexts "
+        "minted upstream). Equivalent to BIBFS_TRACE_SAMPLE",
+    )
+    ap.add_argument(
         "--stats-json",
         default=None,
         metavar="FILE",
@@ -588,6 +616,17 @@ def main(argv=None):
     from bibfs_tpu.utils.platform import apply_platform_env
 
     apply_platform_env()
+    # the distributed-trace flags just set the env knobs the installer
+    # (and any child process we describe work to) reads — one config
+    # surface whether tracing came from the CLI or the environment
+    import os as _os
+
+    from bibfs_tpu.obs import dtrace
+
+    if args.trace_spool is not None:
+        _os.environ[dtrace.ENV_SPOOL] = args.trace_spool
+    if args.trace_sample is not None:
+        _os.environ[dtrace.ENV_SAMPLE] = str(args.trace_sample)
     podctx = None
     if args.coordinator is not None:
         # must run before anything touches a backend (jax requirement);
@@ -615,10 +654,21 @@ def main(argv=None):
             from bibfs_tpu.parallel.podmesh import run_pod_worker
 
             host, port = _pod_control_addr(args)
-            return run_pod_worker(
-                host, port, process_index=podctx.process_index,
-                log=lambda m: print(m, file=sys.stderr, flush=True),
+            # each worker spools its own spans: a sampled query's pod
+            # broadcast shows up as pod_worker_solve spans in every
+            # worker process of the merged trace
+            dtracer = dtrace.install_from_env(
+                f"podworker{podctx.process_index}"
             )
+            try:
+                return run_pod_worker(
+                    host, port, process_index=podctx.process_index,
+                    log=lambda m: print(m, file=sys.stderr, flush=True),
+                )
+            finally:
+                if dtracer is not None:
+                    dtrace.set_dtracer(None)
+                    dtracer.close()
     if args.port is not None:
         if not args.pipeline:
             print("Error: --port needs --pipeline (the background "
@@ -714,6 +764,9 @@ def main(argv=None):
 
         tracer = Tracer()
         set_tracer(tracer)
+    # the distributed-trace spool (per-process span log + flight
+    # recorder dump path); None unless --trace-spool/BIBFS_TRACE_SPOOL
+    dtracer = dtrace.install_from_env("serve")
 
     try:
         if args.load is not None:
@@ -732,6 +785,9 @@ def main(argv=None):
             # turn a completed run into a traceback (or skip the
             # metrics-server teardown below) — the helper reports it
             uninstall_and_save(tracer, args.trace)
+        if dtracer is not None:
+            dtrace.set_dtracer(None)
+            dtracer.close()
         if metrics_server is not None:
             metrics_server.close()
 
@@ -867,6 +923,14 @@ def _serve(args, n, edges, store, QueryEngine, PipelinedQueryEngine,
     except (KeyError, ValueError) as e:
         print(f"Error: {e}", file=sys.stderr)
         return 2
+    from bibfs_tpu.obs.dtrace import get_dtracer
+
+    _dt = get_dtracer()
+    if _dt is not None and engine._faults is not None:
+        # arm the trace_flush chaos seam: spool appends now fire the
+        # engine's fault plan before writing (a failed flush drops the
+        # span, never the query)
+        _dt.faults = engine._faults
     if metrics_server is not None:
         # /healthz answers from the live engine from here on (the
         # standalone 'ok' covered the construction window)
@@ -967,6 +1031,10 @@ def _serve(args, n, edges, store, QueryEngine, PipelinedQueryEngine,
                     pass
                 raise _SigTerm()
 
+            from bibfs_tpu.obs.dtrace import (
+                TOKEN_PREFIX, dspan, parse_token, sample_ctx,
+            )
+
             prev_handler = None
             sigterm = False
             try:
@@ -997,6 +1065,29 @@ def _serve(args, n, edges, store, QueryEngine, PipelinedQueryEngine,
                         drain()
                         print(_control_reply(engine, store, parts[0]))
                         continue
+                    if parts[0] == "flightrec":
+                        # the always-on post-mortem ring: dump it on
+                        # demand (same surface the net front door's
+                        # flightrec op exposes)
+                        from bibfs_tpu.obs.dtrace import FLIGHT
+
+                        if len(parts) == 2 and parts[1] == "dump":
+                            snap = FLIGHT.snapshot()
+                            snap["dumped_to"] = FLIGHT.dump(
+                                reason="demand"
+                            )
+                        elif len(parts) == 1:
+                            snap = FLIGHT.snapshot()
+                        else:
+                            print("error invalid: usage: "
+                                  "flightrec [dump]")
+                            continue
+                        drain()
+                        print("flightrec " + json.dumps(
+                            snap, sort_keys=True, default=str,
+                            separators=(",", ":"),
+                        ))
+                        continue
                     if parts[0] in _STORE_COMMANDS:
                         if store is None:
                             print(f"error invalid: {parts[0]!r} needs "
@@ -1025,6 +1116,15 @@ def _serve(args, n, edges, store, QueryEngine, PipelinedQueryEngine,
                         )
                         print(reply)
                         continue
+                    # a trailing '@t:TRACE:SPAN' token is the stdin
+                    # protocol's trace-context carrier (the fleet
+                    # router appends it to sampled queries); a bare
+                    # 'src dst' line may still get sampled HERE when
+                    # this process is the ingress
+                    ctx = None
+                    if len(parts) == 3 and parts[2].startswith(
+                            TOKEN_PREFIX):
+                        ctx = parse_token(parts.pop())
                     if len(parts) != 2:
                         print("error invalid: expected 'src dst', got "
                               f"{line.strip()!r}")
@@ -1035,19 +1135,28 @@ def _serve(args, n, edges, store, QueryEngine, PipelinedQueryEngine,
                         print("error invalid: non-integer node id in "
                               f"{line.strip()!r}")
                         continue
+                    if ctx is None:
+                        ctx = sample_ctx()
+                    sp = dspan("repl_ingress", ctx, src=src, dst=dst)
                     try:
-                        tickets.append(engine.submit(src, dst, current))
+                        tickets.append(
+                            engine.submit(src, dst, current, ctx=sp.ctx)
+                        )
+                        sp.finish()
                     except QueryError as e:
                         # a draining engine refuses admissions with a
                         # structured capacity error: answer it in-stream
                         # (retryable on a peer replica) and keep serving
                         # what is already queued
+                        sp.finish(error=e.kind)
                         print(f"error {e.kind}: {src} -> {dst}: {e}")
                         continue
                     except RuntimeError as e:
+                        sp.finish(error="capacity")
                         print(f"error capacity: {src} -> {dst}: {e}")
                         continue
                     except ValueError as e:
+                        sp.finish(error="invalid")
                         print(f"error invalid: {src} -> {dst}: {e}")
                         continue
                     drain()

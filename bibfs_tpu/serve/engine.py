@@ -51,6 +51,7 @@ from contextlib import contextmanager
 import numpy as np
 
 from bibfs_tpu.analysis import guarded_by
+from bibfs_tpu.obs.dtrace import stage_histogram
 from bibfs_tpu.obs.metrics import REGISTRY, MetricBank, next_instance_label
 from bibfs_tpu.obs.trace import span
 from bibfs_tpu.serve.buckets import (
@@ -265,12 +266,16 @@ class _Pending:
     serial host rung seeds its meet bound with it (exact pruning).
     ``query`` carries the typed taxonomy query on non-point-to-point
     tickets (None = the classic ``(src, dst)`` shape; ``src``/``dst``
-    then hold a representative pair for error reporting)."""
+    then hold a representative pair for error reporting). ``ctx`` is
+    the distributed-trace context (:mod:`bibfs_tpu.obs.dtrace`) the
+    ingress hop sampled — None on the overwhelmingly common unsampled
+    query, where it costs one slot and nothing else."""
 
     __slots__ = ("src", "dst", "graph", "result", "error", "cutoff",
-                 "query")
+                 "query", "ctx")
 
-    def __init__(self, src: int, dst: int, graph: str | None = None):
+    def __init__(self, src: int, dst: int, graph: str | None = None,
+                 ctx=None):
         self.src = src
         self.dst = dst
         self.graph = graph
@@ -278,6 +283,7 @@ class _Pending:
         self.error: BaseException | None = None
         self.cutoff: int | None = None
         self.query: Query | None = None
+        self.ctx = ctx
 
 
 @guarded_by("_lock", "_graph", "bucket_key", "_host_solver",
@@ -975,6 +981,35 @@ class QueryEngine:
         self._c_cache_served = self.counters.cell("cache_served")
         self._c_host_queries = self.counters.cell("host_queries")
         self._c_overlay = self.counters.cell("overlay_queries")
+        # per-query cost attribution (obs/dtrace.py): the stage
+        # histogram cells, pre-labeled here so serving never allocates
+        # a label cell per query (render-at-zero from construction);
+        # the per-route/per-stage accumulator stats() reports; and the
+        # launch-context hand-off the dispatch routes read to stamp
+        # cross-process descriptors (pod workers) with the flush's
+        # sampled trace context
+        self._stage_cells = stage_histogram()
+        self._stage_acc: dict = {}
+        self._launch_ctx = None
+
+    def _note_stage(self, route: str, stage: str, dur_s: float,
+                    n: int = 1, record: bool = True) -> None:
+        """Record ``dur_s`` against one serving stage: the per-route/
+        per-stage breakdown ``stats()['stages']`` reports, plus one
+        ``bibfs_stage_seconds{stage}`` histogram sample unless
+        ``record=False`` (a multi-query sum already histogrammed
+        per query elsewhere). Callers on concurrent threads (the
+        pipelined engine's flusher + finish worker) hold the engine
+        lock."""
+        if record:
+            self._stage_cells[stage].record(dur_s)
+        acc = self._stage_acc.setdefault(route, {})
+        cell = acc.get(stage)
+        if cell is None:
+            acc[stage] = [n, dur_s]
+        else:
+            cell[0] += n
+            cell[1] += dur_s
 
     # ---- graph resolution (the store seam) ---------------------------
     def _graph_rt(self, name) -> _GraphRuntime:
@@ -1121,13 +1156,16 @@ class QueryEngine:
         return self._current_rt().host_backend_resolved
 
     # ---- submission --------------------------------------------------
-    def submit(self, src: int, dst: int, graph: str | None = None
-               ) -> _Pending:
+    def submit(self, src: int, dst: int, graph: str | None = None,
+               ctx=None) -> _Pending:
         """Queue one query (``graph`` names a store graph on a
         store-backed engine; None = the default graph). Cache hits and
         trivial queries resolve immediately; everything else resolves at
         the next flush (an overfull queue flushes itself at
-        ``max_batch``)."""
+        ``max_batch``). ``ctx`` is a sampled distributed-trace context
+        (:mod:`bibfs_tpu.obs.dtrace`): it rides the ticket so the
+        flush's dispatch routes can propagate it across process hops
+        (pod descriptors) — None (the default) adds no work."""
         if self._rts_released:
             # the snapshot pins are gone: a later flush could neither
             # pin nor solve — fail HERE with a clear error instead of
@@ -1147,7 +1185,7 @@ class QueryEngine:
         name, rt = self._resolve_graph(graph)
         if not (0 <= src < rt.n and 0 <= dst < rt.n):
             raise ValueError(f"src/dst out of range for n={rt.n}")
-        t = _Pending(src, dst, name)
+        t = _Pending(src, dst, name, ctx)
         self._c_queries.inc()
         if src == dst:
             self._c_trivial.inc()
@@ -1366,8 +1404,19 @@ class QueryEngine:
             if overlay is not None:
                 self._flush_overlay(overlay, pairs, unique)
                 return
-            for i in range(0, len(pairs), self.max_batch):
-                self._flush_ladder(pairs[i: i + self.max_batch], unique)
+            # hand the flush's sampled trace context (the first sampled
+            # ticket's — one descriptor per batch, not per query) to the
+            # dispatch routes for the duration of the ladder walk; pod
+            # descriptors stamp it so worker spans join the trace
+            self._launch_ctx = next(
+                (t.ctx for t in pend if t.ctx is not None), None
+            )
+            try:
+                for i in range(0, len(pairs), self.max_batch):
+                    self._flush_ladder(pairs[i: i + self.max_batch],
+                                       unique)
+            finally:
+                self._launch_ctx = None
 
     def _flush_overlay(self, overlay, pairs, unique) -> None:
         """The exact-answering route while live edge updates are
@@ -2013,6 +2062,13 @@ class QueryEngine:
         return {
             **c,
             "solver_dispatch_free": c["queries"] - solved,
+            "stages": {
+                route: {
+                    stage: {"n": cell[0], "s": round(cell[1], 6)}
+                    for stage, cell in sorted(acc.items())
+                }
+                for route, acc in sorted(self._stage_acc.items())
+            },
             "query_kinds": kinds,
             "kind_cache": self._kind_cache.stats(),
             "ladder": list(self._ladder),

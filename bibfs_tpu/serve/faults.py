@@ -81,7 +81,10 @@ KNOWN_SITES = ("device", "device_finish", "mesh", "mesh_finish",
                # the kinds' DEVICE rungs (serve/routes/
                # taxonomy_device.py): each degrades to its host kind
                # rung when faulted
-               "msbfs_device", "weighted_device", "kshortest_device")
+               "msbfs_device", "weighted_device", "kshortest_device",
+               # the distributed-trace spool append (obs/dtrace.py):
+               # a failed flush drops the span, never the query
+               "trace_flush")
 
 KINDS = ("error", "latency")
 
@@ -290,6 +293,18 @@ class FaultPlan:
                     sleep_ms += r.latency_ms
                 elif boom is None:
                     boom = InjectedFault(site, r.describe())
+        if sleep_ms > 0.0 or boom is not None:
+            # the flight recorder's fault hook: record the trip (and
+            # dump the ring, rate-limited, when a dump path is armed)
+            # BEFORE the injected error propagates — the post-mortem
+            # must capture the state that led here, and must never add
+            # a failure of its own
+            try:
+                from bibfs_tpu.obs.dtrace import flight_on_fault
+
+                flight_on_fault(site)
+            except Exception:  # pragma: no cover - defensive
+                pass
         if sleep_ms > 0.0:
             time.sleep(sleep_ms / 1e3)
         if boom is not None:

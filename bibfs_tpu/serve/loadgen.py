@@ -4299,6 +4299,52 @@ def run_net(
         finally:
             fleet.close()
 
+        # ---- phase 7: distributed tracing, end to end ---------------
+        # sampling forced on; the driver and the spawned serving child
+        # spool into one dir, and the merged trace must show at least
+        # one query's spans crossing the process boundary (client span
+        # here, ingress/stage spans in the child) with valid parentage
+        from bibfs_tpu.obs import dtrace as _dtrace
+
+        spool = os.path.join(workdir, "trace_spool")
+        os.environ[_dtrace.ENV_SPOOL] = spool
+        os.environ[_dtrace.ENV_SAMPLE] = "1.0"
+        _dt = _dtrace.install_from_env("loadgen")
+        try:
+            trep = NetReplica(
+                "traced", gpath, max_wait_ms=max_wait_ms,
+            )
+            try:
+                t_pairs = pairs[: min(50, len(pairs))]
+                t_tickets = [
+                    trep.submit(int(s), int(d)) for s, d in t_pairs
+                ]
+                for t in t_tickets:
+                    try:
+                        t.wait(timeout=60.0)
+                    except Exception:
+                        pass
+            finally:
+                trep.close()
+        finally:
+            _dtrace.set_dtracer(None)
+            if _dt is not None:
+                _dt.close()
+            os.environ.pop(_dtrace.ENV_SPOOL, None)
+            os.environ.pop(_dtrace.ENV_SAMPLE, None)
+        t_report = _dtrace.merge_spools(spool)
+        t_cross = _dtrace.cross_process_traces(t_report, min_procs=2)
+        trace_phase = {
+            "spool_files": t_report["files"],
+            "spans": t_report["spans"],
+            "truncated_lines": t_report["truncated_lines"],
+            "traces": len(t_report["traces"]),
+            "cross_process_traces": len(t_cross),
+            "orphan_parents": t_report["orphan_parents"],
+            "ok": bool(t_cross) and t_report["orphan_parents"] == 0,
+        }
+        out["trace_phase"] = trace_phase
+
         # ---- the headline gates -------------------------------------
         top_base = baseline[-1]["sustained_qps"] or 0.0
         top_net = net_points[-1]["sustained_qps"] or 0.0
@@ -4323,6 +4369,7 @@ def run_net(
             "fleet_zero_lost_ok": bool(out["fleet_phase"]["ok"]),
             "metrics_ok": bool(scrape.get("ok")),
             "metrics_missing": scrape.get("metrics_missing"),
+            "trace_ok": bool(trace_phase.get("ok")),
         }
         out["ok"] = all(
             v for k, v in out["gates"].items()
@@ -4420,6 +4467,16 @@ def run_pod_dryrun(
         f"--xla_force_host_platform_device_count={int(local_devices)} "
         + env.get("XLA_FLAGS", "")
     ).strip()
+    # distributed tracing across all three processes (this driver, the
+    # serving primary, the pod worker), sampling forced on: the merged
+    # trace must show one query's spans in >= 3 OS processes
+    from bibfs_tpu.obs import dtrace as _dtrace
+
+    spool = os.path.join(workdir, "trace_spool")
+    env[_dtrace.ENV_SPOOL] = spool
+    env[_dtrace.ENV_SAMPLE] = "1.0"
+    _dt = _dtrace.DTracer(spool, "loadgen", sample=1.0)
+    _dtrace.set_dtracer(_dt)
     common = [
         "--coordinator", coord, "--num-processes", "2",
         "--pod-port", str(pod_port),
@@ -4557,6 +4614,24 @@ def run_pod_dryrun(
         client.close()
         client = None
         rcs = reap(sig_primary=True)
+        # both children have exited (spools closed); merge and gate:
+        # at least one sampled query's spans in driver + primary +
+        # worker, with every parent resolving
+        _dtrace.set_dtracer(None)
+        _dt.close()
+        t_report = _dtrace.merge_spools(spool)
+        t_cross = _dtrace.cross_process_traces(t_report, min_procs=3)
+        trace_block = {
+            "spool_files": t_report["files"],
+            "spans": t_report["spans"],
+            "truncated_lines": t_report["truncated_lines"],
+            "traces": len(t_report["traces"]),
+            "cross_process_traces_3": len(t_cross),
+            "orphan_parents": t_report["orphan_parents"],
+            "procs": sorted({
+                p for t in t_report["traces"] for p in t["procs"]
+            }),
+        }
         out = {
             "n": n,
             "processes": 2,
@@ -4577,13 +4652,21 @@ def run_pod_dryrun(
                 and not bad2
             ),
             "clean_exit_ok": rcs == [0, 0],
+            "trace": trace_block,
+            "trace_ok": (
+                bool(t_cross) and t_report["orphan_parents"] == 0
+            ),
         }
         out["ok"] = (
             out["exact_ok"] and out["mesh_used_ok"]
             and out["swap_ok"] and out["clean_exit_ok"]
+            and out["trace_ok"]
         )
         if not out["ok"]:
             out["logs"] = tails()
+        # the merged Chrome-trace events ride OUTSIDE the bench payload
+        # body: bench.py pops them and writes visual/pod_trace.json
+        out["trace_events"] = t_report["events"]
         return out
     except Exception as e:
         reap(sig_primary=True)
@@ -4593,6 +4676,8 @@ def run_pod_dryrun(
             "logs": tails(),
         }
     finally:
+        _dtrace.set_dtracer(None)
+        _dt.close()
         if client is not None:
             client.close()
         for handle in handles:

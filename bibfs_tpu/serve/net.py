@@ -66,6 +66,15 @@ import threading
 import time
 
 from bibfs_tpu.analysis import guarded_by
+from bibfs_tpu.obs.dtrace import (
+    FLIGHT,
+    ctx_fields,
+    ctx_from_fields,
+    dspan,
+    sample_ctx,
+    stage_histogram,
+    wall_us,
+)
 from bibfs_tpu.obs.metrics import REGISTRY
 from bibfs_tpu.serve.resilience import ERROR_KINDS, QueryError
 from bibfs_tpu.solvers.api import BFSResult
@@ -83,9 +92,11 @@ REJECT_REASONS = ("quota", "capacity", "draining", "oversize",
                   "malformed")
 
 #: control ops the server answers beside queries (the stdin REPL's
-#: command surface, multiplexed)
+#: command surface, multiplexed; ``metrics`` returns this process's
+#: Prometheus rendering for fleet-wide aggregation, ``flightrec`` the
+#: flight-recorder ring — and dumps it with ``dump: true``)
 CONTROL_OPS = ("health", "stats", "memory", "graphs", "version",
-               "update", "roll", "ping")
+               "update", "roll", "ping", "metrics", "flightrec")
 
 
 class FrameError(ValueError):
@@ -207,15 +218,18 @@ class _Conn:
 class _PendingNet:
     """One submitted query awaiting its reply frame."""
 
-    __slots__ = ("ticket", "conn", "rid", "deadline", "tenant", "t0")
+    __slots__ = ("ticket", "conn", "rid", "deadline", "tenant", "t0",
+                 "rx")
 
-    def __init__(self, ticket, conn, rid, deadline, tenant, t0):
+    def __init__(self, ticket, conn, rid, deadline, tenant, t0,
+                 rx=None):
         self.ticket = ticket
         self.conn = conn
         self.rid = rid
         self.deadline = deadline
         self.tenant = tenant
         self.t0 = t0
+        self.rx = rx  # wall-µs arrival stamp, traced queries only
 
 
 # _state stays un-annotated by design (lock-free fast reads in the IO
@@ -304,6 +318,9 @@ class NetServer:
             "Queries answered with a structured timeout because their "
             "per-request deadline expired before the result landed",
         )
+        # per-query cost attribution (obs/dtrace.py): the front door
+        # owns the ingress stage (frame arrival -> ticket submitted)
+        self._stage_cells = stage_histogram()
 
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(
@@ -537,6 +554,7 @@ class NetServer:
         # the deadline SLO is measured from frame arrival (module
         # docstring): anchor it here, before admission and submit
         now = time.monotonic()
+        t_in = time.perf_counter()  # ingress-stage anchor
         tenant = str(msg.get("tenant") or "default")
         # deadline_ms is client-controlled: it must parse BEFORE any
         # admission state moves, so a junk value can neither burn a
@@ -585,12 +603,22 @@ class NetServer:
                 "error": f"admission refused ({reason})",
             })
             return
+        # distributed-trace ingress: adopt the frame's context, or make
+        # the sampling decision HERE when an untraced client hits a
+        # traced server (the front door is the ingress). Unsampled is
+        # ctx=None all the way down — no span, no extra reply fields.
+        ctx = ctx_from_fields(msg)
+        if ctx is None:
+            ctx = sample_ctx()
+        sp = dspan("net_ingress", ctx, tenant=tenant)
         # submit OUTSIDE the server lock: the engine takes its own lock
         try:
             src = int(msg["src"])
             dst = int(msg["dst"])
-            ticket = self._engine.submit(src, dst, msg.get("graph"))
+            ticket = self._engine.submit(src, dst, msg.get("graph"),
+                                         ctx=sp.ctx)
         except QueryError as e:
+            sp.finish(error=e.kind)
             with self._lock:
                 self._submitting -= 1
                 if e.kind == "capacity":
@@ -601,6 +629,7 @@ class NetServer:
             })
             return
         except (KeyError, TypeError, ValueError) as e:
+            sp.finish(error=type(e).__name__)
             with self._lock:
                 self._submitting -= 1
             self._enqueue(conn, {
@@ -609,6 +638,7 @@ class NetServer:
             })
             return
         except RuntimeError as e:  # engine closed underneath us
+            sp.finish(error="closed")
             with self._lock:
                 self._submitting -= 1
                 self._m_rejects.labels(reason="capacity").inc()
@@ -617,14 +647,22 @@ class NetServer:
                 "error": f"{e}",
             })
             return
+        # the ingress stage: frame arrival -> ticket submitted
+        self._stage_cells["ingress"].record(time.perf_counter() - t_in)
+        sp.finish(src=src, dst=dst)
+        rx = round(wall_us(t_in), 3) if ctx is not None else None
         if ticket.result is not None or ticket.error is not None:
             # inline-resolved (cache/trivial/oracle): reply immediately
             # instead of waiting for the next completer wake
             with self._lock:
                 self._submitting -= 1
-            self._enqueue(conn, self._ticket_reply(rid, ticket))
+            reply = self._ticket_reply(rid, ticket)
+            if rx is not None:
+                reply["rx"] = rx
+                reply["stx"] = round(wall_us(time.perf_counter()), 3)
+            self._enqueue(conn, reply)
             return
-        entry = _PendingNet(ticket, conn, rid, deadline, tenant, now)
+        entry = _PendingNet(ticket, conn, rid, deadline, tenant, now, rx)
         with self._lock:
             self._submitting -= 1
             self._pending[self._seq] = entry
@@ -668,6 +706,16 @@ class NetServer:
             return eng.health_snapshot()
         if op == "stats":
             return eng.stats()
+        if op == "metrics":
+            # the fleet-wide scrape seam: this process's full
+            # Prometheus text rendering, aggregated by the router's
+            # /metrics with a replica label
+            return {"render": self._registry.render()}
+        if op == "flightrec":
+            snap = FLIGHT.snapshot()
+            if msg.get("dump"):
+                snap["dumped_to"] = FLIGHT.dump(reason="demand")
+            return snap
         if op == "memory":
             if self._store is None:
                 raise ValueError("no store attached")
@@ -802,7 +850,14 @@ class NetServer:
                              "landed",
                 })
             for _, e in done:
-                self._enqueue(e.conn, self._ticket_reply(e.rid, e.ticket))
+                reply = self._ticket_reply(e.rid, e.ticket)
+                if e.rx is not None:
+                    # traced query: both server clock stamps ride the
+                    # reply so the client can subtract server time from
+                    # its RTT (the wire stage + clock-offset bound)
+                    reply["rx"] = e.rx
+                    reply["stx"] = round(wall_us(time.perf_counter()), 3)
+                self._enqueue(e.conn, reply)
 
     # ---- lifecycle ---------------------------------------------------
     def pending_count(self) -> int:
@@ -879,7 +934,7 @@ class NetTicket:
     the open-loop loadgen reads net latencies identically."""
 
     __slots__ = ("src", "dst", "graph", "result", "error", "event",
-                 "t_done")
+                 "t_done", "span", "t_sent")
 
     def __init__(self, src: int, dst: int, graph):
         self.src = src
@@ -889,6 +944,8 @@ class NetTicket:
         self.error: BaseException | None = None
         self.event = threading.Event()
         self.t_done: float | None = None
+        self.span = None  # the client-side DSpan, sampled queries only
+        self.t_sent: float | None = None
 
     def wait(self, timeout: float | None = None):
         if not self.event.wait(timeout):
@@ -932,6 +989,9 @@ class NetClient:
         except OSError:
             pass
         self.tenant = tenant
+        # the wire stage (client RTT minus server time) lands in this
+        # process's bibfs_stage_seconds when tracing samples a query
+        self._stage_cells = stage_histogram()
         self._lock = threading.RLock()
         self._wlock = threading.Lock()
         self._waiters: dict[int, object] = {}
@@ -1003,10 +1063,35 @@ class NetClient:
                     kind=kind, query=(waiter.src, waiter.dst),
                 )
             waiter.t_done = time.perf_counter()
+            if waiter.span is not None:
+                self._finish_traced(waiter, msg)
             waiter.event.set()
         else:
             waiter.msg = msg
             waiter.event.set()
+
+    def _finish_traced(self, waiter: NetTicket, msg: dict) -> None:
+        """Close a sampled query's client span: subtract the server's
+        own processing time (its ``rx``/``stx`` wall stamps) from the
+        client RTT to get the wire stage, and estimate the clock offset
+        NTP-style — ``(rx - t0) + (stx - t3)) / 2`` with the wire time
+        itself bounding the estimate's error."""
+        rtt_s = waiter.t_done - waiter.t_sent
+        rx, stx = msg.get("rx"), msg.get("stx")
+        args = {"rtt_ms": round(rtt_s * 1e3, 3)}
+        if isinstance(rx, (int, float)) and isinstance(stx, (int, float)):
+            wire_s = max(rtt_s - (stx - rx) / 1e6, 0.0)
+            self._stage_cells["wire"].record(wire_s)
+            t0 = wall_us(waiter.t_sent)
+            t3 = wall_us(waiter.t_done)
+            args["wire_ms"] = round(wire_s * 1e3, 3)
+            args["clock_offset_us"] = round(
+                ((rx - t0) + (stx - t3)) / 2.0, 1
+            )
+            args["offset_bound_us"] = round(wire_s * 5e5, 1)
+        if waiter.error is not None:
+            args["error"] = getattr(waiter.error, "kind", "internal")
+        waiter.span.finish(**args)
 
     def _fail_all(self) -> None:
         with self._lock:
@@ -1022,6 +1107,8 @@ class NetClient:
                         query=(waiter.src, waiter.dst),
                     )
                 waiter.t_done = time.perf_counter()
+                if waiter.span is not None:
+                    waiter.span.finish(error="disconnected")
                 waiter.event.set()
             else:
                 waiter.event.set()  # msg stays None: ConnectionError
@@ -1039,7 +1126,7 @@ class NetClient:
 
     def submit(self, src: int, dst: int, graph: str | None = None, *,
                deadline_ms: float | None = None,
-               tenant: str | None = None) -> NetTicket:
+               tenant: str | None = None, ctx=None) -> NetTicket:
         ticket = NetTicket(int(src), int(dst), graph)
         rid = self._register(ticket)
         frame = {"op": "query", "id": rid, "src": ticket.src,
@@ -1051,6 +1138,17 @@ class NetClient:
         t = tenant if tenant is not None else self.tenant
         if t is not None:
             frame["tenant"] = t
+        # distributed trace: the client IS the ingress when it holds a
+        # tracer — sample here (or adopt the caller's ctx), open the
+        # client span, and carry its context on the frame so the
+        # server's spans parent under it
+        if ctx is None:
+            ctx = sample_ctx()
+        if ctx is not None:
+            sp = dspan("net_client", ctx, src=ticket.src, dst=ticket.dst)
+            ticket.span = sp
+            ticket.t_sent = time.perf_counter()
+            frame.update(ctx_fields(sp.ctx))
         try:
             self._send(encode_frame(frame))
         except ConnectionError:

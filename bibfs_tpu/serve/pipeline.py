@@ -56,6 +56,7 @@ from collections import OrderedDict, deque
 from concurrent.futures import ThreadPoolExecutor
 
 from bibfs_tpu.analysis import guarded_by
+from bibfs_tpu.obs.dtrace import FLIGHT, emit_span
 from bibfs_tpu.obs.metrics import REGISTRY, LogHistogram, MetricBank
 from bibfs_tpu.obs.trace import span
 from bibfs_tpu.serve.engine import QueryEngine, _Pending
@@ -182,12 +183,13 @@ class QueryTicket(_Pending):
     park on the engine's single condition variable, which resolution
     broadcasts once per BATCH."""
 
-    __slots__ = ("t_submit", "t_done", "_engine")
+    __slots__ = ("t_submit", "t_launch", "t_done", "_engine")
 
     def __init__(self, src: int, dst: int, engine=None,
-                 graph: str | None = None):
-        super().__init__(src, dst, graph)
+                 graph: str | None = None, ctx=None):
+        super().__init__(src, dst, graph, ctx)
         self.t_submit = time.perf_counter()
+        self.t_launch: float | None = None  # stamped at batch pop
         self.t_done: float | None = None
         self._engine = engine
 
@@ -369,13 +371,17 @@ class PipelinedQueryEngine(QueryEngine):
         self._flusher.start()
 
     # ---- submission --------------------------------------------------
-    def submit(self, src: int, dst: int, graph: str | None = None
-               ) -> QueryTicket:
+    def submit(self, src: int, dst: int, graph: str | None = None,
+               ctx=None) -> QueryTicket:
         """Queue one query WITHOUT blocking on any solve (``graph``
         names a store graph on a store-backed engine). Trivial queries
         and cache hits resolve before returning; everything else
         resolves when the background flusher's batch lands (depth,
-        deadline, or drain — whichever comes first)."""
+        deadline, or drain — whichever comes first). ``ctx`` is a
+        sampled distributed-trace context (:mod:`bibfs_tpu.obs.dtrace`)
+        — the ticket carries it so resolution emits queue/resolve spans
+        and dispatch routes propagate it; None (the default, every
+        unsampled query) adds one attribute store and nothing else."""
         if self._draining:
             if self._closed:
                 # a killed/closed engine is TERMINAL — it must not
@@ -392,7 +398,7 @@ class PipelinedQueryEngine(QueryEngine):
         name, rt = self._resolve_graph(graph)
         if not (0 <= src < rt.n and 0 <= dst < rt.n):
             raise ValueError(f"src/dst out of range for n={rt.n}")
-        t = QueryTicket(src, dst, self, name)
+        t = QueryTicket(src, dst, self, name, ctx)
         if src == dst:
             with self._lock:
                 if self._closed:
@@ -703,6 +709,8 @@ class PipelinedQueryEngine(QueryEngine):
                 self._g_queue_depth.set(len(self._queue))
                 self._cv.notify_all()  # wake producers blocked on max_queue
                 now = time.perf_counter()
+                for t in batch:
+                    t.t_launch = now  # queue stage ends at the pop
                 wait_ms = (now - batch[0].t_submit) * 1e3
                 self.pipe_counters.cell("queue_wait_max_ms").set_max(
                     wait_ms)
@@ -744,6 +752,19 @@ class PipelinedQueryEngine(QueryEngine):
         )
         for t in batch:
             unique.setdefault((t.src, t.dst), []).append(t)
+        # the flush's sampled trace context rides on the engine for the
+        # ladder walk (one descriptor per batch): dispatch routes stamp
+        # it onto cross-process descriptors (pod workers). Only the
+        # flusher thread runs _launch_group, so this is race-free.
+        self._launch_ctx = next(
+            (t.ctx for t in batch if t.ctx is not None), None
+        )
+        try:
+            self._launch_group_routed(name, unique)
+        finally:
+            self._launch_ctx = None
+
+    def _launch_group_routed(self, name, unique) -> None:
         # overlay BEFORE pin — same swap-race ordering as the sync
         # engine's _flush_graph (see the comment there)
         overlay = self._overlay_pending(name)
@@ -790,6 +811,7 @@ class PipelinedQueryEngine(QueryEngine):
         try:
             with span("overlay_batch", batch=len(unique)):
                 lats = []
+                qlist = []
                 served = 0
                 for key, res in self.routes["overlay"].solve_iter(
                     overlay, list(unique)
@@ -804,9 +826,15 @@ class PipelinedQueryEngine(QueryEngine):
                     for t in tickets:
                         if self._finish_ticket(t, res):
                             lats.append(t.t_done - t.t_submit)
+                            if t.t_launch is not None:
+                                qlist.append(t.t_launch - t.t_submit)
                 self.latency.record_many(lats)
                 with self._lock:
                     self._c_overlay.inc(served)
+                self._note_batch_stages(
+                    "overlay", len(lats), qlist,
+                    resolve_s=time.perf_counter() - t_launch,
+                )
         finally:
             self.stages.exit()
             self._note_batch_done(
@@ -968,7 +996,9 @@ class PipelinedQueryEngine(QueryEngine):
                     min(launch_s + time.perf_counter() - t_fin,
                         results[0].time_s if results else 0.0),
                 )
+                t_resv = time.perf_counter()
                 lats = []
+                qlist = []
                 for (src, dst), res in zip(pairs, results):
                     self.dist_cache.put_result(
                         self.graph_id, src, dst, res.found, res.hops,
@@ -977,7 +1007,13 @@ class PipelinedQueryEngine(QueryEngine):
                     for t in unique[(src, dst)]:
                         if self._finish_ticket(t, res):
                             lats.append(t.t_done - t.t_submit)
+                            qlist.append(t.t_launch - t.t_submit)
                 self.latency.record_many(lats)
+                self._note_batch_stages(
+                    route.name, len(lats), qlist, launch_s,
+                    finish_s=t_resv - t_fin,
+                    resolve_s=time.perf_counter() - t_resv,
+                )
         except Exception as e:
             self._record_error(e)
             for key in pairs:
@@ -1012,16 +1048,15 @@ class PipelinedQueryEngine(QueryEngine):
                 results = self._solve_host_isolated(
                     pairs, self._cutoffs_for(pairs, unique)
                 )
-                self._note_route_time(
-                    rt, "host", pairs, time.perf_counter() - t_launch
-                )
+                launch_s = time.perf_counter() - t_launch
+                self._note_route_time(rt, "host", pairs, launch_s)
             finally:
                 self.stages.exit()
             rt.snapshot.retain()  # the resolve job banks on THIS snapshot
             job_pin = True
             self._finish_pool.submit(
                 self._host_resolve_job, rt, pairs, unique, t_launch,
-                results,
+                results, launch_s,
             )
         except BaseException:
             if job_pin:
@@ -1030,12 +1065,12 @@ class PipelinedQueryEngine(QueryEngine):
             raise
 
     def _host_resolve_job(self, rt, pairs, unique, t_launch,
-                          results) -> None:
+                          results, launch_s=None) -> None:
         self.stages.enter()
         try:
             with self._bound(rt), span("host_resolve", batch=len(pairs)):
                 try:
-                    self._deliver_host(pairs, unique, results)
+                    self._deliver_host(pairs, unique, results, launch_s)
                 except Exception as e:
                     self._record_error(e)
                     for key in pairs:
@@ -1064,6 +1099,7 @@ class PipelinedQueryEngine(QueryEngine):
     # paths), so the increments take the engine lock — cold paths only,
     # the fault-free hot loop never passes through either.
     def _note_fallback(self, frm: str, to: str) -> None:
+        FLIGHT.note("route", fallback=frm, to=to)
         with self._lock:
             super()._note_fallback(frm, to)
 
@@ -1075,14 +1111,17 @@ class PipelinedQueryEngine(QueryEngine):
         with self._lock:
             super()._count_error(err, n)
 
-    def _deliver_host(self, pairs, unique, results) -> None:
+    def _deliver_host(self, pairs, unique, results, launch_s=None) -> None:
         """Resolve one host-solved batch (finish-worker side) through
         the shared delivery skeleton
         (:meth:`QueryEngine._deliver_host_results`): bank and finish
         the successes, fail exactly the tickets whose query the
-        isolator gave up on. Used by the host route and the
+        isolator gave up on. Used by the host route (which passes its
+        solve time as ``launch_s`` for the stage breakdown) and the
         device->host recovery path."""
+        t_resv = time.perf_counter()
         lats = []
+        qlist = []
 
         def resolve_ok(key, res):
             self.dist_cache.put_result(
@@ -1092,6 +1131,8 @@ class PipelinedQueryEngine(QueryEngine):
             for t in unique[key]:
                 if self._finish_ticket(t, res):
                     lats.append(t.t_done - t.t_submit)
+                    if t.t_launch is not None:
+                        qlist.append(t.t_launch - t.t_submit)
 
         def resolve_err(key, err):
             for t in unique[key]:
@@ -1104,6 +1145,10 @@ class PipelinedQueryEngine(QueryEngine):
         self.latency.record_many(lats)
         with self._lock:
             self._c_host_queries.inc(n_ok)
+        self._note_batch_stages(
+            "host", len(lats), qlist, launch_s,
+            resolve_s=time.perf_counter() - t_resv,
+        )
 
     # ---- resolution --------------------------------------------------
     def _finish_ticket(self, t: QueryTicket, res: BFSResult) -> bool:
@@ -1115,6 +1160,26 @@ class PipelinedQueryEngine(QueryEngine):
             return False
         t.t_done = time.perf_counter()
         t.result = res
+        if t.ctx is not None:
+            # sampled query: its ticket timeline becomes causally-
+            # linked spans in this process's spool, parented under the
+            # ingress span whose context rode in on the submit
+            if t.t_launch is not None:
+                emit_span("queue", t.ctx, t.t_submit,
+                          t.t_launch - t.t_submit)
+                emit_span("resolve", t.ctx, t.t_launch,
+                          t.t_done - t.t_launch)
+            else:  # resolved inline at submit (trivial/oracle/cache)
+                emit_span("resolve", t.ctx, t.t_submit,
+                          t.t_done - t.t_submit)
+            FLIGHT.note(
+                "query", trace=t.ctx.trace_id, src=t.src, dst=t.dst,
+                queue_ms=(
+                    None if t.t_launch is None
+                    else round((t.t_launch - t.t_submit) * 1e3, 3)
+                ),
+                total_ms=round((t.t_done - t.t_submit) * 1e3, 3),
+            )
         return True
 
     def _fail_ticket(self, t: QueryTicket, err: BaseException) -> None:
@@ -1138,6 +1203,46 @@ class PipelinedQueryEngine(QueryEngine):
                 self._fail_ticket(t, err)
                 failed += 1
         self._note_batch_done(time.perf_counter(), failed)
+
+    def _note_batch_stages(self, route: str, n: int, queue_list: list,
+                           launch_s: float | None = None, *,
+                           finish_s: float | None = None,
+                           resolve_s: float | None = None) -> None:
+        """One resolved batch's cost attribution: the per-route/
+        per-stage breakdown (under the engine lock — the flusher and
+        the finish worker both land here) plus the always-on
+        flight-recorder batch entry. launch/finish/resolve are
+        batch-grain stages and take one histogram sample each; the
+        queue stage is per-query by nature, so the batch's waits are
+        histogrammed here in ONE ``record_many`` lock acquisition (the
+        per-ticket cost in ``_finish_ticket`` stays a list append)."""
+        queue_sum = 0.0
+        if queue_list:
+            self._stage_cells["queue"].record_many(queue_list)
+            queue_sum = sum(queue_list)
+        with self._lock:
+            if n:
+                self._note_stage(route, "queue", queue_sum, n=n,
+                                 record=False)
+            if launch_s is not None:
+                self._note_stage(route, "launch", launch_s)
+            if finish_s is not None:
+                self._note_stage(route, "finish", finish_s)
+            if resolve_s is not None:
+                self._note_stage(route, "resolve", resolve_s)
+        FLIGHT.note(
+            "batch", route=route, queries=n,
+            queue_ms=round(queue_sum * 1e3, 3),
+            launch_ms=(
+                None if launch_s is None else round(launch_s * 1e3, 3)
+            ),
+            finish_ms=(
+                None if finish_s is None else round(finish_s * 1e3, 3)
+            ),
+            resolve_ms=(
+                None if resolve_s is None else round(resolve_s * 1e3, 3)
+            ),
+        )
 
     def _note_batch_done(self, t_launch: float, tickets: int) -> None:
         service_ms = (time.perf_counter() - t_launch) * 1e3

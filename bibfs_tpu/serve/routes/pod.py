@@ -110,8 +110,12 @@ class PodMeshRoute(MeshRoute):
         rung = min(bucket_batch(len(pairs)), self.engine.max_batch)
         padded = np.zeros((rung, 2), dtype=np.int64)
         padded[: len(pairs)] = pairs
+        # the batch's sampled trace context (set by the engine's ladder
+        # walk for the duration of this launch): the pod broadcast
+        # carries it to every worker process
         seq = self._pod.post_solve(
-            snap.digest, self.config.mode, padded, len(pairs)
+            snap.digest, self.config.mode, padded, len(pairs),
+            ctx=getattr(self.engine, "_launch_ctx", None),
         )
         # the join barrier, phase 1: every worker validated the batch
         # and parked for the verdict

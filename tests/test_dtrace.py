@@ -1,0 +1,327 @@
+"""Distributed tracing (bibfs_tpu/obs/dtrace.py): context wire
+encodings, the per-process spool + torn-tail-tolerant merger with
+parentage validation, cross-process traces out of a REAL spawned
+``bibfs-serve --port`` child, the disabled-sampling zero-allocation
+contract, the bounded flight recorder (ring cap, dump-on-fault under an
+injected ``device`` fault, on-demand control op), and the
+``trace_flush`` chaos seam dropping spans without touching the serving
+path."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from bibfs_tpu.obs import dtrace
+from bibfs_tpu.obs.dtrace import (
+    STAGES,
+    DTracer,
+    FlightRecorder,
+    TraceContext,
+    cross_process_traces,
+    ctx_fields,
+    ctx_from_fields,
+    ctx_token,
+    dspan,
+    merge_spools,
+    parse_token,
+    read_spool,
+    set_dtracer,
+    stage_histogram,
+)
+from bibfs_tpu.obs.metrics import REGISTRY
+from bibfs_tpu.serve.faults import FaultPlan, InjectedFault
+from bibfs_tpu.serve.net import NetClient, read_port_file
+from bibfs_tpu.serve.pipeline import PipelinedQueryEngine
+from bibfs_tpu.solvers.serial import solve_serial
+
+
+def _skiplink_graph(n: int) -> np.ndarray:
+    edges = [[i, i + 1] for i in range(n - 1)]
+    edges += [[i, i + 7] for i in range(n - 7)]
+    return np.array(edges)
+
+
+N = 400
+EDGES = _skiplink_graph(N)
+
+
+@pytest.fixture
+def tracer(tmp_path):
+    """A fresh installed DTracer; uninstalled and closed on exit."""
+    t = DTracer(str(tmp_path / "spool"), "testproc", sample=1.0)
+    prev = set_dtracer(t)
+    yield t
+    set_dtracer(prev)
+    t.close()
+
+
+# ---- wire encodings --------------------------------------------------
+
+def test_ctx_field_and_token_roundtrip():
+    ctx = TraceContext("aa" * 16, "bb" * 8)
+    assert ctx_from_fields(ctx_fields(ctx)).trace_id == ctx.trace_id
+    assert ctx_from_fields(ctx_fields(ctx)).span_id == ctx.span_id
+    assert ctx_fields(None) == {}
+    assert ctx_from_fields({}) is None
+    assert ctx_from_fields({"trace": 42}) is None  # garbage tolerated
+    tok = ctx_token(ctx)
+    back = parse_token(tok)
+    assert (back.trace_id, back.span_id) == (ctx.trace_id, ctx.span_id)
+    assert parse_token("@t:") is None
+    assert parse_token("not-a-token") is None
+
+
+# ---- disabled path ---------------------------------------------------
+
+def test_disabled_sampling_is_the_shared_noop(tracer):
+    # no tracer installed -> the one shared null span
+    set_dtracer(None)
+    assert dspan("x", None) is dspan("y", None)
+    assert dspan("x", TraceContext("t")) is dspan("y", None)
+    # tracer installed but the query unsampled (ctx=None): still null
+    set_dtracer(tracer)
+    assert dspan("x", None) is dspan("y", None)
+    sp = dspan("x", None)
+    assert sp.ctx is None
+    sp.finish(ignored=1)  # no-op, accepts kwargs
+    with sp:
+        pass  # reentrant
+
+
+def test_sample_rate_zero_never_samples(tmp_path):
+    t = DTracer(str(tmp_path), "p", sample=0.0)
+    try:
+        assert all(t.sample() is None for _ in range(64))
+    finally:
+        t.close()
+
+
+def test_unsampled_submits_mint_no_metric_cells():
+    """The cost-attribution cells are pre-labeled at engine
+    construction: serving unsampled queries (ctx=None everywhere) must
+    not allocate registry objects."""
+    eng = PipelinedQueryEngine(N, EDGES, max_wait_ms=5.0)
+    try:
+        eng.submit(0, 50).wait(timeout=60.0)  # warm every lazy mint
+        before = REGISTRY.child_count()
+        for s, d in ((1, 60), (2, 70), (3, 80)):
+            res = eng.submit(s, d, ctx=None).wait(timeout=60.0)
+            assert res.hops == solve_serial(N, EDGES, s, d).hops
+        assert REGISTRY.child_count() == before
+    finally:
+        eng.close()
+
+
+# ---- spool + merger --------------------------------------------------
+
+def test_spool_merge_parentage_and_stage_spans(tmp_path, tracer):
+    ctx = tracer.sample()
+    assert ctx is not None and ctx.span_id == ""
+    with dspan("ingress_test", ctx, src=0, dst=9) as root:
+        child = dspan("inner", root.ctx)
+        child.finish(batch=3)
+    t0 = time.perf_counter() - 0.01
+    dtrace.emit_span("queue", root.ctx, t0, 0.01)
+    rep = merge_spools(tracer.spool_dir)
+    assert rep["files"] == 1 and rep["spans"] == 3
+    assert rep["orphan_parents"] == 0
+    (tr,) = rep["traces"]
+    assert tr["trace"] == ctx.trace_id and tr["spans"] == 3
+    assert tr["procs"] == ["testproc"]
+    # Chrome-trace payload: M metadata per pid + one X event per span
+    out = tmp_path / "merged.json"
+    merge_spools(tracer.spool_dir, out_path=str(out))
+    events = json.load(open(out))
+    assert [e["ph"] for e in events].count("X") == 3
+    meta = [e for e in events if e["ph"] == "M"]
+    assert meta and meta[0]["args"]["name"] == "testproc"
+    names = {e["name"] for e in events if e["ph"] == "X"}
+    assert names == {"ingress_test", "inner", "queue"}
+    # every child event carries its parent span id for the UI
+    inner = next(e for e in events if e["name"] == "inner")
+    assert inner["args"]["parent"] == root.ctx.span_id
+
+
+def test_merge_tolerates_torn_tail_and_flags_orphans(tmp_path, tracer):
+    ctx = tracer.sample()
+    dspan("ok", ctx).finish()
+    # a span claiming a parent nobody recorded: flagged, not fatal
+    dtrace.emit_span("orphan", TraceContext(ctx.trace_id, "feed" * 4),
+                     time.perf_counter(), 0.001)
+    # SIGKILL mid-write: the spool ends in a torn (unterminated) line
+    with open(tracer.path, "a") as f:
+        f.write('{"t":"x","s":"y","n":"torn"')
+    recs, bad = read_spool(tracer.path)
+    assert len(recs) == 2 and bad == 1
+    rep = merge_spools(tracer.spool_dir)
+    assert rep["truncated_lines"] == 1
+    assert rep["orphan_parents"] == 1
+    assert cross_process_traces(rep, min_procs=1) == []  # orphaned
+    # the CLI surfaces the same verdict
+    assert dtrace.main(["merge", tracer.spool_dir]) == 1
+
+
+def test_trace_flush_chaos_seam_drops_spans_not_queries(tmp_path):
+    """An injected ``trace_flush`` fault (InjectedFault is a
+    RuntimeError) is swallowed by the spool writer: the span is
+    dropped and counted, nothing propagates to the caller."""
+    plan = FaultPlan.parse("trace_flush:times=1")
+    t = DTracer(str(tmp_path), "p", sample=1.0, faults=plan)
+    try:
+        ctx = t.sample()
+        t.span("a", ctx).finish()  # eaten by the injected fault
+        t.span("b", ctx).finish()  # plan exhausted: spools normally
+        assert t.dropped == 1
+        recs, _ = read_spool(t.path)
+        assert [r["n"] for r in recs] == ["b"]
+    finally:
+        t.close()
+
+
+def test_spool_write_after_close_drops_not_raises(tmp_path):
+    t = DTracer(str(tmp_path), "p", sample=1.0)
+    t.close()
+    t.span("late", t.sample()).finish()  # interpreter-teardown shape
+    assert t.dropped == 1
+
+
+# ---- per-stage cost attribution --------------------------------------
+
+def test_stage_histogram_cells_and_engine_stage_stats():
+    cells = stage_histogram()
+    assert set(cells) == set(STAGES)
+    eng = PipelinedQueryEngine(N, EDGES, max_wait_ms=5.0)
+    try:
+        for s, d in ((0, 50), (5, 90), (7, 140)):
+            eng.submit(s, d).wait(timeout=60.0)
+        # route-keyed breakdown: {route: {stage: {"n", "s"}}}; queue
+        # is per-query, launch/resolve batch-grain (the host path has
+        # no separate finish leg)
+        flat: dict = {}
+        for acc in eng.stats()["stages"].values():
+            for stage, cell in acc.items():
+                f = flat.setdefault(stage, [0, 0.0])
+                f[0] += cell["n"]
+                f[1] += cell["s"]
+        assert flat["queue"][0] >= 3
+        for st in ("launch", "resolve"):
+            assert flat[st][0] >= 1 and flat[st][1] >= 0.0
+        text = REGISTRY.render()
+        assert 'bibfs_stage_seconds_count{stage="queue"}' in text
+    finally:
+        eng.close()
+
+
+# ---- flight recorder -------------------------------------------------
+
+def test_flight_recorder_ring_is_bounded(tmp_path):
+    rec = FlightRecorder(capacity=8)
+    for i in range(50):
+        rec.note("query", i=i)
+    snap = rec.snapshot()
+    assert len(snap["entries"]) == 8
+    assert [e["i"] for e in snap["entries"]] == list(range(42, 50))
+    path = str(tmp_path / "fr.json")
+    assert rec.dump(path, reason="demand") == path
+    dumped = json.load(open(path))
+    assert dumped["reason"] == "demand"
+    assert len(dumped["entries"]) == 8
+
+
+def test_flight_recorder_dumps_on_injected_device_fault(tmp_path):
+    """A ``device`` fault trip dumps the armed ring atomically — the
+    chaos post-mortem path, through the real serve/faults hook."""
+    dump = str(tmp_path / "fault.flightrec.json")
+    dtrace.FLIGHT.configure(dump_path=dump)
+    dtrace.FLIGHT._last_fault_dump = 0.0  # defeat the rate limiter
+    dtrace.FLIGHT.note("query", src=3, dst=40)
+    plan = FaultPlan.parse("device:times=1")
+    try:
+        with pytest.raises(InjectedFault):
+            plan.fire("device")
+        dumped = json.load(open(dump))
+        assert dumped["reason"] == "fault"
+        kinds = [e["kind"] for e in dumped["entries"]]
+        assert "fault" in kinds and "query" in kinds
+        # the FLIGHT singleton may carry other tests' fault trips
+        # (e.g. the trace_flush seam); ours must be among them
+        assert any(e["kind"] == "fault" and e.get("site") == "device"
+                   for e in dumped["entries"])
+    finally:
+        dtrace.FLIGHT.configure(dump_path="")
+        dtrace.FLIGHT._dump_path = None
+
+
+# ---- cross-process ---------------------------------------------------
+
+@pytest.mark.slow
+def test_cross_process_parentage_net_child(tmp_path):
+    """One sampled query through a REAL spawned ``bibfs-serve --port``
+    child: the client-side net_client span and the child's ingress/
+    queue/resolve spans land in separate per-pid spools and merge into
+    ONE trace across two OS processes with fully-resolved parentage."""
+    from bibfs_tpu.graph.io import write_graph_bin
+
+    gpath = tmp_path / "g.bin"
+    write_graph_bin(gpath, N, EDGES)
+    spool = str(tmp_path / "spool")
+    port_file = str(tmp_path / "net.port")
+    env = {**os.environ, "PYTHONUNBUFFERED": "1",
+           dtrace.ENV_SPOOL: spool, dtrace.ENV_SAMPLE: "1.0"}
+    proc = subprocess.Popen(
+        [sys.executable, "-u", "-m", "bibfs_tpu.serve.cli",
+         str(gpath), "--pipeline", "--no-path",
+         "--max-wait-ms", "5", "--port", "0",
+         "--port-file", port_file],
+        stdin=subprocess.DEVNULL, stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL, env=env,
+    )
+    client = None
+    mine = DTracer(spool, "client", sample=1.0)
+    prev = set_dtracer(mine)
+    try:
+        deadline = time.monotonic() + 180.0
+        addr = None
+        while addr is None:
+            assert proc.poll() is None, "child died before binding"
+            assert time.monotonic() < deadline, "no port file"
+            addr = read_port_file(port_file)
+            if addr is None:
+                time.sleep(0.05)
+        client = NetClient(addr[0], addr[1])
+        for s, d in ((0, 50), (3, 40)):
+            res = client.submit(s, d, ctx=mine.sample()).wait(
+                timeout=60.0)
+            assert res.hops == solve_serial(N, EDGES, s, d).hops
+        # flightrec control op answers over the wire
+        fr = client.request("flightrec")
+        assert fr["capacity"] > 0 and fr["pid"] == proc.pid
+        proc.send_signal(signal.SIGTERM)
+        assert proc.wait(timeout=60.0) == 0
+    finally:
+        set_dtracer(prev)
+        mine.close()
+        if client is not None:
+            client.close()
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=10.0)
+    rep = merge_spools(spool)
+    assert rep["truncated_lines"] == 0
+    good = cross_process_traces(rep, min_procs=2)
+    assert len(good) == 2  # both sampled queries crossed the wire
+    for tr in good:
+        assert tr["orphan_parents"] == 0
+        assert set(tr["procs"]) == {"client", "serve"}
+    # the net_client span measured the wire stage from both clocks
+    names = {r["n"] for f in os.listdir(spool) if f.endswith(".jsonl")
+             for r in read_spool(os.path.join(spool, f))[0]}
+    assert {"net_client", "net_ingress", "queue", "resolve"} <= names
+    # and the CLI gates green on the same spool
+    assert dtrace.main(["merge", spool, "--min-procs", "2"]) == 0
