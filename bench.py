@@ -2663,9 +2663,111 @@ def serve_memtier_main():
         return 1
 
 
+# --serve-elastic defaults: the self-healing elastic fleet soak
+# (bibfs_tpu/serve/loadgen.run_elastic). A Supervisor over a Router of
+# deliberately throttled bibfs-serve children takes a ~10x open-loop
+# ramp while one replica is SIGKILLed: scale-out, scale-in, dead
+# respawn, zero lost acked tickets, bounded probe p99 and zero
+# flapping are all gated. Then the pod-worker failure-domain leg
+# (epoch fencing, zombie late acks, supervisor heal) and the overload
+# brownout leg (deadline-feasibility + ladder shedding). --quick is
+# the CI smoke shape (smaller graph, shorter spans, 2-replica cap).
+ELASTIC_GRID = os.environ.get("BENCH_ELASTIC_GRID", "64x64")
+ELASTIC_BASE_QPS = float(os.environ.get("BENCH_ELASTIC_BASE_QPS", 50.0))
+ELASTIC_RAMP_MULT = float(os.environ.get("BENCH_ELASTIC_RAMP_MULT", 10.0))
+ELASTIC_RAMP_S = float(os.environ.get("BENCH_ELASTIC_RAMP_S", 6.0))
+ELASTIC_TRAIL_S = float(os.environ.get("BENCH_ELASTIC_TRAIL_S", 30.0))
+ELASTIC_MAX_REPLICAS = int(os.environ.get("BENCH_ELASTIC_MAX_REPLICAS", 3))
+ELASTIC_P99_BOUND_MS = float(
+    os.environ.get("BENCH_ELASTIC_P99_BOUND_MS", 30000.0)
+)
+
+
+def serve_elastic_main():
+    """``python bench.py --serve-elastic``: the self-healing elastic
+    fleet soak (bibfs_tpu/serve/loadgen.run_elastic). Three legs, one
+    artifact: the autoscaling Supervisor under a ~10x ramp with a
+    mid-ramp SIGKILL (scale-out AND scale-in witnessed, dead replica
+    respawned, zero lost acked tickets, survivors exact vs the serial
+    oracle, probe p99 bounded, zero flapping inside a cooldown
+    window, zero compile-sentinel events in the trail); pod-worker
+    failure domains (join-barrier abort -> local-ladder degrade,
+    heartbeat-driven respawn + epoch rejoin + graph re-broadcast,
+    zombie late acks fenced); and overload brownout at the front door
+    (infeasible deadlines and expensive kinds shed structured with
+    ``retry_after_ms``, point lookups immune, hysteresis release).
+    Artifact: ``bench_elastic.json``."""
+    t_setup = time.time()
+    platform, tpu_error = select_platform()
+    try:
+        from bibfs_tpu.graph.generate import grid_graph
+        from bibfs_tpu.serve.loadgen import run_elastic
+
+        quick = "--quick" in sys.argv
+        grid_spec = "32x32" if quick else ELASTIC_GRID
+        try:
+            w, h = (int(x) for x in grid_spec.split("x"))
+        except ValueError:
+            print(f"bad BENCH_ELASTIC_GRID {ELASTIC_GRID!r} (want WxH)",
+                  file=sys.stderr)
+            return 1
+        edges = grid_graph(w, h, perforation=0.02, seed=0)
+        out = run_elastic(
+            w * h, edges,
+            base_qps=30.0 if quick else ELASTIC_BASE_QPS,
+            ramp_mult=ELASTIC_RAMP_MULT,
+            warm_span_s=2.0 if quick else 3.0,
+            ramp_span_s=4.0 if quick else ELASTIC_RAMP_S,
+            trail_span_s=20.0 if quick else ELASTIC_TRAIL_S,
+            max_replicas=2 if quick else ELASTIC_MAX_REPLICAS,
+            p99_bound_ms=(
+                60000.0 if quick else ELASTIC_P99_BOUND_MS
+            ),
+        )
+        line = {
+            "metric": f"bibfs_serve_elastic_{w * h}",
+            "value": out["elastic_phase"].get("probe_p99_ms"),
+            "unit": "ms",
+            "graph": f"grid({w}x{h}, perf=0.02)",
+            "platform": platform,
+            "quick": quick,
+            **out,
+            "total_s": round(time.time() - t_setup, 1),
+        }
+        if tpu_error:
+            line["tpu_error"] = tpu_error[:300]
+        _write_artifact("bench_elastic.json", line)
+        print(json.dumps({
+            "metric": line["metric"],
+            "value": line["value"],
+            "unit": "ms",
+            "ok": line["ok"],
+            "gates": out["gates"],
+            "events": [
+                (e["dir"], e["reason"])
+                for e in out["elastic_phase"].get("events", [])
+            ],
+            "fenced_frames": out["pod_phase"].get("fenced_frames"),
+            "detail_file": "bench_elastic.json",
+        }))
+        return 0 if line["ok"] else 1
+    except Exception as e:
+        import traceback
+
+        traceback.print_exc()
+        print(json.dumps({
+            "metric": "bibfs_serve_elastic",
+            "value": None,
+            "error": f"{type(e).__name__}: {e}"[:400],
+        }))
+        return 1
+
+
 if __name__ == "__main__":
     if "--calibrate" in sys.argv:
         sys.exit(calibrate_main())
+    elif "--serve-elastic" in sys.argv:
+        sys.exit(serve_elastic_main())
     elif "--serve-net" in sys.argv:
         sys.exit(serve_net_main())
     elif "--pod-dryrun" in sys.argv:

@@ -15,6 +15,13 @@ consumes replica health, failures re-route with retry/backoff, and
 swaps across the fleet one drained replica at a time. ``bibfs-fleet``
 is the CLI; ``bench.py --serve-fleet`` the kill/restart + rolling-swap
 soak (``bench_fleet.json``).
+
+The self-healing elastic layer (ROADMAP item 2) sits on top:
+:class:`~bibfs_tpu.fleet.supervisor.Supervisor` autoscales the fleet
+(hysteresis + cooldown flap damping over the replicas' own serving
+telemetry), respawns dead replicas, repairs stuck catch-ups from the
+durable store, and heals watched pod meshes; ``bench.py
+--serve-elastic`` is its soak (``bench_elastic.json``).
 """
 
 from bibfs_tpu.fleet.netreplica import NetReplica  # noqa: F401
@@ -28,4 +35,10 @@ from bibfs_tpu.fleet.router import (  # noqa: F401
     FLEET_METRIC_FAMILIES,
     FleetTicket,
     Router,
+)
+from bibfs_tpu.fleet.supervisor import (  # noqa: F401
+    ScalePolicy,
+    Supervisor,
+    Verdict,
+    decide_scale,
 )

@@ -36,7 +36,10 @@ resolve (failover included). ``--metrics-port`` serves the process
 registry — fleet families ``bibfs_fleet_replicas{state}``,
 ``bibfs_fleet_routed_total{replica}``, ``bibfs_fleet_reroutes_total``,
 ``bibfs_fleet_rolls_total``, ``bibfs_fleet_spills_total``,
-``bibfs_fleet_catchups_total`` — over HTTP.
+``bibfs_fleet_catchups_total`` — over HTTP, plus ``/healthz`` backed
+by the router's table (degraded with per-replica reasons — dead,
+draining, catchup-stuck — stays 200 while anything still routes;
+unready is 503).
 """
 
 from __future__ import annotations
@@ -315,6 +318,11 @@ def main(argv=None):
 
     router = Router(replicas, spill_after=args.spill_after)
     scrape.router = router
+    if metrics_server is not None:
+        # /healthz speaks the router's table: ready, degraded (with
+        # per-replica reasons — dead, draining, catchup-stuck) still
+        # 200, unready 503 when nothing routes
+        metrics_server.set_health(router.health_snapshot)
     print(
         "[Fleet] {k} replica(s): {names}".format(
             k=len(replicas),

@@ -40,13 +40,13 @@ import threading
 import time
 
 from bibfs_tpu.analysis import guarded_by
-from bibfs_tpu.fleet.replica import ReplicaDead
+from bibfs_tpu.fleet.replica import LifecycleHooks, ReplicaDead
 from bibfs_tpu.serve.net import NetClient, read_port_file
 from bibfs_tpu.serve.resilience import QueryError
 
 
 @guarded_by("_lock", "_client", "_dead")
-class NetReplica:
+class NetReplica(LifecycleHooks):
     """A spawned ``bibfs-serve --pipeline --port 0`` child driven over
     the framed TCP front door (module docstring)."""
 
@@ -336,12 +336,14 @@ class NetReplica:
             pass
         if client is not None:
             client.close()
+        self._notify_lifecycle("kill")
 
     def restart(self) -> None:
         if self._proc.poll() is None:
             self.kill()
         self._draining = False
         self._spawn()
+        self._notify_lifecycle("restart")
 
     def close(self) -> None:
         """Graceful: SIGTERM lets the child drain its front door and

@@ -55,8 +55,32 @@ class ReplicaDead(RuntimeError):
     replica dead ahead of the next health poll."""
 
 
+class LifecycleHooks:
+    """kill/restart notification plumbing shared by the replica
+    drivers: the router subscribes its poller nudge here so a chaos
+    kill or a supervisor respawn is observed within one immediate poll
+    tick instead of a full ``poll_interval_s``. Callbacks run on the
+    event's own thread and must be cheap and non-raising (an
+    ``Event.set``); failures are swallowed — a broken subscriber must
+    not break the kill/restart it observes."""
+
+    def on_lifecycle(self, cb) -> None:
+        """Subscribe ``cb(name, event)`` to this replica's lifecycle
+        events (``"kill"`` / ``"restart"``)."""
+        if not hasattr(self, "_lifecycle_cbs"):
+            self._lifecycle_cbs = []
+        self._lifecycle_cbs.append(cb)
+
+    def _notify_lifecycle(self, event: str) -> None:
+        for cb in list(getattr(self, "_lifecycle_cbs", ())):
+            try:
+                cb(self.name, event)
+            except Exception:
+                pass
+
+
 @guarded_by("_lock", "_engine")
-class EngineReplica:
+class EngineReplica(LifecycleHooks):
     """An in-process serving engine behind the replica interface.
 
     Parameters
@@ -277,6 +301,7 @@ class EngineReplica:
             eng, self._engine = self._engine, None
         if eng is not None:
             eng.kill()
+        self._notify_lifecycle("kill")
 
     def restart(self) -> None:
         """Bring the replica back over the same store (fresh engine,
@@ -288,6 +313,7 @@ class EngineReplica:
                 self.generation += 1
             self._draining = False
             self._dead = False
+        self._notify_lifecycle("restart")
 
     def close(self) -> None:
         self._dead = True
@@ -358,7 +384,7 @@ _CONTROL_PREFIXES = (
 # re-checked inside the lock where it matters — submit's roll race)
 @guarded_by("_lock", "_pending", "_control", "_current_graph", "_dead",
             "_proc")
-class ProcessReplica:
+class ProcessReplica(LifecycleHooks):
     """A spawned ``bibfs-serve`` subprocess behind the replica
     interface (module docstring). The child runs ``--pipeline`` so
     queries resolve on its background flusher within ``max_wait_ms``;
@@ -996,12 +1022,14 @@ class ProcessReplica:
             self._proc.wait(timeout=10.0)
         except Exception:
             pass
+        self._notify_lifecycle("kill")
 
     def restart(self) -> None:
         if self._proc.poll() is None:
             self.kill()
         self._draining = False
         self._spawn()
+        self._notify_lifecycle("restart")
 
     def close(self) -> None:
         """Graceful: EOF on stdin lets the child drain and exit 0

@@ -176,7 +176,14 @@ class FleetTicket:
                         f"query ({self.src}, {self.dst}) unresolved "
                         f"after {timeout}s (replica {self.replica})"
                     )
-            replica = self._router._replicas[self.replica]
+            replica = self._router._replicas.get(self.replica)
+            if replica is None:  # retired mid-flight (scale-in)
+                if not self._router._reroute(
+                        self,
+                        ReplicaDead(f"replica {self.replica} retired"),
+                        blocking=True):
+                    raise self.error
+                continue
             try:
                 self.result = replica.wait_ticket(
                     self._inner, timeout=remaining
@@ -194,7 +201,7 @@ class FleetTicket:
 # design (_pick's GIL-atomic table read is the hot path)
 @guarded_by("_table_lock", "_states", "_versions", "_committed",
             "_roll_history", "_needs_catchup", "_forced_drain",
-            "_last_gen")
+            "_last_gen", "_catchup_since")
 class Router:
     """Front-end router over N replicas (module docstring).
 
@@ -235,9 +242,10 @@ class Router:
         self._retry = RetryPolicy(attempts=3) if retry is None else retry
         self.poll_interval_s = float(poll_interval_s)
         self.spill_after = int(spill_after or 0)
+        self._vnodes = int(vnodes)
         ring = []
         for name in self._order:
-            for i in range(int(vnodes)):
+            for i in range(self._vnodes):
                 ring.append((_hash64(f"{name}#{i}"), name))
         ring.sort()
         self._ring = ring
@@ -259,6 +267,10 @@ class Router:
             name: getattr(r, "generation", 0)
             for name, r in self._replicas.items()
         }
+        # when each replica ENTERED the catchup table state (monotonic)
+        # — the stuck-duration source for the bibfs_fleet_catchup_stuck
+        # gauge, stats()["pending_catchup"] and health_snapshot()
+        self._catchup_since: dict[str, float] = {}
         self.obs_label = (
             next_instance_label("router") if obs_label is None
             else obs_label
@@ -270,15 +282,29 @@ class Router:
         )
         for s in TABLE_STATES:  # render at zero from the first scrape
             self._g_replicas.labels(router=self.obs_label, state=s).set(0)
-        routed = REGISTRY.counter(
+        # the family handle outlives the ctor: add_replica mints a cell
+        # for every replica that joins after construction
+        self._c_routed_family = REGISTRY.counter(
             "bibfs_fleet_routed_total",
             "Queries dispatched per replica",
             ("router", "replica"),
         )
         self._routed_cells = {
-            name: routed.labels(router=self.obs_label, replica=name)
+            name: self._c_routed_family.labels(
+                router=self.obs_label, replica=name
+            )
             for name in self._order
         }
+        self._g_catchup_stuck = REGISTRY.gauge(
+            "bibfs_fleet_catchup_stuck",
+            "Seconds a replica has been held in the catchup table "
+            "state (0 = not stuck)",
+            ("router", "replica"),
+        )
+        for name in self._order:  # render at zero from the first scrape
+            self._g_catchup_stuck.labels(
+                router=self.obs_label, replica=name
+            ).set(0)
         self._c_reroutes = REGISTRY.counter(
             "bibfs_fleet_reroutes_total",
             "Queries re-routed off a failed/refusing replica",
@@ -302,13 +328,27 @@ class Router:
             ("router",),
         ).labels(router=self.obs_label)
         self._closed = False
-        self._poll_once()  # routing works before the first poller tick
         self._poll_stop = threading.Event()
+        # set by nudge_poll() (replica kill/restart hooks, supervisor
+        # scale events) to cut the re-admit latency floor from
+        # poll_interval_s to one immediate tick
+        self._poll_nudge = threading.Event()
+        for r in self._replicas.values():
+            self._subscribe_lifecycle(r)
+        self._poll_once()  # routing works before the first poller tick
         self._poller = threading.Thread(
             target=self._poll_main, name="bibfs-fleet-poller",
             daemon=True,
         )
         self._poller.start()
+
+    def _subscribe_lifecycle(self, replica) -> None:
+        """Wire a replica's kill/restart notifications to an immediate
+        poll tick (the re-admit latency cut): duck-typed, so anything
+        replica-shaped without the hook still routes."""
+        hook = getattr(replica, "on_lifecycle", None)
+        if hook is not None:
+            hook(lambda _name, _event: self.nudge_poll())
 
     # ---- submission --------------------------------------------------
     def replica(self, name: str):
@@ -317,6 +357,90 @@ class Router:
     @property
     def replica_names(self) -> list:
         return list(self._order)
+
+    # ---- elastic membership -----------------------------------------
+    def _ring_of_locked(self, order) -> list:
+        ring = []
+        for name in order:
+            for i in range(self._vnodes):
+                ring.append((_hash64(f"{name}#{i}"), name))
+        ring.sort()
+        return ring
+
+    def add_replica(self, replica) -> None:
+        """Admit one replica into the fleet at runtime (supervisor
+        scale-out). It enters the table as ``live`` (not routable) and
+        is admitted by the nudged poll tick once its health reads
+        ready; a fleet with committed rolls on record version-checks
+        it through the catch-up gate like any recovering replica.
+        Hot-path readers stay lock-free: the replica dict, order, ring
+        and key list are REPLACED wholesale (GIL-atomic reference
+        assignments), never mutated in place."""
+        name = replica.name
+        # mint the per-replica cells BEFORE the replica becomes
+        # pickable: a dispatch racing the admitting poll tick must
+        # find its routed cell in place
+        self._routed_cells[name] = self._c_routed_family.labels(
+            router=self.obs_label, replica=name
+        )
+        self._g_catchup_stuck.labels(
+            router=self.obs_label, replica=name
+        ).set(0)
+        with self._table_lock:
+            if name in self._replicas:
+                raise ValueError(f"replica name already routed: {name!r}")
+            replicas = dict(self._replicas)
+            replicas[name] = replica
+            order = sorted(replicas)
+            ring = self._ring_of_locked(order)
+            self._states[name] = "live"
+            self._last_gen[name] = getattr(replica, "generation", 0)
+            if self._committed:
+                # never admit a late joiner at a stale version: it
+                # passes the same version gate a recovering replica does
+                self._needs_catchup.add(name)
+            self._replicas = replicas
+            self._order = order
+            self._ring = ring
+            self._ring_keys = [h for h, _ in ring]
+        self._subscribe_lifecycle(replica)
+        self.nudge_poll()
+
+    def remove_replica(self, name: str, *, close: bool = True) -> None:
+        """Retire one replica at runtime (supervisor scale-in or
+        stuck-catchup replacement). The caller is expected to have
+        drained it (``begin_drain`` + ``flush``) so no acked ticket is
+        lost; anything still in flight fails over through the normal
+        reroute path. A router keeps at least one replica."""
+        with self._table_lock:
+            if name not in self._replicas:
+                return
+            if len(self._replicas) == 1:
+                raise ValueError("a router needs at least one replica")
+            replicas = dict(self._replicas)
+            replica = replicas.pop(name)
+            order = sorted(replicas)
+            ring = self._ring_of_locked(order)
+            self._replicas = replicas
+            self._order = order
+            self._ring = ring
+            self._ring_keys = [h for h, _ in ring]
+            self._states.pop(name, None)
+            self._forced_drain.pop(name, None)
+            self._needs_catchup.discard(name)
+            self._catchup_since.pop(name, None)
+            self._last_gen.pop(name, None)
+            self._drop_versions_locked(name)
+        self._routed_cells.pop(name, None)
+        self._g_catchup_stuck.labels(
+            router=self.obs_label, replica=name
+        ).set(0)
+        if close:
+            try:
+                replica.close()
+            except Exception:
+                pass
+        self.nudge_poll()
 
     def submit(self, src: int, dst: int, graph: str | None = None,
                ctx=None) -> FleetTicket:
@@ -382,7 +506,10 @@ class Router:
         last_err = None
         for _ in range(len(self._replicas) + 1):
             name = self._pick(ticket.graph, tried)
-            replica = self._replicas[name]
+            replica = self._replicas.get(name)
+            if replica is None:  # retired between table read and here
+                tried.add(name)
+                continue
             # version BEFORE submit: a rolling swap that lands while
             # this query sits in the replica's queue still resolves it
             # PRE-swap (the roll's drain flushes the queue before the
@@ -426,7 +553,9 @@ class Router:
             ticket.attempts += 1
             ticket.tried.add(name)
             ticket.declared_version = version
-            self._routed_cells[name].inc()
+            cell = self._routed_cells.get(name)
+            if cell is not None:
+                cell.inc()
             return
         raise QueryError(
             "no healthy replica accepted the query",
@@ -495,14 +624,24 @@ class Router:
         avail = eligible - exclude or eligible
         target = self._ring_walk(str(graph or ""), avail)
         if self.spill_after and len(avail) > 1:
-            tload = self._replicas[target].load()
+            tload = self._load_of(target)
             if tload >= self.spill_after:
-                alt = min(avail,
-                          key=lambda n: self._replicas[n].load())
-                if alt != target and self._replicas[alt].load() < tload:
+                alt = min(avail, key=self._load_of)
+                if alt != target and self._load_of(alt) < tload:
                     self._c_spills.inc()
                     return alt
         return target
+
+    def _load_of(self, name: str) -> int:
+        """A replica's queue depth for spill/scale decisions; a replica
+        retired (or dying) mid-read reads as saturated."""
+        replica = self._replicas.get(name)
+        if replica is None:
+            return 1 << 30
+        try:
+            return replica.load()
+        except Exception:
+            return 1 << 30
 
     def _graph_key(self, graph: str | None) -> str:
         return str(graph or "")
@@ -544,7 +683,8 @@ class Router:
 
     def _poll_once(self) -> None:
         counts = {s: 0 for s in TABLE_STATES}
-        for name, replica in self._replicas.items():
+        # snapshot: membership may change under the supervisor mid-poll
+        for name, replica in list(self._replicas.items()):
             try:
                 state = replica.health()["state"]
                 if state not in counts:
@@ -581,8 +721,20 @@ class Router:
                 # bypass the version check)
                 if not self._try_catchup(name):
                     state = "catchup"
+            now = time.monotonic()
             with self._table_lock:
+                if name not in self._replicas:
+                    continue  # retired while we were polling it
                 self._states[name] = state
+                if state == "catchup":
+                    since = self._catchup_since.setdefault(name, now)
+                    stuck = now - since
+                else:
+                    self._catchup_since.pop(name, None)
+                    stuck = 0.0
+            self._g_catchup_stuck.labels(
+                router=self.obs_label, replica=name
+            ).set(round(stuck, 3))
             counts[state] += 1
         for s, c in counts.items():
             self._g_replicas.labels(
@@ -618,7 +770,9 @@ class Router:
         partially-recovered pending state, which could re-admit a
         replica whose declared version matches the fleet while its
         content does not."""
-        replica = self._replicas[name]
+        replica = self._replicas.get(name)
+        if replica is None:  # retired mid-poll
+            return False
         with self._table_lock:
             committed = dict(self._committed)
             history = {g: list(h) for g, h in self._roll_history.items()}
@@ -656,11 +810,24 @@ class Router:
         return True
 
     def _poll_main(self) -> None:
-        while not self._poll_stop.wait(self.poll_interval_s):
+        # the nudge event doubles as the tick timer: a kill/restart/
+        # scale event wakes the poller NOW instead of waiting out
+        # poll_interval_s (the documented re-admit latency floor)
+        while True:
+            self._poll_nudge.wait(self.poll_interval_s)
+            self._poll_nudge.clear()
+            if self._poll_stop.is_set():
+                return
             try:
                 self._poll_once()
             except Exception:
                 pass  # a poll hiccup must not kill the poller
+
+    def nudge_poll(self) -> None:
+        """Wake the health poller immediately (replica lifecycle events,
+        supervisor respawns) — an event, not a tighter interval, so the
+        steady-state poll cost is unchanged."""
+        self._poll_nudge.set()
 
     # ---- rolling swap ------------------------------------------------
     def rolling_swap(self, graph: str | None = None, adds=(), dels=(),
@@ -674,8 +841,10 @@ class Router:
         adds = [tuple(e) for e in adds]
         dels = [tuple(e) for e in dels]
         rows = []
-        for name in self._order:
-            replica = self._replicas[name]
+        for name in list(self._order):
+            replica = self._replicas.get(name)
+            if replica is None:  # retired mid-roll
+                continue
             row = {"replica": name, "ok": False}
             with span("fleet_roll", replica=name,
                       graph=self._graph_key(graph)):
@@ -767,7 +936,50 @@ class Router:
         with self._table_lock:
             return dict(self._states)
 
+    def catchup_stuck(self) -> dict:
+        """``{replica: seconds}`` for every replica currently held in
+        the ``catchup`` table state — the supervisor's escape-hatch
+        input and the stuck-gauge's source of truth."""
+        now = time.monotonic()
+        with self._table_lock:
+            return {
+                name: round(now - since, 3)
+                for name, since in self._catchup_since.items()
+            }
+
+    def health_snapshot(self) -> dict:
+        """The fleet's ``/healthz`` payload: ready while anything is
+        routable and nothing is wedged; degraded (still 200 — the
+        routable replicas ARE serving) with per-replica reasons when a
+        replica is dead, draining or stuck in catchup; unready when
+        nothing routes at all."""
+        now = time.monotonic()
+        with self._table_lock:
+            states = dict(self._states)
+            since = dict(self._catchup_since)
+        reasons = []
+        for name in sorted(states):
+            s = states[name]
+            if s == "catchup":
+                stuck = now - since.get(name, now)
+                reasons.append(
+                    f"replica {name} catchup ({stuck:.1f}s stuck)"
+                )
+            elif s in ("dead", "draining"):
+                reasons.append(f"replica {name} {s}")
+        routable = any(s in ROUTABLE_STATES for s in states.values())
+        if not routable:
+            state = "unready"
+        elif reasons:
+            state = "degraded"
+        else:
+            state = "ready"
+        return {"state": state, "reasons": reasons}
+
     def stats(self) -> dict:
+        now = time.monotonic()
+        replicas = self._replicas  # snapshot vs concurrent scale events
+        order = self._order
         with self._table_lock:
             states = dict(self._states)
             versions = {
@@ -775,16 +987,29 @@ class Router:
                 for (name, g), v in self._versions.items()
             }
             committed = dict(self._committed)
-            pending_catchup = sorted(self._needs_catchup)
+            # dict, not list (membership tests still work): each held
+            # replica carries how long it has been stuck — 0.0 until
+            # the poller has actually seen it in the catchup state
+            pending_catchup = {
+                name: {
+                    "stuck_s": round(
+                        now - self._catchup_since[name], 3
+                    ) if name in self._catchup_since else 0.0,
+                }
+                for name in sorted(self._needs_catchup)
+            }
         return {
             "replicas": {
                 name: {
                     "state": states.get(name),
-                    "kind": getattr(self._replicas[name], "kind", "?"),
-                    "routed": self._routed_cells[name].value,
-                    "load": self._replicas[name].load(),
+                    "kind": getattr(replicas[name], "kind", "?"),
+                    "routed": (
+                        self._routed_cells[name].value
+                        if name in self._routed_cells else 0
+                    ),
+                    "load": self._load_of(name),
                 }
-                for name in self._order
+                for name in order if name in replicas
             },
             "versions": versions,
             "committed": committed,
@@ -806,8 +1031,9 @@ class Router:
         is None too — a scrape never fails because one replica is
         down."""
         out: dict = {}
-        for name in self._order:
-            fn = getattr(self._replicas[name], "metrics_render", None)
+        for name in list(self._order):
+            fn = getattr(self._replicas.get(name), "metrics_render",
+                         None)
             if fn is None:
                 out[name] = None
                 continue
@@ -822,11 +1048,12 @@ class Router:
             return
         self._closed = True
         self._poll_stop.set()
+        self._poll_nudge.set()  # wake the poller so it sees the stop
         self._poller.join(timeout=10.0)
         if close_replicas:
-            for name in self._order:
+            for replica in list(self._replicas.values()):
                 try:
-                    self._replicas[name].close()
+                    replica.close()
                 except Exception:
                     pass
 

@@ -154,6 +154,23 @@ NET_METRIC_FAMILIES = (
     "bibfs_net_deadline_misses_total",
 )
 
+#: self-healing elastic layer (fleet/supervisor.py + fleet/router.py +
+#: parallel/podmesh.py + serve/net.py): scale events and the replica
+#: target mint at Supervisor construction, the catchup-stuck gauge at
+#: Router construction (per replica, zero when healthy), the worker
+#: epoch gauge at PodPrimary construction, and the admission-shed
+#: counter (brownout ladder + deadline-feasibility, reason-labeled)
+#: at NetServer construction — the elastic soak's render gate scrapes
+#: exactly this tuple, so every family must render at zero before the
+#: first scale event
+ELASTIC_METRIC_FAMILIES = (
+    "bibfs_fleet_scale_events_total",
+    "bibfs_fleet_replicas_target",
+    "bibfs_fleet_catchup_stuck",
+    "bibfs_pod_worker_epoch",
+    "bibfs_admission_shed_total",
+)
+
 #: distributed tracing + per-query cost attribution (obs/dtrace.py):
 #: the span-spool counter mints at DTracer construction, the
 #: flight-recorder dump counter at module import (process-singleton
@@ -197,6 +214,7 @@ ALL_METRIC_NAMES = frozenset(
     + ADAPTIVE_METRIC_FAMILIES
     + QUERY_METRIC_FAMILIES
     + NET_METRIC_FAMILIES
+    + ELASTIC_METRIC_FAMILIES
     + DTRACE_METRIC_FAMILIES
     + _FLEET_ONLY
     + (BUILD_INFO_METRIC,)

@@ -79,6 +79,7 @@ import numpy as np
 
 from bibfs_tpu.analysis import guarded_by
 from bibfs_tpu.obs.dtrace import ctx_fields, ctx_from_fields, dspan
+from bibfs_tpu.obs.metrics import REGISTRY, next_instance_label
 from bibfs_tpu.serve.net import MAX_FRAME_BYTES, encode_frame, extract_frames
 
 #: default pod control port offset from the jax.distributed coordinator
@@ -116,7 +117,8 @@ def _recv_frames(sock, buf: bytearray):
     return out
 
 
-@guarded_by("_lock", "_acks", "_dead", "_seq", "_workers")
+@guarded_by("_lock", "_acks", "_dead", "_seq", "_workers", "_epochs",
+            "_last_hb", "_fenced", "_regraph")
 class PodPrimary:
     """Process 0's side of the pod control plane (module docstring).
 
@@ -124,20 +126,53 @@ class PodPrimary:
     introduced itself, then starts one reader thread per connection.
     ``post_*`` broadcast a descriptor (single-writer by construction:
     the engine flusher); ``await_phase`` blocks on the ack mailbox.
+
+    **Failure domains (epoch fencing).** Every worker's hello declares
+    an incarnation ``epoch``, echoed on each of its acks/heartbeats.
+    The reader fences any frame whose epoch is not the worker's
+    CURRENT one — a zombie incarnation's late acks are dropped and
+    counted (:attr:`fenced_frames`) instead of feeding
+    ``await_phase``. A dead worker's replacement rejoins through
+    :meth:`accept_rejoin` at a strictly higher epoch; the next launch
+    re-broadcasts the graph through the existing chunk stream, so the
+    mesh rung RECOVERS rather than degrading forever. Workers spawned
+    with ``heartbeat_s`` send periodic ``hb`` frames;
+    :meth:`check_heartbeats` (the supervisor's tick) marks a silent
+    worker dead after ``heartbeat_timeout_s`` — the launch path then
+    aborts pre-collective exactly like an observed death.
     """
 
     def __init__(self, num_workers: int, *, host: str = "",
-                 port: int = 0, accept_timeout_s: float = 120.0):
+                 port: int = 0, accept_timeout_s: float = 120.0,
+                 heartbeat_timeout_s: float | None = None):
         self.num_workers = int(num_workers)
         self._accept_timeout_s = float(accept_timeout_s)
+        self._hb_timeout_s = (
+            None if heartbeat_timeout_s is None
+            else float(heartbeat_timeout_s)
+        )
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
         self._seq = 0
         self._workers: dict = {}       # process_index -> socket
         self._acks: dict = {}          # (seq, phase) -> {pidx: msg}
         self._dead: dict = {}          # process_index -> reason
+        self._epochs: dict = {}        # process_index -> current epoch
+        self._last_hb: dict = {}       # process_index -> monotonic
+        self._fenced = 0               # stale-epoch frames dropped
+        self._regraph = False          # rejoin -> re-broadcast graph
         self._last_digest: str | None = None  # flusher-only state
         self._closed = False
+        self._obs_label = next_instance_label("pod")
+        self._g_epoch = REGISTRY.gauge(
+            "bibfs_pod_worker_epoch",
+            "Each pod worker's current incarnation epoch",
+            ("pod", "worker"),
+        )
+        for pidx in range(1, self.num_workers + 1):  # render at zero
+            self._g_epoch.labels(
+                pod=self._obs_label, worker=str(pidx)
+            ).set(0)
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._listener.bind((host, int(port)))
@@ -151,6 +186,7 @@ class PodPrimary:
         :class:`PodError` past the accept timeout."""
         deadline = time.monotonic() + self._accept_timeout_s
         joined: dict = {}
+        epochs: dict = {}
         while len(joined) < self.num_workers:
             self._listener.settimeout(
                 max(0.1, deadline - time.monotonic())
@@ -169,11 +205,17 @@ class PodPrimary:
                 sock.close()
                 continue
             joined[pidx] = sock
+            epochs[pidx] = int(hello.get("epoch", 0))
         with self._lock:
             self._workers = joined
+            self._epochs = epochs
+        for pidx, epoch in epochs.items():
+            self._g_epoch.labels(
+                pod=self._obs_label, worker=str(pidx)
+            ).set(epoch)
         for pidx, sock in joined.items():
             threading.Thread(
-                target=self._reader, args=(pidx, sock),
+                target=self._reader, args=(pidx, sock, epochs[pidx]),
                 name=f"bibfs-pod-ack-{pidx}", daemon=True,
             ).start()
 
@@ -191,13 +233,27 @@ class PodPrimary:
                 return frames[0]
 
     # ---- ack plumbing ------------------------------------------------
-    def _reader(self, pidx: int, sock) -> None:
+    def _reader(self, pidx: int, sock, epoch: int = 0) -> None:
         buf = bytearray()
         why = "worker closed the control connection"
         try:
             while True:
                 for msg in _recv_frames(sock, buf):
                     with self._lock:
+                        # epoch fence: a frame from any incarnation
+                        # other than the worker's CURRENT one (a
+                        # zombie's late ack after a rejoin) is dropped
+                        # and counted — it must never feed await_phase.
+                        # An epoch-less frame defaults to THIS reader's
+                        # connection epoch, so a zombie cannot dodge
+                        # the fence by omitting the field.
+                        cur = self._epochs.get(pidx, 0)
+                        if int(msg.get("epoch", epoch)) != cur:
+                            self._fenced += 1
+                            continue
+                        if msg.get("op") == "hb":
+                            self._last_hb[pidx] = time.monotonic()
+                            continue
                         key = (int(msg.get("seq", -1)),
                                str(msg.get("phase", "done")))
                         self._acks.setdefault(key, {})[pidx] = msg
@@ -214,8 +270,11 @@ class PodPrimary:
         except (ConnectionError, OSError, ValueError) as e:
             why = str(e) or why
         with self._lock:
-            self._dead[pidx] = why
-            self._cv.notify_all()
+            # a fenced-out incarnation's reader exits SILENTLY: its
+            # socket death says nothing about the current incarnation
+            if self._epochs.get(pidx, 0) == epoch:
+                self._dead[pidx] = why
+                self._cv.notify_all()
 
     def await_phase(self, seq: int, phase: str,
                     timeout: float = 120.0) -> dict:
@@ -254,6 +313,108 @@ class PodPrimary:
                     f"({phase}): {msg.get('error', 'unspecified')}"
                 )
         return got
+
+    # ---- failure domains --------------------------------------------
+    @property
+    def fenced_frames(self) -> int:
+        """Stale-epoch frames dropped by the reader fence (zombie
+        incarnations' late acks) — the soak's fence witness."""
+        return self._fenced
+
+    def worker_epoch(self, pidx: int) -> int:
+        with self._lock:
+            return int(self._epochs.get(int(pidx), 0))
+
+    def dead_workers(self) -> dict:
+        """``{process_index: reason}`` for every worker currently known
+        dead — the supervisor's pod-heal input."""
+        with self._lock:
+            return dict(self._dead)
+
+    def check_heartbeats(self) -> list:
+        """Mark workers whose heartbeat went silent for longer than
+        ``heartbeat_timeout_s`` as dead; returns the newly-dead
+        process indexes. Only workers that have EVER heartbeat are
+        judged (a worker spawned without ``heartbeat_s`` opted out),
+        and a no-op when the primary was built without a timeout —
+        so legacy pods keep their exact pre-heartbeat behavior."""
+        if self._hb_timeout_s is None:
+            return []
+        now = time.monotonic()
+        newly: list = []
+        with self._lock:
+            for pidx, last in list(self._last_hb.items()):
+                if pidx in self._dead or pidx not in self._workers:
+                    continue
+                if now - last > self._hb_timeout_s:
+                    self._dead[pidx] = (
+                        f"heartbeat silent for {now - last:.1f}s"
+                    )
+                    newly.append(pidx)
+            if newly:
+                self._cv.notify_all()
+        return newly
+
+    def accept_rejoin(self, timeout_s: float = 30.0) -> int:
+        """Admit ONE respawned worker back into the mesh: accept its
+        connection, require a known process index at a STRICTLY higher
+        epoch than the incarnation being replaced (the fence that
+        keeps a zombie from re-admitting itself), swap the control
+        socket, clear the death record, and flag the next launch to
+        re-broadcast the graph through the existing chunk stream (the
+        respawned process holds no graph). Returns the process index;
+        raises :class:`PodError` past the timeout. The old incarnation's
+        socket is deliberately LEFT OPEN: a zombie is by definition
+        still alive, and closing its connection under it would discard
+        its late acks unseen — instead its reader keeps draining them
+        into the epoch fence (counted in :attr:`fenced_frames`) until
+        the zombie's own EOF retires the reader silently, so the
+        recovered incarnation is never re-marked dead by its
+        predecessor's death."""
+        deadline = time.monotonic() + timeout_s
+        while True:
+            if time.monotonic() >= deadline:
+                raise PodError(
+                    f"pod: no acceptable rejoin within {timeout_s}s"
+                )
+            self._listener.settimeout(
+                max(0.1, deadline - time.monotonic())
+            )
+            try:
+                sock, _addr = self._listener.accept()
+            except (socket.timeout, OSError):
+                raise PodError(
+                    f"pod: no rejoin connection within {timeout_s}s"
+                ) from None
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            try:
+                hello = self._read_hello(sock, deadline)
+            except PodError:
+                sock.close()
+                continue
+            pidx = int(hello.get("process", -1))
+            epoch = int(hello.get("epoch", 0))
+            with self._lock:
+                known = pidx in self._workers
+                cur = self._epochs.get(pidx, 0)
+            if pidx < 1 or not known or epoch <= cur:
+                sock.close()
+                continue
+            with self._lock:
+                self._workers[pidx] = sock
+                self._epochs[pidx] = epoch
+                self._dead.pop(pidx, None)
+                self._last_hb.pop(pidx, None)
+                self._regraph = True
+                self._cv.notify_all()
+            self._g_epoch.labels(
+                pod=self._obs_label, worker=str(pidx)
+            ).set(epoch)
+            threading.Thread(
+                target=self._reader, args=(pidx, sock, epoch),
+                name=f"bibfs-pod-ack-{pidx}e{epoch}", daemon=True,
+            ).start()
+            return pidx
 
     # ---- broadcasts (engine-flusher thread only) ---------------------
     def _post(self, desc: dict) -> int:
@@ -297,13 +458,20 @@ class PodPrimary:
         the primary building before the workers have the descriptor
         deadlocks in the transfer layer's rendezvous. Returns
         ``build()``'s result. Flusher-thread only; the digest memo
-        makes the steady-state cost one string compare per launch."""
-        if snapshot.digest == self._last_digest:
+        makes the steady-state cost one string compare per launch. A
+        worker rejoin (:meth:`accept_rejoin`) voids the memo via the
+        ``_regraph`` flag — the respawned incarnation holds no graph,
+        so the next launch re-broadcasts even an unchanged digest."""
+        with self._lock:
+            regraph = self._regraph
+        if not regraph and snapshot.digest == self._last_digest:
             return build() if build is not None else None
         seq = self.post_graph(snapshot)
         out = build() if build is not None else None
         self.await_phase(seq, "done", timeout)
         self._last_digest = snapshot.digest
+        with self._lock:
+            self._regraph = False
         return out
 
     def post_graph(self, snapshot) -> int:
@@ -467,12 +635,23 @@ def _build_worker_graph(msg: dict, parts: list, mesh):
 
 
 def run_pod_worker(host: str, port: int, *, process_index: int,
-                   connect_timeout_s: float = 120.0, log=None) -> int:
+                   connect_timeout_s: float = 120.0, log=None,
+                   epoch: int = 0,
+                   heartbeat_s: float | None = None) -> int:
     """The worker process's main loop (module docstring): connect to
     the primary's pod control port, then execute descriptors strictly
     in receipt order until ``shutdown`` (returns 0) or the primary
     closes the connection (returns 0 too — a vanished primary is a
     normal teardown, the jax.distributed layer owns crash detection).
+
+    ``epoch`` is this incarnation's fencing identity: it rides the
+    hello and every ack, so the primary can reject a previous
+    incarnation's late frames after this worker rejoined at a higher
+    epoch. ``heartbeat_s`` (None = off) starts a sender thread posting
+    ``hb`` frames at that cadence — the primary's
+    ``check_heartbeats`` marks this worker dead when they stop. The
+    socket gains a second writer with heartbeats on, so sends
+    serialize on a leaf write lock (the :class:`NetClient` pattern).
     """
     from bibfs_tpu.parallel.mesh import make_1d_mesh
     from bibfs_tpu.solvers import sharded as _sharded
@@ -482,13 +661,37 @@ def run_pod_worker(host: str, port: int, *, process_index: int,
         if log is not None:
             log(msg)
 
+    epoch = int(epoch)
     mesh = make_1d_mesh()  # the global mesh, spanning every process
     sock = _connect_retry(host, port, connect_timeout_s)
-    sock.sendall(encode_frame(
-        {"op": "hello", "process": int(process_index)}
+    wlock = threading.Lock()
+
+    def send(data: bytes) -> None:
+        with wlock:
+            sock.sendall(data)
+
+    send(encode_frame(
+        {"op": "hello", "process": int(process_index), "epoch": epoch}
     ))
     say(f"[Pod] worker {process_index}: joined {host}:{port} "
-        f"({mesh.devices.size}-device global mesh)")
+        f"(epoch {epoch}, {mesh.devices.size}-device global mesh)")
+    hb_stop = threading.Event()
+    if heartbeat_s is not None:
+        def _hb_main() -> None:
+            frame = encode_frame({
+                "op": "hb", "process": int(process_index),
+                "epoch": epoch,
+            })
+            while not hb_stop.wait(heartbeat_s):
+                try:
+                    send(frame)
+                except OSError:
+                    return
+
+        threading.Thread(
+            target=_hb_main,
+            name=f"bibfs-pod-hb-{process_index}", daemon=True,
+        ).start()
     graphs: dict = {}  # digest -> ShardedGraph (current only)
     buf = bytearray()
     pending: deque = deque()  # decoded frames not yet dispatched
@@ -499,8 +702,8 @@ def run_pod_worker(host: str, port: int, *, process_index: int,
         return pending.popleft()
 
     def ack(seq, phase, ok, **extra):
-        sock.sendall(encode_frame(
-            dict(extra, seq=seq, phase=phase, ok=ok)
+        send(encode_frame(
+            dict(extra, seq=seq, phase=phase, ok=ok, epoch=epoch)
         ))
 
     def await_verdict(seq: int) -> bool:
@@ -609,6 +812,7 @@ def run_pod_worker(host: str, port: int, *, process_index: int,
                 continue
             ack(seq, "done", False, error=f"unknown op {op!r}")
     finally:
+        hb_stop.set()
         try:
             sock.close()
         except OSError:

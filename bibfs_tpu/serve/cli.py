@@ -481,6 +481,14 @@ def main(argv=None):
         "carry none (default: none — requests wait for their result)",
     )
     ap.add_argument(
+        "--net-brownout",
+        action="store_true",
+        help="arm the overload brownout on --port (serve/net.py "
+        "BrownoutPolicy defaults): deadline-feasibility shedding plus "
+        "the expensive-kind ladder, counted in "
+        "bibfs_admission_shed_total. Default: off — no shedding",
+    )
+    ap.add_argument(
         "--coordinator",
         default=None,
         metavar="HOST:PORT",
@@ -811,7 +819,11 @@ def _serve_net(args, engine, store) -> int:
     import signal
     import threading
 
-    from bibfs_tpu.serve.net import NetServer, write_port_file
+    from bibfs_tpu.serve.net import (
+        BrownoutPolicy,
+        NetServer,
+        write_port_file,
+    )
 
     try:
         server = NetServer(
@@ -820,6 +832,7 @@ def _serve_net(args, engine, store) -> int:
             quota_qps=args.net_quota_qps,
             quota_burst=args.net_quota_burst,
             default_deadline_ms=args.net_deadline_ms,
+            brownout=BrownoutPolicy() if args.net_brownout else None,
         )
     except OSError as e:
         print(f"Error: cannot bind --port {args.port}: {e}",

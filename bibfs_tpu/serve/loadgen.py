@@ -36,6 +36,7 @@ import os
 import sys
 import threading
 import time
+from collections import deque
 
 import numpy as np
 
@@ -4685,5 +4686,748 @@ def run_pod_dryrun(
                 handle.close()
             except OSError:
                 pass
+        if own_workdir:
+            shutil.rmtree(workdir, ignore_errors=True)
+
+class _ScriptedPodWorker:
+    """A pod worker's control half scripted for the elastic soak: real
+    sockets, real length-prefixed frames, real heartbeats — no solver
+    behind it, so a fault can be injected at an exact protocol point.
+    ``silent.set()`` turns it into the zombie incarnation (keeps
+    reading so the primary's sends never block, but stops acking AND
+    heartbeating — the GC-pause/partition shape); :meth:`ack` doubles
+    as the zombie's late-ack injector, since every send stamps the
+    worker's OWN epoch and the primary's reader fence judges it."""
+
+    def __init__(self, host: str, port: int, *, process: int = 1,
+                 epoch: int = 0, hb_s: float = 0.15):
+        import socket as _socket
+
+        from bibfs_tpu.serve.net import encode_frame
+
+        self._encode = encode_frame
+        self.process = int(process)
+        self.epoch = int(epoch)
+        self.graphs = 0          # graph descriptors fully received
+        self.joined: list = []   # solve seqs join-acked
+        self.served: list = []   # solve seqs committed + done-acked
+        self.silent = threading.Event()
+        self._stop = threading.Event()
+        self._wlock = threading.Lock()
+        self.sock = _socket.create_connection((host, int(port)),
+                                              timeout=10.0)
+        self.sock.setsockopt(_socket.IPPROTO_TCP,
+                             _socket.TCP_NODELAY, 1)
+        self._send({"op": "hello", "process": self.process,
+                    "epoch": self.epoch})
+        self._hb_s = float(hb_s)
+        threading.Thread(
+            target=self._hb_main, daemon=True,
+            name=f"elastic-pod-hb-e{self.epoch}",
+        ).start()
+        threading.Thread(
+            target=self._main, daemon=True,
+            name=f"elastic-pod-w-e{self.epoch}",
+        ).start()
+
+    def _send(self, obj: dict) -> None:
+        try:
+            with self._wlock:
+                self.sock.sendall(self._encode(dict(obj)))
+        except (OSError, ValueError):
+            pass
+
+    def _hb_main(self) -> None:
+        # first beat IMMEDIATELY: the primary only judges workers that
+        # have ever heartbeat, so a worker that dies before its first
+        # interval elapses would otherwise be invisible to the sweep
+        while True:
+            if not self.silent.is_set():
+                self._send({"op": "hb", "process": self.process,
+                            "epoch": self.epoch})
+            if self._stop.wait(self._hb_s):
+                return
+
+    def ack(self, seq: int, phase: str, ok: bool = True,
+            **extra) -> None:
+        self._send(dict(extra, seq=int(seq), phase=phase,
+                        ok=bool(ok), epoch=self.epoch))
+
+    def _main(self) -> None:
+        from bibfs_tpu.parallel.podmesh import _recv_frames
+
+        buf = bytearray()
+        g_seq, g_left, g_digest = -1, 0, ""
+        try:
+            while not self._stop.is_set():
+                for msg in _recv_frames(self.sock, buf):
+                    if self.silent.is_set():
+                        continue  # the zombie reads but never answers
+                    op = msg.get("op")
+                    seq = int(msg.get("seq", -1))
+                    if op == "graph":
+                        g_seq = seq
+                        g_left = int(msg.get("chunks", 0))
+                        g_digest = str(msg.get("digest"))
+                        if g_left == 0:
+                            self.graphs += 1
+                            self.ack(g_seq, "done", digest=g_digest)
+                    elif op == "graph_chunk":
+                        g_left -= 1
+                        if g_left == 0:
+                            self.graphs += 1
+                            self.ack(g_seq, "done", digest=g_digest)
+                    elif op == "solve":
+                        self.joined.append(seq)
+                        self.ack(seq, "join")
+                    elif op == "go":
+                        fseq = int(msg.get("for", -1))
+                        self.served.append(fseq)
+                        self.ack(fseq, "done")
+                    elif op == "shutdown":
+                        self.ack(seq, "done")
+                        return
+                    # "abort": parked batch skipped, nothing to ack
+        except (ConnectionError, OSError, ValueError):
+            pass
+
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class _IdleRouter:
+    """The pod-heal leg's router stub: the supervisor's pod watching
+    is router-independent, so it supervises an empty fleet."""
+
+    replica_names = ()
+    obs_label = "podheal"
+
+    def table(self) -> dict:
+        return {}
+
+    def catchup_stuck(self) -> dict:
+        return {}
+
+    def replica(self, name):
+        raise KeyError(name)
+
+
+def run_elastic(
+    n: int,
+    edges,
+    *,
+    base_qps: float = 50.0,
+    ramp_mult: float = 10.0,
+    warm_span_s: float = 3.0,
+    ramp_span_s: float = 6.0,
+    trail_span_s: float = 30.0,
+    max_wait_ms: float = 25.0,
+    max_batch: int = 4,
+    start_replicas: int = 1,
+    max_replicas: int = 3,
+    queue_hi: int = 32,
+    queue_lo: int = 2,
+    cooldown_s: float = 2.5,
+    p99_bound_ms: float = 30000.0,
+    hb_timeout_s: float = 0.6,
+    seed: int = 0,
+    workdir: str | None = None,
+) -> dict:
+    """The self-healing elastic fleet soak (``bench.py
+    --serve-elastic``): three failure legs, one artifact
+    (``bench_elastic.json``).
+
+    1. **Elastic fleet.** A :class:`~bibfs_tpu.fleet.Supervisor` over a
+       :class:`~bibfs_tpu.fleet.Router` of deliberately THROTTLED
+       ``bibfs-serve`` children: each child's front door enforces a
+       ``--net-quota-qps`` token bucket (batch shaping alone cannot
+       create overload — ``max_wait_ms`` is a MAX, and full batches
+       flush back-to-back), so the ramp overloads deterministically on
+       any machine and a second replica genuinely doubles fleet
+       capacity. The supervisor's shed signal is the observed rate of
+       structured capacity refusals. Open-loop traffic ramps
+       ``ramp_mult``x over base while one ORIGINAL replica takes a
+       SIGKILL mid-ramp; a closed-loop probe stream clocks end-to-end
+       latency through every scale event. Gates: zero lost acked
+       tickets, every survivor exact vs the serial oracle, probe p99
+       bounded, scale-OUT and scale-IN both witnessed, the dead replica
+       respawned and re-admitted, and zero flapping (no out/in pair
+       closer than the cooldown window).
+    2. **Pod-worker failure domains.** An in-process
+       :class:`~bibfs_tpu.parallel.podmesh.PodPrimary` over a scripted
+       worker speaking the real frame protocol: a served batch at epoch
+       0, then the worker goes zombie mid-batch — the join barrier
+       aborts pre-collective (degrade to the local ladder, never a
+       hang), the supervisor's heartbeat sweep respawns the worker at
+       epoch 1 via ``accept_rejoin``, the next launch re-broadcasts the
+       graph, a batch serves at the new epoch, and the zombie's late
+       ack is FENCED (counted, never re-marking the healthy worker).
+    3. **Overload brownout.** An in-process
+       :class:`~bibfs_tpu.serve.net.NetServer` with
+       :class:`~bibfs_tpu.serve.net.BrownoutPolicy`: an infeasible
+       deadline is shed with a structured ``capacity`` reply carrying
+       ``retry_after_ms``, queue pressure sheds the expensive ladder
+       kinds while POINT lookups keep serving, and the rungs release
+       with hysteresis once pressure clears.
+
+    Cross-cutting: every ``ELASTIC_METRIC_FAMILIES`` family renders,
+    and the trail window shows zero compile-sentinel events
+    (``exec_cache`` miss deltas on same-generation replicas).
+    Returns the ``bench_elastic.json`` payload body."""
+    import shutil
+    import tempfile
+
+    from bibfs_tpu.fleet import (
+        NetReplica,
+        Router,
+        ScalePolicy,
+        Supervisor,
+    )
+    from bibfs_tpu.graph.csr import build_csr, canonical_pairs
+    from bibfs_tpu.graph.io import write_graph_bin
+    from bibfs_tpu.obs.metrics import REGISTRY
+    from bibfs_tpu.obs.names import ELASTIC_METRIC_FAMILIES
+    from bibfs_tpu.parallel.podmesh import PodError, PodPrimary
+    from bibfs_tpu.serve.net import BrownoutPolicy, NetClient, NetServer
+    from bibfs_tpu.serve.pipeline import PipelinedQueryEngine
+    from bibfs_tpu.serve.resilience import QueryError
+    from bibfs_tpu.solvers.serial import solve_serial_csr
+
+    t_all = time.perf_counter()
+    cpairs = canonical_pairs(n, edges)
+    csr = build_csr(n, pairs=cpairs)
+
+    # DISTINCT pairs throughout: a repeated pair is served from the
+    # result cache inline and never loads the queue, which would melt
+    # the overload the autoscaler must see
+    warm_q = max(16, int(base_qps * warm_span_s))
+    ramp_q = max(64, int(base_qps * ramp_mult * ramp_span_s))
+    warm_pairs = sample_query_pairs(n, warm_q, seed=seed + 1)
+    ramp_pairs = sample_query_pairs(n, ramp_q, seed=seed + 2)
+    probe_pool = sample_query_pairs(n, 1024, seed=seed + 3)
+    oracle = {}
+    for pool in (warm_pairs, ramp_pairs, probe_pool):
+        for s, d in {(int(s), int(d)) for s, d in pool}:
+            if (s, d) not in oracle:
+                oracle[(s, d)] = solve_serial_csr(n, *csr, s, d)
+
+    own_workdir = workdir is None
+    workdir = workdir or tempfile.mkdtemp(prefix="bibfs-elastic-")
+    gpath = os.path.join(workdir, "g.bin")
+    write_graph_bin(gpath, n, cpairs)
+
+    def make_store(tag: str) -> str:
+        sd = os.path.join(workdir, tag)
+        os.makedirs(sd, exist_ok=True)
+        shutil.copy(gpath, os.path.join(sd, "a.bin"))
+        return sd
+
+    # per-replica capacity is enforced by the front door's token
+    # bucket, NOT by batching knobs: max_wait_ms is a MAX (full
+    # batches flush back-to-back), so batch shaping alone cannot
+    # create overload on a fast machine — the quota can, on any
+    quota_qps = 4.0 * base_qps
+    quota_burst = 2.0 * base_qps
+
+    def throttled(name: str, tag: str) -> NetReplica:
+        return NetReplica(
+            name, store_dir=make_store(tag), max_wait_ms=max_wait_ms,
+            extra_args=[
+                "--max-batch", str(int(max_batch)),
+                "--net-quota-qps", str(quota_qps),
+                "--net-quota-burst", str(quota_burst),
+            ],
+        )
+
+    out: dict = {
+        "n": int(n),
+        "base_qps": float(base_qps),
+        "ramp_qps": float(base_qps * ramp_mult),
+        "throttle": {"max_batch": int(max_batch),
+                     "max_wait_ms": float(max_wait_ms),
+                     "quota_qps": float(quota_qps),
+                     "quota_burst": float(quota_burst)},
+        "policy": {"queue_hi": int(queue_hi), "queue_lo": int(queue_lo),
+                   "shed_hi": float(base_qps),
+                   "cooldown_s": float(cooldown_s),
+                   "max_replicas": int(max_replicas)},
+    }
+    old_si = sys.getswitchinterval()
+    sys.setswitchinterval(5e-4)
+    try:
+        # ================ leg 1: the elastic fleet ===================
+        fleet = Router(
+            [throttled(f"e{i}", f"store-e{i}")
+             for i in range(int(start_replicas))],
+            poll_interval_s=0.2,
+        )
+        policy = ScalePolicy(
+            min_replicas=int(start_replicas),
+            max_replicas=int(max_replicas),
+            queue_hi=int(queue_hi), queue_lo=int(queue_lo),
+            shed_hi=float(base_qps),
+            settle_ticks=2, cooldown_s=float(cooldown_s),
+            respawn_backoff_s=1.0, stuck_after_s=30.0,
+            warm_timeout_s=120.0,
+        )
+        # the shed signal: structured capacity refusals per second as
+        # observed at the load generator (the same events the replicas
+        # count in bibfs_admission_shed_total) — over-quota pressure is
+        # what scale-out must relieve, and a second replica genuinely
+        # doubles the fleet's token budget
+        refusals: deque = deque()
+        refusals_lock = threading.Lock()
+        refused_total = [0]
+
+        def note_refusal() -> None:
+            with refusals_lock:
+                refusals.append(time.monotonic())
+                refused_total[0] += 1
+
+        def elastic_signals() -> dict:
+            now = time.monotonic()
+            with refusals_lock:
+                while refusals and refusals[0] < now - 1.0:
+                    refusals.popleft()
+                shed = float(len(refusals))
+            depth = 0
+            for nm in fleet.replica_names:
+                try:
+                    ld = int(fleet.replica(nm).load())
+                except Exception:
+                    continue
+                if ld < (1 << 29):  # dead replicas read saturated
+                    depth = max(depth, ld)
+            return {"queue_depth": depth, "p99_ms": None,
+                    "shed_rate": shed}
+
+        sup = Supervisor(
+            fleet, lambda idx: throttled(f"es{idx}", f"store-es{idx}"),
+            policy=policy, poll_interval_s=0.2,
+            signals=elastic_signals,
+        )
+        rows: list = []
+        probe_rows: list = []
+        probe_stop = threading.Event()
+
+        def drive(pairs_seg, rate: float, kill_at=None,
+                  victim=None) -> None:
+            t0 = time.perf_counter()
+            for i, (s, d) in enumerate(pairs_seg):
+                if kill_at is not None and i == kill_at:
+                    fleet.replica(victim).kill()
+                delay = t0 + i / rate - time.perf_counter()
+                if delay > 0:
+                    time.sleep(delay)
+                try:
+                    rows.append(
+                        (int(s), int(d), fleet.submit(int(s), int(d)))
+                    )
+                except QueryError as e:
+                    note_refusal()
+                    rows.append((int(s), int(d),
+                                 _RefusedNet(int(s), int(d), e)))
+
+        def probe_main() -> None:
+            i = 0
+            while not probe_stop.is_set():
+                s, d = probe_pool[i % len(probe_pool)]
+                i += 1
+                t0p = time.perf_counter()
+                try:
+                    t = fleet.submit(int(s), int(d))
+                except QueryError as e:
+                    note_refusal()
+                    probe_rows.append((int(s), int(d),
+                                       _RefusedNet(int(s), int(d), e),
+                                       None))
+                    probe_stop.wait(0.12)
+                    continue
+                try:
+                    t.wait(timeout=90.0)
+                except Exception:
+                    pass
+                probe_rows.append((int(s), int(d), t,
+                                   time.perf_counter() - t0p))
+                probe_stop.wait(0.12)
+
+        victim = fleet.replica_names[0]
+        elastic: dict = {}
+        try:
+            prober = threading.Thread(
+                target=probe_main, name="bibfs-elastic-probe",
+                daemon=True,
+            )
+            prober.start()
+            drive(warm_pairs, base_qps)
+            drive(ramp_pairs, base_qps * ramp_mult,
+                  kill_at=int(0.35 * len(ramp_pairs)), victim=victim)
+            # drain the ramp backlog before the quiet trail
+            fleet.flush(timeout=180.0)
+            for _s, _d, t in rows:
+                try:
+                    t.wait(timeout=120.0)
+                except Exception:
+                    pass
+            # compile-sentinel window opens here: the fleet is warmed
+            # and every shape it will see again is cached
+            def cache_sample() -> dict:
+                sample = {}
+                for name in fleet.replica_names:
+                    try:
+                        rep = fleet.replica(name)
+                        misses = rep.stats().get(
+                            "exec_cache", {}).get("misses")
+                        if misses is not None:
+                            sample[name] = (rep.generation, int(misses))
+                    except Exception:
+                        continue
+                return sample
+
+            before = cache_sample()
+            # the quiet trail: probes only — fleet-max queue depth
+            # sits at ~0 <= queue_lo, which is what provokes scale-in
+            trail_end = time.monotonic() + float(trail_span_s)
+            while time.monotonic() < trail_end:
+                if (any(e["dir"] == "in" for e in sup.events())
+                        and len(fleet.replica_names)
+                        <= int(start_replicas)):
+                    break
+                time.sleep(0.2)
+            after = cache_sample()
+            compile_events = sum(
+                after[k][1] - v[1] for k, v in before.items()
+                if k in after and after[k][0] == v[0]
+            )
+            probe_stop.set()
+            prober.join(timeout=120.0)
+
+            # classification: the run_net convention — lost (acked,
+            # vanished) / unstructured / failed-structured (resubmit)
+            all_rows = rows + [(s, d, t) for s, d, t, _ in probe_rows]
+            lost = [(s, d) for s, d, t in all_rows
+                    if t.result is None and t.error is None]
+            unstructured = [
+                (s, d) for s, d, t in all_rows
+                if t.result is None and t.error is not None
+                and not hasattr(t.error, "kind")
+            ]
+            failed = [(s, d) for s, d, t in all_rows
+                      if t.result is None and hasattr(t.error, "kind")]
+            # resubmission honors the capacity reply's retry_after_ms
+            # hint: a blind loop would outrun the very token bucket
+            # that refused these queries in the first place
+            resubmitted = []
+            for s, d in failed:
+                end = time.monotonic() + 30.0
+                while True:
+                    try:
+                        t = fleet.submit(s, d)
+                        break
+                    except QueryError as e:
+                        if time.monotonic() >= end:
+                            t = _RefusedNet(s, d, e)
+                            break
+                        hint = getattr(e, "retry_after_ms", None)
+                        time.sleep(min(0.25, (hint or 50.0) / 1e3))
+                try:
+                    t.wait(timeout=60.0)
+                except Exception:
+                    pass
+                resubmitted.append((s, d, t))
+            mism = _verify_net(
+                [(s, d) for s, d, _ in all_rows],
+                [t for _, _, t in all_rows], oracle,
+            ) + _verify_net(
+                [(s, d) for s, d, _ in resubmitted],
+                [t for _, _, t in resubmitted], oracle,
+            )
+            resub_unserved = sum(
+                1 for _, _, t in resubmitted if t.result is None
+            )
+            lats = sorted(
+                lat for _, _, t, lat in probe_rows
+                if lat is not None and t.result is not None
+            )
+            p99_ms = (
+                round(lats[int(0.99 * (len(lats) - 1))] * 1e3, 1)
+                if lats else None
+            )
+            events = sup.events()
+            scale_dirs = [e for e in events if e["dir"] in ("out", "in")]
+            flaps = [
+                (a, b) for a, b in zip(scale_dirs, scale_dirs[1:])
+                if a["dir"] != b["dir"]
+                and b["t"] - a["t"] < 0.9 * float(cooldown_s)
+            ]
+            elastic = {
+                "queries": len(rows),
+                "probes": len(probe_rows),
+                "killed": victim,
+                "events": events,
+                "replicas_final": list(fleet.replica_names),
+                "lost": len(lost),
+                "refused_total": refused_total[0],
+                "failed_unstructured": len(unstructured),
+                "failed_structured": len(failed),
+                "resubmit_unserved": resub_unserved,
+                "mismatches": mism[:10],
+                "probe_p99_ms": p99_ms,
+                "flaps": len(flaps),
+                "compile_events_trail": compile_events,
+                "spawn_failures": sup.stats()["spawn_failures"],
+                "scaled_out": any(e["dir"] == "out" for e in events),
+                "scaled_in": any(e["dir"] == "in" for e in events),
+                "respawned_dead": any(
+                    e["dir"] == "respawn" and e["reason"] == "dead"
+                    for e in events
+                ),
+                "victim_state": fleet.table().get(victim),
+            }
+        finally:
+            probe_stop.set()
+            sup.close()
+            fleet.close()
+
+        # ============ leg 2: pod-worker failure domains ==============
+        class _SnapLite:
+            n = 8
+            pairs = np.array(
+                [[i, i + 1] for i in range(7)], dtype=np.int64
+            )
+            digest = "elastic-pod-snap"
+            version = 1
+
+        snap = _SnapLite()
+        pad = np.array([[0, 7], [2, 5]], dtype=np.int64)
+        primary = PodPrimary(
+            1, host="127.0.0.1", heartbeat_timeout_s=float(hb_timeout_s)
+        )
+        workers: dict = {}
+        psup = None
+        pod: dict = {}
+        try:
+            workers[0] = _ScriptedPodWorker(
+                "127.0.0.1", primary.port, epoch=0
+            )
+            primary.accept_workers()
+            primary.ensure_graph(snap, timeout=15.0)
+            graphs_epoch0 = workers[0].graphs
+
+            def pod_batch() -> bool:
+                primary.check_heartbeats()     # the route's sweep
+                primary.ensure_graph(snap, timeout=15.0)
+                seq = primary.post_solve(snap.digest, "sync", pad, 2)
+                primary.await_phase(seq, "join", timeout=10.0)
+                primary.commit_solve(seq)
+                primary.await_phase(seq, "done", timeout=10.0)
+                return True
+
+            served_epoch0 = pod_batch()
+            # let a few heartbeats land first: the sweep only judges
+            # workers it has HEARD from, so a worker that goes zombie
+            # before its first beat would never be marked dead
+            time.sleep(3.0 * 0.15)
+            # the zombie: mid-stream the worker stops acking AND
+            # heartbeating; the next batch must abort via the
+            # two-phase join barrier, never hang in a collective
+            workers[0].silent.set()
+            seq_b = primary.post_solve(snap.digest, "sync", pad, 2)
+            degraded = False
+            try:
+                primary.await_phase(seq_b, "join", timeout=1.5)
+                primary.commit_solve(seq_b)
+            except PodError:
+                primary.abort_solve(seq_b)
+                degraded = True  # -> the engine's local ladder
+            # supervisor-driven heal: heartbeat sweep marks the worker
+            # dead, the respawn callback rejoins at a HIGHER epoch
+            psup = Supervisor(
+                _IdleRouter(), lambda idx: None,
+                policy=ScalePolicy(respawn_backoff_s=1.0),
+                poll_interval_s=0.1,
+            )
+
+            def pod_respawn(p, pidx):
+                workers[1] = _ScriptedPodWorker(
+                    "127.0.0.1", p.port,
+                    epoch=p.worker_epoch(pidx) + 1,
+                )
+                p.accept_rejoin(timeout_s=10.0)
+
+            psup.watch_pod(primary, pod_respawn)
+            heal_end = time.monotonic() + 20.0
+            while time.monotonic() < heal_end:
+                if (not primary.dead_workers()
+                        and primary.worker_epoch(1) >= 1):
+                    break
+                time.sleep(0.05)
+            healed = (not primary.dead_workers()
+                      and primary.worker_epoch(1) >= 1)
+            # recovery: the next launch re-broadcasts the graph (the
+            # respawned incarnation holds none) and serves at epoch 1
+            served_epoch1 = False
+            regraphed = False
+            if healed:
+                served_epoch1 = pod_batch()
+                regraphed = workers[1].graphs >= 1
+            # the zombie wakes and fires its late ack for the aborted
+            # batch: the reader fence drops and counts it
+            workers[0].silent.clear()
+            workers[0].ack(seq_b, "join")
+            fence_end = time.monotonic() + 5.0
+            while time.monotonic() < fence_end:
+                if primary.fenced_frames >= 1:
+                    break
+                time.sleep(0.05)
+            fenced = int(primary.fenced_frames)
+            # the zombie's EOF must retire its reader SILENTLY — the
+            # recovered incarnation is never re-marked dead
+            workers[0].close()
+            time.sleep(0.4)
+            zombie_eof_silent = not primary.dead_workers()
+            pod = {
+                "graphs_epoch0": graphs_epoch0,
+                "served_epoch0": served_epoch0,
+                "degraded_to_local": degraded,
+                "healed": healed,
+                "regraphed": regraphed,
+                "served_epoch1": served_epoch1,
+                "worker_epoch": primary.worker_epoch(1),
+                "fenced_frames": fenced,
+                "zombie_eof_silent": zombie_eof_silent,
+                "heal_events": [
+                    e for e in (psup.events() if psup else [])
+                    if e["reason"] == "pod_worker"
+                ],
+            }
+        finally:
+            if psup is not None:
+                psup.close()
+            try:
+                primary.shutdown(timeout=5.0)
+            except Exception:
+                primary.close()
+            for w in workers.values():
+                w.close()
+
+        # ================= leg 3: overload brownout ==================
+        beng = PipelinedQueryEngine(n, edges, pairs=cpairs,
+                                    max_wait_ms=150.0)
+        bsrv = NetServer(
+            beng, port=0, max_inflight=16,
+            brownout=BrownoutPolicy(min_samples=16),
+        )
+        brown: dict = {}
+        try:
+            bcli = NetClient("127.0.0.1", bsrv.port)
+            try:
+                bw_pairs = sample_query_pairs(n, 24, seed=seed + 7)
+                for s, d in bw_pairs:  # warm past min_samples
+                    bcli.submit(int(s), int(d)).wait(timeout=30.0)
+                fresh = sample_query_pairs(n, 24, seed=seed + 8)
+                fi = iter([(int(s), int(d)) for s, d in fresh])
+
+                def shed_kind(err) -> str | None:
+                    if getattr(err, "kind", None) != "capacity":
+                        return None
+                    return (str(err), getattr(err, "retry_after_ms",
+                                              None))
+
+                # rung 1: a deadline no p99 can meet -> structured
+                # capacity reply with a retry_after_ms backoff hint
+                s, d = next(fi)
+                infeasible = None
+                try:
+                    bcli.submit(s, d, deadline_ms=0.001).wait(
+                        timeout=30.0)
+                except QueryError as e:
+                    infeasible = shed_kind(e)
+                # rung 2: queue pressure -> the ladder sheds expensive
+                # kinds while a point lookup keeps serving
+                burst = [bcli.submit(*next(fi)) for _ in range(14)]
+                ladder_shed = None
+                try:
+                    bcli.submit(*next(fi), kind="kshortest").wait(
+                        timeout=30.0)
+                except QueryError as e:
+                    ladder_shed = shed_kind(e)
+                point = bcli.submit(*next(fi))
+                point.wait(timeout=30.0)
+                point_served = point.result is not None
+                for t in burst:
+                    t.wait(timeout=30.0)
+                # hysteresis release: pressure gone, the rung re-admits
+                release = bcli.submit(*next(fi), kind="kshortest")
+                release.wait(timeout=30.0)
+                released = release.result is not None
+                brown = {
+                    "warmed": len(bw_pairs),
+                    "infeasible_shed": infeasible,
+                    "ladder_shed": ladder_shed,
+                    "point_served": point_served,
+                    "released": released,
+                }
+            finally:
+                bcli.close()
+        finally:
+            bsrv.close()
+            beng.close()
+
+        # ================= the cross-cutting gates ===================
+        render = REGISTRY.render()
+        missing = [m for m in ELASTIC_METRIC_FAMILIES
+                   if m not in render]
+        out["elastic_phase"] = elastic
+        out["pod_phase"] = pod
+        out["brownout_phase"] = brown
+        out["elapsed_s"] = round(time.perf_counter() - t_all, 1)
+        brown_ok = bool(
+            brown.get("infeasible_shed")
+            and "infeasible" in brown["infeasible_shed"][0]
+            and brown["infeasible_shed"][1] is not None
+            and brown.get("ladder_shed")
+            and "kshortest" in brown["ladder_shed"][0]
+            and brown.get("point_served")
+            and brown.get("released")
+        )
+        out["gates"] = {
+            "zero_lost_ok": elastic.get("lost") == 0
+            and elastic.get("failed_unstructured") == 0
+            and elastic.get("resubmit_unserved") == 0,
+            "exact_ok": elastic.get("mismatches") == [],
+            "p99_bounded_ok": (
+                elastic.get("probe_p99_ms") is not None
+                and elastic["probe_p99_ms"] <= float(p99_bound_ms)
+            ),
+            "scale_out_ok": bool(elastic.get("scaled_out")),
+            "scale_in_ok": bool(elastic.get("scaled_in")),
+            "respawn_ok": bool(elastic.get("respawned_dead"))
+            and elastic.get("victim_state") == "ready",
+            "no_flap_ok": elastic.get("flaps") == 0,
+            "compile_sentinel_ok":
+                elastic.get("compile_events_trail") == 0,
+            "pod_degrade_ok": bool(pod.get("served_epoch0"))
+            and bool(pod.get("degraded_to_local")),
+            "pod_recover_ok": bool(pod.get("healed"))
+            and bool(pod.get("regraphed"))
+            and bool(pod.get("served_epoch1"))
+            and pod.get("worker_epoch", 0) >= 1,
+            "pod_fence_ok": pod.get("fenced_frames", 0) >= 1
+            and bool(pod.get("zombie_eof_silent")),
+            "brownout_ok": brown_ok,
+            "metrics_ok": not missing,
+            "metrics_missing": missing,
+        }
+        out["ok"] = all(
+            v for k, v in out["gates"].items() if k.endswith("_ok")
+        )
+        return out
+    finally:
+        sys.setswitchinterval(old_si)
         if own_workdir:
             shutil.rmtree(workdir, ignore_errors=True)

@@ -15,7 +15,7 @@ and replies arrive in COMPLETION order, not submit order — the
 pipelined engine's whole point. Ops:
 
 - ``{"op": "query", "id", "src", "dst", "graph"?, "deadline_ms"?,
-  "tenant"?}`` → ``{"id", "ok": true, "found", "hops"}`` or
+  "tenant"?, "kind"?}`` → ``{"id", "ok": true, "found", "hops"}`` or
   ``{"id", "ok": false, "kind": <taxonomy>, "error": msg}``. The
   ``kind`` is the :data:`~bibfs_tpu.serve.resilience.ERROR_KINDS`
   taxonomy verbatim — a quota/admission refusal is a structured
@@ -43,6 +43,32 @@ quota token. The in-flight bound is sized to stay under the pipelined
 engine's blocking admission queue: the IO thread must never park
 inside ``engine.submit``, because it is the thread every other
 connection's reads ride on.
+
+**Brownout (opt-in).** A server built with a :class:`BrownoutPolicy`
+grows two more admission rungs between the in-flight bound and the
+tenant bucket (so a shed burns no quota token either), both counted in
+``bibfs_admission_shed_total{reason}`` — never in the rejection
+taxonomy above, because a shed is a load-management choice, not an
+error class:
+
+- **deadline feasibility** (reason=infeasible): once the engine's own
+  latency histogram holds enough samples, a query whose ``deadline_ms``
+  is below the live p99 estimate is refused up front — the reply is a
+  structured ``capacity`` error carrying ``retry_after_ms``, so the
+  client backs off instead of burning a solve that will time out
+  anyway.
+- **the kind ladder** (reason=kshortest/weighted/msbfs): queries
+  declare an admission class via an optional ``kind`` frame field
+  (absent = point lookup — the only kind the wire computes today; the
+  ladder is the admission contract for the expensive families the
+  engine roadmap adds). Under queue pressure the expensive kinds shed
+  first — ``kshortest`` at the lowest occupancy, ``msbfs`` last,
+  point lookups never — each rung engaging/releasing with hysteresis
+  so admission does not flap at a threshold.
+
+Brownout is OFF by default: a plain ``NetServer`` sheds nothing, and
+the tight-deadline phases of ``bench.py --serve-net`` (which *count on*
+observing deadline timeouts) are unaffected.
 
 **Threads.** One selector-based IO thread owns the listener and every
 connection (non-blocking reads, frame parse, submit, buffered writes);
@@ -90,6 +116,15 @@ MAX_FRAME_BYTES = 1 << 20
 #: (tenant-less by design: tenant ids are unbounded cardinality)
 REJECT_REASONS = ("quota", "capacity", "draining", "oversize",
                   "malformed")
+
+#: the brownout kind ladder, most-expensive first: under pressure
+#: ``kshortest`` sheds at the lowest occupancy, ``msbfs`` holds
+#: longest, and point lookups (no ``kind`` field) are never ladder-shed
+BROWNOUT_LADDER = ("kshortest", "weighted", "msbfs")
+
+#: shed-reason labels on ``bibfs_admission_shed_total`` — the ladder
+#: kinds plus the deadline-feasibility rung
+SHED_REASONS = ("infeasible",) + BROWNOUT_LADDER
 
 #: control ops the server answers beside queries (the stdin REPL's
 #: command surface, multiplexed; ``metrics`` returns this process's
@@ -232,10 +267,48 @@ class _PendingNet:
         self.rx = rx  # wall-µs arrival stamp, traced queries only
 
 
+class BrownoutPolicy:
+    """Knobs for the front door's overload brownout (module docstring).
+    Constructing one and passing it to :class:`NetServer` IS the
+    opt-in — servers built without one shed nothing.
+
+    ``ladder`` maps admission-class kinds to ENGAGE occupancy fractions
+    of ``max_inflight``; a rung releases at ``engage - release`` (the
+    hysteresis band). ``headroom`` scales the p99 estimate in the
+    feasibility rung (>1.0 sheds earlier), which only arms once the
+    engine's latency histogram holds ``min_samples`` observations."""
+
+    __slots__ = ("feasibility", "min_samples", "headroom", "ladder",
+                 "release", "retry_after_ms")
+
+    def __init__(self, *, feasibility: bool = True,
+                 min_samples: int = 50, headroom: float = 1.0,
+                 ladder=None, release: float = 0.15,
+                 retry_after_ms: float = 250.0):
+        self.feasibility = bool(feasibility)
+        self.min_samples = int(min_samples)
+        self.headroom = float(headroom)
+        self.ladder = dict(ladder) if ladder is not None else {
+            "kshortest": 0.50, "weighted": 0.65, "msbfs": 0.80,
+        }
+        for k in self.ladder:
+            if k not in BROWNOUT_LADDER:
+                raise ValueError(
+                    f"unknown ladder kind {k!r} "
+                    f"(known: {BROWNOUT_LADDER})"
+                )
+        self.release = float(release)
+        self.retry_after_ms = float(retry_after_ms)
+        if self.min_samples < 1:
+            raise ValueError("min_samples must be >= 1")
+        if not (0.0 < self.release < 1.0):
+            raise ValueError("release must be in (0, 1)")
+
+
 # _state stays un-annotated by design (lock-free fast reads in the IO
 # loop; every transition happens under the lock)
 @guarded_by("_lock", "_conns", "_pending", "_buckets", "_submitting",
-            "_seq")
+            "_seq", "_shed_engaged")
 class NetServer:
     """The framed-TCP front door over one (pipelined) engine.
 
@@ -259,6 +332,8 @@ class NetServer:
         disables quotas; burst defaults to 2x qps).
     default_deadline_ms : deadline applied to queries that carry none
         (None = engine SLO only).
+    brownout : a :class:`BrownoutPolicy` to arm the overload brownout
+        rungs (module docstring); None (the default) sheds nothing.
     """
 
     def __init__(self, engine, *, store=None, host: str = "127.0.0.1",
@@ -266,6 +341,7 @@ class NetServer:
                  max_inflight: int = 512, quota_qps: float | None = None,
                  quota_burst: float | None = None,
                  default_deadline_ms: float | None = None,
+                 brownout: BrownoutPolicy | None = None,
                  registry=None):
         self._engine = engine
         self._store = store
@@ -277,10 +353,12 @@ class NetServer:
             and self._quota_qps is not None else quota_burst
         )
         self._default_deadline_ms = default_deadline_ms
+        self._brownout = brownout
         self._lock = threading.RLock()
         self._conns: dict[int, _Conn] = {}
         self._pending: dict[int, _PendingNet] = {}
         self._buckets: dict[str, TokenBucket] = {}
+        self._shed_engaged: set = set()
         self._submitting = 0
         self._seq = 0
         self._state = "serving"
@@ -318,6 +396,20 @@ class NetServer:
             "Queries answered with a structured timeout because their "
             "per-request deadline expired before the result landed",
         )
+        # the brownout shed counter is minted only on brownout-armed
+        # servers (mint-at-zero would misread as "brownout available"
+        # on plain front doors); every reason cell pre-minted
+        self._c_shed = None
+        if brownout is not None:
+            self._c_shed = self._registry.counter(
+                "bibfs_admission_shed_total",
+                "Brownout admission sheds at the front door, by reason "
+                "(infeasible = deadline-feasibility; ladder kinds shed "
+                "under queue pressure before point lookups)",
+                ("reason",),
+            )
+            for r in SHED_REASONS:
+                self._c_shed.labels(reason=r)
         # per-query cost attribution (obs/dtrace.py): the front door
         # owns the ingress stage (frame arrival -> ticket submitted)
         self._stage_cells = stage_histogram()
@@ -574,7 +666,9 @@ class NetServer:
                 })
                 return
         deadline = None if dl_ms is None else now + dl_ms / 1e3
+        qkind = str(msg.get("kind") or "point")
         reason = None
+        shed = None
         with self._lock:
             self._m_requests.labels(op="query").inc()
             if self._state != "serving":
@@ -584,23 +678,37 @@ class NetServer:
                 # the server-wide bound comes BEFORE the tenant bucket:
                 # a capacity refusal must not also cost a quota token
                 reason = "capacity"
-            elif self._quota_qps is not None:
-                bucket = self._buckets.get(tenant)
-                if bucket is None:
-                    bucket = TokenBucket(
-                        self._quota_qps, self._quota_burst
-                    )
-                    self._buckets[tenant] = bucket
-                if not bucket.allow(now):
-                    reason = "quota"
-            if reason is None:
-                self._submitting += 1
             else:
+                if self._brownout is not None:
+                    # brownout rungs also come BEFORE the tenant
+                    # bucket — a shed must not burn a quota token
+                    shed = self._shed_locked(qkind, dl_ms)
+                if shed is None and self._quota_qps is not None:
+                    bucket = self._buckets.get(tenant)
+                    if bucket is None:
+                        bucket = TokenBucket(
+                            self._quota_qps, self._quota_burst
+                        )
+                        self._buckets[tenant] = bucket
+                    if not bucket.allow(now):
+                        reason = "quota"
+            if reason is None and shed is None:
+                self._submitting += 1
+            elif reason is not None:
                 self._m_rejects.labels(reason=reason).inc()
+            else:
+                self._c_shed.labels(reason=shed[0]).inc()
         if reason is not None:
             self._enqueue(conn, {
                 "id": rid, "ok": False, "kind": "capacity",
                 "error": f"admission refused ({reason})",
+            })
+            return
+        if shed is not None:
+            self._enqueue(conn, {
+                "id": rid, "ok": False, "kind": "capacity",
+                "error": f"brownout shed ({shed[0]})",
+                "retry_after_ms": shed[1],
             })
             return
         # distributed-trace ingress: adopt the frame's context, or make
@@ -667,6 +775,37 @@ class NetServer:
             self._submitting -= 1
             self._pending[self._seq] = entry
             self._seq += 1
+
+    def _shed_locked(self, qkind: str, dl_ms):
+        """The two brownout admission rungs (module docstring), server
+        lock held. Returns ``(reason, retry_after_ms)`` to shed, or
+        None to admit."""
+        pol = self._brownout
+        # rung 1: deadline feasibility — refuse a deadline the engine's
+        # own live p99 says cannot be met, once the estimate has enough
+        # samples to mean anything
+        if dl_ms is not None and pol.feasibility:
+            lat = getattr(self._engine, "latency", None)
+            if lat is not None and lat.count >= pol.min_samples:
+                p99_ms = lat.percentile(0.99) * 1e3
+                if dl_ms < p99_ms * pol.headroom:
+                    return "infeasible", round(
+                        max(p99_ms, pol.retry_after_ms), 1
+                    )
+        # rung 2: the kind ladder — expensive admission classes shed
+        # under queue pressure, each rung with its own hysteresis band
+        # so admission does not flap at the threshold
+        occ = ((len(self._pending) + self._submitting)
+               / max(1, self._max_inflight))
+        for k, hi in pol.ladder.items():
+            if k in self._shed_engaged:
+                if occ <= hi - pol.release:
+                    self._shed_engaged.discard(k)
+            elif occ >= hi:
+                self._shed_engaged.add(k)
+        if qkind in self._shed_engaged:
+            return qkind, pol.retry_after_ms
+        return None
 
     def _handle_control(self, conn: _Conn, op: str, msg: dict,
                         rid) -> None:
@@ -1062,6 +1201,11 @@ class NetClient:
                     str(msg.get("error", "front-door error")),
                     kind=kind, query=(waiter.src, waiter.dst),
                 )
+                ra = msg.get("retry_after_ms")
+                if ra is not None:
+                    # brownout sheds carry a backoff hint; ride it on
+                    # the structured error for the caller's retry loop
+                    waiter.error.retry_after_ms = ra
             waiter.t_done = time.perf_counter()
             if waiter.span is not None:
                 self._finish_traced(waiter, msg)
@@ -1126,7 +1270,8 @@ class NetClient:
 
     def submit(self, src: int, dst: int, graph: str | None = None, *,
                deadline_ms: float | None = None,
-               tenant: str | None = None, ctx=None) -> NetTicket:
+               tenant: str | None = None, kind: str | None = None,
+               ctx=None) -> NetTicket:
         ticket = NetTicket(int(src), int(dst), graph)
         rid = self._register(ticket)
         frame = {"op": "query", "id": rid, "src": ticket.src,
@@ -1135,6 +1280,10 @@ class NetClient:
             frame["graph"] = graph
         if deadline_ms is not None:
             frame["deadline_ms"] = float(deadline_ms)
+        if kind is not None:
+            # the admission class for brownout-armed servers (module
+            # docstring); the wire still computes a point lookup
+            frame["kind"] = str(kind)
         t = tenant if tenant is not None else self.tenant
         if t is not None:
             frame["tenant"] = t

@@ -98,6 +98,11 @@ class PodMeshRoute(MeshRoute):
         from bibfs_tpu.solvers import sharded as _sharded
 
         snap = rt.snapshot
+        # heartbeat sweep FIRST: a worker that stopped heartbeating is
+        # marked dead here, so the batch aborts via the join barrier
+        # (PodError before the collective) instead of timing out inside
+        # it — the engine's ladder then degrades to the local rungs
+        self._pod.check_heartbeats()
         # broadcast the snapshot if the workers don't hold it yet (the
         # hot-swap seam: a store roll shows up here as a new digest),
         # building the primary's sharded graph BETWEEN the broadcast

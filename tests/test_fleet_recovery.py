@@ -212,6 +212,85 @@ def test_durable_restart_passes_catchup_without_repair():
         router.close()
 
 
+def test_midroll_crash_respawn_held_then_repaired_by_hatch():
+    """The PR 8 mid-roll-crash wedge, regression-pinned: a replica that
+    died BETWEEN a roll's update acks and its swap respawns with the
+    half-applied batch re-armed in its overlay, so the replay's
+    duplicate adds are refused — the router must hold it in ``catchup``
+    (safe-but-unroutable, with its stuck duration visible in
+    ``pending_catchup``/``catchup_stuck()`` and the
+    bibfs_fleet_catchup_stuck gauge), and the supervisor's escape hatch
+    must repair the fleet with a full respawn from the durable store."""
+    from bibfs_tpu.fleet import ScalePolicy, Supervisor
+
+    class MidRollStub(VersionedStub):
+        def __init__(self, name):
+            super().__init__(name, durable=False)
+            self.rearmed = False
+
+        def roll(self, graph=None, adds=(), dels=()):
+            if self.rearmed:
+                # the respawn re-armed the crashed batch, so the
+                # catch-up replay's adds collide with the overlay
+                raise ValueError("duplicate adds refused")
+            return super().roll(graph, adds=adds, dels=dels)
+
+        def restart(self):
+            super().restart()
+            self.rearmed = True
+
+    stubs = [MidRollStub("s0"), VersionedStub("s1", durable=False)]
+    router = _router(stubs)
+    sup = None
+    try:
+        assert router.rolling_swap("a", adds=[(0, 1)])["ok"]
+        committed = dict(router.stats()["committed"])
+        victim = stubs[0]
+        victim.kill()  # "between the update acks and the swap"
+        assert _wait(lambda: router.table()["s0"] == "dead")
+        victim.restart()  # batch re-armed; replay will be refused
+        assert _wait(lambda: router.table()["s0"] == "catchup")
+        time.sleep(0.3)  # several poll ticks: held, never re-admitted
+        assert router.table()["s0"] == "catchup"
+        assert victim.version("a") == 1  # nothing half-folded
+        assert "s0" in router.stats()["pending_catchup"]
+        assert _wait(lambda: router.catchup_stuck().get("s0", 0.0) > 0.2)
+        assert "bibfs_fleet_catchup_stuck" in REGISTRY.render()
+        # queries keep flowing around the held replica meanwhile
+        assert router.query(1, 2, "a") is not None
+
+        # the escape hatch: replace it with a fresh spawn from the
+        # durable store (declares the committed versions on its own)
+        def spawn(idx):
+            fresh = VersionedStub(f"fresh{idx}", durable=True)
+            fresh.versions = dict(committed)
+            return fresh
+
+        sup = Supervisor(
+            router,
+            spawn,
+            policy=ScalePolicy(stuck_after_s=0.2),
+            poll_interval_s=30.0,
+        )
+        sup.tick()
+        assert _wait(lambda: "s0" not in router.replica_names)
+        assert _wait(
+            lambda: any(
+                n.startswith("fresh")
+                and router.table().get(n) == "ready"
+                for n in router.replica_names
+            )
+        )
+        assert ("repair", "catchup_stuck") in [
+            (e["dir"], e["reason"]) for e in sup.events()
+        ]
+        assert router.query(1, 2, "a") is not None
+    finally:
+        if sup is not None:
+            sup.close()
+        router.close()
+
+
 def test_no_committed_versions_readmits_as_before():
     """Without any committed roll, recovery re-admission works exactly
     as pre-catchup: ready as soon as health says so."""

@@ -1,13 +1,15 @@
 """The network front door (bibfs_tpu/serve/net.py) in-process: frame
 codec, port-file handshake, token buckets, correlation-id query
 round-trips, the wire error taxonomy, per-tenant quota admission,
-per-request deadlines, graceful drain, and the ``bibfs_net_*`` metric
-families rendering at zero from server construction."""
+per-request deadlines, graceful drain, the overload brownout rungs
+(deadline feasibility + the kind ladder), and the ``bibfs_net_*``
+metric families rendering at zero from server construction."""
 
 import json
 import socket
 import struct
 import threading
+import time
 
 import numpy as np
 import pytest
@@ -16,6 +18,8 @@ from bibfs_tpu.obs.metrics import MetricsRegistry
 from bibfs_tpu.obs.names import NET_METRIC_FAMILIES
 from bibfs_tpu.serve.net import (
     MAX_FRAME_BYTES,
+    SHED_REASONS,
+    BrownoutPolicy,
     FrameError,
     NetClient,
     NetServer,
@@ -371,6 +375,166 @@ def test_drain_refuses_queries_answers_control():
         assert "draining" in str(exc.value)
         # control ops still answer on a draining door
         assert client.request("ping") == {"pong": True}
+    finally:
+        client.close()
+        server.close()
+        eng.close()
+
+
+# ---- overload brownout ----------------------------------------------
+
+def test_brownout_default_off_sheds_nothing():
+    """Constructing a BrownoutPolicy IS the opt-in: a plain front door
+    must serve every admission class unshed and must NOT mint the shed
+    counter (a zero row would misread as 'brownout available')."""
+    reg = MetricsRegistry()
+    eng = PipelinedQueryEngine(N, EDGES, max_wait_ms=5.0)
+    server = NetServer(eng, registry=reg)
+    client = NetClient(server.host, server.port)
+    try:
+        s, d = _fresh_pair()
+        res = client.submit(s, d, kind="kshortest").wait(timeout=30.0)
+        assert res.hops == solve_serial(N, EDGES, s, d).hops
+        assert "bibfs_admission_shed_total" not in reg.render()
+    finally:
+        client.close()
+        server.close()
+        eng.close()
+
+
+def test_brownout_feasibility_shed_structured_with_retry_hint():
+    """The feasibility rung: a deadline the engine's live p99 says
+    cannot be met is refused at admission with a structured capacity
+    error carrying ``retry_after_ms`` — and only once the histogram
+    holds enough samples to mean anything."""
+    reg = MetricsRegistry()
+    eng = PipelinedQueryEngine(N, EDGES, max_wait_ms=5.0)
+    # headroom 1e9 makes ANY finite deadline infeasible once armed, so
+    # the test does not depend on this machine's actual latency
+    server = NetServer(
+        eng, registry=reg,
+        brownout=BrownoutPolicy(min_samples=5, headroom=1e9, ladder={}),
+    )
+    client = NetClient(server.host, server.port)
+    try:
+        # below min_samples the rung is unarmed: tight deadlines pass
+        # admission (they may still time out downstream — irrelevant)
+        assert eng.latency.count < 5
+        for _ in range(6):  # arm the estimate
+            s, d = _fresh_pair()
+            client.submit(s, d).wait(timeout=30.0)
+        deadline = time.monotonic() + 10.0
+        while eng.latency.count < 5 and time.monotonic() < deadline:
+            time.sleep(0.01)  # records land just after the ticket wakes
+        assert eng.latency.count >= 5
+        t = client.submit(*_fresh_pair(), deadline_ms=50.0)
+        with pytest.raises(QueryError) as exc:
+            t.wait(timeout=30.0)
+        assert exc.value.kind == "capacity"
+        assert "infeasible" in str(exc.value)
+        assert float(exc.value.retry_after_ms) > 0.0
+        # deadline-less queries never hit the feasibility rung
+        s, d = _fresh_pair()
+        assert client.submit(s, d).wait(timeout=30.0).hops == \
+            solve_serial(N, EDGES, s, d).hops
+        assert 'bibfs_admission_shed_total{reason="infeasible"} 1' \
+            in reg.render()
+    finally:
+        client.close()
+        server.close()
+        eng.close()
+
+
+def test_brownout_ladder_sheds_expensive_kind_spares_point():
+    """The kind ladder: an engaged rung sheds its admission class with
+    a structured capacity error + backoff hint, while point lookups
+    (and kinds not on the ladder) keep flowing."""
+    reg = MetricsRegistry()
+    eng = PipelinedQueryEngine(N, EDGES, max_wait_ms=5.0)
+    # engage threshold 0.0 pins the kshortest rung engaged at any
+    # occupancy (release would need occ <= -0.15) — deterministic
+    server = NetServer(
+        eng, registry=reg,
+        brownout=BrownoutPolicy(feasibility=False,
+                                ladder={"kshortest": 0.0}),
+    )
+    client = NetClient(server.host, server.port)
+    try:
+        t = client.submit(*_fresh_pair(), kind="kshortest")
+        with pytest.raises(QueryError) as exc:
+            t.wait(timeout=30.0)
+        assert exc.value.kind == "capacity"
+        assert "kshortest" in str(exc.value)
+        assert float(exc.value.retry_after_ms) == 250.0
+        # point lookups and un-laddered kinds are immune
+        s, d = _fresh_pair()
+        assert client.submit(s, d).wait(timeout=30.0).hops == \
+            solve_serial(N, EDGES, s, d).hops
+        s, d = _fresh_pair()
+        assert client.submit(s, d, kind="msbfs").wait(
+            timeout=30.0
+        ) is not None
+        text = reg.render()
+        assert 'bibfs_admission_shed_total{reason="kshortest"} 1' \
+            in text
+        # every reason cell pre-minted on an armed server
+        for r in SHED_REASONS:
+            assert f'reason="{r}"' in text
+    finally:
+        client.close()
+        server.close()
+        eng.close()
+
+
+def test_brownout_ladder_hysteresis_band():
+    """A rung engages at its threshold but releases only below
+    ``engage - release`` — occupancy wobbling inside the band must not
+    flap admission. Drives ``_shed_locked`` directly with a pinned
+    occupancy (the in-flight counters), the only deterministic way to
+    hold occupancy mid-band."""
+    eng = PipelinedQueryEngine(N, EDGES, max_wait_ms=5.0)
+    server = NetServer(
+        eng, max_inflight=10,
+        brownout=BrownoutPolicy(feasibility=False,
+                                ladder={"msbfs": 0.5}, release=0.2),
+    )
+    try:
+        def shed_at(occ10):
+            with server._lock:
+                server._submitting = occ10
+                out = server._shed_locked("msbfs", None)
+                server._submitting = 0
+                return out
+
+        assert shed_at(4) is None          # below engage: admitted
+        assert shed_at(5) == ("msbfs", 250.0)   # 0.5 >= 0.5: engaged
+        assert shed_at(4) == ("msbfs", 250.0)   # 0.4 > 0.3: held (band)
+        assert shed_at(3) is None          # 0.3 <= 0.3: released
+        assert shed_at(4) is None          # re-engages only at 0.5
+    finally:
+        server.close()
+        eng.close()
+
+
+def test_brownout_shed_spares_quota_token():
+    """Brownout rungs are checked BEFORE the tenant bucket: a shed must
+    not also burn a quota token. With burst 1 and a negligible refill,
+    the tenant's single token must still buy a query after the shed."""
+    eng = PipelinedQueryEngine(N, EDGES, max_wait_ms=5.0)
+    server = NetServer(
+        eng, quota_qps=0.001, quota_burst=1.0,
+        brownout=BrownoutPolicy(feasibility=False,
+                                ladder={"kshortest": 0.0}),
+    )
+    client = NetClient(server.host, server.port)
+    try:
+        t = client.submit(*_fresh_pair(), kind="kshortest", tenant="t")
+        with pytest.raises(QueryError):
+            t.wait(timeout=30.0)
+        s, d = _fresh_pair()
+        assert client.submit(s, d, tenant="t").wait(
+            timeout=30.0
+        ).hops == solve_serial(N, EDGES, s, d).hops
     finally:
         client.close()
         server.close()

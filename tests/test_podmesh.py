@@ -27,14 +27,16 @@ class _FakeWorker:
     """A worker's control socket driven from the test: decoded-frame
     reads and raw phase acks, no jax behind it."""
 
-    def __init__(self, port: int, process_index: int = 1):
+    def __init__(self, port: int, process_index: int = 1,
+                 epoch: int | None = None):
         self.sock = socket.create_connection(("127.0.0.1", port))
         self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self.buf = bytearray()
         self.pending = deque()
-        self.sock.sendall(encode_frame(
-            {"op": "hello", "process": int(process_index)}
-        ))
+        hello = {"op": "hello", "process": int(process_index)}
+        if epoch is not None:  # incarnation fencing tests
+            hello["epoch"] = int(epoch)
+        self.sock.sendall(encode_frame(hello))
 
     def recv_msg(self, timeout: float = 10.0) -> dict:
         self.sock.settimeout(timeout)
@@ -214,6 +216,156 @@ def test_reader_sweeps_stale_acks():
         with primary._lock:
             assert (99, "done") in primary._acks
             assert (1, "join") not in primary._acks
+    finally:
+        fw.close()
+        primary.close()
+
+
+# ---- failure domains: epochs, heartbeats, rejoin ----------------------
+
+def _poll(cond, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+def test_stale_epoch_ack_is_fenced_and_mailbox_stays_clean():
+    """After a rejoin at epoch 1, the old incarnation's late acks —
+    stamped epoch 0, arriving over its deliberately still-open socket —
+    are dropped and counted, never fed to ``await_phase``; an
+    epoch-LESS frame defaults to its reader's connection epoch, so a
+    zombie cannot dodge the fence by omitting the field."""
+    primary, (fw_a,) = _pod(1)
+    fw_b = _FakeWorker(primary.port, 1, epoch=1)
+    try:
+        assert primary.accept_rejoin(timeout_s=10.0) == 1
+        assert primary.worker_epoch(1) == 1
+        padded = np.zeros((4, 2), dtype=np.int64)
+        seq = primary.post_solve("d" * 16, "sync", padded, 4)
+        assert fw_b.recv_msg()["op"] == "solve"
+        before = primary.fenced_frames
+        fw_a.ack(seq, "join", epoch=0)   # the zombie's late ack
+        fw_a.ack(seq, "join")            # epoch-less: same fate
+        assert _poll(lambda: primary.fenced_frames >= before + 2)
+        with primary._lock:              # fenced != mailboxed
+            assert (seq, "join") not in primary._acks
+        # the CURRENT incarnation's ack feeds the barrier normally
+        fw_b.ack(seq, "join", epoch=1)
+        got = primary.await_phase(seq, "join", timeout=10.0)
+        assert got[1]["epoch"] == 1
+        # and the zombie's eventual EOF retires its reader SILENTLY:
+        # the recovered worker is not re-marked dead by its
+        # predecessor's death
+        fw_a.close()
+        time.sleep(0.3)
+        assert primary.dead_workers() == {}
+    finally:
+        fw_b.close()
+        fw_a.close()
+        primary.close()
+
+
+def test_rejoin_rejects_stale_or_unknown_incarnations():
+    """The rejoin gate: a zombie re-admitting itself at its OWN epoch,
+    or a connection claiming an unknown process index, is refused —
+    only a known worker at a STRICTLY higher epoch swaps in."""
+    primary, (fw_a,) = _pod(1)
+    zombie = _FakeWorker(primary.port, 1, epoch=0)   # not higher
+    stranger = _FakeWorker(primary.port, 7, epoch=3)  # never joined
+    try:
+        with pytest.raises(PodError, match="rejoin"):
+            primary.accept_rejoin(timeout_s=0.8)
+        assert primary.worker_epoch(1) == 0  # untouched
+    finally:
+        zombie.close()
+        stranger.close()
+        fw_a.close()
+        primary.close()
+
+
+def test_heartbeat_loss_marks_dead_and_aborts_prelaunch():
+    """Heartbeats feed LIVENESS only (never the ack mailbox); silence
+    past ``heartbeat_timeout_s`` marks the worker dead, which fails
+    the pending barrier and refuses new launches — the route's ladder
+    then degrades to the local rungs instead of hanging."""
+    from bibfs_tpu.parallel.podmesh import PodPrimary as _PP
+
+    primary = _PP(1, host="127.0.0.1", heartbeat_timeout_s=0.3)
+    fw = _FakeWorker(primary.port, 1)
+    primary.accept_workers()
+    try:
+        fw.sock.sendall(encode_frame({"op": "hb"}))
+        assert _poll(lambda: 1 in primary._last_hb)
+        with primary._lock:
+            assert not primary._acks  # hb never enters the mailbox
+        assert primary.check_heartbeats() == []  # fresh: not judged
+        padded = np.zeros((4, 2), dtype=np.int64)
+        seq = primary.post_solve("d" * 16, "sync", padded, 4)
+        time.sleep(0.45)  # silence past the timeout
+        assert primary.check_heartbeats() == [1]
+        assert primary.dead_workers() == {1: primary.dead_workers()[1]}
+        with pytest.raises(PodError, match="died"):
+            primary.await_phase(seq, "join", timeout=5.0)
+        with pytest.raises(PodError, match="died"):
+            primary.post_solve("d" * 16, "sync", padded, 4)
+    finally:
+        fw.close()
+        primary.close()
+
+
+def test_rejoin_voids_graph_memo_and_rebroadcasts():
+    """The digest memo short-circuits an unchanged graph — but a
+    rejoin voids it (the respawned incarnation holds NO graph), so the
+    next launch re-broadcasts the same digest through the chunk
+    stream."""
+    pairs = np.array([[i, i + 1] for i in range(9)], dtype=np.int64)
+    snap = _Snap(n=10, pairs=pairs, digest="g" * 16, version=1)
+    primary, (fw_a,) = _pod(1)
+    fw_b = None
+
+    def serve_graph(fw, epoch):
+        header = fw.recv_msg()
+        assert header["op"] == "graph"
+        for _ in range(header["chunks"]):
+            assert fw.recv_msg()["op"] == "graph_chunk"
+        fw.ack(header["seq"], "done", True,
+               digest=header["digest"], epoch=epoch)
+
+    try:
+        t = threading.Thread(target=serve_graph, args=(fw_a, 0),
+                             daemon=True)
+        t.start()
+        assert primary.ensure_graph(snap, build=lambda: 1,
+                                    timeout=10.0) == 1
+        t.join(timeout=10.0)
+        # memo: same digest returns from build() without posting
+        assert primary.ensure_graph(snap, build=lambda: 2,
+                                    timeout=1.0) == 2
+        fw_b = _FakeWorker(primary.port, 1, epoch=1)
+        assert primary.accept_rejoin(timeout_s=10.0) == 1
+        t2 = threading.Thread(target=serve_graph, args=(fw_b, 1),
+                              daemon=True)
+        t2.start()
+        assert primary.ensure_graph(snap, build=lambda: 3,
+                                    timeout=10.0) == 3
+        t2.join(timeout=10.0)
+        assert not t2.is_alive()  # the rebroadcast actually happened
+    finally:
+        if fw_b is not None:
+            fw_b.close()
+        fw_a.close()
+        primary.close()
+
+
+def test_epoch_gauge_renders():
+    from bibfs_tpu.obs.metrics import REGISTRY
+
+    primary, (fw,) = _pod(1)
+    try:
+        assert "bibfs_pod_worker_epoch" in REGISTRY.render()
     finally:
         fw.close()
         primary.close()
