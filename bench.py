@@ -2314,6 +2314,106 @@ def serve_queries_main():
         return 1
 
 
+def serve_analytics_main():
+    """``python bench.py --serve-analytics``: the whole-graph
+    analytics soak.
+
+    Runs :func:`bibfs_tpu.serve.loadgen.run_analytics` — every
+    analytics kind (``sssp``/``pagerank``/``components``/
+    ``triangles``) on random + grid + RMAT graphs through BOTH engines
+    with every answer verified against its independent reference
+    (binary-heap Dijkstra, dense NumPy power iteration, union-find,
+    adjacency intersection); a host-vs-blocked A/B over a density
+    ladder whose measured crossovers land in the platform entry's
+    ``analytics`` block of ``calibration.json`` (full runs gate
+    blocked winning every kind at the dense end); the per-digest
+    result-store lifecycle (persist, cross-engine re-serve, a
+    delete-roll invalidating mid-traffic, an adds-only batch served
+    by INCREMENTAL maintenance with zero full recomputes, an mmap
+    respawn); adaptive per-``digest#kind`` ladder learning; and both
+    analytics chaos seams degrading without a lost answer. The gate:
+    every phase green and the ``bibfs_analytics_*`` metric families
+    present in the registry render. Artifact:
+    ``bench_analytics.json``."""
+    t_setup = time.time()
+    # the blocked rungs verify on the multi-device dryrun substrate,
+    # forced BEFORE any jax import (the mesh soak's discipline)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    platform, tpu_error = select_platform()
+    try:
+        from bibfs_tpu.utils.platform import apply_platform_env
+
+        apply_platform_env()
+
+        from bibfs_tpu.obs.metrics import REGISTRY
+        from bibfs_tpu.obs.names import ANALYTICS_METRIC_FAMILIES
+        from bibfs_tpu.serve.loadgen import run_analytics
+
+        quick = "--quick" in sys.argv
+        out = run_analytics(quick=quick)
+        if not quick:
+            # bank the measured host->blocked crossovers (full runs
+            # only — smoke-scale timings would overwrite real ones)
+            from bibfs_tpu.utils.calibrate import (
+                CAL_FILENAME,
+                merge_calibration_block,
+            )
+
+            merge_calibration_block(
+                "cpu", "analytics", out["ab"]["crossovers"],
+                path=os.path.join(
+                    os.path.dirname(os.path.abspath(__file__)),
+                    CAL_FILENAME,
+                ),
+            )
+        render = REGISTRY.render()
+        missing = [
+            m for m in ANALYTICS_METRIC_FAMILIES if m not in render
+        ]
+        line = {
+            "metric": "bibfs_serve_analytics",
+            "value": sum(
+                1 for v in out["gates"].values() if v
+            ),
+            "unit": "gates_green",
+            "platform": platform,
+            "quick": quick,
+            **out,
+            "metrics_missing": missing,
+            "total_s": round(time.time() - t_setup, 1),
+        }
+        line["ok"] = bool(line["ok"] and not missing)
+        if tpu_error:
+            line["tpu_error"] = tpu_error[:300]
+        _write_artifact("bench_analytics.json", line)
+        print(json.dumps({
+            "metric": line["metric"],
+            "value": line["value"],
+            "unit": line["unit"],
+            "ok": line["ok"],
+            "gates": out["gates"],
+            "crossovers": out["ab"]["crossovers"],
+            "metrics_missing": missing,
+            "detail_file": "bench_analytics.json",
+        }))
+        return 0 if line["ok"] else 1
+    except Exception as e:
+        import traceback
+
+        traceback.print_exc()
+        print(json.dumps({
+            "metric": "bibfs_serve_analytics",
+            "value": None,
+            "error": f"{type(e).__name__}: {e}"[:400],
+        }))
+        return 1
+
+
 def serve_fleet_main():
     """``python bench.py --serve-fleet``: the fleet serving soak.
 
@@ -2784,6 +2884,8 @@ if __name__ == "__main__":
         sys.exit(serve_fleet_main())
     elif "--serve-queries" in sys.argv:
         sys.exit(serve_queries_main())
+    elif "--serve-analytics" in sys.argv:
+        sys.exit(serve_analytics_main())
     elif "--serve-oracle" in sys.argv:
         sys.exit(serve_oracle_main())
     elif "--serve-update" in sys.argv:

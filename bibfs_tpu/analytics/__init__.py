@@ -1,0 +1,57 @@
+"""Whole-graph semiring analytics over the blocked tile substrate.
+
+BLEST and "Graph Traversal on Tensor Cores" (PAPERS.md) show the BFS
+level is one instance of a family: swap the (OR, AND) semiring under
+the SAME tiled adjacency product and the machinery computes whole-graph
+analytics at MXU speed. This package is that seam made explicit:
+
+- :mod:`bibfs_tpu.analytics.semiring` — the
+  :class:`~bibfs_tpu.analytics.semiring.Semiring` abstraction, the CSR
+  host kernels, and the INDEPENDENT references every kind is pinned to
+  (Dijkstra / dense power iteration / union-find / adjacency-
+  intersection triangle count);
+- :mod:`bibfs_tpu.analytics.queries` — the typed whole-graph query
+  kinds (``sssp`` / ``pagerank`` / ``components`` / ``triangles``)
+  riding the PR 13/14 kind ladder;
+- :mod:`bibfs_tpu.analytics.results` — the per-digest whole-graph
+  result store (sidecar arrays next to durable checkpoints, served on
+  repeat queries, incrementally maintained across adds-only deltas,
+  mmap-recovered after respawn).
+
+The device rungs live in :mod:`bibfs_tpu.ops.semiring_plane` (the
+generalized ``blocked_expand``) and
+:mod:`bibfs_tpu.serve.routes.analytics` (the ladder rungs).
+"""
+
+from __future__ import annotations
+
+from bibfs_tpu.analytics.queries import (
+    ANALYTICS_KINDS,
+    Components,
+    ComponentsResult,
+    PageRank,
+    PageRankResult,
+    Sssp,
+    SsspResult,
+    Triangles,
+    TrianglesResult,
+    analytics_query_from_spec,
+    analytics_summary,
+)
+from bibfs_tpu.analytics.semiring import SEMIRINGS, Semiring
+
+__all__ = [
+    "ANALYTICS_KINDS",
+    "SEMIRINGS",
+    "Semiring",
+    "Sssp",
+    "SsspResult",
+    "PageRank",
+    "PageRankResult",
+    "Components",
+    "ComponentsResult",
+    "Triangles",
+    "TrianglesResult",
+    "analytics_query_from_spec",
+    "analytics_summary",
+]

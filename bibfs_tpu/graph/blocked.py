@@ -166,6 +166,39 @@ def build_blocked(
     )
 
 
+def build_blocked_weights(g: BlockedGraph, pairs: np.ndarray, *,
+                          seed: int = 0) -> np.ndarray:
+    """The float32 ``[nblocks, bwidth, tile, tile]`` WEIGHT table over
+    ``g``'s tiling: the seeded symmetric edge-weight hash
+    (:func:`bibfs_tpu.query.weighted.edge_weight_hash`) at every stored
+    edge's slot, ``+inf`` everywhere else — the (min, +) semiring's
+    absent-edge identity, so dead slots and sentinel tiles never win a
+    min. Live entries hash identically to ``synthetic_weights`` over
+    the same snapshot (the canonical (min, max) pair), which is what
+    pins the blocked SSSP rung to the host/Dijkstra answers."""
+    from bibfs_tpu.query.weighted import edge_weight_hash
+
+    wtab = np.full(
+        (g.nblocks, g.bwidth, g.tile, g.tile), np.inf, dtype=np.float32
+    )
+    if pairs is None or not pairs.size:
+        return wtab
+    br = pairs[:, 0] // g.tile
+    bc = pairs[:, 1] // g.tile
+    # dense (block row, block col) -> slot map; sentinel column writes
+    # land at index nblocks and are never looked up by a real pair
+    slot_map = np.full((g.nblocks, g.nblocks + 1), -1, dtype=np.int64)
+    slot_map[
+        np.arange(g.nblocks)[:, None], g.bcol
+    ] = np.arange(g.bwidth)[None, :]
+    w = edge_weight_hash(
+        pairs[:, 0].astype(np.int64), pairs[:, 1].astype(np.int64), seed
+    )
+    wtab[br, slot_map[br, bc], pairs[:, 0] % g.tile,
+         pairs[:, 1] % g.tile] = w.astype(np.float32)
+    return wtab
+
+
 def blocked_bucket_key(g: BlockedGraph) -> tuple:
     """The compiled-program shape identity of a blocked table — the
     analog of :func:`bibfs_tpu.serve.buckets.ell_bucket_key` for the

@@ -142,6 +142,18 @@ QUERY_METRIC_FAMILIES = (
     "bibfs_query_device_breaker_state",
 )
 
+#: whole-graph analytics tier (serve/routes/analytics.py +
+#: analytics/results.py): the rounds counter and blocked-rung breaker
+#: gauges mint at route-set construction on EVERY engine, the result-
+#: store event/entry families at store construction — all render at
+#: zero before the first analytics query
+ANALYTICS_METRIC_FAMILIES = (
+    "bibfs_analytics_rounds_total",
+    "bibfs_analytics_breaker_state",
+    "bibfs_analytics_store_events_total",
+    "bibfs_analytics_store_entries",
+)
+
 #: network front door (serve/net.py); minted at NetServer construction
 #: so a ``bibfs-serve --port`` process renders the whole group at zero
 #: before the first connection. Rejection reasons are tenant-less
@@ -213,6 +225,7 @@ ALL_METRIC_NAMES = frozenset(
     + BLOCKED_METRIC_FAMILIES
     + ADAPTIVE_METRIC_FAMILIES
     + QUERY_METRIC_FAMILIES
+    + ANALYTICS_METRIC_FAMILIES
     + NET_METRIC_FAMILIES
     + ELASTIC_METRIC_FAMILIES
     + DTRACE_METRIC_FAMILIES

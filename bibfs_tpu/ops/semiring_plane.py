@@ -1,0 +1,254 @@
+"""Semiring plane products over the blocked tile tables — the
+generalized :mod:`bibfs_tpu.ops.blocked_expand`.
+
+``expand_blocked_plane`` is the (OR, AND) instance of::
+
+    out = A (x) plane        over a semiring (add, mul)
+
+This module owns the other two products the analytics kinds need, over
+the SAME ``[nblocks, bwidth, tile, tile]`` tables, the same sentinel
+``bcol`` gather, and the same chunked block-row discipline:
+
+- :func:`plustimes_plane` — the (+, x) product as the identical
+  batched ``dot_general`` WITHOUT the ``> 0`` readout: raw
+  accumulator counts/sums (PageRank contributions, triangle counts).
+- :func:`minplus_plane` — the (min, +) product: per chunk the
+  ``[rc, bwidth, tile, tile, C]`` combine ``w + gathered`` reduced by
+  ``min`` over (slot, in-tile column). ``from_tab=True`` derives 0/inf
+  weights from the int8 adjacency per chunk (min-LABEL propagation —
+  no weight table materialized); otherwise the table IS a float32
+  weight table (``graph/blocked.build_blocked_weights``).
+
+The whole-graph recurrences (Bellman sweeps, label propagation, damped
+power iteration) run as ``lax.while_loop`` fixpoints INSIDE one jitted
+kernel per shape — one dispatch per query batch, rounds counted on
+device. Kernels are built by pure closures and jitted through
+``lru_cache`` getters keyed on every static (the dense-solver idiom).
+
+Exactness: planes are float32; distances (integer weight sums), labels
+(vertex ids) and per-vertex triangle counts are integer-valued, so the
+blocked answers equal the float64 host rungs bit-for-bit while values
+stay below 2^24 — the serving gates
+(:mod:`bibfs_tpu.serve.routes.analytics`) enforce that bound.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+
+from bibfs_tpu.graph.blocked import TILE
+from bibfs_tpu.ops.blocked_expand import BLOCKED_CHUNK_BUDGET_BYTES
+
+
+def minplus_chunk_rows(bwidth: int, c: int, tile: int = TILE) -> int:
+    """Block rows per (min, +) chunk: the combine materializes a
+    ``[rc, bwidth, tile, tile, C]`` float32 working set — a factor
+    ``tile`` heavier per row than the dot-product path, same budget."""
+    per_row = bwidth * tile * tile * max(1, c) * 4
+    return max(1, BLOCKED_CHUNK_BUDGET_BYTES // max(per_row, 1))
+
+
+def plustimes_plane(fr, tab, bcol, *, rc: int):
+    """``A @ fr`` over (+, x): the blocked_expand gather+dot_general
+    with the raw float32 accumulator returned (no ``> 0`` readout)."""
+    nblocks, bwidth = bcol.shape
+    tile = tab.shape[2]
+    c = fr.shape[1]
+    f2 = fr.reshape(nblocks, tile, c)
+    f2p = jnp.concatenate(
+        [f2, jnp.zeros((1, tile, c), fr.dtype)], axis=0
+    )
+    outs = []
+    for i0 in range(0, nblocks, rc):
+        tab_c = tab[i0: i0 + rc].astype(fr.dtype)
+        fr_c = jnp.take(f2p, bcol[i0: i0 + rc], axis=0)
+        outs.append(jax.lax.dot_general(
+            tab_c, fr_c,
+            dimension_numbers=(((1, 3), (1, 2)), ((0,), (0,))),
+            preferred_element_type=fr.dtype,
+        ))
+    return jnp.concatenate(outs, axis=0).reshape(nblocks * tile, c)
+
+
+def minplus_plane(fr, table, bcol, *, rc: int, from_tab: bool):
+    """``out[u] = min over edges (u, v) of (w_uv + fr[v])`` per plane
+    column. ``table`` is the float32 weight table (+inf at absent
+    slots), or with ``from_tab=True`` the int8 adjacency with 0/inf
+    weights derived per chunk. Sentinel ``bcol`` slots gather an
+    all-+inf frontier tile and never win the min."""
+    nblocks, bwidth = bcol.shape
+    tile = table.shape[2]
+    c = fr.shape[1]
+    inf = jnp.array(jnp.inf, fr.dtype)
+    f2 = fr.reshape(nblocks, tile, c)
+    f2p = jnp.concatenate(
+        [f2, jnp.full((1, tile, c), inf, fr.dtype)], axis=0
+    )
+    outs = []
+    for i0 in range(0, nblocks, rc):
+        w_c = table[i0: i0 + rc]
+        if from_tab:
+            w_c = jnp.where(w_c > 0, jnp.array(0.0, fr.dtype), inf)
+        else:
+            w_c = w_c.astype(fr.dtype)
+        fr_c = jnp.take(f2p, bcol[i0: i0 + rc], axis=0)
+        # [rc, bwidth, tile_row, tile_col, C] combine, min-reduced
+        # over (slot, in-tile column) — the (min, +) contraction
+        comb = w_c[:, :, :, :, None] + fr_c[:, :, None, :, :]
+        outs.append(jnp.min(comb, axis=(1, 3)))
+    return jnp.concatenate(outs, axis=0).reshape(nblocks * tile, c)
+
+
+def _build_minplus_fixpoint(nblocks, bwidth, c, rc, tile, from_tab,
+                            max_rounds):
+    """The Bellman/label-propagation fixpoint: sweep until no entry
+    improves (capped at ``max_rounds``). Returns ``(plane, rounds)``;
+    the final sweep that proves stability is counted."""
+
+    def kernel(table, bcol, init):
+        def cond(state):
+            _d, changed, rounds = state
+            return jnp.logical_and(changed, rounds < max_rounds)
+
+        def body(state):
+            d, _changed, rounds = state
+            nd = jnp.minimum(
+                d, minplus_plane(d, table, bcol, rc=rc, from_tab=from_tab)
+            )
+            return nd, jnp.any(nd < d), rounds + 1
+
+        state = (init, jnp.array(True), jnp.array(0, jnp.int32))
+        d, _changed, rounds = jax.lax.while_loop(cond, body, state)
+        return d, rounds
+
+    return kernel
+
+
+@lru_cache(maxsize=None)
+def _get_minplus_fixpoint(nblocks, bwidth, c, rc, tile, from_tab,
+                          max_rounds):
+    return jax.jit(_build_minplus_fixpoint(
+        nblocks, bwidth, c, rc, tile, from_tab, max_rounds
+    ))
+
+
+def _build_pagerank(nblocks, bwidth, rc, tile, n, damping, tol,
+                    max_iters):
+    """Damped power iteration to L1 tolerance on device: one jitted
+    while_loop, dangling mass redistributed uniformly, pad rows masked
+    out. Returns ``(ranks [n_pad], iters, delta)``."""
+    n_pad = nblocks * tile
+
+    def kernel(tab, bcol, deg):
+        mask = (jnp.arange(n_pad) < n).astype(jnp.float32)
+        degf = deg.astype(jnp.float32)
+        live = degf > 0
+        r0 = mask / jnp.float32(n)
+
+        def cond(state):
+            _r, delta, it = state
+            return jnp.logical_and(delta > tol, it < max_iters)
+
+        def body(state):
+            r, _delta, it = state
+            contrib = jnp.where(live, r / jnp.maximum(degf, 1.0), 0.0)
+            y = plustimes_plane(contrib[:, None], tab, bcol, rc=rc)[:, 0]
+            mass = jnp.sum(jnp.where(live, 0.0, r * mask))
+            rn = mask * (
+                (1.0 - damping) / n + damping * (y + mass / n)
+            )
+            return rn, jnp.sum(jnp.abs(rn - r)), it + 1
+
+        state = (
+            r0, jnp.array(jnp.inf, jnp.float32), jnp.array(0, jnp.int32)
+        )
+        return jax.lax.while_loop(cond, body, state)
+
+    return kernel
+
+
+@lru_cache(maxsize=None)
+def _get_pagerank(nblocks, bwidth, rc, tile, n, damping, tol, max_iters):
+    return jax.jit(_build_pagerank(
+        nblocks, bwidth, rc, tile, n, damping, tol, max_iters
+    ))
+
+
+def _build_tricount(nblocks, bwidth, c, rc, tile):
+    """One column-chunk's triangle contribution:
+    ``sum((A @ P) * P)`` with the product cast to int32 entry-wise
+    BEFORE the sum (each entry is an exact small count in f32; the
+    chunk total may not be)."""
+
+    def kernel(tab, bcol, plane):
+        y = plustimes_plane(plane, tab, bcol, rc=rc)
+        return jnp.sum((y * plane).astype(jnp.int32))
+
+    return kernel
+
+
+@lru_cache(maxsize=None)
+def _get_tricount(nblocks, bwidth, c, rc, tile):
+    return jax.jit(_build_tricount(nblocks, bwidth, c, rc, tile))
+
+
+# ---- the whole-graph entry points the blocked rungs call -------------
+def sssp_blocked(wtab, bcol, sources_init):
+    """Multi-source Bellman fixpoint over a float32 weight table.
+    ``sources_init`` is the ``[n_pad, C]`` plane (0 at each source's
+    column, +inf elsewhere). Returns ``(dist [n_pad, C], rounds)``."""
+    nblocks, bwidth = bcol.shape
+    tile = wtab.shape[2]
+    c = sources_init.shape[1]
+    rc = minplus_chunk_rows(bwidth, c, tile)
+    kern = _get_minplus_fixpoint(
+        nblocks, bwidth, c, rc, tile, False, nblocks * tile
+    )
+    return kern(wtab, bcol, sources_init)
+
+
+def components_blocked(tab, bcol, labels_init):
+    """Min-label propagation fixpoint over the int8 adjacency (0/inf
+    weights derived per chunk). Returns ``(labels [n_pad, 1],
+    rounds)``."""
+    nblocks, bwidth = bcol.shape
+    tile = tab.shape[2]
+    rc = minplus_chunk_rows(bwidth, 1, tile)
+    kern = _get_minplus_fixpoint(
+        nblocks, bwidth, 1, rc, tile, True, nblocks * tile
+    )
+    return kern(tab, bcol, labels_init)
+
+
+def pagerank_blocked(tab, bcol, deg, *, n, damping, tol, max_iters):
+    """Damped power iteration on device. ``tol`` is clamped to what
+    float32 L1 deltas can resolve. Returns ``(ranks [n_pad], iters,
+    delta)``."""
+    from bibfs_tpu.ops.blocked_expand import chunk_block_rows
+
+    nblocks, bwidth = bcol.shape
+    tile = tab.shape[2]
+    rc = chunk_block_rows(bwidth, 1, 4, tile)
+    tol_eff = max(float(tol), 5e-7)
+    kern = _get_pagerank(
+        nblocks, bwidth, rc, tile, int(n), float(damping), tol_eff,
+        int(max_iters),
+    )
+    ranks, delta, iters = kern(tab, bcol, deg)
+    return ranks, iters, delta
+
+
+def triangles_chunk_blocked(tab, bcol, plane):
+    """One column chunk's ordered-pair triangle total (host divides
+    the grand total by 6)."""
+    from bibfs_tpu.ops.blocked_expand import chunk_block_rows
+
+    nblocks, bwidth = bcol.shape
+    tile = tab.shape[2]
+    c = plane.shape[1]
+    rc = chunk_block_rows(bwidth, c, 4, tile)
+    kern = _get_tricount(nblocks, bwidth, c, rc, tile)
+    return kern(tab, bcol, plane)

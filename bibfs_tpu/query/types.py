@@ -44,7 +44,10 @@ from typing import Optional
 #: ``asof`` wraps one of the others but is counted as its own kind —
 #: the operational question "how much time-travel traffic" is about
 #: the replay machinery, not the inner shape
-QUERY_KINDS = ("pt", "msbfs", "weighted", "kshortest", "asof")
+QUERY_KINDS = ("pt", "msbfs", "weighted", "kshortest", "asof",
+               # whole-graph analytics kinds (bibfs_tpu/analytics):
+               # vectors/scalars over the full graph, same dispatch
+               "sssp", "pagerank", "components", "triangles")
 
 #: sources one bitmask-packed msBFS sweep answers (one uint64 word of
 #: reachability bits per vertex per sweep — oracle/trees.py)

@@ -99,6 +99,40 @@ def _control_reply(engine, store, cmd: str) -> str:
     )
 
 
+def _analytics_command(engine, current, parts: list[str]) -> str:
+    """The stdin ``analytics KIND [k=v ...]`` command: submit one
+    whole-graph kind through the engine's ladder (host / blocked rung
+    picked per the calibrated crossover) and reply with the one-line
+    JSON summary — never the whole vector; the vector lives in the
+    per-digest result store and the kind cache. Unknown kinds and
+    malformed params reply ``error invalid:`` in the result stream,
+    same contract as malformed query lines."""
+    from bibfs_tpu.analytics.queries import (
+        analytics_query_from_spec, analytics_summary,
+    )
+    from bibfs_tpu.serve.resilience import QueryError
+
+    params = {}
+    for tok in parts[2:]:
+        key, eq, val = tok.partition("=")
+        if not eq or not key:
+            return ("error invalid: usage: analytics KIND [k=v ...] "
+                    f"(bad token {tok!r})")
+        params[key] = val
+    try:
+        q = analytics_query_from_spec(parts[1] if len(parts) > 1 else "",
+                                      params)
+        res = engine.query_one(q, graph=current)
+    except (ValueError, TypeError) as e:
+        return f"error invalid: {e}"
+    except QueryError as e:
+        return f"error {e.kind}: {e}"
+    return "analytics " + json.dumps(
+        analytics_summary(res), sort_keys=True, default=str,
+        separators=(",", ":"),
+    )
+
+
 def _oracle_status(engine, store, current) -> str:
     """The stdin ``oracle`` command's reply line: the current graph's
     index status + hit counters (store-backed or engine-local)."""
@@ -1100,6 +1134,18 @@ def _serve(args, n, edges, store, QueryEngine, PipelinedQueryEngine,
                             snap, sort_keys=True, default=str,
                             separators=(",", ":"),
                         ))
+                        continue
+                    if parts[0] == "analytics":
+                        # the whole-graph tier: submit-and-flush one
+                        # typed kind and answer with its JSON summary.
+                        # The forced flush also resolves any queued
+                        # src/dst tickets — emit those (in submit
+                        # order) before the analytics reply
+                        reply = _analytics_command(
+                            engine, current, parts
+                        )
+                        drain()
+                        print(reply)
                         continue
                     if parts[0] in _STORE_COMMANDS:
                         if store is None:

@@ -82,6 +82,11 @@ KNOWN_SITES = ("device", "device_finish", "mesh", "mesh_finish",
                # taxonomy_device.py): each degrades to its host kind
                # rung when faulted
                "msbfs_device", "weighted_device", "kshortest_device",
+               # the whole-graph analytics tier (serve/routes/
+               # analytics.py): fired entering / leaving EVERY
+               # analytics solve, host and blocked rung alike — one
+               # spec line degrades the whole tier to its fallbacks
+               "analytics", "analytics_finish",
                # the distributed-trace spool append (obs/dtrace.py):
                # a failed flush drops the span, never the query
                "trace_flush")

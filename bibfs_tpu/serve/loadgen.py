@@ -5431,3 +5431,494 @@ def run_elastic(
         sys.setswitchinterval(old_si)
         if own_workdir:
             shutil.rmtree(workdir, ignore_errors=True)
+
+
+def _analytics_refs(n, edges, seed):
+    """Independent references for one graph: full Dijkstra distances
+    are computed lazily per source by the caller; this precomputes the
+    shared CSR + weights and the three whole-graph answers."""
+    from bibfs_tpu.analytics.semiring import (
+        ref_components_unionfind,
+        ref_pagerank_dense,
+        ref_triangles_intersect,
+    )
+    from bibfs_tpu.graph.csr import build_csr
+    from bibfs_tpu.query.weighted import synthetic_weights
+
+    csr = build_csr(n, edges)
+    return {
+        "csr": csr,
+        "weights": synthetic_weights(*csr, seed),
+        "pagerank": ref_pagerank_dense(n, *csr),
+        "components": ref_components_unionfind(n, edges),
+        "triangles": ref_triangles_intersect(n, *csr),
+    }
+
+
+def _check_analytics(tag, n, refs, queries, results, failures, *,
+                     pr_tol=2e-4):
+    """Verify one (query, result) stream against the independent
+    references: SSSP exact vs binary-heap Dijkstra, PageRank within
+    ``pr_tol`` of the dense power iteration (the blocked rung's f32
+    planes round at ~1e-6), components/triangles exact."""
+    from bibfs_tpu.query.weighted import dijkstra_numpy
+    from bibfs_tpu.serve.resilience import QueryError
+
+    before = len(failures)
+    for q, res in zip(queries, results):
+        if isinstance(res, QueryError):
+            failures.append(f"{tag} {q.kind}: {res}")
+            continue
+        if q.kind == "sssp":
+            ref, _par = dijkstra_numpy(
+                n, *refs["csr"], refs["weights"], int(q.source)
+            )
+            if res.dist.shape != (n,) or not np.allclose(
+                res.dist, ref, atol=1e-9, equal_nan=False
+            ):
+                bad = int(np.sum(~np.isclose(res.dist, ref, atol=1e-9)))
+                failures.append(
+                    f"{tag} sssp src={q.source}: {bad} wrong distances"
+                )
+            if res.reached != int(np.isfinite(ref).sum()):
+                failures.append(f"{tag} sssp src={q.source}: reached")
+        elif q.kind == "pagerank":
+            ref = refs["pagerank"]
+            err = float(np.max(np.abs(res.ranks - ref))) if n else 0.0
+            if res.ranks.shape != (n,) or err > pr_tol:
+                failures.append(f"{tag} pagerank: max err {err:.2e}")
+        elif q.kind == "components":
+            labels, count = refs["components"]
+            if res.count != count or not np.array_equal(
+                res.labels, labels
+            ):
+                failures.append(
+                    f"{tag} components: {res.count} != {count} "
+                    "or labels differ"
+                )
+        elif q.kind == "triangles":
+            if res.count != refs["triangles"]:
+                failures.append(
+                    f"{tag} triangles: {res.count} != "
+                    f"{refs['triangles']}"
+                )
+    return len(failures) == before
+
+
+def _force_analytics_rung(engine, min_edges: int) -> None:
+    """Pin the blocked analytics rungs' crossover for an A/B side
+    (0 forces blocked wherever the tile gates allow; a huge value
+    forces the host rungs)."""
+    from bibfs_tpu.analytics.queries import ANALYTICS_KINDS
+
+    for kind in ANALYTICS_KINDS:
+        engine.routes[f"{kind}_blocked"].min_edges = int(min_edges)
+
+
+def run_analytics(*, quick: bool = False, seed: int = 0,
+                  wal_dir: str | None = None) -> dict:
+    """The whole-graph analytics soak (``bench.py --serve-analytics``).
+
+    Five phases:
+
+    1. **exactness**: every kind on a random G(n, p), a perforated
+       grid, and an RMAT graph, through BOTH engines — the synchronous
+       engine pinned to the BLOCKED rungs (crossover forced to 0), the
+       pipelined engine pinned to the HOST rungs — every answer
+       verified against its independent reference (binary-heap
+       Dijkstra, dense power iteration, union-find, adjacency
+       intersection), and each side witnessed on the rung it claims in
+       ``bibfs_query_total``.
+    2. **host/blocked A/B**: per-kind best-of-3 solver-stamped clocks
+       on a density ladder of random graphs (fresh engine per repeat,
+       process-global jit cache warmed first, tables pre-built by an
+       untimed primer query so only the kind's own fixpoint is timed).
+       The smallest edge count where the blocked rung wins becomes the
+       calibration ``analytics`` block; full runs gate blocked winning
+       every kind at the dense end.
+    3. **serving + store lifecycle** on one durable GraphStore:
+       results persist as sidecar arrays (puts witnessed), a second
+       (pipelined) engine re-serves them without recompute
+       (``route="store"``), a MID-TRAFFIC roll with deletes
+       invalidates and recomputes exactly, an adds-only
+       update+compact serves SSSP/components by INCREMENTAL
+       maintenance (``incremental`` events, no new full puts), and an
+       adaptive engine learns per-``digest#kind`` ladder entries.
+    4. **respawn**: a fresh ``GraphStore.from_dir`` process-restart
+       serves the persisted vectors from mmap (``load`` events) with
+       zero recompute.
+    5. **chaos**: ``analytics:every=3`` and ``analytics_finish:times=4``
+       each injected on a fresh engine; every kind still answers with
+       the degrade witnessed in the resilience counters.
+    """
+    import shutil
+    import tempfile
+
+    from bibfs_tpu.analytics.queries import (
+        ANALYTICS_KINDS,
+        Components,
+        PageRank,
+        Sssp,
+        Triangles,
+    )
+    from bibfs_tpu.graph.generate import (
+        gnp_random_graph,
+        grid_graph,
+        rmat_graph,
+    )
+    from bibfs_tpu.serve import QueryEngine
+    from bibfs_tpu.serve.faults import FaultPlan
+    from bibfs_tpu.serve.pipeline import PipelinedQueryEngine
+    from bibfs_tpu.store import GraphStore
+
+    rng = np.random.default_rng(seed)
+    failures: list[str] = []
+
+    def kind_queries(s1, s2):
+        return [Sssp(int(s1)), Sssp(int(s2)), PageRank(),
+                Components(), Triangles()]
+
+    # ---- phase 1: exactness on random + grid + RMAT, both engines ----
+    if quick:
+        n_rand = 260
+        grid_wh = (14, 13)
+        rmat_scale, rmat_ef = 7, 6
+    else:
+        n_rand = 420
+        grid_wh = (19, 17)
+        rmat_scale, rmat_ef = 9, 8
+    n_rm, e_rm = rmat_graph(rmat_scale, rmat_ef, seed=seed + 3)
+    graphs = {
+        "random": (n_rand, gnp_random_graph(
+            n_rand, 6.0 / n_rand, seed=seed + 1
+        )),
+        "grid": (grid_wh[0] * grid_wh[1], grid_graph(
+            *grid_wh, perforation=0.08, seed=seed + 2
+        )),
+        "rmat": (n_rm, e_rm),
+    }
+    exact = {}
+    for gname, (gn, gedges) in graphs.items():
+        refs = _analytics_refs(gn, gedges, 0)
+        s1, s2 = int(rng.integers(gn)), int(rng.integers(gn))
+        qs = kind_queries(s1, s2)
+        # sync engine, blocked rungs forced on
+        eb = QueryEngine(gn, gedges)
+        _force_analytics_rung(eb, 0)
+        rb = eb.query_many(list(qs), return_errors=True)
+        kb = eb.stats()["query_kinds"]
+        eb.close()
+        _check_analytics(f"{gname}/blocked", gn, refs, qs, rb, failures)
+        # pipelined engine, host rungs forced
+        eh = PipelinedQueryEngine(gn, gedges, max_wait_ms=None)
+        _force_analytics_rung(eh, 1 << 30)
+        rh = eh.query_many(list(qs), return_errors=True)
+        kh = eh.stats()["query_kinds"]
+        eh.close()
+        _check_analytics(f"{gname}/host", gn, refs, qs, rh, failures)
+        blocked_served = {
+            k: int(kb.get(k, {}).get(f"{k}_blocked", 0))
+            for k in ANALYTICS_KINDS
+        }
+        host_served = {
+            k: int(kh.get(k, {}).get(k, 0)) for k in ANALYTICS_KINDS
+        }
+        if not all(blocked_served.values()):
+            failures.append(
+                f"{gname}: blocked rungs not exercised {blocked_served}"
+            )
+        if not all(host_served.values()):
+            failures.append(
+                f"{gname}: host rungs not exercised {host_served}"
+            )
+        if any(
+            kh.get(k, {}).get(f"{k}_blocked") for k in ANALYTICS_KINDS
+        ):
+            failures.append(f"{gname}: host side leaked onto blocked")
+        exact[gname] = {
+            "n": gn, "edges": int(len(gedges)),
+            "blocked_served": blocked_served,
+            "host_served": host_served,
+        }
+    exact_ok = not failures
+
+    # ---- phase 2: host/blocked A/B + crossover ladder ----------------
+    # fresh engine per timed repeat (the per-engine kind cache would
+    # otherwise re-serve the first answer); the process-global jit
+    # cache is warmed by an untimed full pass per size, and an untimed
+    # PRIMER query on each repeat engine pre-builds the tile tables so
+    # the solver-stamped clock times only the kind's own fixpoint.
+    # density ladder: the blocked substrate's work scales with the
+    # occupied TILE x TILE blocks, the host scatter iteration with E —
+    # so the ladder ramps density (edges per round of scatter), not
+    # just vertex count, toward the dense-ish regime the tile tables
+    # were built for
+    ab_sizes = ((300, 8.0), (800, 24.0)) if quick else (
+        (300, 8.0), (900, 12.0), (1200, 200.0)
+    )
+    ab_rows: dict = {}
+    crossovers: dict = {}
+    blocked_wins_dense: dict = {}
+    kind_q = {
+        "sssp": Sssp(1), "pagerank": PageRank(),
+        "components": Components(), "triangles": Triangles(),
+    }
+    # the primer is untimed and runs first on every repeat engine: an
+    # Sssp with a DIFFERENT source builds the tile tables AND the
+    # seed-0 weight table (the one per-(engine, seed) build), so the
+    # timed query's solver-stamped clock is the fixpoint alone
+    primer = {k: Sssp(2) for k in ANALYTICS_KINDS}
+    for an, deg in ab_sizes:
+        a_edges = gnp_random_graph(an, deg / an, seed=seed + 5)
+        num_edges = int(len(a_edges))
+
+        def _timed(kind, min_edges, repeats=3):
+            best = None
+            for _r in range(repeats):
+                e = QueryEngine(an, a_edges)
+                _force_analytics_rung(e, min_edges)
+                e.query_one(primer[kind])  # untimed: builds tables
+                res = e.query_one(kind_q[kind])
+                kinds = e.stats()["query_kinds"]
+                e.close()
+                want = (f"{kind}_blocked" if min_edges == 0 else kind)
+                if not kinds.get(kind, {}).get(want):
+                    failures.append(
+                        f"ab n={an} {kind}: rung {want} not used"
+                    )
+                    return float("inf")
+                if best is None or res.time_s < best:
+                    best = float(res.time_s)
+            return best
+
+        # warm pass: compile every blocked program for this shape
+        ew = QueryEngine(an, a_edges)
+        _force_analytics_rung(ew, 0)
+        ew.query_many(
+            [Sssp(0), PageRank(), Components(), Triangles()],
+            return_errors=True,
+        )
+        ew.close()
+        row = {}
+        for kind in ANALYTICS_KINDS:
+            h = _timed(kind, 1 << 30)
+            b = _timed(kind, 0)
+            wins = bool(b < h)
+            row[kind] = {
+                "host_ms": round(h * 1e3, 3),
+                "blocked_ms": round(b * 1e3, 3),
+                "blocked_wins": wins,
+            }
+            if wins and kind not in crossovers:
+                crossovers[f"{kind}_min_edges"] = num_edges
+            blocked_wins_dense[kind] = wins  # last size stands
+        ab_rows[str(an)] = {"edges": num_edges, **row}
+    for kind in ANALYTICS_KINDS:
+        crossovers.setdefault(f"{kind}_min_edges", 1 << 30)
+    ab_ok = quick or all(blocked_wins_dense.values())
+    if not ab_ok:
+        failures.append(
+            f"blocked rung lost the dense A/B: {blocked_wins_dense}"
+        )
+
+    # ---- phase 3: serving + store lifecycle --------------------------
+    own_wal = wal_dir is None
+    if own_wal:
+        wal_dir = tempfile.mkdtemp(prefix="bibfs-analytics-")
+    os.makedirs(wal_dir, exist_ok=True)
+    store = GraphStore(
+        compact_threshold=None, wal_dir=wal_dir, fsync="off",
+    )
+    sn = 320 if quick else 500
+    s_edges = gnp_random_graph(sn, 7.0 / sn, seed=seed + 7)
+    store.add("g", sn, s_edges)
+
+    def store_events():
+        return store.analytics.stats()["events"]
+
+    def edge_set():
+        return set(
+            map(tuple, store.current("g").undirected_edges().tolist())
+        )
+
+    def rand_new_edges(count, existing):
+        from bibfs_tpu.store.delta import canonical_edge
+
+        out = set()
+        while len(out) < count:
+            u, v = int(rng.integers(sn)), int(rng.integers(sn))
+            if u == v:
+                continue
+            e = canonical_edge(sn, u, v)
+            if e not in existing and e not in out:
+                out.add(e)
+        return sorted(out)
+
+    refs1 = _analytics_refs(sn, np.array(sorted(edge_set())), 0)
+    src1 = int(rng.integers(sn))
+    qs1 = [Sssp(src1), PageRank(), Components(), Triangles()]
+    eng1 = QueryEngine(store=store, graph="g")
+    r1 = eng1.query_many(list(qs1), return_errors=True)
+    _check_analytics("serve/v1", sn, refs1, qs1, r1, failures)
+    ev = store_events()
+    puts_v1 = ev["put"]
+    store_ok = bool(puts_v1 >= len(qs1))
+    if not store_ok:
+        failures.append(f"store puts after v1 serve: {puts_v1}")
+
+    # a SECOND engine (pipelined — the consult seam both engines
+    # share) re-serves from the store with zero recompute
+    eng2 = PipelinedQueryEngine(store=store, graph="g",
+                                max_wait_ms=None)
+    r2 = eng2.query_many(list(qs1), return_errors=True)
+    _check_analytics("serve/store-hit", sn, refs1, qs1, r2, failures)
+    k2 = eng2.stats()["query_kinds"]
+    served_store = sum(
+        int(k2.get(k, {}).get("store", 0)) for k in ANALYTICS_KINDS
+    )
+    if served_store < len(qs1):
+        failures.append(
+            f"second engine not store-served: {served_store}"
+        )
+    reserve_ok = served_store >= len(qs1)
+
+    # MID-TRAFFIC hot-swap with deletes: invalidate-and-recompute
+    cur = edge_set()
+    dels = sorted(
+        map(tuple, rng.permutation(
+            np.array(sorted(cur), dtype=np.int64)
+        )[:4].tolist())
+    )
+    adds = rand_new_edges(8, cur)
+    inval_before = store_events()["invalidated"]
+    store.roll("g", adds=adds, dels=dels)
+    refs2 = _analytics_refs(sn, np.array(sorted(edge_set())), 0)
+    r1b = eng1.query_many(list(qs1), return_errors=True)
+    _check_analytics("serve/post-swap", sn, refs2, qs1, r1b, failures)
+    inval_after = store_events()["invalidated"]
+    swap_ok = bool(inval_after > inval_before)
+    if not swap_ok:
+        failures.append("delete-roll did not invalidate stored results")
+
+    # adds-only delta batch: SSSP/components maintained INCREMENTALLY
+    adds2 = rand_new_edges(6, edge_set())
+    store.update("g", adds=adds2, dels=[])
+    store.compact("g")
+    ev_before = store_events()
+    refs3 = _analytics_refs(sn, np.array(sorted(edge_set())), 0)
+    qs_inc = [Sssp(src1), Components()]
+    r_inc = eng1.query_many(list(qs_inc), return_errors=True)
+    _check_analytics("serve/incremental", sn, refs3, qs_inc, r_inc,
+                     failures)
+    ev_after = store_events()
+    inc_delta = ev_after["incremental"] - ev_before["incremental"]
+    put_delta = ev_after["put"] - ev_before["put"]
+    incremental_ok = bool(inc_delta >= 2 and put_delta == 0)
+    if not incremental_ok:
+        failures.append(
+            f"adds-only leg: incremental={inc_delta} new_puts="
+            f"{put_delta} (wanted >=2 maintained, 0 full recomputes)"
+        )
+
+    # adaptive ladder: per-(digest, kind) entries learned for the new
+    # kinds (the policy namespaces them as ``digest#<kind>``)
+    eng_a = QueryEngine(store=store, graph="g", adaptive=True)
+    eng_a.query_one(Sssp((src1 + 1) % sn))
+    eng_a.query_one(Triangles())
+    pol = (eng_a.stats().get("adaptive") or {}).get("digests", {})
+    adaptive_kinds = sorted({
+        k.rsplit("#", 1)[1] for k in pol if "#" in k
+    })
+    adaptive_ok = bool(
+        {"sssp", "triangles"} <= set(adaptive_kinds)
+    )
+    if not adaptive_ok:
+        failures.append(
+            f"adaptive policy learned no analytics entries: {pol}"
+        )
+    eng_a.close()
+    eng1.close()
+    eng2.close()
+    store.close()
+
+    # ---- phase 4: respawn — mmap-served from the sidecars ------------
+    store_r = GraphStore.from_dir(wal_dir, durable=True)
+    eng_r = QueryEngine(store=store_r, graph="g")
+    ev_r0 = store_r.analytics.stats()["events"]
+    r_resp = eng_r.query_many(list(qs_inc), return_errors=True)
+    _check_analytics("respawn", sn, refs3, qs_inc, r_resp, failures)
+    ev_r1 = store_r.analytics.stats()["events"]
+    kr = eng_r.stats()["query_kinds"]
+    respawn_store_served = sum(
+        int(kr.get(k, {}).get("store", 0)) for k in ANALYTICS_KINDS
+    )
+    respawn_ok = bool(
+        ev_r1["load"] > ev_r0["load"]
+        and respawn_store_served >= len(qs_inc)
+    )
+    if not respawn_ok:
+        failures.append(
+            f"respawn not mmap-served: loads={ev_r1['load']} "
+            f"store_served={respawn_store_served}"
+        )
+    eng_r.close()
+    store_r.close()
+
+    # ---- phase 5: chaos on both analytics seams ----------------------
+    cn = 240
+    c_edges = gnp_random_graph(cn, 7.0 / cn, seed=seed + 9)
+    c_refs = _analytics_refs(cn, c_edges, 0)
+    chaos: dict = {}
+    for spec in ("analytics:every=3", "analytics_finish:times=4"):
+        plan = FaultPlan.parse(spec, seed=seed)
+        ce = QueryEngine(cn, c_edges, faults=plan)
+        cq = kind_queries(3, 5)[1:]  # one sssp + the three others
+        cr = ce.query_many(list(cq), return_errors=True)
+        cst = ce.stats()
+        ce.close()
+        pre = len(failures)
+        _check_analytics(f"chaos[{spec}]", cn, c_refs, cq, cr, failures)
+        res_block = cst["resilience"]
+        fired = res_block["faults"]["fired_total"]
+        degrade = (
+            sum(res_block["fallbacks"].values())
+            + int(res_block["retries"])
+        )
+        answered_exact = len(failures) == pre
+        chaos[spec] = {
+            "answered_exact": answered_exact,
+            "faults_fired": fired,
+            "degrades": degrade,
+            "ok": bool(answered_exact and fired > 0 and degrade > 0),
+        }
+    chaos_ok = all(c["ok"] for c in chaos.values())
+
+    if own_wal:
+        shutil.rmtree(wal_dir, ignore_errors=True)
+
+    gates = {
+        "exact_ok": exact_ok,
+        "ab_ok": ab_ok,
+        "store_ok": store_ok,
+        "reserve_ok": reserve_ok,
+        "swap_ok": swap_ok,
+        "incremental_ok": incremental_ok,
+        "adaptive_ok": adaptive_ok,
+        "respawn_ok": respawn_ok,
+        "chaos_ok": chaos_ok,
+    }
+    return {
+        "ok": bool(all(gates.values()) and not failures),
+        "failures": failures[:20],
+        "gates": gates,
+        "exactness": exact,
+        "ab": {"rows": ab_rows, "crossovers": crossovers,
+               "gated": not quick},
+        "store": {
+            "puts_v1": int(puts_v1),
+            "store_served": int(served_store),
+            "incremental": int(inc_delta),
+            "adaptive_kinds": adaptive_kinds,
+        },
+        "chaos": chaos,
+    }

@@ -131,7 +131,8 @@ SHED_REASONS = ("infeasible",) + BROWNOUT_LADDER
 #: Prometheus rendering for fleet-wide aggregation, ``flightrec`` the
 #: flight-recorder ring — and dumps it with ``dump: true``)
 CONTROL_OPS = ("health", "stats", "memory", "graphs", "version",
-               "update", "roll", "ping", "metrics", "flightrec")
+               "update", "roll", "ping", "metrics", "flightrec",
+               "analytics")
 
 
 class FrameError(ValueError):
@@ -883,6 +884,23 @@ class NetServer:
                 "graph": g,
                 "version": st.get("graph", {}).get("version"),
             }
+        if op == "analytics":
+            # the whole-graph tier over the wire: submit-and-flush one
+            # typed kind and reply with the scalar summary (the vector
+            # stays server-side, in the kind cache and the per-digest
+            # result store — a reply frame never carries O(n) data).
+            # Runs on the IO thread like update/roll: an analytics
+            # flush brackets this replica's traffic for its duration
+            from bibfs_tpu.analytics.queries import (
+                analytics_query_from_spec, analytics_summary,
+            )
+
+            g = msg.get("graph")
+            name = None if g is None else str(g)
+            q = analytics_query_from_spec(
+                str(msg.get("kind") or ""), msg.get("params") or {}
+            )
+            return analytics_summary(eng.query_one(q, graph=name))
         if op in ("update", "roll"):
             if self._store is None:
                 raise ValueError("no store attached")

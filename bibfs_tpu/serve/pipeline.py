@@ -528,6 +528,11 @@ class PipelinedQueryEngine(QueryEngine):
                 self._finish_ticket(t, hit)
                 self.latency.record(t.t_done - t.t_submit)
                 return t
+            res = self._consult_analytics_store(name, rt, q)
+            if res is not None:
+                self._finish_ticket(t, res)
+                self.latency.record(t.t_done - t.t_submit)
+                return t
         rt = self._pin_rt(name)
         # the host-solve serializer also covers taxonomy solves: the
         # kind fallbacks share the per-runtime serial machinery with

@@ -16,6 +16,11 @@ ladder stays the default and the fallback semantics are unchanged.
 
 from __future__ import annotations
 
+from bibfs_tpu.serve.routes.analytics import (
+    AnalyticsBlockedRoute,
+    AnalyticsHostRoute,
+    build_analytics_routes,
+)
 from bibfs_tpu.serve.routes.base import Route
 from bibfs_tpu.serve.routes.blocked import BlockedConfig, BlockedRoute
 from bibfs_tpu.serve.routes.device import DeviceRoute
@@ -43,6 +48,8 @@ from bibfs_tpu.serve.routes.taxonomy_device import (
 
 __all__ = [
     "Route",
+    "AnalyticsBlockedRoute",
+    "AnalyticsHostRoute",
     "BlockedConfig",
     "BlockedRoute",
     "DeviceRoute",
@@ -64,6 +71,7 @@ __all__ = [
     "QueryKindCells",
     "WeightedRoute",
     "WeightedDeviceRoute",
+    "build_analytics_routes",
     "build_routes",
     "build_taxonomy_routes",
     "mesh_prebuild",

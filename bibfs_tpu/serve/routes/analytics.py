@@ -1,0 +1,476 @@
+"""The whole-graph analytics rungs — ``sssp`` / ``pagerank`` /
+``components`` / ``triangles`` through the kind ladder.
+
+Each analytics kind is served exactly like the PR 13/14 taxonomy
+kinds: a host-tier primary (the CSR semiring iteration,
+:mod:`bibfs_tpu.analytics.semiring`) with its own retry policy and
+circuit breaker, a per-query-isolated terminal ``fallback``, and a
+BLOCKED rung above it (:mod:`bibfs_tpu.ops.semiring_plane` over the
+``BlockedGraph`` tile tables) that an adaptive per-digest ladder
+reorders and a faulted device degrades out of with zero lost tickets.
+
+The blocked rungs differ from the device kind rungs in one gate: they
+do NOT require ``_use_device()`` — the blocked semiring product is the
+same jitted program on the CPU substrate (f32 planes, the
+``blocked_expand`` measurement) and wins on dense-ish graphs there
+too, so eligibility is snapshot-base + ELL layout + the tile-table
+budgets + an EXACTNESS bound (integer-valued planes stay exact in f32
+below 2^24) + the calibrated ``analytics`` crossover block
+(``bench.py --serve-analytics`` writes it; committed defaults below).
+
+Chaos seams: every analytics launch fires ``analytics`` going in and
+``analytics_finish`` on the way out (both rungs — the seam is the
+kind, not the tier), so one spec line degrades the whole tier to its
+fallbacks. Metrics: ``bibfs_analytics_rounds_total{engine,kind}``
+(relaxation sweeps / power iterations / label rounds / column chunks)
+and ``bibfs_analytics_breaker_state{engine,kind}`` for the blocked
+rungs, all minted at route-set construction.
+"""
+
+from __future__ import annotations
+
+import time
+import weakref
+
+import numpy as np
+
+from bibfs_tpu.graph.blocked import TILE as TILE_EDGE
+from bibfs_tpu.obs.metrics import REGISTRY
+from bibfs_tpu.obs.trace import span
+from bibfs_tpu.serve.buckets import placement_bucket_key
+from bibfs_tpu.serve.resilience import BREAKER_STATE_CODES
+from bibfs_tpu.serve.routes.taxonomy import TaxonomyRoute
+
+#: committed host->blocked crossovers (edge counts), overridden by the
+#: calibrated ``analytics`` block (``bench.py --serve-analytics``).
+#: The blocked fixpoints pay one dispatch + (first time) one compile;
+#: below a few thousand edges the NumPy scatter iteration wins.
+DEFAULT_ANALYTICS_MIN_EDGES = {
+    "sssp": 4000,
+    "pagerank": 4000,
+    "components": 4000,
+    "triangles": 2000,
+}
+
+#: exactness bound for float32 planes: distances / labels / counts are
+#: integer-valued and exact below 2^24
+_F32_EXACT = 1 << 24
+
+#: triangle column-chunk width (static — one compiled program per graph)
+_TRI_CHUNK = 256
+
+
+def analytics_calibration() -> dict:
+    """The current platform's calibrated ``analytics`` crossover block
+    (empty when absent — committed defaults apply)."""
+    from bibfs_tpu.utils.calibrate import load_calibration
+
+    cal = load_calibration()
+    if not cal:
+        return {}
+    block = cal.get("analytics")
+    return block if isinstance(block, dict) else {}
+
+
+def _rounds_cell(label: str, kind: str):
+    return REGISTRY.counter(
+        "bibfs_analytics_rounds_total",
+        "Whole-graph analytics iteration rounds (Bellman sweeps, "
+        "power iterations, label-propagation rounds, triangle column "
+        "chunks), by kind",
+        ("engine", "kind"),
+    ).labels(engine=label, kind=kind)
+
+
+class AnalyticsHostRoute(TaxonomyRoute):
+    """Shared shape of the four host-tier analytics rungs: the CSR
+    semiring iteration behind ``Route.attempt``, the ``analytics`` /
+    ``analytics_finish`` chaos seams, and a per-query-isolated
+    fallback over the same single-query machinery."""
+
+    def __init__(self, engine, *, retry, breaker, label: str):
+        super().__init__(engine, retry=retry, breaker=breaker)
+        self.rounds_cell = _rounds_cell(label, self.kind)
+
+    def launch(self, rt, queries, ctx=None):
+        with span(f"{self.kind}_batch", batch=len(queries)):
+            self._fire("analytics", queries)
+            t0 = time.perf_counter()
+            out = self._solve_batch(rt, queries, ctx, t0)
+            self._fire("analytics_finish", queries)
+            return out, None, t0
+
+    def _solve_batch(self, rt, queries, ctx, t0):
+        raise NotImplementedError
+
+    def _weights(self, rt, ctx, seed: int):
+        from bibfs_tpu.query.weighted import synthetic_weights
+
+        if ctx.base:
+            return rt.weights_for(seed, ctx.row_ptr, ctx.col_ind)
+        return synthetic_weights(ctx.row_ptr, ctx.col_ind, seed)
+
+
+class SsspRoute(AnalyticsHostRoute):
+    """(min, +) Bellman sweeps to fixpoint; a flush's same-seed
+    sources batch into ONE multi-column plane (the landmarks shape)."""
+
+    name = "sssp"
+    kind = "sssp"
+
+    def _solve_batch(self, rt, queries, ctx, t0):
+        from bibfs_tpu.analytics.queries import SsspResult
+        from bibfs_tpu.analytics.semiring import host_sssp
+
+        by_seed: dict[int, list] = {}
+        for i, q in enumerate(queries):
+            by_seed.setdefault(int(q.weight_seed), []).append((i, q))
+        out: list = [None] * len(queries)
+        for seed, group in sorted(by_seed.items()):
+            w = self._weights(rt, ctx, seed)
+            dist, rounds = host_sssp(
+                ctx.n, ctx.row_ptr, ctx.col_ind, w,
+                [int(q.source) for _i, q in group],
+            )
+            self.rounds_cell.inc(int(rounds))
+            for col, (i, _q) in enumerate(group):
+                d = dist[:, col]
+                out[i] = SsspResult(
+                    found=True, dist=d,
+                    reached=int(np.isfinite(d).sum()),
+                    rounds=int(rounds),
+                    time_s=time.perf_counter() - t0,
+                )
+        return out
+
+    def _fallback_one(self, rt, q, ctx):
+        from bibfs_tpu.analytics.queries import SsspResult
+        from bibfs_tpu.analytics.semiring import host_sssp
+
+        t0 = time.perf_counter()
+        w = self._weights(rt, ctx, int(q.weight_seed))
+        dist, rounds = host_sssp(
+            ctx.n, ctx.row_ptr, ctx.col_ind, w, [int(q.source)]
+        )
+        d = dist[:, 0]
+        return SsspResult(
+            found=True, dist=d, reached=int(np.isfinite(d).sum()),
+            rounds=int(rounds), time_s=time.perf_counter() - t0,
+        )
+
+
+class PageRankRoute(AnalyticsHostRoute):
+    """(+, x) damped power iteration with L1-tolerance termination."""
+
+    name = "pagerank"
+    kind = "pagerank"
+
+    def _solve_batch(self, rt, queries, ctx, t0):
+        return [self._fallback_one(rt, q, ctx) for q in queries]
+
+    def _fallback_one(self, rt, q, ctx):
+        from bibfs_tpu.analytics.queries import PageRankResult
+        from bibfs_tpu.analytics.semiring import host_pagerank
+
+        t0 = time.perf_counter()
+        ranks, iters, delta = host_pagerank(
+            ctx.n, ctx.row_ptr, ctx.col_ind,
+            damping=float(q.damping), tol=float(q.tol),
+            max_iters=int(q.max_iters),
+        )
+        self.rounds_cell.inc(int(iters))
+        return PageRankResult(
+            found=ctx.n > 0, ranks=ranks, iters=int(iters),
+            delta=float(delta), time_s=time.perf_counter() - t0,
+        )
+
+
+class ComponentsRoute(AnalyticsHostRoute):
+    """Min-label propagation to fixpoint."""
+
+    name = "components"
+    kind = "components"
+
+    def _solve_batch(self, rt, queries, ctx, t0):
+        return [self._fallback_one(rt, q, ctx) for q in queries]
+
+    def _fallback_one(self, rt, q, ctx):
+        from bibfs_tpu.analytics.queries import ComponentsResult
+        from bibfs_tpu.analytics.semiring import host_components
+
+        t0 = time.perf_counter()
+        labels, count, rounds = host_components(
+            ctx.n, ctx.row_ptr, ctx.col_ind
+        )
+        self.rounds_cell.inc(int(rounds))
+        return ComponentsResult(
+            found=True, labels=labels, count=int(count),
+            rounds=int(rounds), time_s=time.perf_counter() - t0,
+        )
+
+
+class TrianglesRoute(AnalyticsHostRoute):
+    """The masked popcount matmul count, column-chunked."""
+
+    name = "triangles"
+    kind = "triangles"
+
+    def _solve_batch(self, rt, queries, ctx, t0):
+        return [self._fallback_one(rt, q, ctx) for q in queries]
+
+    def _fallback_one(self, rt, q, ctx):
+        from bibfs_tpu.analytics.queries import TrianglesResult
+        from bibfs_tpu.analytics.semiring import host_triangles
+
+        t0 = time.perf_counter()
+        count, chunks = host_triangles(ctx.n, ctx.row_ptr, ctx.col_ind)
+        self.rounds_cell.inc(int(chunks))
+        return TrianglesResult(
+            found=True, count=int(count),
+            time_s=time.perf_counter() - t0,
+        )
+
+
+class AnalyticsBlockedRoute(TaxonomyRoute):
+    """Shared shape of the blocked analytics rungs: tile-table gates +
+    calibrated crossover, the per-kind breaker gauge, and the ladder
+    contract (an unavailable rung degrades to the host kind rung — no
+    ``fallback`` of its own)."""
+
+    #: extra resident bytes per int8 table byte (sssp adds the f32
+    #: weight table at 4x)
+    TABLE_SCALE = 1
+
+    def __init__(self, engine, *, retry, breaker, label: str):
+        super().__init__(engine, retry=retry, breaker=breaker)
+        self.rounds_cell = _rounds_cell(label, self.kind)
+        gauge = REGISTRY.gauge(
+            "bibfs_analytics_breaker_state",
+            "Blocked analytics rung circuit breakers "
+            "(0=closed 1=half_open 2=open)",
+            ("engine", "kind"),
+        ).labels(engine=label, kind=self.kind)
+        self.breaker_gauge = gauge
+        # weakly bound through the route (registry cells are not
+        # weakref-able) — the mesh/blocked/msbfs contract
+        self_ref = weakref.ref(self)
+
+        def _on_transition(state):
+            route = self_ref()
+            if route is None:
+                return False
+            route.breaker_gauge.set(BREAKER_STATE_CODES[state])
+            return True
+
+        breaker.add_listener(_on_transition)
+        gauge.set(BREAKER_STATE_CODES[breaker.state])
+        cal = analytics_calibration()
+        self.min_edges = int(cal.get(
+            f"{self.kind}_min_edges",
+            DEFAULT_ANALYTICS_MIN_EDGES[self.kind],
+        ))
+
+    def kind_eligible(self, rt, queries, ctx) -> bool:
+        if ctx is None or not ctx.base:
+            return False  # overlay-merged truth: host rungs answer
+        if getattr(rt, "layout", None) != "ell":
+            return False
+        meta = getattr(rt, "blocked_meta", None)
+        if meta is None:
+            return False
+        nblocks, bwidth, _nnz = rt.blocked_meta()
+        if nblocks * TILE_EDGE >= _F32_EXACT:
+            return False  # f32 planes would lose integer exactness
+        from bibfs_tpu.ops.blocked_expand import BLOCKED_TAB_BUDGET_BYTES
+
+        tab_bytes = nblocks * bwidth * TILE_EDGE * TILE_EDGE
+        if tab_bytes * self.TABLE_SCALE > BLOCKED_TAB_BUDGET_BYTES:
+            return False
+        num_edges = int(ctx.col_ind.size) // 2
+        return num_edges >= self.min_edges
+
+    def _note_exec(self, nblocks: int, bwidth: int, extra=()):
+        self.engine.exec_cache.note(placement_bucket_key(
+            ("analytics", nblocks, bwidth),
+            kind=f"{self.kind}_blocked", shards=1, extra=tuple(extra),
+        ))
+
+    def _fallback_one(self, rt, q, ctx):
+        raise NotImplementedError(
+            "blocked analytics rungs degrade to their host kind route"
+        )
+
+    def stats(self) -> dict:
+        out = super().stats()
+        out["crossover"] = {"min_edges": self.min_edges}
+        return out
+
+
+class SsspBlockedRoute(AnalyticsBlockedRoute):
+    """Multi-source (min, +) fixpoint over the float32 weight tables
+    (``graph/blocked.build_blocked_weights``, memoized per (runtime,
+    seed) beside the ELL weight tables)."""
+
+    name = "sssp_blocked"
+    kind = "sssp"
+    TABLE_SCALE = 5  # int8 adjacency + f32 weight table
+
+    def launch(self, rt, queries, ctx=None):
+        from bibfs_tpu.analytics.queries import SsspResult
+        from bibfs_tpu.ops.semiring_plane import sssp_blocked
+
+        with span("sssp_blocked_batch", batch=len(queries)):
+            self._fire("analytics", queries)
+            t0 = time.perf_counter()
+            bg = rt.blocked_graph()
+            by_seed: dict[int, list] = {}
+            for i, q in enumerate(queries):
+                by_seed.setdefault(int(q.weight_seed), []).append((i, q))
+            out: list = [None] * len(queries)
+            for seed, group in sorted(by_seed.items()):
+                wtab = rt.analytics_weight_table(seed)
+                init = np.full(
+                    (bg.n_pad, len(group)), np.inf, dtype=np.float32
+                )
+                for col, (_i, q) in enumerate(group):
+                    init[int(q.source), col] = 0.0
+                self._note_exec(
+                    bg.nblocks, bg.bwidth, extra=(len(group),)
+                )
+                dist, rounds = sssp_blocked(wtab, bg.bcol, init)
+                dist = np.asarray(dist, dtype=np.float64)
+                self.rounds_cell.inc(int(rounds))
+                for col, (i, _q) in enumerate(group):
+                    d = dist[: ctx.n, col]
+                    out[i] = SsspResult(
+                        found=True, dist=d,
+                        reached=int(np.isfinite(d).sum()),
+                        rounds=int(rounds),
+                        time_s=time.perf_counter() - t0,
+                    )
+            self._fire("analytics_finish", queries)
+            return out, None, t0
+
+
+class PageRankBlockedRoute(AnalyticsBlockedRoute):
+    """Damped power iteration as one jitted while_loop per parameter
+    set (tolerance clamped to f32 resolution — ranks agree with the
+    host rung to ~1e-6, the verification tolerance)."""
+
+    name = "pagerank_blocked"
+    kind = "pagerank"
+
+    def launch(self, rt, queries, ctx=None):
+        from bibfs_tpu.analytics.queries import PageRankResult
+        from bibfs_tpu.ops.semiring_plane import pagerank_blocked
+
+        with span("pagerank_blocked_batch", batch=len(queries)):
+            self._fire("analytics", queries)
+            t0 = time.perf_counter()
+            bg = rt.blocked_graph()
+            out = []
+            for q in queries:
+                self._note_exec(bg.nblocks, bg.bwidth)
+                ranks, iters, delta = pagerank_blocked(
+                    bg.tab, bg.bcol, bg.deg, n=ctx.n,
+                    damping=float(q.damping), tol=float(q.tol),
+                    max_iters=int(q.max_iters),
+                )
+                self.rounds_cell.inc(int(iters))
+                out.append(PageRankResult(
+                    found=ctx.n > 0,
+                    ranks=np.asarray(ranks, dtype=np.float64)[: ctx.n],
+                    iters=int(iters), delta=float(delta),
+                    time_s=time.perf_counter() - t0,
+                ))
+            self._fire("analytics_finish", queries)
+            return out, None, t0
+
+
+class ComponentsBlockedRoute(AnalyticsBlockedRoute):
+    """Min-label propagation over the int8 adjacency (0/inf weights
+    derived per chunk — no weight table materialized)."""
+
+    name = "components_blocked"
+    kind = "components"
+
+    def launch(self, rt, queries, ctx=None):
+        from bibfs_tpu.analytics.queries import ComponentsResult
+        from bibfs_tpu.ops.semiring_plane import components_blocked
+
+        with span("components_blocked_batch", batch=len(queries)):
+            self._fire("analytics", queries)
+            t0 = time.perf_counter()
+            bg = rt.blocked_graph()
+            self._note_exec(bg.nblocks, bg.bwidth)
+            init = np.arange(bg.n_pad, dtype=np.float32)[:, None]
+            labels, rounds = components_blocked(bg.tab, bg.bcol, init)
+            labels = np.asarray(labels)[: ctx.n, 0].astype(np.int64)
+            count = int(np.unique(labels).size) if ctx.n else 0
+            self.rounds_cell.inc(int(rounds))
+            res = ComponentsResult(
+                found=True, labels=labels, count=count,
+                rounds=int(rounds), time_s=time.perf_counter() - t0,
+            )
+            self._fire("analytics_finish", queries)
+            return [res for _q in queries], None, t0
+
+
+class TrianglesBlockedRoute(AnalyticsBlockedRoute):
+    """The masked popcount matmul over the tile tables, column-chunked
+    at a static width (one compiled program per graph)."""
+
+    name = "triangles_blocked"
+    kind = "triangles"
+
+    def launch(self, rt, queries, ctx=None):
+        from bibfs_tpu.analytics.queries import TrianglesResult
+        from bibfs_tpu.ops.semiring_plane import triangles_chunk_blocked
+
+        with span("triangles_blocked_batch", batch=len(queries)):
+            self._fire("analytics", queries)
+            t0 = time.perf_counter()
+            bg = rt.blocked_graph()
+            self._note_exec(bg.nblocks, bg.bwidth, extra=(_TRI_CHUNK,))
+            n = ctx.n
+            src = (
+                np.repeat(
+                    np.arange(n, dtype=np.int64),
+                    np.diff(ctx.row_ptr).astype(np.int64),
+                )
+                if n else np.zeros(0, dtype=np.int64)
+            )
+            total = 0
+            chunks = 0
+            for c0 in range(0, n, _TRI_CHUNK):
+                c1 = min(n, c0 + _TRI_CHUNK)
+                plane = np.zeros((bg.n_pad, _TRI_CHUNK), np.float32)
+                m = (ctx.col_ind >= c0) & (ctx.col_ind < c1)
+                plane[src[m], ctx.col_ind[m] - c0] = 1.0
+                total += int(triangles_chunk_blocked(
+                    bg.tab, bg.bcol, plane
+                ))
+                chunks += 1
+            self.rounds_cell.inc(chunks)
+            res = TrianglesResult(
+                found=True, count=total // 6,
+                time_s=time.perf_counter() - t0,
+            )
+            self._fire("analytics_finish", queries)
+            return [res for _q in queries], None, t0
+
+
+def build_analytics_routes(engine, label: str) -> dict:
+    """The analytics rung set every engine carries (host + blocked per
+    kind), each with its OWN retry policy and circuit breaker."""
+    from bibfs_tpu.serve.resilience import CircuitBreaker, RetryPolicy
+
+    routes: dict = {}
+    for cls in (SsspRoute, PageRankRoute, ComponentsRoute,
+                TrianglesRoute, SsspBlockedRoute, PageRankBlockedRoute,
+                ComponentsBlockedRoute, TrianglesBlockedRoute):
+        routes[cls.name] = cls(
+            engine, retry=RetryPolicy(), breaker=CircuitBreaker(),
+            label=label,
+        )
+    return routes

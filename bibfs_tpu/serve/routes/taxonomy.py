@@ -80,6 +80,12 @@ KIND_ROUTES = {
     "weighted": "weighted",
     "kshortest": "kshortest",
     "asof": "asof",
+    # the whole-graph analytics kinds (serve/routes/analytics.py) —
+    # same contract, answers are vectors/scalars instead of paths
+    "sssp": "sssp",
+    "pagerank": "pagerank",
+    "components": "components",
+    "triangles": "triangles",
 }
 
 #: the per-kind ladder ``QueryEngine._flush_kind`` walks: the device
@@ -93,6 +99,10 @@ KIND_LADDERS = {
     "weighted": ("weighted_device", "weighted", "host"),
     "kshortest": ("kshortest_device", "kshortest", "host"),
     "asof": ("asof", "host"),
+    "sssp": ("sssp_blocked", "sssp", "host"),
+    "pagerank": ("pagerank_blocked", "pagerank", "host"),
+    "components": ("components_blocked", "components", "host"),
+    "triangles": ("triangles_blocked", "triangles", "host"),
 }
 
 #: eagerly minted (kind, route) label pairs — the render-at-zero set
@@ -105,6 +115,18 @@ KIND_ROUTE_LABELS = (
     ("kshortest", "kshortest"), ("kshortest", "kshortest_device"),
     ("kshortest", "host"), ("kshortest", "cache"),
     ("asof", "asof"), ("asof", "host"), ("asof", "cache"),
+    # the analytics kinds add a "store" route: answers served from the
+    # per-digest whole-graph result store (analytics/results.py)
+    ("sssp", "sssp"), ("sssp", "sssp_blocked"),
+    ("sssp", "host"), ("sssp", "cache"), ("sssp", "store"),
+    ("pagerank", "pagerank"), ("pagerank", "pagerank_blocked"),
+    ("pagerank", "host"), ("pagerank", "cache"), ("pagerank", "store"),
+    ("components", "components"), ("components", "components_blocked"),
+    ("components", "host"), ("components", "cache"),
+    ("components", "store"),
+    ("triangles", "triangles"), ("triangles", "triangles_blocked"),
+    ("triangles", "host"), ("triangles", "cache"),
+    ("triangles", "store"),
 )
 
 
@@ -634,4 +656,9 @@ def build_taxonomy_routes(engine, label: str) -> dict:
         ),
     }
     routes.update(build_taxonomy_device_routes(engine, label))
+    # the whole-graph analytics kinds (host + blocked rungs) ride every
+    # engine the same way — kind-dispatched, never from the pt ladder
+    from bibfs_tpu.serve.routes.analytics import build_analytics_routes
+
+    routes.update(build_analytics_routes(engine, label))
     return routes

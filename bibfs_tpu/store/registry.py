@@ -378,6 +378,17 @@ class GraphStore:
                 "Duration of the graph's last manifest+replay recovery",
                 ("store", "graph"),
             )
+        # the whole-graph analytics result store rides every registry
+        # (memory-only when the store is not durable); the note_* hooks
+        # below feed it the digest lineage its incremental maintenance
+        # walks
+        from bibfs_tpu.analytics.results import AnalyticsResultStore
+
+        self.analytics = AnalyticsResultStore(
+            root=(os.path.join(self.wal_dir, "analytics")
+                  if self.wal_dir is not None else None),
+            store_label=self.obs_label,
+        )
         self.oracle_k = None if oracle_k is None else int(oracle_k)
         if self.oracle_k is not None and self.oracle_k < 1:
             raise ValueError(f"oracle_k must be >= 1, got {oracle_k}")
@@ -456,6 +467,7 @@ class GraphStore:
                     if self._default == name:
                         self._default = min(self._entries, default=None)
                     self._g_graphs.set(len(self._entries))
+                self.analytics.purge(name)
                 raise
         self._kick_oracle(name, entry)
         self._maybe_rebalance()
@@ -505,6 +517,7 @@ class GraphStore:
                 self._g_index_age.labels(
                     store=self.obs_label, graph=name
                 ).set(0.0)
+        self.analytics.note_register(name, snapshot.digest)
         return entry
 
     @classmethod
@@ -1005,6 +1018,19 @@ class GraphStore:
             entry.touched = time.monotonic()  # the accountant's LRU stamp
             return entry.snapshot.retain()
 
+    def touch(self, name: str) -> None:
+        """Refresh ``name``'s access-recency stamp WITHOUT pinning —
+        the engines call this at their snapshot-pin seam (every flush
+        bind resolves through an already-retained runtime, so without
+        it a hot graph would keep the ``touched`` stamp of its first
+        acquire and :meth:`rebalance` would demote by acquisition
+        order, not true access recency). Unknown names are ignored:
+        the engine may race a remove, and recency is advisory."""
+        with self._lock:
+            entry = self._entries.get(str(name))
+            if entry is not None:
+                entry.touched = time.monotonic()
+
     def overlay(self, name: str) -> DeltaOverlay | None:
         """The graph's pending overlay, or None when it has no pending
         updates — the engines' exact-answering route check."""
@@ -1065,6 +1091,10 @@ class GraphStore:
                     overlay.apply(adds, dels, commit=False)
                     entry.wal.append(entry.snapshot.version, adds, dels)
                 counts = overlay.apply(adds, dels)
+                # feed the analytics result store the acked delta (a
+                # leaf-lock append — its incremental maintenance needs
+                # the adds-only lineage, and deletes mark a barrier)
+                self.analytics.note_update(name, adds, dels)
                 # the live graph changed: the oracle gen moves forward
                 # IN THE SAME locked section as the apply, so no reader
                 # can pair the new edge state with the old index
@@ -1368,6 +1398,11 @@ class GraphStore:
                     self._g_delta.labels(
                         store=self.obs_label, graph=name
                     ).set(len(a2) + len(d2))
+                    # rebase residue means the folded digest is NOT the
+                    # exact sum of the noted updates — a lineage barrier
+                    self.analytics.note_fold(
+                        name, new.digest, clean=not (a2 or d2)
+                    )
                     entry.compactions += 1
                     self._c_compactions.labels(
                         store=self.obs_label, graph=name
@@ -1488,6 +1523,8 @@ class GraphStore:
                             store=self.obs_label, graph=name
                         ).inc()
                 old = self._swap_locked(name, entry, snapshot)
+                # declared-truth replacement: no maintainable lineage
+                self.analytics.note_swap(name, snapshot.digest)
                 entry.overlay = None
                 self._g_delta.labels(
                     store=self.obs_label, graph=name
@@ -1685,6 +1722,9 @@ class GraphStore:
                 "retain_history": self.retain_history,
                 "fsync": self.fsync if self.wal_dir is not None else None,
                 "load_errors": list(self.load_errors),
+                # leaf lock below this one — same order as the commit
+                # hooks (note_update/note_fold under self._lock)
+                "analytics": self.analytics.stats(),
             }
 
     def _oracle_stats_locked(self, entry: _Entry) -> dict | None:

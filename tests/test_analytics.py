@@ -1,0 +1,251 @@
+"""Analytics tier units: the semiring host solvers verified against
+their independent references at a deliberately non-128-multiple ``n``,
+the empty/disconnected edge cases, the spec→query builder's
+``error invalid:`` seam, the scalars-only summary shapes, and the
+whole-graph result store's lifecycle (hit / delete-invalidate /
+adds-only incremental maintenance / durable respawn load)."""
+
+import numpy as np
+import pytest
+
+from bibfs_tpu.analytics.queries import (
+    ANALYTICS_KINDS,
+    Components,
+    PageRank,
+    Sssp,
+    Triangles,
+    analytics_query_from_spec,
+    analytics_summary,
+)
+from bibfs_tpu.analytics.results import (
+    AnalyticsResultStore,
+    maintain_components,
+    maintain_sssp,
+)
+from bibfs_tpu.analytics.semiring import (
+    host_components,
+    host_pagerank,
+    host_sssp,
+    host_triangles,
+    ref_components_unionfind,
+    ref_pagerank_dense,
+    ref_triangles_intersect,
+)
+from bibfs_tpu.graph.csr import build_csr
+from bibfs_tpu.graph.generate import gnp_random_graph
+from bibfs_tpu.query.weighted import dijkstra_numpy, synthetic_weights
+
+# deliberately NOT a multiple of the 128 tile edge — the padding seam
+N = 137
+
+
+def _graph(seed=3, n=N, p=None):
+    edges = gnp_random_graph(n, p if p is not None else 6.0 / n,
+                             seed=seed)
+    rp, ci = build_csr(n, edges)
+    return n, edges, rp, ci
+
+
+# ---- host solvers vs references (non-128-multiple n) ----------------
+def test_host_sssp_matches_dijkstra():
+    n, _, rp, ci = _graph()
+    w = synthetic_weights(rp, ci, 0)
+    dist, rounds = host_sssp(n, rp, ci, w, [5, 99])
+    assert dist.shape == (n, 2) and rounds >= 1
+    for col, src in enumerate((5, 99)):
+        ref, _ = dijkstra_numpy(n, rp, ci, w, src)
+        assert np.allclose(dist[:, col], ref, atol=1e-9, equal_nan=True)
+
+
+def test_host_pagerank_matches_dense_power_iteration():
+    n, _, rp, ci = _graph(seed=7)
+    ranks, iters, delta = host_pagerank(n, rp, ci, damping=0.85,
+                                        tol=1e-10, max_iters=300)
+    ref = ref_pagerank_dense(n, rp, ci, damping=0.85, tol=1e-10,
+                             max_iters=300)
+    assert iters >= 1 and delta <= 1e-10
+    assert abs(ranks.sum() - 1.0) < 1e-9
+    assert np.max(np.abs(ranks - ref)) < 1e-8
+
+
+def test_host_components_matches_unionfind():
+    n, edges, rp, ci = _graph(seed=11, p=2.0 / N)  # sparse → many comps
+    labels, count, rounds = host_components(n, rp, ci)
+    ref_labels, ref_count = ref_components_unionfind(n, edges)
+    assert count == ref_count > 1 and rounds >= 1
+    assert np.array_equal(labels, ref_labels)
+
+
+def test_host_triangles_matches_intersection():
+    n, _, rp, ci = _graph(seed=13, p=10.0 / N)
+    count, chunks = host_triangles(n, rp, ci)
+    assert count == ref_triangles_intersect(n, rp, ci)
+    assert count > 0 and chunks >= 1
+
+
+# ---- empty / disconnected edge cases --------------------------------
+def test_empty_graph_all_kinds():
+    n = 9
+    rp, ci = build_csr(n, np.zeros((0, 2), dtype=np.int64))
+    w = synthetic_weights(rp, ci, 0)
+    dist, _ = host_sssp(n, rp, ci, w, [4])
+    assert dist[4, 0] == 0.0
+    assert np.isinf(np.delete(dist[:, 0], 4)).all()
+    ranks, _, _ = host_pagerank(n, rp, ci)
+    assert np.allclose(ranks, 1.0 / n)  # no links → uniform
+    labels, count, _ = host_components(n, rp, ci)
+    assert count == n and np.array_equal(labels, np.arange(n))
+    tri, _ = host_triangles(n, rp, ci)
+    assert tri == 0
+
+
+def test_disconnected_graph_sssp_and_components():
+    # two cliques, no bridge: 0-1-2-3 and 4-5-6
+    edges = np.array([[0, 1], [1, 2], [2, 3], [0, 3],
+                      [4, 5], [5, 6], [4, 6]])
+    n = 7
+    rp, ci = build_csr(n, edges)
+    w = synthetic_weights(rp, ci, 0)
+    dist, _ = host_sssp(n, rp, ci, w, [0])
+    assert np.isfinite(dist[:4, 0]).all()
+    assert np.isinf(dist[4:, 0]).all()  # the far island is unreachable
+    labels, count, _ = host_components(n, rp, ci)
+    assert count == 2
+    assert set(labels[:4]) == {0} and set(labels[4:]) == {4}
+
+
+# ---- spec → query builder (the control-op seam) ---------------------
+def test_query_from_spec_roundtrip():
+    assert analytics_query_from_spec(
+        "sssp", {"source": "7", "weight_seed": 2}
+    ) == Sssp(7, weight_seed=2)
+    assert analytics_query_from_spec(
+        "pagerank", {"damping": "0.9", "tol": 1e-6, "max_iters": "40"}
+    ) == PageRank(damping=0.9, tol=1e-6, max_iters=40)
+    assert analytics_query_from_spec("components", {}) == Components()
+    assert analytics_query_from_spec("triangles", None) == Triangles()
+
+
+@pytest.mark.parametrize("kind,params,msg", [
+    ("katz", {}, "unknown analytics kind"),
+    ("sssp", {}, "needs source"),
+    ("sssp", {"source": 1, "bogus": 2}, "unknown sssp params"),
+    ("triangles", {"chunk": 4}, "unknown triangles params"),
+])
+def test_query_from_spec_rejects(kind, params, msg):
+    with pytest.raises(ValueError, match=msg):
+        analytics_query_from_spec(kind, params)
+
+
+def test_summary_shapes_are_scalars_only():
+    import json
+
+    n, _, rp, ci = _graph(seed=5)
+    w = synthetic_weights(rp, ci, 0)
+    from bibfs_tpu.analytics.queries import (
+        ComponentsResult, PageRankResult, SsspResult, TrianglesResult,
+    )
+
+    dist, rounds = host_sssp(n, rp, ci, w, [0])
+    ranks, iters, delta = host_pagerank(n, rp, ci)
+    labels, count, crounds = host_components(n, rp, ci)
+    tri, _ = host_triangles(n, rp, ci)
+    results = {
+        "sssp": SsspResult(True, dist[:, 0],
+                           int(np.isfinite(dist[:, 0]).sum()),
+                           rounds, 0.0),
+        "pagerank": PageRankResult(True, ranks, iters, delta, 0.0),
+        "components": ComponentsResult(True, labels, count, crounds,
+                                       0.0),
+        "triangles": TrianglesResult(True, tri, 0.0),
+    }
+    assert set(results) == set(ANALYTICS_KINDS)
+    for kind, res in results.items():
+        s = analytics_summary(res)
+        assert s["kind"] == kind and s["found"] is True
+        json.dumps(s)  # wire-safe: no arrays leaked into the summary
+    with pytest.raises(ValueError, match="not an analytics result"):
+        analytics_summary(object())
+
+
+# ---- whole-graph result store ---------------------------------------
+def _ev(store):
+    return store.stats()["events"]
+
+
+def test_result_store_hit_and_delete_invalidation():
+    st = AnalyticsResultStore(store_label="t-ana-inv")
+    st.note_register("g", "d0")
+    st.put("g", ("triangles",), "d0", "triangles", {},
+           {"count": 4, "found": True})
+    got = st.lookup("g", ("triangles",), "d0")
+    assert got is not None and got[0] == "hit"
+    assert got[1].scalars["count"] == 4
+    base = _ev(st)
+    # a delta batch WITH deletes folds to d1: nothing is maintainable
+    st.note_update("g", np.array([[1, 2]]), np.array([[0, 1]]))
+    st.note_fold("g", "d1", clean=True)
+    assert st.lookup("g", ("triangles",), "d1") is None
+    ev = _ev(st)
+    assert ev["invalidated"] == base["invalidated"] + 1
+    assert st.stats()["entries"] == 0
+
+
+def test_result_store_adds_only_maintenance_matches_recompute():
+    n, edges, rp, ci = _graph(seed=17)
+    w = synthetic_weights(rp, ci, 0)
+    dist, _ = host_sssp(n, rp, ci, w, [3])
+    labels, count, _ = host_components(n, rp, ci)
+
+    st = AnalyticsResultStore(store_label="t-ana-maint")
+    st.note_register("g", "d0")
+    st.put("g", ("sssp", 3, 0), "d0", "sssp", {"dist": dist[:, 0]},
+           {"found": True})
+    st.put("g", ("components",), "d0", "components",
+           {"labels": labels}, {"count": count, "found": True})
+    adds = np.array([[0, 70], [12, 100], [5, 64]], dtype=np.int64)
+    st.note_update("g", adds, None)
+    st.note_fold("g", "d1", clean=True)
+
+    new_edges = np.concatenate([edges, adds])
+    rp2, ci2 = build_csr(n, new_edges)
+    w2 = synthetic_weights(rp2, ci2, 0)
+
+    got = st.lookup("g", ("sssp", 3, 0), "d1")
+    assert got is not None and got[0] == "maintain"
+    _, entry, chain = got
+    assert chain.shape == (3, 2)
+    d_inc, relaxed = maintain_sssp(entry.arrays["dist"], chain, n,
+                                   rp2, ci2, w2, 0)
+    d_ref, _ = host_sssp(n, rp2, ci2, w2, [3])
+    assert np.allclose(d_inc, d_ref[:, 0], atol=1e-9, equal_nan=True)
+    st.commit_maintained("g", ("sssp", 3, 0), "d1", "sssp",
+                         {"dist": d_inc}, {"found": True})
+
+    got = st.lookup("g", ("components",), "d1")
+    assert got is not None and got[0] == "maintain"
+    l_inc, c_inc = maintain_components(got[1].arrays["labels"],
+                                       got[2], n)
+    l_ref, c_ref = ref_components_unionfind(n, new_edges)
+    assert c_inc == c_ref and np.array_equal(l_inc, l_ref)
+
+    ev = _ev(st)
+    assert ev["incremental"] >= 1
+    # the maintained sssp entry now serves at d1 as a plain hit
+    got = st.lookup("g", ("sssp", 3, 0), "d1")
+    assert got is not None and got[0] == "hit"
+
+
+def test_result_store_durable_respawn_load(tmp_path):
+    root = str(tmp_path / "ana")
+    st = AnalyticsResultStore(root, store_label="t-ana-dur")
+    st.note_register("g", "d0")
+    arr = np.arange(6, dtype=np.float64)
+    st.put("g", ("sssp", 0, 0), "d0", "sssp", {"dist": arr},
+           {"found": True})
+    # a second store over the same root = the respawned process
+    st2 = AnalyticsResultStore(root, store_label="t-ana-dur2")
+    got = st2.lookup("g", ("sssp", 0, 0), "d0")
+    assert got is not None and got[0] == "hit"
+    assert np.array_equal(np.asarray(got[1].arrays["dist"]), arr)
+    assert _ev(st2)["load"] >= 1

@@ -1,0 +1,283 @@
+"""Analytics tier through the serving stack: both engines answer every
+kind exactly (host rung and the blocked rung forced on, at a
+non-tile-multiple ``n``), the per-digest result store serves repeats /
+invalidates on deletes / maintains adds-only deltas / survives respawn
+by mmap, the adaptive ladder learns per-``digest#kind`` entries, the
+residency accountant sees REAL access recency through the engines'
+snapshot-pin ``touch`` seam, and the ``analytics`` control op answers
+on both the stdin REPL and the net protocol."""
+
+import io
+import json
+
+import numpy as np
+import pytest
+
+from bibfs_tpu.analytics.queries import (
+    ANALYTICS_KINDS,
+    Components,
+    PageRank,
+    Sssp,
+    Triangles,
+)
+from bibfs_tpu.analytics.semiring import (
+    ref_components_unionfind,
+    ref_pagerank_dense,
+    ref_triangles_intersect,
+)
+from bibfs_tpu.graph.csr import build_csr
+from bibfs_tpu.graph.generate import gnp_random_graph
+from bibfs_tpu.graph.io import write_graph_bin
+from bibfs_tpu.query.weighted import dijkstra_numpy, synthetic_weights
+from bibfs_tpu.serve.engine import QueryEngine
+from bibfs_tpu.serve.pipeline import PipelinedQueryEngine
+from bibfs_tpu.store import GraphStore
+
+# deliberately not a multiple of the 128 tile edge
+N = 150
+EDGES = gnp_random_graph(N, 8.0 / N, seed=21)
+
+
+def _kind_queries(src=4):
+    return [Sssp(src), PageRank(), Components(), Triangles()]
+
+
+def _check_all(n, edges, results, src=4):
+    rp, ci = build_csr(n, edges)
+    w = synthetic_weights(rp, ci, 0)
+    sssp, pr, comp, tri = results
+    ref_d, _ = dijkstra_numpy(n, rp, ci, w, src)
+    assert np.allclose(sssp.dist, ref_d, atol=1e-9, equal_nan=True)
+    assert sssp.reached == int(np.isfinite(ref_d).sum())
+    ref_r = ref_pagerank_dense(n, rp, ci)
+    assert np.max(np.abs(pr.ranks - ref_r)) < 2e-4
+    ref_l, ref_c = ref_components_unionfind(n, edges)
+    assert comp.count == ref_c and np.array_equal(comp.labels, ref_l)
+    assert tri.count == ref_triangles_intersect(n, rp, ci)
+
+
+def _force_rung(engine, min_edges):
+    for k in ANALYTICS_KINDS:
+        engine.routes[f"{k}_blocked"].min_edges = min_edges
+
+
+# ---- both engines, both rungs ---------------------------------------
+def test_host_rungs_serve_all_kinds_exact():
+    eng = PipelinedQueryEngine(N, EDGES, max_wait_ms=None)
+    try:
+        _force_rung(eng, 1 << 30)  # host only
+        res = [eng.query_one(q) for q in _kind_queries()]
+        _check_all(N, EDGES, res)
+        kinds = eng.stats()["query_kinds"]
+        for k in ANALYTICS_KINDS:
+            assert kinds[k].get(k, 0) == 1  # the host route's label
+            assert not kinds[k].get(f"{k}_blocked")
+    finally:
+        eng.close()
+
+
+def test_blocked_rungs_serve_all_kinds_exact_at_non_tile_n():
+    eng = QueryEngine(N, EDGES)
+    try:
+        _force_rung(eng, 0)  # blocked wherever eligible
+        res = [eng.query_one(q) for q in _kind_queries()]
+        _check_all(N, EDGES, res)
+        kinds = eng.stats()["query_kinds"]
+        for k in ANALYTICS_KINDS:
+            assert kinds[k].get(f"{k}_blocked", 0) == 1
+    finally:
+        eng.close()
+
+
+def test_kind_cache_serves_repeat_without_resolve():
+    eng = QueryEngine(N, EDGES)
+    try:
+        r1 = eng.query_one(Triangles())
+        r2 = eng.query_one(Triangles())
+        assert r1.count == r2.count
+        served = eng.stats()["query_kinds"]["triangles"]
+        assert sum(served.values()) == 2  # both answers counted
+    finally:
+        eng.close()
+
+
+# ---- result store lifecycle through the engines ---------------------
+def test_result_store_lifecycle_and_respawn(tmp_path):
+    wal = str(tmp_path / "store")
+    (tmp_path / "store").mkdir()
+    store = GraphStore(compact_threshold=None, wal_dir=wal,
+                       fsync="off")
+    try:
+        n, src = 120, 5
+        edges = gnp_random_graph(n, 7.0 / n, seed=9)
+        store.add("g", n, edges)
+        qs = _kind_queries(src)
+
+        eng1 = QueryEngine(store=store, graph="g")
+        res1 = [eng1.query_one(q) for q in qs]
+        _check_all(n, edges, res1, src)
+        ev = store.analytics.stats()["events"]
+        assert ev["put"] >= len(qs)  # vectors banked as sidecars
+
+        # a SECOND engine re-serves from the store, zero recompute
+        eng2 = PipelinedQueryEngine(store=store, graph="g",
+                                    max_wait_ms=None)
+        res2 = [eng2.query_one(q) for q in qs]
+        _check_all(n, edges, res2, src)
+        k2 = eng2.stats()["query_kinds"]
+        assert all(
+            k2[k].get("store", 0) == 1 for k in ANALYTICS_KINDS
+        )
+        eng2.close()
+
+        # delete-roll: stored vectors invalidate, fresh answers exact
+        inv0 = store.analytics.stats()["events"]["invalidated"]
+        dels = [tuple(e) for e in np.asarray(edges)[:3].tolist()]
+        adds = [(0, 77), (1, 90)]
+        store.roll("g", adds=adds, dels=dels)
+        edges2 = np.array(sorted(
+            (set(map(tuple, np.asarray(edges).tolist())) - set(dels))
+            | set(adds)
+        ))
+        res3 = [eng1.query_one(q) for q in qs]
+        _check_all(n, edges2, res3, src)
+        assert store.analytics.stats()["events"]["invalidated"] > inv0
+
+        # adds-only delta: sssp/components MAINTAIN, no full recompute
+        ev0 = store.analytics.stats()["events"]
+        adds2 = [(2, 101), (3, 88)]
+        store.update("g", adds=adds2)
+        store.compact("g")
+        edges3 = np.array(sorted(
+            set(map(tuple, edges2.tolist())) | set(adds2)
+        ))
+        qs_inc = [Sssp(src), Components()]
+        res4 = [eng1.query_one(q) for q in qs_inc]
+        rp3, ci3 = build_csr(n, edges3)
+        w3 = synthetic_weights(rp3, ci3, 0)
+        ref_d, _ = dijkstra_numpy(n, rp3, ci3, w3, src)
+        assert np.allclose(res4[0].dist, ref_d, atol=1e-9,
+                           equal_nan=True)
+        ref_l, ref_c = ref_components_unionfind(n, edges3)
+        assert res4[1].count == ref_c
+        assert np.array_equal(res4[1].labels, ref_l)
+        ev1 = store.analytics.stats()["events"]
+        assert ev1["incremental"] - ev0["incremental"] >= 2
+        assert ev1["put"] == ev0["put"]
+        eng1.close()
+    finally:
+        store.close()
+
+    # respawn: a fresh process adopts the sidecars and serves by mmap
+    store_r = GraphStore.from_dir(wal, durable=True)
+    try:
+        eng_r = QueryEngine(store=store_r, graph="g")
+        lo = store_r.analytics.stats()["events"]["load"]
+        r = eng_r.query_one(Sssp(5))
+        assert r.found and store_r.analytics.stats()["events"]["load"] > lo
+        kr = eng_r.stats()["query_kinds"]
+        assert kr["sssp"].get("store", 0) == 1
+        eng_r.close()
+    finally:
+        store_r.close()
+
+
+# ---- adaptive ladder learns the new kinds ---------------------------
+def test_adaptive_ladder_learns_analytics_kinds():
+    store = GraphStore(compact_threshold=None)
+    try:
+        store.add("g", N, EDGES)
+        eng = QueryEngine(store=store, graph="g", adaptive=True)
+        eng.query_one(Sssp(2))
+        eng.query_one(Triangles())
+        pol = (eng.stats().get("adaptive") or {}).get("digests", {})
+        learned = {k.rsplit("#", 1)[1] for k in pol if "#" in k}
+        assert {"sssp", "triangles"} <= learned
+        eng.close()
+    finally:
+        store.close()
+
+
+# ---- residency accountant sees real access recency ------------------
+def test_touch_keeps_served_graph_ahead_of_idle_one():
+    """The satellite regression: graph "a" was ACQUIRED first (older
+    acquire stamp) but is the one actually being served — the engine's
+    snapshot-pin seam calls ``store.touch``, so the accountant demotes
+    the idle later-registered "b" first, not the hot "a"."""
+    store = GraphStore(compact_threshold=None)
+    try:
+        rng = np.random.default_rng(31)
+        store.add("a", 90, rng.integers(0, 90, size=(300, 2)))
+        eng = QueryEngine(store=store, graph="a")  # acquires "a" NOW
+        store.add("b", 90, rng.integers(0, 90, size=(300, 2)))
+        # "b" now has the freshest stamp; serving refreshes "a" past it
+        assert eng.query_one(Components()).found
+        store.touch("nope")  # unknown names are ignored, not an error
+        ms = store.memory_stats()
+        store.residency_budget = ms["resident_bytes"] - 1
+        out = store.rebalance()
+        assert out["demoted"] == ["b"]
+        ms = store.memory_stats()["graphs"]
+        assert ms["a"]["tier"] == "hot" and ms["b"]["tier"] == "cold"
+        eng.close()
+    finally:
+        store.close()
+
+
+# ---- the analytics control op on both front doors -------------------
+def test_cli_analytics_command(tmp_path, capsys, monkeypatch):
+    from bibfs_tpu.serve.cli import main as serve_main
+
+    gpath = tmp_path / "g.bin"
+    write_graph_bin(gpath, N, EDGES)
+    monkeypatch.setattr("sys.stdin", io.StringIO(
+        "0 50\n"
+        "analytics components\n"
+        "analytics sssp source=4\n"
+        "analytics katz\n"
+        "analytics sssp bogus\n"
+        "3 40\n"
+    ))
+    rc = serve_main([str(gpath), "--no-path"])
+    assert rc == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    ana = [ln for ln in out if ln.startswith("analytics ")]
+    assert len(ana) == 2
+    comp = json.loads(ana[0][len("analytics "):])
+    rp, ci = build_csr(N, EDGES)
+    _, ref_c = ref_components_unionfind(N, EDGES)
+    assert comp["kind"] == "components" and comp["count"] == ref_c
+    sssp = json.loads(ana[1][len("analytics "):])
+    w = synthetic_weights(rp, ci, 0)
+    ref_d, _ = dijkstra_numpy(N, rp, ci, w, 4)
+    assert sssp["reached"] == int(np.isfinite(ref_d).sum())
+    bad = [ln for ln in out if ln.startswith("error invalid:")]
+    assert any("unknown analytics kind" in ln for ln in bad)
+    assert any("bad token 'bogus'" in ln for ln in bad)
+    assert sum(": length = " in ln for ln in out) == 2  # REPL lives on
+
+
+def test_net_analytics_control_op():
+    from bibfs_tpu.serve.net import NetClient, NetServer
+    from bibfs_tpu.serve.resilience import QueryError
+
+    eng = PipelinedQueryEngine(N, EDGES, max_wait_ms=5.0)
+    srv = NetServer(eng, host="127.0.0.1", port=0)
+    client = NetClient(srv.host, srv.port)
+    try:
+        rp, ci = build_csr(N, EDGES)
+        r = client.request("analytics", kind="triangles")
+        assert r["count"] == ref_triangles_intersect(N, rp, ci)
+        # string params coerce — wire parity with the REPL tokens
+        r = client.request("analytics", kind="pagerank",
+                           params={"damping": "0.9", "max_iters": "50"})
+        assert r["kind"] == "pagerank" and r["iters"] <= 50
+        for bad in ({"kind": "bogus"}, {"kind": "sssp"},
+                    {"kind": "sssp", "params": {"source": 3, "x": 1}}):
+            with pytest.raises(QueryError) as ei:
+                client.request("analytics", **bad)
+            assert ei.value.kind == "invalid"
+    finally:
+        client.close()
+        srv.close()
+        eng.close()

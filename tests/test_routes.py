@@ -62,7 +62,13 @@ def test_route_registry_and_ladder_default():
                                # (serve/routes/taxonomy_device.py)
                                "msbfs", "weighted", "kshortest", "asof",
                                "msbfs_device", "weighted_device",
-                               "kshortest_device"}
+                               "kshortest_device",
+                               # the analytics kind routes too
+                               # (serve/routes/analytics.py)
+                               "sssp", "pagerank", "components",
+                               "triangles", "sssp_blocked",
+                               "pagerank_blocked", "components_blocked",
+                               "triangles_blocked"}
     assert eng._ladder == ("device", "host")
     st = eng.stats()
     assert st["ladder"] == ["device", "host"]
